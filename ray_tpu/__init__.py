@@ -1,0 +1,57 @@
+"""ray_tpu: a TPU-native distributed AI runtime.
+
+Capability surface of the reference Ray (tasks, actors, objects, placement
+groups, Train/Tune/Serve/Data/RL libraries) rebuilt TPU-first: JAX/XLA/pjit
+for all device compute, mesh-sharded SPMD gangs as first-class scheduling
+units, collectives in-band over ICI/DCN, device arrays referenced (never
+copied) by the object layer. See SURVEY.md for the design blueprint.
+"""
+
+__version__ = "0.1.0"
+
+from ray_tpu._private.worker import init, shutdown, is_initialized
+from ray_tpu.api import (
+    ActorClass,
+    ActorHandle,
+    RemoteFunction,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    kill,
+    put,
+    remote,
+    timeline,
+    wait,
+)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu import exceptions
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+)
+
+# Subpackages (imported lazily by users): ray_tpu.mesh, ray_tpu.train,
+# ray_tpu.tune, ray_tpu.serve, ray_tpu.data, ray_tpu.rllib, ray_tpu.util
+
+
+def method(**kwargs):
+    """Decorator for actor methods to set per-method defaults
+    (num_returns), reference: python/ray/actor.py ``@ray.method``."""
+    def wrapper(f):
+        f.__ray_tpu_method_opts__ = kwargs
+        return f
+    return wrapper
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "cancel", "kill", "get_actor", "ObjectRef", "ActorClass", "ActorHandle",
+    "RemoteFunction", "cluster_resources", "available_resources",
+    "timeline", "method", "exceptions", "TaskError", "ActorDiedError",
+    "ObjectLostError", "GetTimeoutError", "TaskCancelledError",
+]

@@ -1,0 +1,99 @@
+"""ObjectRef: the client-side handle to a (possibly pending) object.
+
+Capability parity with the reference's ObjectRef (python/ray/_raylet.pyx
+ObjectRef + C++ reference_count.h): holding a ref pins the object; refs are
+counted per-process and deserializing a ref inside a task registers a borrow
+with the owner.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_hint", "_weakref__", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_hint: Optional[str] = None,
+                 _register_borrow: bool = False, _skip_incref: bool = False):
+        self.id = object_id
+        self.owner_hint = owner_hint  # node/worker hint for the dist. runtime
+        if not _skip_incref:
+            rc = _global_reference_counter()
+            if rc is not None:
+                rc.add_local_ref(object_id, borrowed=_register_borrow)
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the object's value."""
+        from ray_tpu._private.worker import global_worker
+        return global_worker().runtime.object_future(self.id)
+
+    def __await__(self):
+        """Support ``await ref`` inside async actors / async drivers."""
+        import asyncio
+        fut = self.future()
+        loop = asyncio.get_event_loop()
+        afut = loop.create_future()
+
+        def _done(f):
+            def _set():
+                if afut.cancelled():
+                    return
+                exc = f.exception()
+                if exc is not None:
+                    afut.set_exception(exc)
+                else:
+                    afut.set_result(f.result())
+            loop.call_soon_threadsafe(_set)
+
+        fut.add_done_callback(_done)
+        return afut.__await__()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __del__(self):
+        rc = _global_reference_counter()
+        if rc is not None:
+            try:
+                rc.remove_local_ref(self.id)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Plain-pickle fallback (normal path goes through
+        # serialization._Pickler.persistent_id).
+        return (_deserialize_ref, (self.id.binary(), self.owner_hint))
+
+
+def _deserialize_ref(binary: bytes, owner_hint):
+    return ObjectRef(ObjectID(binary), owner_hint=owner_hint,
+                     _register_borrow=True)
+
+
+_rc_lock = threading.Lock()
+_rc: Optional[Any] = None
+
+
+def _global_reference_counter():
+    return _rc
+
+
+def set_global_reference_counter(rc) -> None:
+    global _rc
+    with _rc_lock:
+        _rc = rc

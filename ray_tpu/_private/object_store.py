@@ -1,0 +1,270 @@
+"""In-process object store + distributed reference counting.
+
+Two reference capabilities re-designed for one process (the local runtime) and
+reused by the node runtime:
+
+- CoreWorkerMemoryStore (reference:
+  src/ray/core_worker/store_provider/memory_store/memory_store.h): value slots
+  with futures, async waiters, inlined small objects.
+- ReferenceCounter (reference: src/ray/core_worker/reference_count.h):
+  local refcounts, borrows, lineage pinning, eviction on zero refs.
+
+Values are stored as Python objects (zero-copy within a process — the
+distributed path serializes via ray_tpu._private.serialization, device arrays
+are referenced, not copied: see mesh/device_objects.py).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+
+
+def _sizeof(value: Any) -> int:
+    try:
+        import numpy as np
+        if isinstance(value, np.ndarray):
+            return value.nbytes
+    except Exception:
+        pass
+    try:
+        return sys.getsizeof(value)
+    except Exception:
+        return 64
+
+
+class _Entry:
+    __slots__ = ("value", "is_exception", "ready", "size", "create_time",
+                 "pinned")
+
+    def __init__(self):
+        self.value = None
+        self.is_exception = False
+        self.ready = threading.Event()
+        self.size = 0
+        self.create_time = 0.0
+        self.pinned = False
+
+
+class MemoryStore:
+    """Thread-safe keyed future store."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._futures: Dict[ObjectID, List[Future]] = {}
+        self.total_bytes = 0
+
+    def _entry(self, oid: ObjectID) -> _Entry:
+        e = self._entries.get(oid)
+        if e is None:
+            e = _Entry()
+            self._entries[oid] = e
+        return e
+
+    def put(self, oid: ObjectID, value: Any, is_exception: bool = False):
+        with self._lock:
+            e = self._entry(oid)
+            if e.ready.is_set():
+                return  # immutable: first write wins
+            e.value = value
+            e.is_exception = is_exception
+            e.size = _sizeof(value)
+            e.create_time = time.time()
+            self.total_bytes += e.size
+            futures = self._futures.pop(oid, [])
+        e.ready.set()
+        for f in futures:
+            self._resolve_future(f, e)
+
+    @staticmethod
+    def _resolve_future(f: Future, e: _Entry):
+        if f.set_running_or_notify_cancel():
+            if e.is_exception:
+                f.set_exception(e.value)
+            else:
+                f.set_result(e.value)
+
+    def future(self, oid: ObjectID) -> Future:
+        f: Future = Future()
+        with self._lock:
+            e = self._entry(oid)
+            if not e.ready.is_set():
+                self._futures.setdefault(oid, []).append(f)
+                return f
+        self._resolve_future(f, e)
+        return f
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(oid)
+            return e is not None and e.ready.is_set()
+
+    def get(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
+        with self._lock:
+            e = self._entry(oid)
+        if not e.ready.wait(timeout):
+            raise GetTimeoutError(
+                f"Get timed out after {timeout}s waiting for "
+                f"{oid.hex()[:16]}…")
+        if e.is_exception:
+            raise e.value
+        return e.value
+
+    def wait(self, oids: List[ObjectID], num_returns: int,
+             timeout: Optional[float]) -> tuple:
+        deadline = None if timeout is None else time.time() + timeout
+        ready: List[ObjectID] = []
+        remaining = list(oids)
+        while True:
+            still = []
+            for oid in remaining:
+                if self.contains(oid):
+                    if oid not in ready:
+                        ready.append(oid)
+                else:
+                    still.append(oid)
+            remaining = still
+            if len(ready) >= num_returns or not remaining:
+                return ready, remaining
+            if deadline is not None and time.time() >= deadline:
+                return ready, remaining
+            time.sleep(0.001)
+
+    def delete(self, oid: ObjectID):
+        with self._lock:
+            e = self._entries.pop(oid, None)
+            if e is not None and e.ready.is_set():
+                self.total_bytes -= e.size
+            for f in self._futures.pop(oid, []):
+                if f.set_running_or_notify_cancel():
+                    f.set_exception(ObjectLostError(oid, "deleted"))
+
+    def mark_lost(self, oid: ObjectID, reason: str = "evicted"):
+        """Drop a value but keep the slot pending (for reconstruction)."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None and e.ready.is_set():
+                self.total_bytes -= e.size
+                self._entries[oid] = _Entry()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            ready = sum(1 for e in self._entries.values()
+                        if e.ready.is_set())
+            return {"num_objects": len(self._entries),
+                    "num_ready": ready,
+                    "total_bytes": self.total_bytes}
+
+    def keys(self) -> List[ObjectID]:
+        with self._lock:
+            return list(self._entries.keys())
+
+
+class Reference:
+    __slots__ = ("local_refs", "borrows", "submitted_task_refs",
+                 "lineage_task", "on_zero")
+
+    def __init__(self):
+        self.local_refs = 0
+        self.borrows = 0
+        self.submitted_task_refs = 0
+        self.lineage_task: Optional[TaskID] = None
+        self.on_zero: Optional[Callable] = None
+
+    def total(self) -> int:
+        return self.local_refs + self.borrows + self.submitted_task_refs
+
+
+class ReferenceCounter:
+    """Per-process distributed-GC bookkeeping (local-runtime flavor: one
+    process owns everything, borrows model refs held by tasks/actors)."""
+
+    def __init__(self, on_object_released: Optional[Callable] = None):
+        self._lock = threading.RLock()
+        self._refs: Dict[ObjectID, Reference] = {}
+        self._on_object_released = on_object_released
+        self.enabled = True
+
+    def _ref(self, oid: ObjectID) -> Reference:
+        r = self._refs.get(oid)
+        if r is None:
+            r = Reference()
+            self._refs[oid] = r
+        return r
+
+    def add_local_ref(self, oid: ObjectID, borrowed: bool = False):
+        if not self.enabled:
+            return
+        with self._lock:
+            r = self._ref(oid)
+            if borrowed:
+                r.borrows += 1
+            else:
+                r.local_refs += 1
+
+    def remove_local_ref(self, oid: ObjectID):
+        if not self.enabled:
+            return
+        released = False
+        with self._lock:
+            r = self._refs.get(oid)
+            if r is None:
+                return
+            if r.borrows > 0 and r.local_refs == 0:
+                r.borrows -= 1
+            elif r.local_refs > 0:
+                r.local_refs -= 1
+            if r.total() <= 0:
+                del self._refs[oid]
+                released = True
+        if released and self._on_object_released is not None:
+            self._on_object_released(oid)
+
+    def add_submitted_task_ref(self, oid: ObjectID):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ref(oid).submitted_task_refs += 1
+
+    def remove_submitted_task_ref(self, oid: ObjectID):
+        if not self.enabled:
+            return
+        released = False
+        with self._lock:
+            r = self._refs.get(oid)
+            if r is None:
+                return
+            r.submitted_task_refs -= 1
+            if r.total() <= 0:
+                del self._refs[oid]
+                released = True
+        if released and self._on_object_released is not None:
+            self._on_object_released(oid)
+
+    def set_lineage(self, oid: ObjectID, task_id: TaskID):
+        with self._lock:
+            self._ref(oid).lineage_task = task_id
+
+    def lineage(self, oid: ObjectID) -> Optional[TaskID]:
+        with self._lock:
+            r = self._refs.get(oid)
+            return r.lineage_task if r else None
+
+    def ref_count(self, oid: ObjectID) -> int:
+        with self._lock:
+            r = self._refs.get(oid)
+            return r.total() if r else 0
+
+    def live_objects(self) -> Set[ObjectID]:
+        with self._lock:
+            return set(self._refs.keys())
+
+    def clear(self):
+        with self._lock:
+            self._refs.clear()

@@ -1,0 +1,96 @@
+"""Task timeline profiling.
+
+Capability parity with the reference's profile-event pipeline
+(src/ray/core_worker/profiling.h, python/ray/_private/profiling.py,
+GlobalState.chrome_tracing_dump in python/ray/_private/state.py:413): every
+runtime records named events/spans; ``timeline()`` dumps a Chrome
+``chrome://tracing`` JSON. The TPU flavor can merge XLA profiler traces via
+``merge_xla_trace``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_events: List[Dict[str, Any]] = []
+_enabled = True
+_open_spans: Dict[tuple, float] = {}
+
+
+def set_enabled(flag: bool):
+    global _enabled
+    _enabled = flag
+
+
+def clear():
+    with _lock:
+        _events.clear()
+        _open_spans.clear()
+
+
+def record(category: str, name: str, **meta):
+    if not _enabled:
+        return
+    with _lock:
+        _events.append({
+            "cat": category, "name": name, "ph": "i",
+            "ts": time.time() * 1e6,
+            "pid": 0, "tid": threading.get_ident() % 100000,
+            "args": meta or {},
+        })
+
+
+def record_span_start(category: str, name: str, key=None):
+    if not _enabled:
+        return
+    with _lock:
+        _open_spans[(category, name, key,
+                     threading.get_ident())] = time.time() * 1e6
+
+
+def record_span_end(category: str, name: str, key=None):
+    if not _enabled:
+        return
+    tid = threading.get_ident()
+    with _lock:
+        start = _open_spans.pop((category, name, key, tid), None)
+        if start is None:
+            return
+        now = time.time() * 1e6
+        _events.append({
+            "cat": category, "name": name, "ph": "X",
+            "ts": start, "dur": now - start,
+            "pid": 0, "tid": tid % 100000, "args": {},
+        })
+
+
+@contextmanager
+def profile(name: str, category: str = "user"):
+    record_span_start(category, name)
+    try:
+        yield
+    finally:
+        record_span_end(category, name)
+
+
+def chrome_trace(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    with _lock:
+        events = list(_events)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
+def merge_xla_trace(xla_trace_events: List[Dict[str, Any]]):
+    """Merge device-side events from the XLA profiler into the host
+    timeline (pid=1 lane)."""
+    with _lock:
+        for e in xla_trace_events:
+            e = dict(e)
+            e["pid"] = 1
+            _events.append(e)

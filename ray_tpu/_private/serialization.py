@@ -1,0 +1,105 @@
+"""Serialization layer.
+
+Capability parity with the reference's python/ray/_private/serialization.py:
+cloudpickle for closures/classes, zero-copy handling of large numpy arrays,
+and capture of ObjectRef instances inside serialized values so the ownership
+layer can register borrowers.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+import numpy as np
+
+# Arrays above this many bytes are serialized out-of-band (zero-copy buffers)
+_OOB_THRESHOLD = 1 << 16
+
+
+class SerializedObject:
+    """A serialized value: a pickle stream plus out-of-band buffers and the
+    ObjectRefs it captured (for borrower registration)."""
+
+    __slots__ = ("data", "buffers", "contained_refs")
+
+    def __init__(self, data: bytes, buffers: List[pickle.PickleBuffer],
+                 contained_refs: List[Any]):
+        self.data = data
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    def total_bytes(self) -> int:
+        n = len(self.data)
+        for b in self.buffers:
+            n += b.raw().nbytes
+        return n
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def __init__(self, file, buffer_callback):
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+        self.contained_refs: List[Any] = []
+
+    def persistent_id(self, obj):
+        # Lazy import to avoid a cycle at module load.
+        from ray_tpu._private.object_ref import ObjectRef
+        if isinstance(obj, ObjectRef):
+            self.contained_refs.append(obj)
+            return ("ray_tpu.ObjectRef", obj.id.binary(), obj.owner_hint)
+        return None
+
+
+class _Unpickler(pickle.Unpickler):
+    def persistent_load(self, pid):
+        tag = pid[0]
+        if tag == "ray_tpu.ObjectRef":
+            from ray_tpu._private.object_ref import ObjectRef
+            from ray_tpu._private.ids import ObjectID
+            return ObjectRef(ObjectID(pid[1]), owner_hint=pid[2],
+                             _register_borrow=True)
+        raise pickle.UnpicklingError(f"unknown persistent id {pid!r}")
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+
+    def buffer_cb(buf: pickle.PickleBuffer):
+        raw = buf.raw()
+        if raw.nbytes >= _OOB_THRESHOLD:
+            buffers.append(buf)
+            return False  # keep out-of-band
+        return True       # fold small buffers in-band
+
+    f = io.BytesIO()
+    p = _Pickler(f, buffer_cb)
+    p.dump(value)
+    return SerializedObject(f.getvalue(), buffers, p.contained_refs)
+
+
+def deserialize(obj: SerializedObject) -> Any:
+    return _Unpickler(io.BytesIO(obj.data),
+                      buffers=obj.buffers).load()
+
+
+def dumps(value: Any) -> bytes:
+    """Flat single-buffer form (for IPC / the native store)."""
+    so = serialize(value)
+    parts = [so.data] + [b.raw().tobytes() for b in so.buffers]
+    header = np.array([len(p) for p in parts], dtype=np.int64).tobytes()
+    return (len(parts).to_bytes(4, "little") + header + b"".join(parts))
+
+
+def loads(data: bytes) -> Any:
+    nparts = int.from_bytes(data[:4], "little")
+    sizes = np.frombuffer(data[4:4 + 8 * nparts], dtype=np.int64)
+    off = 4 + 8 * nparts
+    parts: List[memoryview] = []
+    mv = memoryview(data)
+    for s in sizes:
+        parts.append(mv[off:off + int(s)])
+        off += int(s)
+    so = SerializedObject(bytes(parts[0]),
+                          [pickle.PickleBuffer(p) for p in parts[1:]], [])
+    return deserialize(so)

@@ -1,0 +1,187 @@
+"""Task/actor specification types.
+
+Capability parity with the reference's TaskSpecification
+(src/ray/common/task/task_spec.h) and option validation
+(python/ray/_private/ray_option_utils.py), in a fresh dataclass form shared by
+the local and distributed runtimes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+
+class SchedulingStrategy:
+    """Base scheduling strategy (reference:
+    python/ray/util/scheduling_strategies.py)."""
+
+
+@dataclasses.dataclass
+class DefaultSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclasses.dataclass
+class SpreadSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclasses.dataclass
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    node_id: Any = None
+    soft: bool = False
+
+
+@dataclasses.dataclass
+class PlacementGroupSchedulingStrategy(SchedulingStrategy):
+    placement_group: Any = None
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclasses.dataclass
+class SliceAffinitySchedulingStrategy(SchedulingStrategy):
+    """TPU-native: schedule onto a specific ICI slice / sub-slice."""
+    slice_id: Any = None
+    soft: bool = False
+
+
+_TASK_OPTION_DEFAULTS: Dict[str, Any] = {
+    "num_returns": 1,
+    "num_cpus": 1.0,
+    "num_tpus": 0.0,
+    "resources": None,
+    "max_retries": None,        # None -> config default
+    "retry_exceptions": False,
+    "name": None,
+    "scheduling_strategy": None,
+    "runtime_env": None,
+    "_metadata": None,
+}
+
+_ACTOR_OPTION_DEFAULTS: Dict[str, Any] = {
+    "num_cpus": 1.0,
+    "num_tpus": 0.0,
+    "resources": None,
+    "max_restarts": 0,
+    "max_task_retries": 0,
+    "max_concurrency": None,    # None -> 1 (sync) / 1000 (async)
+    "max_pending_calls": -1,
+    "name": None,
+    "namespace": None,
+    "lifetime": None,           # None | "detached"
+    "get_if_exists": False,
+    "scheduling_strategy": None,
+    "runtime_env": None,
+    "concurrency_groups": None,
+}
+
+
+def validate_task_options(options: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(_TASK_OPTION_DEFAULTS)
+    for k, v in options.items():
+        if k not in _TASK_OPTION_DEFAULTS:
+            raise ValueError(f"Unknown task option: {k!r}")
+        out[k] = v
+    nr = out["num_returns"]
+    if not (nr == "streaming" or (isinstance(nr, int) and nr >= 0)):
+        raise ValueError("num_returns must be a non-negative int")
+    for res in ("num_cpus", "num_tpus"):
+        if out[res] is not None and out[res] < 0:
+            raise ValueError(f"{res} must be >= 0")
+    return out
+
+
+def validate_actor_options(options: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(_ACTOR_OPTION_DEFAULTS)
+    for k, v in options.items():
+        if k not in _ACTOR_OPTION_DEFAULTS:
+            raise ValueError(f"Unknown actor option: {k!r}")
+        out[k] = v
+    if out["max_restarts"] is not None and out["max_restarts"] < -1:
+        raise ValueError("max_restarts must be >= -1 (-1 = infinite)")
+    if out["lifetime"] not in (None, "detached", "non_detached"):
+        raise ValueError("lifetime must be None or 'detached'")
+    return out
+
+
+def resources_from_options(opts: Dict[str, Any]) -> Dict[str, float]:
+    res: Dict[str, float] = {}
+    if opts.get("num_cpus"):
+        res["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    extra = opts.get("resources") or {}
+    for k, v in extra.items():
+        if k in ("CPU", "TPU"):
+            raise ValueError(
+                f"Pass {k} via num_cpus/num_tpus, not resources=")
+        res[k] = float(v)
+    return res
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    name: str
+    func: Optional[Callable]            # None for actor method by name
+    args: Tuple[Any, ...]
+    kwargs: Dict[str, Any]
+    num_returns: int
+    return_ids: List[ObjectID]
+    resources: Dict[str, float]
+    max_retries: int = 0
+    retry_exceptions: Any = False       # bool | list[type]
+    scheduling_strategy: Optional[SchedulingStrategy] = None
+    runtime_env: Optional[Dict[str, Any]] = None
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    method_name: Optional[str] = None
+    is_actor_creation: bool = False
+    # Bookkeeping
+    attempt: int = 0
+    parent_task_id: Optional[TaskID] = None
+
+    @property
+    def is_actor_task(self) -> bool:
+        return self.actor_id is not None and not self.is_actor_creation
+
+
+@dataclasses.dataclass
+class ActorCreationSpec:
+    actor_id: ActorID
+    job_id: JobID
+    cls: type
+    args: Tuple[Any, ...]
+    kwargs: Dict[str, Any]
+    resources: Dict[str, float]
+    max_restarts: int
+    max_task_retries: int
+    max_concurrency: int
+    max_pending_calls: int
+    name: Optional[str]
+    namespace: Optional[str]
+    lifetime: Optional[str]
+    scheduling_strategy: Optional[SchedulingStrategy] = None
+    runtime_env: Optional[Dict[str, Any]] = None
+    concurrency_groups: Optional[Dict[str, int]] = None
+    is_async: bool = False
+    get_if_exists: bool = False
+
+
+@dataclasses.dataclass
+class Bundle:
+    resources: Dict[str, float]
+    index: int = -1
+
+
+@dataclasses.dataclass
+class PlacementGroupSpec:
+    pg_id: PlacementGroupID
+    bundles: List[Bundle]
+    strategy: str = "PACK"   # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    name: str = ""
+    lifetime: Optional[str] = None

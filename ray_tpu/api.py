@@ -1,0 +1,311 @@
+"""Public task/actor API.
+
+Capability parity with the reference's public surface:
+``ray.remote/get/put/wait/kill/cancel/get_actor`` +
+``RemoteFunction``/``ActorClass``/``ActorHandle`` with ``.options(...)``
+chaining (python/ray/_private/worker.py:2681, python/ray/remote_function.py:35,
+python/ray/actor.py:377,1020). Fresh implementation over the pluggable
+runtime.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Dict, List, Optional, Union
+
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.task_spec import (ActorCreationSpec, TaskSpec,
+                                        resources_from_options,
+                                        validate_actor_options,
+                                        validate_task_options)
+from ray_tpu._private.worker import global_worker
+from ray_tpu._private.config import GlobalConfig
+
+
+# --------------------------------------------------------------------------
+# Object API
+# --------------------------------------------------------------------------
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("Calling put() on an ObjectRef is not allowed")
+    return global_worker().runtime.put(value)
+
+
+def get(refs: Union[ObjectRef, List[ObjectRef]],
+        timeout: Optional[float] = None) -> Any:
+    return global_worker().runtime.get(refs, timeout=timeout)
+
+
+def wait(refs: List[ObjectRef], num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return global_worker().runtime.wait(refs, num_returns=num_returns,
+                                        timeout=timeout)
+
+
+def cancel(ref: ObjectRef, force: bool = False, recursive: bool = True):
+    global_worker().runtime.cancel(ref, force=force, recursive=recursive)
+
+
+# --------------------------------------------------------------------------
+# Tasks
+# --------------------------------------------------------------------------
+
+class RemoteFunction:
+    def __init__(self, func, options: Dict[str, Any]):
+        self._func = func
+        self._options = validate_task_options(options)
+        functools.update_wrapper(self, func)
+
+    def options(self, **overrides) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(overrides)
+        return RemoteFunction(self._func, merged)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, opts):
+        w = global_worker()
+        rt = w.runtime
+        num_returns = opts["num_returns"]
+        n = 1 if num_returns == "streaming" else num_returns
+        task_id = TaskID.of(rt.job_id)
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(n)]
+        max_retries = opts["max_retries"]
+        if max_retries is None:
+            max_retries = GlobalConfig.default_max_retries
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=rt.job_id,
+            name=opts["name"] or getattr(self._func, "__qualname__",
+                                         "anonymous"),
+            func=self._func,
+            args=tuple(args),
+            kwargs=dict(kwargs),
+            num_returns=n,
+            return_ids=return_ids,
+            resources=resources_from_options(opts),
+            max_retries=max_retries,
+            retry_exceptions=opts["retry_exceptions"],
+            scheduling_strategy=opts["scheduling_strategy"],
+            runtime_env=opts["runtime_env"],
+        )
+        refs = rt.submit_task(spec)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self.__name__!r} cannot be called directly; "
+            f"use .remote()")
+
+
+# --------------------------------------------------------------------------
+# Actors
+# --------------------------------------------------------------------------
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: Optional[int] = None):
+        return ActorMethod(
+            self._handle, self._method_name,
+            self._num_returns if num_returns is None else num_returns)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(
+            self._method_name, args, kwargs, self._num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} cannot be called "
+            f"directly; use .remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, cls: type,
+                 max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._cls = cls
+        self._max_task_retries = max_task_retries
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        attr = getattr(self._cls, name, None)
+        if attr is None or not callable(attr):
+            raise AttributeError(
+                f"{self._cls.__name__} has no method {name!r}")
+        method_opts = getattr(attr, "__ray_tpu_method_opts__", {})
+        return ActorMethod(self, name,
+                           num_returns=method_opts.get("num_returns", 1))
+
+    def _submit_method(self, method_name, args, kwargs, num_returns):
+        w = global_worker()
+        rt = w.runtime
+        task_id = TaskID.of(rt.job_id)
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(max(1, num_returns))]
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=rt.job_id,
+            name=f"{self._cls.__name__}.{method_name}",
+            func=None,
+            args=tuple(args),
+            kwargs=dict(kwargs),
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources={},
+            max_retries=self._max_task_retries,
+            actor_id=self._actor_id,
+            method_name=method_name,
+        )
+        refs = rt.submit_actor_task(self._actor_id, spec)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._actor_id.binary(), self._cls,
+                                  self._max_task_retries))
+
+    def __repr__(self):
+        return (f"ActorHandle({self._cls.__name__}, "
+                f"{self._actor_id.hex()[:12]})")
+
+
+def _rebuild_handle(actor_id_bin, cls, max_task_retries):
+    return ActorHandle(ActorID(actor_id_bin), cls, max_task_retries)
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Dict[str, Any]):
+        self._cls = cls
+        self._options = validate_actor_options(options)
+        functools.update_wrapper(self, cls, updated=[])
+
+    def options(self, **overrides) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(overrides)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        opts = self._options
+        w = global_worker()
+        rt = w.runtime
+        if opts["get_if_exists"] and opts["name"]:
+            try:
+                return get_actor(opts["name"], opts["namespace"])
+            except ValueError:
+                pass
+        is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(self._cls,
+                                           inspect.isfunction))
+        max_concurrency = opts["max_concurrency"]
+        if max_concurrency is None:
+            max_concurrency = 1000 if is_async else 1
+        spec = ActorCreationSpec(
+            actor_id=ActorID.of(rt.job_id),
+            job_id=rt.job_id,
+            cls=self._cls,
+            args=args,
+            kwargs=kwargs,
+            resources=resources_from_options(opts),
+            max_restarts=opts["max_restarts"],
+            max_task_retries=opts["max_task_retries"],
+            max_concurrency=max_concurrency,
+            max_pending_calls=opts["max_pending_calls"],
+            name=opts["name"],
+            namespace=opts["namespace"] or w.namespace,
+            lifetime=opts["lifetime"],
+            scheduling_strategy=opts["scheduling_strategy"],
+            runtime_env=opts["runtime_env"],
+            concurrency_groups=opts["concurrency_groups"],
+            is_async=is_async,
+            get_if_exists=bool(opts["get_if_exists"] and opts["name"]),
+        )
+        state = rt.create_actor(spec)
+        # With get_if_exists a concurrent creator may have won the name
+        # race: the returned state is authoritative, not our spec.
+        actor_id = state.spec.actor_id
+        handle = ActorHandle(actor_id, self._cls,
+                             opts["max_task_retries"])
+        rt._actor_handles[actor_id] = handle
+        return handle
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated "
+            f"directly; use .remote()")
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    w = global_worker()
+    rt = w.runtime
+    actor_id = rt.lookup_named_actor(name, namespace or w.namespace)
+    handle = rt._actor_handles.get(actor_id)
+    if handle is None:
+        st = rt.get_actor_state(actor_id)
+        handle = ActorHandle(actor_id, st.spec.cls,
+                             st.spec.max_task_retries)
+    return handle
+
+
+def kill(actor: ActorHandle, no_restart: bool = True):
+    global_worker().runtime.kill_actor(actor.actor_id,
+                                       no_restart=no_restart)
+
+
+# --------------------------------------------------------------------------
+# The decorator
+# --------------------------------------------------------------------------
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(num_cpus=..., num_tpus=..., ...)`` for
+    functions and classes."""
+    if len(args) == 1 and not kwargs and (inspect.isfunction(args[0]) or
+                                          inspect.isclass(args[0])):
+        target = args[0]
+        if inspect.isclass(target):
+            return ActorClass(target, {})
+        return RemoteFunction(target, {})
+    if args:
+        raise TypeError("@remote takes only keyword options")
+
+    def wrapper(target):
+        if inspect.isclass(target):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+    return wrapper
+
+
+# --------------------------------------------------------------------------
+# Cluster introspection
+# --------------------------------------------------------------------------
+
+def cluster_resources() -> Dict[str, float]:
+    return global_worker().runtime.cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return global_worker().runtime.available_resources()
+
+
+def timeline(filename: Optional[str] = None):
+    from ray_tpu._private import profiling
+    return profiling.chrome_trace(filename)

@@ -1,0 +1,102 @@
+"""Exception hierarchy for ray_tpu.
+
+Capability parity with the reference's ``python/ray/exceptions.py`` (RayError,
+RayTaskError, RayActorError, ObjectLostError, GetTimeoutError, ...), designed
+fresh for this runtime.
+"""
+from __future__ import annotations
+
+import traceback as _tb
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised inside a remote task.
+
+    Re-raised on ``get()`` at the caller, carrying the remote traceback
+    (reference: RayTaskError in python/ray/exceptions.py).
+    """
+
+    def __init__(self, cause: BaseException, task_name: str = "",
+                 remote_traceback: str | None = None):
+        self.cause = cause
+        self.task_name = task_name
+        self.remote_traceback = remote_traceback or "".join(
+            _tb.format_exception(type(cause), cause, cause.__traceback__))
+        super().__init__(str(cause))
+
+    def __str__(self):
+        return (f"Task '{self.task_name}' failed with "
+                f"{type(self.cause).__name__}: {self.cause}\n"
+                f"--- remote traceback ---\n{self.remote_traceback}")
+
+
+class ActorError(RayTpuError):
+    """Base for actor-related failures."""
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead (killed, crashed in __init__, or out of restarts)."""
+
+    def __init__(self, actor_id=None, reason: str = "actor died"):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id} is dead: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object is no longer available and cannot be reconstructed."""
+
+    def __init__(self, object_id=None, reason: str = "object lost"):
+        self.object_id = object_id
+        super().__init__(f"Object {object_id} lost: {reason}")
+
+
+class OwnerDiedError(ObjectLostError):
+    """The owner process of an object died, so the object is unrecoverable."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get(ref, timeout=...)`` timed out."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before or during execution."""
+
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled")
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Actor's pending call queue is over ``max_pending_calls``."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Failed to set up the runtime environment for a task/actor."""
+
+
+class NodeDiedError(RayTpuError):
+    """A node (host) in the cluster died."""
+
+
+class PlacementGroupError(RayTpuError):
+    """Placement group creation/scheduling failed."""
+
+
+class MeshGangError(RayTpuError):
+    """A member of an SPMD mesh gang failed; the whole gang must recover
+    together (gang semantics, see SURVEY.md §7 design stance)."""
+
+    def __init__(self, gang_id=None, failed_member=None, reason: str = ""):
+        self.gang_id = gang_id
+        self.failed_member = failed_member
+        super().__init__(
+            f"Mesh gang {gang_id} failed (member={failed_member}): {reason}")

@@ -1,0 +1,16 @@
+from ray_tpu.util.placement_group import (placement_group,
+                                          remove_placement_group)
+from ray_tpu._private.task_spec import (
+    DefaultSchedulingStrategy,
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SliceAffinitySchedulingStrategy,
+    SpreadSchedulingStrategy,
+)
+
+__all__ = [
+    "placement_group", "remove_placement_group",
+    "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
+    "SpreadSchedulingStrategy", "DefaultSchedulingStrategy",
+    "SliceAffinitySchedulingStrategy",
+]

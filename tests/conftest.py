@@ -1,0 +1,50 @@
+"""Test fixtures.
+
+Forces JAX onto a virtual 8-device CPU mesh (the reference tests multi-node
+behavior with multiple raylets on one machine, python/ray/tests/conftest.py
+``ray_start_cluster``; we test multi-chip behavior with a forced host-platform
+device count) and provides a fresh runtime per test.
+"""
+import os
+
+# Must be set before jax is imported anywhere.
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " +
+                               _flag).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import pytest  # noqa: E402
+
+
+def _force_cpu():
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+_force_cpu()
+
+
+@pytest.fixture
+def rt():
+    """A fresh local runtime per test."""
+    import ray_tpu
+    from ray_tpu._private.config import GlobalConfig
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    GlobalConfig.reset()
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    GlobalConfig.reset()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {devs}"
+    return devs

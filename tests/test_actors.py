@@ -1,0 +1,222 @@
+"""Actor semantics tests (reference analogues:
+python/ray/tests/test_actor.py, test_actor_failures.py,
+test_asyncio_actor.py)."""
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, TaskError
+
+
+def test_basic_actor(rt):
+    @rt.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, by=1):
+            self.n += by
+            return self.n
+
+    c = Counter.remote(10)
+    assert rt.get(c.inc.remote()) == 11
+    assert rt.get(c.inc.remote(5)) == 16
+
+
+def test_actor_call_ordering(rt):
+    @rt.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+
+        def snapshot(self):
+            return list(self.items)
+
+    a = Appender.remote()
+    for i in range(50):
+        a.add.remote(i)
+    assert rt.get(a.snapshot.remote()) == list(range(50))
+
+
+def test_actor_method_exception_does_not_kill(rt):
+    @rt.remote
+    class Fragile:
+        def bad(self):
+            raise RuntimeError("oops")
+
+        def good(self):
+            return "fine"
+
+    f = Fragile.remote()
+    with pytest.raises(TaskError):
+        rt.get(f.bad.remote())
+    assert rt.get(f.good.remote()) == "fine"
+
+
+def test_actor_init_failure(rt):
+    @rt.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("cannot construct")
+
+        def ping(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises(ActorDiedError):
+        rt.get(b.ping.remote(), timeout=5)
+
+
+def test_kill_actor(rt):
+    @rt.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert rt.get(v.ping.remote()) == "pong"
+    rt.kill(v)
+    with pytest.raises(ActorDiedError):
+        rt.get(v.ping.remote(), timeout=5)
+
+
+def test_actor_restart(rt):
+    @rt.remote(max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.state = 0
+
+        def set(self, v):
+            self.state = v
+
+        def get(self):
+            return self.state
+
+    p = Phoenix.remote()
+    rt.get(p.set.remote(42))
+    assert rt.get(p.get.remote()) == 42
+    # Simulate a crash (not an intentional kill): restart policy applies,
+    # state resets.
+    rt.kill(p, no_restart=False)
+    time.sleep(0.2)
+    assert rt.get(p.get.remote(), timeout=5) == 0
+
+
+def test_named_actor(rt):
+    @rt.remote
+    class Registry:
+        def whoami(self):
+            return "registry"
+
+    Registry.options(name="the-registry").remote()
+    h = rt.get_actor("the-registry")
+    assert rt.get(h.whoami.remote()) == "registry"
+    with pytest.raises(ValueError):
+        rt.get_actor("missing")
+
+
+def test_named_actor_duplicate_rejected(rt):
+    @rt.remote
+    class A:
+        def ping(self):
+            return 1
+
+    A.options(name="dup").remote()
+    with pytest.raises(ValueError):
+        A.options(name="dup").remote()
+
+
+def test_get_if_exists(rt):
+    @rt.remote
+    class Singleton:
+        def __init__(self):
+            self.t = time.time()
+
+        def created_at(self):
+            return self.t
+
+    a = Singleton.options(name="s", get_if_exists=True).remote()
+    b = Singleton.options(name="s", get_if_exists=True).remote()
+    assert rt.get(a.created_at.remote()) == rt.get(b.created_at.remote())
+
+
+def test_actor_handle_passing(rt):
+    @rt.remote
+    class Store:
+        def __init__(self):
+            self.v = None
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    @rt.remote
+    def writer(store, value):
+        return ray_tpu.get(store.set.remote(value))
+
+    s = Store.remote()
+    rt.get(writer.remote(s, "written-by-task"))
+    assert rt.get(s.get.remote()) == "written-by-task"
+
+
+def test_async_actor(rt):
+    @rt.remote
+    class AsyncWorker:
+        async def work(self, x):
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    w = AsyncWorker.remote()
+    refs = [w.work.remote(i) for i in range(10)]
+    assert rt.get(refs) == [i * 2 for i in range(10)]
+
+
+def test_async_actor_concurrency(rt):
+    @rt.remote
+    class Sleeper:
+        async def nap(self):
+            await asyncio.sleep(0.2)
+            return 1
+
+    s = Sleeper.remote()
+    start = time.time()
+    refs = [s.nap.remote() for _ in range(10)]
+    assert sum(rt.get(refs)) == 10
+    # Concurrent: 10 naps of 0.2s must not serialize to 2s.
+    assert time.time() - start < 1.5
+
+
+def test_threaded_actor_max_concurrency(rt):
+    @rt.remote(max_concurrency=4)
+    class Parallel:
+        def block(self):
+            time.sleep(0.2)
+            return 1
+
+    p = Parallel.remote()
+    start = time.time()
+    assert sum(rt.get([p.block.remote() for _ in range(4)])) == 4
+    assert time.time() - start < 0.7  # ran in parallel
+
+
+def test_actor_num_restarts_visible_in_state(rt):
+    @rt.remote(max_restarts=1)
+    class R:
+        def ping(self):
+            return 1
+
+    r = R.remote()
+    rt.get(r.ping.remote())
+    runtime = ray_tpu._private.worker.global_worker().runtime
+    rt.kill(r, no_restart=False)
+    time.sleep(0.2)
+    rt.get(r.ping.remote(), timeout=5)
+    actors = runtime.list_actors()
+    assert any(a["num_restarts"] == 1 for a in actors)

@@ -1,0 +1,234 @@
+"""Core task/object API tests (reference analogues:
+python/ray/tests/test_basic.py, test_advanced.py)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import (GetTimeoutError, TaskCancelledError,
+                                TaskError)
+
+
+def test_put_get(rt):
+    ref = rt.put({"a": 1})
+    assert rt.get(ref) == {"a": 1}
+
+
+def test_put_objectref_rejected(rt):
+    ref = rt.put(1)
+    with pytest.raises(TypeError):
+        rt.put(ref)
+
+
+def test_simple_task(rt):
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    assert rt.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_kwargs_and_options(rt):
+    @rt.remote(num_cpus=0.5)
+    def f(a, b=10):
+        return a * b
+
+    assert rt.get(f.remote(3)) == 30
+    assert rt.get(f.options(name="named").remote(2, b=4)) == 8
+
+
+def test_task_dependency_chain(rt):
+    @rt.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(9):
+        ref = inc.remote(ref)
+    assert rt.get(ref) == 10
+
+
+def test_nested_tasks_no_deadlock(rt):
+    # More nesting depth than CPU capacity: blocked parents must release
+    # their resources (reference: worker leasing prevents this deadlock).
+    @rt.remote(num_cpus=1)
+    def fib(n):
+        if n < 2:
+            return n
+        return sum(rt.get([fib.remote(n - 1), fib.remote(n - 2)]))
+
+    assert rt.get(fib.remote(10)) == 55
+
+
+def test_multiple_returns(rt):
+    @rt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert rt.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_num_returns_mismatch_is_error(rt):
+    @rt.remote(num_returns=2)
+    def wrong():
+        return (1, 2, 3)
+
+    refs = wrong.remote()
+    with pytest.raises(TaskError):
+        rt.get(refs[0])
+
+
+def test_task_exception_propagates(rt):
+    @rt.remote
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(TaskError) as ei:
+        rt.get(boom.remote())
+    assert "kapow" in str(ei.value)
+    assert isinstance(ei.value.cause, ValueError)
+
+
+def test_get_timeout(rt):
+    @rt.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(GetTimeoutError):
+        rt.get(slow.remote(), timeout=0.1)
+
+
+def test_wait(rt):
+    @rt.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(2.0)
+    ready, not_ready = rt.wait([fast, slow], num_returns=1, timeout=1.0)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_wait_timeout_returns_partial(rt):
+    @rt.remote
+    def never():
+        time.sleep(30)
+
+    ready, not_ready = rt.wait([never.remote()], num_returns=1,
+                               timeout=0.05)
+    assert ready == []
+    assert len(not_ready) == 1
+
+
+def test_object_ref_as_arg_resolved(rt):
+    @rt.remote
+    def double(x):
+        return 2 * x
+
+    assert rt.get(double.remote(rt.put(21))) == 42
+
+
+def test_retry_on_exception(rt):
+    import itertools
+    counter = itertools.count()
+
+    @rt.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        if next(counter) < 2:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert rt.get(flaky.remote()) == "ok"
+
+
+def test_no_retry_by_default_on_app_error(rt):
+    import itertools
+    counter = itertools.count()
+
+    @rt.remote(max_retries=5)
+    def flaky():
+        next(counter)
+        raise RuntimeError("app error")
+
+    with pytest.raises(TaskError):
+        rt.get(flaky.remote())
+    assert next(counter) == 1  # ran exactly once
+
+
+def test_cancel_pending_task(rt):
+    @rt.remote(num_cpus=8)
+    def hog():
+        time.sleep(3)
+
+    @rt.remote(num_cpus=8)
+    def victim():
+        return 1
+
+    h = hog.remote()
+    v = victim.remote()   # queued behind the hog
+    rt.cancel(v)
+    with pytest.raises(TaskCancelledError):
+        rt.get(v, timeout=5)
+    del h
+
+
+def test_infeasible_task_errors(rt):
+    @rt.remote(num_cpus=10000)
+    def big():
+        return 1
+
+    with pytest.raises(TaskError):
+        rt.get(big.remote(), timeout=5)
+
+
+def test_cluster_resources(rt):
+    res = rt.cluster_resources()
+    assert res["CPU"] == 8.0
+
+
+def test_fractional_resources(rt):
+    @rt.remote(num_cpus=0.25)
+    def tiny(i):
+        time.sleep(0.05)
+        return i
+
+    assert sorted(rt.get([tiny.remote(i) for i in range(32)])) == \
+        list(range(32))
+
+
+def test_custom_resources(rt):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, resources={"accel_slice": 2})
+
+    @ray_tpu.remote(resources={"accel_slice": 1})
+    def uses_slice():
+        return "ok"
+
+    assert ray_tpu.get(uses_slice.remote()) == "ok"
+
+
+def test_lineage_reconstruction(rt):
+    @rt.remote
+    def produce():
+        return list(range(100))
+
+    ref = produce.remote()
+    assert rt.get(ref) == list(range(100))
+    runtime = ray_tpu._private.worker.global_worker().runtime
+    runtime.simulate_object_loss(ref)
+    assert runtime.reconstruct_object(ref)
+    assert rt.get(ref, timeout=5) == list(range(100))
+
+
+def test_timeline_records_tasks(rt):
+    @rt.remote
+    def traced():
+        return 1
+
+    rt.get(traced.remote())
+    events = rt.timeline()
+    assert any("traced" in e["name"] for e in events)

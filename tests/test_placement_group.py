@@ -1,0 +1,51 @@
+"""Placement group tests (reference analogue:
+python/ray/tests/test_placement_group.py)."""
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (PlacementGroupSchedulingStrategy, placement_group,
+                          remove_placement_group)
+
+
+def test_pg_create_and_ready(rt):
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+    assert rt.get(pg.ready.remote() if hasattr(pg.ready, "remote")
+                  else pg.ready(), timeout=5) is pg
+    assert pg.is_ready()
+    assert pg.bundle_specs == [{"CPU": 2.0}, {"CPU": 2.0}]
+
+
+def test_pg_reserves_resources(rt):
+    pg = placement_group([{"CPU": 6}])
+    assert pg.wait(5)
+    avail = rt.available_resources()
+    assert avail["CPU"] == pytest.approx(2.0)
+    remove_placement_group(pg)
+    assert rt.available_resources()["CPU"] == pytest.approx(8.0)
+
+
+def test_task_in_pg(rt):
+    pg = placement_group([{"CPU": 4}])
+    assert pg.wait(5)
+
+    @rt.remote(
+        num_cpus=4,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0))
+    def inside():
+        return "ran-in-pg"
+
+    # Node has only 4 CPUs left but the task runs inside the reservation.
+    assert rt.get(inside.remote(), timeout=5) == "ran-in-pg"
+
+
+def test_infeasible_pg_never_ready(rt):
+    pg = placement_group([{"CPU": 10000}])
+    assert not pg.wait(0.2)
+
+
+def test_invalid_strategy_rejected(rt):
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+    with pytest.raises(ValueError):
+        placement_group([])

@@ -1,0 +1,131 @@
+"""Regression tests for defects found in code review (resource accounting,
+PG removal leak, fire-and-forget leak, actor-in-task creation, @method)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.worker import global_worker
+from ray_tpu.exceptions import GetTimeoutError
+from ray_tpu.util import placement_group, remove_placement_group
+
+
+def test_resource_accounting_exact_after_blocking_get(rt):
+    # A task that blocks in get() releases and then RE-acquires its CPU;
+    # availability must return to exactly the full capacity at the end.
+    @rt.remote(num_cpus=2)
+    def child():
+        return 1
+
+    @rt.remote(num_cpus=2)
+    def parent():
+        return ray_tpu.get(child.remote()) + 1
+
+    assert rt.get(parent.remote()) == 2
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if rt.available_resources()["CPU"] == pytest.approx(8.0):
+            break
+        time.sleep(0.01)
+    assert rt.available_resources()["CPU"] == pytest.approx(8.0)
+
+
+def test_remove_pending_pg_does_not_leak(rt):
+    # Reserve most of the node, create a PG that can't fit yet, remove it
+    # while pending, then free the hog: full capacity must come back.
+    hog = placement_group([{"CPU": 6}])
+    assert hog.wait(5)
+    pending = placement_group([{"CPU": 6}])
+    assert not pending.wait(0.2)
+    remove_placement_group(pending)
+    remove_placement_group(hog)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if rt.available_resources()["CPU"] == pytest.approx(8.0):
+            break
+        time.sleep(0.01)
+    assert rt.available_resources()["CPU"] == pytest.approx(8.0)
+
+
+def test_fire_and_forget_result_not_leaked(rt):
+    runtime = global_worker().runtime
+
+    @rt.remote
+    def produce():
+        return list(range(1000))
+
+    for _ in range(10):
+        produce.remote()   # ref discarded immediately
+    time.sleep(0.5)
+    stats = runtime.store.stats()
+    assert stats["num_ready"] == 0, stats
+
+
+def test_actor_creation_inside_task_no_deadlock(rt):
+    # Task holds all CPUs, then creates an actor needing CPUs: must not
+    # self-deadlock (caller releases while blocked).
+    @rt.remote
+    class Helper:
+        def ping(self):
+            return "pong"
+
+    @rt.remote(num_cpus=8)
+    def spawns_actor():
+        h = Helper.remote()
+        return ray_tpu.get(h.ping.remote())
+
+    assert rt.get(spawns_actor.remote(), timeout=30) == "pong"
+
+
+def test_get_overall_timeout(rt):
+    @rt.remote
+    def never():
+        time.sleep(60)
+
+    refs = [never.remote() for _ in range(3)]
+    start = time.time()
+    with pytest.raises(GetTimeoutError):
+        rt.get(refs, timeout=0.5)
+    # Overall deadline, not per-ref (would be ~1.5s+ if per-ref).
+    assert time.time() - start < 1.2
+
+
+def test_method_decorator_num_returns(rt):
+    @rt.remote
+    class Splitter:
+        @ray_tpu.method(num_returns=2)
+        def split(self, pair):
+            return pair[0], pair[1]
+
+    s = Splitter.remote()
+    a, b = s.split.remote((1, 2))
+    assert rt.get(a) == 1
+    assert rt.get(b) == 2
+
+
+def test_concurrent_get_if_exists(rt):
+    import threading
+
+    @rt.remote
+    class S:
+        def pid(self):
+            return id(self)
+
+    handles = []
+    errs = []
+
+    def make():
+        try:
+            handles.append(
+                S.options(name="race", get_if_exists=True).remote())
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=make) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    pids = {rt.get(h.pid.remote()) for h in handles}
+    assert len(pids) == 1  # everyone got the same actor

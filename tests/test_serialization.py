@@ -1,0 +1,45 @@
+"""Serialization layer tests (reference analogue:
+python/ray/tests/test_serialization.py)."""
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private import serialization
+
+
+def test_roundtrip_basic():
+    for v in [1, "s", {"a": [1, 2]}, (None, True), b"bytes"]:
+        assert serialization.deserialize(serialization.serialize(v)) == v
+
+
+def test_numpy_zero_copy_buffers():
+    arr = np.arange(100000, dtype=np.float32)
+    so = serialization.serialize(arr)
+    assert len(so.buffers) == 1  # out-of-band, not folded into the pickle
+    out = serialization.deserialize(so)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_flat_dumps_loads():
+    payload = {"x": np.ones((256, 256)), "y": list(range(10))}
+    out = serialization.loads(serialization.dumps(payload))
+    np.testing.assert_array_equal(out["x"], payload["x"])
+    assert out["y"] == payload["y"]
+
+
+def test_closure_serialization():
+    factor = 7
+
+    def mul(x):
+        return x * factor
+
+    out = serialization.deserialize(serialization.serialize(mul))
+    assert out(6) == 42
+
+
+def test_objectref_capture_and_restore(rt):
+    ref = rt.put("inner-value")
+    so = serialization.serialize({"nested": [ref]})
+    assert len(so.contained_refs) == 1
+    restored = serialization.deserialize(so)
+    inner = restored["nested"][0]
+    assert rt.get(inner) == "inner-value"
