@@ -1,0 +1,112 @@
+"""Headline benchmark: GPT-2-124M SPMD training throughput on local TPU chips.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+vs_baseline is measured MFU / 0.40 (the north-star target from BASELINE.md:
+>=40% MFU for GPT-2 on TPU; the reference has no TPU numbers to compare
+against, so the target ratio is the baseline).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+
+# Peak dense bf16 FLOP/s per chip by TPU generation.
+_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "cpu": 1e11,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for k, v in _PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return 1e11
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.mesh import create_mesh
+    from ray_tpu.models import GPT2, gpt2_124m, gpt2_sharding_rules
+    from ray_tpu.models.gpt2 import cross_entropy_loss, flops_per_token
+    from ray_tpu.train.spmd import (TrainState, make_train_step,
+                                    put_batch, shard_state)
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+
+    seq = 1024
+    batch = 8 * n_chips if on_tpu else 2
+    cfg = gpt2_124m() if on_tpu else gpt2_124m(n_layer=2, n_embd=128,
+                                               n_head=4, vocab_size=1024,
+                                               n_ctx=seq)
+    model = GPT2(cfg)
+    mesh = create_mesh({"data": -1}, devices=devices)
+    rules = gpt2_sharding_rules(fsdp=False)
+
+    ids = jnp.zeros((batch, seq + 1), dtype=jnp.int32)
+    params = jax.jit(lambda: model.init(jax.random.PRNGKey(0),
+                                        ids[:, :-1]))()
+    optimizer = optax.adamw(3e-4, weight_decay=0.1)
+    state = shard_state(TrainState.create(params, optimizer), rules, mesh)
+
+    def loss_fn(params, b):
+        x, y = b["ids"][:, :-1], b["ids"][:, 1:]
+        return cross_entropy_loss(model.apply(params, x), y)
+
+    train_step = make_train_step(loss_fn, optimizer)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, cfg.vocab_size, size=(batch, seq + 1),
+                       dtype=np.int32)
+
+    with jax.set_mesh(mesh):
+        b = put_batch({"ids": jnp.asarray(data)}, mesh)
+        # Warmup / compile. NOTE: a host fetch (float()) is the only
+        # reliable execution barrier on tunneled devices —
+        # block_until_ready can return before the work actually runs.
+        state, metrics = train_step(state, b)
+        float(metrics["loss"])
+
+        n_steps = 30 if on_tpu else 3
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, metrics = train_step(state, b)
+        final_loss = float(metrics["loss"])  # sync barrier
+        dt = time.perf_counter() - t0
+
+    tokens = batch * seq * n_steps
+    tok_per_s = tokens / dt
+    tok_per_s_chip = tok_per_s / n_chips
+    fpt = flops_per_token(cfg, seq)
+    mfu = (tok_per_s_chip * fpt) / peak_flops(devices[0])
+
+    print(json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_s_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "mfu": round(mfu, 4),
+        "chips": n_chips,
+        "device": getattr(devices[0], "device_kind", "cpu"),
+        "batch": batch,
+        "seq": seq,
+        "step_time_ms": round(1000 * dt / n_steps, 2),
+        "final_loss": round(final_loss, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                ScalingConfig)
+from ray_tpu.air.result import Result
+from ray_tpu.air import session
+
+__all__ = ["Checkpoint", "ScalingConfig", "RunConfig", "FailureConfig",
+           "CheckpointConfig", "Result", "session"]

@@ -1,0 +1,70 @@
+"""Run/scaling/failure/checkpoint configs.
+
+Capability parity with the reference's AIR configs (python/ray/air/config.py:
+ScalingConfig/RunConfig/FailureConfig/CheckpointConfig). ScalingConfig grows
+TPU-native fields: a MeshSpec and a slice topology instead of
+use_gpu/num_gpus.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Union
+
+from ray_tpu.mesh.device_mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How a trainer scales.
+
+    num_workers: host processes in the gang (1 per TPU VM host).
+    chips_per_worker: TPU chips each host contributes (0 = CPU worker).
+    mesh: logical mesh over the gang's chips (dict axis→size or MeshSpec);
+          default = pure data parallel over all chips.
+    topology: optional slice topology hint, e.g. "v5e-16", used by the
+          distributed scheduler for ICI-aware placement.
+    resources_per_worker: extra custom resources per worker.
+    """
+    num_workers: int = 1
+    chips_per_worker: int = 0
+    mesh: Optional[Union[MeshSpec, Dict[str, int]]] = None
+    topology: Optional[str] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def mesh_spec(self) -> Optional[MeshSpec]:
+        if self.mesh is None:
+            return None
+        if isinstance(self.mesh, dict):
+            return MeshSpec.from_dict(self.mesh)
+        return self.mesh
+
+    def worker_resources(self) -> Dict[str, float]:
+        res: Dict[str, float] = {"CPU": 1.0}
+        if self.chips_per_worker:
+            res["TPU"] = float(self.chips_per_worker)
+        if self.resources_per_worker:
+            res.update(self.resources_per_worker)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: gang restarts before giving up (-1 = infinite)."""
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = True
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 1

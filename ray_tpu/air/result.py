@@ -1,0 +1,19 @@
+"""Result of a training/tuning run (reference: python/ray/air/result.py)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Checkpoint]
+    error: Optional[BaseException] = None
+    metrics_history: Optional[List[Dict[str, Any]]] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None

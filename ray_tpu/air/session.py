@@ -1,0 +1,73 @@
+"""Training session: the worker-side API inside a train loop.
+
+Capability parity with the reference's ``session.report`` pipeline
+(python/ray/air/session.py:12 → train/_internal/session.py:261): the user
+loop calls ``report(metrics, checkpoint=)``; results stream to the trainer.
+Also exposes rank/world/mesh context for SPMD loops.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+_ctx = threading.local()
+
+
+class TrainContext:
+    def __init__(self, world_rank: int, world_size: int,
+                 report_fn, mesh=None, trial_info: Optional[Dict] = None,
+                 checkpoint: Optional[Checkpoint] = None,
+                 config: Optional[Dict[str, Any]] = None):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.report_fn = report_fn
+        self.mesh = mesh
+        self.trial_info = trial_info or {}
+        self.loaded_checkpoint = checkpoint
+        self.config = config or {}
+
+
+def _require_ctx() -> TrainContext:
+    ctx = getattr(_ctx, "ctx", None)
+    if ctx is None:
+        raise RuntimeError(
+            "session API used outside a train loop (no active session)")
+    return ctx
+
+
+def in_session() -> bool:
+    return getattr(_ctx, "ctx", None) is not None
+
+
+def set_context(ctx: Optional[TrainContext]):
+    _ctx.ctx = ctx
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) to the trainer."""
+    _require_ctx().report_fn(dict(metrics), checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from (set on restart), else None."""
+    return _require_ctx().loaded_checkpoint
+
+
+def get_world_rank() -> int:
+    return _require_ctx().world_rank
+
+
+def get_world_size() -> int:
+    return _require_ctx().world_size
+
+
+def get_mesh():
+    """The jax device mesh built for this gang (None for CPU loops)."""
+    return _require_ctx().mesh
+
+
+def get_trial_info() -> Dict[str, Any]:
+    return _require_ctx().trial_info

@@ -1,0 +1,11 @@
+from ray_tpu.mesh.device_mesh import (MeshSpec, best_mesh_shape,
+                                      create_mesh, local_device_count)
+from ray_tpu.mesh.sharding import (ShardingRules, batch_sharding,
+                                   infer_sharding, replicated,
+                                   shard_params, with_sharding)
+
+__all__ = [
+    "MeshSpec", "create_mesh", "best_mesh_shape", "local_device_count",
+    "ShardingRules", "infer_sharding", "shard_params", "with_sharding",
+    "batch_sharding", "replicated",
+]

@@ -1,0 +1,145 @@
+"""Device mesh construction: the TPU-native substrate for every parallelism
+strategy.
+
+No reference analogue — the reference's parallelism substrate is NCCL process
+groups (python/ray/util/collective/collective_group/nccl_collective_group.py);
+here parallelism is expressed as named axes of a `jax.sharding.Mesh` and XLA
+inserts the collectives (in-band over ICI/DCN). See SURVEY.md §2.4/§5.8.
+
+Canonical axis order (outermost → innermost):
+    dcn → pipeline → data → fsdp → expert → sequence → tensor
+`tensor` is innermost so tensor-parallel collectives ride the
+fastest/nearest ICI links; `dcn` is outermost so only the slowest-changing
+axis crosses slices (data-parallel gradient sync tolerates DCN latency).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER: Tuple[str, ...] = (
+    "dcn", "pipeline", "data", "fsdp", "expert", "sequence", "tensor")
+
+# Aliases accepted in user configs.
+_AXIS_ALIASES = {
+    "dp": "data", "tp": "tensor", "pp": "pipeline", "sp": "sequence",
+    "cp": "sequence", "ep": "expert", "model": "tensor",
+}
+
+
+def canonical_axis(name: str) -> str:
+    return _AXIS_ALIASES.get(name, name)
+
+
+def local_device_count(backend: Optional[str] = None) -> int:
+    return len(jax.devices(backend))
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    """Declarative mesh: axis name → size. Size -1 on at most one axis means
+    "use all remaining devices". ``dcn`` is the multi-slice dimension."""
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    pipeline: int = 1
+    sequence: int = 1
+    expert: int = 1
+    dcn: int = 1
+
+    @classmethod
+    def from_dict(cls, axes: Dict[str, int]) -> "MeshSpec":
+        kwargs = {}
+        for k, v in axes.items():
+            ck = canonical_axis(k)
+            if ck not in {f.name for f in dataclasses.fields(cls)}:
+                raise ValueError(f"Unknown mesh axis {k!r}")
+            kwargs[ck] = v
+        return cls(**kwargs)
+
+    def sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        """Fill a single -1 axis so the product equals n_devices."""
+        sizes = self.sizes()
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError("At most one axis may be -1")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"product {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"Mesh axes product {fixed} != device count {n_devices}")
+        return MeshSpec(**sizes)
+
+    def num_devices(self) -> int:
+        return math.prod(self.sizes().values())
+
+    def nontrivial_axes(self) -> List[str]:
+        return [a for a in AXIS_ORDER if self.sizes()[a] > 1]
+
+
+def best_mesh_shape(n_devices: int, want_data: int = -1,
+                    want_tensor: int = 1) -> MeshSpec:
+    """Pick a simple DP×TP mesh for n devices."""
+    if n_devices % want_tensor:
+        raise ValueError(
+            f"tensor={want_tensor} does not divide {n_devices}")
+    spec = MeshSpec(data=want_data, tensor=want_tensor)
+    return spec.resolve(n_devices)
+
+
+def create_mesh(spec: Optional[MeshSpec | Dict[str, int]] = None,
+                devices: Optional[Sequence[jax.Device]] = None,
+                allow_split_physical_axes: bool = False) -> Mesh:
+    """Build a `jax.sharding.Mesh` honoring ICI topology.
+
+    Every axis in AXIS_ORDER is present in the mesh (size-1 axes included)
+    so PartitionSpecs can always name them; XLA treats size-1 axes as free.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec(data=len(devices))
+    if isinstance(spec, dict):
+        spec = MeshSpec.from_dict(spec)
+    spec = spec.resolve(len(devices))
+    sizes = spec.sizes()
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    if spec.dcn > 1:
+        # Multi-slice: split devices by slice_index (DCN tier outermost),
+        # preserve ICI ordering within each slice.
+        try:
+            from jax.experimental import mesh_utils
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                shape[1:], dcn_mesh_shape=(spec.dcn,) + (1,) * 6,
+                devices=devices)
+            dev_array = dev_array.reshape(shape)
+        except Exception:
+            dev_array = np.asarray(devices).reshape(shape)
+    else:
+        try:
+            from jax.experimental import mesh_utils
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=devices,
+                allow_split_physical_axes=allow_split_physical_axes)
+        except Exception:
+            # CPU / virtual devices: topology doesn't matter.
+            dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[canonical_axis(axis)]

@@ -1,0 +1,124 @@
+"""Sharding-rules engine: map parameter names to PartitionSpecs.
+
+TPU-native replacement for the reference's per-framework process-group setup
+(train/torch/config.py DDP, tensorflow/config.py TF_CONFIG): instead of wiring
+collectives, models declare *where each tensor lives* on the mesh and XLA
+derives the collectives. Rules are (regex, PartitionSpec) pairs applied to
+flattened parameter paths — composable across DP/FSDP/TP/SP/EP by naming mesh
+axes.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Sequence[Tuple[str, P]]
+
+
+def _flatten_with_paths(tree) -> List[Tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        parts = []
+        for p in path:
+            if isinstance(p, jax.tree_util.DictKey):
+                parts.append(str(p.key))
+            elif isinstance(p, jax.tree_util.SequenceKey):
+                parts.append(str(p.idx))
+            elif isinstance(p, jax.tree_util.GetAttrKey):
+                parts.append(str(p.name))
+            else:
+                parts.append(str(p))
+        out.append(("/".join(parts), leaf))
+    return out
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) rules; first match wins.
+
+    A trailing default rule of P() (replicate) is implicit. Specs may name
+    logical axes; ``axis_map`` translates logical → mesh axes (e.g.
+    {"embed": None, "heads": "tensor"}).
+    """
+
+    def __init__(self, rules: Rules,
+                 axis_map: Optional[Dict[str, Optional[str]]] = None):
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self._axis_map = axis_map or {}
+
+    def _translate(self, spec: P) -> P:
+        if not self._axis_map:
+            return spec
+        out = []
+        for entry in spec:
+            if entry is None:
+                out.append(None)
+            elif isinstance(entry, (tuple, list)):
+                mapped = tuple(self._axis_map.get(a, a) for a in entry)
+                mapped = tuple(a for a in mapped if a is not None)
+                out.append(mapped if mapped else None)
+            else:
+                out.append(self._axis_map.get(entry, entry))
+        return P(*out)
+
+    def spec_for(self, name: str, leaf: Any) -> P:
+        shape = getattr(leaf, "shape", ())
+        if not shape or int(np.prod(shape)) <= 1:
+            return P()  # scalars replicate
+        for pat, spec in self._rules:
+            if pat.search(name):
+                spec = self._translate(spec)
+                if len(spec) > len(shape):
+                    raise ValueError(
+                        f"Rule {pat.pattern!r} spec {spec} has more "
+                        f"dims than param {name} shape {shape}")
+                return spec
+        return P()
+
+    def tree_specs(self, tree) -> Any:
+        named = dict(_flatten_with_paths(tree))
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: self.spec_for(
+                "/".join(_path_str(p) for p in path), leaf), tree)
+
+
+def _path_str(p) -> str:
+    if isinstance(p, jax.tree_util.DictKey):
+        return str(p.key)
+    if isinstance(p, jax.tree_util.SequenceKey):
+        return str(p.idx)
+    if isinstance(p, jax.tree_util.GetAttrKey):
+        return str(p.name)
+    return str(p)
+
+
+def infer_sharding(tree, rules: ShardingRules, mesh: Mesh):
+    """Pytree of NamedShardings for `tree` under `rules`."""
+    specs = rules.tree_specs(tree)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params, rules: ShardingRules, mesh: Mesh):
+    """Device-put a parameter pytree according to the rules."""
+    shardings = infer_sharding(params, rules, mesh)
+    return jax.device_put(params, shardings)
+
+
+def with_sharding(x, spec: P):
+    """Sharding constraint inside jit (hint to GSPMD)."""
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def batch_sharding(mesh: Mesh, *trailing: Union[str, None]) -> NamedSharding:
+    """Sharding for [batch, ...] data: batch over (dcn, data, fsdp)."""
+    return NamedSharding(mesh, P(("dcn", "data", "fsdp"), *trailing))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

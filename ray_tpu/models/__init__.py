@@ -1,0 +1,8 @@
+from ray_tpu.models.gpt2 import (GPT2, GPT2Config, gpt2_sharding_rules,
+                                 gpt2_124m)
+from ray_tpu.models.resnet import ResNet, ResNetConfig, resnet50, resnet18
+
+__all__ = [
+    "GPT2", "GPT2Config", "gpt2_sharding_rules", "gpt2_124m",
+    "ResNet", "ResNetConfig", "resnet50", "resnet18",
+]

@@ -1,0 +1,124 @@
+"""ResNet family in flax (v1.5 bottleneck, as used by the reference's AIR
+image benchmarks — doc/source/ray-air/benchmarks.rst GPU image training).
+
+TPU notes: NHWC layout (XLA-TPU native), bfloat16 conv compute with fp32
+batch-norm statistics, channel counts multiples of 128 in the deep stages so
+convs tile the MXU cleanly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    width: int = 64
+    bottleneck: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    small_inputs: bool = False    # CIFAR-style stem (3x3, no maxpool)
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 4, 6, 3), bottleneck=True,
+                        num_classes=num_classes, **kw)
+
+
+def resnet18(num_classes: int = 1000, **kw) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(2, 2, 2, 2), bottleneck=False,
+                        num_classes=num_classes, **kw)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.config
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), (self.strides, self.strides),
+                 name="conv2")(y)
+        y = norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(4 * self.filters, (1, 1), name="conv3")(y)
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(4 * self.filters, (1, 1),
+                            (self.strides, self.strides),
+                            name="downsample")(residual)
+            residual = norm(name="bn_ds")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.config
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (3, 3), (self.strides, self.strides),
+                 name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), name="conv2")(y)
+        y = norm(name="bn2", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            (self.strides, self.strides),
+                            name="downsample")(residual)
+            residual = norm(name="bn_ds")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.config
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        x = x.astype(cfg.dtype)
+        if cfg.small_inputs:
+            x = conv(cfg.width, (3, 3), name="conv_stem")(x)
+        else:
+            x = conv(cfg.width, (7, 7), (2, 2), name="conv_stem")(x)
+        x = norm(name="bn_stem")(x)
+        x = nn.relu(x)
+        if not cfg.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block_cls = BottleneckBlock if cfg.bottleneck else BasicBlock
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if (i > 0 and j == 0) else 1
+                x = block_cls(cfg.width * (2 ** i), strides, cfg,
+                              name=f"stage{i}_block{j}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                     param_dtype=cfg.param_dtype, name="head")(x)
+        return x

@@ -1,0 +1,3 @@
+from ray_tpu.ops.attention import multi_head_attention
+
+__all__ = ["multi_head_attention"]

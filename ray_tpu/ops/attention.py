@@ -1,0 +1,60 @@
+"""Attention ops with pluggable implementations.
+
+impl:
+- "xla":   einsum attention; XLA fuses mask+softmax well on TPU.
+- "flash": pallas blockwise flash-attention kernel (TPU only, falls back
+           to xla off-TPU) — ray_tpu.ops.flash_attention.
+- "ring":  sequence-parallel ring attention over the mesh `sequence` axis —
+           ray_tpu.parallel.sequence (callers use it via shard_map).
+- "auto":  flash on TPU when shapes allow, else xla.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def xla_attention(q, k, v, causal: bool = True,
+                  bias: Optional[jax.Array] = None) -> jax.Array:
+    """Reference attention. [B, T, H, D] layout; fp32 softmax."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias
+    if causal:
+        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), k=Tk - Tq)
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def multi_head_attention(q, k, v, causal: bool = True,
+                         impl: str = "auto",
+                         bias: Optional[jax.Array] = None) -> jax.Array:
+    if impl == "auto":
+        impl = "flash" if (_on_tpu() and bias is None and
+                           q.shape[1] >= 256 and
+                           q.shape[1] % 128 == 0) else "xla"
+    if impl == "flash":
+        try:
+            from ray_tpu.ops.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal)
+        except Exception:
+            return xla_attention(q, k, v, causal=causal, bias=bias)
+    if impl == "ring":
+        raise ValueError(
+            "impl='ring' must be invoked through "
+            "ray_tpu.parallel.sequence.ring_attention inside shard_map")
+    return xla_attention(q, k, v, causal=causal, bias=bias)

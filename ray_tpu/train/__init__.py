@@ -1,0 +1,8 @@
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                ScalingConfig)
+from ray_tpu.air.result import Result
+from ray_tpu.train.trainer import BaseTrainer, JaxTrainer, DataParallelTrainer
+
+__all__ = ["BaseTrainer", "JaxTrainer", "DataParallelTrainer",
+           "ScalingConfig", "RunConfig", "FailureConfig",
+           "CheckpointConfig", "Result"]

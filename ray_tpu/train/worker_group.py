@@ -1,0 +1,134 @@
+"""WorkerGroup: the actor gang a trainer runs on.
+
+Capability parity with the reference's WorkerGroup + BackendExecutor
+(python/ray/train/_internal/worker_group.py:91,
+train/_internal/backend_executor.py:42): N actors created under a PACK
+placement group, train loops launched asynchronously, results gathered one
+per worker per round, gang teardown/restart on failure. TPU-native: a worker
+is a *host* of an SPMD gang; its method runs a pjit-compiled loop over the
+host's chips, and a MeshSpec (not a process-group backend) defines the
+collective topology.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air import session as air_session
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.util import (PlacementGroupSchedulingStrategy, placement_group,
+                          remove_placement_group)
+
+
+class TrainWorker:
+    """Actor running one gang member's train loop, buffering reports."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self._buffer: List[tuple] = []
+        self._lock = threading.Lock()
+        self._done = False
+        self._error: Optional[BaseException] = None
+
+    def run(self, loop_fn: Callable, config: Dict[str, Any],
+            mesh_axes: Optional[Dict[str, int]],
+            resume_checkpoint: Optional[Checkpoint]) -> str:
+        mesh = None
+        if mesh_axes is not None:
+            from ray_tpu.mesh import create_mesh
+            mesh = create_mesh(mesh_axes)
+
+        def report_fn(metrics, checkpoint):
+            with self._lock:
+                self._buffer.append((metrics, checkpoint))
+
+        ctx = air_session.TrainContext(
+            world_rank=self.rank, world_size=self.world_size,
+            report_fn=report_fn, mesh=mesh,
+            checkpoint=resume_checkpoint, config=config)
+        air_session.set_context(ctx)
+        try:
+            if _takes_arg(loop_fn):
+                loop_fn(config)
+            else:
+                loop_fn()
+            return "done"
+        except BaseException as e:  # noqa: BLE001
+            with self._lock:
+                self._error = e
+            raise
+        finally:
+            with self._lock:
+                self._done = True
+            air_session.set_context(None)
+
+    def poll(self):
+        """Drain buffered (metrics, checkpoint) reports + status."""
+        with self._lock:
+            out = list(self._buffer)
+            self._buffer.clear()
+            return {"reports": out, "done": self._done,
+                    "error": self._error}
+
+    def shutdown_marker(self):
+        return True
+
+
+def _takes_arg(fn) -> bool:
+    import inspect
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return len([p for p in sig.parameters.values()
+                if p.default is p.empty and
+                p.kind in (p.POSITIONAL_ONLY,
+                           p.POSITIONAL_OR_KEYWORD)]) >= 1
+
+
+class WorkerGroup:
+    """A gang of TrainWorker actors under one placement group."""
+
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Dict[str, float],
+                 placement_strategy: str = "PACK"):
+        self.num_workers = num_workers
+        self._pg = placement_group(
+            [dict(resources_per_worker) for _ in range(num_workers)],
+            strategy=placement_strategy)
+        if not self._pg.wait(60):
+            remove_placement_group(self._pg)
+            raise TimeoutError(
+                f"Could not reserve {num_workers}x"
+                f"{resources_per_worker} for the worker group")
+        actor_cls = ray_tpu.remote(TrainWorker)
+        self.workers = []
+        for rank in range(num_workers):
+            w = actor_cls.options(
+                # The PG already reserved the resources.
+                num_cpus=0,
+                max_concurrency=2,   # run() + poll() concurrently
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self._pg,
+                    placement_group_bundle_index=rank),
+            ).remote(rank, num_workers)
+            self.workers.append(w)
+
+    def start_run(self, loop_fn, config, mesh_axes, resume_checkpoint):
+        return [w.run.remote(loop_fn, config, mesh_axes,
+                             resume_checkpoint)
+                for w in self.workers]
+
+    def poll_all(self) -> List[Dict[str, Any]]:
+        return ray_tpu.get([w.poll.remote() for w in self.workers])
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        remove_placement_group(self._pg)

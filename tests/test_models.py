@@ -1,0 +1,107 @@
+"""Model correctness tests on the CPU mesh (tiny configs)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.mesh import create_mesh, shard_params
+from ray_tpu.models import GPT2, ResNet, gpt2_sharding_rules, resnet18
+from ray_tpu.models.gpt2 import (cross_entropy_loss, count_params,
+                                 gpt2_tiny, gpt2_124m)
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = gpt2_tiny(dtype=jnp.float32, remat=False)
+    model = GPT2(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids = jnp.zeros((2, 16), dtype=jnp.int32)
+    params = model.init(rng, ids)
+    return cfg, model, params
+
+
+def test_gpt2_forward_shape(tiny_gpt):
+    cfg, model, params = tiny_gpt
+    ids = jnp.ones((2, 16), dtype=jnp.int32)
+    logits = model.apply(params, ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_gpt2_causality(tiny_gpt):
+    # Changing a future token must not change past logits.
+    cfg, model, params = tiny_gpt
+    rng = jax.random.PRNGKey(1)
+    ids = jax.random.randint(rng, (1, 16), 0, cfg.vocab_size)
+    logits_a = model.apply(params, ids)
+    ids_b = ids.at[0, 10].set((ids[0, 10] + 1) % cfg.vocab_size)
+    logits_b = model.apply(params, ids_b)
+    np.testing.assert_allclose(np.asarray(logits_a[0, :10]),
+                               np.asarray(logits_b[0, :10]),
+                               rtol=2e-4, atol=2e-4)
+    assert not np.allclose(np.asarray(logits_a[0, 10:]),
+                           np.asarray(logits_b[0, 10:]))
+
+
+def test_gpt2_loss_decreases_one_step(tiny_gpt):
+    cfg, model, params = tiny_gpt
+    rng = jax.random.PRNGKey(2)
+    ids = jax.random.randint(rng, (4, 17), 0, cfg.vocab_size)
+    x, y = ids[:, :-1], ids[:, 1:]
+
+    def loss_fn(p):
+        return cross_entropy_loss(model.apply(p, x), y)
+
+    l0, grads = jax.value_and_grad(loss_fn)(params)
+    params2 = jax.tree_util.tree_map(lambda p, g: p - 0.5 * g, params,
+                                     grads)
+    l1 = loss_fn(params2)
+    assert float(l1) < float(l0)
+
+
+def test_gpt2_124m_param_count():
+    cfg = gpt2_124m()
+    model = GPT2(cfg)
+    params = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), dtype=jnp.int32)))
+    n = sum(int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(params))
+    # 124M with padded vocab (50304): ~124.4M
+    assert 120e6 < n < 130e6, n
+
+
+def test_gpt2_sharded_forward_matches_single(tiny_gpt, cpu_mesh_devices):
+    cfg, model, params = tiny_gpt
+    mesh = create_mesh({"data": 2, "tensor": 4})
+    ids = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0,
+                             cfg.vocab_size)
+    expected = model.apply(params, ids)
+    sharded = shard_params(params, gpt2_sharding_rules(fsdp=False), mesh)
+    out = jax.jit(model.apply)(sharded, ids)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(out),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_cross_entropy_ignore_index():
+    logits = jnp.zeros((1, 4, 10))
+    targets = jnp.array([[1, 2, -100, -100]])
+    loss = cross_entropy_loss(logits, targets)
+    # Uniform logits: loss = log(10), averaged over 2 valid tokens.
+    assert float(loss) == pytest.approx(np.log(10), rel=1e-5)
+
+
+def test_resnet18_forward():
+    cfg = resnet18(num_classes=10, dtype=jnp.float32,
+                   small_inputs=True)
+    model = ResNet(cfg)
+    x = jnp.ones((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    logits = model.apply(variables, x)
+    assert logits.shape == (2, 10)
+
+    # Train mode updates batch stats.
+    logits, updates = model.apply(
+        variables, x, train=True, mutable=["batch_stats"])
+    assert logits.shape == (2, 10)
+    assert "batch_stats" in updates
