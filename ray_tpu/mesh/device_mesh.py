@@ -115,6 +115,10 @@ def create_mesh(spec: Optional[MeshSpec | Dict[str, int]] = None,
         spec = MeshSpec(data=len(devices))
     if isinstance(spec, dict):
         spec = MeshSpec.from_dict(spec)
+    if -1 not in spec.sizes().values() and \
+            spec.num_devices() < len(devices):
+        # Fully-specified smaller mesh: use a device subset.
+        devices = list(devices)[:spec.num_devices()]
     spec = spec.resolve(len(devices))
     sizes = spec.sizes()
     shape = tuple(sizes[a] for a in AXIS_ORDER)

@@ -1,0 +1,8 @@
+from ray_tpu.parallel.sequence import (ring_attention,
+                                       sequence_sharded_attention,
+                                       ulysses_attention)
+from ray_tpu.parallel.pipeline import pipeline_apply
+from ray_tpu.parallel.expert import SwitchMoE
+
+__all__ = ["ring_attention", "ulysses_attention",
+           "sequence_sharded_attention", "pipeline_apply", "SwitchMoE"]

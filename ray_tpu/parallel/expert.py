@@ -1,0 +1,104 @@
+"""Expert parallelism: Switch-style MoE with experts sharded on the
+`expert` mesh axis.
+
+The reference has no EP (SURVEY.md §2.4). TPU-native design: expert weights
+carry a P("expert", ...) sharding; token dispatch/combine are dense einsums
+against a one-hot dispatch tensor with sharding constraints, so GSPMD lowers
+the dispatch to all-to-all over ICI — no hand-written routing collectives.
+Capacity-factor truncation keeps shapes static for XLA.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _maybe_constrain(x, spec: P):
+    """Sharding constraint that is a no-op when no mesh is active (so the
+    module also runs un-sharded, e.g. in unit tests / eval scripts)."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or mesh.empty:
+            return x
+        return jax.lax.with_sharding_constraint(x, spec)
+    except Exception:
+        return x
+
+
+class SwitchMoE(nn.Module):
+    """Top-1 (switch) MoE FFN block.
+
+    Input  [B, T, d_model] -> Output [B, T, d_model].
+    num_experts should be a multiple of the mesh `expert` axis size.
+    """
+    num_experts: int
+    d_model: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+    use_sharding_constraint: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, D = x.shape
+        E = self.num_experts
+        N = B * T
+        C = max(1, int(self.capacity_factor * N / E))
+
+        tokens = x.reshape(N, D)
+        router_w = self.param("router", nn.initializers.normal(0.02),
+                              (D, E), jnp.float32)
+        logits = tokens.astype(jnp.float32) @ router_w       # [N, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)              # [N]
+        gate = jnp.take_along_axis(probs, expert_idx[:, None],
+                                   axis=-1)[:, 0]            # [N]
+
+        # Position of each token within its expert's capacity buffer.
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # [N,E]
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot
+        pos = jnp.sum(pos_in_expert, axis=-1)                # [N]
+        keep = pos < C                                       # overflow drop
+        # dispatch[n, e, c] = 1 iff token n goes to slot c of expert e.
+        dispatch = (jax.nn.one_hot(expert_idx, E, dtype=self.dtype) *
+                    keep[:, None])[..., None] * \
+            jax.nn.one_hot(pos, C, dtype=self.dtype)[:, None, :]
+
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (E, D, self.d_ff), jnp.float32).astype(self.dtype)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (E, self.d_ff, D), jnp.float32).astype(self.dtype)
+
+        expert_in = jnp.einsum("nd,nec->ecd", tokens.astype(self.dtype),
+                               dispatch)                     # [E,C,D]
+        if self.use_sharding_constraint:
+            expert_in = _maybe_constrain(expert_in,
+                                         P("expert", None, None))
+        h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, w1))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w2)       # [E,C,D]
+        if self.use_sharding_constraint:
+            expert_out = _maybe_constrain(expert_out,
+                                          P("expert", None, None))
+
+        combined = jnp.einsum("ecd,nec->nd", expert_out, dispatch)
+        out = combined * gate[:, None].astype(self.dtype)
+        # Load-balancing auxiliary loss (Switch Transformer eq. 4).
+        frac_tokens = jnp.mean(
+            jax.nn.one_hot(expert_idx, E, dtype=jnp.float32), axis=0)
+        frac_probs = jnp.mean(probs, axis=0)
+        self.sow("losses", "load_balance",
+                 E * jnp.sum(frac_tokens * frac_probs))
+        return out.reshape(B, T, D)
+
+
+def moe_sharding_rules():
+    """Extra rules for MoE params (merge with the model's rules)."""
+    return [
+        (r"moe.*/w1$", P("expert", None, "tensor")),
+        (r"moe.*/w2$", P("expert", "tensor", None)),
+        (r"moe.*/router$", P(None, None)),
+    ]

@@ -1,0 +1,99 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+The reference has no PP (SURVEY.md §2.4). TPU-native design: stages are the
+`pipeline` mesh axis; every device runs the same shard_map program; stage
+boundaries are `lax.ppermute` neighbor pushes (point-to-point over ICI); the
+schedule is a fori_loop of M + S - 1 ticks, so the whole pipeline is ONE
+XLA program — no per-stage actors, no host round-trips between stages.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any,
+                   x: jax.Array,
+                   num_microbatches: int,
+                   mesh: Mesh,
+                   axis_name: str = "pipeline") -> jax.Array:
+    """Run `stage_fn` as an S-stage pipeline.
+
+    stage_fn(params_for_one_stage, activation) -> activation (same shape).
+    stage_params: pytree whose leaves have a leading stage axis of size S
+        (leaf shape [S, ...]); each device consumes its own slice.
+    x: [B, ...] input batch (replicated across the pipeline axis).
+    num_microbatches: M; B must be divisible by M.
+
+    Returns [B, ...] output of the final stage (replicated).
+    """
+    S = mesh.shape[axis_name]
+    B = x.shape[0]
+    if B % num_microbatches:
+        raise ValueError(f"batch {B} not divisible by M={num_microbatches}")
+    mb = B // num_microbatches
+    M = num_microbatches
+
+    params_spec = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stage_params)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(params_spec, P()), out_specs=P())
+    def run(local_params, xfull):
+        # local_params leaves: [1, ...] (this stage's slice).
+        local_params = jax.tree_util.tree_map(
+            lambda p: p[0], local_params)
+        stage = jax.lax.axis_index(axis_name)
+        micro = xfull.reshape((M, mb) + xfull.shape[1:])
+        # Device-varying over the pipeline axis (jax>=0.9 vma typing).
+        outputs = jax.lax.pcast(jnp.zeros_like(micro), (axis_name,),
+                                to="varying")
+        carry_in = jax.lax.pcast(
+            jnp.zeros((mb,) + xfull.shape[1:], xfull.dtype),
+            (axis_name,), to="varying")
+
+        def tick(t, state):
+            outputs, recv = state
+            # Stage 0 injects microbatch t (while t < M); others use recv.
+            inj = jax.lax.dynamic_index_in_dim(
+                micro, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+            act_in = jnp.where(stage == 0, inj, recv)
+            act_out = stage_fn(local_params, act_in)
+            # Valid iff this stage processed a real microbatch this tick.
+            valid = jnp.logical_and(t - stage >= 0, t - stage < M)
+            act_out = jnp.where(valid, act_out, jnp.zeros_like(act_out))
+            # Last stage banks its result at microbatch index t-(S-1).
+            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            banked = jax.lax.dynamic_update_index_in_dim(
+                outputs, act_out.astype(outputs.dtype), out_idx, axis=0)
+            is_last = stage == S - 1
+            take = jnp.logical_and(is_last, t >= S - 1)
+            outputs = jnp.where(take, banked, outputs)
+            # Push activation to the next stage (ring; wraps harmlessly).
+            recv = jax.lax.ppermute(
+                act_out, axis_name,
+                [(i, (i + 1) % S) for i in range(S)])
+            return outputs, recv
+
+        outputs, _ = jax.lax.fori_loop(0, M + S - 1, tick,
+                                       (outputs, carry_in))
+        # Broadcast the last stage's outputs to every stage so out_specs
+        # P() (replicated) is truthful.
+        outputs = jax.lax.psum(
+            jnp.where(stage == S - 1, outputs,
+                      jnp.zeros_like(outputs)), axis_name)
+        return outputs.reshape((B,) + xfull.shape[1:])
+
+    return run(stage_params, x)
+
+
+def stack_stage_params(per_stage_params) -> Any:
+    """Stack a list of S per-stage pytrees into one pytree with a leading
+    stage axis (what pipeline_apply expects)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)

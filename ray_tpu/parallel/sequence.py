@@ -1,0 +1,128 @@
+"""Sequence/context parallelism: ring attention and Ulysses.
+
+The reference has none of this (SURVEY.md §2.4: zero TP/SP hits — its only
+scaling axis is data). These are the TPU-native long-context obligations:
+
+- ring_attention: blockwise-softmax attention where each device holds a
+  sequence chunk and K/V chunks rotate around the mesh axis via
+  `lax.ppermute` (neighbor exchange rides ICI). O(T/n) activation memory
+  per device; compute overlaps the rotation.
+- ulysses_attention: all-to-all re-shard — trade sequence sharding for head
+  sharding, run full-sequence attention on 1/n of the heads locally, and
+  all-to-all back. One big collective, DCN-friendly.
+
+Both are written to run INSIDE `jax.shard_map` over a mesh `sequence` axis;
+`sequence_sharded_attention` is the outside-jit convenience wrapper.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, qpos, kpos, causal: bool):
+    """One Q-chunk x K-chunk block. q:[B,Tq,H,D] k/v:[B,Tk,H,D].
+    Returns (o_partial [B,Tq,H,D] fp32, m [B,H,Tq] fp32, l [B,H,Tq] fp32).
+    """
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = kpos[None, :] <= qpos[:, None]          # [Tq, Tk]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                            # [B,H,Tq]
+    # Fully-masked rows: keep m finite so exp() underflows to 0 cleanly.
+    m_safe = jnp.maximum(m, -1e29)
+    p = jnp.exp(s - m_safe[..., None])                 # [B,H,Tq,Tk]
+    l = jnp.sum(p, axis=-1)                            # [B,H,Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o, m_safe, l
+
+
+def ring_attention(q, k, v, axis_name: str = "sequence",
+                   causal: bool = True) -> jax.Array:
+    """Blockwise ring attention over `axis_name`. Call inside shard_map;
+    q/k/v are local chunks [B, T_local, H, D] of the sequence-sharded
+    arrays. Returns the local output chunk in q.dtype."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    my_qpos = idx * T + jnp.arange(T)
+
+    def body(s, carry):
+        o, m, l, kc, vc, src = carry
+        kpos = src * T + jnp.arange(T)
+        o_b, m_b, l_b = _block_attention(q, kc, vc, my_qpos, kpos, causal)
+        m_new = jnp.maximum(m, m_b)
+        alpha = jnp.exp(m - m_new)                     # rescale old
+        beta = jnp.exp(m_b - m_new)                    # rescale block
+        o = o * alpha.transpose(0, 2, 1)[..., None] + \
+            o_b * beta.transpose(0, 2, 1)[..., None]
+        l = l * alpha + l_b * beta
+        # Rotate K/V to the next device (neighbor exchange over ICI).
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        src = (src - 1) % n
+        return o, m_new, l, kc, vc, src
+
+    # Mark the accumulators as device-varying over the ring axis so the
+    # fori_loop carry types stay consistent after ppermute (jax>=0.9 vma).
+    o0 = jax.lax.pcast(jnp.zeros((B, T, H, D), jnp.float32),
+                       (axis_name,), to="varying")
+    m0 = jax.lax.pcast(jnp.full((B, H, T), _NEG_INF, jnp.float32),
+                       (axis_name,), to="varying")
+    l0 = jax.lax.pcast(jnp.zeros((B, H, T), jnp.float32),
+                       (axis_name,), to="varying")
+    o, m, l, _, _, _ = jax.lax.fori_loop(
+        0, n, body, (o0, m0, l0, k, v, idx))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sequence",
+                      causal: bool = True,
+                      attention_fn=None) -> jax.Array:
+    """All-to-all head-sharded attention. Call inside shard_map with
+    sequence-sharded local chunks [B, T_local, H, D]; requires H divisible
+    by the axis size."""
+    from ray_tpu.ops.attention import xla_attention
+    attention_fn = attention_fn or xla_attention
+    n = jax.lax.psum(1, axis_name)
+
+    def seq_to_heads(x):
+        # [B, T/n, H, D] -> [B, T, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    oh = attention_fn(qh, kh, vh, causal=causal)
+    return heads_to_seq(oh)
+
+
+def sequence_sharded_attention(q, k, v, mesh: Mesh, causal: bool = True,
+                               impl: str = "ring",
+                               axis_name: str = "sequence") -> jax.Array:
+    """Outside-jit wrapper: q/k/v are global [B,T,H,D] arrays (sharded or
+    not); attention runs sequence-parallel over `axis_name` of `mesh`."""
+    inner = ring_attention if impl == "ring" else ulysses_attention
+    spec = P(None, axis_name, None, None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec)
+    def run(ql, kl, vl):
+        return inner(ql, kl, vl, axis_name=axis_name, causal=causal)
+
+    return run(q, k, v)
