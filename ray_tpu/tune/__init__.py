@@ -1,0 +1,15 @@
+from ray_tpu.tune.search import (choice, grid_search, loguniform, qrandint,
+                                 randint, uniform, BasicVariantGenerator)
+from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                                     MedianStoppingRule,
+                                     PopulationBasedTraining)
+from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid
+from ray_tpu.tune.trial import Trial
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "Trial",
+    "grid_search", "choice", "uniform", "loguniform", "randint",
+    "qrandint", "BasicVariantGenerator",
+    "FIFOScheduler", "AsyncHyperBandScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining",
+]

@@ -1,0 +1,166 @@
+"""Trial schedulers.
+
+Capability parity with the reference's tune.schedulers: FIFO
+(schedulers/trial_scheduler.py), ASHA (async_hyperband.py), median stopping
+(median_stopping_rule.py), PBT (pbt.py). Decisions are made on each
+reported result; PBT additionally exploits/explores through checkpoints.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.tune.trial import Trial
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def __init__(self, metric: str = "loss", mode: str = "min"):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+
+    def _sign(self, value: float) -> float:
+        return -value if self.mode == "max" else value
+
+    def on_result(self, trial: Trial, result: Dict[str, Any],
+                  all_trials: List[Trial]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial: Trial, all_trials: List[Trial]):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: asynchronous successive halving (reference:
+    tune/schedulers/async_hyperband.py). Rungs at grace_period *
+    reduction_factor^k; a trial stops at a rung if it is not in the top
+    1/reduction_factor of completed results at that rung."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 4,
+                 max_t: int = 100):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        # rung milestone -> list of recorded metric values
+        self.rungs: Dict[int, List[float]] = {}
+        m = grace_period
+        while m < max_t:
+            self.rungs[m] = []
+            m *= reduction_factor
+
+    def on_result(self, trial, result, all_trials) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        for milestone in sorted(self.rungs):
+            if t == milestone:
+                recorded = self.rungs[milestone]
+                recorded.append(self._sign(float(value)))
+                k = max(1, len(recorded) // self.rf)
+                cutoff = sorted(recorded)[k - 1]
+                if self._sign(float(value)) > cutoff:
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running averages at the same step (reference:
+    tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+
+    def on_result(self, trial, result, all_trials) -> str:
+        t = result.get(self.time_attr, 0)
+        if t < self.grace:
+            return CONTINUE
+        averages = []
+        for other in all_trials:
+            if other.trial_id == trial.trial_id:
+                continue
+            hist = [self._sign(v)
+                    for v in other.metric_history(self.metric)]
+            if hist:
+                averages.append(sum(hist) / len(hist))
+        if len(averages) < self.min_samples:
+            return CONTINUE
+        median = sorted(averages)[len(averages) // 2]
+        best = min(self._sign(v)
+                   for v in trial.metric_history(self.metric))
+        return STOP if best > median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py): at each
+    perturbation_interval, bottom-quantile trials clone the checkpoint of
+    a top-quantile trial and continue with mutated hyperparameters. The
+    runner performs the actual exploit via trial.checkpoint."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self._rng = random.Random(seed)
+        # trial_id -> exploit instruction for the runner
+        self.pending_exploits: Dict[str, Dict[str, Any]] = {}
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            if callable(spec):
+                new[key] = spec()
+            elif isinstance(spec, list):
+                new[key] = self._rng.choice(spec)
+            else:  # numeric: perturb by 0.8x / 1.2x
+                factor = self._rng.choice([0.8, 1.2])
+                new[key] = config.get(key, spec) * factor
+        return new
+
+    def on_result(self, trial, result, all_trials) -> str:
+        t = result.get(self.time_attr, 0)
+        if t == 0 or t % self.interval != 0:
+            return CONTINUE
+        scored = [(self._sign(x.last_result[self.metric]), x)
+                  for x in all_trials
+                  if x.last_result and self.metric in x.last_result]
+        if len(scored) < 2:
+            return CONTINUE
+        scored.sort(key=lambda p: p[0])
+        n = max(1, int(len(scored) * self.quantile))
+        top = [x for _, x in scored[:n]]
+        bottom_ids = {x.trial_id for _, x in scored[-n:]}
+        if trial.trial_id in bottom_ids:
+            donor = self._rng.choice(top)
+            if donor.trial_id != trial.trial_id and \
+                    donor.checkpoint is not None:
+                self.pending_exploits[trial.trial_id] = {
+                    "config": self._mutate(donor.config),
+                    "checkpoint": donor.checkpoint,
+                }
+        return CONTINUE

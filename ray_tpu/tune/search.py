@@ -1,0 +1,142 @@
+"""Search spaces and variant generation.
+
+Capability parity with the reference's tune.search (sample domains
+python/ray/tune/search/sample.py, grid/variant expansion
+search/basic_variant.py + variant_generator.py). Pluggable Searcher
+interface mirrors search/searcher.py so external algorithms (optuna-style)
+can be adapted.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+        self.lo, self.hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(self.lo, self.hi))
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class QRandInt(Domain):
+    def __init__(self, low: int, high: int, q: int):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        return (rng.randrange(self.low, self.high) // self.q) * self.q
+
+
+class Choice(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def qrandint(low, high, q) -> QRandInt:
+    return QRandInt(low, high, q)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def grid_search(values: List[Any]) -> Dict[str, Any]:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+class Searcher:
+    """Suggest/observe interface (reference: tune/search/searcher.py)."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid expansion x num_samples random sampling."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self._rng = random.Random(seed)
+        self._variants = self._expand()
+        self._idx = 0
+
+    def _expand(self) -> List[Dict[str, Any]]:
+        grid_keys = [k for k, v in self.param_space.items()
+                     if _is_grid(v)]
+        grid_values = [self.param_space[k]["grid_search"]
+                       for k in grid_keys]
+        variants = []
+        for _ in range(self.num_samples):
+            for combo in itertools.product(*grid_values) if grid_keys \
+                    else [()]:
+                cfg = {}
+                for k, v in self.param_space.items():
+                    if k in grid_keys:
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self._rng)
+                    else:
+                        cfg[k] = v
+                variants.append(cfg)
+        return variants
+
+    def total(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._idx >= len(self._variants):
+            return None
+        cfg = self._variants[self._idx]
+        self._idx += 1
+        return cfg

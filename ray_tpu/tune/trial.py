@@ -1,0 +1,45 @@
+"""Trial: one configuration's lifecycle.
+
+Capability parity with the reference's Trial (python/ray/tune/experiment/
+trial.py state machine) reduced to the states the runner drives:
+PENDING → RUNNING → (TERMINATED | ERROR | STOPPED), PAUSED for PBT.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+_ids = itertools.count()
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+STOPPED = "STOPPED"       # stopped early by a scheduler
+
+
+@dataclasses.dataclass
+class Trial:
+    config: Dict[str, Any]
+    trial_id: str = dataclasses.field(
+        default_factory=lambda: f"trial_{next(_ids):05d}")
+    state: str = PENDING
+    last_result: Optional[Dict[str, Any]] = None
+    results: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[BaseException] = None
+    restarts: int = 0
+    # Runner bookkeeping (actor handle + pending run ref).
+    runtime_handle: Any = None
+    run_ref: Any = None
+
+    def metric_history(self, metric: str) -> List[float]:
+        return [r[metric] for r in self.results if metric in r]
+
+    @property
+    def finished(self) -> bool:
+        return self.state in (TERMINATED, ERROR, STOPPED)

@@ -1,0 +1,201 @@
+"""Tuner: trial orchestration event loop.
+
+Capability parity with the reference's Tuner/tune.run/TrialRunner
+(python/ray/tune/tuner.py:212, tune/tune.py:129,
+tune/execution/trial_runner.py:236,864 + ray_trial_executor.py:192): a
+searcher proposes configs, trials run as actors under resource limits, every
+reported result flows through the scheduler (early stopping / PBT exploits),
+checkpoints are tracked per trial, failed trials retry up to max_failures.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.config import RunConfig
+from ray_tpu.air.result import Result
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.schedulers import (CONTINUE, STOP, FIFOScheduler,
+                                     PopulationBasedTraining,
+                                     TrialScheduler)
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.trial import (ERROR, PAUSED, PENDING, RUNNING, STOPPED,
+                                TERMINATED, Trial)
+from ray_tpu.train.worker_group import TrainWorker
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
+    resources_per_trial: Optional[Dict[str, float]] = None
+    max_failures: int = 0
+    time_budget_s: Optional[float] = None
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial]):
+        self.trials = trials
+
+    def __len__(self):
+        return len(self.trials)
+
+    def __getitem__(self, i) -> Result:
+        t = self.trials[i]
+        return Result(metrics=t.last_result, checkpoint=t.checkpoint,
+                      error=t.error,
+                      metrics_history=list(t.results))
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or "loss"
+        mode = mode or "min"
+        best, best_val = None, None
+        for t in self.trials:
+            if not t.last_result or metric not in t.last_result:
+                continue
+            # Best across the trial's whole history (a stopped trial may
+            # have peaked earlier).
+            vals = t.metric_history(metric)
+            v = min(vals) if mode == "min" else max(vals)
+            if best_val is None or (v < best_val if mode == "min"
+                                    else v > best_val):
+                best, best_val = t, v
+        if best is None:
+            raise ValueError(f"No trial reported metric {metric!r}")
+        i = self.trials.index(best)
+        return self[i]
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return [t.error for t in self.trials if t.error is not None]
+
+
+class Tuner:
+    def __init__(self, trainable: Callable,
+                 *, param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._fn = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    # --- trial process management -----------------------------------------
+
+    def _actor_options(self) -> Dict[str, Any]:
+        res = dict(self.tune_config.resources_per_trial or {"CPU": 1})
+        opts: Dict[str, Any] = {"max_concurrency": 2}
+        opts["num_cpus"] = res.pop("CPU", 1)
+        opts["num_tpus"] = res.pop("TPU", 0)
+        if res:
+            opts["resources"] = res
+        return opts
+
+    def _start_trial(self, trial: Trial,
+                     resume_checkpoint=None) -> None:
+        actor_cls = ray_tpu.remote(TrainWorker)
+        handle = actor_cls.options(**self._actor_options()).remote(0, 1)
+        trial.runtime_handle = handle
+        trial.run_ref = handle.run.remote(
+            self._fn, trial.config, None,
+            resume_checkpoint if resume_checkpoint is not None
+            else trial.checkpoint)
+        trial.state = RUNNING
+
+    def _stop_trial(self, trial: Trial, state: str):
+        trial.state = state
+        if trial.runtime_handle is not None:
+            try:
+                ray_tpu.kill(trial.runtime_handle)
+            except Exception:
+                pass
+            trial.runtime_handle = None
+
+    # --- the event loop ---------------------------------------------------
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler(tc.metric, tc.mode)
+        searcher = tc.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=tc.num_samples)
+
+        trials: List[Trial] = []
+        while True:
+            cfg = searcher.suggest(f"t{len(trials)}")
+            if cfg is None:
+                break
+            trials.append(Trial(config=cfg))
+
+        start_time = time.time()
+        while True:
+            running = [t for t in trials if t.state == RUNNING]
+            pending = [t for t in trials if t.state == PENDING]
+            # Launch up to the concurrency cap.
+            while pending and len(running) < tc.max_concurrent_trials:
+                t = pending.pop(0)
+                self._start_trial(t)
+                running.append(t)
+            if not running:
+                break
+
+            made_progress = False
+            for trial in running:
+                poll = ray_tpu.get(
+                    trial.runtime_handle.poll.remote())
+                for metrics, ckpt in poll["reports"]:
+                    made_progress = True
+                    metrics = dict(metrics)
+                    metrics.setdefault("training_iteration",
+                                       len(trial.results) + 1)
+                    trial.results.append(metrics)
+                    trial.last_result = metrics
+                    if ckpt is not None:
+                        trial.checkpoint = ckpt
+                    decision = scheduler.on_result(trial, metrics,
+                                                   trials)
+                    if decision == STOP:
+                        self._stop_trial(trial, STOPPED)
+                        break
+                if trial.state != RUNNING:
+                    continue
+                # PBT exploit?
+                if isinstance(scheduler, PopulationBasedTraining):
+                    exploit = scheduler.pending_exploits.pop(
+                        trial.trial_id, None)
+                    if exploit is not None:
+                        made_progress = True
+                        self._stop_trial(trial, PAUSED)
+                        trial.config = exploit["config"]
+                        trial.checkpoint = exploit["checkpoint"]
+                        self._start_trial(trial)
+                        continue
+                if poll["done"]:
+                    made_progress = True
+                    if poll["error"] is not None:
+                        if trial.restarts < tc.max_failures:
+                            trial.restarts += 1
+                            self._start_trial(trial)
+                        else:
+                            trial.error = poll["error"]
+                            self._stop_trial(trial, ERROR)
+                            scheduler.on_trial_complete(trial, trials)
+                    else:
+                        self._stop_trial(trial, TERMINATED)
+                        scheduler.on_trial_complete(trial, trials)
+
+            if tc.time_budget_s is not None and \
+                    time.time() - start_time > tc.time_budget_s:
+                for t in trials:
+                    if not t.finished:
+                        self._stop_trial(t, STOPPED)
+                break
+            if not made_progress:
+                time.sleep(0.01)
+        return ResultGrid(trials)

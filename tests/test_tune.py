@@ -1,0 +1,134 @@
+"""Tune tests (reference analogues: tune/tests/test_tune_*.py,
+test_trial_scheduler.py)."""
+import pytest
+
+import ray_tpu
+from ray_tpu.air import session, Checkpoint
+from ray_tpu.tune import (AsyncHyperBandScheduler, MedianStoppingRule,
+                          PopulationBasedTraining, TuneConfig, Tuner,
+                          choice, grid_search, uniform)
+
+
+def _trainable_quadratic(config):
+    # Minimum at x=3.
+    loss = (config["x"] - 3.0) ** 2
+    for step in range(3):
+        session.report({"loss": loss + 0.1 / (step + 1)})
+
+
+def test_grid_search_runs_all(rt):
+    tuner = Tuner(
+        _trainable_quadratic,
+        param_space={"x": grid_search([0.0, 1.0, 3.0, 5.0])},
+        tune_config=TuneConfig(metric="loss", mode="min"))
+    grid = tuner.fit()
+    assert len(grid) == 4
+    best = grid.get_best_result("loss", "min")
+    assert best.metrics is not None
+    # x=3 wins.
+    assert abs(best.metrics["loss"] - 0.1 / 3) < 1e-6
+
+
+def test_num_samples_with_domains(rt):
+    tuner = Tuner(
+        _trainable_quadratic,
+        param_space={"x": uniform(-1, 1), "tag": choice(["a", "b"])},
+        tune_config=TuneConfig(num_samples=5))
+    grid = tuner.fit()
+    assert len(grid) == 5
+    assert not grid.errors
+
+
+def test_trial_error_captured(rt):
+    def bad(config):
+        raise RuntimeError("boom-" + str(config["x"]))
+
+    grid = Tuner(bad, param_space={"x": grid_search([1, 2])}).fit()
+    assert len(grid.errors) == 2
+
+
+def test_trial_retry_on_failure(rt):
+    def flaky(config):
+        ckpt = session.get_checkpoint()
+        if ckpt is None:
+            session.report(
+                {"loss": 1.0},
+                checkpoint=Checkpoint.from_dict({"seen": True}))
+            raise RuntimeError("first attempt dies")
+        session.report({"loss": 0.5})
+
+    grid = Tuner(
+        flaky, param_space={"x": grid_search([1])},
+        tune_config=TuneConfig(max_failures=1)).fit()
+    assert not grid.errors
+    assert grid[0].metrics["loss"] == 0.5
+
+
+def test_asha_stops_bad_trials_early(rt):
+    reports_made = {}
+
+    def trainable(config):
+        for step in range(1, 17):
+            # Bad configs have high loss; good configs low.
+            session.report({"loss": config["badness"] + 1.0 / step,
+                            "training_iteration": step})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"badness": grid_search(
+            [0.0, 0.0, 5.0, 5.0, 5.0, 5.0, 5.0, 5.0])},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", max_concurrent_trials=8,
+            scheduler=AsyncHyperBandScheduler(
+                metric="loss", mode="min", grace_period=2,
+                reduction_factor=2, max_t=16)))
+    grid = tuner.fit()
+    stopped = [t for t in grid.trials if t.state == "STOPPED"]
+    finished = [t for t in grid.trials if t.state == "TERMINATED"]
+    assert stopped, "ASHA should stop some bad trials early"
+    assert finished, "good trials should run to completion"
+    # No stopped trial ran all 16 iterations.
+    assert all(len(t.results) < 16 for t in stopped)
+
+
+def test_median_stopping(rt):
+    def trainable(config):
+        for step in range(1, 9):
+            session.report({"loss": config["level"],
+                            "training_iteration": step})
+
+    grid = Tuner(
+        trainable,
+        param_space={"level": grid_search([1.0, 1.0, 1.0, 50.0])},
+        tune_config=TuneConfig(
+            max_concurrent_trials=4,
+            scheduler=MedianStoppingRule(
+                metric="loss", mode="min", grace_period=2,
+                min_samples_required=2))).fit()
+    worst = [t for t in grid.trials if t.config["level"] == 50.0][0]
+    assert worst.state == "STOPPED"
+
+
+def test_pbt_exploits_checkpoint(rt):
+    def trainable(config):
+        ckpt = session.get_checkpoint()
+        score = ckpt["score"] if ckpt else 0.0
+        for step in range(1, 21):
+            score += config["lr"]
+            session.report(
+                {"score": score, "training_iteration": step},
+                checkpoint=Checkpoint.from_dict({"score": score}))
+
+    scheduler = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=5,
+        hyperparam_mutations={"lr": [0.1, 1.0]}, seed=0)
+    grid = Tuner(
+        trainable,
+        param_space={"lr": grid_search([0.1, 0.1, 1.0, 1.0])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               max_concurrent_trials=4,
+                               scheduler=scheduler)).fit()
+    best = grid.get_best_result("score", "max")
+    # With exploitation, the best score should reflect mostly lr=1.0
+    # progress: > 20 * 0.5.
+    assert best.metrics["score"] > 10.0
