@@ -1,0 +1,136 @@
+"""Serve public API.
+
+Capability parity with the reference's @serve.deployment / serve.run
+(python/ray/serve/api.py:250,428).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import time
+from typing import Any, Callable, Dict, Optional, Union
+
+import ray_tpu
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.controller import (CONTROLLER_NAME, Controller,
+                                      get_or_create_controller)
+from ray_tpu.serve.router import DeploymentHandle
+
+
+class Deployment:
+    def __init__(self, target: Union[type, Callable], name: str,
+                 config: DeploymentConfig):
+        self._target = target
+        self.name = name
+        self.config = config
+        self._init_args: tuple = ()
+        self._init_kwargs: Dict[str, Any] = {}
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                autoscaling_config: Optional[AutoscalingConfig] = None,
+                ray_actor_options: Optional[Dict[str, Any]] = None,
+                mesh: Optional[Dict[str, int]] = None) -> "Deployment":
+        import dataclasses
+        cfg = dataclasses.replace(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if autoscaling_config is not None:
+            cfg.autoscaling_config = autoscaling_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = ray_actor_options
+        if mesh is not None:
+            cfg.mesh = mesh
+        d = Deployment(self._target, name or self.name, cfg)
+        d._init_args = self._init_args
+        d._init_kwargs = self._init_kwargs
+        return d
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        d = Deployment(self._target, self.name, self.config)
+        d._init_args = args
+        d._init_kwargs = kwargs
+        return d
+
+    def _as_class(self) -> type:
+        if inspect.isclass(self._target):
+            return self._target
+        fn = self._target
+
+        class _FnWrapper:
+            def __call__(self, *a, **k):
+                return fn(*a, **k)
+        _FnWrapper.__name__ = getattr(fn, "__name__", "fn")
+        return _FnWrapper
+
+
+def deployment(_target=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 8,
+               autoscaling_config: Optional[AutoscalingConfig] = None,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               mesh: Optional[Dict[str, int]] = None):
+    """``@serve.deployment`` decorator for classes or functions."""
+
+    def wrap(target):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=autoscaling_config,
+            ray_actor_options=ray_actor_options,
+            mesh=mesh)
+        return Deployment(
+            target, name or getattr(target, "__name__", "deployment"),
+            cfg)
+
+    if _target is not None:
+        return wrap(_target)
+    return wrap
+
+
+def run(dep: Deployment, *, wait_for_ready: bool = True,
+        timeout_s: float = 60.0) -> DeploymentHandle:
+    """Deploy (or update) and return a handle."""
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.deploy.remote(
+        dep.name, dep._as_class(), dep._init_args, dep._init_kwargs,
+        dep.config))
+    if wait_for_ready:
+        deadline = time.time() + timeout_s
+        while not ray_tpu.get(controller.ready.remote(dep.name)):
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"Deployment {dep.name!r} not ready in {timeout_s}s")
+            time.sleep(0.02)
+    return DeploymentHandle(dep.name, controller)
+
+
+def get_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, get_or_create_controller())
+
+
+def get_deployment(name: str) -> Dict[str, Any]:
+    info = ray_tpu.get(
+        get_or_create_controller().list_deployments.remote())
+    if name not in info:
+        raise ValueError(f"No deployment named {name!r}")
+    return info[name]
+
+
+def list_deployments() -> Dict[str, Any]:
+    return ray_tpu.get(
+        get_or_create_controller().list_deployments.remote())
+
+
+def shutdown():
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=10)
+    except Exception:
+        pass
+    ray_tpu.kill(controller)

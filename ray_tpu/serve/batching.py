@@ -1,0 +1,97 @@
+"""@serve.batch: dynamic request batching.
+
+Capability parity with the reference's batching (python/ray/serve/
+batching.py:46,215 _BatchQueue): concurrent calls to the decorated async
+method are queued and flushed to the underlying function as ONE list call
+when max_batch_size is reached or batch_wait_timeout_s elapses. The
+TPU payoff: a pjit replica sees full batches, keeping the MXU busy.
+"""
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self.queue: List = []
+        self._flush_task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+
+    async def submit(self, instance, item):
+        fut = asyncio.get_event_loop().create_future()
+        async with self._lock:
+            self.queue.append((item, fut))
+            if len(self.queue) >= self.max_batch_size:
+                await self._flush(instance)
+            elif self._flush_task is None or self._flush_task.done():
+                self._flush_task = asyncio.get_event_loop().create_task(
+                    self._timed_flush(instance))
+        return await fut
+
+    async def _timed_flush(self, instance):
+        await asyncio.sleep(self.timeout_s)
+        async with self._lock:
+            await self._flush(instance)
+
+    async def _flush(self, instance):
+        if not self.queue:
+            return
+        batch, self.queue = self.queue, []
+        items = [b[0] for b in batch]
+        futs = [b[1] for b in batch]
+        try:
+            if instance is not None:
+                results = self.fn(instance, items)
+            else:
+                results = self.fn(items)
+            if asyncio.iscoroutine(results):
+                results = await results
+            if len(results) != len(items):
+                raise ValueError(
+                    f"@batch function returned {len(results)} results "
+                    f"for {len(items)} inputs")
+            for fut, r in zip(futs, results):
+                if not fut.done():
+                    fut.set_result(r)
+        except Exception as e:  # noqa: BLE001
+            for fut in futs:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator for (async) methods taking a single request; the wrapped
+    implementation receives a list of requests and returns a list."""
+
+    def wrap(fn):
+        queue_attr = f"__batch_queue_{fn.__name__}"
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:          # bound method: (self, item)
+                instance, item = args
+                q = getattr(instance, queue_attr, None)
+                if q is None:
+                    q = _BatchQueue(fn, max_batch_size,
+                                    batch_wait_timeout_s)
+                    setattr(instance, queue_attr, q)
+                return await q.submit(instance, item)
+            (item,) = args              # free function
+            q = getattr(wrapper, "_queue", None)
+            if q is None:
+                q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                wrapper._queue = q
+            return await q.submit(None, item)
+
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,29 @@
+"""Serve configs (reference: python/ray/serve/config.py pydantic schemas —
+plain dataclasses here)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Request-driven autoscaling (reference:
+    serve/_private/autoscaling_policy.py BasicAutoscalingPolicy)."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Optional[Dict[str, Any]] = None
+    # TPU-native: replicas can be SPMD mesh gangs.
+    mesh: Optional[Dict[str, int]] = None
+    health_check_period_s: float = 5.0
+    graceful_shutdown_timeout_s: float = 10.0

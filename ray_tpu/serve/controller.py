@@ -1,0 +1,218 @@
+"""Serve control plane: controller + replica actors.
+
+Capability parity with the reference's controller reconcile loop
+(python/ray/serve/controller.py:61,229 run_control_loop), DeploymentState
+replica state machine (serve/_private/deployment_state.py:56,942), replica
+wrapper (serve/_private/replica.py:250) and request-driven autoscaling
+(serve/_private/autoscaling_policy.py:93). TPU-native: a replica may be an
+SPMD mesh gang — its actor builds a device mesh at startup and serves
+pjit-compiled inference.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+
+CONTROLLER_NAME = "serve::controller"
+
+
+class Replica:
+    """Actor wrapping one instance of a deployment."""
+
+    def __init__(self, deployment_name: str, replica_id: str,
+                 cls, init_args, init_kwargs, mesh_axes=None):
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self.mesh = None
+        if mesh_axes is not None:
+            from ray_tpu.mesh import create_mesh
+            self.mesh = create_mesh(mesh_axes)
+        if cls is None:
+            self.instance = None
+        else:
+            self.instance = cls(*init_args, **init_kwargs)
+            if self.mesh is not None and \
+                    hasattr(self.instance, "setup_mesh"):
+                self.instance.setup_mesh(self.mesh)
+        self._ongoing = 0
+        self._total = 0
+
+    async def handle_request(self, method_name: str, args, kwargs):
+        self._ongoing += 1
+        self._total += 1
+        try:
+            target = self.instance
+            if method_name == "__call__":
+                fn = target
+            else:
+                fn = getattr(target, method_name)
+            unwrapped = getattr(fn, "__func__", fn)
+            if asyncio.iscoroutinefunction(unwrapped) or \
+                    asyncio.iscoroutinefunction(
+                        getattr(fn, "__call__", None)):
+                return await fn(*args, **kwargs)
+            # Sync callables run in the thread executor so they don't
+            # block the replica's event loop (reference: serve replica
+            # runs sync user code off-loop).
+            import functools
+            loop = asyncio.get_event_loop()
+            result = await loop.run_in_executor(
+                None, functools.partial(fn, *args, **kwargs))
+            if asyncio.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            self._ongoing -= 1
+
+    def stats(self):
+        return {"replica_id": self.replica_id,
+                "ongoing": self._ongoing,
+                "total": self._total}
+
+    def health_check(self):
+        return True
+
+
+class Controller:
+    """Singleton async actor reconciling deployments to target state."""
+
+    def __init__(self):
+        # name -> dict(cls, init_args, init_kwargs, config, version,
+        #              replicas: {rid: handle}, target, last_scale_*)
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._next_replica = 0
+        self._running = True
+        asyncio.get_event_loop().create_task(self._control_loop())
+
+    # --- API ---------------------------------------------------------------
+
+    def deploy(self, name: str, cls, init_args, init_kwargs,
+               config: DeploymentConfig) -> None:
+        d = self._deployments.get(name)
+        version = (d["version"] + 1) if d else 0
+        target = config.num_replicas
+        if config.autoscaling_config:
+            target = max(config.autoscaling_config.min_replicas,
+                         min(target,
+                             config.autoscaling_config.max_replicas))
+        self._deployments[name] = {
+            "cls": cls, "init_args": init_args,
+            "init_kwargs": init_kwargs, "config": config,
+            "version": version,
+            "replicas": dict(d["replicas"]) if d else {},
+            "target": target,
+            "last_upscale": 0.0, "last_downscale": 0.0,
+            "old_version_replicas": set(d["replicas"]) if d else set(),
+        }
+
+    def delete_deployment(self, name: str):
+        d = self._deployments.pop(name, None)
+        if d:
+            for h in d["replicas"].values():
+                self._kill(h)
+
+    def get_replicas(self, name: str):
+        d = self._deployments.get(name)
+        if d is None:
+            raise ValueError(f"No deployment named {name!r}")
+        cfg = d["config"]
+        return {"version": d["version"],
+                "replicas": list(d["replicas"].items()),
+                "max_ongoing": cfg.max_ongoing_requests}
+
+    def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        return {name: {"num_replicas": len(d["replicas"]),
+                       "target": d["target"],
+                       "version": d["version"]}
+                for name, d in self._deployments.items()}
+
+    def ready(self, name: str) -> bool:
+        d = self._deployments.get(name)
+        return (d is not None and
+                len(d["replicas"]) >= max(1, d["target"]) and
+                not d["old_version_replicas"])
+
+    def shutdown(self):
+        self._running = False
+        for name in list(self._deployments):
+            self.delete_deployment(name)
+
+    # --- reconcile ---------------------------------------------------------
+
+    @staticmethod
+    def _kill(handle):
+        try:
+            ray_tpu.kill(handle)
+        except Exception:
+            pass
+
+    def _spawn_replica(self, name: str, d: Dict[str, Any]):
+        rid = f"{name}#{self._next_replica}"
+        self._next_replica += 1
+        cfg: DeploymentConfig = d["config"]
+        opts = dict(cfg.ray_actor_options or {})
+        opts.setdefault("num_cpus", 0.1)
+        actor_cls = ray_tpu.remote(Replica)
+        handle = actor_cls.options(
+            max_concurrency=max(8, cfg.max_ongoing_requests),
+            **opts).remote(
+            name, rid, d["cls"], d["init_args"], d["init_kwargs"],
+            cfg.mesh)
+        d["replicas"][rid] = handle
+
+    async def _control_loop(self):
+        while self._running:
+            try:
+                for name, d in list(self._deployments.items()):
+                    # Roll old-version replicas.
+                    for rid in list(d["old_version_replicas"]):
+                        h = d["replicas"].pop(rid, None)
+                        if h is not None:
+                            self._kill(h)
+                        d["old_version_replicas"].discard(rid)
+                    # Scale to target.
+                    while len(d["replicas"]) < d["target"]:
+                        self._spawn_replica(name, d)
+                    while len(d["replicas"]) > d["target"]:
+                        rid, h = next(iter(d["replicas"].items()))
+                        del d["replicas"][rid]
+                        self._kill(h)
+                    await self._autoscale(name, d)
+            except Exception:  # noqa: BLE001 — keep reconciling
+                import traceback
+                traceback.print_exc()
+            await asyncio.sleep(0.05)
+
+    async def _autoscale(self, name: str, d: Dict[str, Any]):
+        cfg: DeploymentConfig = d["config"]
+        auto: Optional[AutoscalingConfig] = cfg.autoscaling_config
+        if auto is None or not d["replicas"]:
+            return
+        refs = [h.stats.remote() for h in d["replicas"].values()]
+        try:
+            stats = ray_tpu.get(refs, timeout=2)
+        except Exception:
+            return
+        ongoing = sum(s["ongoing"] for s in stats)
+        avg = ongoing / max(1, len(stats))
+        now = time.time()
+        if avg > auto.target_ongoing_requests and \
+                d["target"] < auto.max_replicas and \
+                now - d["last_upscale"] > auto.upscale_delay_s:
+            d["target"] += 1
+            d["last_upscale"] = now
+        elif avg < auto.target_ongoing_requests / 2 and \
+                d["target"] > auto.min_replicas and \
+                now - d["last_downscale"] > auto.downscale_delay_s:
+            d["target"] -= 1
+            d["last_downscale"] = now
+
+
+def get_or_create_controller():
+    cls = ray_tpu.remote(Controller)
+    return cls.options(name=CONTROLLER_NAME, get_if_exists=True,
+                       num_cpus=0).remote()

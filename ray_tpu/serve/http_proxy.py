@@ -1,0 +1,112 @@
+"""HTTP ingress proxy.
+
+Capability parity with the reference's HTTPProxy
+(serve/_private/http_proxy.py:189 — uvicorn/starlette there, aiohttp here):
+routes POST/GET /<deployment_name> to the deployment handle; JSON body
+becomes the request argument; response is JSON. One proxy per node in the
+distributed runtime; serve.start_http() runs it in a background thread.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.api import get_handle, list_deployments
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._handles: Dict[str, Any] = {}
+        self._runner = None
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+
+    def _handle_for(self, name: str):
+        h = self._handles.get(name)
+        if h is None:
+            if name not in list_deployments():
+                return None
+            h = get_handle(name)
+            self._handles[name] = h
+        return h
+
+    async def _dispatch(self, request):
+        from aiohttp import web
+        name = request.match_info["deployment"]
+        handle = self._handle_for(name)
+        if handle is None:
+            return web.json_response(
+                {"error": f"no deployment {name!r}"}, status=404)
+        if request.method == "POST" and request.can_read_body:
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                return web.json_response(
+                    {"error": "body must be JSON"}, status=400)
+        else:
+            payload = dict(request.query) or None
+        try:
+            ref = handle.remote(payload) if payload is not None \
+                else handle.remote()
+            loop = asyncio.get_event_loop()
+            result = await loop.run_in_executor(
+                None, lambda: ray_tpu.get(ref, timeout=60))
+            return web.json_response({"result": result})
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+
+    async def _health(self, request):
+        from aiohttp import web
+        return web.json_response({"status": "ok",
+                                  "deployments": list_deployments()})
+
+    def _run(self):
+        from aiohttp import web
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        app = web.Application()
+        app.router.add_get("/-/healthz", self._health)
+        app.router.add_route("*", "/{deployment}", self._dispatch)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._runner = runner
+        self._started.set()
+        loop.run_forever()
+
+    def start(self, timeout: float = 10.0):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-http-proxy")
+        self._thread.start()
+        if not self._started.wait(timeout):
+            raise TimeoutError("HTTP proxy failed to start")
+        return self
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+_proxy: Optional[HTTPProxy] = None
+
+
+def start_http(host: str = "127.0.0.1", port: int = 8000) -> HTTPProxy:
+    global _proxy
+    if _proxy is None:
+        _proxy = HTTPProxy(host, port).start()
+    return _proxy
+
+
+def stop_http():
+    global _proxy
+    if _proxy is not None:
+        _proxy.stop()
+        _proxy = None

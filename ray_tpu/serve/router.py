@@ -1,0 +1,118 @@
+"""Client-side routing: DeploymentHandle.
+
+Capability parity with the reference's Router/ReplicaSet
+(serve/_private/router.py:62,221: pick a replica under its in-flight cap,
+power-of-two-choices among non-saturated) and the LongPollClient config push
+(serve/_private/long_poll.py — approximated by TTL-based refresh from the
+controller).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+_REFRESH_S = 0.25
+
+
+class DeploymentMethod:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle._route(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, name: str, controller):
+        self._name = name
+        self._controller = controller
+        self._lock = threading.Lock()
+        self._replicas: List = []
+        self._max_ongoing = 8
+        self._version = -1
+        self._fetched_at = 0.0
+        self._inflight: Dict[int, int] = {}   # idx -> count
+
+    # --- replica set maintenance ------------------------------------------
+
+    def _refresh(self, force: bool = False):
+        with self._lock:
+            if not force and time.time() - self._fetched_at < _REFRESH_S \
+                    and self._replicas:
+                return
+            info = ray_tpu.get(
+                self._controller.get_replicas.remote(self._name))
+            if info["version"] != self._version or \
+                    len(info["replicas"]) != len(self._replicas):
+                self._replicas = [h for _, h in info["replicas"]]
+                self._inflight = {i: 0 for i in range(len(self._replicas))}
+                self._version = info["version"]
+            self._max_ongoing = info["max_ongoing"]
+            self._fetched_at = time.time()
+
+    def _pick(self) -> Optional[int]:
+        """Power-of-two-choices among replicas under the in-flight cap."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                return None
+            candidates = [i for i in range(n)
+                          if self._inflight.get(i, 0) < self._max_ongoing]
+            if not candidates:
+                return None
+            if len(candidates) == 1:
+                idx = candidates[0]
+            else:
+                a, b = random.sample(candidates, 2)
+                idx = a if self._inflight.get(a, 0) <= \
+                    self._inflight.get(b, 0) else b
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            return idx
+
+    def _done(self, idx: int):
+        with self._lock:
+            if idx in self._inflight and self._inflight[idx] > 0:
+                self._inflight[idx] -= 1
+
+    # --- calls -------------------------------------------------------------
+
+    def _route(self, method: str, args, kwargs):
+        deadline = time.time() + 30
+        while True:
+            self._refresh()
+            idx = self._pick()
+            if idx is not None:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"No replica of {self._name!r} accepted the request "
+                    f"within 30s (all at max_ongoing_requests)")
+            time.sleep(0.005)
+            self._refresh(force=True)
+        replica = self._replicas[idx]
+        ref = replica.handle_request.remote(method, args, kwargs)
+        self._watch_completion(ref, idx)
+        return ref
+
+    def _watch_completion(self, ref, idx: int):
+        def _wait():
+            try:
+                ref.future().result()
+            except Exception:
+                pass
+            finally:
+                self._done(idx)
+        threading.Thread(target=_wait, daemon=True).start()
+
+    def remote(self, *args, **kwargs):
+        return self._route("__call__", args, kwargs)
+
+    def __getattr__(self, name: str) -> DeploymentMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentMethod(self, name)

@@ -1,0 +1,188 @@
+"""Serve tests (reference analogues: serve/tests/test_standalone.py,
+test_batching.py, test_autoscaling_policy.py)."""
+import asyncio
+import urllib.error
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve import AutoscalingConfig
+
+
+@pytest.fixture
+def serve_rt(rt):
+    yield rt
+    serve.shutdown()
+
+
+def test_class_deployment_call(serve_rt):
+    @serve.deployment
+    class Greeter:
+        def __init__(self, greeting):
+            self.greeting = greeting
+
+        def __call__(self, name):
+            return f"{self.greeting}, {name}!"
+
+        def shout(self, name):
+            return f"{self.greeting.upper()} {name.upper()}"
+
+    handle = serve.run(Greeter.bind("Hello"))
+    assert ray_tpu.get(handle.remote("world")) == "Hello, world!"
+    assert ray_tpu.get(handle.shout.remote("hi")) == "HELLO HI"
+
+
+def test_function_deployment(serve_rt):
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind())
+    assert ray_tpu.get(handle.remote(21)) == 42
+
+
+def test_multiple_replicas_round_robin(serve_rt):
+    @serve.deployment(num_replicas=3)
+    class WhoAmI:
+        def __init__(self):
+            self.id = id(self)
+
+        def __call__(self):
+            return self.id
+
+    handle = serve.run(WhoAmI.bind())
+    seen = {ray_tpu.get(handle.remote()) for _ in range(60)}
+    assert len(seen) == 3   # all replicas served traffic
+
+
+def test_redeploy_updates_version(serve_rt):
+    @serve.deployment
+    class V:
+        def __init__(self, version):
+            self.v = version
+
+        def __call__(self):
+            return self.v
+
+    h = serve.run(V.bind(1))
+    assert ray_tpu.get(h.remote()) == 1
+    h = serve.run(V.bind(2))
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.get(h.remote()) == 2:
+            break
+        time.sleep(0.05)
+    assert ray_tpu.get(h.remote()) == 2
+
+
+def test_deployment_error_propagates(serve_rt):
+    @serve.deployment
+    class Bad:
+        def __call__(self):
+            raise ValueError("replica error")
+
+    h = serve.run(Bad.bind())
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(h.remote())
+
+
+def test_batching(serve_rt):
+    batch_sizes = []
+
+    @serve.deployment(max_ongoing_requests=32)
+    class Batched:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+        async def __call__(self, items):
+            batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+    h = serve.run(Batched.bind())
+    refs = [h.remote(i) for i in range(16)]
+    assert sorted(ray_tpu.get(refs)) == [i * 10 for i in range(16)]
+    # Requests actually coalesced (fewer calls than requests).
+    assert max(batch_sizes) > 1
+
+
+def test_autoscaling_up_and_down(serve_rt):
+    @serve.deployment(
+        max_ongoing_requests=2,
+        autoscaling_config=AutoscalingConfig(
+            min_replicas=1, max_replicas=3,
+            target_ongoing_requests=1.0,
+            upscale_delay_s=0.05, downscale_delay_s=0.3))
+    class Slow:
+        def __call__(self):
+            time.sleep(0.3)
+            return "ok"
+
+    h = serve.run(Slow.bind())
+    assert serve.get_deployment("Slow")["num_replicas"] == 1
+    # Flood with requests -> should scale up.
+    refs = [h.remote() for _ in range(24)]
+    deadline = time.time() + 15
+    scaled_up = False
+    while time.time() < deadline:
+        if serve.get_deployment("Slow")["num_replicas"] >= 2:
+            scaled_up = True
+            break
+        time.sleep(0.05)
+    assert scaled_up, "expected upscale under load"
+    ray_tpu.get(refs)
+    # Idle -> should scale back down to min.
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if serve.get_deployment("Slow")["num_replicas"] == 1:
+            break
+        time.sleep(0.1)
+    assert serve.get_deployment("Slow")["num_replicas"] == 1
+
+
+def test_list_deployments(serve_rt):
+    @serve.deployment
+    def a():
+        return 1
+
+    @serve.deployment
+    def b():
+        return 2
+
+    serve.run(a.bind())
+    serve.run(b.bind())
+    deps = serve.list_deployments()
+    assert set(deps) >= {"a", "b"}
+
+
+def test_http_proxy(serve_rt):
+    import urllib.request
+    import json as _json
+    from ray_tpu.serve.http_proxy import start_http, stop_http
+
+    @serve.deployment
+    def echo(payload):
+        return {"echoed": payload}
+
+    serve.run(echo.bind())
+    proxy = start_http(port=18111)
+    try:
+        req = urllib.request.Request(
+            "http://127.0.0.1:18111/echo", method="POST",
+            data=_json.dumps({"msg": "hi"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = _json.loads(resp.read())
+        assert body == {"result": {"echoed": {"msg": "hi"}}}
+        with urllib.request.urlopen(
+                "http://127.0.0.1:18111/-/healthz", timeout=30) as resp:
+            health = _json.loads(resp.read())
+        assert health["status"] == "ok"
+        # Unknown deployment -> 404
+        try:
+            urllib.request.urlopen(
+                "http://127.0.0.1:18111/missing", timeout=30)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        stop_http()
