@@ -1,0 +1,11 @@
+from ray_tpu.data.dataset import (Dataset, from_items, from_numpy,
+                                  range_dataset, read_csv, read_json)
+
+
+def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001
+    """ray_tpu.data.range(n) — mirrors the reference's ray.data.range."""
+    return range_dataset(n, parallelism)
+
+
+__all__ = ["Dataset", "from_items", "from_numpy", "range",
+           "range_dataset", "read_csv", "read_json"]

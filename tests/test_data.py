@@ -1,0 +1,131 @@
+"""Data tests (reference analogues: python/ray/data/tests/test_dataset.py)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_range_and_take(rt):
+    ds = rd.range(100)
+    assert ds.count() == 100
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+    assert ds.num_blocks() == 8
+
+
+def test_map_filter_flatmap_fused_lazily(rt):
+    ds = rd.range(20).map(lambda x: x * 2).filter(lambda x: x % 4 == 0)
+    ds = ds.flat_map(lambda x: [x, x + 1])
+    # All three stages pending until execution.
+    assert len(ds._stages) == 3
+    out = ds.take_all()
+    expected = []
+    for x in range(20):
+        y = x * 2
+        if y % 4 == 0:
+            expected.extend([y, y + 1])
+    assert out == expected
+
+
+def test_map_batches_numpy_format(rt):
+    ds = rd.from_items([{"x": i} for i in range(32)])
+
+    def add_ten(batch):
+        return {"x": batch["x"] + 10}
+
+    out = ds.map_batches(add_ten, batch_size=8,
+                         batch_format="numpy").take_all()
+    assert [r["x"] for r in out] == [i + 10 for i in range(32)]
+
+
+def test_map_batches_actor_pool(rt):
+    class Multiplier:
+        def __init__(self):
+            self.factor = 3
+
+        def __call__(self, batch):
+            return [x * self.factor for x in batch]
+
+    ds = rd.range(16).map_batches(
+        None, batch_size=4, compute="actors", num_actors=2,
+        fn_constructor=Multiplier)
+    assert sorted(ds.take_all()) == [i * 3 for i in range(16)]
+
+
+def test_repartition_and_split(rt):
+    ds = rd.range(30).repartition(3)
+    assert ds.num_blocks() == 3
+    shards = ds.split(5)
+    assert len(shards) == 5
+    assert sorted(sum((s.take_all() for s in shards), [])) == \
+        list(range(30))
+    assert all(s.count() == 6 for s in shards)
+
+
+def test_random_shuffle_preserves_multiset(rt):
+    ds = rd.range(64, parallelism=4)
+    shuffled = ds.random_shuffle(seed=0)
+    out = shuffled.take_all()
+    assert sorted(out) == list(range(64))
+    assert out != list(range(64))   # actually shuffled
+
+
+def test_sort(rt):
+    ds = rd.from_items([5, 3, 9, 1, 7], parallelism=2)
+    assert ds.sort().take_all() == [1, 3, 5, 7, 9]
+    assert ds.sort(descending=True).take_all() == [9, 7, 5, 3, 1]
+    keyed = rd.from_items([{"v": 3}, {"v": 1}], parallelism=1)
+    assert keyed.sort(key="v").take_all() == [{"v": 1}, {"v": 3}]
+
+
+def test_groupby(rt):
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(12)])
+    counts = {r["key"]: r["count"] for r in ds.groupby("k").count()
+              .take_all()}
+    assert counts == {0: 4, 1: 4, 2: 4}
+    sums = {r["key"]: r["sum"]
+            for r in ds.groupby("k").sum("v").take_all()}
+    assert sums[0] == 0 + 3 + 6 + 9
+
+
+def test_aggregations(rt):
+    ds = rd.range(10)
+    assert ds.sum() == 45
+    assert ds.mean() == pytest.approx(4.5)
+
+
+def test_iter_batches(rt):
+    ds = rd.range(10)
+    batches = list(ds.iter_batches(batch_size=4))
+    assert [len(b) for b in batches] == [4, 4, 2]
+    batches = list(ds.iter_batches(batch_size=4, drop_last=True))
+    assert [len(b) for b in batches] == [4, 4]
+
+
+def test_iter_device_batches_sharded(rt, cpu_mesh_devices):
+    from ray_tpu.mesh import create_mesh
+    mesh = create_mesh({"data": 8})
+    ds = rd.from_items([{"x": np.float32(i)} for i in range(32)])
+    batches = list(ds.iter_device_batches(mesh, batch_size=16))
+    assert len(batches) == 2
+    b = batches[0]["x"]
+    assert b.shape == (16,)
+    # Sharded over the 8 data devices.
+    assert {s.data.shape for s in b.addressable_shards} == {(2,)}
+
+
+def test_read_csv_json(rt, tmp_path):
+    csv_path = tmp_path / "t.csv"
+    csv_path.write_text("a,b\n1,x\n2,y\n")
+    ds = rd.read_csv(str(csv_path))
+    assert ds.take_all() == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+
+    json_path = tmp_path / "t.jsonl"
+    json_path.write_text('{"a": 1}\n{"a": 2}\n')
+    assert rd.read_json(str(json_path)).take_all() == [{"a": 1},
+                                                       {"a": 2}]
+
+
+def test_union(rt):
+    a, b = rd.range(5), rd.range(5).map(lambda x: x + 5)
+    assert sorted(a.union(b).take_all()) == list(range(10))
