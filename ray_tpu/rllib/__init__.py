@@ -1,0 +1,4 @@
+from ray_tpu.rllib.env import CartPoleEnv, SignEnv
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = ["PPO", "PPOConfig", "CartPoleEnv", "SignEnv"]

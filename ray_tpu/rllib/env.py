@@ -1,0 +1,111 @@
+"""Builtin environments (gym-style API without the gym dependency).
+
+The reference's RL stack samples from gym envs inside RolloutWorker actors
+(rllib/evaluation/rollout_worker.py:124; algorithm learning tests use
+CartPole — rllib/algorithms/*/tests). Same API shape here: reset() -> obs,
+step(a) -> (obs, reward, done, info).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+class Env:
+    observation_dim: int
+    num_actions: int
+
+    def reset(self, seed=None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, Dict]:
+        raise NotImplementedError
+
+
+class CartPoleEnv(Env):
+    """Classic cart-pole balancing (physics per the standard formulation:
+    Barto, Sutton & Anderson 1983), 500-step cap like CartPole-v1."""
+
+    observation_dim = 4
+    num_actions = 2
+
+    def __init__(self, max_steps: int = 500):
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.length = 0.5
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_limit = 12 * 2 * np.pi / 360
+        self.x_limit = 2.4
+        self.max_steps = max_steps
+        self._rng = np.random.RandomState(0)
+        self.state = None
+        self.t = 0
+
+    def reset(self, seed=None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self.state = self._rng.uniform(-0.05, 0.05, size=4)
+        self.t = 0
+        return self.state.astype(np.float32)
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self.state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costh, sinth = np.cos(theta), np.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot ** 2 * sinth) / \
+            total_mass
+        theta_acc = (self.gravity * sinth - costh * temp) / (
+            self.length * (4.0 / 3.0 -
+                           self.masspole * costh ** 2 / total_mass))
+        x_acc = temp - polemass_length * theta_acc * costh / total_mass
+        x += self.tau * x_dot
+        x_dot += self.tau * x_acc
+        theta += self.tau * theta_dot
+        theta_dot += self.tau * theta_acc
+        self.state = np.array([x, x_dot, theta, theta_dot])
+        self.t += 1
+        done = bool(abs(x) > self.x_limit or
+                    abs(theta) > self.theta_limit or
+                    self.t >= self.max_steps)
+        return self.state.astype(np.float32), 1.0, done, {}
+
+
+class SignEnv(Env):
+    """Trivially learnable: observation is a scalar; action 1 iff obs > 0
+    earns +1, else -1. Episodes of fixed length. Used to keep learning
+    tests fast (the reference uses CartPole; SignEnv converges in a few
+    hundred steps)."""
+
+    observation_dim = 1
+    num_actions = 2
+
+    def __init__(self, episode_len: int = 16):
+        self.episode_len = episode_len
+        self._rng = np.random.RandomState(0)
+        self.t = 0
+        self.obs = None
+
+    def reset(self, seed=None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self.t = 0
+        self.obs = self._rng.randn(1).astype(np.float32)
+        return self.obs
+
+    def step(self, action: int):
+        correct = (action == 1) == (float(self.obs[0]) > 0)
+        reward = 1.0 if correct else -1.0
+        self.t += 1
+        self.obs = self._rng.randn(1).astype(np.float32)
+        return self.obs, reward, self.t >= self.episode_len, {}
+
+
+ENV_REGISTRY = {
+    "CartPole": CartPoleEnv,
+    "Sign": SignEnv,
+}

@@ -1,0 +1,304 @@
+"""PPO: the minimum-viable RL algorithm of the framework.
+
+Capability parity with the reference's PPO training loop
+(rllib/algorithms/ppo/ppo.py:401 training_step,
+execution/rollout_ops.py:36 synchronous_parallel_sample,
+execution/train_ops.py train_one_step, evaluation/rollout_worker.py:124):
+CPU rollout-worker ACTORS sample episodes with the current policy; the
+driver-side LEARNER does minibatch clipped-PPO SGD as ONE jitted update per
+epoch (scan over minibatches) — on TPU when available, per the BASELINE.md
+target config ("RLlib PPO, TPU learner + CPU rollout workers") — then
+broadcasts new weights to the workers.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import ENV_REGISTRY
+
+
+# --------------------------------------------------------------------------
+# Policy network (jax/flax actor-critic MLP)
+# --------------------------------------------------------------------------
+
+def _policy_defs(obs_dim: int, num_actions: int, hidden: int):
+    import flax.linen as nn
+
+    class ActorCritic(nn.Module):
+        @nn.compact
+        def __call__(self, obs):
+            h = nn.tanh(nn.Dense(hidden)(obs))
+            h = nn.tanh(nn.Dense(hidden)(h))
+            logits = nn.Dense(num_actions)(h)
+            value = nn.Dense(1)(h)[..., 0]
+            return logits, value
+
+    return ActorCritic()
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    env: str = "CartPole"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 256
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    lr: float = 3e-4
+    num_sgd_epochs: int = 4
+    minibatch_size: int = 128
+    hidden_size: int = 64
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.01
+    seed: int = 0
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+# --------------------------------------------------------------------------
+# Rollout worker actor (CPU)
+# --------------------------------------------------------------------------
+
+class RolloutWorker:
+    def __init__(self, env_name: str, hidden: int, seed: int):
+        self.env = ENV_REGISTRY[env_name]()
+        self.obs = self.env.reset(seed=seed)
+        self._rng = np.random.RandomState(seed)
+        self._policy_params = None
+        self._model = _policy_defs(self.env.observation_dim,
+                                   self.env.num_actions, hidden)
+        self._episode_reward = 0.0
+        self.completed_rewards: List[float] = []
+
+    def set_weights(self, params):
+        self._policy_params = params
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect a fragment with the current policy."""
+        import jax
+        import jax.numpy as jnp
+
+        obs_buf, act_buf, rew_buf, done_buf = [], [], [], []
+        logp_buf, val_buf = [], []
+        apply = jax.jit(self._model.apply)
+        for _ in range(num_steps):
+            logits, value = apply(self._policy_params,
+                                  jnp.asarray(self.obs[None]))
+            logits = np.asarray(logits[0], np.float64)
+            probs = np.exp(logits - logits.max())
+            probs /= probs.sum()
+            action = int(self._rng.choice(len(probs), p=probs))
+            logp = float(np.log(probs[action] + 1e-12))
+            next_obs, reward, done, _ = self.env.step(action)
+            obs_buf.append(self.obs)
+            act_buf.append(action)
+            rew_buf.append(reward)
+            done_buf.append(done)
+            logp_buf.append(logp)
+            val_buf.append(float(value[0]))
+            self._episode_reward += reward
+            if done:
+                self.completed_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self.obs = self.env.reset()
+            else:
+                self.obs = next_obs
+        # Bootstrap value for the final state.
+        _, last_val = apply(self._policy_params,
+                            jnp.asarray(self.obs[None]))
+        return {
+            "obs": np.asarray(obs_buf, np.float32),
+            "actions": np.asarray(act_buf, np.int32),
+            "rewards": np.asarray(rew_buf, np.float32),
+            "dones": np.asarray(done_buf, np.bool_),
+            "logp": np.asarray(logp_buf, np.float32),
+            "values": np.asarray(val_buf, np.float32),
+            "last_value": float(last_val[0]),
+        }
+
+    def episode_rewards(self) -> List[float]:
+        out = self.completed_rewards[-100:]
+        return list(out)
+
+
+# --------------------------------------------------------------------------
+# Algorithm
+# --------------------------------------------------------------------------
+
+class PPO:
+    def __init__(self, config: PPOConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        env_cls = ENV_REGISTRY[config.env]
+        probe = env_cls()
+        self.model = _policy_defs(probe.observation_dim,
+                                  probe.num_actions, config.hidden_size)
+        rng = jax.random.PRNGKey(config.seed)
+        self.params = self.model.init(
+            rng, jnp.zeros((1, probe.observation_dim)))
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._iteration = 0
+
+        worker_cls = ray_tpu.remote(RolloutWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=0.5).remote(
+                config.env, config.hidden_size, config.seed + i)
+            for i in range(config.num_rollout_workers)]
+        self._update = self._build_update()
+
+    # --- learner ----------------------------------------------------------
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        cfg = self.config
+        model, optimizer = self.model, self.optimizer
+
+        def loss_fn(params, mb):
+            logits, values = model.apply(params, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb["actions"][:, None], axis=-1)[:, 0]
+            ratio = jnp.exp(logp - mb["logp"])
+            adv = mb["adv"]
+            unclipped = ratio * adv
+            clipped = jnp.clip(ratio, 1 - cfg.clip_eps,
+                               1 + cfg.clip_eps) * adv
+            pg_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+            vf_loss = jnp.mean((values - mb["returns"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = (pg_loss + cfg.vf_coef * vf_loss -
+                     cfg.entropy_coef * entropy)
+            return total, (pg_loss, vf_loss, entropy)
+
+        def epoch(carry, mb):
+            params, opt_state = carry
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
+        @jax.jit
+        def update(params, opt_state, minibatches):
+            (params, opt_state), losses = jax.lax.scan(
+                epoch, (params, opt_state), minibatches)
+            return params, opt_state, jnp.mean(losses)
+
+        return update
+
+    @staticmethod
+    def _gae(batch, gamma: float, lam: float):
+        rewards = batch["rewards"]
+        values = batch["values"]
+        dones = batch["dones"].astype(np.float32)
+        T = len(rewards)
+        adv = np.zeros(T, np.float32)
+        last_adv = 0.0
+        next_value = batch["last_value"]
+        for t in reversed(range(T)):
+            nonterminal = 1.0 - dones[t]
+            delta = rewards[t] + gamma * next_value * nonterminal - \
+                values[t]
+            last_adv = delta + gamma * lam * nonterminal * last_adv
+            adv[t] = last_adv
+            next_value = values[t]
+        returns = adv + values
+        return adv, returns
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: parallel sample -> GAE -> minibatch SGD ->
+        broadcast weights."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        t0 = time.time()
+        weights_ref = ray_tpu.put(self.params)
+        ray_tpu.get([w.set_weights.remote(weights_ref)
+                     for w in self.workers])
+        batches = ray_tpu.get([
+            w.sample.remote(cfg.rollout_fragment_length)
+            for w in self.workers])
+
+        advs, rets = [], []
+        for b in batches:
+            a, r = self._gae(b, cfg.gamma, cfg.gae_lambda)
+            advs.append(a)
+            rets.append(r)
+        data = {
+            "obs": np.concatenate([b["obs"] for b in batches]),
+            "actions": np.concatenate([b["actions"] for b in batches]),
+            "logp": np.concatenate([b["logp"] for b in batches]),
+            "adv": np.concatenate(advs),
+            "returns": np.concatenate(rets),
+        }
+        adv = data["adv"]
+        data["adv"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        n = len(data["obs"])
+        mb_size = min(cfg.minibatch_size, n)
+        rng = np.random.RandomState(cfg.seed + self._iteration)
+        mbs = []
+        for _ in range(cfg.num_sgd_epochs):
+            perm = rng.permutation(n)
+            for i in range(0, n - mb_size + 1, mb_size):
+                idx = perm[i:i + mb_size]
+                mbs.append({k: v[idx] for k, v in data.items()})
+        stacked = {k: jnp.asarray(np.stack([m[k] for m in mbs]))
+                   for k in mbs[0]}
+        self.params, self.opt_state, mean_loss = self._update(
+            self.params, self.opt_state, stacked)
+
+        reward_lists = ray_tpu.get(
+            [w.episode_rewards.remote() for w in self.workers])
+        all_rewards = [r for lst in reward_lists for r in lst]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_reward_mean": (float(np.mean(all_rewards))
+                                    if all_rewards else float("nan")),
+            "episodes_total": len(all_rewards),
+            "timesteps_this_iter": n,
+            "loss": float(mean_loss),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def get_policy_params(self):
+        return self.params
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    # Tune integration: a function trainable.
+    @staticmethod
+    def as_trainable(base_config: Optional[Dict[str, Any]] = None):
+        def trainable(config):
+            from ray_tpu.air import session
+            merged = dict(base_config or {})
+            merged.update({k: v for k, v in config.items()
+                           if k in PPOConfig.__dataclass_fields__})
+            iters = config.get("training_iterations", 10)
+            algo = PPOConfig(**merged).build()
+            try:
+                for _ in range(iters):
+                    session.report(algo.train())
+            finally:
+                algo.stop()
+        return trainable

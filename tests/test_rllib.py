@@ -1,0 +1,61 @@
+"""PPO tests (reference analogue: rllib/algorithms/ppo/tests/test_ppo.py
+learning tests on toy envs)."""
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import CartPoleEnv, PPO, PPOConfig, SignEnv
+
+
+def test_cartpole_env_physics():
+    env = CartPoleEnv()
+    obs = env.reset(seed=0)
+    assert obs.shape == (4,)
+    total = 0
+    done = False
+    while not done:
+        obs, r, done, _ = env.step(0)   # constant push -> falls fast
+        total += r
+    assert 5 < total < 200
+
+
+def test_ppo_single_iteration_metrics(rt):
+    algo = PPOConfig(env="Sign", num_rollout_workers=2,
+                     rollout_fragment_length=64).build()
+    try:
+        result = algo.train()
+        assert result["training_iteration"] == 1
+        assert result["timesteps_this_iter"] == 128
+        assert "loss" in result
+    finally:
+        algo.stop()
+
+
+def test_ppo_learns_sign_env(rt):
+    algo = PPOConfig(env="Sign", num_rollout_workers=2,
+                     rollout_fragment_length=256,
+                     minibatch_size=128, lr=1e-2, entropy_coef=0.0,
+                     seed=1).build()
+    try:
+        first = algo.train()
+        last = None
+        for _ in range(7):
+            last = algo.train()
+        # Random policy: ~0 mean reward. Learned: ~16 (all correct).
+        assert last["episode_reward_mean"] > 8.0, last
+    finally:
+        algo.stop()
+
+
+def test_ppo_under_tune(rt):
+    from ray_tpu.tune import TuneConfig, Tuner, grid_search
+    trainable = PPO.as_trainable({"env": "Sign",
+                                  "num_rollout_workers": 1,
+                                  "rollout_fragment_length": 64})
+    grid = Tuner(
+        trainable,
+        param_space={"lr": grid_search([1e-3, 1e-2]),
+                     "training_iterations": 2},
+        tune_config=TuneConfig(metric="episode_reward_mean",
+                               mode="max")).fit()
+    assert len(grid) == 2
+    assert not grid.errors
