@@ -1,0 +1,198 @@
+"""ctypes binding for the C++ shared-memory object store
+(src/object_store/shm_store.cc — the plasma-equivalent host-RAM tier).
+
+The library is built on demand with g++ (no pybind11 in the image; the
+C ABI + ctypes keeps the binding dependency-free). Zero-copy reads: get()
+returns a memoryview into the shm mapping; put/get of numpy arrays never
+copy through Python byte strings on the read side.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+_SRC = os.path.join(_REPO_ROOT, "src", "object_store", "shm_store.cc")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "build")
+_LIB = os.path.join(_BUILD_DIR, "libshm_store.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+SHM_OK = 0
+_ERRORS = {
+    -1: "object already exists",
+    -2: "object not found",
+    -3: "store full (after eviction)",
+    -4: "invalid object state",
+    -5: "timeout",
+    -6: "system error",
+    -7: "too many objects",
+}
+
+
+class ShmStoreError(RuntimeError):
+    def __init__(self, code: int, op: str):
+        self.code = code
+        super().__init__(f"shm_store.{op}: "
+                         f"{_ERRORS.get(code, f'error {code}')}")
+
+
+class ShmTimeout(ShmStoreError):
+    pass
+
+
+def _ensure_built() -> str:
+    if not os.path.exists(_LIB) or \
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        subprocess.run(
+            ["g++", "-O2", "-Wall", "-fPIC", "-std=c++17", "-shared",
+             "-o", _LIB, _SRC, "-lpthread", "-lrt"],
+            check=True, capture_output=True)
+    return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(_ensure_built())
+        lib.store_create.restype = ctypes.c_void_p
+        lib.store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.store_attach.restype = ctypes.c_void_p
+        lib.store_attach.argtypes = [ctypes.c_char_p]
+        lib.store_detach.argtypes = [ctypes.c_void_p]
+        lib.store_destroy.argtypes = [ctypes.c_void_p]
+        lib.store_create_object.restype = ctypes.c_int64
+        lib.store_create_object.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.store_seal.restype = ctypes.c_int
+        lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.store_get.restype = ctypes.c_int
+        lib.store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.store_release.restype = ctypes.c_int
+        lib.store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.store_delete.restype = ctypes.c_int
+        lib.store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.store_contains.restype = ctypes.c_int
+        lib.store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.store_stats.argtypes = [
+            ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_uint64)] * 4
+        lib.store_base.restype = ctypes.c_void_p
+        lib.store_base.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def _check(code: int, op: str):
+    if code == SHM_OK:
+        return
+    if code == -5:
+        raise ShmTimeout(code, op)
+    raise ShmStoreError(code, op)
+
+
+class ShmObjectStore:
+    """One node-local store segment. The node runtime calls create();
+    workers attach() by name."""
+
+    def __init__(self, handle: int, name: str, owner: bool):
+        self._lib = _load()
+        self._h = handle
+        self.name = name
+        self._owner = owner
+        base = self._lib.store_base(self._h)
+        self._base = base
+
+    # --- lifecycle --------------------------------------------------------
+
+    @classmethod
+    def create(cls, name: str, capacity: int) -> "ShmObjectStore":
+        lib = _load()
+        h = lib.store_create(name.encode(), capacity)
+        if not h:
+            raise ShmStoreError(-6, "create")
+        return cls(h, name, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmObjectStore":
+        lib = _load()
+        h = lib.store_attach(name.encode())
+        if not h:
+            raise ShmStoreError(-2, "attach")
+        return cls(h, name, owner=False)
+
+    def close(self):
+        if self._h:
+            if self._owner:
+                self._lib.store_destroy(self._h)
+            else:
+                self._lib.store_detach(self._h)
+            self._h = None
+
+    # --- object lifecycle -------------------------------------------------
+
+    def put_bytes(self, oid: ObjectID, data: bytes) -> None:
+        off = self._lib.store_create_object(self._h, oid.binary(),
+                                            len(data))
+        if off < 0:
+            _check(int(off), "create_object")
+        ctypes.memmove(self._base + off, data, len(data))
+        _check(self._lib.store_seal(self._h, oid.binary()), "seal")
+
+    def get_view(self, oid: ObjectID,
+                 timeout_ms: int = -1) -> memoryview:
+        """Zero-copy view; caller must release(oid) when done."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        _check(self._lib.store_get(self._h, oid.binary(), timeout_ms,
+                                   ctypes.byref(off), ctypes.byref(size)),
+               "get")
+        buf = (ctypes.c_char * size.value).from_address(
+            self._base + off.value)
+        return memoryview(buf)
+
+    def get_bytes(self, oid: ObjectID, timeout_ms: int = -1) -> bytes:
+        view = self.get_view(oid, timeout_ms)
+        try:
+            return bytes(view)
+        finally:
+            self.release(oid)
+
+    def release(self, oid: ObjectID):
+        self._lib.store_release(self._h, oid.binary())
+
+    def delete(self, oid: ObjectID):
+        _check(self._lib.store_delete(self._h, oid.binary()), "delete")
+
+    def contains(self, oid: ObjectID) -> bool:
+        return bool(self._lib.store_contains(self._h, oid.binary()))
+
+    def stats(self) -> dict:
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        self._lib.store_stats(self._h, *[ctypes.byref(v) for v in vals])
+        return {"bytes_in_use": vals[0].value,
+                "num_objects": vals[1].value,
+                "num_evictions": vals[2].value,
+                "capacity": vals[3].value}
+
+    # --- serialization-aware helpers --------------------------------------
+
+    def put_object(self, oid: ObjectID, value) -> None:
+        from ray_tpu._private import serialization
+        self.put_bytes(oid, serialization.dumps(value))
+
+    def get_object(self, oid: ObjectID, timeout_ms: int = -1):
+        from ray_tpu._private import serialization
+        return serialization.loads(self.get_bytes(oid, timeout_ms))

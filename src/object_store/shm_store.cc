@@ -1,0 +1,471 @@
+// Shared-memory immutable object store (plasma-equivalent).
+//
+// Capability parity with the reference's plasma store
+// (src/ray/object_manager/plasma/store.h, dlmalloc.cc, eviction_policy.cc):
+// a shm arena shared by every process on the node, immutable objects with
+// create/seal/get lifecycle, per-object reference counts, LRU eviction of
+// unreferenced sealed objects under pressure, blocking get with deadline.
+// Design differences (TPU-native runtime): the arena lives in ONE
+// mmap'd /dev/shm segment with an embedded header (hash table + free list +
+// process-shared mutex/condvar), so attach is a single mmap and there is no
+// store daemon process — the raylet-equivalent owns lifecycle, clients
+// attach read-write. Device (HBM) arrays are NOT stored here; they are
+// referenced by handle (see ray_tpu/mesh docs) — this store is the host-RAM
+// tier only.
+//
+// Build: g++ -O2 -shared -fPIC -o libshm_store.so shm_store.cc -lpthread -lrt
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x52544f53;  // "SOTR"
+constexpr int kIdSize = 24;              // ObjectID width (ids.py)
+constexpr uint32_t kMaxObjects = 8192;
+constexpr uint32_t kNumBuckets = 4096;   // hash buckets (power of 2)
+constexpr uint32_t kInvalid = 0xffffffffu;
+
+enum ObjectState : uint32_t {
+  kFree = 0,
+  kCreated = 1,   // allocated, writer filling it
+  kSealed = 2,    // immutable, readable
+};
+
+struct Entry {
+  uint8_t id[kIdSize];
+  uint64_t offset;        // data offset from arena base
+  uint64_t size;
+  uint32_t state;
+  int32_t refcount;
+  uint64_t seal_seq;      // for LRU (monotonic seal/get counter)
+  uint32_t next;          // next entry index in bucket chain
+  uint32_t in_use;
+};
+
+struct FreeBlock {
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct Header {
+  uint32_t magic;
+  uint32_t initialized;
+  uint64_t capacity;          // data-region capacity
+  uint64_t data_start;        // offset of data region from map base
+  pthread_mutex_t mutex;
+  pthread_cond_t cond;
+  uint64_t seq;               // LRU clock
+  uint64_t bytes_in_use;
+  uint64_t num_objects;
+  uint64_t num_evictions;
+  uint32_t buckets[kNumBuckets];
+  Entry entries[kMaxObjects];
+  uint32_t free_count;
+  FreeBlock free_list[kMaxObjects + 1];
+};
+
+struct Store {
+  Header* hdr;
+  uint8_t* base;      // mmap base
+  size_t map_size;
+  char name[256];
+};
+
+uint32_t HashId(const uint8_t* id) {
+  // FNV-1a over the id bytes.
+  uint32_t h = 2166136261u;
+  for (int i = 0; i < kIdSize; i++) {
+    h ^= id[i];
+    h *= 16777619u;
+  }
+  return h & (kNumBuckets - 1);
+}
+
+Entry* FindLocked(Header* hdr, const uint8_t* id, uint32_t* out_index) {
+  uint32_t b = HashId(id);
+  uint32_t idx = hdr->buckets[b];
+  while (idx != kInvalid) {
+    Entry* e = &hdr->entries[idx];
+    if (e->in_use && memcmp(e->id, id, kIdSize) == 0) {
+      if (out_index) *out_index = idx;
+      return e;
+    }
+    idx = e->next;
+  }
+  return nullptr;
+}
+
+void UnlinkLocked(Header* hdr, uint32_t index) {
+  Entry* e = &hdr->entries[index];
+  uint32_t b = HashId(e->id);
+  uint32_t idx = hdr->buckets[b];
+  uint32_t prev = kInvalid;
+  while (idx != kInvalid) {
+    if (idx == index) {
+      if (prev == kInvalid)
+        hdr->buckets[b] = e->next;
+      else
+        hdr->entries[prev].next = e->next;
+      break;
+    }
+    prev = idx;
+    idx = hdr->entries[idx].next;
+  }
+  e->in_use = 0;
+  e->state = kFree;
+}
+
+// --- free-list allocator (first fit, address-ordered coalescing) ---------
+
+void FreeInsertLocked(Header* hdr, uint64_t offset, uint64_t size) {
+  // Insert keeping address order, then coalesce neighbors.
+  uint32_t n = hdr->free_count;
+  uint32_t pos = 0;
+  while (pos < n && hdr->free_list[pos].offset < offset) pos++;
+  for (uint32_t i = n; i > pos; i--) hdr->free_list[i] = hdr->free_list[i - 1];
+  hdr->free_list[pos] = {offset, size};
+  hdr->free_count++;
+  // Coalesce with next.
+  if (pos + 1 < hdr->free_count &&
+      hdr->free_list[pos].offset + hdr->free_list[pos].size ==
+          hdr->free_list[pos + 1].offset) {
+    hdr->free_list[pos].size += hdr->free_list[pos + 1].size;
+    for (uint32_t i = pos + 1; i + 1 < hdr->free_count; i++)
+      hdr->free_list[i] = hdr->free_list[i + 1];
+    hdr->free_count--;
+  }
+  // Coalesce with prev.
+  if (pos > 0 && hdr->free_list[pos - 1].offset +
+                     hdr->free_list[pos - 1].size ==
+                 hdr->free_list[pos].offset) {
+    hdr->free_list[pos - 1].size += hdr->free_list[pos].size;
+    for (uint32_t i = pos; i + 1 < hdr->free_count; i++)
+      hdr->free_list[i] = hdr->free_list[i + 1];
+    hdr->free_count--;
+  }
+}
+
+bool AllocLocked(Header* hdr, uint64_t size, uint64_t* out_offset) {
+  for (uint32_t i = 0; i < hdr->free_count; i++) {
+    if (hdr->free_list[i].size >= size) {
+      *out_offset = hdr->free_list[i].offset;
+      hdr->free_list[i].offset += size;
+      hdr->free_list[i].size -= size;
+      if (hdr->free_list[i].size == 0) {
+        for (uint32_t j = i; j + 1 < hdr->free_count; j++)
+          hdr->free_list[j] = hdr->free_list[j + 1];
+        hdr->free_count--;
+      }
+      return true;
+    }
+  }
+  return false;
+}
+
+// Evict the least-recently-sealed/gotten object with refcount==0.
+// Returns false if nothing evictable.
+bool EvictOneLocked(Header* hdr) {
+  uint32_t victim = kInvalid;
+  uint64_t best_seq = ~0ull;
+  for (uint32_t i = 0; i < kMaxObjects; i++) {
+    Entry* e = &hdr->entries[i];
+    if (e->in_use && e->state == kSealed && e->refcount == 0 &&
+        e->seal_seq < best_seq) {
+      best_seq = e->seal_seq;
+      victim = i;
+    }
+  }
+  if (victim == kInvalid) return false;
+  Entry* e = &hdr->entries[victim];
+  uint64_t asize = ((e->size ? e->size : 1) + 63) & ~63ull;
+  hdr->bytes_in_use -= asize;
+  hdr->num_objects--;
+  hdr->num_evictions++;
+  FreeInsertLocked(hdr, e->offset, asize);
+  UnlinkLocked(hdr, victim);
+  return true;
+}
+
+uint64_t Align(uint64_t v) { return (v + 63) & ~63ull; }
+
+}  // namespace
+
+extern "C" {
+
+// Error codes.
+enum {
+  SHM_OK = 0,
+  SHM_ERR_EXISTS = -1,
+  SHM_ERR_NOT_FOUND = -2,
+  SHM_ERR_FULL = -3,
+  SHM_ERR_STATE = -4,
+  SHM_ERR_TIMEOUT = -5,
+  SHM_ERR_SYS = -6,
+  SHM_ERR_TOO_MANY = -7,
+};
+
+Store* store_create(const char* name, uint64_t capacity) {
+  size_t map_size = sizeof(Header) + capacity;
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)map_size) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* hdr = (Header*)mem;
+  memset(hdr, 0, sizeof(Header));
+  hdr->magic = kMagic;
+  hdr->capacity = capacity;
+  hdr->data_start = sizeof(Header);
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mutex, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&hdr->cond, &ca);
+  for (uint32_t i = 0; i < kNumBuckets; i++) hdr->buckets[i] = kInvalid;
+  hdr->free_count = 1;
+  hdr->free_list[0] = {0, capacity};
+  hdr->initialized = 1;
+  Store* s = new Store();
+  s->hdr = hdr;
+  s->base = (uint8_t*)mem;
+  s->map_size = map_size;
+  strncpy(s->name, name, sizeof(s->name) - 1);
+  return s;
+}
+
+Store* store_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* hdr = (Header*)mem;
+  if (hdr->magic != kMagic || !hdr->initialized) {
+    munmap(mem, (size_t)st.st_size);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->hdr = hdr;
+  s->base = (uint8_t*)mem;
+  s->map_size = (size_t)st.st_size;
+  strncpy(s->name, name, sizeof(s->name) - 1);
+  return s;
+}
+
+void store_detach(Store* s) {
+  if (!s) return;
+  munmap(s->base, s->map_size);
+  delete s;
+}
+
+void store_destroy(Store* s) {
+  if (!s) return;
+  char name[256];
+  strncpy(name, s->name, sizeof(name));
+  store_detach(s);
+  shm_unlink(name);
+}
+
+static int Lock(Header* hdr) {
+  int rc = pthread_mutex_lock(&hdr->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&hdr->mutex);
+    return 0;
+  }
+  return rc;
+}
+
+// Allocates an object; returns its data pointer (into shm) or error.
+int64_t store_create_object(Store* s, const uint8_t* id, uint64_t size) {
+  Header* hdr = s->hdr;
+  uint64_t asize = Align(size ? size : 1);
+  if (Lock(hdr) != 0) return SHM_ERR_SYS;
+  if (FindLocked(hdr, id, nullptr)) {
+    pthread_mutex_unlock(&hdr->mutex);
+    return SHM_ERR_EXISTS;
+  }
+  uint32_t slot = kInvalid;
+  for (uint32_t i = 0; i < kMaxObjects; i++) {
+    if (!hdr->entries[i].in_use) {
+      slot = i;
+      break;
+    }
+  }
+  if (slot == kInvalid) {
+    pthread_mutex_unlock(&hdr->mutex);
+    return SHM_ERR_TOO_MANY;
+  }
+  uint64_t offset;
+  while (!AllocLocked(hdr, asize, &offset)) {
+    if (!EvictOneLocked(hdr)) {
+      pthread_mutex_unlock(&hdr->mutex);
+      return SHM_ERR_FULL;
+    }
+  }
+  Entry* e = &hdr->entries[slot];
+  memcpy(e->id, id, kIdSize);
+  e->offset = offset;
+  e->size = size;
+  e->state = kCreated;
+  e->refcount = 1;  // creator holds a ref until seal+release
+  e->seal_seq = 0;
+  uint32_t b = HashId(id);
+  e->next = hdr->buckets[b];
+  hdr->buckets[b] = slot;
+  e->in_use = 1;
+  hdr->bytes_in_use += asize;
+  hdr->num_objects++;
+  pthread_mutex_unlock(&hdr->mutex);
+  return (int64_t)(hdr->data_start + offset);
+}
+
+int store_seal(Store* s, const uint8_t* id) {
+  Header* hdr = s->hdr;
+  if (Lock(hdr) != 0) return SHM_ERR_SYS;
+  Entry* e = FindLocked(hdr, id, nullptr);
+  if (!e) {
+    pthread_mutex_unlock(&hdr->mutex);
+    return SHM_ERR_NOT_FOUND;
+  }
+  if (e->state != kCreated) {
+    pthread_mutex_unlock(&hdr->mutex);
+    return SHM_ERR_STATE;
+  }
+  e->state = kSealed;
+  e->refcount -= 1;  // drop the creator ref
+  e->seal_seq = ++hdr->seq;
+  pthread_cond_broadcast(&hdr->cond);
+  pthread_mutex_unlock(&hdr->mutex);
+  return SHM_OK;
+}
+
+// Blocking get: waits for seal up to timeout_ms (-1 = forever, 0 = poll).
+// On success fills offset/size and bumps refcount (caller must release).
+int store_get(Store* s, const uint8_t* id, int64_t timeout_ms,
+              uint64_t* out_offset, uint64_t* out_size) {
+  Header* hdr = s->hdr;
+  if (Lock(hdr) != 0) return SHM_ERR_SYS;
+  struct timespec deadline;
+  if (timeout_ms > 0) {
+    clock_gettime(CLOCK_REALTIME, &deadline);
+    deadline.tv_sec += timeout_ms / 1000;
+    deadline.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (deadline.tv_nsec >= 1000000000L) {
+      deadline.tv_sec += 1;
+      deadline.tv_nsec -= 1000000000L;
+    }
+  }
+  for (;;) {
+    Entry* e = FindLocked(hdr, id, nullptr);
+    if (e && e->state == kSealed) {
+      e->refcount++;
+      e->seal_seq = ++hdr->seq;  // LRU touch
+      *out_offset = hdr->data_start + e->offset;
+      *out_size = e->size;
+      pthread_mutex_unlock(&hdr->mutex);
+      return SHM_OK;
+    }
+    if (timeout_ms == 0) {
+      pthread_mutex_unlock(&hdr->mutex);
+      return e ? SHM_ERR_STATE : SHM_ERR_NOT_FOUND;
+    }
+    int rc;
+    if (timeout_ms < 0) {
+      rc = pthread_cond_wait(&hdr->cond, &hdr->mutex);
+    } else {
+      rc = pthread_cond_timedwait(&hdr->cond, &hdr->mutex, &deadline);
+    }
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&hdr->mutex);
+      return SHM_ERR_TIMEOUT;
+    }
+    if (rc != 0 && rc != EOWNERDEAD) {
+      pthread_mutex_unlock(&hdr->mutex);
+      return SHM_ERR_SYS;
+    }
+  }
+}
+
+int store_release(Store* s, const uint8_t* id) {
+  Header* hdr = s->hdr;
+  if (Lock(hdr) != 0) return SHM_ERR_SYS;
+  Entry* e = FindLocked(hdr, id, nullptr);
+  if (!e) {
+    pthread_mutex_unlock(&hdr->mutex);
+    return SHM_ERR_NOT_FOUND;
+  }
+  if (e->refcount > 0) e->refcount--;
+  pthread_mutex_unlock(&hdr->mutex);
+  return SHM_OK;
+}
+
+int store_delete(Store* s, const uint8_t* id) {
+  Header* hdr = s->hdr;
+  if (Lock(hdr) != 0) return SHM_ERR_SYS;
+  uint32_t idx;
+  Entry* e = FindLocked(hdr, id, &idx);
+  if (!e) {
+    pthread_mutex_unlock(&hdr->mutex);
+    return SHM_ERR_NOT_FOUND;
+  }
+  if (e->refcount > 0) {
+    pthread_mutex_unlock(&hdr->mutex);
+    return SHM_ERR_STATE;
+  }
+  hdr->bytes_in_use -= Align(e->size ? e->size : 1);
+  hdr->num_objects--;
+  FreeInsertLocked(hdr, e->offset, Align(e->size ? e->size : 1));
+  UnlinkLocked(hdr, idx);
+  pthread_mutex_unlock(&hdr->mutex);
+  return SHM_OK;
+}
+
+int store_contains(Store* s, const uint8_t* id) {
+  Header* hdr = s->hdr;
+  if (Lock(hdr) != 0) return 0;
+  Entry* e = FindLocked(hdr, id, nullptr);
+  int sealed = (e && e->state == kSealed) ? 1 : 0;
+  pthread_mutex_unlock(&hdr->mutex);
+  return sealed;
+}
+
+void store_stats(Store* s, uint64_t* bytes_in_use, uint64_t* num_objects,
+                 uint64_t* num_evictions, uint64_t* capacity) {
+  Header* hdr = s->hdr;
+  Lock(hdr);
+  *bytes_in_use = hdr->bytes_in_use;
+  *num_objects = hdr->num_objects;
+  *num_evictions = hdr->num_evictions;
+  *capacity = hdr->capacity;
+  pthread_mutex_unlock(&hdr->mutex);
+}
+
+uint8_t* store_base(Store* s) { return s->base; }
+
+}  // extern "C"

@@ -1,0 +1,126 @@
+"""C++ shared-memory object store tests (reference analogues:
+src/ray/object_manager/plasma tests + python/ray/tests/test_object_store.py).
+Cross-process tests use multiprocessing with the 'spawn' method."""
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.shm_store import (ShmObjectStore, ShmStoreError,
+                                        ShmTimeout)
+
+
+@pytest.fixture
+def store():
+    name = f"/raytpu_test_{os.getpid()}_{time.monotonic_ns() % 100000}"
+    s = ShmObjectStore.create(name, 4 * 1024 * 1024)
+    yield s
+    s.close()
+
+
+def test_put_get_bytes(store):
+    oid = ObjectID.from_random()
+    store.put_bytes(oid, b"hello shm")
+    assert store.contains(oid)
+    assert store.get_bytes(oid) == b"hello shm"
+
+
+def test_put_get_object_with_numpy(store):
+    oid = ObjectID.from_random()
+    value = {"arr": np.arange(10000, dtype=np.float32), "tag": "x"}
+    store.put_object(oid, value)
+    out = store.get_object(oid)
+    np.testing.assert_array_equal(out["arr"], value["arr"])
+    assert out["tag"] == "x"
+
+
+def test_duplicate_create_rejected(store):
+    oid = ObjectID.from_random()
+    store.put_bytes(oid, b"1")
+    with pytest.raises(ShmStoreError):
+        store.put_bytes(oid, b"2")
+
+
+def test_get_timeout(store):
+    with pytest.raises(ShmTimeout):
+        store.get_bytes(ObjectID.from_random(), timeout_ms=50)
+
+
+def test_delete_and_refcount(store):
+    oid = ObjectID.from_random()
+    store.put_bytes(oid, b"data")
+    view = store.get_view(oid)   # hold a reference
+    with pytest.raises(ShmStoreError):
+        store.delete(oid)        # refcount > 0 -> state error
+    del view
+    store.release(oid)
+    store.delete(oid)
+    assert not store.contains(oid)
+
+
+def test_lru_eviction_under_pressure(store):
+    # Capacity 4 MiB; insert 8 x 1 MiB unreferenced objects: early ones
+    # must be evicted, latest must survive.
+    oids = []
+    for i in range(8):
+        oid = ObjectID.from_random()
+        store.put_bytes(oid, bytes(1024 * 1024))
+        oids.append(oid)
+    stats = store.stats()
+    assert stats["num_evictions"] >= 4
+    assert store.contains(oids[-1])
+    assert not store.contains(oids[0])
+
+
+def test_stats(store):
+    before = store.stats()
+    oid = ObjectID.from_random()
+    store.put_bytes(oid, bytes(1000))
+    after = store.stats()
+    assert after["num_objects"] == before["num_objects"] + 1
+    assert after["bytes_in_use"] > before["bytes_in_use"]
+
+
+def _writer_proc(store_name, oid_bin, payload):
+    s = ShmObjectStore.attach(store_name)
+    time.sleep(0.2)
+    s.put_bytes(ObjectID(oid_bin), payload)
+    s.close()
+
+
+def test_cross_process_blocking_get(store):
+    oid = ObjectID.from_random()
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_writer_proc,
+                    args=(store.name, oid.binary(), b"from-child"))
+    p.start()
+    try:
+        # Blocks until the child seals the object.
+        assert store.get_bytes(oid, timeout_ms=30000) == b"from-child"
+    finally:
+        p.join(timeout=30)
+    assert p.exitcode == 0
+
+
+def _reader_proc(store_name, oid_bin, q):
+    s = ShmObjectStore.attach(store_name)
+    data = s.get_bytes(ObjectID(oid_bin), timeout_ms=30000)
+    q.put(len(data))
+    s.close()
+
+
+def test_cross_process_read(store):
+    oid = ObjectID.from_random()
+    store.put_bytes(oid, bytes(123456))
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_reader_proc,
+                    args=(store.name, oid.binary(), q))
+    p.start()
+    try:
+        assert q.get(timeout=30) == 123456
+    finally:
+        p.join(timeout=30)
