@@ -33,6 +33,13 @@ class TaskError(RayTpuError):
                 f"{type(self.cause).__name__}: {self.cause}\n"
                 f"--- remote traceback ---\n{self.remote_traceback}")
 
+    def __reduce__(self):
+        # Exceptions pickle via (cls, self.args) by default, which would
+        # pass the message string as `cause`; preserve the real fields
+        # (these cross process boundaries in the distributed runtime).
+        return (type(self), (self.cause, self.task_name,
+                             self.remote_traceback))
+
 
 class ActorError(RayTpuError):
     """Base for actor-related failures."""
@@ -46,6 +53,9 @@ class ActorDiedError(ActorError):
         self.reason = reason
         super().__init__(f"Actor {actor_id} is dead: {reason}")
 
+    def __reduce__(self):
+        return (type(self), (self.actor_id, self.reason))
+
 
 class ActorUnavailableError(ActorError):
     """The actor is temporarily unreachable (e.g. restarting)."""
@@ -56,7 +66,11 @@ class ObjectLostError(RayTpuError):
 
     def __init__(self, object_id=None, reason: str = "object lost"):
         self.object_id = object_id
+        self.reason = reason
         super().__init__(f"Object {object_id} lost: {reason}")
+
+    def __reduce__(self):
+        return (type(self), (self.object_id, self.reason))
 
 
 class OwnerDiedError(ObjectLostError):
@@ -73,6 +87,9 @@ class TaskCancelledError(RayTpuError):
     def __init__(self, task_id=None):
         self.task_id = task_id
         super().__init__(f"Task {task_id} was cancelled")
+
+    def __reduce__(self):
+        return (type(self), (self.task_id,))
 
 
 class PendingCallsLimitExceeded(RayTpuError):

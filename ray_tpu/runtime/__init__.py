@@ -1,0 +1,19 @@
+"""Multiprocess (distributed) runtime.
+
+Process layout (single node; the protocol extends to multi-host by running
+node managers on each host pointed at one head):
+
+- driver: hosts the HEAD service (control plane + cluster scheduler — the
+  GCS + ClusterTaskManager equivalents) and the node manager that spawns
+  worker processes and the C++ shm object store.
+- workers: separate Python processes; each serves an EXECUTOR endpoint
+  (PushTask equivalent), attaches the shm store, executes tasks and hosts
+  actors. Nested task submission flows worker -> head scheduler.
+
+Transport: framed-socket RPC (ray_tpu/runtime/rpc.py) — the reference uses
+gRPC (src/ray/rpc/); this image lacks grpc python codegen, so the wire layer
+is a pluggable length-prefixed protocol behind the same service shapes.
+"""
+from ray_tpu.runtime.cluster_utils import Cluster
+
+__all__ = ["Cluster"]

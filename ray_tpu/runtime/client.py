@@ -1,0 +1,325 @@
+"""Driver-side distributed runtime client + shared object-resolution
+helpers (used by both the driver client and worker runtimes).
+
+Capability parity with the reference's driver path (CoreWorker submit +
+GCS client): tasks/actors go to the head scheduler; objects live in the
+node's C++ shm store.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from types import SimpleNamespace
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu._private.ids import ActorID, JobID, ObjectID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store import ReferenceCounter
+from ray_tpu._private.serialization import dumps, loads
+from ray_tpu._private.task_spec import (ActorCreationSpec,
+                                        PlacementGroupSchedulingStrategy,
+                                        PlacementGroupSpec, TaskSpec)
+from ray_tpu.exceptions import GetTimeoutError
+from ray_tpu.runtime.rpc import RpcClient
+
+
+# --------------------------------------------------------------------------
+# Shared object helpers
+# --------------------------------------------------------------------------
+
+def _read_one(store, oid: ObjectID, timeout_ms: int):
+    from ray_tpu._private.shm_store import ShmTimeout
+    try:
+        status, value = loads(store.get_bytes(oid, timeout_ms=timeout_ms))
+    except ShmTimeout:
+        raise GetTimeoutError(
+            f"Get timed out waiting for {oid.hex()[:16]}…") from None
+    if status == "err":
+        raise value
+    return value
+
+
+def resolve_refs(store, refs, timeout: Optional[float]):
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(
+                f"get() expects ObjectRef(s), got {type(r).__name__}")
+    deadline = None if timeout is None else time.time() + timeout
+    values = []
+    for r in ref_list:
+        if deadline is None:
+            tmo = -1
+        else:
+            tmo = max(1, int((deadline - time.time()) * 1000))
+        values.append(_read_one(store, r.id, tmo))
+    return values[0] if single else values
+
+
+def wait_refs(store, refs, num_returns: int, timeout: Optional[float]):
+    if num_returns > len(refs):
+        raise ValueError("num_returns > len(refs)")
+    deadline = None if timeout is None else time.time() + timeout
+    ready: List[ObjectRef] = []
+    remaining = list(refs)
+    while True:
+        still = []
+        for r in remaining:
+            if store.contains(r.id):
+                ready.append(r)
+            else:
+                still.append(r)
+        remaining = still
+        if len(ready) >= num_returns or not remaining:
+            return ready, remaining
+        if deadline is not None and time.time() >= deadline:
+            return ready, remaining
+        time.sleep(0.002)
+
+
+def object_future(store, oid: ObjectID) -> Future:
+    f: Future = Future()
+
+    def _wait():
+        try:
+            value = _read_one(store, oid, -1)
+        except BaseException as e:  # noqa: BLE001
+            if f.set_running_or_notify_cancel():
+                f.set_exception(e)
+            return
+        if f.set_running_or_notify_cancel():
+            f.set_result(value)
+
+    threading.Thread(target=_wait, daemon=True).start()
+    return f
+
+
+# --------------------------------------------------------------------------
+# Shared submission helpers
+# --------------------------------------------------------------------------
+
+def submit_task_via_head(head: RpcClient, spec: TaskSpec):
+    refs = [ObjectRef(oid) for oid in spec.return_ids]
+    pg_id = None
+    strat = spec.scheduling_strategy
+    if isinstance(strat, PlacementGroupSchedulingStrategy) and \
+            strat.placement_group is not None:
+        pg_id = strat.placement_group.id.hex()
+    payload = cloudpickle.dumps({
+        "task_id": spec.task_id.hex(),
+        "name": spec.name,
+        "func": spec.func,
+        "args": spec.args,
+        "kwargs": spec.kwargs,
+        "num_returns": spec.num_returns,
+        "return_ids": [oid.binary() for oid in spec.return_ids],
+        "resources": spec.resources,
+    })
+    meta = {
+        "task_id": spec.task_id.hex(),
+        "return_ids": [oid.binary() for oid in spec.return_ids],
+        "resources": spec.resources,
+        "max_retries": spec.max_retries,
+        "pg_id": pg_id,
+    }
+    head.call("submit_task", meta, payload)
+    return refs
+
+
+def create_actor_via_head(head: RpcClient, spec: ActorCreationSpec):
+    payload = cloudpickle.dumps({
+        "cls": spec.cls,
+        "args": spec.args,
+        "kwargs": spec.kwargs,
+        "max_concurrency": spec.max_concurrency,
+    })
+    meta = {
+        "actor_id": spec.actor_id.hex(),
+        "resources": spec.resources,
+        "max_restarts": spec.max_restarts,
+        "name": spec.name,
+        "namespace": spec.namespace,
+        "get_if_exists": spec.get_if_exists,
+    }
+    out = head.call("create_actor", meta, payload)
+    final_spec = spec
+    if out["actor_id"] != spec.actor_id.hex():
+        import dataclasses
+        final_spec = dataclasses.replace(
+            spec, actor_id=ActorID.from_hex(out["actor_id"]))
+    return SimpleNamespace(spec=final_spec)
+
+
+def submit_actor_task_via_head(head: RpcClient, actor_id: ActorID,
+                               spec: TaskSpec):
+    refs = [ObjectRef(oid) for oid in spec.return_ids]
+    payload = cloudpickle.dumps({
+        "task_id": spec.task_id.hex(),
+        "name": spec.name,
+        "method": spec.method_name,
+        "args": spec.args,
+        "kwargs": spec.kwargs,
+        "num_returns": spec.num_returns,
+        "return_ids": [oid.binary() for oid in spec.return_ids],
+    })
+    head.call("submit_actor_task", actor_id.hex(),
+              {"task_id": spec.task_id.hex()}, payload)
+    return refs
+
+
+def actor_state_from_head(head: RpcClient, actor_id: ActorID):
+    payload = head.call("actor_class_payload", actor_id.hex())
+    spec = cloudpickle.loads(payload)
+    return SimpleNamespace(spec=SimpleNamespace(
+        actor_id=actor_id, cls=spec["cls"], max_task_retries=0))
+
+
+class DistPlacementGroup:
+    def __init__(self, spec: PlacementGroupSpec, head: RpcClient,
+                 created: bool):
+        self.spec = spec
+        self._head = head
+        self._created = created
+
+    @property
+    def id(self):
+        return self.spec.pg_id
+
+    @property
+    def bundle_specs(self):
+        return [dict(b.resources) for b in self.spec.bundles]
+
+    def is_ready(self) -> bool:
+        return self._created
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        deadline = time.time() + timeout_seconds
+        while not self._created:
+            if time.time() > deadline:
+                return False
+            self._created = self._head.call(
+                "create_placement_group", self.spec.pg_id.hex(),
+                [dict(b.resources) for b in self.spec.bundles],
+                self.spec.strategy)
+            if not self._created:
+                time.sleep(0.05)
+        return True
+
+    def ready(self) -> ObjectRef:
+        oid = ObjectID.from_random()
+        ref = ObjectRef(oid)
+        pg = self
+
+        def _wait():
+            pg.wait(300)
+            from ray_tpu._private.worker import global_worker
+            global_worker().runtime.put_at(oid, pg)
+
+        threading.Thread(target=_wait, daemon=True).start()
+        return ref
+
+
+def create_pg_via_head(head: RpcClient, spec: PlacementGroupSpec):
+    created = head.call(
+        "create_placement_group", spec.pg_id.hex(),
+        [dict(b.resources) for b in spec.bundles], spec.strategy)
+    return DistPlacementGroup(spec, head, created)
+
+
+# --------------------------------------------------------------------------
+# Driver runtime
+# --------------------------------------------------------------------------
+
+class DistributedRuntime:
+    """Runtime interface backed by the head + node workers + shm store."""
+
+    def __init__(self, head_address: str, store_name: str,
+                 node_manager=None):
+        self.head = RpcClient(head_address)
+        from ray_tpu._private.shm_store import ShmObjectStore
+        self.store = ShmObjectStore.attach(store_name)
+        self.node_manager = node_manager
+        self.ref_counter = ReferenceCounter()
+        self.ref_counter.enabled = False
+        self.job_id = JobID.next()
+        self._actor_handles: Dict[Any, Any] = {}
+
+    # objects
+    def put(self, value):
+        oid = ObjectID.from_random()
+        self.store.put_bytes(oid, dumps(("ok", value)))
+        return ObjectRef(oid)
+
+    def put_at(self, oid: ObjectID, value):
+        self.store.put_bytes(oid, dumps(("ok", value)))
+
+    def get(self, refs, timeout=None):
+        return resolve_refs(self.store, refs, timeout)
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        return wait_refs(self.store, refs, num_returns, timeout)
+
+    def object_future(self, oid):
+        return object_future(self.store, oid)
+
+    # tasks / actors
+    def submit_task(self, spec: TaskSpec):
+        return submit_task_via_head(self.head, spec)
+
+    def create_actor(self, spec: ActorCreationSpec):
+        return create_actor_via_head(self.head, spec)
+
+    def submit_actor_task(self, actor_id, spec):
+        return submit_actor_task_via_head(self.head, actor_id, spec)
+
+    def kill_actor(self, actor_id, no_restart=True):
+        self.head.call("kill_actor", actor_id.hex(), no_restart)
+
+    def lookup_named_actor(self, name, namespace):
+        return ActorID.from_hex(
+            self.head.call("lookup_named_actor", name,
+                           namespace or "default"))
+
+    def get_actor_state(self, actor_id):
+        return actor_state_from_head(self.head, actor_id)
+
+    def cancel(self, ref, force=False, recursive=True):
+        pass  # not yet supported on the multiprocess runtime
+
+    # placement groups
+    def create_placement_group(self, spec):
+        return create_pg_via_head(self.head, spec)
+
+    def remove_placement_group(self, pg):
+        self.head.call("remove_placement_group", pg.id.hex())
+
+    # introspection
+    def cluster_resources(self):
+        return self.head.call("cluster_resources")
+
+    def available_resources(self):
+        return self.head.call("available_resources")
+
+    def list_actors(self):
+        return self.head.call("list_actors")
+
+    def list_tasks(self):
+        return []
+
+    def list_objects(self):
+        return []
+
+    def list_workers(self):
+        return self.head.call("list_workers")
+
+    def shutdown(self):
+        try:
+            self.head.call("shutdown", timeout=5)
+        except Exception:
+            pass
+        if self.node_manager is not None:
+            self.node_manager.stop()

@@ -1,0 +1,73 @@
+"""Cluster: the multiprocess test/launch fixture.
+
+Capability parity with the reference's ray.cluster_utils.Cluster
+(python/ray/cluster_utils.py:99 add_node — multiple real raylets on one
+machine as the primary multi-node test vehicle, SURVEY.md §4.2): real
+worker PROCESSES + the C++ shm store + the head scheduler, with
+kill-a-worker chaos for fault-tolerance tests.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ray_tpu.runtime.client import DistributedRuntime
+from ray_tpu.runtime.node import NodeManager
+
+
+class Cluster:
+    def __init__(self, num_workers: int = 2,
+                 resources_per_worker: Optional[Dict[str, float]] = None,
+                 store_capacity: int = 256 * 1024 * 1024,
+                 connect: bool = True):
+        self.node = NodeManager(num_workers=num_workers,
+                                resources_per_worker=resources_per_worker,
+                                store_capacity=store_capacity)
+        self.node.wait_for_workers(num_workers)
+        self.runtime = DistributedRuntime(
+            self.node.head_address, self.node.store_name,
+            node_manager=self.node)
+        self._connected = False
+        if connect:
+            self.connect()
+
+    def connect(self) -> DistributedRuntime:
+        """Install this cluster as the process-global runtime."""
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu._private.object_ref import \
+            set_global_reference_counter
+        if worker_mod.is_initialized():
+            worker_mod.shutdown()
+        worker_mod._worker = worker_mod.Worker(self.runtime,
+                                               mode="driver")
+        set_global_reference_counter(self.runtime.ref_counter)
+        self._connected = True
+        return self.runtime
+
+    def add_worker(self, resources: Optional[Dict[str, float]] = None
+                   ) -> str:
+        index = len(self.node.procs)
+        wid = self.node.start_worker(index, resources)
+        self.node.wait_for_workers()   # all live processes registered
+        return wid
+
+    def kill_worker(self, worker_id: str):
+        self.node.kill_worker(worker_id)
+
+    def workers(self):
+        return self.runtime.list_workers()
+
+    def shutdown(self):
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu._private.object_ref import \
+            set_global_reference_counter
+        if self._connected:
+            worker_mod._worker = None
+            set_global_reference_counter(None)
+            self._connected = False
+        self.runtime.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

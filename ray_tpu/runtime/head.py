@@ -1,0 +1,519 @@
+"""HEAD service: control plane + cluster scheduler.
+
+Capability parity (single service, multiprocess scale) with the reference's
+GCS (src/ray/gcs/gcs_server/ — node membership, actor directory, named
+actors, KV) and the cluster scheduling path (ClusterTaskManager
+scheduling/cluster_task_manager.cc: queue + pick node by resource fit;
+LocalTaskManager dispatch == direct RPC push to the chosen worker's
+executor). Placement groups reserve per-worker resources (the 2PC of
+gcs_placement_group_scheduler.h collapses to one phase on a single head).
+
+Fault tolerance: worker death (reported by the node manager) fails or
+retries its running tasks (owner-style retry, task_manager.h:135) and
+restarts its actors elsewhere up to max_restarts
+(gcs_actor_manager.cc:1037 semantics).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import dumps
+from ray_tpu.exceptions import ActorDiedError, NodeDiedError
+from ray_tpu.runtime.rpc import RpcClient, RpcError
+
+
+class _WorkerInfo:
+    def __init__(self, worker_id: str, address: str,
+                 resources: Dict[str, float]):
+        self.worker_id = worker_id
+        self.address = address
+        self.resources = dict(resources)
+        self.available = dict(resources)
+        self.alive = True
+        self.client = RpcClient(address)
+        self.running: set = set()   # task ids currently dispatched
+
+
+class _ActorInfo:
+    def __init__(self, actor_id: str, worker_id: str, payload: bytes,
+                 resources: Dict[str, float], max_restarts: int,
+                 name: Optional[str], namespace: str):
+        self.actor_id = actor_id
+        self.worker_id = worker_id
+        self.payload = payload          # creation spec (for restarts)
+        self.resources = resources
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        self.dead = False
+        self.death_reason = ""
+        self.name = name
+        self.namespace = namespace
+
+
+class HeadService:
+    """Handler object served by RpcServer in the driver process."""
+
+    def __init__(self, store_name: str):
+        self.store_name = store_name
+        self._lock = threading.RLock()
+        self._workers: Dict[str, _WorkerInfo] = {}
+        self._actors: Dict[str, _ActorInfo] = {}
+        self._named: Dict[Tuple[str, str], str] = {}
+        self._kv: Dict[str, bytes] = {}
+        self._pending: collections.deque = collections.deque()
+        self._task_meta: Dict[str, Dict[str, Any]] = {}
+        self._pgs: Dict[str, Dict[str, Any]] = {}
+        self._store = None
+        self._shutdown = False
+        self._sched_cv = threading.Condition(self._lock)
+        self._sched_thread = threading.Thread(
+            target=self._scheduler_loop, daemon=True, name="head-sched")
+        self._sched_thread.start()
+
+    def _get_store(self):
+        if self._store is None:
+            from ray_tpu._private.shm_store import ShmObjectStore
+            self._store = ShmObjectStore.attach(self.store_name)
+        return self._store
+
+    # ---- node/worker membership ------------------------------------------
+
+    def register_worker(self, worker_id: str, address: str,
+                        resources: Dict[str, float]) -> Dict[str, Any]:
+        with self._lock:
+            self._workers[worker_id] = _WorkerInfo(worker_id, address,
+                                                   resources)
+            self._sched_cv.notify_all()
+        return {"store_name": self.store_name}
+
+    def mark_worker_dead(self, worker_id: str):
+        """Called by the node manager when a worker process dies."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w is None or not w.alive:
+                return
+            w.alive = False
+            running = list(w.running)
+            w.running.clear()
+            dead_actors = [a for a in self._actors.values()
+                           if a.worker_id == worker_id and not a.dead]
+        # Fail or retry tasks that were on that worker.
+        for task_id in running:
+            self._handle_lost_task(task_id)
+        # Restart or kill its actors.
+        for a in dead_actors:
+            self._handle_lost_actor(a)
+
+    def list_workers(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{"worker_id": w.worker_id, "address": w.address,
+                     "alive": w.alive, "resources": dict(w.resources),
+                     "available": dict(w.available),
+                     "running_tasks": list(w.running)}
+                    for w in self._workers.values()]
+
+    def cluster_resources(self) -> Dict[str, float]:
+        with self._lock:
+            total: Dict[str, float] = {}
+            for w in self._workers.values():
+                if not w.alive:
+                    continue
+                for k, v in w.resources.items():
+                    total[k] = total.get(k, 0.0) + v
+            return total
+
+    def available_resources(self) -> Dict[str, float]:
+        with self._lock:
+            total: Dict[str, float] = {}
+            for w in self._workers.values():
+                if not w.alive:
+                    continue
+                for k, v in w.available.items():
+                    total[k] = total.get(k, 0.0) + v
+            return total
+
+    # ---- KV (gcs internal kv parity) -------------------------------------
+
+    def kv_put(self, key: str, value: bytes):
+        with self._lock:
+            self._kv[key] = value
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def kv_del(self, key: str):
+        with self._lock:
+            self._kv.pop(key, None)
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+    # ---- error reporting into the object store ---------------------------
+
+    def _store_error(self, return_ids: List[bytes], exc: BaseException):
+        store = self._get_store()
+        payload = dumps(("err", exc))
+        for rid in return_ids:
+            try:
+                store.put_bytes(ObjectID(rid), payload)
+            except Exception:
+                pass  # already stored
+
+    # ---- normal tasks -----------------------------------------------------
+
+    def submit_task(self, meta: Dict[str, Any], payload: bytes):
+        """meta: task_id, return_ids [bytes], resources, max_retries,
+        pg_id (optional). payload: pickled executable spec."""
+        with self._lock:
+            meta = dict(meta)
+            meta["payload"] = payload
+            meta["attempt"] = 0
+            self._task_meta[meta["task_id"]] = meta
+            self._pending.append(meta["task_id"])
+            self._sched_cv.notify_all()
+
+    def task_blocked(self, worker_id: str, resources: Dict[str, float]):
+        """Worker reports a task blocked in get(): release its resources
+        (unblocked-worker oversubscription semantics, as in local mode)."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w and w.alive:
+                for k, v in resources.items():
+                    w.available[k] = min(w.resources.get(k, 0.0),
+                                         w.available.get(k, 0.0) + v)
+                self._sched_cv.notify_all()
+
+    def task_unblocked(self, worker_id: str,
+                       resources: Dict[str, float]) -> bool:
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w is None or not w.alive:
+                return False
+            for k, v in resources.items():
+                w.available[k] = w.available.get(k, 0.0) - v
+            return True
+
+    def _scheduler_loop(self):
+        while not self._shutdown:
+            with self._lock:
+                progressed = self._try_dispatch_locked()
+                if not progressed:
+                    self._sched_cv.wait(timeout=0.05)
+
+    def _pick_worker_locked(self, resources: Dict[str, float],
+                            pg_id: Optional[str]) -> Optional[_WorkerInfo]:
+        if pg_id is not None:
+            pg = self._pgs.get(pg_id)
+            if not pg or not pg["ready"]:
+                return None
+            # Run inside the reservation on one of the PG's workers.
+            for wid in pg["workers"]:
+                w = self._workers.get(wid)
+                if w and w.alive:
+                    return w
+            return None
+        best = None
+        for w in self._workers.values():
+            if not w.alive:
+                continue
+            if all(w.available.get(k, 0.0) + 1e-9 >= v
+                   for k, v in resources.items()):
+                # Least-loaded fit.
+                if best is None or len(w.running) < len(best.running):
+                    best = w
+        return best
+
+    def _try_dispatch_locked(self) -> bool:
+        progressed = False
+        still = collections.deque()
+        while self._pending:
+            task_id = self._pending.popleft()
+            meta = self._task_meta.get(task_id)
+            if meta is None:
+                continue
+            res = meta.get("resources", {})
+            pg_id = meta.get("pg_id")
+            w = self._pick_worker_locked(res, pg_id)
+            if w is None:
+                still.append(task_id)
+                continue
+            if pg_id is None:
+                for k, v in res.items():
+                    w.available[k] = w.available.get(k, 0.0) - v
+            w.running.add(task_id)
+            meta["worker_id"] = w.worker_id
+            threading.Thread(target=self._dispatch, args=(w, meta),
+                             daemon=True).start()
+            progressed = True
+        self._pending = still
+        return progressed
+
+    def _dispatch(self, w: _WorkerInfo, meta: Dict[str, Any]):
+        task_id = meta["task_id"]
+        try:
+            w.client.call("push_task", meta["payload"])
+            failure: Optional[BaseException] = None
+        except RpcError as e:
+            failure = e
+        with self._lock:
+            w.running.discard(task_id)
+            if meta.get("pg_id") is None and w.alive:
+                for k, v in meta.get("resources", {}).items():
+                    w.available[k] = min(
+                        w.resources.get(k, 0.0),
+                        w.available.get(k, 0.0) + v)
+            self._sched_cv.notify_all()
+        if failure is not None:
+            self._handle_lost_task(task_id)
+        else:
+            with self._lock:
+                self._task_meta.pop(task_id, None)
+
+    def _handle_lost_task(self, task_id: str):
+        with self._lock:
+            meta = self._task_meta.get(task_id)
+            if meta is None:
+                return
+            if meta["attempt"] < meta.get("max_retries", 0):
+                meta["attempt"] += 1
+                self._pending.append(task_id)
+                self._sched_cv.notify_all()
+                return
+            self._task_meta.pop(task_id, None)
+        self._store_error(meta["return_ids"],
+                          NodeDiedError(
+                              f"worker died running task {task_id}"))
+
+    # ---- actors -----------------------------------------------------------
+
+    def create_actor(self, meta: Dict[str, Any], payload: bytes):
+        """meta: actor_id, resources, max_restarts, name, namespace."""
+        actor_id = meta["actor_id"]
+        name = meta.get("name")
+        ns = meta.get("namespace") or "default"
+        with self._lock:
+            if name:
+                existing_id = self._named.get((ns, name))
+                if existing_id is not None:
+                    existing = self._actors.get(existing_id)
+                    if existing is not None and not existing.dead:
+                        if meta.get("get_if_exists"):
+                            return {"actor_id": existing_id}
+                        raise ValueError(
+                            f"Actor name {name!r} already taken")
+            pass
+        deadline = time.time() + 60
+        while True:
+            with self._lock:
+                w = None
+                while w is None:
+                    w = self._pick_worker_locked(
+                        meta.get("resources", {}), None)
+                    if w is None:
+                        if time.time() > deadline:
+                            raise TimeoutError(
+                                f"No worker fits actor resources "
+                                f"{meta.get('resources')}")
+                        self._sched_cv.wait(timeout=0.1)
+                for k, v in meta.get("resources", {}).items():
+                    w.available[k] = w.available.get(k, 0.0) - v
+                info = _ActorInfo(actor_id, w.worker_id, payload,
+                                  meta.get("resources", {}),
+                                  meta.get("max_restarts", 0), name, ns)
+                self._actors[actor_id] = info
+                if name:
+                    self._named[(ns, name)] = actor_id
+                client = w.client
+            try:
+                client.call("create_actor", actor_id, payload)
+                return {"actor_id": actor_id}
+            except RpcError:
+                # Worker died under us (monitor lag): mark it dead —
+                # which releases nothing for this not-yet-counted actor —
+                # give back the reservation, and retry elsewhere.
+                with self._lock:
+                    self._actors.pop(actor_id, None)
+                    if name:
+                        self._named.pop((ns, name), None)
+                    for k, v in meta.get("resources", {}).items():
+                        w.available[k] = w.available.get(k, 0.0) + v
+                self.mark_worker_dead(w.worker_id)
+                if time.time() > deadline:
+                    raise
+
+    def _handle_lost_actor(self, a: _ActorInfo):
+        with self._lock:
+            if a.max_restarts != -1 and a.restarts >= a.max_restarts:
+                a.dead = True
+                a.death_reason = "worker died"
+                return
+            a.restarts += 1
+            a.worker_id = ""   # in-restart: not routable
+        threading.Thread(target=self._restart_actor, args=(a,),
+                         daemon=True).start()
+
+    def _restart_actor(self, a: _ActorInfo, timeout: float = 60.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                w = self._pick_worker_locked(a.resources, None)
+                if w is None:
+                    self._sched_cv.wait(timeout=0.1)
+                    continue
+                for k, v in a.resources.items():
+                    w.available[k] = w.available.get(k, 0.0) - v
+                a.worker_id = w.worker_id
+                client = w.client
+            try:
+                client.call("create_actor", a.actor_id, a.payload)
+                return
+            except RpcError:
+                with self._lock:
+                    for k, v in a.resources.items():
+                        w.available[k] = w.available.get(k, 0.0) + v
+                self.mark_worker_dead(w.worker_id)
+        a.dead = True
+        a.death_reason = "no worker available for restart"
+
+    def submit_actor_task(self, actor_id: str, meta: Dict[str, Any],
+                          payload: bytes):
+        with self._lock:
+            a = self._actors.get(actor_id)
+            if a is None or a.dead:
+                reason = a.death_reason if a else "unknown actor"
+                raise ActorDiedError(actor_id, reason)
+            w = self._workers.get(a.worker_id)
+            if w is None or not w.alive:
+                raise ActorDiedError(actor_id, "worker dead")
+            client = w.client
+        client.call("push_actor_task", actor_id, payload)
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        with self._lock:
+            a = self._actors.get(actor_id)
+            if a is None:
+                raise ValueError(f"Unknown actor {actor_id}")
+            w = self._workers.get(a.worker_id)
+            restart = (not no_restart and
+                       (a.max_restarts == -1 or
+                        a.restarts < a.max_restarts))
+            if not restart:
+                a.dead = True
+                a.death_reason = ("killed via kill()" if no_restart
+                                  else "crashed (out of restarts)")
+                if a.name:
+                    self._named.pop((a.namespace, a.name), None)
+                if w and w.alive:
+                    for k, v in a.resources.items():
+                        w.available[k] = min(
+                            w.resources.get(k, 0.0),
+                            w.available.get(k, 0.0) + v)
+            else:
+                a.restarts += 1
+            client = w.client if (w and w.alive) else None
+        if client is not None:
+            try:
+                client.call("kill_actor", actor_id,
+                            restart)
+            except RpcError:
+                pass
+
+    def lookup_named_actor(self, name: str, namespace: str) -> str:
+        with self._lock:
+            key = (namespace or "default", name)
+            actor_id = self._named.get(key)
+            if actor_id is None:
+                raise ValueError(f"No actor named {name!r}")
+            return actor_id
+
+    def actor_class_payload(self, actor_id: str) -> bytes:
+        with self._lock:
+            a = self._actors.get(actor_id)
+            if a is None:
+                raise ValueError(f"Unknown actor {actor_id}")
+            return a.payload
+
+    def list_actors(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{"actor_id": a.actor_id, "worker_id": a.worker_id,
+                     "state": "DEAD" if a.dead else "ALIVE",
+                     "name": a.name or "", "restarts": a.restarts}
+                    for a in self._actors.values()]
+
+    # ---- placement groups -------------------------------------------------
+
+    def create_placement_group(self, pg_id: str,
+                               bundles: List[Dict[str, float]],
+                               strategy: str) -> bool:
+        with self._lock:
+            reserved: List[Tuple[str, Dict[str, float]]] = []
+            used: set = set()
+            ok = True
+            for b in bundles:
+                w = None
+                for cand in self._workers.values():
+                    if not cand.alive:
+                        continue
+                    if strategy in ("SPREAD", "STRICT_SPREAD") and \
+                            cand.worker_id in used:
+                        continue
+                    if all(cand.available.get(k, 0.0) + 1e-9 >= v
+                           for k, v in b.items()):
+                        w = cand
+                        break
+                if w is None:
+                    ok = False
+                    break
+                for k, v in b.items():
+                    w.available[k] = w.available.get(k, 0.0) - v
+                reserved.append((w.worker_id, b))
+                used.add(w.worker_id)
+            if not ok:
+                for wid, b in reserved:
+                    w = self._workers[wid]
+                    for k, v in b.items():
+                        w.available[k] = w.available.get(k, 0.0) + v
+                return False
+            self._pgs[pg_id] = {
+                "ready": True,
+                "workers": [wid for wid, _ in reserved],
+                "bundles": reserved,
+            }
+            self._sched_cv.notify_all()
+            return True
+
+    def remove_placement_group(self, pg_id: str):
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+            if pg is None:
+                return
+            for wid, b in pg["bundles"]:
+                w = self._workers.get(wid)
+                if w and w.alive:
+                    for k, v in b.items():
+                        w.available[k] = min(
+                            w.resources.get(k, 0.0),
+                            w.available.get(k, 0.0) + v)
+            self._sched_cv.notify_all()
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def ping(self) -> str:
+        return "pong"
+
+    def shutdown(self):
+        self._shutdown = True
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            if w.alive:
+                try:
+                    w.client.call("shutdown", timeout=2)
+                except Exception:
+                    pass

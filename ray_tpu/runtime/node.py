@@ -1,0 +1,131 @@
+"""Node manager: process lifecycle for the multiprocess runtime.
+
+Capability parity with the reference's node/process management
+(python/ray/_private/node.py start_head_processes + services.py
+start_raylet, and the raylet WorkerPool worker_pool.h:149): creates the
+node's C++ shm store, serves the head, spawns/monitors/kills worker
+processes (the chaos NodeKiller hook used by fault-tolerance tests).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu.runtime.head import HeadService
+from ray_tpu.runtime.rpc import RpcServer
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+class NodeManager:
+    def __init__(self, num_workers: int = 2,
+                 resources_per_worker: Optional[Dict[str, float]] = None,
+                 store_capacity: int = 256 * 1024 * 1024,
+                 tpu_owner_worker: Optional[int] = None):
+        self.resources_per_worker = resources_per_worker or {"CPU": 2}
+        self.store_name = f"/raytpu_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        from ray_tpu._private.shm_store import ShmObjectStore
+        self.store = ShmObjectStore.create(self.store_name,
+                                           store_capacity)
+        self.head_service = HeadService(self.store_name)
+        self.head_server = RpcServer(self.head_service)
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self.tpu_owner_worker = tpu_owner_worker
+        self._stopped = False
+        for i in range(num_workers):
+            self.start_worker(i)
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True, name="node-monitor")
+        self._monitor.start()
+
+    @property
+    def head_address(self) -> str:
+        return self.head_server.address
+
+    def start_worker(self, index: int,
+                     resources: Optional[Dict[str, float]] = None
+                     ) -> str:
+        worker_id = f"worker-{index}-{uuid.uuid4().hex[:6]}"
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)   # breaks the TPU plugin (see skills)
+        res = dict(resources or self.resources_per_worker)
+        # Only a designated worker may own the TPU; everyone else is
+        # forced onto the CPU backend so they can't grab the chip.
+        if self.tpu_owner_worker is not None and \
+                index == self.tpu_owner_worker:
+            res.setdefault("TPU", 1.0)
+        else:
+            env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.worker_main",
+             "--head", self.head_address,
+             "--store", self.store_name,
+             "--worker-id", worker_id,
+             "--resources", json.dumps(res)],
+            cwd=_REPO_ROOT, env=env)
+        self.procs[worker_id] = proc
+        return worker_id
+
+    def wait_for_workers(self, n: Optional[int] = None,
+                         timeout: float = 30) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if n is None:
+                # Wait for every live worker process to be registered.
+                target = sum(1 for p in self.procs.values()
+                             if p.poll() is None)
+            else:
+                target = n
+            alive = [w for w in self.head_service.list_workers()
+                     if w["alive"]]
+            if len(alive) >= target:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"Only {len(self.head_service.list_workers())} of {target} "
+            f"workers registered in {timeout}s")
+
+    def kill_worker(self, worker_id: str):
+        """Chaos hook: SIGKILL a worker process (the NodeKillerActor
+        analogue, python/ray/_private/test_utils.py:1089)."""
+        proc = self.procs.get(worker_id)
+        if proc is not None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    def _monitor_loop(self):
+        import traceback
+        while not self._stopped:
+            try:
+                for worker_id, proc in list(self.procs.items()):
+                    if proc.poll() is not None:
+                        self.procs.pop(worker_id, None)
+                        self.head_service.mark_worker_dead(worker_id)
+            except Exception:  # noqa: BLE001 — keep monitoring
+                traceback.print_exc()
+            time.sleep(0.05)
+
+    def stop(self):
+        self._stopped = True
+        self.head_service.shutdown()
+        deadline = time.time() + 3
+        for proc in self.procs.values():
+            try:
+                if proc.poll() is None and time.time() < deadline:
+                    proc.terminate()
+            except Exception:
+                pass
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=3)
+            except Exception:
+                proc.kill()
+        self.head_server.stop()
+        self.store.close()

@@ -1,0 +1,180 @@
+"""Framed-socket RPC.
+
+Role parity with the reference's RPC layer (src/ray/rpc/grpc_server.h,
+grpc_client.h, client_call.h): typed service endpoints, concurrent calls,
+retrying clients, per-connection threads. Wire format: 4-byte little-endian
+length + cloudpickle({"method","args","kwargs"} / {"ok"/"err": ...}).
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+
+_LEN = struct.Struct("<I")
+
+
+def _send_msg(sock: socket.socket, obj: Any):
+    data = cloudpickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    return cloudpickle.loads(_recv_exact(sock, n))
+
+
+class RpcServer:
+    """Serves a handler object's public methods over TCP."""
+
+    def __init__(self, handler: Any, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.host, self.port = self._sock.getsockname()
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"rpc-server-{self.port}")
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        send_lock = threading.Lock()
+        try:
+            while self._running:
+                req = _recv_msg(conn)
+                # Each request runs on its own thread so one long call
+                # doesn't block the connection (client sends one request
+                # per pooled connection at a time).
+                threading.Thread(
+                    target=self._handle_one, args=(conn, req, send_lock),
+                    daemon=True).start()
+        except (ConnectionError, OSError):
+            pass
+
+    def _handle_one(self, conn: socket.socket, req: Dict[str, Any],
+                    send_lock: threading.Lock):
+        rid = req.get("rid")
+        try:
+            method = getattr(self.handler, req["method"])
+            result = method(*req.get("args", ()),
+                            **req.get("kwargs", {}))
+            reply = {"rid": rid, "ok": result}
+        except BaseException as e:  # noqa: BLE001
+            reply = {"rid": rid, "err": e,
+                     "tb": traceback.format_exc()}
+        with send_lock:
+            try:
+                _send_msg(conn, reply)
+            except (ConnectionError, OSError):
+                pass
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+class RpcClient:
+    """Thread-safe client. Each call gets a pooled connection; replies are
+    matched by request id per connection (one in-flight call per pooled
+    connection keeps the protocol trivial)."""
+
+    def __init__(self, address: str, timeout: Optional[float] = None):
+        host, port = address.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.timeout = timeout
+        self._pool: list = []
+        self._pool_lock = threading.Lock()
+        self._rid = 0
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _get_conn(self) -> socket.socket:
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        return self._connect()
+
+    def _put_conn(self, sock: socket.socket):
+        with self._pool_lock:
+            if len(self._pool) < 16:
+                self._pool.append(sock)
+            else:
+                sock.close()
+
+    def call(self, method: str, *args, timeout: Optional[float] = None,
+             **kwargs) -> Any:
+        with self._pool_lock:
+            self._rid += 1
+            rid = self._rid
+        sock = None
+        try:
+            sock = self._get_conn()
+            if timeout is not None:
+                sock.settimeout(timeout)
+            _send_msg(sock, {"rid": rid, "method": method,
+                             "args": args, "kwargs": kwargs})
+            reply = _recv_msg(sock)
+        except (ConnectionError, OSError) as e:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise RpcError(f"RPC {method} to {self.host}:{self.port} "
+                           f"failed: {e}") from e
+        self._put_conn(sock)
+        if "err" in reply:
+            raise reply["err"]
+        return reply["ok"]
+
+    def close(self):
+        with self._pool_lock:
+            for s in self._pool:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._pool.clear()

@@ -1,0 +1,328 @@
+"""Worker process entry point.
+
+Capability parity with the reference's worker bootstrap + executor side
+(python/ray/_private/workers/default_worker.py + CoreWorker task execution
+core_worker.cc:2181/2543): serves an executor endpoint (PushTask
+equivalent), attaches the node's shm object store, resolves args, executes
+tasks/actor methods, writes results to the store, and installs a
+WorkerRuntime so nested ray_tpu API calls inside tasks route back through
+the head scheduler.
+
+Run: python -m ray_tpu.runtime.worker_main --head H:P --store NAME \
+         --worker-id ID --resources '{"CPU": 2}'
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import queue
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import dumps, loads
+from ray_tpu.exceptions import ActorDiedError, TaskError
+from ray_tpu.runtime.rpc import RpcClient, RpcServer
+
+_task_ctx = threading.local()
+
+
+class _ActorSlot:
+    def __init__(self, instance=None, error: Optional[BaseException] = None):
+        self.instance = instance
+        self.error = error
+        self.mailbox: "queue.Queue" = queue.Queue()
+        self.thread: Optional[threading.Thread] = None
+
+
+class Executor:
+    """RPC handler for this worker process."""
+
+    def __init__(self, worker_id: str, head: RpcClient, store,
+                 resources: Dict[str, float]):
+        self.worker_id = worker_id
+        self.head = head
+        self.store = store
+        self.resources = resources
+        self.actors: Dict[str, _ActorSlot] = {}
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+
+    # ---- helpers ----------------------------------------------------------
+
+    def _resolve(self, value):
+        from ray_tpu._private.object_ref import ObjectRef
+        if isinstance(value, ObjectRef):
+            return self._read_object(value.id)
+        return value
+
+    def _read_object(self, oid: ObjectID):
+        status, value = loads(self.store.get_bytes(oid, timeout_ms=-1))
+        if status == "err":
+            raise value
+        return value
+
+    def _write_returns(self, return_ids: List[bytes], num_returns: int,
+                       result: Any):
+        if num_returns == 0:
+            return
+        if num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != num_returns:
+                raise ValueError(
+                    f"expected {num_returns} returns, got {len(values)}")
+        for rid, v in zip(return_ids, values):
+            self.store.put_bytes(ObjectID(rid), dumps(("ok", v)))
+
+    def _write_error(self, return_ids: List[bytes], exc: BaseException):
+        payload = dumps(("err", exc))
+        for rid in return_ids:
+            try:
+                self.store.put_bytes(ObjectID(rid), payload)
+            except Exception:
+                pass
+
+    # ---- normal tasks -----------------------------------------------------
+
+    def push_task(self, payload: bytes) -> str:
+        spec = cloudpickle.loads(payload)
+        _task_ctx.resources = spec.get("resources", {})
+        _task_ctx.blocked = False
+        try:
+            func = spec["func"]
+            args = [self._resolve(a) for a in spec["args"]]
+            kwargs = {k: self._resolve(v)
+                      for k, v in spec["kwargs"].items()}
+            result = func(*args, **kwargs)
+            self._write_returns(spec["return_ids"],
+                                spec["num_returns"], result)
+            return "ok"
+        except BaseException as e:  # noqa: BLE001
+            if not isinstance(e, TaskError):
+                e = TaskError(e, task_name=spec.get("name", ""),
+                              remote_traceback=traceback.format_exc())
+            self._write_error(spec["return_ids"], e)
+            return "error"
+        finally:
+            _task_ctx.resources = None
+
+    # ---- actors -----------------------------------------------------------
+
+    def create_actor(self, actor_id: str, payload: bytes) -> str:
+        spec = cloudpickle.loads(payload)
+        slot = _ActorSlot()
+        try:
+            cls = spec["cls"]
+            slot.instance = cls(*spec["args"], **spec["kwargs"])
+        except BaseException as e:  # noqa: BLE001
+            slot.error = e
+        with self._lock:
+            self.actors[actor_id] = slot
+        slot.thread = threading.Thread(
+            target=self._actor_loop, args=(actor_id, slot), daemon=True,
+            name=f"actor-{actor_id[:8]}")
+        slot.thread.start()
+        return "ok" if slot.error is None else "init_failed"
+
+    def _actor_loop(self, actor_id: str, slot: _ActorSlot):
+        while not self._shutdown.is_set():
+            item = slot.mailbox.get()
+            if item is None:
+                return
+            spec = item
+            try:
+                if slot.error is not None:
+                    raise ActorDiedError(
+                        actor_id, f"__init__ failed: {slot.error!r}")
+                method = getattr(slot.instance, spec["method"])
+                args = [self._resolve(a) for a in spec["args"]]
+                kwargs = {k: self._resolve(v)
+                          for k, v in spec["kwargs"].items()}
+                result = method(*args, **kwargs)
+                self._write_returns(spec["return_ids"],
+                                    spec["num_returns"], result)
+            except BaseException as e:  # noqa: BLE001
+                if not isinstance(e, (TaskError, ActorDiedError)):
+                    e = TaskError(e, task_name=spec.get("name", ""),
+                                  remote_traceback=traceback.format_exc())
+                self._write_error(spec["return_ids"], e)
+
+    def push_actor_task(self, actor_id: str, payload: bytes) -> str:
+        spec = cloudpickle.loads(payload)
+        with self._lock:
+            slot = self.actors.get(actor_id)
+        if slot is None:
+            self._write_error(spec["return_ids"],
+                              ActorDiedError(actor_id, "not on worker"))
+            return "dead"
+        slot.mailbox.put(spec)
+        return "queued"
+
+    def kill_actor(self, actor_id: str, restart: bool) -> str:
+        with self._lock:
+            slot = self.actors.pop(actor_id, None)
+        if slot is not None:
+            slot.mailbox.put(None)
+        return "ok"
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def ping(self) -> str:
+        return "pong"
+
+    def shutdown(self) -> str:
+        self._shutdown.set()
+        threading.Thread(target=lambda: (_sleep_exit()), daemon=True) \
+            .start()
+        return "bye"
+
+
+def _sleep_exit():
+    import time
+    time.sleep(0.2)
+    import os
+    os._exit(0)
+
+
+class WorkerRuntime:
+    """Runtime interface inside a worker process: nested API calls route
+    through the head scheduler; objects through the shm store."""
+
+    def __init__(self, executor: Executor, head: RpcClient,
+                 worker_id: str):
+        self._ex = executor
+        self.head = head
+        self.worker_id = worker_id
+        from ray_tpu._private.object_store import ReferenceCounter
+        self.ref_counter = ReferenceCounter()
+        self.ref_counter.enabled = False
+        from ray_tpu._private.ids import JobID
+        self.job_id = JobID.next()
+        self._handles: Dict[Any, Any] = {}
+
+    @property
+    def _actor_handles(self):
+        return self._handles
+
+    # Shared implementation with the driver client.
+    def put(self, value):
+        from ray_tpu._private.object_ref import ObjectRef
+        oid = ObjectID.from_random()
+        self._ex.store.put_bytes(oid, dumps(("ok", value)))
+        return ObjectRef(oid)
+
+    def get(self, refs, timeout=None):
+        from ray_tpu.runtime.client import resolve_refs
+        res = getattr(_task_ctx, "resources", None)
+        blocked = False
+        if res:
+            missing = any(not self._ex.store.contains(r.id)
+                          for r in ([refs] if not isinstance(refs, list)
+                                    else refs))
+            if missing:
+                self.head.call("task_blocked", self.worker_id, res)
+                blocked = True
+        try:
+            return resolve_refs(self._ex.store, refs, timeout)
+        finally:
+            if blocked:
+                self.head.call("task_unblocked", self.worker_id, res)
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        from ray_tpu.runtime.client import wait_refs
+        return wait_refs(self._ex.store, refs, num_returns, timeout)
+
+    def object_future(self, oid):
+        from ray_tpu.runtime.client import object_future
+        return object_future(self._ex.store, oid)
+
+    def submit_task(self, spec):
+        from ray_tpu.runtime.client import submit_task_via_head
+        return submit_task_via_head(self.head, spec)
+
+    def create_actor(self, spec):
+        from ray_tpu.runtime.client import create_actor_via_head
+        return create_actor_via_head(self.head, spec)
+
+    def submit_actor_task(self, actor_id, spec):
+        from ray_tpu.runtime.client import submit_actor_task_via_head
+        return submit_actor_task_via_head(self.head, actor_id, spec)
+
+    def kill_actor(self, actor_id, no_restart=True):
+        self.head.call("kill_actor", actor_id.hex(), no_restart)
+
+    def lookup_named_actor(self, name, namespace):
+        from ray_tpu._private.ids import ActorID
+        return ActorID.from_hex(
+            self.head.call("lookup_named_actor", name,
+                           namespace or "default"))
+
+    def get_actor_state(self, actor_id):
+        from ray_tpu.runtime.client import actor_state_from_head
+        return actor_state_from_head(self.head, actor_id)
+
+    def cancel(self, ref, force=False, recursive=True):
+        pass  # not supported in the multiprocess runtime yet
+
+    def cluster_resources(self):
+        return self.head.call("cluster_resources")
+
+    def available_resources(self):
+        return self.head.call("available_resources")
+
+    def create_placement_group(self, spec):
+        from ray_tpu.runtime.client import create_pg_via_head
+        return create_pg_via_head(self.head, spec)
+
+    def remove_placement_group(self, pg):
+        self.head.call("remove_placement_group", pg.id.hex())
+
+    def list_actors(self):
+        return self.head.call("list_actors")
+
+    def list_tasks(self):
+        return []
+
+    def list_objects(self):
+        return []
+
+    def shutdown(self):
+        pass
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head", required=True)
+    parser.add_argument("--store", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--resources", default='{"CPU": 1}')
+    args = parser.parse_args()
+
+    from ray_tpu._private.shm_store import ShmObjectStore
+    store = ShmObjectStore.attach(args.store)
+    head = RpcClient(args.head)
+    resources = json.loads(args.resources)
+
+    executor = Executor(args.worker_id, head, store, resources)
+    server = RpcServer(executor)
+
+    # Install the worker-side runtime for nested API usage.
+    runtime = WorkerRuntime(executor, head, args.worker_id)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.object_ref import set_global_reference_counter
+    worker_mod._worker = worker_mod.Worker(runtime, mode="worker")
+    set_global_reference_counter(runtime.ref_counter)
+
+    head.call("register_worker", args.worker_id, server.address,
+              resources)
+    executor._shutdown.wait()
+
+
+if __name__ == "__main__":
+    main()
