@@ -1,0 +1,354 @@
+"""Flash attention: custom pallas TPU kernels (forward + backward).
+
+The framework's own blockwise-attention kernel (SURVEY.md §7 hard part 5 —
+"the only place we write kernels"), used for long sequences where XLA
+attention materializes the [B,H,T,T] score tensor in HBM. Design notes:
+
+- Online softmax: running (m, l, acc) in VMEM scratch, revisited across the
+  kv grid dimension (innermost, "arbitrary" semantics); scores never touch
+  HBM. fp32 accumulation, bf16 MXU matmuls.
+- Causal blocks kj > qi are predicated off with @pl.when (the grid still
+  visits them; the MXU work is skipped).
+- Backward is two kernels: dq (grid over q blocks, accumulate over kv) and
+  dk/dv (grid over kv blocks, accumulate over q), using the saved
+  logsumexp and delta = rowsum(do * o) — no recomputed softmax
+  normalization passes.
+- Layout contract: [B, T, H, D] externally; folded to [B*H, T, D] for the
+  kernels so the grid's leading dimension is embarrassingly parallel.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def _pick_block(t: int, target: int = 512) -> int:
+    blk = min(t, target)
+    while t % blk:
+        blk //= 2
+    return max(blk, min(t, _LANES))
+
+
+
+def _interpret() -> bool:
+    """Pallas TPU kernels run natively on TPU; everywhere else (the CPU
+    test mesh) they run in interpreter mode."""
+    return jax.default_backend() != "tpu"
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
+                blk_q: int, blk_k: int, num_kv: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0]                       # [blk_q, D]
+        k = k_ref[0]                       # [blk_k, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            kpos = kj * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]              # [blk_q, 1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.exp(m_prev - m_new)    # [blk_q, 1]
+        p = jnp.exp(s - m_new)             # [blk_q, blk_k] f32
+        l_new = l_scr[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        pl.when(kj <= qi * (blk_q // blk_k) + (blk_q // blk_k) - 1)(
+            _compute)
+    else:
+        _compute()
+
+    last_kj = (qi * (blk_q // blk_k) + (blk_q // blk_k) - 1) \
+        if causal else num_kv - 1
+
+    @pl.when(kj == last_kj)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse = m_scr[:, :1] + jnp.log(l)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _flash_fwd(q, k, v, causal: bool) -> Tuple[jax.Array, jax.Array]:
+    BH, T, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    blk_q = _pick_block(T)
+    blk_k = _pick_block(Tk)
+    if causal and blk_q % blk_k:
+        blk_k = blk_q = min(blk_q, blk_k)
+    num_kv = Tk // blk_k
+
+    grid = (BH, T // blk_q, num_kv)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, blk_q=blk_q,
+        blk_k=blk_k, num_kv=num_kv)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),   # m
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),   # l
+            pltpu.VMEM((blk_q, D), jnp.float32),        # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse[:, :, 0]
+
+
+# --------------------------------------------------------------------------
+# Backward
+# --------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_scr, *, scale: float, causal: bool,
+                   blk_q: int, blk_k: int, num_kv: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            kpos = kj * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        acc_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(kj <= qi * (blk_q // blk_k) + (blk_q // blk_k) - 1)(
+            _compute)
+    else:
+        _compute()
+
+    last_kj = (qi * (blk_q // blk_k) + (blk_q // blk_k) - 1) \
+        if causal else num_kv - 1
+
+    @pl.when(kj == last_kj)
+    def _finalize():
+        dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                    causal: bool, blk_q: int, blk_k: int, num_q: int):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            kpos = kj * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                      # [blk_q, blk_k]
+        # dv += p^T do
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype).astype(jnp.float32), do,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                     # [blk_q, blk_k]
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        # Only q blocks at/after this kv block contribute.
+        pl.when(qi * blk_q + blk_q - 1 >= kj * blk_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(causal, res, g):
+    q, k, v, o, lse = res
+    do = g
+    BH, T, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    blk_q = _pick_block(T)
+    blk_k = _pick_block(Tk)
+    if causal and blk_q % blk_k:
+        blk_k = blk_q = min(blk_q, blk_k)
+    num_kv = Tk // blk_k
+    num_q = T // blk_q
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                       # [BH, T]
+    lse_b = jnp.broadcast_to(lse[..., None], (BH, T, _LANES))
+    delta_b = jnp.broadcast_to(delta[..., None], (BH, T, _LANES))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k, num_kv=num_kv),
+        grid=(BH, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, lse_b, delta_b)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k, num_q=num_q),
+        grid=(BH, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _LANES), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, D), jnp.float32),
+            pltpu.VMEM((blk_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, lse_b, delta_b)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# custom_vjp wrapper, [B, T, H, D] public layout
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_bhtd(q, k, v, causal):
+    o, _ = _flash_fwd(q, k, v, causal)
+    return o
+
+
+def _flash_bhtd_fwd(q, k, v, causal):
+    o, lse = _flash_fwd(q, k, v, causal)
+    return o, (q, k, v, o, lse)
+
+
+_flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True) -> jax.Array:
+    """Pallas flash attention. q/k/v: [B, T, H, D]; returns [B, T, H, D].
+    T must be a multiple of 128. Differentiable (custom pallas backward).
+    """
+    B, T, H, D = q.shape
+    Tk = k.shape[1]
+    if T % _LANES or Tk % _LANES:
+        raise ValueError(
+            f"flash_attention requires T % {_LANES} == 0, got {T}/{Tk}")
+
+    def fold(x):
+        return x.swapaxes(1, 2).reshape(B * H, x.shape[1], D)
+
+    o = _flash_bhtd(fold(q), fold(k), fold(v), causal)
+    return o.reshape(B, H, T, D).swapaxes(1, 2)
