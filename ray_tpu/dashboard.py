@@ -1,0 +1,90 @@
+"""Dashboard: HTTP observability endpoints.
+
+Capability parity (API plane, no React frontend) with the reference's
+dashboard head (dashboard/head.py + modules): JSON endpoints for cluster
+summary, actors, tasks, objects, workers, the chrome-trace timeline, and
+Prometheus metrics exposition (python/ray/_private/metrics_agent.py role).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    async def _summary(self, request):
+        from aiohttp import web
+        from ray_tpu import state
+        return web.json_response(state.cluster_summary())
+
+    async def _actors(self, request):
+        from aiohttp import web
+        from ray_tpu import state
+        return web.json_response(state.list_actors())
+
+    async def _tasks(self, request):
+        from aiohttp import web
+        from ray_tpu import state
+        return web.json_response(state.list_tasks())
+
+    async def _objects(self, request):
+        from aiohttp import web
+        from ray_tpu import state
+        return web.json_response(state.list_objects())
+
+    async def _workers(self, request):
+        from aiohttp import web
+        from ray_tpu import state
+        return web.json_response(state.list_workers())
+
+    async def _timeline(self, request):
+        from aiohttp import web
+        from ray_tpu._private import profiling
+        return web.json_response(profiling.chrome_trace())
+
+    async def _metrics(self, request):
+        from aiohttp import web
+        from ray_tpu.util.metrics import prometheus_text
+        return web.Response(text=prometheus_text(),
+                            content_type="text/plain")
+
+    def _run(self):
+        from aiohttp import web
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        app = web.Application()
+        app.router.add_get("/api/cluster_summary", self._summary)
+        app.router.add_get("/api/actors", self._actors)
+        app.router.add_get("/api/tasks", self._tasks)
+        app.router.add_get("/api/objects", self._objects)
+        app.router.add_get("/api/workers", self._workers)
+        app.router.add_get("/api/timeline", self._timeline)
+        app.router.add_get("/metrics", self._metrics)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._started.set()
+        loop.run_forever()
+
+    def start(self, timeout: float = 10.0) -> "Dashboard":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dashboard")
+        self._thread.start()
+        if not self._started.wait(timeout):
+            raise TimeoutError("dashboard failed to start")
+        return self
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)

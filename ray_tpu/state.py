@@ -1,0 +1,89 @@
+"""State API: cluster introspection.
+
+Capability parity with the reference's state API
+(python/ray/experimental/state/api.py list_actors:719/list_tasks:942,
+dashboard/state_aggregator.py): filterable listings of actors, tasks,
+objects, workers and resource summaries, backed by whichever runtime is
+active (local or distributed).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private.worker import global_worker
+
+Filter = Tuple[str, str, Any]   # (key, "="|"!=", value)
+
+
+def _apply_filters(rows: List[Dict[str, Any]],
+                   filters: Optional[List[Filter]]) -> List[Dict]:
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        keep = True
+        for key, op, value in filters:
+            actual = row.get(key)
+            if op == "=":
+                keep = actual == value
+            elif op == "!=":
+                keep = actual != value
+            else:
+                raise ValueError(f"Unsupported filter op {op!r}")
+            if not keep:
+                break
+        if keep:
+            out.append(row)
+    return out
+
+
+def list_actors(filters: Optional[List[Filter]] = None,
+                limit: int = 1000) -> List[Dict[str, Any]]:
+    return _apply_filters(
+        global_worker().runtime.list_actors(), filters)[:limit]
+
+
+def list_tasks(filters: Optional[List[Filter]] = None,
+               limit: int = 1000) -> List[Dict[str, Any]]:
+    return _apply_filters(
+        global_worker().runtime.list_tasks(), filters)[:limit]
+
+
+def list_objects(filters: Optional[List[Filter]] = None,
+                 limit: int = 1000) -> List[Dict[str, Any]]:
+    return _apply_filters(
+        global_worker().runtime.list_objects(), filters)[:limit]
+
+
+def list_workers() -> List[Dict[str, Any]]:
+    rt = global_worker().runtime
+    if hasattr(rt, "list_workers"):
+        return rt.list_workers()
+    return [{"worker_id": "driver", "alive": True,
+             "resources": rt.cluster_resources(),
+             "available": rt.available_resources()}]
+
+
+def summarize_tasks() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for t in list_tasks():
+        counts[t["state"]] = counts.get(t["state"], 0) + 1
+    return counts
+
+
+def summarize_actors() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for a in list_actors():
+        counts[a["state"]] = counts.get(a["state"], 0) + 1
+    return counts
+
+
+def cluster_summary() -> Dict[str, Any]:
+    rt = global_worker().runtime
+    return {
+        "resources_total": rt.cluster_resources(),
+        "resources_available": rt.available_resources(),
+        "tasks": summarize_tasks(),
+        "actors": summarize_actors(),
+        "workers": len(list_workers()),
+    }

@@ -1,0 +1,70 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py): load-balance
+function applications over a fixed set of actors."""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        if not actors:
+            raise ValueError("ActorPool requires at least one actor")
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._pending = []
+        self._results = []
+        self._index = 0
+
+    def submit(self, fn: Callable, value: Any):
+        """fn(actor, value) -> ObjectRef."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._index, actor)
+            self._index += 1
+        else:
+            self._pending.append((fn, value))
+
+    def _drain_pending(self):
+        while self._pending and self._idle:
+            fn, value = self._pending.pop(0)
+            self.submit(fn, value)
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor or self._pending)
+
+    def get_next_unordered(self, timeout=None):
+        if not self.has_next():
+            raise StopIteration("No pending results")
+        refs = list(self._future_to_actor)
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        _, actor = self._future_to_actor.pop(ref)
+        self._idle.append(actor)
+        self._drain_pending()
+        return ray_tpu.get(ref)
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        values = list(values)
+        for v in values:
+            self.submit(fn, v)
+        out = {}
+        while self.has_next():
+            refs = list(self._future_to_actor)
+            ready, _ = ray_tpu.wait(refs, num_returns=1)
+            ref = ready[0]
+            idx, actor = self._future_to_actor.pop(ref)
+            self._idle.append(actor)
+            self._drain_pending()
+            out[idx] = ray_tpu.get(ref)
+        return [out[i] for i in sorted(out)]

@@ -1,0 +1,123 @@
+"""Out-of-band collective groups over actors.
+
+Capability parity with the reference's ray.util.collective
+(python/ray/util/collective/collective.py — NCCL/gloo groups with a named
+rendezvous store actor): allreduce/allgather/broadcast/reduce/barrier for
+host (numpy) data between actor processes, rendezvoused through a named
+group actor.
+
+TPU-native note (SURVEY.md §5.8): DEVICE collectives are in-band to XLA —
+psum/all_gather/ppermute over mesh axes inside pjit programs — and need no
+group objects. This module is the CPU/control-plane tier (the gloo
+analogue), e.g. for torch-CPU data-parallel training or coordinating
+host-side state.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_GROUP_PREFIX = "collective::"
+
+_REDUCERS = {
+    "sum": lambda items: np.sum(items, axis=0),
+    "prod": lambda items: np.prod(items, axis=0),
+    "max": lambda items: np.max(items, axis=0),
+    "min": lambda items: np.min(items, axis=0),
+    "mean": lambda items: np.mean(items, axis=0),
+}
+
+
+class _GroupActor:
+    """Rendezvous + reduction point for one group."""
+
+    def __init__(self, world_size: int):
+        self.world = world_size
+        self._rounds: Dict[int, Dict[str, Any]] = {}
+
+    def _round(self, seq: int) -> Dict[str, Any]:
+        r = self._rounds.get(seq)
+        if r is None:
+            r = {"items": {}, "event": asyncio.Event(), "result": None}
+            self._rounds[seq] = r
+        return r
+
+    async def collective(self, seq: int, op: str, rank: int,
+                         payload) -> Any:
+        r = self._round(seq)
+        r["items"][rank] = payload
+        if len(r["items"]) == self.world:
+            items = [r["items"][k] for k in sorted(r["items"])]
+            if op == "barrier":
+                r["result"] = None
+            elif op == "allgather":
+                r["result"] = items
+            elif op == "broadcast":
+                r["result"] = next(i for i in items if i is not None)
+            elif op in _REDUCERS:
+                r["result"] = _REDUCERS[op](
+                    [np.asarray(i) for i in items])
+            else:
+                raise ValueError(f"unknown collective op {op!r}")
+            r["event"].set()
+        await r["event"].wait()
+        result = r["result"]
+        # Garbage-collect finished rounds lazily.
+        self._rounds.pop(seq - 4, None)
+        return result
+
+    def world_size(self) -> int:
+        return self.world
+
+
+def create_collective_group(world_size: int, group_name: str = "default"):
+    """Create (or get) the named group. Call before members use it."""
+    actor_cls = ray_tpu.remote(_GroupActor)
+    return actor_cls.options(
+        name=_GROUP_PREFIX + group_name, get_if_exists=True,
+        num_cpus=0).remote(world_size)
+
+
+def destroy_collective_group(group_name: str = "default"):
+    try:
+        h = ray_tpu.get_actor(_GROUP_PREFIX + group_name)
+        ray_tpu.kill(h)
+    except ValueError:
+        pass
+
+
+class CollectiveGroup:
+    """Member-side handle. Each member constructs one with its rank and
+    calls the ops in the same order (lockstep sequence numbers)."""
+
+    def __init__(self, rank: int, group_name: str = "default"):
+        self.rank = rank
+        self.name = group_name
+        self._actor = ray_tpu.get_actor(_GROUP_PREFIX + group_name)
+        self._seq = 0
+
+    def _call(self, op: str, payload) -> Any:
+        seq = self._seq
+        self._seq += 1
+        return ray_tpu.get(
+            self._actor.collective.remote(seq, op, self.rank, payload))
+
+    def allreduce(self, array, op: str = "sum") -> np.ndarray:
+        return self._call(op, np.asarray(array))
+
+    def allgather(self, array) -> List[np.ndarray]:
+        return self._call("allgather", np.asarray(array))
+
+    def broadcast(self, array, src_rank: int = 0) -> np.ndarray:
+        payload = np.asarray(array) if self.rank == src_rank else None
+        return self._call("broadcast", payload)
+
+    def barrier(self) -> None:
+        self._call("barrier", None)
+
+    def world_size(self) -> int:
+        return ray_tpu.get(self._actor.world_size.remote())

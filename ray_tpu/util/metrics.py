@@ -1,0 +1,155 @@
+"""Application metrics API.
+
+Capability parity with the reference's ray.util.metrics
+(python/ray/util/metrics.py Counter/Gauge/Histogram over the opencensus
+pipeline, src/ray/stats/metric.h DEFINE_stats): a process-local registry
+with tag support and Prometheus text exposition (served by the dashboard).
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+
+
+def registry() -> Dict[str, "Metric"]:
+    return dict(_registry)
+
+
+def clear_registry():
+    with _registry_lock:
+        _registry.clear()
+
+
+class Metric:
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _resolve_tags(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(
+                f"Unknown tags {sorted(extra)} for metric "
+                f"{self.name!r} (declared: {self.tag_keys})")
+        return tuple(sorted(merged.items()))
+
+    def _samples(self) -> List[Tuple[Tuple, Any]]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter increments must be >= 0")
+        key = self._resolve_tags(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _samples(self):
+        with self._lock:
+            return list(self._values.items())
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._resolve_tags(tags)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def _samples(self):
+        with self._lock:
+            return list(self._values.items())
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name, description="",
+                 boundaries: Sequence[float] = (), tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        if not boundaries or list(boundaries) != sorted(boundaries):
+            raise ValueError("boundaries must be a sorted non-empty list")
+        self.boundaries = list(boundaries)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        key = self._resolve_tags(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def _samples(self):
+        with self._lock:
+            return [(k, {"counts": list(v),
+                         "sum": self._sums[k],
+                         "count": self._totals[k]})
+                    for k, v in self._counts.items()]
+
+
+def _fmt_tags(tags: Tuple) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in tags)
+    return "{" + inner + "}"
+
+
+def prometheus_text() -> str:
+    """Prometheus exposition format for every registered metric."""
+    lines: List[str] = []
+    for m in registry().values():
+        lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.TYPE}")
+        for tags, value in m._samples():
+            if isinstance(m, Histogram):
+                cum = 0
+                for bound, c in zip(m.boundaries + [float("inf")],
+                                    value["counts"]):
+                    cum += c
+                    b = "+Inf" if bound == float("inf") else repr(bound)
+                    tag_str = _fmt_tags(tags + (("le", b),))
+                    lines.append(f"{m.name}_bucket{tag_str} {cum}")
+                lines.append(
+                    f"{m.name}_sum{_fmt_tags(tags)} {value['sum']}")
+                lines.append(
+                    f"{m.name}_count{_fmt_tags(tags)} {value['count']}")
+            else:
+                lines.append(f"{m.name}{_fmt_tags(tags)} {value}")
+    return "\n".join(lines) + "\n"

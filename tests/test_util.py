@@ -1,0 +1,164 @@
+"""util + observability tests (reference analogues:
+tests for ray.util.{actor_pool,queue,metrics,collective}, state API
+tests, dashboard module tests)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.collective import (CollectiveGroup,
+                                     create_collective_group,
+                                     destroy_collective_group)
+from ray_tpu.util.metrics import (Counter, Gauge, Histogram,
+                                  clear_registry, prometheus_text)
+from ray_tpu.util.queue import Empty, Queue
+
+
+def test_actor_pool_map(rt):
+    @ray_tpu.remote
+    class Sq:
+        def compute(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(3)])
+    out = pool.map(lambda a, v: a.compute.remote(v), range(10))
+    assert out == [i * i for i in range(10)]
+
+
+def test_actor_pool_unordered(rt):
+    @ray_tpu.remote
+    class Echo:
+        def compute(self, x):
+            return x
+
+    pool = ActorPool([Echo.remote() for _ in range(2)])
+    out = sorted(pool.map_unordered(
+        lambda a, v: a.compute.remote(v), range(8)))
+    assert out == list(range(8))
+
+
+def test_queue(rt):
+    q = Queue(maxsize=8)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+    assert q.get() == "b"
+    with pytest.raises(Empty):
+        q.get_nowait()
+
+    @ray_tpu.remote
+    def producer(q):
+        for i in range(5):
+            q.put(i)
+        return "done"
+
+    ray_tpu.get(producer.remote(q))
+    assert [q.get() for _ in range(5)] == list(range(5))
+    q.shutdown()
+
+
+def test_collective_allreduce_between_actors(rt):
+    create_collective_group(world_size=3, group_name="g1")
+
+    @ray_tpu.remote
+    class Member:
+        def __init__(self, rank):
+            from ray_tpu.util.collective import CollectiveGroup
+            self.rank = rank
+            self.group = CollectiveGroup(rank, "g1")
+
+        def run(self):
+            reduced = self.group.allreduce(
+                np.full(4, float(self.rank + 1)))
+            gathered = self.group.allgather(np.array([self.rank]))
+            bcast = self.group.broadcast(
+                np.array([42.0]) if self.rank == 0 else None,
+                src_rank=0)
+            self.group.barrier()
+            return (reduced.tolist(), [g.tolist() for g in gathered],
+                    bcast.tolist())
+
+    members = [Member.remote(r) for r in range(3)]
+    results = ray_tpu.get([m.run.remote() for m in members])
+    for reduced, gathered, bcast in results:
+        assert reduced == [6.0] * 4          # 1+2+3
+        assert gathered == [[0], [1], [2]]
+        assert bcast == [42.0]
+    destroy_collective_group("g1")
+
+
+def test_metrics_and_prometheus(rt):
+    clear_registry()
+    c = Counter("reqs_total", "requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = Gauge("temp", "temperature")
+    g.set(21.5)
+    h = Histogram("latency_s", "latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = prometheus_text()
+    assert 'reqs_total{route="/a"} 3.0' in text
+    assert "temp 21.5" in text
+    assert 'latency_s_bucket{le="0.1"} 1' in text
+    assert 'latency_s_bucket{le="1.0"} 2' in text
+    assert 'latency_s_bucket{le="+Inf"} 3' in text
+    assert "latency_s_count 3" in text
+    with pytest.raises(ValueError):
+        c.inc(tags={"bogus": "x"})
+    clear_registry()
+
+
+def test_state_api(rt):
+    from ray_tpu import state
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    actors = state.list_actors()
+    assert any(x["state"] == "ALIVE" for x in actors)
+    alive_only = state.list_actors(filters=[("state", "=", "ALIVE")])
+    assert all(x["state"] == "ALIVE" for x in alive_only)
+    summary = state.cluster_summary()
+    assert summary["resources_total"]["CPU"] == 8.0
+    assert summary["actors"].get("ALIVE", 0) >= 1
+
+
+def test_dashboard_endpoints(rt):
+    from ray_tpu.dashboard import Dashboard
+    from ray_tpu.util.metrics import Counter, clear_registry
+
+    clear_registry()
+    Counter("dash_metric", "x").inc(5)
+
+    @ray_tpu.remote
+    def traced_task():
+        return 1
+
+    ray_tpu.get(traced_task.remote())
+    dash = Dashboard(port=18266).start()
+    try:
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:18266{path}", timeout=15) as r:
+                return r.read().decode()
+
+        summary = json.loads(fetch("/api/cluster_summary"))
+        assert summary["resources_total"]["CPU"] == 8.0
+        tasks = json.loads(fetch("/api/tasks"))
+        assert any("traced_task" in t["name"] for t in tasks)
+        assert "dash_metric 5.0" in fetch("/metrics")
+        timeline = json.loads(fetch("/api/timeline"))
+        assert isinstance(timeline, list)
+    finally:
+        dash.stop()
+        clear_registry()
