@@ -68,6 +68,11 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, self._options)
 
+    def bind(self, *args, **kwargs):
+        """Lazy call graph node (reference: python/ray/dag)."""
+        from ray_tpu.dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def _remote(self, args, kwargs, opts):
         w = global_worker()
         rt = w.runtime
@@ -247,6 +252,11 @@ class ActorClass:
                              opts["max_task_retries"])
         rt._actor_handles[actor_id] = handle
         return handle
+
+    def bind(self, *args, **kwargs):
+        """Lazy actor-graph node (reference: python/ray/dag/class_node.py)."""
+        from ray_tpu.dag import ClassNode
+        return ClassNode(self, args, kwargs)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

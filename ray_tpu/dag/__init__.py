@@ -1,0 +1,317 @@
+"""Lazy task/actor call graphs: ``.bind()`` / ``.execute()``.
+
+Capability parity with the reference DAG API (python/ray/dag/dag_node.py:23,
+function_node.py, class_node.py, input_node.py): functions and actor classes
+gain ``.bind(*args)`` which returns a lazy node; nodes compose into a DAG
+that ``.execute(input)`` submits as real tasks/actor calls. This is the
+substrate for Serve deployment graphs and the Workflow engine.
+
+Fresh design: a DAG is an immutable tree of ``DAGNode``s; execution walks it
+once per call with a per-execution memo table so diamond-shaped graphs run
+each shared node exactly once, and passes ``ObjectRef``s (never materialized
+values) between nodes so the scheduler sees real data dependencies.
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu._private.object_ref import ObjectRef
+
+__all__ = [
+    "DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode",
+    "InputNode", "InputAttributeNode", "MultiOutputNode",
+]
+
+
+def _scan(value, visit):
+    """Apply ``visit`` to every DAGNode nested in ``value`` (lists, tuples,
+    dicts); returns the transformed structure."""
+    if isinstance(value, DAGNode):
+        return visit(value)
+    if isinstance(value, list):
+        return [_scan(v, visit) for v in value]
+    if isinstance(value, tuple):
+        return tuple(_scan(v, visit) for v in value)
+    if isinstance(value, dict):
+        return {k: _scan(v, visit) for k, v in value.items()}
+    return value
+
+
+class _ExecutionContext:
+    """Per-execute() state: the DAG input and the node → result memo."""
+
+    def __init__(self, input_args, input_kwargs):
+        self.input_args = input_args
+        self.input_kwargs = input_kwargs
+        self.memo: Dict[str, Any] = {}
+
+
+class DAGNode:
+    """A node in a lazy call graph.
+
+    ``_bound_args``/``_bound_kwargs`` may contain plain values, other
+    DAGNodes, or DAGNodes nested inside lists/tuples/dicts.
+    """
+
+    def __init__(self, args: Tuple, kwargs: Dict[str, Any],
+                 options: Optional[Dict[str, Any]] = None):
+        self._bound_args = tuple(args or ())
+        self._bound_kwargs = dict(kwargs or {})
+        self._bound_options = dict(options or {})
+        self._stable_uuid = uuid.uuid4().hex
+
+    # -- traversal ---------------------------------------------------------
+
+    def _children(self) -> List["DAGNode"]:
+        found: List[DAGNode] = []
+
+        def visit(node):
+            found.append(node)
+            return node
+
+        _scan(self._bound_args, visit)
+        _scan(self._bound_kwargs, visit)
+        return found
+
+    def walk(self) -> List["DAGNode"]:
+        """All nodes reachable from this one (post-order, deduped)."""
+        seen: Dict[str, DAGNode] = {}
+
+        def rec(node):
+            if node._stable_uuid in seen:
+                return
+            for c in node._children():
+                rec(c)
+            seen[node._stable_uuid] = node
+
+        rec(self)
+        return list(seen.values())
+
+    # -- execution ---------------------------------------------------------
+
+    def execute(self, *input_args, **input_kwargs):
+        """Run the DAG; returns an ObjectRef (or an ActorHandle for a bare
+        ClassNode, or a list for MultiOutputNode)."""
+        ctx = _ExecutionContext(input_args, input_kwargs)
+        return self._resolve(ctx)
+
+    def _resolve(self, ctx: _ExecutionContext):
+        hit = ctx.memo.get(self._stable_uuid)
+        if hit is None:
+            args = _scan(self._bound_args, lambda n: n._resolve(ctx))
+            kwargs = _scan(self._bound_kwargs, lambda n: n._resolve(ctx))
+            hit = self._execute_impl(args, kwargs, ctx)
+            ctx.memo[self._stable_uuid] = hit
+        return hit
+
+    def _execute_impl(self, args, kwargs, ctx):
+        raise NotImplementedError
+
+    def __reduce__(self):
+        raise TypeError("DAGNode cannot be serialized; execute() it and "
+                        "pass the resulting ObjectRef instead")
+
+
+class FunctionNode(DAGNode):
+    """Lazy ``fn.bind(...)``; executes as ``fn.options(...).remote(...)``."""
+
+    def __init__(self, remote_fn, args, kwargs, options=None):
+        super().__init__(args, kwargs, options)
+        self._remote_fn = remote_fn
+
+    def options(self, **opts) -> "FunctionNode":
+        return FunctionNode(self._remote_fn, self._bound_args,
+                            self._bound_kwargs,
+                            {**self._bound_options, **opts})
+
+    def _execute_impl(self, args, kwargs, ctx):
+        fn = self._remote_fn
+        if self._bound_options:
+            fn = fn.options(**self._bound_options)
+        return fn.remote(*args, **kwargs)
+
+    def __repr__(self):
+        return f"FunctionNode({getattr(self._remote_fn, '__name__', '?')})"
+
+
+class ClassNode(DAGNode):
+    """Lazy ``ActorClass.bind(...)``; executes by instantiating the actor
+    (once per DAG execution) and yields its handle."""
+
+    def __init__(self, actor_cls, args, kwargs, options=None):
+        super().__init__(args, kwargs, options)
+        self._actor_cls = actor_cls
+        # Persistent handle cache so repeated .execute() on a Serve-style
+        # graph reuses replica actors rather than leaking one per request.
+        self._cached_handle = None
+        self._lock = threading.Lock()
+
+    def options(self, **opts) -> "ClassNode":
+        return ClassNode(self._actor_cls, self._bound_args,
+                         self._bound_kwargs,
+                         {**self._bound_options, **opts})
+
+    def __getattr__(self, name: str) -> "_UnboundClassMethod":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _UnboundClassMethod(self, name)
+
+    def _resolve(self, ctx):
+        # Skip constructor-arg resolution entirely once the actor exists —
+        # re-submitting those upstream tasks would waste work and repeat
+        # their side effects for a dead result.
+        with self._lock:
+            if self._cached_handle is not None:
+                ctx.memo[self._stable_uuid] = self._cached_handle
+                return self._cached_handle
+        return super()._resolve(ctx)
+
+    def _execute_impl(self, args, kwargs, ctx):
+        with self._lock:
+            if self._cached_handle is None:
+                cls = self._actor_cls
+                if self._bound_options:
+                    cls = cls.options(**self._bound_options)
+                self._cached_handle = cls.remote(*args, **kwargs)
+        return self._cached_handle
+
+    def __repr__(self):
+        return f"ClassNode({getattr(self._actor_cls, '__name__', '?')})"
+
+
+class _UnboundClassMethod:
+    """``class_node.method`` — call ``.bind()`` to get a ClassMethodNode."""
+
+    def __init__(self, class_node: ClassNode, method_name: str,
+                 options: Optional[Dict[str, Any]] = None):
+        self._class_node = class_node
+        self._method_name = method_name
+        self._options = dict(options or {})
+
+    def options(self, **opts) -> "_UnboundClassMethod":
+        return _UnboundClassMethod(self._class_node, self._method_name,
+                                   {**self._options, **opts})
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method_name,
+                               args, kwargs, self._options)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Method {self._method_name!r} on a ClassNode is lazy; "
+            f"use .bind(...)")
+
+
+class ClassMethodNode(DAGNode):
+    """Lazy actor method call bound to a ClassNode."""
+
+    def __init__(self, class_node, method_name, args, kwargs, options=None):
+        super().__init__(args, kwargs, options)
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def _children(self):
+        return [self._class_node] + super()._children()
+
+    def _resolve(self, ctx):
+        hit = ctx.memo.get(self._stable_uuid)
+        if hit is None:
+            handle = self._class_node._resolve(ctx)
+            args = _scan(self._bound_args, lambda n: n._resolve(ctx))
+            kwargs = _scan(self._bound_kwargs, lambda n: n._resolve(ctx))
+            method = getattr(handle, self._method_name)
+            if self._bound_options:
+                method = method.options(**self._bound_options)
+            hit = method.remote(*args, **kwargs)
+            ctx.memo[self._stable_uuid] = hit
+        return hit
+
+    def _execute_impl(self, args, kwargs, ctx):  # handled in _resolve
+        raise AssertionError("unreachable")
+
+    def __repr__(self):
+        return (f"ClassMethodNode({self._class_node!r}."
+                f"{self._method_name})")
+
+
+class InputNode(DAGNode):
+    """Placeholder for the runtime input to ``execute()``.
+
+    Usable as a context manager for scoping clarity (parity with the
+    reference's ``with InputNode() as inp:`` idiom,
+    python/ray/dag/input_node.py), though the scope is not enforced.
+    """
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return InputAttributeNode(self, name, "attr")
+
+    def __getitem__(self, key):
+        return InputAttributeNode(self, key, "item")
+
+    def _execute_impl(self, args, kwargs, ctx):
+        if ctx.input_kwargs:
+            raise TypeError("execute() kwargs require InputAttributeNode "
+                            "access (inp.key), not bare InputNode")
+        if len(ctx.input_args) == 1:
+            return ctx.input_args[0]
+        if len(ctx.input_args) == 0:
+            return None
+        return ctx.input_args
+
+    def __repr__(self):
+        return "InputNode()"
+
+
+class InputAttributeNode(DAGNode):
+    """``inp.field`` / ``inp[key]`` — projects the runtime input."""
+
+    def __init__(self, input_node: InputNode, key, kind: str):
+        super().__init__((), {})
+        self._input_node = input_node
+        self._key = key
+        self._kind = kind
+
+    def _children(self):
+        return [self._input_node]
+
+    def _execute_impl(self, args, kwargs, ctx):
+        if self._kind == "item":
+            if ctx.input_kwargs and isinstance(self._key, str) \
+                    and self._key in ctx.input_kwargs:
+                return ctx.input_kwargs[self._key]
+            base = self._input_node._resolve(ctx)
+            return base[self._key]
+        if ctx.input_kwargs and self._key in ctx.input_kwargs:
+            return ctx.input_kwargs[self._key]
+        base = self._input_node._resolve(ctx)
+        return getattr(base, self._key)
+
+    def __repr__(self):
+        return f"InputAttributeNode({self._key!r})"
+
+
+class MultiOutputNode(DAGNode):
+    """Terminal node returning a list of results (one per bound output)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__((list(outputs),), {})
+
+    def _execute_impl(self, args, kwargs, ctx):
+        return list(args[0])
+
+    def __repr__(self):
+        return f"MultiOutputNode(n={len(self._bound_args[0])})"

@@ -1,0 +1,378 @@
+"""Durable workflows: persistent, resumable DAG execution.
+
+Capability parity with the reference workflow library
+(python/ray/workflow/{api,workflow_executor,workflow_state_from_dag}.py):
+a DAG built with ``.bind()`` is converted to a serializable step graph,
+persisted to storage, and executed with each step's result checkpointed as
+it completes. ``resume()`` reloads the graph and skips completed steps, so
+a crashed workflow continues where it left off (exactly-once per step, at
+the granularity of the atomic result write).
+
+Fresh design notes: steps run as ordinary remote tasks with a generic
+runner; the driver-side event loop submits every dependency-ready step
+concurrently (the reference threads continuations through an executor
+actor instead). Virtual actors are out of scope, as in the reference's
+DAG-based API.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.dag import (DAGNode, FunctionNode, InputAttributeNode,
+                         InputNode, MultiOutputNode, _scan)
+from ray_tpu.workflow.storage import WorkflowStorage
+
+__all__ = ["init", "run", "run_async", "resume", "resume_all", "get_status",
+           "get_output", "list_all", "delete", "WorkflowStatus"]
+
+
+class WorkflowStatus:
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    RESUMABLE = "RESUMABLE"
+
+
+_storage: Optional[WorkflowStorage] = None
+
+
+def init(storage_dir: Optional[str] = None) -> None:
+    """Point the workflow engine at a storage directory."""
+    global _storage
+    _storage = WorkflowStorage(storage_dir)
+
+
+def _get_storage() -> WorkflowStorage:
+    global _storage
+    if _storage is None:
+        _storage = WorkflowStorage()
+    return _storage
+
+
+# ---------------------------------------------------------------------------
+# DAG -> serializable step graph
+# ---------------------------------------------------------------------------
+
+class _StepRef:
+    """Placeholder for another step's output inside bound args."""
+
+    def __init__(self, step_id: str):
+        self.step_id = step_id
+
+
+class _InputRef:
+    """Placeholder for (a projection of) the workflow input."""
+
+    def __init__(self, kind: str = "whole", key: Any = None):
+        self.kind = kind  # whole | item | attr
+        self.key = key
+
+
+class _StepSpec:
+    def __init__(self, step_id: str, func, args, kwargs, options,
+                 is_output_list: bool = False):
+        self.step_id = step_id
+        self.func = func  # None for MultiOutputNode
+        self.args = args
+        self.kwargs = kwargs
+        self.options = options or {}
+        self.is_output_list = is_output_list
+
+    def dependencies(self) -> List[str]:
+        deps: List[str] = []
+
+        def visit(v):
+            if isinstance(v, _StepRef):
+                deps.append(v.step_id)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    visit(x)
+            elif isinstance(v, dict):
+                for x in v.values():
+                    visit(x)
+
+        visit(self.args)
+        visit(self.kwargs)
+        return deps
+
+
+class _WorkflowState:
+    """The persisted object: every step plus the terminal step id and the
+    pickled workflow input."""
+
+    def __init__(self, steps: Dict[str, _StepSpec], output_step: str,
+                 input_args: Tuple, input_kwargs: Dict[str, Any]):
+        self.steps = steps
+        self.output_step = output_step
+        self.input_args = input_args
+        self.input_kwargs = input_kwargs
+
+
+def _state_from_dag(dag: DAGNode, input_args, input_kwargs) -> _WorkflowState:
+    steps: Dict[str, _StepSpec] = {}
+    memo: Dict[str, Any] = {}  # node uuid -> placeholder value
+
+    def convert(node: DAGNode):
+        if node._stable_uuid in memo:
+            return memo[node._stable_uuid]
+        if isinstance(node, InputNode):
+            out = _InputRef("whole")
+        elif isinstance(node, InputAttributeNode):
+            out = _InputRef(node._kind, node._key)
+        elif isinstance(node, MultiOutputNode):
+            inner = [_convert_value(v) for v in node._bound_args[0]]
+            sid = f"output-{node._stable_uuid[:8]}"
+            steps[sid] = _StepSpec(sid, None, (inner,), {}, {},
+                                   is_output_list=True)
+            out = _StepRef(sid)
+        elif isinstance(node, FunctionNode):
+            args = _convert_value(node._bound_args)
+            kwargs = _convert_value(node._bound_kwargs)
+            fn = node._remote_fn
+            name = getattr(fn, "__name__", "step")
+            sid = f"{name}-{node._stable_uuid[:8]}"
+            # Decorator-level options (resources, retries) carry into the
+            # step; node-level .options() overrides them, matching what
+            # FunctionNode._execute_impl does on the non-durable path.
+            opts = {**fn._options, **node._bound_options}
+            steps[sid] = _StepSpec(sid, fn._func, args, kwargs, opts)
+            out = _StepRef(sid)
+        else:
+            raise TypeError(
+                f"Durable workflows support function DAGs only; got "
+                f"{type(node).__name__} (actor nodes are not "
+                f"checkpointable)")
+        memo[node._stable_uuid] = out
+        return out
+
+    def _convert_value(v):
+        return _scan(v, convert)
+
+    terminal = convert(dag)
+    if not isinstance(terminal, _StepRef):
+        raise TypeError("workflow DAG must terminate in a function step")
+    return _WorkflowState(steps, terminal.step_id, tuple(input_args),
+                          dict(input_kwargs))
+
+
+# ---------------------------------------------------------------------------
+# Execution
+# ---------------------------------------------------------------------------
+
+def _project_input(ref: _InputRef, input_args, input_kwargs):
+    if ref.kind == "whole":
+        if not input_args and input_kwargs:
+            raise TypeError("workflow input was kwargs-only; access it "
+                            "via InputAttributeNode (inp['key']), not "
+                            "bare InputNode")
+        if len(input_args) == 1:
+            return input_args[0]
+        return input_args if input_args else None
+    if ref.kind == "item":
+        if input_kwargs and isinstance(ref.key, str) \
+                and ref.key in input_kwargs:
+            return input_kwargs[ref.key]
+        base = _project_input(_InputRef("whole"), input_args, input_kwargs)
+        return base[ref.key]
+    if input_kwargs and ref.key in input_kwargs:
+        return input_kwargs[ref.key]
+    base = _project_input(_InputRef("whole"), input_args, input_kwargs)
+    return getattr(base, ref.key)
+
+
+def _run_step(func, args, kwargs):
+    return func(*args, **kwargs)
+
+
+def _execute_state(state: _WorkflowState, workflow_id: str,
+                   storage: WorkflowStorage) -> Any:
+    """Driver-side event loop: submit dependency-ready steps, checkpoint
+    results as they land, finish when the terminal step completes."""
+    results: Dict[str, Any] = {}
+    for sid in state.steps:
+        if storage.has_step(workflow_id, sid):
+            results[sid] = storage.load_step_result(workflow_id, sid)
+
+    def substitute(v):
+        if isinstance(v, _StepRef):
+            return results[v.step_id]
+        if isinstance(v, _InputRef):
+            return _project_input(v, state.input_args, state.input_kwargs)
+        if isinstance(v, list):
+            return [substitute(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(substitute(x) for x in v)
+        if isinstance(v, dict):
+            return {k: substitute(x) for k, x in v.items()}
+        return v
+
+    pending: Dict[Any, str] = {}  # ObjectRef -> step_id
+    done = set(results)
+
+    run_step = ray_tpu.remote(_run_step)
+
+    def ready_steps():
+        for sid, spec in state.steps.items():
+            if sid in done or sid in pending.values():
+                continue
+            if all(d in done for d in spec.dependencies()):
+                yield sid, spec
+
+    while True:
+        for sid, spec in list(ready_steps()):
+            if spec.is_output_list:
+                results[sid] = substitute(spec.args[0])
+                storage.save_step_result(workflow_id, sid, results[sid])
+                done.add(sid)
+                continue
+            args = substitute(spec.args)
+            kwargs = substitute(spec.kwargs)
+            fn = run_step
+            opts = {k: v for k, v in spec.options.items()
+                    if k in ("num_cpus", "num_tpus", "resources",
+                             "max_retries", "name")}
+            if opts:
+                fn = fn.options(**opts)
+            pending[fn.remote(spec.func, args, kwargs)] = sid
+        if state.output_step in done:
+            break
+        if not pending:
+            raise RuntimeError(
+                f"workflow {workflow_id}: no runnable steps but output "
+                f"not produced (cyclic or corrupt state)")
+        ready, _ = ray_tpu.wait(list(pending), num_returns=1)
+        ref = ready[0]
+        sid = pending.pop(ref)
+        value = ray_tpu.get(ref)  # raises on step failure
+        storage.save_step_result(workflow_id, sid, value)
+        results[sid] = value
+        done.add(sid)
+
+    return results[state.output_step]
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+        **kwargs) -> Any:
+    """Execute a DAG durably; blocks and returns the final result."""
+    storage = _get_storage()
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
+    if storage.exists(workflow_id):
+        status = storage.get_status(workflow_id)
+        if status == WorkflowStatus.SUCCESSFUL:
+            return storage.load_output(workflow_id)
+        # A fresh DAG has fresh step ids; overwriting the stored graph
+        # would orphan every prior checkpoint. Force an explicit choice.
+        raise ValueError(
+            f"workflow {workflow_id!r} already exists with status "
+            f"{status}; call workflow.resume({workflow_id!r}) to continue "
+            f"it or workflow.delete({workflow_id!r}) to start over")
+    state = _state_from_dag(dag, args, kwargs)
+    storage.save_state(workflow_id, state)
+    storage.set_status(workflow_id, WorkflowStatus.RUNNING)
+    try:
+        out = _execute_state(state, workflow_id, storage)
+    except BaseException:
+        storage.set_status(workflow_id, WorkflowStatus.FAILED)
+        raise
+    storage.save_output(workflow_id, out)
+    storage.set_status(workflow_id, WorkflowStatus.SUCCESSFUL)
+    return out
+
+
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+              **kwargs):
+    """Execute a DAG durably in the background; returns an ObjectRef."""
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
+    storage = _get_storage()
+    storage_base = storage.base
+
+    # Driver loop runs inside a detached task so the caller is free.
+    def _drive(base, wf_id, dag_state):
+        import ray_tpu.workflow as wf
+        wf.init(base)
+        st = wf._get_storage()
+        try:
+            out = wf._execute_state(dag_state, wf_id, st)
+        except BaseException:
+            st.set_status(wf_id, WorkflowStatus.FAILED)
+            raise
+        st.save_output(wf_id, out)
+        st.set_status(wf_id, WorkflowStatus.SUCCESSFUL)
+        return out
+
+    state = _state_from_dag(dag, args, kwargs)
+    # Persist state + RUNNING before returning so get_status/get_output
+    # polled immediately after run_async see an in-flight workflow.
+    storage.save_state(workflow_id, state)
+    storage.set_status(workflow_id, WorkflowStatus.RUNNING)
+    return ray_tpu.remote(_drive).remote(storage_base, workflow_id, state)
+
+
+def resume(workflow_id: str) -> Any:
+    """Resume a failed/interrupted workflow; completed steps are skipped."""
+    storage = _get_storage()
+    if not storage.exists(workflow_id):
+        raise ValueError(f"no such workflow: {workflow_id}")
+    if storage.get_status(workflow_id) == WorkflowStatus.SUCCESSFUL:
+        return storage.load_output(workflow_id)
+    state = storage.load_state(workflow_id)
+    storage.set_status(workflow_id, WorkflowStatus.RUNNING)
+    try:
+        out = _execute_state(state, workflow_id, storage)
+    except BaseException:
+        storage.set_status(workflow_id, WorkflowStatus.FAILED)
+        raise
+    storage.save_output(workflow_id, out)
+    storage.set_status(workflow_id, WorkflowStatus.SUCCESSFUL)
+    return out
+
+
+def resume_all() -> List[Tuple[str, Any]]:
+    """Resume every non-successful stored workflow; returns
+    (workflow_id, result) pairs for the ones that succeed."""
+    storage = _get_storage()
+    out = []
+    for wf_id in storage.list_workflows():
+        if storage.get_status(wf_id) != WorkflowStatus.SUCCESSFUL:
+            try:
+                out.append((wf_id, resume(wf_id)))
+            except Exception:
+                pass
+    return out
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    return _get_storage().get_status(workflow_id)
+
+
+def get_output(workflow_id: str, timeout: Optional[float] = None) -> Any:
+    """Fetch the stored output of a workflow, waiting if it is RUNNING."""
+    storage = _get_storage()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        if storage.has_output(workflow_id):
+            return storage.load_output(workflow_id)
+        status = storage.get_status(workflow_id)
+        if status in (WorkflowStatus.FAILED, None):
+            raise RuntimeError(
+                f"workflow {workflow_id} has no output (status={status})")
+        if deadline is not None and time.monotonic() > deadline:
+            raise TimeoutError(f"workflow {workflow_id} still {status}")
+        time.sleep(0.05)
+
+
+def list_all() -> List[Tuple[str, Optional[str]]]:
+    storage = _get_storage()
+    return [(wf, storage.get_status(wf))
+            for wf in storage.list_workflows()]
+
+
+def delete(workflow_id: str) -> None:
+    _get_storage().delete(workflow_id)

@@ -1,0 +1,119 @@
+"""Durable workflow storage (filesystem backend).
+
+Capability parity with the reference's workflow storage
+(python/ray/workflow/workflow_storage.py): per-workflow directory holding the
+serialized DAG state, one result file per completed step, and a status
+marker. Writes are atomic (tmp + rename) so a crash mid-write never corrupts
+a step result — this is what makes resume exactly-once-ish.
+
+Layout::
+
+    {base}/{workflow_id}/state.pkl        # serialized step graph + input
+    {base}/{workflow_id}/status           # RUNNING/SUCCESSFUL/FAILED/...
+    {base}/{workflow_id}/steps/{id}.pkl   # completed step results
+    {base}/{workflow_id}/output.pkl       # final workflow output
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Any, List, Optional
+
+import cloudpickle as pickle
+
+_DEFAULT_BASE = os.path.join(tempfile.gettempdir(), "ray_tpu", "workflows")
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class WorkflowStorage:
+    def __init__(self, base_dir: Optional[str] = None):
+        self.base = base_dir or _DEFAULT_BASE
+        os.makedirs(self.base, exist_ok=True)
+
+    # -- workflow-level ----------------------------------------------------
+
+    def _wf_dir(self, workflow_id: str) -> str:
+        if not workflow_id or "/" in workflow_id or workflow_id.startswith("."):
+            raise ValueError(f"invalid workflow id: {workflow_id!r}")
+        return os.path.join(self.base, workflow_id)
+
+    def exists(self, workflow_id: str) -> bool:
+        return os.path.isdir(self._wf_dir(workflow_id))
+
+    def list_workflows(self) -> List[str]:
+        return sorted(
+            d for d in os.listdir(self.base)
+            if os.path.isdir(os.path.join(self.base, d)))
+
+    def delete(self, workflow_id: str) -> None:
+        shutil.rmtree(self._wf_dir(workflow_id), ignore_errors=True)
+
+    def save_state(self, workflow_id: str, state: Any) -> None:
+        _atomic_write(os.path.join(self._wf_dir(workflow_id), "state.pkl"),
+                      pickle.dumps(state))
+
+    def load_state(self, workflow_id: str) -> Any:
+        with open(os.path.join(self._wf_dir(workflow_id), "state.pkl"),
+                  "rb") as f:
+            return pickle.load(f)
+
+    def set_status(self, workflow_id: str, status: str) -> None:
+        _atomic_write(os.path.join(self._wf_dir(workflow_id), "status"),
+                      status.encode())
+
+    def get_status(self, workflow_id: str) -> Optional[str]:
+        try:
+            with open(os.path.join(self._wf_dir(workflow_id), "status"),
+                      "rb") as f:
+                return f.read().decode()
+        except FileNotFoundError:
+            return None
+
+    # -- step-level --------------------------------------------------------
+
+    def _step_path(self, workflow_id: str, step_id: str) -> str:
+        return os.path.join(self._wf_dir(workflow_id), "steps",
+                            f"{step_id}.pkl")
+
+    def has_step(self, workflow_id: str, step_id: str) -> bool:
+        return os.path.exists(self._step_path(workflow_id, step_id))
+
+    def save_step_result(self, workflow_id: str, step_id: str,
+                         value: Any) -> None:
+        _atomic_write(self._step_path(workflow_id, step_id),
+                      pickle.dumps(value))
+
+    def load_step_result(self, workflow_id: str, step_id: str) -> Any:
+        with open(self._step_path(workflow_id, step_id), "rb") as f:
+            return pickle.load(f)
+
+    # -- output ------------------------------------------------------------
+
+    def save_output(self, workflow_id: str, value: Any) -> None:
+        _atomic_write(os.path.join(self._wf_dir(workflow_id), "output.pkl"),
+                      pickle.dumps(value))
+
+    def load_output(self, workflow_id: str) -> Any:
+        with open(os.path.join(self._wf_dir(workflow_id), "output.pkl"),
+                  "rb") as f:
+            return pickle.load(f)
+
+    def has_output(self, workflow_id: str) -> bool:
+        return os.path.exists(
+            os.path.join(self._wf_dir(workflow_id), "output.pkl"))

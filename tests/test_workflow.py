@@ -1,0 +1,171 @@
+"""Workflow engine tests (parity: python/ray/workflow tests)."""
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+from ray_tpu.workflow import WorkflowStatus
+
+
+@pytest.fixture
+def wf(rt, tmp_path):
+    workflow.init(str(tmp_path))
+    yield workflow
+
+
+def test_run_simple(wf):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    dag = double.bind(add.bind(1, 2))
+    assert wf.run(dag, workflow_id="w1") == 6
+    assert wf.get_status("w1") == WorkflowStatus.SUCCESSFUL
+    assert wf.get_output("w1") == 6
+    assert ("w1", WorkflowStatus.SUCCESSFUL) in wf.list_all()
+
+
+def test_run_with_input(wf):
+    @ray_tpu.remote
+    def mul(x, y):
+        return x * y
+
+    with InputNode() as inp:
+        dag = mul.bind(inp["a"], inp["b"])
+    assert wf.run(dag, a=3, b=4, workflow_id="w2") == 12
+
+
+def test_idempotent_rerun(wf):
+    calls = []
+
+    @ray_tpu.remote
+    def f():
+        calls.append(1)
+        return 7
+
+    dag = f.bind()
+    assert wf.run(dag, workflow_id="w3") == 7
+    # Re-running a SUCCESSFUL workflow returns the stored output.
+    assert wf.run(f.bind(), workflow_id="w3") == 7
+
+
+def test_failure_and_resume(wf, tmp_path):
+    marker = tmp_path / "allow"
+
+    @ray_tpu.remote
+    def first():
+        return 10
+
+    @ray_tpu.remote
+    def flaky(x):
+        import os
+        if not os.path.exists(str(marker)):
+            raise RuntimeError("boom")
+        return x + 1
+
+    dag = flaky.bind(first.bind())
+    with pytest.raises(Exception):
+        wf.run(dag, workflow_id="w4")
+    assert wf.get_status("w4") == WorkflowStatus.FAILED
+
+    # Re-running a FAILED id is rejected (would orphan checkpoints).
+    with pytest.raises(ValueError):
+        wf.run(dag, workflow_id="w4")
+
+    marker.write_text("ok")
+    # resume skips the completed `first` step and reruns only `flaky`
+    assert wf.resume("w4") == 11
+    assert wf.get_status("w4") == WorkflowStatus.SUCCESSFUL
+
+
+def test_completed_steps_not_rerun_on_resume(wf, tmp_path):
+    count_file = tmp_path / "count"
+    count_file.write_text("0")
+    marker = tmp_path / "allow"
+
+    @ray_tpu.remote
+    def counted():
+        n = int(count_file.read_text()) + 1
+        count_file.write_text(str(n))
+        return n
+
+    @ray_tpu.remote
+    def gate(x):
+        import os
+        if not os.path.exists(str(marker)):
+            raise RuntimeError("not yet")
+        return x
+
+    dag = gate.bind(counted.bind())
+    with pytest.raises(Exception):
+        wf.run(dag, workflow_id="w5")
+    marker.write_text("ok")
+    assert wf.resume("w5") == 1
+    assert count_file.read_text() == "1"  # counted ran exactly once
+
+
+def test_run_async_and_get_output(wf):
+    @ray_tpu.remote
+    def slow():
+        import time
+        time.sleep(0.2)
+        return 42
+
+    ref = wf.run_async(slow.bind(), workflow_id="w6")
+    assert ray_tpu.get(ref) == 42
+    assert wf.get_output("w6", timeout=5) == 42
+
+
+def test_resume_all(wf, tmp_path):
+    marker = tmp_path / "go"
+
+    @ray_tpu.remote
+    def gated():
+        import os
+        if not os.path.exists(str(marker)):
+            raise RuntimeError("down")
+        return "done"
+
+    with pytest.raises(Exception):
+        wf.run(gated.bind(), workflow_id="wa")
+    marker.write_text("x")
+    results = dict(wf.resume_all())
+    assert results["wa"] == "done"
+
+
+def test_delete(wf):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    wf.run(f.bind(), workflow_id="wd")
+    wf.delete("wd")
+    assert wf.get_status("wd") is None
+
+
+def test_actor_dag_rejected(wf):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 1
+
+    node = A.bind()
+    with pytest.raises(TypeError):
+        wf.run(node.m.bind(), workflow_id="wx")
+
+
+def test_parallel_fanout(wf):
+    @ray_tpu.remote
+    def part(i):
+        return i * i
+
+    @ray_tpu.remote
+    def gather(parts):
+        return sum(parts)
+
+    dag = gather.bind([part.bind(i) for i in range(5)])
+    assert wf.run(dag, workflow_id="wp") == sum(i * i for i in range(5))
