@@ -62,7 +62,11 @@ _TASK_OPTION_DEFAULTS: Dict[str, Any] = {
 }
 
 _ACTOR_OPTION_DEFAULTS: Dict[str, Any] = {
-    "num_cpus": 1.0,
+    # Actors reserve NO cpu for their lifetime unless asked (reference
+    # semantics, python/ray/_private/ray_option_utils.py: actor num_cpus
+    # defaults to 0 while running) — otherwise actor pools starve the
+    # cluster and nested pools deadlock.
+    "num_cpus": 0.0,
     "num_tpus": 0.0,
     "resources": None,
     "max_restarts": 0,

@@ -192,6 +192,12 @@ class _UnboundClassMethod:
         self._options = dict(options or {})
 
     def options(self, **opts) -> "_UnboundClassMethod":
+        # ActorMethod.options only understands num_returns; reject anything
+        # else here, at build time, rather than deep inside execute().
+        bad = set(opts) - {"num_returns"}
+        if bad:
+            raise TypeError(
+                f"unsupported actor-method option(s): {sorted(bad)}")
         return _UnboundClassMethod(self._class_node, self._method_name,
                                    {**self._options, **opts})
 

@@ -1,0 +1,88 @@
+"""joblib backend: run sklearn/joblib workloads on the cluster.
+
+Capability parity with the reference's joblib integration
+(python/ray/util/joblib/__init__.py + ray_backend.py): after
+``register_ray()``, ``with joblib.parallel_backend("ray_tpu"):`` routes
+every joblib batch to a remote task, so ``GridSearchCV`` et al. fan out
+across the cluster instead of local processes.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+import ray_tpu
+
+__all__ = ["register_ray"]
+
+
+def _run_joblib_batch(batch):
+    return batch()
+
+
+class _RayFuture:
+    """Future-ish wrapper joblib expects from ``apply_async``."""
+
+    def __init__(self, ref, callback: Optional[Callable]):
+        self._ref = ref
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+        def waiter():
+            try:
+                self._value = ray_tpu.get(ref)
+            except BaseException as e:  # noqa: BLE001
+                self._error = e
+            self._done.set()
+            if callback is not None and self._error is None:
+                callback(self._value)
+
+        threading.Thread(target=waiter, daemon=True).start()
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("joblib batch not finished")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+def register_ray() -> None:
+    """Register the ``ray_tpu`` joblib parallel backend."""
+    from joblib import register_parallel_backend
+    from joblib._parallel_backends import (AutoBatchingMixin,
+                                           ParallelBackendBase)
+
+    class RayTpuBackend(AutoBatchingMixin, ParallelBackendBase):
+        supports_timeout = True
+
+        def configure(self, n_jobs=1, parallel=None, **_):
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+        def effective_n_jobs(self, n_jobs):
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+            if n_jobs == -1 or n_jobs is None:
+                return max(1, cpus)
+            return max(1, n_jobs)
+
+        def apply_async(self, func, callback=None):
+            ref = ray_tpu.remote(_run_joblib_batch).remote(func)
+            return _RayFuture(ref, callback)
+
+        # joblib >= 1.4 prefers submit(); same contract.
+        def submit(self, func, callback=None):
+            return self.apply_async(func, callback)
+
+        def retrieve_result_callback(self, out):
+            return out.get()
+
+        def abort_everything(self, ensure_ready=True):
+            if ensure_ready:
+                self.configure(n_jobs=self.parallel.n_jobs,
+                               parallel=self.parallel)
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,202 @@
+"""``multiprocessing.Pool`` API backed by actors.
+
+Capability parity with the reference's drop-in pool
+(python/ray/util/multiprocessing/pool.py): ``Pool`` exposes
+apply/apply_async/map/map_async/starmap/imap/imap_unordered/close/join/
+terminate with the stdlib's semantics, but each "process" is an actor, so
+the pool composes with the cluster scheduler and with TPU resource requests
+(``ray_remote_args={"num_tpus": 1}``).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+import ray_tpu
+
+__all__ = ["Pool", "AsyncResult", "TimeoutError"]
+
+TimeoutError = ray_tpu.exceptions.GetTimeoutError
+
+
+@ray_tpu.remote
+class _PoolActor:
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_batch(self, func, argument_tuples: List[Tuple[tuple, dict]]):
+        return [func(*a, **kw) for a, kw in argument_tuples]
+
+    def ping(self):
+        return True
+
+
+class AsyncResult:
+    """Stdlib-compatible handle over a set of chunk refs."""
+
+    def __init__(self, chunk_refs: List[Any], single: bool = False,
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None):
+        self._chunk_refs = chunk_refs
+        self._single = single
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._thread = threading.Thread(
+            target=self._collect, args=(callback, error_callback),
+            daemon=True)
+        self._thread.start()
+
+    def _collect(self, callback, error_callback):
+        try:
+            chunks = ray_tpu.get(self._chunk_refs)
+            flat = list(itertools.chain.from_iterable(chunks))
+            self._result = flat[0] if self._single else flat
+            self._done.set()
+            if callback is not None:
+                callback(self._result)
+        except BaseException as e:  # noqa: BLE001 — stored and re-raised
+            self._error = e
+            self._done.set()
+            if error_callback is not None:
+                error_callback(e)
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def wait(self, timeout: Optional[float] = None):
+        self._done.wait(timeout)
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        return self._error is None
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = (),
+                 ray_remote_args: Optional[dict] = None):
+        if processes is None:
+            processes = max(1, int(
+                ray_tpu.cluster_resources().get("CPU", 1)))
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._size = processes
+        cls = _PoolActor
+        if ray_remote_args:
+            cls = cls.options(**ray_remote_args)
+        self._actors = [cls.remote(initializer, initargs)
+                        for _ in range(processes)]
+        ray_tpu.get([a.ping.remote() for a in self._actors])
+        self._rr = itertools.cycle(range(processes))
+        self._closed = False
+        self._outstanding: List[AsyncResult] = []
+
+    # -- helpers -----------------------------------------------------------
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    def _submit_chunks(self, func, arg_tuples: List[Tuple[tuple, dict]],
+                       chunksize: Optional[int]):
+        if chunksize is None:
+            chunksize = max(1, len(arg_tuples) // (self._size * 4) or 1)
+        refs = []
+        for i in range(0, len(arg_tuples), chunksize):
+            actor = self._actors[next(self._rr)]
+            refs.append(actor.run_batch.remote(
+                func, arg_tuples[i:i + chunksize]))
+        return refs
+
+    # -- stdlib API --------------------------------------------------------
+
+    def apply(self, func, args=(), kwds=None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args=(), kwds=None, callback=None,
+                    error_callback=None) -> AsyncResult:
+        self._check_open()
+        refs = self._submit_chunks(func, [(tuple(args), kwds or {})], 1)
+        r = AsyncResult(refs, single=True, callback=callback,
+                        error_callback=error_callback)
+        self._outstanding.append(r)
+        return r
+
+    def map(self, func, iterable: Iterable, chunksize=None) -> List[Any]:
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func, iterable, chunksize=None, callback=None,
+                  error_callback=None) -> AsyncResult:
+        self._check_open()
+        tuples = [((x,), {}) for x in iterable]
+        refs = self._submit_chunks(func, tuples, chunksize)
+        r = AsyncResult(refs, callback=callback,
+                        error_callback=error_callback)
+        self._outstanding.append(r)
+        return r
+
+    def starmap(self, func, iterable, chunksize=None) -> List[Any]:
+        return self.starmap_async(func, iterable, chunksize).get()
+
+    def starmap_async(self, func, iterable, chunksize=None, callback=None,
+                      error_callback=None) -> AsyncResult:
+        self._check_open()
+        tuples = [(tuple(args), {}) for args in iterable]
+        refs = self._submit_chunks(func, tuples, chunksize)
+        r = AsyncResult(refs, callback=callback,
+                        error_callback=error_callback)
+        self._outstanding.append(r)
+        return r
+
+    def imap(self, func, iterable, chunksize=1):
+        self._check_open()
+        tuples = [((x,), {}) for x in iterable]
+        refs = self._submit_chunks(func, tuples, chunksize)
+        for ref in refs:  # ordered
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, func, iterable, chunksize=1):
+        self._check_open()
+        tuples = [((x,), {}) for x in iterable]
+        pending = set(self._submit_chunks(func, tuples, chunksize))
+        while pending:
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1)
+            pending.discard(ready[0])
+            yield from ray_tpu.get(ready[0])
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+        for a in self._actors:
+            ray_tpu.kill(a)
+        self._actors = []
+
+    def join(self):
+        """Block until all outstanding async work finishes (stdlib
+        close()/join() completion-barrier contract)."""
+        if not self._closed:
+            raise ValueError("Pool is still open")
+        for r in self._outstanding:
+            r.wait()
+        self._outstanding = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+        return False

@@ -191,10 +191,18 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
                    storage: WorkflowStorage) -> Any:
     """Driver-side event loop: submit dependency-ready steps, checkpoint
     results as they land, finish when the terminal step completes."""
-    results: Dict[str, Any] = {}
-    for sid in state.steps:
-        if storage.has_step(workflow_id, sid):
-            results[sid] = storage.load_step_result(workflow_id, sid)
+    done = {sid for sid in state.steps
+            if storage.has_step(workflow_id, sid)}
+    # Load only checkpoints some remaining step (or the output) consumes —
+    # resuming a mostly-done workflow shouldn't deserialize every
+    # intermediate result.
+    needed = {state.output_step}
+    for sid, spec in state.steps.items():
+        if sid not in done:
+            needed.update(spec.dependencies())
+    results: Dict[str, Any] = {
+        sid: storage.load_step_result(workflow_id, sid)
+        for sid in done & needed}
 
     def substitute(v):
         if isinstance(v, _StepRef):
@@ -210,7 +218,6 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
         return v
 
     pending: Dict[Any, str] = {}  # ObjectRef -> step_id
-    done = set(results)
 
     run_step = ray_tpu.remote(_run_step)
 
@@ -221,22 +228,41 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
             if all(d in done for d in spec.dependencies()):
                 yield sid, spec
 
-    while True:
-        for sid, spec in list(ready_steps()):
-            if spec.is_output_list:
-                results[sid] = substitute(spec.args[0])
-                storage.save_step_result(workflow_id, sid, results[sid])
-                done.add(sid)
+    def drain_pending():
+        """Checkpoint every in-flight step that still completes, so a
+        sibling failure doesn't discard finished work on resume."""
+        while pending:
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1)
+            sid = pending.pop(ready[0])
+            try:
+                value = ray_tpu.get(ready[0])
+            except Exception:
                 continue
-            args = substitute(spec.args)
-            kwargs = substitute(spec.kwargs)
-            fn = run_step
-            opts = {k: v for k, v in spec.options.items()
-                    if k in ("num_cpus", "num_tpus", "resources",
-                             "max_retries", "name")}
-            if opts:
-                fn = fn.options(**opts)
-            pending[fn.remote(spec.func, args, kwargs)] = sid
+            storage.save_step_result(workflow_id, sid, value)
+
+    while True:
+        # Output-list steps complete synchronously and can unlock further
+        # steps, so re-scan until the ready set is exhausted.
+        progressed = True
+        while progressed:
+            progressed = False
+            for sid, spec in list(ready_steps()):
+                if spec.is_output_list:
+                    results[sid] = substitute(spec.args[0])
+                    storage.save_step_result(workflow_id, sid,
+                                             results[sid])
+                    done.add(sid)
+                    progressed = True
+                    continue
+                args = substitute(spec.args)
+                kwargs = substitute(spec.kwargs)
+                fn = run_step
+                opts = {k: v for k, v in spec.options.items()
+                        if k in ("num_cpus", "num_tpus", "resources",
+                                 "max_retries", "name")}
+                if opts:
+                    fn = fn.options(**opts)
+                pending[fn.remote(spec.func, args, kwargs)] = sid
         if state.output_step in done:
             break
         if not pending:
@@ -246,7 +272,11 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
         ready, _ = ray_tpu.wait(list(pending), num_returns=1)
         ref = ready[0]
         sid = pending.pop(ref)
-        value = ray_tpu.get(ref)  # raises on step failure
+        try:
+            value = ray_tpu.get(ref)  # raises on step failure
+        except BaseException:
+            drain_pending()
+            raise
         storage.save_step_result(workflow_id, sid, value)
         results[sid] = value
         done.add(sid)
@@ -291,6 +321,13 @@ def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
     """Execute a DAG durably in the background; returns an ObjectRef."""
     workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
     storage = _get_storage()
+    if storage.exists(workflow_id):
+        status = storage.get_status(workflow_id)
+        if status == WorkflowStatus.SUCCESSFUL:
+            return ray_tpu.put(storage.load_output(workflow_id))
+        raise ValueError(
+            f"workflow {workflow_id!r} already exists with status "
+            f"{status}; resume() or delete() it first")
     storage_base = storage.base
 
     # Driver loop runs inside a detached task so the caller is free.
@@ -335,12 +372,15 @@ def resume(workflow_id: str) -> Any:
 
 
 def resume_all() -> List[Tuple[str, Any]]:
-    """Resume every non-successful stored workflow; returns
-    (workflow_id, result) pairs for the ones that succeed."""
+    """Resume every FAILED/RESUMABLE stored workflow; returns
+    (workflow_id, result) pairs for the ones that succeed. RUNNING
+    workflows are skipped — they may be live under run_async, and
+    resuming one would double-execute its steps."""
     storage = _get_storage()
     out = []
     for wf_id in storage.list_workflows():
-        if storage.get_status(wf_id) != WorkflowStatus.SUCCESSFUL:
+        if storage.get_status(wf_id) in (WorkflowStatus.FAILED,
+                                         WorkflowStatus.RESUMABLE):
             try:
                 out.append((wf_id, resume(wf_id)))
             except Exception:

@@ -1,0 +1,135 @@
+"""Integration surface tests: multiprocessing.Pool, joblib, ParallelIterator
+(parity: python/ray/util/{multiprocessing,joblib,iter}).
+"""
+import pytest
+
+import ray_tpu
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def _boom(x):
+    raise ValueError("boom")
+
+
+class TestPool:
+    def test_map(self, rt):
+        from ray_tpu.util.multiprocessing import Pool
+        with Pool(4) as p:
+            assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+
+    def test_apply_and_async(self, rt):
+        from ray_tpu.util.multiprocessing import Pool
+        with Pool(2) as p:
+            assert p.apply(_add, (1, 2)) == 3
+            r = p.apply_async(_add, (4, 5))
+            assert r.get(timeout=10) == 9
+            assert r.ready() and r.successful()
+
+    def test_starmap(self, rt):
+        from ray_tpu.util.multiprocessing import Pool
+        with Pool(2) as p:
+            assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+
+    def test_imap_ordered(self, rt):
+        from ray_tpu.util.multiprocessing import Pool
+        with Pool(3) as p:
+            assert list(p.imap(_sq, range(7))) == [x * x for x in range(7)]
+
+    def test_imap_unordered(self, rt):
+        from ray_tpu.util.multiprocessing import Pool
+        with Pool(3) as p:
+            got = sorted(p.imap_unordered(_sq, range(7)))
+            assert got == sorted(x * x for x in range(7))
+
+    def test_error_propagates(self, rt):
+        from ray_tpu.util.multiprocessing import Pool
+        with Pool(2) as p:
+            r = p.apply_async(_boom, (1,))
+            with pytest.raises(Exception):
+                r.get(timeout=10)
+            assert not r.successful()
+
+    def test_initializer(self, rt):
+        from ray_tpu.util.multiprocessing import Pool
+
+        def init(v):
+            import os
+            os.environ["POOL_INIT"] = str(v)
+
+        def read(_):
+            import os
+            return os.environ.get("POOL_INIT")
+
+        with Pool(2, initializer=init, initargs=(7,)) as p:
+            assert p.map(read, range(2)) == ["7", "7"]
+
+    def test_closed_pool_rejects(self, rt):
+        from ray_tpu.util.multiprocessing import Pool
+        p = Pool(1)
+        p.close()
+        with pytest.raises(ValueError):
+            p.map(_sq, [1])
+        p.join()
+
+
+class TestJoblib:
+    def test_parallel_backend(self, rt):
+        import joblib
+        from ray_tpu.util.joblib import register_ray
+        register_ray()
+        with joblib.parallel_backend("ray_tpu"):
+            out = joblib.Parallel(n_jobs=4)(
+                joblib.delayed(_sq)(i) for i in range(20))
+        assert out == [i * i for i in range(20)]
+
+
+class TestParallelIterator:
+    def test_from_items_gather_sync(self, rt):
+        from ray_tpu.util import iter as rit
+        it = rit.from_items(list(range(8)), num_shards=3)
+        assert sorted(it.gather_sync()) == list(range(8))
+
+    def test_for_each_filter_batch(self, rt):
+        from ray_tpu.util import iter as rit
+        it = (rit.from_range(10, num_shards=2)
+              .for_each(lambda x: x * 2)
+              .filter(lambda x: x % 4 == 0))
+        assert sorted(it.gather_sync()) == [0, 4, 8, 12, 16]
+
+    def test_batch_flatten(self, rt):
+        from ray_tpu.util import iter as rit
+        it = rit.from_range(6, num_shards=2).batch(2).flatten()
+        assert sorted(it.gather_sync()) == list(range(6))
+
+    def test_gather_async(self, rt):
+        from ray_tpu.util import iter as rit
+        it = rit.from_range(12, num_shards=4).for_each(lambda x: x + 100)
+        assert sorted(it.gather_async(num_async=2)) == \
+            [x + 100 for x in range(12)]
+
+    def test_union_and_take(self, rt):
+        from ray_tpu.util import iter as rit
+        a = rit.from_items([1, 2], num_shards=1)
+        b = rit.from_items([3, 4], num_shards=1)
+        u = a.union(b)
+        assert u.num_shards() == 2
+        assert sorted(u.gather_sync()) == [1, 2, 3, 4]
+        assert len(rit.from_range(10, num_shards=2).take(3)) == 3
+
+    def test_repeat(self, rt):
+        from ray_tpu.util import iter as rit
+        it = rit.from_items([1, 2], num_shards=1, repeat=True)
+        assert it.take(5) == [1, 2, 1, 2, 1]
+
+    def test_local_iterator_transforms(self, rt):
+        from ray_tpu.util import iter as rit
+        loc = (rit.from_range(6, num_shards=2).gather_sync()
+               .for_each(lambda x: x + 1).filter(lambda x: x % 2 == 0))
+        assert sorted(loc) == [2, 4, 6]
