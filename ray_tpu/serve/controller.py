@@ -107,12 +107,18 @@ class Controller:
             "target": target,
             "last_upscale": 0.0, "last_downscale": 0.0,
             "old_version_replicas": set(d["replicas"]) if d else set(),
+            # rid -> (handle, drain_start_ts); removed from routing but
+            # kept alive until in-flight requests finish (reference:
+            # STOPPING state in serve/_private/deployment_state.py:56).
+            "draining": dict(d["draining"]) if d else {},
         }
 
     def delete_deployment(self, name: str):
         d = self._deployments.pop(name, None)
         if d:
             for h in d["replicas"].values():
+                self._kill(h)
+            for h, _ in d["draining"].values():
                 self._kill(h)
 
     def get_replicas(self, name: str):
@@ -168,11 +174,11 @@ class Controller:
         while self._running:
             try:
                 for name, d in list(self._deployments.items()):
-                    # Roll old-version replicas.
+                    # Roll old-version replicas (drain, don't hard-kill).
                     for rid in list(d["old_version_replicas"]):
                         h = d["replicas"].pop(rid, None)
                         if h is not None:
-                            self._kill(h)
+                            d["draining"][rid] = (h, time.time())
                         d["old_version_replicas"].discard(rid)
                     # Scale to target.
                     while len(d["replicas"]) < d["target"]:
@@ -180,12 +186,33 @@ class Controller:
                     while len(d["replicas"]) > d["target"]:
                         rid, h = next(iter(d["replicas"].items()))
                         del d["replicas"][rid]
-                        self._kill(h)
+                        d["draining"][rid] = (h, time.time())
+                    await self._drain(d)
                     await self._autoscale(name, d)
             except Exception:  # noqa: BLE001 — keep reconciling
                 import traceback
                 traceback.print_exc()
             await asyncio.sleep(0.05)
+
+    async def _drain(self, d: Dict[str, Any]):
+        """Kill draining replicas once idle (or past their deadline).
+
+        A minimum grace period of two router cache TTLs must elapse
+        before an idle kill, so handles holding a stale replica list
+        can't route onto a just-killed actor.
+        """
+        from ray_tpu.serve.router import _REFRESH_S
+        for rid, (h, started) in list(d["draining"].items()):
+            if time.time() - started < 2 * _REFRESH_S:
+                continue
+            try:
+                stats = ray_tpu.get(h.stats.remote(), timeout=2)
+                idle = stats["ongoing"] == 0
+            except Exception:
+                idle = True
+            if idle or time.time() - started > 30.0:
+                del d["draining"][rid]
+                self._kill(h)
 
     async def _autoscale(self, name: str, d: Dict[str, Any]):
         cfg: DeploymentConfig = d["config"]
