@@ -1,0 +1,23 @@
+"""Autoscaler: demand-driven, slice-granular cluster scaling.
+
+TPU-native counterpart of python/ray/autoscaler/ (SURVEY.md §2.2 P11):
+StandardAutoscaler + bin-packing ResourceDemandScheduler + LoadMetrics +
+pluggable NodeProvider, with a process-backed fake provider for e2e
+tests. TPU node types are whole ICI slices, so scaling is slice-granular.
+"""
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.load_metrics import LoadMetrics
+from ray_tpu.autoscaler.monitor import AutoscalingCluster, Monitor
+from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
+                                              MockProvider, NodeProvider,
+                                              TAG_NODE_STATUS,
+                                              TAG_NODE_TYPE)
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    NodeTypeConfig, get_infeasible_demands, get_nodes_to_launch)
+
+__all__ = [
+    "StandardAutoscaler", "LoadMetrics", "Monitor", "AutoscalingCluster",
+    "NodeProvider", "MockProvider", "FakeMultiNodeProvider",
+    "NodeTypeConfig", "get_nodes_to_launch", "get_infeasible_demands",
+    "TAG_NODE_TYPE", "TAG_NODE_STATUS",
+]

@@ -1,0 +1,147 @@
+"""StandardAutoscaler: scale the fleet to match demand.
+
+Capability parity with the reference's StandardAutoscaler
+(python/ray/autoscaler/_private/autoscaler.py:154,345): each ``update()``
+enforces per-type min_workers, launches nodes for unmet pending demands
+(bounded by max_workers and upscaling_speed), and terminates nodes idle
+longer than idle_timeout_s. TPU node types scale by whole slices.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.load_metrics import LoadMetrics
+from ray_tpu.autoscaler.node_provider import (NodeProvider, TAG_NODE_TYPE)
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    NodeTypeConfig, get_infeasible_demands, get_nodes_to_launch)
+
+logger = logging.getLogger(__name__)
+
+
+class StandardAutoscaler:
+    def __init__(self, config: Dict, provider: NodeProvider,
+                 load_metrics: Optional[LoadMetrics] = None):
+        self.provider = provider
+        self.load_metrics = load_metrics or LoadMetrics()
+        self.update_config(config)
+        # node_id -> worker_id binding filled in by the monitor for
+        # providers that know it (FakeMultiNodeProvider).
+        self.num_launches = 0
+        self.num_terminations = 0
+        self.infeasible_demands: List[Dict[str, float]] = []
+
+    def update_config(self, config: Dict) -> None:
+        self.config = dict(config)
+        self.max_workers = config.get("max_workers", 8)
+        self.idle_timeout_s = config.get("idle_timeout_s", 60.0)
+        self.upscaling_speed = max(
+            float(config.get("upscaling_speed", 1.0)), 0.0)
+        self.node_types: Dict[str, NodeTypeConfig] = {
+            name: NodeTypeConfig.from_config(name, cfg)
+            for name, cfg in config.get(
+                "available_node_types", {}).items()}
+
+    # -- helpers -------------------------------------------------------------
+
+    def _counts_by_type(self, nodes: List[str]) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for nid in nodes:
+            ntype = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "?")
+            counts[ntype] = counts.get(ntype, 0) + 1
+        return counts
+
+    def _launch(self, ntype: str, count: int) -> None:
+        cfg = self.node_types[ntype]
+        self.provider.create_node(ntype, cfg.resources, count)
+        self.num_launches += count
+        logger.info("Autoscaler: launched %d x %s", count, ntype)
+
+    # -- the reconcile step --------------------------------------------------
+
+    def update(self, node_to_worker: Optional[Dict[str, str]] = None
+               ) -> None:
+        """One reconcile round. ``node_to_worker`` maps provider node ids
+        to runtime worker ids (for idle/busy attribution)."""
+        node_to_worker = node_to_worker or {}
+        nodes = self.provider.non_terminated_nodes()
+        counts = self._counts_by_type(nodes)
+
+        # 1. Enforce min_workers per type.
+        for cfg in self.node_types.values():
+            short = cfg.min_workers - counts.get(cfg.name, 0)
+            if short > 0:
+                self._launch(cfg.name, short)
+                counts[cfg.name] = counts.get(cfg.name, 0) + short
+
+        # 2. Launch for unmet pending demands. Nodes we launched that
+        # haven't registered a runtime worker yet count as in-flight
+        # capacity so a startup-lag window doesn't multiply launches.
+        lm = self.load_metrics
+        node_available = [n.available for n in lm.nodes.values()]
+        registered = set(lm.nodes)
+        pending_launches: Dict[str, int] = {}
+        for nid in nodes:
+            wid = node_to_worker.get(nid)
+            if wid is None and hasattr(self.provider, "worker_id_of"):
+                wid = self.provider.worker_id_of(nid)
+            if wid is not None and wid in registered:
+                continue
+            ntype = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "?")
+            pending_launches[ntype] = pending_launches.get(ntype, 0) + 1
+        # In-flight nodes are already inside `counts`; the scheduler
+        # adds their full capacity as free space and counts them toward
+        # max_workers, so drop them from the existing tally.
+        counts_registered = dict(counts)
+        for ntype, cnt in pending_launches.items():
+            counts_registered[ntype] = \
+                max(0, counts_registered.get(ntype, 0) - cnt)
+        to_launch = get_nodes_to_launch(
+            self.node_types, counts_registered, node_available,
+            lm.pending_demands, self.max_workers,
+            pending_launches=pending_launches)
+        infeasible = get_infeasible_demands(
+            self.node_types, lm.pending_demands)
+        if infeasible and infeasible != self.infeasible_demands:
+            logger.warning("Autoscaler: infeasible demands %s",
+                           infeasible)
+        self.infeasible_demands = infeasible
+        # upscaling_speed bounds launches per round to
+        # ceil(speed * max(current, 1)) per type, like the reference.
+        for ntype, cnt in to_launch.items():
+            cap = int(math.ceil(
+                self.upscaling_speed * max(counts.get(ntype, 0), 1)))
+            self._launch(ntype, min(cnt, max(cap, 1)))
+
+        # 3. Terminate idle nodes beyond min_workers.
+        nodes = self.provider.non_terminated_nodes()
+        counts = self._counts_by_type(nodes)
+        for nid in nodes:
+            ntype = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "?")
+            cfg = self.node_types.get(ntype)
+            if cfg and counts.get(ntype, 0) <= cfg.min_workers:
+                continue
+            wid = node_to_worker.get(nid)
+            if wid is None and hasattr(self.provider, "worker_id_of"):
+                wid = self.provider.worker_id_of(nid)
+            if wid is None or wid not in lm.nodes:
+                continue   # not yet registered: treat as starting up
+            if lm.nodes[wid].busy:
+                continue
+            if lm.idle_seconds(wid) >= self.idle_timeout_s:
+                self.provider.terminate_node(nid)
+                self.num_terminations += 1
+                counts[ntype] = counts.get(ntype, 0) - 1
+                logger.info("Autoscaler: terminated idle node %s", nid)
+
+    def summary(self) -> Dict:
+        nodes = self.provider.non_terminated_nodes()
+        return {
+            "nodes_by_type": self._counts_by_type(nodes),
+            "num_launches": self.num_launches,
+            "num_terminations": self.num_terminations,
+            "infeasible_demands": list(self.infeasible_demands),
+            "load": self.load_metrics.summary(),
+        }

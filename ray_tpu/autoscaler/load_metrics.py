@@ -1,0 +1,79 @@
+"""LoadMetrics: the autoscaler's view of cluster load.
+
+Capability parity with the reference's LoadMetrics
+(python/ray/autoscaler/_private/load_metrics.py:62): per-node static and
+available resources, pending resource demands, and last-active
+timestamps used for idle-node termination.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+
+class NodeLoad:
+    def __init__(self, worker_id: str, resources: Dict[str, float],
+                 available: Dict[str, float], busy: bool):
+        self.worker_id = worker_id
+        self.resources = resources
+        self.available = available
+        self.busy = busy
+
+
+class LoadMetrics:
+    def __init__(self):
+        self.pending_demands: List[Dict[str, float]] = []
+        self.nodes: Dict[str, NodeLoad] = {}
+        self.last_active_at: Dict[str, float] = {}
+        self.last_updated = 0.0
+
+    def update(self, snapshot: Dict) -> None:
+        """Ingest a HeadService.load_metrics_snapshot() payload."""
+        now = time.time()
+        self.pending_demands = list(snapshot.get("pending_demands", []))
+        self.nodes = {}
+        for n in snapshot.get("nodes", []):
+            if not n.get("alive", False):
+                continue
+            # Busy if running work, hosting actors, or holding any
+            # resource reservation (e.g. placement-group bundles, which
+            # consume availability without a task or actor attached).
+            reserved = any(
+                n["available"].get(k, 0.0) + 1e-9 < v
+                for k, v in n["resources"].items())
+            busy = (n.get("num_running_tasks", 0) > 0 or
+                    n.get("num_actors", 0) > 0 or reserved)
+            wid = n["worker_id"]
+            self.nodes[wid] = NodeLoad(wid, dict(n["resources"]),
+                                       dict(n["available"]), busy)
+            if busy or wid not in self.last_active_at:
+                self.last_active_at[wid] = now
+        # Forget departed nodes.
+        for wid in list(self.last_active_at):
+            if wid not in self.nodes:
+                del self.last_active_at[wid]
+        self.last_updated = now
+
+    def idle_seconds(self, worker_id: str) -> float:
+        ts = self.last_active_at.get(worker_id)
+        if ts is None:
+            return 0.0
+        return time.time() - ts
+
+    def summary(self) -> Dict:
+        return {
+            "num_nodes": len(self.nodes),
+            "num_pending_demands": len(self.pending_demands),
+            "cluster_resources": _merge(
+                [n.resources for n in self.nodes.values()]),
+            "available_resources": _merge(
+                [n.available for n in self.nodes.values()]),
+        }
+
+
+def _merge(dicts: List[Dict[str, float]]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for d in dicts:
+        for k, v in d.items():
+            out[k] = out.get(k, 0.0) + v
+    return out

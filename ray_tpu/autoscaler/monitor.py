@@ -1,0 +1,93 @@
+"""Autoscaler monitor: the polling loop that drives StandardAutoscaler.
+
+Capability parity with the reference's monitor process
+(python/ray/autoscaler/_private/monitor.py:125), run here as a thread
+against a live HeadService, plus ``AutoscalingCluster`` — the e2e test
+vehicle equivalent to ray.cluster_utils.AutoscalingCluster
+(python/ray/cluster_utils.py:24) with processes as fake nodes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.load_metrics import LoadMetrics
+from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider
+
+
+class Monitor:
+    def __init__(self, head_service, autoscaler: StandardAutoscaler,
+                 update_interval_s: float = 0.25):
+        self._head = head_service
+        self._autoscaler = autoscaler
+        self._interval = update_interval_s
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="autoscaler-monitor")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stopped.set()
+        self._thread.join(timeout=5)
+
+    def _run(self):
+        while not self._stopped.is_set():
+            try:
+                snapshot = self._head.load_metrics_snapshot()
+                self._autoscaler.load_metrics.update(snapshot)
+                self._autoscaler.update()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+            self._stopped.wait(self._interval)
+
+
+class AutoscalingCluster:
+    """A Cluster that starts empty and scales via the autoscaler."""
+
+    def __init__(self, config: Dict,
+                 store_capacity: int = 256 * 1024 * 1024,
+                 update_interval_s: float = 0.25):
+        from ray_tpu.runtime.cluster_utils import Cluster
+        self.cluster = Cluster(num_workers=0,
+                               store_capacity=store_capacity,
+                               connect=False)
+        self.provider = FakeMultiNodeProvider(self.cluster.node)
+        self.autoscaler = StandardAutoscaler(
+            config, self.provider, LoadMetrics())
+        self.monitor = Monitor(self.cluster.node.head_service,
+                               self.autoscaler,
+                               update_interval_s).start()
+
+    @property
+    def runtime(self):
+        return self.cluster.runtime
+
+    def connect(self):
+        return self.cluster.connect()
+
+    def num_nodes(self) -> int:
+        return len(self.provider.non_terminated_nodes())
+
+    def wait_for_nodes(self, n: int, timeout: float = 30) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.num_nodes() >= n:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def shutdown(self):
+        self.monitor.stop()
+        self.cluster.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

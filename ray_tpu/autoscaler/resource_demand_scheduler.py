@@ -1,0 +1,115 @@
+"""Bin-packing resource demand scheduler.
+
+Capability parity with the reference's ResourceDemandScheduler
+(python/ray/autoscaler/_private/resource_demand_scheduler.py:46,141):
+given pending resource demands and the current node fleet, decide which
+node types to launch. TPU-first: node types whose resources include
+``TPU`` represent whole ICI slices, so the packing naturally scales by
+slices rather than individual chips.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class NodeTypeConfig:
+    def __init__(self, name: str, resources: Dict[str, float],
+                 min_workers: int = 0, max_workers: int = 2**31):
+        self.name = name
+        self.resources = dict(resources)
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+
+    @classmethod
+    def from_config(cls, name: str, cfg: Dict) -> "NodeTypeConfig":
+        return cls(name, cfg.get("resources", {}),
+                   cfg.get("min_workers", 0),
+                   cfg.get("max_workers", 2**31))
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+
+def _subtract(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def get_nodes_to_launch(
+    node_types: Dict[str, NodeTypeConfig],
+    existing_counts: Dict[str, int],
+    node_available: List[Dict[str, float]],
+    pending_demands: List[Dict[str, float]],
+    max_workers: int,
+    pending_launches: Optional[Dict[str, int]] = None,
+) -> Dict[str, int]:
+    """First-fit-decreasing packing of demands onto free space, then onto
+    planned launches, then onto new nodes (smallest feasible type).
+
+    Returns {node_type: count} to launch. ``node_available`` is the free
+    resources of each live node; ``pending_launches`` are launches already
+    in flight (their full capacity counts as free space).
+    """
+    pending_launches = dict(pending_launches or {})
+    total_nodes = sum(existing_counts.values()) + \
+        sum(pending_launches.values())
+    # Free space: live nodes' available + in-flight launches' capacity.
+    space: List[Dict[str, float]] = [dict(a) for a in node_available]
+    for ntype, cnt in pending_launches.items():
+        cfg = node_types.get(ntype)
+        if cfg:
+            space.extend(dict(cfg.resources) for _ in range(cnt))
+
+    to_launch: Dict[str, int] = {}
+    demands = sorted(pending_demands,
+                     key=lambda d: (-len(d), -sum(d.values())))
+    for demand in demands:
+        if not demand:
+            continue
+        placed = False
+        for avail in space:
+            if _fits(avail, demand):
+                _subtract(avail, demand)
+                placed = True
+                break
+        if placed:
+            continue
+        if total_nodes >= max_workers:
+            continue
+        # Pick the smallest feasible node type (fewest total resources
+        # that still fit the demand), respecting per-type max_workers.
+        best: Optional[NodeTypeConfig] = None
+        for cfg in node_types.values():
+            launched = existing_counts.get(cfg.name, 0) + \
+                pending_launches.get(cfg.name, 0) + \
+                to_launch.get(cfg.name, 0)
+            if launched >= cfg.max_workers:
+                continue
+            if not _fits(cfg.resources, demand):
+                continue
+            if best is None or \
+                    sum(cfg.resources.values()) < \
+                    sum(best.resources.values()):
+                best = cfg
+        if best is None:
+            continue   # infeasible demand: report, never launch
+        to_launch[best.name] = to_launch.get(best.name, 0) + 1
+        total_nodes += 1
+        avail = dict(best.resources)
+        _subtract(avail, demand)
+        space.append(avail)
+    return to_launch
+
+
+def get_infeasible_demands(
+    node_types: Dict[str, NodeTypeConfig],
+    pending_demands: List[Dict[str, float]],
+) -> List[Dict[str, float]]:
+    """Demands no configured node type could ever satisfy."""
+    out = []
+    for demand in pending_demands:
+        if demand and not any(_fits(cfg.resources, demand)
+                              for cfg in node_types.values()):
+            out.append(demand)
+    return out

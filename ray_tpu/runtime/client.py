@@ -234,6 +234,15 @@ def create_pg_via_head(head: RpcClient, spec: PlacementGroupSpec):
 # Driver runtime
 # --------------------------------------------------------------------------
 
+def connect_to_cluster(address: str) -> "DistributedRuntime":
+    """Attach this process as a driver to a running head by address
+    (the Ray Client analogue, python/ray/util/client/ — same-protocol
+    attach rather than a gRPC proxy; requires same-host shm access)."""
+    head = RpcClient(address, timeout=10)
+    info = head.call("cluster_info")
+    return DistributedRuntime(address, info["store_name"])
+
+
 class DistributedRuntime:
     """Runtime interface backed by the head + node workers + shm store."""
 

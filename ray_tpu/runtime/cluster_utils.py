@@ -36,6 +36,8 @@ class Cluster:
         from ray_tpu._private.object_ref import \
             set_global_reference_counter
         if worker_mod.is_initialized():
+            if worker_mod._worker.runtime is self.runtime:
+                return self.runtime   # already connected: no-op
             worker_mod.shutdown()
         worker_mod._worker = worker_mod.Worker(self.runtime,
                                                mode="driver")

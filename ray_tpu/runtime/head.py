@@ -67,6 +67,11 @@ class HeadService:
         self._pending: collections.deque = collections.deque()
         self._task_meta: Dict[str, Dict[str, Any]] = {}
         self._pgs: Dict[str, Dict[str, Any]] = {}
+        # Demands not in the task queue but still unmet — blocked actor
+        # creations and unplaceable placement groups — so the autoscaler
+        # sees them (reference: resource load includes actor/PG shapes).
+        self._pending_actor_demands: Dict[str, Dict[str, float]] = {}
+        self._failed_pg_demands: Dict[str, Any] = {}   # pg_id -> (bundles, ts)
         self._store = None
         self._shutdown = False
         self._sched_cv = threading.Condition(self._lock)
@@ -316,11 +321,17 @@ class HeadService:
                     w = self._pick_worker_locked(
                         meta.get("resources", {}), None)
                     if w is None:
+                        # Surface the blocked demand to the autoscaler.
+                        self._pending_actor_demands[actor_id] = dict(
+                            meta.get("resources", {}))
                         if time.time() > deadline:
+                            self._pending_actor_demands.pop(actor_id,
+                                                            None)
                             raise TimeoutError(
                                 f"No worker fits actor resources "
                                 f"{meta.get('resources')}")
                         self._sched_cv.wait(timeout=0.1)
+                self._pending_actor_demands.pop(actor_id, None)
                 for k, v in meta.get("resources", {}).items():
                     w.available[k] = w.available.get(k, 0.0) - v
                 info = _ActorInfo(actor_id, w.worker_id, payload,
@@ -446,6 +457,44 @@ class HeadService:
                      "name": a.name or "", "restarts": a.restarts}
                     for a in self._actors.values()]
 
+    # ---- autoscaler feed ---------------------------------------------------
+
+    def load_metrics_snapshot(self) -> Dict[str, Any]:
+        """Demand + usage view consumed by the autoscaler monitor
+        (reference: LoadMetrics fed by raylet resource reports,
+        python/ray/autoscaler/_private/load_metrics.py:62)."""
+        with self._lock:
+            pending: List[Dict[str, float]] = []
+            for task_id in self._pending:
+                meta = self._task_meta.get(task_id)
+                if meta is not None:
+                    pending.append(dict(meta.get("resources", {})))
+            pending.extend(dict(d) for d in
+                           self._pending_actor_demands.values())
+            now = time.time()
+            for pg_id in list(self._failed_pg_demands):
+                bundles, ts = self._failed_pg_demands[pg_id]
+                if now - ts > 5.0 or pg_id in self._pgs:
+                    del self._failed_pg_demands[pg_id]
+                else:
+                    pending.extend(dict(b) for b in bundles)
+            actors_per_worker: Dict[str, int] = {}
+            for a in self._actors.values():
+                if not a.dead and a.worker_id:
+                    actors_per_worker[a.worker_id] = \
+                        actors_per_worker.get(a.worker_id, 0) + 1
+            nodes = []
+            for w in self._workers.values():
+                nodes.append({
+                    "worker_id": w.worker_id,
+                    "alive": w.alive,
+                    "resources": dict(w.resources),
+                    "available": dict(w.available),
+                    "num_running_tasks": len(w.running),
+                    "num_actors": actors_per_worker.get(w.worker_id, 0),
+                })
+            return {"pending_demands": pending, "nodes": nodes}
+
     # ---- placement groups -------------------------------------------------
 
     def create_placement_group(self, pg_id: str,
@@ -479,7 +528,10 @@ class HeadService:
                     w = self._workers[wid]
                     for k, v in b.items():
                         w.available[k] = w.available.get(k, 0.0) + v
+                self._failed_pg_demands[pg_id] = (
+                    [dict(b) for b in bundles], time.time())
                 return False
+            self._failed_pg_demands.pop(pg_id, None)
             self._pgs[pg_id] = {
                 "ready": True,
                 "workers": [wid for wid, _ in reserved],
@@ -506,6 +558,12 @@ class HeadService:
 
     def ping(self) -> str:
         return "pong"
+
+    def cluster_info(self) -> Dict[str, Any]:
+        """Bootstrap info for drivers attaching by address (the Ray
+        Client analogue, python/ray/util/client/ — here the driver talks
+        the same protocol as workers instead of a proxied one)."""
+        return {"store_name": self.store_name}
 
     def shutdown(self):
         self._shutdown = True

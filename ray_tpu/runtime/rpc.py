@@ -152,8 +152,9 @@ class RpcClient:
         sock = None
         try:
             sock = self._get_conn()
-            if timeout is not None:
-                sock.settimeout(timeout)
+            # Always (re)set: pooled sockets keep the previous call's
+            # timeout otherwise. Fall back to the client-level default.
+            sock.settimeout(self.timeout if timeout is None else timeout)
             _send_msg(sock, {"rid": rid, "method": method,
                              "args": args, "kwargs": kwargs})
             reply = _recv_msg(sock)
