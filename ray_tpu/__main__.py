@@ -1,0 +1,5 @@
+"""`python -m ray_tpu` → the CLI (reference: the `ray` console script,
+python/ray/scripts/scripts.py)."""
+from ray_tpu.scripts.cli import main
+
+main()

@@ -165,8 +165,10 @@ class _ActorState:
     def _instantiate(self):
         try:
             profiling.record("actor_init", self.spec.cls.__name__)
-            self.instance = self.spec.cls(*self.spec.args,
-                                          **self.spec.kwargs)
+            from ray_tpu._private.runtime_env import runtime_env_context
+            with runtime_env_context(self.spec.runtime_env):
+                self.instance = self.spec.cls(*self.spec.args,
+                                              **self.spec.kwargs)
             self.init_error = None
         except BaseException as e:  # noqa: BLE001
             self.init_error = e
@@ -487,7 +489,9 @@ class LocalRuntime:
             args, kwargs = self._resolve_args(spec)
             if spec.task_id in self._cancelled:
                 raise TaskCancelledError(spec.task_id)
-            result = spec.func(*args, **kwargs)
+            from ray_tpu._private.runtime_env import runtime_env_context
+            with runtime_env_context(spec.runtime_env):
+                result = spec.func(*args, **kwargs)
             self._store_returns(spec, result)
             self._task_states[spec.task_id] = "FINISHED"
         except TaskCancelledError as e:
@@ -693,7 +697,9 @@ class LocalRuntime:
                 raise ActorDiedError(st.spec.actor_id, st.death_reason)
             args, kwargs = self._resolve_args(spec)
             method = getattr(st.instance, spec.method_name)
-            result = method(*args, **kwargs)
+            from ray_tpu._private.runtime_env import runtime_env_context
+            with runtime_env_context(st.spec.runtime_env):
+                result = method(*args, **kwargs)
             self._store_returns(spec, result)
             self._task_states[spec.task_id] = "FINISHED"
         except BaseException as e:  # noqa: BLE001
@@ -710,9 +716,11 @@ class LocalRuntime:
                 raise ActorDiedError(st.spec.actor_id, st.death_reason)
             args, kwargs = self._resolve_args(spec)
             method = getattr(st.instance, spec.method_name)
-            result = method(*args, **kwargs)
-            if inspect.isawaitable(result):
-                result = await result
+            from ray_tpu._private.runtime_env import runtime_env_context
+            with runtime_env_context(st.spec.runtime_env):
+                result = method(*args, **kwargs)
+                if inspect.isawaitable(result):
+                    result = await result
             self._store_returns(spec, result)
             self._task_states[spec.task_id] = "FINISHED"
         except BaseException as e:  # noqa: BLE001

@@ -1,0 +1,127 @@
+"""Runtime environments: per-task/actor execution environments.
+
+Capability parity with the reference's runtime_env subsystem
+(python/ray/_private/runtime_env/{working_dir,py_modules,plugin}.py and
+the per-node agent dashboard/modules/runtime_env/runtime_env_agent.py:159):
+``env_vars``, ``working_dir`` and ``py_modules`` are supported. The
+reference isolates runtime envs by starting dedicated worker processes
+keyed by the env (worker_pool.h:149); here the env is applied around each
+execution under a process-wide lock — same observable semantics for
+tasks, serialized only among tasks that carry a runtime_env. Zipped
+``working_dir`` archives are staged into a URI-keyed cache the way the
+agent caches working-dir URIs.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import sys
+import threading
+import zipfile
+from typing import Any, Dict, Optional
+
+_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules"}
+
+# cwd / os.environ / sys.path are process-global: only one task with a
+# runtime_env mutates them at a time.
+_apply_lock = threading.RLock()
+
+_CACHE_DIR = os.path.join("/tmp", "ray_tpu", "runtime_env_cache")
+
+
+def validate_runtime_env(runtime_env: Optional[Dict[str, Any]]
+                         ) -> Optional[Dict[str, Any]]:
+    if runtime_env is None:
+        return None
+    if not isinstance(runtime_env, dict):
+        raise TypeError("runtime_env must be a dict, got "
+                        f"{type(runtime_env).__name__}")
+    unknown = set(runtime_env) - _KNOWN_KEYS
+    if unknown:
+        raise ValueError(
+            f"Unsupported runtime_env keys {sorted(unknown)}; "
+            f"supported: {sorted(_KNOWN_KEYS)}")
+    env_vars = runtime_env.get("env_vars")
+    if env_vars is not None:
+        if not isinstance(env_vars, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in env_vars.items()):
+            raise TypeError("runtime_env['env_vars'] must be a "
+                            "Dict[str, str]")
+    wd = runtime_env.get("working_dir")
+    if wd is not None and not isinstance(wd, str):
+        raise TypeError("runtime_env['working_dir'] must be a path str")
+    mods = runtime_env.get("py_modules")
+    if mods is not None and not isinstance(mods, (list, tuple)):
+        raise TypeError("runtime_env['py_modules'] must be a list")
+    return dict(runtime_env)
+
+
+def _stage_working_dir(path: str) -> str:
+    """Resolve a working_dir to a directory; .zip archives extract into
+    a content-addressed cache (the URI-cache analogue)."""
+    if not path.endswith(".zip"):
+        if not os.path.isdir(path):
+            raise FileNotFoundError(
+                f"runtime_env working_dir {path!r} does not exist")
+        return path
+    with open(path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    target = os.path.join(_CACHE_DIR, digest)
+    if not os.path.isdir(target):
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        tmp = target + ".tmp"
+        with zipfile.ZipFile(path) as zf:
+            zf.extractall(tmp)
+        try:
+            os.rename(tmp, target)
+        except OSError:
+            pass   # concurrent extraction won the race
+    return target
+
+
+@contextlib.contextmanager
+def runtime_env_context(runtime_env: Optional[Dict[str, Any]]):
+    """Apply a runtime_env around an execution, restoring afterwards."""
+    if not runtime_env:
+        yield
+        return
+    with _apply_lock:
+        saved_env: Dict[str, Optional[str]] = {}
+        saved_cwd = None
+        added_paths = []
+        try:
+            for k, v in (runtime_env.get("env_vars") or {}).items():
+                saved_env[k] = os.environ.get(k)
+                os.environ[k] = v
+            wd = runtime_env.get("working_dir")
+            if wd:
+                staged = _stage_working_dir(wd)
+                saved_cwd = os.getcwd()
+                os.chdir(staged)
+                if staged not in sys.path:
+                    sys.path.insert(0, staged)
+                    added_paths.append(staged)
+            for mod in (runtime_env.get("py_modules") or []):
+                mod = os.path.abspath(mod)
+                if mod not in sys.path:
+                    sys.path.insert(0, mod)
+                    added_paths.append(mod)
+            yield
+        finally:
+            for p in added_paths:
+                try:
+                    sys.path.remove(p)
+                except ValueError:
+                    pass
+            if saved_cwd is not None:
+                try:
+                    os.chdir(saved_cwd)
+                except OSError:
+                    pass
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old

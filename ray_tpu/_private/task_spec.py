@@ -95,6 +95,8 @@ def validate_task_options(options: Dict[str, Any]) -> Dict[str, Any]:
     for res in ("num_cpus", "num_tpus"):
         if out[res] is not None and out[res] < 0:
             raise ValueError(f"{res} must be >= 0")
+    from ray_tpu._private.runtime_env import validate_runtime_env
+    out["runtime_env"] = validate_runtime_env(out["runtime_env"])
     return out
 
 
@@ -108,6 +110,8 @@ def validate_actor_options(options: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError("max_restarts must be >= -1 (-1 = infinite)")
     if out["lifetime"] not in (None, "detached", "non_detached"):
         raise ValueError("lifetime must be None or 'detached'")
+    from ray_tpu._private.runtime_env import validate_runtime_env
+    out["runtime_env"] = validate_runtime_env(out["runtime_env"])
     return out
 
 

@@ -1,0 +1,13 @@
+"""Job submission: run driver scripts against a cluster.
+
+Capability parity with the reference's job submission stack
+(dashboard/modules/job/ — JobSubmissionClient.submit_job sdk.py:34,83,
+server-side job_manager.py supervising the entrypoint process): jobs are
+entrypoint commands spawned by the head with the cluster address in
+their environment, tracked through a PENDING/RUNNING/SUCCEEDED/FAILED/
+STOPPED lifecycle with captured logs.
+"""
+from ray_tpu.job.manager import JobInfo, JobManager, JobStatus
+from ray_tpu.job.sdk import JobSubmissionClient
+
+__all__ = ["JobManager", "JobInfo", "JobStatus", "JobSubmissionClient"]

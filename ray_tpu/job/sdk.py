@@ -1,0 +1,51 @@
+"""Client SDK for job submission.
+
+Reference: dashboard/modules/job/sdk.py:34,83 (JobSubmissionClient) —
+REST there, head RPC here; identical surface: submit/stop/status/logs/
+list + wait helper.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.job.manager import JobStatus
+from ray_tpu.runtime.rpc import RpcClient
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        self._client = RpcClient(address, timeout=30)
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        return self._client.call("submit_job", entrypoint,
+                                 submission_id, runtime_env, metadata)
+
+    def stop_job(self, job_id: str) -> bool:
+        return self._client.call("stop_job", job_id)
+
+    def get_job_status(self, job_id: str) -> str:
+        return self._client.call("get_job_status", job_id)
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        return self._client.call("get_job_info", job_id)
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._client.call("get_job_logs", job_id)
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return self._client.call("list_jobs")
+
+    def wait_until_finished(self, job_id: str,
+                            timeout: float = 300) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            status = self.get_job_status(job_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"Job {job_id} not finished within {timeout}s")

@@ -118,6 +118,7 @@ def submit_task_via_head(head: RpcClient, spec: TaskSpec):
         "num_returns": spec.num_returns,
         "return_ids": [oid.binary() for oid in spec.return_ids],
         "resources": spec.resources,
+        "runtime_env": spec.runtime_env,
     })
     meta = {
         "task_id": spec.task_id.hex(),
@@ -136,6 +137,7 @@ def create_actor_via_head(head: RpcClient, spec: ActorCreationSpec):
         "args": spec.args,
         "kwargs": spec.kwargs,
         "max_concurrency": spec.max_concurrency,
+        "runtime_env": spec.runtime_env,
     })
     meta = {
         "actor_id": spec.actor_id.hex(),
@@ -326,9 +328,12 @@ class DistributedRuntime:
         return self.head.call("list_workers")
 
     def shutdown(self):
+        if self.node_manager is None:
+            # Attached driver (connect_to_cluster): disconnecting must
+            # not take the shared cluster down with it.
+            return
         try:
             self.head.call("shutdown", timeout=5)
         except Exception:
             pass
-        if self.node_manager is not None:
-            self.node_manager.stop()
+        self.node_manager.stop()

@@ -556,6 +556,55 @@ class HeadService:
 
     # ---- lifecycle --------------------------------------------------------
 
+    # ---- jobs / node-manager services -------------------------------------
+
+    def attach_node_manager(self, node_manager, address: str):
+        """Called by NodeManager once the RPC server is bound."""
+        self._node_manager = node_manager
+        self._address = address
+
+    def _job_manager(self):
+        jm = getattr(self, "_jm", None)
+        if jm is None:
+            from ray_tpu.job.manager import JobManager
+            jm = self._jm = JobManager(
+                getattr(self, "_address", ""))
+        return jm
+
+    def submit_job(self, entrypoint, submission_id=None,
+                   runtime_env=None, metadata=None) -> str:
+        return self._job_manager().submit_job(
+            entrypoint, submission_id=submission_id,
+            runtime_env=runtime_env, metadata=metadata)
+
+    def stop_job(self, job_id: str) -> bool:
+        return self._job_manager().stop_job(job_id)
+
+    def get_job_status(self, job_id: str) -> str:
+        return self._job_manager().get_job_status(job_id)
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        return self._job_manager().get_job_info(job_id)
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._job_manager().get_job_logs(job_id)
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return self._job_manager().list_jobs()
+
+    def request_worker(self, resources: Optional[Dict[str, float]] = None
+                       ) -> str:
+        """Start another worker process on the head's node (CLI
+        ``ray-tpu start --address`` analogue for one-machine clusters)."""
+        nm = getattr(self, "_node_manager", None)
+        if nm is None:
+            raise RuntimeError("No node manager attached to this head")
+        return nm.start_worker(len(nm.procs), resources)
+
+    def store_stats(self) -> Dict[str, Any]:
+        store = self._get_store()
+        return store.stats()
+
     def ping(self) -> str:
         return "pong"
 
@@ -567,6 +616,9 @@ class HeadService:
 
     def shutdown(self):
         self._shutdown = True
+        jm = getattr(self, "_jm", None)
+        if jm is not None:
+            jm.shutdown()
         with self._lock:
             workers = list(self._workers.values())
         for w in workers:

@@ -36,6 +36,8 @@ class NodeManager:
                                            store_capacity)
         self.head_service = HeadService(self.store_name)
         self.head_server = RpcServer(self.head_service)
+        self.head_service.attach_node_manager(
+            self, self.head_server.address)
         self.procs: Dict[str, subprocess.Popen] = {}
         self.tpu_owner_worker = tpu_owner_worker
         self._stopped = False

@@ -37,6 +37,7 @@ class _ActorSlot:
         self.error = error
         self.mailbox: "queue.Queue" = queue.Queue()
         self.thread: Optional[threading.Thread] = None
+        self.runtime_env = None
 
 
 class Executor:
@@ -99,7 +100,9 @@ class Executor:
             args = [self._resolve(a) for a in spec["args"]]
             kwargs = {k: self._resolve(v)
                       for k, v in spec["kwargs"].items()}
-            result = func(*args, **kwargs)
+            from ray_tpu._private.runtime_env import runtime_env_context
+            with runtime_env_context(spec.get("runtime_env")):
+                result = func(*args, **kwargs)
             self._write_returns(spec["return_ids"],
                                 spec["num_returns"], result)
             return "ok"
@@ -119,7 +122,10 @@ class Executor:
         slot = _ActorSlot()
         try:
             cls = spec["cls"]
-            slot.instance = cls(*spec["args"], **spec["kwargs"])
+            from ray_tpu._private.runtime_env import runtime_env_context
+            slot.runtime_env = spec.get("runtime_env")
+            with runtime_env_context(slot.runtime_env):
+                slot.instance = cls(*spec["args"], **spec["kwargs"])
         except BaseException as e:  # noqa: BLE001
             slot.error = e
         with self._lock:
@@ -144,7 +150,10 @@ class Executor:
                 args = [self._resolve(a) for a in spec["args"]]
                 kwargs = {k: self._resolve(v)
                           for k, v in spec["kwargs"].items()}
-                result = method(*args, **kwargs)
+                from ray_tpu._private.runtime_env import \
+                    runtime_env_context
+                with runtime_env_context(slot.runtime_env):
+                    result = method(*args, **kwargs)
                 self._write_returns(spec["return_ids"],
                                     spec["num_returns"], result)
             except BaseException as e:  # noqa: BLE001

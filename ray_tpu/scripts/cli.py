@@ -1,0 +1,216 @@
+"""CLI: `python -m ray_tpu <command>`.
+
+Capability parity with the reference CLI (python/ray/scripts/scripts.py,
+click group :61 — `ray start/stop/status/submit/timeline/memory` plus the
+state CLI `ray list ...`, experimental/state/state_cli.py), over the head
+RPC protocol instead of GCS.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import click
+
+from ray_tpu.scripts.head_daemon import address_file_path
+
+
+def _resolve_address(address):
+    if address:
+        return address
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    path = address_file_path()
+    if os.path.exists(path):
+        with open(path) as f:
+            return f.read().strip()
+    raise click.ClickException(
+        "No running cluster found: pass --address, set RAY_TPU_ADDRESS, "
+        "or run `ray-tpu start --head` first.")
+
+
+def _head_client(address):
+    from ray_tpu.runtime.rpc import RpcClient
+    return RpcClient(_resolve_address(address), timeout=30)
+
+
+@click.group()
+def cli():
+    """TPU-native distributed runtime CLI."""
+
+
+@cli.command()
+@click.option("--head", is_flag=True, help="Start a head node here.")
+@click.option("--address", default=None,
+              help="Join an existing head (starts one more worker).")
+@click.option("--num-workers", default=2, show_default=True)
+@click.option("--resources", default='{"CPU": 2}', show_default=True,
+              help="Per-worker resources as JSON.")
+@click.option("--store-capacity", default=256 * 1024 * 1024,
+              show_default=True)
+@click.option("--block", is_flag=True,
+              help="Run the head in the foreground.")
+def start(head, address, num_workers, resources, store_capacity, block):
+    """Start a head daemon or add a worker to a running head."""
+    if head and address:
+        raise click.ClickException("--head and --address are exclusive")
+    if not head and not address and not os.path.exists(
+            address_file_path()):
+        raise click.ClickException("Pass --head to start a new cluster")
+    if head:
+        cmd = [sys.executable, "-m", "ray_tpu.scripts.head_daemon",
+               "--num-workers", str(num_workers),
+               "--resources", resources,
+               "--store-capacity", str(store_capacity)]
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        if block:
+            os.execve(sys.executable, [sys.executable] + cmd[1:], env)
+        proc = subprocess.Popen(cmd, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True, text=True)
+        deadline = time.time() + 60
+        addr = None
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("RAY_TPU_HEAD_ADDRESS="):
+                addr = line.strip().split("=", 1)[1]
+                break
+            if proc.poll() is not None:
+                raise click.ClickException(
+                    f"Head daemon exited: {line}")
+        if addr is None:
+            proc.terminate()
+            raise click.ClickException("Head daemon did not report an "
+                                       "address within 60s")
+        click.echo(f"Started head at {addr} (pid {proc.pid}).")
+        click.echo(f"Connect with ray_tpu.init(address={addr!r}) or "
+                   f"RAY_TPU_ADDRESS={addr}")
+    else:
+        client = _head_client(address)
+        wid = client.call("request_worker", json.loads(resources))
+        click.echo(f"Started worker {wid}")
+
+
+@cli.command()
+@click.option("--address", default=None)
+def stop(address):
+    """Stop the running cluster."""
+    try:
+        client = _head_client(address)
+        client.call("shutdown", timeout=5)
+    except Exception:
+        pass
+    path = address_file_path()
+    if os.path.exists(path):
+        os.remove(path)
+    click.echo("Stopped.")
+
+
+@cli.command()
+@click.option("--address", default=None)
+def status(address):
+    """Cluster resources, workers, and jobs."""
+    client = _head_client(address)
+    total = client.call("cluster_resources")
+    avail = client.call("available_resources")
+    workers = client.call("list_workers")
+    click.echo("Resources:")
+    for k in sorted(total):
+        click.echo(f"  {k}: {avail.get(k, 0.0):g}/{total[k]:g} free")
+    click.echo(f"Workers ({len(workers)}):")
+    for w in workers:
+        state = "ALIVE" if w["alive"] else "DEAD"
+        click.echo(f"  {w['worker_id']}: {state} "
+                   f"{w['resources']} running={len(w['running_tasks'])}")
+    try:
+        jobs = client.call("list_jobs")
+        if jobs:
+            click.echo(f"Jobs ({len(jobs)}):")
+            for j in jobs:
+                click.echo(f"  {j['job_id']}: {j['status']} "
+                           f"({j['entrypoint']!r})")
+    except Exception:
+        pass
+
+
+@cli.command()
+@click.option("--address", default=None)
+@click.option("--working-dir", default=None)
+@click.option("--submission-id", default=None)
+@click.option("--no-wait", is_flag=True)
+@click.argument("entrypoint", nargs=-1, required=True)
+def submit(address, working_dir, submission_id, no_wait, entrypoint):
+    """Submit a job: ray-tpu submit -- python my_script.py"""
+    from ray_tpu.job import JobSubmissionClient
+    addr = _resolve_address(address)
+    client = JobSubmissionClient(addr)
+    import shlex
+    runtime_env = {"working_dir": working_dir} if working_dir else None
+    job_id = client.submit_job(entrypoint=shlex.join(entrypoint),
+                               submission_id=submission_id,
+                               runtime_env=runtime_env)
+    click.echo(f"Submitted {job_id}")
+    if no_wait:
+        return
+    status_ = client.wait_until_finished(job_id, timeout=3600)
+    click.echo(client.get_job_logs(job_id), nl=False)
+    click.echo(f"Job {job_id}: {status_}")
+    if status_ != "SUCCEEDED":
+        sys.exit(1)
+
+
+@cli.command()
+@click.option("--address", default=None)
+@click.argument("job_id")
+def logs(address, job_id):
+    """Print a job's captured output."""
+    from ray_tpu.job import JobSubmissionClient
+    client = JobSubmissionClient(_resolve_address(address))
+    click.echo(client.get_job_logs(job_id), nl=False)
+
+
+@cli.command()
+@click.option("--address", default=None)
+def memory(address):
+    """Object-store usage (reference: `ray memory`)."""
+    client = _head_client(address)
+    stats = client.call("store_stats")
+    click.echo(json.dumps(stats, indent=2))
+
+
+@cli.command("list")
+@click.option("--address", default=None)
+@click.argument("kind",
+                type=click.Choice(["actors", "workers", "jobs"]))
+def list_cmd(address, kind):
+    """State listing (reference: `ray list actors` state CLI)."""
+    client = _head_client(address)
+    rows = client.call({"actors": "list_actors",
+                        "workers": "list_workers",
+                        "jobs": "list_jobs"}[kind])
+    click.echo(json.dumps(rows, indent=2, default=str))
+
+
+@cli.command()
+@click.option("--output", "-o", default="timeline.json",
+              show_default=True)
+def timeline(output):
+    """Export the local profile timeline as a Chrome trace
+    (reference: `ray timeline`)."""
+    import ray_tpu
+    path = ray_tpu.timeline(output)
+    click.echo(f"Wrote {path}")
+
+
+def main():
+    cli()
+
+
+if __name__ == "__main__":
+    main()

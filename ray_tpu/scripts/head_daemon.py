@@ -1,0 +1,58 @@
+"""Head daemon: `python -m ray_tpu.scripts.head_daemon` — the process
+behind `ray-tpu start --head` (reference: services.py start_gcs_server /
+start_raylet spawning the native daemons; here the head + node manager
+live in one process)."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def address_file_path() -> str:
+    return os.path.join("/tmp", "ray_tpu", "head_address")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument("--resources", default='{"CPU": 2}')
+    parser.add_argument("--store-capacity", type=int,
+                        default=256 * 1024 * 1024)
+    args = parser.parse_args()
+
+    from ray_tpu.runtime.node import NodeManager
+    nm = NodeManager(num_workers=args.num_workers,
+                     resources_per_worker=json.loads(args.resources),
+                     store_capacity=args.store_capacity)
+    nm.wait_for_workers(args.num_workers)
+    path = address_file_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(nm.head_address)
+    # stdout line parsed by the CLI parent.
+    print(f"RAY_TPU_HEAD_ADDRESS={nm.head_address}", flush=True)
+
+    stop = {"flag": False}
+
+    def _sig(_s, _f):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        nm.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Job submission + CLI tests (reference analogues:
+dashboard/modules/job/tests/test_job_manager.py, test_sdk.py, and
+python/ray/tests/test_cli.py)."""
+import sys
+import textwrap
+
+import pytest
+from click.testing import CliRunner
+
+from ray_tpu.job import JobStatus, JobSubmissionClient
+from ray_tpu.runtime import Cluster
+from ray_tpu.scripts.cli import cli
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import ray_tpu._private.worker as worker_mod
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    c = Cluster(num_workers=1, resources_per_worker={"CPU": 2},
+                connect=False)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture
+def client(cluster):
+    return JobSubmissionClient(cluster.node.head_address)
+
+
+def test_submit_and_succeed(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'print(6 * 7)'")
+    assert client.wait_until_finished(job_id, 60) == JobStatus.SUCCEEDED
+    assert "42" in client.get_job_logs(job_id)
+    info = client.get_job_info(job_id)
+    assert info["status"] == JobStatus.SUCCEEDED
+    assert info["end_time"] is not None
+
+
+def test_job_failure_reports_exit_code(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import sys; sys.exit(3)'")
+    assert client.wait_until_finished(job_id, 60) == JobStatus.FAILED
+    assert "exit code 3" in client.get_job_info(job_id)["message"]
+
+
+def test_stop_running_job(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    assert client.get_job_status(job_id) == JobStatus.RUNNING
+    assert client.stop_job(job_id)
+    assert client.wait_until_finished(job_id, 30) == JobStatus.STOPPED
+    assert not client.stop_job(job_id)   # already terminal
+
+
+def test_duplicate_submission_id_rejected(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'pass'", submission_id="dup-1")
+    client.wait_until_finished(job_id, 60)
+    with pytest.raises(Exception):
+        client.submit_job(entrypoint="true", submission_id="dup-1")
+
+
+def test_job_runs_tasks_on_cluster(client, tmp_path):
+    script = tmp_path / "driver.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, %r)
+        import ray_tpu
+        ray_tpu.init(address=os.environ["RAY_TPU_ADDRESS"])
+
+        @ray_tpu.remote
+        def cube(x):
+            return x ** 3
+
+        print("total:", sum(ray_tpu.get(
+            [cube.remote(i) for i in range(4)])))
+        ray_tpu.shutdown()
+    """ % "/root/repo"))
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} {script}")
+    assert client.wait_until_finished(job_id, 120) == \
+        JobStatus.SUCCEEDED
+    assert "total: 36" in client.get_job_logs(job_id)
+
+
+def test_env_vars_runtime_env(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c "
+                   f"'import os; print(os.environ[\"MY_FLAG\"])'",
+        runtime_env={"env_vars": {"MY_FLAG": "flag-value"}})
+    assert client.wait_until_finished(job_id, 60) == JobStatus.SUCCEEDED
+    assert "flag-value" in client.get_job_logs(job_id)
+
+
+# ---- CLI -----------------------------------------------------------------
+
+def test_cli_status_and_list(cluster):
+    addr = cluster.node.head_address
+    runner = CliRunner()
+    res = runner.invoke(cli, ["status", "--address", addr])
+    assert res.exit_code == 0, res.output
+    assert "Workers (1)" in res.output
+    res = runner.invoke(cli, ["list", "--address", addr, "workers"])
+    assert res.exit_code == 0
+    assert "worker-0" in res.output
+
+
+def test_cli_submit(cluster):
+    addr = cluster.node.head_address
+    runner = CliRunner()
+    res = runner.invoke(cli, [
+        "submit", "--address", addr, "--",
+        sys.executable, "-c", "print('cli-job-ok')"])
+    assert res.exit_code == 0, res.output
+    assert "cli-job-ok" in res.output
+    assert "SUCCEEDED" in res.output
+
+
+def test_cli_memory(cluster):
+    addr = cluster.node.head_address
+    runner = CliRunner()
+    res = runner.invoke(cli, ["memory", "--address", addr])
+    assert res.exit_code == 0, res.output
+    assert "capacity" in res.output

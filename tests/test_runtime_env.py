@@ -1,0 +1,113 @@
+"""Runtime env tests (reference analogues:
+python/ray/tests/test_runtime_env.py, test_runtime_env_env_vars.py,
+test_runtime_env_working_dir.py)."""
+import os
+import zipfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime_env import validate_runtime_env
+
+
+def test_env_vars_applied_and_restored(rt):
+    os.environ.pop("RT_ENV_TEST", None)
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_ENV_TEST": "yes"}})
+    def read_env():
+        return os.environ.get("RT_ENV_TEST")
+
+    assert ray_tpu.get(read_env.remote()) == "yes"
+    assert "RT_ENV_TEST" not in os.environ
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("RT_ENV_TEST")
+
+    assert ray_tpu.get(read_plain.remote()) is None
+
+
+def test_working_dir(rt, tmp_path):
+    (tmp_path / "data.txt").write_text("payload")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def read_file():
+        with open("data.txt") as f:
+            return f.read()
+
+    assert ray_tpu.get(read_file.remote()) == "payload"
+
+
+def test_working_dir_zip_staged_once(rt, tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "inside.txt").write_text("zipped")
+    archive = tmp_path / "wd.zip"
+    with zipfile.ZipFile(archive, "w") as zf:
+        zf.write(src / "inside.txt", "inside.txt")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(archive)})
+    def read_file():
+        with open("inside.txt") as f:
+            return f.read(), os.getcwd()
+
+    (a, cwd1) = ray_tpu.get(read_file.remote())
+    (b, cwd2) = ray_tpu.get(read_file.remote())
+    assert a == b == "zipped"
+    assert cwd1 == cwd2   # content-addressed cache reused
+
+
+def test_py_modules(rt, tmp_path):
+    mod_dir = tmp_path / "mods"
+    mod_dir.mkdir()
+    (mod_dir / "rt_env_probe_mod.py").write_text("VALUE = 123\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_module():
+        import rt_env_probe_mod
+        return rt_env_probe_mod.VALUE
+
+    assert ray_tpu.get(use_module.remote()) == 123
+
+
+def test_actor_runtime_env(rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_FLAG": "on"}})
+    class EnvActor:
+        def __init__(self):
+            self.at_init = os.environ.get("ACTOR_FLAG")
+
+        def probe(self):
+            return self.at_init, os.environ.get("ACTOR_FLAG")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.probe.remote()) == ("on", "on")
+
+
+def test_validation_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="Unsupported runtime_env"):
+        validate_runtime_env({"conda": "env"})
+    with pytest.raises(TypeError):
+        validate_runtime_env({"env_vars": {"A": 1}})
+
+
+def test_runtime_env_in_worker_process():
+    """env_vars must also apply on multiprocess workers."""
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=1,
+                 resources_per_worker={"CPU": 2}) as cluster:  # noqa: F841
+        @ray_tpu.remote(runtime_env={"env_vars": {"WRK_FLAG": "w1"}})
+        def read_env():
+            return os.environ.get("WRK_FLAG")
+
+        assert ray_tpu.get(read_env.remote()) == "w1"
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"WRK_FLAG": "w2"}})
+        class A:
+            def probe(self):
+                return os.environ.get("WRK_FLAG")
+
+        a = A.remote()
+        assert ray_tpu.get(a.probe.remote()) == "w2"
