@@ -61,6 +61,11 @@ define_flag("worker_pool_prestart", bool, True,
             "Prestart workers based on scheduling backlog.")
 define_flag("max_pending_actor_calls", int, 10000,
             "Client-side cap on in-flight calls per actor handle.")
+define_flag("memory_monitor_threshold", float, 0.0,
+            "Node memory used-fraction above which task dispatch pauses "
+            "(0 disables; analogue of memory_monitor in the raylet).")
+define_flag("memory_monitor_interval_ms", int, 250,
+            "Memory monitor poll interval.")
 define_flag("testing_delay_us_max", int, 0,
             "Chaos: max random delay injected into every runtime event "
             "handler (analogue of testing_asio_delay_us).")
@@ -149,3 +154,15 @@ class _Config:
 
 
 GlobalConfig = _Config()
+
+
+def chaos_delay():
+    """Shared chaos hook: random delay injected into runtime event
+    handlers (N22, common/asio/asio_chaos.cc analogue). Controlled by
+    the testing_delay_us_{min,max} flags."""
+    hi = GlobalConfig.testing_delay_us_max
+    if hi:
+        import random
+        import time
+        lo = GlobalConfig.testing_delay_us_min
+        time.sleep(random.uniform(lo, hi) / 1e6)

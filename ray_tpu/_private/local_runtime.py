@@ -312,6 +312,19 @@ class LocalRuntime:
         self._pgs: Dict[PlacementGroupID, PlacementGroup] = {}
         self._shutdown = False
         self._sched_cv = threading.Condition()
+        self._memory_monitor = None
+        if GlobalConfig.memory_monitor_threshold > 0:
+            from ray_tpu._private.memory_monitor import MemoryMonitor
+            self._memory_monitor = MemoryMonitor(
+                threshold=GlobalConfig.memory_monitor_threshold,
+                check_interval_s=(
+                    GlobalConfig.memory_monitor_interval_ms / 1000.0),
+                on_threshold=lambda f: logger.warning(
+                    "Memory pressure: %.0f%% used — pausing task "
+                    "dispatch (reference: raylet MemoryMonitor OOM "
+                    "prevention)", f * 100),
+                on_recovered=lambda f: self._kick_scheduler(),
+            ).start()
         self._sched_thread = threading.Thread(
             target=self._scheduler_loop, daemon=True, name="local-scheduler")
         self._sched_thread.start()
@@ -319,10 +332,8 @@ class LocalRuntime:
     # --- chaos -------------------------------------------------------------
 
     def _chaos_delay(self):
-        hi = GlobalConfig.testing_delay_us_max
-        if hi:
-            lo = GlobalConfig.testing_delay_us_min
-            time.sleep(random.uniform(lo, hi) / 1e6)
+        from ray_tpu._private.config import chaos_delay
+        chaos_delay()
 
     # --- objects -----------------------------------------------------------
 
@@ -424,6 +435,11 @@ class LocalRuntime:
         """Dispatch every queued task whose resources fit. Returns True if
         any dispatch happened."""
         any_dispatched = False
+        if self._memory_monitor is not None and \
+                self._memory_monitor.above_threshold:
+            # Above the watermark: stop starting new work until usage
+            # drops (on_recovered kicks the scheduler).
+            return False
         still_pending = collections.deque()
         while self._pending:
             spec = self._pending.popleft()
@@ -490,7 +506,9 @@ class LocalRuntime:
             if spec.task_id in self._cancelled:
                 raise TaskCancelledError(spec.task_id)
             from ray_tpu._private.runtime_env import runtime_env_context
-            with runtime_env_context(spec.runtime_env):
+            from ray_tpu.util.tracing import execution_span
+            with runtime_env_context(spec.runtime_env), \
+                    execution_span(spec.name, "task", spec.trace_ctx):
                 result = spec.func(*args, **kwargs)
             self._store_returns(spec, result)
             self._task_states[spec.task_id] = "FINISHED"
@@ -698,7 +716,10 @@ class LocalRuntime:
             args, kwargs = self._resolve_args(spec)
             method = getattr(st.instance, spec.method_name)
             from ray_tpu._private.runtime_env import runtime_env_context
-            with runtime_env_context(st.spec.runtime_env):
+            from ray_tpu.util.tracing import execution_span
+            with runtime_env_context(st.spec.runtime_env), \
+                    execution_span(spec.name, "actor_task",
+                                   spec.trace_ctx):
                 result = method(*args, **kwargs)
             self._store_returns(spec, result)
             self._task_states[spec.task_id] = "FINISHED"
@@ -872,6 +893,8 @@ class LocalRuntime:
 
     def shutdown(self):
         self._shutdown = True
+        if self._memory_monitor is not None:
+            self._memory_monitor.stop()
         self._kick_scheduler()
         with self._lock:
             actors = list(self._actors.values())

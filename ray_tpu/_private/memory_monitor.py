@@ -1,0 +1,87 @@
+"""Node memory watermark monitor.
+
+Capability parity with the reference's MemoryMonitor
+(src/ray/common/memory_monitor.h:48 wired into the raylet at
+node_manager.h:853, Python counterpart _private/memory_monitor.py:94):
+a watermark thread that reads node memory usage and triggers a callback
+above the threshold so the runtime can shed load (refuse/kill tasks)
+before the OS OOM-killer does.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+
+def read_proc_meminfo() -> Tuple[int, int]:
+    """Returns (used_bytes, total_bytes) from /proc/meminfo."""
+    total = avail = None
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemTotal:"):
+                total = int(line.split()[1]) * 1024
+            elif line.startswith("MemAvailable:"):
+                avail = int(line.split()[1]) * 1024
+            if total is not None and avail is not None:
+                break
+    if total is None or avail is None:
+        raise RuntimeError("Could not parse /proc/meminfo")
+    return total - avail, total
+
+
+class MemoryMonitor:
+    """Polls a usage provider; fires ``on_threshold(fraction)`` when the
+    used fraction crosses ``threshold`` and ``on_recovered(fraction)``
+    when it drops back under."""
+
+    def __init__(self, threshold: float = 0.95,
+                 check_interval_s: float = 1.0,
+                 usage_provider: Optional[
+                     Callable[[], Tuple[int, int]]] = None,
+                 on_threshold: Optional[Callable[[float], None]] = None,
+                 on_recovered: Optional[Callable[[float], None]] = None):
+        self.threshold = threshold
+        self._interval = check_interval_s
+        self._provider = usage_provider or read_proc_meminfo
+        self._on_threshold = on_threshold
+        self._on_recovered = on_recovered
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.above_threshold = False
+        self.last_fraction = 0.0
+
+    def check_once(self) -> bool:
+        """One poll; returns True if above threshold. Usable without the
+        thread (tests, or inline checks in a dispatch loop)."""
+        used, total = self._provider()
+        frac = used / max(total, 1)
+        self.last_fraction = frac
+        if frac >= self.threshold and not self.above_threshold:
+            self.above_threshold = True
+            if self._on_threshold:
+                self._on_threshold(frac)
+        elif frac < self.threshold and self.above_threshold:
+            self.above_threshold = False
+            if self._on_recovered:
+                self._on_recovered(frac)
+        return self.above_threshold
+
+    def start(self) -> "MemoryMonitor":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="memory-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stopped.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        while not self._stopped.is_set():
+            try:
+                self.check_once()
+            except Exception:
+                pass
+            self._stopped.wait(self._interval)

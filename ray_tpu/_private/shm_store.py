@@ -12,6 +12,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 from typing import Optional, Tuple
 
 from ray_tpu._private.ids import ObjectID
@@ -26,6 +27,11 @@ _lib_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
 SHM_OK = 0
+SHM_ERR_EXISTS = -1
+SHM_ERR_NOT_FOUND = -2
+SHM_ERR_FULL = -3
+SHM_ERR_TOO_MANY = -7
+
 _ERRORS = {
     -1: "object already exists",
     -2: "object not found",
@@ -74,6 +80,13 @@ def _load() -> ctypes.CDLL:
         lib.store_create_object.restype = ctypes.c_int64
         lib.store_create_object.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.store_create_object_ex.restype = ctypes.c_int64
+        lib.store_create_object_ex.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_int]
+        lib.store_lru_candidate.restype = ctypes.c_int
+        lib.store_lru_candidate.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
         lib.store_seal.restype = ctypes.c_int
         lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.store_get.restype = ctypes.c_int
@@ -114,6 +127,15 @@ class ShmObjectStore:
         self._owner = owner
         base = self._lib.store_base(self._h)
         self._base = base
+        # Spill directory is derived from the store name so every
+        # process attached to the same segment agrees on it (reference:
+        # N15 object spilling, raylet/local_object_manager.h:38 +
+        # _private/external_storage.py filesystem backend).
+        from ray_tpu._private.config import GlobalConfig
+        self._spill_dir = os.path.join(
+            GlobalConfig.object_spill_dir, name.lstrip("/"))
+        self._num_spilled = 0
+        self._num_restored = 0
 
     # --- lifecycle --------------------------------------------------------
 
@@ -137,6 +159,8 @@ class ShmObjectStore:
         if self._h:
             if self._owner:
                 self._lib.store_destroy(self._h)
+                import shutil
+                shutil.rmtree(self._spill_dir, ignore_errors=True)
             else:
                 self._lib.store_detach(self._h)
             self._h = None
@@ -144,12 +168,85 @@ class ShmObjectStore:
     # --- object lifecycle -------------------------------------------------
 
     def put_bytes(self, oid: ObjectID, data: bytes) -> None:
-        off = self._lib.store_create_object(self._h, oid.binary(),
-                                            len(data))
-        if off < 0:
-            _check(int(off), "create_object")
+        # No-evict create: under memory pressure cold LRU objects are
+        # spilled to disk to make room (never silently dropped); if the
+        # incoming object still doesn't fit, it spills itself.
+        while True:
+            off = self._lib.store_create_object_ex(
+                self._h, oid.binary(), len(data), 0)
+            if off == SHM_ERR_FULL:
+                if self._spill_lru_one():
+                    continue
+                self._spill_bytes(oid, data)
+                return
+            if off == SHM_ERR_TOO_MANY:
+                self._spill_bytes(oid, data)
+                return
+            if off < 0:
+                _check(int(off), "create_object")
+            break
         ctypes.memmove(self._base + off, data, len(data))
         _check(self._lib.store_seal(self._h, oid.binary()), "seal")
+
+    def _spill_lru_one(self) -> bool:
+        """Spill+delete the LRU sealed refcount-0 object. False if no
+        candidate exists."""
+        buf = ctypes.create_string_buffer(len(ObjectID.nil().binary()))
+        rc = self._lib.store_lru_candidate(self._h, buf)
+        if rc != SHM_OK:
+            return False
+        victim = ObjectID(buf.raw)
+        return self.spill(victim)
+
+    # --- spilling ---------------------------------------------------------
+
+    def _spill_path(self, oid: ObjectID) -> str:
+        return os.path.join(self._spill_dir, oid.hex())
+
+    def _spill_bytes(self, oid: ObjectID, data: bytes) -> None:
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = self._spill_path(oid)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)   # atomic: readers see whole objects only
+        self._num_spilled += 1
+
+    def _read_spilled(self, oid: ObjectID) -> Optional[bytes]:
+        try:
+            with open(self._spill_path(oid), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def spill(self, oid: ObjectID) -> bool:
+        """Explicitly move a sealed object from shm to disk."""
+        try:
+            data = self.get_bytes_shm_only(oid, timeout_ms=0)
+        except ShmStoreError:
+            return False
+        self._spill_bytes(oid, data)
+        try:
+            # shm copy only — the spill file IS the object now.
+            _check(self._lib.store_delete(self._h, oid.binary()),
+                   "delete")
+        except ShmStoreError:
+            pass
+        return True
+
+    def restore(self, oid: ObjectID) -> bool:
+        """Try to bring a spilled object back into shm."""
+        data = self._read_spilled(oid)
+        if data is None:
+            return False
+        off = self._lib.store_create_object_ex(self._h, oid.binary(),
+                                               len(data), 0)
+        if off < 0:
+            return off == SHM_ERR_EXISTS
+        ctypes.memmove(self._base + off, data, len(data))
+        _check(self._lib.store_seal(self._h, oid.binary()), "seal")
+        self._num_restored += 1
+        return True
 
     def get_view(self, oid: ObjectID,
                  timeout_ms: int = -1) -> memoryview:
@@ -163,21 +260,61 @@ class ShmObjectStore:
             self._base + off.value)
         return memoryview(buf)
 
-    def get_bytes(self, oid: ObjectID, timeout_ms: int = -1) -> bytes:
+    def get_bytes_shm_only(self, oid: ObjectID,
+                           timeout_ms: int = -1) -> bytes:
         view = self.get_view(oid, timeout_ms)
         try:
             return bytes(view)
         finally:
             self.release(oid)
 
+    def get_bytes(self, oid: ObjectID, timeout_ms: int = -1) -> bytes:
+        """Get with spill fallback: poll shm in slices, checking the
+        spill directory between slices (a spilled object never signals
+        the shm condvar)."""
+        deadline = None if timeout_ms < 0 else \
+            time.monotonic() + timeout_ms / 1000.0
+        data = self._read_spilled(oid)
+        if data is not None:
+            return data
+        slice_cap = 250   # re-check the spill dir only on slice expiry
+        while True:
+            slice_ms = slice_cap if deadline is None else \
+                max(0, min(slice_cap,
+                           int((deadline - time.monotonic()) * 1000)))
+            try:
+                return self.get_bytes_shm_only(oid, timeout_ms=slice_ms)
+            except ShmTimeout:
+                pass
+            except ShmStoreError as e:
+                # 0-slice probes report not-found/unsealed, not timeout.
+                if e.code not in (-2, -4):
+                    raise
+            data = self._read_spilled(oid)
+            if data is not None:
+                return data
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ShmTimeout(-5, "get")
+
     def release(self, oid: ObjectID):
         self._lib.store_release(self._h, oid.binary())
 
     def delete(self, oid: ObjectID):
-        _check(self._lib.store_delete(self._h, oid.binary()), "delete")
+        had_spill = False
+        try:
+            os.unlink(self._spill_path(oid))
+            had_spill = True
+        except OSError:
+            pass
+        rc = self._lib.store_delete(self._h, oid.binary())
+        if had_spill and rc == SHM_ERR_NOT_FOUND:
+            return   # spilled-only object: the unlink was the delete
+        _check(rc, "delete")
 
     def contains(self, oid: ObjectID) -> bool:
-        return bool(self._lib.store_contains(self._h, oid.binary()))
+        if self._lib.store_contains(self._h, oid.binary()):
+            return True
+        return os.path.exists(self._spill_path(oid))
 
     def stats(self) -> dict:
         vals = [ctypes.c_uint64() for _ in range(4)]
@@ -185,7 +322,9 @@ class ShmObjectStore:
         return {"bytes_in_use": vals[0].value,
                 "num_objects": vals[1].value,
                 "num_evictions": vals[2].value,
-                "capacity": vals[3].value}
+                "capacity": vals[3].value,
+                "num_spilled": self._num_spilled,
+                "num_restored": self._num_restored}
 
     # --- serialization-aware helpers --------------------------------------
 

@@ -152,6 +152,9 @@ class TaskSpec:
     # Bookkeeping
     attempt: int = 0
     parent_task_id: Optional[TaskID] = None
+    # Tracing context propagated caller -> executor (P18,
+    # util/tracing/tracing_helper.py parity).
+    trace_ctx: Optional[Dict[str, str]] = None
 
     @property
     def is_actor_task(self) -> bool:

@@ -1,0 +1,65 @@
+"""Usage stats: opt-out telemetry collection (disabled-by-default here).
+
+Capability parity with the reference's usage_lib
+(python/ray/_private/usage/usage_lib.py): collects a schema-stable
+payload (version, API surface used, cluster shape) gated by an opt-out
+env var. This build has zero egress, so "report" writes the payload to a
+local file instead of POSTing; the collection/gating logic is the part
+with parity value.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List
+
+_ENV_OPT_OUT = "RAY_TPU_USAGE_STATS_ENABLED"
+
+_lock = threading.Lock()
+_features_used: set = set()
+
+
+def usage_stats_enabled() -> bool:
+    # Mirrors RAY_usage_stats_enabled gating; default ON like the
+    # reference (opt-out), but writing only to the local session dir.
+    return os.environ.get(_ENV_OPT_OUT, "1").strip().lower() not in (
+        "0", "false", "no", "off")
+
+
+def record_library_usage(feature: str) -> None:
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _features_used.add(feature)
+
+
+def get_features_used() -> List[str]:
+    with _lock:
+        return sorted(_features_used)
+
+
+def build_payload() -> Dict[str, Any]:
+    import ray_tpu
+    payload: Dict[str, Any] = {
+        "schema_version": "0.1",
+        "source": "ray_tpu",
+        "collected_at": time.time(),
+        "libraries_used": get_features_used(),
+    }
+    try:
+        payload["cluster_resources"] = ray_tpu.api.cluster_resources()
+    except Exception:
+        payload["cluster_resources"] = {}
+    return payload
+
+
+def report_usage(path: str = "/tmp/ray_tpu/usage_stats.json") -> str:
+    """Writes the payload locally (no egress in this environment)."""
+    if not usage_stats_enabled():
+        return ""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(build_payload(), f, indent=2)
+    return path

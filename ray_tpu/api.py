@@ -54,6 +54,13 @@ def cancel(ref: ObjectRef, force: bool = False, recursive: bool = True):
 # Tasks
 # --------------------------------------------------------------------------
 
+def _maybe_trace(spec_name: str, kind: str):
+    """Client-side invocation span + shipped context (no-op unless
+    tracing was enabled via ray_tpu.util.tracing.setup_tracing)."""
+    from ray_tpu.util import tracing
+    return tracing.invocation_context(spec_name, kind)
+
+
 class RemoteFunction:
     def __init__(self, func, options: Dict[str, Any]):
         self._func = func
@@ -99,6 +106,8 @@ class RemoteFunction:
             retry_exceptions=opts["retry_exceptions"],
             scheduling_strategy=opts["scheduling_strategy"],
             runtime_env=opts["runtime_env"],
+            trace_ctx=_maybe_trace(spec_name=opts["name"] or getattr(
+                self._func, "__qualname__", "anonymous"), kind="task"),
         )
         refs = rt.submit_task(spec)
         if num_returns == 1:
@@ -178,6 +187,8 @@ class ActorHandle:
             max_retries=self._max_task_retries,
             actor_id=self._actor_id,
             method_name=method_name,
+            trace_ctx=_maybe_trace(
+                f"{self._cls.__name__}.{method_name}", "actor_task"),
         )
         refs = rt.submit_actor_task(self._actor_id, spec)
         if num_returns == 1:

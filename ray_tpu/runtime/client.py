@@ -119,6 +119,7 @@ def submit_task_via_head(head: RpcClient, spec: TaskSpec):
         "return_ids": [oid.binary() for oid in spec.return_ids],
         "resources": spec.resources,
         "runtime_env": spec.runtime_env,
+        "trace_ctx": spec.trace_ctx,
     })
     meta = {
         "task_id": spec.task_id.hex(),
@@ -167,6 +168,7 @@ def submit_actor_task_via_head(head: RpcClient, actor_id: ActorID,
         "kwargs": spec.kwargs,
         "num_returns": spec.num_returns,
         "return_ids": [oid.binary() for oid in spec.return_ids],
+        "trace_ctx": spec.trace_ctx,
     })
     head.call("submit_actor_task", actor_id.hex(),
               {"task_id": spec.task_id.hex()}, payload)

@@ -55,6 +55,13 @@ class Cluster:
     def kill_worker(self, worker_id: str):
         self.node.kill_worker(worker_id)
 
+    def start_node_killer(self, interval_s: float = 1.0,
+                          max_kills: int = 3,
+                          respawn: bool = True) -> "NodeKiller":
+        """Chaos: kill a random worker every interval (NodeKillerActor
+        analogue, python/ray/_private/test_utils.py:1089)."""
+        return NodeKiller(self, interval_s, max_kills, respawn).start()
+
     def workers(self):
         return self.runtime.list_workers()
 
@@ -73,3 +80,48 @@ class Cluster:
 
     def __exit__(self, *exc):
         self.shutdown()
+
+
+class NodeKiller:
+    """Kills a random live worker every ``interval_s`` until ``max_kills``
+    is reached, optionally respawning a replacement — the chaos vehicle
+    for fault-tolerance tests (reference: NodeKillerActor + chaos_test/)."""
+
+    def __init__(self, cluster: Cluster, interval_s: float,
+                 max_kills: int, respawn: bool):
+        import threading
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.respawn = respawn
+        self.num_kills = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="node-killer")
+
+    def start(self) -> "NodeKiller":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def _run(self):
+        import random
+        while not self._stop.is_set() and self.num_kills < self.max_kills:
+            if self._stop.wait(self.interval_s):
+                return
+            alive = [w["worker_id"]
+                     for w in self.cluster.node.head_service.list_workers()
+                     if w["alive"]]
+            if not alive:
+                continue
+            victim = random.choice(alive)
+            self.cluster.kill_worker(victim)
+            self.num_kills += 1
+            if self.respawn:
+                try:
+                    self.cluster.add_worker()
+                except Exception:
+                    pass

@@ -179,6 +179,7 @@ class HeadService:
             meta = dict(meta)
             meta["payload"] = payload
             meta["attempt"] = 0
+            meta["state"] = "pending"
             self._task_meta[meta["task_id"]] = meta
             self._pending.append(meta["task_id"])
             self._sched_cv.notify_all()
@@ -240,8 +241,8 @@ class HeadService:
         while self._pending:
             task_id = self._pending.popleft()
             meta = self._task_meta.get(task_id)
-            if meta is None:
-                continue
+            if meta is None or meta.get("state") != "pending":
+                continue   # stale duplicate queue entry
             res = meta.get("resources", {})
             pg_id = meta.get("pg_id")
             w = self._pick_worker_locked(res, pg_id)
@@ -252,6 +253,7 @@ class HeadService:
                 for k, v in res.items():
                     w.available[k] = w.available.get(k, 0.0) - v
             w.running.add(task_id)
+            meta["state"] = "dispatched"
             meta["worker_id"] = w.worker_id
             threading.Thread(target=self._dispatch, args=(w, meta),
                              daemon=True).start()
@@ -275,6 +277,10 @@ class HeadService:
                         w.available.get(k, 0.0) + v)
             self._sched_cv.notify_all()
         if failure is not None:
+            # The worker is unreachable: treat the connection failure as
+            # death detection (don't wait for the node monitor poll —
+            # otherwise retries burn against the same dead worker).
+            self.mark_worker_dead(w.worker_id)
             self._handle_lost_task(task_id)
         else:
             with self._lock:
@@ -283,10 +289,13 @@ class HeadService:
     def _handle_lost_task(self, task_id: str):
         with self._lock:
             meta = self._task_meta.get(task_id)
-            if meta is None:
+            if meta is None or meta.get("state") != "dispatched":
+                # Already requeued (the dispatch-failure path and the
+                # node monitor can both observe one death) or done.
                 return
             if meta["attempt"] < meta.get("max_retries", 0):
                 meta["attempt"] += 1
+                meta["state"] = "pending"
                 self._pending.append(task_id)
                 self._sched_cv.notify_all()
                 return

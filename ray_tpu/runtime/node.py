@@ -57,6 +57,10 @@ class NodeManager:
         worker_id = f"worker-{index}-{uuid.uuid4().hex[:6]}"
         env = dict(os.environ)
         env.pop("PYTHONPATH", None)   # breaks the TPU plugin (see skills)
+        # Propagate driver-side flag overrides (chaos delays, spill
+        # settings, …) to the worker, reference `_system_config` style.
+        from ray_tpu._private.config import GlobalConfig
+        env.update(GlobalConfig.to_env())
         res = dict(resources or self.resources_per_worker)
         # Only a designated worker may own the TPU; everyone else is
         # forced onto the CPU backend so they can't grab the chip.

@@ -18,6 +18,7 @@ import json
 import queue
 import sys
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional
 
@@ -91,7 +92,15 @@ class Executor:
 
     # ---- normal tasks -----------------------------------------------------
 
+    @staticmethod
+    def _chaos_delay():
+        """Env-configured random handler delay (N22; flags propagated
+        via RAY_TPU_* env by NodeManager.start_worker)."""
+        from ray_tpu._private.config import chaos_delay
+        chaos_delay()
+
     def push_task(self, payload: bytes) -> str:
+        self._chaos_delay()
         spec = cloudpickle.loads(payload)
         _task_ctx.resources = spec.get("resources", {})
         _task_ctx.blocked = False
@@ -101,7 +110,10 @@ class Executor:
             kwargs = {k: self._resolve(v)
                       for k, v in spec["kwargs"].items()}
             from ray_tpu._private.runtime_env import runtime_env_context
-            with runtime_env_context(spec.get("runtime_env")):
+            from ray_tpu.util.tracing import execution_span
+            with runtime_env_context(spec.get("runtime_env")), \
+                    execution_span(spec.get("name", "task"), "task",
+                                   spec.get("trace_ctx")):
                 result = func(*args, **kwargs)
             self._write_returns(spec["return_ids"],
                                 spec["num_returns"], result)
@@ -152,7 +164,11 @@ class Executor:
                           for k, v in spec["kwargs"].items()}
                 from ray_tpu._private.runtime_env import \
                     runtime_env_context
-                with runtime_env_context(slot.runtime_env):
+                from ray_tpu.util.tracing import execution_span
+                with runtime_env_context(slot.runtime_env), \
+                        execution_span(spec.get("name", "actor_task"),
+                                       "actor_task",
+                                       spec.get("trace_ctx")):
                     result = method(*args, **kwargs)
                 self._write_returns(spec["return_ids"],
                                     spec["num_returns"], result)

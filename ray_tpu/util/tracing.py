@@ -1,0 +1,196 @@
+"""Distributed tracing: spans around remote calls with context
+propagation.
+
+Capability parity with the reference's tracing helper
+(python/ray/util/tracing/tracing_helper.py:290,324,449 — span capture
+around every ``.remote()`` invocation and task/actor execution, with the
+trace context propagated into the callee so cross-process call chains
+share one trace). OpenTelemetry isn't a baked-in dependency, so spans go
+to a pluggable exporter (in-memory by default, JSON dump helper); an OTel
+exporter can be plugged via ``setup_tracing(exporter=...)``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+_state = threading.local()
+_lock = threading.Lock()
+_enabled = False
+_spans: List[Dict[str, Any]] = []
+_exporter: Optional[Callable[[Dict[str, Any]], None]] = None
+_trace_dir: Optional[str] = None
+_TRACE_DIR_ENV = "RAY_TPU_TRACE_DIR"
+
+
+def setup_tracing(exporter: Optional[Callable[[Dict[str, Any]], None]]
+                  = None, trace_dir: Optional[str] = None) -> None:
+    """Enable tracing (reference: ray.init(_tracing_startup_hook=...)).
+
+    ``trace_dir`` (default: a per-pid dir under /tmp/ray_tpu/traces) is
+    exported via env so worker processes SPAWNED AFTER this call
+    self-enable and append their spans as JSONL there; get_spans()
+    merges them back. Workers already running keep tracing disabled.
+    """
+    global _enabled, _exporter, _trace_dir
+    _enabled = True
+    _exporter = exporter
+    _trace_dir = trace_dir or os.path.join(
+        "/tmp", "ray_tpu", "traces", f"driver-{os.getpid()}")
+    os.makedirs(_trace_dir, exist_ok=True)
+    os.environ[_TRACE_DIR_ENV] = _trace_dir
+
+
+def _maybe_enable_from_env() -> bool:
+    """Worker-process self-enable: a shipped trace context plus the
+    inherited trace-dir env turns tracing on with a file sink."""
+    global _enabled, _trace_dir
+    if _enabled:
+        return True
+    env_dir = os.environ.get(_TRACE_DIR_ENV)
+    if not env_dir:
+        return False
+    _trace_dir = env_dir
+    _enabled = True
+    return True
+
+
+def teardown_tracing() -> None:
+    global _enabled, _exporter, _trace_dir
+    _enabled = False
+    _exporter = None
+    _trace_dir = None
+    os.environ.pop(_TRACE_DIR_ENV, None)
+    with _lock:
+        _spans.clear()
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def get_spans(include_workers: bool = True) -> List[Dict[str, Any]]:
+    with _lock:
+        out = list(_spans)
+    if include_workers and _trace_dir and os.path.isdir(_trace_dir):
+        for fname in os.listdir(_trace_dir):
+            if not fname.endswith(".jsonl"):
+                continue
+            try:
+                with open(os.path.join(_trace_dir, fname)) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            out.append(json.loads(line))
+            except OSError:
+                pass
+    return out
+
+
+def export_json(path: str) -> str:
+    with _lock:
+        data = list(_spans)
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
+    return path
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    return getattr(_state, "ctx", None)
+
+
+def _emit(span: Dict[str, Any]) -> None:
+    with _lock:
+        _spans.append(span)
+    if _exporter is not None:
+        try:
+            _exporter(span)
+        except Exception:
+            pass
+    if _trace_dir is not None:
+        # Cross-process sink: every process appends to its own file.
+        try:
+            path = os.path.join(_trace_dir, f"{os.getpid()}.jsonl")
+            with open(path, "a") as f:
+                f.write(json.dumps(span) + "\n")
+        except OSError:
+            pass
+
+
+class span:
+    """Context manager recording one span; sets the thread-local context
+    so nested remote calls become children."""
+
+    def __init__(self, name: str, kind: str = "internal",
+                 parent: Optional[Dict[str, str]] = None,
+                 attributes: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.kind = kind
+        self.attributes = dict(attributes or {})
+        explicit_parent = parent if parent is not None \
+            else current_context()
+        self.trace_id = (explicit_parent or {}).get(
+            "trace_id") or _new_id()
+        self.parent_id = (explicit_parent or {}).get("span_id")
+        self.span_id = _new_id()
+        self._prev_ctx = None
+        self._start = 0.0
+
+    @property
+    def context(self) -> Dict[str, str]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def __enter__(self) -> "span":
+        self._start = time.time()
+        self._prev_ctx = current_context()
+        _state.ctx = self.context
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _state.ctx = self._prev_ctx
+        if not _enabled:
+            return False
+        _emit({
+            "name": self.name,
+            "kind": self.kind,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_time": self._start,
+            "end_time": time.time(),
+            "status": "error" if exc_type else "ok",
+            "attributes": self.attributes,
+        })
+        return False
+
+
+def invocation_context(task_name: str, kind: str
+                       ) -> Optional[Dict[str, str]]:
+    """Called by the API layer on ``.remote()``: records the client-side
+    invocation span and returns the context to ship with the spec."""
+    if not _enabled:
+        return None
+    with span(f"{task_name}.remote", kind=kind,
+              attributes={"task": task_name}) as s:
+        return s.context
+
+
+def execution_span(task_name: str, kind: str,
+                   ctx: Optional[Dict[str, str]]):
+    """Called by executors around the user function: the server-side
+    span, parented to the shipped invocation context."""
+    if ctx is not None:
+        _maybe_enable_from_env()
+    if not _enabled:
+        import contextlib
+        return contextlib.nullcontext()
+    return span(f"{task_name}.execute", kind=kind, parent=ctx,
+                attributes={"task": task_name})

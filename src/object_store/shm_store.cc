@@ -302,7 +302,11 @@ static int Lock(Header* hdr) {
 }
 
 // Allocates an object; returns its data pointer (into shm) or error.
-int64_t store_create_object(Store* s, const uint8_t* id, uint64_t size) {
+// allow_evict=0 returns SHM_ERR_FULL without evicting anything, so a
+// spilling layer above can keep primary copies durable (the analogue of
+// plasma only evicting objects that were spilled or are reconstructable).
+int64_t store_create_object_ex(Store* s, const uint8_t* id, uint64_t size,
+                               int allow_evict) {
   Header* hdr = s->hdr;
   uint64_t asize = Align(size ? size : 1);
   if (Lock(hdr) != 0) return SHM_ERR_SYS;
@@ -323,7 +327,7 @@ int64_t store_create_object(Store* s, const uint8_t* id, uint64_t size) {
   }
   uint64_t offset;
   while (!AllocLocked(hdr, asize, &offset)) {
-    if (!EvictOneLocked(hdr)) {
+    if (!allow_evict || !EvictOneLocked(hdr)) {
       pthread_mutex_unlock(&hdr->mutex);
       return SHM_ERR_FULL;
     }
@@ -343,6 +347,36 @@ int64_t store_create_object(Store* s, const uint8_t* id, uint64_t size) {
   hdr->num_objects++;
   pthread_mutex_unlock(&hdr->mutex);
   return (int64_t)(hdr->data_start + offset);
+}
+
+int64_t store_create_object(Store* s, const uint8_t* id, uint64_t size) {
+  return store_create_object_ex(s, id, size, 1);
+}
+
+// Copy the id of the least-recently-used sealed refcount-0 object into
+// out_id. Lets a spilling layer pick the eviction victim, move it to
+// disk, then delete it — spill-before-evict (plasma eviction_policy.cc
+// analogue where only spilled objects become evictable).
+int store_lru_candidate(Store* s, uint8_t* out_id) {
+  Header* hdr = s->hdr;
+  if (Lock(hdr) != 0) return SHM_ERR_SYS;
+  uint32_t victim = kInvalid;
+  uint64_t best_seq = ~0ull;
+  for (uint32_t i = 0; i < kMaxObjects; i++) {
+    Entry* e = &hdr->entries[i];
+    if (e->in_use && e->state == kSealed && e->refcount == 0 &&
+        e->seal_seq < best_seq) {
+      best_seq = e->seal_seq;
+      victim = i;
+    }
+  }
+  if (victim == kInvalid) {
+    pthread_mutex_unlock(&hdr->mutex);
+    return SHM_ERR_NOT_FOUND;
+  }
+  memcpy(out_id, hdr->entries[victim].id, kIdSize);
+  pthread_mutex_unlock(&hdr->mutex);
+  return SHM_OK;
 }
 
 int store_seal(Store* s, const uint8_t* id) {

@@ -218,3 +218,14 @@ def test_placement_group_distributed(cluster):
         time.sleep(0.05)
     assert cluster.runtime.available_resources()["CPU"] == \
         pytest.approx(before)
+
+
+def test_driver_attach_by_address(cluster):
+    """connect_to_cluster: a second driver attaches by address and its
+    shutdown must not take the cluster down (Ray Client parity, P9)."""
+    from ray_tpu.runtime.client import connect_to_cluster
+    rt2 = connect_to_cluster(cluster.node.head_address)
+    ref = rt2.put({"k": 1})
+    assert rt2.get(ref) == {"k": 1}
+    rt2.shutdown()   # must be a no-op for the shared cluster
+    assert cluster.runtime.head.call("ping") == "pong"

@@ -1,0 +1,136 @@
+"""Tracing + usage-stats tests (reference analogues:
+python/ray/tests/test_tracing.py, test_usage_stats.py)."""
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import usage_stats
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def traced(rt):
+    tracing.setup_tracing()
+    yield rt
+    tracing.teardown_tracing()
+
+
+def test_task_spans_share_trace(traced):
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(child.remote(x)) + 10
+
+    assert ray_tpu.get(parent.remote(1)) == 12
+    spans = tracing.get_spans()
+
+    def find(suffix):
+        return next(s for s in spans if s["name"].endswith(suffix))
+
+    parent_invoke = find("parent.remote")
+    parent_exec = find("parent.execute")
+    child_invoke = find("child.remote")
+    child_exec = find("child.execute")
+    # All spans of the chain share one trace id.
+    assert child_exec["trace_id"] == parent_invoke["trace_id"]
+    assert child_invoke["trace_id"] == parent_invoke["trace_id"]
+    # Parent/child structure: execute span is a child of its invoke span.
+    assert parent_exec["parent_id"] == parent_invoke["span_id"]
+
+
+def test_actor_spans(traced):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    names = [s["name"] for s in tracing.get_spans()]
+    assert any(n.endswith("Counter.inc.remote") for n in names)
+    assert any(n.endswith("Counter.inc.execute") for n in names)
+
+
+def test_exporter_and_json(traced, tmp_path):
+    seen = []
+    tracing.setup_tracing(exporter=seen.append)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    assert any(s["name"].endswith("f.execute") for s in seen)
+    path = tracing.export_json(str(tmp_path / "spans.json"))
+    assert os.path.getsize(path) > 0
+
+
+def test_tracing_disabled_is_noop(rt):
+    assert tracing.current_context() is None
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    assert tracing.get_spans() == []
+
+
+def test_usage_stats_gating(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    assert not usage_stats.usage_stats_enabled()
+    assert usage_stats.report_usage() == ""
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+    usage_stats.record_library_usage("train")
+    usage_stats.record_library_usage("serve")
+    payload = usage_stats.build_payload()
+    assert "train" in payload["libraries_used"]
+    assert payload["schema_version"]
+
+
+def test_distributed_tracing_collects_worker_spans():
+    """Worker-side execute spans must reach the driver via the shared
+    trace dir (cross-process sink)."""
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu._private.config import GlobalConfig
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    tracing.setup_tracing()
+    try:
+        with Cluster(num_workers=1,
+                     resources_per_worker={"CPU": 2}):
+            @ray_tpu.remote
+            def traced_fn():
+                return 7
+
+            @ray_tpu.remote
+            class TracedActor:
+                def m(self):
+                    return 8
+
+            assert ray_tpu.get(traced_fn.remote()) == 7
+            a = TracedActor.remote()
+            assert ray_tpu.get(a.m.remote()) == 8
+            spans = tracing.get_spans()
+            names = [s["name"] for s in spans]
+            assert any(n.endswith("traced_fn.execute") for n in names)
+            assert any(n.endswith("TracedActor.m.execute")
+                       for n in names)
+            invoke = next(s for s in spans
+                          if s["name"].endswith("traced_fn.remote"))
+            execute = next(s for s in spans
+                           if s["name"].endswith("traced_fn.execute"))
+            assert execute["trace_id"] == invoke["trace_id"]
+            assert execute["parent_id"] == invoke["span_id"]
+    finally:
+        tracing.teardown_tracing()
+        GlobalConfig.reset()
