@@ -246,6 +246,10 @@ class ShmObjectStore:
         ctypes.memmove(self._base + off, data, len(data))
         _check(self._lib.store_seal(self._h, oid.binary()), "seal")
         self._num_restored += 1
+        try:
+            os.unlink(self._spill_path(oid))   # shm copy is primary now
+        except OSError:
+            pass
         return True
 
     def get_view(self, oid: ObjectID,
@@ -274,6 +278,12 @@ class ShmObjectStore:
         the shm condvar)."""
         deadline = None if timeout_ms < 0 else \
             time.monotonic() + timeout_ms / 1000.0
+        # Probe shm first (0-timeout): resident objects — the common
+        # case — never pay a disk syscall.
+        try:
+            return self.get_bytes_shm_only(oid, timeout_ms=0)
+        except ShmStoreError:
+            pass
         data = self._read_spilled(oid)
         if data is not None:
             return data

@@ -1,9 +1,10 @@
-"""Usage stats: opt-out telemetry collection (disabled-by-default here).
+"""Usage stats: opt-out collection, written locally only.
 
 Capability parity with the reference's usage_lib
 (python/ray/_private/usage/usage_lib.py): collects a schema-stable
 payload (version, API surface used, cluster shape) gated by an opt-out
-env var. This build has zero egress, so "report" writes the payload to a
+env var — ON by default like the reference, opt out with
+RAY_TPU_USAGE_STATS_ENABLED=0. This build has zero egress, so "report" writes the payload to a
 local file instead of POSTing; the collection/gating logic is the part
 with parity value.
 """

@@ -1,5 +1,17 @@
+"""Distributed datasets (reference: python/ray/data — SURVEY.md §2.3 L5).
+
+Blocks live in the object store; transforms are lazy fused stages;
+pipelines stream windows; datasources cover csv/json/text/binary/numpy
+(+ gated parquet); actor-pool compute for stateful batch inference;
+``iter_device_batches`` feeds sharded jax arrays onto a device mesh.
+"""
 from ray_tpu.data.dataset import (Dataset, from_items, from_numpy,
                                   range_dataset, read_csv, read_json)
+from ray_tpu.data.datasources import (RandomAccessDataset, from_pandas,
+                                      read_binary_files, read_numpy,
+                                      read_parquet, read_text, to_pandas,
+                                      write_csv, write_json, write_numpy)
+from ray_tpu.data.pipeline import DatasetPipeline
 
 
 def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001
@@ -7,5 +19,10 @@ def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001
     return range_dataset(n, parallelism)
 
 
-__all__ = ["Dataset", "from_items", "from_numpy", "range",
-           "range_dataset", "read_csv", "read_json"]
+__all__ = [
+    "Dataset", "DatasetPipeline", "RandomAccessDataset",
+    "from_items", "from_numpy", "from_pandas", "range", "range_dataset",
+    "read_csv", "read_json", "read_text", "read_binary_files",
+    "read_numpy", "read_parquet", "to_pandas",
+    "write_csv", "write_json", "write_numpy",
+]

@@ -71,6 +71,12 @@ def _from_batch(batch: BatchFormat) -> Block:
     return list(batch)
 
 
+def _key_getter(key):
+    if key is None:
+        return lambda r: r
+    return key if callable(key) else (lambda r: r[key])
+
+
 class _BatchActor:
     """Actor-pool compute for map_batches (reference:
     _internal/compute.py ActorPoolStrategy)."""
@@ -95,6 +101,8 @@ class _BatchActor:
 class Dataset:
     def __init__(self, block_refs: List[ray_tpu.ObjectRef],
                  stages: Tuple = ()):
+        from ray_tpu._private.usage_stats import record_library_usage
+        record_library_usage("data")
         self._block_refs = list(block_refs)
         self._stages = tuple(stages)
 
@@ -250,6 +258,86 @@ class Dataset:
         splits = np.array_split(np.arange(len(rows)), n)
         return [Dataset([ray_tpu.put([rows[i] for i in idx])])
                 for idx in splits]
+
+    def window(self, *, blocks_per_window: int = 2):
+        """Streaming windows (reference: Dataset.window ->
+        DatasetPipeline)."""
+        import builtins
+        from ray_tpu.data.pipeline import DatasetPipeline
+        blocks = self._block_refs
+        stages = self._stages
+        windows = [Dataset(blocks[i:i + blocks_per_window], stages)
+                   for i in builtins.range(0, len(blocks),
+                                           blocks_per_window)]
+        return DatasetPipeline.from_windows(windows)
+
+    def repeat(self, times: Optional[int] = None):
+        """Epoch repetition (reference: Dataset.repeat)."""
+        return self.window(
+            blocks_per_window=max(1, len(self._block_refs))
+        ).repeat(times)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-wise zip (reference: Dataset.zip)."""
+        a = self.take_all()
+        b = other.take_all()
+        if len(a) != len(b):
+            raise ValueError(
+                f"zip() requires equal lengths, got {len(a)} vs {len(b)}")
+        import builtins
+        rows = []
+        for x, y in builtins.zip(a, b):
+            if isinstance(x, dict) and isinstance(y, dict):
+                merged = dict(x)
+                for k, v in y.items():
+                    merged[k if k not in merged else f"{k}_1"] = v
+                rows.append(merged)
+            else:
+                rows.append((x, y))
+        from ray_tpu.data.dataset import from_items
+        return from_items(rows, max(1, self.num_blocks()))
+
+    def limit(self, n: int) -> "Dataset":
+        from ray_tpu.data.dataset import from_items
+        return from_items(self.take(n), max(1, self.num_blocks()))
+
+    def unique(self, key: Optional[Union[str, Callable]] = None
+               ) -> List[Any]:
+        getter = _key_getter(key)
+        seen = []
+        seen_set = set()
+        for row in self.iter_rows():
+            v = getter(row)
+            if v not in seen_set:
+                seen_set.add(v)
+                seen.append(v)
+        return seen
+
+    def min(self, key: Optional[Union[str, Callable]] = None):
+        import builtins
+        getter = _key_getter(key)
+        return builtins.min(getter(r) for r in self.iter_rows())
+
+    def max(self, key: Optional[Union[str, Callable]] = None):
+        import builtins
+        getter = _key_getter(key)
+        return builtins.max(getter(r) for r in self.iter_rows())
+
+    def to_pandas(self):
+        from ray_tpu.data.datasources import to_pandas
+        return to_pandas(self)
+
+    def write_csv(self, path: str) -> str:
+        from ray_tpu.data.datasources import write_csv
+        return write_csv(self, path)
+
+    def write_json(self, path: str) -> str:
+        from ray_tpu.data.datasources import write_json
+        return write_json(self, path)
+
+    def write_numpy(self, path: str, column: str = "data") -> str:
+        from ray_tpu.data.datasources import write_numpy
+        return write_numpy(self, path, column)
 
     def union(self, other: "Dataset") -> "Dataset":
         a, b = self.materialize(), other.materialize()

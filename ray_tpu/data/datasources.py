@@ -1,0 +1,152 @@
+"""Additional datasources + sinks.
+
+Capability parity with the reference's datasource set
+(python/ray/data/read_api.py:222+ and data/datasource/ — parquet, csv,
+json, numpy, binary, text readers; write_* sinks; from_pandas /
+to_pandas interconversion).
+"""
+from __future__ import annotations
+
+import glob as globlib
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.dataset import Dataset, from_items
+
+
+def _expand(path: str) -> List[str]:
+    if os.path.isdir(path):
+        return sorted(
+            p for p in globlib.glob(os.path.join(path, "*"))
+            if os.path.isfile(p))
+    return sorted(globlib.glob(path)) or [path]
+
+
+def read_text(path: str, parallelism: int = 8) -> Dataset:
+    """One row per line (reference: read_text)."""
+    rows: List[str] = []
+    for p in _expand(path):
+        with open(p) as f:
+            rows.extend(line.rstrip("\n") for line in f)
+    return from_items(rows, parallelism)
+
+
+def read_binary_files(path: str, parallelism: int = 8,
+                      include_paths: bool = False) -> Dataset:
+    """Whole files as bytes rows (reference: read_binary_files)."""
+    rows: List[Any] = []
+    for p in _expand(path):
+        with open(p, "rb") as f:
+            data = f.read()
+        rows.append({"path": p, "bytes": data} if include_paths
+                    else data)
+    return from_items(rows, parallelism)
+
+
+def read_numpy(path: str, parallelism: int = 8) -> Dataset:
+    """.npy files -> rows of {'data': row} (reference: read_numpy)."""
+    arrays = [np.load(p) for p in _expand(path)]
+    arr = np.concatenate(arrays) if len(arrays) > 1 else arrays[0]
+    from ray_tpu.data.dataset import from_numpy
+    return from_numpy(arr, parallelism)
+
+
+def read_parquet(path: str, parallelism: int = 8) -> Dataset:
+    """Parquet via pandas/pyarrow; raises a clear ImportError where
+    pyarrow is unavailable."""
+    try:
+        import pyarrow.parquet as pq  # noqa: F401
+    except ImportError:
+        raise ImportError(
+            "read_parquet requires pyarrow, which is not available in "
+            "this environment; convert to csv/json/npy or install "
+            "pyarrow.") from None
+    import pandas as pd
+    frames = [pd.read_parquet(p) for p in _expand(path)]
+    return from_pandas(pd.concat(frames), parallelism)
+
+
+def from_pandas(df, parallelism: int = 8) -> Dataset:
+    """DataFrame -> dataset of dict rows (reference: from_pandas)."""
+    rows = df.to_dict(orient="records")
+    return from_items(rows, parallelism)
+
+
+def to_pandas(ds: Dataset):
+    import pandas as pd
+    return pd.DataFrame(ds.take_all())
+
+
+def write_csv(ds: Dataset, path: str) -> str:
+    import csv
+    rows = ds.take_all()
+    if rows and not isinstance(rows[0], dict):
+        rows = [{"value": r} for r in rows]
+    fields: List[str] = []
+    for r in rows:
+        for k in r:
+            if k not in fields:
+                fields.append(k)
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fields)
+        w.writeheader()
+        w.writerows(rows)
+    return path
+
+
+def write_json(ds: Dataset, path: str) -> str:
+    import json
+    with open(path, "w") as f:
+        for r in ds.take_all():
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+def write_numpy(ds: Dataset, path: str,
+                column: Optional[str] = "data") -> str:
+    rows = ds.take_all()
+    if rows and isinstance(rows[0], dict):
+        arr = np.stack([np.asarray(r[column]) for r in rows])
+    else:
+        arr = np.asarray(rows)
+    np.save(path, arr)
+    return path
+
+
+class RandomAccessDataset:
+    """O(log n) point lookups on a sorted-by-key dataset (reference:
+    python/ray/data/random_access_dataset.py — sorted blocks + binary
+    search within the owning block)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._key = key
+        rows = sorted(ds.take_all(), key=lambda r: r[key])
+        n_blocks = max(1, ds.num_blocks())
+        splits = np.array_split(np.arange(len(rows)), n_blocks)
+        self._blocks: List[ray_tpu.ObjectRef] = []
+        self._bounds: List[Any] = []   # first key of each block
+        for idx in splits:
+            if len(idx) == 0:
+                continue
+            block = [rows[i] for i in idx]
+            self._blocks.append(ray_tpu.put(block))
+            self._bounds.append(block[0][key])
+
+    def get(self, key_value: Any) -> Optional[Dict[str, Any]]:
+        import bisect
+        if not self._blocks:
+            return None
+        i = bisect.bisect_right(self._bounds, key_value) - 1
+        if i < 0:
+            return None
+        block = ray_tpu.get(self._blocks[i])
+        lo = bisect.bisect_left([r[self._key] for r in block], key_value)
+        if lo < len(block) and block[lo][self._key] == key_value:
+            return block[lo]
+        return None
+
+    def multiget(self, keys: List[Any]) -> List[Optional[Dict[str, Any]]]:
+        return [self.get(k) for k in keys]

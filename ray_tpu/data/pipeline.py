@@ -1,0 +1,124 @@
+"""DatasetPipeline: windowed/repeated streaming execution.
+
+Capability parity with the reference's DatasetPipeline
+(python/ray/data/dataset_pipeline.py — ``ds.window(blocks_per_window)``
+/ ``ds.repeat(n)`` produce a pipeline whose windows execute their lazy
+stages one window at a time, bounding memory; per-epoch iteration via
+``iter_epochs``). TPU-relevant: ``iter_device_batches`` feeds a mesh one
+window at a time so host RAM holds only a window of blocks.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List, Optional
+
+import ray_tpu
+
+
+class DatasetPipeline:
+    def __init__(self, windows_fn: Callable[[], Iterator["Dataset"]],
+                 length: Optional[int] = None,
+                 epoch_fn: Optional[
+                     Callable[[], Iterator["Dataset"]]] = None):
+        self._windows_fn = windows_fn
+        self._length = length
+        # One epoch's windows (set by repeat(); used by iter_epochs so
+        # an "epoch" is one pass over the base data, not the whole
+        # repeated stream).
+        self._epoch_fn = epoch_fn or windows_fn
+
+    # --- construction helpers (used by Dataset.window/repeat) -------------
+
+    @classmethod
+    def from_windows(cls, datasets: List["Dataset"]) -> "DatasetPipeline":
+        return cls(lambda: iter(datasets), length=len(datasets))
+
+    # --- transforms (applied lazily per window) ---------------------------
+
+    def map(self, fn) -> "DatasetPipeline":
+        base = self._windows_fn
+        return DatasetPipeline(
+            lambda: (w.map(fn) for w in base()), self._length)
+
+    def map_batches(self, fn, **kwargs) -> "DatasetPipeline":
+        base = self._windows_fn
+        return DatasetPipeline(
+            lambda: (w.map_batches(fn, **kwargs) for w in base()),
+            self._length)
+
+    def filter(self, fn) -> "DatasetPipeline":
+        base = self._windows_fn
+        return DatasetPipeline(
+            lambda: (w.filter(fn) for w in base()), self._length)
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        base = self._windows_fn
+
+        def gen():
+            epoch = 0
+            while times is None or epoch < times:
+                yield from base()
+                epoch += 1
+
+        return DatasetPipeline(
+            gen, None if times is None or self._length is None
+            else self._length * times,
+            epoch_fn=base)
+
+    # --- consumption ------------------------------------------------------
+
+    def iter_windows(self) -> Iterator["Dataset"]:
+        return self._windows_fn()
+
+    def iter_rows(self) -> Iterator[Any]:
+        for w in self.iter_windows():
+            yield from w.iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "default") -> Iterator[Any]:
+        for w in self.iter_windows():
+            yield from w.iter_batches(batch_size=batch_size,
+                                      batch_format=batch_format)
+
+    def iter_epochs(self, num_epochs: int) -> Iterator["DatasetPipeline"]:
+        """Yields a one-epoch pipeline per epoch (for a repeat()ed
+        pipeline, one pass over the BASE data each — reference:
+        DatasetPipeline.iter_epochs)."""
+        for _ in range(num_epochs):
+            yield DatasetPipeline(self._epoch_fn)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def count(self) -> int:
+        return sum(w.count() for w in self.iter_windows())
+
+    def num_windows(self) -> Optional[int]:
+        return self._length
+
+    def split(self, n: int) -> List["DatasetPipeline"]:
+        """Lazy round-robin window split for n consumers (reference:
+        pipeline.split for per-worker shards). Works on unbounded
+        repeat() pipelines: each shard re-walks the window generator
+        and takes every n-th window."""
+        import itertools
+        base = self._windows_fn
+        length = None if self._length is None else \
+            (self._length + n - 1) // n
+
+        def shard_fn(i):
+            return lambda: itertools.islice(base(), i, None, n)
+
+        return [DatasetPipeline(shard_fn(i), length)
+                for i in range(n)]
+
+    def __repr__(self):
+        w = "?" if self._length is None else self._length
+        return f"DatasetPipeline(num_windows={w})"
+
+
+from ray_tpu.data.dataset import Dataset  # noqa: E402  (cycle-free tail)

@@ -1,8 +1,13 @@
 from ray_tpu.models.gpt2 import (GPT2, GPT2Config, gpt2_sharding_rules,
                                  gpt2_124m)
+from ray_tpu.models.llama import (Llama, LlamaConfig, generate,
+                                  llama2_7b, llama_sharding_rules,
+                                  llama_tiny)
 from ray_tpu.models.resnet import ResNet, ResNetConfig, resnet50, resnet18
 
 __all__ = [
     "GPT2", "GPT2Config", "gpt2_sharding_rules", "gpt2_124m",
     "ResNet", "ResNetConfig", "resnet50", "resnet18",
+    "Llama", "LlamaConfig", "llama2_7b", "llama_tiny",
+    "llama_sharding_rules", "generate",
 ]

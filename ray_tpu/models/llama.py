@@ -1,0 +1,329 @@
+"""Llama-family transformer in flax, mesh-first, with a jitted
+KV-cache generation loop.
+
+The reference has no model zoo; this family exists for the build's
+serving north star (BASELINE.md: "Serve Llama-2-7B JAX replicas
+autoscaled on v5e") and as the GQA/RoPE/SwiGLU exemplar of the model
+stack. TPU design mirrors models/gpt2.py: bf16 matmuls with fp32
+norms/logits, MXU-friendly dims, sharding declared as logical-axis
+rules (Megatron TP + FSDP), pallas/XLA attention via ray_tpu.ops.
+Decode uses a static-shape KV cache updated with dynamic_update_slice
+inside one jitted lax.while_loop — no per-token retrace.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.mesh.sharding import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 4096
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32          # < n_heads => grouped-query attention
+    hidden_dim: int = 11008       # SwiGLU inner dim
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def llama2_7b(**overrides) -> LlamaConfig:
+    return LlamaConfig(**overrides)
+
+
+def llama_tiny(**overrides) -> LlamaConfig:
+    """Test-size config for CPU-mesh tests (GQA exercised: 4 q heads,
+    2 kv heads)."""
+    d = dict(vocab_size=256, max_seq_len=128, dim=64, n_layers=2,
+             n_heads=4, n_kv_heads=2, hidden_dim=128)
+    d.update(overrides)
+    return LlamaConfig(**d)
+
+
+# --------------------------------------------------------------------------
+# RoPE
+# --------------------------------------------------------------------------
+
+def rope_freqs(head_dim: int, max_len: int, theta: float) -> jnp.ndarray:
+    inv = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    return jnp.outer(t, inv)   # [max_len, head_dim/2]
+
+
+def apply_rope(x: jnp.ndarray, freqs: jnp.ndarray,
+               positions: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, T, H, D]; positions: [T] or [B, T]."""
+    f = freqs[positions]                       # [..., T, D/2]
+    if f.ndim == 2:
+        f = f[None]                            # [1, T, D/2]
+    cos = jnp.cos(f)[..., None, :]             # [B|1, T, 1, D/2]
+    sin = jnp.sin(f)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        xf = x.astype(jnp.float32)
+        norm = xf * jax.lax.rsqrt(
+            jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, freqs, positions, kv_cache=None,
+                 cache_len=None):
+        cfg = self.config
+        B, T, _ = x.shape
+        hd = cfg.head_dim
+        q = nn.Dense(cfg.n_heads * hd, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="wq")(x)
+        k = nn.Dense(cfg.n_kv_heads * hd, use_bias=False,
+                     dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="wk")(x)
+        v = nn.Dense(cfg.n_kv_heads * hd, use_bias=False,
+                     dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="wv")(x)
+        q = q.reshape(B, T, cfg.n_heads, hd)
+        k = k.reshape(B, T, cfg.n_kv_heads, hd)
+        v = v.reshape(B, T, cfg.n_kv_heads, hd)
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+
+        new_cache = None
+        if kv_cache is not None:
+            # Decode path: append this step's K/V into the static cache.
+            ck, cv = kv_cache
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, cache_len, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, cache_len, 0, 0))
+            new_cache = (ck, cv)
+            k, v = ck, cv
+            S = k.shape[1]
+            # Mask out positions beyond cache_len + T.
+            kv_pos = jnp.arange(S)
+            valid = kv_pos < (cache_len + T)
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+            scores = jnp.einsum(
+                "bthd,bshd->bhts", q.astype(jnp.float32),
+                k.astype(jnp.float32)) / np.sqrt(hd)
+            q_pos = cache_len + jnp.arange(T)
+            causal = kv_pos[None, :] <= q_pos[:, None]
+            mask = (causal & valid[None, :])[None, None]
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            y = jnp.einsum("bhts,bshd->bthd",
+                           probs.astype(v.dtype), v)
+        else:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+            from ray_tpu.ops.attention import multi_head_attention
+            y = multi_head_attention(q, k, v, causal=True,
+                                     impl=cfg.attention_impl)
+        y = y.reshape(B, T, cfg.n_heads * hd)
+        out = nn.Dense(cfg.dim, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wo")(y)
+        return out, new_cache
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = nn.Dense(cfg.hidden_dim, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="w1")(x)
+        up = nn.Dense(cfg.hidden_dim, use_bias=False, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype, name="w3")(x)
+        return nn.Dense(cfg.dim, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="w2")(
+            nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, freqs, positions, kv_cache=None,
+                 cache_len=None):
+        cfg = self.config
+        h, new_cache = LlamaAttention(cfg, name="attention")(
+            RMSNorm(cfg.norm_eps, name="attention_norm")(x),
+            freqs, positions, kv_cache, cache_len)
+        x = x + h
+        x = x + LlamaMLP(cfg, name="feed_forward")(
+            RMSNorm(cfg.norm_eps, name="ffn_norm")(x))
+        return x, new_cache
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, kv_caches=None, cache_len=None):
+        """Returns (logits, new_kv_caches). kv_caches: list per layer of
+        (k, v) arrays [B, max_seq, n_kv_heads, head_dim]."""
+        cfg = self.config
+        B, T = input_ids.shape
+        tok = self.param("tok_embeddings",
+                         nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.dim), cfg.param_dtype)
+        x = tok[input_ids].astype(cfg.dtype)
+        freqs = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        if cache_len is None:
+            positions = jnp.arange(T)
+        else:
+            positions = cache_len + jnp.arange(T)
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock, static_argnums=())
+        new_caches = []
+        for i in range(cfg.n_layers):
+            cache_i = None if kv_caches is None else kv_caches[i]
+            x, nc = block(cfg, name=f"layers_{i}")(
+                x, freqs, positions, cache_i, cache_len)
+            new_caches.append(nc)
+        x = RMSNorm(cfg.norm_eps, name="norm")(x)
+        logits = jax.lax.dot_general(
+            x.astype(cfg.dtype), tok.astype(cfg.dtype),
+            (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if kv_caches is None:
+            return logits, None
+        return logits, new_caches
+
+
+def init_kv_caches(cfg: LlamaConfig, batch: int, max_len: int):
+    return [
+        (jnp.zeros((batch, max_len, cfg.n_kv_heads, cfg.head_dim),
+                   cfg.dtype),
+         jnp.zeros((batch, max_len, cfg.n_kv_heads, cfg.head_dim),
+                   cfg.dtype))
+        for _ in range(cfg.n_layers)]
+
+
+_DECODE_CACHE: dict = {}
+
+
+def generate(model: Llama, params, prompt_ids: jnp.ndarray,
+             max_new_tokens: int, temperature: float = 0.0,
+             rng: Optional[jax.Array] = None,
+             eos_id: Optional[int] = None) -> jnp.ndarray:
+    """Jitted autoregressive decode: one prefill call, then a
+    lax.while_loop of single-token steps over a static KV cache. The
+    jitted function is cached per (config, batch, prompt_len,
+    max_new_tokens, temperature, eos) so repeated calls — e.g. serve
+    requests — reuse one compilation.
+    """
+    cfg = model.config
+    B, T0 = prompt_ids.shape
+    total = T0 + max_new_tokens
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    cache_key = (cfg, B, T0, max_new_tokens, temperature, eos_id)
+    cached = _DECODE_CACHE.get(cache_key)
+    if cached is not None:
+        return cached(params, prompt_ids, rng)
+
+    @jax.jit
+    def _decode(params, prompt_ids, rng):
+        caches = init_kv_caches(cfg, B, total)
+        logits, caches = model.apply(params, prompt_ids,
+                                     kv_caches=caches, cache_len=0)
+        tokens = jnp.zeros((B, total), jnp.int32)
+        tokens = jax.lax.dynamic_update_slice(tokens, prompt_ids, (0, 0))
+
+        def pick(logits_last, key):
+            if temperature <= 0.0:
+                return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                key, logits_last / temperature, axis=-1).astype(jnp.int32)
+
+        first = pick(logits[:, -1], rng)
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, first[:, None], (0, T0))
+
+        def cond(state):
+            i, _tokens, _caches, _key, done = state
+            return (i < max_new_tokens) & ~done
+
+        def body(state):
+            i, tokens, caches, key, done = state
+            key, sub = jax.random.split(key)
+            cur = jax.lax.dynamic_slice(tokens, (0, T0 + i - 1),
+                                        (B, 1))
+            logits, caches = model.apply(
+                params, cur, kv_caches=caches, cache_len=T0 + i - 1)
+            nxt = pick(logits[:, -1], sub)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, nxt[:, None], (0, T0 + i))
+            if eos_id is not None:
+                done = jnp.all(jnp.any(
+                    tokens[:, T0:] == eos_id, axis=1))
+            return (i + 1, tokens, caches, key, done)
+
+        state = (jnp.int32(1), tokens, caches, rng, jnp.bool_(False))
+        _, tokens, _, _, _ = jax.lax.while_loop(cond, body, state)
+        return tokens
+
+    _DECODE_CACHE[cache_key] = _decode
+    return _decode(params, prompt_ids, rng)
+
+
+def llama_sharding_rules(fsdp: bool = True) -> ShardingRules:
+    """Megatron TP + FSDP rules for flax Llama params.
+
+    Column-parallel: wq/wk/wv, w1/w3. Row-parallel: wo, w2.
+    Embeddings shard vocab over `tensor`, dim over `fsdp`.
+    """
+    f = "fsdp" if fsdp else None
+    return ShardingRules([
+        (r"attention/w[qkv]/kernel", P(f, "tensor")),
+        (r"attention/wo/kernel",     P("tensor", f)),
+        (r"feed_forward/w[13]/kernel", P(f, "tensor")),
+        (r"feed_forward/w2/kernel",  P("tensor", f)),
+        (r"tok_embeddings$",         P("tensor", f)),
+    ])
+
+
+def llama_param_count(cfg: LlamaConfig) -> int:
+    per_layer = (cfg.dim * cfg.n_heads * cfg.head_dim +
+                 2 * cfg.dim * cfg.n_kv_heads * cfg.head_dim +
+                 cfg.n_heads * cfg.head_dim * cfg.dim +
+                 3 * cfg.dim * cfg.hidden_dim + 2 * cfg.dim)
+    return (cfg.vocab_size * cfg.dim + cfg.n_layers * per_layer +
+            cfg.dim)

@@ -1,4 +1,16 @@
+"""RL library: Algorithm/AlgorithmConfig surface with PPO (sync
+on-policy), DQN (off-policy replay) and IMPALA (async actor-learner with
+V-trace) over CPU rollout actors + a jitted JAX learner (TPU when
+present). Reference: rllib/ (SURVEY.md §2.3 L7, §3.6)."""
+from ray_tpu.rllib.algorithm import (Algorithm, AlgorithmConfig,
+                                     register_env)
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPoleEnv, SignEnv
+from ray_tpu.rllib.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
-__all__ = ["PPO", "PPOConfig", "CartPoleEnv", "SignEnv"]
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "register_env",
+    "PPO", "PPOConfig", "DQN", "DQNConfig", "Impala", "ImpalaConfig",
+    "CartPoleEnv", "SignEnv",
+]

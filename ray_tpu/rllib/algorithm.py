@@ -1,0 +1,156 @@
+"""Algorithm/AlgorithmConfig base: the RLlib surface shape.
+
+Capability parity with the reference's builder-pattern AlgorithmConfig
+(rllib/algorithms/algorithm_config.py — ``.environment().rollouts()
+.training().resources()`` chaining, ``.build()``) and the Algorithm
+Trainable contract (rllib/algorithms/algorithm.py:145 — ``train()`` one
+iteration, save/restore checkpoints, nests under Tune like any
+trainable). TPU-native stance per BASELINE.md: learners are jitted JAX
+updates (TPU when present), rollout workers are CPU actors.
+"""
+from __future__ import annotations
+
+import copy
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Type
+
+from ray_tpu.rllib.env import ENV_REGISTRY
+
+
+def register_env(name: str, creator: Callable[[], Any]) -> None:
+    """Register a custom env constructor (reference: ray.tune
+    register_env used throughout rllib)."""
+    ENV_REGISTRY[name] = creator
+
+
+class AlgorithmConfig:
+    """Chainable config; subclasses add algorithm-specific fields via
+    ``_defaults()``."""
+
+    def __init__(self):
+        self.env: str = "CartPole"
+        self.num_rollout_workers: int = 2
+        self.rollout_fragment_length: int = 256
+        self.gamma: float = 0.99
+        self.lr: float = 3e-4
+        self.hidden_size: int = 64
+        self.seed: int = 0
+        self.num_tpus_for_learner: float = 0.0
+        for k, v in self._defaults().items():
+            setattr(self, k, v)
+
+    def _defaults(self) -> Dict[str, Any]:
+        return {}
+
+    # --- chaining sections (reference surface) ----------------------------
+
+    def environment(self, env: Optional[str] = None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        return self
+
+    def rollouts(self, num_rollout_workers: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None
+                 ) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(
+                    f"{type(self).__name__} has no training field {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def resources(self, num_tpus_for_learner: Optional[float] = None
+                  ) -> "AlgorithmConfig":
+        if num_tpus_for_learner is not None:
+            self.num_tpus_for_learner = num_tpus_for_learner
+        return self
+
+    def debugging(self, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in vars(self).items()
+                if not k.startswith("_")}
+
+    def algo_class(self) -> Type["Algorithm"]:
+        raise NotImplementedError
+
+    def build(self) -> "Algorithm":
+        return self.algo_class()(self.copy())
+
+
+class Algorithm:
+    """One-iteration-at-a-time trainer (Trainable contract)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        from ray_tpu._private.usage_stats import record_library_usage
+        record_library_usage("rllib")
+        self.config = config
+        self.iteration = 0
+        self._setup()
+
+    def _setup(self) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        result = self.training_step()
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        return result
+
+    # --- checkpointing (Trainable.save/restore parity) --------------------
+
+    def get_state(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def save(self, path: str) -> str:
+        with open(path, "wb") as f:
+            pickle.dump({"iteration": self.iteration,
+                         "state": self.get_state()}, f)
+        return path
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self.iteration = blob["iteration"]
+        self.set_state(blob["state"])
+
+    def stop(self) -> None:
+        pass
+
+    # --- Tune integration -------------------------------------------------
+
+    @classmethod
+    def as_trainable(cls, base_config: AlgorithmConfig):
+        def trainable(config: Dict[str, Any]):
+            from ray_tpu.air import session
+            cfg = base_config.copy()
+            for k, v in config.items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+            iters = config.get("training_iterations", 10)
+            algo = cfg.build()
+            try:
+                for _ in range(iters):
+                    session.report(algo.train())
+            finally:
+                algo.stop()
+        return trainable

@@ -1,0 +1,272 @@
+"""DQN: off-policy value learning with replay + target network.
+
+Capability parity with the reference's DQN family
+(rllib/algorithms/dqn/dqn.py — replay-buffer training_step, target
+network sync every N steps, epsilon-greedy exploration on rollout
+workers; double-DQN action selection per the default config). The
+learner is one jitted update (TPU when present); rollout workers are
+CPU actors sampling with the current epsilon.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import ENV_REGISTRY
+
+
+def _q_net(obs_dim: int, num_actions: int, hidden: int):
+    import flax.linen as nn
+
+    class QNet(nn.Module):
+        @nn.compact
+        def __call__(self, obs):
+            h = nn.relu(nn.Dense(hidden)(obs))
+            h = nn.relu(nn.Dense(hidden)(h))
+            return nn.Dense(num_actions)(h)
+
+    return QNet()
+
+
+class ReplayBuffer:
+    """Uniform FIFO replay (reference:
+    rllib/utils/replay_buffers/replay_buffer.py)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.bool_)
+        self.size = 0
+        self._next = 0
+
+    def add_batch(self, batch: Dict[str, np.ndarray]):
+        n = len(batch["actions"])
+        for i in range(n):
+            j = self._next
+            self.obs[j] = batch["obs"][i]
+            self.next_obs[j] = batch["next_obs"][i]
+            self.actions[j] = batch["actions"][i]
+            self.rewards[j] = batch["rewards"][i]
+            self.dones[j] = batch["dones"][i]
+            self._next = (self._next + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, batch_size: int,
+               rng: np.random.RandomState) -> Dict[str, np.ndarray]:
+        idx = rng.randint(0, self.size, size=batch_size)
+        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+                "actions": self.actions[idx],
+                "rewards": self.rewards[idx], "dones": self.dones[idx]}
+
+
+class DQNRolloutWorker:
+    """CPU actor: epsilon-greedy transitions with the current Q-net."""
+
+    def __init__(self, env_name: str, hidden: int, seed: int):
+        self.env = ENV_REGISTRY[env_name]()
+        self.obs = self.env.reset(seed=seed)
+        self._rng = np.random.RandomState(seed)
+        self._params = None
+        self._model = _q_net(self.env.observation_dim,
+                             self.env.num_actions, hidden)
+        self._apply = None
+        self._episode_reward = 0.0
+        self.completed_rewards: List[float] = []
+
+    def set_weights(self, params):
+        self._params = params
+
+    def sample(self, num_steps: int, epsilon: float
+               ) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        if self._apply is None:
+            self._apply = jax.jit(self._model.apply)
+        obs_b, nobs_b, act_b, rew_b, done_b = [], [], [], [], []
+        for _ in range(num_steps):
+            if self._rng.rand() < epsilon:
+                action = int(self._rng.randint(self.env.num_actions))
+            else:
+                q = self._apply(self._params, jnp.asarray(self.obs[None]))
+                action = int(np.asarray(q[0]).argmax())
+            next_obs, reward, done, _ = self.env.step(action)
+            obs_b.append(self.obs)
+            nobs_b.append(next_obs)
+            act_b.append(action)
+            rew_b.append(reward)
+            done_b.append(done)
+            self._episode_reward += reward
+            if done:
+                self.completed_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self.obs = self.env.reset()
+            else:
+                self.obs = next_obs
+        return {"obs": np.asarray(obs_b, np.float32),
+                "next_obs": np.asarray(nobs_b, np.float32),
+                "actions": np.asarray(act_b, np.int32),
+                "rewards": np.asarray(rew_b, np.float32),
+                "dones": np.asarray(done_b, np.bool_)}
+
+    def episode_rewards(self) -> List[float]:
+        return list(self.completed_rewards[-100:])
+
+
+class DQNConfig(AlgorithmConfig):
+    def _defaults(self) -> Dict[str, Any]:
+        return {
+            "replay_buffer_capacity": 50_000,
+            "learning_starts": 500,
+            "train_batch_size": 64,
+            "num_sgd_iter_per_step": 8,
+            "target_network_update_freq": 4,   # in training iterations
+            "epsilon_initial": 1.0,
+            "epsilon_final": 0.05,
+            "epsilon_decay_iters": 20,
+            "double_q": True,
+            "rollout_fragment_length": 128,
+        }
+
+    def algo_class(self):
+        return DQN
+
+
+class DQN(Algorithm):
+    def _setup(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        env = ENV_REGISTRY[cfg.env]()
+        self._obs_dim = env.observation_dim
+        self._num_actions = env.num_actions
+        self._model = _q_net(self._obs_dim, self._num_actions,
+                             cfg.hidden_size)
+        key = jax.random.PRNGKey(cfg.seed)
+        self._params = self._model.init(
+            key, jnp.zeros((1, self._obs_dim), jnp.float32))
+        self._target_params = self._params
+        self._opt = optax.adam(cfg.lr)
+        self._opt_state = self._opt.init(self._params)
+        self._rng = np.random.RandomState(cfg.seed)
+        self._buffer = ReplayBuffer(cfg.replay_buffer_capacity,
+                                    self._obs_dim)
+        worker_cls = ray_tpu.remote(num_cpus=1)(DQNRolloutWorker)
+        self._workers = [
+            worker_cls.remote(cfg.env, cfg.hidden_size, cfg.seed + i)
+            for i in range(cfg.num_rollout_workers)]
+        self._sync_weights()
+        self._update = self._build_update()
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        model = self._model
+        gamma = cfg.gamma
+        double_q = cfg.double_q
+        opt = self._opt
+
+        def loss_fn(params, target_params, batch):
+            q = model.apply(params, batch["obs"])
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1)[:, 0]
+            q_next_target = model.apply(target_params, batch["next_obs"])
+            if double_q:
+                # Online net picks the action, target net evaluates it.
+                q_next_online = model.apply(params, batch["next_obs"])
+                next_a = jnp.argmax(q_next_online, axis=1)
+                next_q = jnp.take_along_axis(
+                    q_next_target, next_a[:, None], axis=1)[:, 0]
+            else:
+                next_q = q_next_target.max(axis=1)
+            target = batch["rewards"] + gamma * next_q * \
+                (1.0 - batch["dones"].astype(jnp.float32))
+            td = q_taken - jax.lax.stop_gradient(target)
+            return jnp.mean(td ** 2)
+
+        @jax.jit
+        def update(params, opt_state, target_params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, target_params, batch)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            import optax as _optax
+            params = _optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return update
+
+    def _sync_weights(self):
+        import jax
+        host = jax.device_get(self._params)
+        ray_tpu.get([w.set_weights.remote(host) for w in self._workers])
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.iteration / max(1, cfg.epsilon_decay_iters))
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        cfg = self.config
+        t0 = time.time()
+        eps = self._epsilon()
+        batches = ray_tpu.get([
+            w.sample.remote(cfg.rollout_fragment_length, eps)
+            for w in self._workers])
+        for b in batches:
+            self._buffer.add_batch(b)
+        steps = sum(len(b["actions"]) for b in batches)
+        losses = []
+        if self._buffer.size >= cfg.learning_starts:
+            for _ in range(cfg.num_sgd_iter_per_step):
+                mb = self._buffer.sample(cfg.train_batch_size, self._rng)
+                mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                self._params, self._opt_state, loss = self._update(
+                    self._params, self._opt_state,
+                    self._target_params, mb)
+                losses.append(float(loss))
+            if (self.iteration + 1) % cfg.target_network_update_freq == 0:
+                self._target_params = self._params
+            self._sync_weights()
+        rewards: List[float] = []
+        for w in self._workers:
+            rewards.extend(ray_tpu.get(w.episode_rewards.remote()))
+        return {
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else float("nan"),
+            "num_env_steps_sampled": steps,
+            "buffer_size": self._buffer.size,
+            "epsilon": eps,
+            "loss": float(np.mean(losses)) if losses else None,
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+        return {"params": jax.device_get(self._params),
+                "target_params": jax.device_get(self._target_params)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self._params = state["params"]
+        self._target_params = state["target_params"]
+        self._opt_state = self._opt.init(self._params)
+        self._sync_weights()
+
+    def stop(self):
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -1,0 +1,191 @@
+"""IMPALA: asynchronous actor-learner training with V-trace correction.
+
+Capability parity with the reference's IMPALA
+(rllib/algorithms/impala/impala.py:620 training_step — workers sample
+continuously and asynchronously; the learner consumes batches without
+waiting for all workers, correcting off-policyness with V-trace
+[Espeholt et al. 2018]). Here: rollout-worker actors sample with their
+(possibly stale) policy snapshot; the learner drains whatever batches
+are ready each step (ray_tpu.wait), applies one jitted V-trace update
+per batch, and pushes fresh weights back — the async pattern rides the
+task/actor layer the same way the reference rides object refs.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.ppo import RolloutWorker, _policy_defs
+from ray_tpu.rllib.env import ENV_REGISTRY
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def _defaults(self) -> Dict[str, Any]:
+        return {
+            "vtrace_clip_rho": 1.0,
+            "vtrace_clip_c": 1.0,
+            "vf_coef": 0.5,
+            "entropy_coef": 0.01,
+            "max_batches_per_step": 4,
+            "rollout_fragment_length": 128,
+        }
+
+    def algo_class(self):
+        return Impala
+
+
+class Impala(Algorithm):
+    def _setup(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        env = ENV_REGISTRY[cfg.env]()
+        self._model = _policy_defs(env.observation_dim,
+                                   env.num_actions, cfg.hidden_size)
+        key = jax.random.PRNGKey(cfg.seed)
+        self._params = self._model.init(
+            key, jnp.zeros((1, env.observation_dim), jnp.float32))
+        self._opt = optax.adam(cfg.lr)
+        self._opt_state = self._opt.init(self._params)
+        worker_cls = ray_tpu.remote(num_cpus=1)(RolloutWorker)
+        self._workers = [
+            worker_cls.remote(cfg.env, cfg.hidden_size, cfg.seed + i)
+            for i in range(cfg.num_rollout_workers)]
+        host = jax.device_get(self._params)
+        ray_tpu.get([w.set_weights.remote(host) for w in self._workers])
+        # Kick off the first round of async sampling; _inflight maps
+        # sample-ref -> worker so completed workers are immediately
+        # re-tasked (the reference's async request manager).
+        self._inflight: Dict[Any, Any] = {
+            w.sample.remote(cfg.rollout_fragment_length): w
+            for w in self._workers}
+        self._update = self._build_update()
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        model = self._model
+        gamma = cfg.gamma
+        rho_bar = cfg.vtrace_clip_rho
+        c_bar = cfg.vtrace_clip_c
+
+        def vtrace(values, last_value, rewards, dones, rhos):
+            """V-trace targets via a reverse scan (Espeholt et al. '18,
+            eq. 1): vs = V(s) + sum_k (gamma^k * prod(c) * delta_k)."""
+            discounts = gamma * (1.0 - dones.astype(jnp.float32))
+            next_values = jnp.concatenate(
+                [values[1:], jnp.array([last_value])])
+            clipped_rho = jnp.minimum(rho_bar, rhos)
+            clipped_c = jnp.minimum(c_bar, rhos)
+            deltas = clipped_rho * (
+                rewards + discounts * next_values - values)
+
+            def body(acc, xs):
+                delta, disc, c = xs
+                acc = delta + disc * c * acc
+                return acc, acc
+
+            _, advs = jax.lax.scan(
+                body, jnp.float32(0.0),
+                (deltas, discounts, clipped_c), reverse=True)
+            vs = values + advs
+            next_vs = jnp.concatenate(
+                [vs[1:], jnp.array([last_value])])
+            pg_adv = clipped_rho * (
+                rewards + discounts * next_vs - values)
+            return jax.lax.stop_gradient(vs), \
+                jax.lax.stop_gradient(pg_adv)
+
+        def loss_fn(params, batch):
+            logits, values = model.apply(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            rhos = jnp.exp(logp - batch["logp"])
+            vs, pg_adv = vtrace(
+                jax.lax.stop_gradient(values), batch["last_value"],
+                batch["rewards"], batch["dones"], rhos)
+            pg_loss = -jnp.mean(logp * pg_adv)
+            vf_loss = jnp.mean((values - vs) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+            return pg_loss + cfg.vf_coef * vf_loss - \
+                cfg.entropy_coef * entropy
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = self._opt.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        t0 = time.time()
+        losses: List[float] = []
+        steps = 0
+        consumed = 0
+        host = None
+        while consumed < cfg.max_batches_per_step and self._inflight:
+            ready, _ = ray_tpu.wait(list(self._inflight),
+                                    num_returns=1, timeout=30)
+            if not ready:
+                break
+            ref = ready[0]
+            worker = self._inflight.pop(ref)
+            batch = ray_tpu.get(ref)
+            jb = {k: jnp.asarray(v) for k, v in batch.items()}
+            self._params, self._opt_state, loss = self._update(
+                self._params, self._opt_state, jb)
+            losses.append(float(loss))
+            steps += len(batch["actions"])
+            consumed += 1
+            # Refresh the worker's policy and re-task it immediately.
+            host = jax.device_get(self._params)
+            worker.set_weights.remote(host)
+            self._inflight[worker.sample.remote(
+                cfg.rollout_fragment_length)] = worker
+        rewards: List[float] = []
+        for w in self._workers:
+            rewards.extend(ray_tpu.get(w.episode_rewards.remote()))
+        return {
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else float("nan"),
+            "num_env_steps_sampled": steps,
+            "num_batches_consumed": consumed,
+            "loss": float(np.mean(losses)) if losses else None,
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+        return {"params": jax.device_get(self._params)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        import jax
+        self._params = state["params"]
+        self._opt_state = self._opt.init(self._params)
+        host = jax.device_get(self._params)
+        ray_tpu.get([w.set_weights.remote(host) for w in self._workers])
+
+    def stop(self):
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

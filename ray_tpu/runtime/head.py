@@ -263,11 +263,16 @@ class HeadService:
 
     def _dispatch(self, w: _WorkerInfo, meta: Dict[str, Any]):
         task_id = meta["task_id"]
-        try:
-            w.client.call("push_task", meta["payload"])
-            failure: Optional[BaseException] = None
-        except RpcError as e:
-            failure = e
+        failure: Optional[BaseException] = None
+        for attempt in range(2):
+            try:
+                w.client.call("push_task", meta["payload"])
+                failure = None
+                break
+            except RpcError as e:
+                # One retry: a stale pooled socket raises the same error
+                # as a dead worker; the retry opens a fresh connection.
+                failure = e
         with self._lock:
             w.running.discard(task_id)
             if meta.get("pg_id") is None and w.alive:

@@ -93,6 +93,8 @@ def deployment(_target=None, *, name: Optional[str] = None,
 def run(dep: Deployment, *, wait_for_ready: bool = True,
         timeout_s: float = 60.0) -> DeploymentHandle:
     """Deploy (or update) and return a handle."""
+    from ray_tpu._private.usage_stats import record_library_usage
+    record_library_usage("serve")
     controller = get_or_create_controller()
     ray_tpu.get(controller.deploy.remote(
         dep.name, dep._as_class(), dep._init_args, dep._init_kwargs,

@@ -1,0 +1,56 @@
+"""LLM serving: Llama replicas behind serve deployments.
+
+The build's serving north star (BASELINE.md: "Serve Llama-2-7B JAX
+replicas autoscaled on v5e"): a deployment class wrapping a jitted
+Llama decode (models/llama.py generate — prefill + while_loop KV-cache
+steps), with request batching via the serve batching queue and an
+optional device mesh per replica (tensor-parallel serving = a replica
+whose mesh has a nontrivial `tensor` axis; cf. serve/_private/replica.py
+in the reference for the replica wrapper shape)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class LlamaDeployment:
+    """Deployment-ready Llama wrapper: __init__ builds/loads the model,
+    __call__ generates. Wrap with @serve.deployment at use site so
+    num_replicas/autoscaling stay caller-controlled."""
+
+    def __init__(self, config=None, params=None, max_new_tokens: int = 64,
+                 temperature: float = 0.0):
+        import jax
+        from ray_tpu.models.llama import Llama, llama_tiny
+        self.cfg = config or llama_tiny()
+        self.model = Llama(self.cfg)
+        if params is None:
+            import jax.numpy as jnp
+            params = self.model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 8), jnp.int32))
+        self.params = params
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.mesh = None
+
+    def setup_mesh(self, mesh):
+        """Called by the serve replica when cfg.mesh is set: shard the
+        params tensor-parallel over the replica's mesh."""
+        from ray_tpu.mesh.sharding import shard_params
+        from ray_tpu.models.llama import llama_sharding_rules
+        self.mesh = mesh
+        self.params = shard_params(self.params,
+                                   llama_sharding_rules(fsdp=False),
+                                   mesh)
+
+    def __call__(self, prompt_ids: List[int]) -> List[int]:
+        """One request: token ids in, generated ids out."""
+        import jax.numpy as jnp
+        from ray_tpu.models.llama import generate
+        prompt = jnp.asarray([prompt_ids], jnp.int32)
+        out = generate(self.model, self.params, prompt,
+                       max_new_tokens=self.max_new_tokens,
+                       temperature=self.temperature)
+        return np.asarray(out[0]).tolist()

@@ -51,6 +51,8 @@ class BaseTrainer:
         return None
 
     def fit(self) -> Result:
+        from ray_tpu._private.usage_stats import record_library_usage
+        record_library_usage("train")
         failure_config = (self.run_config.failure_config or
                           FailureConfig())
         max_failures = failure_config.max_failures

@@ -121,6 +121,8 @@ class Tuner:
     # --- the event loop ---------------------------------------------------
 
     def fit(self) -> ResultGrid:
+        from ray_tpu._private.usage_stats import record_library_usage
+        record_library_usage("tune")
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler(tc.metric, tc.mode)
         searcher = tc.search_alg or BasicVariantGenerator(

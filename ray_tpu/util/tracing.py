@@ -63,6 +63,9 @@ def teardown_tracing() -> None:
     global _enabled, _exporter, _trace_dir
     _enabled = False
     _exporter = None
+    if _trace_dir is not None:
+        import shutil
+        shutil.rmtree(_trace_dir, ignore_errors=True)
     _trace_dir = None
     os.environ.pop(_TRACE_DIR_ENV, None)
     with _lock:
@@ -77,8 +80,9 @@ def get_spans(include_workers: bool = True) -> List[Dict[str, Any]]:
     with _lock:
         out = list(_spans)
     if include_workers and _trace_dir and os.path.isdir(_trace_dir):
+        own = f"{os.getpid()}.jsonl"   # own spans are already in _spans
         for fname in os.listdir(_trace_dir):
-            if not fname.endswith(".jsonl"):
+            if not fname.endswith(".jsonl") or fname == own:
                 continue
             try:
                 with open(os.path.join(_trace_dir, fname)) as f:

@@ -129,3 +129,100 @@ def test_read_csv_json(rt, tmp_path):
 def test_union(rt):
     a, b = rd.range(5), rd.range(5).map(lambda x: x + 5)
     assert sorted(a.union(b).take_all()) == list(range(10))
+
+
+# ---- widened surface: datasources, pipeline, zip/limit, random access ----
+
+def test_read_write_text_binary_numpy(rt, tmp_path):
+    from ray_tpu import data
+    p = tmp_path / "a.txt"
+    p.write_text("alpha\nbeta\ngamma\n")
+    ds = data.read_text(str(p))
+    assert ds.take_all() == ["alpha", "beta", "gamma"]
+
+    binp = tmp_path / "b.bin"
+    binp.write_bytes(b"\x01\x02")
+    ds = data.read_binary_files(str(binp), include_paths=True)
+    row = ds.take_all()[0]
+    assert row["bytes"] == b"\x01\x02" and row["path"].endswith("b.bin")
+
+    import numpy as np
+    arr = np.arange(12).reshape(6, 2).astype(np.float32)
+    np.save(tmp_path / "c.npy", arr)
+    ds = data.read_numpy(str(tmp_path / "c.npy"))
+    assert ds.count() == 6
+    out = tmp_path / "out.npy"
+    ds.write_numpy(str(out))
+    assert np.load(out).shape == (6, 2)
+
+
+def test_from_to_pandas_roundtrip(rt):
+    import pandas as pd
+    from ray_tpu import data
+    df = pd.DataFrame({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+    ds = data.from_pandas(df)
+    assert ds.count() == 3
+    assert ds.sum("x") == 6
+    df2 = ds.to_pandas()
+    assert list(df2["y"]) == ["a", "b", "c"]
+
+
+def test_read_parquet_roundtrip(rt, tmp_path):
+    import pandas as pd
+    from ray_tpu import data
+    df = pd.DataFrame({"a": [1, 2, 3], "b": [1.5, 2.5, 3.5]})
+    path = tmp_path / "t.parquet"
+    df.to_parquet(path)
+    ds = data.read_parquet(str(path))
+    assert ds.count() == 3
+    assert ds.sum("a") == 6
+
+
+def test_zip_limit_unique_minmax(rt):
+    from ray_tpu import data
+    a = data.from_items([{"x": i} for i in range(5)])
+    b = data.from_items([{"y": i * 10} for i in range(5)])
+    z = a.zip(b)
+    assert z.take_all()[2] == {"x": 2, "y": 20}
+    assert data.range(100).limit(7).count() == 7
+    d = data.from_items([3, 1, 3, 2, 1])
+    assert d.unique() == [3, 1, 2]
+    assert d.min() == 1 and d.max() == 3
+
+
+def test_dataset_pipeline_window_repeat(rt):
+    from ray_tpu import data
+    ds = data.range(32, parallelism=8)
+    pipe = ds.window(blocks_per_window=2)
+    assert pipe.num_windows() == 4
+    assert pipe.count() == 32
+    # map applies per window lazily
+    doubled = pipe.map(lambda x: x * 2)
+    assert sorted(doubled.take(32)) == sorted(x * 2 for x in range(32))
+    # repeat for epochs
+    rep = ds.repeat(3)
+    assert rep.count() == 96
+    epochs = list(ds.repeat(2).iter_epochs(2))
+    assert len(epochs) == 2
+    # Each epoch covers the BASE data exactly once, not the repeats.
+    assert all(e.count() == 32 for e in epochs)
+    # split for consumers
+    shards = pipe.split(2)
+    assert sum(s.count() for s in shards) == 32
+    # Lazy split works on an unbounded pipeline.
+    inf_shards = ds.window(blocks_per_window=2).repeat(None).split(2)
+    it = inf_shards[0].iter_rows()
+    assert len([next(it) for _ in range(40)]) == 40
+
+
+def test_random_access_dataset(rt):
+    from ray_tpu import data
+    ds = data.from_items(
+        [{"id": i, "val": i * i} for i in range(50)], parallelism=5)
+    rad = data.RandomAccessDataset(ds, "id")
+    assert rad.get(7) == {"id": 7, "val": 49}
+    assert rad.get(49) == {"id": 49, "val": 2401}
+    assert rad.get(0) == {"id": 0, "val": 0}
+    assert rad.get(100) is None
+    assert rad.multiget([3, 100, 10]) == [
+        {"id": 3, "val": 9}, None, {"id": 10, "val": 100}]

@@ -186,3 +186,17 @@ def test_http_proxy(serve_rt):
             assert e.code == 404
     finally:
         stop_http()
+
+
+def test_llama_llm_deployment(serve_rt):
+    """North-star path: Llama JAX replicas behind serve (tiny config)."""
+    from ray_tpu.serve.llm import LlamaDeployment
+
+    LLM = serve.deployment(num_replicas=1)(LlamaDeployment)
+    handle = serve.run(LLM.bind(max_new_tokens=4))
+    out = ray_tpu.get(handle.remote([1, 2, 3]))
+    assert len(out) == 7           # 3 prompt + 4 generated
+    assert out[:3] == [1, 2, 3]
+    # Deterministic greedy decode across requests.
+    out2 = ray_tpu.get(handle.remote([1, 2, 3]))
+    assert out == out2
