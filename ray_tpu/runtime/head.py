@@ -619,6 +619,25 @@ class HeadService:
         store = self._get_store()
         return store.stats()
 
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Cluster-wide metrics from the native shm segment (N20)."""
+        reg = getattr(self, "_metrics_reg", None)
+        if reg is None:
+            from ray_tpu._private.shm_metrics import ShmMetricsRegistry
+            try:
+                reg = self._metrics_reg = ShmMetricsRegistry.attach(
+                    self.store_name + "_m")
+            except OSError:
+                return {}
+        return reg.read_all()
+
+    def metrics_prometheus(self) -> str:
+        reg = getattr(self, "_metrics_reg", None)
+        if reg is None:
+            self.metrics_snapshot()
+            reg = getattr(self, "_metrics_reg", None)
+        return reg.prometheus_text() if reg else ""
+
     def ping(self) -> str:
         return "pong"
 

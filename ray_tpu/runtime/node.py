@@ -34,6 +34,10 @@ class NodeManager:
         from ray_tpu._private.shm_store import ShmObjectStore
         self.store = ShmObjectStore.create(self.store_name,
                                            store_capacity)
+        # Native metrics segment: workers record with lock-free atomics,
+        # the head aggregates without RPC (N20, src/metrics/).
+        from ray_tpu._private.shm_metrics import ShmMetricsRegistry
+        self.metrics = ShmMetricsRegistry.create(self.store_name + "_m")
         self.head_service = HeadService(self.store_name)
         self.head_server = RpcServer(self.head_service)
         self.head_service.attach_node_manager(
@@ -121,6 +125,10 @@ class NodeManager:
     def stop(self):
         self._stopped = True
         self.head_service.shutdown()
+        try:
+            self.metrics.close()
+        except Exception:
+            pass
         deadline = time.time() + 3
         for proc in self.procs.values():
             try:

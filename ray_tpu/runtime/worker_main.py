@@ -115,6 +115,12 @@ class Executor:
                     execution_span(spec.get("name", "task"), "task",
                                    spec.get("trace_ctx")):
                 result = func(*args, **kwargs)
+            from ray_tpu.util import metrics as metrics_mod
+            reg = metrics_mod.get_shm_registry()
+            if reg is not None:
+                # Before the result write: a caller observing the result
+                # must also observe the counter.
+                reg.counter_add("raytpu_tasks_executed_total")
             self._write_returns(spec["return_ids"],
                                 spec["num_returns"], result)
             return "ok"
@@ -331,6 +337,13 @@ def main():
 
     from ray_tpu._private.shm_store import ShmObjectStore
     store = ShmObjectStore.attach(args.store)
+    try:
+        from ray_tpu._private.shm_metrics import ShmMetricsRegistry
+        from ray_tpu.util import metrics as metrics_mod
+        metrics_mod.set_shm_registry(
+            ShmMetricsRegistry.attach(args.store + "_m"))
+    except Exception:
+        pass   # metrics are best-effort
     head = RpcClient(args.head)
     resources = json.loads(args.resources)
 

@@ -184,6 +184,20 @@ def memory(address):
     click.echo(json.dumps(stats, indent=2))
 
 
+@cli.command()
+@click.option("--address", default=None)
+@click.option("--prometheus", is_flag=True,
+              help="Prometheus text exposition instead of JSON.")
+def metrics(address, prometheus):
+    """Cluster-wide metrics from the native shm segment."""
+    client = _head_client(address)
+    if prometheus:
+        click.echo(client.call("metrics_prometheus"), nl=False)
+    else:
+        click.echo(json.dumps(client.call("metrics_snapshot"),
+                              indent=2))
+
+
 @cli.command("list")
 @click.option("--address", default=None)
 @click.argument("kind",

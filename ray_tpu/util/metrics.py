@@ -13,6 +13,25 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
+# Optional node-wide shared-memory sink (the native stats substrate,
+# src/metrics/shm_metrics.cc): when attached — worker processes attach
+# at bootstrap — every record also lands in the shm segment so the head
+# aggregates across processes without RPC.
+_shm_registry = None
+
+
+def set_shm_registry(reg) -> None:
+    global _shm_registry
+    _shm_registry = reg
+
+
+def get_shm_registry():
+    return _shm_registry
+
+
+def _shm_key(name: str, tags: tuple) -> str:
+    from ray_tpu._private.shm_metrics import metric_key
+    return metric_key(name, dict(tags))
 
 
 def registry() -> Dict[str, "Metric"]:
@@ -70,6 +89,8 @@ class Counter(Metric):
         key = self._resolve_tags(tags)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
+        if _shm_registry is not None:
+            _shm_registry.counter_add(_shm_key(self.name, key), value)
 
     def _samples(self):
         with self._lock:
@@ -87,6 +108,8 @@ class Gauge(Metric):
         key = self._resolve_tags(tags)
         with self._lock:
             self._values[key] = float(value)
+        if _shm_registry is not None:
+            _shm_registry.gauge_set(_shm_key(self.name, key), value)
 
     def _samples(self):
         with self._lock:
@@ -115,6 +138,9 @@ class Histogram(Metric):
             counts[bisect.bisect_left(self.boundaries, value)] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+        if _shm_registry is not None:
+            _shm_registry.histogram_observe(_shm_key(self.name, key),
+                                            value)
 
     def _samples(self):
         with self._lock:

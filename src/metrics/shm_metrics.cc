@@ -1,0 +1,216 @@
+// Shared-memory metrics core: the native stats substrate (N20).
+//
+// Capability parity with the reference's C++ stats core
+// (src/ray/stats/metric.h DEFINE_stats registry + metric_exporter.cc
+// export path): a fixed-size shared-memory segment of named metric
+// slots updated with lock-free atomics by any attached process (worker
+// processes record; the head aggregates by reading the segment — no
+// RPC on the metrics hot path, which is the TPU-native answer to the
+// reference's opencensus-to-agent pipeline).
+//
+// C ABI for ctypes (no pybind11 in the image). Types: counter (add),
+// gauge (set), histogram (fixed exponential buckets).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x4d455452494b5301ull;  // "METRIKS\1"
+constexpr int kMaxMetrics = 1024;
+constexpr int kNameSize = 128;     // "name|tag1=v1,tag2=v2"
+constexpr int kNumBuckets = 16;    // histogram: exponential, base 2
+
+enum MetricType : uint32_t {
+  kUnused = 0,
+  kCounter = 1,
+  kGauge = 2,
+  kHistogram = 3,
+};
+
+struct Slot {
+  char name[kNameSize];
+  std::atomic<uint32_t> type;
+  std::atomic<uint64_t> count;          // counter / histogram count
+  std::atomic<double> value;            // gauge / counter value
+  std::atomic<double> sum;              // histogram sum
+  std::atomic<uint64_t> buckets[kNumBuckets];
+};
+
+struct Header {
+  uint64_t magic;
+  pthread_mutex_t create_mutex;   // only for slot creation
+  std::atomic<uint32_t> num_slots;
+  Slot slots[kMaxMetrics];
+};
+
+struct Registry {
+  Header* hdr;
+  size_t map_size;
+};
+
+Slot* FindSlot(Header* hdr, const char* name) {
+  uint32_t n = hdr->num_slots.load(std::memory_order_acquire);
+  for (uint32_t i = 0; i < n; i++) {
+    if (strncmp(hdr->slots[i].name, name, kNameSize) == 0) {
+      return &hdr->slots[i];
+    }
+  }
+  return nullptr;
+}
+
+Slot* FindOrCreate(Header* hdr, const char* name, uint32_t type) {
+  Slot* s = FindSlot(hdr, name);
+  if (s != nullptr) return s;
+  pthread_mutex_lock(&hdr->create_mutex);
+  s = FindSlot(hdr, name);   // re-check under the lock
+  if (s == nullptr) {
+    uint32_t n = hdr->num_slots.load(std::memory_order_relaxed);
+    if (n >= kMaxMetrics) {
+      pthread_mutex_unlock(&hdr->create_mutex);
+      return nullptr;
+    }
+    s = &hdr->slots[n];
+    strncpy(s->name, name, kNameSize - 1);
+    s->name[kNameSize - 1] = '\0';
+    s->type.store(type, std::memory_order_relaxed);
+    hdr->num_slots.store(n + 1, std::memory_order_release);
+  }
+  pthread_mutex_unlock(&hdr->create_mutex);
+  return s;
+}
+
+void AtomicAddDouble(std::atomic<double>* target, double delta) {
+  double cur = target->load(std::memory_order_relaxed);
+  while (!target->compare_exchange_weak(cur, cur + delta)) {
+  }
+}
+
+int BucketIndex(double v) {
+  // Exponential buckets: [0,1), [1,2), [2,4), ... [2^14, inf)
+  if (v < 1.0) return 0;
+  int idx = 1;
+  double bound = 2.0;
+  while (idx < kNumBuckets - 1 && v >= bound) {
+    bound *= 2.0;
+    idx++;
+  }
+  return idx;
+}
+
+}  // namespace
+
+extern "C" {
+
+Registry* metrics_create(const char* name) {
+  size_t map_size = sizeof(Header);
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)map_size) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* hdr = (Header*)mem;
+  memset(hdr, 0, sizeof(Header));
+  hdr->magic = kMagic;
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->create_mutex, &ma);
+  Registry* r = new Registry{hdr, map_size};
+  return r;
+}
+
+Registry* metrics_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t map_size = sizeof(Header);
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* hdr = (Header*)mem;
+  if (hdr->magic != kMagic) {
+    munmap(mem, map_size);
+    return nullptr;
+  }
+  return new Registry{hdr, map_size};
+}
+
+void metrics_detach(Registry* r) {
+  if (r == nullptr) return;
+  munmap(r->hdr, r->map_size);
+  delete r;
+}
+
+void metrics_destroy(Registry* r, const char* name) {
+  if (r == nullptr) return;
+  munmap(r->hdr, r->map_size);
+  shm_unlink(name);
+  delete r;
+}
+
+// type: 1=counter 2=gauge 3=histogram. Returns 0 ok, -1 full.
+int metrics_counter_add(Registry* r, const char* name, double delta) {
+  Slot* s = FindOrCreate(r->hdr, name, kCounter);
+  if (s == nullptr) return -1;
+  AtomicAddDouble(&s->value, delta);
+  s->count.fetch_add(1, std::memory_order_relaxed);
+  return 0;
+}
+
+int metrics_gauge_set(Registry* r, const char* name, double value) {
+  Slot* s = FindOrCreate(r->hdr, name, kGauge);
+  if (s == nullptr) return -1;
+  s->value.store(value, std::memory_order_relaxed);
+  return 0;
+}
+
+int metrics_histogram_observe(Registry* r, const char* name, double v) {
+  Slot* s = FindOrCreate(r->hdr, name, kHistogram);
+  if (s == nullptr) return -1;
+  AtomicAddDouble(&s->sum, v);
+  s->count.fetch_add(1, std::memory_order_relaxed);
+  s->buckets[BucketIndex(v)].fetch_add(1, std::memory_order_relaxed);
+  return 0;
+}
+
+int metrics_num_slots(Registry* r) {
+  return (int)r->hdr->num_slots.load(std::memory_order_acquire);
+}
+
+// Read slot i into out params. Returns type, or 0 if out of range.
+int metrics_read_slot(Registry* r, int i, char* out_name,
+                      double* out_value, uint64_t* out_count,
+                      double* out_sum, uint64_t* out_buckets) {
+  uint32_t n = r->hdr->num_slots.load(std::memory_order_acquire);
+  if (i < 0 || (uint32_t)i >= n) return 0;
+  Slot* s = &r->hdr->slots[i];
+  strncpy(out_name, s->name, kNameSize);
+  *out_value = s->value.load(std::memory_order_relaxed);
+  *out_count = s->count.load(std::memory_order_relaxed);
+  *out_sum = s->sum.load(std::memory_order_relaxed);
+  for (int b = 0; b < kNumBuckets; b++) {
+    out_buckets[b] = s->buckets[b].load(std::memory_order_relaxed);
+  }
+  return (int)s->type.load(std::memory_order_relaxed);
+}
+
+int metrics_name_size() { return kNameSize; }
+int metrics_num_buckets() { return kNumBuckets; }
+
+}  // extern "C"

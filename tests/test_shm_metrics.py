@@ -1,0 +1,74 @@
+"""Native shm metrics core tests (reference analogue: the stats core
+src/ray/stats/metric.h + metrics export pipeline, SURVEY.md §2.1 N20)."""
+import os
+import uuid
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.shm_metrics import ShmMetricsRegistry, metric_key
+
+
+@pytest.fixture
+def reg():
+    name = f"/raytpu_test_m_{uuid.uuid4().hex[:8]}"
+    r = ShmMetricsRegistry.create(name)
+    yield r
+    r.close()
+
+
+def test_counter_gauge_histogram(reg):
+    reg.counter_add("reqs", 1)
+    reg.counter_add("reqs", 2)
+    reg.gauge_set("temp", 42.5)
+    for v in (0.5, 3.0, 100.0):
+        reg.histogram_observe("lat", v)
+    out = reg.read_all()
+    assert out["reqs"]["type"] == "counter"
+    assert out["reqs"]["value"] == 3.0
+    assert out["temp"]["value"] == 42.5
+    h = out["lat"]
+    assert h["count"] == 3
+    assert h["sum"] == 103.5
+    assert sum(h["buckets"]) == 3
+
+
+def test_cross_process_attach(reg):
+    r2 = ShmMetricsRegistry.attach(reg.name)
+    r2.counter_add("shared", 5)
+    reg.counter_add("shared", 7)
+    assert reg.read_all()["shared"]["value"] == 12.0
+    r2.close()
+
+
+def test_prometheus_text(reg):
+    reg.counter_add(metric_key("hits", {"route": "a"}), 2)
+    reg.gauge_set("up", 1)
+    text = reg.prometheus_text()
+    assert '# TYPE hits counter' in text
+    assert 'hits{route="a"} 2.0' in text
+    assert "up 1.0" in text
+
+
+def test_worker_metrics_aggregate_on_head():
+    """Counters recorded inside worker processes must be visible in the
+    head's aggregated snapshot without any RPC from the workers."""
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=2, resources_per_worker={"CPU": 2}) as c:
+        @ray_tpu.remote
+        def work(i):
+            from ray_tpu.util.metrics import Counter
+            Counter("app_work_done", tag_keys=()).inc(1)
+            return i
+
+        assert sorted(ray_tpu.get(
+            [work.remote(i) for i in range(6)])) == list(range(6))
+        snap = c.runtime.head.call("metrics_snapshot")
+        assert snap["app_work_done"]["value"] == 6.0
+        # Built-in runtime counter recorded by the executor.
+        assert snap["raytpu_tasks_executed_total"]["value"] >= 6.0
+        text = c.runtime.head.call("metrics_prometheus")
+        assert "app_work_done 6.0" in text
