@@ -140,10 +140,20 @@ def create_actor_via_head(head: RpcClient, spec: ActorCreationSpec):
         "max_concurrency": spec.max_concurrency,
         "runtime_env": spec.runtime_env,
     })
+    pg_id = None
+    bundle_index = -1
+    strat = spec.scheduling_strategy
+    if isinstance(strat, PlacementGroupSchedulingStrategy) and \
+            strat.placement_group is not None:
+        pg_id = strat.placement_group.id.hex()
+        bundle_index = getattr(strat, "placement_group_bundle_index",
+                               -1)
     meta = {
         "actor_id": spec.actor_id.hex(),
         "resources": spec.resources,
         "max_restarts": spec.max_restarts,
+        "pg_id": pg_id,
+        "bundle_index": bundle_index,
         "name": spec.name,
         "namespace": spec.namespace,
         "get_if_exists": spec.get_if_exists,

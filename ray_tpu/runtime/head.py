@@ -328,12 +328,14 @@ class HeadService:
                             f"Actor name {name!r} already taken")
             pass
         deadline = time.time() + 60
+        pg_id = meta.get("pg_id")
+        bundle_index = meta.get("bundle_index", -1)
         while True:
             with self._lock:
                 w = None
                 while w is None:
-                    w = self._pick_worker_locked(
-                        meta.get("resources", {}), None)
+                    w = self._pick_actor_worker_locked(
+                        meta.get("resources", {}), pg_id, bundle_index)
                     if w is None:
                         # Surface the blocked demand to the autoscaler.
                         self._pending_actor_demands[actor_id] = dict(
@@ -371,6 +373,26 @@ class HeadService:
                 self.mark_worker_dead(w.worker_id)
                 if time.time() > deadline:
                     raise
+
+    def _pick_actor_worker_locked(self, resources, pg_id,
+                                  bundle_index):
+        """PG-pinned actors go to the worker holding their bundle (the
+        reference routes actor creation through the bundle's raylet —
+        gcs_actor_scheduler.cc); others fall back to resource fit."""
+        if pg_id is not None:
+            pg = self._pgs.get(pg_id)
+            if not pg or not pg["ready"]:
+                return None
+            if 0 <= bundle_index < len(pg["bundles"]):
+                wid = pg["bundles"][bundle_index][0]
+                w = self._workers.get(wid)
+                return w if (w and w.alive) else None
+            for wid in pg["workers"]:
+                w = self._workers.get(wid)
+                if w and w.alive:
+                    return w
+            return None
+        return self._pick_worker_locked(resources, None)
 
     def _handle_lost_actor(self, a: _ActorInfo):
         with self._lock:

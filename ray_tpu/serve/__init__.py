@@ -1,8 +1,10 @@
-from ray_tpu.serve.api import (deployment, run, shutdown, get_deployment,
-                               get_handle, list_deployments)
+from ray_tpu.serve.api import (delete, deployment, run, shutdown,
+                               get_deployment, get_handle,
+                               list_deployments, status)
+from ray_tpu.serve.drivers import DAGDriver
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 
 __all__ = ["deployment", "run", "shutdown", "get_deployment", "get_handle",
-           "list_deployments", "batch", "AutoscalingConfig",
-           "DeploymentConfig"]
+           "list_deployments", "status", "delete", "DAGDriver", "batch",
+           "AutoscalingConfig", "DeploymentConfig"]

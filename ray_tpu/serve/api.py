@@ -90,23 +90,48 @@ def deployment(_target=None, *, name: Optional[str] = None,
     return wrap
 
 
+def _deploy_one(dep: Deployment, controller, deployed: set,
+                timeout_s: float) -> DeploymentHandle:
+    """Deploy `dep`, first recursively deploying any bound Deployment
+    found in its init args and substituting its handle — model
+    composition via deployment graphs (reference: serve deployment
+    graphs built on python/ray/dag, deployment_graph.py)."""
+    def resolve(v):
+        if isinstance(v, Deployment):
+            return _deploy_one(v, controller, deployed, timeout_s)
+        if isinstance(v, dict):
+            return {k: resolve(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            out = [resolve(x) for x in v]
+            return tuple(out) if isinstance(v, tuple) else out
+        return v
+
+    if dep.name in deployed:
+        return DeploymentHandle(dep.name, controller)
+    deployed.add(dep.name)
+    init_args = tuple(resolve(a) for a in dep._init_args)
+    init_kwargs = {k: resolve(v) for k, v in dep._init_kwargs.items()}
+    ray_tpu.get(controller.deploy.remote(
+        dep.name, dep._as_class(), init_args, init_kwargs, dep.config))
+    deadline = time.time() + timeout_s
+    while not ray_tpu.get(controller.ready.remote(dep.name)):
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"Deployment {dep.name!r} not ready in {timeout_s}s")
+        time.sleep(0.02)
+    return DeploymentHandle(dep.name, controller)
+
+
 def run(dep: Deployment, *, wait_for_ready: bool = True,
         timeout_s: float = 60.0) -> DeploymentHandle:
-    """Deploy (or update) and return a handle."""
+    """Deploy (or update) a deployment — or a whole deployment graph:
+    bound Deployments appearing in init args are deployed recursively
+    and replaced by their handles. Returns the root handle."""
     from ray_tpu._private.usage_stats import record_library_usage
     record_library_usage("serve")
     controller = get_or_create_controller()
-    ray_tpu.get(controller.deploy.remote(
-        dep.name, dep._as_class(), dep._init_args, dep._init_kwargs,
-        dep.config))
-    if wait_for_ready:
-        deadline = time.time() + timeout_s
-        while not ray_tpu.get(controller.ready.remote(dep.name)):
-            if time.time() > deadline:
-                raise TimeoutError(
-                    f"Deployment {dep.name!r} not ready in {timeout_s}s")
-            time.sleep(0.02)
-    return DeploymentHandle(dep.name, controller)
+    return _deploy_one(dep, controller, set(),
+                       timeout_s if wait_for_ready else 0.0)
 
 
 def get_handle(name: str) -> DeploymentHandle:
@@ -124,6 +149,27 @@ def get_deployment(name: str) -> Dict[str, Any]:
 def list_deployments() -> Dict[str, Any]:
     return ray_tpu.get(
         get_or_create_controller().list_deployments.remote())
+
+
+def status() -> Dict[str, Any]:
+    """Deployment + replica status summary (reference: serve.status()
+    schema — application/deployment statuses)."""
+    info = list_deployments()
+    return {
+        "deployments": {
+            name: {
+                "status": ("HEALTHY"
+                           if d["num_replicas"] >= max(1, d["target"])
+                           else "UPDATING"),
+                **d,
+            } for name, d in info.items()},
+    }
+
+
+def delete(name: str):
+    """Remove one deployment (reference: serve.delete)."""
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name))
 
 
 def shutdown():

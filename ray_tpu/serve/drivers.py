@@ -1,0 +1,35 @@
+"""Ingress drivers.
+
+Capability parity with the reference's DAGDriver
+(python/ray/serve/drivers.py — an ingress deployment routing HTTP paths
+to the deployment graph's entry handles).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import ray_tpu
+
+
+class DAGDriver:
+    """Route-table ingress: maps path prefixes to deployment handles.
+
+    Use: serve.run(serve.deployment(DAGDriver).bind(
+             {"/a": DepA.bind(), "/b": DepB.bind()}))
+    Bound deployments in the dict are deployed recursively by serve.run
+    and arrive here as live handles.
+    """
+
+    def __init__(self, route_table: Dict[str, Any]):
+        self._routes = dict(route_table)
+
+    def routes(self) -> Dict[str, str]:
+        return {path: getattr(h, "_name", repr(h))
+                for path, h in self._routes.items()}
+
+    def __call__(self, path: str, *args, **kwargs):
+        h = self._routes.get(path)
+        if h is None:
+            raise KeyError(
+                f"No route {path!r}; known: {sorted(self._routes)}")
+        return ray_tpu.get(h.remote(*args, **kwargs))

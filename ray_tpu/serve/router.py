@@ -32,11 +32,19 @@ class DeploymentHandle:
         self._name = name
         self._controller = controller
         self._lock = threading.Lock()
+        self._init_runtime_state()
+
+    def _init_runtime_state(self):
         self._replicas: List = []
         self._max_ongoing = 8
         self._version = -1
         self._fetched_at = 0.0
         self._inflight: Dict[int, int] = {}   # idx -> count
+
+    def __reduce__(self):
+        # Handles travel inside replica init args (deployment graphs);
+        # locks/caches don't pickle — reconstruct from the name.
+        return (_rebuild_handle, (self._name,))
 
     # --- replica set maintenance ------------------------------------------
 
@@ -116,3 +124,8 @@ class DeploymentHandle:
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentMethod(self, name)
+
+
+def _rebuild_handle(name: str) -> "DeploymentHandle":
+    from ray_tpu.serve.controller import get_or_create_controller
+    return DeploymentHandle(name, get_or_create_controller())

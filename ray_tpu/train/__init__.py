@@ -1,8 +1,16 @@
 from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
                                 ScalingConfig)
 from ray_tpu.air.result import Result
+from ray_tpu.train.gbdt import (LightGBMTrainer, SklearnTrainer,
+                                XGBoostTrainer)
+from ray_tpu.train.predictor import (BatchPredictor, JaxPredictor,
+                                     Predictor, SklearnPredictor)
 from ray_tpu.train.trainer import BaseTrainer, JaxTrainer, DataParallelTrainer
+from ray_tpu.train.torch import TorchTrainer
 
 __all__ = ["BaseTrainer", "JaxTrainer", "DataParallelTrainer",
+           "TorchTrainer", "SklearnTrainer", "XGBoostTrainer",
+           "LightGBMTrainer", "Predictor", "JaxPredictor",
+           "SklearnPredictor", "BatchPredictor",
            "ScalingConfig", "RunConfig", "FailureConfig",
            "CheckpointConfig", "Result"]

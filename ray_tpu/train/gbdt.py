@@ -1,0 +1,91 @@
+"""Tree/estimator trainers: sklearn first-class, xgboost/lightgbm gated.
+
+Capability parity with the reference's GBDT + sklearn trainers
+(python/ray/train/xgboost/, lightgbm/, sklearn/ — a Trainer that fits
+an estimator on a Dataset and emits a framework Checkpoint). xgboost and
+lightgbm are not in this image, so those trainer classes raise a clear
+ImportError at construction; SklearnTrainer carries the shared shape.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+
+
+def _dataset_to_xy(ds, label_column: str):
+    rows = ds.take_all()
+    y = np.asarray([r[label_column] for r in rows])
+    feats = [k for k in rows[0] if k != label_column]
+    X = np.asarray([[r[k] for k in feats] for r in rows], np.float64)
+    return X, y
+
+
+class SklearnTrainer:
+    """Fit any sklearn estimator on a Dataset (reference:
+    train/sklearn/sklearn_trainer.py)."""
+
+    def __init__(self, *, estimator, datasets: Dict[str, Any],
+                 label_column: str,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.estimator = estimator
+        self.datasets = datasets
+        self.label_column = label_column
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        from ray_tpu._private.usage_stats import record_library_usage
+        record_library_usage("train")
+        X, y = _dataset_to_xy(self.datasets["train"], self.label_column)
+        self.estimator.fit(X, y)
+        metrics: Dict[str, Any] = {
+            "train_score": float(self.estimator.score(X, y))}
+        valid = self.datasets.get("valid")
+        if valid is not None:
+            Xv, yv = _dataset_to_xy(valid, self.label_column)
+            metrics["valid_score"] = float(self.estimator.score(Xv, yv))
+        ckpt = Checkpoint.from_dict({"estimator": self.estimator})
+        return Result(metrics=metrics, checkpoint=ckpt,
+                      metrics_history=[metrics])
+
+
+def _gated(name: str, module: str):
+    class _GatedTrainer:
+        def __init__(self, *a, **kw):
+            raise ImportError(
+                f"{name} requires {module!r}, which is not available "
+                f"in this environment; use SklearnTrainer (e.g. "
+                f"HistGradientBoostingRegressor/Classifier) instead.")
+    _GatedTrainer.__name__ = name
+    return _GatedTrainer
+
+
+try:
+    import xgboost  # noqa: F401
+    _HAS_XGB = True
+except ImportError:
+    _HAS_XGB = False
+
+if not _HAS_XGB:
+    XGBoostTrainer = _gated("XGBoostTrainer", "xgboost")
+else:   # pragma: no cover - xgboost not in this image
+    class XGBoostTrainer(SklearnTrainer):
+        pass
+
+try:
+    import lightgbm  # noqa: F401
+    _HAS_LGBM = True
+except ImportError:
+    _HAS_LGBM = False
+
+if not _HAS_LGBM:
+    LightGBMTrainer = _gated("LightGBMTrainer", "lightgbm")
+else:   # pragma: no cover
+    class LightGBMTrainer(SklearnTrainer):
+        pass

@@ -1,0 +1,73 @@
+"""Torch backend: gloo process groups over the worker gang.
+
+Capability parity with the reference's torch Train backend
+(python/ray/train/torch/config.py:28,54,105 — `_TorchBackend.on_start`
+runs `_setup_torch_process_group` on every worker with a TCP rendezvous
+on worker 0; `prepare_model` wraps the model in DDP). TPU-native stance:
+JaxTrainer + mesh collectives are the flagship path; TorchTrainer
+exists for CPU torch workloads and API parity. Requires gang members in
+distinct processes (use the multiprocess runtime with SPREAD placement);
+one process can host only one torch process-group rank.
+"""
+from __future__ import annotations
+
+import socket
+from typing import Callable, Dict, Optional
+
+from ray_tpu.train.trainer import BaseTrainer
+
+_RDZV_KEY = "_torch_init_method"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _setup_torch_process_group(rank: int, world_size: int,
+                               config: Dict) -> None:
+    """Runs on each gang member (reference: train/torch/config.py:54)."""
+    import torch.distributed as dist
+    if world_size <= 1:
+        return
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    dist.init_process_group(
+        backend="gloo",
+        init_method=config[_RDZV_KEY],
+        rank=rank,
+        world_size=world_size)
+
+
+def prepare_model(model):
+    """Wrap in DDP when a process group is active (reference:
+    train/torch/train_loop_utils.py prepare_model)."""
+    import torch.distributed as dist
+    if dist.is_available() and dist.is_initialized() and \
+            dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+        return DistributedDataParallel(model)
+    return model
+
+
+def get_device():
+    import torch
+    return torch.device("cpu")
+
+
+class TorchTrainer(BaseTrainer):
+    """Data-parallel torch training on a gang of worker actors with a
+    gloo process group (NCCL has no role on TPU hosts)."""
+
+    def __init__(self, train_loop_per_worker: Callable, **kwargs):
+        super().__init__(train_loop_per_worker, **kwargs)
+        # TCP rendezvous chosen up front so every gang member gets the
+        # same init_method through the loop config.
+        self._config[_RDZV_KEY] = \
+            f"tcp://127.0.0.1:{_free_port()}"
+
+    def _backend_setup(self) -> Optional[Callable]:
+        return _setup_torch_process_group

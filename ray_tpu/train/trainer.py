@@ -50,6 +50,11 @@ class BaseTrainer:
     def _mesh_axes(self) -> Optional[Dict[str, int]]:
         return None
 
+    # Subclasses may return a callable(rank, world_size, config) run on
+    # each gang member before the loop (framework backend setup).
+    def _backend_setup(self) -> Optional[Callable]:
+        return None
+
     def fit(self) -> Result:
         from ray_tpu._private.usage_stats import record_library_usage
         record_library_usage("train")
@@ -88,7 +93,8 @@ class BaseTrainer:
         last_metrics: Optional[Dict[str, Any]] = None
         try:
             run_refs = group.start_run(self._loop, self._config,
-                                       self._mesh_axes(), resume_ckpt)
+                                       self._mesh_axes(), resume_ckpt,
+                                       self._backend_setup())
             done = [False] * sc.num_workers
             error: Optional[BaseException] = None
             while not all(done) and error is None:

@@ -35,11 +35,17 @@ class TrainWorker:
 
     def run(self, loop_fn: Callable, config: Dict[str, Any],
             mesh_axes: Optional[Dict[str, int]],
-            resume_checkpoint: Optional[Checkpoint]) -> str:
+            resume_checkpoint: Optional[Checkpoint],
+            backend_setup: Optional[Callable] = None) -> str:
         mesh = None
         if mesh_axes is not None:
             from ray_tpu.mesh import create_mesh
             mesh = create_mesh(mesh_axes)
+        if backend_setup is not None:
+            # Framework backend hook run on each gang member before the
+            # loop (reference: Backend.on_start, e.g. torch process
+            # group setup in train/torch/config.py:54).
+            backend_setup(self.rank, self.world_size, config)
 
         def report_fn(metrics, checkpoint):
             with self._lock:
@@ -117,9 +123,10 @@ class WorkerGroup:
             ).remote(rank, num_workers)
             self.workers.append(w)
 
-    def start_run(self, loop_fn, config, mesh_axes, resume_checkpoint):
+    def start_run(self, loop_fn, config, mesh_axes, resume_checkpoint,
+                  backend_setup=None):
         return [w.run.remote(loop_fn, config, mesh_axes,
-                             resume_checkpoint)
+                             resume_checkpoint, backend_setup)
                 for w in self.workers]
 
     def poll_all(self) -> List[Dict[str, Any]]:

@@ -1,6 +1,8 @@
 from ray_tpu.tune.search import (choice, grid_search, loguniform, qrandint,
-                                 randint, uniform, BasicVariantGenerator)
+                                 randint, uniform, BasicVariantGenerator,
+                                 TPESearcher)
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                                     HyperBandScheduler,
                                      MedianStoppingRule,
                                      PopulationBasedTraining)
 from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid
@@ -9,7 +11,7 @@ from ray_tpu.tune.trial import Trial
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "Trial",
     "grid_search", "choice", "uniform", "loguniform", "randint",
-    "qrandint", "BasicVariantGenerator",
-    "FIFOScheduler", "AsyncHyperBandScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining",
+    "qrandint", "BasicVariantGenerator", "TPESearcher",
+    "FIFOScheduler", "AsyncHyperBandScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
 ]

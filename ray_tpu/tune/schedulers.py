@@ -164,3 +164,49 @@ class PopulationBasedTraining(TrialScheduler):
                     "checkpoint": donor.checkpoint,
                 }
         return CONTINUE
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand-style successive halving (reference:
+    tune/schedulers/hyperband.py; the async variant is
+    AsyncHyperBandScheduler). Rungs at r, r*eta, r*eta^2, ...; when all
+    live trials have reached a rung, the bottom 1-1/eta fraction stops.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 81, reduction_factor: int = 3,
+                 time_attr: str = "training_iteration"):
+        super().__init__(metric, mode)
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self.time_attr = time_attr
+        self.rungs: List[int] = []
+        r = 1
+        while r < max_t:
+            self.rungs.append(r)
+            r *= reduction_factor
+
+    def on_result(self, trial, result, trials) -> str:
+        t = result.get(self.time_attr, len(trial.results))
+        if t >= self.max_t:
+            return STOP
+        # Find the highest rung this trial just reached.
+        reached = [r for r in self.rungs if t >= r]
+        if not reached:
+            return CONTINUE
+        rung = reached[-1]
+        live = [tr for tr in trials if not tr.finished]
+        # Synchronous: decide only once every live trial reached the rung.
+        at_rung = [tr for tr in live
+                   if len(tr.metric_history(self.metric)) >= rung]
+        if len(at_rung) < len(live) or len(at_rung) < 2:
+            return CONTINUE
+        scores = []
+        for tr in at_rung:
+            vals = tr.metric_history(self.metric)[:rung]
+            s = min(vals) if self.mode == "min" else max(vals)
+            scores.append((s, tr.trial_id))
+        scores.sort(reverse=(self.mode == "max"))
+        keep = max(1, len(scores) // self.eta)
+        survivors = {tid for _, tid in scores[:keep]}
+        return CONTINUE if trial.trial_id in survivors else STOP

@@ -140,3 +140,109 @@ class BasicVariantGenerator(Searcher):
         cfg = self._variants[self._idx]
         self._idx += 1
         return cfg
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator searcher (the Optuna-default
+    algorithm; reference integrates it via tune/search/optuna/).
+
+    After ``n_startup`` random trials, completed trials are split into
+    good/bad halves by objective; candidates are sampled from a kernel
+    density around good observations and scored by the density ratio
+    l(x)/g(x) (Bergstra et al. 2011), per independent dimension.
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "min", num_samples: int = 16,
+                 n_startup: int = 5, n_candidates: int = 24,
+                 gamma: float = 0.33, seed: int = 0):
+        self.param_space = dict(param_space)
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.gamma = gamma
+        self._rng = random.Random(seed)
+        self._suggested = 0
+        self._observed: List[Tuple[Dict[str, Any], float]] = []
+
+    # -- domain helpers ----------------------------------------------------
+
+    def _random_config(self) -> Dict[str, Any]:
+        out = {}
+        for k, v in self.param_space.items():
+            out[k] = v.sample(self._rng) if isinstance(v, Domain) else v
+        return out
+
+    def _numeric(self, dom) -> bool:
+        return isinstance(dom, (Uniform, LogUniform, RandInt, QRandInt))
+
+    def _kde_score(self, values: List[float], x: float,
+                   bandwidth: float) -> float:
+        if not values:
+            return 1e-12
+        import math
+        return sum(
+            math.exp(-0.5 * ((x - v) / bandwidth) ** 2)
+            for v in values) / len(values) + 1e-12
+
+    def _suggest_tpe(self) -> Dict[str, Any]:
+        ranked = sorted(self._observed, key=lambda o: o[1],
+                        reverse=(self.mode == "max"))
+        n_good = max(1, int(len(ranked) * self.gamma))
+        good = [c for c, _ in ranked[:n_good]]
+        bad = [c for c, _ in ranked[n_good:]] or good
+        best, best_score = None, -1.0
+        for _ in range(self.n_candidates):
+            cand = self._random_config()
+            score = 1.0
+            for k, dom in self.param_space.items():
+                if isinstance(dom, LogUniform):
+                    import math
+                    tx = math.log(cand[k])
+                    gv = [math.log(c[k]) for c in good]
+                    bv = [math.log(c[k]) for c in bad]
+                    bw = max((math.log(dom.high) -
+                              math.log(dom.low)) / 8, 1e-6)
+                elif self._numeric(dom):
+                    tx = float(cand[k])
+                    gv = [float(c[k]) for c in good]
+                    bv = [float(c[k]) for c in bad]
+                    span = float(getattr(dom, "high", 1) -
+                                 getattr(dom, "low", 0))
+                    bw = max(span / 8, 1e-6)
+                elif isinstance(dom, Choice):
+                    gcnt = sum(1 for c in good if c[k] == cand[k])
+                    bcnt = sum(1 for c in bad if c[k] == cand[k])
+                    score *= ((gcnt + 1) / (len(good) + 1)) / \
+                        ((bcnt + 1) / (len(bad) + 1))
+                    continue
+                else:
+                    continue
+                score *= self._kde_score(gv, tx, bw) / \
+                    self._kde_score(bv, tx, bw)
+            if score > best_score:
+                best, best_score = cand, score
+        return best or self._random_config()
+
+    # -- Searcher interface ------------------------------------------------
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        if len(self._observed) < self.n_startup:
+            return self._random_config()
+        return self._suggest_tpe()
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None):
+        if result and self.metric in result:
+            config = result.get("config")
+            if config is not None:
+                self._observed.append((config, result[self.metric]))
+
+    def observe(self, config: Dict[str, Any], value: float):
+        """Direct observation hook (used by the trial runner)."""
+        self._observed.append((dict(config), value))

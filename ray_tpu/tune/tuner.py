@@ -86,6 +86,7 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restored_trials: List[Trial] = []
 
     # --- trial process management -----------------------------------------
 
@@ -128,17 +129,29 @@ class Tuner:
         searcher = tc.search_alg or BasicVariantGenerator(
             self.param_space, num_samples=tc.num_samples)
 
-        trials: List[Trial] = []
-        while True:
-            cfg = searcher.suggest(f"t{len(trials)}")
-            if cfg is None:
-                break
-            trials.append(Trial(config=cfg))
+        trials: List[Trial] = list(self._restored_trials)
+        # A restored experiment re-runs its unfinished trials; the
+        # search budget was already spent in the original run.
+        searcher_done = bool(self._restored_trials)
 
         start_time = time.time()
         while True:
             running = [t for t in trials if t.state == RUNNING]
             pending = [t for t in trials if t.state == PENDING]
+            # Suggest lazily as slots free up so model-based searchers
+            # (TPE) see completed-trial observations before proposing
+            # (reference: TrialRunner pulls from the search algorithm
+            # incrementally, not up front).
+            while not searcher_done and \
+                    len(running) + len(pending) < \
+                    tc.max_concurrent_trials:
+                cfg = searcher.suggest(f"t{len(trials)}")
+                if cfg is None:
+                    searcher_done = True
+                    break
+                t = Trial(config=cfg)
+                trials.append(t)
+                pending.append(t)
             # Launch up to the concurrency cap.
             while pending and len(running) < tc.max_concurrent_trials:
                 t = pending.pop(0)
@@ -191,6 +204,8 @@ class Tuner:
                     else:
                         self._stop_trial(trial, TERMINATED)
                         scheduler.on_trial_complete(trial, trials)
+                    self._observe(searcher, trial, tc)
+                    self._save_experiment_state(trials)
 
             if tc.time_budget_s is not None and \
                     time.time() - start_time > tc.time_budget_s:
@@ -200,4 +215,87 @@ class Tuner:
                 break
             if not made_progress:
                 time.sleep(0.01)
+        self._save_experiment_state(trials)
         return ResultGrid(trials)
+
+    # --- searcher feedback + experiment persistence -----------------------
+
+    @staticmethod
+    def _observe(searcher, trial: Trial, tc: TuneConfig):
+        """Feed the completed trial back to model-based searchers."""
+        observe = getattr(searcher, "observe", None)
+        if observe is None or not trial.results:
+            return
+        vals = trial.metric_history(tc.metric)
+        if not vals:
+            return
+        best = min(vals) if tc.mode == "min" else max(vals)
+        observe(trial.config, best)
+
+    def _state_path(self) -> Optional[str]:
+        import os
+        if not self.run_config.storage_path:
+            return None
+        name = self.run_config.name or "tune_experiment"
+        d = os.path.join(self.run_config.storage_path, name)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, "experiment_state.pkl")
+
+    def _save_experiment_state(self, trials: List[Trial]):
+        """Reference: TrialRunner checkpointing + Syncer — trial state
+        and latest checkpoints persist under storage_path so the
+        experiment is resumable (tune.run(resume=...))."""
+        path = self._state_path()
+        if path is None:
+            return
+        import pickle
+        blob = []
+        for t in trials:
+            blob.append({
+                "trial_id": t.trial_id,
+                "config": t.config,
+                "state": t.state,
+                "results": t.results,
+                "checkpoint": (t.checkpoint.to_dict()
+                               if t.checkpoint is not None else None),
+                "error": repr(t.error) if t.error else None,
+            })
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(blob, f)
+        import os
+        os.replace(tmp, path)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                restart_errored: bool = True,
+                **tuner_kwargs) -> "Tuner":
+        """Resume an experiment: finished trials keep their results;
+        unfinished — and, by default, errored — trials are re-queued
+        (reference: Tuner.restore(restart_errored=...) /
+        tune.run(resume=True))."""
+        import os
+        import pickle
+        from ray_tpu.air.checkpoint import Checkpoint
+        state_file = path if path.endswith(".pkl") else os.path.join(
+            path, "experiment_state.pkl")
+        with open(state_file, "rb") as f:
+            blob = pickle.load(f)
+        tuner = cls(trainable, **tuner_kwargs)
+        restored: List[Trial] = []
+        for rec in blob:
+            t = Trial(config=rec["config"], trial_id=rec["trial_id"])
+            t.results = rec["results"]
+            t.last_result = rec["results"][-1] if rec["results"] else None
+            if rec["checkpoint"] is not None:
+                t.checkpoint = Checkpoint.from_dict(rec["checkpoint"])
+            keep = (TERMINATED, STOPPED) if restart_errored else \
+                (TERMINATED, ERROR, STOPPED)
+            if rec["state"] in keep:
+                t.state = rec["state"]
+            else:
+                t.state = PENDING   # re-run unfinished/errored trials
+                t.results = []
+            restored.append(t)
+        tuner._restored_trials = restored
+        return tuner

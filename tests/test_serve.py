@@ -200,3 +200,60 @@ def test_llama_llm_deployment(serve_rt):
     # Deterministic greedy decode across requests.
     out2 = ray_tpu.get(handle.remote([1, 2, 3]))
     assert out == out2
+
+
+def test_deployment_graph_composition(serve_rt):
+    """Bound deployments as init args become live handles (the serve
+    deployment-graph / model-composition pattern)."""
+    @serve.deployment
+    class Preprocessor:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            pre = ray_tpu.get(self.pre.remote(x))
+            return pre + 1
+
+    handle = serve.run(Model.bind(Preprocessor.bind()))
+    assert ray_tpu.get(handle.remote(10)) == 21
+    # Both deployments exist as first-class deployments.
+    deps = serve.list_deployments()
+    assert "Model" in deps and "Preprocessor" in deps
+
+
+def test_dag_driver_routes(serve_rt):
+    from ray_tpu.serve import DAGDriver
+
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    @serve.deployment
+    def square(x):
+        return x * x
+
+    ingress = serve.deployment(DAGDriver).bind(
+        {"/double": double.bind(), "/square": square.bind()})
+    h = serve.run(ingress)
+    assert ray_tpu.get(h.remote("/double", 21)) == 42
+    assert ray_tpu.get(h.remote("/square", 5)) == 25
+    routes = ray_tpu.get(h.routes.remote())
+    assert set(routes) == {"/double", "/square"}
+
+
+def test_status_and_delete(serve_rt):
+    @serve.deployment(num_replicas=2)
+    def f():
+        return 1
+
+    serve.run(f.bind())
+    st = serve.status()
+    assert st["deployments"]["f"]["status"] == "HEALTHY"
+    assert st["deployments"]["f"]["num_replicas"] == 2
+    serve.delete("f")
+    assert "f" not in serve.list_deployments()

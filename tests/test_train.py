@@ -123,3 +123,101 @@ def test_jax_trainer_spmd_gang(rt, cpu_mesh_devices):
                                      mesh={"data": -1})).fit()
     assert result.ok, result.error
     assert result.metrics["last_loss"] < result.metrics["first_loss"] * 0.1
+
+
+# ---- widened surface: torch backend, predictors, estimator trainers -------
+
+def test_torch_trainer_ddp_gloo():
+    """TorchTrainer on a multiprocess cluster: gloo process group spans
+    gang members in distinct worker processes; gradients allreduce."""
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=2, resources_per_worker={"CPU": 2}):
+        from ray_tpu.train import ScalingConfig, TorchTrainer
+        from ray_tpu.air import session
+
+        def loop(config):
+            import numpy as np
+            import torch
+            import torch.distributed as dist
+            from ray_tpu.train.torch import prepare_model
+            torch.manual_seed(0)
+            model = prepare_model(torch.nn.Linear(4, 1))
+            opt = torch.optim.SGD(model.parameters(), lr=0.1)
+            rank = session.get_world_rank()
+            rng = np.random.RandomState(rank)
+            for _ in range(5):
+                x = torch.tensor(rng.randn(8, 4), dtype=torch.float32)
+                y = x.sum(dim=1, keepdim=True)
+                loss = ((model(x) - y) ** 2).mean()
+                opt.zero_grad()
+                loss.backward()
+                opt.step()
+            # All ranks must hold identical (DDP-synced) weights.
+            w = list(model.parameters())[0].detach().numpy().ravel()
+            session.report({"w0": float(w[0]),
+                            "world": dist.get_world_size(),
+                            "loss": float(loss)})
+
+        trainer = TorchTrainer(
+            loop, scaling_config=ScalingConfig(
+                num_workers=2, placement_strategy="STRICT_SPREAD"))
+        result = trainer.fit()
+        assert result.error is None
+        assert result.metrics["world"] == 2
+
+
+def test_jax_predictor_and_batch_predictor(rt):
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu import data
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.train import BatchPredictor, JaxPredictor
+
+    params = {"w": jnp.asarray([[2.0]]), "b": jnp.asarray([1.0])}
+
+    def apply_fn(p, x):
+        return x @ p["w"] + p["b"]
+
+    ckpt = Checkpoint.from_dict({"params": params})
+    pred = JaxPredictor.from_checkpoint(ckpt, apply_fn=apply_fn)
+    out = pred.predict(np.asarray([[1.0], [3.0]], np.float32))
+    np.testing.assert_allclose(out, [[3.0], [7.0]])
+
+    ds = data.from_items([{"x": [float(i)]} for i in range(8)],
+                         parallelism=4)
+    bp = BatchPredictor.from_checkpoint(ckpt, JaxPredictor,
+                                        apply_fn=apply_fn)
+    preds = bp.predict(ds, feature_key="x", compute="actors",
+                       num_actors=2)
+    vals = sorted(float(r["prediction"][0]) for r in preds.take_all())
+    assert vals == [1.0 + 2.0 * i for i in range(8)]
+
+
+def test_sklearn_trainer_and_predictor(rt):
+    import numpy as np
+    from sklearn.tree import DecisionTreeRegressor
+    from ray_tpu import data
+    from ray_tpu.train import SklearnTrainer, SklearnPredictor
+
+    rows = [{"a": float(i), "b": float(i % 3), "y": 2.0 * i}
+            for i in range(40)]
+    ds = data.from_items(rows)
+    trainer = SklearnTrainer(
+        estimator=DecisionTreeRegressor(max_depth=5),
+        datasets={"train": ds, "valid": ds}, label_column="y")
+    result = trainer.fit()
+    assert result.metrics["train_score"] > 0.9
+    pred = SklearnPredictor.from_checkpoint(result.checkpoint)
+    out = pred.predict(np.asarray([[10.0, 1.0]]))
+    assert out.shape == (1,)
+
+
+def test_gbdt_trainers_gated():
+    from ray_tpu.train import LightGBMTrainer, XGBoostTrainer
+    with pytest.raises(ImportError, match="xgboost"):
+        XGBoostTrainer()
+    with pytest.raises(ImportError, match="lightgbm"):
+        LightGBMTrainer()

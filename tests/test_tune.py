@@ -132,3 +132,101 @@ def test_pbt_exploits_checkpoint(rt):
     # With exploitation, the best score should reflect mostly lr=1.0
     # progress: > 20 * 0.5.
     assert best.metrics["score"] > 10.0
+
+
+# ---- widened surface: TPE, HyperBand, experiment resume -------------------
+
+def test_tpe_searcher_improves_over_random(rt):
+    """TPE should concentrate samples near the optimum of a smooth
+    1-d objective after startup trials."""
+    from ray_tpu.air import session
+    from ray_tpu.tune import TPESearcher, TuneConfig, Tuner, uniform
+
+    space = {"x": uniform(-10.0, 10.0)}
+
+    def objective(config):
+        session.report({"loss": (config["x"] - 3.0) ** 2})
+
+    searcher = TPESearcher(space, metric="loss", mode="min",
+                           num_samples=20, n_startup=6, seed=1)
+    tuner = Tuner(objective,
+                  tune_config=TuneConfig(metric="loss", mode="min",
+                                         search_alg=searcher,
+                                         max_concurrent_trials=2))
+    grid = tuner.fit()
+    assert len(grid) == 20
+    best = grid.get_best_result("loss", "min")
+    assert best.metrics["loss"] < 2.0
+    # Model-based phase trials must on average beat the random phase.
+    startup = [t.last_result["loss"] for t in grid.trials[:6]]
+    guided = [t.last_result["loss"] for t in grid.trials[12:]]
+    assert sum(guided) / len(guided) < sum(startup) / len(startup)
+
+
+def test_hyperband_stops_losers(rt):
+    from ray_tpu.air import session
+    from ray_tpu.tune import (HyperBandScheduler, TuneConfig, Tuner,
+                              grid_search)
+
+    def trainable(config):
+        for i in range(9):
+            session.report({"loss": config["q"] + i * 0.01})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"q": grid_search([1.0, 2.0, 3.0, 4.0])},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=1,
+            max_concurrent_trials=4,
+            scheduler=HyperBandScheduler(metric="loss", mode="min",
+                                         max_t=9,
+                                         reduction_factor=2)))
+    grid = tuner.fit()
+    from ray_tpu.tune.trial import STOPPED
+    stopped = [t for t in grid.trials if t.state == STOPPED]
+    assert stopped, "HyperBand should stop at least one loser"
+    best_trial = min(
+        (t for t in grid.trials if t.last_result),
+        key=lambda t: min(t.metric_history("loss")))
+    assert best_trial.config["q"] == 1.0
+
+
+def test_experiment_state_resume(rt, tmp_path):
+    from ray_tpu.air import session
+    from ray_tpu.air.config import RunConfig
+    from ray_tpu.tune import TuneConfig, Tuner, grid_search
+    from ray_tpu.tune.trial import TERMINATED
+
+    calls_file = tmp_path / "calls.txt"
+
+    def trainable(config):
+        with open(calls_file, "a") as f:
+            f.write(f"{config['v']}\n")
+        if config["v"] == 99 and \
+                len(open(calls_file).readlines()) < 4:
+            raise RuntimeError("boom")   # fails on the first pass
+        session.report({"loss": float(config["v"])})
+
+    run_cfg = RunConfig(name="exp1", storage_path=str(tmp_path))
+    tuner = Tuner(trainable,
+                  param_space={"v": grid_search([1, 2, 99])},
+                  tune_config=TuneConfig(metric="loss", mode="min",
+                                         max_concurrent_trials=1),
+                  run_config=run_cfg)
+    grid = tuner.fit()
+    assert any(t.error is not None for t in grid.trials)
+    state_dir = tmp_path / "exp1"
+    assert (state_dir / "experiment_state.pkl").exists()
+
+    # Resume: finished trials keep results; the failed one re-runs.
+    tuner2 = Tuner.restore(str(state_dir), trainable,
+                           tune_config=TuneConfig(
+                               metric="loss", mode="min",
+                               max_concurrent_trials=1),
+                           run_config=run_cfg)
+    grid2 = tuner2.fit()
+    assert len(grid2) == 3
+    done = [t for t in grid2.trials if t.state == TERMINATED]
+    assert len(done) == 3   # all complete after resume
+    vals = sorted(t.last_result["loss"] for t in grid2.trials)
+    assert vals == [1.0, 2.0, 99.0]
