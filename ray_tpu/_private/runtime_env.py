@@ -83,45 +83,71 @@ def _stage_working_dir(path: str) -> str:
 
 @contextlib.contextmanager
 def runtime_env_context(runtime_env: Optional[Dict[str, Any]]):
-    """Apply a runtime_env around an execution, restoring afterwards."""
+    """Apply a runtime_env around an execution, restoring afterwards.
+
+    The lock is held only while mutating/restoring process-global state,
+    NOT across user-code execution: an in-process task blocking on
+    ``get()`` of another runtime_env task (the LocalRuntime runs tasks on
+    threads in one process) must not deadlock the other task's apply
+    step. The cost is that concurrent runtime_env tasks can observe each
+    other's env between apply and restore — the docstring above already
+    concedes env bleed for tasks *without* an env; true isolation is the
+    env-keyed worker-process path in the multiprocess runtime.
+    """
     if not runtime_env:
         yield
         return
-    with _apply_lock:
-        saved_env: Dict[str, Optional[str]] = {}
-        saved_cwd = None
-        added_paths = []
-        try:
-            for k, v in (runtime_env.get("env_vars") or {}).items():
-                saved_env[k] = os.environ.get(k)
-                os.environ[k] = v
-            wd = runtime_env.get("working_dir")
-            if wd:
-                staged = _stage_working_dir(wd)
-                saved_cwd = os.getcwd()
-                os.chdir(staged)
-                if staged not in sys.path:
-                    sys.path.insert(0, staged)
-                    added_paths.append(staged)
-            for mod in (runtime_env.get("py_modules") or []):
-                mod = os.path.abspath(mod)
-                if mod not in sys.path:
-                    sys.path.insert(0, mod)
-                    added_paths.append(mod)
-            yield
-        finally:
-            for p in added_paths:
-                try:
-                    sys.path.remove(p)
-                except ValueError:
-                    pass
-            if saved_cwd is not None:
-                try:
-                    os.chdir(saved_cwd)
-                except OSError:
-                    pass
-            for k, old in saved_env.items():
-                if old is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = old
+    saved_env: Dict[str, Optional[str]] = {}
+    set_env: Dict[str, str] = {}
+    saved_cwd = None
+    staged_cwd = None
+    added_paths = []
+
+    def _restore_locked():
+        for p in added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        if saved_cwd is not None and os.getcwd() == staged_cwd:
+            # Only undo our own chdir: a concurrently-applied env may
+            # have moved cwd since; restoring blindly would clobber it.
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass
+        for k, old in saved_env.items():
+            if os.environ.get(k) != set_env.get(k):
+                continue   # someone else overwrote it; not ours to undo
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+    try:
+        with _apply_lock:
+            try:
+                for k, v in (runtime_env.get("env_vars") or {}).items():
+                    saved_env[k] = os.environ.get(k)
+                    os.environ[k] = v
+                    set_env[k] = v
+                wd = runtime_env.get("working_dir")
+                if wd:
+                    staged_cwd = _stage_working_dir(wd)
+                    saved_cwd = os.getcwd()
+                    os.chdir(staged_cwd)
+                    if staged_cwd not in sys.path:
+                        sys.path.insert(0, staged_cwd)
+                        added_paths.append(staged_cwd)
+                for mod in (runtime_env.get("py_modules") or []):
+                    mod = os.path.abspath(mod)
+                    if mod not in sys.path:
+                        sys.path.insert(0, mod)
+                        added_paths.append(mod)
+            except BaseException:
+                _restore_locked()   # half-applied: undo before raising
+                raise
+        yield
+    finally:
+        with _apply_lock:
+            _restore_locked()

@@ -278,11 +278,11 @@ def generate(model: Llama, params, prompt_ids: jnp.ndarray,
             tokens, first[:, None], (0, T0))
 
         def cond(state):
-            i, _tokens, _caches, _key, done = state
-            return (i < max_new_tokens) & ~done
+            i, _tokens, _caches, _key, done_rows = state
+            return (i < max_new_tokens) & ~jnp.all(done_rows)
 
         def body(state):
-            i, tokens, caches, key, done = state
+            i, tokens, caches, key, done_rows = state
             key, sub = jax.random.split(key)
             cur = jax.lax.dynamic_slice(tokens, (0, T0 + i - 1),
                                         (B, 1))
@@ -292,11 +292,15 @@ def generate(model: Llama, params, prompt_ids: jnp.ndarray,
             tokens = jax.lax.dynamic_update_slice(
                 tokens, nxt[:, None], (0, T0 + i))
             if eos_id is not None:
-                done = jnp.all(jnp.any(
-                    tokens[:, T0:] == eos_id, axis=1))
-            return (i + 1, tokens, caches, key, done)
+                # Per-row flags track only tokens actually sampled, so
+                # the zero-filled tail never counts and eos_id may
+                # legitimately be 0.
+                done_rows = done_rows | (nxt == eos_id)
+            return (i + 1, tokens, caches, key, done_rows)
 
-        state = (jnp.int32(1), tokens, caches, rng, jnp.bool_(False))
+        done0 = (first == eos_id) if eos_id is not None \
+            else jnp.zeros((B,), jnp.bool_)
+        state = (jnp.int32(1), tokens, caches, rng, done0)
         _, tokens, _, _, _ = jax.lax.while_loop(cond, body, state)
         return tokens
 

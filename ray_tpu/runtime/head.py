@@ -41,7 +41,8 @@ class _WorkerInfo:
 class _ActorInfo:
     def __init__(self, actor_id: str, worker_id: str, payload: bytes,
                  resources: Dict[str, float], max_restarts: int,
-                 name: Optional[str], namespace: str):
+                 name: Optional[str], namespace: str,
+                 pg_id: Optional[str] = None, bundle_index: int = -1):
         self.actor_id = actor_id
         self.worker_id = worker_id
         self.payload = payload          # creation spec (for restarts)
@@ -52,6 +53,12 @@ class _ActorInfo:
         self.death_reason = ""
         self.name = name
         self.namespace = namespace
+        # PG-pinned actors consume the placement group's reservation
+        # (tracked per-bundle in pg["bundle_used"]), which was already
+        # deducted from the worker at PG creation — per-actor accounting
+        # must not double-count it against the worker.
+        self.pg_id = pg_id
+        self.bundle_index = bundle_index
 
 
 class HeadService:
@@ -334,7 +341,7 @@ class HeadService:
             with self._lock:
                 w = None
                 while w is None:
-                    w = self._pick_actor_worker_locked(
+                    w, placed_bidx = self._pick_actor_worker_locked(
                         meta.get("resources", {}), pg_id, bundle_index)
                     if w is None:
                         # Surface the blocked demand to the autoscaler.
@@ -348,11 +355,17 @@ class HeadService:
                                 f"{meta.get('resources')}")
                         self._sched_cv.wait(timeout=0.1)
                 self._pending_actor_demands.pop(actor_id, None)
-                for k, v in meta.get("resources", {}).items():
-                    w.available[k] = w.available.get(k, 0.0) - v
+                if pg_id is None:    # PG bundle already holds the reservation
+                    for k, v in meta.get("resources", {}).items():
+                        w.available[k] = w.available.get(k, 0.0) - v
+                else:                # consume the bundle's reservation
+                    used = self._pgs[pg_id]["bundle_used"][placed_bidx]
+                    for k, v in meta.get("resources", {}).items():
+                        used[k] = used.get(k, 0.0) + v
                 info = _ActorInfo(actor_id, w.worker_id, payload,
                                   meta.get("resources", {}),
-                                  meta.get("max_restarts", 0), name, ns)
+                                  meta.get("max_restarts", 0), name, ns,
+                                  pg_id=pg_id, bundle_index=placed_bidx)
                 self._actors[actor_id] = info
                 if name:
                     self._named[(ns, name)] = actor_id
@@ -368,31 +381,56 @@ class HeadService:
                     self._actors.pop(actor_id, None)
                     if name:
                         self._named.pop((ns, name), None)
-                    for k, v in meta.get("resources", {}).items():
-                        w.available[k] = w.available.get(k, 0.0) + v
+                    if pg_id is None:
+                        for k, v in meta.get("resources", {}).items():
+                            w.available[k] = w.available.get(k, 0.0) + v
+                    else:
+                        self._release_bundle_locked(
+                            pg_id, placed_bidx, meta.get("resources", {}))
                 self.mark_worker_dead(w.worker_id)
                 if time.time() > deadline:
                     raise
+
+    def _release_bundle_locked(self, pg_id, idx, resources):
+        pg = self._pgs.get(pg_id)
+        if pg is None or not (0 <= idx < len(pg.get("bundle_used", []))):
+            return
+        used = pg["bundle_used"][idx]
+        for k, v in resources.items():
+            used[k] = max(0.0, used.get(k, 0.0) - v)
+
+    def _bundle_fits_locked(self, pg, idx, resources) -> bool:
+        cap = pg["bundles"][idx][1]
+        used = pg["bundle_used"][idx]
+        return all(used.get(k, 0.0) + v <= cap.get(k, 0.0) + 1e-9
+                   for k, v in resources.items())
 
     def _pick_actor_worker_locked(self, resources, pg_id,
                                   bundle_index):
         """PG-pinned actors go to the worker holding their bundle (the
         reference routes actor creation through the bundle's raylet —
-        gcs_actor_scheduler.cc); others fall back to resource fit."""
+        gcs_actor_scheduler.cc); others fall back to resource fit.
+
+        Returns (worker, bundle_index) — bundle_index is -1 for
+        non-PG placement. PG placement is capacity-checked against the
+        bundle's reservation (pg["bundle_used"]) so actors can't
+        overcommit a bundle."""
         if pg_id is not None:
             pg = self._pgs.get(pg_id)
             if not pg or not pg["ready"]:
-                return None
+                return None, -1
             if 0 <= bundle_index < len(pg["bundles"]):
-                wid = pg["bundles"][bundle_index][0]
+                candidates = [bundle_index]
+            else:
+                candidates = range(len(pg["bundles"]))
+            for idx in candidates:
+                wid = pg["bundles"][idx][0]
                 w = self._workers.get(wid)
-                return w if (w and w.alive) else None
-            for wid in pg["workers"]:
-                w = self._workers.get(wid)
-                if w and w.alive:
-                    return w
-            return None
-        return self._pick_worker_locked(resources, None)
+                if w and w.alive and \
+                        self._bundle_fits_locked(pg, idx, resources):
+                    return w, idx
+            return None, -1
+        return self._pick_worker_locked(resources, None), -1
 
     def _handle_lost_actor(self, a: _ActorInfo):
         with self._lock:
@@ -409,21 +447,35 @@ class HeadService:
         deadline = time.time() + timeout
         while time.time() < deadline:
             with self._lock:
-                w = self._pick_worker_locked(a.resources, None)
+                if a.pg_id is not None:
+                    # The actor still holds its bundle_used claim, so
+                    # route straight back to its own bundle's worker —
+                    # no capacity re-check, no re-deduction.
+                    w = None
+                    pg = self._pgs.get(a.pg_id)
+                    if pg and 0 <= a.bundle_index < len(pg["bundles"]):
+                        cand = self._workers.get(
+                            pg["bundles"][a.bundle_index][0])
+                        if cand and cand.alive:
+                            w = cand
+                else:
+                    w = self._pick_worker_locked(a.resources, None)
                 if w is None:
                     self._sched_cv.wait(timeout=0.1)
                     continue
-                for k, v in a.resources.items():
-                    w.available[k] = w.available.get(k, 0.0) - v
+                if a.pg_id is None:
+                    for k, v in a.resources.items():
+                        w.available[k] = w.available.get(k, 0.0) - v
                 a.worker_id = w.worker_id
                 client = w.client
             try:
                 client.call("create_actor", a.actor_id, a.payload)
                 return
             except RpcError:
-                with self._lock:
-                    for k, v in a.resources.items():
-                        w.available[k] = w.available.get(k, 0.0) + v
+                if a.pg_id is None:
+                    with self._lock:
+                        for k, v in a.resources.items():
+                            w.available[k] = w.available.get(k, 0.0) + v
                 self.mark_worker_dead(w.worker_id)
         a.dead = True
         a.death_reason = "no worker available for restart"
@@ -456,7 +508,10 @@ class HeadService:
                                   else "crashed (out of restarts)")
                 if a.name:
                     self._named.pop((a.namespace, a.name), None)
-                if w and w.alive:
+                if a.pg_id is not None:
+                    self._release_bundle_locked(
+                        a.pg_id, a.bundle_index, a.resources)
+                elif w and w.alive:
                     for k, v in a.resources.items():
                         w.available[k] = min(
                             w.resources.get(k, 0.0),
@@ -572,6 +627,10 @@ class HeadService:
                 "ready": True,
                 "workers": [wid for wid, _ in reserved],
                 "bundles": reserved,
+                # Per-bundle resources consumed by PG-pinned actors —
+                # bounds packing into a bundle without touching the
+                # worker's own availability (already deducted above).
+                "bundle_used": [dict() for _ in reserved],
             }
             self._sched_cv.notify_all()
             return True

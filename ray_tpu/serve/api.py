@@ -113,12 +113,13 @@ def _deploy_one(dep: Deployment, controller, deployed: set,
     init_kwargs = {k: resolve(v) for k, v in dep._init_kwargs.items()}
     ray_tpu.get(controller.deploy.remote(
         dep.name, dep._as_class(), init_args, init_kwargs, dep.config))
-    deadline = time.time() + timeout_s
-    while not ray_tpu.get(controller.ready.remote(dep.name)):
-        if time.time() > deadline:
-            raise TimeoutError(
-                f"Deployment {dep.name!r} not ready in {timeout_s}s")
-        time.sleep(0.02)
+    if timeout_s > 0:     # timeout_s<=0 means "don't wait for readiness"
+        deadline = time.time() + timeout_s
+        while not ray_tpu.get(controller.ready.remote(dep.name)):
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"Deployment {dep.name!r} not ready in {timeout_s}s")
+            time.sleep(0.02)
     return DeploymentHandle(dep.name, controller)
 
 

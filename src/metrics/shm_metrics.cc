@@ -69,7 +69,15 @@ Slot* FindSlot(Header* hdr, const char* name) {
 Slot* FindOrCreate(Header* hdr, const char* name, uint32_t type) {
   Slot* s = FindSlot(hdr, name);
   if (s != nullptr) return s;
-  pthread_mutex_lock(&hdr->create_mutex);
+  int rc = pthread_mutex_lock(&hdr->create_mutex);
+  if (rc == EOWNERDEAD) {
+    // A process died holding the robust mutex; mark it consistent so it
+    // keeps providing mutual exclusion (else it degrades to
+    // ENOTRECOVERABLE after our unlock and creation races go unlocked).
+    pthread_mutex_consistent(&hdr->create_mutex);
+  } else if (rc != 0) {
+    return nullptr;
+  }
   s = FindSlot(hdr, name);   // re-check under the lock
   if (s == nullptr) {
     uint32_t n = hdr->num_slots.load(std::memory_order_relaxed);

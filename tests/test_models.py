@@ -246,3 +246,27 @@ def test_fused_linear_cross_entropy_matches_naive():
     n2 = float(jnp.sqrt(sum(jnp.sum(a * a)
                             for a in jax.tree_util.tree_leaves(g2))))
     np.testing.assert_allclose(n1, n2, rtol=2e-2)
+
+
+def test_llama_generate_eos_zero_not_instant_stop():
+    """ADVICE r1: eos_id=0 must not read the zero-initialized tail of
+    the token buffer as "eos already generated" and halt after one
+    decode step."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models import Llama, generate, llama_tiny
+
+    cfg = llama_tiny()
+    model = Llama(cfg)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    ref = generate(model, params, prompt, max_new_tokens=8)
+    out = generate(model, params, prompt, max_new_tokens=8, eos_id=0)
+    # Greedy decode with eos_id=0 matches the no-eos decode until a real
+    # 0 token is produced; if none was produced they must be identical.
+    gen = ref[0, 4:]
+    if not bool((gen == 0).any()):
+        assert (out == ref).all()
+    else:
+        first0 = int((gen == 0).argmax())
+        assert (out[0, 4:4 + first0 + 1] == gen[:first0 + 1]).all()

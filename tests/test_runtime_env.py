@@ -111,3 +111,19 @@ def test_runtime_env_in_worker_process():
 
         a = A.remote()
         assert ray_tpu.get(a.probe.remote()) == "w2"
+
+
+def test_runtime_env_task_nested_get_no_deadlock(rt):
+    """ADVICE r1: a runtime_env task blocking on get() of another
+    runtime_env task (both in-process on threads) must not deadlock on
+    the process-wide apply lock."""
+    @rt.remote(runtime_env={"env_vars": {"RT_ENV_CHILD": "1"}})
+    def child():
+        import os
+        return os.environ.get("RT_ENV_CHILD")
+
+    @rt.remote(runtime_env={"env_vars": {"RT_ENV_PARENT": "1"}})
+    def parent():
+        return ray_tpu.get(child.remote())
+
+    assert rt.get(parent.remote(), timeout=20) == "1"

@@ -257,3 +257,28 @@ def test_status_and_delete(serve_rt):
     assert st["deployments"]["f"]["num_replicas"] == 2
     serve.delete("f")
     assert "f" not in serve.list_deployments()
+
+
+def test_run_no_wait_returns_immediately(serve_rt):
+    """ADVICE r1: wait_for_ready=False must skip the readiness wait, not
+    raise TimeoutError on the first poll."""
+    @serve.deployment
+    class Slow:
+        def __init__(self):
+            time.sleep(0.5)
+
+        def __call__(self):
+            return "up"
+
+    h = serve.run(Slow.bind(), wait_for_ready=False)
+    # Handle returned before the replica finished __init__; a call still
+    # eventually succeeds once it's up.
+    deadline = time.time() + 30
+    while True:
+        try:
+            assert ray_tpu.get(h.remote(), timeout=30) == "up"
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
