@@ -23,9 +23,56 @@ from typing import Any, Dict, Optional
 
 _KNOWN_KEYS = {"env_vars", "working_dir", "py_modules"}
 
-# cwd / os.environ / sys.path are process-global: only one task with a
-# runtime_env mutates them at a time.
+# cwd / os.environ / sys.path are process-global; the lock guards only
+# the apply/restore mutations (never user code — see
+# runtime_env_context). Overlapping contexts are reconciled with
+# per-key undo stacks and sys.path refcounts so any completion order
+# restores the true original value.
 _apply_lock = threading.RLock()
+
+# key -> [[token, saved_value], ...] (oldest first). Restoring an entry
+# that is not top-of-stack splices it out and hands its saved value to
+# the entry above (which captured OUR value as its "old"), so the final
+# restore still lands on the genuine original.
+_env_stacks: Dict[str, list] = {}
+_cwd_stack: list = []
+_path_claims: Dict[str, int] = {}
+
+
+def _stack_restore(stack: list, token: object, setter) -> None:
+    for i, (tok, saved) in enumerate(stack):
+        if tok is token:
+            if i == len(stack) - 1:
+                setter(saved)
+            else:
+                stack[i + 1][1] = saved
+            del stack[i]
+            return
+
+
+def _claim_path(path: str) -> None:
+    rec = _path_claims.get(path)
+    if rec is not None:
+        rec[1] += 1
+        return
+    inserted = path not in sys.path   # pre-existing entries aren't ours
+    if inserted:
+        sys.path.insert(0, path)
+    _path_claims[path] = [inserted, 1]
+
+
+def _release_path(path: str) -> None:
+    rec = _path_claims.get(path)
+    if rec is None:
+        return
+    rec[1] -= 1
+    if rec[1] <= 0:
+        _path_claims.pop(path, None)
+        if rec[0]:
+            try:
+                sys.path.remove(path)
+            except ValueError:
+                pass
 
 _CACHE_DIR = os.path.join("/tmp", "ray_tpu", "runtime_env_cache")
 
@@ -97,53 +144,58 @@ def runtime_env_context(runtime_env: Optional[Dict[str, Any]]):
     if not runtime_env:
         yield
         return
-    saved_env: Dict[str, Optional[str]] = {}
-    set_env: Dict[str, str] = {}
-    saved_cwd = None
-    staged_cwd = None
-    added_paths = []
+    token = object()
+    applied = {"env": False, "cwd": False, "paths": []}
+
+    def _apply_locked():
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            _env_stacks.setdefault(k, []).append([token,
+                                                  os.environ.get(k)])
+            os.environ[k] = v
+        applied["env"] = True
+        wd = runtime_env.get("working_dir")
+        if wd:
+            staged = _stage_working_dir(wd)
+            _cwd_stack.append([token, os.getcwd()])
+            applied["cwd"] = True
+            os.chdir(staged)
+            _claim_path(staged)
+            applied["paths"].append(staged)
+        for mod in (runtime_env.get("py_modules") or []):
+            mod = os.path.abspath(mod)
+            _claim_path(mod)
+            applied["paths"].append(mod)
 
     def _restore_locked():
-        for p in added_paths:
-            try:
-                sys.path.remove(p)
-            except ValueError:
-                pass
-        if saved_cwd is not None and os.getcwd() == staged_cwd:
-            # Only undo our own chdir: a concurrently-applied env may
-            # have moved cwd since; restoring blindly would clobber it.
-            try:
-                os.chdir(saved_cwd)
-            except OSError:
-                pass
-        for k, old in saved_env.items():
-            if os.environ.get(k) != set_env.get(k):
-                continue   # someone else overwrote it; not ours to undo
-            if old is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = old
+        # Idempotent: every branch consumes its `applied` mark, so a
+        # double call (apply-failure path + finally) is a no-op.
+        for p in applied["paths"]:
+            _release_path(p)
+        applied["paths"] = []
+        if applied["cwd"]:
+            _stack_restore(_cwd_stack, token,
+                           lambda old: os.chdir(old))
+            applied["cwd"] = False
+        if applied["env"]:
+            for k in (runtime_env.get("env_vars") or {}):
+                stack = _env_stacks.get(k)
+                if not stack:
+                    continue
+
+                def setter(old, k=k):
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
+                _stack_restore(stack, token, setter)
+                if not stack:
+                    _env_stacks.pop(k, None)
+            applied["env"] = False
 
     try:
         with _apply_lock:
             try:
-                for k, v in (runtime_env.get("env_vars") or {}).items():
-                    saved_env[k] = os.environ.get(k)
-                    os.environ[k] = v
-                    set_env[k] = v
-                wd = runtime_env.get("working_dir")
-                if wd:
-                    staged_cwd = _stage_working_dir(wd)
-                    saved_cwd = os.getcwd()
-                    os.chdir(staged_cwd)
-                    if staged_cwd not in sys.path:
-                        sys.path.insert(0, staged_cwd)
-                        added_paths.append(staged_cwd)
-                for mod in (runtime_env.get("py_modules") or []):
-                    mod = os.path.abspath(mod)
-                    if mod not in sys.path:
-                        sys.path.insert(0, mod)
-                        added_paths.append(mod)
+                _apply_locked()
             except BaseException:
                 _restore_locked()   # half-applied: undo before raising
                 raise

@@ -31,6 +31,12 @@ class ScalingConfig:
     topology: Optional[str] = None
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
+    # Multi-host: bootstrap jax.distributed across the gang so the mesh
+    # spans every member's devices. None = auto (on when num_workers>1
+    # and the gang landed in distinct OS processes); True = require
+    # (error if the runtime can't give the gang distinct processes);
+    # False = never (each worker meshes only its local devices).
+    jax_distributed: Optional[bool] = None
 
     def mesh_spec(self) -> Optional[MeshSpec]:
         if self.mesh is None:

@@ -696,6 +696,18 @@ class HeadService:
             raise RuntimeError("No node manager attached to this head")
         return nm.start_worker(len(nm.procs), resources)
 
+    def stop_worker(self, worker_id: str) -> None:
+        """Tear down a (dedicated) worker process — the inverse of
+        request_worker; used by gang trainers to retire their gang's
+        processes so re-bootstrap always gets fresh ones."""
+        nm = getattr(self, "_node_manager", None)
+        if nm is not None:
+            try:
+                nm.kill_worker(worker_id)
+            except Exception:
+                pass
+        self.mark_worker_dead(worker_id)
+
     def store_stats(self) -> Dict[str, Any]:
         store = self._get_store()
         return store.stats()

@@ -1,6 +1,7 @@
 from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
                                 ScalingConfig)
 from ray_tpu.air.result import Result
+from ray_tpu.train import gang
 from ray_tpu.train.gbdt import (LightGBMTrainer, SklearnTrainer,
                                 XGBoostTrainer)
 from ray_tpu.train.predictor import (BatchPredictor, JaxPredictor,
@@ -8,7 +9,7 @@ from ray_tpu.train.predictor import (BatchPredictor, JaxPredictor,
 from ray_tpu.train.trainer import BaseTrainer, JaxTrainer, DataParallelTrainer
 from ray_tpu.train.torch import TorchTrainer
 
-__all__ = ["BaseTrainer", "JaxTrainer", "DataParallelTrainer",
+__all__ = ["gang", "BaseTrainer", "JaxTrainer", "DataParallelTrainer",
            "TorchTrainer", "SklearnTrainer", "XGBoostTrainer",
            "LightGBMTrainer", "Predictor", "JaxPredictor",
            "SklearnPredictor", "BatchPredictor",

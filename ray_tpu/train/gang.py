@@ -1,0 +1,102 @@
+"""Multi-host gang bootstrap: jax.distributed across a trainer gang.
+
+Reference analogue: the torch rendezvous in
+python/ray/train/torch/config.py:54 (_setup_torch_process_group) — worker 0
+owns the rendezvous endpoint and every gang member connects to it. The
+TPU-native replacement is jax.distributed's coordinator service: after
+``init_gang`` on every member, ``jax.devices()`` is the GLOBAL device list
+across all gang hosts and pjit programs span the whole slice, with XLA
+placing collectives on ICI (intra-slice) / DCN (cross-slice). No process
+groups, no NCCL — the mesh IS the collective topology (SURVEY.md §5.8).
+
+One gang member == one OS process == one "JAX host". On real TPU pods
+that's one TPU VM host (its local chips are the process's addressable
+devices); in tests it's a worker process with a virtual CPU device count.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+# Collision-free identity for "distinct OS process" checks: PIDs repeat
+# across hosts/containers, hostnames repeat across containers — a
+# per-process random id does not.
+import uuid as _uuid  # noqa: E402
+PROCESS_UUID = _uuid.uuid4().hex
+
+# Per-process gang state. jax.distributed can only be initialized once per
+# process lifetime; re-bootstrap therefore requires a fresh worker process
+# (the node manager replaces dead workers, so elastic restart gets fresh
+# processes for the dead members; surviving members re-use their init only
+# if the coordinator endpoint is unchanged).
+_STATE = {"coordinator": None, "num_processes": 0, "process_id": -1}
+
+
+def pick_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def host_ip() -> str:
+    """Best-effort routable IP of this host (the coordinator must be
+    reachable from every gang member's host)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))     # no packets sent
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def coordinator_endpoint() -> str:
+    """Allocate a coordinator endpoint on THIS host. Must be called in
+    the process that will be gang process 0 (jax.distributed starts the
+    coordination service there). If this process already bootstrapped as
+    process 0, returns the existing endpoint so a gang re-run in
+    surviving processes is an idempotent no-op."""
+    if gang_initialized() and _STATE["process_id"] == 0:
+        return _STATE["coordinator"]
+    return f"{host_ip()}:{pick_free_port()}"
+
+
+def init_gang(coordinator: str, num_processes: int,
+              process_id: int) -> None:
+    """Idempotent jax.distributed.initialize for this process.
+
+    Must run before this process's first JAX computation (backend init
+    locks the device topology). A second call with the same coordinates
+    is a no-op; different coordinates in an already-bootstrapped process
+    raise — the caller needs a fresh process.
+    """
+    if _STATE["coordinator"] is not None:
+        if (_STATE["coordinator"] == coordinator and
+                _STATE["num_processes"] == num_processes and
+                _STATE["process_id"] == process_id):
+            return
+        raise RuntimeError(
+            f"jax.distributed already initialized in this process as "
+            f"process {_STATE['process_id']}/{_STATE['num_processes']} "
+            f"@ {_STATE['coordinator']}; cannot re-bootstrap as "
+            f"{process_id}/{num_processes} @ {coordinator}. Gang "
+            f"re-bootstrap requires a fresh worker process.")
+    import jax
+    jax.distributed.initialize(coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _STATE.update(coordinator=coordinator, num_processes=num_processes,
+                  process_id=process_id)
+    logger.info("gang member %d/%d joined %s: %d global / %d local "
+                "devices", process_id, num_processes, coordinator,
+                jax.device_count(), jax.local_device_count())
+
+
+def gang_initialized() -> bool:
+    return _STATE["coordinator"] is not None
+
+
+def gang_process_id() -> Optional[int]:
+    return _STATE["process_id"] if gang_initialized() else None

@@ -89,6 +89,20 @@ def batch_shardings(mesh: Mesh, batch_example) -> Any:
 
 
 def put_batch(batch, mesh: Mesh):
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(
-            x, NamedSharding(mesh, P(("dcn", "data", "fsdp")))), batch)
+    """Shard a batch's leading dim over the data axes.
+
+    Single-process: a plain device_put. Multi-process gang (the mesh
+    spans jax.distributed hosts): each process contributes its LOCAL
+    batch as this host's shard of the global array — per-host data
+    loading, the global batch is num_hosts x local without any
+    host-to-host copy."""
+    sh = NamedSharding(mesh, P(("dcn", "data", "fsdp")))
+    import numpy as np
+
+    def put(x):
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                sh, np.asarray(x))
+        return jax.device_put(x, sh)
+
+    return jax.tree_util.tree_map(put, batch)

@@ -55,6 +55,23 @@ class BaseTrainer:
     def _backend_setup(self) -> Optional[Callable]:
         return None
 
+    def _use_jax_distributed(self, group: WorkerGroup) -> bool:
+        """Whether to bootstrap jax.distributed across this gang (see
+        ScalingConfig.jax_distributed). Only meaningful for trainers
+        that build a device mesh."""
+        want = self.scaling_config.jax_distributed
+        if want is False or self._mesh_axes() is None or \
+                self.scaling_config.num_workers <= 1:
+            return False
+        can = group.can_bootstrap_gang()
+        if want is True and not can:
+            raise RuntimeError(
+                "ScalingConfig.jax_distributed=True but the gang "
+                "members do not occupy distinct OS processes (the "
+                "in-process local runtime cannot host a jax.distributed "
+                "gang — start a multiprocess Cluster).")
+        return can
+
     def fit(self) -> Result:
         from ray_tpu._private.usage_stats import record_library_usage
         record_library_usage("train")
@@ -87,14 +104,22 @@ class BaseTrainer:
     def _run_once(self, resume_ckpt: Optional[Checkpoint],
                   history: list) -> Result:
         sc = self.scaling_config
+        # Gang trainers get dedicated FRESH worker processes so
+        # jax.distributed bootstrap (and re-bootstrap after an elastic
+        # restart) is reliable — a process joins one coordinator ever.
+        want_gang = (sc.jax_distributed is not False and
+                     sc.num_workers > 1 and
+                     self._mesh_axes() is not None)
         group = WorkerGroup(sc.num_workers, sc.worker_resources(),
-                            sc.placement_strategy)
+                            sc.placement_strategy,
+                            dedicated_processes=want_gang)
         latest_ckpt = resume_ckpt
         last_metrics: Optional[Dict[str, Any]] = None
         try:
             run_refs = group.start_run(self._loop, self._config,
                                        self._mesh_axes(), resume_ckpt,
-                                       self._backend_setup())
+                                       self._backend_setup(),
+                                       self._use_jax_distributed(group))
             done = [False] * sc.num_workers
             error: Optional[BaseException] = None
             while not all(done) and error is None:

@@ -33,10 +33,31 @@ class TrainWorker:
         self._done = False
         self._error: Optional[BaseException] = None
 
+    def process_identity(self) -> str:
+        """Collision-free per-process id (PIDs/hostnames repeat across
+        containers; see gang.PROCESS_UUID)."""
+        from ray_tpu.train import gang
+        return gang.PROCESS_UUID
+
+    def gang_endpoint(self) -> str:
+        """Allocate (or reuse) the jax.distributed coordinator endpoint
+        on this host — called on the rank-0 member only."""
+        from ray_tpu.train import gang
+        return gang.coordinator_endpoint()
+
     def run(self, loop_fn: Callable, config: Dict[str, Any],
             mesh_axes: Optional[Dict[str, int]],
             resume_checkpoint: Optional[Checkpoint],
-            backend_setup: Optional[Callable] = None) -> str:
+            backend_setup: Optional[Callable] = None,
+            gang_bootstrap: Optional[Dict[str, Any]] = None) -> str:
+        if gang_bootstrap is not None:
+            # Join the jax.distributed gang BEFORE any jax computation:
+            # after this, jax.devices() spans every member's chips and
+            # the mesh below is a true multi-host mesh.
+            from ray_tpu.train import gang
+            gang.init_gang(gang_bootstrap["coordinator"],
+                           gang_bootstrap["num_processes"],
+                           self.rank)
         mesh = None
         if mesh_axes is not None:
             from ray_tpu.mesh import create_mesh
@@ -100,8 +121,15 @@ class WorkerGroup:
 
     def __init__(self, num_workers: int,
                  resources_per_worker: Dict[str, float],
-                 placement_strategy: str = "PACK"):
+                 placement_strategy: str = "PACK",
+                 dedicated_processes: bool = False):
         self.num_workers = num_workers
+        self._dedicated_worker_ids: List[str] = []
+        self._head = None
+        if dedicated_processes:
+            resources_per_worker, placement_strategy = \
+                self._spawn_dedicated(num_workers,
+                                      dict(resources_per_worker))
         self._pg = placement_group(
             [dict(resources_per_worker) for _ in range(num_workers)],
             strategy=placement_strategy)
@@ -123,10 +151,63 @@ class WorkerGroup:
             ).remote(rank, num_workers)
             self.workers.append(w)
 
+    def _spawn_dedicated(self, num_workers, resources):
+        """Spawn one FRESH worker process per gang member, tagged with a
+        one-off token resource so the placement group lands exactly on
+        them. Fresh processes are what make jax.distributed bootstrap
+        (and gang re-bootstrap after an elastic restart) reliable: a
+        process can only ever join one coordinator (gang.init_gang).
+        Reference shape: dedicated train-worker processes under the
+        Train placement group (backend_executor.py:137).
+
+        No-op (returns inputs unchanged) on the in-process local
+        runtime, which has no worker processes to spawn."""
+        import uuid
+        from ray_tpu._private.worker import global_worker
+        head = getattr(global_worker().runtime, "head", None)
+        if head is None:
+            return resources, "PACK"
+        token = f"_gang_{uuid.uuid4().hex[:8]}"
+        res = dict(resources)
+        res[token] = 1.0
+        for _ in range(num_workers):
+            self._dedicated_worker_ids.append(
+                head.call("request_worker", res))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            alive = [w for w in head.call("list_workers")
+                     if w["alive"] and token in w.get("resources", {})]
+            if len(alive) >= num_workers:
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError(
+                f"dedicated gang workers did not register: "
+                f"{self._dedicated_worker_ids}")
+        self._head = head
+        return res, "STRICT_SPREAD"
+
+    def can_bootstrap_gang(self) -> bool:
+        """jax.distributed needs one OS process per member: true iff all
+        members landed in distinct processes, none of them the driver
+        (the local thread-runtime runs actors in-process)."""
+        from ray_tpu.train import gang
+        ids = ray_tpu.get(
+            [w.process_identity.remote() for w in self.workers])
+        return (len(set(ids)) == self.num_workers and
+                gang.PROCESS_UUID not in ids)
+
     def start_run(self, loop_fn, config, mesh_axes, resume_checkpoint,
-                  backend_setup=None):
+                  backend_setup=None, jax_distributed=False):
+        gang_bootstrap = None
+        if jax_distributed:
+            coordinator = ray_tpu.get(
+                self.workers[0].gang_endpoint.remote())
+            gang_bootstrap = {"coordinator": coordinator,
+                              "num_processes": self.num_workers}
         return [w.run.remote(loop_fn, config, mesh_axes,
-                             resume_checkpoint, backend_setup)
+                             resume_checkpoint, backend_setup,
+                             gang_bootstrap)
                 for w in self.workers]
 
     def poll_all(self) -> List[Dict[str, Any]]:
@@ -139,3 +220,8 @@ class WorkerGroup:
             except Exception:
                 pass
         remove_placement_group(self._pg)
+        for wid in self._dedicated_worker_ids:
+            try:
+                self._head.call("stop_worker", wid)
+            except Exception:
+                pass

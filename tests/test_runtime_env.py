@@ -127,3 +127,52 @@ def test_runtime_env_task_nested_get_no_deadlock(rt):
         return ray_tpu.get(child.remote())
 
     assert rt.get(parent.remote(), timeout=20) == "1"
+
+
+def test_runtime_env_overlapping_restore_order(rt):
+    """Overlapping tasks setting the same env var must restore the TRUE
+    original no matter which finishes first (per-key undo stacks)."""
+    import os
+    import threading
+    os.environ["RT_ENV_OVERLAP"] = "orig"
+    try:
+        from ray_tpu._private.runtime_env import runtime_env_context
+        ev_a_applied = threading.Event()
+        ev_b_applied = threading.Event()
+        ev_a_done = threading.Event()
+
+        def task_a():
+            with runtime_env_context(
+                    {"env_vars": {"RT_ENV_OVERLAP": "a"}}):
+                ev_a_applied.set()
+                ev_b_applied.wait(5)   # B applies over us
+            ev_a_done.set()            # A restores FIRST (mid-stack)
+
+        def task_b():
+            ev_a_applied.wait(5)
+            with runtime_env_context(
+                    {"env_vars": {"RT_ENV_OVERLAP": "b"}}):
+                ev_b_applied.set()
+                ev_a_done.wait(5)      # outlive A
+
+        ta = threading.Thread(target=task_a)
+        tb = threading.Thread(target=task_b)
+        ta.start(); tb.start()
+        ta.join(10); tb.join(10)
+        assert os.environ["RT_ENV_OVERLAP"] == "orig"
+    finally:
+        os.environ.pop("RT_ENV_OVERLAP", None)
+
+
+def test_runtime_env_apply_failure_restores(rt):
+    """A half-applied env (bad working_dir after env_vars) must undo the
+    env_vars before raising — and must not double-restore."""
+    import os
+    from ray_tpu._private.runtime_env import runtime_env_context
+    assert "RT_ENV_HALF" not in os.environ
+    with pytest.raises(FileNotFoundError):
+        with runtime_env_context({
+                "env_vars": {"RT_ENV_HALF": "x"},
+                "working_dir": "/nonexistent_dir_xyz"}):
+            pass
+    assert "RT_ENV_HALF" not in os.environ

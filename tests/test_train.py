@@ -221,3 +221,112 @@ def test_gbdt_trainers_gated():
         XGBoostTrainer()
     with pytest.raises(ImportError, match="lightgbm"):
         LightGBMTrainer()
+
+
+def test_jax_trainer_multihost_gang():
+    """VERDICT r1 #2: a JaxTrainer gang spanning SEPARATE OS processes
+    bootstraps jax.distributed (coordinator from rank 0) and builds ONE
+    mesh over every member's devices — the multi-host training model
+    (SURVEY §7 step 6), exercised with 2 virtual CPU hosts x 8 devices."""
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=2, resources_per_worker={"CPU": 2}):
+        from ray_tpu.train import JaxTrainer, ScalingConfig
+        from ray_tpu.air import session
+
+        def loop(config):
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ray_tpu.train.spmd import put_batch
+
+            mesh = session.get_mesh()
+            rank = session.get_world_rank()
+            # The mesh must span BOTH hosts' devices.
+            n_global = int(np.prod(list(mesh.shape.values())))
+
+            @jax.jit
+            def step(w, batch):
+                x, y = batch["x"], batch["y"]
+
+                def loss_fn(w):
+                    return jnp.mean((x @ w - y) ** 2)
+                loss, g = jax.value_and_grad(loss_fn)(w)
+                return w - 0.1 * g, loss
+
+            rng = np.random.RandomState(0)
+            true_w = np.asarray(rng.randn(16, 4), np.float32)
+            local_rng = np.random.RandomState(100 + rank)
+            w = jax.device_put(jnp.zeros((16, 4)),
+                               NamedSharding(mesh, P()))
+            losses = []
+            for _ in range(60):
+                # Per-host local batch: each host contributes its own
+                # shard of the global batch (no cross-host copies).
+                xl = np.asarray(local_rng.randn(32, 16), np.float32)
+                yl = xl @ true_w
+                batch = put_batch({"x": xl, "y": yl}, mesh)
+                w, loss = step(w, batch)
+                losses.append(float(loss))
+            session.report({
+                "first_loss": losses[0], "last_loss": losses[-1],
+                "n_global_devices": n_global,
+                "process_count": jax.process_count(),
+                "process_index": jax.process_index(),
+            })
+
+        result = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(
+                num_workers=2, mesh={"data": -1},
+                jax_distributed=True,
+                placement_strategy="STRICT_SPREAD")).fit()
+        assert result.ok, result.error
+        m = result.metrics
+        assert m["process_count"] == 2
+        assert m["n_global_devices"] == 16
+        assert m["last_loss"] < m["first_loss"] * 0.1
+
+
+def test_jax_trainer_gang_elastic_restart():
+    """Gang elastic restart re-bootstraps jax.distributed cleanly: each
+    attempt gets FRESH dedicated worker processes (a process can join
+    only one coordinator), so attempt 2 succeeds after attempt 1's gang
+    fails mid-run."""
+    import os
+    import tempfile
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    marker = os.path.join(tempfile.mkdtemp(), "attempt1_failed")
+    with Cluster(num_workers=2, resources_per_worker={"CPU": 2}):
+        from ray_tpu.train import (FailureConfig, JaxTrainer, RunConfig,
+                                   ScalingConfig)
+        from ray_tpu.air import session
+
+        def loop(config):
+            import jax
+            import os
+            # Join the mesh first — proves bootstrap worked this attempt.
+            n = jax.device_count()
+            if session.get_world_rank() == 1 and \
+                    not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                raise RuntimeError("injected gang failure")
+            session.report({"devices": n,
+                            "procs": jax.process_count()})
+
+        result = JaxTrainer(
+            loop, train_loop_config={"marker": marker},
+            scaling_config=ScalingConfig(
+                num_workers=2, mesh={"data": -1}, jax_distributed=True),
+            run_config=RunConfig(
+                failure_config=FailureConfig(max_failures=2))).fit()
+        assert result.ok, result.error
+        assert result.metrics["procs"] == 2
+        assert result.metrics["devices"] == 16
+        assert os.path.exists(marker)   # attempt 1 really failed
