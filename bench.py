@@ -40,7 +40,8 @@ def main():
 
     from ray_tpu.mesh import create_mesh
     from ray_tpu.models import GPT2, gpt2_124m, gpt2_sharding_rules
-    from ray_tpu.models.gpt2 import cross_entropy_loss, flops_per_token
+    from ray_tpu.models.gpt2 import (flops_per_token,
+                                     linear_cross_entropy)
     from ray_tpu.train.spmd import (TrainState, make_train_step,
                                     put_batch, shard_state)
 
@@ -49,9 +50,11 @@ def main():
     on_tpu = devices[0].platform == "tpu"
 
     seq = 1024
-    # Measured sweep on v5e: batch 24 + flash attention (blk 1024) is
-    # the per-chip sweet spot — 43% MFU vs 34.6% at batch 8 (batch 32+
-    # regresses; fp32 logits + activations start to thrash HBM).
+    # Measured sweep on v5e (tools/mfu_sweep.py / mfu_round2.py): batch
+    # 24 + packed flash attention (blk 1024) + lse-gather CE is the
+    # per-chip sweet spot — 53.2% MFU; batch 32 regresses (fp32 logits
+    # thrash HBM) and the scan-chunked fused CE loses to XLA's own
+    # scheduling of the one big projection.
     batch = 24 * n_chips if on_tpu else 2
     cfg = gpt2_124m() if on_tpu else gpt2_124m(n_layer=2, n_embd=128,
                                                n_head=4, vocab_size=1024,
@@ -68,7 +71,8 @@ def main():
 
     def loss_fn(params, b):
         x, y = b["ids"][:, :-1], b["ids"][:, 1:]
-        return cross_entropy_loss(model.apply(params, x), y)
+        feats = model.apply(params, x, return_features=True)
+        return linear_cross_entropy(feats, params["params"]["wte"], y)
 
     train_step = make_train_step(loss_fn, optimizer)
     rng = np.random.RandomState(0)

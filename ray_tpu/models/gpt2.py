@@ -162,6 +162,29 @@ def cross_entropy_loss(logits, targets, ignore_index: int = -100):
     return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
 
 
+def linear_cross_entropy(features, wte, targets,
+                         ignore_index: int = -100):
+    """Tied-embedding projection + cross-entropy via logsumexp-gather.
+
+    Keeps the [B, T, V] logits fp32 (needed for a stable softmax over
+    50k classes) but never materializes log-softmax as a saved
+    residual — backward recomputes softmax from the logits, so HBM
+    sees one logits tensor instead of two. Measured on v5e (GPT-2-124M
+    b24, tools/mfu_round2.py): 46.9% MFU vs 42.5% for the
+    log_softmax/take_along_axis formulation, and it beats the
+    scan-chunked variant (fused_linear_cross_entropy) by 7+ points —
+    XLA overlaps the one big projection better than a serialized scan.
+    """
+    mask = (targets != ignore_index)
+    tgt = jnp.where(mask, targets, 0)
+    logits = jax.lax.dot_general(
+        features, wte.astype(features.dtype), (((2,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    return ((lse - gold) * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
 def fused_linear_cross_entropy(features, wte, targets,
                                chunk: int = 128,
                                ignore_index: int = -100):
@@ -211,6 +234,15 @@ def gpt2_sharding_rules(fsdp: bool = True) -> ShardingRules:
     large dim over `fsdp`.
     """
     f = "fsdp" if fsdp else None
+    # Embeddings are vocab/ctx-parallel with the embedding dim UNSHARDED:
+    # sharding wte/wpe's trailing dim over `fsdp` forces the partitioner
+    # to reshard batch-sharded (data, fsdp) activation gradients onto an
+    # embedding-dim fsdp layout with a transposed mesh order — an
+    # "involuntary full rematerialization" (replicate-then-reshard) in
+    # the embedding backward on dp x fsdp x tp meshes. Sharding only the
+    # vocab/ctx dim (over tensor AND fsdp) keeps dwte/dwpe a pure
+    # scatter into row shards; the dryrun log is remat-warning-free.
+    wte_spec = P(("tensor", "fsdp") if fsdp else "tensor", None)
     return ShardingRules([
         (r"attn/c_attn/kernel", P(f, "tensor")),
         (r"attn/c_proj/kernel", P("tensor", f)),
@@ -218,8 +250,8 @@ def gpt2_sharding_rules(fsdp: bool = True) -> ShardingRules:
         (r"mlp/c_proj/kernel",  P("tensor", f)),
         (r"attn/c_attn/bias",   P("tensor")),
         (r"mlp/c_fc/bias",      P("tensor")),
-        (r"wte$",               P("tensor", f)),
-        (r"wpe$",               P(None, f)),
+        (r"wte$",               wte_spec),
+        (r"wpe$",               P(f, None)),
         # ln_*/scale|bias and remaining biases: replicate (default).
     ])
 

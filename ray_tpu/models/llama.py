@@ -312,7 +312,10 @@ def llama_sharding_rules(fsdp: bool = True) -> ShardingRules:
     """Megatron TP + FSDP rules for flax Llama params.
 
     Column-parallel: wq/wk/wv, w1/w3. Row-parallel: wo, w2.
-    Embeddings shard vocab over `tensor`, dim over `fsdp`.
+    Embeddings are vocab-parallel over (tensor, fsdp) with the model dim
+    unsharded — sharding the model dim of tok_embeddings over fsdp
+    forces an involuntary-full-remat reshard of the embedding gradient
+    on dp x fsdp x tp meshes (see gpt2_sharding_rules).
     """
     f = "fsdp" if fsdp else None
     return ShardingRules([
@@ -320,7 +323,8 @@ def llama_sharding_rules(fsdp: bool = True) -> ShardingRules:
         (r"attention/wo/kernel",     P("tensor", f)),
         (r"feed_forward/w[13]/kernel", P(f, "tensor")),
         (r"feed_forward/w2/kernel",  P("tensor", f)),
-        (r"tok_embeddings$",         P("tensor", f)),
+        (r"tok_embeddings$",
+         P(("tensor", "fsdp") if fsdp else "tensor", None)),
     ])
 
 

@@ -57,3 +57,33 @@ def test_flash_rejects_unaligned():
     q, k, v = _rand_qkv(T=100)
     with pytest.raises(ValueError):
         flash_attention(q, k, v)
+
+
+def test_flash_rejects_causal_cross_length():
+    q, _, _ = _rand_qkv(T=512, H=1)
+    _, k, v = _rand_qkv(T=256, H=1, seed=3)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, causal=True)
+
+
+@pytest.mark.parametrize("H,D", [
+    (4, 64),    # multi-group packed path (G=2), the GPT-2-shape family
+    (12, 64),   # the production GPT-2-124M head config (G=6)
+    (3, 64),    # odd H: padded to H'=4
+    (2, 96),    # D not a power of two: padded to D'=128
+    (2, 256),   # wide heads D > 128: one head per program
+])
+def test_flash_packed_groups_and_padding(H, D):
+    q, k, v = _rand_qkv(B=1, T=256, H=H, D=D, seed=4)
+    expected = xla_attention(q, k, v, causal=True, precision="highest")
+    out = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(out),
+                               rtol=2e-3, atol=2e-3)
+    # Gradients flow through the pad/slice wrapper correctly.
+    gf = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, causal=True) ** 2))(q)
+    gx = jax.grad(lambda q: jnp.sum(
+        xla_attention(q, k, v, causal=True,
+                      precision="highest") ** 2))(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gx),
+                               rtol=5e-3, atol=5e-3)

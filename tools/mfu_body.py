@@ -1,0 +1,78 @@
+"""Body-only variants: attention impl, LN dtype, fwd-vs-bwd split."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.mesh import create_mesh
+    from ray_tpu.models import GPT2, gpt2_124m, gpt2_sharding_rules
+    from ray_tpu.train.spmd import (TrainState, make_train_step,
+                                    put_batch, shard_state)
+
+    devices = jax.devices()
+    seq, batch, steps = 1024, 24, 15
+    mesh = create_mesh({"data": -1}, devices=devices)
+    rules = gpt2_sharding_rules(fsdp=False)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, 50304, size=(batch, seq + 1), dtype=np.int32)
+
+    def run(name, cfg, mode):
+        model = GPT2(cfg)
+        ids = jnp.zeros((batch, seq + 1), dtype=jnp.int32)
+        params = jax.jit(lambda: model.init(jax.random.PRNGKey(0),
+                                            ids[:, :-1]))()
+
+        def loss_fn(params, b):
+            x = b["ids"][:, :-1]
+            feats = model.apply(params, x, return_features=True)
+            return feats.astype(jnp.float32).mean()
+
+        with jax.set_mesh(mesh):
+            b = put_batch({"ids": jnp.asarray(data)}, mesh)
+            if mode == "train":
+                optimizer = optax.adamw(3e-4, weight_decay=0.1)
+                state = shard_state(
+                    TrainState.create(params, optimizer), rules, mesh)
+                step = make_train_step(loss_fn, optimizer)
+                state, m = step(state, b)
+                float(m["loss"])
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    state, m = step(state, b)
+                float(m["loss"])
+                dt = time.perf_counter() - t0
+            else:  # fwd only
+                fwd = jax.jit(loss_fn)
+                float(fwd(params, b))
+                t0 = time.perf_counter()
+                out = None
+                for _ in range(steps):
+                    out = fwd(params, b)
+                float(out)
+                dt = time.perf_counter() - t0
+        print(json.dumps({"variant": name, "mode": mode,
+                          "step_ms": round(1000 * dt / steps, 2)}),
+              flush=True)
+
+    base = gpt2_124m()
+    run("flash_train", base, "train")
+    run("flash_fwd", base, "fwd")
+    run("xla_train", gpt2_124m(attention_impl="xla"), "train")
+    run("xla_fwd", gpt2_124m(attention_impl="xla"), "fwd")
+    run("bf16ln_train", gpt2_124m(dtype=jnp.bfloat16), "train")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Decompose GPT-2 step time: body-only vs vocab-projection vs optimizer,
+and test an unrolled (non-scan) chunked CE. Prints one JSON line each.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def bench_step(name, loss_fn, batch, steps, model, cfg):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.mesh import create_mesh
+    from ray_tpu.models import gpt2_sharding_rules
+    from ray_tpu.models.gpt2 import flops_per_token
+    from ray_tpu.train.spmd import (TrainState, make_train_step,
+                                    put_batch, shard_state)
+    from bench import peak_flops
+
+    devices = jax.devices()
+    seq = 1024
+    mesh = create_mesh({"data": -1}, devices=devices)
+    rules = gpt2_sharding_rules(fsdp=False)
+    ids = jnp.zeros((batch, seq + 1), dtype=jnp.int32)
+    params = jax.jit(lambda: model.init(jax.random.PRNGKey(0),
+                                        ids[:, :-1]))()
+    optimizer = optax.adamw(3e-4, weight_decay=0.1)
+    state = shard_state(TrainState.create(params, optimizer), rules, mesh)
+    train_step = make_train_step(loss_fn, optimizer)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, cfg.vocab_size, size=(batch, seq + 1),
+                       dtype=np.int32)
+    with jax.set_mesh(mesh):
+        b = put_batch({"ids": jnp.asarray(data)}, mesh)
+        state, metrics = train_step(state, b)
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = train_step(state, b)
+        float(metrics["loss"])
+        dt = time.perf_counter() - t0
+    tok_s_chip = batch * seq * steps / dt
+    mfu = tok_s_chip * flops_per_token(cfg, seq) / peak_flops(devices[0])
+    print(json.dumps({"variant": name, "batch": batch,
+                      "step_ms": round(1000 * dt / steps, 2),
+                      "mfu_vs_full_flops": round(mfu, 4)}), flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import GPT2, gpt2_124m
+    from ray_tpu.models.gpt2 import cross_entropy_loss
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=15)
+    p.add_argument("--batch", type=int, default=24)
+    args = p.parse_args()
+    cfg = gpt2_124m()
+    model = GPT2(cfg)
+
+    def loss_naive(params, b):
+        x, y = b["ids"][:, :-1], b["ids"][:, 1:]
+        return cross_entropy_loss(model.apply(params, x), y)
+
+    def loss_body_only(params, b):
+        x = b["ids"][:, :-1]
+        feats = model.apply(params, x, return_features=True)
+        return feats.astype(jnp.float32).mean()
+
+    def make_unrolled(n_chunks):
+        def loss_unrolled(params, b):
+            x, y = b["ids"][:, :-1], b["ids"][:, 1:]
+            feats = model.apply(params, x, return_features=True)
+            wte = params["params"]["wte"]
+            B, T, C = feats.shape
+            step = T // n_chunks
+            total = jnp.float32(0.0)
+            count = jnp.int32(0)
+
+            @jax.checkpoint
+            def chunk_loss(xx, tt):
+                logits = jax.lax.dot_general(
+                    xx, wte.astype(xx.dtype), (((2,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                ll = jnp.take_along_axis(
+                    logp, tt[..., None], axis=-1)[..., 0]
+                return -ll.sum(), tt.size
+
+            for i in range(n_chunks):
+                ls, cnt = chunk_loss(
+                    feats[:, i * step:(i + 1) * step],
+                    y[:, i * step:(i + 1) * step])
+                total += ls
+                count += cnt
+            return total / count
+        return loss_unrolled
+
+    bench_step("naive", loss_naive, args.batch, args.steps, model, cfg)
+    bench_step("body_only", loss_body_only, args.batch, args.steps,
+               model, cfg)
+    bench_step("unrolled2", make_unrolled(2), args.batch, args.steps,
+               model, cfg)
+    bench_step("unrolled4", make_unrolled(4), args.batch, args.steps,
+               model, cfg)
+
+
+if __name__ == "__main__":
+    main()

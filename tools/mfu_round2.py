@@ -1,0 +1,100 @@
+"""Round 2: batch scaling of the body + lse-gather CE + part isolation."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.mesh import create_mesh
+    from ray_tpu.models import GPT2, gpt2_124m, gpt2_sharding_rules
+    from ray_tpu.models.gpt2 import flops_per_token
+    from ray_tpu.train.spmd import (TrainState, make_train_step,
+                                    put_batch, shard_state)
+    from bench import peak_flops
+
+    devices = jax.devices()
+    seq, steps = 1024, 15
+    mesh = create_mesh({"data": -1}, devices=devices)
+    rules = gpt2_sharding_rules(fsdp=False)
+
+    def run(name, batch, loss_kind):
+        cfg = gpt2_124m()
+        model = GPT2(cfg)
+        rng = np.random.RandomState(0)
+        data = rng.randint(0, cfg.vocab_size, size=(batch, seq + 1),
+                           dtype=np.int32)
+        ids = jnp.zeros((batch, seq + 1), dtype=jnp.int32)
+        params = jax.jit(lambda: model.init(jax.random.PRNGKey(0),
+                                            ids[:, :-1]))()
+
+        if loss_kind == "body":
+            def loss_fn(params, b):
+                x = b["ids"][:, :-1]
+                feats = model.apply(params, x, return_features=True)
+                return feats.astype(jnp.float32).mean()
+        elif loss_kind == "lse":
+            def loss_fn(params, b):
+                x, y = b["ids"][:, :-1], b["ids"][:, 1:]
+                feats = model.apply(params, x, return_features=True)
+                wte = params["params"]["wte"]
+                logits = jax.lax.dot_general(
+                    feats, wte.astype(feats.dtype),
+                    (((2,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                lse = jax.scipy.special.logsumexp(logits, axis=-1)
+                gold = jnp.take_along_axis(
+                    logits, y[..., None], axis=-1)[..., 0]
+                return (lse - gold).mean()
+        else:
+            from ray_tpu.models.gpt2 import cross_entropy_loss
+
+            def loss_fn(params, b):
+                x, y = b["ids"][:, :-1], b["ids"][:, 1:]
+                return cross_entropy_loss(model.apply(params, x), y)
+
+        optimizer = optax.adamw(3e-4, weight_decay=0.1)
+        state = shard_state(TrainState.create(params, optimizer), rules,
+                            mesh)
+        train_step = make_train_step(loss_fn, optimizer)
+        try:
+            with jax.set_mesh(mesh):
+                b = put_batch({"ids": jnp.asarray(data)}, mesh)
+                state, m = train_step(state, b)
+                float(m["loss"])
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    state, m = train_step(state, b)
+                loss = float(m["loss"])
+                dt = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"variant": name, "error": repr(e)[:160]}),
+                  flush=True)
+            return
+        tok_s = batch * seq * steps / dt
+        mfu = tok_s * flops_per_token(cfg, seq) / peak_flops(devices[0])
+        print(json.dumps({
+            "variant": name, "batch": batch, "loss_kind": loss_kind,
+            "step_ms": round(1000 * dt / steps, 2),
+            "mfu": round(mfu, 4), "loss": round(loss, 3)}), flush=True)
+
+    import os
+    for spec in os.environ.get(
+            "MFU_VARIANTS",
+            "lse_b20,lse_b24,lse_b28,lse_b32").split(","):
+        kind, b = spec.rsplit("_b", 1)
+        run(spec, int(b), kind)
+
+
+if __name__ == "__main__":
+    main()

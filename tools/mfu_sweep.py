@@ -1,0 +1,120 @@
+"""MFU sweep for the headline GPT-2 bench: compares loss-function and
+batch-size variants on the local chip so bench.py's configuration is a
+measured choice, not a guess.
+
+Run: python tools/mfu_sweep.py [--steps 15]
+Prints one JSON line per variant.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_variant(name: str, batch: int, loss_kind: str, chunk: int,
+                steps: int, remat: bool = False):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.mesh import create_mesh
+    from ray_tpu.models import GPT2, gpt2_124m, gpt2_sharding_rules
+    from ray_tpu.models.gpt2 import (cross_entropy_loss, flops_per_token,
+                                     fused_linear_cross_entropy)
+    from ray_tpu.train.spmd import (TrainState, make_train_step,
+                                    put_batch, shard_state)
+    from bench import peak_flops
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    seq = 1024
+    cfg = gpt2_124m(remat=remat)
+    model = GPT2(cfg)
+    mesh = create_mesh({"data": -1}, devices=devices)
+    rules = gpt2_sharding_rules(fsdp=False)
+
+    ids = jnp.zeros((batch, seq + 1), dtype=jnp.int32)
+    params = jax.jit(lambda: model.init(jax.random.PRNGKey(0),
+                                        ids[:, :-1]))()
+    optimizer = optax.adamw(3e-4, weight_decay=0.1)
+    state = shard_state(TrainState.create(params, optimizer), rules, mesh)
+
+    if loss_kind == "naive":
+        def loss_fn(params, b):
+            x, y = b["ids"][:, :-1], b["ids"][:, 1:]
+            return cross_entropy_loss(model.apply(params, x), y)
+    else:
+        def loss_fn(params, b):
+            x, y = b["ids"][:, :-1], b["ids"][:, 1:]
+            feats = model.apply(params, x, return_features=True)
+            wte = params["params"]["wte"]
+            return fused_linear_cross_entropy(feats, wte, y, chunk=chunk)
+
+    train_step = make_train_step(loss_fn, optimizer)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, cfg.vocab_size, size=(batch, seq + 1),
+                       dtype=np.int32)
+
+    with jax.set_mesh(mesh):
+        b = put_batch({"ids": jnp.asarray(data)}, mesh)
+        t_c0 = time.perf_counter()
+        state, metrics = train_step(state, b)
+        float(metrics["loss"])
+        compile_s = time.perf_counter() - t_c0
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = train_step(state, b)
+        final_loss = float(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tok_per_s_chip = tokens / dt / n_chips
+    fpt = flops_per_token(cfg, seq)
+    mfu = (tok_per_s_chip * fpt) / peak_flops(devices[0])
+    print(json.dumps({
+        "variant": name, "batch": batch, "loss": loss_kind,
+        "chunk": chunk, "remat": remat,
+        "mfu": round(mfu, 4),
+        "tok_s_chip": round(tok_per_s_chip, 1),
+        "step_ms": round(1000 * dt / steps, 2),
+        "compile_s": round(compile_s, 1),
+        "final_loss": round(final_loss, 3),
+    }), flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=15)
+    p.add_argument("--only", type=str, default="")
+    args = p.parse_args()
+
+    variants = [
+        ("b24_naive", 24, "naive", 0, False),
+        ("b24_fused512", 24, "fused", 512, False),
+        ("b32_fused512", 32, "fused", 512, False),
+        ("b48_fused512", 48, "fused", 512, False),
+        ("b64_fused512", 64, "fused", 512, False),
+        ("b32_fused256", 32, "fused", 256, False),
+        ("b32_fused1024", 32, "fused", 1024, False),
+        ("b48_fused1024", 48, "fused", 1024, False),
+    ]
+    for name, batch, kind, chunk, remat in variants:
+        if args.only and args.only not in name:
+            continue
+        try:
+            run_variant(name, batch, kind, chunk, args.steps, remat)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"variant": name, "error": repr(e)[:200]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
