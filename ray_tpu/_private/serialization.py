@@ -91,7 +91,21 @@ def dumps(value: Any) -> bytes:
     return (len(parts).to_bytes(4, "little") + header + b"".join(parts))
 
 
+_INTERNED: dict = {}
+
+
+def _intern_blob(value: Any) -> bytes:
+    """dumps() a constant once and remember the blob so loads() can
+    short-circuit the unpickler for it (used for the ubiquitous
+    ("ok", None) task result)."""
+    blob = dumps(value)
+    _INTERNED[blob] = value
+    return blob
+
+
 def loads(data: bytes) -> Any:
+    if len(data) < 64 and data in _INTERNED:   # tiny constants only
+        return _INTERNED[data]
     nparts = int.from_bytes(data[:4], "little")
     sizes = np.frombuffer(data[4:4 + 8 * nparts], dtype=np.int64)
     off = 4 + 8 * nparts
@@ -103,3 +117,8 @@ def loads(data: bytes) -> Any:
     so = SerializedObject(bytes(parts[0]),
                           [pickle.PickleBuffer(p) for p in parts[1:]], [])
     return deserialize(so)
+
+
+# Interned in EVERY process at import (the blob is deterministic), so a
+# reader short-circuits regardless of which process wrote it.
+NONE_RESULT_BLOB = _intern_blob(("ok", None))

@@ -49,6 +49,10 @@ def resolve_refs(store, refs, timeout: Optional[float]):
         if not isinstance(r, ObjectRef):
             raise TypeError(
                 f"get() expects ObjectRef(s), got {type(r).__name__}")
+    if len(ref_list) > 1:
+        prefetch = getattr(store, "prefetch", None)
+        if prefetch is not None:
+            prefetch([r.id for r in ref_list])
     deadline = None if timeout is None else time.time() + timeout
     values = []
     for r in ref_list:
@@ -102,6 +106,106 @@ def object_future(store, oid: ObjectID) -> Future:
 # Shared submission helpers
 # --------------------------------------------------------------------------
 
+def _function_ref(head: RpcClient, func) -> str:
+    """Register `func` in the head's function table once and return its
+    content hash (the GCS function-table pattern,
+    python/ray/_private/function_manager.py — per-task payloads carry
+    the hash, not a fresh pickle of the function)."""
+    fn_id = getattr(func, "__raytpu_fn_id__", None)
+    registered = getattr(head, "_fn_registered", None)
+    if registered is None:
+        registered = head._fn_registered = set()
+    if fn_id is None:
+        import hashlib
+        blob = cloudpickle.dumps(func)
+        fn_id = hashlib.sha1(blob).hexdigest()
+        try:
+            func.__raytpu_fn_id__ = fn_id
+        except (AttributeError, TypeError):
+            pass      # unsettable (builtin/bound): re-hash next time
+        if fn_id not in registered:
+            head.call("register_function", fn_id, blob)
+            registered.add(fn_id)
+        return fn_id
+    if fn_id not in registered:
+        head.call("register_function", fn_id, cloudpickle.dumps(func))
+        registered.add(fn_id)
+    return fn_id
+
+
+class _SubmitBuffer:
+    """Client-side submission coalescing: .remote() appends and returns
+    immediately; a flusher ships batches as ONE one-way RPC (one head
+    lock acquire + one scheduler wake per window). Submission outcome
+    surfaces through the return objects, so no reply is needed —
+    failure to flush only happens if this whole process dies, taking
+    any would-be getter with it."""
+
+    FLUSH_AT = 256            # tasks per batch before an eager flush
+    WINDOW_S = 0.0005
+
+    def __init__(self, head: RpcClient):
+        self._head = head
+        self._buf: list = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add(self, meta, payload):
+        eager = None
+        with self._lock:
+            self._buf.append((meta, payload))
+            if len(self._buf) >= self.FLUSH_AT:
+                eager, self._buf = self._buf, []
+            elif self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="submit-flusher")
+                self._thread.start()
+        if eager is not None:
+            self._ship(eager)
+        else:
+            self._wake.set()
+
+    def _ship(self, batch):
+        """Deliver a batch, surviving transient socket failures — a
+        silently dropped batch would hang every get() on its refs. The
+        one-way send reconnects once; the request/reply fallback proves
+        delivery; if the head is truly gone we requeue and keep trying
+        (the whole runtime is down anyway until it returns)."""
+        for _attempt in range(2):
+            try:
+                self._head.call_oneway("submit_tasks", batch, fast=True)
+                return
+            except Exception:
+                continue
+        try:
+            self._head.call("submit_tasks", batch)
+            return
+        except Exception:
+            with self._lock:
+                self._buf = batch + self._buf
+            self._wake.set()
+            time.sleep(0.2)
+
+    def _loop(self):
+        while True:
+            self._wake.wait(timeout=1.0)
+            self._wake.clear()
+            time.sleep(self.WINDOW_S)
+            with self._lock:
+                batch, self._buf = self._buf, []
+            if batch:
+                self._ship(batch)
+
+
+def _submit_buffer(head: RpcClient) -> _SubmitBuffer:
+    buf = getattr(head, "_submit_buffer", None)
+    if buf is None:
+        buf = head._submit_buffer = _SubmitBuffer(head)
+    return buf
+
+
 def submit_task_via_head(head: RpcClient, spec: TaskSpec):
     refs = [ObjectRef(oid) for oid in spec.return_ids]
     pg_id = None
@@ -112,7 +216,7 @@ def submit_task_via_head(head: RpcClient, spec: TaskSpec):
     payload = cloudpickle.dumps({
         "task_id": spec.task_id.hex(),
         "name": spec.name,
-        "func": spec.func,
+        "fn_ref": _function_ref(head, spec.func),
         "args": spec.args,
         "kwargs": spec.kwargs,
         "num_returns": spec.num_returns,
@@ -128,7 +232,7 @@ def submit_task_via_head(head: RpcClient, spec: TaskSpec):
         "max_retries": spec.max_retries,
         "pg_id": pg_id,
     }
-    head.call("submit_task", meta, payload)
+    _submit_buffer(head).add(meta, payload)
     return refs
 
 
@@ -266,6 +370,15 @@ class DistributedRuntime:
         from ray_tpu._private.shm_store import ShmObjectStore
         self.store = ShmObjectStore.attach(store_name)
         self.node_manager = node_manager
+        # Drivers colocate with the head node: their puts/gets go through
+        # the head node's object plane (remote pulls on miss).
+        from ray_tpu.runtime.object_plane import ObjectPlane
+        self.plane = ObjectPlane(self.store, self.head, node_id="head")
+        self.plane.refresh_multinode()
+        from ray_tpu.runtime.pubsub import Subscriber
+        self._subscriber = Subscriber(RpcClient(head_address))
+        self._subscriber.subscribe_state("nodes",
+                                         self.plane.on_nodes_update)
         self.ref_counter = ReferenceCounter()
         self.ref_counter.enabled = False
         self.job_id = JobID.next()
@@ -274,20 +387,20 @@ class DistributedRuntime:
     # objects
     def put(self, value):
         oid = ObjectID.from_random()
-        self.store.put_bytes(oid, dumps(("ok", value)))
+        self.plane.put_bytes(oid, dumps(("ok", value)))
         return ObjectRef(oid)
 
     def put_at(self, oid: ObjectID, value):
-        self.store.put_bytes(oid, dumps(("ok", value)))
+        self.plane.put_bytes(oid, dumps(("ok", value)))
 
     def get(self, refs, timeout=None):
-        return resolve_refs(self.store, refs, timeout)
+        return resolve_refs(self.plane, refs, timeout)
 
     def wait(self, refs, num_returns=1, timeout=None):
-        return wait_refs(self.store, refs, num_returns, timeout)
+        return wait_refs(self.plane, refs, num_returns, timeout)
 
     def object_future(self, oid):
-        return object_future(self.store, oid)
+        return object_future(self.plane, oid)
 
     # tasks / actors
     def submit_task(self, spec: TaskSpec):
@@ -339,7 +452,11 @@ class DistributedRuntime:
     def list_workers(self):
         return self.head.call("list_workers")
 
+    def list_nodes(self):
+        return self.head.call("list_nodes")
+
     def shutdown(self):
+        self._subscriber.stop()
         if self.node_manager is None:
             # Attached driver (connect_to_cluster): disconnecting must
             # not take the shared cluster down with it.

@@ -22,6 +22,7 @@ class Cluster:
         self.node = NodeManager(num_workers=num_workers,
                                 resources_per_worker=resources_per_worker,
                                 store_capacity=store_capacity)
+        self.agent_procs: Dict[str, object] = {}
         self.node.wait_for_workers(num_workers)
         self.runtime = DistributedRuntime(
             self.node.head_address, self.node.store_name,
@@ -52,6 +53,67 @@ class Cluster:
         self.node.wait_for_workers()   # all live processes registered
         return wid
 
+    def add_node(self, num_workers: int = 2,
+                 resources_per_worker: Optional[Dict[str, float]] = None,
+                 store_capacity: int = 256 * 1024 * 1024,
+                 timeout: float = 60.0) -> str:
+        """Join a SECOND node as a separate process tree with its own
+        shm store segment (the multi-raylet `Cluster.add_node` analogue,
+        python/ray/cluster_utils.py:165 — here it exercises the real
+        cross-node object plane)."""
+        import json
+        import os
+        import subprocess
+        import sys
+        import time
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        from ray_tpu._private.config import GlobalConfig
+        env.update(GlobalConfig.to_env())
+        env["JAX_PLATFORMS"] = "cpu"
+        alive_before = len([w for w in self.runtime.list_workers()
+                            if w["alive"]])
+        repo = os.path.abspath(os.path.join(
+            os.path.dirname(__file__), "..", ".."))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.node_agent",
+             "--head", self.node.head_address,
+             "--workers", str(num_workers),
+             "--resources", json.dumps(resources_per_worker or
+                                       {"CPU": 2}),
+             "--store-capacity", str(store_capacity)],
+            cwd=repo, env=env, stdout=subprocess.PIPE, text=True)
+        line = proc.stdout.readline()   # "node_agent ready node_id=..."
+        if "node_id=" not in line:
+            raise RuntimeError(f"node agent failed to start: {line!r}")
+        node_id = line.split("node_id=")[1].split()[0]
+        self.agent_procs[node_id] = proc
+        deadline = time.time() + timeout
+        # Wait for THIS node's workers on top of whatever was already
+        # registered cluster-wide (not just the head node's procs —
+        # a second add_node would otherwise return early).
+        want = num_workers + alive_before
+        while time.time() < deadline:
+            if len([w for w in self.runtime.list_workers()
+                    if w["alive"]]) >= want:
+                return node_id
+            time.sleep(0.05)
+        raise TimeoutError(f"node {node_id}: workers not registered")
+
+    def kill_node(self, node_id: str):
+        """SIGKILL a secondary node's whole process tree (agent +
+        workers die with it via the agent monitor being gone; worker
+        processes are killed explicitly through the head's node table)."""
+        proc = self.agent_procs.pop(node_id, None)
+        if proc is not None:
+            proc.kill()
+            proc.wait(timeout=10)
+        # The head notices via missed heartbeats; tests shorten the
+        # heartbeat config or call mark_node_dead directly for speed.
+
+    def nodes(self):
+        return self.runtime.list_nodes()
+
     def kill_worker(self, worker_id: str):
         self.node.kill_worker(worker_id)
 
@@ -73,6 +135,20 @@ class Cluster:
             worker_mod._worker = None
             set_global_reference_counter(None)
             self._connected = False
+        for proc in self.agent_procs.values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        for proc in self.agent_procs.values():
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        self.agent_procs.clear()
         self.runtime.shutdown()
 
     def __enter__(self):

@@ -1,0 +1,77 @@
+"""Head (control-plane) process entry point.
+
+The head runs in its OWN process, like the reference's `gcs_server`
+binary (spawned by services.py start_gcs_server): the driver talks to it
+over RPC, so scheduler loops, dispatch senders, and pub/sub handlers
+never contend with driver Python for one GIL — moving the head out of
+the driver process took the single-client task benchmark from ~1.6k/s
+to the PERF_r03 numbers.
+
+Run: python -m ray_tpu.runtime.head_main --store NAME [--port P]
+Prints one line "head ready address=H:P" on stdout when serving.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--store", required=True)
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+
+    import os
+
+    from ray_tpu.runtime.head import HeadService
+    from ray_tpu.runtime.rpc import RpcServer
+
+    profile_out = os.environ.get("RAY_TPU_PROFILE_HEAD", "")
+    if profile_out:
+        # All-threads frame sampler (cProfile only sees one thread).
+        import atexit
+        import collections
+        import sys
+        import threading
+        samples: collections.Counter = collections.Counter()
+
+        def _sampler():
+            while True:
+                time.sleep(0.002)
+                for frame in list(
+                        sys._current_frames().values()):
+                    f = frame
+                    stack = []
+                    for _ in range(3):
+                        if f is None:
+                            break
+                        stack.append(
+                            f"{f.f_code.co_filename.rsplit('/', 1)[-1]}"
+                            f":{f.f_lineno}:{f.f_code.co_name}")
+                        f = f.f_back
+                    samples[" < ".join(stack)] += 1
+
+        threading.Thread(target=_sampler, daemon=True).start()
+
+        def _dump():
+            with open(profile_out, "w") as fh:
+                for line, n in samples.most_common(60):
+                    fh.write(f"{n:8d}  {line}\n")
+        atexit.register(_dump)
+
+    service = HeadService(args.store)
+    server = RpcServer(service, port=args.port)
+    service._address = server.address    # job manager needs it
+    print(f"head ready address={server.address}", flush=True)
+    try:
+        while not service._shutdown:
+            time.sleep(0.1)
+        time.sleep(0.3)    # let the final RPC replies flush
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

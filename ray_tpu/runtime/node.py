@@ -3,8 +3,11 @@
 Capability parity with the reference's node/process management
 (python/ray/_private/node.py start_head_processes + services.py
 start_raylet, and the raylet WorkerPool worker_pool.h:149): creates the
-node's C++ shm store, serves the head, spawns/monitors/kills worker
-processes (the chaos NodeKiller hook used by fault-tolerance tests).
+node's C++ shm store, serves the head, serves this node's object-plane
+endpoint (chunked cross-node reads, see runtime/object_plane.py),
+spawns/monitors/kills worker processes (the chaos NodeKiller hook used by
+fault-tolerance tests). Secondary machines join with NodeAgent
+(runtime/node_agent.py), which reuses the same worker-spawn path.
 """
 from __future__ import annotations
 
@@ -17,11 +20,77 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
-from ray_tpu.runtime.head import HeadService
+
 from ray_tpu.runtime.rpc import RpcServer
 
 _REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def spawn_worker_process(head_address: str, store_name: str,
+                         worker_id: str, resources: Dict[str, float],
+                         node_id: str = "head",
+                         force_cpu_backend: bool = False
+                         ) -> subprocess.Popen:
+    """Start one worker process (shared by NodeManager and NodeAgent)."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)   # breaks the TPU plugin (see skills)
+    # Propagate driver-side flag overrides (chaos delays, spill
+    # settings, …) to the worker, reference `_system_config` style.
+    from ray_tpu._private.config import GlobalConfig
+    env.update(GlobalConfig.to_env())
+    if force_cpu_backend:
+        env["JAX_PLATFORMS"] = "cpu"
+    # The worker watches this pid and exits when it dies (see
+    # worker_main._watch_parent) — even on SIGKILL of the spawner no
+    # orphan keeps holding RPC ports and the shm segment.
+    # (PR_SET_PDEATHSIG is unsuitable: it fires when the spawning
+    # THREAD exits, and RPC handler threads spawn workers too.)
+    env["RAY_TPU_PARENT_PID"] = str(os.getpid())
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.runtime.worker_main",
+         "--head", head_address,
+         "--store", store_name,
+         "--worker-id", worker_id,
+         "--node-id", node_id,
+         "--resources", json.dumps(resources)],
+        cwd=_REPO_ROOT, env=env)
+
+
+class _NodeService:
+    """Worker-process lifecycle RPC served by the node manager — the
+    head (its own process, like the reference's gcs_server) calls back
+    into it for request_worker/stop_worker."""
+
+    def __init__(self, nm: "NodeManager"):
+        self._nm = nm
+
+    def start_worker(self, index: int,
+                     resources: Optional[Dict[str, float]] = None) -> str:
+        return self._nm.start_worker(index, resources)
+
+    def kill_worker(self, worker_id: str) -> None:
+        self._nm.kill_worker(worker_id)
+
+    def num_workers(self) -> int:
+        return len(self._nm.procs)
+
+
+class _HeadProxy:
+    """Method-call proxy so in-process consumers (tests, fixtures) can
+    keep calling `node.head_service.X(...)` with the head in its own
+    process."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            return self._client.call(name, *args, **kwargs)
+        return call
 
 
 class NodeManager:
@@ -38,10 +107,22 @@ class NodeManager:
         # the head aggregates without RPC (N20, src/metrics/).
         from ray_tpu._private.shm_metrics import ShmMetricsRegistry
         self.metrics = ShmMetricsRegistry.create(self.store_name + "_m")
-        self.head_service = HeadService(self.store_name)
-        self.head_server = RpcServer(self.head_service)
-        self.head_service.attach_node_manager(
-            self, self.head_server.address)
+        # The head is its own PROCESS (gcs_server parity): scheduler
+        # loops and dispatch senders don't share the driver's GIL.
+        self.head_proc = self._spawn_head()
+        from ray_tpu.runtime.rpc import RpcClient
+        self.head_client = RpcClient(self._head_address)
+        self.head_service = _HeadProxy(self.head_client)
+        # Serve worker-lifecycle callbacks for the head.
+        self.node_server = RpcServer(_NodeService(self))
+        self.head_client.call("attach_node_service",
+                              self.node_server.address)
+        # This node's object-plane endpoint + membership entry.
+        from ray_tpu.runtime.object_plane import ObjectService
+        self.object_server = RpcServer(ObjectService(self.store))
+        self.head_client.call("register_node", "head",
+                              self.object_server.address,
+                              self.store_name)
         self.procs: Dict[str, subprocess.Popen] = {}
         self.tpu_owner_worker = tpu_owner_worker
         self._stopped = False
@@ -51,35 +132,43 @@ class NodeManager:
                                          daemon=True, name="node-monitor")
         self._monitor.start()
 
+    def _spawn_head(self) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        env["JAX_PLATFORMS"] = "cpu"     # the head never touches a TPU
+        from ray_tpu._private.config import GlobalConfig
+        env.update(GlobalConfig.to_env())
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.head_main",
+             "--store", self.store_name],
+            cwd=_REPO_ROOT, env=env, stdout=subprocess.PIPE, text=True)
+        line = proc.stdout.readline()
+        if "address=" not in line:
+            raise RuntimeError(f"head failed to start: {line!r}")
+        self._head_address = line.split("address=")[1].strip()
+        return proc
+
     @property
     def head_address(self) -> str:
-        return self.head_server.address
+        return self._head_address
 
     def start_worker(self, index: int,
                      resources: Optional[Dict[str, float]] = None
                      ) -> str:
         worker_id = f"worker-{index}-{uuid.uuid4().hex[:6]}"
-        env = dict(os.environ)
-        env.pop("PYTHONPATH", None)   # breaks the TPU plugin (see skills)
-        # Propagate driver-side flag overrides (chaos delays, spill
-        # settings, …) to the worker, reference `_system_config` style.
-        from ray_tpu._private.config import GlobalConfig
-        env.update(GlobalConfig.to_env())
         res = dict(resources or self.resources_per_worker)
-        # Only a designated worker may own the TPU; everyone else is
-        # forced onto the CPU backend so they can't grab the chip.
-        if self.tpu_owner_worker is not None and \
-                index == self.tpu_owner_worker:
+        # Only a designated worker may own the TPU; everyone else
+        # (including ALL workers when no owner is designated) is forced
+        # onto the CPU backend so they can't grab the chip — two
+        # workers initializing the TPU backend deadlock on libtpu's
+        # single-process lock.
+        is_owner = (self.tpu_owner_worker is not None and
+                    index == self.tpu_owner_worker)
+        if is_owner:
             res.setdefault("TPU", 1.0)
-        else:
-            env["JAX_PLATFORMS"] = "cpu"
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.runtime.worker_main",
-             "--head", self.head_address,
-             "--store", self.store_name,
-             "--worker-id", worker_id,
-             "--resources", json.dumps(res)],
-            cwd=_REPO_ROOT, env=env)
+        proc = spawn_worker_process(
+            self.head_address, self.store_name, worker_id, res,
+            node_id="head", force_cpu_backend=not is_owner)
         self.procs[worker_id] = proc
         return worker_id
 
@@ -93,14 +182,14 @@ class NodeManager:
                              if p.poll() is None)
             else:
                 target = n
-            alive = [w for w in self.head_service.list_workers()
+            alive = [w for w in self.head_client.call("list_workers")
                      if w["alive"]]
             if len(alive) >= target:
                 return
             time.sleep(0.05)
         raise TimeoutError(
-            f"Only {len(self.head_service.list_workers())} of {target} "
-            f"workers registered in {timeout}s")
+            f"Only {len(self.head_client.call('list_workers'))} of "
+            f"{target} workers registered in {timeout}s")
 
     def kill_worker(self, worker_id: str):
         """Chaos hook: SIGKILL a worker process (the NodeKillerActor
@@ -117,14 +206,18 @@ class NodeManager:
                 for worker_id, proc in list(self.procs.items()):
                     if proc.poll() is not None:
                         self.procs.pop(worker_id, None)
-                        self.head_service.mark_worker_dead(worker_id)
+                        self.head_client.call("mark_worker_dead",
+                                              worker_id)
             except Exception:  # noqa: BLE001 — keep monitoring
                 traceback.print_exc()
             time.sleep(0.05)
 
     def stop(self):
         self._stopped = True
-        self.head_service.shutdown()
+        try:
+            self.head_client.call("shutdown", timeout=5)
+        except Exception:
+            pass
         try:
             self.metrics.close()
         except Exception:
@@ -141,5 +234,10 @@ class NodeManager:
                 proc.wait(timeout=3)
             except Exception:
                 proc.kill()
-        self.head_server.stop()
+        try:
+            self.head_proc.wait(timeout=3)
+        except Exception:
+            self.head_proc.kill()
+        self.node_server.stop()
+        self.object_server.stop()
         self.store.close()

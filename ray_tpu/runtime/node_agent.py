@@ -1,0 +1,181 @@
+"""Node agent: joins an existing head as a SECOND node.
+
+Role parity with the reference's non-head raylet (`ray start --address`
+→ services.py start_raylet on a worker machine): owns this node's shm
+store segment, serves the node's object-plane endpoint, spawns and
+monitors this node's worker processes, and heartbeats the head
+(GcsHeartbeatManager semantics — the head declares the node dead after
+num_heartbeats_timeout missed beats and drops its object locations).
+
+Run: python -m ray_tpu.runtime.node_agent --head H:P --workers N \
+         [--resources '{"CPU": 2}'] [--store-capacity BYTES] [--node-id ID]
+
+Tests use this to build two separate process trees with two store
+segments on one machine — the cross-"node" object transfer fixture
+(the ray_start_cluster analogue for the object plane).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+from ray_tpu.runtime.rpc import RpcClient, RpcError, RpcServer
+
+
+class NodeAgent:
+    def __init__(self, head_address: str, num_workers: int = 2,
+                 resources_per_worker: Optional[Dict[str, float]] = None,
+                 store_capacity: int = 256 * 1024 * 1024,
+                 node_id: Optional[str] = None):
+        self.head_address = head_address
+        self.head = RpcClient(head_address, timeout=30)
+        self.node_id = node_id or \
+            f"node-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        self.resources_per_worker = resources_per_worker or {"CPU": 2}
+        self.store_name = f"/raytpu_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        from ray_tpu._private.shm_store import ShmObjectStore
+        self.store = ShmObjectStore.create(self.store_name,
+                                           store_capacity)
+        from ray_tpu._private.shm_metrics import ShmMetricsRegistry
+        self.metrics = ShmMetricsRegistry.create(self.store_name + "_m")
+        from ray_tpu.runtime.object_plane import ObjectService
+        self.object_server = RpcServer(ObjectService(self.store))
+        self.head.call("register_node", self.node_id,
+                       self.object_server.address, self.store_name)
+        self.procs: Dict[str, object] = {}
+        self._stopped = threading.Event()
+        for i in range(num_workers):
+            self.start_worker(i)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name=f"agent-monitor-{self.node_id[:12]}")
+        self._monitor.start()
+        self._beat = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name=f"agent-heartbeat-{self.node_id[:12]}")
+        self._beat.start()
+
+    def start_worker(self, index: int,
+                     resources: Optional[Dict[str, float]] = None) -> str:
+        from ray_tpu.runtime.node import spawn_worker_process
+        worker_id = (f"{self.node_id}-worker-{index}-"
+                     f"{uuid.uuid4().hex[:6]}")
+        proc = spawn_worker_process(
+            self.head_address, self.store_name, worker_id,
+            dict(resources or self.resources_per_worker),
+            node_id=self.node_id,
+            # Secondary nodes never own the (single) local TPU.
+            force_cpu_backend=True)
+        self.procs[worker_id] = proc
+        return worker_id
+
+    def wait_for_workers(self, timeout: float = 30) -> None:
+        deadline = time.time() + timeout
+        want = set(self.procs)
+        while time.time() < deadline:
+            alive = {w["worker_id"]
+                     for w in self.head.call("list_workers")
+                     if w["alive"]}
+            if want <= alive:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"node {self.node_id}: workers not registered")
+
+    def _monitor_loop(self):
+        while not self._stopped.is_set():
+            for worker_id, proc in list(self.procs.items()):
+                if proc.poll() is not None:
+                    self.procs.pop(worker_id, None)
+                    try:
+                        self.head.call("mark_worker_dead", worker_id)
+                    except RpcError:
+                        pass
+            time.sleep(0.05)
+
+    def _heartbeat_loop(self):
+        from ray_tpu._private.config import GlobalConfig
+        period = GlobalConfig.heartbeat_period_ms / 1000.0
+        misses = 0
+        while not self._stopped.wait(timeout=period):
+            try:
+                ok = self.head.call("node_heartbeat", self.node_id,
+                                    timeout=5)
+                misses = 0
+                if not ok:
+                    # Head declared us dead (or restarted): re-join.
+                    self.head.call("register_node", self.node_id,
+                                   self.object_server.address,
+                                   self.store_name)
+            except RpcError:
+                misses += 1
+                if misses >= GlobalConfig.num_heartbeats_timeout:
+                    # Head is gone: tear the node down.
+                    self.stop()
+                    return
+
+    def kill_worker(self, worker_id: str):
+        proc = self.procs.get(worker_id)
+        if proc is not None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    def stop(self):
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        for proc in self.procs.values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=3)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        self.object_server.stop()
+        try:
+            self.metrics.close()
+        except Exception:
+            pass
+        self.store.close()
+
+
+def main():
+    import signal
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--head", required=True)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--resources", default='{"CPU": 2}')
+    ap.add_argument("--store-capacity", type=int,
+                    default=256 * 1024 * 1024)
+    ap.add_argument("--node-id", default=None)
+    args = ap.parse_args()
+    agent = NodeAgent(args.head, num_workers=args.workers,
+                      resources_per_worker=json.loads(args.resources),
+                      store_capacity=args.store_capacity,
+                      node_id=args.node_id)
+    # Graceful teardown on terminate (tears workers down with us; a
+    # SIGKILL is covered by the workers' PR_SET_PDEATHSIG).
+    signal.signal(signal.SIGTERM, lambda *_: agent.stop())
+    print(f"node_agent ready node_id={agent.node_id} "
+          f"store={agent.store_name}", flush=True)
+    try:
+        while not agent._stopped.wait(timeout=1.0):
+            pass
+    except KeyboardInterrupt:
+        pass
+    agent.stop()
+
+
+if __name__ == "__main__":
+    main()

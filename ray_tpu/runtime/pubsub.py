@@ -1,0 +1,205 @@
+"""Head-hosted pub/sub: versioned channels + long-poll delivery.
+
+Role parity with the reference's GCS-hosted pub/sub (long-poll
+publisher/subscriber, src/ray/pubsub/publisher.h:298, subscriber.h:329;
+channels gcs_service.proto:568) and the serve config-push layer built on
+it (python/ray/serve/_private/long_poll.py:63,179). TPU-first deltas:
+one hub lives inside the head service (no separate pubsub server), and
+delivery is long-poll over the framed-socket RPC layer — a blocked
+``psub_poll`` call holds only its handler thread, and every state channel
+is versioned so a reconnecting subscriber resyncs with one round trip.
+
+Two channel kinds:
+- **state channels** hold one versioned value (serve route tables, node
+  membership). Subscribers poll with their last-seen version and get the
+  latest value the moment it differs — no event history is kept.
+- **stream channels** hold an append-only sequence (log records, worker
+  events) with a bounded replay buffer; subscribers get batches ordered
+  by sequence number.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class PubSubHub:
+    """In-head hub. All methods are thread-safe."""
+
+    def __init__(self, stream_buffer: int = 4096):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # state channels: name -> (version, value)
+        self._state: Dict[str, Tuple[int, Any]] = {}
+        # stream channels: name -> deque[(seq, item)], next_seq
+        self._streams: Dict[str, collections.deque] = {}
+        self._next_seq: Dict[str, int] = {}
+        self._stream_buffer = stream_buffer
+
+    # ---- publish ----------------------------------------------------------
+
+    def publish_state(self, channel: str, value: Any) -> int:
+        with self._cv:
+            version = self._state.get(channel, (0, None))[0] + 1
+            self._state[channel] = (version, value)
+            self._cv.notify_all()
+            return version
+
+    def publish_stream(self, channel: str, item: Any) -> int:
+        with self._cv:
+            seq = self._next_seq.get(channel, 0)
+            self._next_seq[channel] = seq + 1
+            buf = self._streams.get(channel)
+            if buf is None:
+                buf = self._streams[channel] = collections.deque(
+                    maxlen=self._stream_buffer)
+            buf.append((seq, item))
+            self._cv.notify_all()
+            return seq
+
+    def drop_channel(self, channel: str):
+        with self._cv:
+            self._state.pop(channel, None)
+            self._streams.pop(channel, None)
+            self._next_seq.pop(channel, None)
+
+    # ---- long-poll --------------------------------------------------------
+
+    def _collect(self, state_versions: Dict[str, int],
+                 stream_seqs: Dict[str, int]):
+        out_state, out_streams = {}, {}
+        for chan, last in state_versions.items():
+            cur = self._state.get(chan)
+            if cur is not None and cur[0] > last:
+                out_state[chan] = cur
+        for chan, last in stream_seqs.items():
+            buf = self._streams.get(chan)
+            if buf and buf[-1][0] >= last:
+                out_streams[chan] = [(s, it) for s, it in buf
+                                     if s >= last]
+        return out_state, out_streams
+
+    def poll(self, state_versions: Optional[Dict[str, int]] = None,
+             stream_seqs: Optional[Dict[str, int]] = None,
+             timeout: float = 30.0):
+        """Block until any subscribed channel moves past the given
+        version/sequence, then return {"state": {chan: (version, value)},
+        "streams": {chan: [(seq, item), ...]}}. Empty dicts on timeout.
+
+        state_versions: channel -> last seen version (0 = send current).
+        stream_seqs:    channel -> next wanted sequence number.
+        """
+        state_versions = state_versions or {}
+        stream_seqs = stream_seqs or {}
+        deadline = time.time() + timeout
+        with self._cv:
+            while True:
+                out_state, out_streams = self._collect(
+                    state_versions, stream_seqs)
+                if out_state or out_streams:
+                    return {"state": out_state, "streams": out_streams}
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return {"state": {}, "streams": {}}
+                self._cv.wait(timeout=min(remaining, 1.0))
+
+    def state_snapshot(self, channel: str):
+        with self._lock:
+            return self._state.get(channel, (0, None))
+
+
+class Subscriber:
+    """Client-side long-poll loop delivering updates to callbacks.
+
+    subscribe_state(chan, cb): cb(version, value) on every change (and
+    once immediately with the current value, if any).
+    subscribe_stream(chan, cb): cb(seq, item) per item, in order.
+    """
+
+    def __init__(self, head_client, poll_timeout: float = 30.0):
+        self._head = head_client
+        self._poll_timeout = poll_timeout
+        self._lock = threading.Lock()
+        self._state_cbs: Dict[str, List[Callable]] = {}
+        self._stream_cbs: Dict[str, List[Callable]] = {}
+        self._state_versions: Dict[str, int] = {}
+        self._stream_seqs: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def subscribe_state(self, channel: str, callback: Callable):
+        with self._lock:
+            self._state_cbs.setdefault(channel, []).append(callback)
+            self._state_versions.setdefault(channel, 0)
+        self._ensure_running()
+        self._wake.set()
+
+    def subscribe_stream(self, channel: str, callback: Callable,
+                         from_seq: int = 0):
+        with self._lock:
+            self._stream_cbs.setdefault(channel, []).append(callback)
+            self._stream_seqs.setdefault(channel, from_seq)
+        self._ensure_running()
+        self._wake.set()
+
+    def unsubscribe(self, channel: str):
+        with self._lock:
+            self._state_cbs.pop(channel, None)
+            self._stream_cbs.pop(channel, None)
+            self._state_versions.pop(channel, None)
+            self._stream_seqs.pop(channel, None)
+
+    def _ensure_running(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="pubsub-subscriber")
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                sv = dict(self._state_versions)
+                ss = dict(self._stream_seqs)
+            if not sv and not ss:
+                self._wake.wait(timeout=1.0)
+                self._wake.clear()
+                continue
+            try:
+                out = self._head.call(
+                    "psub_poll", sv, ss,
+                    timeout=self._poll_timeout + 10,
+                    poll_timeout=self._poll_timeout)
+            except Exception:
+                if self._stop.wait(timeout=0.5):
+                    return
+                continue
+            for chan, (version, value) in out.get("state", {}).items():
+                with self._lock:
+                    if self._state_versions.get(chan, 0) >= version:
+                        continue
+                    self._state_versions[chan] = version
+                    cbs = list(self._state_cbs.get(chan, ()))
+                for cb in cbs:
+                    try:
+                        cb(version, value)
+                    except Exception:  # noqa: BLE001 — keep delivering
+                        pass
+            for chan, items in out.get("streams", {}).items():
+                for seq, item in items:
+                    with self._lock:
+                        if self._stream_seqs.get(chan, 0) > seq:
+                            continue
+                        self._stream_seqs[chan] = seq + 1
+                        cbs = list(self._stream_cbs.get(chan, ()))
+                    for cb in cbs:
+                        try:
+                            cb(seq, item)
+                        except Exception:  # noqa: BLE001
+                            pass

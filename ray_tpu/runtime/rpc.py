@@ -7,6 +7,7 @@ length + cloudpickle({"method","args","kwargs"} / {"ok"/"err": ...}).
 """
 from __future__ import annotations
 
+import pickle
 import socket
 import struct
 import threading
@@ -18,8 +19,13 @@ import cloudpickle
 _LEN = struct.Struct("<I")
 
 
-def _send_msg(sock: socket.socket, obj: Any):
-    data = cloudpickle.dumps(obj)
+def _send_msg(sock: socket.socket, obj: Any, fast: bool = False):
+    # fast=True: the caller asserts the message tree is plain-picklable
+    # (bytes/str/numbers/dict/list/tuple) — plain pickle skips the
+    # CloudPickler construction on hot paths. Loads is shared: pickle
+    # output is always cloudpickle-loadable.
+    data = pickle.dumps(obj, protocol=5) if fast else \
+        cloudpickle.dumps(obj)
     sock.sendall(_LEN.pack(len(data)) + data)
 
 
@@ -74,6 +80,12 @@ class RpcServer:
         try:
             while self._running:
                 req = _recv_msg(conn)
+                if req.get("rid") is None:
+                    # One-way pipelined call: handled inline (by
+                    # contract these are enqueue-fast), preserving
+                    # arrival order and skipping a thread spawn.
+                    self._handle_one(conn, req, send_lock)
+                    continue
                 # Each request runs on its own thread so one long call
                 # doesn't block the connection (client sends one request
                 # per pooled connection at a time).
@@ -94,6 +106,8 @@ class RpcServer:
         except BaseException as e:  # noqa: BLE001
             reply = {"rid": rid, "err": e,
                      "tb": traceback.format_exc()}
+        if rid is None:
+            return     # one-way call: no reply expected
         with send_lock:
             try:
                 _send_msg(conn, reply)
@@ -124,6 +138,8 @@ class RpcClient:
         self._pool: list = []
         self._pool_lock = threading.Lock()
         self._rid = 0
+        self._oneway_sock: Optional[socket.socket] = None
+        self._oneway_lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection((self.host, self.port),
@@ -171,6 +187,33 @@ class RpcClient:
             raise reply["err"]
         return reply["ok"]
 
+    def call_oneway(self, method: str, *args, fast: bool = False,
+                    **kwargs) -> None:
+        """Fire-and-forget: send the request and return without waiting
+        for (or receiving) a reply. Used on hot submission paths where
+        the outcome surfaces elsewhere (e.g. the object store). A
+        dedicated pipelined connection keeps one-way sends ordered with
+        each other and off the request/reply sockets. fast=True asserts
+        the args are plain-picklable (see _send_msg)."""
+        with self._pool_lock:
+            sock = self._oneway_sock
+            if sock is None:
+                sock = self._oneway_sock = self._connect()
+        try:
+            with self._oneway_lock:
+                _send_msg(sock, {"rid": None, "method": method,
+                                 "args": args, "kwargs": kwargs},
+                          fast=fast)
+        except (ConnectionError, OSError) as e:
+            with self._pool_lock:
+                self._oneway_sock = None
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise RpcError(f"RPC {method} to {self.host}:{self.port} "
+                           f"failed: {e}") from e
+
     def close(self):
         with self._pool_lock:
             for s in self._pool:
@@ -179,3 +222,9 @@ class RpcClient:
                 except OSError:
                     pass
             self._pool.clear()
+            if self._oneway_sock is not None:
+                try:
+                    self._oneway_sock.close()
+                except OSError:
+                    pass
+                self._oneway_sock = None

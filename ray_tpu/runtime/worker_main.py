@@ -39,20 +39,35 @@ class _ActorSlot:
         self.mailbox: "queue.Queue" = queue.Queue()
         self.thread: Optional[threading.Thread] = None
         self.runtime_env = None
+        self.aloop = None      # lazily-created asyncio loop
 
 
 class Executor:
     """RPC handler for this worker process."""
 
-    def __init__(self, worker_id: str, head: RpcClient, store,
+    def __init__(self, worker_id: str, head: RpcClient, plane,
                  resources: Dict[str, float]):
         self.worker_id = worker_id
         self.head = head
-        self.store = store
+        self.plane = plane           # ObjectPlane over the node's store
+        self.store = plane.store
         self.resources = resources
         self.actors: Dict[str, _ActorSlot] = {}
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
+        self._task_q: "queue.Queue" = queue.Queue()
+        self._pool_lock = threading.Lock()
+        self._idle_threads = 0
+        # Batched completion reports back to the head (event-driven
+        # dispatch: push_task replies at enqueue; the head releases
+        # resources when tasks_done arrives).
+        self._done: List[str] = []
+        self._done_lock = threading.Lock()
+        self._done_wake = threading.Event()
+        self._notifier = threading.Thread(
+            target=self._notify_loop, daemon=True,
+            name="executor-notify")
+        self._notifier.start()
 
     # ---- helpers ----------------------------------------------------------
 
@@ -63,16 +78,26 @@ class Executor:
         return value
 
     def _read_object(self, oid: ObjectID):
-        status, value = loads(self.store.get_bytes(oid, timeout_ms=-1))
+        status, value = loads(self.plane.get_bytes(oid, timeout_ms=-1))
         if status == "err":
             raise value
         return value
+
 
     def _write_returns(self, return_ids: List[bytes], num_returns: int,
                        result: Any):
         if num_returns == 0:
             return
         if num_returns == 1:
+            if result is None:
+                # Side-effect-only tasks are common; skip the
+                # serializer for the constant result (and the
+                # unpickler on the reader side — interned blob).
+                from ray_tpu._private.serialization import \
+                    NONE_RESULT_BLOB
+                self.plane.put_bytes(ObjectID(return_ids[0]),
+                                     NONE_RESULT_BLOB)
+                return
             values = [result]
         else:
             values = list(result)
@@ -80,13 +105,13 @@ class Executor:
                 raise ValueError(
                     f"expected {num_returns} returns, got {len(values)}")
         for rid, v in zip(return_ids, values):
-            self.store.put_bytes(ObjectID(rid), dumps(("ok", v)))
+            self.plane.put_bytes(ObjectID(rid), dumps(("ok", v)))
 
     def _write_error(self, return_ids: List[bytes], exc: BaseException):
         payload = dumps(("err", exc))
         for rid in return_ids:
             try:
-                self.store.put_bytes(ObjectID(rid), payload)
+                self.plane.put_bytes(ObjectID(rid), payload)
             except Exception:
                 pass
 
@@ -100,21 +125,125 @@ class Executor:
         chaos_delay()
 
     def push_task(self, payload: bytes) -> str:
+        """Enqueue-and-return: the task body runs on a pooled thread and
+        completion flows back through the batched tasks_done channel —
+        the head's dispatch RPC never waits on user code."""
+        return self.push_tasks([payload])
+
+    def push_tasks(self, payloads: List[bytes]) -> str:
+        """Batched dispatch from the head's per-worker sender. Raw
+        payload bytes go straight onto the pool queue; pool threads do
+        the deserialization (keeps the RPC reader thread lean)."""
         self._chaos_delay()
-        spec = cloudpickle.loads(payload)
+        need = 0
+        for payload in payloads:
+            self._task_q.put(payload)
+        # Elastic cached pool: spawn only when nobody is idle. Blocked
+        # tasks (nested get) occupy their thread, so the pool must be
+        # able to grow past the resource slot count — a fixed pool
+        # could deadlock a dependency chain.
+        with self._pool_lock:
+            need = max(0, len(payloads) - self._idle_threads)
+        for _ in range(need):
+            threading.Thread(target=self._pool_loop, daemon=True,
+                             name="task-pool").start()
+        return "queued"
+
+    def _pool_loop(self):
+        while not self._shutdown.is_set():
+            with self._pool_lock:
+                self._idle_threads += 1
+            try:
+                item = self._task_q.get(timeout=20)
+            except queue.Empty:
+                # Exit-vs-enqueue race: push_tasks may have enqueued
+                # after our timeout but before we deregister. Decide
+                # under the pool lock with a queue re-check, so either
+                # we see the item (and keep serving) or push_tasks sees
+                # our decremented idle count (and spawns).
+                with self._pool_lock:
+                    if not self._task_q.empty():
+                        self._idle_threads -= 1
+                        continue
+                    self._idle_threads -= 1
+                    return     # idle-reap this thread
+            with self._pool_lock:
+                self._idle_threads -= 1
+            self._run_task(cloudpickle.loads(item))
+
+    def _notify_loop(self):
+        last_send = 0.0
+        while not self._shutdown.is_set():
+            self._done_wake.wait(timeout=1.0)
+            self._done_wake.clear()
+            # Adaptive coalescing: under load (back-to-back sends),
+            # wait half a millisecond so completions batch and the
+            # head runs one scheduler pass per batch instead of per
+            # task; idle completions still report immediately.
+            if time.monotonic() - last_send < 0.001:
+                time.sleep(0.0005)
+            with self._done_lock:
+                batch, self._done = self._done, []
+            last_send = time.monotonic()
+            if batch:
+                try:
+                    # One-way: completions pile up naturally while a
+                    # send is in flight, so batching is load-adaptive
+                    # without an artificial delay on the idle path.
+                    self.head.call_oneway("tasks_done", self.worker_id,
+                                          batch, fast=True)
+                except Exception:
+                    # A dropped batch would leak the head's resource
+                    # accounting for these tasks even though both ends
+                    # are alive (transient socket error): requeue and
+                    # retry after a backoff until the head is truly
+                    # unreachable-forever (then our death supersedes).
+                    with self._done_lock:
+                        self._done = batch + self._done
+                    self._done_wake.set()
+                    time.sleep(0.2)
+
+    def _report_done(self, task_id: str):
+        with self._done_lock:
+            self._done.append(task_id)
+        self._done_wake.set()
+
+    def _resolve_function(self, spec):
+        fn_ref = spec.get("fn_ref")
+        if fn_ref is None:
+            return spec["func"]
+        cache = getattr(self, "_fn_cache", None)
+        if cache is None:
+            cache = self._fn_cache = {}
+        func = cache.get(fn_ref)
+        if func is None:
+            blob = self.head.call("get_function", fn_ref)
+            if blob is None:
+                raise RuntimeError(f"unknown function {fn_ref}")
+            func = cache[fn_ref] = cloudpickle.loads(blob)
+        return func
+
+    def _run_task(self, spec) -> str:
         _task_ctx.resources = spec.get("resources", {})
         _task_ctx.blocked = False
         try:
-            func = spec["func"]
+            func = self._resolve_function(spec)
             args = [self._resolve(a) for a in spec["args"]]
             kwargs = {k: self._resolve(v)
                       for k, v in spec["kwargs"].items()}
-            from ray_tpu._private.runtime_env import runtime_env_context
-            from ray_tpu.util.tracing import execution_span
-            with runtime_env_context(spec.get("runtime_env")), \
-                    execution_span(spec.get("name", "task"), "task",
-                                   spec.get("trace_ctx")):
+            if spec.get("runtime_env") is None and \
+                    spec.get("trace_ctx") is None:
+                # Hot path: no env to apply, no span to propagate —
+                # skip both context managers.
                 result = func(*args, **kwargs)
+            else:
+                from ray_tpu._private.runtime_env import \
+                    runtime_env_context
+                from ray_tpu.util.tracing import execution_span
+                with runtime_env_context(spec.get("runtime_env")), \
+                        execution_span(spec.get("name", "task"),
+                                       "task", spec.get("trace_ctx")):
+                    result = func(*args, **kwargs)
             from ray_tpu.util import metrics as metrics_mod
             reg = metrics_mod.get_shm_registry()
             if reg is not None:
@@ -132,6 +261,7 @@ class Executor:
             return "error"
         finally:
             _task_ctx.resources = None
+            self._report_done(spec.get("task_id", ""))
 
     # ---- actors -----------------------------------------------------------
 
@@ -176,6 +306,15 @@ class Executor:
                                        "actor_task",
                                        spec.get("trace_ctx")):
                     result = method(*args, **kwargs)
+                    import inspect
+                    if inspect.iscoroutine(result):
+                        # asyncio actor: drive the coroutine on this
+                        # actor's own event loop (ordered semantics,
+                        # the fiber-transport analogue).
+                        if slot.aloop is None:
+                            import asyncio
+                            slot.aloop = asyncio.new_event_loop()
+                        result = slot.aloop.run_until_complete(result)
                 self._write_returns(spec["return_ids"],
                                     spec["num_returns"], result)
             except BaseException as e:  # noqa: BLE001
@@ -245,7 +384,7 @@ class WorkerRuntime:
     def put(self, value):
         from ray_tpu._private.object_ref import ObjectRef
         oid = ObjectID.from_random()
-        self._ex.store.put_bytes(oid, dumps(("ok", value)))
+        self._ex.plane.put_bytes(oid, dumps(("ok", value)))
         return ObjectRef(oid)
 
     def get(self, refs, timeout=None):
@@ -253,6 +392,9 @@ class WorkerRuntime:
         res = getattr(_task_ctx, "resources", None)
         blocked = False
         if res:
+            # Local-store miss == we are about to block; an object
+            # fetchable from a peer node resolves fast enough that
+            # releasing resources isn't worth the head round trip.
             missing = any(not self._ex.store.contains(r.id)
                           for r in ([refs] if not isinstance(refs, list)
                                     else refs))
@@ -260,18 +402,18 @@ class WorkerRuntime:
                 self.head.call("task_blocked", self.worker_id, res)
                 blocked = True
         try:
-            return resolve_refs(self._ex.store, refs, timeout)
+            return resolve_refs(self._ex.plane, refs, timeout)
         finally:
             if blocked:
                 self.head.call("task_unblocked", self.worker_id, res)
 
     def wait(self, refs, num_returns=1, timeout=None):
         from ray_tpu.runtime.client import wait_refs
-        return wait_refs(self._ex.store, refs, num_returns, timeout)
+        return wait_refs(self._ex.plane, refs, num_returns, timeout)
 
     def object_future(self, oid):
         from ray_tpu.runtime.client import object_future
-        return object_future(self._ex.store, oid)
+        return object_future(self._ex.plane, oid)
 
     def submit_task(self, spec):
         from ray_tpu.runtime.client import submit_task_via_head
@@ -327,11 +469,34 @@ class WorkerRuntime:
         pass
 
 
+def _watch_parent():
+    """Exit when the spawning node manager/agent process dies (orphan
+    prevention; covers SIGKILL of the parent, which no signal handler
+    there could)."""
+    import os
+    ppid = int(os.environ.get("RAY_TPU_PARENT_PID", "0"))
+    if not ppid:
+        return
+
+    def loop():
+        while True:
+            try:
+                os.kill(ppid, 0)
+            except OSError:
+                os._exit(0)
+            time.sleep(1.0)
+
+    threading.Thread(target=loop, daemon=True,
+                     name="parent-watch").start()
+
+
 def main():
+    _watch_parent()
     parser = argparse.ArgumentParser()
     parser.add_argument("--head", required=True)
     parser.add_argument("--store", required=True)
     parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--node-id", default="head")
     parser.add_argument("--resources", default='{"CPU": 1}')
     args = parser.parse_args()
 
@@ -347,7 +512,9 @@ def main():
     head = RpcClient(args.head)
     resources = json.loads(args.resources)
 
-    executor = Executor(args.worker_id, head, store, resources)
+    from ray_tpu.runtime.object_plane import ObjectPlane
+    plane = ObjectPlane(store, head, node_id=args.node_id)
+    executor = Executor(args.worker_id, head, plane, resources)
     server = RpcServer(executor)
 
     # Install the worker-side runtime for nested API usage.
@@ -357,8 +524,14 @@ def main():
     worker_mod._worker = worker_mod.Worker(runtime, mode="worker")
     set_global_reference_counter(runtime.ref_counter)
 
-    head.call("register_worker", args.worker_id, server.address,
-              resources)
+    reply = head.call("register_worker", args.worker_id, server.address,
+                      resources, args.node_id)
+    plane.multinode = bool(reply.get("multinode"))
+    # Track node membership by push so the single-node fast path flips
+    # the moment a second node joins (and back).
+    from ray_tpu.runtime.pubsub import Subscriber
+    sub = Subscriber(RpcClient(args.head))
+    sub.subscribe_state("nodes", plane.on_nodes_update)
     executor._shutdown.wait()
 
 

@@ -28,10 +28,10 @@
 
 namespace {
 
-constexpr uint32_t kMagic = 0x52544f53;  // "SOTR"
+constexpr uint32_t kMagic = 0x52544f54;  // "TOTR" (v2 layout)
 constexpr int kIdSize = 24;              // ObjectID width (ids.py)
-constexpr uint32_t kMaxObjects = 8192;
-constexpr uint32_t kNumBuckets = 4096;   // hash buckets (power of 2)
+constexpr uint32_t kMaxObjects = 65536;
+constexpr uint32_t kNumBuckets = 32768;  // hash buckets (power of 2)
 constexpr uint32_t kInvalid = 0xffffffffu;
 
 enum ObjectState : uint32_t {
@@ -67,6 +67,9 @@ struct Header {
   uint64_t bytes_in_use;
   uint64_t num_objects;
   uint64_t num_evictions;
+  uint32_t free_entry_head;   // O(1) entry allocation (chained via
+                              // Entry.next, which is otherwise only
+                              // used for in_use bucket chains)
   uint32_t buckets[kNumBuckets];
   Entry entries[kMaxObjects];
   uint32_t free_count;
@@ -122,6 +125,8 @@ void UnlinkLocked(Header* hdr, uint32_t index) {
   }
   e->in_use = 0;
   e->state = kFree;
+  e->next = hdr->free_entry_head;     // back onto the entry free list
+  hdr->free_entry_head = index;
 }
 
 // --- free-list allocator (first fit, address-ordered coalescing) ---------
@@ -242,6 +247,9 @@ Store* store_create(const char* name, uint64_t capacity) {
   pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
   pthread_cond_init(&hdr->cond, &ca);
   for (uint32_t i = 0; i < kNumBuckets; i++) hdr->buckets[i] = kInvalid;
+  for (uint32_t i = 0; i < kMaxObjects; i++)
+    hdr->entries[i].next = (i + 1 < kMaxObjects) ? i + 1 : kInvalid;
+  hdr->free_entry_head = 0;
   hdr->free_count = 1;
   hdr->free_list[0] = {0, capacity};
   hdr->initialized = 1;
@@ -314,16 +322,12 @@ int64_t store_create_object_ex(Store* s, const uint8_t* id, uint64_t size,
     pthread_mutex_unlock(&hdr->mutex);
     return SHM_ERR_EXISTS;
   }
-  uint32_t slot = kInvalid;
-  for (uint32_t i = 0; i < kMaxObjects; i++) {
-    if (!hdr->entries[i].in_use) {
-      slot = i;
-      break;
+  if (hdr->free_entry_head == kInvalid) {
+    // Entry table exhausted: evicting one sealed object frees a slot.
+    if (!allow_evict || !EvictOneLocked(hdr)) {
+      pthread_mutex_unlock(&hdr->mutex);
+      return SHM_ERR_TOO_MANY;
     }
-  }
-  if (slot == kInvalid) {
-    pthread_mutex_unlock(&hdr->mutex);
-    return SHM_ERR_TOO_MANY;
   }
   uint64_t offset;
   while (!AllocLocked(hdr, asize, &offset)) {
@@ -332,6 +336,10 @@ int64_t store_create_object_ex(Store* s, const uint8_t* id, uint64_t size,
       return SHM_ERR_FULL;
     }
   }
+  // Pop the entry slot only after space is secured — the FULL path
+  // above must not leak slots.
+  uint32_t slot = hdr->free_entry_head;   // O(1) entry allocation
+  hdr->free_entry_head = hdr->entries[slot].next;
   Entry* e = &hdr->entries[slot];
   memcpy(e->id, id, kIdSize);
   e->offset = offset;

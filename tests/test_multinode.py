@@ -1,0 +1,179 @@
+"""Multi-node object/control plane tests.
+
+Two "nodes" = two shm store segments + two node manager process trees on
+one machine (the reference tests multi-node the same way: multiple real
+raylets via ray.cluster_utils.Cluster, python/ray/cluster_utils.py:165).
+Covers: cross-node task/object flow, 100MB transfers both directions,
+pub/sub delivery, node death + lineage reconstruction of lost results.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime import Cluster
+
+
+@pytest.fixture(scope="module")
+def two_node_cluster():
+    import ray_tpu._private.worker as worker_mod
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    c = Cluster(num_workers=1,
+                resources_per_worker={"CPU": 2, "node0": 10},
+                store_capacity=512 * 1024 * 1024)
+    node_id = c.add_node(num_workers=1,
+                         resources_per_worker={"CPU": 2, "node1": 10},
+                         store_capacity=512 * 1024 * 1024)
+    yield c, node_id
+    c.shutdown()
+
+
+def test_two_nodes_registered(two_node_cluster):
+    c, node_id = two_node_cluster
+    nodes = {n["node_id"]: n for n in c.nodes()}
+    assert "head" in nodes and node_id in nodes
+    assert nodes[node_id]["alive"]
+    # Two distinct store segments.
+    assert nodes["head"]["store_name"] != nodes[node_id]["store_name"]
+
+
+def test_cross_node_task_chain(two_node_cluster):
+    """A task on node1 consumes the output of a task on node0."""
+
+    @ray_tpu.remote(resources={"node0": 1})
+    def produce():
+        return np.arange(1000, dtype=np.int64)
+
+    @ray_tpu.remote(resources={"node1": 1})
+    def consume(a):
+        return int(a.sum())
+
+    ref = produce.remote()
+    assert ray_tpu.get(consume.remote(ref)) == 499500
+
+
+def test_100mb_both_directions(two_node_cluster):
+    """100MB array moves node0 -> node1 and node1 -> node0."""
+    nbytes = 100 * 1024 * 1024
+
+    @ray_tpu.remote(resources={"node0": 1})
+    def big_on_0():
+        return np.ones(nbytes // 8, dtype=np.float64)
+
+    @ray_tpu.remote(resources={"node1": 1})
+    def big_on_1():
+        return np.full(nbytes // 8, 2.0, dtype=np.float64)
+
+    @ray_tpu.remote(resources={"node0": 1})
+    def sum_on_0(a):
+        return float(a.sum())
+
+    @ray_tpu.remote(resources={"node1": 1})
+    def sum_on_1(a):
+        return float(a.sum())
+
+    n = nbytes // 8
+    t0 = time.time()
+    assert ray_tpu.get(sum_on_1.remote(big_on_0.remote())) == n * 1.0
+    assert ray_tpu.get(sum_on_0.remote(big_on_1.remote())) == n * 2.0
+    elapsed = time.time() - t0
+    assert elapsed < 60, f"200MB of transfers took {elapsed:.1f}s"
+
+
+def test_driver_get_from_remote_node(two_node_cluster):
+    @ray_tpu.remote(resources={"node1": 1})
+    def produce():
+        return {"payload": np.arange(500000, dtype=np.float32)}
+
+    out = ray_tpu.get(produce.remote())
+    assert float(out["payload"][-1]) == 499999.0
+
+
+def test_driver_put_read_on_remote_node(two_node_cluster):
+    arr = np.arange(250000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+
+    @ray_tpu.remote(resources={"node1": 1})
+    def total(a):
+        return float(a.sum())
+
+    assert ray_tpu.get(total.remote(ref)) == pytest.approx(
+        float(arr.sum()))
+
+
+def test_pubsub_state_and_stream(two_node_cluster):
+    c, _ = two_node_cluster
+    hub_client = c.runtime.head
+    hub_client.call("publish", "test_chan", {"v": 1})
+    out = hub_client.call("psub_poll", {"test_chan": 0}, {},
+                          poll_timeout=5)
+    assert out["state"]["test_chan"][1] == {"v": 1}
+    version = out["state"]["test_chan"][0]
+    # Long-poll blocks until the next publish, then delivers fast.
+    import threading
+    got = {}
+
+    def waiter():
+        got.update(hub_client.call(
+            "psub_poll", {"test_chan": version}, {}, poll_timeout=10))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    t0 = time.time()
+    hub_client.call("publish", "test_chan", {"v": 2})
+    t.join(timeout=5)
+    latency = time.time() - t0
+    assert got["state"]["test_chan"][1] == {"v": 2}
+    assert latency < 1.0, f"long-poll delivery took {latency:.2f}s"
+    # Stream channel: ordered batch delivery.
+    for i in range(5):
+        hub_client.call("publish", "test_stream", {"i": i}, stream=True)
+    out = hub_client.call("psub_poll", {}, {"test_stream": 0},
+                          poll_timeout=5)
+    assert [it["i"] for _, it in out["streams"]["test_stream"]] == \
+        list(range(5))
+
+
+def test_node_death_lineage_reconstruction():
+    """A result living only on a dead node is rebuilt from lineage."""
+    import ray_tpu._private.worker as worker_mod
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=1,
+                 resources_per_worker={"CPU": 2, "node0": 10},
+                 store_capacity=128 * 1024 * 1024) as c:
+        node_id = c.add_node(
+            num_workers=1, resources_per_worker={"CPU": 2, "big": 10},
+            store_capacity=128 * 1024 * 1024)
+
+        # Runs on node1 the first time (needs "big"); after node1 dies
+        # reconstruction must land it elsewhere, so make the resource
+        # requirement soft: use plain CPU but force first placement via
+        # a value marker instead.
+        @ray_tpu.remote(max_retries=2)
+        def produce(tag):
+            import os
+            return ("value", tag, os.getpid())
+
+        # Pin the first run to node1 via its marker resource.
+        ref = produce.options(resources={"big": 1}).remote("x")
+        first = ray_tpu.get(ref)
+        assert first[0] == "value"
+
+        # Kill node1's process tree and tell the head immediately
+        # (tests shouldn't wait out the 30s heartbeat timeout).
+        c.kill_node(node_id)
+        c.node.head_service.mark_node_dead(node_id)
+
+        # The object's only copy is gone. A fresh get must trigger
+        # lineage reconstruction... but the spec needs {"big": 1},
+        # which no longer exists — so reconstruction must requeue and
+        # then time out OR we re-add capacity. Re-add capacity:
+        c.add_node(num_workers=1,
+                   resources_per_worker={"CPU": 2, "big": 10},
+                   store_capacity=128 * 1024 * 1024)
+        rebuilt = ray_tpu.get(ref, timeout=60)
+        assert rebuilt[0] == "value" and rebuilt[1] == "x"

@@ -1,0 +1,195 @@
+"""Core runtime microbenchmarks.
+
+The shape of the reference's microbenchmark suite
+(python/ray/_private/ray_perf.py:93 — named metrics for task/actor call
+throughput and object put/get bandwidth, regression-tracked per round in
+PERF_r{N}.json). Run against the REAL multiprocess runtime (head
+scheduler + worker processes + C++ shm store), not local mode.
+
+Run: python tools/ray_perf.py [--out PERF.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+RESULTS = []
+
+
+def timeit(name, fn, multiplier=1):
+    # Warmup, then 3 timed repetitions; report the best rate.
+    fn()
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        n = fn()
+        dt = time.perf_counter() - t0
+        best = max(best, n * multiplier / dt)
+    RESULTS.append({"name": name, "rate": round(best, 1)})
+    print(f"{name:48s} {best:12.1f} /s", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.runtime import Cluster
+    import ray_tpu._private.worker as worker_mod
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+
+    # 2 workers x 8 CPUs: measured best on this 1-core box (more worker
+    # processes just add context-switch overhead).
+    cluster = Cluster(num_workers=2,
+                      resources_per_worker={"CPU": 8},
+                      store_capacity=1024 * 1024 * 1024)
+    N = 200 if args.quick else 2000
+
+    @ray_tpu.remote
+    def noop():
+        pass
+
+    @ray_tpu.remote
+    def noop_arg(x):
+        return x
+
+    @ray_tpu.remote
+    class Actor:
+        def noop(self):
+            pass
+
+        def echo(self, x):
+            return x
+
+    @ray_tpu.remote
+    class AsyncActor:
+        async def noop(self):
+            pass
+
+    try:
+        # --- tasks ---------------------------------------------------------
+        def single_client_tasks():
+            ray_tpu.get([noop.remote() for _ in range(N)])
+            return N
+
+        timeit("single_client_task_throughput", single_client_tasks)
+
+        def tasks_with_arg():
+            ray_tpu.get([noop_arg.remote(i) for i in range(N)])
+            return N
+
+        timeit("single_client_task_with_arg_throughput", tasks_with_arg)
+
+        def multi_client_tasks():
+            import threading
+            k = 4
+            errs = []
+
+            def client():
+                try:
+                    ray_tpu.get([noop.remote() for _ in range(N)])
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=client) for _ in range(k)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise errs[0]
+            return N * k
+
+        timeit("multi_client_task_throughput", multi_client_tasks)
+
+        # --- actors --------------------------------------------------------
+        a = Actor.remote()
+        ray_tpu.get(a.noop.remote())
+
+        def actor_sync_1_1():
+            ray_tpu.get([a.noop.remote() for _ in range(N)])
+            return N
+
+        timeit("actor_calls_sync_1_1", actor_sync_1_1)
+
+        actors = [Actor.remote() for _ in range(4)]
+        ray_tpu.get([x.noop.remote() for x in actors])
+
+        def actor_sync_1_n():
+            refs = []
+            for _ in range(N // 4):
+                refs.extend(x.noop.remote() for x in actors)
+            ray_tpu.get(refs)
+            return len(refs)
+
+        timeit("actor_calls_sync_1_n", actor_sync_1_n)
+
+        aa = AsyncActor.remote()
+        ray_tpu.get(aa.noop.remote())
+
+        def async_actor_calls():
+            ray_tpu.get([aa.noop.remote() for _ in range(N)])
+            return N
+
+        timeit("async_actor_calls_sync", async_actor_calls)
+
+        # --- objects -------------------------------------------------------
+        def put_small():
+            for _ in range(N):
+                ray_tpu.put(b"x" * 100)
+            return N
+
+        timeit("put_calls_per_s", put_small)
+
+        big = np.ones(64 * 1024 * 1024 // 8)      # 64 MB
+
+        def put_gigabytes():
+            refs = [ray_tpu.put(big) for _ in range(8)]
+            del refs
+            return 8 * big.nbytes / 1e9
+
+        timeit("put_gigabytes_per_s", put_gigabytes)
+
+        ref_big = ray_tpu.put(big)
+
+        def get_gigabytes():
+            for _ in range(8):
+                ray_tpu.get(ref_big)
+            return 8 * big.nbytes / 1e9
+
+        timeit("get_gigabytes_per_s", get_gigabytes)
+
+        n_small = 1000
+        small_refs = [ray_tpu.put(i) for i in range(n_small)]
+
+        def get_many_small():
+            ray_tpu.get(small_refs)
+            return n_small
+
+        timeit("get_calls_per_s", get_many_small)
+
+    finally:
+        cluster.shutdown()
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"metrics": RESULTS,
+                       "config": {"workers": 2, "cpus_per_worker": 8, "host_cores": 1}},
+                      f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
