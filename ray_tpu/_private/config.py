@@ -59,6 +59,9 @@ define_flag("object_spill_dir", str, "/tmp/ray_tpu_spill",
             "Directory for spilled objects.")
 define_flag("worker_pool_prestart", bool, True,
             "Prestart workers based on scheduling backlog.")
+define_flag("env_worker_idle_timeout_s", float, 60.0,
+            "Idle seconds before a dedicated runtime-env worker "
+            "process is reaped (worker_pool idle reaping analogue).")
 define_flag("max_pending_actor_calls", int, 10000,
             "Client-side cap on in-flight calls per actor handle.")
 define_flag("memory_monitor_threshold", float, 0.0,

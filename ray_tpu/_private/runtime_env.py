@@ -23,6 +23,32 @@ from typing import Any, Dict, Optional
 
 _KNOWN_KEYS = {"env_vars", "working_dir", "py_modules"}
 
+
+def runtime_env_key(runtime_env: Optional[Dict[str, Any]]
+                    ) -> Optional[str]:
+    """Canonical content key for worker-pool routing (the reference
+    keys dedicated worker processes by serialized runtime env,
+    worker_pool.h:149)."""
+    if not runtime_env:
+        return None
+    import json
+    blob = json.dumps(runtime_env, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+_permanent_envs: list = []
+
+
+def enter_runtime_env_permanently(runtime_env: Dict[str, Any]) -> None:
+    """Apply a runtime env for the lifetime of this process (dedicated
+    env-keyed workers apply their env once at startup; per-task
+    apply/restore is then skipped entirely)."""
+    ctx = runtime_env_context(runtime_env)
+    ctx.__enter__()        # never exited: the process IS the env
+    # Keep the suspended generator alive — dropping the last reference
+    # would run its finally block and RESTORE the env.
+    _permanent_envs.append(ctx)
+
 # cwd / os.environ / sys.path are process-global; the lock guards only
 # the apply/restore mutations (never user code — see
 # runtime_env_context). Overlapping contexts are reconciled with

@@ -92,6 +92,8 @@ def init(address: Optional[str] = None,
             # ray_tpu.runtime.client.
             from ray_tpu.runtime.client import connect_to_cluster
             runtime = connect_to_cluster(address)
+            if log_to_driver:
+                runtime.start_log_streaming()
             _worker = Worker(runtime, mode="driver")
         if namespace:
             _worker.namespace = namespace

@@ -207,12 +207,21 @@ def _submit_buffer(head: RpcClient) -> _SubmitBuffer:
 
 
 def submit_task_via_head(head: RpcClient, spec: TaskSpec):
+    from ray_tpu._private.task_spec import (
+        NodeAffinitySchedulingStrategy, SpreadSchedulingStrategy)
     refs = [ObjectRef(oid) for oid in spec.return_ids]
     pg_id = None
+    strat_meta = None
     strat = spec.scheduling_strategy
     if isinstance(strat, PlacementGroupSchedulingStrategy) and \
             strat.placement_group is not None:
         pg_id = strat.placement_group.id.hex()
+    elif isinstance(strat, SpreadSchedulingStrategy):
+        strat_meta = {"type": "spread"}
+    elif isinstance(strat, NodeAffinitySchedulingStrategy):
+        strat_meta = {"type": "node_affinity",
+                      "node_id": strat.node_id,
+                      "soft": bool(strat.soft)}
     payload = cloudpickle.dumps({
         "task_id": spec.task_id.hex(),
         "name": spec.name,
@@ -232,6 +241,21 @@ def submit_task_via_head(head: RpcClient, spec: TaskSpec):
         "max_retries": spec.max_retries,
         "pg_id": pg_id,
     }
+    if spec.runtime_env:
+        # Env-keyed worker-pool routing (isolation): the head sends
+        # this task only to a dedicated worker for this env.
+        from ray_tpu._private.runtime_env import runtime_env_key
+        meta["env_key"] = runtime_env_key(spec.runtime_env)
+        meta["runtime_env"] = spec.runtime_env
+    if strat_meta is not None:
+        meta["strategy"] = strat_meta
+    else:
+        # Locality hints: schedule where the argument objects live
+        # (lease_policy.cc locality path). Hex ids only — cheap.
+        arg_oids = [a.id.hex() for a in spec.args
+                    if isinstance(a, ObjectRef)][:16]
+        if arg_oids:
+            meta["arg_oids"] = arg_oids
     _submit_buffer(head).add(meta, payload)
     return refs
 
@@ -262,6 +286,10 @@ def create_actor_via_head(head: RpcClient, spec: ActorCreationSpec):
         "namespace": spec.namespace,
         "get_if_exists": spec.get_if_exists,
     }
+    if spec.runtime_env:
+        from ray_tpu._private.runtime_env import runtime_env_key
+        meta["env_key"] = runtime_env_key(spec.runtime_env)
+        meta["runtime_env"] = spec.runtime_env
     out = head.call("create_actor", meta, payload)
     final_spec = spec
     if out["actor_id"] != spec.actor_id.hex():
@@ -455,7 +483,20 @@ class DistributedRuntime:
     def list_nodes(self):
         return self.head.call("list_nodes")
 
+    def start_log_streaming(self, sink=None):
+        """Stream worker stdout/stderr records to this driver
+        (log_to_driver=True). Additional calls add sinks."""
+        if getattr(self, "_log_streamer", None) is None:
+            from ray_tpu._private.log_streaming import DriverLogStreamer
+            self._log_streamer = DriverLogStreamer(
+                f"{self.head.host}:{self.head.port}", sink=sink)
+        elif sink is not None:
+            self._log_streamer.add_sink(sink)
+        return self._log_streamer
+
     def shutdown(self):
+        if getattr(self, "_log_streamer", None) is not None:
+            self._log_streamer.stop()
         self._subscriber.stop()
         if self.node_manager is None:
             # Attached driver (connect_to_cluster): disconnecting must

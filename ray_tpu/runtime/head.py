@@ -28,8 +28,11 @@ from ray_tpu.runtime.rpc import RpcClient, RpcError
 
 class _WorkerInfo:
     def __init__(self, worker_id: str, address: str,
-                 resources: Dict[str, float], node_id: str = "head"):
+                 resources: Dict[str, float], node_id: str = "head",
+                 env_key: Optional[str] = None):
         self.worker_id = worker_id
+        self.env_key = env_key      # runtime-env pool key (or None)
+        self.last_active = time.time()
         self.address = address
         self.resources = dict(resources)
         self.available = dict(resources)
@@ -67,8 +70,12 @@ class _ActorInfo:
     def __init__(self, actor_id: str, worker_id: str, payload: bytes,
                  resources: Dict[str, float], max_restarts: int,
                  name: Optional[str], namespace: str,
-                 pg_id: Optional[str] = None, bundle_index: int = -1):
+                 pg_id: Optional[str] = None, bundle_index: int = -1,
+                 env_key: Optional[str] = None,
+                 runtime_env: Optional[Dict] = None):
         self.actor_id = actor_id
+        self.env_key = env_key
+        self.runtime_env = runtime_env
         self.worker_id = worker_id
         self.payload = payload          # creation spec (for restarts)
         self.resources = resources
@@ -87,10 +94,12 @@ class _ActorInfo:
 
 
 class HeadService:
-    """Handler object served by RpcServer in the driver process."""
+    """Handler object served by RpcServer in the head process."""
 
-    def __init__(self, store_name: str):
+    def __init__(self, store_name: str,
+                 state_dir: Optional[str] = None):
         self.store_name = store_name
+        self.state_dir = state_dir
         self._lock = threading.RLock()
         self._workers: Dict[str, _WorkerInfo] = {}
         self._actors: Dict[str, _ActorInfo] = {}
@@ -128,6 +137,14 @@ class HeadService:
         from ray_tpu._private.config import GlobalConfig
         self._lineage_budget = int(GlobalConfig.lineage_max_bytes)
         self._sched_cv = threading.Condition(self._lock)
+        # --- persistence (GCS table-storage parity) --------------------
+        self._persist_dirty = threading.Event()
+        if state_dir:
+            self._restore_state()
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, daemon=True,
+                name="head-persist")
+            self._persist_thread.start()
         self._sched_thread = threading.Thread(
             target=self._scheduler_loop, daemon=True, name="head-sched")
         self._sched_thread.start()
@@ -135,6 +152,99 @@ class HeadService:
             target=self._node_monitor_loop, daemon=True,
             name="head-node-monitor")
         self._node_monitor.start()
+
+    # ---- persistence / recovery (gcs_table_storage.h:261,
+    # redis_store_client.h:28 role — here a debounced full snapshot of
+    # the durable tables; worker/node bindings are NOT persisted, they
+    # re-attach via heartbeats) ---------------------------------------
+
+    def _dirty(self):
+        self._persist_dirty.set()
+
+    def _snapshot_path(self) -> str:
+        import os
+        return os.path.join(self.state_dir, "head_state.pkl")
+
+    def _persist_loop(self):
+        import os
+        import cloudpickle
+        os.makedirs(self.state_dir, exist_ok=True)
+        while not self._shutdown:
+            if not self._persist_dirty.wait(timeout=1.0):
+                continue
+            time.sleep(0.25)            # debounce bursts
+            self._persist_dirty.clear()
+            with self._lock:
+                state = {
+                    "kv": dict(self._kv),
+                    "functions": dict(getattr(self, "_functions", {})),
+                    "named": dict(self._named),
+                    "actors": {
+                        aid: {"payload": a.payload,
+                              "resources": a.resources,
+                              "max_restarts": a.max_restarts,
+                              "restarts": a.restarts,
+                              "name": a.name, "namespace": a.namespace,
+                              "pg_id": a.pg_id,
+                              "bundle_index": a.bundle_index,
+                              "env_key": a.env_key,
+                              "runtime_env": a.runtime_env}
+                        for aid, a in self._actors.items()
+                        if not a.dead},
+                    "pg_specs": {
+                        pg_id: {"bundles": [dict(b) for _, b in
+                                            pg["bundles"]],
+                                "strategy": pg.get("strategy", "PACK")}
+                        for pg_id, pg in self._pgs.items()},
+                }
+            tmp = self._snapshot_path() + ".tmp"
+            with open(tmp, "wb") as f:
+                cloudpickle.dump(state, f)
+            os.replace(tmp, self._snapshot_path())
+
+    def _restore_state(self):
+        import os
+        import cloudpickle
+        path = self._snapshot_path()
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            state = cloudpickle.load(f)
+        with self._lock:
+            self._kv.update(state.get("kv", {}))
+            self._functions = dict(state.get("functions", {}))
+            self._named.update(state.get("named", {}))
+            for aid, rec in state.get("actors", {}).items():
+                info = _ActorInfo(
+                    aid, "", rec["payload"], rec["resources"],
+                    rec["max_restarts"], rec["name"], rec["namespace"],
+                    pg_id=rec.get("pg_id"),
+                    bundle_index=rec.get("bundle_index", -1),
+                    env_key=rec.get("env_key"),
+                    runtime_env=rec.get("runtime_env"))
+                info.restarts = rec.get("restarts", 0)
+                # worker_id="" == awaiting re-attach: the worker that
+                # hosts this actor re-reports it on its next heartbeat
+                # miss; calls meanwhile wait (submit_actor_task).
+                self._actors[aid] = info
+            # PGs are restored as specs awaiting re-reservation once
+            # workers re-register.
+            self._recovering_pgs = dict(state.get("pg_specs", {}))
+
+    def _try_recover_pgs_locked(self):
+        pending = getattr(self, "_recovering_pgs", None)
+        if not pending:
+            return
+        for pg_id in list(pending):
+            spec = pending[pg_id]
+            # Re-reserve outside the actor accounting; actors re-report
+            # and re-occupy their bundles afterwards. Keep the spec
+            # until creation SUCCEEDS — early attempts can fail while
+            # only some workers have re-attached (e.g. STRICT_SPREAD
+            # needing more distinct workers).
+            if self.create_placement_group(pg_id, spec["bundles"],
+                                           spec["strategy"]):
+                del pending[pg_id]
 
     def _get_store(self):
         if self._store is None:
@@ -201,6 +311,7 @@ class HeadService:
             now = time.time()
             stale = []
             with self._lock:
+                self._reap_idle_env_workers_locked()
                 for n in self._nodes.values():
                     # The head's own node has no heartbeating agent.
                     if n.alive and n.node_id != "head" and \
@@ -295,7 +406,8 @@ class HeadService:
         cost = len(payload)
         entry = {"meta": {k: meta[k] for k in
                           ("task_id", "return_ids", "resources",
-                           "max_retries", "pg_id") if k in meta},
+                           "max_retries", "pg_id", "env_key",
+                           "runtime_env", "strategy") if k in meta},
                  "payload": payload}
         for rid in meta.get("return_ids", ()):
             rid_hex = rid.hex() if isinstance(rid, bytes) else rid
@@ -310,8 +422,11 @@ class HeadService:
             self._lineage_bytes -= dropped["cost"]
 
     def _enqueue_locked(self, task_id: str, meta: Dict[str, Any]):
+        strat = meta.get("strategy")
         sig = (tuple(sorted(meta.get("resources", {}).items())),
-               meta.get("pg_id"))
+               meta.get("pg_id"), meta.get("env_key"),
+               tuple(sorted(strat.items())) if strat else None,
+               bool(meta.get("arg_oids")))
         self._pending.setdefault(sig, collections.deque()).append(
             task_id)
         self._sched_cv.notify_all()
@@ -347,6 +462,11 @@ class HeadService:
         return self.hub.poll(state_versions, stream_seqs,
                              timeout=poll_timeout)
 
+    def psub_stream_seq(self, channel: str) -> int:
+        """Next sequence number of a stream channel — late subscribers
+        start here instead of replaying the retained history."""
+        return self.hub.next_seq(channel)
+
     def publish(self, channel: str, value: Any, stream: bool = False):
         if stream:
             return self.hub.publish_stream(channel, value)
@@ -356,14 +476,39 @@ class HeadService:
 
     def register_worker(self, worker_id: str, address: str,
                         resources: Dict[str, float],
-                        node_id: str = "head") -> Dict[str, Any]:
+                        node_id: str = "head",
+                        env_key: Optional[str] = None
+                        ) -> Dict[str, Any]:
         with self._lock:
-            self._workers[worker_id] = _WorkerInfo(worker_id, address,
-                                                   resources, node_id)
+            self._workers[worker_id] = _WorkerInfo(
+                worker_id, address, resources, node_id, env_key)
+            self._try_recover_pgs_locked()
             self._sched_cv.notify_all()
             node = self._nodes.get(node_id)
             store = node.store_name if node else self.store_name
         return {"store_name": store, "multinode": self.node_count() > 1}
+
+    def worker_heartbeat(self, worker_id: str) -> bool:
+        """False tells the worker this head doesn't know it (restarted
+        head, or it was marked dead) — re-register + report_actors."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+            return w is not None and w.alive
+
+    def report_actors(self, worker_id: str,
+                      actor_ids: List[str]) -> None:
+        """Worker re-attaching after a head restart re-binds the actors
+        it hosts (the directory was restored from the snapshot with
+        empty bindings)."""
+        with self._lock:
+            for aid in actor_ids:
+                a = self._actors.get(aid)
+                if a is not None and not a.dead and a.worker_id == "":
+                    # Only fill EMPTY bindings: a live binding means a
+                    # restart already placed the actor elsewhere (the
+                    # reporter holds a stale instance).
+                    a.worker_id = worker_id
+            self._sched_cv.notify_all()
 
     def mark_worker_dead(self, worker_id: str):
         """Called by the node manager when a worker process dies."""
@@ -426,6 +571,7 @@ class HeadService:
             if not hasattr(self, "_functions"):
                 self._functions = {}
             self._functions[fn_id] = blob
+        self._dirty()
 
     def get_function(self, fn_id: str) -> Optional[bytes]:
         with self._lock:
@@ -436,6 +582,7 @@ class HeadService:
     def kv_put(self, key: str, value: bytes):
         with self._lock:
             self._kv[key] = value
+        self._dirty()
 
     def kv_get(self, key: str) -> Optional[bytes]:
         with self._lock:
@@ -444,6 +591,7 @@ class HeadService:
     def kv_del(self, key: str):
         with self._lock:
             self._kv.pop(key, None)
+        self._dirty()
 
     def kv_keys(self, prefix: str = "") -> List[str]:
         with self._lock:
@@ -481,9 +629,12 @@ class HeadService:
                 meta["attempt"] = 0
                 meta["state"] = "pending"
                 self._task_meta[meta["task_id"]] = meta
+                strat = meta.get("strategy")
                 sig = (tuple(sorted(meta.get("resources",
                                              {}).items())),
-                       meta.get("pg_id"))
+                       meta.get("pg_id"), meta.get("env_key"),
+                       tuple(sorted(strat.items())) if strat else None,
+                       bool(meta.get("arg_oids")))
                 self._pending.setdefault(
                     sig, collections.deque()).append(meta["task_id"])
             self._sched_cv.notify_all()
@@ -517,7 +668,27 @@ class HeadService:
                     self._sched_cv.wait(timeout=0.05)
 
     def _pick_worker_locked(self, resources: Dict[str, float],
-                            pg_id: Optional[str]) -> Optional[_WorkerInfo]:
+                            pg_id: Optional[str],
+                            env_key: Optional[str] = None,
+                            strategy: Optional[Dict[str, Any]] = None,
+                            arg_oids: Optional[List[str]] = None
+                            ) -> Optional[_WorkerInfo]:
+        """Placement policies (reference:
+        src/ray/raylet/scheduling/policy/*_scheduling_policy.cc):
+
+        - default: hybrid — pack onto the head node while its
+          utilization stays under scheduler_spread_threshold, then
+          spill to the least-loaded feasible worker anywhere
+          (hybrid_scheduling_policy.cc shape).
+        - locality (default + object args): among feasible workers,
+          prefer the node holding the most argument objects — the
+          LeasePolicy locality path (core_worker/lease_policy.cc).
+        - spread: fewest-running NODE first, then worker
+          (spread_scheduling_policy.cc).
+        - node_affinity: only that node; soft=True spills back to the
+          hybrid choice when the node is gone or full
+          (node_affinity_scheduling_policy.cc).
+        """
         if pg_id is not None:
             pg = self._pgs.get(pg_id)
             if not pg or not pg["ready"]:
@@ -528,16 +699,65 @@ class HeadService:
                 if w and w.alive:
                     return w
             return None
-        best = None
+        feasible = []
         for w in self._workers.values():
             if not w.alive:
                 continue
+            # Runtime-env isolation (worker_pool.h:149 parity): tasks
+            # with an env run ONLY in that env's dedicated workers, and
+            # env-less tasks never land in env workers — concurrent
+            # executions cannot observe each other's environment.
+            if w.env_key != env_key:
+                continue
             if all(w.available.get(k, 0.0) + 1e-9 >= v
                    for k, v in resources.items()):
-                # Least-loaded fit.
-                if best is None or len(w.running) < len(best.running):
-                    best = w
-        return best
+                feasible.append(w)
+        if not feasible:
+            return None
+
+        def least_loaded(ws):
+            return min(ws, key=lambda w: len(w.running))
+
+        stype = (strategy or {}).get("type")
+        if stype == "node_affinity":
+            on_node = [w for w in feasible
+                       if w.node_id == strategy.get("node_id")]
+            if on_node:
+                return least_loaded(on_node)
+            if strategy.get("soft"):
+                return least_loaded(feasible)   # spillback
+            return None
+        if stype == "spread":
+            by_node: Dict[str, List[_WorkerInfo]] = {}
+            for w in feasible:
+                by_node.setdefault(w.node_id, []).append(w)
+            node_load = {nid: sum(len(w.running) for w in ws)
+                         for nid, ws in by_node.items()}
+            nid = min(node_load, key=node_load.get)
+            return least_loaded(by_node[nid])
+        if arg_oids:
+            # Locality: count arg objects already on each node.
+            node_score: Dict[str, int] = {}
+            for oid_hex in arg_oids:
+                for nid in self._obj_locs.get(oid_hex, ()):
+                    node_score[nid] = node_score.get(nid, 0) + 1
+            if node_score:
+                best_nid = max(node_score, key=node_score.get)
+                local = [w for w in feasible
+                         if w.node_id == best_nid]
+                if local:
+                    return least_loaded(local)
+        # Hybrid default: pack the head node under the threshold.
+        from ray_tpu._private.config import GlobalConfig
+        threshold = GlobalConfig.scheduler_spread_threshold
+        head_ws = [w for w in feasible if w.node_id == "head"]
+        if head_ws:
+            cap = sum(max(1.0, w.resources.get("CPU", 1.0))
+                      for w in head_ws)
+            used = sum(len(w.running) for w in head_ws)
+            if used / cap < threshold:
+                return least_loaded(head_ws)
+        return least_loaded(feasible)
 
     def _try_dispatch_locked(self) -> bool:
         progressed = False
@@ -551,8 +771,14 @@ class HeadService:
                     continue
                 res = meta.get("resources", {})
                 pg_id = meta.get("pg_id")
-                w = self._pick_worker_locked(res, pg_id)
+                env_key = meta.get("env_key")
+                w = self._pick_worker_locked(
+                    res, pg_id, env_key, meta.get("strategy"),
+                    meta.get("arg_oids"))
                 if w is None:
+                    if env_key is not None:
+                        self._ensure_env_worker_locked(
+                            env_key, meta.get("runtime_env"), res)
                     break    # this shape can't place now; next shape
                 queue.popleft()
                 if pg_id is None:
@@ -560,6 +786,7 @@ class HeadService:
                         w.available[k] = w.available.get(k, 0.0) - v
                 w.running.add(task_id)
                 w.running_res[task_id] = (dict(res), pg_id)
+                w.last_active = time.time()
                 meta["state"] = "dispatched"
                 meta["worker_id"] = w.worker_id
                 if w.sender is None or not w.sender.is_alive():
@@ -624,11 +851,69 @@ class HeadService:
                     self._handle_lost_task(m["task_id"])
                 return
 
+    def _ensure_env_worker_locked(self, env_key: str,
+                                  runtime_env: Optional[Dict],
+                                  resources: Optional[Dict] = None):
+        """Spawn one dedicated worker for a runtime-env key when no
+        FEASIBLE one exists (worker_pool StartWorkerProcess parity).
+        At most one spawn in flight per key."""
+        if runtime_env is None:
+            return
+        need = dict(resources or {})
+        if any(w.env_key == env_key and w.alive and
+               all(w.resources.get(k, 0.0) + 1e-9 >= v
+                   for k, v in need.items())
+               for w in self._workers.values()):
+            return
+        spawns = getattr(self, "_env_spawns", None)
+        if spawns is None:
+            spawns = self._env_spawns = {}
+        if time.time() < spawns.get(env_key, 0):
+            return
+        spawns[env_key] = time.time() + 30      # spawn cooldown
+        ns = getattr(self, "_node_service", None)
+        if ns is None:
+            return
+
+        spawn_res = dict(need)
+        spawn_res["CPU"] = max(1.0, spawn_res.get("CPU", 1.0))
+
+        def spawn():
+            try:
+                ns.call("start_worker", ns.call("num_workers"),
+                        spawn_res, runtime_env)
+            except Exception:
+                pass
+
+        threading.Thread(target=spawn, daemon=True,
+                         name=f"env-spawn-{env_key[:8]}").start()
+
+    def _reap_idle_env_workers_locked(self):
+        """Idle reaping for dedicated env workers (worker_pool idle
+        reaping parity): no running tasks, no actors, idle past the
+        timeout -> stop the process."""
+        from ray_tpu._private.config import GlobalConfig
+        timeout = GlobalConfig.env_worker_idle_timeout_s
+        now = time.time()
+        victims = []
+        actors_by_worker = {a.worker_id for a in self._actors.values()
+                            if not a.dead}
+        for w in self._workers.values():
+            if (w.env_key is not None and w.alive and not w.running and
+                    w.worker_id not in actors_by_worker and
+                    now - w.last_active > timeout):
+                victims.append(w.worker_id)
+        for wid in victims:
+            threading.Thread(target=self.stop_worker, args=(wid,),
+                             daemon=True).start()
+
     def tasks_done(self, worker_id: str, task_ids: List[str]):
         """Batched completion report from a worker executor: releases
         resources, records result locations + lineage."""
         with self._lock:
             w = self._workers.get(worker_id)
+            if w is not None:
+                w.last_active = time.time()
             for task_id in task_ids:
                 meta = self._task_meta.pop(task_id, None)
                 if w is not None:
@@ -698,8 +983,14 @@ class HeadService:
                 w = None
                 while w is None:
                     w, placed_bidx = self._pick_actor_worker_locked(
-                        meta.get("resources", {}), pg_id, bundle_index)
+                        meta.get("resources", {}), pg_id, bundle_index,
+                        meta.get("env_key"))
                     if w is None:
+                        if meta.get("env_key") is not None:
+                            self._ensure_env_worker_locked(
+                                meta["env_key"],
+                                meta.get("runtime_env"),
+                                meta.get("resources", {}))
                         # Surface the blocked demand to the autoscaler.
                         self._pending_actor_demands[actor_id] = dict(
                             meta.get("resources", {}))
@@ -721,13 +1012,16 @@ class HeadService:
                 info = _ActorInfo(actor_id, w.worker_id, payload,
                                   meta.get("resources", {}),
                                   meta.get("max_restarts", 0), name, ns,
-                                  pg_id=pg_id, bundle_index=placed_bidx)
+                                  pg_id=pg_id, bundle_index=placed_bidx,
+                                  env_key=meta.get("env_key"),
+                                  runtime_env=meta.get("runtime_env"))
                 self._actors[actor_id] = info
                 if name:
                     self._named[(ns, name)] = actor_id
                 client = w.client
             try:
                 client.call("create_actor", actor_id, payload)
+                self._dirty()
                 return {"actor_id": actor_id}
             except RpcError:
                 # Worker died under us (monitor lag): mark it dead —
@@ -762,7 +1056,7 @@ class HeadService:
                    for k, v in resources.items())
 
     def _pick_actor_worker_locked(self, resources, pg_id,
-                                  bundle_index):
+                                  bundle_index, env_key=None):
         """PG-pinned actors go to the worker holding their bundle (the
         reference routes actor creation through the bundle's raylet —
         gcs_actor_scheduler.cc); others fall back to resource fit.
@@ -786,7 +1080,7 @@ class HeadService:
                         self._bundle_fits_locked(pg, idx, resources):
                     return w, idx
             return None, -1
-        return self._pick_worker_locked(resources, None), -1
+        return self._pick_worker_locked(resources, None, env_key), -1
 
     def _handle_lost_actor(self, a: _ActorInfo):
         with self._lock:
@@ -815,7 +1109,11 @@ class HeadService:
                         if cand and cand.alive:
                             w = cand
                 else:
-                    w = self._pick_worker_locked(a.resources, None)
+                    w = self._pick_worker_locked(a.resources, None,
+                                                 a.env_key)
+                    if w is None and a.env_key is not None:
+                        self._ensure_env_worker_locked(
+                            a.env_key, a.runtime_env, a.resources)
                 if w is None:
                     self._sched_cv.wait(timeout=0.1)
                     continue
@@ -838,15 +1136,27 @@ class HeadService:
 
     def submit_actor_task(self, actor_id: str, meta: Dict[str, Any],
                           payload: bytes):
+        deadline = time.time() + 30
         with self._lock:
-            a = self._actors.get(actor_id)
-            if a is None or a.dead:
-                reason = a.death_reason if a else "unknown actor"
-                raise ActorDiedError(actor_id, reason)
-            w = self._workers.get(a.worker_id)
-            if w is None or not w.alive:
-                raise ActorDiedError(actor_id, "worker dead")
-            client = w.client
+            while True:
+                a = self._actors.get(actor_id)
+                if a is None or a.dead:
+                    reason = a.death_reason if a else "unknown actor"
+                    raise ActorDiedError(actor_id, reason)
+                if a.worker_id == "":
+                    # Restored-from-snapshot (or mid-restart) actor
+                    # awaiting its worker's re-attach: wait for the
+                    # binding instead of failing the call.
+                    if time.time() > deadline:
+                        raise ActorDiedError(
+                            actor_id, "no worker re-attached the actor")
+                    self._sched_cv.wait(timeout=0.2)
+                    continue
+                w = self._workers.get(a.worker_id)
+                if w is None or not w.alive:
+                    raise ActorDiedError(actor_id, "worker dead")
+                client = w.client
+                break
         client.call("push_actor_task", actor_id, payload)
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
@@ -875,6 +1185,7 @@ class HeadService:
             else:
                 a.restarts += 1
             client = w.client if (w and w.alive) else None
+        self._dirty()
         if client is not None:
             try:
                 client.call("kill_actor", actor_id,
@@ -982,6 +1293,7 @@ class HeadService:
             self._failed_pg_demands.pop(pg_id, None)
             self._pgs[pg_id] = {
                 "ready": True,
+                "strategy": strategy,
                 "workers": [wid for wid, _ in reserved],
                 "bundles": reserved,
                 # Per-bundle resources consumed by PG-pinned actors —

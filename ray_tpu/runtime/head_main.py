@@ -20,6 +20,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--store", required=True)
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--state-dir", default=None)
     args = ap.parse_args()
 
     import os
@@ -60,7 +61,7 @@ def main():
                     fh.write(f"{n:8d}  {line}\n")
         atexit.register(_dump)
 
-    service = HeadService(args.store)
+    service = HeadService(args.store, state_dir=args.state_dir)
     server = RpcServer(service, port=args.port)
     service._address = server.address    # job manager needs it
     print(f"head ready address={server.address}", flush=True)

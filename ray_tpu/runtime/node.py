@@ -30,7 +30,8 @@ _REPO_ROOT = os.path.abspath(
 def spawn_worker_process(head_address: str, store_name: str,
                          worker_id: str, resources: Dict[str, float],
                          node_id: str = "head",
-                         force_cpu_backend: bool = False
+                         force_cpu_backend: bool = False,
+                         runtime_env: Optional[Dict] = None
                          ) -> subprocess.Popen:
     """Start one worker process (shared by NodeManager and NodeAgent)."""
     env = dict(os.environ)
@@ -47,14 +48,17 @@ def spawn_worker_process(head_address: str, store_name: str,
     # (PR_SET_PDEATHSIG is unsuitable: it fires when the spawning
     # THREAD exits, and RPC handler threads spawn workers too.)
     env["RAY_TPU_PARENT_PID"] = str(os.getpid())
-    return subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu.runtime.worker_main",
-         "--head", head_address,
-         "--store", store_name,
-         "--worker-id", worker_id,
-         "--node-id", node_id,
-         "--resources", json.dumps(resources)],
-        cwd=_REPO_ROOT, env=env)
+    cmd = [sys.executable, "-m", "ray_tpu.runtime.worker_main",
+           "--head", head_address,
+           "--store", store_name,
+           "--worker-id", worker_id,
+           "--node-id", node_id,
+           "--resources", json.dumps(resources)]
+    if runtime_env:
+        # Dedicated env-keyed worker (worker_pool.h:149 parity): the
+        # env is applied once at startup; the process IS the env.
+        cmd += ["--runtime-env", json.dumps(runtime_env)]
+    return subprocess.Popen(cmd, cwd=_REPO_ROOT, env=env)
 
 
 class _NodeService:
@@ -66,8 +70,9 @@ class _NodeService:
         self._nm = nm
 
     def start_worker(self, index: int,
-                     resources: Optional[Dict[str, float]] = None) -> str:
-        return self._nm.start_worker(index, resources)
+                     resources: Optional[Dict[str, float]] = None,
+                     runtime_env: Optional[Dict] = None) -> str:
+        return self._nm.start_worker(index, resources, runtime_env)
 
     def kill_worker(self, worker_id: str) -> None:
         self._nm.kill_worker(worker_id)
@@ -108,7 +113,9 @@ class NodeManager:
         from ray_tpu._private.shm_metrics import ShmMetricsRegistry
         self.metrics = ShmMetricsRegistry.create(self.store_name + "_m")
         # The head is its own PROCESS (gcs_server parity): scheduler
-        # loops and dispatch senders don't share the driver's GIL.
+        # loops and dispatch senders don't share the driver's GIL. Its
+        # durable tables snapshot into _state_dir for restart recovery.
+        self._state_dir: Optional[str] = None
         self.head_proc = self._spawn_head()
         from ray_tpu.runtime.rpc import RpcClient
         self.head_client = RpcClient(self._head_address)
@@ -132,15 +139,20 @@ class NodeManager:
                                          daemon=True, name="node-monitor")
         self._monitor.start()
 
-    def _spawn_head(self) -> subprocess.Popen:
+    def _spawn_head(self, port: int = 0) -> subprocess.Popen:
         env = dict(os.environ)
         env.pop("PYTHONPATH", None)
         env["JAX_PLATFORMS"] = "cpu"     # the head never touches a TPU
         from ray_tpu._private.config import GlobalConfig
         env.update(GlobalConfig.to_env())
+        if self._state_dir is None:
+            import tempfile
+            self._state_dir = tempfile.mkdtemp(prefix="raytpu_head_")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.runtime.head_main",
-             "--store", self.store_name],
+             "--store", self.store_name,
+             "--port", str(port),
+             "--state-dir", self._state_dir],
             cwd=_REPO_ROOT, env=env, stdout=subprocess.PIPE, text=True)
         line = proc.stdout.readline()
         if "address=" not in line:
@@ -148,12 +160,51 @@ class NodeManager:
         self._head_address = line.split("address=")[1].strip()
         return proc
 
+    def restart_head(self):
+        """Respawn the head at the SAME address from its persisted
+        snapshot (head fault tolerance: clients keep their address;
+        workers re-attach via heartbeats). Also the chaos hook for
+        kill-the-head tests."""
+        try:
+            self.head_proc.kill()
+            self.head_proc.wait(timeout=10)
+        except Exception:
+            pass
+        port = int(self._head_address.rsplit(":", 1)[1])
+        # The old socket may linger in TIME_WAIT; retry binding briefly.
+        deadline = time.time() + 15
+        while True:
+            try:
+                self.head_proc = self._spawn_head(port=port)
+                break
+            except RuntimeError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.5)
+        # Drop stale pooled connections to the dead head, then
+        # re-attach head-node services (retry while it boots).
+        self.head_client.close()
+        deadline = time.time() + 15
+        while True:
+            try:
+                self.head_client.call("attach_node_service",
+                                      self.node_server.address)
+                self.head_client.call("register_node", "head",
+                                      self.object_server.address,
+                                      self.store_name)
+                return
+            except Exception:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+
     @property
     def head_address(self) -> str:
         return self._head_address
 
     def start_worker(self, index: int,
-                     resources: Optional[Dict[str, float]] = None
+                     resources: Optional[Dict[str, float]] = None,
+                     runtime_env: Optional[Dict] = None
                      ) -> str:
         worker_id = f"worker-{index}-{uuid.uuid4().hex[:6]}"
         res = dict(resources or self.resources_per_worker)
@@ -168,7 +219,8 @@ class NodeManager:
             res.setdefault("TPU", 1.0)
         proc = spawn_worker_process(
             self.head_address, self.store_name, worker_id, res,
-            node_id="head", force_cpu_backend=not is_owner)
+            node_id="head", force_cpu_backend=not is_owner,
+            runtime_env=runtime_env)
         self.procs[worker_id] = proc
         return worker_id
 
@@ -201,6 +253,8 @@ class NodeManager:
 
     def _monitor_loop(self):
         import traceback
+
+        from ray_tpu.runtime.rpc import RpcError
         while not self._stopped:
             try:
                 for worker_id, proc in list(self.procs.items()):
@@ -208,6 +262,8 @@ class NodeManager:
                         self.procs.pop(worker_id, None)
                         self.head_client.call("mark_worker_dead",
                                               worker_id)
+            except RpcError:
+                pass    # head down/restarting: report on next pass
             except Exception:  # noqa: BLE001 — keep monitoring
                 traceback.print_exc()
             time.sleep(0.05)

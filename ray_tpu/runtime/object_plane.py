@@ -168,7 +168,9 @@ class ObjectPlane:
             if deadline is not None:
                 rem = int((deadline - time.time()) * 1000)
                 if rem <= 0:
-                    raise ShmTimeout(-5, "get")
+                    # Deadline hit: one zero-wait local attempt so an
+                    # object that IS here isn't reported as a timeout.
+                    return self.store.get_bytes(oid, timeout_ms=0)
                 wait = min(wait, max(rem, 1))
             try:
                 return self.store.get_bytes(oid, timeout_ms=wait)

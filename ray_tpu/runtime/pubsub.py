@@ -29,14 +29,24 @@ class PubSubHub:
     """In-head hub. All methods are thread-safe."""
 
     def __init__(self, stream_buffer: int = 4096):
+        import uuid
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # Epoch: identifies THIS hub instance. A restarted head builds
+        # a fresh hub whose versions restart at 1 — subscribers compare
+        # epochs and reset their cursors instead of silently dropping
+        # every post-restart update as "old".
+        self.epoch = uuid.uuid4().hex
         # state channels: name -> (version, value)
         self._state: Dict[str, Tuple[int, Any]] = {}
         # stream channels: name -> deque[(seq, item)], next_seq
         self._streams: Dict[str, collections.deque] = {}
         self._next_seq: Dict[str, int] = {}
         self._stream_buffer = stream_buffer
+
+    def next_seq(self, channel: str) -> int:
+        with self._lock:
+            return self._next_seq.get(channel, 0)
 
     # ---- publish ----------------------------------------------------------
 
@@ -99,10 +109,13 @@ class PubSubHub:
                 out_state, out_streams = self._collect(
                     state_versions, stream_seqs)
                 if out_state or out_streams:
-                    return {"state": out_state, "streams": out_streams}
+                    return {"state": out_state,
+                            "streams": out_streams,
+                            "epoch": self.epoch}
                 remaining = deadline - time.time()
                 if remaining <= 0:
-                    return {"state": {}, "streams": {}}
+                    return {"state": {}, "streams": {},
+                            "epoch": self.epoch}
                 self._cv.wait(timeout=min(remaining, 1.0))
 
     def state_snapshot(self, channel: str):
@@ -129,6 +142,7 @@ class Subscriber:
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._epoch: Optional[str] = None
 
     def subscribe_state(self, channel: str, callback: Callable):
         with self._lock:
@@ -180,6 +194,21 @@ class Subscriber:
                 if self._stop.wait(timeout=0.5):
                     return
                 continue
+            epoch = out.get("epoch")
+            if epoch is not None:
+                if self._epoch is not None and epoch != self._epoch:
+                    # Head restarted: its channels restart at version 1
+                    # while we hold higher cursors — reset so current
+                    # state re-delivers and streams resume from the
+                    # fresh hub's start.
+                    with self._lock:
+                        for chan in self._state_versions:
+                            self._state_versions[chan] = 0
+                        for chan in self._stream_seqs:
+                            self._stream_seqs[chan] = 0
+                    self._epoch = epoch
+                    continue
+                self._epoch = epoch
             for chan, (version, value) in out.get("state", {}).items():
                 with self._lock:
                     if self._state_versions.get(chan, 0) >= version:

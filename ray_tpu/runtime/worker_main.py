@@ -55,6 +55,7 @@ class Executor:
         self.actors: Dict[str, _ActorSlot] = {}
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
+        self.startup_env_key: Optional[str] = None
         self._task_q: "queue.Queue" = queue.Queue()
         self._pool_lock = threading.Lock()
         self._idle_threads = 0
@@ -226,11 +227,18 @@ class Executor:
     def _run_task(self, spec) -> str:
         _task_ctx.resources = spec.get("resources", {})
         _task_ctx.blocked = False
+        from ray_tpu._private.log_streaming import set_log_tag
+        set_log_tag(f"{spec.get('name', 'task')} "
+                    f"task={spec.get('task_id', '')[:12]}")
         try:
             func = self._resolve_function(spec)
             args = [self._resolve(a) for a in spec["args"]]
             kwargs = {k: self._resolve(v)
                       for k, v in spec["kwargs"].items()}
+            if self.startup_env_key is not None:
+                # Dedicated env worker: the env is this process.
+                spec = dict(spec)
+                spec["runtime_env"] = None
             if spec.get("runtime_env") is None and \
                     spec.get("trace_ctx") is None:
                 # Hot path: no env to apply, no span to propagate —
@@ -261,30 +269,120 @@ class Executor:
             return "error"
         finally:
             _task_ctx.resources = None
+            set_log_tag(None)
             self._report_done(spec.get("task_id", ""))
 
     # ---- actors -----------------------------------------------------------
 
+    @staticmethod
+    def _wants_asyncio(cls) -> bool:
+        import asyncio
+        import inspect
+        for _name, m in inspect.getmembers(cls):
+            if asyncio.iscoroutinefunction(m):
+                return True
+        return False
+
     def create_actor(self, actor_id: str, payload: bytes) -> str:
         spec = cloudpickle.loads(payload)
         slot = _ActorSlot()
-        try:
-            cls = spec["cls"]
-            from ray_tpu._private.runtime_env import runtime_env_context
-            slot.runtime_env = spec.get("runtime_env")
-            with runtime_env_context(slot.runtime_env):
-                slot.instance = cls(*spec["args"], **spec["kwargs"])
-        except BaseException as e:  # noqa: BLE001
-            slot.error = e
+        cls = spec["cls"]
+        slot.runtime_env = spec.get("runtime_env")
+        if self._wants_asyncio(cls):
+            # asyncio actor: instantiate AND serve inside a dedicated
+            # event loop (fiber-transport parity, core_worker fiber.h)
+            # — __init__ may create background tasks
+            # (asyncio.get_event_loop().create_task), and they
+            # interleave with ordered message execution at awaits.
+            init_done = threading.Event()
+            slot.thread = threading.Thread(
+                target=self._actor_asyncio_main,
+                args=(actor_id, slot, spec, init_done), daemon=True,
+                name=f"actor-{actor_id[:8]}")
+            slot.thread.start()
+            init_done.wait(timeout=300)
+        else:
+            try:
+                from ray_tpu._private.runtime_env import \
+                    runtime_env_context
+                with runtime_env_context(slot.runtime_env):
+                    slot.instance = cls(*spec["args"], **spec["kwargs"])
+            except BaseException as e:  # noqa: BLE001
+                slot.error = e
+            slot.thread = threading.Thread(
+                target=self._actor_loop, args=(actor_id, slot),
+                daemon=True, name=f"actor-{actor_id[:8]}")
+            slot.thread.start()
         with self._lock:
             self.actors[actor_id] = slot
-        slot.thread = threading.Thread(
-            target=self._actor_loop, args=(actor_id, slot), daemon=True,
-            name=f"actor-{actor_id[:8]}")
-        slot.thread.start()
         return "ok" if slot.error is None else "init_failed"
 
+    def _actor_asyncio_main(self, actor_id: str, slot: _ActorSlot,
+                            spec, init_done: threading.Event):
+        import asyncio
+        from ray_tpu._private.log_streaming import set_log_tag
+        set_log_tag(f"actor={actor_id[:12]}")
+        loop = slot.aloop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        try:
+            from ray_tpu._private.runtime_env import runtime_env_context
+            with runtime_env_context(slot.runtime_env):
+                slot.instance = spec["cls"](*spec["args"],
+                                            **spec["kwargs"])
+        except BaseException as e:  # noqa: BLE001
+            slot.error = e
+        finally:
+            init_done.set()
+
+        async def drain():
+            while not self._shutdown.is_set():
+                item = await loop.run_in_executor(None,
+                                                  slot.mailbox.get)
+                if item is None:
+                    return
+                await self._execute_actor_item_async(actor_id, slot,
+                                                     item)
+
+        try:
+            loop.run_until_complete(drain())
+        except Exception:
+            pass
+        finally:
+            loop.close()
+
+    async def _execute_actor_item_async(self, actor_id: str,
+                                        slot: _ActorSlot, spec):
+        import asyncio
+        try:
+            if slot.error is not None:
+                raise ActorDiedError(
+                    actor_id, f"__init__ failed: {slot.error!r}")
+            method = getattr(slot.instance, spec["method"])
+            args = [self._resolve(a) for a in spec["args"]]
+            kwargs = {k: self._resolve(v)
+                      for k, v in spec["kwargs"].items()}
+            from ray_tpu._private.runtime_env import runtime_env_context
+            from ray_tpu.util.tracing import execution_span
+            renv = None if self.startup_env_key is not None \
+                else slot.runtime_env
+            with runtime_env_context(renv), \
+                    execution_span(spec.get("name", "actor_task"),
+                                   "actor_task",
+                                   spec.get("trace_ctx")):
+                result = method(*args, **kwargs)
+                if asyncio.iscoroutine(result):
+                    result = await result
+            self._write_returns(spec["return_ids"],
+                                spec["num_returns"], result)
+        except BaseException as e:  # noqa: BLE001
+            if not isinstance(e, (TaskError, ActorDiedError)):
+                e = TaskError(e, task_name=spec.get("name", ""),
+                              remote_traceback=traceback.format_exc())
+            self._write_error(spec["return_ids"], e)
+
     def _actor_loop(self, actor_id: str, slot: _ActorSlot):
+        from ray_tpu._private.log_streaming import set_log_tag
+        set_log_tag(f"actor={actor_id[:12]}")
         while not self._shutdown.is_set():
             item = slot.mailbox.get()
             if item is None:
@@ -498,7 +596,21 @@ def main():
     parser.add_argument("--worker-id", required=True)
     parser.add_argument("--node-id", default="head")
     parser.add_argument("--resources", default='{"CPU": 1}')
+    parser.add_argument("--runtime-env", default=None)
     args = parser.parse_args()
+
+    startup_env = json.loads(args.runtime_env) if args.runtime_env \
+        else None
+    env_key = None
+    if startup_env:
+        # Dedicated env-keyed worker: apply once, forever — the head
+        # routes only matching tasks/actors here, so per-execution
+        # apply/restore is skipped (true process isolation,
+        # worker_pool.h:149 semantics).
+        from ray_tpu._private.runtime_env import (
+            enter_runtime_env_permanently, runtime_env_key)
+        enter_runtime_env_permanently(startup_env)
+        env_key = runtime_env_key(startup_env)
 
     from ray_tpu._private.shm_store import ShmObjectStore
     store = ShmObjectStore.attach(args.store)
@@ -515,6 +627,7 @@ def main():
     from ray_tpu.runtime.object_plane import ObjectPlane
     plane = ObjectPlane(store, head, node_id=args.node_id)
     executor = Executor(args.worker_id, head, plane, resources)
+    executor.startup_env_key = env_key
     server = RpcServer(executor)
 
     # Install the worker-side runtime for nested API usage.
@@ -525,8 +638,43 @@ def main():
     set_global_reference_counter(runtime.ref_counter)
 
     reply = head.call("register_worker", args.worker_id, server.address,
-                      resources, args.node_id)
+                      resources, args.node_id, env_key)
     plane.multinode = bool(reply.get("multinode"))
+    # Capture this worker's stdout/stderr and stream to the driver
+    # (log_to_driver pipeline; the reference's log_monitor analogue).
+    from ray_tpu._private.log_streaming import WorkerLogPublisher
+    WorkerLogPublisher(head, args.worker_id).install()
+
+    def heartbeat_loop():
+        # Worker->head liveness + head-restart re-attach: a False reply
+        # means the head lost us (restart from snapshot or a spurious
+        # death mark) — re-register and re-bind our live actors.
+        while not executor._shutdown.is_set():
+            time.sleep(1.0)
+            try:
+                known = head.call("worker_heartbeat", args.worker_id,
+                                  timeout=5)
+            except Exception:
+                continue        # head down; retry
+            if not known:
+                try:
+                    reply2 = head.call("register_worker",
+                                       args.worker_id, server.address,
+                                       resources, args.node_id,
+                                       env_key)
+                    plane.multinode = bool(reply2.get("multinode"))
+                    with executor._lock:
+                        live = [aid for aid, s in
+                                executor.actors.items()
+                                if s.error is None]
+                    if live:
+                        head.call("report_actors", args.worker_id,
+                                  live)
+                except Exception:
+                    pass
+
+    threading.Thread(target=heartbeat_loop, daemon=True,
+                     name="worker-heartbeat").start()
     # Track node membership by push so the single-node fast path flips
     # the moment a second node joins (and back).
     from ray_tpu.runtime.pubsub import Subscriber

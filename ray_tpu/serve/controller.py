@@ -130,6 +130,34 @@ class Controller:
                 "replicas": list(d["replicas"].items()),
                 "max_ongoing": cfg.max_ongoing_requests}
 
+    def _publish_replicas(self, name: str, d: Dict[str, Any]):
+        """Push the replica table to the head's pub/sub hub so handles
+        learn about scale events without polling (LongPollHost parity,
+        serve/_private/long_poll.py:179). No-op on the local runtime
+        (no head hub) — handles fall back to TTL refresh there."""
+        fp = (d["version"], tuple(sorted(d["replicas"])),
+              d["config"].max_ongoing_requests)
+        if d.get("_published_fp") == fp:
+            return
+        from ray_tpu._private.worker import global_worker
+        head = getattr(global_worker().runtime, "head", None)
+        if head is None:
+            return
+        try:
+            import cloudpickle
+            # Pre-pickled: actor handles must deserialize in SUBSCRIBER
+            # processes (which have runtimes), never in the head.
+            head.call("publish", f"serve:replicas:{name}",
+                      cloudpickle.dumps({
+                          "version": d["version"],
+                          "replicas": list(d["replicas"].items()),
+                          "max_ongoing":
+                              d["config"].max_ongoing_requests,
+                      }))
+            d["_published_fp"] = fp
+        except Exception:
+            pass   # hub unreachable: handles still have TTL fallback
+
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
         return {name: {"num_replicas": len(d["replicas"]),
                        "target": d["target"],
@@ -187,6 +215,7 @@ class Controller:
                         rid, h = next(iter(d["replicas"].items()))
                         del d["replicas"][rid]
                         d["draining"][rid] = (h, time.time())
+                    self._publish_replicas(name, d)
                     await self._drain(d)
                     await self._autoscale(name, d)
             except Exception:  # noqa: BLE001 — keep reconciling

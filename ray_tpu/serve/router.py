@@ -2,9 +2,12 @@
 
 Capability parity with the reference's Router/ReplicaSet
 (serve/_private/router.py:62,221: pick a replica under its in-flight cap,
-power-of-two-choices among non-saturated) and the LongPollClient config push
-(serve/_private/long_poll.py — approximated by TTL-based refresh from the
-controller).
+power-of-two-choices among non-saturated) and the LongPollClient config
+push (serve/_private/long_poll.py:63): on the distributed runtime the
+controller publishes its replica table to the head's pub/sub hub and
+handles SUBSCRIBE — zero polling RPCs in steady state, scale events
+visible push-latency fast. The local (in-process) runtime has no hub;
+handles fall back to TTL refresh there.
 """
 from __future__ import annotations
 
@@ -40,6 +43,10 @@ class DeploymentHandle:
         self._version = -1
         self._fetched_at = 0.0
         self._inflight: Dict[int, int] = {}   # idx -> count
+        self._poll_count = 0        # controller RPCs (regression tests)
+        self._push_active = False
+        self._subscriber = None
+        self._maybe_subscribe()
 
     def __reduce__(self):
         # Handles travel inside replica init args (deployment graphs);
@@ -48,19 +55,51 @@ class DeploymentHandle:
 
     # --- replica set maintenance ------------------------------------------
 
+    def _maybe_subscribe(self):
+        """Long-poll push of the replica table (distributed runtime)."""
+        from ray_tpu._private.worker import global_worker
+        head = getattr(global_worker().runtime, "head", None)
+        if head is None:
+            return
+        try:
+            from ray_tpu.runtime.pubsub import Subscriber
+            from ray_tpu.runtime.rpc import RpcClient
+            sub = Subscriber(RpcClient(f"{head.host}:{head.port}"))
+            sub.subscribe_state(f"serve:replicas:{self._name}",
+                                self._on_push)
+            self._subscriber = sub
+        except Exception:
+            pass       # fall back to TTL polling
+
+    def _on_push(self, version: int, blob):
+        if not blob:
+            return
+        import cloudpickle
+        info = cloudpickle.loads(blob)
+        with self._lock:
+            self._push_active = True
+            self._apply_locked(info)
+            self._fetched_at = time.time()
+
+    def _apply_locked(self, info):
+        if info["version"] != self._version or \
+                len(info["replicas"]) != len(self._replicas):
+            self._replicas = [h for _, h in info["replicas"]]
+            self._inflight = {i: 0 for i in range(len(self._replicas))}
+            self._version = info["version"]
+        self._max_ongoing = info["max_ongoing"]
+
     def _refresh(self, force: bool = False):
         with self._lock:
+            if self._push_active and self._replicas and not force:
+                return      # push keeps us fresh: no polling
             if not force and time.time() - self._fetched_at < _REFRESH_S \
                     and self._replicas:
                 return
+            self._poll_count += 1
             info = ray_tpu.get(
                 self._controller.get_replicas.remote(self._name))
-            if info["version"] != self._version or \
-                    len(info["replicas"]) != len(self._replicas):
-                self._replicas = [h for _, h in info["replicas"]]
-                self._inflight = {i: 0 for i in range(len(self._replicas))}
-                self._version = info["version"]
-            self._max_ongoing = info["max_ongoing"]
+            self._apply_locked(info)
             self._fetched_at = time.time()
 
     def _pick(self) -> Optional[int]:

@@ -1,0 +1,12 @@
+"""Public scheduling strategies (reference:
+python/ray/util/scheduling_strategies.py:15,41). Implementations live
+with the task spec; the head's policy dispatch is
+runtime/head.py _pick_worker_locked."""
+from ray_tpu._private.task_spec import (  # noqa: F401
+    DefaultSchedulingStrategy,
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SchedulingStrategy,
+    SliceAffinitySchedulingStrategy,
+    SpreadSchedulingStrategy,
+)

@@ -1,0 +1,98 @@
+"""Head fault tolerance (VERDICT r2 #6): the head process is killed and
+restarted at the same address from its persisted snapshot; workers
+re-attach via heartbeats, named actors resolve with their in-worker
+state intact, KV survives, and work keeps flowing (reference: GCS
+restart with Redis-persisted tables, gcs/gcs_table_storage.h:261,
+store_client/redis_store_client.h:28)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime import Cluster
+
+
+@pytest.fixture()
+def cluster():
+    import ray_tpu._private.worker as worker_mod
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    c = Cluster(num_workers=2, resources_per_worker={"CPU": 4})
+    yield c
+    c.shutdown()
+
+
+def _retry(fn, timeout=30.0):
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return fn()
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.25)
+
+
+def test_head_restart_recovers_actors_kv_and_tasks(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(name="survivor").remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    assert ray_tpu.get(c.inc.remote()) == 2
+    cluster.runtime.head.call("kv_put", "persist-key",
+                              b"persist-value")
+
+    # Let the debounced snapshot land.
+    time.sleep(0.8)
+
+    # Kill + restart the head at the same address.
+    cluster.node.restart_head()
+
+    # Workers re-attach within ~1 heartbeat; KV restored from snapshot.
+    assert _retry(lambda: cluster.runtime.head.call(
+        "kv_get", "persist-key")) == b"persist-value"
+
+    # The named actor resolves on the restarted head and its IN-WORKER
+    # state survived (the worker process never died).
+    h = _retry(lambda: ray_tpu.get_actor("survivor"))
+    assert _retry(lambda: ray_tpu.get(h.inc.remote(), timeout=30)) == 3
+
+    # New tasks flow through the recovered scheduler.
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert _retry(lambda: ray_tpu.get(add.remote(20, 22),
+                                      timeout=30)) == 42
+
+
+def test_training_style_actor_survives_head_restart(cluster):
+    """An actor mid 'training' (stateful stepping) keeps its progress
+    across a head restart — the gang-keeps-training property at actor
+    granularity (the compute loop lives in worker processes and never
+    depends on head liveness)."""
+    @ray_tpu.remote
+    class Stepper:
+        def __init__(self):
+            self.steps = 0
+
+        def step_many(self, k):
+            for _ in range(k):
+                self.steps += 1
+            return self.steps
+
+    s = Stepper.options(name="trainer").remote()
+    assert ray_tpu.get(s.step_many.remote(5)) == 5
+    time.sleep(0.8)            # snapshot
+    cluster.node.restart_head()
+    h = _retry(lambda: ray_tpu.get_actor("trainer"))
+    # Progress resumes exactly where it was: 5 + 7.
+    assert _retry(lambda: ray_tpu.get(h.step_many.remote(7),
+                                      timeout=30)) == 12
