@@ -77,6 +77,245 @@ def _key_getter(key):
     return key if callable(key) else (lambda r: r[key])
 
 
+# --------------------------------------------------------------------------
+# Shuffle / reorganization task graph (reference shape:
+# python/ray/data/_internal/push_based_shuffle.py — map tasks partition
+# each input block, reduce tasks merge one partition from every map task;
+# no row ever passes through the driver, only O(blocks) metadata does).
+# --------------------------------------------------------------------------
+
+@ray_tpu.remote(num_cpus=0.25)
+def _block_len(block: Block) -> int:
+    return len(block)
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _block_sum(block: Block, key):
+    getter = _key_getter(key)
+    return sum(getter(r) for r in block) if block else 0
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _truncate_block(block: Block, k: int) -> Block:
+    return block[:k]
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _block_unique(block: Block, key) -> List[Any]:
+    getter = _key_getter(key)
+    seen, out = set(), []
+    for row in block:
+        v = getter(row)
+        if v not in seen:
+            seen.add(v)
+            out.append(v)
+    return out
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _block_extreme(block: Block, key, lo: bool):
+    # (has_value, value) — None is a legal extreme value, so an empty
+    # block needs a distinct sentinel.
+    getter = _key_getter(key)
+    vals = [getter(r) for r in block]
+    if not vals:
+        return (False, None)
+    import builtins
+    return (True, builtins.min(vals) if lo else builtins.max(vals))
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _block_np(block: Block, key):
+    if key is not None:
+        return np.asarray([r[key] for r in block])
+    return np.asarray(block)
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _slice_block(block: Block, cuts: List[Tuple[int, int]]):
+    """Map side of a range repartition: slice this block into the
+    per-output-partition row ranges computed from global offsets."""
+    out = tuple(block[s:e] for (s, e) in cuts)
+    return out if len(out) > 1 else out[0]
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _concat_parts(*parts: Block) -> Block:
+    return [row for p in parts for row in p]
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _sample_keys(block: Block, key, k: int) -> List[Any]:
+    getter = _key_getter(key)
+    if not block:
+        return []
+    idx = np.linspace(0, len(block) - 1, num=min(k, len(block)),
+                      dtype=int)
+    return [getter(block[i]) for i in idx]
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _range_partition(block: Block, key, bounds: List[Any]):
+    """Map side of sample-sort: bucket rows by the sampled boundaries.
+    Each bucket is pre-sorted so the reduce side can merge cheaply."""
+    getter = _key_getter(key)
+    n_out = len(bounds) + 1
+    buckets: List[Block] = [[] for _ in range(n_out)]
+    import bisect
+    for row in block:
+        buckets[bisect.bisect_right(bounds, getter(row))].append(row)
+    for b in buckets:
+        b.sort(key=getter)
+    return tuple(buckets) if n_out > 1 else buckets[0]
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _sorted_merge(key, descending: bool, *parts: Block) -> Block:
+    import heapq
+    getter = _key_getter(key)
+    merged = list(heapq.merge(*parts, key=getter))
+    if descending:
+        merged.reverse()
+    return merged
+
+
+def _stable_hash(v: Any) -> int:
+    """Process-independent, type-insensitive hash: Python's ``hash()``
+    is randomized per interpreter for str/bytes (which would route equal
+    keys to different partitions on different distributed workers), and
+    numerically equal keys (1, 1.0, np.int64(1)) must land in the same
+    partition or the reducer emits duplicate groups."""
+    import zlib
+    if isinstance(v, (bool, np.bool_, int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        f = float(v)
+        return int(f) if f.is_integer() \
+            else zlib.crc32(repr(f).encode())
+    if isinstance(v, str):
+        return zlib.crc32(v.encode())
+    if isinstance(v, bytes):
+        return zlib.crc32(v)
+    if isinstance(v, tuple):
+        h = 0
+        for e in v:
+            h = zlib.crc32(repr(_stable_hash(e)).encode(), h)
+        return h
+    return zlib.crc32(repr(v).encode())
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _hash_partition(block: Block, key, n_out: int):
+    getter = _key_getter(key)
+    buckets: List[Block] = [[] for _ in range(n_out)]
+    for row in block:
+        buckets[_stable_hash(getter(row)) % n_out].append(row)
+    return tuple(buckets) if n_out > 1 else buckets[0]
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _group_and_agg(key, agg_fn, *parts: Block) -> Block:
+    """Reduce side of groupby: rows are emitted wrapped with their
+    group key ({"__gkey", "row"}) so the follow-up global sort can
+    order ANY aggregate row type by group key."""
+    getter = _key_getter(key)
+    groups: Dict[Any, List[Any]] = {}
+    for p in parts:
+        for row in p:
+            groups.setdefault(getter(row), []).append(row)
+    return [{"__gkey": k, "row": agg_fn(k, rows)}
+            for k, rows in groups.items()]
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _strip_gkey(block: Block) -> Block:
+    return [r["row"] for r in block]
+
+
+def _gkey_sortable(r) -> Tuple:
+    """Total order over group keys that never raises on mixed types:
+    numbers order numerically in one class; other types order within
+    their type name (cross-type decided by the name)."""
+    k = r["__gkey"]
+    if isinstance(k, (bool, int, float, np.integer, np.floating)):
+        return (0, "", float(k))
+    if isinstance(k, str):
+        return (1, "str", k)
+    return (1, type(k).__name__, repr(k))
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _zip_ranges(n_left: int, *parts: Block) -> Block:
+    """Reduce side of zip: first ``n_left`` parts are row-aligned slices
+    of the left dataset, the rest of the right; concatenating each side
+    in block order reconstructs the same global row range."""
+    a = [row for p in parts[:n_left] for row in p]
+    b = [row for p in parts[n_left:] for row in p]
+    import builtins
+    rows = []
+    for x, y in builtins.zip(a, b):
+        if isinstance(x, dict) and isinstance(y, dict):
+            merged = dict(x)
+            for k, v in y.items():
+                merged[k if k not in merged else f"{k}_1"] = v
+            rows.append(merged)
+        else:
+            rows.append((x, y))
+    return rows
+
+
+def _even_cuts(total: int, n_out: int) -> List[Tuple[int, int]]:
+    """Global [start, end) row ranges for ``n_out`` near-equal output
+    partitions (matches np.array_split sizing)."""
+    sizes = [total // n_out + (1 if i < total % n_out else 0)
+             for i in range(n_out)]
+    cuts, off = [], 0
+    for s in sizes:
+        cuts.append((off, off + s))
+        off += s
+    return cuts
+
+
+def _slice_plan(block_lens: List[int],
+                out_cuts: List[Tuple[int, int]]
+                ) -> List[List[Tuple[int, int]]]:
+    """For each input block, the local (start, end) slice that lands in
+    each output partition (empty slices allowed)."""
+    plans = []
+    off = 0
+    for blen in block_lens:
+        lo, hi = off, off + blen
+        plans.append([(max(s, lo) - lo, max(min(e, hi), lo) - lo)
+                      for (s, e) in out_cuts])
+        off = hi
+    return plans
+
+
+def _fan_out(task, n_out: int, block_refs: List["ray_tpu.ObjectRef"],
+             per_block_args=None, shared_args: Tuple = ()) -> List[List]:
+    """Launch the map side of a shuffle: one ``task`` per input block
+    with ``num_returns=n_out``. Returns, per input block, the list of
+    per-output-partition part refs."""
+    bound = task.options(num_returns=n_out)
+    all_parts = []
+    for i, ref in enumerate(block_refs):
+        args = (per_block_args[i],) if per_block_args is not None \
+            else shared_args
+        parts = bound.remote(ref, *args)
+        all_parts.append([parts] if n_out == 1 else list(parts))
+    return all_parts
+
+
+def _shuffle_slices(block_refs: List["ray_tpu.ObjectRef"],
+                    block_lens: List[int],
+                    out_cuts: List[Tuple[int, int]]) -> List[List]:
+    """Launch the map side: one slice task per input block; returns, per
+    input block, the list of per-output-partition part refs."""
+    plans = _slice_plan(block_lens, out_cuts)
+    return _fan_out(_slice_block, len(out_cuts), block_refs,
+                    per_block_args=plans)
+
+
 class _BatchActor:
     """Actor-pool compute for map_batches (reference:
     _internal/compute.py ActorPoolStrategy)."""
@@ -147,13 +386,15 @@ class Dataset:
     # --- execution --------------------------------------------------------
 
     def materialize(self) -> "Dataset":
+        """Execute pending stages as one task per block. The transformed
+        blocks stay in the object store as the task outputs — they are
+        never pulled into (or re-serialized from) the driver, so
+        downstream shuffle ops keep their no-driver-rows guarantee even
+        with lazy stages pending. Stage errors surface at first get."""
         if not self._stages:
             return self
-        refs = [_apply_stages.remote(b, self._stages)
-                for b in self._block_refs]
-        # Resolve now so errors surface here.
-        blocks = ray_tpu.get(refs)
-        return Dataset([ray_tpu.put(b) for b in blocks])
+        return Dataset([_apply_stages.remote(b, self._stages)
+                        for b in self._block_refs])
 
     def _resolved_blocks(self) -> List[Block]:
         ds = self.materialize()
@@ -173,11 +414,7 @@ class Dataset:
 
     def count(self) -> int:
         ds = self.materialize()
-
-        @ray_tpu.remote(num_cpus=0.25)
-        def _len(b):
-            return len(b)
-        return sum(ray_tpu.get([_len.remote(r)
+        return sum(ray_tpu.get([_block_len.remote(r)
                                 for r in ds._block_refs]))
 
     def num_blocks(self) -> int:
@@ -188,23 +425,36 @@ class Dataset:
             print(row)
 
     def sum(self, key: Optional[Union[str, Callable]] = None):
-        rows = self.take_all()
-        if key is None:
-            return sum(rows)
-        getter = key if callable(key) else (lambda r: r[key])
-        return sum(getter(r) for r in rows)
+        """Per-block partial sums as remote tasks; only the scalar
+        partials return to the driver."""
+        ds = self.materialize()
+        partials = ray_tpu.get([_block_sum.remote(b, key)
+                                for b in ds._block_refs])
+        return sum(partials)
 
     def mean(self, key: Optional[Union[str, Callable]] = None):
         n = self.count()
         return self.sum(key) / n if n else float("nan")
 
     # --- reorganization ---------------------------------------------------
+    # All reorganization ops below run as two-stage task graphs (map:
+    # slice/partition each block, reduce: merge one partition from every
+    # block). The driver only ever sees O(blocks) ints of metadata —
+    # never rows — so datasets larger than driver RAM reorganize fine.
+
+    def _block_lengths(self) -> Tuple["Dataset", List[int]]:
+        ds = self.materialize()
+        lens = ray_tpu.get([_block_len.remote(b)
+                            for b in ds._block_refs])
+        return ds, lens
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        rows = self.take_all()
-        splits = np.array_split(np.arange(len(rows)), num_blocks)
-        blocks = [[rows[i] for i in idx] for idx in splits]
-        return Dataset([ray_tpu.put(b) for b in blocks])
+        ds, lens = self._block_lengths()
+        cuts = _even_cuts(sum(lens), num_blocks)
+        all_parts = _shuffle_slices(ds._block_refs, lens, cuts)
+        merged = [_concat_parts.remote(*[parts[j] for parts in all_parts])
+                  for j in range(num_blocks)]
+        return Dataset(merged)
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
         """Two-stage all-to-all shuffle (reference:
@@ -240,24 +490,44 @@ class Dataset:
 
     def sort(self, key: Optional[Union[str, Callable]] = None,
              descending: bool = False) -> "Dataset":
-        rows = self.take_all()
-        getter = (key if callable(key)
-                  else (lambda r: r[key]) if key else (lambda r: r))
-        rows.sort(key=getter, reverse=descending)
-        n = max(1, self.num_blocks())
-        splits = np.array_split(np.arange(len(rows)), n)
-        return Dataset([ray_tpu.put([rows[i] for i in idx])
-                        for idx in splits])
+        """Distributed sample-sort: sample boundary keys from each block,
+        range-partition every block by those boundaries (map tasks, each
+        bucket pre-sorted), then k-way merge each range (reduce tasks).
+        Only the boundary samples pass through the driver."""
+        ds = self.materialize()
+        n = max(1, len(ds._block_refs))
+        samples: List[Any] = []
+        for s in ray_tpu.get([_sample_keys.remote(b, key, 4 * n)
+                              for b in ds._block_refs]):
+            samples.extend(s)
+        samples.sort()
+        if samples and n > 1:
+            idx = [len(samples) * (j + 1) // n for j in range(n - 1)]
+            bounds = [samples[min(i, len(samples) - 1)] for i in idx]
+        else:
+            bounds = []
+        n_out = len(bounds) + 1
+        all_parts = _fan_out(_range_partition, n_out, ds._block_refs,
+                             shared_args=(key, bounds))
+        order = range(n_out - 1, -1, -1) if descending else range(n_out)
+        merged = [_sorted_merge.remote(
+                      key, descending,
+                      *[parts[j] for parts in all_parts])
+                  for j in order]
+        return Dataset(merged)
 
     def groupby(self, key: Union[str, Callable]) -> "GroupedDataset":
         return GroupedDataset(self, key)
 
     def split(self, n: int) -> List["Dataset"]:
-        """Per-worker shards (equal row counts ±1)."""
-        rows = self.take_all()
-        splits = np.array_split(np.arange(len(rows)), n)
-        return [Dataset([ray_tpu.put([rows[i] for i in idx])])
-                for idx in splits]
+        """Per-worker shards (equal row counts ±1), built with the same
+        map/reduce slice graph as repartition — no driver materialize."""
+        ds, lens = self._block_lengths()
+        cuts = _even_cuts(sum(lens), n)
+        all_parts = _shuffle_slices(ds._block_refs, lens, cuts)
+        return [Dataset([_concat_parts.remote(
+                    *[parts[j] for parts in all_parts])])
+                for j in range(n)]
 
     def window(self, *, blocks_per_window: int = 2):
         """Streaming windows (reference: Dataset.window ->
@@ -278,50 +548,83 @@ class Dataset:
         ).repeat(times)
 
     def zip(self, other: "Dataset") -> "Dataset":
-        """Row-wise zip (reference: Dataset.zip)."""
-        a = self.take_all()
-        b = other.take_all()
-        if len(a) != len(b):
+        """Row-wise zip as a task graph: both sides are sliced to the
+        same global row ranges (map), each range zipped remotely
+        (reduce). Rows never visit the driver."""
+        a, a_lens = self._block_lengths()
+        b, b_lens = other._block_lengths()
+        if sum(a_lens) != sum(b_lens):
             raise ValueError(
-                f"zip() requires equal lengths, got {len(a)} vs {len(b)}")
-        import builtins
-        rows = []
-        for x, y in builtins.zip(a, b):
-            if isinstance(x, dict) and isinstance(y, dict):
-                merged = dict(x)
-                for k, v in y.items():
-                    merged[k if k not in merged else f"{k}_1"] = v
-                rows.append(merged)
-            else:
-                rows.append((x, y))
-        from ray_tpu.data.dataset import from_items
-        return from_items(rows, max(1, self.num_blocks()))
+                f"zip() requires equal lengths, "
+                f"got {sum(a_lens)} vs {sum(b_lens)}")
+        n_out = max(1, self.num_blocks())
+        cuts = _even_cuts(sum(a_lens), n_out)
+        a_parts = _shuffle_slices(a._block_refs, a_lens, cuts)
+        b_parts = _shuffle_slices(b._block_refs, b_lens, cuts)
+        out = []
+        for j in range(n_out):
+            left = [parts[j] for parts in a_parts]
+            right = [parts[j] for parts in b_parts]
+            out.append(_zip_ranges.remote(len(left), *left, *right))
+        return Dataset(out)
 
     def limit(self, n: int) -> "Dataset":
-        from ray_tpu.data.dataset import from_items
-        return from_items(self.take(n), max(1, self.num_blocks()))
+        """Keep the first ``n`` rows by truncating blocks remotely —
+        lengths are fetched incrementally and blocks beyond the cutoff
+        are never touched."""
+        ds = self.materialize()
+        out, remaining = [], n
+        refs = ds._block_refs
+        chunk = 64          # batch length fetches; stop at the cutoff
+        for i in range(0, len(refs), chunk):
+            if remaining <= 0:
+                break
+            batch = refs[i:i + chunk]
+            lens = ray_tpu.get([_block_len.remote(r) for r in batch])
+            for ref, blen in zip(batch, lens):
+                if remaining <= 0:
+                    break
+                if blen <= remaining:
+                    out.append(ref)
+                    remaining -= blen
+                else:
+                    out.append(_truncate_block.remote(ref, remaining))
+                    remaining = 0
+        return Dataset(out or [ray_tpu.put([])])
 
     def unique(self, key: Optional[Union[str, Callable]] = None
                ) -> List[Any]:
-        getter = _key_getter(key)
-        seen = []
-        seen_set = set()
-        for row in self.iter_rows():
-            v = getter(row)
-            if v not in seen_set:
-                seen_set.add(v)
-                seen.append(v)
-        return seen
+        """Per-block remote dedup, then a first-seen-order merge of the
+        (already-deduped) partials in the driver."""
+        ds = self.materialize()
+        seen: set = set()
+        merged: List[Any] = []
+        for part in ray_tpu.get([_block_unique.remote(b, key)
+                                 for b in ds._block_refs]):
+            for v in part:
+                if v not in seen:
+                    seen.add(v)
+                    merged.append(v)
+        return merged
+
+    def _extreme(self, key, reducer):
+        import builtins
+        ds = self.materialize()
+        lo = reducer is builtins.min
+        parts = [v for has, v in ray_tpu.get(
+                     [_block_extreme.remote(b, key, lo)
+                      for b in ds._block_refs]) if has]
+        if not parts:
+            raise ValueError("min()/max() of an empty dataset")
+        return reducer(parts)
 
     def min(self, key: Optional[Union[str, Callable]] = None):
         import builtins
-        getter = _key_getter(key)
-        return builtins.min(getter(r) for r in self.iter_rows())
+        return self._extreme(key, builtins.min)
 
     def max(self, key: Optional[Union[str, Callable]] = None):
         import builtins
-        getter = _key_getter(key)
-        return builtins.max(getter(r) for r in self.iter_rows())
+        return self._extreme(key, builtins.max)
 
     def to_pandas(self):
         from ray_tpu.data.datasources import to_pandas
@@ -378,10 +681,15 @@ class Dataset:
                 yield jax.device_put(np.asarray(batch), sharding)
 
     def to_numpy(self, key: Optional[str] = None) -> np.ndarray:
-        rows = self.take_all()
-        if key is not None:
-            return np.asarray([r[key] for r in rows])
-        return np.asarray(rows)
+        """Per-block remote conversion, concatenated on the driver (the
+        result is a driver-resident ndarray by definition)."""
+        ds = self.materialize()
+        parts = [p for p in ray_tpu.get([_block_np.remote(b, key)
+                                         for b in ds._block_refs])
+                 if len(p)]
+        if not parts:
+            return np.asarray([])
+        return np.concatenate(parts, axis=0)
 
     def __repr__(self):
         return (f"Dataset(num_blocks={self.num_blocks()}, "
@@ -389,27 +697,37 @@ class Dataset:
 
 
 class GroupedDataset:
-    """Hash-partitioned groupby (reference: data/grouped_dataset.py)."""
+    """Hash-partitioned groupby (reference: data/grouped_dataset.py via
+    _internal/push_based_shuffle.py): map tasks hash-partition each
+    block by key, one reduce task per partition groups its rows and
+    applies the aggregation. Rows never pass through the driver; the
+    aggregated result is sorted by key with the distributed sort."""
 
     def __init__(self, ds: Dataset, key: Union[str, Callable]):
         self._ds = ds
-        self._key = key if callable(key) else (lambda r, k=key: r[k])
-
-    def _groups(self) -> Dict[Any, List[Any]]:
-        groups: Dict[Any, List[Any]] = {}
-        for row in self._ds.iter_rows():
-            groups.setdefault(self._key(row), []).append(row)
-        return groups
-
-    def count(self) -> Dataset:
-        items = [{"key": k, "count": len(v)}
-                 for k, v in sorted(self._groups().items())]
-        return from_items(items)
+        self._key = key
 
     def aggregate(self, agg_fn: Callable[[Any, List[Any]], Any]
                   ) -> Dataset:
-        items = [agg_fn(k, v) for k, v in sorted(self._groups().items())]
-        return from_items(items)
+        """Aggregated rows come back globally sorted by group key (any
+        row type: the shuffle carries the key alongside each row, and
+        the sort key is type-tagged so even mixed-type keys order
+        deterministically instead of raising inside remote tasks)."""
+        ds = self._ds.materialize()
+        n_out = max(1, len(ds._block_refs))
+        all_parts = _fan_out(_hash_partition, n_out, ds._block_refs,
+                             shared_args=(self._key, n_out))
+        agg_blocks = [_group_and_agg.remote(
+                          self._key, agg_fn,
+                          *[parts[j] for parts in all_parts])
+                      for j in range(n_out)]
+        keyed = Dataset(agg_blocks).sort(_gkey_sortable)
+        return Dataset([_strip_gkey.remote(b)
+                        for b in keyed._block_refs])
+
+    def count(self) -> Dataset:
+        return self.aggregate(
+            lambda k, rows: {"key": k, "count": len(rows)})
 
     def sum(self, value_key: Union[str, Callable]) -> Dataset:
         getter = value_key if callable(value_key) else \

@@ -1,0 +1,189 @@
+"""Distributed Data reorganization: repartition/sort/groupby/split/zip
+run as task graphs only — no row ever materializes in the driver
+(reference shape: python/ray/data/_internal/push_based_shuffle.py).
+"""
+import contextlib
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.data import from_items, range_dataset
+from ray_tpu.data.dataset import Dataset
+
+
+@contextlib.contextmanager
+def no_driver_rows():
+    """Ban driver-side row materialization: both take_all and
+    re-putting rows from the driver (the old materialize() get+put
+    pattern) explode while a reorganization op runs."""
+    def _boom(self):
+        raise AssertionError(
+            "take_all() called during a reorganization op")
+
+    real_take_all = Dataset.take_all
+    real_put = ray_tpu.put
+
+    def _no_put(obj, **kw):
+        raise AssertionError(
+            "driver-side put() during a reorganization op")
+    Dataset.take_all = _boom
+    ray_tpu.put = _no_put
+    try:
+        yield
+    finally:
+        Dataset.take_all = real_take_all
+        ray_tpu.put = real_put
+
+
+def _rows(ds):
+    # consumption (allowed to materialize) without going through take_all
+    out = []
+    for ref in ds.materialize()._block_refs:
+        out.extend(ray_tpu.get(ref))
+    return out
+
+
+def test_repartition_no_driver_rows(rt):
+    src = range_dataset(100, parallelism=7)
+    with no_driver_rows():
+        ds = src.repartition(4)
+        assert ds.num_blocks() == 4
+        rows = _rows(ds)
+    assert rows == list(range(100))            # order preserved
+    lens = [len(ray_tpu.get(b)) for b in ds._block_refs]
+    assert max(lens) - min(lens) <= 1
+
+
+def test_sort_multiblock_no_driver_rows(rt):
+    rng = np.random.RandomState(0)
+    vals = [int(v) for v in rng.randint(0, 10_000, size=500)]
+    src = from_items(vals, parallelism=8)
+    with no_driver_rows():
+        rows = _rows(src.sort())
+    assert rows == sorted(vals)
+
+
+def test_sort_descending_by_key(rt):
+    src = from_items([{"k": i % 17, "v": i} for i in range(200)],
+                     parallelism=6)
+    with no_driver_rows():
+        keys = [r["k"] for r in _rows(src.sort("k", descending=True))]
+    assert keys == sorted(keys, reverse=True)
+
+
+def test_groupby_shuffle_no_driver_rows(rt):
+    src = from_items([{"g": i % 5, "v": i} for i in range(100)],
+                     parallelism=8)
+    with no_driver_rows():
+        rows = _rows(src.groupby("g").sum("v"))
+    assert {r["key"]: r["sum"] for r in rows} == {
+        g: sum(i for i in range(100) if i % 5 == g) for g in range(5)}
+    assert [r["key"] for r in rows] == sorted(r["key"] for r in rows)
+
+
+def test_groupby_count_sorted(rt):
+    src = from_items([chr(ord("a") + (i % 3)) for i in range(30)],
+                     parallelism=4)
+    with no_driver_rows():
+        rows = _rows(src.groupby(lambda r: r).count())
+    assert rows == [{"key": "a", "count": 10},
+                    {"key": "b", "count": 10},
+                    {"key": "c", "count": 10}]
+
+
+def test_split_no_driver_rows(rt):
+    src = range_dataset(103, parallelism=5)
+    with no_driver_rows():
+        shards = src.split(4)
+        assert len(shards) == 4
+        all_rows = [r for s in shards for r in _rows(s)]
+        sizes = [len(_rows(s)) for s in shards]
+    assert all_rows == list(range(103))
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_zip_no_driver_rows(rt):
+    a = range_dataset(60, parallelism=4)
+    b = from_items([i * 10 for i in range(60)], parallelism=7)
+    with no_driver_rows():
+        rows = _rows(a.zip(b))
+    assert rows == [(i, i * 10) for i in range(60)]
+
+
+def test_zip_unequal_raises(rt):
+    with pytest.raises(ValueError):
+        range_dataset(10).zip(range_dataset(11))
+
+
+def test_sum_mean_min_max_remote(rt):
+    src = from_items([{"v": i} for i in range(50)], parallelism=6)
+    with no_driver_rows():
+        assert src.sum("v") == sum(range(50))
+        assert src.mean("v") == pytest.approx(24.5)
+        assert src.min("v") == 0
+        assert src.max("v") == 49
+
+
+def test_limit_truncates_remotely(rt):
+    src = range_dataset(100, parallelism=10)
+    with no_driver_rows():
+        ds = src.limit(37)
+        rows = _rows(ds)
+    assert rows == list(range(37))
+    # whole blocks past the cutoff were dropped, not copied
+    assert ds.num_blocks() <= 4
+
+
+def test_unique_remote(rt):
+    src = from_items([i % 7 for i in range(70)], parallelism=5)
+    with no_driver_rows():
+        uniq = src.unique()
+    assert sorted(uniq) == list(range(7))
+
+
+def test_lazy_stages_stay_in_store(rt):
+    # pending map stages must execute as tasks whose outputs stay in
+    # the object store — not get+put through the driver
+    src = (range_dataset(120, parallelism=6)
+           .map(lambda x: x * 2)
+           .filter(lambda x: x % 4 == 0))
+    with no_driver_rows():
+        rows = _rows(src.repartition(3))
+    assert rows == [x * 2 for x in range(120) if (x * 2) % 4 == 0]
+
+
+def test_groupby_string_keys_stable_hash(rt):
+    # str keys exercise _stable_hash (process-randomized hash() would
+    # split a key across partitions on distributed workers)
+    src = from_items([{"g": f"key-{i % 4}"} for i in range(80)],
+                     parallelism=8)
+    with no_driver_rows():
+        rows = _rows(src.groupby("g").count())
+    assert {r["key"]: r["count"] for r in rows} == {
+        f"key-{i}": 20 for i in range(4)}
+
+
+def test_aggregate_non_dict_rows_no_silent_loss(rt):
+    # agg rows without a "key" column: result arrives unsorted but
+    # complete, and no error escapes
+    src = from_items([{"g": i % 3} for i in range(30)], parallelism=4)
+    with no_driver_rows():
+        rows = _rows(src.groupby("g").aggregate(
+            lambda k, rs: (k, len(rs))))
+    assert sorted(rows) == [(0, 10), (1, 10), (2, 10)]
+
+
+def test_min_handles_none_values(rt):
+    ds = from_items([{"v": None}], parallelism=1)
+    assert ds.min("v") is None
+
+
+def test_aggregate_larger_than_any_block(rt):
+    # aggregate data (1000 rows) far exceeds any single block (~84 rows)
+    src = from_items([{"g": i % 3, "v": 1} for i in range(1000)],
+                     parallelism=12)
+    with no_driver_rows():
+        rows = _rows(src.groupby("g").count())
+    assert {r["key"]: r["count"] for r in rows} == {
+        0: 334, 1: 333, 2: 333}
