@@ -236,7 +236,29 @@ def init_kv_caches(cfg: LlamaConfig, batch: int, max_len: int):
         for _ in range(cfg.n_layers)]
 
 
-_DECODE_CACHE: dict = {}
+_CACHE_CAP = 32       # compiled decode variants kept per process
+
+
+def _cache_get(cache: "collections.OrderedDict", key):
+    """Bounded LRU for compiled decode closures: long-lived serving
+    replicas see many (batch, prompt-length) shapes; unbounded caching
+    would pin every jit executable + model closure forever."""
+    hit = cache.get(key)
+    if hit is not None:
+        cache.move_to_end(key)
+    return hit
+
+
+def _cache_put(cache: "collections.OrderedDict", key, value):
+    cache[key] = value
+    cache.move_to_end(key)
+    while len(cache) > _CACHE_CAP:
+        cache.popitem(last=False)
+
+
+import collections
+
+_DECODE_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 
 
 def generate(model: Llama, params, prompt_ids: jnp.ndarray,
@@ -255,7 +277,7 @@ def generate(model: Llama, params, prompt_ids: jnp.ndarray,
     if rng is None:
         rng = jax.random.PRNGKey(0)
     cache_key = (cfg, B, T0, max_new_tokens, temperature, eos_id)
-    cached = _DECODE_CACHE.get(cache_key)
+    cached = _cache_get(_DECODE_CACHE, cache_key)
     if cached is not None:
         return cached(params, prompt_ids, rng)
 
@@ -267,13 +289,7 @@ def generate(model: Llama, params, prompt_ids: jnp.ndarray,
         tokens = jnp.zeros((B, total), jnp.int32)
         tokens = jax.lax.dynamic_update_slice(tokens, prompt_ids, (0, 0))
 
-        def pick(logits_last, key):
-            if temperature <= 0.0:
-                return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
-            return jax.random.categorical(
-                key, logits_last / temperature, axis=-1).astype(jnp.int32)
-
-        first = pick(logits[:, -1], rng)
+        first = _pick_token(logits[:, -1], rng, temperature)
         tokens = jax.lax.dynamic_update_slice(
             tokens, first[:, None], (0, T0))
 
@@ -288,7 +304,7 @@ def generate(model: Llama, params, prompt_ids: jnp.ndarray,
                                         (B, 1))
             logits, caches = model.apply(
                 params, cur, kv_caches=caches, cache_len=T0 + i - 1)
-            nxt = pick(logits[:, -1], sub)
+            nxt = _pick_token(logits[:, -1], sub, temperature)
             tokens = jax.lax.dynamic_update_slice(
                 tokens, nxt[:, None], (0, T0 + i))
             if eos_id is not None:
@@ -304,8 +320,105 @@ def generate(model: Llama, params, prompt_ids: jnp.ndarray,
         _, tokens, _, _, _ = jax.lax.while_loop(cond, body, state)
         return tokens
 
-    _DECODE_CACHE[cache_key] = _decode
+    _cache_put(_DECODE_CACHE, cache_key, _decode)
     return _decode(params, prompt_ids, rng)
+
+
+_STREAM_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+
+
+def generate_stream(model: Llama, params, prompt_ids: jnp.ndarray,
+                    max_new_tokens: int, temperature: float = 0.0,
+                    rng: Optional[jax.Array] = None,
+                    eos_id: Optional[int] = None,
+                    chunk_size: int = 8):
+    """Incremental decode for streaming serving: a jitted prefill plus
+    a jitted lax.scan of ``chunk_size`` single-token steps. Yields each
+    batch-row's next token as a numpy int32 array of shape [B], in
+    bursts of up to ``chunk_size``.
+
+    Why chunked: a host readback pays the runtime's completion-
+    notification latency (tens of ms on tunneled devices) REGARDLESS
+    of compute size, so syncing per token caps streaming at ~1/latency
+    tokens/s. One scan dispatch + one [K, B] readback amortizes that
+    latency over K tokens while keeping time-to-first-token at one
+    prefill + one sync. The whole-sequence `generate` (on-device
+    while_loop) remains the fastest path for full completions.
+    (Reference capability: serve streaming responses,
+    python/ray/serve/api.py streaming + _private/http_util.py chunked
+    responses.)"""
+    cfg = model.config
+    B, T0 = prompt_ids.shape
+    K = max(1, min(chunk_size, max_new_tokens))
+    n_chunks = (max_new_tokens - 1 + K - 1) // K
+    total = T0 + 1 + n_chunks * K    # cache covers whole-K chunks
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    key = (cfg, B, T0, K, n_chunks, temperature)
+    cached = _cache_get(_STREAM_CACHE, key)
+    if cached is None:
+        @jax.jit
+        def _prefill(params, prompt_ids, rng):
+            caches = init_kv_caches(cfg, B, total)
+            logits, caches = model.apply(params, prompt_ids,
+                                         kv_caches=caches, cache_len=0)
+            first = _pick_token(logits[:, -1], rng, temperature)
+            return first, caches
+
+        @jax.jit
+        def _chunk(params, cur, caches, cache_len, rng):
+            def body(carry, i):
+                cur, caches, key = carry
+                key, sub = jax.random.split(key)
+                logits, caches = model.apply(
+                    params, cur[:, None], kv_caches=caches,
+                    cache_len=cache_len + i)
+                nxt = _pick_token(logits[:, -1], sub, temperature)
+                return (nxt, caches, key), nxt
+            (cur, caches, rng), toks = jax.lax.scan(
+                body, (cur, caches, rng), jnp.arange(K))
+            return toks, cur, caches      # toks: [K, B]
+
+        cached = (_prefill, _chunk)
+        _cache_put(_STREAM_CACHE, key, cached)
+    _prefill, _chunk = cached
+
+    rng, sub = jax.random.split(rng)
+    tok, caches = _prefill(params, prompt_ids, sub)
+    first = np.asarray(tok)
+    done = np.zeros((B,), bool)
+    if eos_id is not None:
+        done |= (first == eos_id)
+    yield first
+    emitted = 1
+    for c in range(n_chunks):
+        if emitted >= max_new_tokens or \
+                (eos_id is not None and done.all()):
+            return
+        rng, sub = jax.random.split(rng)
+        # the chunk's first step consumes the last emitted token, which
+        # sits at position T0 + emitted - 1
+        toks, tok, caches = _chunk(params, tok, caches,
+                                   jnp.int32(T0 + emitted - 1), sub)
+        out = np.asarray(toks)           # ONE sync per K tokens
+        for j in range(out.shape[0]):
+            if emitted >= max_new_tokens:
+                return
+            row = out[j]
+            if eos_id is not None:
+                done |= (row == eos_id)
+            yield row
+            emitted += 1
+            if eos_id is not None and done.all():
+                return
+
+
+def _pick_token(logits_last, key, temperature: float):
+    if temperature <= 0.0:
+        return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits_last / temperature, axis=-1).astype(jnp.int32)
 
 
 def llama_sharding_rules(fsdp: bool = True) -> ShardingRules:

@@ -4,7 +4,8 @@ from ray_tpu.serve.api import (delete, deployment, run, shutdown,
 from ray_tpu.serve.drivers import DAGDriver
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.router import StreamingResponse
 
 __all__ = ["deployment", "run", "shutdown", "get_deployment", "get_handle",
            "list_deployments", "status", "delete", "DAGDriver", "batch",
-           "AutoscalingConfig", "DeploymentConfig"]
+           "AutoscalingConfig", "DeploymentConfig", "StreamingResponse"]

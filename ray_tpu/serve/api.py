@@ -14,7 +14,8 @@ import ray_tpu
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.controller import (CONTROLLER_NAME, Controller,
                                       get_or_create_controller)
-from ray_tpu.serve.router import DeploymentHandle
+from ray_tpu.serve.router import (DeploymentHandle, clear_handle_cache,
+                                  get_or_create_handle)
 
 
 class Deployment:
@@ -107,7 +108,7 @@ def _deploy_one(dep: Deployment, controller, deployed: set,
         return v
 
     if dep.name in deployed:
-        return DeploymentHandle(dep.name, controller)
+        return get_or_create_handle(dep.name)
     deployed.add(dep.name)
     init_args = tuple(resolve(a) for a in dep._init_args)
     init_kwargs = {k: resolve(v) for k, v in dep._init_kwargs.items()}
@@ -120,7 +121,7 @@ def _deploy_one(dep: Deployment, controller, deployed: set,
                 raise TimeoutError(
                     f"Deployment {dep.name!r} not ready in {timeout_s}s")
             time.sleep(0.02)
-    return DeploymentHandle(dep.name, controller)
+    return get_or_create_handle(dep.name)
 
 
 def run(dep: Deployment, *, wait_for_ready: bool = True,
@@ -136,7 +137,7 @@ def run(dep: Deployment, *, wait_for_ready: bool = True,
 
 
 def get_handle(name: str) -> DeploymentHandle:
-    return DeploymentHandle(name, get_or_create_controller())
+    return get_or_create_handle(name)
 
 
 def get_deployment(name: str) -> Dict[str, Any]:
@@ -174,6 +175,7 @@ def delete(name: str):
 
 
 def shutdown():
+    clear_handle_cache()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:

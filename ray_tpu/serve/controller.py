@@ -40,6 +40,147 @@ class Replica:
                 self.instance.setup_mesh(self.mesh)
         self._ongoing = 0
         self._total = 0
+        self._streams: Dict[str, Dict[str, Any]] = {}
+
+    def _target_fn(self, method_name: str):
+        target = self.instance
+        if method_name == "__call__":
+            return target
+        return getattr(target, method_name)
+
+    async def handle_request_streaming(self, req_id: str,
+                                       method_name: str, args, kwargs):
+        """Start a streaming request (reference: serve replica
+        streaming responses, serve/_private/replica.py + http_util
+        chunked encoding). The user method may return a generator /
+        async generator (each item is a chunk) or a plain value (one
+        chunk). Chunks buffer here; the caller drains them with
+        next_chunks long-polls."""
+        import inspect
+
+        loop = asyncio.get_event_loop()
+        fn = self._target_fn(method_name)   # raises BEFORE any state
+        self._reap_abandoned_streams()
+        st = {"chunks": [], "done": False, "error": None,
+              "event": asyncio.Event(), "last_poll": time.time(),
+              "abandoned": False}
+        self._streams[req_id] = st
+        self._ongoing += 1
+        self._total += 1
+
+        def _notify():
+            loop.call_soon_threadsafe(st["event"].set)
+
+        def _finish(error=None):
+            if error is not None:
+                st["error"] = error
+            st["done"] = True
+            self._ongoing -= 1
+            _notify()
+
+        # For __call__ the target IS the instance; inspect its bound
+        # __call__ (the instance itself is never a genfunction).
+        probe = getattr(fn, "__call__", fn) if not inspect.isfunction(
+            fn) and not inspect.ismethod(fn) else fn
+        unwrapped = getattr(probe, "__func__", probe)
+        if inspect.isasyncgenfunction(unwrapped):
+            async def _drain_async():
+                try:
+                    async for chunk in fn(*args, **kwargs):
+                        if st["abandoned"]:
+                            break       # consumer gone: stop buffering
+                        st["chunks"].append(chunk)
+                        st["event"].set()
+                except Exception as e:       # noqa: BLE001
+                    st["error"] = e
+                finally:
+                    st["done"] = True
+                    self._ongoing -= 1
+                    st["event"].set()
+            asyncio.ensure_future(_drain_async())
+            return True
+
+        def _drain_sync():
+            # Runs in the thread executor: generators from sync user
+            # code iterate here so slow token production never blocks
+            # the replica's event loop.
+            try:
+                result = fn(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    # plain `async def` method: await it on the loop,
+                    # stream its return value as the single chunk
+                    result = asyncio.run_coroutine_threadsafe(
+                        result, loop).result()
+                if inspect.isasyncgen(result):
+                    async def _adrain():
+                        async for c in result:
+                            if st["abandoned"]:
+                                break
+                            st["chunks"].append(c)
+                            st["event"].set()
+                    asyncio.run_coroutine_threadsafe(
+                        _adrain(), loop).result()
+                elif inspect.isgenerator(result) or (
+                        hasattr(result, "__iter__") and
+                        not isinstance(result, (str, bytes, dict,
+                                                list, tuple))):
+                    for chunk in result:
+                        if st["abandoned"]:
+                            break       # consumer gone: stop buffering
+                        st["chunks"].append(chunk)
+                        _notify()
+                else:
+                    st["chunks"].append(result)
+            except Exception as e:           # noqa: BLE001
+                _finish(e)
+                return
+            _finish()
+
+        loop.run_in_executor(None, _drain_sync)
+        return True
+
+    _STREAM_ABANDON_S = 120.0     # no poll for this long => abandoned
+
+    def _reap_abandoned_streams(self):
+        """Drop stream records whose consumer stopped polling (client
+        disconnect / driver crash): the producer loop sees `abandoned`
+        and stops buffering, bounding replica memory."""
+        now = time.time()
+        for rid in list(self._streams):
+            st = self._streams[rid]
+            # done-but-undrained records leak just the same as live
+            # ones whose consumer vanished: both go by poll age.
+            if now - st["last_poll"] > self._STREAM_ABANDON_S:
+                st["abandoned"] = True
+                st["chunks"].clear()
+                self._streams.pop(rid, None)
+
+    async def next_chunks(self, req_id: str, start: int,
+                          timeout: float = 10.0):
+        """Long-poll for chunks past ``start``; returns
+        {chunks, done, error}. The stream record is dropped once the
+        consumer has seen everything."""
+        st = self._streams.get(req_id)
+        if st is None:
+            raise KeyError(f"unknown stream {req_id!r}")
+        st["last_poll"] = time.time()
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while len(st["chunks"]) <= start and not st["done"]:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            try:
+                await asyncio.wait_for(st["event"].wait(), remaining)
+            except asyncio.TimeoutError:
+                break
+            st["event"].clear()
+        chunks = st["chunks"][start:]
+        done = st["done"] and (start + len(chunks)) == len(st["chunks"])
+        err = st["error"] if done else None
+        if done:
+            self._streams.pop(req_id, None)
+        return {"chunks": chunks, "done": done, "error": err}
 
     async def handle_request(self, method_name: str, args, kwargs):
         self._ongoing += 1
@@ -69,6 +210,7 @@ class Replica:
             self._ongoing -= 1
 
     def stats(self):
+        self._reap_abandoned_streams()
         return {"replica_id": self.replica_id,
                 "ongoing": self._ongoing,
                 "total": self._total}

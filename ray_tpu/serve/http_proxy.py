@@ -26,6 +26,12 @@ class HTTPProxy:
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
+        # Dedicated pool for blocking handle calls: streaming long-polls
+        # park a thread per in-flight chunk wait, which would starve the
+        # loop's small default executor (and /healthz with it).
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(
+            max_workers=128, thread_name_prefix="serve-proxy")
 
     def _handle_for(self, name: str):
         h = self._handles.get(name)
@@ -51,15 +57,67 @@ class HTTPProxy:
                     {"error": "body must be JSON"}, status=400)
         else:
             payload = dict(request.query) or None
+        # Streaming is transport metadata: opt in via the query string
+        # ONLY (?stream=1). POST bodies are never inspected or
+        # modified — a deployment may legitimately take a "stream" key.
+        stream = request.query.get("stream") in ("1", "true")
+        if stream and request.method != "POST":
+            payload.pop("stream", None)     # strip it from query args
+            payload = payload or None
         try:
+            if stream:
+                return await self._dispatch_stream(request, handle,
+                                                   payload)
             ref = handle.remote(payload) if payload is not None \
                 else handle.remote()
             loop = asyncio.get_event_loop()
             result = await loop.run_in_executor(
-                None, lambda: ray_tpu.get(ref, timeout=60))
+                self._pool, lambda: ray_tpu.get(ref, timeout=60))
             return web.json_response({"result": result})
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=500)
+
+    async def _dispatch_stream(self, request, handle, payload):
+        """Chunked-transfer streaming: each chunk from the deployment's
+        generator is one newline-delimited JSON line (reference:
+        serve/_private/http_util.py streaming responses)."""
+        from aiohttp import web
+        loop = asyncio.get_event_loop()
+        method = handle.options(stream=True)
+        sr = await loop.run_in_executor(
+            self._pool, lambda: method.remote(payload)
+            if payload is not None else method.remote())
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"})
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        it = iter(sr)
+
+        def _next():
+            try:
+                return True, next(it)
+            except StopIteration:
+                return False, None
+        # Once prepare() has committed chunked encoding we can never
+        # return a second (json) response: mid-stream failures become a
+        # terminal {"error": ...} line on the stream itself.
+        try:
+            while True:
+                more, chunk = await loop.run_in_executor(self._pool,
+                                                         _next)
+                if not more:
+                    break
+                await resp.write(
+                    (json.dumps({"chunk": chunk}, default=str) +
+                     "\n").encode())
+        except Exception as e:  # noqa: BLE001
+            try:
+                await resp.write(
+                    (json.dumps({"error": str(e)}) + "\n").encode())
+            except (ConnectionError, OSError):
+                pass           # client already gone
+        await resp.write_eof()
+        return resp
 
     async def _health(self, request):
         from aiohttp import web
@@ -93,6 +151,7 @@ class HTTPProxy:
     def stop(self):
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
+        self._pool.shutdown(wait=False)
 
 
 _proxy: Optional[HTTPProxy] = None

@@ -54,3 +54,44 @@ class LlamaDeployment:
                        max_new_tokens=self.max_new_tokens,
                        temperature=self.temperature)
         return np.asarray(out[0]).tolist()
+
+    def stream(self, prompt_ids: List[int]):
+        """Streaming request: yields each generated token id as soon
+        as it is sampled (token-at-a-time decode; serve wraps this
+        generator in a StreamingResponse and the HTTP proxy in a
+        chunked ndjson response)."""
+        import jax.numpy as jnp
+        from ray_tpu.models.llama import generate_stream
+        prompt = jnp.asarray([prompt_ids], jnp.int32)
+        for tok in generate_stream(self.model, self.params, prompt,
+                                   max_new_tokens=self.max_new_tokens,
+                                   temperature=self.temperature):
+            yield int(tok[0])
+
+    def generate_batch(self, prompts: List[List[int]]) -> List[List[int]]:
+        """Batched generation for throughput serving: prompts are
+        bucketed by length and each bucket decodes as one batch on the
+        chip (one MXU-efficient kernel instead of B tiny ones).
+
+        Bucketing instead of padding: the model applies only a causal
+        mask, so padding a shorter prompt would let it attend to the
+        pad tokens and change its completion versus an unbatched
+        call — same-length batching is the correctness-preserving way
+        to batch (serving clients typically use fixed prompt shapes,
+        giving one bucket)."""
+        import jax.numpy as jnp
+        from ray_tpu.models.llama import generate
+        buckets: Dict[int, List[int]] = {}
+        for i, p in enumerate(prompts):
+            buckets.setdefault(len(p), []).append(i)
+        results: List[Optional[List[int]]] = [None] * len(prompts)
+        for plen, idxs in buckets.items():
+            batch = np.asarray([prompts[i] for i in idxs], np.int32)
+            out = generate(self.model, self.params,
+                           jnp.asarray(batch),
+                           max_new_tokens=self.max_new_tokens,
+                           temperature=self.temperature)
+            gen = np.asarray(out)[:, plen:]
+            for row, i in zip(gen, idxs):
+                results[i] = row.tolist()
+        return results

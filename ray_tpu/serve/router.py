@@ -22,12 +22,68 @@ _REFRESH_S = 0.25
 
 
 class DeploymentMethod:
-    def __init__(self, handle: "DeploymentHandle", method: str):
+    def __init__(self, handle: "DeploymentHandle", method: str,
+                 stream: bool = False):
         self._handle = handle
         self._method = method
+        self._stream = stream
+
+    def options(self, *, stream: bool = False) -> "DeploymentMethod":
+        return DeploymentMethod(self._handle, self._method, stream)
 
     def remote(self, *args, **kwargs):
+        if self._stream:
+            return self._handle._route_stream(self._method, args,
+                                              kwargs)
         return self._handle._route(self._method, args, kwargs)
+
+
+class StreamingResponse:
+    """Iterator over a streaming serve call's chunks (reference:
+    DeploymentResponseGenerator, serve handle streaming). Pulls chunk
+    batches from the replica with long-polls; releases the handle's
+    in-flight slot when the stream ends."""
+
+    def __init__(self, handle: "DeploymentHandle", replica, idx: int,
+                 req_id: str):
+        self._handle = handle
+        self._replica = replica
+        self._idx = idx
+        self._req_id = req_id
+        self._buf: List[Any] = []
+        self._pos = 0          # chunks consumed from the replica
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self._released = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while not self._buf and not self._done:
+            out = ray_tpu.get(self._replica.next_chunks.remote(
+                self._req_id, self._pos))
+            self._buf.extend(out["chunks"])
+            self._pos += len(out["chunks"])
+            if out["done"]:
+                self._done = True
+                self._error = out["error"]
+                self._release()
+        if self._buf:
+            return self._buf.pop(0)
+        # buffer drained: surface a mid-stream error, else finish
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        raise StopIteration
+
+    def _release(self):
+        if not self._released:
+            self._released = True
+            self._handle._done(self._idx)
+
+    def __del__(self):
+        self._release()
 
 
 class DeploymentHandle:
@@ -128,23 +184,40 @@ class DeploymentHandle:
 
     # --- calls -------------------------------------------------------------
 
-    def _route(self, method: str, args, kwargs):
+    def _acquire_replica(self):
         deadline = time.time() + 30
         while True:
             self._refresh()
             idx = self._pick()
             if idx is not None:
-                break
+                return idx
             if time.time() > deadline:
                 raise TimeoutError(
                     f"No replica of {self._name!r} accepted the request "
                     f"within 30s (all at max_ongoing_requests)")
             time.sleep(0.005)
             self._refresh(force=True)
+
+    def _route(self, method: str, args, kwargs):
+        idx = self._acquire_replica()
         replica = self._replicas[idx]
         ref = replica.handle_request.remote(method, args, kwargs)
         self._watch_completion(ref, idx)
         return ref
+
+    def _route_stream(self, method: str, args, kwargs
+                      ) -> "StreamingResponse":
+        import uuid
+        idx = self._acquire_replica()
+        replica = self._replicas[idx]
+        req_id = uuid.uuid4().hex
+        try:
+            ray_tpu.get(replica.handle_request_streaming.remote(
+                req_id, method, args, kwargs))
+        except BaseException:
+            self._done(idx)      # failed start must release the slot
+            raise
+        return StreamingResponse(self, replica, idx, req_id)
 
     def _watch_completion(self, ref, idx: int):
         def _wait():
@@ -159,12 +232,65 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         return self._route("__call__", args, kwargs)
 
+    def options(self, *, stream: bool = False) -> DeploymentMethod:
+        """handle.options(stream=True).remote(...) returns a
+        StreamingResponse iterator of chunks."""
+        return DeploymentMethod(self, "__call__", stream)
+
     def __getattr__(self, name: str) -> DeploymentMethod:
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentMethod(self, name)
 
 
-def _rebuild_handle(name: str) -> "DeploymentHandle":
+    def close(self):
+        """Stop the push subscriber thread and its RPC connection."""
+        sub, self._subscriber = self._subscriber, None
+        if sub is not None:
+            try:
+                sub.stop()
+            except Exception:
+                pass
+        self._push_active = False
+
+
+# One handle per deployment per process: handles own a long-poll
+# subscriber thread + RPC connection, so constructing one per
+# get_handle()/unpickle would leak threads and sockets without bound.
+_handle_cache: Dict[str, DeploymentHandle] = {}
+_handle_cache_runtime: Any = None
+_handle_cache_lock = threading.Lock()
+
+
+def get_or_create_handle(name: str) -> DeploymentHandle:
+    global _handle_cache_runtime
+    from ray_tpu._private.worker import global_worker
     from ray_tpu.serve.controller import get_or_create_controller
-    return DeploymentHandle(name, get_or_create_controller())
+    rt = global_worker().runtime
+    with _handle_cache_lock:
+        if _handle_cache_runtime is not rt:
+            _clear_handles_locked()
+            _handle_cache_runtime = rt
+        h = _handle_cache.get(name)
+        if h is None:
+            h = DeploymentHandle(name, get_or_create_controller())
+            _handle_cache[name] = h
+        return h
+
+
+def _clear_handles_locked():
+    for h in _handle_cache.values():
+        h.close()
+    _handle_cache.clear()
+
+
+def clear_handle_cache():
+    """Close all cached handles (serve shutdown / runtime teardown)."""
+    global _handle_cache_runtime
+    with _handle_cache_lock:
+        _clear_handles_locked()
+        _handle_cache_runtime = None
+
+
+def _rebuild_handle(name: str) -> "DeploymentHandle":
+    return get_or_create_handle(name)

@@ -1,0 +1,174 @@
+"""Llama serving benchmark (BASELINE.md: "Serve-equiv Llama-2-7B JAX
+replica — tokens/s, p50/p99 latency").
+
+Drives a serve deployment wrapping the Llama decode on the real chip:
+- throughput phase: concurrent clients -> @serve.batch batched decode
+  (batch padded to a fixed shape so ONE compiled executable serves
+  every request);
+- streaming phase: token-at-a-time decode measuring time-to-first-token
+  and steady-state streaming rate.
+
+Writes SERVE_BENCH_r03.json and prints it.
+
+Usage: python serve_bench.py [--model 7b|1b|tiny] [--out FILE]
+(7b needs ~14GB HBM; falls back to 1b automatically on OOM.)
+"""
+import argparse
+import json
+import statistics
+import threading
+import time
+
+import numpy as np
+
+
+def build_configs(name):
+    import jax.numpy as jnp
+    from ray_tpu.models.llama import LlamaConfig
+    if name == "7b":
+        return "llama2-7b-bf16", LlamaConfig(
+            max_seq_len=256, param_dtype=jnp.bfloat16)
+    if name == "1b":
+        return "llama-1.1b-bf16", LlamaConfig(
+            max_seq_len=256, dim=2048, n_layers=22, n_heads=16,
+            n_kv_heads=16, hidden_dim=5632, param_dtype=jnp.bfloat16)
+    from ray_tpu.models.llama import llama_tiny
+    return "llama-tiny", llama_tiny()
+
+
+PROMPT_LEN = 128
+GEN_TOKENS = 64
+BATCH = 8
+
+
+def make_server(cfg):
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LlamaDeployment
+
+    @serve.deployment(max_ongoing_requests=64)
+    class LlamaServer:
+        def __init__(self):
+            self.inner = LlamaDeployment(config=cfg,
+                                         max_new_tokens=GEN_TOKENS)
+
+        @serve.batch(max_batch_size=BATCH, batch_wait_timeout_s=0.02)
+        async def __call__(self, prompts):
+            n = len(prompts)
+            # Pad the batch to a fixed size: one (B, T0) shape means
+            # one compiled executable for every traffic level.
+            padded = list(prompts) + \
+                [prompts[0]] * (BATCH - n)
+            out = self.inner.generate_batch(padded)
+            return out[:n]
+
+        def stream(self, prompt):
+            yield from self.inner.stream(prompt)
+
+    return serve.run(LlamaServer.bind(), timeout_s=600)
+
+
+def bench(handle, rng):
+    import ray_tpu
+
+    def prompt():
+        return rng.randint(1, 31000, size=PROMPT_LEN).tolist()
+
+    # --- warmup / compile (one batched decode + one stream step) ----
+    t0 = time.time()
+    ray_tpu.get(handle.remote(prompt()), timeout=3600)
+    compile_s = time.time() - t0
+    print(f"warmup+compile: {compile_s:.1f}s", flush=True)
+
+    # --- throughput: 64 requests from 16 threads -------------------
+    n_req, n_threads = 64, 16
+    latencies = []
+    lat_lock = threading.Lock()
+
+    def client(n):
+        for _ in range(n):
+            t = time.time()
+            ray_tpu.get(handle.remote(prompt()), timeout=3600)
+            with lat_lock:
+                latencies.append(time.time() - t)
+
+    t0 = time.time()
+    threads = [threading.Thread(target=client,
+                                args=(n_req // n_threads,))
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    throughput = n_req * GEN_TOKENS / wall
+    lat_ms = sorted(x * 1000 for x in latencies)
+    p50 = statistics.median(lat_ms)
+    p99 = lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))]
+
+    # --- streaming: time-to-first-token + token rate ---------------
+    ttfts, rates = [], []
+    for _ in range(3):
+        t0 = time.time()
+        it = iter(handle.stream.options(stream=True).remote(prompt()))
+        first = next(it)
+        ttfts.append(time.time() - t0)
+        n = 1
+        for _tok in it:
+            n += 1
+        dt = time.time() - t0
+        rates.append(n / dt)
+    return {
+        "throughput_tok_s": round(throughput, 1),
+        "p50_ms": round(p50, 1),
+        "p99_ms": round(p99, 1),
+        "ttft_ms": round(min(ttfts) * 1000, 1),
+        "stream_tok_s": round(max(rates), 1),
+        "requests": n_req,
+        "client_threads": n_threads,
+        "compile_s": round(compile_s, 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="7b",
+                    choices=["7b", "1b", "tiny"])
+    ap.add_argument("--out", default="SERVE_BENCH_r03.json")
+    args = ap.parse_args()
+
+    import ray_tpu
+    ray_tpu.init()
+    order = {"7b": ["7b", "1b"], "1b": ["1b"],
+             "tiny": ["tiny"]}[args.model]
+    result = None
+    for name in order:
+        label, cfg = build_configs(name)
+        print(f"model: {label}", flush=True)
+        try:
+            handle = make_server(cfg)
+            rng = np.random.RandomState(0)
+            result = bench(handle, rng)
+            result["model"] = label
+            break
+        except Exception as e:   # noqa: BLE001
+            msg = str(e)
+            oom = "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower()
+            print(f"{label} failed ({msg[:200]})", flush=True)
+            from ray_tpu import serve
+            serve.shutdown()
+            if not oom or name == order[-1]:
+                raise
+    result["batch"] = BATCH
+    result["prompt_len"] = PROMPT_LEN
+    result["gen_tokens"] = GEN_TOKENS
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    from ray_tpu import serve
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -270,3 +270,43 @@ def test_llama_generate_eos_zero_not_instant_stop():
     else:
         first0 = int((gen == 0).argmax())
         assert (out[0, 4:4 + first0 + 1] == gen[:first0 + 1]).all()
+
+
+def test_llama_generate_stream_matches_generate():
+    """Chunked streaming decode must emit exactly the fused
+    while_loop decode's tokens (greedy), across chunk boundaries."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.llama import (Llama, generate, generate_stream,
+                                      llama_tiny)
+    cfg = llama_tiny()
+    m = Llama(cfg)
+    p = m.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(1, 200, (2, 16)), jnp.int32)
+    full = np.asarray(generate(m, p, prompt, max_new_tokens=21))
+    for chunk in (1, 4, 8):
+        st = np.stack(list(generate_stream(
+            m, p, prompt, max_new_tokens=21, chunk_size=chunk)), axis=1)
+        assert st.shape[1] == 21
+        assert (full[:, 16:37] == st).all(), f"chunk_size={chunk}"
+
+
+def test_llama_generate_stream_eos_stops():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.llama import (Llama, generate, generate_stream,
+                                      llama_tiny)
+    cfg = llama_tiny()
+    m = Llama(cfg)
+    p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    prompt = jnp.asarray(
+        np.random.RandomState(5).randint(1, 200, (1, 16)), jnp.int32)
+    full = np.asarray(generate(m, p, prompt, max_new_tokens=24))
+    eos = int(full[0, 16 + 5])        # the 6th generated token
+    toks = [int(t[0]) for t in generate_stream(
+        m, p, prompt, max_new_tokens=24, eos_id=eos, chunk_size=4)]
+    assert eos in toks
+    assert len(toks) == toks.index(eos) + 1    # nothing after eos

@@ -282,3 +282,177 @@ def test_run_no_wait_returns_immediately(serve_rt):
             if time.time() > deadline:
                 raise
             time.sleep(0.1)
+
+
+def test_handle_cache_one_per_deployment(serve_rt):
+    """get_handle() and unpickling reuse ONE handle per deployment per
+    process — each handle owns a long-poll subscriber thread + RPC
+    connection, so per-call construction would leak without bound."""
+    import cloudpickle
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Echo.bind())
+    h2 = serve.get_handle("Echo")
+    h3 = serve.get_handle("Echo")
+    assert h2 is h3
+    assert cloudpickle.loads(cloudpickle.dumps(h2)) is h2
+    assert ray_tpu.get(h.remote("hi"), timeout=10) == "hi"
+    serve.shutdown()
+    from ray_tpu.serve.router import _handle_cache
+    assert not _handle_cache
+
+
+def test_streaming_response_generator(serve_rt):
+    """handle.options(stream=True) yields chunks as the replica's
+    generator produces them (reference: serve streaming responses)."""
+    @serve.deployment
+    class Tokens:
+        def __call__(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+
+        def evens(self, n):
+            for i in range(0, n, 2):
+                yield i
+
+    h = serve.run(Tokens.bind())
+    chunks = list(h.options(stream=True).remote(5))
+    assert chunks == [f"tok{i}" for i in range(5)]
+    # method-level streaming
+    assert list(h.evens.options(stream=True).remote(6)) == [0, 2, 4]
+    # non-generator methods stream as a single chunk
+    @serve.deployment
+    class Plain:
+        def __call__(self, x):
+            return x + 1
+    hp = serve.run(Plain.bind())
+    assert list(hp.options(stream=True).remote(41)) == [42]
+
+
+def test_streaming_incremental_delivery(serve_rt):
+    """First chunk arrives while the producer is still generating."""
+    import time as _time
+
+    @serve.deployment
+    class Slow:
+        def __call__(self, n):
+            for i in range(n):
+                yield i
+                _time.sleep(0.15)
+
+    h = serve.run(Slow.bind())
+    t0 = _time.time()
+    it = iter(h.options(stream=True).remote(4))
+    first = next(it)
+    t_first = _time.time() - t0
+    rest = list(it)
+    t_all = _time.time() - t0
+    assert first == 0 and rest == [1, 2, 3]
+    # 4 chunks take >= 0.45s total; the first must arrive well before
+    assert t_first < t_all - 0.25, (t_first, t_all)
+
+
+def test_streaming_error_propagates(serve_rt):
+    @serve.deployment
+    class Boom:
+        def __call__(self):
+            yield 1
+            raise RuntimeError("mid-stream kaboom")
+
+    h = serve.run(Boom.bind())
+    it = iter(h.options(stream=True).remote())
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="kaboom"):
+        list(it)
+
+
+def test_streaming_releases_inflight_slot(serve_rt):
+    @serve.deployment(max_ongoing_requests=1)
+    class One:
+        def __call__(self):
+            yield "a"
+            yield "b"
+
+    h = serve.run(One.bind())
+    for _ in range(3):      # would deadlock if slots leaked
+        assert list(h.options(stream=True).remote()) == ["a", "b"]
+
+
+def test_streaming_async_generator(serve_rt):
+    @serve.deployment
+    class AsyncGen:
+        async def __call__(self, n):
+            import asyncio as aio
+            for i in range(n):
+                await aio.sleep(0.01)
+                yield i * 10
+
+    h = serve.run(AsyncGen.bind())
+    assert list(h.options(stream=True).remote(3)) == [0, 10, 20]
+
+
+def test_http_proxy_streaming(serve_rt):
+    import urllib.request
+
+    @serve.deployment
+    class Chunks:
+        def __call__(self, payload):
+            for i in range(int(payload["n"])):
+                yield {"i": i}
+
+    serve.run(Chunks.bind())
+    from ray_tpu.serve.http_proxy import start_http, stop_http
+    import json as _json
+    proxy = start_http(port=18731)
+    try:
+        req = urllib.request.Request(
+            "http://127.0.0.1:18731/Chunks?stream=1",
+            data=_json.dumps({"n": 3}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            lines = [l for l in r.read().decode().splitlines() if l]
+        assert [_json.loads(l)["chunk"] for l in lines] == \
+            [{"i": 0}, {"i": 1}, {"i": 2}]
+    finally:
+        stop_http()
+
+
+def test_streaming_failed_start_releases_slot(serve_rt):
+    """A stream that fails to start (bad method) must release the
+    handle's in-flight slot, or the handle wedges permanently."""
+    @serve.deployment(max_ongoing_requests=2)
+    class S:
+        def __call__(self):
+            yield "ok"
+
+    h = serve.run(S.bind())
+    for _ in range(5):      # more failures than max_ongoing slots
+        with pytest.raises(Exception):
+            h.nope.options(stream=True).remote()
+    assert list(h.options(stream=True).remote()) == ["ok"]
+
+
+def test_streaming_plain_async_method(serve_rt):
+    """options(stream=True) on a plain `async def` awaits it and
+    streams the return value as one chunk."""
+    @serve.deployment
+    class A:
+        async def __call__(self, x):
+            return x + 1
+
+    h = serve.run(A.bind())
+    assert list(h.options(stream=True).remote(41)) == [42]
+
+
+def test_llama_generate_batch_ragged_matches_unbatched(serve_rt):
+    from ray_tpu.serve.llm import LlamaDeployment
+    dep = LlamaDeployment(max_new_tokens=8)
+    prompts = [[5, 6, 7], [1, 2, 3, 4, 5, 6], [9, 8, 7]]
+    batched = dep.generate_batch(prompts)
+    for p, got in zip(prompts, batched):
+        solo = dep(p)[len(p):]
+        assert got == solo, (p, got, solo)
