@@ -40,17 +40,25 @@ class _TeeStream(io.TextIOBase):
         self._name = stream_name
         self._collector = collector
         self._buf = ""
+        # The executor runs tasks on a thread pool and this object is
+        # the process-wide sys.stdout: the buffer read-modify-write
+        # must be serialized or concurrent prints lose/mangle lines.
+        self._wlock = threading.Lock()
 
     def write(self, s: str) -> int:
         try:
             self._orig.write(s)
         except Exception:
             pass
-        self._buf += s
-        while "\n" in self._buf:
-            line, self._buf = self._buf.split("\n", 1)
-            if line:
-                self._collector(self._name, line)
+        lines = []
+        with self._wlock:
+            self._buf += s
+            while "\n" in self._buf:
+                line, self._buf = self._buf.split("\n", 1)
+                if line:
+                    lines.append(line)
+        for line in lines:
+            self._collector(self._name, line)
         return len(s)
 
     def flush(self):

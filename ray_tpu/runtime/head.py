@@ -898,9 +898,12 @@ class HeadService:
         victims = []
         actors_by_worker = {a.worker_id for a in self._actors.values()
                             if not a.dead}
+        pg_workers = {wid for pg in self._pgs.values()
+                      for wid in pg["workers"]}
         for w in self._workers.values():
             if (w.env_key is not None and w.alive and not w.running and
                     w.worker_id not in actors_by_worker and
+                    w.worker_id not in pg_workers and
                     now - w.last_active > timeout):
                 victims.append(w.worker_id)
         for wid in victims:
@@ -1267,6 +1270,12 @@ class HeadService:
                 w = None
                 for cand in self._workers.values():
                     if not cand.alive:
+                        continue
+                    # Dedicated runtime-env workers never host PG
+                    # bundles: a bundle would let env-less PG work run
+                    # inside a mutated environment, and would pin a
+                    # worker the idle reaper may stop.
+                    if cand.env_key is not None:
                         continue
                     if strategy in ("SPREAD", "STRICT_SPREAD") and \
                             cand.worker_id in used:

@@ -93,3 +93,29 @@ def test_env_actor_runs_in_dedicated_worker(cluster):
     plain = {ray_tpu.get(plain_pid.remote(), timeout=30)
              for _ in range(6)}
     assert apid not in plain
+
+
+def test_pg_never_reserves_on_env_workers(cluster):
+    """PG bundles must skip dedicated runtime-env workers: a bundle
+    there would run env-less PG work inside a mutated environment and
+    pin a worker the idle reaper may stop."""
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"PG_ENV": "1"}})
+    def spawn_env_worker():
+        import os
+        return os.getpid()
+
+    ray_tpu.get(spawn_env_worker.remote(), timeout=60)
+
+    # 2 plain workers + 1 env worker are alive. STRICT_SPREAD over 3
+    # bundles can only succeed by using the env worker — it must not.
+    pg3 = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert not pg3.wait(1.5), \
+        "PG reserved a bundle on a dedicated env worker"
+    remove_placement_group(pg3)
+
+    # Positive control: 2 bundles fit on the plain workers.
+    pg2 = placement_group([{"CPU": 1}] * 2, strategy="STRICT_SPREAD")
+    assert pg2.wait(10)
+    remove_placement_group(pg2)

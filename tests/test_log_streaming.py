@@ -82,3 +82,38 @@ def test_stderr_stream_marked(log_cluster):
     hits = _collect_until(
         records, lambda r: r["line"] == "to-stderr-123")
     assert hits and hits[0]["stream"] == "err"
+
+
+def test_tee_stream_concurrent_writes_lose_nothing():
+    """_TeeStream replaces the process-wide sys.stdout while the worker
+    executor runs tasks on a thread pool: concurrent writers must not
+    lose or mangle lines."""
+    import io
+    import threading
+
+    from ray_tpu._private.log_streaming import _TeeStream
+
+    collected = []
+    lock = threading.Lock()
+
+    def collect(stream, line):
+        with lock:
+            collected.append(line)
+
+    tee = _TeeStream(io.StringIO(), "out", collect)
+    n_threads, n_lines = 8, 200
+
+    def writer(tid):
+        for i in range(n_lines):
+            tee.write(f"t{tid}-line{i}\n")
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(collected) == n_threads * n_lines
+    assert sorted(collected) == sorted(
+        f"t{t}-line{i}" for t in range(n_threads)
+        for i in range(n_lines))
