@@ -104,6 +104,12 @@ class NodeManager:
                  store_capacity: int = 256 * 1024 * 1024,
                  tpu_owner_worker: Optional[int] = None):
         self.resources_per_worker = resources_per_worker or {"CPU": 2}
+        # Root of the cluster's process tree: mint the shared RPC
+        # secret here so every spawned process (head, workers, node
+        # agents) authenticates; external drivers attach by setting
+        # RAY_TPU_cluster_token.
+        from ray_tpu._private.config import ensure_cluster_token
+        ensure_cluster_token()
         self.store_name = f"/raytpu_{os.getpid()}_{uuid.uuid4().hex[:8]}"
         from ray_tpu._private.shm_store import ShmObjectStore
         self.store = ShmObjectStore.create(self.store_name,

@@ -8,7 +8,13 @@ monitors this node's worker processes, and heartbeats the head
 num_heartbeats_timeout missed beats and drops its object locations).
 
 Run: python -m ray_tpu.runtime.node_agent --head H:P --workers N \
-         [--resources '{"CPU": 2}'] [--store-capacity BYTES] [--node-id ID]
+         [--token SECRET] [--resources '{"CPU": 2}'] \
+         [--store-capacity BYTES] [--node-id ID]
+
+Every RPC connection authenticates with the cluster token the head
+minted at startup; a node joining from another machine must present it
+via --token or the RAY_TPU_cluster_token environment variable (same
+contract as an external driver attach).
 
 Tests use this to build two separate process trees with two store
 segments on one machine — the cross-"node" object transfer fixture
@@ -159,7 +165,15 @@ def main():
     ap.add_argument("--store-capacity", type=int,
                     default=256 * 1024 * 1024)
     ap.add_argument("--node-id", default=None)
+    ap.add_argument("--token", default=None,
+                    help="cluster auth token (defaults to the "
+                         "RAY_TPU_cluster_token environment variable)")
     args = ap.parse_args()
+    if args.token:
+        from ray_tpu._private.config import GlobalConfig
+        GlobalConfig.apply_system_config({"cluster_token": args.token})
+        # worker processes this agent spawns inherit it via to_env()
+        os.environ["RAY_TPU_cluster_token"] = args.token
     agent = NodeAgent(args.head, num_workers=args.workers,
                       resources_per_worker=json.loads(args.resources),
                       store_capacity=args.store_capacity,

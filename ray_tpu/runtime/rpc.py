@@ -2,11 +2,29 @@
 
 Role parity with the reference's RPC layer (src/ray/rpc/grpc_server.h,
 grpc_client.h, client_call.h): typed service endpoints, concurrent calls,
-retrying clients, per-connection threads. Wire format: 4-byte little-endian
-length + cloudpickle({"method","args","kwargs"} / {"ok"/"err": ...}).
+retrying clients, per-connection threads.
+
+Wire protocol (the schema'd-protocol role of src/ray/protobuf/ — here a
+versioned binary framing instead of 21 protos, since both ends are this
+codebase):
+
+  HELLO (once per TCP connection, client -> server):
+      magic  b"RAYT"         (4 bytes)
+      version u16 LE          — PROTO_VERSION; mismatch is rejected
+      tlen    u16 LE          — auth token length
+      token   tlen bytes      — cluster secret (GlobalConfig.cluster_token)
+  FRAME (both directions, after a successful HELLO):
+      length  u32 LE + cloudpickle payload
+      request:  {"rid", "method", "args", "kwargs"} (rid None = one-way)
+      response: {"rid", "ok": result} | {"rid", "err", "tb"}
+
+The server verifies magic/version/token BEFORE deserializing anything, so
+an arbitrary connecting process can no longer feed pickle to the handler
+(the reference gets the same property from gRPC framing + Redis password).
 """
 from __future__ import annotations
 
+import hmac
 import pickle
 import socket
 import struct
@@ -17,6 +35,36 @@ from typing import Any, Callable, Dict, Optional
 import cloudpickle
 
 _LEN = struct.Struct("<I")
+
+MAGIC = b"RAYT"
+PROTO_VERSION = 1
+_HELLO = struct.Struct("<4sHH")
+_HANDSHAKE_TIMEOUT_S = 10.0
+
+
+def _token_bytes() -> bytes:
+    from ray_tpu._private.config import GlobalConfig
+    return GlobalConfig.cluster_token.encode()
+
+
+def _send_hello(sock: socket.socket):
+    tok = _token_bytes()
+    sock.sendall(_HELLO.pack(MAGIC, PROTO_VERSION, len(tok)) + tok)
+
+
+def _check_hello(sock: socket.socket) -> Optional[str]:
+    """Server side: returns None on success, else a rejection reason."""
+    magic, version, tlen = _HELLO.unpack(
+        _recv_exact(sock, _HELLO.size))
+    if magic != MAGIC:
+        return "bad magic (not a ray_tpu client)"
+    if version != PROTO_VERSION:
+        return (f"protocol version mismatch: peer {version}, "
+                f"server {PROTO_VERSION}")
+    token = _recv_exact(sock, tlen) if tlen else b""
+    if not hmac.compare_digest(token, _token_bytes()):
+        return "authentication failed (bad cluster token)"
+    return None
 
 
 def _send_msg(sock: socket.socket, obj: Any, fast: bool = False):
@@ -78,6 +126,16 @@ class RpcServer:
     def _serve_conn(self, conn: socket.socket):
         send_lock = threading.Lock()
         try:
+            conn.settimeout(_HANDSHAKE_TIMEOUT_S)
+            reason = _check_hello(conn)
+            if reason is not None:
+                try:
+                    _send_msg(conn, {"rid": None,
+                                     "err": RpcError(reason)})
+                except (ConnectionError, OSError):
+                    pass
+                return
+            conn.settimeout(None)
             while self._running:
                 req = _recv_msg(conn)
                 if req.get("rid") is None:
@@ -92,8 +150,13 @@ class RpcServer:
                 threading.Thread(
                     target=self._handle_one, args=(conn, req, send_lock),
                     daemon=True).start()
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, struct.error):
             pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _handle_one(self, conn: socket.socket, req: Dict[str, Any],
                     send_lock: threading.Lock):
@@ -145,6 +208,7 @@ class RpcClient:
         sock = socket.create_connection((self.host, self.port),
                                         timeout=self.timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_hello(sock)
         return sock
 
     def _get_conn(self) -> socket.socket:
@@ -182,6 +246,16 @@ class RpcClient:
                     pass
             raise RpcError(f"RPC {method} to {self.host}:{self.port} "
                            f"failed: {e}") from e
+        if reply.get("rid") != rid:
+            # Connection-level rejection (handshake failure): the
+            # server closed this socket — pooling it would surface a
+            # misleading 'peer closed' on the NEXT call.
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise reply.get("err") or RpcError(
+                f"RPC {method}: connection rejected")
         self._put_conn(sock)
         if "err" in reply:
             raise reply["err"]

@@ -1,0 +1,134 @@
+"""Wire-protocol handshake: version negotiation + cluster-token auth
+(the schema'd/authenticated-protocol role of src/ray/protobuf/ + the
+Redis-password gate). An arbitrary connecting process must not be able
+to drive the handler (pickle RCE) or even get its payload unpickled.
+"""
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu.runtime import rpc
+from ray_tpu.runtime.rpc import (MAGIC, PROTO_VERSION, RpcClient,
+                                 RpcError, RpcServer, _HELLO, _LEN)
+
+
+class _Recorder:
+    def __init__(self):
+        self.calls = []
+
+    def touch(self, x=None):
+        self.calls.append(x)
+        return "touched"
+
+
+@pytest.fixture
+def server():
+    GlobalConfig.apply_system_config(
+        {"cluster_token": "secret-token-123"})
+    handler = _Recorder()
+    srv = RpcServer(handler)
+    yield srv, handler
+    srv.stop()
+    GlobalConfig.apply_system_config({"cluster_token": ""})
+
+
+def test_authed_call_works(server):
+    srv, handler = server
+    c = RpcClient(srv.address, timeout=5)
+    assert c.call("touch", 42) == "touched"
+    assert handler.calls == [42]
+    c.close()
+
+
+def test_no_hello_never_reaches_handler(server):
+    srv, handler = server
+    sock = socket.create_connection((srv.host, srv.port), timeout=5)
+    # A raw attacker frame: length-prefixed pickle calling touch().
+    evil = pickle.dumps({"rid": 1, "method": "touch",
+                         "args": ("pwned",), "kwargs": {}})
+    sock.sendall(_LEN.pack(len(evil)) + evil)
+    # Server reads those bytes AS a HELLO, sees bad magic, closes.
+    sock.settimeout(5)
+    try:
+        while True:
+            if not sock.recv(4096):
+                break
+    except ConnectionResetError:
+        pass                            # server dropped us: also fine
+    except socket.timeout:
+        pytest.fail("server kept the unauthenticated connection open")
+    time.sleep(0.1)
+    assert handler.calls == []          # payload never executed
+    sock.close()
+
+
+def test_wrong_token_rejected(server):
+    srv, handler = server
+    sock = socket.create_connection((srv.host, srv.port), timeout=5)
+    tok = b"WRONG-token"
+    sock.sendall(_HELLO.pack(MAGIC, PROTO_VERSION, len(tok)) + tok)
+    req = pickle.dumps({"rid": 1, "method": "touch", "args": ("x",),
+                       "kwargs": {}})
+    sock.sendall(_LEN.pack(len(req)) + req)
+    sock.settimeout(5)
+    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    reply = pickle.loads(_recv_exact(sock, n))
+    assert "err" in reply and "authentication failed" in \
+        str(reply["err"])
+    time.sleep(0.1)
+    assert handler.calls == []
+    sock.close()
+
+
+def test_version_mismatch_rejected(server):
+    srv, handler = server
+    sock = socket.create_connection((srv.host, srv.port), timeout=5)
+    tok = b"secret-token-123"
+    sock.sendall(_HELLO.pack(MAGIC, PROTO_VERSION + 7, len(tok)) + tok)
+    req = pickle.dumps({"rid": 1, "method": "touch", "args": (),
+                       "kwargs": {}})
+    sock.sendall(_LEN.pack(len(req)) + req)
+    sock.settimeout(5)
+    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    reply = pickle.loads(_recv_exact(sock, n))
+    assert "err" in reply and "version mismatch" in str(reply["err"])
+    assert handler.calls == []
+    sock.close()
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("closed")
+        buf += chunk
+    return buf
+
+
+def test_empty_token_mode_still_requires_magic(server):
+    """Even with auth disabled (empty token), garbage bytes never get
+    unpickled."""
+    srv, handler = server
+    GlobalConfig.apply_system_config({"cluster_token": ""})
+    try:
+        sock = socket.create_connection((srv.host, srv.port),
+                                        timeout=5)
+        sock.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+        sock.settimeout(5)
+        try:
+            while sock.recv(4096):
+                pass
+        except ConnectionResetError:
+            pass
+        except socket.timeout:
+            pytest.fail("server kept a non-protocol connection open")
+        assert handler.calls == []
+    finally:
+        GlobalConfig.apply_system_config(
+            {"cluster_token": "secret-token-123"})
