@@ -1,0 +1,75 @@
+"""End-to-end drive of this batch's changes on a REAL multi-process
+cluster: authenticated RPC handshake (every connection below uses it),
+worker log streaming, distributed Data shuffles, and serve token
+streaming. Run from /root/repo."""
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8"
+                           ).strip()
+
+import ray_tpu
+from ray_tpu.runtime.cluster_utils import Cluster
+
+
+def main():
+    from ray_tpu._private.config import GlobalConfig
+    c = Cluster(num_workers=2, resources_per_worker={"CPU": 4})
+    try:
+        assert GlobalConfig.cluster_token, "cluster token was not minted"
+        print(f"cluster up, token minted "
+              f"({GlobalConfig.cluster_token[:6]}…): handshake in play "
+              f"on every head/worker/object connection")
+
+        # --- tasks across authed connections ------------------------
+        @ray_tpu.remote
+        def add(a, b):
+            print(f"adding {a}+{b}")       # exercises log pipeline
+            return a + b
+        assert ray_tpu.get(add.remote(2, 3), timeout=30) == 5
+        print("authed task round trip: OK")
+
+        # --- log streaming to driver --------------------------------
+        got = []
+        c.runtime.start_log_streaming(sink=lambda rec: got.append(rec))
+        ray_tpu.get(add.remote(7, 8), timeout=30)
+        deadline = time.time() + 10
+        while time.time() < deadline and not any(
+                "adding 7+8" in r["line"] for r in got):
+            time.sleep(0.1)
+        assert any("adding 7+8" in r["line"] for r in got), got[:5]
+        print("worker print streamed to driver over pub/sub: OK")
+
+        # --- distributed data shuffle -------------------------------
+        from ray_tpu.data import from_items
+        rows = (from_items([{"g": f"k{i % 4}", "v": i}
+                            for i in range(400)], parallelism=8)
+                .groupby("g").sum("v").take_all())
+        assert {r["key"] for r in rows} == {f"k{i}" for i in range(4)}
+        print("distributed groupby on 2-proc cluster: OK")
+
+        # --- serve streaming on the distributed runtime -------------
+        from ray_tpu import serve
+
+        @serve.deployment
+        class Counter:
+            def __call__(self, n):
+                for i in range(n):
+                    yield i * i
+
+        h = serve.run(Counter.bind())
+        out = list(h.options(stream=True).remote(6))
+        assert out == [0, 1, 4, 9, 16, 25], out
+        print("serve streaming over distributed runtime: OK")
+        serve.shutdown()
+
+        print("ALL DRIVES PASSED")
+    finally:
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    main()
