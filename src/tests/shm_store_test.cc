@@ -1,0 +1,254 @@
+// Native tests for the shm object store (the role of the reference's
+// object-store *_test.cc suite, e.g. src/ray/object_manager/test/ —
+// exercised here directly against the C API with asserts; built and
+// run under ASan/UBSan and TSan by `make -C src test` / `test-tsan`).
+//
+// Covers: create/seal/get/release/delete lifecycle, duplicate and
+// missing ids, capacity pressure + LRU eviction candidates, blocking
+// get with timeout, multi-threaded producers/consumers on one
+// segment, and survival of a SIGKILLed child process mid-traffic
+// (robust mutex recovery).
+#include <pthread.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+enum {
+  SHM_OK = 0,
+  SHM_ERR_EXISTS = -1,
+  SHM_ERR_NOT_FOUND = -2,
+  SHM_ERR_FULL = -3,
+  SHM_ERR_STATE = -4,
+  SHM_ERR_TIMEOUT = -5,
+  SHM_ERR_SYS = -6,
+  SHM_ERR_TOO_MANY = -7,
+};
+
+struct Store;
+extern "C" {
+Store* store_create(const char* name, uint64_t capacity);
+Store* store_attach(const char* name);
+void store_detach(Store* s);
+void store_destroy(Store* s);
+int64_t store_create_object(Store* s, const uint8_t* id, uint64_t size);
+int64_t store_create_object_ex(Store* s, const uint8_t* id,
+                               uint64_t size, int allow_evict);
+int store_lru_candidate(Store* s, uint8_t* out_id);
+int store_seal(Store* s, const uint8_t* id);
+int store_get(Store* s, const uint8_t* id, int64_t timeout_ms,
+              uint64_t* out_offset, uint64_t* out_size);
+int store_release(Store* s, const uint8_t* id);
+int store_delete(Store* s, const uint8_t* id);
+int store_contains(Store* s, const uint8_t* id);
+void store_stats(Store* s, uint64_t* bytes_in_use, uint64_t* num_objects,
+                 uint64_t* num_evictions, uint64_t* capacity);
+uint8_t* store_base(Store* s);
+}
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (0)
+
+constexpr int kIdSize = 24;   // ObjectID width (matches shm_store.cc)
+
+static void make_id(uint8_t* id, uint64_t n) {
+  memset(id, 0, kIdSize);
+  memcpy(id, &n, sizeof(n));
+}
+
+static void test_lifecycle(const char* seg) {
+  Store* s = store_create(seg, 1 << 20);
+  CHECK(s != nullptr);
+  uint8_t id[kIdSize];
+  make_id(id, 1);
+  int64_t off = store_create_object(s, id, 1000);
+  CHECK(off >= 0);
+  memset(store_base(s) + off, 0xAB, 1000);
+  CHECK(store_create_object(s, id, 10) == SHM_ERR_EXISTS);
+  // unsealed objects are not gettable (STATE), absent ids NOT_FOUND
+  uint64_t goff = 0, gsize = 0;
+  CHECK(store_get(s, id, 0, &goff, &gsize) == SHM_ERR_STATE);
+  uint8_t absent[kIdSize];
+  make_id(absent, 31337);
+  CHECK(store_get(s, absent, 0, &goff, &gsize) == SHM_ERR_NOT_FOUND);
+  CHECK(store_seal(s, id) == SHM_OK);
+  CHECK(store_seal(s, id) != SHM_OK);      // double seal rejected
+  CHECK(store_get(s, id, 0, &goff, &gsize) == SHM_OK);
+  CHECK(gsize == 1000);
+  for (int i = 0; i < 1000; i++) CHECK(store_base(s)[goff + i] == 0xAB);
+  CHECK(store_contains(s, id) == 1);
+  uint64_t in_use, nobj, nevict, cap;
+  store_stats(s, &in_use, &nobj, &nevict, &cap);
+  CHECK(nobj == 1 && in_use >= 1000 && cap == (1 << 20));
+  // refcount held: delete must not free under the reader
+  CHECK(store_release(s, id) == SHM_OK);
+  CHECK(store_delete(s, id) == SHM_OK);
+  CHECK(store_contains(s, id) == 0);
+  uint8_t missing[kIdSize];
+  make_id(missing, 999);
+  CHECK(store_delete(s, missing) == SHM_ERR_NOT_FOUND);
+  store_destroy(s);
+  printf("lifecycle: OK\n");
+}
+
+static void test_capacity_and_lru(const char* seg) {
+  Store* s = store_create(seg, 64 * 1024);
+  CHECK(s != nullptr);
+  uint8_t id[kIdSize];
+  // fill with sealed, released objects
+  uint64_t n = 0;
+  for (;; n++) {
+    make_id(id, n);
+    int64_t off = store_create_object_ex(s, id, 8 * 1024, 0);
+    if (off < 0) {
+      CHECK(off == SHM_ERR_FULL);
+      break;
+    }
+    CHECK(store_seal(s, id) == SHM_OK);
+  }
+  CHECK(n >= 6);                      // ~8 fit, minus headers
+  uint8_t victim[kIdSize];
+  CHECK(store_lru_candidate(s, victim) == SHM_OK);
+  uint64_t first;
+  memcpy(&first, victim, sizeof(first));
+  CHECK(first == 0);                  // oldest seal = LRU
+  // touching object 0 via get moves it off the LRU position
+  uint64_t goff, gsize;
+  make_id(id, 0);
+  CHECK(store_get(s, id, 0, &goff, &gsize) == SHM_OK);
+  CHECK(store_release(s, id) == SHM_OK);
+  CHECK(store_lru_candidate(s, victim) == SHM_OK);
+  memcpy(&first, victim, sizeof(first));
+  CHECK(first == 1);
+  // allow_evict=1 reclaims space automatically
+  make_id(id, 1000);
+  CHECK(store_create_object_ex(s, id, 8 * 1024, 1) >= 0);
+  CHECK(store_seal(s, id) == SHM_OK);
+  store_destroy(s);
+  printf("capacity+lru: OK\n");
+}
+
+static void test_blocking_get(const char* seg) {
+  Store* s = store_create(seg, 1 << 20);
+  CHECK(s != nullptr);
+  uint8_t id[kIdSize];
+  make_id(id, 42);
+  uint64_t goff, gsize;
+  // timeout path
+  CHECK(store_get(s, id, 50, &goff, &gsize) == SHM_ERR_TIMEOUT);
+  std::thread producer([&] {
+    usleep(100 * 1000);
+    CHECK(store_create_object(s, id, 64) >= 0);
+    CHECK(store_seal(s, id) == SHM_OK);
+  });
+  CHECK(store_get(s, id, 5000, &goff, &gsize) == SHM_OK);
+  CHECK(gsize == 64);
+  producer.join();
+  CHECK(store_release(s, id) == SHM_OK);
+  store_destroy(s);
+  printf("blocking get: OK\n");
+}
+
+static void test_threaded(const char* seg) {
+  Store* s = store_create(seg, 8 << 20);
+  CHECK(s != nullptr);
+  constexpr int kThreads = 8, kIters = 200;
+  std::atomic<int> failures{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; t++) {
+    ts.emplace_back([&, t] {
+      uint8_t id[kIdSize];
+      for (int i = 0; i < kIters; i++) {
+        uint64_t key = (uint64_t)t * 1000000 + i;
+        make_id(id, key);
+        int64_t off = store_create_object(s, id, 512);
+        if (off < 0) {
+          failures++;
+          continue;
+        }
+        memset(store_base(s) + off, t + 1, 512);
+        if (store_seal(s, id) != SHM_OK) failures++;
+        uint64_t goff, gsize;
+        if (store_get(s, id, 1000, &goff, &gsize) != SHM_OK ||
+            gsize != 512 || store_base(s)[goff] != t + 1 ||
+            store_base(s)[goff + 511] != t + 1) {
+          failures++;
+        } else {
+          store_release(s, id);
+        }
+        if (i % 2 == 0 && store_delete(s, id) != SHM_OK) failures++;
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  CHECK(failures.load() == 0);
+  uint64_t in_use, nobj, nevict, cap;
+  store_stats(s, &in_use, &nobj, &nevict, &cap);
+  CHECK(nobj == kThreads * kIters / 2);   // odd i survive
+  store_destroy(s);
+  printf("threaded producers/consumers: OK\n");
+}
+
+static void test_killed_child(const char* seg) {
+  // A child hammering the store is SIGKILLed mid-traffic; the parent
+  // must keep operating (robust mutex recovers an owner-died lock).
+  Store* s = store_create(seg, 4 << 20);
+  CHECK(s != nullptr);
+  for (int round = 0; round < 3; round++) {
+    pid_t pid = fork();
+    if (pid == 0) {
+      Store* c = store_attach(seg);
+      if (!c) _exit(1);
+      uint8_t id[kIdSize];
+      for (uint64_t i = 0;; i++) {
+        make_id(id, 500000 + (i % 64));
+        int64_t off = store_create_object(c, id, 256);
+        if (off >= 0) {
+          store_seal(c, id);
+          store_delete(c, id);
+        }
+      }
+    }
+    usleep(30 * 1000);
+    kill(pid, SIGKILL);
+    waitpid(pid, nullptr, 0);
+    // parent traffic must continue cleanly
+    uint8_t id[kIdSize];
+    for (int i = 0; i < 50; i++) {
+      make_id(id, 700000 + round * 100 + i);
+      int64_t off = store_create_object(s, id, 128);
+      CHECK(off >= 0);
+      CHECK(store_seal(s, id) == SHM_OK);
+      uint64_t goff, gsize;
+      CHECK(store_get(s, id, 1000, &goff, &gsize) == SHM_OK);
+      store_release(s, id);
+      CHECK(store_delete(s, id) == SHM_OK);
+    }
+  }
+  store_destroy(s);
+  printf("SIGKILLed child recovery: OK\n");
+}
+
+int main() {
+  char seg[64];
+  snprintf(seg, sizeof(seg), "/shmtest_%d", (int)getpid());
+  test_lifecycle(seg);
+  test_capacity_and_lru(seg);
+  test_blocking_get(seg);
+  test_threaded(seg);
+  test_killed_child(seg);
+  printf("ALL STORE TESTS PASSED\n");
+  return 0;
+}

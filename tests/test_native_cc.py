@@ -1,0 +1,32 @@
+"""Builds and runs the native C++ test suite under ASan+UBSan
+(`make -C src test`), the role of the reference's *_test.cc files +
+.bazelrc asan config. The tsan variant (`make -C src test-tsan`) is
+exercised too; both must pass cleanly for the shm store and metrics
+registry — the runtime's two native components."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src")
+
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+@needs_gxx
+@pytest.mark.parametrize("target", ["test", "test-tsan"])
+def test_native_suite(target):
+    proc = subprocess.run(
+        ["make", "-C", _SRC, target],
+        capture_output=True, text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    assert "ALL STORE TESTS PASSED" in out
+    assert "ALL METRICS TESTS PASSED" in out
+    for bad in ("AddressSanitizer", "ThreadSanitizer",
+                "UndefinedBehaviorSanitizer", "runtime error"):
+        assert bad not in out, out[-4000:]
