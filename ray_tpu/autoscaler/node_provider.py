@@ -147,3 +147,183 @@ class FakeMultiNodeProvider(NodeProvider):
             rec = self._nodes.pop(node_id, None)
         if rec is not None:
             self._nm.kill_worker(rec["worker_id"])
+
+
+# ---------------------------------------------------------------------------
+# TPU pod/slice provider
+# ---------------------------------------------------------------------------
+
+# accelerator type -> (hosts per slice, chips per host). Slice topology
+# table for the TPU generations this framework targets; a slice is the
+# atomic provisioning unit (you cannot get half an ICI domain).
+TPU_TOPOLOGIES: Dict[str, Any] = {
+    "v4-8":    (1, 4),
+    "v4-16":   (2, 4),
+    "v4-32":   (4, 4),
+    "v5e-1":   (1, 1),
+    "v5e-4":   (1, 4),
+    "v5e-8":   (2, 4),
+    "v5e-16":  (4, 4),
+    "v5e-32":  (8, 4),
+    "v5p-8":   (1, 4),
+    "v5p-16":  (2, 4),
+}
+
+QR_PROVISIONING = "PROVISIONING"
+QR_READY = "READY"
+QR_DELETING = "DELETING"
+
+
+class SimulatedTPUCloud:
+    """Simulated queued-resource backend with the request/response
+    shape of the Cloud TPU API (queued resources: create -> PROVISIONING
+    -> READY; delete -> DELETING -> gone). Stands in for the real API
+    in this environment; a production backend implements the same four
+    methods against the TPU REST surface (SURVEY.md §7 step 9 allows a
+    simulated backend as the design artifact).
+
+    ``provision_delay_s`` models slice spin-up; ``capacity`` models
+    stockouts per accelerator type (create beyond it parks the queued
+    resource in PROVISIONING forever — exactly how real stockouts
+    surface)."""
+
+    def __init__(self, provision_delay_s: float = 0.0,
+                 capacity: Optional[Dict[str, int]] = None):
+        self._lock = threading.Lock()
+        self._delay = provision_delay_s
+        self._capacity = dict(capacity or {})
+        self._qrs: Dict[str, Dict[str, Any]] = {}
+        self._subnet = 0     # monotonic: deleted slices never reuse IPs
+
+    def create_queued_resource(self, name: str, accelerator_type: str
+                               ) -> Dict[str, Any]:
+        if accelerator_type not in TPU_TOPOLOGIES:
+            raise ValueError(
+                f"unknown accelerator_type {accelerator_type!r}")
+        hosts, chips = TPU_TOPOLOGIES[accelerator_type]
+        with self._lock:
+            if name in self._qrs:
+                raise ValueError(f"queued resource {name!r} exists")
+            subnet = self._subnet
+            self._subnet += 1
+            self._qrs[name] = {
+                "name": name,
+                "accelerator_type": accelerator_type,
+                "state": QR_PROVISIONING,
+                "create_time": time.time(),
+                "node_ips": [
+                    f"10.{128 + subnet // 256}.{subnet % 256}.{h}"
+                    for h in range(hosts)],
+                "hosts": hosts,
+                "chips_per_host": chips,
+            }
+            return dict(self._qrs[name])
+
+    def _ready_count(self, accel: str) -> int:
+        return sum(1 for q in self._qrs.values()
+                   if q["accelerator_type"] == accel and
+                   q["state"] == QR_READY)
+
+    def describe(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            q = self._qrs.get(name)
+            if q is None:
+                return None
+            if q["state"] == QR_PROVISIONING and \
+                    time.time() - q["create_time"] >= self._delay:
+                cap = self._capacity.get(q["accelerator_type"])
+                if cap is None or self._ready_count(
+                        q["accelerator_type"]) < cap:
+                    q["state"] = QR_READY
+            return dict(q)
+
+    def delete_queued_resource(self, name: str) -> None:
+        with self._lock:
+            self._qrs.pop(name, None)
+
+    def list_queued_resources(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            names = list(self._qrs)
+        out = [self.describe(n) for n in names]
+        return [q for q in out if q is not None]
+
+
+class TPUPodProvider(NodeProvider):
+    """Slice-granular TPU provider (reference role:
+    python/ray/autoscaler/_private/gcp/node_provider.py + tpu.py —
+    re-designed TPU-first): one autoscaler "node" IS one ICI slice
+    (all its hosts), provisioned and terminated atomically through a
+    queued-resource backend. Scaling never splits an ICI domain, so a
+    launched node always carries a usable collective mesh.
+
+    ``node_type`` names must be accelerator types from TPU_TOPOLOGIES
+    (e.g. "v5e-16"). Use :func:`tpu_node_types` to generate the
+    matching ``available_node_types`` autoscaler config."""
+
+    def __init__(self, cloud: Optional[SimulatedTPUCloud] = None,
+                 name_prefix: str = "raytpu"):
+        self.cloud = cloud or SimulatedTPUCloud()
+        self._prefix = name_prefix
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, str] = {}   # node_id -> accelerator type
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            ids = list(self._nodes)
+        return [nid for nid in ids
+                if self.cloud.describe(nid) is not None]
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        q = self.cloud.describe(node_id)
+        with self._lock:
+            accel = self._nodes.get(node_id, "?")
+        status = STATUS_UP if q and q["state"] == QR_READY \
+            else STATUS_PENDING
+        return {TAG_NODE_TYPE: accel, TAG_NODE_STATUS: status}
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    count: int = 1) -> List[str]:
+        created = []
+        for _ in range(count):
+            nid = f"{self._prefix}-{node_type}-{uuid.uuid4().hex[:6]}"
+            self.cloud.create_queued_resource(nid, node_type)
+            with self._lock:
+                self._nodes[nid] = node_type
+            created.append(nid)
+        return created
+
+    def terminate_node(self, node_id: str) -> None:
+        self.cloud.delete_queued_resource(node_id)
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def is_running(self, node_id: str) -> bool:
+        q = self.cloud.describe(node_id)
+        return bool(q and q["state"] == QR_READY)
+
+    def internal_ip(self, node_id: str) -> Optional[str]:
+        q = self.cloud.describe(node_id)
+        return q["node_ips"][0] if q else None
+
+    def slice_hosts(self, node_id: str) -> List[str]:
+        """All host IPs of the slice (the gang bootstrap endpoint
+        list: host 0 is the jax.distributed coordinator)."""
+        q = self.cloud.describe(node_id)
+        return list(q["node_ips"]) if q else []
+
+
+def tpu_node_types(*accelerator_types: str,
+                   cpus_per_host: int = 96,
+                   max_workers: int = 4) -> Dict[str, Dict[str, Any]]:
+    """``available_node_types`` entries for accelerator types: the
+    node's resource shape is the WHOLE slice (TPU = total chips), so
+    the demand scheduler bin-packs gang demands onto slices."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for accel in accelerator_types:
+        hosts, chips = TPU_TOPOLOGIES[accel]
+        out[accel] = {
+            "resources": {"TPU": float(hosts * chips),
+                          "CPU": float(cpus_per_host * hosts)},
+            "max_workers": max_workers,
+        }
+    return out

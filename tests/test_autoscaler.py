@@ -219,3 +219,59 @@ def test_autoscaling_cluster_e2e():
         while time.time() < deadline and asc.num_nodes() > 0:
             time.sleep(0.2)
         assert asc.num_nodes() == 0
+
+
+# ---- TPU pod/slice provider ----------------------------------------------
+
+def test_tpu_pod_provider_slice_lifecycle():
+    from ray_tpu.autoscaler.node_provider import (
+        STATUS_PENDING, STATUS_UP, SimulatedTPUCloud, TPUPodProvider,
+        TAG_NODE_STATUS)
+    cloud = SimulatedTPUCloud(provision_delay_s=0.2)
+    p = TPUPodProvider(cloud)
+    (nid,) = p.create_node("v5e-16", {"TPU": 16}, 1)
+    assert p.node_tags(nid)[TAG_NODE_STATUS] == STATUS_PENDING
+    assert not p.is_running(nid)
+    time.sleep(0.25)
+    assert p.node_tags(nid)[TAG_NODE_STATUS] == STATUS_UP
+    assert p.is_running(nid)
+    # slice-granular: one node = 4 hosts (whole ICI domain)
+    hosts = p.slice_hosts(nid)
+    assert len(hosts) == 4 and p.internal_ip(nid) == hosts[0]
+    p.terminate_node(nid)
+    assert p.non_terminated_nodes() == []
+
+
+def test_tpu_pod_provider_stockout_stays_pending():
+    from ray_tpu.autoscaler.node_provider import (SimulatedTPUCloud,
+                                                  TPUPodProvider)
+    cloud = SimulatedTPUCloud(capacity={"v5e-8": 1})
+    p = TPUPodProvider(cloud)
+    a, b = p.create_node("v5e-8", {"TPU": 8}, 2)
+    time.sleep(0.05)
+    # only one slice has capacity; the other is stockout-pending
+    assert sorted([p.is_running(a), p.is_running(b)]) == [False, True]
+
+
+def test_autoscaler_scales_tpu_slices():
+    from ray_tpu.autoscaler.node_provider import (SimulatedTPUCloud,
+                                                  TPUPodProvider,
+                                                  tpu_node_types)
+    provider = TPUPodProvider(SimulatedTPUCloud())
+    config = {
+        "max_workers": 8,
+        "idle_timeout_s": 0.2,
+        "available_node_types": tpu_node_types("v5e-8", "v5e-16"),
+    }
+    auto = StandardAutoscaler(config, provider)
+    # a 16-chip gang demand launches ONE v5e-16 slice, not two v5e-8s
+    auto.load_metrics.update({
+        "pending_demands": [{"TPU": 16}], "nodes": []})
+    auto.update()
+    assert auto.summary()["nodes_by_type"] == {"v5e-16": 1}
+    # an 8-chip demand on top launches the smaller slice
+    auto.load_metrics.update({
+        "pending_demands": [{"TPU": 16}, {"TPU": 8}], "nodes": []})
+    auto.update()
+    counts = auto.summary()["nodes_by_type"]
+    assert counts == {"v5e-16": 1, "v5e-8": 1}
