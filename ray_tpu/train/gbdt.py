@@ -1,10 +1,12 @@
-"""Tree/estimator trainers: sklearn first-class, xgboost/lightgbm gated.
+"""Tree/estimator trainers: sklearn, xgboost-API, lightgbm-API.
 
 Capability parity with the reference's GBDT + sklearn trainers
 (python/ray/train/xgboost/, lightgbm/, sklearn/ — a Trainer that fits
-an estimator on a Dataset and emits a framework Checkpoint). xgboost and
-lightgbm are not in this image, so those trainer classes raise a clear
-ImportError at construction; SklearnTrainer carries the shared shape.
+an estimator on a Dataset and emits a framework Checkpoint).
+XGBoostTrainer/LightGBMTrainer accept their libraries' params dicts
+and run on sklearn's histogram-GBDT engine when the native package is
+absent (as in this image), or pass through to the real library when
+it is importable.
 """
 from __future__ import annotations
 
@@ -55,37 +57,124 @@ class SklearnTrainer:
                       metrics_history=[metrics])
 
 
-def _gated(name: str, module: str):
-    class _GatedTrainer:
-        def __init__(self, *a, **kw):
-            raise ImportError(
-                f"{name} requires {module!r}, which is not available "
-                f"in this environment; use SklearnTrainer (e.g. "
-                f"HistGradientBoostingRegressor/Classifier) instead.")
-    _GatedTrainer.__name__ = name
-    return _GatedTrainer
+class _GBDTTrainer:
+    """Shared engine for the GBDT trainer API (reference:
+    train/xgboost/xgboost_trainer.py, train/lightgbm/lightgbm_trainer.py:
+    params dict + num_boost_round + datasets -> fitted booster +
+    Checkpoint + per-dataset eval metrics).
+
+    The tree engine is sklearn's histogram-based GBDT (the same
+    algorithm family LightGBM introduced and XGBoost's `hist` mode
+    uses), so these trainers WORK in this environment; when the real
+    xgboost/lightgbm package is importable it is used instead and the
+    params pass through natively."""
+
+    #: subclass hooks: params-dict translation + native passthrough
+    _param_map: Dict[str, str] = {}
+    _native_module = ""
+    _native_classes = ("", "")       # (classifier, regressor) names
+
+    def __init__(self, *, params: Optional[Dict[str, Any]] = None,
+                 num_boost_round: int = 100,
+                 datasets: Dict[str, Any], label_column: str,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.params = dict(params or {})
+        self.num_boost_round = num_boost_round
+        self.datasets = datasets
+        self.label_column = label_column
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    # -- objective handling -------------------------------------------
+
+    def _is_classification(self) -> bool:
+        # xgboost objectives are "<task>:<loss>" (reg:logistic is
+        # REGRESSION); lightgbm uses bare names. Classification iff
+        # the task prefix says so.
+        obj = str(self.params.get("objective", ""))
+        task = obj.split(":", 1)[0]
+        return task in ("binary", "multi", "multiclass",
+                        "multiclassova")
+
+    def _make_estimator(self):
+        from sklearn.ensemble import (HistGradientBoostingClassifier,
+                                      HistGradientBoostingRegressor)
+        kwargs: Dict[str, Any] = {"max_iter": self.num_boost_round}
+        for theirs, ours in self._param_map.items():
+            if theirs in self.params:
+                kwargs[ours] = self.params[theirs]
+        cls = HistGradientBoostingClassifier \
+            if self._is_classification() \
+            else HistGradientBoostingRegressor
+        return cls(**kwargs)
+
+    def _make_native_or_fallback(self):
+        import importlib
+        try:
+            mod = importlib.import_module(self._native_module)
+        except ImportError:
+            return self._make_estimator()
+        name = self._native_classes[0] if self._is_classification() \
+            else self._native_classes[1]     # pragma: no cover
+        cls = getattr(mod, name)             # pragma: no cover
+        return cls(n_estimators=self.num_boost_round, **{
+            k: v for k, v in self.params.items()
+            if k != "objective"})            # pragma: no cover
+
+    def _metric(self, est, X, y) -> Dict[str, float]:
+        if self._is_classification():
+            return {"error": float(1.0 - est.score(X, y))}
+        pred = est.predict(X)
+        return {"rmse": float(np.sqrt(np.mean((pred - y) ** 2)))}
+
+    def fit(self) -> Result:
+        from ray_tpu._private.usage_stats import record_library_usage
+        record_library_usage("train")
+        X, y = _dataset_to_xy(self.datasets["train"],
+                              self.label_column)
+        est = self._make_native_or_fallback()
+        est.fit(X, y)
+        metrics: Dict[str, Any] = {
+            f"train-{k}": v for k, v in self._metric(est, X, y).items()}
+        valid = self.datasets.get("valid")
+        if valid is not None:
+            Xv, yv = _dataset_to_xy(valid, self.label_column)
+            metrics.update({f"valid-{k}": v
+                            for k, v in self._metric(
+                                est, Xv, yv).items()})
+        ckpt = Checkpoint.from_dict({"estimator": est,
+                                     "params": dict(self.params)})
+        return Result(metrics=metrics, checkpoint=ckpt,
+                      metrics_history=[metrics])
+
+    @staticmethod
+    def get_model(checkpoint: Checkpoint):
+        """The fitted booster/estimator out of a Checkpoint
+        (reference: XGBoostTrainer.get_model)."""
+        return checkpoint.to_dict()["estimator"]
 
 
-try:
-    import xgboost  # noqa: F401
-    _HAS_XGB = True
-except ImportError:
-    _HAS_XGB = False
+class XGBoostTrainer(_GBDTTrainer):
+    """xgboost-API trainer (params: objective/eta/max_depth/...);
+    runs on sklearn's histogram GBDT when xgboost is absent."""
+    _param_map = {"eta": "learning_rate",
+                  "learning_rate": "learning_rate",
+                  "max_depth": "max_depth",
+                  "reg_lambda": "l2_regularization",
+                  "lambda": "l2_regularization",
+                  "min_child_weight": "min_samples_leaf"}
+    _native_module = "xgboost"
+    _native_classes = ("XGBClassifier", "XGBRegressor")
 
-if not _HAS_XGB:
-    XGBoostTrainer = _gated("XGBoostTrainer", "xgboost")
-else:   # pragma: no cover - xgboost not in this image
-    class XGBoostTrainer(SklearnTrainer):
-        pass
 
-try:
-    import lightgbm  # noqa: F401
-    _HAS_LGBM = True
-except ImportError:
-    _HAS_LGBM = False
-
-if not _HAS_LGBM:
-    LightGBMTrainer = _gated("LightGBMTrainer", "lightgbm")
-else:   # pragma: no cover
-    class LightGBMTrainer(SklearnTrainer):
-        pass
+class LightGBMTrainer(_GBDTTrainer):
+    """lightgbm-API trainer (params: objective/num_leaves/...);
+    runs on sklearn's histogram GBDT when lightgbm is absent."""
+    _param_map = {"learning_rate": "learning_rate",
+                  "num_leaves": "max_leaf_nodes",
+                  "max_depth": "max_depth",
+                  "lambda_l2": "l2_regularization",
+                  "min_data_in_leaf": "min_samples_leaf"}
+    _native_module = "lightgbm"
+    _native_classes = ("LGBMClassifier", "LGBMRegressor")
