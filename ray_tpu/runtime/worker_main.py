@@ -218,10 +218,22 @@ class Executor:
             cache = self._fn_cache = {}
         func = cache.get(fn_ref)
         if func is None:
-            blob = self.head.call("get_function", fn_ref)
-            if blob is None:
-                raise RuntimeError(f"unknown function {fn_ref}")
-            func = cache[fn_ref] = cloudpickle.loads(blob)
+            if isinstance(fn_ref, str) and \
+                    fn_ref.startswith("import://"):
+                # Cross-language task (reference: C++/Java task specs
+                # name functions, core_worker cross_language path): the
+                # spec carries an import path instead of a pickled
+                # closure, so non-Python clients can submit work.
+                import importlib
+                mod_name, _, attr = \
+                    fn_ref[len("import://"):].partition(":")
+                func = getattr(importlib.import_module(mod_name), attr)
+            else:
+                blob = self.head.call("get_function", fn_ref)
+                if blob is None:
+                    raise RuntimeError(f"unknown function {fn_ref}")
+                func = cloudpickle.loads(blob)
+            cache[fn_ref] = func
         return func
 
     def _run_task(self, spec) -> str:

@@ -1,0 +1,28 @@
+"""Cross-language task targets (reference: the cross_language function
+descriptors C++/Java tasks name, src/ray/core_worker cross-language
+path). Any importable "module:function" works as a C++ `Submit`
+target; these are the in-repo examples the demo and tests use. Args
+and return values must be plain data (None/bool/int/float/str/bytes/
+list/tuple/dict) — the C++ pickle codec rejects code objects by
+design."""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+
+def square(x: int) -> int:
+    return x * x
+
+
+def describe(xs: List[float]) -> Dict[str, Any]:
+    xs = list(xs)
+    return {"n": len(xs), "sum": float(sum(xs)),
+            "min": min(xs), "max": max(xs)}
+
+
+def echo(value: Any) -> Any:
+    return value
+
+
+def boom() -> None:
+    raise RuntimeError("cross-lang failure example")

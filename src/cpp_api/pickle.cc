@@ -1,0 +1,526 @@
+#include "pickle.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+
+namespace raytpu {
+
+// ---- Value ---------------------------------------------------------------
+
+Value Value::Bool(bool b) {
+  Value v;
+  v.kind_ = Kind::kBool;
+  v.b_ = b;
+  return v;
+}
+Value Value::Int(int64_t i) {
+  Value v;
+  v.kind_ = Kind::kInt;
+  v.i_ = i;
+  return v;
+}
+Value Value::Float(double f) {
+  Value v;
+  v.kind_ = Kind::kFloat;
+  v.f_ = f;
+  return v;
+}
+Value Value::Str(std::string s) {
+  Value v;
+  v.kind_ = Kind::kStr;
+  v.s_ = std::move(s);
+  return v;
+}
+Value Value::Bytes(std::string b) {
+  Value v;
+  v.kind_ = Kind::kBytes;
+  v.s_ = std::move(b);
+  return v;
+}
+Value Value::List(ValueList items) {
+  Value v;
+  v.kind_ = Kind::kList;
+  v.seq_ = std::make_shared<ValueList>(std::move(items));
+  return v;
+}
+Value Value::Tuple(ValueList items) {
+  Value v;
+  v.kind_ = Kind::kTuple;
+  v.seq_ = std::make_shared<ValueList>(std::move(items));
+  return v;
+}
+Value Value::Dict(ValueDict items) {
+  Value v;
+  v.kind_ = Kind::kDict;
+  v.map_ = std::make_shared<ValueDict>(std::move(items));
+  return v;
+}
+
+static void TypeError(const char* want, Value::Kind got) {
+  throw std::runtime_error(std::string("pickle Value: wanted ") + want +
+                           ", got kind " +
+                           std::to_string(static_cast<int>(got)));
+}
+
+bool Value::as_bool() const {
+  if (kind_ != Kind::kBool) TypeError("bool", kind_);
+  return b_;
+}
+int64_t Value::as_int() const {
+  if (kind_ == Kind::kBool) return b_ ? 1 : 0;
+  if (kind_ != Kind::kInt) TypeError("int", kind_);
+  return i_;
+}
+double Value::as_float() const {
+  if (kind_ == Kind::kInt) return static_cast<double>(i_);
+  if (kind_ != Kind::kFloat) TypeError("float", kind_);
+  return f_;
+}
+const std::string& Value::as_str() const {
+  if (kind_ != Kind::kStr) TypeError("str", kind_);
+  return s_;
+}
+const std::string& Value::as_bytes() const {
+  if (kind_ != Kind::kBytes && kind_ != Kind::kStr)
+    TypeError("bytes", kind_);
+  return s_;
+}
+const ValueList& Value::items() const {
+  if (kind_ != Kind::kList && kind_ != Kind::kTuple)
+    TypeError("list/tuple", kind_);
+  return *seq_;
+}
+const ValueDict& Value::dict() const {
+  if (kind_ != Kind::kDict) TypeError("dict", kind_);
+  return *map_;
+}
+
+const Value* Value::find(const std::string& key) const {
+  for (const auto& kv : dict()) {
+    if (kv.first.kind() == Kind::kStr && kv.first.as_str() == key)
+      return &kv.second;
+  }
+  return nullptr;
+}
+
+const Value& Value::at(const std::string& key) const {
+  const Value* v = find(key);
+  if (!v) throw std::runtime_error("pickle dict: missing key " + key);
+  return *v;
+}
+
+std::string Value::Repr() const {
+  switch (kind_) {
+    case Kind::kNone: return "None";
+    case Kind::kBool: return b_ ? "True" : "False";
+    case Kind::kInt: return std::to_string(i_);
+    case Kind::kFloat: return std::to_string(f_);
+    case Kind::kStr: return "'" + s_ + "'";
+    case Kind::kBytes: return "b<" + std::to_string(s_.size()) + ">";
+    case Kind::kList:
+    case Kind::kTuple: {
+      std::string out = kind_ == Kind::kList ? "[" : "(";
+      for (const auto& e : *seq_) out += e.Repr() + ", ";
+      return out + (kind_ == Kind::kList ? "]" : ")");
+    }
+    case Kind::kDict: {
+      std::string out = "{";
+      for (const auto& kv : *map_)
+        out += kv.first.Repr() + ": " + kv.second.Repr() + ", ";
+      return out + "}";
+    }
+  }
+  return "?";
+}
+
+// ---- encoder (protocol 2) ------------------------------------------------
+
+namespace {
+
+void PutU32(std::string& out, uint32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);
+  out.append(b, 4);
+}
+
+void Encode(std::string& out, const Value& v) {
+  switch (v.kind()) {
+    case Value::Kind::kNone:
+      out.push_back('N');
+      break;
+    case Value::Kind::kBool:
+      out.push_back(v.as_bool() ? char(0x88) : char(0x89));
+      break;
+    case Value::Kind::kInt: {
+      int64_t i = v.as_int();
+      if (i >= 0 && i < 256) {
+        out.push_back('K');
+        out.push_back(static_cast<char>(i));
+      } else if (i >= INT32_MIN && i <= INT32_MAX) {
+        out.push_back('J');
+        PutU32(out, static_cast<uint32_t>(static_cast<int32_t>(i)));
+      } else {
+        out.push_back(char(0x8a));     // LONG1
+        out.push_back(8);
+        char b[8];
+        memcpy(b, &i, 8);
+        out.append(b, 8);
+      }
+      break;
+    }
+    case Value::Kind::kFloat: {
+      out.push_back('G');              // BINFLOAT: big-endian double
+      double d = v.as_float();
+      uint64_t bits;
+      memcpy(&bits, &d, 8);
+      for (int s = 56; s >= 0; s -= 8)
+        out.push_back(static_cast<char>((bits >> s) & 0xff));
+      break;
+    }
+    case Value::Kind::kStr: {
+      const std::string& s = v.as_str();
+      if (s.size() < 256) {
+        out.push_back(char(0x8c));     // SHORT_BINUNICODE
+        out.push_back(static_cast<char>(s.size()));
+      } else {
+        out.push_back('X');            // BINUNICODE
+        PutU32(out, static_cast<uint32_t>(s.size()));
+      }
+      out += s;
+      break;
+    }
+    case Value::Kind::kBytes: {
+      const std::string& s = v.as_bytes();
+      if (s.size() < 256) {
+        out.push_back('C');            // SHORT_BINBYTES
+        out.push_back(static_cast<char>(s.size()));
+      } else {
+        out.push_back('B');            // BINBYTES
+        PutU32(out, static_cast<uint32_t>(s.size()));
+      }
+      out += s;
+      break;
+    }
+    case Value::Kind::kList: {
+      out.push_back(']');
+      out.push_back('(');
+      for (const auto& e : v.items()) Encode(out, e);
+      out.push_back('e');              // APPENDS
+      break;
+    }
+    case Value::Kind::kTuple: {
+      const auto& items = v.items();
+      if (items.empty()) {
+        out.push_back(')');
+      } else {
+        out.push_back('(');
+        for (const auto& e : items) Encode(out, e);
+        out.push_back('t');
+      }
+      break;
+    }
+    case Value::Kind::kDict: {
+      out.push_back('}');
+      out.push_back('(');
+      for (const auto& kv : v.dict()) {
+        Encode(out, kv.first);
+        Encode(out, kv.second);
+      }
+      out.push_back('u');              // SETITEMS
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+std::string PickleDumps(const Value& v) {
+  std::string out;
+  out.push_back(char(0x80));           // PROTO
+  out.push_back(2);
+  Encode(out, v);
+  out.push_back('.');                  // STOP
+  return out;
+}
+
+// ---- decoder -------------------------------------------------------------
+
+namespace {
+
+class Reader {
+ public:
+  explicit Reader(const std::string& d) : data_(d) {}
+
+  uint8_t U8() {
+    Need(1);
+    return static_cast<uint8_t>(data_[pos_++]);
+  }
+  uint16_t U16() {
+    Need(2);
+    uint16_t v;
+    memcpy(&v, data_.data() + pos_, 2);
+    pos_ += 2;
+    return v;
+  }
+  uint32_t U32() {
+    Need(4);
+    uint32_t v;
+    memcpy(&v, data_.data() + pos_, 4);
+    pos_ += 4;
+    return v;
+  }
+  uint64_t U64() {
+    Need(8);
+    uint64_t v;
+    memcpy(&v, data_.data() + pos_, 8);
+    pos_ += 8;
+    return v;
+  }
+  std::string Take(size_t n) {
+    Need(n);
+    std::string s = data_.substr(pos_, n);
+    pos_ += n;
+    return s;
+  }
+  std::string Line() {
+    std::string s;
+    for (;;) {
+      char c = static_cast<char>(U8());
+      if (c == '\n') return s;
+      s.push_back(c);
+    }
+  }
+  bool AtEnd() const { return pos_ >= data_.size(); }
+
+ private:
+  void Need(size_t n) {
+    if (pos_ + n > data_.size())
+      throw std::runtime_error("pickle: truncated stream");
+  }
+  const std::string& data_;
+  size_t pos_ = 0;
+};
+
+struct Mark {};     // sentinel on the unpickler stack
+
+struct StackItem {
+  bool is_mark = false;
+  Value value;
+};
+
+class Unpickler {
+ public:
+  explicit Unpickler(const std::string& d) : r_(d) {}
+
+  Value Run() {
+    for (;;) {
+      uint8_t op = r_.U8();
+      switch (op) {
+        case 0x80:                     // PROTO
+          r_.U8();
+          break;
+        case 0x95:                     // FRAME
+          r_.U64();
+          break;
+        case 'N': Push(Value::None()); break;
+        case 0x88: Push(Value::Bool(true)); break;
+        case 0x89: Push(Value::Bool(false)); break;
+        case 'K': Push(Value::Int(r_.U8())); break;
+        case 'M': Push(Value::Int(r_.U16())); break;
+        case 'J':
+          Push(Value::Int(static_cast<int32_t>(r_.U32())));
+          break;
+        case 0x8a: {                   // LONG1 (little-endian 2's cpl)
+          uint8_t n = r_.U8();
+          if (n > 8)
+            throw std::runtime_error("pickle: LONG1 too wide");
+          std::string b = r_.Take(n);
+          int64_t v = 0;
+          for (int i = 0; i < n; i++)
+            v |= static_cast<int64_t>(static_cast<uint8_t>(b[i]))
+                 << (8 * i);
+          if (n > 0 && n < 8 && (b[n - 1] & 0x80))
+            v -= (1LL << (8 * n));     // sign-extend
+          Push(Value::Int(v));
+          break;
+        }
+        case 'G': {                    // BINFLOAT big-endian
+          uint64_t bits = 0;
+          for (int i = 0; i < 8; i++) bits = (bits << 8) | r_.U8();
+          double d;
+          memcpy(&d, &bits, 8);
+          Push(Value::Float(d));
+          break;
+        }
+        case 0x8c: Push(Value::Str(r_.Take(r_.U8()))); break;
+        case 'X': Push(Value::Str(r_.Take(r_.U32()))); break;
+        case 0x8d: Push(Value::Str(r_.Take(r_.U64()))); break;
+        case 'C': Push(Value::Bytes(r_.Take(r_.U8()))); break;
+        case 'B': Push(Value::Bytes(r_.Take(r_.U32()))); break;
+        case 0x8e: Push(Value::Bytes(r_.Take(r_.U64()))); break;
+        case 0x96: {                   // BYTEARRAY8
+          Push(Value::Bytes(r_.Take(r_.U64())));
+          break;
+        }
+        case '}': Push(Value::Dict({})); break;
+        case ']': Push(Value::List({})); break;
+        case ')': Push(Value::Tuple({})); break;
+        case '(': PushMark(); break;
+        case 't': {                    // TUPLE (since mark)
+          ValueList items = PopToMark();
+          Push(Value::Tuple(std::move(items)));
+          break;
+        }
+        case 0x85: {                   // TUPLE1
+          Value a = Pop();
+          Push(Value::Tuple({a}));
+          break;
+        }
+        case 0x86: {
+          Value b = Pop(), a = Pop();
+          Push(Value::Tuple({a, b}));
+          break;
+        }
+        case 0x87: {
+          Value c = Pop(), b = Pop(), a = Pop();
+          Push(Value::Tuple({a, b, c}));
+          break;
+        }
+        case 'a': {                    // APPEND
+          Value v = Pop();
+          MutableList().push_back(std::move(v));
+          break;
+        }
+        case 'e': {                    // APPENDS
+          ValueList items = PopToMark();
+          auto& lst = MutableList();
+          for (auto& it : items) lst.push_back(std::move(it));
+          break;
+        }
+        case 's': {                    // SETITEM
+          Value v = Pop(), k = Pop();
+          MutableDict().emplace_back(std::move(k), std::move(v));
+          break;
+        }
+        case 'u': {                    // SETITEMS
+          ValueList items = PopToMark();
+          auto& d = MutableDict();
+          for (size_t i = 0; i + 1 < items.size(); i += 2)
+            d.emplace_back(std::move(items[i]),
+                           std::move(items[i + 1]));
+          break;
+        }
+        case 0x93: {                   // STACK_GLOBAL
+          // Objects (e.g. exception instances in error replies)
+          // arrive as GLOBAL + REDUCE. We cannot construct them, but
+          // we CAN represent them — class path + ctor args — so error
+          // paths surface real diagnostics instead of codec failures.
+          Value name = Pop(), module = Pop();
+          Push(Value::Str(module.as_str() + "." + name.as_str()));
+          break;
+        }
+        case 'c': {                    // GLOBAL (newline-terminated)
+          std::string module = r_.Line();
+          std::string name = r_.Line();
+          Push(Value::Str(module + "." + name));
+          break;
+        }
+        case 'R':                      // REDUCE: callable(args)
+        case 0x81: {                   // NEWOBJ: cls.__new__(cls,*a)
+          Value args = Pop(), callable = Pop();
+          Push(Value::Tuple({std::move(callable), std::move(args)}));
+          break;
+        }
+        case 'b': {                    // BUILD: obj.__setstate__(st)
+          Pop();                       // drop the state, keep the obj
+          break;
+        }
+        case 0x94:                     // MEMOIZE
+          memo_.push_back(Top());
+          break;
+        case 'q':                      // BINPUT
+          SetMemo(r_.U8());
+          break;
+        case 'r':                      // LONG_BINPUT
+          SetMemo(r_.U32());
+          break;
+        case 'h': Push(GetMemo(r_.U8())); break;      // BINGET
+        case 'j': Push(GetMemo(r_.U32())); break;     // LONG_BINGET
+        case '.':                      // STOP
+          return Pop();
+        default:
+          throw std::runtime_error(
+              "pickle: unsupported opcode 0x" + [op] {
+                char b[8];
+                snprintf(b, sizeof(b), "%02x", op);
+                return std::string(b);
+              }() + " (plain-data subset)");
+      }
+    }
+  }
+
+ private:
+  void Push(Value v) {
+    stack_.push_back({false, std::move(v)});
+  }
+  void PushMark() { stack_.push_back({true, Value()}); }
+  Value Pop() {
+    if (stack_.empty() || stack_.back().is_mark)
+      throw std::runtime_error("pickle: stack underflow");
+    Value v = std::move(stack_.back().value);
+    stack_.pop_back();
+    return v;
+  }
+  Value& Top() {
+    if (stack_.empty() || stack_.back().is_mark)
+      throw std::runtime_error("pickle: stack underflow");
+    return stack_.back().value;
+  }
+  ValueList PopToMark() {
+    ValueList items;
+    while (!stack_.empty() && !stack_.back().is_mark) {
+      items.push_back(std::move(stack_.back().value));
+      stack_.pop_back();
+    }
+    if (stack_.empty())
+      throw std::runtime_error("pickle: no mark");
+    stack_.pop_back();                 // the mark
+    std::reverse(items.begin(), items.end());
+    return items;
+  }
+  // list/dict mutation in place: the container object on the stack
+  // shares its payload via Value's shared_ptr, so memoized references
+  // observe the mutation (python memo semantics).
+  ValueList& MutableList() {
+    if (stack_.empty() || stack_.back().is_mark)
+      throw std::runtime_error("pickle: container op on empty stack");
+    return const_cast<ValueList&>(stack_.back().value.items());
+  }
+  ValueDict& MutableDict() {
+    if (stack_.empty() || stack_.back().is_mark)
+      throw std::runtime_error("pickle: container op on empty stack");
+    return const_cast<ValueDict&>(stack_.back().value.dict());
+  }
+  void SetMemo(size_t idx) {
+    if (memo_.size() <= idx) memo_.resize(idx + 1);
+    memo_[idx] = Top();
+  }
+  Value GetMemo(size_t idx) {
+    if (idx >= memo_.size())
+      throw std::runtime_error("pickle: memo miss");
+    return memo_[idx];
+  }
+
+  Reader r_;
+  std::vector<StackItem> stack_;
+  std::vector<Value> memo_;
+};
+
+}  // namespace
+
+Value PickleLoads(const std::string& data) {
+  return Unpickler(data).Run();
+}
+
+}  // namespace raytpu

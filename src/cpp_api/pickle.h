@@ -1,0 +1,76 @@
+// Minimal pickle codec for the C++ user API (the protobuf-schema role
+// of the reference's cross-language layer, adapted to this framework's
+// pickled-dict wire protocol: src/ray/core_worker/lib — C++ API — and
+// protobuf/ serve as the reference points; here C++ speaks the same
+// frames the Python runtime does, restricted to PLAIN data).
+//
+// Encoder emits protocol-2 opcodes (loadable by every Python pickle
+// version); decoder understands the opcode subset CPython/cloudpickle
+// protocol 5 emits for plain values: None/bool/int/float/str/bytes/
+// list/tuple/dict (+ FRAME/MEMOIZE/GET bookkeeping). Anything else
+// (classes, closures) raises — by design: cross-language payloads are
+// data, not code.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace raytpu {
+
+class Value;
+using ValueList = std::vector<Value>;
+using ValueDict = std::vector<std::pair<Value, Value>>;
+
+class Value {
+ public:
+  enum class Kind { kNone, kBool, kInt, kFloat, kStr, kBytes, kList,
+                    kTuple, kDict };
+
+  Value() : kind_(Kind::kNone) {}
+  static Value None() { return Value(); }
+  static Value Bool(bool b);
+  static Value Int(int64_t v);
+  static Value Float(double v);
+  static Value Str(std::string s);
+  static Value Bytes(std::string b);
+  static Value List(ValueList items);
+  static Value Tuple(ValueList items);
+  static Value Dict(ValueDict items);
+
+  Kind kind() const { return kind_; }
+  bool is_none() const { return kind_ == Kind::kNone; }
+  bool as_bool() const;
+  int64_t as_int() const;
+  double as_float() const;
+  const std::string& as_str() const;
+  const std::string& as_bytes() const;
+  const ValueList& items() const;      // list or tuple
+  const ValueDict& dict() const;
+
+  // dict convenience: value for a string key (throws if absent)
+  const Value& at(const std::string& key) const;
+  const Value* find(const std::string& key) const;
+
+  std::string Repr() const;            // debugging aid
+
+ private:
+  Kind kind_;
+  bool b_ = false;
+  int64_t i_ = 0;
+  double f_ = 0.0;
+  std::string s_;                      // str or bytes payload
+  std::shared_ptr<ValueList> seq_;
+  std::shared_ptr<ValueDict> map_;
+};
+
+// Serialize a Value as a pickle stream (protocol 2).
+std::string PickleDumps(const Value& v);
+
+// Parse a pickle stream (protocols 2-5, plain-data subset).
+Value PickleLoads(const std::string& data);
+
+}  // namespace raytpu

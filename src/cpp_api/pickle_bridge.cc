@@ -1,0 +1,30 @@
+// Interop bridge for testing the C++ pickle codec against CPython:
+// reads one length-prefixed pickle stream from stdin, decodes it with
+// the subset decoder, re-encodes with the subset encoder, writes the
+// length-prefixed result to stdout. The pytest side pipes CPython
+// protocol-5 pickles through and asserts pickle.loads(output) equals
+// the original — a true cross-boundary round trip in both directions.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "pickle.h"
+
+int main() {
+  uint32_t len;
+  if (fread(&len, 4, 1, stdin) != 1) return 2;
+  std::string in(len, '\0');
+  if (len && fread(in.data(), 1, len, stdin) != len) return 2;
+  try {
+    std::string out =
+        raytpu::PickleDumps(raytpu::PickleLoads(in));
+    uint32_t olen = static_cast<uint32_t>(out.size());
+    fwrite(&olen, 4, 1, stdout);
+    fwrite(out.data(), 1, out.size(), stdout);
+    return 0;
+  } catch (const std::exception& e) {
+    fprintf(stderr, "decode failed: %s\n", e.what());
+    return 1;
+  }
+}

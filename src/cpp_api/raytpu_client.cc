@@ -1,0 +1,305 @@
+#include "raytpu_client.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <random>
+#include <stdexcept>
+
+namespace raytpu {
+
+// ---- shm store C API (object_store/shm_store.cc) -------------------------
+
+extern "C" {
+Store* store_attach(const char* name);
+void store_detach(Store* s);
+int64_t store_create_object(Store* s, const uint8_t* id, uint64_t size);
+int store_seal(Store* s, const uint8_t* id);
+int store_get(Store* s, const uint8_t* id, int64_t timeout_ms,
+              uint64_t* out_offset, uint64_t* out_size);
+int store_release(Store* s, const uint8_t* id);
+uint8_t* store_base(Store* s);
+}
+
+// ---- framed authed RPC ---------------------------------------------------
+
+class RpcConn {
+ public:
+  RpcConn(const std::string& addr, const std::string& token)
+      : token_(token) {
+    auto colon = addr.rfind(':');
+    if (colon == std::string::npos)
+      throw std::runtime_error("bad address " + addr);
+    host_ = addr.substr(0, colon);
+    port_ = std::stoi(addr.substr(colon + 1));
+    Connect();
+  }
+  ~RpcConn() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  Value Call(const std::string& method, ValueList args) {
+    int64_t rid = ++rid_;
+    ValueDict req{
+        {Value::Str("rid"), Value::Int(rid)},
+        {Value::Str("method"), Value::Str(method)},
+        {Value::Str("args"), Value::Tuple(std::move(args))},
+        {Value::Str("kwargs"), Value::Dict({})},
+    };
+    SendFrame(PickleDumps(Value::Dict(std::move(req))));
+    Value reply = PickleLoads(RecvFrame());
+    const Value* err = reply.find("err");
+    if (err) {
+      // Exception objects decode to ('module.Class', (args...))
+      // representations (pickle.cc REDUCE handling), so the real
+      // class and message surface here.
+      throw std::runtime_error("rpc error: " + err->Repr());
+    }
+    return reply.at("ok");
+  }
+
+ private:
+  void Connect() {
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(port_));
+    if (inet_pton(AF_INET, host_.c_str(), &sa.sin_addr) != 1)
+      throw std::runtime_error("bad host " + host_);
+    if (connect(fd_, reinterpret_cast<sockaddr*>(&sa),
+                sizeof(sa)) != 0)
+      throw std::runtime_error("connect to " + host_ + " failed");
+    // HELLO: magic + version + token (rpc.py wire protocol)
+    std::string hello = "RAYT";
+    uint16_t version = 1, tlen = static_cast<uint16_t>(token_.size());
+    hello.append(reinterpret_cast<char*>(&version), 2);
+    hello.append(reinterpret_cast<char*>(&tlen), 2);
+    hello += token_;
+    SendAll(hello.data(), hello.size());
+  }
+
+  void SendAll(const char* p, size_t n) {
+    while (n > 0) {
+      ssize_t w = write(fd_, p, n);
+      if (w <= 0) throw std::runtime_error("rpc send failed");
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+  void RecvAll(char* p, size_t n) {
+    while (n > 0) {
+      ssize_t r = read(fd_, p, n);
+      if (r <= 0) throw std::runtime_error("rpc recv failed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+  void SendFrame(const std::string& payload) {
+    uint32_t len = static_cast<uint32_t>(payload.size());
+    char hdr[4];
+    memcpy(hdr, &len, 4);
+    SendAll(hdr, 4);
+    SendAll(payload.data(), payload.size());
+  }
+  std::string RecvFrame() {
+    char hdr[4];
+    RecvAll(hdr, 4);
+    uint32_t len;
+    memcpy(&len, hdr, 4);
+    std::string payload(len, '\0');
+    RecvAll(payload.data(), len);
+    return payload;
+  }
+
+  std::string host_;
+  int port_ = 0;
+  std::string token_;
+  int fd_ = -1;
+  int64_t rid_ = 0;
+};
+
+// ---- ids + serialization container ---------------------------------------
+
+namespace {
+
+constexpr int kTaskIdLen = 20;    // ids.py _TASK_ID_LEN
+constexpr int kObjectIdLen = 24;  // + 4-byte return index
+
+std::string RandomBytes(int n) {
+  static std::random_device rd;
+  static std::mt19937_64 gen(rd());
+  std::string out(n, '\0');
+  for (int i = 0; i < n; i += 8) {
+    uint64_t v = gen();
+    memcpy(out.data() + i,
+           &v, std::min(8, n - i));
+  }
+  return out;
+}
+
+std::string ToHex(const std::string& raw) {
+  static const char* kHex = "0123456789abcdef";
+  std::string out;
+  out.reserve(raw.size() * 2);
+  for (unsigned char c : raw) {
+    out.push_back(kHex[c >> 4]);
+    out.push_back(kHex[c & 0xf]);
+  }
+  return out;
+}
+
+// serialization.py dumps(): u32 nparts + int64 sizes + parts. Plain
+// values never carry out-of-band buffers, so nparts == 1 both ways.
+std::string ContainerDumps(const std::string& pickled) {
+  std::string out;
+  uint32_t nparts = 1;
+  out.append(reinterpret_cast<char*>(&nparts), 4);
+  int64_t size = static_cast<int64_t>(pickled.size());
+  out.append(reinterpret_cast<char*>(&size), 8);
+  out += pickled;
+  return out;
+}
+
+std::string ContainerPart0(const uint8_t* data, uint64_t size) {
+  if (size < 4) throw std::runtime_error("object container truncated");
+  uint32_t nparts;
+  memcpy(&nparts, data, 4);
+  if (size < 4 + 8ull * nparts)
+    throw std::runtime_error("object container truncated");
+  int64_t part0;
+  memcpy(&part0, data + 4, 8);
+  uint64_t off = 4 + 8ull * nparts;
+  if (size < off + static_cast<uint64_t>(part0))
+    throw std::runtime_error("object container truncated");
+  return std::string(reinterpret_cast<const char*>(data + off),
+                     static_cast<size_t>(part0));
+}
+
+}  // namespace
+
+std::string ObjectRef24::hex() const { return ToHex(id); }
+
+// ---- Client --------------------------------------------------------------
+
+Client::Client(const std::string& head_addr, const std::string& token) {
+  rpc_ = new RpcConn(head_addr, token);
+  store_name_ =
+      rpc_->Call("cluster_info", {}).at("store_name").as_str();
+  store_ = store_attach(store_name_.c_str());
+  if (!store_)
+    throw std::runtime_error("cannot attach shm store " + store_name_);
+}
+
+Client::~Client() {
+  if (store_) store_detach(store_);
+  delete rpc_;
+}
+
+void Client::KvPut(const std::string& key, const std::string& value) {
+  rpc_->Call("kv_put", {Value::Str(key), Value::Bytes(value)});
+}
+
+bool Client::KvGet(const std::string& key, std::string* out) {
+  Value v = rpc_->Call("kv_get", {Value::Str(key)});
+  if (v.is_none()) return false;
+  *out = v.as_bytes();
+  return true;
+}
+
+void Client::KvDel(const std::string& key) {
+  rpc_->Call("kv_del", {Value::Str(key)});
+}
+
+ObjectRef24 Client::Put(const Value& value) {
+  ObjectRef24 ref{RandomBytes(kObjectIdLen)};
+  // status-tuple container, exactly what Python readers expect
+  std::string blob = ContainerDumps(PickleDumps(
+      Value::Tuple({Value::Str("ok"), value})));
+  const uint8_t* id =
+      reinterpret_cast<const uint8_t*>(ref.id.data());
+  int64_t off = store_create_object(store_, id, blob.size());
+  if (off < 0)
+    throw std::runtime_error("store_create_object failed");
+  memcpy(store_base(store_) + off, blob.data(), blob.size());
+  if (store_seal(store_, id) != 0)
+    throw std::runtime_error("store_seal failed");
+  // multinode location registration (no-op overhead on one node)
+  rpc_->Call("register_objects",
+             {Value::Str("head"),
+              Value::List({Value::Str(ref.hex())})});
+  return ref;
+}
+
+Value Client::Get(const ObjectRef24& ref, int64_t timeout_ms) {
+  const uint8_t* id =
+      reinterpret_cast<const uint8_t*>(ref.id.data());
+  uint64_t off = 0, size = 0;
+  int rc = store_get(store_, id, timeout_ms, &off, &size);
+  if (rc != 0)
+    throw std::runtime_error("get failed rc=" + std::to_string(rc));
+  std::string part0 =
+      ContainerPart0(store_base(store_) + off, size);
+  store_release(store_, id);
+  Value tup = PickleLoads(part0);
+  const auto& items = tup.items();
+  if (items.size() != 2)
+    throw std::runtime_error("malformed result tuple");
+  if (items[0].as_str() == "err")
+    throw std::runtime_error("task failed: " + items[1].Repr());
+  return items[1];
+}
+
+ObjectRef24 Client::Submit(const std::string& fn_path, ValueList args,
+                           ValueDict kwargs, double num_cpus) {
+  std::string task_id = RandomBytes(kTaskIdLen);
+  std::string return_id = task_id + std::string("\0\0\0\0", 4);
+  std::string task_hex = ToHex(task_id);
+  Value resources = Value::Dict({
+      {Value::Str("CPU"), Value::Float(num_cpus)}});
+  Value return_ids = Value::List({Value::Bytes(return_id)});
+  ValueDict spec{
+      {Value::Str("task_id"), Value::Str(task_hex)},
+      {Value::Str("name"), Value::Str("cpp:" + fn_path)},
+      {Value::Str("fn_ref"), Value::Str("import://" + fn_path)},
+      {Value::Str("args"), Value::Tuple(std::move(args))},
+      {Value::Str("kwargs"), Value::Dict(std::move(kwargs))},
+      {Value::Str("num_returns"), Value::Int(1)},
+      {Value::Str("return_ids"), return_ids},
+      {Value::Str("resources"), resources},
+      {Value::Str("runtime_env"), Value::None()},
+      {Value::Str("trace_ctx"), Value::None()},
+  };
+  // Pin to the head node: this client's data plane is the head
+  // node's shm segment, so the result must be produced there. (A
+  // location-directory-aware Get is the multinode follow-up.)
+  Value strategy = Value::Dict({
+      {Value::Str("type"), Value::Str("node_affinity")},
+      {Value::Str("node_id"), Value::Str("head")},
+      {Value::Str("soft"), Value::Bool(false)}});
+  ValueDict meta{
+      {Value::Str("task_id"), Value::Str(task_hex)},
+      {Value::Str("return_ids"), return_ids},
+      {Value::Str("resources"), resources},
+      {Value::Str("max_retries"), Value::Int(3)},
+      {Value::Str("pg_id"), Value::None()},
+      {Value::Str("strategy"), strategy},
+  };
+  rpc_->Call("submit_task",
+             {Value::Dict(std::move(meta)),
+              Value::Bytes(PickleDumps(Value::Dict(std::move(spec))))});
+  return ObjectRef24{std::move(return_id)};
+}
+
+Value Client::ClusterResources() {
+  return rpc_->Call("cluster_resources", {});
+}
+
+}  // namespace raytpu

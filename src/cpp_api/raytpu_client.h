@@ -1,0 +1,67 @@
+// C++ user API (reference role: the C++ worker API, src/ray/core_worker/
+// lib/ + cpp/ — re-designed for this framework's architecture): a native
+// client that speaks the head's authenticated framed-pickle RPC for
+// control (kv, task submission, cluster state) and attaches the node's
+// shm object store directly for the data plane (get/put of task results
+// and objects, zero extra copies through the head).
+//
+// Tasks are cross-language: C++ submits an IMPORT PATH
+// ("module:function") plus plain-data args; a Python worker imports and
+// runs the function. Results are read back as plain data. This matches
+// the reference's cross_language task model (function descriptors, not
+// pickled closures).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "pickle.h"
+
+struct Store;    // from object_store/shm_store.cc
+
+namespace raytpu {
+
+class RpcConn;
+
+struct ObjectRef24 {
+  std::string id;      // 24 raw bytes
+  std::string hex() const;
+};
+
+class Client {
+ public:
+  // token: the cluster secret (RAY_TPU_cluster_token); empty = unauthed
+  // cluster. Connects the control plane and attaches the head node's
+  // shm segment for data.
+  Client(const std::string& head_addr, const std::string& token);
+  ~Client();
+
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // ---- KV (GCS client parity) ----------------------------------------
+  void KvPut(const std::string& key, const std::string& value);
+  // returns false if the key is absent
+  bool KvGet(const std::string& key, std::string* out);
+  void KvDel(const std::string& key);
+
+  // ---- objects --------------------------------------------------------
+  ObjectRef24 Put(const Value& value);
+  // Blocks up to timeout_ms (-1 = forever). Throws on task error.
+  Value Get(const ObjectRef24& ref, int64_t timeout_ms = -1);
+
+  // ---- tasks ----------------------------------------------------------
+  // fn_path: "package.module:function". args/kwargs are plain data.
+  ObjectRef24 Submit(const std::string& fn_path, ValueList args,
+                     ValueDict kwargs = {}, double num_cpus = 1.0);
+
+  // ---- cluster state --------------------------------------------------
+  Value ClusterResources();
+
+ private:
+  RpcConn* rpc_ = nullptr;
+  Store* store_ = nullptr;
+  std::string store_name_;
+};
+
+}  // namespace raytpu

@@ -1,0 +1,84 @@
+// End-to-end drive of the C++ user API against a live cluster:
+//   raytpu_cpp_demo <head_host:port>
+// (cluster token read from RAY_TPU_cluster_token). Exercises KV,
+// put/get through the shm data plane, cross-language task submission
+// (Python executes, C++ reads the result), and error propagation.
+#include <cstdio>
+#include <cstdlib>
+#include <stdexcept>
+#include <string>
+
+#include "raytpu_client.h"
+
+using raytpu::Client;
+using raytpu::Value;
+using raytpu::ValueList;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <head_host:port>\n", argv[0]);
+    return 2;
+  }
+  const char* tok = getenv("RAY_TPU_cluster_token");
+  Client c(argv[1], tok ? tok : "");
+
+  // ---- KV ----------------------------------------------------------
+  c.KvPut("cpp/answer", "42");
+  std::string v;
+  CHECK(c.KvGet("cpp/answer", &v) && v == "42");
+  c.KvDel("cpp/answer");
+  CHECK(!c.KvGet("cpp/answer", &v));
+  printf("kv: OK\n");
+
+  // ---- object put/get through the shm plane ------------------------
+  auto ref = c.Put(Value::Dict({
+      {Value::Str("xs"), Value::List({Value::Int(1), Value::Int(2),
+                                      Value::Int(3)})},
+      {Value::Str("tag"), Value::Str("from-c++")}}));
+  Value got = c.Get(ref, 5000);
+  CHECK(got.at("tag").as_str() == "from-c++");
+  CHECK(got.at("xs").items().size() == 3 &&
+        got.at("xs").items()[2].as_int() == 3);
+  printf("put/get: OK\n");
+
+  // ---- cross-language task: Python runs it, C++ reads it -----------
+  auto r1 = c.Submit("ray_tpu.util.cross_lang:square",
+                     ValueList{Value::Int(21)});
+  CHECK(c.Get(r1, 30000).as_int() == 441);
+  auto r2 = c.Submit("ray_tpu.util.cross_lang:describe",
+                     ValueList{Value::List({Value::Float(1.5),
+                                            Value::Float(2.5),
+                                            Value::Float(4.0)})});
+  Value stats = c.Get(r2, 30000);
+  CHECK(stats.at("n").as_int() == 3);
+  CHECK(stats.at("sum").as_float() == 8.0);
+  printf("cross-language tasks: OK\n");
+
+  // ---- task errors surface as C++ exceptions -----------------------
+  auto r3 = c.Submit("ray_tpu.util.cross_lang:boom", ValueList{});
+  bool threw = false;
+  try {
+    c.Get(r3, 30000);
+  } catch (const std::exception& e) {
+    threw = true;
+  }
+  CHECK(threw);
+  printf("error propagation: OK\n");
+
+  // ---- cluster state -----------------------------------------------
+  Value res = c.ClusterResources();
+  CHECK(res.find("CPU") != nullptr);
+  printf("cluster_resources: OK (CPU=%g)\n",
+         res.at("CPU").as_float());
+
+  printf("CPP API DEMO PASSED\n");
+  return 0;
+}
