@@ -1,9 +1,10 @@
 """Dashboard: HTTP observability endpoints.
 
-Capability parity (API plane, no React frontend) with the reference's
-dashboard head (dashboard/head.py + modules): JSON endpoints for cluster
-summary, actors, tasks, objects, workers, the chrome-trace timeline, and
-Prometheus metrics exposition (python/ray/_private/metrics_agent.py role).
+Capability parity with the reference's dashboard head (dashboard/head.py
++ modules): JSON endpoints for cluster summary, actors, tasks, objects,
+workers, the chrome-trace timeline, Prometheus metrics exposition
+(python/ray/_private/metrics_agent.py role), and a dependency-free HTML
+frontend at "/" (dashboard/client role, dashboard_ui.py).
 """
 from __future__ import annotations
 
@@ -20,6 +21,11 @@ class Dashboard:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    async def _index(self, request):
+        from aiohttp import web
+        from ray_tpu.dashboard_ui import INDEX_HTML
+        return web.Response(text=INDEX_HTML, content_type="text/html")
 
     async def _summary(self, request):
         from aiohttp import web
@@ -63,6 +69,7 @@ class Dashboard:
         self._loop = loop
         asyncio.set_event_loop(loop)
         app = web.Application()
+        app.router.add_get("/", self._index)
         app.router.add_get("/api/cluster_summary", self._summary)
         app.router.add_get("/api/actors", self._actors)
         app.router.add_get("/api/tasks", self._tasks)

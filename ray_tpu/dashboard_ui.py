@@ -1,0 +1,184 @@
+"""Single-file dashboard frontend (reference role: the dashboard's
+React client, dashboard/client/ — here a dependency-free HTML page the
+dashboard serves at "/", polling its own JSON endpoints). Stat tiles
+for the headline numbers, tables for workers/actors/tasks/objects;
+status is never color-alone (label + dot); light/dark via
+prefers-color-scheme."""
+
+INDEX_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<style>
+:root {
+  --bg: #fafaf8; --surface: #ffffff; --ink: #1a1a1a;
+  --ink-2: #555550; --ink-3: #8a8a84; --line: #e4e4df;
+  --good: #1a7f37; --bad: #b42318; --warn: #9a6700;
+  --accent: #4a64d0;
+}
+@media (prefers-color-scheme: dark) {
+  :root {
+    --bg: #16161a; --surface: #1f1f24; --ink: #ececec;
+    --ink-2: #b0b0aa; --ink-3: #7c7c76; --line: #33333a;
+    --good: #4ade80; --bad: #f87171; --warn: #fbbf24;
+    --accent: #93a5f5;
+  }
+}
+* { box-sizing: border-box; }
+body { margin: 0; background: var(--bg); color: var(--ink);
+       font: 14px/1.45 system-ui, sans-serif; }
+header { padding: 14px 20px; border-bottom: 1px solid var(--line);
+         display: flex; align-items: baseline; gap: 12px; }
+header h1 { font-size: 16px; margin: 0; font-weight: 650; }
+header .sub { color: var(--ink-3); font-size: 12px; }
+main { max-width: 1100px; margin: 0 auto; padding: 16px 20px 40px; }
+.tiles { display: grid; gap: 10px;
+         grid-template-columns: repeat(auto-fit, minmax(150px, 1fr)); }
+.tile { background: var(--surface); border: 1px solid var(--line);
+        border-radius: 8px; padding: 12px 14px; }
+.tile .label { font-size: 11px; text-transform: uppercase;
+               letter-spacing: .04em; color: var(--ink-3); }
+.tile .value { font-size: 24px; font-weight: 650; margin-top: 2px;
+               font-variant-numeric: tabular-nums; }
+.tile .hint { font-size: 11px; color: var(--ink-2); margin-top: 2px; }
+h2 { font-size: 13px; text-transform: uppercase; letter-spacing: .05em;
+     color: var(--ink-2); margin: 22px 0 8px; }
+table { width: 100%; border-collapse: collapse;
+        background: var(--surface); border: 1px solid var(--line);
+        border-radius: 8px; overflow: hidden; font-size: 13px; }
+th, td { text-align: left; padding: 6px 12px;
+         border-bottom: 1px solid var(--line);
+         font-variant-numeric: tabular-nums; }
+th { font-size: 11px; text-transform: uppercase; color: var(--ink-3);
+     letter-spacing: .04em; font-weight: 600; }
+tr:last-child td { border-bottom: none; }
+td.mono { font-family: ui-monospace, monospace; font-size: 12px;
+          color: var(--ink-2); }
+.pill { display: inline-flex; align-items: center; gap: 5px; }
+.dot { width: 7px; height: 7px; border-radius: 50%; flex: none; }
+.ok .dot { background: var(--good); } .ok { color: var(--good); }
+.bad .dot { background: var(--bad); } .bad { color: var(--bad); }
+.warn .dot { background: var(--warn); } .warn { color: var(--warn); }
+.muted { color: var(--ink-3); }
+.empty { color: var(--ink-3); padding: 10px 12px; }
+#err { color: var(--bad); font-size: 12px; display: none; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span class="sub">cluster dashboard · refreshes every 2s</span>
+  <span id="err">head unreachable</span>
+</header>
+<main>
+  <div class="tiles" id="tiles"></div>
+  <h2>Workers</h2><div id="workers"></div>
+  <h2>Actors</h2><div id="actors"></div>
+  <h2>Tasks</h2><div id="tasks"></div>
+  <h2>Objects</h2><div id="objects"></div>
+</main>
+<script>
+"use strict";
+// all user-controlled strings pass through esc() before innerHTML
+const esc = (s) => String(s).replace(/[&<>"']/g, (c) => ({
+  "&": "&amp;", "<": "&lt;", ">": "&gt;",
+  '"': "&quot;", "'": "&#39;"}[c]));
+const fmt = (v) => typeof v === "number"
+  ? (Number.isInteger(v) ? v.toLocaleString()
+     : v.toLocaleString(undefined, {maximumFractionDigits: 2}))
+  : String(v);
+const gb = (b) => (b / 2 ** 30).toFixed(1) + " GB";
+
+function tile(label, value, hint) {
+  return `<div class="tile"><div class="label">${esc(label)}</div>` +
+         `<div class="value">${esc(value)}</div>` +
+         (hint ? `<div class="hint">${esc(hint)}</div>` : "") +
+         `</div>`;
+}
+function pill(ok, text, warn) {
+  const cls = ok ? "ok" : (warn ? "warn" : "bad");
+  return `<span class="pill ${cls}"><span class="dot"></span>` +
+         `${esc(text)}</span>`;
+}
+function table(rows, cols) {
+  if (!rows.length) return `<div class="empty">none</div>`;
+  const head = cols.map(c => `<th>${esc(c.label)}</th>`).join("");
+  const body = rows.map(r =>
+    `<tr>${cols.map(c => `<td class="${c.cls || ""}">` +
+                         `${c.fn(r)}</td>`).join("")}</tr>`).join("");
+  return `<table><thead><tr>${head}</tr></thead>` +
+         `<tbody>${body}</tbody></table>`;
+}
+async function j(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(path);
+  return r.json();
+}
+function resPair(total, avail, key) {
+  const t = total[key] || 0, a = avail[key] ?? t;
+  return `${fmt(t - a)} / ${fmt(t)} used`;
+}
+async function refresh() {
+  try {
+    const [sum, workers, actors, tasks, objects] = await Promise.all([
+      j("/api/cluster_summary"), j("/api/workers"), j("/api/actors"),
+      j("/api/tasks"), j("/api/objects")]);
+    const t = sum.resources_total || {}, a = sum.resources_available || {};
+    const running = (sum.tasks || {}).RUNNING || 0;
+    const finished = (sum.tasks || {}).FINISHED || 0;
+    document.getElementById("tiles").innerHTML =
+      tile("Workers", sum.workers ?? workers.length) +
+      tile("CPU", fmt(t.CPU || 0), resPair(t, a, "CPU")) +
+      (t.TPU ? tile("TPU chips", fmt(t.TPU), resPair(t, a, "TPU")) : "") +
+      tile("Memory", gb(t.memory || 0), resPair(t, a, "memory")) +
+      tile("Tasks running", running, `${fmt(finished)} finished`) +
+      tile("Actors", Object.values(sum.actors || {})
+                     .reduce((x, y) => x + y, 0));
+    document.getElementById("workers").innerHTML = table(workers, [
+      {label: "id", cls: "mono", fn: r => esc(r.worker_id)},
+      {label: "state", fn: r => pill(r.alive, r.alive ? "alive" : "dead")},
+      {label: "cpu", fn: r => resPair(r.resources || {},
+                                      r.available || {}, "CPU")},
+      {label: "node", cls: "mono",
+       fn: r => esc(r.node_id || "head")}]);
+    document.getElementById("actors").innerHTML = table(actors, [
+      {label: "id", cls: "mono",
+       fn: r => esc((r.actor_id || "").slice(0, 16))},
+      {label: "class", fn: r => esc(r.class_name || r.name || "")},
+      {label: "state", fn: r => {
+        const s = r.state || (r.dead ? "DEAD" : "ALIVE");
+        return pill(s === "ALIVE", s, s === "RESTARTING");
+      }},
+      {label: "name", fn: r => r.name ? esc(r.name)
+                               : `<span class=muted>—</span>`}]);
+    const recent = tasks.slice(-50).reverse();
+    document.getElementById("tasks").innerHTML = table(recent, [
+      {label: "task", fn: r => esc(r.name || "")},
+      {label: "id", cls: "mono",
+       fn: r => esc((r.task_id || "").slice(0, 16))},
+      {label: "state", fn: r => {
+        const s = r.state || "";
+        return pill(s === "FINISHED" || s === "RUNNING", s,
+                    s === "PENDING");
+      }}]);
+    document.getElementById("objects").innerHTML = table(
+      objects.slice(0, 50), [
+      {label: "object", cls: "mono",
+       fn: r => esc((r.object_id || "").slice(0, 20))},
+      {label: "refs", fn: r => fmt(r.ref_count ?? 0)},
+      {label: "state", fn: r => pill(!!r.ready,
+                                     r.ready ? "ready" : "pending",
+                                     !r.ready)}]);
+    document.getElementById("err").style.display = "none";
+  } catch (e) {
+    document.getElementById("err").style.display = "inline";
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -407,6 +407,13 @@ class DistributedRuntime:
         self._subscriber = Subscriber(RpcClient(head_address))
         self._subscriber.subscribe_state("nodes",
                                          self.plane.on_nodes_update)
+        # Resource syncer view (ray_syncer role): the head pushes its
+        # resource snapshot; resource queries serve from this cache —
+        # zero polling RPCs in steady state.
+        self._resource_view: Optional[Dict[str, Any]] = None
+        self._resource_view_ts = 0.0
+        self._subscriber.subscribe_state("resources",
+                                         self._on_resources)
         self.ref_counter = ReferenceCounter()
         self.ref_counter.enabled = False
         self.job_id = JobID.next()
@@ -462,10 +469,29 @@ class DistributedRuntime:
         self.head.call("remove_placement_group", pg.id.hex())
 
     # introspection
+    def _on_resources(self, version: int, snap):
+        if snap:
+            self._resource_view = snap
+            self._resource_view_ts = time.time()
+
+    # Serve from the pushed view only while it is demonstrably live;
+    # a dead/restarting head must surface as an RPC error, not as a
+    # frozen pre-outage snapshot.
+    _RESOURCE_VIEW_TTL_S = 15.0
+
     def cluster_resources(self):
+        view = self._resource_view
+        if view is not None and \
+                time.time() - self._resource_view_ts < \
+                self._RESOURCE_VIEW_TTL_S:
+            return dict(view["cluster_resources"])
         return self.head.call("cluster_resources")
 
     def available_resources(self):
+        # Availability is a freshness query (callers assert right
+        # after a reservation); the pushed view lags by up to one sync
+        # period, so this one stays an RPC. The synced snapshot still
+        # carries availability for monitors that prefer push.
         return self.head.call("available_resources")
 
     def list_actors(self):

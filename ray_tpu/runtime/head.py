@@ -319,6 +319,56 @@ class HeadService:
                         stale.append(n.node_id)
             for node_id in stale:
                 self.mark_node_dead(node_id)
+            self._sync_resources()
+
+    # ---- resource syncer (ray_syncer / gcs_resource_manager role:
+    # push-based cluster-state distribution — subscribers hold a
+    # locally-served resource view instead of polling RPCs) ----------
+
+    def _aggregate_resources_locked(self) -> Tuple[Dict[str, float],
+                                                   Dict[str, float]]:
+        """One aggregation path for RPC queries AND the synced
+        snapshot, so push subscribers and pollers see one accounting."""
+        cluster: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for w in self._workers.values():
+            if not w.alive:
+                continue
+            for k, v in w.resources.items():
+                cluster[k] = cluster.get(k, 0.0) + v
+            for k, v in w.available.items():
+                avail[k] = round(avail.get(k, 0.0) + v, 6)
+        return cluster, avail
+
+    def _resource_snapshot_locked(self) -> Dict[str, Any]:
+        cluster, avail = self._aggregate_resources_locked()
+        return {"cluster_resources": cluster,
+                "available_resources": avail,
+                "num_workers": sum(1 for w in self._workers.values()
+                                   if w.alive),
+                "num_nodes": max(1, sum(1 for n in self._nodes.values()
+                                        if n.alive))}
+
+    def _sync_resources(self):
+        """Publish the resource view when it changed — once per
+        monitor period for availability drift, immediately from
+        membership events (register/death). snapshot+compare+publish
+        all run under the (reentrant) head lock so concurrent callers
+        can never publish snapshots out of order."""
+        with self._lock:
+            snap = self._resource_snapshot_locked()
+            now = time.time()
+            changed = snap != getattr(self, "_last_resource_snap",
+                                      None)
+            # keepalive republish: subscribers key freshness off the
+            # last push, so a quiet-but-healthy cluster must still
+            # heartbeat the channel or their TTL would force them
+            # back to polling RPCs.
+            stale = now - getattr(self, "_last_resource_pub", 0) > 5.0
+            if changed or stale:
+                self._last_resource_snap = snap
+                self._last_resource_pub = now
+                self.hub.publish_state("resources", snap)
 
     # ---- object directory (owner-based location parity) -------------------
 
@@ -486,6 +536,7 @@ class HeadService:
             self._sched_cv.notify_all()
             node = self._nodes.get(node_id)
             store = node.store_name if node else self.store_name
+        self._sync_resources()
         return {"store_name": store, "multinode": self.node_count() > 1}
 
     def worker_heartbeat(self, worker_id: str) -> bool:
@@ -529,6 +580,7 @@ class HeadService:
             "worker_events", {"type": "worker_dead",
                               "worker_id": worker_id,
                               "ts": time.time()})
+        self._sync_resources()
         # Fail or retry tasks that were on that worker.
         for task_id in running:
             self._handle_lost_task(task_id)
@@ -546,23 +598,11 @@ class HeadService:
 
     def cluster_resources(self) -> Dict[str, float]:
         with self._lock:
-            total: Dict[str, float] = {}
-            for w in self._workers.values():
-                if not w.alive:
-                    continue
-                for k, v in w.resources.items():
-                    total[k] = total.get(k, 0.0) + v
-            return total
+            return self._aggregate_resources_locked()[0]
 
     def available_resources(self) -> Dict[str, float]:
         with self._lock:
-            total: Dict[str, float] = {}
-            for w in self._workers.values():
-                if not w.alive:
-                    continue
-                for k, v in w.available.items():
-                    total[k] = total.get(k, 0.0) + v
-            return total
+            return self._aggregate_resources_locked()[1]
 
     # ---- function table (function_manager.py parity) ----------------------
 

@@ -85,5 +85,8 @@ def cluster_summary() -> Dict[str, Any]:
         "resources_available": rt.available_resources(),
         "tasks": summarize_tasks(),
         "actors": summarize_actors(),
-        "workers": len(list_workers()),
+        # alive only: the headline number must agree with the state
+        # rows (dead workers stay listed with alive=False)
+        "workers": sum(1 for w in list_workers()
+                       if w.get("alive", True)),
     }

@@ -302,3 +302,37 @@ def test_pg_bundle_capacity_bounds_actors(cluster):
     t.join()
     ray_tpu.kill(a2)
     remove_placement_group(pg)
+
+
+def test_resource_syncer_pushes_view(cluster):
+    """N6 resource-syncer role: the head pushes resource snapshots
+    over pub/sub; resource queries serve from the cached view and a
+    membership change shows up push-fast WITHOUT a polling RPC."""
+    import time as _t
+    rt = cluster.runtime
+    # wait for the first push
+    deadline = _t.time() + 10
+    while rt._resource_view is None and _t.time() < deadline:
+        _t.sleep(0.05)
+    assert rt._resource_view is not None, "no resource push arrived"
+    base_cpus = rt.cluster_resources().get("CPU", 0)
+    assert base_cpus > 0
+
+    calls_before = getattr(rt.head, "_rid", None)
+    rt.cluster_resources()          # served from the pushed cache
+    # no RPC was issued for the query
+    assert getattr(rt.head, "_rid", None) == calls_before
+
+    # membership change propagates by push
+    wid = cluster.add_worker({"CPU": 3})
+    deadline = _t.time() + 10
+    while _t.time() < deadline and \
+            rt.cluster_resources().get("CPU", 0) < base_cpus + 3:
+        _t.sleep(0.05)
+    assert rt.cluster_resources()["CPU"] == base_cpus + 3
+    cluster.node.kill_worker(wid)
+    deadline = _t.time() + 15
+    while _t.time() < deadline and \
+            rt.cluster_resources().get("CPU", 0) > base_cpus:
+        _t.sleep(0.05)
+    assert rt.cluster_resources()["CPU"] == base_cpus

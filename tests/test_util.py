@@ -159,6 +159,9 @@ def test_dashboard_endpoints(rt):
         assert "dash_metric 5.0" in fetch("/metrics")
         timeline = json.loads(fetch("/api/timeline"))
         assert isinstance(timeline, list)
+        index = fetch("/")
+        assert "<!DOCTYPE html>" in index
+        assert "/api/cluster_summary" in index   # frontend polls APIs
     finally:
         dash.stop()
         clear_registry()
