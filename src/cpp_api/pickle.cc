@@ -134,7 +134,7 @@ std::string Value::Repr() const {
   return "?";
 }
 
-// ---- encoder (protocol 2) ------------------------------------------------
+// ---- encoder (protocol 4) ------------------------------------------------
 
 namespace {
 
@@ -238,7 +238,7 @@ void Encode(std::string& out, const Value& v) {
 std::string PickleDumps(const Value& v) {
   std::string out;
   out.push_back(char(0x80));           // PROTO
-  out.push_back(2);
+  out.push_back(4);
   Encode(out, v);
   out.push_back('.');                  // STOP
   return out;
@@ -405,6 +405,8 @@ class Unpickler {
         }
         case 'u': {                    // SETITEMS
           ValueList items = PopToMark();
+          if (items.size() % 2 != 0)
+            throw std::runtime_error("pickle: malformed SETITEMS");
           auto& d = MutableDict();
           for (size_t i = 0; i + 1 < items.size(); i += 2)
             d.emplace_back(std::move(items[i]),

@@ -4,9 +4,9 @@
 // protobuf/ serve as the reference points; here C++ speaks the same
 // frames the Python runtime does, restricted to PLAIN data).
 //
-// Encoder emits protocol-2 opcodes (loadable by every Python pickle
-// version); decoder understands the opcode subset CPython/cloudpickle
-// protocol 5 emits for plain values: None/bool/int/float/str/bytes/
+// Encoder emits a protocol-4 stream (its string/bytes opcodes are
+// protocol 3/4); decoder understands the opcode subset
+// CPython/cloudpickle protocol 5 emits for plain values: None/bool/int/float/str/bytes/
 // list/tuple/dict (+ FRAME/MEMOIZE/GET bookkeeping). Anything else
 // (classes, closures) raises — by design: cross-language payloads are
 // data, not code.

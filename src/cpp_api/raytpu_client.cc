@@ -114,6 +114,10 @@ class RpcConn {
     RecvAll(hdr, 4);
     uint32_t len;
     memcpy(&len, hdr, 4);
+    // Peer frames are control-plane sized; a huge length means the
+    // stream desynced — fail cleanly instead of a 4 GiB allocation.
+    if (len > (512u << 20))
+      throw std::runtime_error("rpc frame too large (desync?)");
     std::string payload(len, '\0');
     RecvAll(payload.data(), len);
     return payload;
@@ -245,8 +249,13 @@ Value Client::Get(const ObjectRef24& ref, int64_t timeout_ms) {
   int rc = store_get(store_, id, timeout_ms, &off, &size);
   if (rc != 0)
     throw std::runtime_error("get failed rc=" + std::to_string(rc));
-  std::string part0 =
-      ContainerPart0(store_base(store_) + off, size);
+  std::string part0;
+  try {
+    part0 = ContainerPart0(store_base(store_) + off, size);
+  } catch (...) {
+    store_release(store_, id);   // never leak the refcount
+    throw;
+  }
   store_release(store_, id);
   Value tup = PickleLoads(part0);
   const auto& items = tup.items();
