@@ -1,0 +1,57 @@
+"""Core API tour: tasks, actors, objects, placement groups.
+
+Run: python examples/01_tasks_actors_objects.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))      # repo root (run from anywhere)
+
+import ray_tpu
+
+ray_tpu.init()
+
+# -- tasks -----------------------------------------------------------
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+print("squares:", ray_tpu.get([square.remote(i) for i in range(5)]))
+
+# -- objects ---------------------------------------------------------
+big = ray_tpu.put(list(range(10_000)))
+
+@ray_tpu.remote
+def head3(xs):
+    return xs[:3]
+
+print("head3:", ray_tpu.get(head3.remote(big)))
+
+# -- actors ----------------------------------------------------------
+@ray_tpu.remote(max_restarts=1)
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def add(self, k):
+        self.n += k
+        return self.n
+
+c = Counter.remote()
+print("counter:", ray_tpu.get([c.add.remote(1) for _ in range(3)]))
+
+# -- placement groups ------------------------------------------------
+from ray_tpu.util import (PlacementGroupSchedulingStrategy,
+                          placement_group)
+pg = placement_group([{"CPU": 1}], strategy="PACK")
+pg.wait(10)
+
+@ray_tpu.remote(num_cpus=1,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg))
+def pinned():
+    return "ran inside the reservation"
+
+print(ray_tpu.get(pinned.remote()))
+ray_tpu.shutdown()

@@ -1,0 +1,47 @@
+"""Serve a deployment with token streaming + the HTTP proxy.
+
+Run: python examples/03_serve_streaming.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))      # repo root (run from anywhere)
+
+import json
+import time
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init()
+
+@serve.deployment(num_replicas=1)
+class Echoer:
+    def __call__(self, payload):
+        # a generator response streams chunk by chunk
+        for word in str(payload.get("text", "")).split():
+            time.sleep(0.05)
+            yield {"token": word}
+
+serve.run(Echoer.bind())
+
+# python handle, streaming
+h = serve.get_handle("Echoer")
+for chunk in h.options(stream=True).remote({"text": "hello tpu world"}):
+    print("chunk:", chunk)
+
+# HTTP, chunked ndjson
+from ray_tpu.serve.http_proxy import start_http, stop_http
+start_http(port=8000)
+req = urllib.request.Request(
+    "http://127.0.0.1:8000/Echoer?stream=1",
+    data=json.dumps({"text": "streamed over http"}).encode(),
+    headers={"Content-Type": "application/json"})
+with urllib.request.urlopen(req, timeout=30) as r:
+    for line in r:
+        print("http:", json.loads(line))
+stop_http()
+serve.shutdown()
+ray_tpu.shutdown()

@@ -1,0 +1,28 @@
+"""Distributed Data: lazy transforms + task-graph shuffles.
+
+Run: python examples/04_data_pipeline.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))      # repo root (run from anywhere)
+
+import ray_tpu
+from ray_tpu.data import from_items
+
+ray_tpu.init()
+
+ds = (from_items([{"user": f"u{i % 7}", "amount": i % 23}
+                  for i in range(10_000)], parallelism=16)
+      .filter(lambda r: r["amount"] > 2)
+      .map(lambda r: {**r, "fee": r["amount"] * 0.01}))
+
+# two-stage hash shuffle; rows never pass through the driver
+totals = ds.groupby("user").sum("amount")
+print(totals.take_all())
+
+# distributed sample-sort
+top = ds.sort("amount", descending=True).take(3)
+print("top:", top)
+ray_tpu.shutdown()
