@@ -14,8 +14,7 @@ import jax
 
 # honor JAX_PLATFORMS=cpu even when a TPU plugin is installed (the
 # env var alone does not always override a preinstalled plugin)
-import os as _os
-if _os.environ.get("JAX_PLATFORMS") == "cpu":
+if os.environ.get("JAX_PLATFORMS") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 import numpy as np

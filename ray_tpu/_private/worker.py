@@ -23,7 +23,7 @@ logger = logging.getLogger(__name__)
 class Worker:
     def __init__(self, runtime, mode: str):
         self.runtime = runtime
-        self.mode = mode  # "local" | "node" | "driver" | "worker"
+        self.mode = mode  # "local" | "node" | "driver" | "worker" | "client"
         self.namespace = "default"
 
 
@@ -87,6 +87,12 @@ def init(address: Optional[str] = None,
             res.setdefault("memory", 8 * 1024 ** 3)
             runtime = LocalRuntime(res, job_id=JobID.next())
             _worker = Worker(runtime, mode="local")
+        elif address.startswith("ray://"):
+            # Proxied remote driver (Ray Client parity): one endpoint,
+            # no cluster network/shm access needed on this machine.
+            from ray_tpu.runtime.client_proxy import ProxyRuntime
+            runtime = ProxyRuntime(address[len("ray://"):])
+            _worker = Worker(runtime, mode="client")
         else:
             # Distributed attach (node runtime); implemented in
             # ray_tpu.runtime.client.

@@ -1,0 +1,349 @@
+"""Proxied remote driver — the Ray Client role
+(python/ray/util/client/: ray.init("ray://host:port") drives a cluster
+through ONE proxy endpoint, no cluster network or shm access needed).
+
+Server side (`ClientProxyService`, run next to the head via
+`python -m ray_tpu.runtime.client_proxy --head H:P`): holds a real
+driver-grade `DistributedRuntime` and executes every API op on behalf
+of remote clients. Objects stay server-side; clients hold ObjectRefs
+whose backing values are pinned per client session until the session
+is released.
+
+Client side (`ProxyRuntime`): the runtime installed by
+`ray_tpu.init(address="ray://host:port")` — each op ships as one
+authenticated RPC whose payload crosses with the framework serializer
+(ObjectRefs stay symbolic; task specs carry their cloudpickled
+functions exactly as the in-cluster driver path does)."""
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.ids import ActorID, JobID, ObjectID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store import ReferenceCounter
+from ray_tpu._private.serialization import dumps, loads
+from ray_tpu.runtime.rpc import RpcClient, RpcServer
+
+
+class ClientProxyService:
+    """RPC handler executing driver ops against an in-cluster runtime."""
+
+    def __init__(self, runtime):
+        self.rt = runtime
+        self._lock = threading.Lock()
+        # session id -> OrderedDict{ref hex -> ObjectRef}: pins keep
+        # the server-side GC from collecting values a remote client
+        # still references. Bounded per session (oldest pins drop
+        # first — an evicted-then-needed object comes back via lineage
+        # reconstruction) and reaped whole when a session goes silent
+        # (crashed client with no release_session).
+        import collections
+        self._sessions: Dict[str, "collections.OrderedDict"] = {}
+        self._last_seen: Dict[str, float] = {}
+        self.max_pins_per_session = 100_000
+        self.session_ttl_s = 600.0
+
+    # -- plumbing ------------------------------------------------------
+
+    def _pin(self, session: str, refs) -> None:
+        import collections
+        with self._lock:
+            pins = self._sessions.setdefault(
+                session, collections.OrderedDict())
+            one = [refs] if isinstance(refs, ObjectRef) else refs
+            for r in one:
+                if isinstance(r, ObjectRef):
+                    pins[r.id.hex()] = r
+            while len(pins) > self.max_pins_per_session:
+                pins.popitem(last=False)
+
+    def _touch(self, session: str) -> None:
+        import time
+        now = time.time()
+        with self._lock:
+            self._last_seen[session] = now
+            dead = [s for s, t in self._last_seen.items()
+                    if now - t > self.session_ttl_s]
+            for s in dead:
+                self._sessions.pop(s, None)
+                self._last_seen.pop(s, None)
+
+    def proxy(self, session: str, op: str, blob: bytes) -> bytes:
+        """One driver op: blob = serialized (args, kwargs); returns
+        serialized ("ok", result) / ("err", exception)."""
+        try:
+            self._touch(session)
+            args, kwargs = loads(blob)
+            result = getattr(self, "_op_" + op)(session, *args,
+                                                **kwargs)
+            return dumps(("ok", result))
+        except BaseException as e:   # noqa: BLE001
+            try:
+                return dumps(("err", e))
+            except Exception:        # unpicklable exception
+                return dumps(("err", RuntimeError(repr(e))))
+
+    def release_session(self, session: str) -> int:
+        with self._lock:
+            pins = self._sessions.pop(session, {})
+            self._last_seen.pop(session, None)
+        return len(pins)
+
+    # -- ops -----------------------------------------------------------
+
+    def _op_put(self, session, value):
+        ref = self.rt.put(value)
+        self._pin(session, ref)
+        return ref
+
+    def _op_get(self, session, refs, timeout=None):
+        return self.rt.get(refs, timeout=timeout)
+
+    def _op_wait(self, session, refs, num_returns=1, timeout=None):
+        return self.rt.wait(refs, num_returns=num_returns,
+                            timeout=timeout)
+
+    def _op_submit_task(self, session, spec):
+        refs = self.rt.submit_task(spec)
+        self._pin(session, refs)
+        return refs
+
+    def _op_create_actor(self, session, spec):
+        return self.rt.create_actor(spec)
+
+    def _op_submit_actor_task(self, session, actor_id, spec):
+        refs = self.rt.submit_actor_task(actor_id, spec)
+        self._pin(session, refs)
+        return refs
+
+    def _op_kill_actor(self, session, actor_id, no_restart=True):
+        return self.rt.kill_actor(actor_id, no_restart=no_restart)
+
+    def _op_lookup_named_actor(self, session, name, namespace):
+        return self.rt.lookup_named_actor(name, namespace)
+
+    def _op_get_actor_state(self, session, actor_id):
+        return self.rt.get_actor_state(actor_id)
+
+    def _op_cancel(self, session, ref, force=False, recursive=True):
+        return self.rt.cancel(ref, force=force, recursive=recursive)
+
+    def _op_create_placement_group(self, session, spec):
+        # ship only the created flag: the server-side PG object holds
+        # sockets/locks; the client builds its own handle from the spec
+        pg = self.rt.create_placement_group(spec)
+        return pg.is_ready()
+
+    def _op_pg_wait(self, session, spec, timeout_seconds):
+        pg = self.rt.create_placement_group(spec)   # idempotent
+        return pg.wait(timeout_seconds)
+
+    def _op_remove_placement_group(self, session, pg_id_hex):
+        return self.rt.head.call("remove_placement_group", pg_id_hex)
+
+    def _op_cluster_resources(self, session):
+        return self.rt.cluster_resources()
+
+    def _op_available_resources(self, session):
+        return self.rt.available_resources()
+
+    def _op_list_actors(self, session):
+        return self.rt.list_actors()
+
+    def _op_list_tasks(self, session):
+        return self.rt.list_tasks()
+
+    def _op_list_objects(self, session):
+        return self.rt.list_objects()
+
+    def _op_list_workers(self, session):
+        return self.rt.list_workers()
+
+    def _op_list_nodes(self, session):
+        return self.rt.list_nodes()
+
+
+
+
+class ProxyPlacementGroup:
+    """Client-side placement-group handle (same surface as the
+    in-cluster DistPlacementGroup, but proxy-backed: the spec is plain
+    data, readiness queries go through the proxy)."""
+
+    def __init__(self, spec, runtime: "ProxyRuntime", created: bool):
+        self.spec = spec
+        self._rt = runtime
+        self._created = created
+
+    @property
+    def id(self):
+        return self.spec.pg_id
+
+    @property
+    def bundle_specs(self):
+        return [dict(b.resources) for b in self.spec.bundles]
+
+    def is_ready(self) -> bool:
+        return self._created
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        if not self._created:
+            self._created = self._rt._call("pg_wait", self.spec,
+                                           timeout_seconds)
+        return self._created
+
+    def ready(self) -> ObjectRef:
+        """Proxied semantics: waits for readiness, then returns a ref
+        to a plain readiness record (the in-cluster variant resolves
+        to the pg object itself; this handle holds sockets and cannot
+        cross the wire)."""
+        ok = self.wait(300)
+        return self._rt.put({"pg_id": self.spec.pg_id.hex(),
+                             "ready": ok})
+
+
+class ProxyRuntime:
+    """Client-side runtime: every op is one RPC to the proxy."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self.client = RpcClient(address, timeout=None)
+        self.session = uuid.uuid4().hex
+        # Remote refs are symbolic on this side; no local ref counting.
+        self.ref_counter = ReferenceCounter()
+        self.ref_counter.enabled = False
+        self.job_id = JobID.next()
+        self._actor_handles: Dict[Any, Any] = {}
+
+    def _call(self, op: str, *args, **kwargs):
+        blob = dumps((args, kwargs))
+        status, value = loads(
+            self.client.call("proxy", self.session, op, blob))
+        if status == "err":
+            raise value
+        return value
+
+    # -- objects -------------------------------------------------------
+    def put(self, value):
+        return self._call("put", value)
+
+    def get(self, refs, timeout=None):
+        return self._call("get", refs, timeout=timeout)
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        return self._call("wait", refs, num_returns=num_returns,
+                          timeout=timeout)
+
+    def object_future(self, oid: ObjectID):
+        from concurrent.futures import Future
+        f: Future = Future()
+
+        def _wait():
+            try:
+                v = self._call("get", ObjectRef(oid))
+            except BaseException as e:   # noqa: BLE001
+                if f.set_running_or_notify_cancel():
+                    f.set_exception(e)
+                return
+            if f.set_running_or_notify_cancel():
+                f.set_result(v)
+        threading.Thread(target=_wait, daemon=True).start()
+        return f
+
+    # -- tasks / actors ------------------------------------------------
+    def submit_task(self, spec):
+        return self._call("submit_task", spec)
+
+    def create_actor(self, spec):
+        return self._call("create_actor", spec)
+
+    def submit_actor_task(self, actor_id, spec):
+        return self._call("submit_actor_task", actor_id, spec)
+
+    def kill_actor(self, actor_id, no_restart=True):
+        return self._call("kill_actor", actor_id,
+                          no_restart=no_restart)
+
+    def lookup_named_actor(self, name, namespace):
+        return self._call("lookup_named_actor", name, namespace)
+
+    def get_actor_state(self, actor_id):
+        return self._call("get_actor_state", actor_id)
+
+    def cancel(self, ref, force=False, recursive=True):
+        return self._call("cancel", ref, force=force,
+                          recursive=recursive)
+
+    # -- placement groups ---------------------------------------------
+    def create_placement_group(self, spec):
+        created = self._call("create_placement_group", spec)
+        return ProxyPlacementGroup(spec, self, created)
+
+    def remove_placement_group(self, pg):
+        return self._call("remove_placement_group", pg.id.hex())
+
+    # -- state ---------------------------------------------------------
+    def cluster_resources(self):
+        return self._call("cluster_resources")
+
+    def available_resources(self):
+        return self._call("available_resources")
+
+    def list_actors(self):
+        return self._call("list_actors")
+
+    def list_tasks(self):
+        return self._call("list_tasks")
+
+    def list_objects(self):
+        return self._call("list_objects")
+
+    def list_workers(self):
+        return self._call("list_workers")
+
+    def list_nodes(self):
+        return self._call("list_nodes")
+
+    def start_log_streaming(self, sink=None):
+        pass     # logs stay cluster-side for proxied drivers (v1)
+
+    def shutdown(self):
+        try:
+            self.client.call("release_session", self.session,
+                             timeout=5)
+        except Exception:
+            pass
+        self.client.close()
+
+
+def start_proxy(head_address: str, port: int = 0):
+    """Run a proxy endpoint next to the head; returns (server, runtime).
+    The proxy machine needs head + shm access (it IS the in-cluster
+    driver for its clients)."""
+    from ray_tpu.runtime.client import DistributedRuntime
+    info = RpcClient(head_address, timeout=30).call("cluster_info")
+    rt = DistributedRuntime(head_address, info["store_name"])
+    server = RpcServer(ClientProxyService(rt), port=port)
+    return server, rt
+
+
+def main():
+    import argparse
+    import time
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--head", required=True)
+    ap.add_argument("--port", type=int, default=10001)
+    args = ap.parse_args()
+    server, _rt = start_proxy(args.head, args.port)
+    print(f"client proxy ready on {server.address}", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()
