@@ -25,33 +25,70 @@ def _expand(path: str) -> List[str]:
     return sorted(globlib.glob(path)) or [path]
 
 
-def read_text(path: str, parallelism: int = 8) -> Dataset:
-    """One row per line (reference: read_text)."""
-    rows: List[str] = []
-    for p in _expand(path):
+@ray_tpu.remote(num_cpus=0.25)
+def _read_source_file(p: str, fmt: str, include_paths: bool):
+    """Source task: file bytes never pass through the driver
+    (reference: read tasks, data/read_api.py)."""
+    if fmt == "text":
         with open(p) as f:
-            rows.extend(line.rstrip("\n") for line in f)
-    return from_items(rows, parallelism)
+            return [line.rstrip("\n") for line in f]
+    if fmt == "binary":
+        with open(p, "rb") as f:
+            data = f.read()
+        return [{"path": p, "bytes": data}] if include_paths \
+            else [data]
+    if fmt == "csv":
+        import csv
+        rows: List[Any] = []
+        with open(p, newline="") as f:
+            for row in csv.DictReader(f):
+                parsed = {}
+                for k, v in row.items():
+                    try:
+                        parsed[k] = float(v) if "." in v or "e" in v \
+                            else int(v)
+                    except (ValueError, TypeError):
+                        parsed[k] = v
+                rows.append(parsed)
+        return rows
+    if fmt == "jsonl":
+        import json
+        rows = []
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+        return rows
+    arr = np.load(p)                 # numpy
+    return [{"data": row} for row in arr]
+
+
+def _read_source(path: str, fmt: str, parallelism: int,
+                 include_paths: bool = False) -> Dataset:
+    paths = _expand(path)
+    ds = Dataset([_read_source_file.remote(p, fmt, include_paths)
+                  for p in paths])
+    if len(paths) < parallelism:
+        ds = ds.repartition(parallelism)
+    return ds
+
+
+def read_text(path: str, parallelism: int = 8) -> Dataset:
+    """One row per line, one read task per file (reference:
+    read_text)."""
+    return _read_source(path, "text", parallelism)
 
 
 def read_binary_files(path: str, parallelism: int = 8,
                       include_paths: bool = False) -> Dataset:
     """Whole files as bytes rows (reference: read_binary_files)."""
-    rows: List[Any] = []
-    for p in _expand(path):
-        with open(p, "rb") as f:
-            data = f.read()
-        rows.append({"path": p, "bytes": data} if include_paths
-                    else data)
-    return from_items(rows, parallelism)
+    return _read_source(path, "binary", parallelism, include_paths)
 
 
 def read_numpy(path: str, parallelism: int = 8) -> Dataset:
     """.npy files -> rows of {'data': row} (reference: read_numpy)."""
-    arrays = [np.load(p) for p in _expand(path)]
-    arr = np.concatenate(arrays) if len(arrays) > 1 else arrays[0]
-    from ray_tpu.data.dataset import from_numpy
-    return from_numpy(arr, parallelism)
+    return _read_source(path, "numpy", parallelism)
 
 
 def read_parquet(path: str, parallelism: int = 8) -> Dataset:
@@ -80,40 +117,130 @@ def to_pandas(ds: Dataset):
     return pd.DataFrame(ds.take_all())
 
 
-def write_csv(ds: Dataset, path: str) -> str:
-    import csv
-    rows = ds.take_all()
-    if rows and not isinstance(rows[0], dict):
-        rows = [{"value": r} for r in rows]
+@ray_tpu.remote(num_cpus=0.25)
+def _block_fields(block) -> List[str]:
+    """Union of column names in one block, in first-seen order (csv
+    schema pass: O(blocks) lists of names return to the driver, never
+    rows)."""
     fields: List[str] = []
-    for r in rows:
-        for k in r:
+    for r in block:
+        if isinstance(r, dict):
+            for k in r:
+                if k not in fields:
+                    fields.append(k)
+        elif "value" not in fields:
+            fields.append("value")
+    return fields
+
+
+def _union_fields(ds: Dataset) -> List[str]:
+    fields: List[str] = []
+    for part in ray_tpu.get([_block_fields.remote(b)
+                             for b in ds._block_refs]):
+        for k in part:
             if k not in fields:
                 fields.append(k)
-    with open(path, "w", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=fields)
-        w.writeheader()
-        w.writerows(rows)
+    return fields
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _write_block(block, path: str, fmt: str, column: Optional[str],
+                 fields: Optional[List[str]] = None):
+    """Sink task: one output file per block (reference: write_* tasks,
+    data/_internal write path — rows never pass through the driver)."""
+    if fmt == "csv":
+        import csv
+        rows = block if block and isinstance(block[0], dict) \
+            else [{"value": r} for r in block]
+        with open(path, "w", newline="") as f:
+            # one dataset-wide schema: every part file has the same
+            # header, so parts concatenate cleanly downstream
+            w = csv.DictWriter(f, fieldnames=fields or ["value"],
+                               restval="")
+            w.writeheader()
+            w.writerows(rows)
+    elif fmt == "json":
+        import json
+        with open(path, "w") as f:
+            for r in block:
+                f.write(json.dumps(r) + "\n")
+    elif fmt == "numpy":
+        if block and isinstance(block[0], dict):
+            arr = np.stack([np.asarray(r[column]) for r in block])
+        else:
+            arr = np.asarray(block)
+        np.save(path, arr)
     return path
+
+
+_EXT = {"csv": "csv", "json": "json", "numpy": "npy"}
+
+
+def _write(ds: Dataset, path: str, fmt: str,
+           column: Optional[str] = None) -> str:
+    """Directory path (trailing sep or existing dir) -> one
+    ``part-NNNNN.<ext>`` file per block, written by remote tasks in
+    parallel. Plain file path -> blocks stream through the driver one
+    at a time into a single file (constant driver memory)."""
+    dir_mode = path.endswith(os.sep) or os.path.isdir(path)
+    ds = ds.materialize()
+    fields = _union_fields(ds) if fmt == "csv" else None
+    if dir_mode:
+        os.makedirs(path, exist_ok=True)
+        outs = [_write_block.remote(
+                    b, os.path.join(
+                        path, f"part-{i:05d}.{_EXT[fmt]}"),
+                    fmt, column, fields)
+                for i, b in enumerate(ds._block_refs)]
+        ray_tpu.get(outs)
+        return path
+    # Single file: stream one block at a time through the driver.
+    if fmt == "json":
+        import json
+        with open(path, "w") as f:
+            for b in ds._block_refs:
+                for r in ray_tpu.get(b):
+                    f.write(json.dumps(r) + "\n")
+        return path
+    if fmt == "csv":
+        import csv
+        with open(path, "w", newline="") as f:
+            # dataset-wide field union (collected as metadata above):
+            # no column is ever silently dropped
+            w = csv.DictWriter(f, fieldnames=fields or ["value"],
+                               restval="")
+            w.writeheader()
+            for b in ds._block_refs:
+                block = ray_tpu.get(b)
+                w.writerows(
+                    block if block and isinstance(block[0], dict)
+                    else [{"value": r} for r in block])
+        return path
+    # numpy: one array file needs the whole array once
+    parts = []
+    for b in ds._block_refs:
+        block = ray_tpu.get(b)
+        if block and isinstance(block[0], dict):
+            parts.append(np.stack([np.asarray(r[column])
+                                   for r in block]))
+        elif block:
+            parts.append(np.asarray(block))
+    np.save(path, np.concatenate(parts) if parts
+            else np.asarray([]))
+    return path
+
+
+def write_csv(ds: Dataset, path: str) -> str:
+    return _write(ds, path, "csv")
 
 
 def write_json(ds: Dataset, path: str) -> str:
-    import json
-    with open(path, "w") as f:
-        for r in ds.take_all():
-            f.write(json.dumps(r) + "\n")
-    return path
+    return _write(ds, path, "json")
 
 
 def write_numpy(ds: Dataset, path: str,
                 column: Optional[str] = "data") -> str:
-    rows = ds.take_all()
-    if rows and isinstance(rows[0], dict):
-        arr = np.stack([np.asarray(r[column]) for r in rows])
-    else:
-        arr = np.asarray(rows)
-    np.save(path, arr)
-    return path
+    return _write(ds, path, "numpy", column)
 
 
 class RandomAccessDataset:
@@ -122,18 +249,20 @@ class RandomAccessDataset:
     search within the owning block)."""
 
     def __init__(self, ds: Dataset, key: str):
+        from ray_tpu.data.dataset import _sample_keys
         self._key = key
-        rows = sorted(ds.take_all(), key=lambda r: r[key])
-        n_blocks = max(1, ds.num_blocks())
-        splits = np.array_split(np.arange(len(rows)), n_blocks)
+        # distributed sample-sort: rows never visit the driver; only
+        # each block's first key (the bound) does
+        sorted_ds = ds.sort(key)
         self._blocks: List[ray_tpu.ObjectRef] = []
         self._bounds: List[Any] = []   # first key of each block
-        for idx in splits:
-            if len(idx) == 0:
-                continue
-            block = [rows[i] for i in idx]
-            self._blocks.append(ray_tpu.put(block))
-            self._bounds.append(block[0][key])
+        firsts = ray_tpu.get(
+            [_sample_keys.remote(b, key, 1)
+             for b in sorted_ds._block_refs])
+        for b, f in zip(sorted_ds._block_refs, firsts):
+            if f:                      # skip empty blocks
+                self._blocks.append(b)
+                self._bounds.append(f[0])
 
     def get(self, key_value: Any) -> Optional[Dict[str, Any]]:
         import bisect

@@ -187,3 +187,80 @@ def test_aggregate_larger_than_any_block(rt):
         rows = _rows(src.groupby("g").count())
     assert {r["key"]: r["count"] for r in rows} == {
         0: 334, 1: 333, 2: 333}
+
+
+def test_write_dir_mode_one_file_per_block(rt, tmp_path):
+    """Directory sinks write one part file per block via remote tasks;
+    rows never pass through the driver."""
+    import csv
+    import json
+    import os
+
+    from ray_tpu.data import datasources as rd
+    src = from_items([{"a": i, "b": f"s{i}"} for i in range(40)],
+                     parallelism=4)
+    with no_driver_rows():
+        out = rd.write_csv(src, str(tmp_path / "csvdir") + os.sep)
+    parts = sorted(os.listdir(out))
+    assert parts == [f"part-0000{i}.csv" for i in range(4)]
+    rows = []
+    for p in parts:
+        with open(os.path.join(out, p)) as f:
+            rows.extend(csv.DictReader(f))
+    assert len(rows) == 40
+
+    with no_driver_rows():
+        jout = rd.write_json(src, str(tmp_path / "jsondir") + os.sep)
+    jrows = []
+    for p in sorted(os.listdir(jout)):
+        with open(os.path.join(jout, p)) as f:
+            jrows.extend(json.loads(l) for l in f)
+    assert sorted(r["a"] for r in jrows) == list(range(40))
+
+
+def test_read_tasks_per_file(rt, tmp_path):
+    """Readers are one remote task per file; file bytes never pass
+    through the driver."""
+    from ray_tpu.data.dataset import read_csv
+    for i in range(3):
+        (tmp_path / f"f{i}.csv").write_text(
+            "a,b\n" + "".join(f"{i * 10 + j},x\n" for j in range(5)))
+    with no_driver_rows():
+        ds = read_csv(str(tmp_path / "*.csv"), parallelism=2)
+        rows = _rows(ds)
+    assert sorted(r["a"] for r in rows) == \
+        sorted(i * 10 + j for i in range(3) for j in range(5))
+
+
+def test_random_access_distributed_build(rt):
+    from ray_tpu.data.datasources import RandomAccessDataset
+    src = from_items([{"k": i, "v": i * i}
+                      for i in range(200)][::-1], parallelism=8)
+    with no_driver_rows():
+        rad = RandomAccessDataset(src, "k")
+    assert rad.get(7)["v"] == 49
+    assert rad.get(199)["v"] == 199 * 199
+    assert rad.get(1000) is None
+
+
+def test_write_csv_unions_heterogeneous_schemas(rt, tmp_path):
+    """CSV schema is the dataset-wide field union — a column appearing
+    only in later rows/blocks is never silently dropped, and every
+    part file shares one header."""
+    import csv
+    import os
+
+    from ray_tpu.data import datasources as rd
+    src = from_items([{"a": 1}, {"a": 2, "b": 9}] * 10, parallelism=2)
+    single = rd.write_csv(src, str(tmp_path / "one.csv"))
+    with open(single) as f:
+        rows = list(csv.DictReader(f))
+    assert set(rows[0]) == {"a", "b"}
+    assert sum(1 for r in rows if r["b"] == "9") == 10
+
+    out = rd.write_csv(src, str(tmp_path / "parts") + os.sep)
+    headers = set()
+    for p in sorted(os.listdir(out)):
+        with open(os.path.join(out, p)) as f:
+            headers.add(f.readline().strip())
+    assert headers == {"a,b"}       # one schema across all parts
