@@ -3,6 +3,9 @@ from ray_tpu.models.gpt2 import (GPT2, GPT2Config, gpt2_sharding_rules,
 from ray_tpu.models.llama import (Llama, LlamaConfig, generate,
                                   llama2_7b, llama_sharding_rules,
                                   llama_tiny)
+from ray_tpu.models.mixtral import (Mixtral, MixtralConfig,
+                                    mixtral_8x7b, mixtral_sharding_rules,
+                                    mixtral_tiny, moe_aux_loss)
 from ray_tpu.models.resnet import ResNet, ResNetConfig, resnet50, resnet18
 
 __all__ = [
@@ -10,4 +13,6 @@ __all__ = [
     "ResNet", "ResNetConfig", "resnet50", "resnet18",
     "Llama", "LlamaConfig", "llama2_7b", "llama_tiny",
     "llama_sharding_rules", "generate",
+    "Mixtral", "MixtralConfig", "mixtral_8x7b", "mixtral_tiny",
+    "mixtral_sharding_rules", "moe_aux_loss",
 ]

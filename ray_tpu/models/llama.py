@@ -181,13 +181,58 @@ class LlamaBlock(nn.Module):
     def __call__(self, x, freqs, positions, kv_cache=None,
                  cache_len=None):
         cfg = self.config
-        h, new_cache = LlamaAttention(cfg, name="attention")(
-            RMSNorm(cfg.norm_eps, name="attention_norm")(x),
-            freqs, positions, kv_cache, cache_len)
-        x = x + h
-        x = x + LlamaMLP(cfg, name="feed_forward")(
-            RMSNorm(cfg.norm_eps, name="ffn_norm")(x))
-        return x, new_cache
+        return block_forward(
+            cfg, cfg, LlamaMLP(cfg, name="feed_forward"),
+            x, freqs, positions, kv_cache, cache_len)
+
+
+def transformer_forward(mod: nn.Module, cfg, block_cls, input_ids,
+                        kv_caches=None, cache_len=None):
+    """Shared decoder-transformer body (embedding, RoPE table,
+    position/cache plumbing, layer loop, final norm, tied logits).
+    Every Llama-shaped family (Llama, Mixtral) calls this with its own
+    block class, so the decode contract `generate`/`generate_stream`
+    rely on cannot drift per family. Called from a compact __call__:
+    submodules bind into the caller's scope."""
+    B, T = input_ids.shape
+    tok = mod.param("tok_embeddings",
+                    nn.initializers.normal(0.02),
+                    (cfg.vocab_size, cfg.dim), cfg.param_dtype)
+    x = tok[input_ids].astype(cfg.dtype)
+    freqs = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    if cache_len is None:
+        positions = jnp.arange(T)
+    else:
+        positions = cache_len + jnp.arange(T)
+    block = block_cls
+    if cfg.remat:
+        block = nn.remat(block_cls, static_argnums=())
+    new_caches = []
+    for i in range(cfg.n_layers):
+        cache_i = None if kv_caches is None else kv_caches[i]
+        x, nc = block(cfg, name=f"layers_{i}")(
+            x, freqs, positions, cache_i, cache_len)
+        new_caches.append(nc)
+    x = RMSNorm(cfg.norm_eps, name="norm")(x)
+    logits = jax.lax.dot_general(
+        x.astype(cfg.dtype), tok.astype(cfg.dtype),
+        (((2,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if kv_caches is None:
+        return logits, None
+    return logits, new_caches
+
+
+def block_forward(cfg, attn_cfg, ffn_module, x, freqs, positions,
+                  kv_cache=None, cache_len=None):
+    """Shared pre-norm block body: attention residual + FFN residual.
+    The FFN module is the only thing that varies across families."""
+    h, new_cache = LlamaAttention(attn_cfg, name="attention")(
+        RMSNorm(cfg.norm_eps, name="attention_norm")(x),
+        freqs, positions, kv_cache, cache_len)
+    x = x + h
+    x = x + ffn_module(RMSNorm(cfg.norm_eps, name="ffn_norm")(x))
+    return x, new_cache
 
 
 class Llama(nn.Module):
@@ -197,34 +242,8 @@ class Llama(nn.Module):
     def __call__(self, input_ids, kv_caches=None, cache_len=None):
         """Returns (logits, new_kv_caches). kv_caches: list per layer of
         (k, v) arrays [B, max_seq, n_kv_heads, head_dim]."""
-        cfg = self.config
-        B, T = input_ids.shape
-        tok = self.param("tok_embeddings",
-                         nn.initializers.normal(0.02),
-                         (cfg.vocab_size, cfg.dim), cfg.param_dtype)
-        x = tok[input_ids].astype(cfg.dtype)
-        freqs = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
-        if cache_len is None:
-            positions = jnp.arange(T)
-        else:
-            positions = cache_len + jnp.arange(T)
-        block = LlamaBlock
-        if cfg.remat:
-            block = nn.remat(LlamaBlock, static_argnums=())
-        new_caches = []
-        for i in range(cfg.n_layers):
-            cache_i = None if kv_caches is None else kv_caches[i]
-            x, nc = block(cfg, name=f"layers_{i}")(
-                x, freqs, positions, cache_i, cache_len)
-            new_caches.append(nc)
-        x = RMSNorm(cfg.norm_eps, name="norm")(x)
-        logits = jax.lax.dot_general(
-            x.astype(cfg.dtype), tok.astype(cfg.dtype),
-            (((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if kv_caches is None:
-            return logits, None
-        return logits, new_caches
+        return transformer_forward(self, self.config, LlamaBlock,
+                                   input_ids, kv_caches, cache_len)
 
 
 def init_kv_caches(cfg: LlamaConfig, batch: int, max_len: int):

@@ -1,0 +1,228 @@
+"""Mixtral-family sparse-MoE transformer: Llama blocks with the FFN
+replaced by a top-k routed mixture of SwiGLU experts.
+
+The reference has no model zoo; this family is the expert-parallel
+exemplar of the model stack (SURVEY.md §2.4 EP): experts live on an
+`expert` mesh axis, tokens dispatch with capacity buffers via dense
+einsums (compiler-friendly: no dynamic shapes, XLA lowers the
+dispatch/combine einsums onto the MXU and inserts the all-to-alls the
+expert sharding implies). Attention/norm/RoPE and the KV-cache decode
+path are shared with models/llama.py, so `generate` /
+`generate_stream` work unchanged."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.mesh.sharding import ShardingRules
+from ray_tpu.models.llama import (LlamaConfig, block_forward,
+                                  transformer_forward)
+from ray_tpu.parallel.expert import _maybe_constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 4096
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: int = 14336        # per-expert SwiGLU inner dim
+    num_experts: int = 8
+    num_experts_per_tok: int = 2   # top-k routing (Mixtral: 2)
+    capacity_factor: float = 1.25
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def attention_config(self) -> LlamaConfig:
+        """The attention stack is exactly Llama's; reuse its module
+        with a mirrored config."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size, max_seq_len=self.max_seq_len,
+            dim=self.dim, n_layers=self.n_layers,
+            n_heads=self.n_heads, n_kv_heads=self.n_kv_heads,
+            hidden_dim=self.hidden_dim, rope_theta=self.rope_theta,
+            norm_eps=self.norm_eps, dtype=self.dtype,
+            param_dtype=self.param_dtype, remat=self.remat,
+            attention_impl=self.attention_impl)
+
+
+def mixtral_8x7b(**overrides) -> MixtralConfig:
+    return MixtralConfig(**overrides)
+
+
+def mixtral_tiny(**overrides) -> MixtralConfig:
+    """Test-size config (GQA + 4 experts top-2) for CPU-mesh tests."""
+    d = dict(vocab_size=256, max_seq_len=128, dim=64, n_layers=2,
+             n_heads=4, n_kv_heads=2, hidden_dim=128, num_experts=4,
+             num_experts_per_tok=2)
+    d.update(overrides)
+    return MixtralConfig(**d)
+
+
+class MoEFeedForward(nn.Module):
+    """Top-k routed SwiGLU experts with capacity buffers.
+
+    Dense-dispatch formulation (same shape discipline as
+    parallel/expert.py SwitchMoE, generalized to top-k): static [E, C]
+    capacity buffers, dispatch/combine as einsums, overflow dropped.
+    Expert weight tensors carry the `expert` axis for EP sharding."""
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        B, T, D = x.shape
+        E, K = cfg.num_experts, cfg.num_experts_per_tok
+        N = B * T
+        # Small token counts (decode steps) run DROP-FREE: worst-case
+        # capacity N*K is tiny there, and dropping at T=1 would
+        # silently zero expert contributions on routing collisions and
+        # change generated tokens. Large N (prefill/training) uses the
+        # standard capacity factor.
+        if N * K <= 4096:
+            C = N * K
+        else:
+            C = max(K, int(cfg.capacity_factor * K * N / E))
+
+        tokens = x.reshape(N, D)
+        router_w = self.param("router", nn.initializers.normal(0.02),
+                              (D, E), jnp.float32)
+        logits = tokens.astype(jnp.float32) @ router_w        # [N, E]
+        # Mixtral normalizes softmax over the selected top-k only.
+        topk_logits, topk_idx = jax.lax.top_k(logits, K)      # [N, K]
+        topk_gates = jax.nn.softmax(topk_logits, axis=-1)     # [N, K]
+
+        # Capacity slots per (token, choice): position of this
+        # assignment within its expert's buffer, counted over the
+        # flattened [N*K] assignment stream.
+        assign_onehot = jax.nn.one_hot(
+            topk_idx.reshape(-1), E, dtype=jnp.int32)         # [N*K, E]
+        pos = (jnp.cumsum(assign_onehot, axis=0) - 1) * assign_onehot
+        slot = jnp.sum(pos, axis=-1).reshape(N, K)            # [N, K]
+        keep = slot < C                                       # overflow
+
+        # dispatch[n, e, c] = sum over kept choices of token n
+        disp = (jax.nn.one_hot(topk_idx, E, dtype=cfg.dtype) *
+                keep[..., None].astype(cfg.dtype))            # [N,K,E]
+        slots = jax.nn.one_hot(slot, C, dtype=cfg.dtype)      # [N,K,C]
+        dispatch = jnp.einsum("nke,nkc->nec", disp, slots)    # [N,E,C]
+        combine = jnp.einsum(
+            "nke,nkc,nk->nec", disp, slots,
+            topk_gates.astype(cfg.dtype))                     # [N,E,C]
+
+        pd = cfg.param_dtype
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (E, D, cfg.hidden_dim), pd).astype(cfg.dtype)
+        w3 = self.param("w3", nn.initializers.lecun_normal(),
+                        (E, D, cfg.hidden_dim), pd).astype(cfg.dtype)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (E, cfg.hidden_dim, D), pd).astype(cfg.dtype)
+
+        expert_in = jnp.einsum("nd,nec->ecd",
+                               tokens.astype(cfg.dtype), dispatch)
+        expert_in = _maybe_constrain(expert_in,
+                                     P("expert", None, None))
+        h = nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w1)) * \
+            jnp.einsum("ecd,edf->ecf", expert_in, w3)
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w2)
+        expert_out = _maybe_constrain(expert_out,
+                                      P("expert", None, None))
+
+        out = jnp.einsum("ecd,nec->nd", expert_out, combine)
+
+        # Load-balance auxiliary (Switch eq. 4 over top-1 choice).
+        top1 = jax.nn.one_hot(topk_idx[:, 0], E, dtype=jnp.float32)
+        frac_tokens = jnp.mean(top1, axis=0)
+        frac_probs = jnp.mean(jax.nn.softmax(logits, axis=-1), axis=0)
+        self.sow("losses", "load_balance",
+                 E * jnp.sum(frac_tokens * frac_probs))
+        return out.reshape(B, T, D)
+
+
+class MixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, freqs, positions, kv_cache=None,
+                 cache_len=None):
+        cfg = self.config
+        return block_forward(
+            cfg, cfg.attention_config(),
+            MoEFeedForward(cfg, name="moe"),
+            x, freqs, positions, kv_cache, cache_len)
+
+
+class Mixtral(nn.Module):
+    """Call signature mirrors models/llama.py Llama — enforced by
+    construction: both families run the shared transformer_forward, so
+    the decode paths (generate / generate_stream, KV caches) apply
+    unchanged."""
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, input_ids, kv_caches=None, cache_len=None):
+        return transformer_forward(self, self.config, MixtralBlock,
+                                   input_ids, kv_caches, cache_len)
+
+
+def mixtral_sharding_rules(fsdp: bool = True) -> ShardingRules:
+    """Llama TP/FSDP rules + expert-parallel rules for the MoE params:
+    expert tensors shard their leading E dim over `expert` and their
+    inner dim over `tensor`."""
+    f = "fsdp" if fsdp else None
+    return ShardingRules([
+        (r"attention/w[qkv]/kernel", P(f, "tensor")),
+        (r"attention/wo/kernel",     P("tensor", f)),
+        (r"moe/w[13]$",              P("expert", f, "tensor")),
+        (r"moe/w2$",                 P("expert", "tensor", f)),
+        (r"moe/router$",             P(None, None)),
+        (r"tok_embeddings$",
+         P(("tensor", "fsdp") if fsdp else "tensor", None)),
+    ])
+
+
+def moe_aux_loss(variables) -> jnp.ndarray:
+    """Mean load-balance loss over layers (add `mutable=['losses']` to
+    apply, then weight this into the training loss)."""
+    losses = variables.get("losses", {})
+    vals = jax.tree_util.tree_leaves(losses)
+    if not vals:
+        return jnp.float32(0.0)
+    return sum(jnp.asarray(v).mean() for v in vals) / len(vals)
+
+
+def mixtral_param_count(cfg: MixtralConfig) -> int:
+    attn = (cfg.dim * cfg.n_heads * cfg.head_dim +
+            2 * cfg.dim * cfg.n_kv_heads * cfg.head_dim +
+            cfg.n_heads * cfg.head_dim * cfg.dim)
+    moe = cfg.num_experts * 3 * cfg.dim * cfg.hidden_dim + \
+        cfg.dim * cfg.num_experts
+    per_layer = attn + moe + 2 * cfg.dim
+    return cfg.vocab_size * cfg.dim + cfg.n_layers * per_layer + cfg.dim
+
+
+def active_params_per_token(cfg: MixtralConfig) -> int:
+    """Sparse models are priced by ACTIVE params: K experts of E."""
+    attn = (cfg.dim * cfg.n_heads * cfg.head_dim +
+            2 * cfg.dim * cfg.n_kv_heads * cfg.head_dim +
+            cfg.n_heads * cfg.head_dim * cfg.dim)
+    moe = cfg.num_experts_per_tok * 3 * cfg.dim * cfg.hidden_dim + \
+        cfg.dim * cfg.num_experts
+    per_layer = attn + moe + 2 * cfg.dim
+    return cfg.vocab_size * cfg.dim + cfg.n_layers * per_layer + cfg.dim
