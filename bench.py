@@ -32,6 +32,32 @@ def peak_flops(device) -> float:
     return 1e11
 
 
+def _devices_or_die(timeout_s: float = 240.0):
+    """Device init goes through the axon tunnel, which can wedge and
+    block jax.devices() forever — fail FAST with a diagnosable JSON
+    line instead of hanging the whole bench run."""
+    import sys
+    import threading
+    out = {}
+
+    def probe():
+        import jax
+        out["devices"] = jax.devices()
+
+    th = threading.Thread(target=probe, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if "devices" not in out:
+        print(json.dumps({
+            "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+            "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0,
+            "error": f"TPU backend unreachable: jax.devices() did not "
+                     f"return within {timeout_s:.0f}s (axon tunnel "
+                     f"wedged?)"}))
+        sys.exit(1)
+    return out["devices"]
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -45,7 +71,7 @@ def main():
     from ray_tpu.train.spmd import (TrainState, make_train_step,
                                     put_batch, shard_state)
 
-    devices = jax.devices()
+    devices = _devices_or_die()
     n_chips = len(devices)
     on_tpu = devices[0].platform == "tpu"
 
