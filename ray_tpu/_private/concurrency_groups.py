@@ -1,0 +1,55 @@
+"""Actor concurrency groups: shared mailbox routing (reference:
+concurrency groups in the core worker task transports — per-group
+parallelism, FIFO within a group, independent across groups).
+
+Both actor executors (the in-process runtime's _ActorState and the
+worker process's _ActorSlot) delegate their group bookkeeping here so
+routing/sizing/sentinel logic cannot drift between runtimes."""
+from __future__ import annotations
+
+import queue
+from typing import Dict, Optional
+
+DEFAULT_GROUP = "_default"
+
+
+class GroupMailboxes:
+    """One FIFO mailbox per concurrency group (+ the default group,
+    which carries the actor's max_concurrency)."""
+
+    def __init__(self, concurrency_groups: Optional[Dict[str, int]],
+                 max_concurrency: int):
+        self.groups: Dict[str, int] = dict(concurrency_groups or {})
+        self.max_concurrency = max(1, max_concurrency)
+        self.boxes: Dict[str, "queue.Queue"] = {
+            g: queue.Queue() for g in [DEFAULT_GROUP, *self.groups]}
+
+    def size(self, group: str) -> int:
+        if group == DEFAULT_GROUP:
+            return self.max_concurrency
+        return max(1, self.groups[group])
+
+    def route(self, group: Optional[str]) -> "queue.Queue":
+        """Mailbox for a call's group; raises ValueError on an
+        undeclared group."""
+        g = group or DEFAULT_GROUP
+        box = self.boxes.get(g)
+        if box is None:
+            raise ValueError(
+                f"actor has no concurrency group {g!r} "
+                f"(declared: {sorted(self.groups) or 'none'})")
+        return box
+
+    def items(self):
+        return self.boxes.items()
+
+    def stop(self):
+        """One sentinel per consumer thread of every group."""
+        for g, box in self.boxes.items():
+            for _ in range(self.size(g)):
+                box.put(None)
+
+    def stop_one_per_group(self):
+        """One sentinel per group (async pumps: one pump per group)."""
+        for box in self.boxes.values():
+            box.put(None)

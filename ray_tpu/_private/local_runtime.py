@@ -136,7 +136,9 @@ class _ActorState:
         self.death_reason = ""
         self.num_restarts = 0
         self.restarting = False
-        self.mailbox: "queue.Queue" = queue.Queue()
+        from ray_tpu._private.concurrency_groups import GroupMailboxes
+        self.gm = GroupMailboxes(spec.concurrency_groups,
+                                 max(1, spec.max_concurrency))
         self.pending_count = 0
         self.lock = threading.RLock()
         self.threads: List[threading.Thread] = []
@@ -153,14 +155,16 @@ class _ActorState:
             t.start()
             self.threads = [t]
         else:
-            n = max(1, self.spec.max_concurrency)
             self.threads = []
-            for i in range(n):
-                t = threading.Thread(
-                    target=self._thread_loop, daemon=True,
-                    name=f"actor-{self.spec.actor_id.hex()[:8]}-{i}")
-                t.start()
-                self.threads.append(t)
+            for group, box in self.gm.items():
+                for i in range(self.gm.size(group)):
+                    t = threading.Thread(
+                        target=self._thread_loop, args=(box,),
+                        daemon=True,
+                        name=f"actor-{self.spec.actor_id.hex()[:8]}"
+                             f"-{group}-{i}")
+                    t.start()
+                    self.threads.append(t)
 
     def _instantiate(self):
         try:
@@ -177,7 +181,7 @@ class _ActorState:
         finally:
             self.created.set()
 
-    def _thread_loop(self):
+    def _thread_loop(self, box: "queue.Queue"):
         # First thread instantiates.
         if not self.created.is_set():
             with self.lock:
@@ -185,7 +189,7 @@ class _ActorState:
                     self._instantiate()
         self.created.wait()
         while True:
-            item = self.mailbox.get()
+            item = box.get()
             if item is None:
                 return
             spec, ctx_runtime = item
@@ -203,11 +207,13 @@ class _ActorState:
         self.loop = loop
         asyncio.set_event_loop(loop)
         self._instantiate()
-        sem = asyncio.Semaphore(max(1, self.spec.max_concurrency))
+        # per-group semaphores bound concurrency independently
+        sems = {g: asyncio.Semaphore(self.gm.size(g))
+                for g, _ in self.gm.items()}
 
-        async def pump():
+        async def pump(box, sem):
             while True:
-                item = await loop.run_in_executor(None, self.mailbox.get)
+                item = await loop.run_in_executor(None, box.get)
                 if item is None:
                     return
                 spec, ctx_runtime = item
@@ -226,12 +232,18 @@ class _ActorState:
 
                 loop.create_task(run_one())
 
+        async def pump_all():
+            await asyncio.gather(*[
+                pump(box, sems[g])
+                for g, box in self.gm.items()])
+
         try:
-            loop.run_until_complete(pump())
+            loop.run_until_complete(pump_all())
         finally:
             loop.close()
 
     def submit(self, spec: TaskSpec, runtime: "LocalRuntime"):
+        box = self.gm.route(getattr(spec, "concurrency_group", None))
         with self.lock:
             if self.dead and not self.restarting:
                 runtime._store_error(
@@ -244,11 +256,13 @@ class _ActorState:
                     f"actor {self.spec.actor_id.hex()[:8]} has "
                     f"{self.pending_count} pending calls (limit {limit})")
             self.pending_count += 1
-        self.mailbox.put((spec, runtime))
+        box.put((spec, runtime))
 
     def stop(self):
-        for _ in self.threads:
-            self.mailbox.put(None)
+        if self.spec.is_async:
+            self.gm.stop_one_per_group()
+        else:
+            self.gm.stop()
 
 
 class PlacementGroup:
@@ -702,7 +716,15 @@ class LocalRuntime:
             self._tasks_by_id[spec.task_id] = spec
             self._task_states[spec.task_id] = "PENDING_ACTOR"
         st = self.get_actor_state(actor_id)
-        st.submit(spec, self)
+        try:
+            st.submit(spec, self)
+        except BaseException:
+            # rejected at submit (unknown concurrency group, pending
+            # limit): drop the phantom task record
+            with self._lock:
+                self._tasks_by_id.pop(spec.task_id, None)
+                self._task_states.pop(spec.task_id, None)
+            raise
         return refs
 
     def _execute_actor_task(self, st: _ActorState, spec: TaskSpec):

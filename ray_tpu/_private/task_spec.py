@@ -148,6 +148,8 @@ class TaskSpec:
     # Actor fields
     actor_id: Optional[ActorID] = None
     method_name: Optional[str] = None
+    # actor concurrency group the call executes in (None = default)
+    concurrency_group: Optional[str] = None
     is_actor_creation: bool = False
     # Bookkeeping
     attempt: int = 0

@@ -126,19 +126,25 @@ class RemoteFunction:
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns: Optional[int] = None):
+    def options(self, num_returns: Optional[int] = None,
+                concurrency_group: Optional[str] = None):
         return ActorMethod(
             self._handle, self._method_name,
-            self._num_returns if num_returns is None else num_returns)
+            self._num_returns if num_returns is None else num_returns,
+            self._concurrency_group if concurrency_group is None
+            else concurrency_group)
 
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(
-            self._method_name, args, kwargs, self._num_returns)
+            self._method_name, args, kwargs, self._num_returns,
+            self._concurrency_group)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -165,10 +171,13 @@ class ActorHandle:
             raise AttributeError(
                 f"{self._cls.__name__} has no method {name!r}")
         method_opts = getattr(attr, "__ray_tpu_method_opts__", {})
-        return ActorMethod(self, name,
-                           num_returns=method_opts.get("num_returns", 1))
+        return ActorMethod(
+            self, name,
+            num_returns=method_opts.get("num_returns", 1),
+            concurrency_group=method_opts.get("concurrency_group"))
 
-    def _submit_method(self, method_name, args, kwargs, num_returns):
+    def _submit_method(self, method_name, args, kwargs, num_returns,
+                       concurrency_group=None):
         w = global_worker()
         rt = w.runtime
         task_id = TaskID.of(rt.job_id)
@@ -187,6 +196,7 @@ class ActorHandle:
             max_retries=self._max_task_retries,
             actor_id=self._actor_id,
             method_name=method_name,
+            concurrency_group=concurrency_group,
             trace_ctx=_maybe_trace(
                 f"{self._cls.__name__}.{method_name}", "actor_task"),
         )

@@ -266,6 +266,7 @@ def create_actor_via_head(head: RpcClient, spec: ActorCreationSpec):
         "args": spec.args,
         "kwargs": spec.kwargs,
         "max_concurrency": spec.max_concurrency,
+        "concurrency_groups": spec.concurrency_groups,
         "runtime_env": spec.runtime_env,
     })
     pg_id = None
@@ -285,6 +286,7 @@ def create_actor_via_head(head: RpcClient, spec: ActorCreationSpec):
         "name": spec.name,
         "namespace": spec.namespace,
         "get_if_exists": spec.get_if_exists,
+        "concurrency_groups": spec.concurrency_groups,
     }
     if spec.runtime_env:
         from ray_tpu._private.runtime_env import runtime_env_key
@@ -310,10 +312,12 @@ def submit_actor_task_via_head(head: RpcClient, actor_id: ActorID,
         "kwargs": spec.kwargs,
         "num_returns": spec.num_returns,
         "return_ids": [oid.binary() for oid in spec.return_ids],
+        "concurrency_group": spec.concurrency_group,
         "trace_ctx": spec.trace_ctx,
     })
     head.call("submit_actor_task", actor_id.hex(),
-              {"task_id": spec.task_id.hex()}, payload)
+              {"task_id": spec.task_id.hex(),
+               "concurrency_group": spec.concurrency_group}, payload)
     return refs
 
 

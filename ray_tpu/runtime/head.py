@@ -91,6 +91,10 @@ class _ActorInfo:
         # must not double-count it against the worker.
         self.pg_id = pg_id
         self.bundle_index = bundle_index
+        # declared concurrency groups (validated at call submission so
+        # an unknown group fails synchronously at .remote(), matching
+        # the in-process runtime)
+        self.concurrency_groups: Dict[str, int] = {}
 
 
 class HeadService:
@@ -1058,6 +1062,8 @@ class HeadService:
                                   pg_id=pg_id, bundle_index=placed_bidx,
                                   env_key=meta.get("env_key"),
                                   runtime_env=meta.get("runtime_env"))
+                info.concurrency_groups = dict(
+                    meta.get("concurrency_groups") or {})
                 self._actors[actor_id] = info
                 if name:
                     self._named[(ns, name)] = actor_id
@@ -1186,6 +1192,12 @@ class HeadService:
                 if a is None or a.dead:
                     reason = a.death_reason if a else "unknown actor"
                     raise ActorDiedError(actor_id, reason)
+                group = meta.get("concurrency_group")
+                if group and group not in a.concurrency_groups:
+                    raise ValueError(
+                        f"actor has no concurrency group {group!r} "
+                        f"(declared: "
+                        f"{sorted(a.concurrency_groups) or 'none'})")
                 if a.worker_id == "":
                     # Restored-from-snapshot (or mid-restart) actor
                     # awaiting its worker's re-attach: wait for the

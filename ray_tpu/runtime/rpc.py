@@ -132,6 +132,14 @@ class RpcServer:
                 try:
                     _send_msg(conn, {"rid": None,
                                      "err": RpcError(reason)})
+                    # Drain whatever the peer already sent before
+                    # closing: closing with unread rx data turns the
+                    # close into an RST, which can discard the error
+                    # frame before the peer reads it.
+                    conn.settimeout(0.5)
+                    for _ in range(16):       # bounded drain
+                        if not conn.recv(65536):
+                            break
                 except (ConnectionError, OSError):
                     pass
                 return

@@ -33,13 +33,27 @@ _task_ctx = threading.local()
 
 
 class _ActorSlot:
-    def __init__(self, instance=None, error: Optional[BaseException] = None):
+    def __init__(self, instance=None, error: Optional[BaseException] = None,
+                 concurrency_groups: Optional[Dict[str, int]] = None,
+                 max_concurrency: int = 1):
+        from ray_tpu._private.concurrency_groups import GroupMailboxes
         self.instance = instance
         self.error = error
-        self.mailbox: "queue.Queue" = queue.Queue()
+        self.gm = GroupMailboxes(concurrency_groups, max_concurrency)
+        self.threads: list = []
         self.thread: Optional[threading.Thread] = None
         self.runtime_env = None
-        self.aloop = None      # lazily-created asyncio loop
+        self.aloop = None      # asyncio actors: their event loop
+        # sync actors: coroutine-returning methods drive a PER-THREAD
+        # loop — multiple group threads must never share one loop
+        self._thread_loops = threading.local()
+
+    def thread_loop(self):
+        loop = getattr(self._thread_loops, "loop", None)
+        if loop is None:
+            import asyncio
+            loop = self._thread_loops.loop = asyncio.new_event_loop()
+        return loop
 
 
 class Executor:
@@ -297,7 +311,9 @@ class Executor:
 
     def create_actor(self, actor_id: str, payload: bytes) -> str:
         spec = cloudpickle.loads(payload)
-        slot = _ActorSlot()
+        slot = _ActorSlot(
+            concurrency_groups=spec.get("concurrency_groups"),
+            max_concurrency=spec.get("max_concurrency", 1))
         cls = spec["cls"]
         slot.runtime_env = spec.get("runtime_env")
         if self._wants_asyncio(cls):
@@ -321,10 +337,15 @@ class Executor:
                     slot.instance = cls(*spec["args"], **spec["kwargs"])
             except BaseException as e:  # noqa: BLE001
                 slot.error = e
-            slot.thread = threading.Thread(
-                target=self._actor_loop, args=(actor_id, slot),
-                daemon=True, name=f"actor-{actor_id[:8]}")
-            slot.thread.start()
+            for group, box in slot.gm.items():
+                for i in range(slot.gm.size(group)):
+                    t = threading.Thread(
+                        target=self._actor_loop,
+                        args=(actor_id, slot, box), daemon=True,
+                        name=f"actor-{actor_id[:8]}-{group}-{i}")
+                    t.start()
+                    slot.threads.append(t)
+            slot.thread = slot.threads[0]
         with self._lock:
             self.actors[actor_id] = slot
         return "ok" if slot.error is None else "init_failed"
@@ -346,17 +367,29 @@ class Executor:
         finally:
             init_done.set()
 
-        async def drain():
+        # One pump per concurrency group; per-group semaphores bound
+        # concurrency independently (default group = max_concurrency).
+        sems = {g: asyncio.Semaphore(slot.gm.size(g))
+                for g, _ in slot.gm.items()}
+
+        async def drain(box, sem):
             while not self._shutdown.is_set():
-                item = await loop.run_in_executor(None,
-                                                  slot.mailbox.get)
+                item = await loop.run_in_executor(None, box.get)
                 if item is None:
                     return
-                await self._execute_actor_item_async(actor_id, slot,
-                                                     item)
+
+                async def run_one(item=item):
+                    async with sem:
+                        await self._execute_actor_item_async(
+                            actor_id, slot, item)
+                loop.create_task(run_one())
+
+        async def drain_all():
+            await asyncio.gather(*[drain(box, sems[g])
+                                   for g, box in slot.gm.items()])
 
         try:
-            loop.run_until_complete(drain())
+            loop.run_until_complete(drain_all())
         except Exception:
             pass
         finally:
@@ -392,11 +425,12 @@ class Executor:
                               remote_traceback=traceback.format_exc())
             self._write_error(spec["return_ids"], e)
 
-    def _actor_loop(self, actor_id: str, slot: _ActorSlot):
+    def _actor_loop(self, actor_id: str, slot: _ActorSlot,
+                    box: "queue.Queue"):
         from ray_tpu._private.log_streaming import set_log_tag
         set_log_tag(f"actor={actor_id[:12]}")
         while not self._shutdown.is_set():
-            item = slot.mailbox.get()
+            item = box.get()
             if item is None:
                 return
             spec = item
@@ -418,13 +452,11 @@ class Executor:
                     result = method(*args, **kwargs)
                     import inspect
                     if inspect.iscoroutine(result):
-                        # asyncio actor: drive the coroutine on this
-                        # actor's own event loop (ordered semantics,
-                        # the fiber-transport analogue).
-                        if slot.aloop is None:
-                            import asyncio
-                            slot.aloop = asyncio.new_event_loop()
-                        result = slot.aloop.run_until_complete(result)
+                        # coroutine from a sync-classified actor: each
+                        # group thread drives its OWN loop — a shared
+                        # loop would race across concurrent threads
+                        result = slot.thread_loop() \
+                            .run_until_complete(result)
                 self._write_returns(spec["return_ids"],
                                     spec["num_returns"], result)
             except BaseException as e:  # noqa: BLE001
@@ -441,14 +473,25 @@ class Executor:
             self._write_error(spec["return_ids"],
                               ActorDiedError(actor_id, "not on worker"))
             return "dead"
-        slot.mailbox.put(spec)
+        try:
+            box = slot.gm.route(spec.get("concurrency_group"))
+        except ValueError as e:
+            # backstop: the head validates groups at submission, so
+            # this only fires on a stale/raced actor definition
+            self._write_error(spec["return_ids"], TaskError(
+                e, task_name=spec.get("name", "")))
+            return "bad_group"
+        box.put(spec)
         return "queued"
 
     def kill_actor(self, actor_id: str, restart: bool) -> str:
         with self._lock:
             slot = self.actors.pop(actor_id, None)
         if slot is not None:
-            slot.mailbox.put(None)
+            if slot.aloop is not None:      # async: one pump per group
+                slot.gm.stop_one_per_group()
+            else:
+                slot.gm.stop()
         return "ok"
 
     # ---- lifecycle --------------------------------------------------------
