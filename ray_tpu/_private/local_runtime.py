@@ -239,6 +239,11 @@ class _ActorState:
 
         try:
             loop.run_until_complete(pump_all())
+            pending = [t for t in asyncio.all_tasks(loop)
+                       if not t.done()]
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
         finally:
             loop.close()
 

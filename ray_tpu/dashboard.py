@@ -81,6 +81,8 @@ class Dashboard:
         loop.run_until_complete(runner.setup())
         site = web.TCPSite(runner, self.host, self.port)
         loop.run_until_complete(site.start())
+        if runner.addresses:
+            self.port = runner.addresses[0][1]
         self._started.set()
         loop.run_forever()
 

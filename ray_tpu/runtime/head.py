@@ -192,6 +192,8 @@ class HeadService:
                               "pg_id": a.pg_id,
                               "bundle_index": a.bundle_index,
                               "env_key": a.env_key,
+                              "concurrency_groups":
+                                  a.concurrency_groups,
                               "runtime_env": a.runtime_env}
                         for aid, a in self._actors.items()
                         if not a.dead},
@@ -227,6 +229,8 @@ class HeadService:
                     env_key=rec.get("env_key"),
                     runtime_env=rec.get("runtime_env"))
                 info.restarts = rec.get("restarts", 0)
+                info.concurrency_groups = dict(
+                    rec.get("concurrency_groups") or {})
                 # worker_id="" == awaiting re-attach: the worker that
                 # hosts this actor re-reports it on its next heartbeat
                 # miss; calls meanwhile wait (submit_actor_task).

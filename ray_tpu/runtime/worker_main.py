@@ -55,6 +55,12 @@ class _ActorSlot:
             loop = self._thread_loops.loop = asyncio.new_event_loop()
         return loop
 
+    def close_thread_loop(self):
+        loop = getattr(self._thread_loops, "loop", None)
+        if loop is not None:
+            loop.close()
+            self._thread_loops.loop = None
+
 
 class Executor:
     """RPC handler for this worker process."""
@@ -390,6 +396,14 @@ class Executor:
 
         try:
             loop.run_until_complete(drain_all())
+            # drain saw its sentinel but fire-and-forget run_one tasks
+            # may still be in flight: finish them so every queued call
+            # writes its result before the loop dies
+            pending = [t for t in asyncio.all_tasks(loop)
+                       if not t.done()]
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
         except Exception:
             pass
         finally:
@@ -432,6 +446,7 @@ class Executor:
         while not self._shutdown.is_set():
             item = box.get()
             if item is None:
+                slot.close_thread_loop()   # don't leak per-thread loops
                 return
             spec = item
             try:

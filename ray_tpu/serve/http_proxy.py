@@ -136,6 +136,9 @@ class HTTPProxy:
         loop.run_until_complete(runner.setup())
         site = web.TCPSite(runner, self.host, self.port)
         loop.run_until_complete(site.start())
+        # port=0 binds an ephemeral port; report the real one
+        if runner.addresses:
+            self.port = runner.addresses[0][1]
         self._runner = runner
         self._started.set()
         loop.run_forever()

@@ -164,23 +164,23 @@ def test_http_proxy(serve_rt):
         return {"echoed": payload}
 
     serve.run(echo.bind())
-    proxy = start_http(port=18111)
+    proxy = start_http(port=0)
     try:
         req = urllib.request.Request(
-            "http://127.0.0.1:18111/echo", method="POST",
+            f"http://127.0.0.1:{proxy.port}/echo", method="POST",
             data=_json.dumps({"msg": "hi"}).encode(),
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=30) as resp:
             body = _json.loads(resp.read())
         assert body == {"result": {"echoed": {"msg": "hi"}}}
         with urllib.request.urlopen(
-                "http://127.0.0.1:18111/-/healthz", timeout=30) as resp:
+                f"http://127.0.0.1:{proxy.port}/-/healthz", timeout=30) as resp:
             health = _json.loads(resp.read())
         assert health["status"] == "ok"
         # Unknown deployment -> 404
         try:
             urllib.request.urlopen(
-                "http://127.0.0.1:18111/missing", timeout=30)
+                f"http://127.0.0.1:{proxy.port}/missing", timeout=30)
             assert False, "expected 404"
         except urllib.error.HTTPError as e:
             assert e.code == 404
@@ -407,10 +407,10 @@ def test_http_proxy_streaming(serve_rt):
     serve.run(Chunks.bind())
     from ray_tpu.serve.http_proxy import start_http, stop_http
     import json as _json
-    proxy = start_http(port=18731)
+    proxy = start_http(port=0)
     try:
         req = urllib.request.Request(
-            "http://127.0.0.1:18731/Chunks?stream=1",
+            f"http://127.0.0.1:{proxy.port}/Chunks?stream=1",
             data=_json.dumps({"n": 3}).encode(),
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=30) as r:

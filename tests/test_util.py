@@ -145,11 +145,11 @@ def test_dashboard_endpoints(rt):
         return 1
 
     ray_tpu.get(traced_task.remote())
-    dash = Dashboard(port=18266).start()
+    dash = Dashboard(port=0).start()
     try:
         def fetch(path):
             with urllib.request.urlopen(
-                    f"http://127.0.0.1:18266{path}", timeout=15) as r:
+                    f"http://127.0.0.1:{dash.port}{path}", timeout=15) as r:
                 return r.read().decode()
 
         summary = json.loads(fetch("/api/cluster_summary"))
