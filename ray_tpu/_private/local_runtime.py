@@ -248,13 +248,16 @@ class _ActorState:
             loop.close()
 
     def submit(self, spec: TaskSpec, runtime: "LocalRuntime"):
-        box = self.gm.route(getattr(spec, "concurrency_group", None))
         with self.lock:
             if self.dead and not self.restarting:
+                # dead-actor contract first: callers uniformly get an
+                # ActorDiedError via the ref, even with a bad group
                 runtime._store_error(
                     spec, ActorDiedError(self.spec.actor_id,
                                          self.death_reason))
                 return
+        box = self.gm.route(getattr(spec, "concurrency_group", None))
+        with self.lock:
             limit = self.spec.max_pending_calls
             if limit and limit > 0 and self.pending_count >= limit:
                 raise PendingCallsLimitExceeded(

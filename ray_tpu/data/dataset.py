@@ -759,49 +759,14 @@ def from_numpy(arr: np.ndarray, parallelism: int = 8) -> Dataset:
                     for chunk in chunks])
 
 
-@ray_tpu.remote(num_cpus=0.25)
-def _read_file_block(path: str, fmt: str) -> Block:
-    """Source task: one input file per block (reference: read tasks,
-    data/read_api.py — file bytes never pass through the driver)."""
-    if fmt == "csv":
-        import csv
-        rows: Block = []
-        with open(path, newline="") as f:
-            for row in csv.DictReader(f):
-                parsed = {}
-                for k, v in row.items():
-                    try:
-                        parsed[k] = float(v) if "." in v or "e" in v \
-                            else int(v)
-                    except (ValueError, TypeError):
-                        parsed[k] = v
-                rows.append(parsed)
-        return rows
-    import json
-    rows = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                rows.append(json.loads(line))
-    return rows
-
-
-def _read_files(path: str, fmt: str, parallelism: int) -> Dataset:
-    import glob as globlib
-    paths = sorted(globlib.glob(path)) or [path]
-    ds = Dataset([_read_file_block.remote(p, fmt) for p in paths])
-    if len(paths) < parallelism:
-        ds = ds.repartition(parallelism)
-    return ds
-
-
 def read_csv(path: str, parallelism: int = 8) -> Dataset:
     """CSV rows as dicts (header required), one read task per file.
-    Values parsed as float/int when possible."""
-    return _read_files(path, "csv", parallelism)
+    Values parsed as int/float when possible."""
+    from ray_tpu.data.datasources import _read_source
+    return _read_source(path, "csv", parallelism)
 
 
 def read_json(path: str, parallelism: int = 8) -> Dataset:
     """JSON-lines files, one read task per file."""
-    return _read_files(path, "json", parallelism)
+    from ray_tpu.data.datasources import _read_source
+    return _read_source(path, "jsonl", parallelism)

@@ -44,11 +44,15 @@ def _read_source_file(p: str, fmt: str, include_paths: bool):
             for row in csv.DictReader(f):
                 parsed = {}
                 for k, v in row.items():
+                    # int first, float fallback: "2"->2, "1E5"->1e5,
+                    # "NaN"->nan, else keep the string
                     try:
-                        parsed[k] = float(v) if "." in v or "e" in v \
-                            else int(v)
+                        parsed[k] = int(v)
                     except (ValueError, TypeError):
-                        parsed[k] = v
+                        try:
+                            parsed[k] = float(v)
+                        except (ValueError, TypeError):
+                            parsed[k] = v
                 rows.append(parsed)
         return rows
     if fmt == "jsonl":

@@ -40,7 +40,18 @@ class Replica:
                 self.instance.setup_mesh(self.mesh)
         self._ongoing = 0
         self._total = 0
+        # _ongoing is mutated from the event loop AND pool threads
+        # (streaming _finish): the read-modify-write must be locked or
+        # lost updates drift the count autoscaling/draining read.
+        import threading
+        self._count_lock = threading.Lock()
         self._streams: Dict[str, Dict[str, Any]] = {}
+
+    def _adjust_ongoing(self, delta: int):
+        with self._count_lock:
+            self._ongoing += delta
+            if delta > 0:
+                self._total += 1
 
     def _target_fn(self, method_name: str):
         target = self.instance
@@ -62,11 +73,10 @@ class Replica:
         fn = self._target_fn(method_name)   # raises BEFORE any state
         self._reap_abandoned_streams()
         st = {"chunks": [], "done": False, "error": None,
-              "event": asyncio.Event(), "last_poll": time.time(),
-              "abandoned": False}
+              "base": 0, "event": asyncio.Event(),
+              "last_poll": time.time(), "abandoned": False}
         self._streams[req_id] = st
-        self._ongoing += 1
-        self._total += 1
+        self._adjust_ongoing(+1)
 
         def _notify():
             loop.call_soon_threadsafe(st["event"].set)
@@ -75,7 +85,7 @@ class Replica:
             if error is not None:
                 st["error"] = error
             st["done"] = True
-            self._ongoing -= 1
+            self._adjust_ongoing(-1)
             _notify()
 
         # For __call__ the target IS the instance; inspect its bound
@@ -95,7 +105,7 @@ class Replica:
                     st["error"] = e
                 finally:
                     st["done"] = True
-                    self._ongoing -= 1
+                    self._adjust_ongoing(-1)
                     st["event"].set()
             asyncio.ensure_future(_drain_async())
             return True
@@ -121,9 +131,9 @@ class Replica:
                     asyncio.run_coroutine_threadsafe(
                         _adrain(), loop).result()
                 elif inspect.isgenerator(result) or (
-                        hasattr(result, "__iter__") and
-                        not isinstance(result, (str, bytes, dict,
-                                                list, tuple))):
+                        hasattr(result, "__next__")):
+                    # only true iterators stream element-wise; plain
+                    # iterable VALUES (arrays, sets) are one chunk
                     for chunk in result:
                         if st["abandoned"]:
                             break       # consumer gone: stop buffering
@@ -166,7 +176,10 @@ class Replica:
         st["last_poll"] = time.time()
         loop = asyncio.get_event_loop()
         deadline = loop.time() + timeout
-        while len(st["chunks"]) <= start and not st["done"]:
+        # indices are absolute; the buffer holds [base:] (acked chunks
+        # are trimmed — single consumer per stream)
+        while len(st["chunks"]) <= max(0, start - st["base"]) and \
+                not st["done"]:
             remaining = deadline - loop.time()
             if remaining <= 0:
                 break
@@ -175,16 +188,23 @@ class Replica:
             except asyncio.TimeoutError:
                 break
             st["event"].clear()
-        chunks = st["chunks"][start:]
-        done = st["done"] and (start + len(chunks)) == len(st["chunks"])
+        local = max(0, start - st["base"])
+        chunks = st["chunks"][local:]
+        done = st["done"] and (local + len(chunks)) == \
+            len(st["chunks"])
         err = st["error"] if done else None
         if done:
             self._streams.pop(req_id, None)
+        elif chunks:
+            # single consumer: trim acknowledged chunks so a long
+            # stream buffers O(unconsumed), not O(everything produced)
+            drop = local + len(chunks)
+            del st["chunks"][:drop]
+            st["base"] += drop
         return {"chunks": chunks, "done": done, "error": err}
 
     async def handle_request(self, method_name: str, args, kwargs):
-        self._ongoing += 1
-        self._total += 1
+        self._adjust_ongoing(+1)
         try:
             target = self.instance
             if method_name == "__call__":
@@ -207,7 +227,7 @@ class Replica:
                 result = await result
             return result
         finally:
-            self._ongoing -= 1
+            self._adjust_ongoing(-1)
 
     def stats(self):
         self._reap_abandoned_streams()

@@ -116,7 +116,10 @@ class HTTPProxy:
                     (json.dumps({"error": str(e)}) + "\n").encode())
             except (ConnectionError, OSError):
                 pass           # client already gone
-        await resp.write_eof()
+        try:
+            await resp.write_eof()
+        except (ConnectionError, OSError):
+            pass               # disconnect mid-stream: close quietly
         return resp
 
     async def _health(self, request):

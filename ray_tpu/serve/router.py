@@ -61,8 +61,15 @@ class StreamingResponse:
 
     def __next__(self):
         while not self._buf and not self._done:
-            out = ray_tpu.get(self._replica.next_chunks.remote(
-                self._req_id, self._pos))
+            try:
+                out = ray_tpu.get(self._replica.next_chunks.remote(
+                    self._req_id, self._pos))
+            except BaseException:
+                # transport failure (replica death, stream reaped):
+                # the in-flight slot must not stay held
+                self._done = True
+                self._release()
+                raise
             self._buf.extend(out["chunks"])
             self._pos += len(out["chunks"])
             if out["done"]:
