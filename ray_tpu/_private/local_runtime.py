@@ -256,8 +256,8 @@ class _ActorState:
                     spec, ActorDiedError(self.spec.actor_id,
                                          self.death_reason))
                 return
-        box = self.gm.route(getattr(spec, "concurrency_group", None))
-        with self.lock:
+            box = self.gm.route(
+                getattr(spec, "concurrency_group", None))
             limit = self.spec.max_pending_calls
             if limit and limit > 0 and self.pending_count >= limit:
                 raise PendingCallsLimitExceeded(

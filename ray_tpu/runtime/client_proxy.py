@@ -329,20 +329,27 @@ def start_proxy(head_address: str, port: int = 0):
     return server, rt
 
 
-def main():
-    import argparse
+def serve_forever(head_address: str, port: int = 10001,
+                  echo=print) -> None:
+    """Run a proxy endpoint until interrupted (shared by the module
+    entry point and the CLI `client-proxy` command)."""
     import time
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--head", required=True)
-    ap.add_argument("--port", type=int, default=10001)
-    args = ap.parse_args()
-    server, _rt = start_proxy(args.head, args.port)
-    print(f"client proxy ready on {server.address}", flush=True)
+    server, _rt = start_proxy(head_address, port)
+    echo(f"client proxy ready on ray://{server.address}")
     try:
         while True:
             time.sleep(1.0)
     except KeyboardInterrupt:
         server.stop()
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--head", required=True)
+    ap.add_argument("--port", type=int, default=10001)
+    args = ap.parse_args()
+    serve_forever(args.head, args.port)
 
 
 if __name__ == "__main__":

@@ -198,6 +198,39 @@ def metrics(address, prometheus):
                               indent=2))
 
 
+@cli.command()
+@click.option("--address", default=None)
+@click.option("--port", default=8265, type=int)
+@click.option("--host", default="127.0.0.1")
+def dashboard(address, port, host):
+    """Serve the dashboard UI + JSON API (reference: `ray dashboard`).
+    Attaches to the cluster, then blocks."""
+    import time as _time
+
+    import ray_tpu
+    ray_tpu.init(address=_resolve_address(address),
+                 ignore_reinit_error=True)
+    from ray_tpu.dashboard import Dashboard
+    dash = Dashboard(host=host, port=port).start()
+    click.echo(f"dashboard at http://{host}:{dash.port}/")
+    try:
+        while True:
+            _time.sleep(1)
+    except KeyboardInterrupt:
+        dash.stop()
+
+
+@cli.command("client-proxy")
+@click.option("--address", default=None,
+              help="head address (host:port)")
+@click.option("--port", default=10001, type=int)
+def client_proxy(address, port):
+    """Run a ray:// client proxy next to the head so remote drivers
+    can connect with init(address='ray://host:port')."""
+    from ray_tpu.runtime.client_proxy import serve_forever
+    serve_forever(_resolve_address(address), port, echo=click.echo)
+
+
 @cli.command("list")
 @click.option("--address", default=None)
 @click.argument("kind",

@@ -124,3 +124,19 @@ def test_cli_memory(cluster):
     res = runner.invoke(cli, ["memory", "--address", addr])
     assert res.exit_code == 0, res.output
     assert "capacity" in res.output
+
+
+def test_cli_registers_ops_commands():
+    """`python -m ray_tpu` exposes the ops surface (reference: ray
+    dashboard / client server entry points)."""
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "--help"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    for cmd in ("start", "stop", "status", "submit", "logs", "memory",
+                "metrics", "list", "timeline", "dashboard",
+                "client-proxy"):
+        assert cmd in out, f"missing CLI command {cmd}"
