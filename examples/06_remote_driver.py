@@ -1,0 +1,46 @@
+"""Drive a cluster remotely through the ray:// client proxy.
+
+Run: python examples/06_remote_driver.py
+(Starts an in-process cluster + proxy to demo; in production run
+`python -m ray_tpu client-proxy --address HEAD:PORT` next to the head
+and connect from any machine with init(address="ray://host:10001").)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))      # repo root (run from anywhere)
+
+import ray_tpu
+from ray_tpu.runtime.cluster_utils import Cluster
+from ray_tpu.runtime.client_proxy import start_proxy
+
+cluster = Cluster(num_workers=2, resources_per_worker={"CPU": 2},
+                  connect=False)
+server, _ = start_proxy(cluster.node.head_address)
+
+# ---- the remote-driver side (this is all a real client needs) ------
+ray_tpu.init(address=f"ray://{server.address}")
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+print("squares:", ray_tpu.get([square.remote(i) for i in range(5)]))
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def add(self, k):
+        self.n += k
+        return self.n
+
+c = Counter.remote()
+print("counter:", ray_tpu.get([c.add.remote(2) for _ in range(3)]))
+print("cluster CPUs:", ray_tpu.cluster_resources()["CPU"])
+
+ray_tpu.shutdown()
+server.stop()
+cluster.shutdown()
+print("remote driver demo done")

@@ -456,3 +456,53 @@ def test_llama_generate_batch_ragged_matches_unbatched(serve_rt):
     for p, got in zip(prompts, batched):
         solo = dep(p)[len(p):]
         assert got == solo, (p, got, solo)
+
+
+def test_autoscaling_counts_streaming_load(serve_rt):
+    """Streaming requests hold their in-flight slot for their whole
+    duration, so sustained streams drive upscale and draining streams
+    release it (the ongoing counter feeding autoscaling is shared with
+    the streaming path)."""
+    import threading
+
+    @serve.deployment(
+        max_ongoing_requests=2,
+        autoscaling_config=AutoscalingConfig(
+            min_replicas=1, max_replicas=3,
+            target_ongoing_requests=1.0,
+            upscale_delay_s=0.05, downscale_delay_s=0.3))
+    class Tokens:
+        def __call__(self, n):
+            for i in range(n):
+                time.sleep(0.02)
+                yield i
+
+    h = serve.run(Tokens.bind())
+    assert serve.get_deployment("Tokens")["num_replicas"] == 1
+
+    done = []
+
+    def consume():
+        done.append(len(list(h.options(stream=True).remote(80))))
+
+    threads = [threading.Thread(target=consume) for _ in range(6)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 15
+    scaled_up = False
+    while time.time() < deadline:
+        if serve.get_deployment("Tokens")["num_replicas"] >= 2:
+            scaled_up = True
+            break
+        time.sleep(0.05)
+    for t in threads:
+        t.join()
+    assert scaled_up, "streaming load must register as ongoing"
+    assert done == [80] * 6
+    # streams finished -> ongoing drains -> back to min replicas
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if serve.get_deployment("Tokens")["num_replicas"] == 1:
+            break
+        time.sleep(0.1)
+    assert serve.get_deployment("Tokens")["num_replicas"] == 1
