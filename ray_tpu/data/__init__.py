@@ -7,10 +7,12 @@ pipelines stream windows; datasources cover csv/json/text/binary/numpy
 """
 from ray_tpu.data.dataset import (Dataset, from_items, from_numpy,
                                   range_dataset, read_csv, read_json)
-from ray_tpu.data.datasources import (RandomAccessDataset, from_pandas,
-                                      read_binary_files, read_numpy,
-                                      read_parquet, read_text, to_pandas,
-                                      write_csv, write_json, write_numpy)
+from ray_tpu.data.datasources import (RandomAccessDataset,
+                                      from_huggingface, from_pandas,
+                                      from_torch, read_binary_files,
+                                      read_numpy, read_parquet,
+                                      read_text, to_pandas, write_csv,
+                                      write_json, write_numpy)
 from ray_tpu.data.pipeline import DatasetPipeline
 
 
@@ -21,7 +23,8 @@ def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001
 
 __all__ = [
     "Dataset", "DatasetPipeline", "RandomAccessDataset",
-    "from_items", "from_numpy", "from_pandas", "range", "range_dataset",
+    "from_items", "from_numpy", "from_pandas", "from_torch",
+    "from_huggingface", "range", "range_dataset",
     "read_csv", "read_json", "read_text", "read_binary_files",
     "read_numpy", "read_parquet", "to_pandas",
     "write_csv", "write_json", "write_numpy",

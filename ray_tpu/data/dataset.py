@@ -680,6 +680,36 @@ class Dataset:
             else:
                 yield jax.device_put(np.asarray(batch), sharding)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           dtypes=None) -> Iterator[Any]:
+        """Batches as torch tensors (reference:
+        Dataset.iter_torch_batches) — dict rows become dicts of
+        tensors; scalar rows one tensor. ``dtypes`` optionally maps
+        column -> torch dtype."""
+        import torch
+
+        def to_t(v, key=None):
+            t = torch.as_tensor(np.asarray(v))
+            if dtypes and key in dtypes:
+                t = t.to(dtypes[key])
+            return t
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            if isinstance(batch, dict):
+                yield {k: to_t(v, k) for k, v in batch.items()}
+            elif batch and isinstance(batch[0], (tuple, list)):
+                # tuple rows (e.g. from_torch (features, label)):
+                # stack each position into its own tensor
+                cols = list(zip(*batch))
+                yield tuple(to_t(np.stack([np.asarray(x)
+                                           for x in col]))
+                            for col in cols)
+            else:
+                yield to_t(batch)
+
     def to_numpy(self, key: Optional[str] = None) -> np.ndarray:
         """Per-block remote conversion, concatenated on the driver (the
         result is a driver-resident ndarray by definition)."""

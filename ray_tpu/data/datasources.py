@@ -283,3 +283,35 @@ class RandomAccessDataset:
 
     def multiget(self, keys: List[Any]) -> List[Optional[Dict[str, Any]]]:
         return [self.get(k) for k in keys]
+
+
+def from_torch(dataset, parallelism: int = 8) -> Dataset:
+    """A torch map-style Dataset -> rows (reference: from_torch).
+    Tensors convert to numpy so blocks serialize zero-copy."""
+    rows: List[Any] = []
+    for i in range(len(dataset)):
+        item = dataset[i]
+        rows.append(_torchify(item))
+    return from_items(rows, parallelism)
+
+
+def _torchify(item):
+    try:
+        import torch
+        if isinstance(item, torch.Tensor):
+            return item.detach().cpu().numpy()
+    except ImportError:
+        pass
+    if isinstance(item, tuple):
+        return tuple(_torchify(x) for x in item)
+    if isinstance(item, list):
+        return [_torchify(x) for x in item]
+    if isinstance(item, dict):
+        return {k: _torchify(v) for k, v in item.items()}
+    return item
+
+
+def from_huggingface(hf_dataset, parallelism: int = 8) -> Dataset:
+    """A huggingface datasets.Dataset -> rows of dicts (reference:
+    from_huggingface)."""
+    return from_items(list(hf_dataset), parallelism)

@@ -226,3 +226,47 @@ def test_random_access_dataset(rt):
     assert rad.get(100) is None
     assert rad.multiget([3, 100, 10]) == [
         {"id": 3, "val": 9}, None, {"id": 10, "val": 100}]
+
+
+def test_torch_interop(rt):
+    """iter_torch_batches + from_torch (reference:
+    Dataset.iter_torch_batches, from_torch)."""
+    import numpy as np
+    import torch
+
+    ds = rd.from_items([{"x": float(i), "y": i % 2}
+                        for i in range(10)], parallelism=2)
+    batches = list(ds.iter_torch_batches(
+        batch_size=4, dtypes={"x": torch.float32}))
+    assert [len(b["x"]) for b in batches] == [4, 4, 2]
+    assert batches[0]["x"].dtype == torch.float32
+    assert torch.equal(batches[0]["y"],
+                       torch.as_tensor([0, 1, 0, 1]))
+
+    class TDS(torch.utils.data.Dataset):
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            return torch.full((3,), float(i)), i
+
+    ds2 = rd.from_torch(TDS(), parallelism=2)
+    rows = ds2.take_all()
+    assert len(rows) == 6
+    arr, label = rows[4]
+    assert isinstance(arr, np.ndarray) and label == 4
+    assert arr.tolist() == [4.0, 4.0, 4.0]
+    # the composed round trip: tuple rows batch into stacked tensors
+    (feats, labels), = list(ds2.iter_torch_batches(batch_size=6))
+    assert feats.shape == (6, 3) and labels.tolist() == list(range(6))
+
+    class ListDS(torch.utils.data.Dataset):
+        def __len__(self):
+            return 2
+
+        def __getitem__(self, i):
+            return [torch.ones(2) * i, torch.zeros(1)]
+
+    lrows = rd.from_torch(ListDS(), parallelism=1).take_all()
+    assert all(isinstance(x, np.ndarray)
+               for row in lrows for x in row)
