@@ -14,6 +14,18 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 
+def _family_for(cfg):
+    """ONE config-type -> (model class, serving sharding rules) map so
+    model construction and mesh sharding can never disagree (a missed
+    dispatch site would silently replicate expert weights)."""
+    from ray_tpu.models.llama import Llama, llama_sharding_rules
+    from ray_tpu.models.mixtral import (Mixtral, MixtralConfig,
+                                        mixtral_sharding_rules)
+    if isinstance(cfg, MixtralConfig):
+        return Mixtral, mixtral_sharding_rules(fsdp=False)
+    return Llama, llama_sharding_rules(fsdp=False)
+
+
 class LlamaDeployment:
     """Deployment-ready Llama wrapper: __init__ builds/loads the model,
     __call__ generates. Wrap with @serve.deployment at use site so
@@ -22,9 +34,11 @@ class LlamaDeployment:
     def __init__(self, config=None, params=None, max_new_tokens: int = 64,
                  temperature: float = 0.0, stream_chunk: int = 8):
         import jax
-        from ray_tpu.models.llama import Llama, llama_tiny
+        from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
-        self.model = Llama(self.cfg)
+        # any Llama-shaped family serves through the same decode stack
+        model_cls, self._sharding_rules = _family_for(self.cfg)
+        self.model = model_cls(self.cfg)
         if params is None:
             import jax.numpy as jnp
             params = self.model.init(
@@ -41,12 +55,11 @@ class LlamaDeployment:
 
     def setup_mesh(self, mesh):
         """Called by the serve replica when cfg.mesh is set: shard the
-        params tensor-parallel over the replica's mesh."""
+        params over the replica's mesh (tensor-parallel; for Mixtral
+        also expert-parallel)."""
         from ray_tpu.mesh.sharding import shard_params
-        from ray_tpu.models.llama import llama_sharding_rules
         self.mesh = mesh
-        self.params = shard_params(self.params,
-                                   llama_sharding_rules(fsdp=False),
+        self.params = shard_params(self.params, self._sharding_rules,
                                    mesh)
 
     def __call__(self, prompt_ids: List[int]) -> List[int]:

@@ -506,3 +506,23 @@ def test_autoscaling_counts_streaming_load(serve_rt):
             break
         time.sleep(0.1)
     assert serve.get_deployment("Tokens")["num_replicas"] == 1
+
+
+def test_llm_deployment_serves_mixtral(serve_rt):
+    """The LLM deployment serves any Llama-shaped family: a Mixtral
+    (sparse-MoE) replica answers batched and streaming requests."""
+    from ray_tpu.models.mixtral import mixtral_tiny
+    from ray_tpu.serve.llm import LlamaDeployment
+
+    @serve.deployment
+    class MoELLM(LlamaDeployment):
+        def __init__(self):
+            super().__init__(config=mixtral_tiny(), max_new_tokens=6,
+                             stream_chunk=3)
+
+    h = serve.run(MoELLM.bind(), timeout_s=300)
+    prompt = list(range(1, 9))
+    full = ray_tpu.get(h.remote(prompt), timeout=300)
+    assert len(full) == len(prompt) + 6
+    streamed = list(h.stream.options(stream=True).remote(prompt))
+    assert streamed == full[len(prompt):]
