@@ -6,10 +6,20 @@ Prints ONE JSON line:
 vs_baseline is measured MFU / 0.40 (the north-star target from BASELINE.md:
 >=40% MFU for GPT-2 on TPU; the reference has no TPU numbers to compare
 against, so the target ratio is the baseline).
+
+The measurement runs in a CHILD subprocess (``bench.py --child``) so a
+wedged device-init tunnel can be killed and retried: JAX backend state is
+per-process, so a fresh child is a full backend re-init. The parent makes
+up to BENCH_ATTEMPTS attempts (default 4) with backoff and prints the
+first successful JSON line; only if every attempt fails does it emit an
+error JSON line with rc=1.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 
@@ -32,7 +42,7 @@ def peak_flops(device) -> float:
     return 1e11
 
 
-def _devices_or_die(timeout_s: float = 240.0):
+def _devices_or_die(timeout_s: float = 120.0):
     """Device init goes through the axon tunnel, which can wedge and
     block jax.devices() forever — fail FAST with a diagnosable JSON
     line instead of hanging the whole bench run."""
@@ -141,5 +151,60 @@ def main():
     }))
 
 
+def _error_line(msg: str) -> str:
+    return json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0,
+        "error": msg})
+
+
+def supervise() -> int:
+    """Run the measurement in a killable child process, retrying on
+    failure. Each child is a fresh OS process, so every attempt fully
+    re-initializes the JAX backend (the only way to recover from a
+    wedged axon tunnel short of the far end healing itself)."""
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "4"))
+    child_budget = float(os.environ.get("BENCH_CHILD_TIMEOUT", "900"))
+    backoffs = [30.0, 60.0, 120.0]
+    errors = []
+    for i in range(attempts):
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True, text=True, timeout=child_budget,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {i + 1}: child exceeded "
+                          f"{child_budget:.0f}s budget, killed")
+        else:
+            line = None
+            for ln in (proc.stdout or "").splitlines():
+                ln = ln.strip()
+                if ln.startswith("{") and '"metric"' in ln:
+                    line = ln
+            if proc.returncode == 0 and line is not None:
+                try:
+                    parsed = json.loads(line)
+                except json.JSONDecodeError:
+                    parsed = None
+                if parsed and parsed.get("value", 0) > 0:
+                    print(line)
+                    return 0
+            tail = ((proc.stderr or "").strip().splitlines() or [""])[-1]
+            detail = line or tail[:300]
+            errors.append(f"attempt {i + 1} (rc={proc.returncode}, "
+                          f"{time.monotonic() - t0:.0f}s): {detail}")
+        sys.stderr.write(errors[-1] + "\n")
+        if i < attempts - 1:
+            time.sleep(backoffs[min(i, len(backoffs) - 1)])
+    print(_error_line(f"all {attempts} attempts failed: "
+                      + " | ".join(errors)[:1500]))
+    return 1
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        main()
+    else:
+        sys.exit(supervise())

@@ -1,0 +1,86 @@
+"""Paged KV cache: block-pool storage for continuous-batching decode.
+
+vLLM-style paged attention re-thought for TPU/XLA (ref capability:
+serve request batching, python/ray/serve/batching.py:46,215 — which
+coalesces calls but decodes each batch to completion; this pool is the
+structure that lets requests join/leave the decode batch per token):
+
+- The KV pool is ONE static-shape array per layer,
+  ``[n_pages, page_size, n_kv_heads, head_dim]`` — XLA never sees a
+  dynamic allocation; the host-side ``BlockAllocator`` hands page ids
+  to sequences as they grow and reclaims them on completion or
+  preemption.
+- Page 0 is the NULL page: inactive decode slots point their page
+  table at it and harmlessly scatter their dead writes there, so the
+  jitted decode step needs no ``lax.cond`` masking — every slot does
+  identical work every step (SPMD-friendly, no divergence).
+- Gather/scatter use plain advanced indexing: XLA lowers them to
+  dynamic-gather/scatter HLO that tiles fine on TPU. A dedicated
+  pallas paged-attention kernel can replace the gather later without
+  changing this layout.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax.numpy as jnp
+
+
+class PagedKVLayer(NamedTuple):
+    """Per-layer view of the paged KV pool handed to the attention
+    module (a pytree: safe to carry through jit/scan).
+
+    pages_k/pages_v: [n_pages, page_size, n_kv_heads, head_dim]
+    page_table:      [n_slots, max_pages] int32 — logical page p of
+                     slot s lives in physical page ``page_table[s, p]``
+    """
+    pages_k: jnp.ndarray
+    pages_v: jnp.ndarray
+    page_table: jnp.ndarray
+
+    @property
+    def page_size(self) -> int:
+        return self.pages_k.shape[1]
+
+
+def init_kv_pool(cfg, n_pages: int, page_size: int):
+    """One (k, v) page pool per layer. Page 0 is reserved (null)."""
+    shape = (n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    return [(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+            for _ in range(cfg.n_layers)]
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over the physical page pool.
+
+    Page 0 is never handed out — it is the null page inactive slots
+    write into. All-or-nothing alloc so a half-grown sequence never
+    holds pages it cannot use.
+    """
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError("pool needs >= 2 pages (page 0 is null)")
+        self.n_pages = n_pages
+        self._free: List[int] = list(range(n_pages - 1, 0, -1))
+        self._free_set = set(self._free)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
+        return out
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if not 0 < p < self.n_pages:
+                raise ValueError(f"bad page id {p}")
+            if p in self._free_set:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(pages)
+        self._free_set.update(pages)

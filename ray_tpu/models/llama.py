@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.mesh.sharding import ShardingRules
+from ray_tpu.models.kv_cache import PagedKVLayer
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,7 +121,41 @@ class LlamaAttention(nn.Module):
         k = apply_rope(k, freqs, positions)
 
         new_cache = None
-        if kv_cache is not None:
+        if isinstance(kv_cache, PagedKVLayer):
+            # Paged decode (continuous batching): T == 1, per-slot
+            # positions. Scatter this step's K/V into the slot's
+            # current page, then attend over the slot's gathered page
+            # window. Inactive slots carry page_table rows of 0 (the
+            # null page) — their writes land there and their outputs
+            # are ignored host-side, so no lax.cond is needed.
+            pc = kv_cache
+            pos = cache_len                       # [B] int32
+            Pg = pc.page_size
+            bidx = jnp.arange(B)
+            page_idx = pc.page_table[bidx, pos // Pg]      # [B]
+            off = pos % Pg
+            pk = pc.pages_k.at[page_idx, off].set(
+                k[:, 0].astype(pc.pages_k.dtype))
+            pv = pc.pages_v.at[page_idx, off].set(
+                v[:, 0].astype(pc.pages_v.dtype))
+            new_cache = pc._replace(pages_k=pk, pages_v=pv)
+            # [B, max_pages, Pg, KH, D] -> [B, L, KH, D]; gathered
+            # index == logical sequence position by construction.
+            L = pc.page_table.shape[1] * Pg
+            kg = pk[pc.page_table].reshape(B, L, cfg.n_kv_heads, hd)
+            vg = pv[pc.page_table].reshape(B, L, cfg.n_kv_heads, hd)
+            rep = cfg.n_heads // cfg.n_kv_heads
+            kg = jnp.repeat(kg, rep, axis=2)
+            vg = jnp.repeat(vg, rep, axis=2)
+            scores = jnp.einsum(
+                "bthd,bshd->bhts", q.astype(jnp.float32),
+                kg.astype(jnp.float32)) / np.sqrt(hd)
+            valid = jnp.arange(L)[None] <= pos[:, None]    # [B, L]
+            scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            y = jnp.einsum("bhts,bshd->bthd",
+                           probs.astype(vg.dtype), vg)
+        elif kv_cache is not None:
             # Decode path: append this step's K/V into the static cache.
             ck, cv = kv_cache
             ck = jax.lax.dynamic_update_slice(
@@ -202,6 +237,10 @@ def transformer_forward(mod: nn.Module, cfg, block_cls, input_ids,
     freqs = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     if cache_len is None:
         positions = jnp.arange(T)
+    elif jnp.ndim(cache_len) == 1:
+        # Per-slot positions (paged continuous-batching decode):
+        # [B] + [T] -> [B, T]; apply_rope handles batched positions.
+        positions = cache_len[:, None] + jnp.arange(T)[None]
     else:
         positions = cache_len + jnp.arange(T)
     block = block_cls

@@ -32,7 +32,11 @@ class LlamaDeployment:
     num_replicas/autoscaling stay caller-controlled."""
 
     def __init__(self, config=None, params=None, max_new_tokens: int = 64,
-                 temperature: float = 0.0, stream_chunk: int = 8):
+                 temperature: float = 0.0, stream_chunk: int = 8,
+                 use_engine: bool = True, max_slots: int = 16,
+                 page_size: int = 16, n_pages: Optional[int] = None,
+                 decode_chunk: Optional[int] = None,
+                 eos_id: Optional[int] = None):
         import jax
         from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
@@ -52,6 +56,17 @@ class LlamaDeployment:
         # tok/s at the cost of burstier delivery (TTFT is unaffected)
         self.stream_chunk = stream_chunk
         self.mesh = None
+        # Continuous batching (serve/engine.py): requests join/leave
+        # the decode batch at token granularity instead of riding
+        # whole-call batches (supersedes @serve.batch for LLMs).
+        self.use_engine = use_engine
+        self._engine = None
+        import threading
+        self._engine_lock = threading.Lock()
+        self._engine_opts = dict(
+            max_slots=max_slots, page_size=page_size,
+            n_pages=n_pages, chunk=decode_chunk or stream_chunk,
+            eos_id=eos_id)
 
     def setup_mesh(self, mesh):
         """Called by the serve replica when cfg.mesh is set: shard the
@@ -62,8 +77,32 @@ class LlamaDeployment:
         self.params = shard_params(self.params, self._sharding_rules,
                                    mesh)
 
+    def engine(self):
+        """The replica's continuous-batching engine (lazy: params may
+        be resharded by setup_mesh after __init__). Locked: replicas
+        run sync handlers on an executor, so two first requests race
+        here — an unlocked check would double-allocate the KV pool."""
+        with self._engine_lock:
+            if self._engine is None:
+                from ray_tpu.serve.engine import LLMEngine
+                opts = dict(self._engine_opts)
+                if opts["n_pages"] is None:
+                    # full residency by default: every slot can reach
+                    # prompt+completion without preemption
+                    per_seq = -(-self.cfg.max_seq_len
+                                // opts["page_size"])
+                    opts["n_pages"] = opts["max_slots"] * per_seq + 1
+                self._engine = LLMEngine(
+                    self.model, self.params,
+                    temperature=self.temperature, **opts).start()
+            return self._engine
+
     def __call__(self, prompt_ids: List[int]) -> List[int]:
-        """One request: token ids in, generated ids out."""
+        """One request: token ids in, prompt+generated ids out."""
+        if self.use_engine:
+            gen = self.engine().submit(
+                prompt_ids, max_new_tokens=self.max_new_tokens).result()
+            return list(prompt_ids) + gen
         import jax.numpy as jnp
         from ray_tpu.models.llama import generate
         prompt = jnp.asarray([prompt_ids], jnp.int32)
@@ -77,6 +116,11 @@ class LlamaDeployment:
         as it is sampled (token-at-a-time decode; serve wraps this
         generator in a StreamingResponse and the HTTP proxy in a
         chunked ndjson response)."""
+        if self.use_engine:
+            yield from self.engine().submit(
+                prompt_ids,
+                max_new_tokens=self.max_new_tokens).stream()
+            return
         import jax.numpy as jnp
         from ray_tpu.models.llama import generate_stream
         prompt = jnp.asarray([prompt_ids], jnp.int32)
@@ -97,6 +141,11 @@ class LlamaDeployment:
         call — same-length batching is the correctness-preserving way
         to batch (serving clients typically use fixed prompt shapes,
         giving one bucket)."""
+        if self.use_engine:
+            eng = self.engine()
+            hs = [eng.submit(p, max_new_tokens=self.max_new_tokens)
+                  for p in prompts]
+            return [h.result() for h in hs]
         import jax.numpy as jnp
         from ray_tpu.models.llama import generate
         buckets: Dict[int, List[int]] = {}

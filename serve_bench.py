@@ -1,14 +1,15 @@
 """Llama serving benchmark (BASELINE.md: "Serve-equiv Llama-2-7B JAX
 replica — tokens/s, p50/p99 latency").
 
-Drives a serve deployment wrapping the Llama decode on the real chip:
-- throughput phase: concurrent clients -> @serve.batch batched decode
-  (batch padded to a fixed shape so ONE compiled executable serves
-  every request);
-- streaming phase: token-at-a-time decode measuring time-to-first-token
-  and steady-state streaming rate.
+Drives a serve deployment wrapping the continuous-batching engine
+(serve/engine.py) on the real chip:
+- throughput phase: concurrent clients submit straight into the
+  engine; requests join/leave the paged-KV decode batch at token
+  granularity (no whole-call batch coalescing, no convoy effect);
+- streaming phase: tokens stream from the engine measuring
+  time-to-first-token and steady-state streaming rate.
 
-Writes SERVE_BENCH_r03.json and prints it.
+Writes SERVE_BENCH_r04.json and prints it.
 
 Usage: python serve_bench.py [--model 7b|1b|tiny] [--out FILE]
 (7b needs ~14GB HBM; falls back to 1b automatically on OOM.)
@@ -38,7 +39,8 @@ def build_configs(name):
 
 PROMPT_LEN = 128
 GEN_TOKENS = 64
-BATCH = 8
+SLOTS = 16          # continuous-batching decode width
+DECODE_CHUNK = 8    # tokens per device dispatch (host-sync amortizer)
 
 
 def make_server(cfg):
@@ -49,30 +51,32 @@ def make_server(cfg):
     @serve.deployment(max_ongoing_requests=64)
     class LlamaServer:
         def __init__(self):
-            self.inner = LlamaDeployment(config=cfg,
-                                         max_new_tokens=GEN_TOKENS)
+            self.inner = LlamaDeployment(
+                config=cfg, max_new_tokens=GEN_TOKENS,
+                max_slots=SLOTS, page_size=16,
+                decode_chunk=DECODE_CHUNK)
 
-        @serve.batch(max_batch_size=BATCH, batch_wait_timeout_s=0.02)
-        async def __call__(self, prompts):
-            n = len(prompts)
-            # Pad the batch to a fixed size: one (B, T0) shape means
-            # one compiled executable for every traffic level.
-            padded = list(prompts) + \
-                [prompts[0]] * (BATCH - n)
-            out = self.inner.generate_batch(padded)
-            return out[:n]
+        def __call__(self, prompt):
+            # joins the engine's decode batch at the next chunk
+            # boundary; returns generated ids only
+            return self.inner(prompt)[len(prompt):]
 
         def stream(self, prompt):
             yield from self.inner.stream(prompt)
 
+        def engine_stats(self):
+            return dict(self.inner.engine().stats)
+
     return serve.run(LlamaServer.bind(), timeout_s=600)
 
 
-def bench(handle, rng):
+def bench(handle, rng, cfg):
     import ray_tpu
 
+    plen = min(PROMPT_LEN, cfg.max_seq_len - GEN_TOKENS)
+
     def prompt():
-        return rng.randint(1, 31000, size=PROMPT_LEN).tolist()
+        return rng.randint(1, cfg.vocab_size - 1, size=plen).tolist()
 
     # --- warmup / compile (one batched decode + one stream step) ----
     t0 = time.time()
@@ -127,6 +131,7 @@ def bench(handle, rng):
         "requests": n_req,
         "client_threads": n_threads,
         "compile_s": round(compile_s, 1),
+        "prompt_len": plen,
     }
 
 
@@ -134,9 +139,15 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="7b",
                     choices=["7b", "1b", "tiny"])
-    ap.add_argument("--out", default="SERVE_BENCH_r03.json")
+    ap.add_argument("--out", default="SERVE_BENCH_r04.json")
     args = ap.parse_args()
 
+    import os
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # env alone doesn't always override the axon plugin: the
+        # config update must land before any device use
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     import ray_tpu
     ray_tpu.init()
     order = {"7b": ["7b", "1b"], "1b": ["1b"],
@@ -148,7 +159,7 @@ def main():
         try:
             handle = make_server(cfg)
             rng = np.random.RandomState(0)
-            result = bench(handle, rng)
+            result = bench(handle, rng, cfg)
             result["model"] = label
             break
         except Exception as e:   # noqa: BLE001
@@ -159,9 +170,14 @@ def main():
             serve.shutdown()
             if not oom or name == order[-1]:
                 raise
-    result["batch"] = BATCH
-    result["prompt_len"] = PROMPT_LEN
+    result["slots"] = SLOTS
+    result["decode_chunk"] = DECODE_CHUNK
     result["gen_tokens"] = GEN_TOKENS
+    try:
+        result["engine"] = ray_tpu.get(
+            handle.engine_stats.remote(), timeout=60)
+    except Exception:
+        pass
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
