@@ -23,7 +23,7 @@ from ray_tpu._private.task_spec import (ActorCreationSpec,
                                         PlacementGroupSchedulingStrategy,
                                         PlacementGroupSpec, TaskSpec)
 from ray_tpu.exceptions import GetTimeoutError
-from ray_tpu.runtime.rpc import RpcClient
+from ray_tpu.runtime.rpc import RpcClient, RpcError
 
 
 # --------------------------------------------------------------------------
@@ -301,6 +301,155 @@ def create_actor_via_head(head: RpcClient, spec: ActorCreationSpec):
     return SimpleNamespace(spec=final_spec)
 
 
+_ACTOR_ADDR_TTL = 10.0      # bounds the stale-route window post-restart
+
+
+class _DirectActorSender:
+    """Per-worker-address direct actor-task pipe (reference: the
+    CoreWorker direct actor transport, core_worker/transport/ —
+    actor calls skip the control plane entirely). Calls enqueue and
+    return; a flusher ships batches as ONE one-way RPC straight to the
+    actor's worker. Per-caller ordering rides this dedicated socket.
+    If the worker is unreachable the batch bounces through the head's
+    reroute path (which waits out restarts or fails the returns), so
+    no call is ever silently dropped."""
+
+    FLUSH_AT = 128
+    WINDOW_S = 0.0005
+
+    def __init__(self, head: RpcClient, addr: str):
+        self._head = head
+        self._addr = addr
+        self._client = RpcClient(addr, timeout=30)
+        self._buf: list = []
+        self._lock = threading.Lock()
+        self._ship_lock = threading.Lock()   # serializes deliveries
+        self._wake = threading.Event()
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+
+    def add(self, actor_id_hex: str, payload: bytes) -> bool:
+        eager = None
+        with self._lock:
+            if self._stopped:
+                return False     # route was torn down: caller re-routes
+            self._buf.append((actor_id_hex, payload, 0))
+            if len(self._buf) >= self.FLUSH_AT:
+                eager, self._buf = self._buf, []
+            elif self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="actor-direct-send")
+                self._thread.start()
+        if eager is not None:
+            self._ship(eager)
+        else:
+            self._wake.set()
+        return True
+
+    def _ship(self, batch):
+        # Request/reply (not one-way): a one-way send to a freshly
+        # killed worker disappears into the TCP buffer with no error,
+        # silently dropping calls. The reply is the delivery ack; its
+        # cost is one RTT per BATCH (callers never block here — the
+        # flusher thread pays it). The ship lock keeps an eager
+        # caller-thread ship from overtaking the flusher's in-flight
+        # batch (per-caller ordering). Duplicate delivery on a timed-
+        # out-but-delivered batch is suppressed worker-side by task-id
+        # dedup.
+        with self._ship_lock:
+            for _attempt in range(2):
+                try:
+                    self._client.call("push_actor_tasks", batch)
+                    return
+                except Exception:
+                    continue
+            # Worker unreachable: invalidate the route and hand every
+            # call to the head, which re-resolves (or fails the
+            # return objects).
+            _drop_actor_route(self._head, self._addr)
+            self._reroute(batch)
+
+    def _reroute(self, batch):
+        for actor_id_hex, payload, attempts in batch:
+            try:
+                self._head.call("reroute_actor_task", actor_id_hex,
+                                payload, attempts)
+            except Exception:
+                pass    # head down: the whole runtime is down anyway
+
+    def stop(self):
+        """Tear down after a route invalidation: reroute anything
+        still buffered, stop the flusher, close the sockets."""
+        with self._lock:
+            self._stopped = True
+            batch, self._buf = self._buf, []
+        self._wake.set()
+        if batch:
+            self._reroute(batch)
+        try:
+            self._client.close()
+        except Exception:
+            pass
+
+    def _loop(self):
+        while not self._stopped:
+            self._wake.wait(timeout=1.0)
+            self._wake.clear()
+            time.sleep(self.WINDOW_S)
+            with self._lock:
+                batch, self._buf = self._buf, []
+            if batch:
+                self._ship(batch)
+
+
+def _direct_state(head: RpcClient):
+    st = getattr(head, "_direct_actor_state", None)
+    if st is None:
+        st = head._direct_actor_state = {
+            "addrs": {},       # actor_id_hex -> (addr, expires_at)
+            "senders": {},     # addr -> _DirectActorSender
+            "lock": threading.Lock(),
+        }
+    return st
+
+
+def _resolve_actor_route(head: RpcClient, actor_id_hex: str):
+    """Worker address for the actor, None while it rebinds. Raises
+    ActorDiedError for known-dead actors (submit-time semantics)."""
+    st = _direct_state(head)
+    now = time.time()
+    with st["lock"]:
+        ent = st["addrs"].get(actor_id_hex)
+        if ent is not None and ent[1] > now:
+            return ent[0]
+    addr = head.call("actor_address", actor_id_hex)
+    if addr is not None:
+        with st["lock"]:
+            st["addrs"][actor_id_hex] = (addr, now + _ACTOR_ADDR_TTL)
+    return addr
+
+
+def _drop_actor_route(head: RpcClient, addr: str):
+    st = _direct_state(head)
+    with st["lock"]:
+        sender = st["senders"].pop(addr, None)
+        st["addrs"] = {a: e for a, e in st["addrs"].items()
+                       if e[0] != addr}
+    if sender is not None:
+        # Off-lock: stop() reroutes buffered items through the head.
+        threading.Thread(target=sender.stop, daemon=True).start()
+
+
+def _direct_sender(head: RpcClient, addr: str) -> _DirectActorSender:
+    st = _direct_state(head)
+    with st["lock"]:
+        s = st["senders"].get(addr)
+        if s is None:
+            s = st["senders"][addr] = _DirectActorSender(head, addr)
+        return s
+
+
 def submit_actor_task_via_head(head: RpcClient, actor_id: ActorID,
                                spec: TaskSpec):
     refs = [ObjectRef(oid) for oid in spec.return_ids]
@@ -315,7 +464,20 @@ def submit_actor_task_via_head(head: RpcClient, actor_id: ActorID,
         "concurrency_group": spec.concurrency_group,
         "trace_ctx": spec.trace_ctx,
     })
-    head.call("submit_actor_task", actor_id.hex(),
+    aid = actor_id.hex()
+    # Direct dispatch fast path: pipelined one-way pushes straight to
+    # the actor's worker. Group'd calls keep the head path so an
+    # unknown concurrency group still raises at submission.
+    if spec.concurrency_group is None:
+        addr = None
+        try:
+            addr = _resolve_actor_route(head, aid)
+        except RpcError:
+            addr = None      # head hiccup: blocking path will surface it
+        if addr is not None and \
+                _direct_sender(head, addr).add(aid, payload):
+            return refs
+    head.call("submit_actor_task", aid,
               {"task_id": spec.task_id.hex(),
                "concurrency_group": spec.concurrency_group}, payload)
     return refs

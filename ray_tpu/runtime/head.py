@@ -1187,36 +1187,98 @@ class HeadService:
         a.dead = True
         a.death_reason = "no worker available for restart"
 
-    def submit_actor_task(self, actor_id: str, meta: Dict[str, Any],
-                          payload: bytes):
-        deadline = time.time() + 30
+    def actor_address(self, actor_id: str) -> Optional[str]:
+        """Worker address for direct actor-task dispatch (reference:
+        the CoreWorker direct actor transport resolves the actor's
+        worker and pushes tasks peer-to-peer,
+        core_worker/transport/direct_actor_transport — the head only
+        brokers the address). Returns None while the actor is
+        rebinding (caller falls back to the head-routed path, which
+        waits out the restart)."""
         with self._lock:
-            while True:
-                a = self._actors.get(actor_id)
-                if a is None or a.dead:
-                    reason = a.death_reason if a else "unknown actor"
-                    raise ActorDiedError(actor_id, reason)
-                group = meta.get("concurrency_group")
-                if group and group not in a.concurrency_groups:
-                    raise ValueError(
-                        f"actor has no concurrency group {group!r} "
-                        f"(declared: "
-                        f"{sorted(a.concurrency_groups) or 'none'})")
-                if a.worker_id == "":
-                    # Restored-from-snapshot (or mid-restart) actor
-                    # awaiting its worker's re-attach: wait for the
-                    # binding instead of failing the call.
-                    if time.time() > deadline:
-                        raise ActorDiedError(
-                            actor_id, "no worker re-attached the actor")
-                    self._sched_cv.wait(timeout=0.2)
-                    continue
-                w = self._workers.get(a.worker_id)
-                if w is None or not w.alive:
-                    raise ActorDiedError(actor_id, "worker dead")
-                client = w.client
-                break
-        client.call("push_actor_task", actor_id, payload)
+            a = self._actors.get(actor_id)
+            if a is None or a.dead:
+                reason = a.death_reason if a else "unknown actor"
+                raise ActorDiedError(actor_id, reason)
+            if a.worker_id == "":
+                return None
+            w = self._workers.get(a.worker_id)
+            if w is None or not w.alive:
+                return None
+            return w.address
+
+    def reroute_actor_task(self, actor_id: str, payload: bytes,
+                           attempts: int = 0):
+        """A direct-dispatched actor task landed on a worker that no
+        longer hosts the actor (restart/migration race): re-deliver
+        through the head-routed path, or fail the task's return
+        objects if the actor is truly dead. Runs on its own thread —
+        re-delivery legitimately blocks while a restarting actor
+        rebinds."""
+        def _run():
+            try:
+                # Bounce backoff: each extra hop means we raced a
+                # rebind — give the new worker time to finish creation.
+                if attempts:
+                    time.sleep(0.1 * attempts)
+                self.submit_actor_task(actor_id, {}, payload, attempts)
+            except BaseException as e:  # noqa: BLE001
+                if not isinstance(e, ActorDiedError):
+                    e = ActorDiedError(actor_id, f"reroute failed: {e}")
+                try:
+                    import cloudpickle
+                    spec = cloudpickle.loads(payload)
+                    self._store_error(spec["return_ids"], e)
+                except Exception:
+                    pass
+        threading.Thread(target=_run, daemon=True,
+                         name="actor-reroute").start()
+
+    def submit_actor_task(self, actor_id: str, meta: Dict[str, Any],
+                          payload: bytes, attempts: int = 0):
+        deadline = time.time() + 30
+        while True:
+            with self._lock:
+                while True:
+                    a = self._actors.get(actor_id)
+                    if a is None or a.dead:
+                        reason = a.death_reason if a else "unknown actor"
+                        raise ActorDiedError(actor_id, reason)
+                    group = meta.get("concurrency_group")
+                    if group and group not in a.concurrency_groups:
+                        raise ValueError(
+                            f"actor has no concurrency group {group!r} "
+                            f"(declared: "
+                            f"{sorted(a.concurrency_groups) or 'none'})")
+                    if a.worker_id == "":
+                        # Restored-from-snapshot (or mid-restart) actor
+                        # awaiting its worker's re-attach: wait for the
+                        # binding instead of failing the call.
+                        if time.time() > deadline:
+                            raise ActorDiedError(
+                                actor_id,
+                                "no worker re-attached the actor")
+                        self._sched_cv.wait(timeout=0.2)
+                        continue
+                    w = self._workers.get(a.worker_id)
+                    if w is None or not w.alive:
+                        raise ActorDiedError(actor_id, "worker dead")
+                    client = w.client
+                    worker_id = w.worker_id
+                    break
+            try:
+                client.call("push_actor_task", actor_id, payload,
+                            attempts)
+                return
+            except RpcError:
+                # Unreachable worker == death evidence (a reroute can
+                # beat the node monitor's poll here): mark it dead —
+                # which kicks off the actor's restart — and re-enter
+                # the wait loop under the SAME deadline instead of
+                # failing a restartable actor's call.
+                self.mark_worker_dead(worker_id)
+                if time.time() > deadline:
+                    raise ActorDiedError(actor_id, "worker unreachable")
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
         with self._lock:

@@ -43,6 +43,11 @@ class _ActorSlot:
         self.threads: list = []
         self.thread: Optional[threading.Thread] = None
         self.runtime_env = None
+        # Bounded replay filter for direct-dispatch batch retries
+        # (ordered dict as an LRU set of task ids).
+        import collections
+        self.seen_tasks: "collections.OrderedDict" = \
+            collections.OrderedDict()
         self.aloop = None      # asyncio actors: their event loop
         # sync actors: coroutine-returning methods drive a PER-THREAD
         # loop — multiple group threads must never share one loop
@@ -480,11 +485,49 @@ class Executor:
                                   remote_traceback=traceback.format_exc())
                 self._write_error(spec["return_ids"], e)
 
-    def push_actor_task(self, actor_id: str, payload: bytes) -> str:
+    def push_actor_tasks(self, items: List) -> str:
+        """Batched direct dispatch from a CALLER process (reference:
+        direct actor transport — tasks skip the head entirely). Items
+        are (actor_id, payload, attempts) tuples; per-caller ordering
+        rides the caller's dedicated one-way socket, exactly like the
+        head's dispatch senders."""
+        for actor_id, payload, attempts in items:
+            self.push_actor_task(actor_id, payload, attempts)
+        return "queued"
+
+    def push_actor_task(self, actor_id: str, payload: bytes,
+                        attempts: int = 0) -> str:
         spec = cloudpickle.loads(payload)
         with self._lock:
             slot = self.actors.get(actor_id)
         if slot is None:
+            # Grace window: a restart publishes the actor's new route
+            # before create_actor finishes on this worker, so a prompt
+            # push can beat the in-flight creation. Misses are rare —
+            # polling briefly here beats bouncing the task around.
+            deadline = time.time() + 1.0
+            while slot is None and time.time() < deadline:
+                time.sleep(0.02)
+                with self._lock:
+                    slot = self.actors.get(actor_id)
+        if slot is None:
+            # Stale direct dispatch (the actor restarted elsewhere or
+            # the caller's address cache lagged): bounce through the
+            # head, which knows the actor's current binding — writing
+            # ActorDiedError here would fail calls to a LIVE actor.
+            # Tradeoff: a rerouted call can land AFTER a younger call
+            # that went straight to the new worker — per-caller order
+            # is relaxed across a restart boundary (the reference's
+            # direct transport has the same window during actor
+            # reconstruction).
+            if attempts < 3:
+                try:
+                    self.head.call_oneway("reroute_actor_task",
+                                          actor_id, payload,
+                                          attempts + 1)
+                    return "rerouted"
+                except Exception:
+                    pass
             self._write_error(spec["return_ids"],
                               ActorDiedError(actor_id, "not on worker"))
             return "dead"
@@ -496,6 +539,18 @@ class Executor:
             self._write_error(spec["return_ids"], TaskError(
                 e, task_name=spec.get("name", "")))
             return "bad_group"
+        # Enqueue-side dedup: a direct sender retries a batch whose
+        # ack timed out, so a delivered-but-unacked task can arrive
+        # twice — task ids are unique per call, making replays exact.
+        tid = spec.get("task_id")
+        if tid is not None:
+            with self._lock:
+                seen = slot.seen_tasks
+                if tid in seen:
+                    return "dup"
+                seen[tid] = None
+                while len(seen) > 8192:
+                    seen.popitem(last=False)
         box.put(spec)
         return "queued"
 
