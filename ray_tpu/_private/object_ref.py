@@ -75,14 +75,30 @@ class ObjectRef:
                 pass
 
     def __reduce__(self):
-        # Plain-pickle fallback (normal path goes through
-        # serialization._Pickler.persistent_id).
+        # A pickled ref is a ref ESCAPING this process (task arg,
+        # nested in another object, shipped to an actor): promote the
+        # object out of the owner's memory tier into shm first, or the
+        # receiver could never resolve it (reference: in-process
+        # memory_store objects are inlined/promoted when borrowed).
+        _promote_if_local(self.id)
         return (_deserialize_ref, (self.id.binary(), self.owner_hint))
 
 
 def _deserialize_ref(binary: bytes, owner_hint):
     return ObjectRef(ObjectID(binary), owner_hint=owner_hint,
                      _register_borrow=True)
+
+
+def _promote_if_local(oid: ObjectID) -> None:
+    """If any plane in this process owns `oid`, move it to shm so
+    other processes can resolve the escaping ref. Checks EVERY live
+    plane, not just the global worker's — the owner can be a
+    non-global runtime (e.g. the client-proxy server's)."""
+    try:
+        from ray_tpu.runtime.object_plane import promote_everywhere
+        promote_everywhere(oid)
+    except Exception:
+        pass    # no runtime / local runtime: nothing to promote
 
 
 _rc_lock = threading.Lock()

@@ -168,25 +168,72 @@ class ShmObjectStore:
     # --- object lifecycle -------------------------------------------------
 
     def put_bytes(self, oid: ObjectID, data: bytes) -> None:
-        # No-evict create: under memory pressure cold LRU objects are
-        # spilled to disk to make room (never silently dropped); if the
-        # incoming object still doesn't fit, it spills itself.
+        self.put_parts(oid, [data], len(data))
+
+    def put_parts(self, oid: ObjectID, parts, total: int) -> None:
+        """Create + stream buffer-like parts straight into the shm
+        mapping + seal. With serialization.serialize_parts this is the
+        single-copy put path (reference: plasma CreateAndSeal writes
+        the serialized object directly into the store buffer).
+
+        No-evict create: under memory pressure cold LRU objects are
+        spilled to disk to make room (never silently dropped); if the
+        incoming object still doesn't fit, it spills itself."""
         while True:
             off = self._lib.store_create_object_ex(
-                self._h, oid.binary(), len(data), 0)
+                self._h, oid.binary(), total, 0)
             if off == SHM_ERR_FULL:
                 if self._spill_lru_one():
                     continue
-                self._spill_bytes(oid, data)
+                self._spill_parts(oid, parts)
                 return
             if off == SHM_ERR_TOO_MANY:
-                self._spill_bytes(oid, data)
+                self._spill_parts(oid, parts)
                 return
             if off < 0:
                 _check(int(off), "create_object")
             break
-        ctypes.memmove(self._base + off, data, len(data))
+        dst = (ctypes.c_char * total).from_address(self._base + off)
+        view = memoryview(dst).cast("B")
+        pos = 0
+        for p in parts:
+            if isinstance(p, memoryview):
+                p = p.cast("B")
+            n = len(p)
+            view[pos:pos + n] = p
+            pos += n
         _check(self._lib.store_seal(self._h, oid.binary()), "seal")
+
+    # --- raw create/seal (streamed remote pulls) ---------------------------
+
+    def create_for_write(self, oid: ObjectID, size: int) -> Optional[
+            memoryview]:
+        """Allocate an unsealed object and return a writable view into
+        the mapping, or None if it cannot fit (caller falls back to a
+        buffered pull + spill). Readers block until seal_raw()."""
+        while True:
+            off = self._lib.store_create_object_ex(
+                self._h, oid.binary(), size, 0)
+            if off == SHM_ERR_FULL:
+                if self._spill_lru_one():
+                    continue
+                return None
+            if off in (SHM_ERR_TOO_MANY, SHM_ERR_EXISTS):
+                return None
+            if off < 0:
+                _check(int(off), "create_object")
+            dst = (ctypes.c_char * size).from_address(self._base + off)
+            return memoryview(dst).cast("B")
+
+    def seal_raw(self, oid: ObjectID) -> None:
+        _check(self._lib.store_seal(self._h, oid.binary()), "seal")
+
+    def abort_raw(self, oid: ObjectID) -> None:
+        """Drop an unsealed allocation after a failed streamed write."""
+        try:
+            self._lib.store_delete(self._h, oid.binary())
+        except Exception:
+            pass
 
     def _spill_lru_one(self) -> bool:
         """Spill+delete the LRU sealed refcount-0 object. False if no
@@ -204,11 +251,15 @@ class ShmObjectStore:
         return os.path.join(self._spill_dir, oid.hex())
 
     def _spill_bytes(self, oid: ObjectID, data: bytes) -> None:
+        self._spill_parts(oid, [data])
+
+    def _spill_parts(self, oid: ObjectID, parts) -> None:
         os.makedirs(self._spill_dir, exist_ok=True)
         path = self._spill_path(oid)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
-            f.write(data)
+            for p in parts:
+                f.write(p)
         os.replace(tmp, path)   # atomic: readers see whole objects only
         self._num_spilled += 1
 

@@ -580,15 +580,22 @@ class DistributedRuntime:
         self._resource_view_ts = 0.0
         self._subscriber.subscribe_state("resources",
                                          self._on_resources)
-        self.ref_counter = ReferenceCounter()
-        self.ref_counter.enabled = False
+        # Eager local GC: zero-ref owned objects delete immediately
+        # instead of waiting for LRU pressure/spill (the plane keeps
+        # escaped refs pinned, so this is safe without a cross-process
+        # borrow protocol).
+        self.ref_counter = ReferenceCounter(
+            on_object_released=self.plane.release_owned)
         self.job_id = JobID.next()
         self._actor_handles: Dict[Any, Any] = {}
 
     # objects
     def put(self, value):
         oid = ObjectID.from_random()
-        self.plane.put_bytes(oid, dumps(("ok", value)))
+        # owned: small puts live in the process memory tier until
+        # their ref escapes (promotion on ref pickling); owned objects
+        # are eagerly freed when their last local ref drops
+        self.plane.put_obj(oid, ("ok", value), owned=True)
         return ObjectRef(oid)
 
     def put_at(self, oid: ObjectID, value):
@@ -597,6 +604,16 @@ class DistributedRuntime:
     def get(self, refs, timeout=None):
         return resolve_refs(self.plane, refs, timeout)
 
+    def submit_task(self, spec: TaskSpec):
+        refs = submit_task_via_head(self.head, spec)
+        self.plane.mark_owned([r.id for r in refs])
+        return refs
+
+    def submit_actor_task(self, actor_id, spec):
+        refs = submit_actor_task_via_head(self.head, actor_id, spec)
+        self.plane.mark_owned([r.id for r in refs])
+        return refs
+
     def wait(self, refs, num_returns=1, timeout=None):
         return wait_refs(self.plane, refs, num_returns, timeout)
 
@@ -604,14 +621,8 @@ class DistributedRuntime:
         return object_future(self.plane, oid)
 
     # tasks / actors
-    def submit_task(self, spec: TaskSpec):
-        return submit_task_via_head(self.head, spec)
-
     def create_actor(self, spec: ActorCreationSpec):
         return create_actor_via_head(self.head, spec)
-
-    def submit_actor_task(self, actor_id, spec):
-        return submit_actor_task_via_head(self.head, actor_id, spec)
 
     def kill_actor(self, actor_id, no_restart=True):
         self.head.call("kill_actor", actor_id.hex(), no_restart)

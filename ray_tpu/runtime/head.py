@@ -411,6 +411,21 @@ class HeadService:
                 if not locs:
                     del self._obj_locs[oid_hex]
 
+    def free_objects(self, oid_hexes: List[str]):
+        """Owner-driven eager free (reference: reference_count.h:39-61
+        owner releases -> deletes broadcast to holders): the owner's
+        last ref dropped, so every node's copy can go NOW instead of
+        waiting for LRU pressure. Location directory and lineage are
+        cleared (a deliberately freed object must not be rebuilt); the
+        delete rides the pub/sub hub to every node agent."""
+        with self._lock:
+            for oid_hex in oid_hexes:
+                self._obj_locs.pop(oid_hex, None)
+                ent = self._lineage.pop(oid_hex, None)
+                if ent is not None:
+                    self._lineage_bytes -= ent.get("cost", 0)
+        self.hub.publish_stream("object_free", {"oids": oid_hexes})
+
     def locate_object(self, oid_hex: str, probe: bool = False,
                       reconstruct: bool = False) -> List[Dict[str, str]]:
         """Live locations of an object. `probe=True` additionally asks

@@ -56,6 +56,23 @@ class NodeAgent:
                        self.object_server.address, self.store_name)
         self.procs: Dict[str, object] = {}
         self._stopped = threading.Event()
+        # Owner-driven eager GC: the head broadcasts freed object ids
+        # on `object_free`; this node drops its copies immediately
+        # (spilled files included) instead of waiting for LRU.
+        try:
+            from ray_tpu._private.ids import ObjectID
+            from ray_tpu.runtime.pubsub import Subscriber
+            self._free_sub = Subscriber(RpcClient(head_address))
+
+            def _on_free(_seq, item):
+                for oid_hex in item.get("oids", ()):
+                    try:
+                        self.store.delete(ObjectID.from_hex(oid_hex))
+                    except Exception:
+                        pass      # not on this node: fine
+            self._free_sub.subscribe_stream("object_free", _on_free)
+        except Exception:
+            self._free_sub = None
         for i in range(num_workers):
             self.start_worker(i)
         self._monitor = threading.Thread(

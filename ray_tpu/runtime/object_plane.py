@@ -18,14 +18,89 @@ Pieces:
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional
 
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.runtime.rpc import RpcClient, RpcError
 
-CHUNK = 4 * 1024 * 1024
+# Swept on the 1-core rig (see round-4 notes): 16MB chunks x 2 lanes
+# beat 4MB x 3 (0.94 vs 0.61 GB/s raw) — per-chunk RPC overhead
+# dominates below 16MB, and with one host core extra lanes just add
+# GIL churn.
+CHUNK = 16 * 1024 * 1024
+# Owned objects at or below this stay in the owner's process memory
+# (reference: memory_store.h:43 in-process store +
+# ray_config_def.h:181 100KiB inline threshold) until something needs
+# them cross-process (promotion happens when their ref is pickled).
+INLINE_THRESHOLD = 100 * 1024
+_MEMORY_TIER_BUDGET = 64 * 1024 * 1024
+# Streamed-pull knobs: parallel chunk streams per pull and a process-
+# wide cap on in-flight pulled bytes (reference: push_manager.h:29
+# rate-limited chunked transfer, pull_manager.h:47 admission).
+PULL_STREAMS = 2
+_INFLIGHT_PULL_BYTES = 128 * 1024 * 1024
+
+
+class _MemoryTier:
+    """Per-process LRU of small OWNED objects. Overflow does not drop:
+    the coldest entry is promoted to shm (other processes may later
+    borrow a ref), so the tier is a pure fast path, never a lifetime
+    hazard."""
+
+    def __init__(self, budget: int = _MEMORY_TIER_BUDGET):
+        self._d: "collections.OrderedDict[ObjectID, bytes]" = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self.budget = budget
+        self._lock = threading.Lock()
+
+    def put(self, oid: ObjectID, data: bytes):
+        evicted = []
+        with self._lock:
+            self._d[oid] = data
+            self._d.move_to_end(oid)
+            self._bytes += len(data)
+            while self._bytes > self.budget and len(self._d) > 1:
+                k, v = self._d.popitem(last=False)
+                self._bytes -= len(v)
+                evicted.append((k, v))
+        return evicted      # caller promotes these to shm
+
+    def get(self, oid: ObjectID) -> Optional[bytes]:
+        with self._lock:
+            data = self._d.get(oid)
+            if data is not None:
+                self._d.move_to_end(oid)
+            return data
+
+    def pop(self, oid: ObjectID) -> Optional[bytes]:
+        with self._lock:
+            data = self._d.pop(oid, None)
+            if data is not None:
+                self._bytes -= len(data)
+            return data
+
+    def __contains__(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._d
+
+
+# Every live plane in this process. Promotion-on-pickle must reach the
+# plane that OWNS the object — which is not always the global worker's
+# runtime (e.g. the client-proxy server holds its own DistributedRuntime
+# while the process-global runtime is the proxy client).
+_ALL_PLANES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def promote_everywhere(oid: ObjectID) -> None:
+    """Called when a ref is pickled: whichever plane owns the object
+    moves it out of its memory tier and pins it against eager free."""
+    for plane in list(_ALL_PLANES):
+        plane.promote(oid)
 
 
 class ObjectService:
@@ -66,6 +141,20 @@ class ObjectService:
         finally:
             self.store.release(oid)
 
+    def raw_pull_chunk(self, oid_hex: str, offset: int, length: int):
+        """Raw-framed chunk read: returns (view-slice, release) so the
+        RPC server sends the bytes STRAIGHT out of the shm mapping —
+        the hot transfer path has zero server-side copies (the pinned
+        object is released after the send completes)."""
+        oid = ObjectID.from_hex(oid_hex)
+        try:
+            view = self.store.get_view(oid, timeout_ms=0)
+        except Exception:
+            data = self.store.get_bytes(oid, timeout_ms=0)
+            return memoryview(data)[offset:offset + length]
+        return (memoryview(view)[offset:offset + length],
+                lambda: self.store.release(oid))
+
 
 class ObjectPlane:
     """Location-aware object access for one process.
@@ -80,13 +169,36 @@ class ObjectPlane:
         self.head = head
         self.node_id = node_id
         self.multinode = False
+        self.memory = _MemoryTier()
+        # Eager local GC bookkeeping: `owned` = put by THIS process via
+        # the put() API; `escaped` = the ref was pickled at least once
+        # (another process may hold it). Zero-ref release deletes the
+        # local copy only for owned-and-never-escaped objects — that is
+        # provably safe without a cross-process borrow protocol, and it
+        # is the overwhelmingly common put-use-drop pattern.
+        self._owned: set = set()
+        self._escaped: set = set()
+        self._own_lock = threading.Lock()
+        self._pull_sem = threading.BoundedSemaphore(
+            max(1, _INFLIGHT_PULL_BYTES // CHUNK))
         self._peers: Dict[str, RpcClient] = {}
         self._peers_lock = threading.Lock()
-        # Batched async put registration.
+        # Batched async put registration + owner-driven frees.
         self._pending_reg: List[str] = []
+        self._pending_free: List[str] = []
         self._reg_lock = threading.Lock()
         self._reg_wake = threading.Event()
         self._reg_thread: Optional[threading.Thread] = None
+        # Zero-ref releases land here from ObjectRef.__del__ (possibly
+        # inside a GC pause): deque.append is atomic, so the finalizer
+        # never takes a lock — the flusher thread does the actual free
+        # (an inline free could self-deadlock on _own_lock if GC fired
+        # under it).
+        self._release_q: "collections.deque" = collections.deque()
+        _ALL_PLANES.add(self)
+        # The flusher starts NOW so the zero-lock release_owned never
+        # has to (thread creation takes locks a finalizer must avoid).
+        self._ensure_reg_thread()
 
     # ---- membership -------------------------------------------------------
 
@@ -108,29 +220,139 @@ class ObjectPlane:
         if self.multinode:
             self._register_async(oid.hex())
 
-    def _register_async(self, oid_hex: str) -> None:
+    def put_obj(self, oid: ObjectID, value, owned: bool = False):
+        """Serialize + store. Small OWNED objects stay in this
+        process's memory tier — no shm create/seal, no location
+        registration — until promote() moves them out. Large objects
+        stream their serialized parts straight into shm (one copy)."""
+        from ray_tpu._private.serialization import serialize_parts
+        if self._release_q:
+            # Safe-context wake (we are NOT in a finalizer here): put
+            # churn must not outrun the 1s-poll free flusher, or the
+            # store fills with dead objects and starts spilling.
+            self._reg_wake.set()
+        parts, total, _ = serialize_parts(value)
+        if owned:
+            with self._own_lock:
+                self._owned.add(oid)
+        if owned and total <= INLINE_THRESHOLD:
+            blob = b"".join(bytes(p) if isinstance(p, memoryview)
+                            else p for p in parts)
+            for k, v in self.memory.put(oid, blob):
+                self._promote_blob(k, v)
+            return
+        self.store.put_parts(oid, parts, total)
+        if self.multinode:
+            self._register_async(oid.hex())
+
+    def promote(self, oid: ObjectID) -> None:
+        """The object's ref got pickled (it is escaping this process):
+        move it out of the memory tier into shm, and pin it against
+        eager release — an external holder may now exist. No-op for
+        objects this plane doesn't own (borrowed refs re-pickled here),
+        which also keeps the escape set bounded by owned objects."""
+        with self._own_lock:
+            if oid not in self._owned:
+                return
+            self._escaped.add(oid)
+        data = self.memory.pop(oid)
+        if data is not None:
+            self._promote_blob(oid, data)
+
+    def mark_owned(self, oids) -> None:
+        """Claim ownership of task-return objects at submission: the
+        caller is their owner, so dropping its last ref eagerly frees
+        the local copy (return ids travel as raw bytes inside specs,
+        never as pickled refs, so they can't self-escape)."""
+        with self._own_lock:
+            self._owned.update(oids)
+
+    def release_owned(self, oid: ObjectID) -> None:
+        """Zero-ref notification (called from ObjectRef.__del__, which
+        can run inside a GC pause): deque.append ONLY — it is atomic
+        and takes no lock, so a finalizer firing on a thread that
+        already holds any plane lock (even the Event's internal one)
+        cannot self-deadlock. The flusher polls at 1s, so a free is
+        delayed at most a second; hot paths (put churn) wake it via
+        their own registration traffic."""
+        self._release_q.append(oid)
+
+    def _ensure_reg_thread(self):
         with self._reg_lock:
-            self._pending_reg.append(oid_hex)
             if self._reg_thread is None or \
                     not self._reg_thread.is_alive():
                 self._reg_thread = threading.Thread(
                     target=self._reg_loop, daemon=True,
                     name="objplane-register")
                 self._reg_thread.start()
+
+    def _drain_releases(self):
+        """Eagerly drop local copies of owned objects whose refs never
+        escaped (reference: owner-based object lifetime,
+        reference_count.h — the full borrower protocol is unnecessary
+        for never-borrowed objects). Escaped objects stay for
+        LRU/spill to manage, and their bookkeeping is dropped here so
+        the owned/escaped sets stay bounded by LIVE refs."""
+        while True:
+            try:
+                oid = self._release_q.popleft()
+            except IndexError:
+                return
+            with self._own_lock:
+                if oid not in self._owned:
+                    continue
+                self._owned.discard(oid)
+                if oid in self._escaped:
+                    # external holders may exist: keep the object,
+                    # drop the (now-dead) bookkeeping
+                    self._escaped.discard(oid)
+                    continue
+            was_inline = self.memory.pop(oid) is not None
+            try:
+                self.store.delete(oid)
+            except Exception:
+                pass    # spilled-only, already evicted, not in shm
+            if self.multinode and not was_inline:
+                # Remote copies (task ran on a peer node, or neighbors
+                # cached a pull) free eagerly too — the head
+                # broadcasts the delete to every node agent. Inline
+                # objects never left this process: no broadcast.
+                with self._reg_lock:
+                    self._pending_free.append(oid.hex())
+
+    def _promote_blob(self, oid: ObjectID, data: bytes) -> None:
+        try:
+            self.store.put_bytes(oid, data)
+        except Exception:
+            return   # already there (concurrent promote): fine
+        if self.multinode:
+            self._register_async(oid.hex())
+
+    def _register_async(self, oid_hex: str) -> None:
+        with self._reg_lock:
+            self._pending_reg.append(oid_hex)
+        self._ensure_reg_thread()
         self._reg_wake.set()
 
     def _reg_loop(self):
         while True:
             self._reg_wake.wait(timeout=1.0)
             self._reg_wake.clear()
+            self._drain_releases()
             with self._reg_lock:
                 batch, self._pending_reg = self._pending_reg, []
+                frees, self._pending_free = self._pending_free, []
             if batch:
                 try:
                     self.head.call("register_objects", self.node_id,
                                    batch)
                 except Exception:
                     pass    # locate falls back to probing nodes
+            if frees:
+                try:
+                    self.head.call("free_objects", frees)
+                except Exception:
+                    pass    # LRU/spill still bounds remote copies
 
     def flush_registrations(self) -> None:
         with self._reg_lock:
@@ -141,7 +363,7 @@ class ObjectPlane:
     # ---- get --------------------------------------------------------------
 
     def contains(self, oid: ObjectID) -> bool:
-        if self.store.contains(oid):
+        if oid in self.memory or self.store.contains(oid):
             return True
         if not self.multinode:
             return False
@@ -152,6 +374,9 @@ class ObjectPlane:
 
     def get_bytes(self, oid: ObjectID, timeout_ms: int = -1) -> bytes:
         from ray_tpu._private.shm_store import ShmTimeout
+        data = self.memory.get(oid)
+        if data is not None:
+            return data
         if not self.multinode:
             return self.store.get_bytes(oid, timeout_ms=timeout_ms)
         deadline = None if timeout_ms < 0 else \
@@ -188,7 +413,8 @@ class ObjectPlane:
         are fine — the caller's per-object get loop handles them."""
         if not self.multinode:
             return
-        missing = [o for o in oids if not self.store.contains(o)]
+        missing = [o for o in oids
+                   if o not in self.memory and not self.store.contains(o)]
         if not missing:
             return
         try:
@@ -201,14 +427,8 @@ class ObjectPlane:
             for loc in loc_list:
                 if loc["node_id"] == self.node_id:
                     continue
-                data = self._pull(oid, loc)
-                if data is not None:
-                    try:
-                        self.store.put_bytes(oid, data)
-                        self._register_async(oid.hex())
-                    except Exception:
-                        pass
-                    break
+                if self._pull(oid, loc, want_bytes=False) is not None:
+                    break     # _pull cached it into the local store
 
     def _try_remote_fetch(self, oid: ObjectID,
                           reconstruct: bool) -> Optional[bytes]:
@@ -222,36 +442,56 @@ class ObjectPlane:
                 continue        # it's local (or about to be): retry shm
             data = self._pull(oid, loc)
             if data is not None:
-                # Cache locally so repeated gets (and neighbors pulling
-                # from us) hit shm; registration advertises the copy.
-                try:
-                    self.store.put_bytes(oid, data)
-                    self._register_async(oid.hex())
-                except Exception:
-                    pass        # store full: still return the bytes
+                # _pull streamed it into the local store (repeated
+                # gets and neighbor pulls now hit shm) and registered
+                # the new copy.
                 return data
         return None
 
-    def _peer(self, addr: str) -> RpcClient:
+    def _peer(self, addr: str, lane: int = 0) -> RpcClient:
+        key = f"{addr}#{lane}"
         with self._peers_lock:
-            client = self._peers.get(addr)
+            client = self._peers.get(key)
             if client is None:
-                client = self._peers[addr] = RpcClient(addr, timeout=30)
+                client = self._peers[key] = RpcClient(addr, timeout=30)
             return client
 
-    def _pull(self, oid: ObjectID, loc: Dict) -> Optional[bytes]:
-        client = self._peer(loc["object_addr"])
+    def _pull(self, oid: ObjectID, loc: Dict, want_bytes: bool = True):
+        """Pull a remote object INTO the local store, streaming chunks
+        straight into a pre-created shm allocation over PULL_STREAMS
+        parallel connections. Transfer memory overhead is O(in-flight
+        chunks), never O(object). A process-wide semaphore caps total
+        in-flight pulled bytes (admission control).
+
+        Returns the object bytes (or, with want_bytes=False, the size
+        — prefetchers don't need a heap copy of what just landed in
+        shm), or None on failure. Only REMOTE failures unregister the
+        location: a local store race must not erase the head's record
+        of a healthy remote copy."""
         oid_hex = oid.hex()
+        addr = loc["object_addr"]
+        view = None
         try:
-            size = client.call("object_size", oid_hex)
+            size = self._peer(addr).call("object_size", oid_hex)
             if size < 0:
                 raise RpcError("object gone")
-            buf = bytearray(size)
-            for off in range(0, size, CHUNK):
-                n = min(CHUNK, size - off)
-                buf[off:off + n] = client.call(
-                    "pull_chunk", oid_hex, off, n)
-            return bytes(buf)
+            view = self.store.create_for_write(oid, size)
+            if view is None:
+                # Can't allocate (store full beyond spill, or a racing
+                # pull already created it): buffered fallback.
+                data = self._pull_buffered(oid_hex, addr, size)
+                try:
+                    self.store.put_bytes(oid, data)
+                    if self.multinode:
+                        self._register_async(oid_hex)
+                except Exception:
+                    pass        # store full / raced: still return it
+                return data if want_bytes else len(data)
+            try:
+                self._fetch_into(view, oid_hex, addr, size)
+            except BaseException:
+                self.store.abort_raw(oid)
+                raise
         except (RpcError, Exception):
             # Stale location (evicted or node died): tell the head.
             try:
@@ -260,3 +500,59 @@ class ObjectPlane:
             except Exception:
                 pass
             return None
+        # Local finishing steps: failures here are OUR store racing
+        # (concurrent free/evict), not evidence against the remote.
+        view.release()
+        try:
+            self.store.seal_raw(oid)
+        except Exception:
+            return None
+        if self.multinode:
+            self._register_async(oid_hex)
+        if not want_bytes:
+            return size
+        try:
+            return self.store.get_bytes(oid, timeout_ms=0)
+        except Exception:
+            return None     # raced delete: caller retries the loop
+
+    def _fetch_into(self, view, oid_hex: str, addr: str, size: int):
+        offsets = list(range(0, size, CHUNK))
+        n_streams = min(PULL_STREAMS, max(1, len(offsets)))
+        errors: List[BaseException] = []
+
+        def stream(lane: int):
+            client = self._peer(addr, lane)
+            for off in offsets[lane::n_streams]:
+                n = min(CHUNK, size - off)
+                with self._pull_sem:
+                    try:
+                        client.call_into("raw_pull_chunk", oid_hex,
+                                         off, n,
+                                         dest=view[off:off + n])
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+
+        if n_streams == 1:
+            stream(0)
+        else:
+            threads = [threading.Thread(target=stream, args=(i,),
+                                        daemon=True)
+                       for i in range(n_streams)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if errors:
+            raise errors[0]
+
+    def _pull_buffered(self, oid_hex: str, addr: str,
+                       size: int) -> bytes:
+        client = self._peer(addr)
+        buf = bytearray(size)
+        for off in range(0, size, CHUNK):
+            n = min(CHUNK, size - off)
+            buf[off:off + n] = client.call("pull_chunk", oid_hex,
+                                           off, n)
+        return bytes(buf)

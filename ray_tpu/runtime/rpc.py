@@ -169,21 +169,41 @@ class RpcServer:
     def _handle_one(self, conn: socket.socket, req: Dict[str, Any],
                     send_lock: threading.Lock):
         rid = req.get("rid")
+        raw = None
+        cleanup = None
         try:
             method = getattr(self.handler, req["method"])
             result = method(*req.get("args", ()),
                             **req.get("kwargs", {}))
-            reply = {"rid": rid, "ok": result}
+            if req["method"].startswith("raw_"):
+                # Raw-framed reply: a tiny pickled header announcing
+                # the byte count, then the buffer itself straight out
+                # of the handler's view — no pickling of the payload,
+                # so bulk transfer costs zero extra copies server-side.
+                if isinstance(result, tuple):
+                    raw, cleanup = result
+                else:
+                    raw = result
+                reply = {"rid": rid, "raw": len(raw)}
+            else:
+                reply = {"rid": rid, "ok": result}
         except BaseException as e:  # noqa: BLE001
             reply = {"rid": rid, "err": e,
                      "tb": traceback.format_exc()}
         if rid is None:
+            if cleanup is not None:
+                cleanup()
             return     # one-way call: no reply expected
         with send_lock:
             try:
                 _send_msg(conn, reply)
+                if raw is not None:
+                    conn.sendall(raw)
             except (ConnectionError, OSError):
                 pass
+            finally:
+                if cleanup is not None:
+                    cleanup()
 
     def stop(self):
         self._running = False
@@ -268,6 +288,59 @@ class RpcClient:
         if "err" in reply:
             raise reply["err"]
         return reply["ok"]
+
+    def call_into(self, method: str, *args, dest,
+                  timeout: Optional[float] = None) -> int:
+        """Call a raw-framed server method (name must start with
+        ``raw_``) and receive the payload DIRECTLY into ``dest`` (a
+        writable buffer, e.g. a shm mapping view) via recv_into — the
+        bulk bytes never pass through pickle or an intermediate
+        buffer. Returns the byte count received."""
+        with self._pool_lock:
+            self._rid += 1
+            rid = self._rid
+        sock = None
+        try:
+            sock = self._get_conn()
+            sock.settimeout(self.timeout if timeout is None else timeout)
+            _send_msg(sock, {"rid": rid, "method": method,
+                             "args": args})
+            reply = _recv_msg(sock)
+            if reply.get("rid") != rid:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise reply.get("err") or RpcError(
+                    f"RPC {method}: connection rejected")
+            if "err" in reply:
+                self._put_conn(sock)
+                raise reply["err"]
+            n = reply["raw"]
+            if n > len(dest):
+                try:
+                    sock.close()   # raw bytes are in flight: unpoolable
+                except OSError:
+                    pass
+                raise RpcError(f"raw reply {n}B exceeds dest "
+                               f"{len(dest)}B")
+            mv = memoryview(dest)[:n]
+            got = 0
+            while got < n:
+                r = sock.recv_into(mv[got:], n - got)
+                if r == 0:
+                    raise ConnectionError("peer closed mid-raw-reply")
+                got += r
+            self._put_conn(sock)
+            return n
+        except (ConnectionError, OSError) as e:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise RpcError(f"RPC {method} to {self.host}:{self.port} "
+                           f"failed: {e}") from e
 
     def call_oneway(self, method: str, *args, fast: bool = False,
                     **kwargs) -> None:

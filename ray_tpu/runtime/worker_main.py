@@ -131,7 +131,10 @@ class Executor:
                 raise ValueError(
                     f"expected {num_returns} returns, got {len(values)}")
         for rid, v in zip(return_ids, values):
-            self.plane.put_bytes(ObjectID(rid), dumps(("ok", v)))
+            # put_obj streams serialized parts into shm (single copy);
+            # returns are owned by the CALLER, so never inline here —
+            # a worker-process memory tier would be invisible to it.
+            self.plane.put_obj(ObjectID(rid), ("ok", v))
 
     def _write_error(self, return_ids: List[bytes], exc: BaseException):
         payload = dumps(("err", exc))
@@ -593,8 +596,8 @@ class WorkerRuntime:
         self.head = head
         self.worker_id = worker_id
         from ray_tpu._private.object_store import ReferenceCounter
-        self.ref_counter = ReferenceCounter()
-        self.ref_counter.enabled = False
+        self.ref_counter = ReferenceCounter(
+            on_object_released=self._ex.plane.release_owned)
         from ray_tpu._private.ids import JobID
         self.job_id = JobID.next()
         self._handles: Dict[Any, Any] = {}
@@ -607,7 +610,7 @@ class WorkerRuntime:
     def put(self, value):
         from ray_tpu._private.object_ref import ObjectRef
         oid = ObjectID.from_random()
-        self._ex.plane.put_bytes(oid, dumps(("ok", value)))
+        self._ex.plane.put_obj(oid, ("ok", value), owned=True)
         return ObjectRef(oid)
 
     def get(self, refs, timeout=None):
@@ -640,7 +643,9 @@ class WorkerRuntime:
 
     def submit_task(self, spec):
         from ray_tpu.runtime.client import submit_task_via_head
-        return submit_task_via_head(self.head, spec)
+        refs = submit_task_via_head(self.head, spec)
+        self._ex.plane.mark_owned([r.id for r in refs])
+        return refs
 
     def create_actor(self, spec):
         from ray_tpu.runtime.client import create_actor_via_head
@@ -648,7 +653,9 @@ class WorkerRuntime:
 
     def submit_actor_task(self, actor_id, spec):
         from ray_tpu.runtime.client import submit_actor_task_via_head
-        return submit_actor_task_via_head(self.head, actor_id, spec)
+        refs = submit_actor_task_via_head(self.head, actor_id, spec)
+        self._ex.plane.mark_owned([r.id for r in refs])
+        return refs
 
     def kill_actor(self, actor_id, no_restart=True):
         self.head.call("kill_actor", actor_id.hex(), no_restart)

@@ -54,4 +54,37 @@ def test_actor_call_throughput_floor(perf_cluster):
     t0 = time.perf_counter()
     ray_tpu.get([a.noop.remote() for _ in range(n)])
     rate = n / (time.perf_counter() - t0)
-    assert rate >= 800, f"actor call throughput {rate:.0f}/s below 800"
+    # Direct dispatch (round 4) measures ~20-26k/s; floor ~4x under.
+    assert rate >= 5000, \
+        f"actor call throughput {rate:.0f}/s below 5000"
+
+
+def test_put_bandwidth_floor(perf_cluster):
+    """Round-4 zero-copy put path measures ~6 GB/s; the pre-round-4
+    path (serialize->join->memmove + LRU spill churn) measured
+    0.2 GB/s. Floor at 1 GB/s catches a copy regression."""
+    import numpy as np
+    big = np.ones(64 * 1024 * 1024 // 8)
+    ray_tpu.put(big)                                   # warmup
+    n = 4
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ref = ray_tpu.put(big)
+        del ref            # put-drop churn: eager free keeps the
+        #                    store bounded (no spill stalls)
+    rate = n * big.nbytes / (time.perf_counter() - t0) / 1e9
+    # ~6 GB/s solo; under full-suite load on the 1-core CI box it can
+    # dip near 1 — floor at 0.8 still catches the 0.2 GB/s regression.
+    assert rate >= 0.8, f"put bandwidth {rate:.2f} GB/s below 0.8"
+
+
+def test_small_put_rate_floor(perf_cluster):
+    """Memory-tier puts (no shm create/seal) measure ~50k/s; floor 4x
+    under."""
+    ray_tpu.put(b"warm")
+    n = 2000
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(i) for i in range(n)]
+    rate = n / (time.perf_counter() - t0)
+    del refs
+    assert rate >= 12000, f"small put rate {rate:.0f}/s below 12000"

@@ -1,0 +1,142 @@
+"""Scalability-envelope benchmarks.
+
+The shape of the reference's release benchmarks
+(/root/reference/release/benchmarks/README.md:5-31 — many nodes, many
+actors, 1M queued tasks, 1 GiB broadcast) scaled to one machine:
+simulated nodes are extra shm-store segments + node agents, and the
+counts are sized so a single core finishes each probe in seconds while
+still stressing the same code paths (head dispatch fan-out, actor
+directory, PG bundle packing, deep queues, many-node broadcast).
+
+Run: python tools/ray_scale.py [--out SCALE.json]
+Each metric prints as it lands; the JSON is written at the end.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+RESULTS = {}
+
+
+def record(name, value, unit):
+    RESULTS[name] = {"value": round(value, 2), "unit": unit}
+    print(f"{name:44s} {value:12.2f} {unit}", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="")
+    ap.add_argument("--actors", type=int, default=1000)
+    ap.add_argument("--pgs", type=int, default=200)
+    ap.add_argument("--queue", type=int, default=100_000)
+    ap.add_argument("--broadcast-nodes", type=int, default=8)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import ray_tpu
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+
+    cluster = Cluster(num_workers=2,
+                      resources_per_worker={"CPU": 1000},
+                      store_capacity=2 * 1024 * 1024 * 1024)
+    try:
+        # --- many actors -------------------------------------------------
+        @ray_tpu.remote(num_cpus=0.001)
+        class Tiny:
+            def ping(self):
+                return 1
+
+        n_act = args.actors
+        t0 = time.perf_counter()
+        actors = [Tiny.remote() for _ in range(n_act)]
+        # one call through every actor proves them all alive
+        ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+        dt = time.perf_counter() - t0
+        record("actors_created_and_called_per_s", n_act / dt, "/s")
+
+        t0 = time.perf_counter()
+        ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+        dt = time.perf_counter() - t0
+        record("calls_across_1k_actors_per_s", n_act / dt, "/s")
+        for a in actors:
+            ray_tpu.kill(a)
+        del actors
+
+        # --- many placement groups ---------------------------------------
+        from ray_tpu.util import placement_group, remove_placement_group
+        n_pg = args.pgs
+        t0 = time.perf_counter()
+        pgs = [placement_group([{"CPU": 0.01}], strategy="PACK")
+               for _ in range(n_pg)]
+        for pg in pgs:
+            assert pg.wait(120)
+        dt = time.perf_counter() - t0
+        record("placement_groups_created_per_s", n_pg / dt, "/s")
+        t0 = time.perf_counter()
+        for pg in pgs:
+            remove_placement_group(pg)
+        record("placement_groups_removed_per_s",
+               n_pg / (time.perf_counter() - t0), "/s")
+
+        # --- deep queue ---------------------------------------------------
+        @ray_tpu.remote(num_cpus=0.001)
+        def noop():
+            pass
+
+        n_q = args.queue
+        t0 = time.perf_counter()
+        refs = [noop.remote() for _ in range(n_q)]
+        submit_dt = time.perf_counter() - t0
+        record("deep_queue_submit_per_s", n_q / submit_dt, "/s")
+        ray_tpu.get(refs, timeout=1200)
+        total_dt = time.perf_counter() - t0
+        record("deep_queue_drain_per_s", n_q / total_dt, "/s")
+        del refs
+
+        # --- 1 GiB broadcast to N nodes ----------------------------------
+        n_nodes = args.broadcast_nodes
+        for i in range(n_nodes):
+            cluster.add_node(
+                num_workers=1,
+                resources_per_worker={"CPU": 2, f"bnode{i}": 10},
+                store_capacity=2 * 1024 * 1024 * 1024)
+
+        @ray_tpu.remote(num_cpus=0.001)
+        def touch(arr):
+            return int(arr[0]) + arr.nbytes
+
+        gib = np.ones((1 << 30) // 8)      # 1 GiB float64
+        ref = ray_tpu.put(gib)
+        t0 = time.perf_counter()
+        outs = ray_tpu.get(
+            [touch.options(resources={f"bnode{i}": 1}).remote(ref)
+             for i in range(n_nodes)], timeout=1200)
+        dt = time.perf_counter() - t0
+        assert all(o == 1 + gib.nbytes for o in outs)
+        record("broadcast_1GiB_nodes_per_s", n_nodes / dt, "nodes/s")
+        record("broadcast_1GiB_aggregate_gbps",
+               n_nodes * gib.nbytes / dt / 1e9, "GB/s")
+    finally:
+        cluster.shutdown()
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"metrics": RESULTS,
+                       "config": vars(args)}, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
