@@ -21,7 +21,7 @@ import threading
 import zipfile
 from typing import Any, Dict, Optional
 
-_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules"}
+_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
 
 
 def runtime_env_key(runtime_env: Optional[Dict[str, Any]]
@@ -128,7 +128,152 @@ def validate_runtime_env(runtime_env: Optional[Dict[str, Any]]
     mods = runtime_env.get("py_modules")
     if mods is not None and not isinstance(mods, (list, tuple)):
         raise TypeError("runtime_env['py_modules'] must be a list")
+    pip = runtime_env.get("pip")
+    if pip is not None:
+        if isinstance(pip, dict):
+            pkgs = pip.get("packages")
+        else:
+            pkgs = pip
+        if not isinstance(pkgs, (list, tuple)) or not all(
+                isinstance(p, str) for p in pkgs):
+            raise TypeError(
+                "runtime_env['pip'] must be a list of requirement "
+                "strings or {'packages': [...], 'local_index': path}")
     return dict(runtime_env)
+
+
+# ---------------------------------------------------------------- pip envs
+
+def _pip_spec(runtime_env: Dict[str, Any]):
+    pip = runtime_env.get("pip")
+    if pip is None:
+        return None, None
+    if isinstance(pip, dict):
+        return list(pip.get("packages") or []), pip.get("local_index")
+    return list(pip), None
+
+
+def pip_env_dir(runtime_env: Dict[str, Any]) -> Optional[str]:
+    pkgs, index = _pip_spec(runtime_env)
+    if pkgs is None:
+        return None
+    import json
+    key = hashlib.sha1(json.dumps([sorted(pkgs), index])
+                       .encode()).hexdigest()[:16]
+    return os.path.join(_CACHE_DIR, "venvs", key)
+
+
+def stage_pip_env(runtime_env: Dict[str, Any],
+                  timeout_s: float = 600.0) -> Optional[str]:
+    """Materialize the env's virtualenv on THIS node and return its
+    python executable; cache-hit by requirements hash (reference:
+    python/ray/_private/runtime_env/pip.py — per-URI venv cache built
+    by the runtime-env agent on the executing node).
+
+    The venv uses --system-site-packages so the framework stack (jax,
+    numpy, ray_tpu's deps) stays importable, matching the reference's
+    inherit-base-environment behavior. Installs run with --no-index
+    unless a local_index is given — this image has no network, so pip
+    envs install local wheels/source dirs (--no-build-isolation: the
+    system setuptools does the build)."""
+    pkgs, index = _pip_spec(runtime_env)
+    if pkgs is None:
+        return None
+    vdir = pip_env_dir(runtime_env)
+    py = os.path.join(vdir, "bin", "python")
+    marker = os.path.join(vdir, ".ok")
+    if os.path.exists(marker):
+        return py                          # cache hit
+    os.makedirs(os.path.dirname(vdir), exist_ok=True)
+    lock = vdir + ".lock"
+    import subprocess
+    import time
+    try:
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        # another process is staging this exact env: wait for it —
+        # unless its recorded pid is dead (SIGKILLed staker), in
+        # which case break the stale lock and take over.
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if os.path.exists(marker):
+                return py
+            if not os.path.exists(lock):    # staker finished/failed
+                return stage_pip_env(runtime_env, timeout_s)
+            try:
+                with open(lock) as f:
+                    owner = int(f.read().strip() or 0)
+                if owner:
+                    os.kill(owner, 0)       # raises if dead
+            except (OSError, ValueError):
+                try:
+                    os.unlink(lock)         # dead owner: break it
+                except OSError:
+                    pass
+                return stage_pip_env(runtime_env, timeout_s)
+            time.sleep(0.25)
+        raise TimeoutError(f"pip env {vdir} staging timed out")
+    try:
+        os.write(fd, str(os.getpid()).encode())
+        os.close(fd)
+        if not os.path.exists(py):
+            proc = subprocess.run(
+                [sys.executable, "-m", "venv",
+                 "--system-site-packages", vdir],
+                capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"venv creation failed (rc={proc.returncode}): "
+                    f"{(proc.stderr or '')[-2000:]}")
+            # --system-site-packages links the SYSTEM python's site
+            # dir, but this interpreter may itself be a venv (the
+            # image's /opt/venv) holding the whole framework stack —
+            # layer OUR site-packages underneath via a .pth so jax/
+            # numpy/setuptools stay importable (venv-local packages
+            # still win: their dir sorts first on sys.path).
+            own_sites = [p for p in sys.path
+                         if p.endswith("site-packages")
+                         and os.path.isdir(p)]
+            with open(os.path.join(_venv_site(vdir),
+                                   "_raytpu_base.pth"), "w") as f:
+                f.write("\n".join(own_sites) + "\n")
+        if pkgs:           # empty list = bare venv, nothing to install
+            cmd = [py, "-m", "pip", "install",
+                   "--no-warn-script-location",
+                   "--no-build-isolation",
+                   "--disable-pip-version-check"]
+            if index:
+                cmd += ["--no-index", "--find-links", index]
+            else:
+                cmd += ["--no-index"]
+            cmd += pkgs
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout_s)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip env install failed (rc={proc.returncode}): "
+                    f"{(proc.stderr or '')[-2000:]}")
+        with open(marker, "w") as f:
+            f.write("ok")
+        return py
+    finally:
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
+
+
+def _venv_site(vdir: str) -> str:
+    v = sys.version_info
+    return os.path.join(vdir, "lib", f"python{v.major}.{v.minor}",
+                        "site-packages")
+
+
+def pip_env_site_packages(runtime_env: Dict[str, Any]) -> Optional[str]:
+    """The venv's site-packages dir (for in-process sys.path layering
+    in the local runtime, where re-exec isn't possible)."""
+    vdir = pip_env_dir(runtime_env)
+    return None if vdir is None else _venv_site(vdir)
 
 
 def _stage_working_dir(path: str) -> str:
@@ -173,7 +318,22 @@ def runtime_env_context(runtime_env: Optional[Dict[str, Any]]):
     token = object()
     applied = {"env": False, "cwd": False, "paths": []}
 
+    # pip env (in-process application): stage the venv OUTSIDE the
+    # apply lock (installs take seconds) and layer its site-packages
+    # onto sys.path. Dedicated env workers instead re-exec into the
+    # venv interpreter at startup (worker_main) and skip this — the
+    # marker env var says this process already IS that venv.
+    pip_site = None
+    if runtime_env.get("pip") is not None:
+        vdir = pip_env_dir(runtime_env)
+        if os.environ.get("RAY_TPU_VENV") != vdir:
+            stage_pip_env(runtime_env)
+            pip_site = pip_env_site_packages(runtime_env)
+
     def _apply_locked():
+        if pip_site:
+            _claim_path(pip_site)
+            applied["paths"].append(pip_site)
         for k, v in (runtime_env.get("env_vars") or {}).items():
             _env_stacks.setdefault(k, []).append([token,
                                                   os.environ.get(k)])

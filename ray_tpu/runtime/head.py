@@ -587,6 +587,14 @@ class HeadService:
     def mark_worker_dead(self, worker_id: str):
         """Called by the node manager when a worker process dies."""
         with self._lock:
+            # A spawned env worker can die BEFORE registering (setup
+            # crash): remember the id so the env-spawn tracker knows
+            # its in-flight spawn is gone and may retry.
+            done = getattr(self, "_env_spawn_done", None)
+            if done is None:
+                done = self._env_spawn_done = collections.deque(
+                    maxlen=256)
+            done.append(worker_id)
             w = self._workers.get(worker_id)
             if w is None or not w.alive:
                 return
@@ -914,14 +922,59 @@ class HeadService:
                     self._handle_lost_task(m["task_id"])
                 return
 
+    def env_setup_failed(self, env_key: str, message: str):
+        """A dedicated env worker failed its environment setup (pip
+        install error, bad working_dir, ...) before registering: fail
+        every queued task for that env with the real error instead of
+        hanging the callers, and stop respawning for a while
+        (reference: runtime-env agent setup errors fail the task with
+        RuntimeEnvSetupError)."""
+        with self._lock:
+            failures = getattr(self, "_env_failures", None)
+            if failures is None:
+                failures = self._env_failures = {}
+            failures[env_key] = (time.time(), message)
+            self._fail_env_tasks_locked(env_key, message)
+            self._sched_cv.notify_all()
+
+    def _fail_env_tasks_locked(self, env_key: str, message: str):
+        err = RuntimeError(
+            f"runtime_env setup failed for this task's environment: "
+            f"{message}")
+        doomed = []
+        for sig, queue in list(self._pending.items()):
+            if sig[2] != env_key:      # sig: (res, pg, env_key, ...)
+                continue
+            for task_id in queue:
+                meta = self._task_meta.pop(task_id, None)
+                if meta is not None:
+                    doomed.append(meta["return_ids"])
+            del self._pending[sig]
+        if doomed:
+            def _store():
+                for rids in doomed:
+                    self._store_error(rids, err)
+            threading.Thread(target=_store, daemon=True).start()
+
     def _ensure_env_worker_locked(self, env_key: str,
                                   runtime_env: Optional[Dict],
                                   resources: Optional[Dict] = None):
         """Spawn one dedicated worker for a runtime-env key when no
         FEASIBLE one exists (worker_pool StartWorkerProcess parity).
-        At most one spawn in flight per key."""
+        At most one spawn in flight per key: the cooldown stays armed
+        while the spawned process is still setting up (pip installs
+        can take minutes) and is disarmed when it registers or dies."""
         if runtime_env is None:
             return
+        failures = getattr(self, "_env_failures", {})
+        failed = failures.get(env_key)
+        if failed is not None:
+            if time.time() - failed[0] < 60:
+                # recent deterministic failure: fail fast instead of
+                # respawn-looping; retry window after 60s
+                self._fail_env_tasks_locked(env_key, failed[1])
+                return
+            failures.pop(env_key, None)
         need = dict(resources or {})
         if any(w.env_key == env_key and w.alive and
                all(w.resources.get(k, 0.0) + 1e-9 >= v
@@ -931,9 +984,17 @@ class HeadService:
         spawns = getattr(self, "_env_spawns", None)
         if spawns is None:
             spawns = self._env_spawns = {}
-        if time.time() < spawns.get(env_key, 0):
-            return
-        spawns[env_key] = time.time() + 30      # spawn cooldown
+        ent = spawns.get(env_key)
+        if ent is not None:
+            deadline, wid = ent
+            if wid is not None and (
+                    wid in self._workers
+                    or wid in getattr(self, "_env_spawn_done", ())):
+                spawns.pop(env_key, None)   # registered or died
+            elif time.time() < deadline:
+                return                      # still starting up
+        # generous deadline: setup may build a venv
+        spawns[env_key] = (time.time() + 600, None)
         ns = getattr(self, "_node_service", None)
         if ns is None:
             return
@@ -943,10 +1004,15 @@ class HeadService:
 
         def spawn():
             try:
-                ns.call("start_worker", ns.call("num_workers"),
-                        spawn_res, runtime_env)
+                wid = ns.call("start_worker", ns.call("num_workers"),
+                              spawn_res, runtime_env)
+                with self._lock:
+                    ent = spawns.get(env_key)
+                    if ent is not None:
+                        spawns[env_key] = (ent[0], wid)
             except Exception:
-                pass
+                with self._lock:
+                    spawns.pop(env_key, None)
 
         threading.Thread(target=spawn, daemon=True,
                          name=f"env-spawn-{env_key[:8]}").start()

@@ -437,9 +437,15 @@ class ObjectPlane:
                                   probe=True, reconstruct=reconstruct)
         except Exception:
             return None
-        for loc in locs:
-            if loc["node_id"] == self.node_id:
-                continue        # it's local (or about to be): retry shm
+        peers = [l for l in locs if l["node_id"] != self.node_id]
+        # Randomize replica choice: during a broadcast every node that
+        # finished pulling is itself a source, so spreading pulls over
+        # the replicas turns N-pullers-on-one-seed into a dissemination
+        # tree (the reference's ObjectManager picks among locations the
+        # same way, object_directory location shuffling).
+        import random
+        random.shuffle(peers)
+        for loc in peers:
             data = self._pull(oid, loc)
             if data is not None:
                 # _pull streamed it into the local store (repeated

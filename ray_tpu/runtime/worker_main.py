@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import queue
 import sys
 import threading
@@ -735,14 +736,42 @@ def main():
         else None
     env_key = None
     if startup_env:
-        # Dedicated env-keyed worker: apply once, forever — the head
-        # routes only matching tasks/actors here, so per-execution
-        # apply/restore is skipped (true process isolation,
-        # worker_pool.h:149 semantics).
         from ray_tpu._private.runtime_env import (
-            enter_runtime_env_permanently, runtime_env_key)
-        enter_runtime_env_permanently(startup_env)
+            enter_runtime_env_permanently, pip_env_dir,
+            runtime_env_key, stage_pip_env)
         env_key = runtime_env_key(startup_env)
+        try:
+            if startup_env.get("pip") is not None:
+                # pip env: stage the venv on this node and RE-EXEC
+                # into its interpreter (reference: the runtime-env
+                # agent builds the venv and workers launch with its
+                # python, _private/runtime_env/pip.py). The marker env
+                # var breaks the exec loop and tells
+                # runtime_env_context this process already IS the
+                # venv.
+                vdir = pip_env_dir(startup_env)
+                if os.environ.get("RAY_TPU_VENV") != vdir:
+                    venv_py = stage_pip_env(startup_env)
+                    env = dict(os.environ)
+                    env["RAY_TPU_VENV"] = vdir
+                    os.execve(venv_py, [venv_py, "-m",
+                                        "ray_tpu.runtime.worker_main",
+                                        *sys.argv[1:]], env)
+            # Dedicated env-keyed worker: apply once, forever — the
+            # head routes only matching tasks/actors here, so
+            # per-execution apply/restore is skipped (true process
+            # isolation, worker_pool.h:149 semantics).
+            enter_runtime_env_permanently(startup_env)
+        except BaseException as e:  # noqa: BLE001
+            # Setup failure must surface to the callers, not hang
+            # them: tell the head so queued tasks for this env fail
+            # with the real error (pip stderr etc).
+            try:
+                RpcClient(args.head, timeout=10).call(
+                    "env_setup_failed", env_key, str(e)[-2000:])
+            except Exception:
+                pass
+            raise
 
     from ray_tpu._private.shm_store import ShmObjectStore
     store = ShmObjectStore.attach(args.store)
