@@ -153,12 +153,43 @@ def _pip_spec(runtime_env: Dict[str, Any]):
     return list(pip), None
 
 
+def _local_pkg_fingerprint(path: str) -> str:
+    """Content stamp for a local source package: walk of relative
+    paths + sizes + mtimes. Without it, editing the package in place
+    would serve the stale cached venv forever (the requirement STRING
+    doesn't change)."""
+    h = hashlib.sha1()
+    for root, dirs, files in os.walk(path):
+        # skip build artifacts: pip's source build writes egg-info/
+        # build/ INTO the package dir, and including them would change
+        # the key between staging and the worker's re-exec check
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git",
+                                      "build", "dist")
+                         and not d.endswith(".egg-info"))
+        for fn in sorted(files):
+            fp = os.path.join(root, fn)
+            try:
+                st = os.stat(fp)
+            except OSError:
+                continue
+            h.update(f"{os.path.relpath(fp, path)}:"
+                     f"{st.st_size}:{st.st_mtime_ns}".encode())
+    return h.hexdigest()[:12]
+
+
 def pip_env_dir(runtime_env: Dict[str, Any]) -> Optional[str]:
     pkgs, index = _pip_spec(runtime_env)
     if pkgs is None:
         return None
     import json
-    key = hashlib.sha1(json.dumps([sorted(pkgs), index])
+    keyed = []
+    for p in sorted(pkgs):
+        if os.path.isdir(p):       # local source dir: key by content
+            keyed.append(f"{p}@{_local_pkg_fingerprint(p)}")
+        else:
+            keyed.append(p)
+    key = hashlib.sha1(json.dumps([keyed, index])
                        .encode()).hexdigest()[:16]
     return os.path.join(_CACHE_DIR, "venvs", key)
 

@@ -52,6 +52,11 @@ class Dashboard:
         from ray_tpu import state
         return web.json_response(state.list_workers())
 
+    async def _nodes(self, request):
+        from aiohttp import web
+        from ray_tpu import state
+        return web.json_response(state.list_nodes())
+
     async def _timeline(self, request):
         from aiohttp import web
         from ray_tpu._private import profiling
@@ -75,6 +80,7 @@ class Dashboard:
         app.router.add_get("/api/tasks", self._tasks)
         app.router.add_get("/api/objects", self._objects)
         app.router.add_get("/api/workers", self._workers)
+        app.router.add_get("/api/nodes", self._nodes)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/metrics", self._metrics)
         runner = web.AppRunner(app)

@@ -74,6 +74,7 @@ td.mono { font-family: ui-monospace, monospace; font-size: 12px;
 </header>
 <main>
   <div class="tiles" id="tiles"></div>
+  <h2>Nodes</h2><div id="nodes"></div>
   <h2>Workers</h2><div id="workers"></div>
   <h2>Actors</h2><div id="actors"></div>
   <h2>Tasks</h2><div id="tasks"></div>
@@ -122,9 +123,11 @@ function resPair(total, avail, key) {
 }
 async function refresh() {
   try {
-    const [sum, workers, actors, tasks, objects] = await Promise.all([
+    const [sum, workers, actors, tasks, objects, nodes] =
+      await Promise.all([
       j("/api/cluster_summary"), j("/api/workers"), j("/api/actors"),
-      j("/api/tasks"), j("/api/objects")]);
+      j("/api/tasks"), j("/api/objects"),
+      j("/api/nodes").catch(() => [])]);
     const t = sum.resources_total || {}, a = sum.resources_available || {};
     const running = (sum.tasks || {}).RUNNING || 0;
     const finished = (sum.tasks || {}).FINISHED || 0;
@@ -136,6 +139,29 @@ async function refresh() {
       tile("Tasks running", running, `${fmt(finished)} finished`) +
       tile("Actors", Object.values(sum.actors || {})
                      .reduce((x, y) => x + y, 0));
+    // per-node hardware rows (reporter_agent parity): cpu/mem/store
+    // snapshots shipped with node heartbeats
+    document.getElementById("nodes").innerHTML = table(nodes, [
+      {label: "node", cls: "mono", fn: r => esc(r.node_id)},
+      {label: "state", fn: r => pill(r.alive, r.alive ? "alive" : "dead")},
+      {label: "cpu %", fn: r => r.hw ? fmt(r.hw.cpu_percent)
+                               : `<span class=muted>—</span>`},
+      {label: "load", fn: r => r.hw && r.hw.load_avg
+                       ? fmt(r.hw.load_avg[0]) : `<span class=muted>—</span>`},
+      {label: "mem", fn: r => r.hw && r.hw.mem
+                       ? `${fmt(r.hw.mem.percent)}% of ${gb(r.hw.mem.total)}`
+                       : `<span class=muted>—</span>`},
+      {label: "object store", fn: r => {
+        const s = r.hw && r.hw.object_store;
+        return s ? `${gb(s.bytes_in_use)} / ${gb(s.capacity)}`
+                 : `<span class=muted>—</span>`;
+      }},
+      {label: "tpu HBM", fn: r => {
+        const t = r.hw && r.hw.tpu && r.hw.tpu[0];
+        return t && t.hbm_bytes_in_use != null
+          ? `${gb(t.hbm_bytes_in_use)} / ${gb(t.hbm_bytes_limit)}`
+          : `<span class=muted>—</span>`;
+      }}]);
     document.getElementById("workers").innerHTML = table(workers, [
       {label: "id", cls: "mono", fn: r => esc(r.worker_id)},
       {label: "state", fn: r => pill(r.alive, r.alive ? "alive" : "dead")},

@@ -269,12 +269,17 @@ class HeadService:
                                              store_name)
         self._publish_nodes()
 
-    def node_heartbeat(self, node_id: str) -> bool:
+    def node_heartbeat(self, node_id: str, hw: Optional[Dict] = None
+                       ) -> bool:
         with self._lock:
             n = self._nodes.get(node_id)
             if n is None or not n.alive:
                 return False    # tells a zombie agent to re-register
             n.last_heartbeat = time.time()
+            if hw is not None:
+                # per-node hardware snapshot riding the heartbeat
+                # (reporter_agent.py role)
+                n.hw = hw
             return True
 
     def node_count(self) -> int:
@@ -282,11 +287,31 @@ class HeadService:
             return sum(1 for n in self._nodes.values() if n.alive)
 
     def list_nodes(self) -> List[Dict[str, Any]]:
+        self._refresh_own_hw()
         with self._lock:
             return [{"node_id": n.node_id, "alive": n.alive,
                      "object_addr": n.object_addr,
-                     "store_name": n.store_name}
+                     "store_name": n.store_name,
+                     "last_heartbeat": getattr(n, "last_heartbeat", 0),
+                     "hw": getattr(n, "hw", None)}
                     for n in self._nodes.values()]
+
+    def _refresh_own_hw(self, max_age_s: float = 2.0):
+        """The head node has no agent heartbeating at it: snapshot its
+        hardware locally (cached) when someone asks."""
+        now = time.time()
+        if now - getattr(self, "_own_hw_ts", 0) < max_age_s:
+            return
+        self._own_hw_ts = now
+        try:
+            from ray_tpu._private.hw_report import collect_hw_stats
+            hw = collect_hw_stats(self._get_store())
+        except Exception:
+            return
+        with self._lock:
+            n = self._nodes.get("head")
+            if n is not None:
+                n.hw = hw
 
     def _publish_nodes(self):
         self.hub.publish_state("nodes", self.list_nodes())
@@ -833,7 +858,11 @@ class HeadService:
     def _try_dispatch_locked(self) -> bool:
         progressed = False
         for sig in list(self._pending):
-            queue = self._pending[sig]
+            queue = self._pending.get(sig)
+            if queue is None:
+                # a fail-fast path (env setup failure) deleted this
+                # sig after the snapshot was taken
+                continue
             while queue:
                 task_id = queue[0]
                 meta = self._task_meta.get(task_id)
@@ -1118,10 +1147,19 @@ class HeadService:
                         meta.get("resources", {}), pg_id, bundle_index,
                         meta.get("env_key"))
                     if w is None:
-                        if meta.get("env_key") is not None:
+                        env_key = meta.get("env_key")
+                        if env_key is not None:
+                            failed = getattr(self, "_env_failures",
+                                             {}).get(env_key)
+                            if failed is not None:
+                                # surface the REAL setup error (pip
+                                # stderr), not a placement timeout
+                                raise RuntimeError(
+                                    f"runtime_env setup failed for "
+                                    f"this actor's environment: "
+                                    f"{failed[1]}")
                             self._ensure_env_worker_locked(
-                                meta["env_key"],
-                                meta.get("runtime_env"),
+                                env_key, meta.get("runtime_env"),
                                 meta.get("resources", {}))
                         # Surface the blocked demand to the autoscaler.
                         self._pending_actor_demands[actor_id] = dict(

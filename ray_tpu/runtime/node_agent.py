@@ -125,10 +125,20 @@ class NodeAgent:
         from ray_tpu._private.config import GlobalConfig
         period = GlobalConfig.heartbeat_period_ms / 1000.0
         misses = 0
+        from ray_tpu._private.hw_report import collect_hw_stats
+        hw_every = max(1, int(2.0 / period))   # hw snapshot ~2s cadence
+        beat = 0
         while not self._stopped.wait(timeout=period):
+            hw = None
+            if beat % hw_every == 0:
+                try:
+                    hw = collect_hw_stats(self.store)
+                except Exception:
+                    pass     # reporter is best-effort
+            beat += 1
             try:
                 ok = self.head.call("node_heartbeat", self.node_id,
-                                    timeout=5)
+                                    hw, timeout=5)
                 misses = 0
                 if not ok:
                     # Head declared us dead (or restarted): re-join.

@@ -37,8 +37,12 @@ import cloudpickle
 _LEN = struct.Struct("<I")
 
 MAGIC = b"RAYT"
-PROTO_VERSION = 1
+PROTO_VERSION = 2
 _HELLO = struct.Struct("<4sHH")
+# v2 handshake ACK (server -> client after a successful HELLO): the
+# codec version rides back so both ends know exactly what the peer
+# speaks (the proto-file version-negotiation role).
+_HELLO_ACK = struct.Struct("<4sH")
 _HANDSHAKE_TIMEOUT_S = 10.0
 
 
@@ -50,6 +54,21 @@ def _token_bytes() -> bytes:
 def _send_hello(sock: socket.socket):
     tok = _token_bytes()
     sock.sendall(_HELLO.pack(MAGIC, PROTO_VERSION, len(tok)) + tok)
+    # v2: read the server's handshake ack (codec version exchange).
+    # A server that rejected us sends an error FRAME instead — its
+    # first 4 bytes are a little-endian length, never b"RAYT", so the
+    # magic check below distinguishes the two without ambiguity.
+    head = _recv_exact(sock, _HELLO_ACK.size)
+    magic, codec = _HELLO_ACK.unpack(head)
+    if magic != MAGIC:
+        # rejection frame: reassemble it and surface the server's
+        # reason as the error
+        rest_len = _LEN.unpack(head[:4])[0]
+        body = head[4:] + _recv_exact(
+            sock, rest_len - (len(head) - 4))
+        reply = pickle.loads(body)
+        raise reply.get("err") or RpcError("handshake rejected")
+    return codec
 
 
 def _check_hello(sock: socket.socket) -> Optional[str]:
@@ -143,6 +162,8 @@ class RpcServer:
                 except (ConnectionError, OSError):
                     pass
                 return
+            from ray_tpu.runtime.schemas import CODEC_VERSION
+            conn.sendall(_HELLO_ACK.pack(MAGIC, CODEC_VERSION))
             conn.settimeout(None)
             while self._running:
                 req = _recv_msg(conn)
@@ -172,6 +193,9 @@ class RpcServer:
         raw = None
         cleanup = None
         try:
+            from ray_tpu.runtime.schemas import validate_request
+            validate_request(req["method"], req.get("args", ()),
+                             req.get("kwargs", {}))
             method = getattr(self.handler, req["method"])
             result = method(*req.get("args", ()),
                             **req.get("kwargs", {}))
@@ -236,7 +260,7 @@ class RpcClient:
         sock = socket.create_connection((self.host, self.port),
                                         timeout=self.timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send_hello(sock)
+        self.peer_codec = _send_hello(sock)
         return sock
 
     def _get_conn(self) -> socket.socket:

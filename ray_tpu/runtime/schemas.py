@@ -1,0 +1,136 @@
+"""Typed wire-message schemas for the control-plane RPC protocol.
+
+The capability of the reference's 21 protobuf files
+(src/ray/protobuf/*.proto, e.g. gcs_service.proto): every
+control-plane method has a declared signature, unknown fields are
+rejected instead of silently absorbed, and the peer's codec version is
+exchanged at connection setup so version skew fails CLOSED with a
+clear error instead of corrupting state mid-flight.
+
+Schemas are declarative tuples instead of generated classes — both
+ends are this codebase, so the value of protos here is validation +
+versioning, not cross-language codegen.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+# Bumped whenever a schema or the frame layout changes incompatibly.
+# Exchanged in the handshake ack; PROTO_VERSION (rpc.py) gates the
+# handshake itself.
+CODEC_VERSION = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class Param:
+    name: str
+    types: Optional[tuple] = None     # None = any
+    required: bool = True
+
+
+def P(name, types=None, required=True):
+    if types is not None and not isinstance(types, tuple):
+        types = (types,)
+    return Param(name, types, required)
+
+
+_BYTES = (bytes, bytearray, memoryview)
+
+# Method name -> parameter schema. Methods not listed are legacy /
+# dynamic endpoints and pass through unvalidated (the registry covers
+# the control-plane surface the reference declares in protos).
+SCHEMAS: Dict[str, Tuple[Param, ...]] = {
+    # task submission / dispatch
+    "submit_tasks": (P("batch", list),),
+    "push_tasks": (P("payloads", list),),
+    "tasks_done": (P("worker_id", str), P("task_ids", list)),
+    # actors
+    "submit_actor_task": (P("actor_id", str), P("meta", dict),
+                          P("payload", _BYTES),
+                          P("attempts", int, required=False)),
+    "push_actor_task": (P("actor_id", str), P("payload", _BYTES),
+                        P("attempts", int, required=False)),
+    "push_actor_tasks": (P("items", list),),
+    "reroute_actor_task": (P("actor_id", str), P("payload", _BYTES),
+                           P("attempts", int, required=False)),
+    "actor_address": (P("actor_id", str),),
+    "kill_actor": (P("actor_id", str),
+                   P("restart", (bool, int), required=False)),
+    # object directory / transfer
+    "register_objects": (P("node_id", str), P("oid_hexes", list)),
+    "free_objects": (P("oid_hexes", list),),
+    "locate_object": (P("oid_hex", str),
+                      P("probe", bool, required=False),
+                      P("reconstruct", bool, required=False)),
+    "locate_objects": (P("oid_hexes", list),),
+    "unregister_object": (P("oid_hex", str), P("node_id", str)),
+    "object_size": (P("oid_hex", str),),
+    "has_object": (P("oid_hex", str),),
+    "pull_chunk": (P("oid_hex", str), P("offset", int),
+                   P("length", int)),
+    "raw_pull_chunk": (P("oid_hex", str), P("offset", int),
+                       P("length", int)),
+    # membership
+    "register_node": (P("node_id", str), P("object_addr", str),
+                      P("store_name", str)),
+    "node_heartbeat": (P("node_id", str),
+                       P("hw", (dict, type(None)), required=False)),
+    "mark_worker_dead": (P("worker_id", str),),
+    "env_setup_failed": (P("env_key", str), P("message", str)),
+    # KV
+    "kv_put": (P("key", str), P("value", _BYTES)),
+    "kv_get": (P("key", str),),
+    "kv_del": (P("key", str),),
+    "kv_keys": (P("prefix", str, required=False),),
+}
+
+
+class SchemaError(Exception):
+    """Request rejected by schema validation (fails closed)."""
+
+
+def validate_request(method: str, args: tuple,
+                     kwargs: Dict[str, Any]) -> None:
+    """Raise SchemaError for malformed requests to schema'd methods.
+    Unknown kwargs are rejected outright — the unknown-field
+    protection protos give (a newer peer's extra field must not be
+    silently dropped by an older server)."""
+    schema = SCHEMAS.get(method)
+    if schema is None:
+        return
+    by_name = {p.name: p for p in schema}
+    if len(args) > len(schema):
+        raise SchemaError(
+            f"{method}: takes at most {len(schema)} arguments, "
+            f"got {len(args)}")
+    seen = set()
+    for p, a in zip(schema, args):
+        seen.add(p.name)
+        _check_type(method, p, a)
+    for k, v in kwargs.items():
+        p = by_name.get(k)
+        if p is None:
+            raise SchemaError(
+                f"{method}: unknown field {k!r} (schema fields: "
+                f"{sorted(by_name)}; version skew? this server "
+                f"speaks codec {CODEC_VERSION})")
+        if p.name in seen:
+            raise SchemaError(f"{method}: duplicate field {k!r}")
+        seen.add(p.name)
+        _check_type(method, p, v)
+    missing = [p.name for p in schema
+               if p.required and p.name not in seen]
+    if missing:
+        raise SchemaError(f"{method}: missing required fields "
+                          f"{missing}")
+
+
+def _check_type(method: str, p: Param, value: Any) -> None:
+    if p.types is None or value is None and not p.required:
+        return
+    if not isinstance(value, p.types):
+        want = "/".join(t.__name__ for t in p.types)
+        raise SchemaError(
+            f"{method}: field {p.name!r} expects {want}, got "
+            f"{type(value).__name__}")

@@ -64,6 +64,18 @@ def list_workers() -> List[Dict[str, Any]]:
              "available": rt.available_resources()}]
 
 
+def list_nodes() -> List[Dict[str, Any]]:
+    """Per-node membership + hardware snapshots (reporter_agent.py
+    role: psutil/TPU stats ride node heartbeats into the head)."""
+    rt = global_worker().runtime
+    if hasattr(rt, "list_nodes"):
+        return rt.list_nodes()
+    # local runtime: one in-process "node", sampled directly
+    from ray_tpu._private.hw_report import collect_hw_stats
+    return [{"node_id": "local", "alive": True,
+             "hw": collect_hw_stats()}]
+
+
 def summarize_tasks() -> Dict[str, int]:
     counts: Dict[str, int] = {}
     for t in list_tasks():

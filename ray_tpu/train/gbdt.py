@@ -19,12 +19,67 @@ from ray_tpu.air.config import RunConfig, ScalingConfig
 from ray_tpu.air.result import Result
 
 
-def _dataset_to_xy(ds, label_column: str):
-    rows = ds.take_all()
-    y = np.asarray([r[label_column] for r in rows])
-    feats = [k for k in rows[0] if k != label_column]
-    X = np.asarray([[r[k] for k in feats] for r in rows], np.float64)
-    return X, y
+def _assemble_xy(block_refs, label_column: str):
+    """Stream dataset blocks into feature/label arrays block-by-block
+    — runs INSIDE the fit worker, so rows never co-reside in the
+    driver (reference: train/gbdt_trainer.py distributes the data
+    loading to the training workers)."""
+    import ray_tpu
+    feats = None
+    Xs, ys = [], []
+    for ref in block_refs:
+        rows = ray_tpu.get(ref)
+        if not rows:
+            continue
+        if feats is None:
+            feats = [k for k in rows[0] if k != label_column]
+        ys.append(np.asarray([r[label_column] for r in rows]))
+        Xs.append(np.asarray([[r[k] for k in feats] for r in rows],
+                             np.float64))
+    if not Xs:
+        raise ValueError("dataset is empty")
+    return np.concatenate(Xs), np.concatenate(ys)
+
+
+def _fit_task(est, train_refs, valid_refs, label_column: str,
+              metric_fn):
+    """Worker-side fit: assemble shards, fit, score. Returns the
+    fitted estimator + metrics + the fitting pid (provenance: proves
+    the driver never touched the rows)."""
+    import os
+    X, y = _assemble_xy(train_refs, label_column)
+    est.fit(X, y)
+    metrics = {f"train-{k}": v for k, v in metric_fn(est, X, y).items()}
+    if valid_refs is not None:
+        Xv, yv = _assemble_xy(valid_refs, label_column)
+        metrics.update({f"valid-{k}": v
+                        for k, v in metric_fn(est, Xv, yv).items()})
+    metrics["fit_pid"] = os.getpid()
+    return est, metrics
+
+
+def _run_remote_fit(est, datasets, label_column, metric_fn,
+                    scaling_config):
+    """Dispatch the fit as a task (driver holds only block REFS)."""
+    import ray_tpu
+    train_refs = list(datasets["train"].materialize()._block_refs)
+    valid = datasets.get("valid")
+    valid_refs = list(valid.materialize()._block_refs) \
+        if valid is not None else None
+    opts = {}
+    res = getattr(scaling_config, "resources_per_worker", None)
+    if res:
+        cpus = res.get("CPU")
+        if cpus:
+            opts["num_cpus"] = cpus
+        extra = {k: v for k, v in res.items() if k != "CPU"}
+        if extra:
+            opts["resources"] = extra
+    fn = ray_tpu.remote(_fit_task)
+    if opts:
+        fn = fn.options(**opts)
+    return ray_tpu.get(fn.remote(est, train_refs, valid_refs,
+                                 label_column, metric_fn))
 
 
 class SklearnTrainer:
@@ -44,15 +99,13 @@ class SklearnTrainer:
     def fit(self) -> Result:
         from ray_tpu._private.usage_stats import record_library_usage
         record_library_usage("train")
-        X, y = _dataset_to_xy(self.datasets["train"], self.label_column)
-        self.estimator.fit(X, y)
-        metrics: Dict[str, Any] = {
-            "train_score": float(self.estimator.score(X, y))}
-        valid = self.datasets.get("valid")
-        if valid is not None:
-            Xv, yv = _dataset_to_xy(valid, self.label_column)
-            metrics["valid_score"] = float(self.estimator.score(Xv, yv))
-        ckpt = Checkpoint.from_dict({"estimator": self.estimator})
+        est, metrics = _run_remote_fit(
+            self.estimator, self.datasets, self.label_column,
+            lambda e, X, y: {"score": float(e.score(X, y))},
+            self.scaling_config)
+        metrics = {k.replace("-", "_"): v for k, v in metrics.items()}
+        self.estimator = est
+        ckpt = Checkpoint.from_dict({"estimator": est})
         return Result(metrics=metrics, checkpoint=ckpt,
                       metrics_history=[metrics])
 
@@ -122,27 +175,21 @@ class _GBDTTrainer:
             k: v for k, v in self.params.items()
             if k != "objective"})            # pragma: no cover
 
-    def _metric(self, est, X, y) -> Dict[str, float]:
-        if self._is_classification():
-            return {"error": float(1.0 - est.score(X, y))}
-        pred = est.predict(X)
-        return {"rmse": float(np.sqrt(np.mean((pred - y) ** 2)))}
-
     def fit(self) -> Result:
         from ray_tpu._private.usage_stats import record_library_usage
         record_library_usage("train")
-        X, y = _dataset_to_xy(self.datasets["train"],
-                              self.label_column)
         est = self._make_native_or_fallback()
-        est.fit(X, y)
-        metrics: Dict[str, Any] = {
-            f"train-{k}": v for k, v in self._metric(est, X, y).items()}
-        valid = self.datasets.get("valid")
-        if valid is not None:
-            Xv, yv = _dataset_to_xy(valid, self.label_column)
-            metrics.update({f"valid-{k}": v
-                            for k, v in self._metric(
-                                est, Xv, yv).items()})
+        classification = self._is_classification()
+
+        def metric_fn(e, X, y, _cls=classification):
+            if _cls:
+                return {"error": float(1.0 - e.score(X, y))}
+            pred = e.predict(X)
+            return {"rmse": float(np.sqrt(np.mean((pred - y) ** 2)))}
+
+        est, metrics = _run_remote_fit(
+            est, self.datasets, self.label_column, metric_fn,
+            self.scaling_config)
         ckpt = Checkpoint.from_dict({"estimator": est,
                                      "params": dict(self.params)})
         return Result(metrics=metrics, checkpoint=ckpt,

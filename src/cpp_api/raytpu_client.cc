@@ -77,13 +77,22 @@ class RpcConn {
     if (connect(fd_, reinterpret_cast<sockaddr*>(&sa),
                 sizeof(sa)) != 0)
       throw std::runtime_error("connect to " + host_ + " failed");
-    // HELLO: magic + version + token (rpc.py wire protocol)
+    // HELLO: magic + version + token (rpc.py wire protocol v2)
     std::string hello = "RAYT";
-    uint16_t version = 1, tlen = static_cast<uint16_t>(token_.size());
+    uint16_t version = 2, tlen = static_cast<uint16_t>(token_.size());
     hello.append(reinterpret_cast<char*>(&version), 2);
     hello.append(reinterpret_cast<char*>(&tlen), 2);
     hello += token_;
     SendAll(hello.data(), hello.size());
+    // v2 handshake ACK: magic (4) + codec version (u16). A rejection
+    // arrives as a length-prefixed error frame instead; its first
+    // bytes are a little-endian length, never "RAYT".
+    char ack[6];
+    RecvAll(ack, 6);
+    if (memcmp(ack, "RAYT", 4) != 0)
+      throw std::runtime_error(
+          "handshake rejected by server (version/auth mismatch)");
+    memcpy(&peer_codec_, ack + 4, 2);
   }
 
   void SendAll(const char* p, size_t n) {
@@ -127,6 +136,7 @@ class RpcConn {
   int port_ = 0;
   std::string token_;
   int fd_ = -1;
+  uint16_t peer_codec_ = 0;
   int64_t rid_ = 0;
 };
 

@@ -122,6 +122,44 @@ def test_pip_env_failure_fails_tasks_fast(tmp_path):
         c.shutdown()
 
 
+def test_edited_local_pkg_invalidates_cache(tmp_path):
+    """Editing a local source package in place must produce a NEW venv
+    key (content fingerprint), not serve the stale cached venv."""
+    import os
+    pkg = _make_pkg(tmp_path, "graft_edit_pkg", magic=1)
+    env = {"pip": [pkg]}
+    d1 = pip_env_dir(env)
+    init = os.path.join(pkg, "graft_edit_pkg", "__init__.py")
+    with open(init, "a") as f:
+        f.write("EXTRA = 1\n")
+    os.utime(init, (time.time() + 2, time.time() + 2))
+    d2 = pip_env_dir(env)
+    assert d1 != d2
+
+
+def test_pip_env_failure_fails_actor(tmp_path):
+    """Actor creation with a broken pip env surfaces the REAL setup
+    error instead of a placement timeout."""
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    c = Cluster(num_workers=1, resources_per_worker={"CPU": 2})
+    try:
+        @ray_tpu.remote(
+            runtime_env={"pip": ["also-not-a-real-package-xyz42"]})
+        class A:
+            def ping(self):
+                return 1
+
+        with pytest.raises(Exception,
+                           match="runtime_env setup failed"):
+            a = A.remote()
+            ray_tpu.get(a.ping.remote(), timeout=90)
+    finally:
+        c.shutdown()
+
+
 def test_pip_env_in_local_runtime(tmp_path, rt):
     """The in-process runtime layers the venv's site-packages onto
     sys.path for the task's duration."""

@@ -133,6 +133,52 @@ def test_state_api(rt):
     assert summary["actors"].get("ALIVE", 0) >= 1
 
 
+def test_node_hw_reporter_to_dashboard():
+    """Per-node hardware reporter (reporter_agent.py parity): psutil
+    snapshots ride agent heartbeats into the head; /api/nodes and the
+    UI surface live per-node cpu/mem/store rows."""
+    import time as _time
+
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.dashboard import Dashboard
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    c = Cluster(num_workers=1, resources_per_worker={"CPU": 2})
+    c.add_node(num_workers=1, resources_per_worker={"CPU": 2})
+    dash = Dashboard(port=0).start()
+    try:
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{dash.port}{path}",
+                    timeout=15) as r:
+                return r.read().decode()
+
+        deadline = _time.time() + 20
+        nodes = []
+        while _time.time() < deadline:
+            nodes = json.loads(fetch("/api/nodes"))
+            with_hw = [n for n in nodes if n.get("hw")]
+            if len(with_hw) >= 2:      # head + agent node both report
+                break
+            _time.sleep(0.3)
+        assert len(nodes) >= 2
+        with_hw = [n for n in nodes if n.get("hw")]
+        assert len(with_hw) >= 2, nodes
+        for n in with_hw:
+            hw = n["hw"]
+            assert hw["mem"]["total"] > 0
+            assert "cpu_percent" in hw and "load_avg" in hw
+        agent = [n for n in nodes if n["node_id"] != "head"][0]
+        assert agent["hw"]["object_store"]["capacity"] > 0
+        # frontend renders the nodes section
+        index = fetch("/")
+        assert "/api/nodes" in index and ">Nodes</h2>" in index
+    finally:
+        dash.stop()
+        c.shutdown()
+
+
 def test_dashboard_endpoints(rt):
     from ray_tpu.dashboard import Dashboard
     from ray_tpu.util.metrics import Counter, clear_registry

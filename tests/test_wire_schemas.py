@@ -1,0 +1,109 @@
+"""Typed wire-message schemas + protocol version negotiation.
+
+Reference capability: the 21 proto files (src/ray/protobuf/
+gcs_service.proto etc.) give every control-plane method a declared
+signature, reject unknown fields, and make version skew fail closed.
+"""
+import pytest
+
+import ray_tpu.runtime.rpc as rpc
+from ray_tpu.runtime.rpc import RpcClient, RpcError, RpcServer
+from ray_tpu.runtime.schemas import (CODEC_VERSION, SchemaError,
+                                     validate_request)
+
+
+class _Handler:
+    def locate_object(self, oid_hex, probe=False, reconstruct=False):
+        return [{"oid": oid_hex, "probe": probe}]
+
+    def free_text(self, anything):          # unschema'd: passthrough
+        return anything
+
+
+@pytest.fixture()
+def server():
+    s = RpcServer(_Handler())
+    yield s
+    s.stop()
+
+
+def test_validate_request_unit():
+    validate_request("locate_object", ("ab",), {"probe": True})
+    with pytest.raises(SchemaError, match="unknown field 'bogus'"):
+        validate_request("locate_object", ("ab",), {"bogus": 1})
+    with pytest.raises(SchemaError, match="expects str"):
+        validate_request("locate_object", (123,), {})
+    with pytest.raises(SchemaError, match="missing required"):
+        validate_request("register_objects", (), {})
+    with pytest.raises(SchemaError, match="at most"):
+        validate_request("kv_get", ("a", "b", "c"), {})
+    validate_request("not_a_known_method", (1, 2), {"x": 3})  # legacy
+
+
+def test_server_rejects_unknown_field(server):
+    client = RpcClient(server.address)
+    assert client.call("locate_object", "abcd")[0]["oid"] == "abcd"
+    with pytest.raises(SchemaError, match="unknown field 'shiny'"):
+        client.call("locate_object", "abcd", shiny=True)
+    # error names the server's codec version (skew diagnosis)
+    try:
+        client.call("locate_object", "abcd", shiny=True)
+    except SchemaError as e:
+        assert f"codec {CODEC_VERSION}" in str(e)
+    client.close()
+
+
+def test_server_rejects_bad_type(server):
+    client = RpcClient(server.address)
+    with pytest.raises(SchemaError, match="expects str, got int"):
+        client.call("locate_object", 42)
+    client.close()
+
+
+def test_codec_version_exchanged(server):
+    client = RpcClient(server.address)
+    client.call("free_text", "hi")
+    assert client.peer_codec == CODEC_VERSION
+    client.close()
+
+
+def test_old_client_fails_closed(server):
+    """Version skew (old client, new server): the connection is
+    rejected at handshake with a clear both-versions error — no
+    request payload is ever deserialized. Simulated with a raw
+    previous-version HELLO (client and server share this process, so
+    monkeypatching the module global would downgrade both ends)."""
+    import pickle
+    import socket
+    import struct
+
+    from ray_tpu._private.config import GlobalConfig
+    host, port = server.address.split(":")
+    tok = GlobalConfig.cluster_token.encode()
+    with socket.create_connection((host, int(port)), timeout=10) as s:
+        s.sendall(struct.pack("<4sHH", b"RAYT",
+                              rpc.PROTO_VERSION - 1, len(tok)) + tok)
+        # old clients wait for a length-prefixed reply frame
+        n = struct.unpack("<I", _recv(s, 4))[0]
+        reply = pickle.loads(_recv(s, n))
+    err = reply["err"]
+    assert isinstance(err, RpcError)
+    assert "protocol version mismatch" in str(err)
+    assert f"server {rpc.PROTO_VERSION}" in str(err)
+
+
+def _recv(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("closed")
+        buf += chunk
+    return buf
+
+
+def test_unschema_d_methods_still_flow(server):
+    client = RpcClient(server.address)
+    assert client.call("free_text", {"arbitrary": ["payload"]}) == \
+        {"arbitrary": ["payload"]}
+    client.close()
