@@ -70,6 +70,10 @@ def _devices_or_die(timeout_s: float = 120.0):
 
 def main():
     import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # env alone doesn't always override the axon plugin (smoke
+        # runs); the config update must land before any device use
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
     import optax

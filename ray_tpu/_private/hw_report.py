@@ -14,8 +14,6 @@ import sys
 import time
 from typing import Any, Dict, Optional
 
-_last: Dict[str, Any] = {}
-
 
 def collect_hw_stats(store=None) -> Dict[str, Any]:
     """One snapshot of this node's hardware state; cheap enough to

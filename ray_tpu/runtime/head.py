@@ -484,13 +484,35 @@ class HeadService:
                     probe_targets = [
                         (n.node_id, n.object_client, n.object_addr)
                         for n in self._nodes.values() if n.alive]
-        for nid, client, addr in probe_targets:
-            try:
-                if client.call("has_object", oid_hex, timeout=2):
-                    self.register_objects(nid, [oid_hex])
-                    out.append({"node_id": nid, "object_addr": addr})
-            except RpcError:
-                pass
+        if probe_targets:
+            # Parallel probe sweep: serial per-node RPCs would make a
+            # directory miss cost O(nodes x timeout) — quadratic
+            # badness at 50 nodes (each node's miss loop probing all
+            # others). A SHARED bounded executor (not per-sweep thread
+            # spawns) caps concurrent probes cluster-wide; stragglers
+            # past the wait deadline finish in the pool instead of
+            # leaking fresh threads.
+            from concurrent.futures import ThreadPoolExecutor, wait
+            pool = getattr(self, "_probe_pool", None)
+            if pool is None:
+                pool = self._probe_pool = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="obj-probe")
+            found: List = []
+            flock = threading.Lock()
+
+            def _probe(nid, client, addr):
+                try:
+                    if client.call("has_object", oid_hex, timeout=2):
+                        with flock:
+                            found.append((nid, addr))
+                except RpcError:
+                    pass
+
+            futs = [pool.submit(_probe, *t) for t in probe_targets]
+            wait(futs, timeout=3)
+            for nid, addr in found:
+                self.register_objects(nid, [oid_hex])
+                out.append({"node_id": nid, "object_addr": addr})
         if not out and reconstruct:
             self._maybe_reconstruct(oid_hex)
         return out
@@ -1151,9 +1173,15 @@ class HeadService:
                         if env_key is not None:
                             failed = getattr(self, "_env_failures",
                                              {}).get(env_key)
-                            if failed is not None:
+                            if failed is not None and \
+                                    time.time() - failed[0] < 60:
                                 # surface the REAL setup error (pip
-                                # stderr), not a placement timeout
+                                # stderr), not a placement timeout;
+                                # stale entries (>60s) fall through to
+                                # a fresh spawn attempt like the task
+                                # path
+                                self._pending_actor_demands.pop(
+                                    actor_id, None)
                                 raise RuntimeError(
                                     f"runtime_env setup failed for "
                                     f"this actor's environment: "

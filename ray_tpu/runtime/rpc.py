@@ -257,10 +257,17 @@ class RpcClient:
         self._oneway_lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
-        sock = socket.create_connection((self.host, self.port),
-                                        timeout=self.timeout)
+        sock = socket.create_connection(
+            (self.host, self.port),
+            timeout=self.timeout or _HANDSHAKE_TIMEOUT_S)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Bound the ack read even for timeout=None clients: a wedged
+        # server whose backlog still accepts connects must not hang
+        # the handshake forever (call() re-applies the caller's
+        # timeout on the pooled socket afterwards).
+        sock.settimeout(_HANDSHAKE_TIMEOUT_S)
         self.peer_codec = _send_hello(sock)
+        sock.settimeout(self.timeout)
         return sock
 
     def _get_conn(self) -> socket.socket:
