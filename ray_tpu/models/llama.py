@@ -144,17 +144,23 @@ class LlamaAttention(nn.Module):
             L = pc.page_table.shape[1] * Pg
             kg = pk[pc.page_table].reshape(B, L, cfg.n_kv_heads, hd)
             vg = pv[pc.page_table].reshape(B, L, cfg.n_kv_heads, hd)
+            # Grouped-query attention WITHOUT materializing repeated
+            # K/V: q reshapes to [B, T, KH, rep, D] and contracts
+            # against the grouped cache directly — at rep=8 (1.1B) a
+            # repeat would move 8x the KV bytes per step, the decode
+            # hot loop's dominant traffic.
             rep = cfg.n_heads // cfg.n_kv_heads
-            kg = jnp.repeat(kg, rep, axis=2)
-            vg = jnp.repeat(vg, rep, axis=2)
+            qg = q.reshape(B, -1, cfg.n_kv_heads, rep, hd)
             scores = jnp.einsum(
-                "bthd,bshd->bhts", q.astype(jnp.float32),
+                "btkrd,bskd->bkrts", qg.astype(jnp.float32),
                 kg.astype(jnp.float32)) / np.sqrt(hd)
             valid = jnp.arange(L)[None] <= pos[:, None]    # [B, L]
-            scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+            scores = jnp.where(valid[:, None, None, None, :],
+                               scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
-            y = jnp.einsum("bhts,bshd->bthd",
+            y = jnp.einsum("bkrts,bskd->btkrd",
                            probs.astype(vg.dtype), vg)
+            y = y.reshape(B, -1, cfg.n_heads, hd)
         elif kv_cache is not None:
             # Decode path: append this step's K/V into the static cache.
             ck, cv = kv_cache
@@ -168,19 +174,21 @@ class LlamaAttention(nn.Module):
             # Mask out positions beyond cache_len + T.
             kv_pos = jnp.arange(S)
             valid = kv_pos < (cache_len + T)
+            # grouped-query contraction (no repeated-K/V copy; see
+            # the paged branch above)
             rep = cfg.n_heads // cfg.n_kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+            qg = q.reshape(B, T, cfg.n_kv_heads, rep, hd)
             scores = jnp.einsum(
-                "bthd,bshd->bhts", q.astype(jnp.float32),
+                "btkrd,bskd->bkrts", qg.astype(jnp.float32),
                 k.astype(jnp.float32)) / np.sqrt(hd)
             q_pos = cache_len + jnp.arange(T)
             causal = kv_pos[None, :] <= q_pos[:, None]
-            mask = (causal & valid[None, :])[None, None]
+            mask = (causal & valid[None, :])[None, None, None]
             scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
-            y = jnp.einsum("bhts,bshd->bthd",
+            y = jnp.einsum("bkrts,bskd->btkrd",
                            probs.astype(v.dtype), v)
+            y = y.reshape(B, T, cfg.n_heads, hd)
         else:
             rep = cfg.n_heads // cfg.n_kv_heads
             k = jnp.repeat(k, rep, axis=2)

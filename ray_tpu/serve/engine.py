@@ -145,12 +145,16 @@ class LLMEngine:
         self._rid = itertools.count()
         self._admit_seq = itertools.count()
         self._rng = jax.random.PRNGKey(seed)
+        self._pending = None      # in-flight chunk: (tokens_dev, riders)
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
         self.stats: Dict[str, int] = collections.Counter()
         self._prefill_cache: "collections.OrderedDict" = \
             collections.OrderedDict()
         self._max_prefill_compiles = max_prefill_compiles
+        # same-length waiting requests prefill together (one jitted
+        # call, bucketed batch) up to this width
+        self._max_prefill_batch = 4
         self._decode_fn = self._build_decode()
 
     # ---------------------------------------------------------- public
@@ -201,15 +205,25 @@ class LLMEngine:
             self._thread.join(timeout=30)
 
     def step(self) -> bool:
-        """One scheduler iteration: admit waiting requests, grow/
-        preempt, decode one chunk. Returns False when idle. Use
-        directly for deterministic tests; production uses start()."""
+        """One scheduler iteration, PIPELINED with the device:
+
+            process chunk k's tokens  ->  admit  ->  grow/preempt
+                                     ->  dispatch chunk k+1
+
+        Chunk k+1 is dispatched while chunk k's readback is consumed —
+        the device never waits on the host's ~70ms sync (decode feeds
+        its own next-token on-device; the host only needs tokens for
+        emission/completion, which tolerates one chunk of lag). This
+        is iteration-level scheduling with async output processing
+        (the vLLM multi-step idea, shaped for jax async dispatch).
+        Returns False when idle."""
         with self._lock:
+            self._process_pending_locked()
             self._admit_locked()
             if not any(self.slots):
-                return False
+                return self._pending is not None
             self._grow_or_preempt_locked()
-            self._decode_chunk_locked()
+            self._dispatch_chunk_locked()
             return True
 
     # ------------------------------------------------------- scheduler
@@ -218,7 +232,8 @@ class LLMEngine:
         while True:
             with self._work:
                 while (not self._stopped and not self._wait
-                       and not any(self.slots)):
+                       and not any(self.slots)
+                       and self._pending is None):
                     self._work.wait()
                 if self._stopped and not any(self.slots):
                     return
@@ -243,29 +258,50 @@ class LLMEngine:
 
     def _admit_locked(self):
         while self._wait:
-            free_ix = next((i for i, s in enumerate(self.slots)
-                            if s is None), None)
-            if free_ix is None:
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            if not free:
                 return
-            req = self._wait[0]
-            prompt = req.recompute_prompt
-            n0 = max(1, -(-len(prompt) // self.Pg))
-            page_ids = self.alloc.alloc(n0)
-            if page_ids is None:
-                return          # wait for completions to release pages
-            self._wait.popleft()
+            # Batched prefill: take the FIFO PREFIX of the wait queue
+            # sharing the head request's padded length (fixed-shape
+            # serving traffic batches fully; mixed lengths degrade to
+            # batch 1 — never reordering past a different-length
+            # request keeps admission fair).
+            head_pad = -(-max(1, len(self._wait[0].recompute_prompt))
+                         // self.Pg) * self.Pg
+            group = []
+            for req in self._wait:
+                if len(group) >= min(len(free), self._max_prefill_batch):
+                    break
+                prompt = req.recompute_prompt
+                pad = -(-max(1, len(prompt)) // self.Pg) * self.Pg
+                if pad != head_pad:
+                    break
+                n0 = max(1, -(-len(prompt) // self.Pg))
+                page_ids = self.alloc.alloc(n0)
+                if page_ids is None:
+                    break      # pool dry: wait for completions
+                group.append((req, prompt, page_ids))
+            if not group:
+                return
+            for _ in group:
+                self._wait.popleft()
             try:
-                first = self._prefill(prompt, page_ids)
+                firsts = self._prefill_batch(
+                    [(p, pids) for _, p, pids in group], head_pad)
             except BaseException as e:
-                self.alloc.free(page_ids)
-                req.error = e
-                req.out_q.put(_DONE)
+                for req, _p, pids in group:
+                    self.alloc.free(pids)
+                    req.error = e
+                    req.out_q.put(_DONE)
                 continue
-            slot = _Slot(req=req, pages=page_ids, pos=len(prompt),
-                         cur=first, admit_seq=next(self._admit_seq))
-            self.slots[free_ix] = slot
-            self.stats["admitted"] += 1
-            self._emit(free_ix, [first])
+            for (req, prompt, page_ids), first, ix in zip(
+                    group, firsts, free):
+                slot = _Slot(req=req, pages=page_ids,
+                             pos=len(prompt), cur=first,
+                             admit_seq=next(self._admit_seq))
+                self.slots[ix] = slot
+                self.stats["admitted"] += 1
+                self._emit(ix, [first])
 
     def _grow_or_preempt_locked(self):
         """Ensure every active slot's pages cover this chunk's writes;
@@ -302,29 +338,48 @@ class LLMEngine:
         self.stats["preemptions"] += 1
         self._wait.appendleft(slot.req)   # front: re-admit first
 
-    def _decode_chunk_locked(self):
+    def _dispatch_chunk_locked(self):
+        """Launch one K-step decode chunk asynchronously. The carry
+        (pages, per-slot cur token) lives on device; the host records
+        which slots rode the chunk and reads the tokens back NEXT
+        step, overlapped with the following chunk's compute."""
         pt = np.zeros((self.S, self.max_pages), np.int32)
         pos = np.zeros((self.S,), np.int32)
         cur = np.zeros((self.S,), np.int32)
+        riders = []
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
             pt[i, :len(slot.pages)] = slot.pages
             pos[i] = slot.pos
             cur[i] = slot.cur
-        self._rng, sub = jax.random.split(self._rng)
-        toks, self.pages = self._decode_fn(
+            riders.append((i, slot))
+        toks, self.pages, self._rng = self._decode_fn(
             self.params, self.pages, jnp.asarray(pt),
-            jnp.asarray(pos), jnp.asarray(cur), sub)
-        toks = np.asarray(toks)               # ONE sync per chunk
+            jnp.asarray(pos), jnp.asarray(cur), self._rng)
+        # pos advances NOW (host mirror of the device carry); cur and
+        # emission land at processing time
+        for _i, slot in riders:
+            slot.pos += self.K
+        self._pending = (toks, riders)
         self.stats["chunks"] += 1
         self.stats["decode_steps"] += self.K
-        for i, slot in enumerate(self.slots):
-            if slot is None:
-                continue
+
+    def _process_pending_locked(self):
+        """Consume the previous chunk's tokens (the only device->host
+        sync). Runs while the NEXT chunk computes."""
+        if self._pending is None:
+            return
+        toks_dev, riders = self._pending
+        self._pending = None
+        toks = np.asarray(toks_dev)           # overlapped readback
+        for i, slot in riders:
+            if self.slots[i] is not slot:
+                continue      # preempted after dispatch: recompute
+            # host mirror of cur for the NEXT dispatch (the device
+            # already carried it forward internally during the chunk)
+            slot.cur = int(toks[-1, i])
             accept = toks[:min(self.K, slot.req.remaining), i].tolist()
-            slot.pos += self.K
-            slot.cur = accept[-1] if accept else slot.cur
             self._emit(i, accept)
 
     def _emit(self, ix: int, tokens: List[int]):
@@ -349,48 +404,63 @@ class LLMEngine:
 
     # ----------------------------------------------------- jitted fns
 
-    def _prefill(self, prompt: List[int], page_ids: List[int]) -> int:
-        T0 = len(prompt)
-        T0pad = -(-T0 // self.Pg) * self.Pg
-        fn = self._prefill_cache.get(T0pad)
+    def _prefill_batch(self, items, T0pad: int) -> List[int]:
+        """Prefill up to _max_prefill_batch same-padded-length prompts
+        in ONE jitted call (bucketed batch: pad rows with dummies that
+        scatter into the null page). items: [(prompt, page_ids), ...]"""
+        n = len(items)
+        # FIXED batch width: one executable per prompt length (dummy
+        # rows scatter into the null page). Bucketed widths would
+        # compile B=1/2/4 variants lazily — measured as multi-second
+        # p99 stalls mid-load; a few dummy prefill rows are far
+        # cheaper than a retrace.
+        B = self._max_prefill_batch
+        n_pages = T0pad // self.Pg
+        fn = self._prefill_cache.get((T0pad, B))
         if fn is None:
-            fn = self._build_prefill(T0pad)
-            self._prefill_cache[T0pad] = fn
+            fn = self._build_prefill(T0pad, B)
+            self._prefill_cache[(T0pad, B)] = fn
             while len(self._prefill_cache) > self._max_prefill_compiles:
                 self._prefill_cache.popitem(last=False)
-        self._prefill_cache.move_to_end(T0pad)
-        ids = np.zeros((1, T0pad), np.int32)
-        ids[0, :T0] = prompt
-        pids = np.asarray(page_ids, np.int32)
-        self._rng, sub = jax.random.split(self._rng)
-        first, self.pages = fn(self.params, jnp.asarray(ids),
-                               jnp.int32(T0), self.pages,
-                               jnp.asarray(pids), sub)
+        self._prefill_cache.move_to_end((T0pad, B))
+        ids = np.zeros((B, T0pad), np.int32)
+        lens = np.ones((B,), np.int32)
+        pids = np.zeros((B, n_pages), np.int32)   # dummies -> null page
+        for r, (prompt, page_ids) in enumerate(items):
+            ids[r, :len(prompt)] = prompt
+            lens[r] = len(prompt)
+            pids[r, :len(page_ids)] = page_ids
+        firsts, self.pages, self._rng = fn(
+            self.params, jnp.asarray(ids), jnp.asarray(lens),
+            self.pages, jnp.asarray(pids), self._rng)
         self.stats["prefills"] += 1
-        return int(first)
+        self.stats["prefilled_seqs"] += n
+        return [int(t) for t in np.asarray(firsts)[:n]]
 
-    def _build_prefill(self, T0pad: int):
+    def _build_prefill(self, T0pad: int, B: int):
         model, cfg, Pg, temp = (self.model, self.cfg, self.Pg,
                                 self.temperature)
         n_prompt_pages = T0pad // Pg
         from ray_tpu.models.llama import _pick_token, init_kv_caches
 
-        def prefill(params, ids, true_len, pages, page_ids, rng):
-            caches = init_kv_caches(cfg, 1, T0pad)
+        def prefill(params, ids, true_lens, pages, page_ids, rng):
+            rng, sub = jax.random.split(rng)
+            caches = init_kv_caches(cfg, B, T0pad)
             logits, caches = model.apply(params, ids,
                                          kv_caches=caches, cache_len=0)
+            flat_ids = page_ids.reshape(-1)     # [B * n_prompt_pages]
             new_pages = []
             for (pk, pv), (ck, cv) in zip(pages, caches):
-                kp = ck[0].reshape(n_prompt_pages, Pg,
-                                   cfg.n_kv_heads, cfg.head_dim)
-                vp = cv[0].reshape(n_prompt_pages, Pg,
-                                   cfg.n_kv_heads, cfg.head_dim)
+                kp = ck.reshape(B * n_prompt_pages, Pg,
+                                cfg.n_kv_heads, cfg.head_dim)
+                vp = cv.reshape(B * n_prompt_pages, Pg,
+                                cfg.n_kv_heads, cfg.head_dim)
                 new_pages.append((
-                    pk.at[page_ids].set(kp.astype(pk.dtype)),
-                    pv.at[page_ids].set(vp.astype(pv.dtype))))
-            first = _pick_token(logits[0, true_len - 1][None], rng,
-                                temp)[0]
-            return first, new_pages
+                    pk.at[flat_ids].set(kp.astype(pk.dtype)),
+                    pv.at[flat_ids].set(vp.astype(pv.dtype))))
+            last = logits[jnp.arange(B), true_lens - 1]    # [B, V]
+            firsts = _pick_token(last, sub, temp)
+            return firsts, new_pages, rng
 
         return jax.jit(prefill, donate_argnums=(3,))
 
@@ -409,8 +479,11 @@ class LLMEngine:
                 nxt = _pick_token(logits[:, -1], sub, temp)
                 new_pages = [(c.pages_k, c.pages_v) for c in new_kv]
                 return (new_pages, pos + 1, nxt, key), nxt
-            (pages, _, _, _), toks = jax.lax.scan(
+            (pages, _, _, key), toks = jax.lax.scan(
                 body, (pages, pos, cur, rng), None, length=K)
-            return toks, pages        # toks: [K, S]
+            # the advanced key returns as device state: the host never
+            # runs jax.random.split between chunks (each split is a
+            # device dispatch — pure overhead on the decode hot loop)
+            return toks, pages, key        # toks: [K, S]
 
         return jax.jit(decode, donate_argnums=(1,))
