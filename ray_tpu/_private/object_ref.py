@@ -99,6 +99,17 @@ def _promote_if_local(oid: ObjectID) -> None:
         promote_everywhere(oid)
     except Exception:
         pass    # no runtime / local runtime: nothing to promote
+    import sys
+    if "ray_tpu.mesh.device_objects" in sys.modules:
+        # An escaping ref to an HBM-resident device object forces its
+        # one host spill (mesh/device_objects.py module doc). Guarded
+        # by sys.modules: a process that never registered a device
+        # object has nothing to spill and skips the jax import. Spill
+        # failures (device_get error, shm store full) propagate — the
+        # pickle fails HERE at the root cause, instead of shipping a
+        # ref whose payload will never exist and hanging the consumer.
+        from ray_tpu.mesh.device_objects import spill_on_escape
+        spill_on_escape(oid)
 
 
 _rc_lock = threading.Lock()

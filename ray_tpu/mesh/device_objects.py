@@ -1,0 +1,469 @@
+"""Device-array (HBM) object layer: jax Arrays referenced, never copied.
+
+The TPU-native replacement for the reference's plasma zero-copy contract
+(reference: src/ray/common/ray_object.h:28 — RayObject wraps buffers
+without copying; src/ray/object_manager/plasma/store.h:55 — clients map
+the store's memory directly). On TPU the analogous resource is HBM, and
+the analogous contract is: a `put()` of a `jax.Array` must not move the
+array. It stays on device, owned by the producing process, and the
+object layer hands out a small *handle* describing it:
+
+    (object id, global shape/dtype, mesh axes, partition spec,
+     per-device buffer refs)
+
+Lifecycle, designed around XLA's ownership model rather than plasma's:
+
+- **put**: the living Array is parked in this process's
+  `DeviceObjectTable`; only a ~300-byte descriptor enters the object
+  plane. No device→host transfer, no serialization of the payload.
+- **same-process get**: descriptor → table hit → the *identical* Array
+  object (buffer identity, asserted in tests/test_device_objects.py).
+- **escape** (the ref is pickled into a task arg / actor state /
+  another object): the owner spills one host copy into its shm store —
+  the same escape-analysis moment the byte-object layer uses for
+  memory-tier promotion (object_plane.py:promote). Until a ref
+  escapes, no host copy ever exists.
+- **cross-process get**: the consumer pulls the spilled host payload
+  through the ordinary object plane (same-node shm / cross-node
+  chunked pull) and re-materializes on its own devices with the
+  handle's sharding via `jax.device_put`. Repeated gets hit a bounded
+  resolved-borrow cache.
+- **SPMD gang sharing**: in a multi-controller gang every process
+  already holds its addressable shards of a global Array, so
+  `gang_put(arr, tag)` registers the local view under a
+  deterministic id on every rank and a get anywhere in the gang
+  resolves to the local living Array — zero data motion, the handle
+  is the only thing that ever crosses a process boundary.
+- **free**: when the owner's last local ref drops, the eager-GC drain
+  (object_plane._drain_releases) also drops the table entry (freeing
+  HBM) and any spilled payload.
+- **reshard**: `reshard(value, axes)` moves an Array between
+  shardings with `jax.device_put`, which XLA lowers to device-to-device
+  copies (ICI collective permute across chips) — the host is never in
+  the path.
+
+Module-import discipline: jax is imported only inside functions, and
+callers on paths that may run in jax-free processes guard with
+`'ray_tpu.mesh.device_objects' in sys.modules` — a process that never
+registered a device object never pays a jax import.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+# Return-index sentinel marking the spilled host payload of a device
+# object (real task-return indices are small ints; puts use 0).
+_PAYLOAD_INDEX = (0xDE50B1).to_bytes(4, "little")
+
+# Bounded cache of arrays this process materialized from OTHER owners'
+# payloads (borrows): repeated gets of a hot ref skip the pull +
+# device_put. Entries are dropped LRU beyond the budget; correctness
+# never depends on a hit.
+_BORROW_CACHE_BUDGET = 256 * 1024 * 1024
+
+
+def payload_oid(oid: ObjectID) -> ObjectID:
+    """The derived id under which a device object's host spill lives."""
+    return ObjectID(oid.binary()[:-4] + _PAYLOAD_INDEX)
+
+
+class DeviceArrayHandle:
+    """What travels instead of the array: metadata + buffer refs.
+
+    ``buffers`` is a tuple of (device_id, shard_index, nbytes) refs
+    describing where the living HBM buffers are — the object-layer
+    analogue of plasma's object header (ray_object.h:28), except the
+    payload it points at is device memory owned by XLA.
+    """
+
+    __slots__ = ("oid", "shape", "dtype", "mesh_axes", "pspec",
+                 "buffers", "device_kind", "fully_addressable",
+                 "owner_node")
+
+    def __init__(self, oid: bytes, shape: Tuple[int, ...], dtype: str,
+                 mesh_axes: Tuple[Tuple[str, int], ...],
+                 pspec: Tuple, buffers: Tuple[Tuple[int, int, int], ...],
+                 device_kind: str, fully_addressable: bool,
+                 owner_node: str):
+        self.oid = oid
+        self.shape = shape
+        self.dtype = dtype
+        self.mesh_axes = mesh_axes
+        self.pspec = pspec
+        self.buffers = buffers
+        self.device_kind = device_kind
+        self.fully_addressable = fully_addressable
+        self.owner_node = owner_node
+
+    def __reduce__(self):
+        return (DeviceArrayHandle,
+                (self.oid, self.shape, self.dtype, self.mesh_axes,
+                 self.pspec, self.buffers, self.device_kind,
+                 self.fully_addressable, self.owner_node))
+
+    def __repr__(self):
+        return (f"DeviceArrayHandle({ObjectID(self.oid).hex()[:12]}…, "
+                f"shape={self.shape}, dtype={self.dtype}, "
+                f"mesh={dict(self.mesh_axes)}, pspec={self.pspec}, "
+                f"{len(self.buffers)} buffers)")
+
+
+def _describe(arr) -> Tuple[Tuple[Tuple[str, int], ...], Tuple,
+                            Tuple[Tuple[int, int, int], ...], str, bool]:
+    """Extract (mesh_axes, pspec, buffer refs, device kind,
+    fully_addressable) from a living jax Array."""
+    sharding = arr.sharding
+    mesh_axes: Tuple[Tuple[str, int], ...] = ()
+    pspec: Tuple = ()
+    try:
+        mesh = sharding.mesh            # NamedSharding
+        mesh_axes = tuple((str(k), int(v)) for k, v in mesh.shape.items())
+        spec = sharding.spec
+        pspec = tuple(
+            tuple(p) if isinstance(p, (tuple, list)) else p for p in spec)
+    except AttributeError:
+        pass                            # SingleDeviceSharding et al.
+    buffers = []
+    itemsize = arr.dtype.itemsize
+    for i, sh in enumerate(arr.addressable_shards):
+        n = 1
+        for d in sh.data.shape:
+            n *= d
+        buffers.append((int(sh.device.id), i, n * itemsize))
+    kind = arr.devices().pop().platform if arr.devices() else "cpu"
+    return (mesh_axes, pspec, tuple(buffers), kind,
+            bool(arr.is_fully_addressable))
+
+
+class DeviceObjectTable:
+    """Per-process registry of living device Arrays keyed by ObjectID.
+
+    The owning side of the zero-copy contract: entries hold a strong
+    reference to the Array (pinning its HBM buffers) until the owner's
+    last ObjectRef drops or the entry is explicitly dropped.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[ObjectID, Any] = {}
+        self._planes: Dict[ObjectID, Any] = {}      # oid -> weakref(plane)
+        self._spilled: set = set()
+        # borrow cache: oid -> (array, nbytes)
+        self._borrows: "collections.OrderedDict[ObjectID, Tuple[Any, int]]" \
+            = collections.OrderedDict()
+        self._borrow_bytes = 0
+
+    # ---- owner side -------------------------------------------------------
+
+    def register(self, oid: ObjectID, arr, plane=None) -> None:
+        with self._lock:
+            self._entries[oid] = arr
+            if plane is not None:
+                self._planes[oid] = weakref.ref(plane)
+
+    def lookup(self, oid: ObjectID):
+        with self._lock:
+            arr = self._entries.get(oid)
+            if arr is not None:
+                return arr
+            hit = self._borrows.get(oid)
+            if hit is not None:
+                self._borrows.move_to_end(oid)
+                return hit[0]
+            return None
+
+    def is_registered(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._entries
+
+    def drop(self, oid: ObjectID) -> None:
+        """Release the HBM pin (owner free path)."""
+        with self._lock:
+            self._entries.pop(oid, None)
+            self._planes.pop(oid, None)
+            self._spilled.discard(oid)
+            hit = self._borrows.pop(oid, None)
+            if hit is not None:
+                self._borrow_bytes -= hit[1]
+
+    def spill(self, oid: ObjectID) -> bool:
+        """Write one host copy of the array into the owner plane's shm
+        store under payload_oid (the escape moment — see module doc).
+        Idempotent. Returns False for arrays whose shards this process
+        cannot address (multi-host gang arrays resolve via gang
+        registration on every rank instead — there is nothing a single
+        process could spill that would reconstruct the global array).
+        """
+        with self._lock:
+            if oid in self._spilled:
+                return True
+            arr = self._entries.get(oid)
+            plane_ref = self._planes.get(oid)
+        if arr is None or plane_ref is None:
+            return False
+        plane = plane_ref()
+        if plane is None:
+            import logging
+            logging.getLogger(__name__).warning(
+                "device object %s: owning plane is gone; escape spill "
+                "skipped (consumers will not resolve this ref)",
+                oid.hex()[:12])
+            return False
+        if not arr.is_fully_addressable:
+            return False
+        import jax
+        host = jax.device_get(arr)       # the ONE device->host copy
+        plane.put_obj(payload_oid(oid), ("ok", host), owned=False)
+        with self._lock:
+            self._spilled.add(oid)
+        return True
+
+    def was_spilled(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._spilled
+
+    # ---- borrow side ------------------------------------------------------
+
+    def cache_borrow(self, oid: ObjectID, arr, nbytes: int) -> None:
+        with self._lock:
+            old = self._borrows.pop(oid, None)
+            if old is not None:
+                self._borrow_bytes -= old[1]
+            self._borrows[oid] = (arr, nbytes)
+            self._borrow_bytes += nbytes
+            while self._borrow_bytes > _BORROW_CACHE_BUDGET \
+                    and len(self._borrows) > 1:
+                _, (_, n) = self._borrows.popitem(last=False)
+                self._borrow_bytes -= n
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            import sys  # noqa: F401  (cheap; stats is a debug path)
+            owned_bytes = 0
+            for arr in self._entries.values():
+                try:
+                    owned_bytes += arr.nbytes
+                except Exception:
+                    pass
+            return {"owned": len(self._entries),
+                    "owned_bytes": owned_bytes,
+                    "spilled": len(self._spilled),
+                    "borrows": len(self._borrows),
+                    "borrow_bytes": self._borrow_bytes}
+
+
+_TABLE = DeviceObjectTable()
+
+
+def table() -> DeviceObjectTable:
+    return _TABLE
+
+
+# --------------------------------------------------------------------------
+# put / resolve / free hooks (called from the runtime layer)
+# --------------------------------------------------------------------------
+
+def is_device_array(value) -> bool:
+    import sys
+    if "jax" not in sys.modules:
+        return False
+    import jax
+    return isinstance(value, jax.Array)
+
+
+def maybe_put_device(plane, oid: ObjectID, value,
+                     node_id: str = "head") -> bool:
+    """put() interception: if `value` is a jax Array, park it in the
+    table and store only a descriptor. Returns True if intercepted."""
+    if not is_device_array(value):
+        return False
+    mesh_axes, pspec, buffers, kind, full = _describe(value)
+    handle = DeviceArrayHandle(
+        oid.binary(), tuple(int(s) for s in value.shape),
+        str(value.dtype), mesh_axes, pspec, buffers, kind, full, node_id)
+    _TABLE.register(oid, value, plane)
+    plane.put_obj(oid, ("devobj", handle), owned=True)
+    return True
+
+
+def resolve_handle(handle: DeviceArrayHandle, plane,
+                   timeout_ms: int = -1):
+    """Turn a descriptor back into a living Array (see module doc for
+    the three paths: table hit / gang-local / payload pull)."""
+    oid = ObjectID(handle.oid)
+    arr = _TABLE.lookup(oid)
+    if arr is not None:
+        return arr
+    # Borrow path: pull the spilled host payload through the plane.
+    # The payload is written synchronously before the descriptor can
+    # escape, so an unbounded caller still gets a diagnosis instead of
+    # a hang: cap the blocking wait and explain the likely cause.
+    from ray_tpu._private.serialization import loads
+    from ray_tpu._private.shm_store import ShmTimeout
+    cap_ms = 30_000 if timeout_ms < 0 else timeout_ms
+    try:
+        data = plane.get_bytes(payload_oid(oid), timeout_ms=cap_ms)
+    except ShmTimeout:
+        if timeout_ms >= 0:
+            # The caller's own deadline expired mid-pull: report it as
+            # the timeout it is, not as a missing object.
+            from ray_tpu.exceptions import GetTimeoutError
+            raise GetTimeoutError(
+                f"Get timed out pulling the host payload of device "
+                f"object {oid.hex()[:12]}…") from None
+        raise LookupError(
+            f"device object {oid.hex()[:12]}… is not resolvable here "
+            f"(no payload after {cap_ms / 1000:.0f}s): no local "
+            f"registration and no host payload. Multi-host gang "
+            f"arrays (fully_addressable={handle.fully_addressable}) "
+            f"resolve only on gang ranks; other device objects spill "
+            f"at ref escape.") from None
+    status, host = loads(data)
+    if status != "ok":      # pragma: no cover - spill never stores errs
+        raise host
+    arr = _device_put_like(host, handle)
+    _TABLE.cache_borrow(oid, arr, int(getattr(host, "nbytes", 0)))
+    return arr
+
+
+def _device_put_like(host, handle: DeviceArrayHandle):
+    """Re-materialize a host payload on this process's devices,
+    reproducing the handle's sharding when a matching mesh fits."""
+    import jax
+    if handle.mesh_axes:
+        sizes = dict(handle.mesh_axes)
+        need = 1
+        for s in sizes.values():
+            need *= s
+        if need <= len(jax.devices()) and need > 1:
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            try:
+                try:
+                    # Canonical axis names ride the ICI-aware builder.
+                    from ray_tpu.mesh.device_mesh import create_mesh
+                    mesh = create_mesh(sizes)
+                except ValueError:
+                    # Arbitrary user axis names: plain mesh, same shape.
+                    devs = np.asarray(
+                        jax.devices()[:need]).reshape(
+                        tuple(sizes.values()))
+                    mesh = Mesh(devs, tuple(sizes.keys()))
+                spec = PartitionSpec(*handle.pspec)
+                return jax.device_put(host, NamedSharding(mesh, spec))
+            except Exception:
+                pass     # device topology differs: replicate below
+    return jax.device_put(host)
+
+
+def spill_on_escape(oid: ObjectID) -> None:
+    """Hook from ObjectRef pickling (object_ref._promote_if_local):
+    an escaping ref to a device object forces the host spill so any
+    other process can resolve it."""
+    if _TABLE.is_registered(oid):
+        _TABLE.spill(oid)
+
+
+def on_ref_released(oid: ObjectID, plane, escaped: bool = False) -> None:
+    """Hook from the eager-GC drain: the owner's last local ref
+    dropped. Always frees the HBM pin. The spilled host payload is
+    deleted only when the ref never escaped (external holders may
+    still resolve an escaped ref from the payload; until the borrower
+    protocol reclaims it, the shm LRU bounds it — same policy as
+    escaped byte objects)."""
+    if not _TABLE.is_registered(oid):
+        _TABLE.drop(oid)     # clears any borrow-cache entry
+        return
+    spilled = _TABLE.was_spilled(oid)
+    _TABLE.drop(oid)
+    if spilled and not escaped:
+        poid = payload_oid(oid)
+        try:
+            plane.store.delete(poid)
+        except Exception:
+            pass
+        if getattr(plane, "multinode", False):
+            with plane._reg_lock:
+                plane._pending_free.append(poid.hex())
+
+
+# --------------------------------------------------------------------------
+# SPMD gang sharing
+# --------------------------------------------------------------------------
+
+def gang_oid(tag: str) -> ObjectID:
+    return ObjectID(
+        hashlib.sha256(b"raytpu-gangobj:" + tag.encode()).digest()[:24])
+
+
+def gang_put(arr, tag: str):
+    """Collective put of a (possibly multi-host) global Array.
+
+    Every gang rank calls this with its view of the same global Array;
+    each registers the living Array locally under the deterministic id
+    for `tag`, and rank 0 publishes the descriptor. A get anywhere in
+    the gang resolves to the local living Array — the data never moves
+    (on hardware, shards stay pinned in each host's HBM; only the
+    handle crosses DCN).
+    """
+    import jax
+    from ray_tpu._private.object_ref import ObjectRef
+    from ray_tpu._private.worker import global_worker
+    oid = gang_oid(tag)
+    rt = global_worker().runtime
+    plane = getattr(rt, "plane", None)
+    if plane is None:           # worker facade nests the executor
+        ex = getattr(rt, "_ex", None)
+        plane = getattr(ex, "plane", None)
+    if plane is None:
+        # Local runtime: the in-process store already holds living
+        # objects; register + store directly.
+        _TABLE.register(oid, arr)
+        rt.store.put(oid, arr)
+        return ObjectRef(oid)
+    _TABLE.register(oid, arr, plane)
+    if jax.process_index() == 0:
+        mesh_axes, pspec, buffers, kind, full = _describe(arr)
+        handle = DeviceArrayHandle(
+            oid.binary(), tuple(int(s) for s in arr.shape),
+            str(arr.dtype), mesh_axes, pspec, buffers, kind, full,
+            getattr(plane, "node_id", "head"))
+        plane.put_obj(oid, ("devobj", handle), owned=False)
+    return ObjectRef(oid)
+
+
+def gang_drop(tag: str) -> None:
+    """Release this rank's pin on a gang object."""
+    _TABLE.drop(gang_oid(tag))
+
+
+# --------------------------------------------------------------------------
+# device-to-device resharding
+# --------------------------------------------------------------------------
+
+def reshard(value, axes: Optional[Dict[str, int]] = None, spec=None,
+            mesh=None):
+    """Move an Array between shardings without touching the host.
+
+    `jax.device_put` with a NamedSharding target lowers to
+    device-to-device copies — across chips this is an ICI collective
+    permute; the host never sees the payload (contrast: the
+    reference's GPU object transfer stages through plasma host
+    memory, object_manager.h:114).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    if mesh is None:
+        from ray_tpu.mesh.device_mesh import create_mesh
+        mesh = create_mesh(axes or {})
+    if spec is None:
+        spec = PartitionSpec()
+    elif not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    return jax.device_put(value, NamedSharding(mesh, spec))

@@ -30,6 +30,16 @@ from ray_tpu.runtime.rpc import RpcClient, RpcError
 # Shared object helpers
 # --------------------------------------------------------------------------
 
+def _maybe_put_device(plane, oid: ObjectID, value, node_id: str) -> bool:
+    """Device-array put interception (zero-copy HBM object layer).
+    Guarded so jax-free processes never import jax."""
+    import sys
+    if "jax" not in sys.modules:
+        return False
+    from ray_tpu.mesh.device_objects import maybe_put_device
+    return maybe_put_device(plane, oid, value, node_id)
+
+
 def _read_one(store, oid: ObjectID, timeout_ms: int):
     from ray_tpu._private.shm_store import ShmTimeout
     try:
@@ -39,6 +49,12 @@ def _read_one(store, oid: ObjectID, timeout_ms: int):
             f"Get timed out waiting for {oid.hex()[:16]}…") from None
     if status == "err":
         raise value
+    if status == "devobj":
+        # Descriptor of an HBM-resident device object: resolve to the
+        # living Array (same-process: buffer identity; cross-process:
+        # spilled-payload pull + device_put).
+        from ray_tpu.mesh.device_objects import resolve_handle
+        return resolve_handle(value, store, timeout_ms)
     return value
 
 
@@ -592,6 +608,10 @@ class DistributedRuntime:
     # objects
     def put(self, value):
         oid = ObjectID.from_random()
+        if _maybe_put_device(self.plane, oid, value, "head"):
+            # jax Arrays stay in HBM, referenced by a handle — the
+            # plane stores only a descriptor (mesh/device_objects.py).
+            return ObjectRef(oid)
         # owned: small puts live in the process memory tier until
         # their ref escapes (promotion on ref pickling); owned objects
         # are eagerly freed when their last local ref drops

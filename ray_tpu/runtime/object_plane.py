@@ -61,8 +61,10 @@ class _MemoryTier:
     def put(self, oid: ObjectID, data: bytes):
         evicted = []
         with self._lock:
+            old = self._d.pop(oid, None)
+            if old is not None:
+                self._bytes -= len(old)
             self._d[oid] = data
-            self._d.move_to_end(oid)
             self._bytes += len(data)
             while self._bytes > self.budget and len(self._d) > 1:
                 k, v = self._d.popitem(last=False)
@@ -302,11 +304,14 @@ class ObjectPlane:
                 if oid not in self._owned:
                     continue
                 self._owned.discard(oid)
-                if oid in self._escaped:
-                    # external holders may exist: keep the object,
-                    # drop the (now-dead) bookkeeping
+                escaped = oid in self._escaped
+                if escaped:
                     self._escaped.discard(oid)
-                    continue
+            self._device_released(oid, escaped)
+            if escaped:
+                # external holders may exist: keep the object,
+                # drop the (now-dead) bookkeeping
+                continue
             was_inline = self.memory.pop(oid) is not None
             try:
                 self.store.delete(oid)
@@ -319,6 +324,19 @@ class ObjectPlane:
                 # objects never left this process: no broadcast.
                 with self._reg_lock:
                     self._pending_free.append(oid.hex())
+
+    def _device_released(self, oid: ObjectID, escaped: bool) -> None:
+        """Free the HBM pin of a released device object (and, for
+        never-escaped ones, any manually-spilled host payload). Guarded
+        by sys.modules so jax-free processes skip the import."""
+        import sys
+        if "ray_tpu.mesh.device_objects" not in sys.modules:
+            return
+        try:
+            from ray_tpu.mesh.device_objects import on_ref_released
+            on_ref_released(oid, self, escaped=escaped)
+        except Exception:
+            pass
 
     def _promote_blob(self, oid: ObjectID, data: bytes) -> None:
         try:

@@ -108,6 +108,11 @@ class Executor:
         status, value = loads(self.plane.get_bytes(oid, timeout_ms=-1))
         if status == "err":
             raise value
+        if status == "devobj":
+            # HBM-resident device object: resolve the descriptor to a
+            # living Array (mesh/device_objects.py).
+            from ray_tpu.mesh.device_objects import resolve_handle
+            return resolve_handle(value, self.plane)
         return value
 
 
@@ -610,7 +615,11 @@ class WorkerRuntime:
     # Shared implementation with the driver client.
     def put(self, value):
         from ray_tpu._private.object_ref import ObjectRef
+        from ray_tpu.runtime.client import _maybe_put_device
         oid = ObjectID.from_random()
+        if _maybe_put_device(self._ex.plane, oid, value,
+                             self._ex.plane.node_id):
+            return ObjectRef(oid)
         self._ex.plane.put_obj(oid, ("ok", value), owned=True)
         return ObjectRef(oid)
 
