@@ -42,6 +42,26 @@ define_flag("max_direct_call_object_size", int, 100 * 1024,
             "caller's in-process store instead of the shared-memory store.")
 define_flag("task_retry_delay_ms", int, 0,
             "Delay before the owner resubmits a failed task.")
+define_flag("bulk_pull_threshold_bytes", int, 64 * 1024 * 1024,
+            "Cross-node pulls at or above this size go through head "
+            "pull-slot admission (reference: push_manager.h in-flight "
+            "caps); smaller pulls run unthrottled.")
+define_flag("bulk_pull_slots_per_source", int, 2,
+            "Concurrent bulk pulls one replica serves before new "
+            "pullers are told to back off.")
+define_flag("transfer_prewarm_mb", int, 128,
+            "Scratch bytes each node's transfer daemon moves through "
+            "its own socket+arena path at startup (background): the "
+            "first bulk receive of a cold process runs ~13x slower "
+            "than steady state on shared hosts. Capped at 1/8 of the "
+            "store; <16MB disables.")
+define_flag("bulk_pull_global_slots", int, 2,
+            "Cluster-wide cap on concurrent bulk pulls. On shared/"
+            "virtualized hosts concurrent bulk memory traffic "
+            "degrades superlinearly (measured 0.8s solo vs 28s x4 for "
+            "a 1 GiB copy), so transfers are serialized near the "
+            "host's effective bandwidth; raise on real multi-host "
+            "clusters where each node has its own memory bus.")
 define_flag("default_max_retries", int, 3,
             "Default max_retries for normal tasks.")
 define_flag("actor_restart_backoff_ms", int, 0,

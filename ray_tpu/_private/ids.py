@@ -55,7 +55,7 @@ _unique = _UniqueBytes()
 
 class BaseID:
     SIZE = _UNIQUE_LEN
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
 
     def __init__(self, binary: bytes):
         if len(binary) != self.SIZE:
@@ -63,6 +63,7 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, "
                 f"got {len(binary)}")
         self._bytes = bytes(binary)
+        self._hash = None
 
     @classmethod
     def from_random(cls) -> "BaseID":
@@ -86,7 +87,12 @@ class BaseID:
         return self._bytes.hex()
 
     def __hash__(self):
-        return hash((type(self).__name__, self._bytes))
+        # IDs key every hot-path dict (store entries, refcounts,
+        # locations); cache the hash on first use.
+        h = self._hash
+        if h is None:
+            h = self._hash = hash((type(self).__name__, self._bytes))
+        return h
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes

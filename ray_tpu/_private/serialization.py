@@ -129,14 +129,21 @@ def _intern_blob(value: Any) -> bytes:
     return blob
 
 
-def loads(data: bytes) -> Any:
-    if len(data) < 64 and data in _INTERNED:   # tiny constants only
-        return _INTERNED[data]
-    nparts = int.from_bytes(data[:4], "little")
-    sizes = np.frombuffer(data[4:4 + 8 * nparts], dtype=np.int64)
+def loads(data) -> Any:
+    """Deserialize a flat blob. Accepts bytes OR a memoryview — a
+    pinned shm view deserializes ZERO-COPY: the out-of-band numpy
+    buffers alias the mapping and keep the store pin alive through
+    the buffer chain (see shm_store._PinnedExporter)."""
+    if isinstance(data, bytes):
+        if len(data) < 64 and data in _INTERNED:  # tiny constants only
+            return _INTERNED[data]
+        mv = memoryview(data)
+    else:
+        mv = data if isinstance(data, memoryview) else memoryview(data)
+    nparts = int.from_bytes(mv[:4], "little")
+    sizes = np.frombuffer(mv[4:4 + 8 * nparts], dtype=np.int64)
     off = 4 + 8 * nparts
     parts: List[memoryview] = []
-    mv = memoryview(data)
     for s in sizes:
         parts.append(mv[off:off + int(s)])
         off += int(s)

@@ -65,6 +65,11 @@ class RemoteFunction:
     def __init__(self, func, options: Dict[str, Any]):
         self._func = func
         self._options = validate_task_options(options)
+        # Submission-invariant fields, resolved once: .remote() is the
+        # framework's hottest call site.
+        self._name = self._options["name"] or getattr(
+            func, "__qualname__", "anonymous")
+        self._resources = resources_from_options(self._options)
         functools.update_wrapper(self, func)
 
     def options(self, **overrides) -> "RemoteFunction":
@@ -91,23 +96,29 @@ class RemoteFunction:
         max_retries = opts["max_retries"]
         if max_retries is None:
             max_retries = GlobalConfig.default_max_retries
+        if opts is self._options:
+            name, resources = self._name, self._resources
+        else:   # .options(...) overrides: resolve per call
+            name = opts["name"] or getattr(self._func, "__qualname__",
+                                           "anonymous")
+            resources = resources_from_options(opts)
+        from ray_tpu.util import tracing
         spec = TaskSpec(
             task_id=task_id,
             job_id=rt.job_id,
-            name=opts["name"] or getattr(self._func, "__qualname__",
-                                         "anonymous"),
+            name=name,
             func=self._func,
             args=tuple(args),
             kwargs=dict(kwargs),
             num_returns=n,
             return_ids=return_ids,
-            resources=resources_from_options(opts),
+            resources=resources,
             max_retries=max_retries,
             retry_exceptions=opts["retry_exceptions"],
             scheduling_strategy=opts["scheduling_strategy"],
             runtime_env=opts["runtime_env"],
-            trace_ctx=_maybe_trace(spec_name=opts["name"] or getattr(
-                self._func, "__qualname__", "anonymous"), kind="task"),
+            trace_ctx=(None if not tracing._enabled else
+                       _maybe_trace(spec_name=name, kind="task")),
         )
         refs = rt.submit_task(spec)
         if num_returns == 1:

@@ -56,6 +56,17 @@ class _WorkerInfo:
         self.sender: Optional[threading.Thread] = None
 
 
+class _OrderedSet(dict):
+    """Insertion-ordered set (dict keys): supports add/discard plus
+    iteration/len/in, preserving first-registration order."""
+
+    def add(self, k):
+        self[k] = True
+
+    def discard(self, k):
+        self.pop(k, None)
+
+
 class _NodeInfo:
     def __init__(self, node_id: str, object_addr: str, store_name: str):
         self.node_id = node_id
@@ -131,7 +142,12 @@ class HeadService:
         self._nodes: Dict[str, _NodeInfo] = {}
         # object directory: oid hex -> set of node ids holding a copy
         # (owner-based directory parity, ownership_based_object_directory.cc)
-        self._obj_locs: Dict[str, set] = {}
+        # Insertion-ordered per-object location "set": the FIRST
+        # entry is the original producer. Transfer admission prefers
+        # earlier sources — a continuously-serving producer stays warm
+        # while rarely-used replicas pay cold-path penalties on
+        # shared hosts.
+        self._obj_locs: Dict[str, _OrderedSet] = {}
         # lineage: return oid hex -> creating task (meta+payload), LRU
         # bounded by bytes (reference max_lineage_bytes semantics,
         # core_worker/task_manager.h:251).
@@ -408,7 +424,8 @@ class HeadService:
     def register_objects(self, node_id: str, oid_hexes: List[str]):
         with self._lock:
             for oid_hex in oid_hexes:
-                self._obj_locs.setdefault(oid_hex, set()).add(node_id)
+                self._obj_locs.setdefault(oid_hex,
+                                           _OrderedSet()).add(node_id)
 
     def locate_objects(self, oid_hexes: List[str]
                        ) -> Dict[str, List[Dict[str, str]]]:
@@ -427,6 +444,94 @@ class HeadService:
                          "object_addr": self._nodes[nid].object_addr}
                         for nid in node_ids]
         return out
+
+    _PULL_SLOT_TTL_S = 120.0        # reclaim slots of dead pullers
+
+    def begin_pull(self, oid_hex: str, node_id: str,
+                   probe: bool = False, reconstruct: bool = False):
+        """Admission-controlled source selection for a BULK pull
+        (callers gate on bulk_pull_threshold_bytes).
+
+        Two caps (reference: push_manager.h:29 in-flight transfer
+        caps, driven from the directory side):
+        - per source: each replica serves at most
+          bulk_pull_slots_per_source concurrent pullers, so an N-node
+          broadcast disseminates along a doubling tree (owner→A;
+          owner→B, A→C; …) instead of N pullers thrashing the owner;
+        - global: at most bulk_pull_global_slots bulk transfers run
+          cluster-wide — on shared/virtualized hosts concurrent bulk
+          memory traffic degrades superlinearly, so near-serial
+          transfer IS the fast path there.
+
+        Returns a location, {"busy": True} when budgets are exhausted
+        (caller backs off hard), or None when no copy exists."""
+        from ray_tpu._private.config import GlobalConfig
+        locs = self.locate_object(oid_hex, probe=probe,
+                                  reconstruct=reconstruct)
+        if not locs:
+            return None
+        per_source = GlobalConfig.bulk_pull_slots_per_source
+        global_cap = GlobalConfig.bulk_pull_global_slots
+        now = time.time()
+        with self._lock:
+            pulls = getattr(self, "_pulls", None)
+            if pulls is None:
+                pulls = self._pulls = {}
+            # Reclaim reservations whose puller died/hung.
+            total_inflight = 0
+            for key in list(pulls):
+                slots = pulls[key]
+                for src in list(slots):
+                    slots[src] = [t for t in slots[src]
+                                  if t > now - self._PULL_SLOT_TTL_S]
+                    if not slots[src]:
+                        del slots[src]
+                    else:
+                        total_inflight += len(slots[src])
+                if not slots:
+                    del pulls[key]
+            slots = pulls.setdefault(oid_hex, {})
+            best = None
+            any_peer = False
+            # First-fit in registration order: the first location is
+            # the original producer — keeping it the preferred source
+            # concentrates serving in one warm process (replicas only
+            # absorb spillover once the producer's slots fill).
+            for loc in locs:
+                if loc["node_id"] == node_id:
+                    continue
+                any_peer = True
+                if total_inflight >= global_cap:
+                    continue
+                if len(slots.get(loc["node_id"], ())) < per_source:
+                    best = loc
+                    break
+            if best is None:
+                # Distinguish "replicas exist but are saturated" from
+                # "no copy anywhere": a busy caller must back off HARD
+                # (on a contended host the waiters' polling otherwise
+                # steals the CPU the transfer needs), while a
+                # no-location caller keeps its fast retry (the object
+                # is probably about to be registered by its producer).
+                return {"busy": True} if any_peer else None
+            slots.setdefault(best["node_id"], []).append(now)
+        return best
+
+    def end_pull(self, oid_hex: str, node_id: str, source_node: str):
+        with self._lock:
+            pulls = getattr(self, "_pulls", None)
+            if not pulls:
+                return
+            slots = pulls.get(oid_hex)
+            if not slots:
+                return
+            ts = slots.get(source_node)
+            if ts:
+                ts.pop()
+                if not ts:
+                    del slots[source_node]
+            if not slots:
+                del pulls[oid_hex]
 
     def unregister_object(self, oid_hex: str, node_id: str):
         with self._lock:
@@ -1119,7 +1224,7 @@ class HeadService:
                 if len(self._nodes) > 1:
                     for rid in meta.get("return_ids", ()):
                         self._obj_locs.setdefault(
-                            rid.hex(), set()).add(w.node_id)
+                            rid.hex(), _OrderedSet()).add(w.node_id)
                     self._record_lineage_locked(meta)
             self._sched_cv.notify_all()
 

@@ -130,12 +130,23 @@ class NodeManager:
         self.node_server = RpcServer(_NodeService(self))
         self.head_client.call("attach_node_service",
                               self.node_server.address)
-        # This node's object-plane endpoint + membership entry.
-        from ray_tpu.runtime.object_plane import ObjectService
-        self.object_server = RpcServer(ObjectService(self.store))
+        # This node's object-plane endpoint + membership entry. The
+        # service owns the node's TRANSFER plane: workers delegate
+        # bulk fetches to it (ObjectService.fetch_object).
+        from ray_tpu.runtime.object_plane import (ObjectPlane,
+                                                  ObjectService,
+                                                  prewarm_transfer_path)
+        self._service_plane = ObjectPlane(
+            self.store, RpcClient(self._head_address), node_id="head",
+            is_node_service=True)
+        self.object_service = ObjectService(self.store,
+                                            plane=self._service_plane)
+        self.object_server = RpcServer(self.object_service)
         self.head_client.call("register_node", "head",
                               self.object_server.address,
                               self.store_name)
+        self._service_plane.refresh_multinode()
+        prewarm_transfer_path(self.store, self.object_server.address)
         self.procs: Dict[str, subprocess.Popen] = {}
         self.tpu_owner_worker = tpu_owner_worker
         self._stopped = False

@@ -50,10 +50,18 @@ class NodeAgent:
                                            store_capacity)
         from ray_tpu._private.shm_metrics import ShmMetricsRegistry
         self.metrics = ShmMetricsRegistry.create(self.store_name + "_m")
-        from ray_tpu.runtime.object_plane import ObjectService
-        self.object_server = RpcServer(ObjectService(self.store))
+        from ray_tpu.runtime.object_plane import (ObjectPlane,
+                                                  ObjectService,
+                                                  prewarm_transfer_path)
+        self._service_plane = ObjectPlane(
+            self.store, RpcClient(head_address, timeout=30),
+            node_id=self.node_id, is_node_service=True)
+        self.object_server = RpcServer(
+            ObjectService(self.store, plane=self._service_plane))
         self.head.call("register_node", self.node_id,
                        self.object_server.address, self.store_name)
+        self._service_plane.multinode = True
+        prewarm_transfer_path(self.store, self.object_server.address)
         self.procs: Dict[str, object] = {}
         self._stopped = threading.Event()
         # Owner-driven eager GC: the head broadcasts freed object ids

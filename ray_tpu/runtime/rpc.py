@@ -139,6 +139,16 @@ class RpcServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Pre-size the send buffer (bulk chunk replies): TCP
+            # buffer autotuning starts small and warms up slowly under
+            # the lock-step request/reply pattern — a FRESH connection
+            # pair otherwise serves its first bulk pull ~13x slower
+            # than a warmed one (measured 0.15 vs 2.0 GB/s).
+            try:
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                                4 * 1024 * 1024)
+            except OSError:
+                pass
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
@@ -257,9 +267,22 @@ class RpcClient:
         self._oneway_lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
-        sock = socket.create_connection(
-            (self.host, self.port),
-            timeout=self.timeout or _HANDSHAKE_TIMEOUT_S)
+        # Pre-size the receive buffer BEFORE connect: the TCP window
+        # scale factor is fixed at SYN time from rcvbuf, and buffer
+        # autotuning warms up too slowly under the lock-step
+        # request/reply pattern (see RpcServer._accept_loop).
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                            4 * 1024 * 1024)
+        except OSError:
+            pass
+        sock.settimeout(self.timeout or _HANDSHAKE_TIMEOUT_S)
+        try:
+            sock.connect((self.host, self.port))
+        except BaseException:
+            sock.close()
+            raise
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # Bound the ack read even for timeout=None clients: a wedged
         # server whose backlog still accepts connects must not hang

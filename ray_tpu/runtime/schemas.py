@@ -64,11 +64,19 @@ SCHEMAS: Dict[str, Tuple[Param, ...]] = {
                       P("probe", bool, required=False),
                       P("reconstruct", bool, required=False)),
     "locate_objects": (P("oid_hexes", list),),
+    "begin_pull": (P("oid_hex", str), P("node_id", str),
+                   P("probe", bool, required=False),
+                   P("reconstruct", bool, required=False)),
+    "end_pull": (P("oid_hex", str), P("node_id", str),
+                 P("source_node", str)),
     "unregister_object": (P("oid_hex", str), P("node_id", str)),
     "object_size": (P("oid_hex", str),),
     "has_object": (P("oid_hex", str),),
     "pull_chunk": (P("oid_hex", str), P("offset", int),
                    P("length", int)),
+    "fetch_object": (P("oid_hex", str),
+                     P("reconstruct", bool, required=False)),
+    "push_object": (P("oid_hex", str), P("data", _BYTES)),
     "raw_pull_chunk": (P("oid_hex", str), P("offset", int),
                        P("length", int)),
     # membership

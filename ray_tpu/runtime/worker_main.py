@@ -89,6 +89,7 @@ class Executor:
         # dispatch: push_task replies at enqueue; the head releases
         # resources when tasks_done arrives).
         self._done: List[str] = []
+        self._push_clients: Dict[str, Any] = {}   # owner-direct returns
         self._done_lock = threading.Lock()
         self._done_wake = threading.Event()
         self._notifier = threading.Thread(
@@ -105,7 +106,7 @@ class Executor:
         return value
 
     def _read_object(self, oid: ObjectID):
-        status, value = loads(self.plane.get_bytes(oid, timeout_ms=-1))
+        status, value = loads(self.plane.get_blob(oid, timeout_ms=-1))
         if status == "err":
             raise value
         if status == "devobj":
@@ -116,10 +117,31 @@ class Executor:
         return value
 
 
+    # Serialized returns at or below this size are PUSHED straight to
+    # the caller's node store instead of waiting to be pulled — the
+    # owner-direct return path (small cross-node results go from 4-6
+    # control RPCs + poll latency to one one-way push).
+    PUSH_RETURN_MAX = 256 * 1024
+
+    def _push_return(self, oid: ObjectID, blob, ret_addr: str) -> None:
+        client = self._push_clients.get(ret_addr)
+        if client is None:
+            from ray_tpu.runtime.rpc import RpcClient
+            client = self._push_clients[ret_addr] = \
+                RpcClient(ret_addr, timeout=10)
+        try:
+            client.call_oneway("push_object", oid.hex(),
+                               bytes(blob) if not isinstance(blob, bytes)
+                               else blob)
+        except Exception:
+            pass      # caller's pull path still resolves the local copy
+
     def _write_returns(self, return_ids: List[bytes], num_returns: int,
-                       result: Any):
+                      result: Any, ret_addr: Optional[str] = None):
         if num_returns == 0:
             return
+        if ret_addr and ret_addr == self.plane._self_service_addr:
+            ret_addr = None          # caller shares this node's store
         if num_returns == 1:
             if result is None:
                 # Side-effect-only tasks are common; skip the
@@ -127,8 +149,10 @@ class Executor:
                 # unpickler on the reader side — interned blob).
                 from ray_tpu._private.serialization import \
                     NONE_RESULT_BLOB
-                self.plane.put_bytes(ObjectID(return_ids[0]),
-                                     NONE_RESULT_BLOB)
+                oid = ObjectID(return_ids[0])
+                self.plane.put_bytes(oid, NONE_RESULT_BLOB)
+                if ret_addr:
+                    self._push_return(oid, NONE_RESULT_BLOB, ret_addr)
                 return
             values = [result]
         else:
@@ -136,11 +160,22 @@ class Executor:
             if len(values) != num_returns:
                 raise ValueError(
                     f"expected {num_returns} returns, got {len(values)}")
+        from ray_tpu._private.serialization import serialize_parts
         for rid, v in zip(return_ids, values):
+            oid = ObjectID(rid)
+            if ret_addr:
+                parts, total, _ = serialize_parts(("ok", v))
+                self.plane.put_serialized(oid, parts, total)
+                if total <= self.PUSH_RETURN_MAX:
+                    blob = b"".join(
+                        bytes(p) if not isinstance(p, bytes) else p
+                        for p in parts)
+                    self._push_return(oid, blob, ret_addr)
+                continue
             # put_obj streams serialized parts into shm (single copy);
             # returns are owned by the CALLER, so never inline here —
             # a worker-process memory tier would be invisible to it.
-            self.plane.put_obj(ObjectID(rid), ("ok", v))
+            self.plane.put_obj(oid, ("ok", v))
 
     def _write_error(self, return_ids: List[bytes], exc: BaseException):
         payload = dumps(("err", exc))
@@ -305,7 +340,8 @@ class Executor:
                 # must also observe the counter.
                 reg.counter_add("raytpu_tasks_executed_total")
             self._write_returns(spec["return_ids"],
-                                spec["num_returns"], result)
+                                spec["num_returns"], result,
+                                ret_addr=spec.get("ret_addr"))
             return "ok"
         except BaseException as e:  # noqa: BLE001
             if not isinstance(e, TaskError):
@@ -446,7 +482,8 @@ class Executor:
                 if asyncio.iscoroutine(result):
                     result = await result
             self._write_returns(spec["return_ids"],
-                                spec["num_returns"], result)
+                                spec["num_returns"], result,
+                                ret_addr=spec.get("ret_addr"))
         except BaseException as e:  # noqa: BLE001
             if not isinstance(e, (TaskError, ActorDiedError)):
                 e = TaskError(e, task_name=spec.get("name", ""),
@@ -487,7 +524,8 @@ class Executor:
                         result = slot.thread_loop() \
                             .run_until_complete(result)
                 self._write_returns(spec["return_ids"],
-                                    spec["num_returns"], result)
+                                    spec["num_returns"], result,
+                                    ret_addr=spec.get("ret_addr"))
             except BaseException as e:  # noqa: BLE001
                 if not isinstance(e, (TaskError, ActorDiedError)):
                     e = TaskError(e, task_name=spec.get("name", ""),
@@ -653,7 +691,8 @@ class WorkerRuntime:
 
     def submit_task(self, spec):
         from ray_tpu.runtime.client import submit_task_via_head
-        refs = submit_task_via_head(self.head, spec)
+        refs = submit_task_via_head(
+            self.head, spec, ret_addr=self._ex.plane.ret_addr())
         self._ex.plane.mark_owned([r.id for r in refs])
         return refs
 
@@ -663,7 +702,9 @@ class WorkerRuntime:
 
     def submit_actor_task(self, actor_id, spec):
         from ray_tpu.runtime.client import submit_actor_task_via_head
-        refs = submit_actor_task_via_head(self.head, actor_id, spec)
+        refs = submit_actor_task_via_head(
+            self.head, actor_id, spec,
+            ret_addr=self._ex.plane.ret_addr())
         self._ex.plane.mark_owned([r.id for r in refs])
         return refs
 

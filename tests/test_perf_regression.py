@@ -1,9 +1,17 @@
 """Throughput floor regression tests for the distributed runtime.
 
 The full suite is tools/ray_perf.py (PERF_r{N}.json per round); this
-test pins a conservative floor so a scheduler/dispatch regression fails
-CI instead of silently landing (reference: microbenchmarks double as
-perf regression tests, python/ray/_private/ray_perf.py).
+test pins floors so a scheduler/dispatch regression fails CI instead
+of silently landing (reference: microbenchmarks double as perf
+regression tests, python/ray/_private/ray_perf.py).
+
+Robustness: every floor takes the BEST of several repetitions. This
+CI box is a 1-core shared host whose throughput swings ±40% under
+concurrent load (and collapses under concurrent bulk memory traffic)
+— a single-shot measurement flakes, but a transient stall never
+inflates the best-of, so tight floors stay meaningful. Floors are set
+≲1.5x under the solo best (VERDICT r4 ask), which still catches the
+regressions each test documents.
 """
 import time
 
@@ -12,10 +20,13 @@ import pytest
 import ray_tpu
 from ray_tpu.runtime import Cluster
 
-# Measured ~10-12k/s on this 1-core box; floor set ~4x under to stay
-# robust against CI noise while still catching order-of-magnitude
-# regressions (the pre-round-3 runtime measured ~1.2k/s).
-TASKS_PER_S_FLOOR = 2500
+
+def best_of(fn, reps=5):
+    """Best rate over `reps` runs: immune to transient host stalls."""
+    best = 0.0
+    for _ in range(reps):
+        best = max(best, fn())
+    return best
 
 
 @pytest.fixture(scope="module")
@@ -29,20 +40,29 @@ def perf_cluster():
 
 
 def test_task_throughput_floor(perf_cluster):
+    """Solo best ~10-12k/s (r5); floor 8k catches the pre-round-3
+    runtime (~1.2k/s) and any >35% dispatch regression — the r4 PERF
+    artifact's apparent 11.7->7.6k/s drop (VERDICT r4 weak #3) turned
+    out to be HOST variance (same-day A/B of r3 vs r4 code measured
+    8.8k vs 8.9k), which best-of reps absorbs."""
     @ray_tpu.remote
     def noop():
         pass
 
     ray_tpu.get([noop.remote() for _ in range(200)])   # warmup
-    n = 4000
-    t0 = time.perf_counter()
-    ray_tpu.get([noop.remote() for _ in range(n)])
-    rate = n / (time.perf_counter() - t0)
-    assert rate >= TASKS_PER_S_FLOOR, \
-        f"task throughput {rate:.0f}/s below floor {TASKS_PER_S_FLOOR}"
+
+    def run(n=3000):
+        t0 = time.perf_counter()
+        ray_tpu.get([noop.remote() for _ in range(n)])
+        return n / (time.perf_counter() - t0)
+
+    rate = best_of(run)
+    assert rate >= 8000, \
+        f"task throughput {rate:.0f}/s below floor 8000"
 
 
 def test_actor_call_throughput_floor(perf_cluster):
+    """Direct dispatch (r4) measures ~20-26k/s solo; floor 14k."""
     @ray_tpu.remote
     class A:
         def noop(self):
@@ -50,41 +70,70 @@ def test_actor_call_throughput_floor(perf_cluster):
 
     a = A.remote()
     ray_tpu.get([a.noop.remote() for _ in range(100)])
-    n = 1000
-    t0 = time.perf_counter()
-    ray_tpu.get([a.noop.remote() for _ in range(n)])
-    rate = n / (time.perf_counter() - t0)
-    # Direct dispatch (round 4) measures ~20-26k/s; floor ~4x under.
-    assert rate >= 5000, \
-        f"actor call throughput {rate:.0f}/s below 5000"
+
+    def run(n=2000):
+        t0 = time.perf_counter()
+        ray_tpu.get([a.noop.remote() for _ in range(n)])
+        return n / (time.perf_counter() - t0)
+
+    rate = best_of(run)
+    assert rate >= 14000, \
+        f"actor call throughput {rate:.0f}/s below 14000"
 
 
 def test_put_bandwidth_floor(perf_cluster):
-    """Round-4 zero-copy put path measures ~6 GB/s; the pre-round-4
-    path (serialize->join->memmove + LRU spill churn) measured
-    0.2 GB/s. Floor at 1 GB/s catches a copy regression."""
+    """Zero-copy put path measures ~6 GB/s solo; the pre-round-4 path
+    (serialize->join->memmove + LRU spill churn) measured 0.2 GB/s.
+    Floor 2.0 GB/s catches a copy regression."""
     import numpy as np
     big = np.ones(64 * 1024 * 1024 // 8)
     ray_tpu.put(big)                                   # warmup
-    n = 4
-    t0 = time.perf_counter()
-    for _ in range(n):
-        ref = ray_tpu.put(big)
-        del ref            # put-drop churn: eager free keeps the
-        #                    store bounded (no spill stalls)
-    rate = n * big.nbytes / (time.perf_counter() - t0) / 1e9
-    # ~6 GB/s solo; under full-suite load on the 1-core CI box it can
-    # dip near 1 — floor at 0.8 still catches the 0.2 GB/s regression.
-    assert rate >= 0.8, f"put bandwidth {rate:.2f} GB/s below 0.8"
+
+    def run(n=4):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ref = ray_tpu.put(big)
+            del ref        # put-drop churn: eager free keeps the
+            #                store bounded (no spill stalls)
+        return n * big.nbytes / (time.perf_counter() - t0) / 1e9
+
+    rate = best_of(run)
+    assert rate >= 2.0, f"put bandwidth {rate:.2f} GB/s below 2.0"
+
+
+def test_get_bandwidth_floor(perf_cluster):
+    """Zero-copy get: a 64MB object resolves as a pinned shm view, so
+    a get plus a full read of the payload must beat 1.5 GB/s (the
+    r3/r4 copy-out path measured 1.6-2.0 GB/s for the COPY ALONE,
+    before reading a byte). Guards the pin path staying zero-copy."""
+    import numpy as np
+    big = np.ones(64 * 1024 * 1024 // 8)
+    ref = ray_tpu.put(big)
+
+    def run(n=4):
+        t0 = time.perf_counter()
+        total = 0.0
+        for _ in range(n):
+            out = ray_tpu.get(ref)
+            total += float(out[0]) + out.nbytes
+        assert total > 0
+        return n * big.nbytes / (time.perf_counter() - t0) / 1e9
+
+    rate = best_of(run)
+    assert rate >= 1.5, f"get bandwidth {rate:.2f} GB/s below 1.5"
 
 
 def test_small_put_rate_floor(perf_cluster):
-    """Memory-tier puts (no shm create/seal) measure ~50k/s; floor 4x
-    under."""
+    """Memory-tier puts (no shm create/seal) measure ~50k/s solo;
+    floor 25k."""
     ray_tpu.put(b"warm")
-    n = 2000
-    t0 = time.perf_counter()
-    refs = [ray_tpu.put(i) for i in range(n)]
-    rate = n / (time.perf_counter() - t0)
-    del refs
-    assert rate >= 12000, f"small put rate {rate:.0f}/s below 12000"
+
+    def run(n=2000):
+        t0 = time.perf_counter()
+        refs = [ray_tpu.put(i) for i in range(n)]
+        rate = n / (time.perf_counter() - t0)
+        del refs
+        return rate
+
+    rate = best_of(run)
+    assert rate >= 25000, f"small put rate {rate:.0f}/s below 25000"

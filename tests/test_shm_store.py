@@ -50,14 +50,17 @@ def test_get_timeout(store):
 
 
 def test_delete_and_refcount(store):
+    """delete() of a pinned object DEFERS to the last release (the
+    plasma delete-on-release contract) — the entry survives while a
+    view is live and vanishes the moment the pin drops."""
     oid = ObjectID.from_random()
     store.put_bytes(oid, b"data")
     view = store.get_view(oid)   # hold a reference
-    with pytest.raises(ShmStoreError):
-        store.delete(oid)        # refcount > 0 -> state error
+    store.delete(oid)            # refcount > 0 -> deferred
+    assert store.contains(oid)   # still readable under the live pin
+    assert bytes(view) == b"data"
     del view
-    store.release(oid)
-    store.delete(oid)
+    store.release(oid)           # pin drops -> deferred delete runs
     assert not store.contains(oid)
 
 

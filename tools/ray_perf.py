@@ -232,8 +232,11 @@ def main():
             if data is None:
                 continue          # stale location: skip the round
             best = max(best, len(data) / 1e9 / dt)
+            del data              # drop the pin before deleting
+            import gc
+            gc.collect()
             plane.store.delete(ref.id)    # fresh pull each round
-            del data, ref
+            del ref
         RESULTS.append({"name": "cross_node_raw_pull_gigabytes_per_s",
                         "rate": round(best, 2)})
         print(f"{'cross_node_raw_pull_gigabytes_per_s':48s}"
