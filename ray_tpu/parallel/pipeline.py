@@ -48,48 +48,67 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         # local_params leaves: [1, ...] (this stage's slice).
         local_params = jax.tree_util.tree_map(
             lambda p: p[0], local_params)
-        stage = jax.lax.axis_index(axis_name)
-        micro = xfull.reshape((M, mb) + xfull.shape[1:])
-        # Device-varying over the pipeline axis (jax>=0.9 vma typing).
-        outputs = jax.lax.pcast(jnp.zeros_like(micro), (axis_name,),
-                                to="varying")
-        carry_in = jax.lax.pcast(
-            jnp.zeros((mb,) + xfull.shape[1:], xfull.dtype),
-            (axis_name,), to="varying")
-
-        def tick(t, state):
-            outputs, recv = state
-            # Stage 0 injects microbatch t (while t < M); others use recv.
-            inj = jax.lax.dynamic_index_in_dim(
-                micro, jnp.minimum(t, M - 1), axis=0, keepdims=False)
-            act_in = jnp.where(stage == 0, inj, recv)
-            act_out = stage_fn(local_params, act_in)
-            # Valid iff this stage processed a real microbatch this tick.
-            valid = jnp.logical_and(t - stage >= 0, t - stage < M)
-            act_out = jnp.where(valid, act_out, jnp.zeros_like(act_out))
-            # Last stage banks its result at microbatch index t-(S-1).
-            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
-            banked = jax.lax.dynamic_update_index_in_dim(
-                outputs, act_out.astype(outputs.dtype), out_idx, axis=0)
-            is_last = stage == S - 1
-            take = jnp.logical_and(is_last, t >= S - 1)
-            outputs = jnp.where(take, banked, outputs)
-            # Push activation to the next stage (ring; wraps harmlessly).
-            recv = jax.lax.ppermute(
-                act_out, axis_name,
-                [(i, (i + 1) % S) for i in range(S)])
-            return outputs, recv
-
-        outputs, _ = jax.lax.fori_loop(0, M + S - 1, tick,
-                                       (outputs, carry_in))
-        # Broadcast the last stage's outputs to every stage so out_specs
-        # P() (replicated) is truthful.
-        outputs = jax.lax.psum(
-            jnp.where(stage == S - 1, outputs,
-                      jnp.zeros_like(outputs)), axis_name)
-        return outputs.reshape((B,) + xfull.shape[1:])
+        return pipeline_run_local(stage_fn, local_params, xfull, M,
+                                  S, axis_name)
 
     return run(stage_params, x)
+
+
+def pipeline_run_local(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                       local_params: Any, x: jax.Array,
+                       num_microbatches: int, num_stages: int,
+                       axis_name: str = "pipeline") -> jax.Array:
+    """The GPipe schedule itself, for callers ALREADY inside a
+    shard_map (e.g. train.compose, which also shards the batch over
+    data/sequence axes). `x` is this device's local batch slice;
+    `local_params` is this stage's parameter slice (no leading stage
+    axis). Returns the final-stage output, replicated over the
+    pipeline axis."""
+    S = num_stages
+    M = num_microbatches
+    B = x.shape[0]
+    mb = B // M
+    stage = jax.lax.axis_index(axis_name)
+    micro = x.reshape((M, mb) + x.shape[1:])
+    # Carries derive from x (inheriting its varying axes — data/
+    # sequence/... in the composed step) plus the pipeline axis the
+    # schedule itself varies over (jax>=0.9 vma typing).
+    outputs = jax.lax.pcast(jnp.zeros_like(micro), (axis_name,),
+                            to="varying")
+    carry_in = jax.lax.pcast(jnp.zeros_like(micro[0]),
+                             (axis_name,), to="varying")
+
+    def tick(t, state):
+        outputs, recv = state
+        # Stage 0 injects microbatch t (while t < M); others use recv.
+        inj = jax.lax.dynamic_index_in_dim(
+            micro, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+        act_in = jnp.where(stage == 0, inj, recv)
+        act_out = stage_fn(local_params, act_in)
+        # Valid iff this stage processed a real microbatch this tick.
+        valid = jnp.logical_and(t - stage >= 0, t - stage < M)
+        act_out = jnp.where(valid, act_out, jnp.zeros_like(act_out))
+        # Last stage banks its result at microbatch index t-(S-1).
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        banked = jax.lax.dynamic_update_index_in_dim(
+            outputs, act_out.astype(outputs.dtype), out_idx, axis=0)
+        is_last = stage == S - 1
+        take = jnp.logical_and(is_last, t >= S - 1)
+        outputs = jnp.where(take, banked, outputs)
+        # Push activation to the next stage (ring; wraps harmlessly).
+        recv = jax.lax.ppermute(
+            act_out, axis_name,
+            [(i, (i + 1) % S) for i in range(S)])
+        return outputs, recv
+
+    outputs, _ = jax.lax.fori_loop(0, M + S - 1, tick,
+                                   (outputs, carry_in))
+    # Broadcast the last stage's outputs to every stage so replicated
+    # out_specs over the pipeline axis are truthful.
+    outputs = jax.lax.psum(
+        jnp.where(stage == S - 1, outputs,
+                  jnp.zeros_like(outputs)), axis_name)
+    return outputs.reshape((B,) + x.shape[1:])
 
 
 def stack_stage_params(per_stage_params) -> Any:

@@ -73,14 +73,15 @@ def ring_attention(q, k, v, axis_name: str = "sequence",
         src = (src - 1) % n
         return o, m_new, l, kc, vc, src
 
-    # Mark the accumulators as device-varying over the ring axis so the
-    # fori_loop carry types stay consistent after ppermute (jax>=0.9 vma).
-    o0 = jax.lax.pcast(jnp.zeros((B, T, H, D), jnp.float32),
-                       (axis_name,), to="varying")
-    m0 = jax.lax.pcast(jnp.full((B, H, T), _NEG_INF, jnp.float32),
-                       (axis_name,), to="varying")
-    l0 = jax.lax.pcast(jnp.zeros((B, H, T), jnp.float32),
-                       (axis_name,), to="varying")
+    # Derive the accumulators FROM q so they inherit q's full
+    # varying-manual-axes type: inside a multi-axis shard_map (e.g. the
+    # composed pipeline x sequence x data step) q varies over every
+    # sharded axis, and a carry typed narrower than the body's outputs
+    # fails vma checking (jax>=0.9).
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+    bht = jnp.moveaxis(q, 1, 2)[..., 0]          # [B, H, T], q's vma
+    m0 = jnp.full_like(bht, _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros_like(bht, dtype=jnp.float32)
     o, m, l, _, _, _ = jax.lax.fori_loop(
         0, n, body, (o0, m0, l0, k, v, idx))
     l = jnp.maximum(l, 1e-30)

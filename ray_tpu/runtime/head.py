@@ -477,8 +477,12 @@ class HeadService:
             pulls = getattr(self, "_pulls", None)
             if pulls is None:
                 pulls = self._pulls = {}
-            # Reclaim reservations whose puller died/hung.
+            # Reclaim reservations whose puller died/hung, and total
+            # each SOURCE's in-flight transfers across ALL objects —
+            # the per-source cap protects the replica process, so it
+            # must count every object it is serving.
             total_inflight = 0
+            src_load: Dict[str, int] = {}
             for key in list(pulls):
                 slots = pulls[key]
                 for src in list(slots):
@@ -487,7 +491,9 @@ class HeadService:
                     if not slots[src]:
                         del slots[src]
                     else:
-                        total_inflight += len(slots[src])
+                        n_src = len(slots[src])
+                        total_inflight += n_src
+                        src_load[src] = src_load.get(src, 0) + n_src
                 if not slots:
                     del pulls[key]
             slots = pulls.setdefault(oid_hex, {})
@@ -503,7 +509,7 @@ class HeadService:
                 any_peer = True
                 if total_inflight >= global_cap:
                     continue
-                if len(slots.get(loc["node_id"], ())) < per_source:
+                if src_load.get(loc["node_id"], 0) < per_source:
                     best = loc
                     break
             if best is None:
@@ -515,9 +521,12 @@ class HeadService:
                 # is probably about to be registered by its producer).
                 return {"busy": True} if any_peer else None
             slots.setdefault(best["node_id"], []).append(now)
+        best = dict(best)
+        best["slot_ts"] = now       # end_pull releases THIS stamp
         return best
 
-    def end_pull(self, oid_hex: str, node_id: str, source_node: str):
+    def end_pull(self, oid_hex: str, node_id: str, source_node: str,
+                 slot_ts: float = 0.0):
         with self._lock:
             pulls = getattr(self, "_pulls", None)
             if not pulls:
@@ -527,7 +536,13 @@ class HeadService:
                 return
             ts = slots.get(source_node)
             if ts:
-                ts.pop()
+                # Release the finishing pull's OWN stamp (popping an
+                # arbitrary one would age a still-running pull's slot
+                # toward TTL reclamation and overshoot the caps).
+                if slot_ts in ts:
+                    ts.remove(slot_ts)
+                else:
+                    ts.pop()
                 if not ts:
                     del slots[source_node]
             if not slots:

@@ -122,7 +122,8 @@ def prewarm_transfer_path(store, self_addr: str) -> None:
     for tiny test stores."""
     from ray_tpu._private.config import GlobalConfig
     try:
-        cap_mb = int(store.stats()["capacity"] // (8 << 20))
+        # src + dst scratch together stay within 1/8 of the store.
+        cap_mb = int(store.stats()["capacity"] // (16 << 20))
     except Exception:
         cap_mb = 64
     mb = min(GlobalConfig.transfer_prewarm_mb, cap_mb)
@@ -134,7 +135,14 @@ def prewarm_transfer_path(store, self_addr: str) -> None:
         dst = ObjectID.from_random()
         n = mb << 20
         try:
-            store.put_bytes(src, b"\0" * n)
+            sview = store.create_for_write(src, n)
+            if sview is None:
+                return
+            one_mb = b"\1" * (1 << 20)       # reusable 1MB pattern
+            for off in range(0, n, 1 << 20):
+                sview[off:off + (1 << 20)] = one_mb
+            sview.release()
+            store.seal_raw(src)
             view = store.create_for_write(dst, n)
             if view is None:
                 store.delete(src)
@@ -622,6 +630,9 @@ class ObjectPlane:
         are bounded to one head RPC per 5s while unresolved."""
         if not self.multinode:
             return None
+        return self._resolved_self_addr()
+
+    def _resolved_self_addr(self) -> Optional[str]:
         addr = self._self_service_addr
         if addr is None:
             now = time.time()
@@ -636,12 +647,7 @@ class ObjectPlane:
         (caller pulls directly)."""
         if self.is_node_service:
             return None
-        addr = self._self_service_addr
-        if addr is None:
-            now = time.time()
-            if now >= self._self_resolve_at:
-                self._self_resolve_at = now + 5.0   # bound lookups
-                addr = self._resolve_self_service()
+        addr = self._resolved_self_addr()
         if addr is None:
             return None
         client = self._fetch_client
@@ -730,7 +736,8 @@ class ObjectPlane:
         finally:
             try:
                 self.head.call_oneway("end_pull", oid.hex(),
-                                      self.node_id, loc["node_id"])
+                                      self.node_id, loc["node_id"],
+                                      loc.get("slot_ts", 0.0))
             except Exception:
                 pass    # slot TTL reclaims it
         # On success _pull streamed the object into the local store

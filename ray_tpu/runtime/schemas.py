@@ -68,7 +68,8 @@ SCHEMAS: Dict[str, Tuple[Param, ...]] = {
                    P("probe", bool, required=False),
                    P("reconstruct", bool, required=False)),
     "end_pull": (P("oid_hex", str), P("node_id", str),
-                 P("source_node", str)),
+                 P("source_node", str),
+                 P("slot_ts", (int, float), required=False)),
     "unregister_object": (P("oid_hex", str), P("node_id", str)),
     "object_size": (P("oid_hex", str),),
     "has_object": (P("oid_hex", str),),

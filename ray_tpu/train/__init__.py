@@ -6,6 +6,9 @@ from ray_tpu.train.gbdt import (LightGBMTrainer, SklearnTrainer,
                                 XGBoostTrainer)
 from ray_tpu.train.predictor import (BatchPredictor, JaxPredictor,
                                      Predictor, SklearnPredictor)
+from ray_tpu.train.compose import (make_composed_loss,
+                                   make_composed_train_step,
+                                   put_composed_batch)
 from ray_tpu.train.trainer import BaseTrainer, JaxTrainer, DataParallelTrainer
 from ray_tpu.train.torch import TorchTrainer
 
@@ -14,4 +17,5 @@ __all__ = ["gang", "BaseTrainer", "JaxTrainer", "DataParallelTrainer",
            "LightGBMTrainer", "Predictor", "JaxPredictor",
            "SklearnPredictor", "BatchPredictor",
            "ScalingConfig", "RunConfig", "FailureConfig",
-           "CheckpointConfig", "Result"]
+           "CheckpointConfig", "Result", "make_composed_train_step",
+           "make_composed_loss", "put_composed_batch"]

@@ -1,0 +1,182 @@
+"""Composed parallelism: one train step over any mix of mesh axes.
+
+SURVEY §7 step 7's obligation — pipeline (PP), sequence/context (SP),
+expert (EP) and data/fsdp/dcn parallelism "composable as mesh-axis
+configs on JaxTrainer" — satisfied the TPU way: ONE `shard_map` over
+the full mesh runs the GPipe schedule on the `pipeline` axis while the
+batch stays sharded over (dcn, data, fsdp) on its leading dim and over
+`sequence` on its second dim; the stage function may freely use the
+manual-collective building blocks inside (ring_attention over
+`sequence`, all_to_all expert dispatch over `expert`). Gradients flow
+through the whole composition — `jax.value_and_grad` of the
+shard_mapped loss inserts the psums for replicated params and the
+transposed ppermutes for the pipeline/ring exchanges.
+
+The reference has no counterpart (its only scaling axis is data
+parallelism; SURVEY.md §2.4); this module is pure TPU-native surface.
+
+Usage with JaxTrainer (the loop runs identically on 1 process or a
+multi-host gang — the mesh comes from ScalingConfig.mesh):
+
+    def loop(config):
+        mesh = session.get_mesh()
+        step, state = make_composed_train_step(
+            stage_fn, loss_fn, optax.adam(1e-3), mesh,
+            stage_params, num_microbatches=4)
+        for batch in data:
+            state, metrics = step(state, put_composed_batch(batch, mesh))
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.pipeline import pipeline_run_local
+from ray_tpu.train.spmd import TrainState
+
+# Batch layout: leading dim sharded over the data-parallel axes,
+# second dim (sequence) over the sequence axis.
+DATA_AXES = ("dcn", "data", "fsdp")
+
+
+def composed_batch_spec(ndim: int) -> P:
+    """PartitionSpec for a batch leaf: [B, T, ...] -> data axes on B,
+    sequence on T; 1-D leaves shard only the batch dim."""
+    if ndim == 0:
+        return P()              # scalars replicate
+    if ndim == 1:
+        return P(DATA_AXES)
+    return P(DATA_AXES, "sequence")
+
+
+def put_composed_batch(batch, mesh: Mesh):
+    """Device-place a batch pytree with the composed layout. On a
+    multi-host gang each process contributes its local shard (per-host
+    data loading, same contract as spmd.put_batch)."""
+    import numpy as np
+
+    def put(x):
+        x = np.asarray(x)
+        sh = NamedSharding(mesh, composed_batch_spec(x.ndim))
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sh, x)
+        return jax.device_put(x, sh)
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+def shard_stage_params(stage_params, mesh: Mesh):
+    """Place stage-stacked params (leading stage axis) P('pipeline')."""
+    sh = NamedSharding(mesh, P("pipeline"))
+    return jax.tree_util.tree_map(
+        lambda p: jax.device_put(p, sh), stage_params)
+
+
+def make_composed_loss(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                       loss_fn: Callable[[jax.Array, Any],
+                                         Tuple[jax.Array, jax.Array]],
+                       mesh: Mesh,
+                       num_microbatches: int = 1):
+    """Build loss(params, batch) running the full composition.
+
+    stage_fn(stage_params, x_local) -> activation (same shape): one
+        pipeline stage's computation on this device's LOCAL slice
+        ([B/(dp), T/(sp), ...]). May use ring_attention(axis_name=
+        'sequence'), lax collectives over 'expert'/'tensor', etc.
+    loss_fn(out_local, batch_local) -> (loss_sum, weight): LOCAL sums;
+        the builder psums them over the whole mesh and returns
+        sum/weight (a true global mean regardless of sharding).
+    batch: pytree whose first leaf is the input x; the entire batch
+        pytree is passed to loss_fn.
+    """
+    S = mesh.shape.get("pipeline", 1)
+    M = num_microbatches
+    all_axes = tuple(mesh.axis_names)
+
+    def loss(params, batch):
+        params_spec = jax.tree_util.tree_map(
+            lambda _: P("pipeline"), params)
+        batch_spec = jax.tree_util.tree_map(
+            lambda b: composed_batch_spec(b.ndim), batch)
+
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(params_spec, batch_spec), out_specs=P())
+        def run(local_params, local_batch):
+            # Each pipeline rank holds S_total/S stages; apply them in
+            # order (a "stage" of the schedule = this rank's slice).
+            def local_stage(lp, act):
+                # Params vary over the pipeline axis; the carry must
+                # too or the scan's vma types diverge.
+                vma = set(getattr(jax.typeof(act), "vma", ()) or ())
+                if "pipeline" not in vma:
+                    act = jax.lax.pcast(act, ("pipeline",),
+                                        to="varying")
+
+                def body(carry, p):
+                    return stage_fn(p, carry), None
+                out, _ = jax.lax.scan(body, act, lp)
+                return out
+
+            xl = jax.tree_util.tree_leaves(local_batch)[0]
+            if S > 1:
+                out = pipeline_run_local(local_stage, local_params,
+                                         xl, M, S, "pipeline")
+            else:
+                out = local_stage(local_params, xl)
+            lsum, weight = loss_fn(out, local_batch)
+            lsum = jnp.asarray(lsum, jnp.float32)
+            weight = jnp.asarray(weight, jnp.float32)
+            # Global mean: the loss sum is psum'd over exactly the
+            # axes it VARIES over (batch/sequence shards; replicated
+            # pipeline/tensor copies already hold the full value and
+            # jax's vma typing rejects psum over invarying axes). The
+            # weight is by contract a LOCAL count, so along any sum
+            # axis where it came out invarying (e.g. a shape-derived
+            # Python constant) the replicas each hold the local count
+            # and a multiply stands in for the psum.
+            vma_l = set(getattr(jax.typeof(lsum), "vma", ()) or ())
+            sum_axes = tuple(a for a in all_axes if a in vma_l)
+            if sum_axes:
+                lsum = jax.lax.psum(lsum, sum_axes)
+            vma_w = set(getattr(jax.typeof(weight), "vma", ()) or ())
+            w_axes = tuple(a for a in sum_axes if a in vma_w)
+            if w_axes:
+                weight = jax.lax.psum(weight, w_axes)
+            for a in sum_axes:
+                if a not in vma_w:
+                    weight = weight * mesh.shape[a]
+            return lsum / weight
+
+        return run(params, batch)
+
+    return loss
+
+
+def make_composed_train_step(
+        stage_fn, loss_fn, optimizer: optax.GradientTransformation,
+        mesh: Mesh, stage_params, num_microbatches: int = 1,
+        donate: bool = True):
+    """The composed analogue of spmd.make_train_step: returns
+    (jitted_step, initial TrainState) where the step trains through
+    pipeline x sequence x data/fsdp/dcn (x whatever the stage_fn uses
+    internally) in ONE compiled program."""
+    stage_params = shard_stage_params(stage_params, mesh)
+    state = TrainState.create(stage_params, optimizer)
+    composed = make_composed_loss(stage_fn, loss_fn, mesh,
+                                  num_microbatches)
+
+    def step_fn(state: TrainState, batch):
+        loss, grads = jax.value_and_grad(composed)(state.params, batch)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (TrainState(new_params, new_opt, state.step + 1),
+                {"loss": loss, "step": state.step + 1})
+
+    return (jax.jit(step_fn, donate_argnums=(0,) if donate else ()),
+            state)

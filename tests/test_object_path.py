@@ -124,7 +124,12 @@ def test_task_return_eagerly_freed(cluster):
         arr = ray_tpu.get(r, timeout=30)
         assert arr.nbytes == 64 << 20
         del arr, r
-    time.sleep(0.5)
+    # The free flusher polls at 1s and deferred (pinned) deletes run
+    # at pin release: poll instead of racing a fixed sleep.
+    deadline = time.time() + 6
+    while time.time() < deadline and \
+            plane.store.stats()["bytes_in_use"] >= 200 * 1024 * 1024:
+        time.sleep(0.25)
     stats = plane.store.stats()
     assert stats["num_spilled"] == spilled_before
     assert stats["bytes_in_use"] < 200 * 1024 * 1024
