@@ -55,6 +55,11 @@ define_flag("transfer_prewarm_mb", int, 128,
             "first bulk receive of a cold process runs ~13x slower "
             "than steady state on shared hosts. Capped at 1/8 of the "
             "store; <16MB disables.")
+define_flag("borrow_grace_s", float, 3.0,
+            "Window the head waits after an escaped object's owner "
+            "drop (or its last borrow drop) before freeing: covers "
+            "refs pickled but not yet deserialized/registered by "
+            "their receiver.")
 define_flag("bulk_pull_global_slots", int, 2,
             "Cluster-wide cap on concurrent bulk pulls. On shared/"
             "virtualized hosts concurrent bulk memory traffic "

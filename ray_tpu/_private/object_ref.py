@@ -24,6 +24,13 @@ class ObjectRef:
             rc = _global_reference_counter()
             if rc is not None:
                 rc.add_local_ref(object_id, borrowed=_register_borrow)
+            if _register_borrow and _borrow_notifier is not None:
+                # Deserialized ref owned elsewhere: register the
+                # borrow with the owner-side protocol (batched).
+                try:
+                    _borrow_notifier(object_id)
+                except Exception:
+                    pass    # worst case: LRU bounds the object
 
     def binary(self) -> bytes:
         return self.id.binary()
@@ -114,6 +121,7 @@ def _promote_if_local(oid: ObjectID) -> None:
 
 _rc_lock = threading.Lock()
 _rc: Optional[Any] = None
+_borrow_notifier: Optional[Any] = None
 
 
 def _global_reference_counter():
@@ -124,3 +132,11 @@ def set_global_reference_counter(rc) -> None:
     global _rc
     with _rc_lock:
         _rc = rc
+
+
+def set_borrow_notifier(fn) -> None:
+    """Install the runtime's borrow-registration hook (the
+    distributed runtimes pass their plane's note_borrow)."""
+    global _borrow_notifier
+    with _rc_lock:
+        _borrow_notifier = fn

@@ -15,13 +15,15 @@ from __future__ import annotations
 
 import contextlib
 import hashlib
+import json
 import os
 import sys
 import threading
 import zipfile
 from typing import Any, Dict, Optional
 
-_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
+_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules", "pip",
+               "conda", "container"}
 
 
 def runtime_env_key(runtime_env: Optional[Dict[str, Any]]
@@ -128,6 +130,28 @@ def validate_runtime_env(runtime_env: Optional[Dict[str, Any]]
     mods = runtime_env.get("py_modules")
     if mods is not None and not isinstance(mods, (list, tuple)):
         raise TypeError("runtime_env['py_modules'] must be a list")
+    conda = runtime_env.get("conda")
+    if conda is not None and not isinstance(conda, (str, dict)):
+        raise TypeError(
+            "runtime_env['conda'] must be an env name (str) or an "
+            "environment spec (dict with 'dependencies')")
+    container = runtime_env.get("container")
+    if container is not None:
+        if not isinstance(container, dict) or \
+                not container.get("image"):
+            raise TypeError(
+                "runtime_env['container'] must be a dict with an "
+                "'image' key (and optional 'run_options' list)")
+        ro = container.get("run_options", [])
+        if not isinstance(ro, list) or \
+                not all(isinstance(o, str) for o in ro):
+            raise TypeError(
+                "runtime_env['container']['run_options'] must be a "
+                "list of strings")
+    if conda is not None and runtime_env.get("pip") is not None:
+        raise ValueError(
+            "runtime_env cannot combine 'conda' and 'pip' (install "
+            "pip packages via the conda spec's dependencies)")
     pip = runtime_env.get("pip")
     if pip is not None:
         if isinstance(pip, dict):
@@ -292,6 +316,186 @@ def stage_pip_env(runtime_env: Dict[str, Any],
             os.unlink(lock)
         except OSError:
             pass
+
+
+# --------------------------------------------------------------- conda envs
+# Reference: python/ray/_private/runtime_env/conda.py — named envs
+# resolve to their interpreter; dict specs materialize a cached env;
+# workers re-exec under the env's python (same dedicated-worker
+# routing as pip envs).
+
+def find_conda() -> Optional[str]:
+    import shutil
+    for name in ("mamba", "micromamba", "conda"):
+        p = shutil.which(name)
+        if p:
+            return p
+    return None
+
+
+def conda_available() -> bool:
+    return find_conda() is not None
+
+
+def _conda_env_dir(spec: Dict[str, Any]) -> str:
+    key = hashlib.sha1(json.dumps(spec, sort_keys=True,
+                                  default=str).encode()).hexdigest()[:16]
+    return os.path.join(_CACHE_DIR, "conda", key)
+
+
+def conda_env_python(runtime_env: Dict[str, Any],
+                     timeout_s: float = 900.0) -> Optional[str]:
+    """Resolve (or materialize) the env's conda environment and return
+    its python executable. Named envs must already exist; dict specs
+    create a cached env under the runtime-env cache (one `conda env
+    create` per spec hash per node). Raises when no conda/mamba binary
+    is on PATH — callers surface that through env_setup_failed."""
+    spec = runtime_env.get("conda")
+    if spec is None:
+        return None
+    exe = find_conda()
+    if exe is None:
+        raise RuntimeError(
+            "runtime_env['conda'] requested but no conda/mamba/"
+            "micromamba binary is on PATH on this node")
+    import subprocess
+    if isinstance(spec, str):
+        if os.path.isdir(spec):              # prefix path
+            return os.path.join(spec, "bin", "python")
+        proc = subprocess.run([exe, "env", "list", "--json"],
+                              capture_output=True, text=True,
+                              timeout=60)
+        envs = json.loads(proc.stdout or "{}").get("envs", [])
+        for prefix in envs:
+            if os.path.basename(prefix) == spec:
+                return os.path.join(prefix, "bin", "python")
+        raise RuntimeError(f"conda env {spec!r} not found on this "
+                           f"node (known: {envs})")
+    edir = _conda_env_dir(spec)
+    py = os.path.join(edir, "bin", "python")
+
+    def build():
+        import tempfile
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".json", delete=False) as f:
+            json.dump(spec, f)
+            spec_file = f.name
+        try:
+            proc = subprocess.run(
+                [exe, "env", "create", "--prefix", edir,
+                 "--file", spec_file, "--yes"],
+                capture_output=True, text=True, timeout=timeout_s)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"conda env create failed (rc={proc.returncode}):"
+                    f" {(proc.stderr or '')[-2000:]}")
+        finally:
+            os.unlink(spec_file)
+
+    _locked_stage(edir, py, build, timeout_s)
+    return py
+
+
+def _locked_stage(target_dir: str, probe_path: str, build,
+                  timeout_s: float) -> None:
+    """Cross-process once-only staging: first claimer builds under a
+    pid-stamped lock file; others wait for the .ok marker (or break a
+    dead claimer's lock). Shared by conda staging (and any future
+    cached-artifact env type)."""
+    import time as _time
+    marker = os.path.join(target_dir, ".raytpu_ok")
+    if os.path.exists(marker):
+        return
+    os.makedirs(os.path.dirname(target_dir), exist_ok=True)
+    lock = target_dir + ".lock"
+    try:
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        deadline = _time.time() + timeout_s
+        while _time.time() < deadline:
+            if os.path.exists(marker):
+                return
+            if not os.path.exists(lock):
+                return _locked_stage(target_dir, probe_path, build,
+                                     timeout_s)
+            try:
+                with open(lock) as f:
+                    owner = int(f.read().strip() or 0)
+                if owner:
+                    os.kill(owner, 0)
+            except (OSError, ValueError):
+                try:
+                    os.unlink(lock)
+                except OSError:
+                    pass
+                return _locked_stage(target_dir, probe_path, build,
+                                     timeout_s)
+            _time.sleep(0.25)
+        raise TimeoutError(f"staging {target_dir} timed out")
+    try:
+        os.write(fd, str(os.getpid()).encode())
+        os.close(fd)
+        if not os.path.exists(probe_path):
+            build()
+        with open(marker, "w") as f:
+            f.write("ok")
+    finally:
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------- container envs
+# Reference: python/ray/_private/runtime_env/container.py — the worker
+# command is wrapped in `podman/docker run` with the node's state
+# mounted. Here the node agent wraps spawn_worker_process's command
+# when the env names an image; the prefix builder is a pure function
+# so it is testable without an engine installed.
+
+def find_container_engine() -> Optional[str]:
+    import shutil
+    for name in ("podman", "docker"):
+        p = shutil.which(name)
+        if p:
+            return p
+    return None
+
+
+def container_command_prefix(runtime_env: Dict[str, Any],
+                             engine: Optional[str] = None,
+                             env_vars: Optional[Dict[str, str]] = None):
+    """The argv prefix that runs a worker inside the env's image:
+    host networking (the worker must reach the head's loopback RPC
+    ports), host PID namespace (the worker's parent-death watcher
+    probes the spawner's host pid), /dev/shm and the repo mounted
+    through (the C++ store mapping and cwd imports must resolve to
+    the same paths inside). `env_vars` become --env flags — they must
+    sit BEFORE the image (everything after it is the in-container
+    command). Returns None when the env has no container."""
+    spec = (runtime_env or {}).get("container")
+    if not spec:
+        return None
+    engine = engine or find_container_engine()
+    if engine is None:
+        raise RuntimeError(
+            "runtime_env['container'] requested but neither podman "
+            "nor docker is on PATH on this node")
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        "..", ".."))
+    prefix = [engine, "run", "--rm", "-i",
+              "--network", "host",
+              "--ipc", "host",           # shm store segments
+              "--pid", "host",           # parent-death watcher
+              "-v", f"{repo}:{repo}",
+              "-v", "/dev/shm:/dev/shm",
+              "-w", repo]
+    for k, v in (env_vars or {}).items():
+        prefix += ["--env", f"{k}={v}"]
+    for opt in spec.get("run_options", []):
+        prefix.append(opt)
+    prefix.append(spec["image"])
+    return prefix
 
 
 def _venv_site(vdir: str) -> str:

@@ -104,6 +104,10 @@ def init(address: Optional[str] = None,
         if namespace:
             _worker.namespace = namespace
         set_global_reference_counter(runtime.ref_counter)
+        plane = getattr(runtime, "plane", None)
+        if plane is not None:
+            from ray_tpu._private.object_ref import set_borrow_notifier
+            set_borrow_notifier(plane.note_borrow)
         return _worker
 
 
@@ -113,6 +117,8 @@ def shutdown():
         if _worker is None:
             return
         set_global_reference_counter(None)
+        from ray_tpu._private.object_ref import set_borrow_notifier
+        set_borrow_notifier(None)
         try:
             _worker.runtime.shutdown()
         finally:

@@ -316,6 +316,86 @@ def _shuffle_slices(block_refs: List["ray_tpu.ObjectRef"],
                     per_block_args=plans)
 
 
+# Input-block count above which the all-to-all ops switch from the
+# simple pull shuffle (N maps x num_returns=N, then N reduces over N
+# args = O(N^2) live intermediate objects) to the push-based pipeline.
+PUSH_SHUFFLE_THRESHOLD = 32
+_PUSH_ROUND = 16         # map tasks per pipelined round
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _random_split(block: Block, seed_i: int, n: int):
+    """Map side of random_shuffle (both strategies): split one block
+    into n random parts. Seed convention: base + input-block index."""
+    rng = np.random.RandomState(seed_i)
+    perm = rng.permutation(len(block))
+    parts = np.array_split(perm, n)
+    out = [[block[i] for i in part] for part in parts]
+    return tuple(out) if n > 1 else out[0]
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _perm_merge(seed_j: int, *parts: Block) -> Block:
+    """Reduce side (pull strategy): concat + output permutation.
+    Seed convention: base + output index + 10000."""
+    merged = [row for p in parts for row in p]
+    rng = np.random.RandomState(seed_j + 10000)
+    perm = rng.permutation(len(merged))
+    return [merged[i] for i in perm]
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _perm_finalize(seed_j: int, merged: Block) -> Block:
+    """Push-strategy finalize: same output permutation as _perm_merge
+    applied to the already-folded partition (seed conventions MUST
+    stay in lockstep so both strategies shuffle identically)."""
+    rng = np.random.RandomState(seed_j + 10000)
+    perm = rng.permutation(len(merged))
+    return [merged[i] for i in perm]
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _fold_concat(accum: Optional[Block], *parts: Block) -> Block:
+    """Merge-side accumulator of the push shuffle: folds one round's
+    parts for one output partition into the running merged block
+    (order-preserving concat)."""
+    out = list(accum) if accum else []
+    for p in parts:
+        out.extend(p)
+    return out
+
+
+def _pipelined_all_to_all(block_refs: List["ray_tpu.ObjectRef"],
+                          launch_map, n_out: int,
+                          fold=None,
+                          round_size: int = _PUSH_ROUND) -> List:
+    """Push-based shuffle executor (reference:
+    python/ray/data/_internal/push_based_shuffle.py — map outputs are
+    merged INCREMENTALLY by merge tasks instead of all N x M parts
+    staying live until one big reduce).
+
+    launch_map(i, ref) -> list of n_out per-partition part refs for
+    input block i. Maps launch in rounds of `round_size`; after each
+    round, every output partition folds that round's parts into its
+    accumulator block, so at most O(round_size x n_out) intermediate
+    objects are in flight — the part refs drop as each fold is
+    submitted and the eager-GC frees them as the folds complete. The
+    returned accumulators preserve input-block order (fold is an
+    ordered concat), so ordered ops (repartition) reuse this path.
+    """
+    fold = fold or _fold_concat
+    accums: List = [None] * n_out
+    for start in range(0, len(block_refs), round_size):
+        chunk = block_refs[start:start + round_size]
+        parts = [launch_map(start + i, r)
+                 for i, r in enumerate(chunk)]
+        for j in range(n_out):
+            col = [p[j] for p in parts]
+            accums[j] = fold.remote(accums[j], *col)
+        del parts        # refs drop -> freed as folds consume them
+    return accums
+
+
 class _BatchActor:
     """Actor-pool compute for map_batches (reference:
     _internal/compute.py ActorPoolStrategy)."""
@@ -448,45 +528,65 @@ class Dataset:
                             for b in ds._block_refs])
         return ds, lens
 
-    def repartition(self, num_blocks: int) -> "Dataset":
+    def repartition(self, num_blocks: int,
+                    strategy: str = "auto") -> "Dataset":
         ds, lens = self._block_lengths()
         cuts = _even_cuts(sum(lens), num_blocks)
+        if strategy == "push" or (
+                strategy == "auto" and
+                len(ds._block_refs) > PUSH_SHUFFLE_THRESHOLD):
+            # Large job: pipelined push shuffle — O(round x out)
+            # live intermediates instead of O(blocks x out).
+            plans = _slice_plan(lens, cuts)
+            slicer = _slice_block.options(num_returns=len(cuts))
+
+            def launch(i, ref):
+                parts = slicer.remote(ref, plans[i])
+                return [parts] if len(cuts) == 1 else list(parts)
+
+            return Dataset(_pipelined_all_to_all(
+                ds._block_refs, launch, len(cuts)))
         all_parts = _shuffle_slices(ds._block_refs, lens, cuts)
         merged = [_concat_parts.remote(*[parts[j] for parts in all_parts])
                   for j in range(num_blocks)]
         return Dataset(merged)
 
-    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+    def random_shuffle(self, seed: Optional[int] = None,
+                       strategy: str = "auto") -> "Dataset":
         """Two-stage all-to-all shuffle (reference:
         _internal/push_based_shuffle.py shape): stage 1 splits each block
-        into N random parts; stage 2 merges part i of every block."""
+        into N random parts; stage 2 merges part i of every block.
+        Above PUSH_SHUFFLE_THRESHOLD input blocks (or with
+        strategy="push") the merge side runs as the pipelined push
+        shuffle, then applies the final per-partition permutation."""
         ds = self.materialize()
         n = max(1, len(ds._block_refs))
-
-        @ray_tpu.remote(num_cpus=0.25, num_returns=n)
-        def split_block(block, seed_i):
-            rng = np.random.RandomState(seed_i)
-            perm = rng.permutation(len(block))
-            parts = np.array_split(perm, n)
-            out = [[block[i] for i in part] for part in parts]
-            return out if n > 1 else out[0]
-
-        @ray_tpu.remote(num_cpus=0.25)
-        def merge(seed_i, *parts):
-            merged = [row for p in parts for row in p]
-            rng = np.random.RandomState(seed_i + 10000)
-            perm = rng.permutation(len(merged))
-            return [merged[i] for i in perm]
-
+        if strategy == "push" or (
+                strategy == "auto" and n > PUSH_SHUFFLE_THRESHOLD):
+            return ds._random_shuffle_push(seed, n)
         base = seed if seed is not None else 0
-        all_parts = [split_block.remote(b, base + i)
+        splitter = _random_split.options(num_returns=n)
+        all_parts = [splitter.remote(b, base + i, n)
                      for i, b in enumerate(ds._block_refs)]
         if n == 1:
             all_parts = [[p] for p in all_parts]
-        merged = [merge.remote(base + j,
-                               *[parts[j] for parts in all_parts])
+        merged = [_perm_merge.remote(base + j,
+                                     *[parts[j] for parts in all_parts])
                   for j in range(n)]
         return Dataset(merged)
+
+    def _random_shuffle_push(self, seed: Optional[int],
+                             n: int) -> "Dataset":
+        base = seed if seed is not None else 0
+        splitter = _random_split.options(num_returns=n)
+
+        def launch(i, ref):
+            parts = splitter.remote(ref, base + i, n)
+            return [parts] if n == 1 else list(parts)
+
+        accums = _pipelined_all_to_all(self._block_refs, launch, n)
+        return Dataset([_perm_finalize.remote(base + j, a)
+                        for j, a in enumerate(accums)])
 
     def sort(self, key: Optional[Union[str, Callable]] = None,
              descending: bool = False) -> "Dataset":

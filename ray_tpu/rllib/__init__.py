@@ -1,16 +1,27 @@
-"""RL library: Algorithm/AlgorithmConfig surface with PPO (sync
-on-policy), DQN (off-policy replay) and IMPALA (async actor-learner with
-V-trace) over CPU rollout actors + a jitted JAX learner (TPU when
-present). Reference: rllib/ (SURVEY.md §2.3 L7, §3.6)."""
+"""RL library: Algorithm/AlgorithmConfig surface with PPO/A2C (sync
+on-policy), DQN (off-policy replay), IMPALA (async actor-learner with
+V-trace), offline BC/CQL over ray_tpu.data transition datasets, and
+multi-agent PPO (dict-keyed envs, per-policy mapping) over CPU rollout
+actors + jitted JAX learners (TPU when present).
+Reference: rllib/ (SURVEY.md §2.3 L7, §3.6)."""
+from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithm import (Algorithm, AlgorithmConfig,
                                      register_env)
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPoleEnv, SignEnv
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
+from ray_tpu.rllib.multi_agent import (MultiAgentEnv, MultiAgentPPO,
+                                       MultiAgentPPOConfig,
+                                       MultiCartPole)
+from ray_tpu.rllib.offline import (BC, BCConfig, CQL, CQLConfig,
+                                   episodes_to_dataset)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "register_env",
-    "PPO", "PPOConfig", "DQN", "DQNConfig", "Impala", "ImpalaConfig",
+    "PPO", "PPOConfig", "A2C", "A2CConfig", "DQN", "DQNConfig",
+    "Impala", "ImpalaConfig", "BC", "BCConfig", "CQL", "CQLConfig",
+    "episodes_to_dataset", "MultiAgentEnv", "MultiAgentPPO",
+    "MultiAgentPPOConfig", "MultiCartPole",
     "CartPoleEnv", "SignEnv",
 ]

@@ -1,0 +1,135 @@
+"""A2C: synchronous advantage actor-critic.
+
+Capability parity with the reference's A2C
+(rllib/algorithms/a2c/a2c.py — synchronous parallel sampling + one
+policy-gradient step per iteration; PPO minus the clipped surrogate
+and the multi-epoch SGD). Reuses PPO's rollout-worker actors and GAE;
+the learner is ONE jitted actor-critic update per iteration.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import ENV_REGISTRY
+from ray_tpu.rllib.ppo import PPO, RolloutWorker, _policy_defs
+
+
+@dataclasses.dataclass
+class A2CConfig:
+    env: str = "CartPole"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 256
+    gamma: float = 0.99
+    gae_lambda: float = 1.0          # classic A2C: plain returns
+    lr: float = 7e-4
+    hidden_size: int = 64
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.01
+    seed: int = 0
+
+    def build(self) -> "A2C":
+        return A2C(self)
+
+
+class A2C:
+    def __init__(self, config: A2CConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        probe = ENV_REGISTRY[config.env]()
+        self.model = _policy_defs(probe.observation_dim,
+                                  probe.num_actions, config.hidden_size)
+        self.params = self.model.init(
+            jax.random.PRNGKey(config.seed),
+            jnp.zeros((1, probe.observation_dim)))
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._iteration = 0
+        worker_cls = ray_tpu.remote(RolloutWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=0.5).remote(
+                config.env, config.hidden_size, config.seed + i)
+            for i in range(config.num_rollout_workers)]
+        self._update = self._build_update()
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        cfg = self.config
+        model, optimizer = self.model, self.optimizer
+
+        def loss_fn(params, batch):
+            logits, values = model.apply(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+            pg_loss = -jnp.mean(logp * batch["adv"])
+            vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            return (pg_loss + cfg.vf_coef * vf_loss -
+                    cfg.entropy_coef * entropy)
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            return optax.apply_updates(params, updates), opt_state, \
+                loss
+
+        return update
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        cfg = self.config
+        t0 = time.time()
+        weights_ref = ray_tpu.put(self.params)
+        ray_tpu.get([w.set_weights.remote(weights_ref)
+                     for w in self.workers])
+        batches = ray_tpu.get(
+            [w.sample.remote(cfg.rollout_fragment_length)
+             for w in self.workers])
+        obs, act, adv, ret = [], [], [], []
+        for b in batches:
+            a, r = PPO._gae(b, cfg.gamma, cfg.gae_lambda)
+            obs.append(b["obs"])
+            act.append(b["actions"])
+            adv.append(a)
+            ret.append(r)
+        adv = np.concatenate(adv)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        batch = {"obs": jnp.asarray(np.concatenate(obs)),
+                 "actions": jnp.asarray(np.concatenate(act)),
+                 "adv": jnp.asarray(adv),
+                 "returns": jnp.asarray(np.concatenate(ret))}
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state, batch)
+        self._iteration += 1
+        rewards = [r for w in ray_tpu.get(
+            [w.episode_rewards.remote() for w in self.workers])
+            for r in w]
+        return {
+            "training_iteration": self._iteration,
+            "loss": float(loss),
+            "episode_reward_mean": float(np.mean(rewards))
+            if rewards else float("nan"),
+            "num_env_steps_sampled":
+                cfg.rollout_fragment_length * len(self.workers),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def get_policy_params(self):
+        return self.params
+
+    def stop(self):
+        for w in self.workers:
+            ray_tpu.kill(w)

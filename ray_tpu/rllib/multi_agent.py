@@ -1,0 +1,297 @@
+"""Multi-agent RL: MultiAgentEnv + per-policy independent PPO.
+
+Capability parity with the reference's multi-agent support
+(rllib/env/multi_agent_env.py — dict-keyed obs/action/reward per
+agent; rllib/algorithms/algorithm_config.multi_agent() — a policies
+dict and a policy_mapping_fn routing agents to policies). Training is
+independent PPO per policy (the reference's default for parameter-
+unshared policies): each policy has its own params/optimizer and
+learns from exactly the transitions its agents generated; updates are
+the same jitted learner as single-agent PPO, batched per policy.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import ENV_REGISTRY, CartPoleEnv
+
+
+class MultiAgentEnv:
+    """Dict-keyed multi-agent episode interface
+    (rllib/env/multi_agent_env.py): reset/step return per-agent
+    dicts; "__all__" in dones ends the episode."""
+
+    agent_ids: List[str] = []
+    observation_dim: int = 0
+    num_actions: int = 0
+
+    def reset(self, seed=None) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, int]
+             ) -> Tuple[Dict, Dict, Dict, Dict]:
+        raise NotImplementedError
+
+
+class MultiCartPole(MultiAgentEnv):
+    """N independent CartPoles with shared episode boundaries — the
+    standard smoke-test multi-agent env (each agent's transitions are
+    its own; policies can be mapped per-agent or shared)."""
+
+    def __init__(self, num_agents: int = 2, max_steps: int = 200):
+        self.agent_ids = [f"agent_{i}" for i in range(num_agents)]
+        self._envs = {a: CartPoleEnv(max_steps=max_steps)
+                      for a in self.agent_ids}
+        probe = CartPoleEnv()
+        self.observation_dim = probe.observation_dim
+        self.num_actions = probe.num_actions
+        self._done: Dict[str, bool] = {}
+
+    def reset(self, seed=None) -> Dict[str, np.ndarray]:
+        self._done = {a: False for a in self.agent_ids}
+        return {a: e.reset(seed=None if seed is None else seed + i)
+                for i, (a, e) in enumerate(self._envs.items())}
+
+    def step(self, actions: Dict[str, int]):
+        obs, rew, done = {}, {}, {}
+        for a, act in actions.items():
+            if self._done[a]:
+                continue
+            o, r, d, _ = self._envs[a].step(act)
+            obs[a], rew[a], done[a] = o, r, d
+            self._done[a] = d
+        done["__all__"] = all(self._done.values())
+        return obs, rew, done, {}
+
+
+MULTI_ENV_REGISTRY: Dict[str, Callable[[], MultiAgentEnv]] = {
+    "MultiCartPole": MultiCartPole,
+}
+
+
+class MultiAgentRolloutWorker:
+    """Samples one multi-agent env with per-policy parameter sets;
+    returns per-POLICY transition batches."""
+
+    def __init__(self, env_name: str, hidden: int,
+                 policy_ids: List[str], mapping: Dict[str, str],
+                 seed: int):
+        from ray_tpu.rllib.ppo import _policy_defs
+        self.env = MULTI_ENV_REGISTRY[env_name]()
+        self.mapping = mapping
+        self._rng = np.random.RandomState(seed)
+        self._model = _policy_defs(self.env.observation_dim,
+                                   self.env.num_actions, hidden)
+        self._params: Dict[str, Any] = {}
+        self.obs = self.env.reset(seed=seed)
+        self._ep_rewards: Dict[str, float] = \
+            {a: 0.0 for a in self.env.agent_ids}
+        self.completed: List[float] = []
+
+    def set_weights(self, per_policy_params: Dict[str, Any]):
+        self._params = per_policy_params
+
+    def sample(self, num_steps: int) -> Dict[str, Dict[str, np.ndarray]]:
+        import jax
+        import jax.numpy as jnp
+        apply = jax.jit(self._model.apply)
+        bufs: Dict[str, Dict[str, list]] = {
+            p: {k: [] for k in ("obs", "actions", "rewards", "dones",
+                                "logp", "values")}
+            for p in set(self.mapping.values())}
+        for _ in range(num_steps):
+            actions = {}
+            step_info = {}
+            for a, o in self.obs.items():
+                pid = self.mapping[a]
+                logits, value = apply(self._params[pid],
+                                      jnp.asarray(o[None]))
+                logits = np.asarray(logits[0], np.float64)
+                probs = np.exp(logits - logits.max())
+                probs /= probs.sum()
+                act = int(self._rng.choice(len(probs), p=probs))
+                actions[a] = act
+                step_info[a] = (o, act,
+                                float(np.log(probs[act] + 1e-12)),
+                                float(value[0]))
+            nobs, rew, done, _ = self.env.step(actions)
+            for a, (o, act, logp, val) in step_info.items():
+                pid = self.mapping[a]
+                b = bufs[pid]
+                b["obs"].append(o)
+                b["actions"].append(act)
+                b["rewards"].append(rew.get(a, 0.0))
+                b["dones"].append(done.get(a, True))
+                b["logp"].append(logp)
+                b["values"].append(val)
+                self._ep_rewards[a] += rew.get(a, 0.0)
+            if done["__all__"]:
+                self.completed.append(
+                    sum(self._ep_rewards.values()))
+                self._ep_rewards = {a: 0.0
+                                    for a in self.env.agent_ids}
+                self.obs = self.env.reset()
+            else:
+                # Done agents leave the episode: only agents the env
+                # reported obs for keep acting (a finished agent must
+                # not keep feeding frozen-obs transitions into its
+                # policy's batch).
+                self.obs = nobs
+        out = {}
+        for pid, b in bufs.items():
+            if not b["actions"]:
+                continue
+            out[pid] = {
+                "obs": np.asarray(b["obs"], np.float32),
+                "actions": np.asarray(b["actions"], np.int32),
+                "rewards": np.asarray(b["rewards"], np.float32),
+                "dones": np.asarray(b["dones"], np.bool_),
+                "logp": np.asarray(b["logp"], np.float32),
+                "values": np.asarray(b["values"], np.float32),
+                "last_value": 0.0,
+            }
+        return out
+
+    def episode_rewards(self) -> List[float]:
+        return self.completed[-100:]
+
+
+@dataclasses.dataclass
+class MultiAgentPPOConfig:
+    env: str = "MultiCartPole"
+    policies: Tuple[str, ...] = ("shared",)
+    policy_mapping: Optional[Dict[str, str]] = None   # agent -> policy
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 128
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    lr: float = 3e-4
+    num_sgd_epochs: int = 2
+    minibatch_size: int = 64
+    hidden_size: int = 64
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.01
+    seed: int = 0
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO:
+    def __init__(self, config: MultiAgentPPOConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.rllib.ppo import PPOConfig, PPO, _policy_defs
+        self.config = config
+        probe = MULTI_ENV_REGISTRY[config.env]()
+        mapping = config.policy_mapping or {
+            a: config.policies[i % len(config.policies)]
+            for i, a in enumerate(probe.agent_ids)}
+        self.mapping = mapping
+        self.model = _policy_defs(probe.observation_dim,
+                                  probe.num_actions,
+                                  config.hidden_size)
+        self.optimizer = optax.adam(config.lr)
+        self.params: Dict[str, Any] = {}
+        self.opt_states: Dict[str, Any] = {}
+        for i, pid in enumerate(config.policies):
+            p = self.model.init(
+                jax.random.PRNGKey(config.seed + i),
+                jnp.zeros((1, probe.observation_dim)))
+            self.params[pid] = p
+            self.opt_states[pid] = self.optimizer.init(p)
+        # Reuse single-agent PPO's jitted minibatch-scan learner: the
+        # update is policy-agnostic (params in, params out).
+        ppo_cfg = PPOConfig(
+            env="CartPole", num_rollout_workers=0,
+            gamma=config.gamma, gae_lambda=config.gae_lambda,
+            clip_eps=config.clip_eps, lr=config.lr,
+            num_sgd_epochs=config.num_sgd_epochs,
+            minibatch_size=config.minibatch_size,
+            hidden_size=config.hidden_size,
+            vf_coef=config.vf_coef,
+            entropy_coef=config.entropy_coef, seed=config.seed)
+        shim = PPO.__new__(PPO)
+        shim.config = ppo_cfg
+        shim.model = self.model
+        shim.optimizer = self.optimizer
+        self._update = PPO._build_update(shim)
+        self._iteration = 0
+        worker_cls = ray_tpu.remote(MultiAgentRolloutWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=0.5).remote(
+                config.env, config.hidden_size,
+                list(config.policies), mapping, config.seed + i)
+            for i in range(config.num_rollout_workers)]
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        from ray_tpu.rllib.ppo import PPO
+        cfg = self.config
+        t0 = time.time()
+        wref = ray_tpu.put(self.params)
+        ray_tpu.get([w.set_weights.remote(wref)
+                     for w in self.workers])
+        per_worker = ray_tpu.get(
+            [w.sample.remote(cfg.rollout_fragment_length)
+             for w in self.workers])
+        losses = {}
+        for pid in cfg.policies:
+            obs, act, logp, adv, ret = [], [], [], [], []
+            for batches in per_worker:
+                b = batches.get(pid)
+                if b is None:
+                    continue
+                a, r = PPO._gae(b, cfg.gamma, cfg.gae_lambda)
+                obs.append(b["obs"])
+                act.append(b["actions"])
+                logp.append(b["logp"])
+                adv.append(a)
+                ret.append(r)
+            if not obs:
+                continue
+            advs = np.concatenate(adv)
+            advs = (advs - advs.mean()) / (advs.std() + 1e-8)
+            data = {"obs": np.concatenate(obs),
+                    "actions": np.concatenate(act),
+                    "logp": np.concatenate(logp),
+                    "adv": advs,
+                    "returns": np.concatenate(ret)}
+            n = len(data["actions"])
+            mbs = max(1, min(cfg.minibatch_size, n))
+            n_mb = max(1, n // mbs)
+            order = np.random.RandomState(
+                cfg.seed + self._iteration).permutation(n)[:n_mb * mbs]
+            stacked = {
+                k: jnp.asarray(v[order].reshape(
+                    (n_mb, mbs) + v.shape[1:]))
+                for k, v in data.items()}
+            reps = {k: jnp.concatenate([stacked[k]] *
+                                       cfg.num_sgd_epochs)
+                    for k in stacked}
+            self.params[pid], self.opt_states[pid], loss = \
+                self._update(self.params[pid], self.opt_states[pid],
+                             reps)
+            losses[pid] = float(loss)
+        self._iteration += 1
+        rewards = [r for w in ray_tpu.get(
+            [w.episode_rewards.remote() for w in self.workers])
+            for r in w]
+        return {
+            "training_iteration": self._iteration,
+            "policy_loss": losses,
+            "episode_reward_mean": float(np.mean(rewards))
+            if rewards else float("nan"),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def stop(self):
+        for w in self.workers:
+            ray_tpu.kill(w)

@@ -1,0 +1,221 @@
+"""Offline RL: behavior cloning (BC) and conservative Q-learning (CQL).
+
+Capability parity with the reference's offline stack
+(rllib/algorithms/bc/bc.py, rllib/algorithms/cql/cql.py, offline data
+via ray.data — rllib/offline/): transitions live in a
+ray_tpu.data.Dataset of row dicts {obs, action, reward, next_obs,
+done}; learners are jitted JAX updates over shuffled minibatches
+(no environment interaction — pure dataset training).
+
+TPU-native stance: the whole offline epoch (scan over minibatches) is
+one compiled program, matching the online learners.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import ENV_REGISTRY
+
+
+def episodes_to_dataset(rollouts: List[Dict[str, np.ndarray]]):
+    """Turn rollout-worker sample batches into an offline transition
+    Dataset (the reference writes JSON sample batches via
+    rllib/offline/json_writer.py; here blocks go straight into the
+    object store)."""
+    from ray_tpu.data import Dataset
+    blocks = []
+    for b in rollouts:
+        rows = []
+        n = len(b["actions"])
+        for t in range(n):
+            next_obs = b["obs"][t + 1] if t + 1 < n else b["obs"][t]
+            rows.append({
+                "obs": np.asarray(b["obs"][t], np.float32),
+                "action": int(b["actions"][t]),
+                "reward": float(b["rewards"][t]),
+                "next_obs": np.asarray(next_obs, np.float32),
+                "done": bool(b["dones"][t]),
+            })
+        blocks.append(ray_tpu.put(rows))
+    return Dataset(blocks)
+
+
+def _dataset_arrays(dataset) -> Dict[str, np.ndarray]:
+    rows = dataset.take_all()
+    return {
+        "obs": np.asarray([r["obs"] for r in rows], np.float32),
+        "action": np.asarray([r["action"] for r in rows], np.int32),
+        "reward": np.asarray([r["reward"] for r in rows], np.float32),
+        "next_obs": np.asarray([r["next_obs"] for r in rows],
+                               np.float32),
+        "done": np.asarray([r["done"] for r in rows], np.bool_),
+    }
+
+
+@dataclasses.dataclass
+class BCConfig:
+    env: str = "CartPole"          # for obs/action dims only
+    lr: float = 1e-3
+    hidden_size: int = 64
+    batch_size: int = 256
+    seed: int = 0
+
+    def build(self, dataset) -> "BC":
+        return BC(self, dataset)
+
+
+class BC:
+    """Behavior cloning: supervised cross-entropy on dataset actions
+    (rllib/algorithms/bc/bc.py — MARWIL with beta=0)."""
+
+    def __init__(self, config: BCConfig, dataset):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.rllib.ppo import _policy_defs
+        self.config = config
+        probe = ENV_REGISTRY[config.env]()
+        self.model = _policy_defs(probe.observation_dim,
+                                  probe.num_actions,
+                                  config.hidden_size)
+        self.params = self.model.init(
+            jax.random.PRNGKey(config.seed),
+            jnp.zeros((1, probe.observation_dim)))
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.data = _dataset_arrays(dataset)
+        self._rng = np.random.RandomState(config.seed)
+        self._iteration = 0
+        model, optimizer = self.model, self.optimizer
+
+        def loss_fn(params, mb):
+            logits, _ = model.apply(params, mb["obs"])
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, mb["action"][:, None], axis=-1)[:, 0]
+            return jnp.mean(nll)
+
+        @jax.jit
+        def update(params, opt_state, mb):
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            return optax.apply_updates(params, updates), opt_state, \
+                loss
+
+        self._update = update
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        n = len(self.data["action"])
+        idx = self._rng.choice(n, size=min(self.config.batch_size, n),
+                               replace=False)
+        mb = {"obs": jnp.asarray(self.data["obs"][idx]),
+              "action": jnp.asarray(self.data["action"][idx])}
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state, mb)
+        self._iteration += 1
+        return {"training_iteration": self._iteration,
+                "loss": float(loss)}
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        import jax.numpy as jnp
+        logits, _ = self.model.apply(self.params,
+                                     jnp.asarray(obs[None]))
+        return int(np.argmax(np.asarray(logits)[0]))
+
+
+@dataclasses.dataclass
+class CQLConfig:
+    env: str = "CartPole"
+    lr: float = 5e-4
+    hidden_size: int = 64
+    batch_size: int = 256
+    gamma: float = 0.99
+    cql_alpha: float = 1.0         # conservative penalty weight
+    target_update_every: int = 20
+    seed: int = 0
+
+    def build(self, dataset) -> "CQL":
+        return CQL(self, dataset)
+
+
+class CQL:
+    """Discrete conservative Q-learning
+    (rllib/algorithms/cql/cql.py; Kumar et al. 2020): DQN's TD loss
+    plus alpha * (logsumexp_a Q(s,a) - Q(s, a_data)) — pushing down
+    out-of-distribution action values so the offline policy never
+    exploits unobserved actions."""
+
+    def __init__(self, config: CQLConfig, dataset):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.rllib.dqn import _q_net
+        self.config = config
+        probe = ENV_REGISTRY[config.env]()
+        self.model = _q_net(probe.observation_dim, probe.num_actions,
+                            config.hidden_size)
+        self.params = self.model.init(
+            jax.random.PRNGKey(config.seed),
+            jnp.zeros((1, probe.observation_dim)))
+        self.target_params = self.params
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.data = _dataset_arrays(dataset)
+        self._rng = np.random.RandomState(config.seed)
+        self._iteration = 0
+        model, optimizer, cfg = self.model, self.optimizer, config
+
+        def loss_fn(params, target_params, mb):
+            q = model.apply(params, mb["obs"])
+            q_data = jnp.take_along_axis(
+                q, mb["action"][:, None], axis=-1)[:, 0]
+            q_next = model.apply(target_params, mb["next_obs"])
+            target = mb["reward"] + cfg.gamma * \
+                (1.0 - mb["done"]) * jnp.max(q_next, axis=-1)
+            td = jnp.mean((q_data - jax.lax.stop_gradient(target))
+                          ** 2)
+            conservative = jnp.mean(
+                jax.scipy.special.logsumexp(q, axis=-1) - q_data)
+            return td + cfg.cql_alpha * conservative, \
+                (td, conservative)
+
+        @jax.jit
+        def update(params, target_params, opt_state, mb):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, mb)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            return optax.apply_updates(params, updates), opt_state, \
+                loss, aux
+
+        self._update = update
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        cfg = self.config
+        n = len(self.data["action"])
+        idx = self._rng.choice(n, size=min(cfg.batch_size, n),
+                               replace=False)
+        mb = {k: jnp.asarray(v[idx].astype(np.float32)
+                             if k in ("reward",) else v[idx])
+              for k, v in self.data.items()}
+        mb["done"] = mb["done"].astype(jnp.float32)
+        self.params, self.opt_state, loss, (td, cons) = self._update(
+            self.params, self.target_params, self.opt_state, mb)
+        self._iteration += 1
+        if self._iteration % cfg.target_update_every == 0:
+            self.target_params = self.params
+        return {"training_iteration": self._iteration,
+                "loss": float(loss), "td_loss": float(td),
+                "conservative_gap": float(cons)}
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        import jax.numpy as jnp
+        q = self.model.apply(self.params, jnp.asarray(obs[None]))
+        return int(np.argmax(np.asarray(q)[0]))

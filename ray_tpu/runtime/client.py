@@ -304,15 +304,21 @@ def submit_task_via_head(head: RpcClient, spec: TaskSpec,
         from ray_tpu._private.runtime_env import runtime_env_key
         meta["env_key"] = runtime_env_key(spec.runtime_env)
         meta["runtime_env"] = spec.runtime_env
+    ref_args = [a.id.hex() for a in spec.args
+                if isinstance(a, ObjectRef)]
+    if ref_args:
+        # Queue-time arg pinning: the head holds these against the
+        # borrower protocol's eager free until the task leaves the
+        # system — a caller dropping its own ref right after a burst
+        # submit must not free an argument out from under tasks still
+        # queued (head._pin_args_locked).
+        meta["pin_oids"] = ref_args[:64]
     if strat_meta is not None:
         meta["strategy"] = strat_meta
-    else:
+    elif ref_args:
         # Locality hints: schedule where the argument objects live
         # (lease_policy.cc locality path). Hex ids only — cheap.
-        arg_oids = [a.id.hex() for a in spec.args
-                    if isinstance(a, ObjectRef)][:16]
-        if arg_oids:
-            meta["arg_oids"] = arg_oids
+        meta["arg_oids"] = ref_args[:16]
     _submit_buffer(head).add(meta, payload)
     return refs
 
@@ -386,46 +392,55 @@ class _DirectActorSender:
         self._thread: Optional[threading.Thread] = None
 
     def add(self, actor_id_hex: str, payload: bytes) -> bool:
-        eager = None
+        eager = False
         with self._lock:
             if self._stopped:
                 return False     # route was torn down: caller re-routes
             self._buf.append((actor_id_hex, payload, 0))
             if len(self._buf) >= self.FLUSH_AT:
-                eager, self._buf = self._buf, []
+                eager = True
             elif self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
                     target=self._loop, daemon=True,
                     name="actor-direct-send")
                 self._thread.start()
-        if eager is not None:
-            self._ship(eager)
+        if eager:
+            self._ship_pending()
         else:
             self._wake.set()
         return True
 
-    def _ship(self, batch):
+    def _ship_pending(self):
+        """Drain-and-deliver under the ship lock. The buffer is popped
+        INSIDE the lock, so two concurrent shippers (the flusher and
+        an eager caller thread) can never deliver out of enqueue order
+        — whoever wins the lock takes everything buffered so far."""
+        with self._ship_lock:
+            with self._lock:
+                batch, self._buf = self._buf, []
+            if not batch:
+                return
+            self._ship_locked(batch)
+
+    def _ship_locked(self, batch):
         # Request/reply (not one-way): a one-way send to a freshly
         # killed worker disappears into the TCP buffer with no error,
         # silently dropping calls. The reply is the delivery ack; its
         # cost is one RTT per BATCH (callers never block here — the
-        # flusher thread pays it). The ship lock keeps an eager
-        # caller-thread ship from overtaking the flusher's in-flight
-        # batch (per-caller ordering). Duplicate delivery on a timed-
+        # flusher thread pays it). Duplicate delivery on a timed-
         # out-but-delivered batch is suppressed worker-side by task-id
         # dedup.
-        with self._ship_lock:
-            for _attempt in range(2):
-                try:
-                    self._client.call("push_actor_tasks", batch)
-                    return
-                except Exception:
-                    continue
-            # Worker unreachable: invalidate the route and hand every
-            # call to the head, which re-resolves (or fails the
-            # return objects).
-            _drop_actor_route(self._head, self._addr)
-            self._reroute(batch)
+        for _attempt in range(2):
+            try:
+                self._client.call("push_actor_tasks", batch)
+                return
+            except Exception:
+                continue
+        # Worker unreachable: invalidate the route and hand every
+        # call to the head, which re-resolves (or fails the
+        # return objects).
+        _drop_actor_route(self._head, self._addr)
+        self._reroute(batch)
 
     def _reroute(self, batch):
         for actor_id_hex, payload, attempts in batch:
@@ -454,10 +469,7 @@ class _DirectActorSender:
             self._wake.wait(timeout=1.0)
             self._wake.clear()
             time.sleep(self.WINDOW_S)
-            with self._lock:
-                batch, self._buf = self._buf, []
-            if batch:
-                self._ship(batch)
+            self._ship_pending()
 
 
 def _direct_state(head: RpcClient):

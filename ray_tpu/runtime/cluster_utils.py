@@ -43,6 +43,8 @@ class Cluster:
         worker_mod._worker = worker_mod.Worker(self.runtime,
                                                mode="driver")
         set_global_reference_counter(self.runtime.ref_counter)
+        from ray_tpu._private.object_ref import set_borrow_notifier
+        set_borrow_notifier(self.runtime.plane.note_borrow)
         self._connected = True
         return self.runtime
 
@@ -134,6 +136,8 @@ class Cluster:
         if self._connected:
             worker_mod._worker = None
             set_global_reference_counter(None)
+            from ray_tpu._private.object_ref import set_borrow_notifier
+            set_borrow_notifier(None)
             self._connected = False
         for proc in self.agent_procs.values():
             try:

@@ -346,6 +346,7 @@ class HeadService:
                 locs.discard(node_id)
         for wid in workers:
             self.mark_worker_dead(wid)
+        self._reconcile_borrows_for_dead_node(node_id)
         self._publish_nodes()
         self.hub.publish_stream(
             "node_events", {"type": "node_dead", "node_id": node_id,
@@ -368,6 +369,7 @@ class HeadService:
                         stale.append(n.node_id)
             for node_id in stale:
                 self.mark_node_dead(node_id)
+            self._sweep_borrows(now)
             self._sync_resources()
 
     # ---- resource syncer (ray_syncer / gcs_resource_manager role:
@@ -421,9 +423,19 @@ class HeadService:
 
     # ---- object directory (owner-based location parity) -------------------
 
+    # Recently-freed guard: a worker finishing a task AFTER the caller
+    # already dropped the return ref re-registers an object the head
+    # just freed; without this, that late registration resurrects a
+    # location entry for an owner-less object (it would linger until
+    # LRU). Bounded FIFO — the race window is sub-second.
+    _RECENT_FREED_CAP = 100_000
+
     def register_objects(self, node_id: str, oid_hexes: List[str]):
         with self._lock:
+            rf = getattr(self, "_recently_freed", None)
             for oid_hex in oid_hexes:
+                if rf is not None and oid_hex in rf:
+                    continue     # freed already: don't resurrect
                 self._obj_locs.setdefault(oid_hex,
                                            _OrderedSet()).add(node_id)
 
@@ -556,20 +568,189 @@ class HeadService:
                 if not locs:
                     del self._obj_locs[oid_hex]
 
+    # ---- distributed borrower protocol ------------------------------------
+    # The owner-eager-GC extension for ESCAPED refs (reference:
+    # reference_count.h:39-61 — the owner tracks borrowers and frees
+    # only after every borrow drops). Head-brokered here: borrowers
+    # register/drop with the head (batched, async), owners report
+    # their own last-ref drop, and the head frees an escaped object
+    # once owner_released AND borrows==0 AND a grace window has passed
+    # since the last escape (covering the pickle->deserialize gap
+    # where a borrow exists on the wire but is not yet registered).
+
+    def _pin_args_locked(self, meta) -> None:
+        """Pin a queued/running task's ref args against borrower-
+        protocol eager free (reference: task specs hold references
+        until the task completes, reference_count.h). Mirrors
+        _task_meta's lifecycle exactly: pinned at ingest/requeue,
+        unpinned wherever the meta leaves the table."""
+        pins = getattr(self, "_arg_pins", None)
+        if pins is None:
+            pins = self._arg_pins = {}
+        for oh in meta.get("pin_oids", ()):
+            pins[oh] = pins.get(oh, 0) + 1
+
+    def _unpin_args_locked(self, meta) -> None:
+        if not meta:
+            return
+        pins = getattr(self, "_arg_pins", None)
+        if not pins:
+            return
+        st = getattr(self, "_borrows", None)
+        from ray_tpu._private.config import GlobalConfig
+        now = time.time()
+        for oh in meta.get("pin_oids", ()):
+            n = pins.get(oh, 0) - 1
+            if n > 0:
+                pins[oh] = n
+                continue
+            pins.pop(oh, None)
+            # Last pin gone: if the owner already released and no
+            # borrows remain, start the free clock now.
+            if st:
+                ent = st.get(oh)
+                if ent and ent["released"] and ent["n"] == 0 and \
+                        ent["free_at"] is None:
+                    ent["free_at"] = now + GlobalConfig.borrow_grace_s
+
+    def _borrow_state(self) -> Dict[str, Dict[str, Any]]:
+        st = getattr(self, "_borrows", None)
+        if st is None:
+            st = self._borrows = {}
+        return st
+
+    def add_borrows(self, oid_hexes: List[str],
+                    node_id: str = "") -> None:
+        with self._lock:
+            st = self._borrow_state()
+            for oh in oid_hexes:
+                ent = st.setdefault(oh, {"n": 0, "released": False,
+                                         "free_at": None,
+                                         "by_node": {}})
+                ent["n"] += 1
+                bn = ent.setdefault("by_node", {})
+                bn[node_id] = bn.get(node_id, 0) + 1
+
+    def drop_borrows(self, oid_hexes: List[str],
+                     node_id: str = "") -> None:
+        from ray_tpu._private.config import GlobalConfig
+        grace = GlobalConfig.borrow_grace_s
+        now = time.time()
+        with self._lock:
+            st = self._borrow_state()
+            for oh in oid_hexes:
+                ent = st.get(oh)
+                if ent is None:
+                    continue
+                ent["n"] = max(0, ent["n"] - 1)
+                bn = ent.get("by_node")
+                if bn is not None and node_id in bn:
+                    bn[node_id] -= 1
+                    if bn[node_id] <= 0:
+                        del bn[node_id]
+                if ent["n"] == 0:
+                    pins = getattr(self, "_arg_pins", None) or {}
+                    if ent["released"]:
+                        if not pins.get(oh):
+                            # Grace after the LAST drop too: the
+                            # borrower may have re-pickled the ref to
+                            # a third process whose registration is
+                            # still in flight.
+                            ent["free_at"] = now + grace
+                    else:
+                        del st[oh]              # owner still holds it
+        self._sweep_borrows(now)
+
+    def owner_released(self, items: List) -> None:
+        """Owner's last local ref dropped for escaped objects.
+        items: [(oid_hex, seconds_since_last_escape), ...]."""
+        from ray_tpu._private.config import GlobalConfig
+        grace = GlobalConfig.borrow_grace_s
+        now = time.time()
+        with self._lock:
+            st = self._borrow_state()
+            pins = getattr(self, "_arg_pins", None) or {}
+            for oh, age in items:
+                ent = st.setdefault(oh, {"n": 0, "released": False,
+                                         "free_at": None})
+                ent["released"] = True
+                if ent["n"] == 0 and not pins.get(oh):
+                    ent["free_at"] = now + max(0.0, grace - age)
+        self._sweep_borrows(now)
+
+    def _reconcile_borrows_for_dead_node(self, node_id: str) -> None:
+        """A dead node's borrow registrations can never be dropped by
+        their (dead) borrowers: forget them so escaped objects still
+        free eagerly instead of leaking the head entry forever
+        (reference: the owner clears borrowers on borrower death,
+        reference_count.h). Borrows from surviving processes on other
+        nodes are untouched. (A single crashed WORKER on a live node
+        is narrower: its borrows fall back to the LRU bound.)"""
+        from ray_tpu._private.config import GlobalConfig
+        grace = GlobalConfig.borrow_grace_s
+        now = time.time()
+        with self._lock:
+            st = getattr(self, "_borrows", None)
+            if not st:
+                return
+            pins = getattr(self, "_arg_pins", None) or {}
+            for oh in list(st):
+                ent = st[oh]
+                bn = ent.get("by_node")
+                if not bn or node_id not in bn:
+                    continue
+                dead = bn.pop(node_id)
+                ent["n"] = max(0, ent["n"] - dead)
+                if ent["n"] == 0:
+                    if ent["released"]:
+                        if not pins.get(oh):
+                            ent["free_at"] = now + grace
+                    else:
+                        del st[oh]
+        self._sweep_borrows(now)
+
+    def _sweep_borrows(self, now: float) -> None:
+        ready = []
+        with self._lock:
+            st = getattr(self, "_borrows", None)
+            if not st:
+                return
+            for oh in list(st):
+                ent = st[oh]
+                if ent["released"] and ent["n"] == 0 and \
+                        ent["free_at"] is not None and \
+                        now >= ent["free_at"]:
+                    ready.append(oh)
+                    del st[oh]
+        if ready:
+            self.free_objects(ready)
+
     def free_objects(self, oid_hexes: List[str]):
         """Owner-driven eager free (reference: reference_count.h:39-61
         owner releases -> deletes broadcast to holders): the owner's
         last ref dropped, so every node's copy can go NOW instead of
         waiting for LRU pressure. Location directory and lineage are
         cleared (a deliberately freed object must not be rebuilt); the
-        delete rides the pub/sub hub to every node agent."""
-        with self._lock:
-            for oid_hex in oid_hexes:
-                self._obj_locs.pop(oid_hex, None)
-                ent = self._lineage.pop(oid_hex, None)
-                if ent is not None:
-                    self._lineage_bytes -= ent.get("cost", 0)
-        self.hub.publish_stream("object_free", {"oids": oid_hexes})
+        delete rides the pub/sub hub to every node agent. Processed in
+        chunks: a million-ref drop must not hold the head lock or ship
+        one giant pub/sub frame while transfers are in flight."""
+        CHUNK = 20000
+        for i in range(0, len(oid_hexes), CHUNK):
+            part = oid_hexes[i:i + CHUNK]
+            with self._lock:
+                rf = getattr(self, "_recently_freed", None)
+                if rf is None:
+                    import collections as _c
+                    rf = self._recently_freed = _c.OrderedDict()
+                for oid_hex in part:
+                    self._obj_locs.pop(oid_hex, None)
+                    ent = self._lineage.pop(oid_hex, None)
+                    if ent is not None:
+                        self._lineage_bytes -= ent.get("cost", 0)
+                    rf[oid_hex] = True
+                while len(rf) > self._RECENT_FREED_CAP:
+                    rf.popitem(last=False)
+            self.hub.publish_stream("object_free", {"oids": part})
 
     def locate_object(self, oid_hex: str, probe: bool = False,
                       reconstruct: bool = False) -> List[Dict[str, str]]:
@@ -692,6 +873,7 @@ class HeadService:
             meta["state"] = "pending"
             meta["reconstruction"] = True
             self._task_meta[task_id] = meta
+            self._pin_args_locked(meta)
             self._enqueue_locked(task_id, meta)
             return True
 
@@ -867,6 +1049,7 @@ class HeadService:
                 meta["attempt"] = 0
                 meta["state"] = "pending"
                 self._task_meta[meta["task_id"]] = meta
+                self._pin_args_locked(meta)
                 strat = meta.get("strategy")
                 sig = (tuple(sorted(meta.get("resources",
                                              {}).items())),
@@ -1118,6 +1301,7 @@ class HeadService:
                 continue
             for task_id in queue:
                 meta = self._task_meta.pop(task_id, None)
+                self._unpin_args_locked(meta)
                 if meta is not None:
                     doomed.append(meta["return_ids"])
             del self._pending[sig]
@@ -1219,6 +1403,7 @@ class HeadService:
                 w.last_active = time.time()
             for task_id in task_ids:
                 meta = self._task_meta.pop(task_id, None)
+                self._unpin_args_locked(meta)
                 if w is not None:
                     w.running.discard(task_id)
                     held = w.running_res.pop(task_id, None)
@@ -1256,6 +1441,7 @@ class HeadService:
                 self._enqueue_locked(task_id, meta)
                 return
             self._task_meta.pop(task_id, None)
+            self._unpin_args_locked(meta)
         self._store_error(meta["return_ids"],
                           NodeDiedError(
                               f"worker died running task {task_id}"))

@@ -58,6 +58,19 @@ def spawn_worker_process(head_address: str, store_name: str,
         # Dedicated env-keyed worker (worker_pool.h:149 parity): the
         # env is applied once at startup; the process IS the env.
         cmd += ["--runtime-env", json.dumps(runtime_env)]
+        if runtime_env.get("container"):
+            # Container env: the worker runs inside the image with
+            # host networking + /dev/shm + the repo mounted through
+            # (reference: runtime_env/container.py wraps the worker
+            # command in podman run).
+            from ray_tpu._private.runtime_env import \
+                container_command_prefix
+            pass_env = {k: v for k, v in env.items()
+                        if k.startswith(("RAY_TPU_", "JAX_", "XLA_"))}
+            prefix = container_command_prefix(runtime_env,
+                                              env_vars=pass_env)
+            cmd = prefix + ["python", "-m",
+                            "ray_tpu.runtime.worker_main"] + cmd[3:]
     return subprocess.Popen(cmd, cwd=_REPO_ROOT, env=env)
 
 
@@ -147,6 +160,24 @@ class NodeManager:
                               self.store_name)
         self._service_plane.refresh_multinode()
         prewarm_transfer_path(self.store, self.object_server.address)
+        # Owner-driven eager free: the head broadcasts freed ids on
+        # `object_free` (including borrower-protocol frees of escaped
+        # objects) — the HEAD node's copies drop here, same as every
+        # agent node (node_agent.py does the same for its store).
+        try:
+            from ray_tpu._private.ids import ObjectID
+            from ray_tpu.runtime.pubsub import Subscriber
+            self._free_sub = Subscriber(RpcClient(self._head_address))
+
+            def _on_free(_seq, item):
+                for oid_hex in item.get("oids", ()):
+                    try:
+                        self.store.delete(ObjectID.from_hex(oid_hex))
+                    except Exception:
+                        pass      # not on this node: fine
+            self._free_sub.subscribe_stream("object_free", _on_free)
+        except Exception:
+            self._free_sub = None
         self.procs: Dict[str, subprocess.Popen] = {}
         self.tpu_owner_worker = tpu_owner_worker
         self._stopped = False

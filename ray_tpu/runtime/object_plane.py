@@ -321,14 +321,24 @@ class ObjectPlane:
         # is the overwhelmingly common put-use-drop pattern.
         self._owned: set = set()
         self._escaped: set = set()
+        self._escape_ts: Dict[ObjectID, float] = {}
+        # Refs THIS process borrowed (deserialized from another
+        # owner): registered with the head so escaped objects free on
+        # last-borrow-drop instead of lingering under LRU (the
+        # head-brokered borrower protocol, head.py add_borrows).
+        self._borrowed: set = set()
         self._own_lock = threading.Lock()
         self._pull_sem = threading.BoundedSemaphore(
             max(1, _INFLIGHT_PULL_BYTES // CHUNK))
         self._peers: Dict[str, RpcClient] = {}
         self._peers_lock = threading.Lock()
-        # Batched async put registration + owner-driven frees.
+        # Batched async put registration + owner-driven frees +
+        # borrower-protocol traffic.
         self._pending_reg: List[str] = []
         self._pending_free: List[str] = []
+        self._pending_borrow: List[str] = []
+        self._pending_borrow_drop: List[str] = []
+        self._pending_owner_released: List = []
         self._reg_lock = threading.Lock()
         self._reg_wake = threading.Event()
         self._reg_thread: Optional[threading.Thread] = None
@@ -410,6 +420,7 @@ class ObjectPlane:
             if oid not in self._owned:
                 return
             self._escaped.add(oid)
+            self._escape_ts[oid] = time.time()
         data = self.memory.pop(oid)
         if data is not None:
             self._promote_blob(oid, data)
@@ -421,6 +432,21 @@ class ObjectPlane:
         never as pickled refs, so they can't self-escape)."""
         with self._own_lock:
             self._owned.update(oids)
+
+    def note_borrow(self, oid: ObjectID) -> None:
+        """A ref owned ELSEWHERE was deserialized in this process:
+        register the borrow with the head (batched). Called from
+        ObjectRef creation via the borrow-notifier hook. (Also on
+        single-node clusters — the owner may be another process on
+        this node.)"""
+        with self._own_lock:
+            if oid in self._owned or oid in self._borrowed:
+                return          # own object, or borrow already noted
+            self._borrowed.add(oid)
+        with self._reg_lock:
+            self._pending_borrow.append(oid.hex())
+        self._ensure_reg_thread()
+        self._reg_wake.set()
 
     def release_owned(self, oid: ObjectID) -> None:
         """Zero-ref notification (called from ObjectRef.__del__, which
@@ -455,15 +481,28 @@ class ObjectPlane:
                 return
             with self._own_lock:
                 if oid not in self._owned:
+                    if oid in self._borrowed:
+                        # Last local ref of a BORROWED object: tell
+                        # the owner-side protocol (batched).
+                        self._borrowed.discard(oid)
+                        with self._reg_lock:
+                            self._pending_borrow_drop.append(oid.hex())
                     continue
                 self._owned.discard(oid)
                 escaped = oid in self._escaped
+                esc_age = None
                 if escaped:
                     self._escaped.discard(oid)
+                    esc_age = time.time() -                         self._escape_ts.pop(oid, time.time())
             self._device_released(oid, escaped)
             if escaped:
-                # external holders may exist: keep the object,
-                # drop the (now-dead) bookkeeping
+                # External holders may exist: keep the object for now
+                # and hand lifetime to the head's borrower protocol —
+                # it frees the copies once every registered borrow
+                # drops (plus a grace window for in-flight handoffs).
+                with self._reg_lock:
+                    self._pending_owner_released.append(
+                        (oid.hex(), esc_age))
                 continue
             was_inline = self.memory.pop(oid) is not None
             try:
@@ -512,7 +551,17 @@ class ObjectPlane:
             self._drain_releases()
             with self._reg_lock:
                 batch, self._pending_reg = self._pending_reg, []
-                frees, self._pending_free = self._pending_free, []
+                # Bound each free RPC: dropping a million refs at once
+                # (deep-queue churn) must not serialize into one giant
+                # frame that stalls the head for seconds.
+                frees = self._pending_free[:20000]
+                del self._pending_free[:20000]
+                borrows, self._pending_borrow = \
+                    self._pending_borrow, []
+                drops, self._pending_borrow_drop = \
+                    self._pending_borrow_drop, []
+                released, self._pending_owner_released = \
+                    self._pending_owner_released, []
             if batch:
                 try:
                     self.head.call("register_objects", self.node_id,
@@ -524,6 +573,26 @@ class ObjectPlane:
                     self.head.call("free_objects", frees)
                 except Exception:
                     pass    # LRU/spill still bounds remote copies
+                with self._reg_lock:
+                    if self._pending_free:
+                        self._reg_wake.set()    # keep draining
+            if borrows:
+                try:
+                    self.head.call("add_borrows", borrows,
+                                   self.node_id)
+                except Exception:
+                    pass    # worst case: LRU bounds the object
+            if drops:
+                try:
+                    self.head.call("drop_borrows", drops,
+                                   self.node_id)
+                except Exception:
+                    pass
+            if released:
+                try:
+                    self.head.call("owner_released", released)
+                except Exception:
+                    pass
 
     def flush_registrations(self) -> None:
         with self._reg_lock:

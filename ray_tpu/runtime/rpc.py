@@ -338,6 +338,29 @@ class RpcClient:
                 pass
             raise reply.get("err") or RpcError(
                 f"RPC {method}: connection rejected")
+        if "raw" in reply:
+            # A raw_-framed method was invoked via plain call(): the
+            # payload is already in flight on this pooled socket, so
+            # drain it before reuse (leaving it would desynchronize
+            # every later call on the connection), then fail clearly.
+            n = int(reply["raw"])
+            try:
+                left = n
+                sink = bytearray(min(left, 1 << 20))
+                while left > 0:
+                    got = sock.recv_into(sink, min(left, len(sink)))
+                    if got == 0:
+                        raise ConnectionError("peer closed mid-drain")
+                    left -= got
+                self._put_conn(sock)
+            except (ConnectionError, OSError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise RpcError(
+                f"RPC {method} returns a raw-framed payload "
+                f"({n} bytes); use call_into() with a dest buffer")
         self._put_conn(sock)
         if "err" in reply:
             raise reply["err"]

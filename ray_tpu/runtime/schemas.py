@@ -71,6 +71,11 @@ SCHEMAS: Dict[str, Tuple[Param, ...]] = {
                  P("source_node", str),
                  P("slot_ts", (int, float), required=False)),
     "unregister_object": (P("oid_hex", str), P("node_id", str)),
+    "add_borrows": (P("oid_hexes", list),
+                    P("node_id", str, required=False)),
+    "drop_borrows": (P("oid_hexes", list),
+                     P("node_id", str, required=False)),
+    "owner_released": (P("items", list),),
     "object_size": (P("oid_hex", str),),
     "has_object": (P("oid_hex", str),),
     "pull_chunk": (P("oid_hex", str), P("offset", int),

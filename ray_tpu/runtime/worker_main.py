@@ -537,7 +537,18 @@ class Executor:
         direct actor transport — tasks skip the head entirely). Items
         are (actor_id, payload, attempts) tuples; per-caller ordering
         rides the caller's dedicated one-way socket, exactly like the
-        head's dispatch senders."""
+        head's dispatch senders.
+
+        Delivery semantics across ACTOR RESTART are at-least-once:
+        the replay filter (slot.seen_tasks) dies with the worker, so a
+        batch delivered-but-unacked just before a crash can be
+        replayed via the head's reroute to the RESTARTED actor and
+        re-execute its side effects — the same window the documented
+        ordering relaxation on restart already implies. Exactly-once
+        across restarts would need the seen set persisted through the
+        head's actor rebind; callers needing it should make actor
+        methods idempotent (the reference gives the same guidance for
+        max_task_retries with side-effecting actors)."""
         for actor_id, payload, attempts in items:
             self.push_actor_task(actor_id, payload, attempts)
         return "queued"
@@ -807,6 +818,21 @@ def main():
                     os.execve(venv_py, [venv_py, "-m",
                                         "ray_tpu.runtime.worker_main",
                                         *sys.argv[1:]], env)
+            elif startup_env.get("conda") is not None:
+                # conda env: resolve (or create) it on this node and
+                # RE-EXEC under its interpreter (reference:
+                # runtime_env/conda.py — the worker process IS the
+                # env). The marker breaks the exec loop.
+                from ray_tpu._private.runtime_env import \
+                    conda_env_python
+                conda_py = conda_env_python(startup_env)
+                if os.environ.get("RAY_TPU_CONDA") != conda_py:
+                    env = dict(os.environ)
+                    env["RAY_TPU_CONDA"] = conda_py
+                    os.execve(conda_py,
+                              [conda_py, "-m",
+                               "ray_tpu.runtime.worker_main",
+                               *sys.argv[1:]], env)
             # Dedicated env-keyed worker: apply once, forever — the
             # head routes only matching tasks/actors here, so
             # per-execution apply/restore is skipped (true process
@@ -847,6 +873,8 @@ def main():
     from ray_tpu._private.object_ref import set_global_reference_counter
     worker_mod._worker = worker_mod.Worker(runtime, mode="worker")
     set_global_reference_counter(runtime.ref_counter)
+    from ray_tpu._private.object_ref import set_borrow_notifier
+    set_borrow_notifier(executor.plane.note_borrow)
 
     reply = head.call("register_worker", args.worker_id, server.address,
                       resources, args.node_id, env_key)

@@ -1,5 +1,6 @@
 from ray_tpu.tune.search import (choice, grid_search, loguniform, qrandint,
                                  randint, uniform, BasicVariantGenerator,
+                                 BOHBSearcher, Searcher, SearcherAdapter,
                                  TPESearcher)
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
                                      HyperBandScheduler,
@@ -12,6 +13,7 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "Trial",
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "qrandint", "BasicVariantGenerator", "TPESearcher",
+    "BOHBSearcher", "Searcher", "SearcherAdapter",
     "FIFOScheduler", "AsyncHyperBandScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
 ]

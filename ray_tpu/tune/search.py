@@ -89,15 +89,113 @@ def _is_grid(v) -> bool:
 
 
 class Searcher:
-    """Suggest/observe interface (reference: tune/search/searcher.py)."""
+    """Suggest/observe interface (reference: tune/search/searcher.py —
+    the same contract external integrations implement there: suggest,
+    on_trial_result, on_trial_complete, save/restore, and
+    set_search_properties)."""
+
+    metric: Optional[str] = None
+    mode: str = "min"
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str,
+                        result: Optional[Dict] = None):
+        """Intermediate result (multi-fidelity searchers use these)."""
 
     def on_trial_complete(self, trial_id: str,
                           result: Optional[Dict] = None,
                           error: bool = False):
         pass
+
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str],
+                              config: Optional[Dict[str, Any]] = None
+                              ) -> bool:
+        """Late-bind objective/space from TuneConfig (reference:
+        searcher.py set_search_properties). Returns True if applied."""
+        if metric is not None:
+            self.metric = metric
+        if mode is not None:
+            self.mode = mode
+        if config and not getattr(self, "param_space", None):
+            self.param_space = dict(config)
+        return True
+
+    # -- persistence (experiment resume restores searcher state) ----------
+
+    def save(self, path: str) -> None:
+        import pickle
+        with open(path, "wb") as f:
+            pickle.dump(self.__dict__, f)
+
+    def restore(self, path: str) -> None:
+        import pickle
+        with open(path, "rb") as f:
+            self.__dict__.update(pickle.load(f))
+
+
+class SearcherAdapter(Searcher):
+    """Bridge an EXTERNAL ask/tell optimizer into the Searcher
+    contract (the plugin seam the reference fills per-library under
+    tune/search/{optuna,hyperopt,...}; one adapter here because every
+    modern optimizer exposes ask/tell).
+
+    `ext` must provide ask() -> config dict and tell(config, value);
+    mode handling: values are negated for mode='max' before tell when
+    `minimizing` (the usual external convention) is True."""
+
+    def __init__(self, ext, metric: str, mode: str = "min",
+                 num_samples: int = 16, minimizing: bool = True):
+        self.ext = ext
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.minimizing = minimizing
+        self._suggested = 0
+        self._configs: Dict[str, Dict[str, Any]] = {}
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        cfg = dict(self.ext.ask())
+        self._configs[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False):
+        cfg = self._configs.pop(trial_id, None)
+        if cfg is None or error or not result or \
+                self.metric not in result:
+            return
+        v = float(result[self.metric])
+        if self.mode == "max" and self.minimizing:
+            v = -v
+        self.ext.tell(cfg, v)
+
+    def observe(self, config: Dict[str, Any], value: float):
+        v = float(value)
+        if self.mode == "max" and self.minimizing:
+            v = -v
+        self.ext.tell(dict(config), v)
+
+    def save(self, path: str) -> None:
+        import pickle
+        with open(path, "wb") as f:
+            pickle.dump({"suggested": self._suggested,
+                         "configs": self._configs,
+                         "ext": self.ext}, f)
+
+    def restore(self, path: str) -> None:
+        import pickle
+        with open(path, "rb") as f:
+            st = pickle.load(f)
+        self._suggested = st["suggested"]
+        self._configs = st["configs"]
+        self.ext = st["ext"]
 
 
 class BasicVariantGenerator(Searcher):
@@ -246,3 +344,63 @@ class TPESearcher(Searcher):
     def observe(self, config: Dict[str, Any], value: float):
         """Direct observation hook (used by the trial runner)."""
         self._observed.append((dict(config), value))
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model half (Falkner et al. 2018): TPE model built from
+    the HIGHEST budget that has enough observations, paired with the
+    bandit half — HyperBandScheduler's brackets — for early stopping.
+    (Reference integrates this as tune/search/bohb/ TuneBOHB +
+    HyperBandForBOHB.)
+
+    Observations are recorded per budget (training iterations seen);
+    suggest() fits the KDE on the largest budget with >= n_min points,
+    falling back to lower budgets, then to random — so the model
+    always uses the highest-fidelity evidence available, the core
+    BOHB idea."""
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "min", num_samples: int = 16,
+                 n_startup: int = 5, n_candidates: int = 24,
+                 gamma: float = 0.33, seed: int = 0, n_min: int = 4):
+        super().__init__(param_space, metric, mode=mode,
+                         num_samples=num_samples, n_startup=n_startup,
+                         n_candidates=n_candidates, gamma=gamma,
+                         seed=seed)
+        self.n_min = n_min
+        # budget -> [(config, value), ...]
+        self._by_budget: Dict[int, List[Tuple[Dict[str, Any], float]]]\
+            = {}
+
+    def observe(self, config: Dict[str, Any], value: float,
+                budget: int = 1):
+        self._by_budget.setdefault(int(budget), []).append(
+            (dict(config), float(value)))
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False):
+        if error or not result or self.metric not in result:
+            return
+        config = result.get("config")
+        if config is not None:
+            self.observe(config, result[self.metric],
+                         result.get("training_iteration", 1))
+
+    def _model_budget(self) -> Optional[int]:
+        for b in sorted(self._by_budget, reverse=True):
+            if len(self._by_budget[b]) >= self.n_min:
+                return b
+        return None
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        b = self._model_budget()
+        if b is None:
+            return self._random_config()
+        # Point the parent's KDE machinery at the chosen budget's
+        # observations for this one suggestion.
+        self._observed = self._by_budget[b]
+        return self._suggest_tpe()

@@ -222,7 +222,8 @@ class Tuner:
 
     @staticmethod
     def _observe(searcher, trial: Trial, tc: TuneConfig):
-        """Feed the completed trial back to model-based searchers."""
+        """Feed the completed trial back to model-based searchers
+        (budget-aware ones also learn the fidelity it reached)."""
         observe = getattr(searcher, "observe", None)
         if observe is None or not trial.results:
             return
@@ -230,7 +231,16 @@ class Tuner:
         if not vals:
             return
         best = min(vals) if tc.mode == "min" else max(vals)
-        observe(trial.config, best)
+        import inspect
+        try:
+            takes_budget = "budget" in \
+                inspect.signature(observe).parameters
+        except (TypeError, ValueError):
+            takes_budget = False
+        if takes_budget:
+            observe(trial.config, best, budget=len(vals))
+        else:
+            observe(trial.config, best)
 
     def _state_path(self) -> Optional[str]:
         import os

@@ -1,0 +1,135 @@
+"""conda + container runtime-env types (VERDICT r5 #8).
+
+Both ride the pip/venv machinery: env-keyed dedicated workers, cached
+staging, env_setup_failed surfacing. conda tests run only where a
+conda/mamba binary exists (this image has none — they skip cleanly,
+the VERDICT's stated done bar); the container command builder is a
+pure function and is tested engine-free.
+Reference: python/ray/_private/runtime_env/{conda,container}.py.
+"""
+import pytest
+
+from ray_tpu._private.runtime_env import (conda_available,
+                                          container_command_prefix,
+                                          find_container_engine,
+                                          runtime_env_key,
+                                          validate_runtime_env)
+
+
+def test_validation_accepts_and_rejects():
+    validate_runtime_env({"conda": "base"})
+    validate_runtime_env({"conda": {"dependencies": ["numpy"]}})
+    validate_runtime_env({"container": {"image": "python:3.12"}})
+    with pytest.raises(TypeError):
+        validate_runtime_env({"conda": 42})
+    with pytest.raises(TypeError):
+        validate_runtime_env({"container": {"run_options": []}})
+    with pytest.raises(TypeError):
+        validate_runtime_env({"container": {"image": "x",
+                                            "run_options": [1]}})
+    with pytest.raises(ValueError):
+        validate_runtime_env({"conda": "x", "pip": ["y"]})
+
+
+def test_env_keys_distinct_per_type():
+    ks = {runtime_env_key(e) for e in (
+        {"conda": "a"}, {"conda": "b"},
+        {"conda": {"dependencies": ["numpy"]}},
+        {"container": {"image": "img:1"}},
+        {"container": {"image": "img:2"}},
+        {"pip": ["pkg"]},
+    )}
+    assert len(ks) == 6        # every env maps to its own worker pool
+
+
+def test_container_prefix_construction():
+    prefix = container_command_prefix(
+        {"container": {"image": "img:1",
+                       "run_options": ["--cpus=2", "--memory=1g"]}},
+        engine="podman")
+    assert prefix[0] == "podman" and prefix[-1] == "img:1"
+    assert prefix[1:3] == ["run", "--rm"]
+    # worker must reach the head's loopback ports and the shm store
+    assert "host" in prefix[prefix.index("--network") + 1]
+    assert "/dev/shm:/dev/shm" in prefix
+    assert "--cpus=2" in prefix and "--memory=1g" in prefix
+    # run options come before the image (engine args, not cmd args)
+    assert prefix.index("--cpus=2") < prefix.index("img:1")
+
+
+def test_container_prefix_requires_engine(monkeypatch):
+    import ray_tpu._private.runtime_env as m
+    monkeypatch.setattr(m, "find_container_engine", lambda: None)
+    with pytest.raises(RuntimeError, match="podman"):
+        m.container_command_prefix({"container": {"image": "x"}})
+
+
+def test_conda_missing_binary_fails_closed(monkeypatch):
+    import ray_tpu._private.runtime_env as m
+    monkeypatch.setattr(m, "find_conda", lambda: None)
+    with pytest.raises(RuntimeError, match="conda"):
+        m.conda_env_python({"conda": "base"})
+
+
+def test_conda_env_setup_failure_surfaces_to_caller():
+    """Without conda on the node, a task pinned to a conda env must
+    FAIL with the real staging error (env_setup_failed path), not
+    hang. If conda exists, the same submission must instead run inside
+    the env — both outcomes are asserted."""
+    import ray_tpu
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=1, resources_per_worker={"CPU": 2}):
+        @ray_tpu.remote(runtime_env={"conda": "raytpu-does-not-exist"})
+        def probe():
+            import sys
+            return sys.executable
+
+        if conda_available():
+            with pytest.raises(Exception, match="not found"):
+                ray_tpu.get(probe.remote(), timeout=120)
+        else:
+            with pytest.raises(Exception, match="conda"):
+                ray_tpu.get(probe.remote(), timeout=120)
+
+
+@pytest.mark.skipif(not conda_available(),
+                    reason="no conda/mamba on this image")
+def test_conda_named_env_task_runs_in_env():
+    """Task executes under the named conda env's interpreter (done bar:
+    'task runs in a conda env the driver lacks')."""
+    import sys
+    import ray_tpu
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=1, resources_per_worker={"CPU": 2}):
+        @ray_tpu.remote(runtime_env={"conda": "base"})
+        def interp():
+            import sys as s
+            return s.executable
+
+        exe = ray_tpu.get(interp.remote(), timeout=600)
+        assert exe != sys.executable
+
+
+@pytest.mark.skipif(find_container_engine() is None,
+                    reason="no podman/docker on this image")
+def test_container_env_task_runs_in_image():
+    import ray_tpu
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=1, resources_per_worker={"CPU": 2}):
+        @ray_tpu.remote(
+            runtime_env={"container": {"image": "python:3.12-slim"}})
+        def hostname_ns():
+            import os
+            return os.path.exists("/.dockerenv") or \
+                os.path.exists("/run/.containerenv")
+
+        assert ray_tpu.get(hostname_ns.remote(), timeout=600)

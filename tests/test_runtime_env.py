@@ -85,9 +85,11 @@ def test_actor_runtime_env(rt):
 
 def test_validation_rejects_unknown_keys():
     with pytest.raises(ValueError, match="Unsupported runtime_env"):
-        validate_runtime_env({"conda": "env"})
+        validate_runtime_env({"dockerfile": "x"})   # truly unknown
     with pytest.raises(TypeError):
         validate_runtime_env({"env_vars": {"A": 1}})
+    # conda/container are supported types now (r5)
+    validate_runtime_env({"conda": "env"})
 
 
 def test_runtime_env_in_worker_process():

@@ -90,28 +90,17 @@ def main():
         record("placement_groups_removed_per_s",
                n_pg / (time.perf_counter() - t0), "/s")
 
-        # --- deep queue ---------------------------------------------------
-        @ray_tpu.remote(num_cpus=0.001)
-        def noop():
-            pass
-
-        n_q = args.queue
-        t0 = time.perf_counter()
-        refs = [noop.remote() for _ in range(n_q)]
-        submit_dt = time.perf_counter() - t0
-        record("deep_queue_submit_per_s", n_q / submit_dt, "/s")
-        ray_tpu.get(refs, timeout=1200)
-        total_dt = time.perf_counter() - t0
-        record("deep_queue_drain_per_s", n_q / total_dt, "/s")
-        del refs
-
         # --- 1 GiB broadcast to N nodes ----------------------------------
+        # Runs BEFORE the deep queue: dropping a million task-return
+        # refs afterwards triggers a (chunk-bounded) eager-free drain
+        # that would otherwise share the core with the transfers.
         n_nodes = args.broadcast_nodes
         for i in range(n_nodes):
             cluster.add_node(
                 num_workers=1,
                 resources_per_worker={"CPU": 2, f"bnode{i}": 10},
                 store_capacity=2 * 1024 * 1024 * 1024)
+        time.sleep(8)      # let the agents' transfer prewarm finish
 
         @ray_tpu.remote(num_cpus=0.001)
         def touch(arr):
@@ -128,6 +117,21 @@ def main():
         record("broadcast_1GiB_nodes_per_s", n_nodes / dt, "nodes/s")
         record("broadcast_1GiB_aggregate_gbps",
                n_nodes * gib.nbytes / dt / 1e9, "GB/s")
+
+        # --- deep queue ---------------------------------------------------
+        @ray_tpu.remote(num_cpus=0.001)
+        def noop():
+            pass
+
+        n_q = args.queue
+        t0 = time.perf_counter()
+        refs = [noop.remote() for _ in range(n_q)]
+        submit_dt = time.perf_counter() - t0
+        record("deep_queue_submit_per_s", n_q / submit_dt, "/s")
+        ray_tpu.get(refs, timeout=1200)
+        total_dt = time.perf_counter() - t0
+        record("deep_queue_drain_per_s", n_q / total_dt, "/s")
+        del refs
     finally:
         cluster.shutdown()
 
