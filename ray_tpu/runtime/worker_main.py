@@ -285,23 +285,44 @@ class Executor:
         cache = getattr(self, "_fn_cache", None)
         if cache is None:
             cache = self._fn_cache = {}
+        if isinstance(fn_ref, str) and (
+                fn_ref.startswith(("import://", "registry://"))
+                or ":" in fn_ref):
+            # Cross-language task (reference: C++/Java task specs name
+            # functions, core_worker cross_language path): the spec
+            # carries a descriptor instead of a pickled closure, so
+            # non-Python clients can submit work. Bare "module:attr"
+            # counts (function-table hashes are hex, colon-free).
+            # registry:// is deliberately NOT memoized — a
+            # re-registration must take effect on every worker — and
+            # descriptor results/args are validated against the
+            # plain-data contract at this boundary.
+            from ray_tpu.util.cross_lang import (resolve_descriptor,
+                                                 validate_args)
+            target = cache.get(fn_ref) \
+                if not fn_ref.startswith("registry://") else None
+            if target is None:
+                target = resolve_descriptor(fn_ref)
+                if not fn_ref.startswith("registry://"):
+                    cache[fn_ref] = target
+
+            import functools
+
+            @functools.wraps(target)
+            def checked(*args, **kwargs):
+                validate_args(list(args))
+                validate_args(kwargs)
+                out = target(*args, **kwargs)
+                validate_args(out)
+                return out
+
+            return checked
         func = cache.get(fn_ref)
         if func is None:
-            if isinstance(fn_ref, str) and \
-                    fn_ref.startswith("import://"):
-                # Cross-language task (reference: C++/Java task specs
-                # name functions, core_worker cross_language path): the
-                # spec carries an import path instead of a pickled
-                # closure, so non-Python clients can submit work.
-                import importlib
-                mod_name, _, attr = \
-                    fn_ref[len("import://"):].partition(":")
-                func = getattr(importlib.import_module(mod_name), attr)
-            else:
-                blob = self.head.call("get_function", fn_ref)
-                if blob is None:
-                    raise RuntimeError(f"unknown function {fn_ref}")
-                func = cloudpickle.loads(blob)
+            blob = self.head.call("get_function", fn_ref)
+            if blob is None:
+                raise RuntimeError(f"unknown function {fn_ref}")
+            func = cloudpickle.loads(blob)
             cache[fn_ref] = func
         return func
 

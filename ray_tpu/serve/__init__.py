@@ -1,11 +1,13 @@
 from ray_tpu.serve.api import (delete, deployment, run, shutdown,
                                get_deployment, get_handle,
                                list_deployments, status)
-from ray_tpu.serve.drivers import DAGDriver
+from ray_tpu.serve.drivers import (DAGDriver, json_request,
+                                   json_to_ndarray)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.router import StreamingResponse
 
 __all__ = ["deployment", "run", "shutdown", "get_deployment", "get_handle",
-           "list_deployments", "status", "delete", "DAGDriver", "batch",
+           "list_deployments", "status", "delete", "DAGDriver",
+           "json_request", "json_to_ndarray", "batch",
            "AutoscalingConfig", "DeploymentConfig", "StreamingResponse"]

@@ -1,35 +1,129 @@
-"""Ingress drivers.
+"""Ingress drivers: DAGDriver + HTTP adapters.
 
-Capability parity with the reference's DAGDriver
-(python/ray/serve/drivers.py — an ingress deployment routing HTTP paths
-to the deployment graph's entry handles).
+Capability parity with the reference's driver layer
+(python/ray/serve/drivers.py DAGDriver — an ingress deployment that
+executes a deployment graph per request and optionally adapts raw HTTP
+payloads into model inputs via `http_adapter`, the pattern of
+serve/http_adapters.py). Two ingress shapes:
+
+- single graph: ``DAGDriver.bind(graph_node)`` — every request runs
+  the bound graph (``predict``);
+- route table: ``DAGDriver.bind({"/a": DepA.bind(), ...})`` — the
+  path picks the sub-graph (``predict_with_route`` / ``__call__``).
+
+Bound deployments inside the argument are deployed recursively by
+serve.run and arrive here as live handles.
 """
 from __future__ import annotations
 
-from typing import Any, Dict
+import json
+from typing import Any, Callable, Dict, Optional, Union
 
 import ray_tpu
 
 
-class DAGDriver:
-    """Route-table ingress: maps path prefixes to deployment handles.
+# --------------------------------------------------------------------------
+# HTTP adapters (reference: python/ray/serve/http_adapters.py)
+# --------------------------------------------------------------------------
 
-    Use: serve.run(serve.deployment(DAGDriver).bind(
-             {"/a": DepA.bind(), "/b": DepB.bind()}))
-    Bound deployments in the dict are deployed recursively by serve.run
-    and arrive here as live handles.
+def json_request(body: Union[bytes, str, Dict]) -> Any:
+    """Default adapter: parse a JSON body into the model input."""
+    if isinstance(body, (bytes, bytearray)):
+        body = body.decode()
+    if isinstance(body, str):
+        return json.loads(body) if body else None
+    return body
+
+
+def json_to_ndarray(body: Union[bytes, str, Dict]) -> Any:
+    """Adapter for numeric payloads: {"array": [...]} -> np.ndarray
+    (reference: http_adapters.json_to_ndarray)."""
+    import numpy as np
+    data = json_request(body)
+    if isinstance(data, dict) and "array" in data:
+        return np.asarray(data["array"])
+    return np.asarray(data)
+
+
+def starlette_request(body: Any) -> Any:
+    """Identity adapter: hand the raw request payload through."""
+    return body
+
+
+class DAGDriver:
+    """Graph ingress (reference: serve/drivers.py:DAGDriver).
+
+    The driver is itself a deployment; serve.run deploys the bound
+    graph(s) beneath it and the HTTP proxy (serve.start_http) reaches
+    it like any deployment — POST /DAGDriver with a JSON body routes
+    through ``__call__``.
     """
 
-    def __init__(self, route_table: Dict[str, Any]):
-        self._routes = dict(route_table)
+    def __init__(self, dags: Union[Any, Dict[str, Any]],
+                 http_adapter: Optional[Callable] = None):
+        self._adapter = http_adapter or json_request
+        self._adapter_explicit = http_adapter is not None
+        if isinstance(dags, dict):
+            self._routes: Dict[str, Any] = dict(dags)
+            self._entry = None
+        else:
+            self._routes = {}
+            self._entry = dags
+
+    # -- introspection -----------------------------------------------------
 
     def routes(self) -> Dict[str, str]:
-        return {path: getattr(h, "_name", repr(h))
-                for path, h in self._routes.items()}
+        out = {path: getattr(h, "_name", repr(h))
+               for path, h in self._routes.items()}
+        if self._entry is not None:
+            out["/"] = getattr(self._entry, "_name", repr(self._entry))
+        return out
 
-    def __call__(self, path: str, *args, **kwargs):
-        h = self._routes.get(path)
+    # -- request paths -----------------------------------------------------
+
+    def _resolve(self, handle, *args, **kwargs):
+        out = handle.remote(*args, **kwargs)
+        # Deployment handles return ObjectRefs; DAG nodes may return
+        # nested refs — resolve to the final value for the caller.
+        from ray_tpu._private.object_ref import ObjectRef
+        while isinstance(out, ObjectRef):
+            out = ray_tpu.get(out)
+        return out
+
+    def predict(self, *args, **kwargs):
+        """Run the single bound graph (reference: dag_handle.predict)."""
+        if self._entry is None:
+            raise ValueError(
+                "DAGDriver was bound with a route table; use "
+                "predict_with_route(path, ...) or __call__(path, ...)")
+        return self._resolve(self._entry, *args, **kwargs)
+
+    def predict_with_route(self, route_path: str, *args, **kwargs):
+        h = self._routes.get(route_path)
         if h is None:
             raise KeyError(
-                f"No route {path!r}; known: {sorted(self._routes)}")
-        return ray_tpu.get(h.remote(*args, **kwargs))
+                f"No route {route_path!r}; known: "
+                f"{sorted(self._routes)}")
+        if self._adapter_explicit and len(args) == 1 and not kwargs:
+            # An explicitly-configured adapter applies to route-table
+            # requests too (single-payload form, the HTTP shape).
+            args = (self._adapter(args[0]),)
+        return self._resolve(h, *args, **kwargs)
+
+    def __call__(self, request: Any = None, *args, **kwargs):
+        """HTTP-shaped entry: for a route-table driver the first
+        argument is the path; for a single-graph driver the request
+        body goes through the http_adapter and into the graph."""
+        if self._entry is None:
+            if args or kwargs:
+                return self.predict_with_route(request, *args,
+                                               **kwargs)
+            # Path-only call (health checks / route probing).
+            return self.predict_with_route(request)
+        if args or kwargs:
+            raise TypeError(
+                "single-graph DAGDriver takes exactly one request "
+                f"payload; got extra args={args!r} kwargs={kwargs!r}")
+        payload = self._adapter(request) if request is not None \
+            else None
+        return self.predict(payload)

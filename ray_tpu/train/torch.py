@@ -3,20 +3,35 @@
 Capability parity with the reference's torch Train backend
 (python/ray/train/torch/config.py:28,54,105 — `_TorchBackend.on_start`
 runs `_setup_torch_process_group` on every worker with a TCP rendezvous
-on worker 0; `prepare_model` wraps the model in DDP). TPU-native stance:
-JaxTrainer + mesh collectives are the flagship path; TorchTrainer
-exists for CPU torch workloads and API parity. Requires gang members in
-distinct processes (use the multiprocess runtime with SPREAD placement);
-one process can host only one torch process-group rank.
+on worker 0; train_loop_utils.py — `prepare_model` wraps DDP,
+`prepare_data_loader` installs a DistributedSampler, checkpoints carry
+module state dicts). TPU-native stance: JaxTrainer + mesh collectives
+are the flagship path; TorchTrainer exists for CPU torch workloads and
+API parity. Requires gang members in distinct processes (use the
+multiprocess runtime with SPREAD placement); one process can host only
+one torch process-group rank.
 """
 from __future__ import annotations
 
+import dataclasses
 import socket
 from typing import Callable, Dict, Optional
 
 from ray_tpu.train.trainer import BaseTrainer
 
 _RDZV_KEY = "_torch_init_method"
+
+
+@dataclasses.dataclass
+class TorchConfig:
+    """Backend knobs (reference: train/torch/config.py:28 TorchConfig).
+    `backend` defaults to gloo — the only sane choice on TPU hosts
+    (NCCL needs NVIDIA GPUs); `init_method` tcp|env mirrors the
+    reference; `timeout_s` bounds the rendezvous."""
+
+    backend: str = "gloo"
+    init_method: str = "tcp"        # "tcp" | "env"
+    timeout_s: float = 1800.0
 
 
 def _free_port() -> int:
@@ -30,16 +45,36 @@ def _free_port() -> int:
 def _setup_torch_process_group(rank: int, world_size: int,
                                config: Dict) -> None:
     """Runs on each gang member (reference: train/torch/config.py:54)."""
+    import datetime
     import torch.distributed as dist
     if world_size <= 1:
         return
     if dist.is_initialized():
         dist.destroy_process_group()
+    tc: TorchConfig = config.get("_torch_config") or TorchConfig()
+    if tc.init_method == "env":
+        # env:// rendezvous (reference: TorchConfig init_method="env"):
+        # expose the chosen endpoint through the standard variables.
+        import os
+        addr = config[_RDZV_KEY][len("tcp://"):]
+        host, _, port = addr.rpartition(":")
+        os.environ["MASTER_ADDR"] = host
+        os.environ["MASTER_PORT"] = port
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world_size)
+        init_method = "env://"
+    elif tc.init_method == "tcp":
+        init_method = config[_RDZV_KEY]
+    else:
+        raise ValueError(
+            f"TorchConfig.init_method must be 'tcp' or 'env', got "
+            f"{tc.init_method!r}")
     dist.init_process_group(
-        backend="gloo",
-        init_method=config[_RDZV_KEY],
+        backend=tc.backend,
+        init_method=init_method,
         rank=rank,
-        world_size=world_size)
+        world_size=world_size,
+        timeout=datetime.timedelta(seconds=tc.timeout_s))
 
 
 def prepare_model(model):
@@ -53,21 +88,73 @@ def prepare_model(model):
     return model
 
 
+def prepare_data_loader(data_loader):
+    """Shard a DataLoader across the gang (reference:
+    train_loop_utils.prepare_data_loader): rebuilds it with a
+    DistributedSampler over the active process group so each rank sees
+    its 1/world_size of the dataset. No-op outside a gang."""
+    import torch.distributed as dist
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    from torch.utils.data import DataLoader, RandomSampler
+    from torch.utils.data.distributed import DistributedSampler
+    # Preserve the loader's order semantics: only loaders that were
+    # shuffling (RandomSampler) keep shuffling under the distributed
+    # sampler — a sequential eval loader must stay sequential.
+    was_shuffling = isinstance(getattr(data_loader, "sampler", None),
+                               RandomSampler)
+    sampler = DistributedSampler(data_loader.dataset,
+                                 num_replicas=dist.get_world_size(),
+                                 rank=dist.get_rank(),
+                                 shuffle=was_shuffling)
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        pin_memory=data_loader.pin_memory,
+        drop_last=data_loader.drop_last)
+
+
 def get_device():
     import torch
     return torch.device("cpu")
+
+
+def checkpoint_from_model(model, **extra) -> "Checkpoint":
+    """Module -> AIR Checkpoint (state dict unwrapped from DDP), the
+    shape TorchTrainer results carry (reference:
+    train/torch/torch_checkpoint.py)."""
+    from ray_tpu.air import Checkpoint
+    module = getattr(model, "module", model)    # unwrap DDP
+    return Checkpoint.from_dict(
+        {"model_state": {k: v.detach().cpu()
+                         for k, v in module.state_dict().items()},
+         **extra})
+
+
+def load_model_from_checkpoint(checkpoint, model):
+    """Restore a module's weights from a TorchTrainer checkpoint."""
+    state = checkpoint.to_dict()["model_state"]
+    module = getattr(model, "module", model)
+    module.load_state_dict(state)
+    return model
 
 
 class TorchTrainer(BaseTrainer):
     """Data-parallel torch training on a gang of worker actors with a
     gloo process group (NCCL has no role on TPU hosts)."""
 
-    def __init__(self, train_loop_per_worker: Callable, **kwargs):
+    def __init__(self, train_loop_per_worker: Callable,
+                 torch_config: Optional[TorchConfig] = None, **kwargs):
         super().__init__(train_loop_per_worker, **kwargs)
         # TCP rendezvous chosen up front so every gang member gets the
         # same init_method through the loop config.
         self._config[_RDZV_KEY] = \
             f"tcp://127.0.0.1:{_free_port()}"
+        self._config["_torch_config"] = torch_config or TorchConfig()
 
     def _backend_setup(self) -> Optional[Callable]:
         return _setup_torch_process_group

@@ -1,14 +1,107 @@
-"""Cross-language task targets (reference: the cross_language function
-descriptors C++/Java tasks name, src/ray/core_worker cross-language
-path). Any importable "module:function" works as a C++ `Submit`
-target; these are the in-repo examples the demo and tests use. Args
-and return values must be plain data (None/bool/int/float/str/bytes/
-list/tuple/dict) — the C++ pickle codec rejects code objects by
-design."""
+"""Cross-language function descriptors + registry.
+
+Capability parity with the reference's cross-language path
+(src/ray/core_worker — C++/Java task specs name functions by
+DESCRIPTOR, not by pickled closure; the receiving worker resolves the
+descriptor against its own runtime). Descriptors here:
+
+- ``import://module:attr`` — resolved by import on the executing
+  worker (any importable callable; the form the C++ client's
+  ``Submit`` emits, src/cpp_api/raytpu_client.cc);
+- ``registry://name`` — resolved against the process-local registry
+  populated via :func:`register_function` (lets non-Python clients
+  call short stable names without knowing module layout);
+- a bare ``module:attr`` string is treated as ``import://``.
+
+Args and return values must be plain data (None/bool/int/float/str/
+bytes/list/tuple/dict) — the C++ pickle codec rejects code objects by
+design. ``validate_args`` enforces the same contract Python-side so a
+bad payload fails at the boundary with a clear error instead of deep
+inside the codec.
+"""
 from __future__ import annotations
 
-from typing import Any, Dict, List
+import threading
+from typing import Any, Callable, Dict, List
 
+_REGISTRY: Dict[str, Callable] = {}
+_REG_LOCK = threading.Lock()
+
+_PLAIN = (type(None), bool, int, float, str, bytes)
+
+
+def register_function(name: str, fn: Callable) -> None:
+    """Expose `fn` to cross-language callers as ``registry://name``.
+    Call at import time in any module the worker loads (e.g. via
+    runtime_env py_modules) — registration is per-process."""
+    if not callable(fn):
+        raise TypeError(f"{fn!r} is not callable")
+    with _REG_LOCK:
+        _REGISTRY[name] = fn
+
+
+def registered_functions() -> List[str]:
+    with _REG_LOCK:
+        return sorted(_REGISTRY)
+
+
+def resolve_descriptor(descriptor: str) -> Callable:
+    """Descriptor -> callable on THIS worker. Raises LookupError with
+    the known-name list for registry misses (the error a foreign
+    client sees in its task result)."""
+    if descriptor.startswith("registry://"):
+        name = descriptor[len("registry://"):]
+        with _REG_LOCK:
+            fn = _REGISTRY.get(name)
+        if fn is None:
+            raise LookupError(
+                f"no registered cross-language function {name!r} "
+                f"(known: {registered_functions()})")
+        return fn
+    if descriptor.startswith("import://"):
+        descriptor = descriptor[len("import://"):]
+    mod_name, sep, attr = descriptor.partition(":")
+    if not sep or not mod_name or not attr:
+        raise ValueError(
+            f"bad cross-language descriptor {descriptor!r}; expected "
+            f"'module:attr', 'import://module:attr' or "
+            f"'registry://name'")
+    import importlib
+    obj: Any = importlib.import_module(mod_name)
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    if not callable(obj):
+        raise TypeError(f"{descriptor!r} resolves to non-callable "
+                        f"{type(obj).__name__}")
+    return obj
+
+
+def validate_args(value: Any, _depth: int = 0) -> None:
+    """Enforce the plain-data contract (mirrors the C++ codec,
+    src/cpp_api/pickle.cc): descriptive TypeError instead of a codec
+    rejection deep in the stack."""
+    if _depth > 32:
+        raise TypeError("cross-language value nests too deeply")
+    if isinstance(value, _PLAIN):
+        return
+    if isinstance(value, (list, tuple)):
+        for v in value:
+            validate_args(v, _depth + 1)
+        return
+    if isinstance(value, dict):
+        for k, v in value.items():
+            validate_args(k, _depth + 1)
+            validate_args(v, _depth + 1)
+        return
+    raise TypeError(
+        f"cross-language values must be plain data "
+        f"(None/bool/int/float/str/bytes/list/tuple/dict); got "
+        f"{type(value).__name__}")
+
+
+# --------------------------------------------------------------------------
+# In-repo example targets (used by the C++ demo and tests).
+# --------------------------------------------------------------------------
 
 def square(x: int) -> int:
     return x * x
@@ -26,3 +119,8 @@ def echo(value: Any) -> Any:
 
 def boom() -> None:
     raise RuntimeError("cross-lang failure example")
+
+
+register_function("square", square)
+register_function("describe", describe)
+register_function("echo", echo)

@@ -526,3 +526,24 @@ def test_llm_deployment_serves_mixtral(serve_rt):
     assert len(full) == len(prompt) + 6
     streamed = list(h.stream.options(stream=True).remote(prompt))
     assert streamed == full[len(prompt):]
+
+
+def test_dag_driver_single_graph_with_adapter(rt):
+    """Single-graph DAGDriver: the http_adapter parses the payload
+    and predict() runs the bound graph (reference drivers.py shape)."""
+    import json
+    from ray_tpu import serve
+    from ray_tpu.serve import DAGDriver, json_to_ndarray
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, arr):
+            return (arr * 2).tolist()
+
+    ingress = serve.deployment(DAGDriver).bind(
+        Doubler.bind(), http_adapter=json_to_ndarray)
+    handle = serve.run(ingress, timeout_s=120)
+    out = ray_tpu.get(handle.remote(
+        json.dumps({"array": [1, 2, 3]})))
+    assert out == [2, 4, 6]
+    serve.shutdown()

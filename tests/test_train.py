@@ -487,3 +487,28 @@ def test_jax_trainer_gang_elastic_restart():
         assert result.metrics["procs"] == 2
         assert result.metrics["devices"] == 16
         assert os.path.exists(marker)   # attempt 1 really failed
+
+
+def test_torch_helpers_and_checkpoint_roundtrip():
+    """TorchConfig/prepare_data_loader/checkpoint helpers (reference:
+    train/torch/train_loop_utils.py + torch_checkpoint.py)."""
+    import torch
+    from torch.utils.data import DataLoader, TensorDataset
+    from ray_tpu.train.torch import (TorchConfig, checkpoint_from_model,
+                                     load_model_from_checkpoint,
+                                     prepare_data_loader, prepare_model)
+    tc = TorchConfig()
+    assert tc.backend == "gloo"
+    model = torch.nn.Linear(4, 2)
+    # outside a gang both prepares are no-ops
+    assert prepare_model(model) is model
+    dl = DataLoader(TensorDataset(torch.zeros(8, 4)), batch_size=4)
+    assert prepare_data_loader(dl) is dl
+    # checkpoint round trip restores exact weights
+    with torch.no_grad():
+        model.weight.fill_(1.5)
+    ckpt = checkpoint_from_model(model, epoch=3)
+    fresh = torch.nn.Linear(4, 2)
+    load_model_from_checkpoint(ckpt, fresh)
+    assert torch.equal(fresh.weight, model.weight)
+    assert ckpt.to_dict()["epoch"] == 3
