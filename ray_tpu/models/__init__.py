@@ -1,3 +1,6 @@
+from ray_tpu.models.bert import (Bert, BertConfig, bert_base,
+                                 bert_sharding_rules, bert_tiny,
+                                 mask_tokens, mlm_loss)
 from ray_tpu.models.gpt2 import (GPT2, GPT2Config, gpt2_sharding_rules,
                                  gpt2_124m)
 from ray_tpu.models.llama import (Llama, LlamaConfig, generate,
@@ -9,6 +12,8 @@ from ray_tpu.models.mixtral import (Mixtral, MixtralConfig,
 from ray_tpu.models.resnet import ResNet, ResNetConfig, resnet50, resnet18
 
 __all__ = [
+    "Bert", "BertConfig", "bert_base", "bert_tiny",
+    "bert_sharding_rules", "mask_tokens", "mlm_loss",
     "GPT2", "GPT2Config", "gpt2_sharding_rules", "gpt2_124m",
     "ResNet", "ResNetConfig", "resnet50", "resnet18",
     "Llama", "LlamaConfig", "llama2_7b", "llama_tiny",

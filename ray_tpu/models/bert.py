@@ -1,0 +1,222 @@
+"""BERT: bidirectional encoder + masked-LM head, TPU-native flax.
+
+The encoder model family widening models/ beyond decoders (the
+reference framework ships no models; BASELINE.md's model obligations
+are decoder-LM training/serving, which GPT-2/Llama/Mixtral cover —
+BERT adds the encoder/MLM shape of embedding and classification
+fleets). Same conventions as gpt2.py: fp32 LayerNorms around
+cfg.dtype matmuls, attention through ops.attention (padding handled
+as an additive bias so the pallas flash path stays available for
+unmasked batches), sharding declared as logical-axis rules.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.mesh.sharding import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def bert_base(**overrides) -> BertConfig:
+    return BertConfig(**overrides)
+
+
+def bert_tiny(**overrides) -> BertConfig:
+    d = dict(vocab_size=1024, max_position_embeddings=128, dim=128,
+             n_layers=2, n_heads=2, hidden_dim=256,
+             dtype=jnp.float32)
+    d.update(overrides)
+    return BertConfig(**d)
+
+
+def _padding_bias(attention_mask):
+    """[B, T] 1/0 mask -> additive [B, 1, 1, T] fp32 bias (0 keep,
+    -inf drop) broadcast over heads and query positions."""
+    neg = jnp.asarray(-1e30, jnp.float32)
+    return jnp.where(attention_mask[:, None, None, :] > 0, 0.0, neg)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, bias=None, deterministic: bool = True):
+        cfg = self.config
+        B, T, C = x.shape
+        qkv = nn.Dense(3 * cfg.dim, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, T, cfg.n_heads, cfg.head_dim)
+
+        from ray_tpu.ops.attention import multi_head_attention
+        y = multi_head_attention(heads(q), heads(k), heads(v),
+                                 causal=False, impl=cfg.attention_impl,
+                                 bias=bias)
+        y = y.reshape(B, T, C)
+        y = nn.Dense(cfg.dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="out")(y)
+        if cfg.dropout > 0:
+            y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return y
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, bias=None, deterministic: bool = True):
+        cfg = self.config
+        # Post-LN, the BERT arrangement (vs GPT's pre-LN).
+        a = BertSelfAttention(cfg, name="attn")(
+            x.astype(cfg.dtype), bias, deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + a)
+        h = nn.Dense(cfg.hidden_dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype,
+                     name="ffn_in")(x.astype(cfg.dtype))
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="ffn_out")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_ffn")(x + h)
+
+
+class Bert(nn.Module):
+    """Encoder trunk + heads.
+
+    __call__(input_ids, token_type_ids=None, attention_mask=None)
+    returns the final hidden states [B, T, dim] (fp32);
+    return_mlm_logits=True ties the decoder to the word embedding;
+    return_pooled=True returns (hidden, pooled) where pooled is the
+    tanh-projected [CLS] vector (init with the flags you will apply
+    with — flax creates only the traced params).
+    """
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None,
+                 attention_mask=None, deterministic: bool = True,
+                 return_mlm_logits: bool = False,
+                 return_pooled: bool = False):
+        cfg = self.config
+        B, T = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.dim), cfg.param_dtype)
+        wpe = self.param("wpe", nn.initializers.normal(0.02),
+                         (cfg.max_position_embeddings, cfg.dim),
+                         cfg.param_dtype)
+        wtt = self.param("wtt", nn.initializers.normal(0.02),
+                         (cfg.type_vocab_size, cfg.dim),
+                         cfg.param_dtype)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = wte[input_ids] + wpe[jnp.arange(T)][None] + \
+            wtt[token_type_ids]
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
+        bias = None
+        if attention_mask is not None:
+            bias = _padding_bias(attention_mask)
+        for i in range(cfg.n_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, bias,
+                                                  deterministic)
+        if return_pooled:
+            cls = x[:, 0].astype(cfg.dtype)
+            pooled = jnp.tanh(
+                nn.Dense(cfg.dim, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype,
+                         name="pooler")(cls))
+            return x, pooled
+        if not return_mlm_logits:
+            return x
+        # Tied MLM head (transform + decode against wte^T).
+        h = nn.Dense(cfg.dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="mlm_dense")(
+            x.astype(cfg.dtype))
+        h = nn.gelu(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(h)
+        logits = jnp.einsum("btd,vd->btv", h.astype(cfg.dtype),
+                            wte.astype(cfg.dtype))
+        logits = logits + self.param(
+            "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,),
+            cfg.param_dtype)
+        return logits
+
+def mlm_loss(logits, labels, ignore_index: int = -100):
+    """Masked-LM cross entropy: positions labeled ignore_index are
+    excluded from the mean (the 85% unmasked positions)."""
+    mask = labels != ignore_index
+    safe = jnp.where(mask, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(mask, nll, 0.0)
+    return nll.sum() / jnp.maximum(mask.sum(), 1)
+
+
+def mask_tokens(rng, input_ids, vocab_size: int, mask_token: int,
+                mask_prob: float = 0.15):
+    """Standard BERT masking: pick mask_prob positions as MLM targets
+    (80% [MASK] / 10% random / 10% kept); all other labels are -100."""
+    import numpy as np
+    ids = np.asarray(input_ids)
+    labels = np.full_like(ids, -100)
+    pick = rng.random_sample(ids.shape) < mask_prob
+    labels[pick] = ids[pick]
+    action = rng.random_sample(ids.shape)
+    ids = ids.copy()
+    ids[pick & (action < 0.8)] = mask_token
+    rand = pick & (action >= 0.8) & (action < 0.9)
+    ids[rand] = rng.randint(0, vocab_size, size=int(rand.sum()))
+    return ids, labels
+
+
+def bert_sharding_rules(fsdp: bool = True) -> ShardingRules:
+    """Megatron-style TP + optional FSDP for the encoder: qkv/ffn_in
+    column-parallel, out/ffn_out row-parallel, embeddings vocab/ctx
+    sharded (same no-trailing-dim-sharding stance as gpt2's rules —
+    see gpt2_sharding_rules for the remat rationale)."""
+    f = "fsdp" if fsdp else None
+    emb_spec = P(("tensor", "fsdp") if fsdp else "tensor", None)
+    return ShardingRules([
+        (r"wte$", emb_spec),
+        (r"wpe$", emb_spec),
+        (r"wtt$", P(None, None)),
+        (r"mlm_bias$", P(None)),
+        (r"(qkv|ffn_in|pooler)/kernel$", P(f, "tensor")),
+        # mlm_dense output feeds the TIED decode einsum against wte:
+        # tensor-sharding it would hand wte a dim-sharded gradient
+        # contribution that conflicts with its vocab-sharded spec
+        # (involuntary full remat in the embedding backward). Keep the
+        # head fsdp-only; it is a single small [d, d] matmul.
+        (r"mlm_dense/kernel$", P(f, None)),
+        (r"(attn/out|ffn_out)/kernel$", P("tensor", f)),
+        (r"bias$", P(None)),
+        (r"(ln_\w+|scale)$", P(None)),
+        (r".*", P(None)),
+    ])

@@ -1,6 +1,8 @@
 from ray_tpu.serve.api import (delete, deployment, run, shutdown,
                                get_deployment, get_handle,
                                list_deployments, status)
+from ray_tpu.serve.multiplex import (get_multiplexed_model_id,
+                                     multiplexed)
 from ray_tpu.serve.drivers import (DAGDriver, json_request,
                                    json_to_ndarray)
 from ray_tpu.serve.batching import batch
@@ -10,4 +12,5 @@ from ray_tpu.serve.router import StreamingResponse
 __all__ = ["deployment", "run", "shutdown", "get_deployment", "get_handle",
            "list_deployments", "status", "delete", "DAGDriver",
            "json_request", "json_to_ndarray", "batch",
+           "multiplexed", "get_multiplexed_model_id",
            "AutoscalingConfig", "DeploymentConfig", "StreamingResponse"]

@@ -69,6 +69,9 @@ class Replica:
         next_chunks long-polls."""
         import inspect
 
+        from ray_tpu.serve.multiplex import (MUX_KWARG,
+                                             _set_request_model_id)
+        _set_request_model_id(kwargs.pop(MUX_KWARG, None))
         loop = asyncio.get_event_loop()
         fn = self._target_fn(method_name)   # raises BEFORE any state
         self._reap_abandoned_streams()
@@ -146,7 +149,11 @@ class Replica:
                 return
             _finish()
 
-        loop.run_in_executor(None, _drain_sync)
+        # copy_context: request-scoped ContextVars (multiplexed model
+        # id) must follow the sync drain into the executor thread.
+        import contextvars
+        loop.run_in_executor(None, contextvars.copy_context().run,
+                             _drain_sync)
         return True
 
     _STREAM_ABANDON_S = 120.0     # no poll for this long => abandoned
@@ -206,6 +213,9 @@ class Replica:
     async def handle_request(self, method_name: str, args, kwargs):
         self._adjust_ongoing(+1)
         try:
+            from ray_tpu.serve.multiplex import (MUX_KWARG,
+                                                 _set_request_model_id)
+            _set_request_model_id(kwargs.pop(MUX_KWARG, None))
             target = self.instance
             if method_name == "__call__":
                 fn = target
@@ -218,11 +228,15 @@ class Replica:
                 return await fn(*args, **kwargs)
             # Sync callables run in the thread executor so they don't
             # block the replica's event loop (reference: serve replica
-            # runs sync user code off-loop).
+            # runs sync user code off-loop). copy_context carries
+            # request-scoped ContextVars (multiplexed model id) into
+            # the executor thread.
+            import contextvars
             import functools
             loop = asyncio.get_event_loop()
+            ctx = contextvars.copy_context()
             result = await loop.run_in_executor(
-                None, functools.partial(fn, *args, **kwargs))
+                None, ctx.run, functools.partial(fn, *args, **kwargs))
             if asyncio.iscoroutine(result):
                 result = await result
             return result

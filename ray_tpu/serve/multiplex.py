@@ -1,0 +1,126 @@
+"""Model multiplexing: many models behind one deployment.
+
+Capability parity with the reference's model multiplexing
+(python/ray/serve/api.py @serve.multiplexed +
+serve/_private/... ModelMultiplexWrapper; the LoRA-serving pattern):
+a replica lazily loads models by id into a bounded per-replica LRU,
+and the handle routes requests for a model id to a replica that
+already holds it (cache affinity) so the fleet converges to a stable
+model->replica assignment without central placement.
+
+Usage:
+
+    @serve.deployment(max_ongoing_requests=8)
+    class Multi:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            return load_expensive_model(model_id)
+
+        def __call__(self, payload):
+            model = self.get_model(serve.get_multiplexed_model_id())
+            return model(payload)
+
+    h = serve.run(Multi.bind())
+    h.options(multiplexed_model_id="m1").remote(x)
+"""
+from __future__ import annotations
+
+import collections
+import contextvars
+import functools
+import threading
+from typing import Callable, Optional
+
+# The model id of the request being executed, set by the replica
+# around the user method (context parity with
+# serve.context._serve_request_context). A ContextVar so it follows
+# the request across the replica's off-loop executor hop
+# (copy_context in controller.handle_request).
+_model_id_var: "contextvars.ContextVar[str]" = contextvars.ContextVar(
+    "raytpu_mux_model_id", default="")
+
+# Kwarg smuggling the model id through the request path; stripped by
+# the replica before the user method sees kwargs.
+MUX_KWARG = "__mux_model_id"
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id the current request asked for
+    (empty string when the caller set none)."""
+    return _model_id_var.get()
+
+
+def _set_request_model_id(model_id: Optional[str]):
+    _model_id_var.set(model_id or "")
+
+
+def multiplexed(_fn: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorate the replica method that loads a model by id: results
+    cache in a per-replica LRU of at most max_num_models_per_replica
+    entries; eviction calls the old model's ``__del__`` (drop the
+    reference) after calling an optional ``unload()`` hook."""
+
+    def wrap(fn):
+        cache_attr = f"__mux_cache_{fn.__name__}"
+        lock_attr = f"__mux_lock_{fn.__name__}"
+
+        loading_attr = f"__mux_loading_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(self, model_id: str):
+            lock = getattr(self, lock_attr, None)
+            if lock is None:
+                lock = threading.Lock()
+                setattr(self, lock_attr, lock)
+            while True:
+                with lock:
+                    cache = getattr(self, cache_attr, None)
+                    if cache is None:
+                        cache = collections.OrderedDict()
+                        setattr(self, cache_attr, cache)
+                    if model_id in cache:
+                        cache.move_to_end(model_id)
+                        return cache[model_id]
+                    loading = getattr(self, loading_attr, None)
+                    if loading is None:
+                        loading = {}
+                        setattr(self, loading_attr, loading)
+                    ev = loading.get(model_id)
+                    if ev is None:
+                        loading[model_id] = threading.Event()
+                        break               # this caller loads
+                # Another request is loading the same id: wait for it
+                # instead of loading a duplicate (N concurrent loads =
+                # N x load time + N models in memory, and the N-1
+                # dropped copies would skip their unload() hook).
+                ev.wait(timeout=600)
+            # Load OUTSIDE the lock (loads are slow; concurrent
+            # requests for cached models must not queue behind one).
+            try:
+                model = fn(self, model_id)
+            except BaseException:
+                with lock:
+                    getattr(self, loading_attr).pop(model_id).set()
+                raise
+            with lock:
+                cache[model_id] = model
+                cache.move_to_end(model_id)
+                while len(cache) > max_num_models_per_replica:
+                    _mid, old = cache.popitem(last=False)
+                    unload = getattr(old, "unload", None)
+                    if callable(unload):
+                        try:
+                            unload()
+                        except Exception:
+                            pass
+                getattr(self, loading_attr).pop(model_id).set()
+            return model
+
+        wrapper.__is_multiplexed__ = True
+        wrapper.__max_models__ = max_num_models_per_replica
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -23,19 +23,37 @@ _REFRESH_S = 0.25
 
 class DeploymentMethod:
     def __init__(self, handle: "DeploymentHandle", method: str,
-                 stream: bool = False):
+                 stream: bool = False,
+                 multiplexed_model_id: Optional[str] = None):
         self._handle = handle
         self._method = method
         self._stream = stream
+        self._model_id = multiplexed_model_id
 
-    def options(self, *, stream: bool = False) -> "DeploymentMethod":
-        return DeploymentMethod(self._handle, self._method, stream)
+    _UNSET = object()
+
+    def options(self, *, stream: Optional[bool] = None,
+                multiplexed_model_id: Any = _UNSET
+                ) -> "DeploymentMethod":
+        """Unspecified options inherit from this method binding;
+        multiplexed_model_id='' explicitly clears multiplexing."""
+        return DeploymentMethod(
+            self._handle, self._method,
+            self._stream if stream is None else stream,
+            self._model_id if multiplexed_model_id is self._UNSET
+            else (multiplexed_model_id or None))
 
     def remote(self, *args, **kwargs):
+        if self._model_id:
+            from ray_tpu.serve.multiplex import MUX_KWARG
+            kwargs = dict(kwargs)
+            kwargs[MUX_KWARG] = self._model_id
         if self._stream:
             return self._handle._route_stream(self._method, args,
-                                              kwargs)
-        return self._handle._route(self._method, args, kwargs)
+                                              kwargs,
+                                              model_id=self._model_id)
+        return self._handle._route(self._method, args, kwargs,
+                                   model_id=self._model_id)
 
 
 class StreamingResponse:
@@ -150,6 +168,10 @@ class DeploymentHandle:
             self._replicas = [h for _, h in info["replicas"]]
             self._inflight = {i: 0 for i in range(len(self._replicas))}
             self._version = info["version"]
+            # Replica indices shifted: stale model-affinity entries
+            # would pin models to the wrong replica.
+            if getattr(self, "_mux_affinity", None):
+                self._mux_affinity.clear()
         self._max_ongoing = info["max_ongoing"]
 
     def _refresh(self, force: bool = False):
@@ -165,8 +187,13 @@ class DeploymentHandle:
             self._apply_locked(info)
             self._fetched_at = time.time()
 
-    def _pick(self) -> Optional[int]:
-        """Power-of-two-choices among replicas under the in-flight cap."""
+    def _pick(self, model_id: Optional[str] = None) -> Optional[int]:
+        """Power-of-two-choices among replicas under the in-flight
+        cap. Multiplexed requests prefer the replica that last served
+        their model id (cache affinity — reference: the multiplexed
+        routing policy in serve's router): affinity wins while that
+        replica has capacity; otherwise the request spills to the
+        balanced choice and the affinity map learns the new home."""
         with self._lock:
             n = len(self._replicas)
             if n == 0:
@@ -175,12 +202,28 @@ class DeploymentHandle:
                           if self._inflight.get(i, 0) < self._max_ongoing]
             if not candidates:
                 return None
-            if len(candidates) == 1:
-                idx = candidates[0]
-            else:
-                a, b = random.sample(candidates, 2)
-                idx = a if self._inflight.get(a, 0) <= \
-                    self._inflight.get(b, 0) else b
+            idx = None
+            if model_id:
+                mux = getattr(self, "_mux_affinity", None)
+                if mux is None:
+                    mux = self._mux_affinity = {}
+                home = mux.get(model_id)
+                if home is not None and home in candidates:
+                    idx = home
+            if idx is None:
+                if len(candidates) == 1:
+                    idx = candidates[0]
+                else:
+                    a, b = random.sample(candidates, 2)
+                    idx = a if self._inflight.get(a, 0) <= \
+                        self._inflight.get(b, 0) else b
+                if model_id:
+                    self._mux_affinity[model_id] = idx
+                    # Bound the affinity map (ids churn in LoRA-style
+                    # fleets).
+                    if len(self._mux_affinity) > 4096:
+                        self._mux_affinity.pop(
+                            next(iter(self._mux_affinity)))
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
             return idx
 
@@ -191,11 +234,11 @@ class DeploymentHandle:
 
     # --- calls -------------------------------------------------------------
 
-    def _acquire_replica(self):
+    def _acquire_replica(self, model_id: Optional[str] = None):
         deadline = time.time() + 30
         while True:
             self._refresh()
-            idx = self._pick()
+            idx = self._pick(model_id)
             if idx is not None:
                 return idx
             if time.time() > deadline:
@@ -205,17 +248,19 @@ class DeploymentHandle:
             time.sleep(0.005)
             self._refresh(force=True)
 
-    def _route(self, method: str, args, kwargs):
-        idx = self._acquire_replica()
+    def _route(self, method: str, args, kwargs,
+               model_id: Optional[str] = None):
+        idx = self._acquire_replica(model_id)
         replica = self._replicas[idx]
         ref = replica.handle_request.remote(method, args, kwargs)
         self._watch_completion(ref, idx)
         return ref
 
-    def _route_stream(self, method: str, args, kwargs
+    def _route_stream(self, method: str, args, kwargs,
+                      model_id: Optional[str] = None
                       ) -> "StreamingResponse":
         import uuid
-        idx = self._acquire_replica()
+        idx = self._acquire_replica(model_id)
         replica = self._replicas[idx]
         req_id = uuid.uuid4().hex
         try:
@@ -239,10 +284,16 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         return self._route("__call__", args, kwargs)
 
-    def options(self, *, stream: bool = False) -> DeploymentMethod:
+    def options(self, *, stream: bool = False,
+                multiplexed_model_id: Optional[str] = None
+                ) -> DeploymentMethod:
         """handle.options(stream=True).remote(...) returns a
-        StreamingResponse iterator of chunks."""
-        return DeploymentMethod(self, "__call__", stream)
+        StreamingResponse iterator of chunks;
+        options(multiplexed_model_id=...) routes with model-cache
+        affinity and sets serve.get_multiplexed_model_id() in the
+        replica."""
+        return DeploymentMethod(self, "__call__", stream,
+                                multiplexed_model_id)
 
     def __getattr__(self, name: str) -> DeploymentMethod:
         if name.startswith("_"):

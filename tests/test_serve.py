@@ -547,3 +547,78 @@ def test_dag_driver_single_graph_with_adapter(rt):
         json.dumps({"array": [1, 2, 3]})))
     assert out == [2, 4, 6]
     serve.shutdown()
+
+
+def test_model_multiplexing(rt):
+    """@serve.multiplexed LRU model loading + model-id routing
+    affinity (reference: serve model multiplexing, the LoRA pattern):
+    loads are cached per replica, the id reaches the replica via
+    get_multiplexed_model_id, eviction respects the per-replica cap,
+    and repeated requests for one model keep hitting the same replica.
+    """
+    import os
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=8)
+    class Multi:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            self.loads.append(model_id)
+            scale = int(model_id[1:]) if model_id else 0
+            return {"id": model_id, "scale": scale}
+
+        def __call__(self, x):
+            mid = serve.get_multiplexed_model_id()
+            model = self.get_model(mid)
+            return {"pid": os.getpid(), "out": x * model["scale"],
+                    "loads": list(self.loads)}
+
+    h = serve.run(Multi.bind(), timeout_s=120)
+    # same model id repeatedly: one replica, one load
+    outs = [ray_tpu.get(h.options(multiplexed_model_id="m3").remote(5))
+            for _ in range(6)]
+    assert all(o["out"] == 15 for o in outs)
+    assert len({o["pid"] for o in outs}) == 1      # affinity held
+    assert outs[-1]["loads"].count("m3") == 1      # loaded once
+    # a third model on one replica evicts the LRU entry (cap 2)
+    for mid in ("m1", "m2", "m4", "m1"):
+        ray_tpu.get(h.options(multiplexed_model_id=mid).remote(1))
+    # un-multiplexed requests still work (empty model id)
+    probe = ray_tpu.get(h.remote(7))
+    assert probe["out"] == 0      # scale-0 default model
+    serve.shutdown()
+
+
+def test_multiplexed_loader_dedup_under_concurrency(rt):
+    """Concurrent first requests for one model id coalesce into a
+    single load (duplicate loads = N x memory + dropped copies
+    skipping unload)."""
+    import threading
+    import time as _t
+    from ray_tpu.serve.multiplex import multiplexed
+
+    class Host:
+        def __init__(self):
+            self.loads = []
+
+        @multiplexed(max_num_models_per_replica=2)
+        def get_model(self, mid):
+            self.loads.append(mid)
+            _t.sleep(0.2)          # slow load window
+            return {"id": mid}
+
+    host = Host()
+    results = []
+    ts = [threading.Thread(
+        target=lambda: results.append(host.get_model("m1")))
+        for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(results) == 8
+    assert all(r["id"] == "m1" for r in results)
+    assert host.loads == ["m1"]            # exactly one load
