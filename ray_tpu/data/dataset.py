@@ -473,8 +473,46 @@ class Dataset:
         with lazy stages pending. Stage errors surface at first get."""
         if not self._stages:
             return self
-        return Dataset([_apply_stages.remote(b, self._stages)
-                        for b in self._block_refs])
+        import time as _time
+        t0 = _time.perf_counter()
+        out = Dataset([_apply_stages.remote(b, self._stages)
+                       for b in self._block_refs])
+        out._exec_stats = {
+            "stages": [k for k, _ in self._stages],
+            "num_blocks": len(self._block_refs),
+            "submit_s": round(_time.perf_counter() - t0, 4),
+        }
+        return out
+
+    def stats(self) -> str:
+        """Execution summary (reference: Dataset.stats() — per-stage
+        execution report). Lazy datasets report the pending plan;
+        materialized ones the last execution's shape; block sizes are
+        fetched on demand (one len() task per block)."""
+        lines = [f"Dataset(num_blocks={len(self._block_refs)}, "
+                 f"pending_stages={[k for k, _ in self._stages]})"]
+        ex = getattr(self, "_exec_stats", None)
+        if ex:
+            lines.append(
+                f"  last execution: stages={ex['stages']} over "
+                f"{ex['num_blocks']} blocks, submit {ex['submit_s']}s")
+        if not self._stages:
+            # Row counts only for executed datasets: counting the
+            # INPUT blocks of a pending filter/flat_map would report
+            # a number the transform will change (and a stats() call
+            # must not silently barrier on a pending execution).
+            try:
+                lens = ray_tpu.get([_block_len.remote(r)
+                                    for r in self._block_refs],
+                                   timeout=60)
+                total = sum(lens)
+                lines.append(
+                    f"  rows: {total} total; per-block min/mean/max ="
+                    f" {min(lens)}/{total / max(len(lens), 1):.1f}/"
+                    f"{max(lens)}")
+            except Exception:
+                pass   # blocks still executing: plan-only report
+        return "\n".join(lines)
 
     def _resolved_blocks(self) -> List[Block]:
         ds = self.materialize()

@@ -293,6 +293,7 @@ def submit_task_via_head(head: RpcClient, spec: TaskSpec,
     })
     meta = {
         "task_id": spec.task_id.hex(),
+        "name": spec.name,
         "return_ids": [oid.binary() for oid in spec.return_ids],
         "resources": spec.resources,
         "max_retries": spec.max_retries,
@@ -752,10 +753,10 @@ class DistributedRuntime:
         return self.head.call("list_actors")
 
     def list_tasks(self):
-        return []
+        return self.head.call("list_tasks")
 
     def list_objects(self):
-        return []
+        return self.head.call("list_objects")
 
     def list_workers(self):
         return self.head.call("list_workers")

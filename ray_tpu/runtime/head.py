@@ -874,6 +874,11 @@ class HeadService:
             meta["reconstruction"] = True
             self._task_meta[task_id] = meta
             self._pin_args_locked(meta)
+            # The task is live again: a lingering FINISHED row would
+            # contradict the pending one in list_tasks.
+            rec = getattr(self, "_done_tasks", None)
+            if rec is not None:
+                rec.pop(task_id, None)
             self._enqueue_locked(task_id, meta)
             return True
 
@@ -975,6 +980,70 @@ class HeadService:
                      "available": dict(w.available),
                      "running_tasks": list(w.running)}
                     for w in self._workers.values()]
+
+    # Completed tasks kept for the state API (bounded ring; reference:
+    # the task-events buffer behind list_tasks, GcsTaskManager).
+    _DONE_TASKS_CAP = 2000
+
+    def _record_task_done_locked(self, task_id: str, meta,
+                                 state: str) -> None:
+        rec = getattr(self, "_done_tasks", None)
+        if rec is None:
+            import collections as _c
+            rec = self._done_tasks = _c.OrderedDict()
+        rec[task_id] = {"task_id": task_id,
+                        "name": (meta or {}).get("name", ""),
+                        "state": state,
+                        "end_time": time.time()}
+        while len(rec) > self._DONE_TASKS_CAP:
+            rec.popitem(last=False)
+
+    def list_objects(self) -> List[Dict[str, Any]]:
+        """State-API object listing from the location directory
+        (reference: list_objects over the object table). Single-node
+        clusters skip per-object registration, so entries appear once
+        a second node joins (directory-backed, like the reference's
+        GCS-backed listing)."""
+        import itertools
+        CAP = 10000
+        with self._lock:
+            out = []
+            borrows = getattr(self, "_borrows", {})
+            for oid_hex, nodes in itertools.islice(
+                    self._obj_locs.items(), CAP):
+                ent = borrows.get(oid_hex)
+                out.append({"object_id": oid_hex,
+                            "locations": list(nodes),
+                            "borrows": ent["n"] if ent else 0})
+            truncated = len(self._obj_locs) > CAP
+        if truncated:
+            out.append({"object_id": "...",
+                        "truncated": True,
+                        "locations": [],
+                        "borrows": 0})
+        return out
+
+    def list_tasks(self) -> List[Dict[str, Any]]:
+        """State-API task listing (reference:
+        experimental/state/api.py list_tasks): queued + running from
+        the live tables, finished from the bounded ring."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            running = set()
+            for w in self._workers.values():
+                running.update(w.running)
+            for task_id, meta in self._task_meta.items():
+                out.append({
+                    "task_id": task_id,
+                    "name": meta.get("name", ""),
+                    "state": "RUNNING" if task_id in running
+                             else "PENDING",
+                    "attempt": meta.get("attempt", 0),
+                })
+            for rec in reversed(
+                    getattr(self, "_done_tasks", {}).values()):
+                out.append(dict(rec))
+        return out
 
     def cluster_resources(self) -> Dict[str, float]:
         with self._lock:
@@ -1303,6 +1372,8 @@ class HeadService:
                 meta = self._task_meta.pop(task_id, None)
                 self._unpin_args_locked(meta)
                 if meta is not None:
+                    self._record_task_done_locked(task_id, meta,
+                                                  "FAILED")
                     doomed.append(meta["return_ids"])
             del self._pending[sig]
         if doomed:
@@ -1404,6 +1475,12 @@ class HeadService:
             for task_id in task_ids:
                 meta = self._task_meta.pop(task_id, None)
                 self._unpin_args_locked(meta)
+                if meta is not None:
+                    # meta None = already finalized elsewhere (e.g.
+                    # failed via worker death while this report was in
+                    # flight): never overwrite that terminal record.
+                    self._record_task_done_locked(task_id, meta,
+                                                  "FINISHED")
                 if w is not None:
                     w.running.discard(task_id)
                     held = w.running_res.pop(task_id, None)
@@ -1442,6 +1519,7 @@ class HeadService:
                 return
             self._task_meta.pop(task_id, None)
             self._unpin_args_locked(meta)
+            self._record_task_done_locked(task_id, meta, "FAILED")
         self._store_error(meta["return_ids"],
                           NodeDiedError(
                               f"worker died running task {task_id}"))

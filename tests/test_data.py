@@ -270,3 +270,14 @@ def test_torch_interop(rt):
     lrows = rd.from_torch(ListDS(), parallelism=1).take_all()
     assert all(isinstance(x, np.ndarray)
                for row in lrows for x in row)
+
+
+def test_dataset_stats_report(rt):
+    from ray_tpu.data import Dataset
+    ds = Dataset([ray_tpu.put([i, i + 1]) for i in range(4)])
+    lazy = ds.map(lambda x: x * 2)
+    plan = lazy.stats()
+    assert "pending_stages=['map']" in plan
+    mat = lazy.materialize()
+    rep = mat.stats()
+    assert "last execution" in rep and "rows: 8 total" in rep

@@ -388,3 +388,53 @@ def test_concurrency_groups_distributed(cluster):
     assert quick_dt < _time.time() - t0   # quick beat the group drain
     # group parallelism proven by the peak-concurrency counter
     assert ray_tpu.get(w.peak_seen.remote(), timeout=10) == 2
+
+
+def test_state_api_lists_tasks_and_objects():
+    """list_tasks/list_objects on the multiprocess runtime (were
+    empty stubs; reference: experimental/state/api.py)."""
+    import time
+    import numpy as np
+    import ray_tpu
+    from ray_tpu import state
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=1,
+                 resources_per_worker={"CPU": 2, "n0": 10}) as c:
+        c.add_node(num_workers=1,
+                   resources_per_worker={"CPU": 2, "n1": 10})
+
+        @ray_tpu.remote
+        def work(x):
+            return x + 1
+
+        refs = [work.remote(i) for i in range(5)]
+        assert ray_tpu.get(refs) == [1, 2, 3, 4, 5]
+        deadline = time.time() + 10
+        finished = []
+        while time.time() < deadline:
+            finished = [t for t in state.list_tasks()
+                        if t["state"] == "FINISHED"
+                        and t["name"].endswith("work")]
+            if len(finished) >= 5:
+                break
+            time.sleep(0.2)
+        assert len(finished) >= 5, finished[:3]
+        # objects: a registered multinode object shows its location
+        ref = ray_tpu.put(np.ones((1 << 20) // 8))
+
+        @ray_tpu.remote(resources={"n1": 1})
+        def touch(a):
+            return a.nbytes
+        assert ray_tpu.get(touch.remote(ref)) == 1 << 20
+        deadline = time.time() + 10
+        objs = []
+        while time.time() < deadline:
+            objs = state.list_objects()
+            if any(o["object_id"] == ref.id.hex() for o in objs):
+                break
+            time.sleep(0.2)
+        mine = [o for o in objs if o["object_id"] == ref.id.hex()]
+        assert mine and mine[0]["locations"], objs[:3]
