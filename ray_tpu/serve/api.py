@@ -32,7 +32,9 @@ class Deployment:
                 max_ongoing_requests: Optional[int] = None,
                 autoscaling_config: Optional[AutoscalingConfig] = None,
                 ray_actor_options: Optional[Dict[str, Any]] = None,
-                mesh: Optional[Dict[str, int]] = None) -> "Deployment":
+                mesh: Optional[Dict[str, int]] = None,
+                user_config: Optional[Dict[str, Any]] = None
+                ) -> "Deployment":
         import dataclasses
         cfg = dataclasses.replace(self.config)
         if num_replicas is not None:
@@ -45,6 +47,8 @@ class Deployment:
             cfg.ray_actor_options = ray_actor_options
         if mesh is not None:
             cfg.mesh = mesh
+        if user_config is not None:
+            cfg.user_config = user_config
         d = Deployment(self._target, name or self.name, cfg)
         d._init_args = self._init_args
         d._init_kwargs = self._init_kwargs
@@ -72,7 +76,8 @@ def deployment(_target=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 8,
                autoscaling_config: Optional[AutoscalingConfig] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
-               mesh: Optional[Dict[str, int]] = None):
+               mesh: Optional[Dict[str, int]] = None,
+               user_config: Optional[Dict[str, Any]] = None):
     """``@serve.deployment`` decorator for classes or functions."""
 
     def wrap(target):
@@ -81,7 +86,8 @@ def deployment(_target=None, *, name: Optional[str] = None,
             max_ongoing_requests=max_ongoing_requests,
             autoscaling_config=autoscaling_config,
             ray_actor_options=ray_actor_options,
-            mesh=mesh)
+            mesh=mesh,
+            user_config=user_config)
         return Deployment(
             target, name or getattr(target, "__name__", "deployment"),
             cfg)

@@ -25,5 +25,9 @@ class DeploymentConfig:
     ray_actor_options: Optional[Dict[str, Any]] = None
     # TPU-native: replicas can be SPMD mesh gangs.
     mesh: Optional[Dict[str, int]] = None
+    # Live-reconfigurable options delivered to instance.reconfigure()
+    # without restarting replicas (reference: deployment user_config +
+    # rolling reconfigure, serve/_private/deployment_state.py).
+    user_config: Optional[Dict[str, Any]] = None
     health_check_period_s: float = 5.0
     graceful_shutdown_timeout_s: float = 10.0

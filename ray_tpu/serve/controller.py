@@ -24,7 +24,8 @@ class Replica:
     """Actor wrapping one instance of a deployment."""
 
     def __init__(self, deployment_name: str, replica_id: str,
-                 cls, init_args, init_kwargs, mesh_axes=None):
+                 cls, init_args, init_kwargs, mesh_axes=None,
+                 user_config=None):
         self.deployment_name = deployment_name
         self.replica_id = replica_id
         self.mesh = None
@@ -38,6 +39,9 @@ class Replica:
             if self.mesh is not None and \
                     hasattr(self.instance, "setup_mesh"):
                 self.instance.setup_mesh(self.mesh)
+            self._user_config = None
+            if user_config is not None:
+                self.reconfigure(user_config)
         self._ongoing = 0
         self._total = 0
         # _ongoing is mutated from the event loop AND pool threads
@@ -46,6 +50,19 @@ class Replica:
         import threading
         self._count_lock = threading.Lock()
         self._streams: Dict[str, Dict[str, Any]] = {}
+
+    def reconfigure(self, user_config) -> bool:
+        """Apply a user_config update IN PLACE (reference: the replica
+        reconfigure hook — rolling updates without restarts). The
+        instance's own ``reconfigure(user_config)`` does the work; a
+        deployment without one simply records the config (visible via
+        stats) so updates are not an error."""
+        self._user_config = user_config
+        fn = getattr(self.instance, "reconfigure", None)
+        if callable(fn):
+            fn(user_config)
+            return True
+        return False
 
     def _adjust_ongoing(self, delta: int):
         with self._count_lock:
@@ -246,6 +263,7 @@ class Replica:
     def stats(self):
         self._reap_abandoned_streams()
         return {"replica_id": self.replica_id,
+                "user_config": getattr(self, "_user_config", None),
                 "ongoing": self._ongoing,
                 "total": self._total}
 
@@ -269,6 +287,27 @@ class Controller:
     def deploy(self, name: str, cls, init_args, init_kwargs,
                config: DeploymentConfig) -> None:
         d = self._deployments.get(name)
+        if d is not None and self._only_user_config_changed(
+                d, cls, init_args, init_kwargs, config):
+            # Light path (reference: user_config-only updates roll
+            # reconfigure() through live replicas, no restarts). The
+            # acks are AWAITED: a reconfigure() that raises, or a
+            # wedged replica, must not be reported as a successful
+            # deploy — on any failure fall through to the versioned
+            # redeploy, which replaces replicas wholesale.
+            refs = []
+            try:
+                for h in list(d["replicas"].values()):
+                    refs.append(
+                        h.reconfigure.remote(config.user_config))
+                # Bounded: a wedged replica must not stall the
+                # controller mailbox longer than this.
+                ray_tpu.get(refs, timeout=10)
+            except Exception:
+                pass          # heavy path below restarts replicas
+            else:
+                d["config"] = config
+                return
         version = (d["version"] + 1) if d else 0
         target = config.num_replicas
         if config.autoscaling_config:
@@ -288,6 +327,30 @@ class Controller:
             # STOPPING state in serve/_private/deployment_state.py:56).
             "draining": dict(d["draining"]) if d else {},
         }
+
+    @staticmethod
+    def _only_user_config_changed(d, cls, init_args, init_kwargs,
+                                  config: DeploymentConfig) -> bool:
+        import dataclasses
+        old: DeploymentConfig = d["config"]
+        a = dataclasses.replace(old, user_config=None)
+        b = dataclasses.replace(config, user_config=None)
+        if a != b or old.user_config == config.user_config:
+            return False
+        if old.user_config is None or config.user_config is None:
+            # Setting or CLEARING user_config restarts: live replicas
+            # would otherwise see reconfigure(None) while future
+            # spawns (guarded on `is not None`) never get the call.
+            return False
+        # Code identity: the redeploy must carry the same class/args
+        # (bit-identical pickles) or replicas need real restarts.
+        import cloudpickle
+        try:
+            return (cloudpickle.dumps((cls, init_args, init_kwargs)) ==
+                    cloudpickle.dumps((d["cls"], d["init_args"],
+                                       d["init_kwargs"])))
+        except Exception:
+            return False
 
     def delete_deployment(self, name: str):
         d = self._deployments.pop(name, None)
@@ -371,7 +434,7 @@ class Controller:
             max_concurrency=max(8, cfg.max_ongoing_requests),
             **opts).remote(
             name, rid, d["cls"], d["init_args"], d["init_kwargs"],
-            cfg.mesh)
+            cfg.mesh, cfg.user_config)
         d["replicas"][rid] = handle
 
     async def _control_loop(self):

@@ -622,3 +622,44 @@ def test_multiplexed_loader_dedup_under_concurrency(rt):
     assert len(results) == 8
     assert all(r["id"] == "m1" for r in results)
     assert host.loads == ["m1"]            # exactly one load
+
+
+def test_user_config_reconfigure_without_restart(serve_rt):
+    """user_config updates roll reconfigure() through LIVE replicas —
+    no restarts (reference: deployment user_config semantics)."""
+    import time
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1, user_config={"threshold": 1})
+    class Scorer:
+        def __init__(self):
+            self.pid_mark = id(self)
+            self.threshold = None
+
+        def reconfigure(self, user_config):
+            self.threshold = user_config["threshold"]
+
+        def __call__(self, x):
+            return {"hit": x >= self.threshold,
+                    "mark": self.pid_mark,
+                    "threshold": self.threshold}
+
+    app = Scorer.bind()
+    h = serve.run(app, timeout_s=120)
+    first = ray_tpu.get(h.remote(5))
+    assert first == {"hit": True, "mark": first["mark"],
+                     "threshold": 1}
+
+    # redeploy with ONLY user_config changed
+    h2 = serve.run(Scorer.options(user_config={"threshold": 10}).bind(),
+                   timeout_s=120)
+    deadline = time.time() + 10
+    out = None
+    while time.time() < deadline:
+        out = ray_tpu.get(h2.remote(5))
+        if out["threshold"] == 10:
+            break
+        time.sleep(0.2)
+    assert out["threshold"] == 10 and out["hit"] is False
+    # the SAME instance served both configs: no replica restart
+    assert out["mark"] == first["mark"]
