@@ -47,7 +47,8 @@ def wait(refs: List[ObjectRef], num_returns: int = 1,
 
 
 def cancel(ref: ObjectRef, force: bool = False, recursive: bool = True):
-    global_worker().runtime.cancel(ref, force=force, recursive=recursive)
+    return global_worker().runtime.cancel(ref, force=force,
+                                          recursive=recursive)
 
 
 # --------------------------------------------------------------------------

@@ -523,7 +523,8 @@ def _direct_sender(head: RpcClient, addr: str) -> _DirectActorSender:
 def submit_actor_task_via_head(head: RpcClient, actor_id: ActorID,
                                spec: TaskSpec,
                                ret_addr: Optional[str] = None):
-    refs = [ObjectRef(oid) for oid in spec.return_ids]
+    refs = [ObjectRef(oid, owner_hint="actor")
+            for oid in spec.return_ids]
     payload = _dumps_spec({
         "task_id": spec.task_id.hex(),
         "name": spec.name,
@@ -667,12 +668,12 @@ class DistributedRuntime:
         if _maybe_put_device(self.plane, oid, value, "head"):
             # jax Arrays stay in HBM, referenced by a handle — the
             # plane stores only a descriptor (mesh/device_objects.py).
-            return ObjectRef(oid)
+            return ObjectRef(oid, owner_hint="put")
         # owned: small puts live in the process memory tier until
         # their ref escapes (promotion on ref pickling); owned objects
         # are eagerly freed when their last local ref drops
         self.plane.put_obj(oid, ("ok", value), owned=True)
-        return ObjectRef(oid)
+        return ObjectRef(oid, owner_hint="put")
 
     def put_at(self, oid: ObjectID, value):
         self.plane.put_bytes(oid, dumps(("ok", value)))
@@ -714,7 +715,23 @@ class DistributedRuntime:
         return actor_state_from_head(self.head, actor_id)
 
     def cancel(self, ref, force=False, recursive=True):
-        pass  # not yet supported on the multiprocess runtime
+        """Cancel the task producing `ref` (reference: ray.cancel).
+        Queued tasks fail immediately with TaskCancelledError; running
+        tasks are interrupted only with force=True (async exception in
+        the executing thread — C-blocked tasks interrupt when the call
+        returns). `recursive` child cancellation is not yet honored.
+        put() refs and actor-task refs raise TypeError, matching the
+        reference's contract (actor calls need kill, not cancel)."""
+        hint = getattr(ref, "owner_hint", None)
+        if hint == "put":
+            raise TypeError("ray_tpu.cancel() on a put() ref: only "
+                            "task returns are cancellable")
+        if hint == "actor":
+            raise TypeError("ray_tpu.cancel() on an actor-task ref: "
+                            "use ray_tpu.kill(actor) to interrupt "
+                            "actor work")
+        return self.head.call("cancel_task",
+                              ref.id.task_id().hex(), force)
 
     # placement groups
     def create_placement_group(self, spec):

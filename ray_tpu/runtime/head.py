@@ -998,6 +998,69 @@ class HeadService:
         while len(rec) > self._DONE_TASKS_CAP:
             rec.popitem(last=False)
 
+    def cancel_task(self, task_id: str, force: bool = False) -> str:
+        """Cancel a task (reference: CoreWorker CancelTask). Queued
+        tasks dequeue with TaskCancelledError. Running tasks are
+        interrupted only with force=True — delivered as an async
+        TaskCancelledError into the executing THREAD (this executor
+        multiplexes tasks, so the reference's kill-the-worker force
+        path would take out co-resident tasks; see
+        Executor.cancel_task_exec for the interruption window).
+        Returns "cancelled" | "running" | "interrupted" | "done"
+        ("done" also covers refs that never were task returns — put()
+        refs are not distinguishable and never cancellable).
+        recursive child-cancellation is NOT yet implemented."""
+        from ray_tpu.exceptions import TaskCancelledError
+        with self._lock:
+            meta = self._task_meta.get(task_id)
+            if meta is None:
+                if task_id in getattr(self, "_done_tasks", {}):
+                    return "done"    # genuinely finished
+                # Unknown: the submission may still be in the client's
+                # flush buffer (cancel raced it here). Mark it so the
+                # ingest drops it on arrival — otherwise a cancel
+                # issued right after .remote() silently no-ops.
+                pc = getattr(self, "_precancelled", None)
+                if pc is None:
+                    import collections as _c
+                    pc = self._precancelled = _c.OrderedDict()
+                pc[task_id] = True
+                while len(pc) > 10000:
+                    pc.popitem(last=False)
+                return "cancelled"
+            running_worker = None
+            for w in self._workers.values():
+                if task_id in w.running:
+                    running_worker = w
+                    break
+            if running_worker is None:
+                # Still queued: drop it from its pending lane.
+                for sig, queue in self._pending.items():
+                    if task_id in queue:
+                        queue.remove(task_id)
+                        break
+                self._task_meta.pop(task_id, None)
+                self._unpin_args_locked(meta)
+                self._record_task_done_locked(task_id, meta,
+                                              "CANCELLED")
+                rids = meta["return_ids"]
+            elif not force:
+                return "running"     # no safe in-band interruption
+        if running_worker is None:
+            self._store_error(rids, TaskCancelledError(task_id))
+            return "cancelled"
+        # The interrupted task fails through the NORMAL completion
+        # path (its thread raises, the error is written to the
+        # returns, tasks_done releases resources) — no retry budget
+        # surgery, no worker death, no capacity loss. A "not-running"
+        # reply means it finished between our check and delivery.
+        try:
+            r = running_worker.client.call("cancel_task_exec",
+                                           task_id, timeout=10)
+        except Exception:
+            return "running"         # unreachable: nothing cancelled
+        return "interrupted" if r == "interrupted" else "done"
+
     def list_objects(self) -> List[Dict[str, Any]]:
         """State-API object listing from the location directory
         (reference: list_objects over the object table). Single-node
@@ -1111,9 +1174,17 @@ class HeadService:
     def submit_tasks(self, batch: List[Tuple[Dict[str, Any], bytes]]):
         """Batched submission: one lock acquire + one scheduler wake
         for a whole client-side flush window."""
+        precancel_rids = []
         with self._lock:
+            pc = getattr(self, "_precancelled", None)
             for meta, payload in batch:
                 meta = dict(meta)
+                if pc and pc.pop(meta["task_id"], None):
+                    # Cancelled before arrival: never enqueue.
+                    self._record_task_done_locked(
+                        meta["task_id"], meta, "CANCELLED")
+                    precancel_rids.append(meta["return_ids"])
+                    continue
                 meta["payload"] = payload
                 meta["attempt"] = 0
                 meta["state"] = "pending"
@@ -1128,6 +1199,10 @@ class HeadService:
                 self._pending.setdefault(
                     sig, collections.deque()).append(meta["task_id"])
             self._sched_cv.notify_all()
+        if precancel_rids:
+            from ray_tpu.exceptions import TaskCancelledError
+            for rids in precancel_rids:
+                self._store_error(rids, TaskCancelledError())
 
     def task_blocked(self, worker_id: str, resources: Dict[str, float]):
         """Worker reports a task blocked in get(): release its resources

@@ -45,6 +45,9 @@ SCHEMAS: Dict[str, Tuple[Param, ...]] = {
     "submit_tasks": (P("batch", list),),
     "push_tasks": (P("payloads", list),),
     "tasks_done": (P("worker_id", str), P("task_ids", list)),
+    "cancel_task": (P("task_id", str),
+                    P("force", bool, required=False)),
+    "cancel_task_exec": (P("task_id", str),),
     # actors
     "submit_actor_task": (P("actor_id", str), P("meta", dict),
                           P("payload", _BYTES),

@@ -90,6 +90,12 @@ class Executor:
         # resources when tasks_done arrives).
         self._done: List[str] = []
         self._push_clients: Dict[str, Any] = {}   # owner-direct returns
+        # task_id -> executing thread ident (force-cancel targeting);
+        # _cancel_on_start absorbs cancels that beat their task's
+        # dequeue (dispatched-but-not-started window).
+        self._task_threads: Dict[str, int] = {}
+        self._threads_lock = threading.Lock()
+        self._cancel_on_start: Dict[str, bool] = {}
         self._done_lock = threading.Lock()
         self._done_wake = threading.Event()
         self._notifier = threading.Thread(
@@ -329,6 +335,19 @@ class Executor:
     def _run_task(self, spec) -> str:
         _task_ctx.resources = spec.get("resources", {})
         _task_ctx.blocked = False
+        # Register this thread as the task's executor so a
+        # force-cancel can interrupt exactly this task (and nothing
+        # co-resident on the worker).
+        from ray_tpu.exceptions import TaskCancelledError as _TCE
+        tid_key = spec.get("task_id", "")
+        with self._threads_lock:
+            precancelled = self._cancel_on_start.pop(tid_key, False)
+            if not precancelled:
+                self._task_threads[tid_key] = threading.get_ident()
+        if precancelled:
+            self._write_error(spec["return_ids"], _TCE(tid_key))
+            self._report_done(tid_key)
+            return "cancelled"
         from ray_tpu._private.log_streaming import set_log_tag
         set_log_tag(f"{spec.get('name', 'task')} "
                     f"task={spec.get('task_id', '')[:12]}")
@@ -354,26 +373,51 @@ class Executor:
                         execution_span(spec.get("name", "task"),
                                        "task", spec.get("trace_ctx")):
                     result = func(*args, **kwargs)
+            # User code is done: close the cancellation window BEFORE
+            # committing results (a cancel landing mid-commit would
+            # corrupt the very value the caller may already observe).
+            with self._threads_lock:
+                self._task_threads.pop(tid_key, None)
             from ray_tpu.util import metrics as metrics_mod
             reg = metrics_mod.get_shm_registry()
             if reg is not None:
                 # Before the result write: a caller observing the result
                 # must also observe the counter.
                 reg.counter_add("raytpu_tasks_executed_total")
-            self._write_returns(spec["return_ids"],
-                                spec["num_returns"], result,
-                                ret_addr=spec.get("ret_addr"))
+            try:
+                self._write_returns(spec["return_ids"],
+                                    spec["num_returns"], result,
+                                    ret_addr=spec.get("ret_addr"))
+            except _TCE:
+                # An already-scheduled async cancel fired mid-commit:
+                # the user code DID complete — commit anyway.
+                self._write_returns(spec["return_ids"],
+                                    spec["num_returns"], result,
+                                    ret_addr=spec.get("ret_addr"))
             return "ok"
         except BaseException as e:  # noqa: BLE001
-            if not isinstance(e, TaskError):
+            if not isinstance(e, (TaskError, _TCE)):
                 e = TaskError(e, task_name=spec.get("name", ""),
                               remote_traceback=traceback.format_exc())
-            self._write_error(spec["return_ids"], e)
+            try:
+                self._write_error(spec["return_ids"], e)
+            except _TCE:
+                # A second async cancel landed mid-write: the write
+                # must still commit or the caller hangs.
+                self._write_error(spec["return_ids"], e)
             return "error"
         finally:
+            # Deregister under the SAME lock delivery uses: once this
+            # pop runs, no new cancel can target this thread, so the
+            # commit below cannot be interrupted by a fresh cancel.
+            with self._threads_lock:
+                self._task_threads.pop(tid_key, None)
             _task_ctx.resources = None
             set_log_tag(None)
-            self._report_done(spec.get("task_id", ""))
+            try:
+                self._report_done(spec.get("task_id", ""))
+            except _TCE:
+                self._report_done(spec.get("task_id", ""))
 
     # ---- actors -----------------------------------------------------------
 
@@ -648,6 +692,41 @@ class Executor:
     def ping(self) -> str:
         return "pong"
 
+    def cancel_task_exec(self, task_id: str) -> str:
+        """Force-cancel the THREAD executing `task_id` by raising
+        TaskCancelledError asynchronously in it (CPython
+        PyThreadState_SetAsyncExc). Proportionate for this executor —
+        workers multiplex many tasks on a thread pool, so the
+        reference's kill-the-worker force path would destroy
+        co-resident tasks/actors. The exception lands at the next
+        bytecode boundary: pure-Python loops die promptly; a task
+        blocked in a C call (sleep, IO, jit execution) is interrupted
+        when the call returns. A task DISPATCHED but not yet started
+        (still in the worker queue) is marked to cancel at start.
+        Returns "interrupted" | "not-running". Delivery happens under
+        _threads_lock against the commit-side pop, so a task that
+        already finished its user code can no longer be targeted."""
+        import ctypes
+        from ray_tpu.exceptions import TaskCancelledError
+        with self._threads_lock:
+            ident = self._task_threads.get(task_id)
+            if ident is None:
+                # Dispatched-but-queued window: cancel at start.
+                self._cancel_on_start[task_id] = True
+                while len(self._cancel_on_start) > 1000:
+                    self._cancel_on_start.pop(
+                        next(iter(self._cancel_on_start)))
+                return "interrupted"
+            n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident),
+                ctypes.py_object(TaskCancelledError))
+            if n != 1:
+                if n > 1:     # invalid state: undo (per CPython docs)
+                    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                        ctypes.c_ulong(ident), None)
+                return "not-running"
+            return "interrupted"
+
     def shutdown(self) -> str:
         self._shutdown.set()
         threading.Thread(target=lambda: (_sleep_exit()), daemon=True) \
@@ -689,9 +768,9 @@ class WorkerRuntime:
         oid = ObjectID.from_random()
         if _maybe_put_device(self._ex.plane, oid, value,
                              self._ex.plane.node_id):
-            return ObjectRef(oid)
+            return ObjectRef(oid, owner_hint="put")
         self._ex.plane.put_obj(oid, ("ok", value), owned=True)
-        return ObjectRef(oid)
+        return ObjectRef(oid, owner_hint="put")
 
     def get(self, refs, timeout=None):
         from ray_tpu.runtime.client import resolve_refs
@@ -754,7 +833,17 @@ class WorkerRuntime:
         return actor_state_from_head(self.head, actor_id)
 
     def cancel(self, ref, force=False, recursive=True):
-        pass  # not supported in the multiprocess runtime yet
+        """Nested cancel from inside a task (same head path and
+        same non-cancellable-ref contract as the driver's)."""
+        hint = getattr(ref, "owner_hint", None)
+        if hint == "put":
+            raise TypeError("ray_tpu.cancel() on a put() ref: only "
+                            "task returns are cancellable")
+        if hint == "actor":
+            raise TypeError("ray_tpu.cancel() on an actor-task ref: "
+                            "use ray_tpu.kill(actor)")
+        return self.head.call("cancel_task",
+                              ref.id.task_id().hex(), force)
 
     def cluster_resources(self):
         return self.head.call("cluster_resources")

@@ -438,3 +438,76 @@ def test_state_api_lists_tasks_and_objects():
             time.sleep(0.2)
         mine = [o for o in objs if o["object_id"] == ref.id.hex()]
         assert mine and mine[0]["locations"], objs[:3]
+
+
+def test_cancel_queued_and_force_running():
+    """ray_tpu.cancel on the multiprocess runtime (was a no-op stub):
+    queued tasks fail fast with TaskCancelledError; force=True
+    interrupts a RUNNING task by killing its worker (reference:
+    ray.cancel force_kill semantics)."""
+    import time
+    import pytest
+    import ray_tpu
+    from ray_tpu.exceptions import TaskCancelledError
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=2, resources_per_worker={"CPU": 1}):
+        @ray_tpu.remote(num_cpus=1)
+        def sleeper(sec):
+            # interruption-friendly wait: the async cancel exception
+            # lands between bytecodes, i.e. every 50ms here
+            t0 = time.time()
+            while time.time() - t0 < sec:
+                time.sleep(0.05)
+            return "done"
+
+        # occupy BOTH CPUs, then queue a third task and cancel it
+        running = [sleeper.remote(30), sleeper.remote(6)]
+        time.sleep(0.5)
+        queued = sleeper.remote(0)
+        time.sleep(0.3)
+        ray_tpu.cancel(queued)
+        with pytest.raises(TaskCancelledError):
+            ray_tpu.get(queued, timeout=15)
+        # non-force cancel of a RUNNING task is a no-op ("running")
+        ray_tpu.cancel(running[0])
+        # force-cancel: async TaskCancelledError in the executing
+        # THREAD — the task fails promptly, the worker survives, and
+        # nothing co-resident is touched
+        t0 = time.time()
+        res = ray_tpu.cancel(running[0], force=True)
+        assert res == "interrupted", res
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(running[0], timeout=20)
+        assert "cancel" in repr(ei.value).lower(), ei.value
+        assert time.time() - t0 < 15       # prompt, not wait-it-out
+        # the other task completes; BOTH workers still serve
+        assert ray_tpu.get(running[1], timeout=60) == "done"
+        assert ray_tpu.get(
+            [sleeper.remote(0) for _ in range(4)], timeout=60) == \
+            ["done"] * 4
+
+
+def test_cancel_rejects_non_task_refs():
+    import pytest
+    import ray_tpu
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=1, resources_per_worker={"CPU": 2}):
+        with pytest.raises(TypeError, match="put"):
+            ray_tpu.cancel(ray_tpu.put(1))
+
+        @ray_tpu.remote
+        class A:
+            def f(self):
+                return 1
+
+        a = A.remote()
+        ref = a.f.remote()
+        with pytest.raises(TypeError, match="actor"):
+            ray_tpu.cancel(ref)
+        assert ray_tpu.get(ref) == 1
