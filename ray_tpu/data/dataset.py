@@ -893,6 +893,25 @@ class GroupedDataset:
         return Dataset([_strip_gkey.remote(b)
                         for b in keyed._block_refs])
 
+    def map_groups(self, fn: Callable[[List[Any]], Any]) -> Dataset:
+        """Apply `fn` to each group's FULL row list (reference:
+        grouped_dataset.map_groups): fn(rows) -> one row, or a LIST of
+        rows which flattens into multiple output rows. Groups execute
+        as the aggregate's reduce tasks; results come back ordered by
+        group key."""
+        _marker = "__raytpu_rowlist"
+
+        def agg(_k, rows):
+            out = fn(rows)
+            if isinstance(out, list):
+                return {_marker: out}
+            return out
+
+        ds = self.aggregate(agg)
+        return ds.flat_map(
+            lambda r: r[_marker]
+            if isinstance(r, dict) and _marker in r else [r])
+
     def count(self) -> Dataset:
         return self.aggregate(
             lambda k, rows: {"key": k, "count": len(rows)})

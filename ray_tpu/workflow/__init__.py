@@ -25,11 +25,21 @@ from ray_tpu.dag import (DAGNode, FunctionNode, InputAttributeNode,
                          InputNode, MultiOutputNode, _scan)
 from ray_tpu.workflow.storage import WorkflowStorage
 
-__all__ = ["init", "run", "run_async", "resume", "resume_all", "get_status",
+__all__ = ["init", "run", "run_async", "resume", "resume_all",
+           "cancel", "WorkflowCancelledError", "get_status",
            "get_output", "list_all", "delete", "WorkflowStatus"]
 
 
+class WorkflowCancelledError(RuntimeError):
+    """The workflow was cancelled via workflow.cancel()."""
+
+    def __init__(self, workflow_id: str):
+        self.workflow_id = workflow_id
+        super().__init__(f"workflow {workflow_id!r} was cancelled")
+
+
 class WorkflowStatus:
+    CANCELED = "CANCELED"
     RUNNING = "RUNNING"
     SUCCESSFUL = "SUCCESSFUL"
     FAILED = "FAILED"
@@ -221,6 +231,18 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
 
     run_step = ray_tpu.remote(_run_step)
 
+    def check_cancel():
+        if storage.get_status(workflow_id) == WorkflowStatus.CANCELED:
+            # Best-effort cancel of in-flight steps; completed ones
+            # stay checkpointed, so a later resume() continues from
+            # here (the canceled workflow is resumable by design).
+            for ref in list(pending):
+                try:
+                    ray_tpu.cancel(ref)
+                except Exception:
+                    pass
+            raise WorkflowCancelledError(workflow_id)
+
     def ready_steps():
         for sid, spec in state.steps.items():
             if sid in done or sid in pending.values():
@@ -241,6 +263,7 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
             storage.save_step_result(workflow_id, sid, value)
 
     while True:
+        check_cancel()
         # Output-list steps complete synchronously and can unlock further
         # steps, so re-scan until the ready set is exhausted.
         progressed = True
@@ -269,7 +292,12 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
             raise RuntimeError(
                 f"workflow {workflow_id}: no runnable steps but output "
                 f"not produced (cyclic or corrupt state)")
-        ready, _ = ray_tpu.wait(list(pending), num_returns=1)
+        # Bounded wait so a cancel() is observed within ~1s even while
+        # a long step runs.
+        ready, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                timeout=1.0)
+        if not ready:
+            continue
         ref = ready[0]
         sid = pending.pop(ref)
         try:
@@ -308,6 +336,8 @@ def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
     storage.set_status(workflow_id, WorkflowStatus.RUNNING)
     try:
         out = _execute_state(state, workflow_id, storage)
+    except WorkflowCancelledError:
+        raise           # status already CANCELED; don't mark FAILED
     except BaseException:
         storage.set_status(workflow_id, WorkflowStatus.FAILED)
         raise
@@ -337,6 +367,8 @@ def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
         st = wf._get_storage()
         try:
             out = wf._execute_state(dag_state, wf_id, st)
+        except wf.WorkflowCancelledError:
+            raise       # status already CANCELED; don't mark FAILED
         except BaseException:
             st.set_status(wf_id, WorkflowStatus.FAILED)
             raise
@@ -363,6 +395,8 @@ def resume(workflow_id: str) -> Any:
     storage.set_status(workflow_id, WorkflowStatus.RUNNING)
     try:
         out = _execute_state(state, workflow_id, storage)
+    except WorkflowCancelledError:
+        raise           # status already CANCELED; don't mark FAILED
     except BaseException:
         storage.set_status(workflow_id, WorkflowStatus.FAILED)
         raise
@@ -388,6 +422,20 @@ def resume_all() -> List[Tuple[str, Any]]:
     return out
 
 
+def cancel(workflow_id: str) -> None:
+    """Request cancellation (reference: workflow.cancel): the driving
+    loop observes the CANCELED status at its next scheduling point,
+    cancels in-flight steps best-effort, and raises
+    WorkflowCancelledError to its caller. Checkpointed step results
+    are KEPT — resume(workflow_id) continues the workflow later."""
+    storage = _get_storage()
+    if not storage.exists(workflow_id):
+        raise ValueError(f"no workflow {workflow_id!r}")
+    if storage.get_status(workflow_id) == WorkflowStatus.SUCCESSFUL:
+        return     # completed first: cancellation lost the race
+    storage.set_status(workflow_id, WorkflowStatus.CANCELED)
+
+
 def get_status(workflow_id: str) -> Optional[str]:
     return _get_storage().get_status(workflow_id)
 
@@ -400,7 +448,8 @@ def get_output(workflow_id: str, timeout: Optional[float] = None) -> Any:
         if storage.has_output(workflow_id):
             return storage.load_output(workflow_id)
         status = storage.get_status(workflow_id)
-        if status in (WorkflowStatus.FAILED, None):
+        if status in (WorkflowStatus.FAILED,
+                      WorkflowStatus.CANCELED, None):
             raise RuntimeError(
                 f"workflow {workflow_id} has no output (status={status})")
         if deadline is not None and time.monotonic() > deadline:

@@ -281,3 +281,27 @@ def test_dataset_stats_report(rt):
     mat = lazy.materialize()
     rep = mat.stats()
     assert "last execution" in rep and "rows: 8 total" in rep
+
+
+def test_groupby_map_groups(rt):
+    from ray_tpu.data import Dataset
+    rows = [{"k": i % 3, "v": i} for i in range(12)]
+    ds = Dataset([ray_tpu.put(rows[:6]), ray_tpu.put(rows[6:])])
+    out = ds.groupby("k").map_groups(
+        lambda grp: {"k": grp[0]["k"],
+                     "vs": sorted(r["v"] for r in grp)}).take_all()
+    assert [o["k"] for o in out] == [0, 1, 2]
+    assert out[0]["vs"] == [0, 3, 6, 9]
+    assert out[2]["vs"] == [2, 5, 8, 11]
+
+
+def test_map_groups_list_return_flattens(rt):
+    from ray_tpu.data import Dataset
+    rows = [{"k": i % 2, "v": i} for i in range(6)]
+    ds = Dataset([ray_tpu.put(rows)])
+    out = ds.groupby("k").map_groups(
+        lambda grp: [{"k": r["k"], "v2": r["v"] * 2} for r in grp]
+    ).take_all()
+    assert len(out) == 6                       # flattened, not nested
+    assert all(set(r) == {"k", "v2"} for r in out)
+    assert sorted(r["v2"] for r in out) == [0, 2, 4, 6, 8, 10]

@@ -169,3 +169,53 @@ def test_parallel_fanout(wf):
 
     dag = gather.bind([part.bind(i) for i in range(5)])
     assert wf.run(dag, workflow_id="wp") == sum(i * i for i in range(5))
+
+
+def test_cancel_then_resume(rt, tmp_path):
+    """workflow.cancel stops the driving loop (in-flight steps
+    best-effort-cancelled, checkpoints KEPT); resume() continues from
+    the completed prefix."""
+    import threading
+    import time
+    import pytest
+    from ray_tpu import workflow
+    from ray_tpu.workflow import WorkflowCancelledError
+
+    workflow.init(str(tmp_path))
+    ran = []
+
+    @ray_tpu.remote
+    def quick(tag):
+        return tag
+
+    import os
+    gate = str(tmp_path / "gate")
+
+    @ray_tpu.remote
+    def slow(x, gate_path):
+        import os as _os
+        import time as _t
+        t0 = _t.time()
+        while not _os.path.exists(gate_path) and \
+                _t.time() - t0 < 20:
+            _t.sleep(0.05)
+        return x + "!"
+
+    dag = slow.bind(quick.bind("a"), gate)
+    wid = "wf-cancel-1"
+
+    def canceller():
+        time.sleep(1.0)
+        workflow.cancel(wid)
+
+    threading.Thread(target=canceller, daemon=True).start()
+    t0 = time.time()
+    with pytest.raises(WorkflowCancelledError):
+        workflow.run(dag, workflow_id=wid)
+    assert time.time() - t0 < 15          # stopped, didn't wait out
+    assert workflow.get_status(wid) == workflow.WorkflowStatus.CANCELED
+
+    # resume() re-runs only what's missing; the workflow completes
+    open(gate, "w").write("go")      # let the slow step finish fast
+    out = workflow.resume(wid)
+    assert out == "a!"
