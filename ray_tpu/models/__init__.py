@@ -1,6 +1,9 @@
 from ray_tpu.models.bert import (Bert, BertConfig, bert_base,
                                  bert_sharding_rules, bert_tiny,
                                  mask_tokens, mlm_loss)
+from ray_tpu.models.t5 import (T5, T5Config, greedy_decode as
+                               t5_greedy_decode, seq2seq_loss,
+                               t5_sharding_rules, t5_small, t5_tiny)
 from ray_tpu.models.gpt2 import (GPT2, GPT2Config, gpt2_sharding_rules,
                                  gpt2_124m)
 from ray_tpu.models.llama import (Llama, LlamaConfig, generate,
@@ -12,6 +15,8 @@ from ray_tpu.models.mixtral import (Mixtral, MixtralConfig,
 from ray_tpu.models.resnet import ResNet, ResNetConfig, resnet50, resnet18
 
 __all__ = [
+    "T5", "T5Config", "t5_small", "t5_tiny", "t5_sharding_rules",
+    "t5_greedy_decode", "seq2seq_loss",
     "Bert", "BertConfig", "bert_base", "bert_tiny",
     "bert_sharding_rules", "mask_tokens", "mlm_loss",
     "GPT2", "GPT2Config", "gpt2_sharding_rules", "gpt2_124m",

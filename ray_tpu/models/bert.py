@@ -53,11 +53,7 @@ def bert_tiny(**overrides) -> BertConfig:
     return BertConfig(**d)
 
 
-def _padding_bias(attention_mask):
-    """[B, T] 1/0 mask -> additive [B, 1, 1, T] fp32 bias (0 keep,
-    -inf drop) broadcast over heads and query positions."""
-    neg = jnp.asarray(-1e30, jnp.float32)
-    return jnp.where(attention_mask[:, None, None, :] > 0, 0.0, neg)
+from ray_tpu.ops.attention import padding_bias as _padding_bias
 
 
 class BertSelfAttention(nn.Module):

@@ -79,3 +79,11 @@ def multi_head_attention(q, k, v, causal: bool = True,
             "impl='ring' must be invoked through "
             "ray_tpu.parallel.sequence.ring_attention inside shard_map")
     return xla_attention(q, k, v, causal=causal, bias=bias)
+
+
+def padding_bias(attention_mask):
+    """[B, T] 1/0 mask -> additive [B, 1, 1, T] fp32 bias (0 keep,
+    -1e30 drop) broadcast over heads and query positions. The shared
+    mask convention for encoder models (bert, t5)."""
+    import jax.numpy as jnp
+    return jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e30)

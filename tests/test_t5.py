@@ -1,0 +1,91 @@
+"""T5 encoder-decoder family: causal/cross attention semantics,
+seq2seq training convergence on a copy task, greedy decode, sharding.
+"""
+import numpy as np
+import pytest
+
+
+def test_forward_shapes_and_causality():
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models import T5, t5_tiny
+    cfg = t5_tiny()
+    model = T5(cfg)
+    rng = np.random.RandomState(0)
+    enc = jnp.asarray(rng.randint(2, cfg.vocab_size, (2, 10)))
+    dec = jnp.asarray(rng.randint(2, cfg.vocab_size, (2, 7)))
+    params = model.init(jax.random.PRNGKey(0), enc, dec)
+    logits = model.apply(params, enc, dec)
+    assert logits.shape == (2, 7, cfg.vocab_size)
+    # decoder causality: changing a LATER target token must not
+    # change earlier positions' logits
+    dec2 = dec.at[:, 5].set((dec[:, 5] + 1) % cfg.vocab_size)
+    l2 = model.apply(params, enc, dec2)
+    np.testing.assert_allclose(np.asarray(logits[:, :5]),
+                               np.asarray(l2[:, :5]), atol=1e-5)
+    assert not np.allclose(np.asarray(logits[:, 5:]),
+                           np.asarray(l2[:, 5:]))
+    # encoder padding mask: padded source positions don't leak
+    mask = jnp.asarray([[1] * 10, [1] * 6 + [0] * 4])
+    lm = model.apply(params, enc, dec, enc_mask=mask)
+    enc_trunc = enc[1:, :6]
+    lt = model.apply(params, enc_trunc, dec[1:],
+                     enc_mask=jnp.ones((1, 6), jnp.int32))
+    np.testing.assert_allclose(np.asarray(lm[1]), np.asarray(lt[0]),
+                               atol=2e-4)
+
+
+def test_copy_task_trains_and_decodes():
+    """Seq2seq training under the SHARDED spmd step on the 8-device
+    mesh: the model fits a fixed batch of copy examples (pure T5 has
+    no cross-attention position bias, so generalizing copy alignment
+    from scratch needs far more than a unit-test budget — fixed-batch
+    convergence still exercises the full sharded fwd/bwd) and greedy
+    decode echoes those sources."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from ray_tpu.mesh.device_mesh import create_mesh
+    from ray_tpu.models import (T5, seq2seq_loss, t5_greedy_decode,
+                                t5_sharding_rules, t5_tiny)
+    from ray_tpu.train.spmd import (TrainState, make_train_step,
+                                    put_batch, shard_state)
+    cfg = t5_tiny(vocab_size=32, dim=64, n_heads=4, hidden_dim=128)
+    mesh = create_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+    model = T5(cfg)
+    rng = np.random.RandomState(0)
+    L = 6
+
+    def make_batch(n=16):
+        src = rng.randint(3, cfg.vocab_size, (n, L))
+        dec_in = np.concatenate(
+            [np.full((n, 1), 1), src[:, :-1]], axis=1)   # BOS + shift
+        return {"enc": src.astype(np.int32),
+                "dec": dec_in.astype(np.int32),
+                "tgt": src.astype(np.int32)}
+
+    b0 = make_batch(2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(b0["enc"]), jnp.asarray(b0["dec"]))
+    optimizer = optax.adam(1e-2)
+    state = shard_state(TrainState.create(params, optimizer),
+                        t5_sharding_rules(), mesh)
+
+    def loss_fn(p, batch):
+        logits = model.apply(p, batch["enc"], batch["dec"])
+        return seq2seq_loss(logits, batch["tgt"])
+
+    step = make_train_step(loss_fn, optimizer)
+    fixed = make_batch()
+    losses = []
+    with jax.set_mesh(mesh):
+        batch = put_batch(fixed, mesh)
+        for _ in range(250):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+    assert losses[-1] < 0.3, (losses[0], losses[-1])
+    # greedy decode echoes the fitted sources (host-side params)
+    host = jax.device_get(state.params)
+    src = fixed["enc"][:2]
+    out = t5_greedy_decode(model, host, src, max_len=L, bos_id=1)
+    assert (np.asarray(out) == src).mean() > 0.9, (out, src)
