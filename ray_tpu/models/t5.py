@@ -100,6 +100,7 @@ class RelPosBias(nn.Module):
 
 class T5Attention(nn.Module):
     config: T5Config
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x, kv=None, bias=None):
@@ -119,7 +120,7 @@ class T5Attention(nn.Module):
 
         from ray_tpu.ops.attention import multi_head_attention
         y = multi_head_attention(heads(q, Tq), heads(k, Tk),
-                                 heads(v, Tk), causal=False,
+                                 heads(v, Tk), causal=self.causal,
                                  impl="xla", bias=bias)
         y = y.reshape(B, Tq, cfg.dim)
         return nn.Dense(cfg.dim, use_bias=False, dtype=cfg.dtype,
@@ -142,7 +143,7 @@ class T5FFN(nn.Module):
 from ray_tpu.models.llama import RMSNorm as _LlamaRMSNorm
 
 
-def RMSNorm(dim, name):
+def RMSNorm(name):
     """Llama's RMSNorm (identical math; dim inferred from input) with
     T5's 1e-6 epsilon."""
     return _LlamaRMSNorm(eps=1e-6, name=name)
@@ -160,10 +161,10 @@ class EncoderLayer(nn.Module):
                 return nn.Dropout(cfg.dropout)(v, deterministic)
             return v
 
-        h = RMSNorm(cfg.dim, name="ln_attn")(x)
+        h = RMSNorm(name="ln_attn")(x)
         x = x + drop(T5Attention(cfg, name="attn")(
             h.astype(cfg.dtype), bias=bias))
-        h = RMSNorm(cfg.dim, name="ln_ffn")(x)
+        h = RMSNorm(name="ln_ffn")(x)
         return x + drop(T5FFN(cfg, name="ffn")(h.astype(cfg.dtype)))
 
 
@@ -180,19 +181,15 @@ class DecoderLayer(nn.Module):
                 return nn.Dropout(cfg.dropout)(v, deterministic)
             return v
 
-        h = RMSNorm(cfg.dim, name="ln_self")(x)
-        x = x + drop(T5Attention(cfg, name="self_attn")(
+        h = RMSNorm(name="ln_self")(x)
+        x = x + drop(T5Attention(cfg, causal=True,
+                                 name="self_attn")(
             h.astype(cfg.dtype), bias=self_bias))
-        h = RMSNorm(cfg.dim, name="ln_cross")(x)
+        h = RMSNorm(name="ln_cross")(x)
         x = x + drop(T5Attention(cfg, name="cross_attn")(
             h.astype(cfg.dtype), kv=enc, bias=cross_bias))
-        h = RMSNorm(cfg.dim, name="ln_ffn")(x)
+        h = RMSNorm(name="ln_ffn")(x)
         return x + drop(T5FFN(cfg, name="ffn")(h.astype(cfg.dtype)))
-
-
-def _causal_bias(T):
-    mask = jnp.tril(jnp.ones((T, T), bool))
-    return jnp.where(mask, 0.0, -1e30)[None, None]   # [1,1,T,T]
 
 
 from ray_tpu.ops.attention import padding_bias as _pad_bias
@@ -225,14 +222,15 @@ class T5(nn.Module):
             for i in range(cfg.n_enc_layers):
                 x = EncoderLayer(cfg, name=f"enc_{i}")(
                     x, enc_bias, deterministic)
-            enc_out = RMSNorm(cfg.dim, name="enc_final_ln")(x)
+            enc_out = RMSNorm(name="enc_final_ln")(x)
         if encode_only:
             return enc_out
         # --- decoder ---
         y = emb[dec_ids].astype(cfg.dtype)
+        # Causality rides the attention op (causal=True on
+        # self_attn); only the rel-pos term travels as a bias.
         self_bias = RelPosBias(cfg, bidirectional=False,
-                               name="dec_relpos")(Td, Td) + \
-            _causal_bias(Td)
+                               name="dec_relpos")(Td, Td)
         cross_bias = None
         if enc_mask is not None:
             cross_bias = _pad_bias(enc_mask)
@@ -240,7 +238,7 @@ class T5(nn.Module):
             y = DecoderLayer(cfg, name=f"dec_{i}")(
                 y, enc_out.astype(cfg.dtype), self_bias, cross_bias,
                 deterministic)
-        y = RMSNorm(cfg.dim, name="dec_final_ln")(y)
+        y = RMSNorm(name="dec_final_ln")(y)
         # Tied head, T5's 1/sqrt(d) output scaling.
         logits = jnp.einsum("btd,vd->btv", y.astype(cfg.dtype),
                             emb.astype(cfg.dtype))
@@ -257,13 +255,24 @@ def seq2seq_loss(logits, targets, pad_id: int = 0):
         jnp.maximum(mask.sum(), 1)
 
 
+_DECODE_CACHE: dict = {}
+
+
 def greedy_decode(model: T5, params, enc_ids, max_len: int,
                   bos_id: int = 1, enc_mask=None):
     """Jitted greedy seq2seq decode: the encoder runs ONCE, then one
     lax.scan over target positions re-runs the (short-sequence)
     decoder per step — the classic simple schedule; KV-cached decode
-    rides the Llama engine for the decoder-only families."""
+    rides the Llama engine for the decoder-only families. Compiled
+    programs cache per (config, shapes) like llama's generate."""
     B = enc_ids.shape[0]
+    key = (model.config, B, enc_ids.shape[1], max_len, bos_id,
+           enc_mask is not None)
+    cached = _DECODE_CACHE.get(key)
+    if cached is not None:
+        return cached(params, jnp.asarray(enc_ids),
+                      None if enc_mask is None else
+                      jnp.asarray(enc_mask))
 
     @jax.jit
     def run(params, enc_ids, enc_mask):
@@ -285,6 +294,9 @@ def greedy_decode(model: T5, params, enc_ids, max_len: int,
         dec, outs = jax.lax.scan(step, dec0, jnp.arange(max_len))
         return dec[:, 1:]
 
+    if len(_DECODE_CACHE) > 16:
+        _DECODE_CACHE.clear()     # bound retained executables
+    _DECODE_CACHE[key] = run
     return run(params, jnp.asarray(enc_ids),
                None if enc_mask is None else jnp.asarray(enc_mask))
 

@@ -85,5 +85,4 @@ def padding_bias(attention_mask):
     """[B, T] 1/0 mask -> additive [B, 1, 1, T] fp32 bias (0 keep,
     -1e30 drop) broadcast over heads and query positions. The shared
     mask convention for encoder models (bert, t5)."""
-    import jax.numpy as jnp
     return jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e30)
