@@ -72,11 +72,16 @@ def pipeline_run_local(stage_fn: Callable[[Any, jax.Array], jax.Array],
     micro = x.reshape((M, mb) + x.shape[1:])
     # Carries derive from x (inheriting its varying axes — data/
     # sequence/... in the composed step) plus the pipeline axis the
-    # schedule itself varies over (jax>=0.9 vma typing).
-    outputs = jax.lax.pcast(jnp.zeros_like(micro), (axis_name,),
-                            to="varying")
-    carry_in = jax.lax.pcast(jnp.zeros_like(micro[0]),
-                             (axis_name,), to="varying")
+    # schedule itself varies over (jax>=0.9 vma typing; skip the cast
+    # when the caller already widened x over the pipeline axis).
+    def _vary_pipeline(v):
+        vma = set(getattr(jax.typeof(v), "vma", ()) or ())
+        if axis_name in vma:
+            return v
+        return jax.lax.pcast(v, (axis_name,), to="varying")
+
+    outputs = _vary_pipeline(jnp.zeros_like(micro))
+    carry_in = _vary_pipeline(jnp.zeros_like(micro[0]))
 
     def tick(t, state):
         outputs, recv = state

@@ -110,19 +110,25 @@ def make_composed_loss(stage_fn: Callable[[Any, jax.Array], jax.Array],
             # Each pipeline rank holds S_total/S stages; apply them in
             # order (a "stage" of the schedule = this rank's slice).
             def local_stage(lp, act):
-                # Params vary over the pipeline axis; the carry must
-                # too or the scan's vma types diverge.
-                vma = set(getattr(jax.typeof(act), "vma", ()) or ())
-                if "pipeline" not in vma:
-                    act = jax.lax.pcast(act, ("pipeline",),
-                                        to="varying")
-
                 def body(carry, p):
                     return stage_fn(p, carry), None
                 out, _ = jax.lax.scan(body, act, lp)
                 return out
 
             xl = jax.tree_util.tree_leaves(local_batch)[0]
+            # The stage's output may vary over ANY nontrivial mesh
+            # axis (pipeline-sharded params, expert all_to_alls,
+            # tensor collectives inside stage_fn) — type the input,
+            # hence every schedule carry derived from it, as varying
+            # over all of them up front or the scan vma types diverge
+            # on the first iteration. Over-marking is semantically
+            # safe (it only widens the loss psum, which the weight
+            # widens identically).
+            vma = set(getattr(jax.typeof(xl), "vma", ()) or ())
+            widen = tuple(a for a in mesh.axis_names
+                          if mesh.shape[a] > 1 and a not in vma)
+            if widen:
+                xl = jax.lax.pcast(xl, widen, to="varying")
             if S > 1:
                 out = pipeline_run_local(local_stage, local_params,
                                          xl, M, S, "pipeline")
