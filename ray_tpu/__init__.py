@@ -26,6 +26,7 @@ from ray_tpu.api import (
     wait,
 )
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.runtime_context import RuntimeContext, get_runtime_context
 from ray_tpu import exceptions
 from ray_tpu.exceptions import (
     ActorDiedError,
@@ -49,6 +50,7 @@ def method(**kwargs):
 
 
 __all__ = [
+    "RuntimeContext", "get_runtime_context",
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "cancel", "kill", "get_actor", "ObjectRef", "ActorClass", "ActorHandle",
     "RemoteFunction", "cluster_resources", "available_resources",

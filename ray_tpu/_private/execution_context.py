@@ -1,0 +1,11 @@
+"""Thread-local execution context shared across module identities.
+
+worker_main runs as BOTH `__main__` (the spawned process) and
+`ray_tpu.runtime.worker_main` (imports from other code): a
+module-level threading.local defined there would exist twice. This
+tiny neutral module holds the one true context object; worker_main
+writes it, ray_tpu.runtime_context reads it.
+"""
+import threading
+
+task_ctx = threading.local()

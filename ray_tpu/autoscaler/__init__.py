@@ -7,6 +7,7 @@ tests. TPU node types are whole ICI slices, so scaling is slice-granular.
 """
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
 from ray_tpu.autoscaler.load_metrics import LoadMetrics
+from ray_tpu.autoscaler.sdk import request_resources
 from ray_tpu.autoscaler.monitor import AutoscalingCluster, Monitor
 from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
                                               MockProvider, NodeProvider,
@@ -17,6 +18,7 @@ from ray_tpu.autoscaler.resource_demand_scheduler import (
 
 __all__ = [
     "StandardAutoscaler", "LoadMetrics", "Monitor", "AutoscalingCluster",
+    "request_resources",
     "NodeProvider", "MockProvider", "FakeMultiNodeProvider",
     "NodeTypeConfig", "get_nodes_to_launch", "get_infeasible_demands",
     "TAG_NODE_TYPE", "TAG_NODE_STATUS",

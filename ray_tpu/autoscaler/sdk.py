@@ -1,0 +1,28 @@
+"""Autoscaler SDK (reference: ray.autoscaler.sdk.request_resources).
+
+request_resources pins a standing demand floor: the autoscaler keeps
+enough nodes to satisfy these bundles even with an empty task queue
+(pre-scaling for anticipated load); calling again replaces the floor,
+and request_resources([]) clears it.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def request_resources(num_cpus: Optional[int] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None
+                      ) -> None:
+    from ray_tpu._private.worker import global_worker
+    out: List[Dict[str, float]] = []
+    if num_cpus:
+        out.extend({"CPU": 1.0} for _ in range(int(num_cpus)))
+    if bundles:
+        out.extend(dict(b) for b in bundles)
+    rt = global_worker().runtime
+    head = getattr(rt, "head", None)
+    if head is None:
+        raise RuntimeError(
+            "request_resources needs the multiprocess runtime "
+            "(an autoscaler-managed cluster)")
+    head.call("request_resources", out)

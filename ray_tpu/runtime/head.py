@@ -1944,12 +1944,37 @@ class HeadService:
 
     # ---- autoscaler feed ---------------------------------------------------
 
+    def request_resources(self, bundles: List[Dict[str, float]]
+                          ) -> None:
+        """Autoscaler SDK (reference: ray.autoscaler.sdk.
+        request_resources): pin a STANDING demand floor the scaler
+        satisfies regardless of queue state. Idempotent — the latest
+        call replaces the previous floor; an empty list clears it.
+        Bundles are validated here: a standing malformed entry would
+        otherwise poison EVERY autoscaler tick."""
+        clean = []
+        for b in bundles:
+            if not isinstance(b, dict) or not all(
+                    isinstance(k, str) and
+                    isinstance(v, (int, float)) and
+                    not isinstance(v, bool) and v >= 0
+                    for k, v in b.items()):
+                raise ValueError(
+                    f"request_resources bundle must be a "
+                    f"Dict[str, number >= 0], got {b!r}")
+            clean.append({k: float(v) for k, v in b.items()})
+        with self._lock:
+            self._requested_resources = clean
+
     def load_metrics_snapshot(self) -> Dict[str, Any]:
         """Demand + usage view consumed by the autoscaler monitor
         (reference: LoadMetrics fed by raylet resource reports,
         python/ray/autoscaler/_private/load_metrics.py:62)."""
         with self._lock:
             pending: List[Dict[str, float]] = []
+            pending.extend(
+                dict(b) for b in
+                getattr(self, "_requested_resources", ()))
             for queue in self._pending.values():
                 for task_id in queue:
                     meta = self._task_meta.get(task_id)

@@ -96,6 +96,9 @@ SCHEMAS: Dict[str, Tuple[Param, ...]] = {
     "mark_worker_dead": (P("worker_id", str),),
     "env_setup_failed": (P("env_key", str), P("message", str)),
     # KV
+    # autoscaler
+    "request_resources": (P("bundles", list),),
+    # KV
     "kv_put": (P("key", str), P("value", _BYTES)),
     "kv_get": (P("key", str),),
     "kv_del": (P("key", str),),

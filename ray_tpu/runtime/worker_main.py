@@ -30,7 +30,7 @@ from ray_tpu._private.serialization import dumps, loads
 from ray_tpu.exceptions import ActorDiedError, TaskError
 from ray_tpu.runtime.rpc import RpcClient, RpcServer
 
-_task_ctx = threading.local()
+from ray_tpu._private.execution_context import task_ctx as _task_ctx
 
 
 class _ActorSlot:
@@ -335,6 +335,8 @@ class Executor:
     def _run_task(self, spec) -> str:
         _task_ctx.resources = spec.get("resources", {})
         _task_ctx.blocked = False
+        _task_ctx.task_id = spec.get("task_id")
+        _task_ctx.actor_id = None
         # Register this thread as the task's executor so a
         # force-cancel can interrupt exactly this task (and nothing
         # co-resident on the worker).
@@ -412,6 +414,7 @@ class Executor:
             # commit below cannot be interrupted by a fresh cancel.
             with self._threads_lock:
                 self._task_threads.pop(tid_key, None)
+            _task_ctx.task_id = None
             _task_ctx.resources = None
             set_log_tag(None)
             try:
@@ -531,6 +534,13 @@ class Executor:
             if slot.error is not None:
                 raise ActorDiedError(
                     actor_id, f"__init__ failed: {slot.error!r}")
+            # Identity for get_runtime_context(). Thread-local on the
+            # actor's loop thread: interleaved awaits of DIFFERENT
+            # methods can observe the most recent setter — a known
+            # limit of the async path (ids are per-thread, not
+            # per-coroutine).
+            _task_ctx.task_id = spec.get("task_id")
+            _task_ctx.actor_id = actor_id
             method = getattr(slot.instance, spec["method"])
             args = [self._resolve(a) for a in spec["args"]]
             kwargs = {k: self._resolve(v)
@@ -569,6 +579,8 @@ class Executor:
                 if slot.error is not None:
                     raise ActorDiedError(
                         actor_id, f"__init__ failed: {slot.error!r}")
+                _task_ctx.task_id = spec.get("task_id")
+                _task_ctx.actor_id = actor_id
                 method = getattr(slot.instance, spec["method"])
                 args = [self._resolve(a) for a in spec["args"]]
                 kwargs = {k: self._resolve(v)

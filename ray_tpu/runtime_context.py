@@ -1,0 +1,87 @@
+"""Runtime context (reference: ray.get_runtime_context /
+runtime_context.RuntimeContext): identity of the current execution
+site — job, worker, node, current task/actor — queryable from drivers,
+tasks and actor methods alike.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class RuntimeContext:
+    """Snapshot accessor; construct via get_runtime_context()."""
+
+    def get_job_id(self) -> Optional[str]:
+        """The SUBMITTING job's id. Inside a task this derives from
+        the current task id (TaskIDs embed their job's 4-byte prefix,
+        _private/ids.py), so workers report the driver's job, not
+        their own process-local one."""
+        tid = self.get_task_id()
+        if tid:
+            return tid[:8]
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker().runtime
+        jid = getattr(rt, "job_id", None)
+        return jid.hex() if jid is not None else None
+
+    def get_worker_id(self) -> Optional[str]:
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker().runtime
+        return getattr(rt, "worker_id", None) or "driver"
+
+    def get_node_id(self) -> Optional[str]:
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker().runtime
+        plane = getattr(rt, "plane", None) or getattr(
+            getattr(rt, "_ex", None), "plane", None)
+        return getattr(plane, "node_id", None) or "local"
+
+    def get_task_id(self) -> Optional[str]:
+        """Hex id of the task currently executing on THIS thread
+        (None on the driver / outside task execution)."""
+        # multiprocess executor threads (worker_main runs as
+        # __main__, so the context lives in a neutral module)
+        try:
+            from ray_tpu._private.execution_context import task_ctx
+            tid = getattr(task_ctx, "task_id", None)
+            if tid:
+                return tid
+        except Exception:
+            pass
+        # local runtime
+        try:
+            from ray_tpu._private.local_runtime import \
+                current_task_context
+            ctx = current_task_context()
+            if ctx is not None:
+                return ctx.spec.task_id.hex()
+        except Exception:
+            pass
+        return None
+
+    def get_actor_id(self) -> Optional[str]:
+        try:
+            from ray_tpu._private.execution_context import task_ctx
+            aid = getattr(task_ctx, "actor_id", None)
+            if aid:
+                return aid
+        except Exception:
+            pass
+        # local runtime: the executing spec carries the actor id
+        try:
+            from ray_tpu._private.local_runtime import \
+                current_task_context
+            ctx = current_task_context()
+            aid = getattr(ctx.spec, "actor_id", None) \
+                if ctx is not None else None
+            return aid.hex() if aid is not None else None
+        except Exception:
+            return None
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False      # restart counters live on the head
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()

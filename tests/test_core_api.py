@@ -232,3 +232,78 @@ def test_timeline_records_tasks(rt):
     rt.get(traced.remote())
     events = rt.timeline()
     assert any("traced" in e["name"] for e in events)
+
+
+def test_runtime_context_surface(rt):
+    """ray_tpu.get_runtime_context() (reference parity): identity is
+    queryable from the driver AND inside tasks/actors."""
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.get_job_id()
+    assert ctx.get_task_id() is None          # driver: no task
+
+    @ray_tpu.remote
+    def who():
+        c = ray_tpu.get_runtime_context()
+        return {"task": c.get_task_id(), "job": c.get_job_id(),
+                "node": c.get_node_id()}
+
+    info = ray_tpu.get(who.remote())
+    assert info["task"]
+    assert info["job"]
+
+
+def test_runtime_context_in_multiprocess_worker():
+    import ray_tpu
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=1, resources_per_worker={"CPU": 2}):
+        @ray_tpu.remote
+        def who():
+            c = ray_tpu.get_runtime_context()
+            return c.get_task_id(), c.get_worker_id(), c.get_node_id()
+
+        tid, wid, nid = ray_tpu.get(who.remote())
+        assert tid and len(tid) == 40        # 20-byte task id hex
+        assert nid
+
+
+def test_request_resources_demand_floor():
+    """autoscaler.sdk.request_resources pins a standing demand the
+    load snapshot carries even with an empty queue."""
+    import ray_tpu
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.autoscaler import request_resources
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=1, resources_per_worker={"CPU": 2}):
+        from ray_tpu._private.worker import global_worker
+        head = global_worker().runtime.head
+        request_resources(bundles=[{"CPU": 4.0}, {"TPU": 8.0}])
+        snap = head.call("load_metrics_snapshot")
+        assert {"CPU": 4.0} in snap["pending_demands"]
+        assert {"TPU": 8.0} in snap["pending_demands"]
+        request_resources(bundles=[])         # clears the floor
+        snap = head.call("load_metrics_snapshot")
+        assert {"TPU": 8.0} not in snap["pending_demands"]
+
+
+def test_runtime_context_in_actor():
+    import ray_tpu
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu.runtime import Cluster
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    with Cluster(num_workers=1, resources_per_worker={"CPU": 2}):
+        @ray_tpu.remote
+        class A:
+            def ident(self):
+                c = ray_tpu.get_runtime_context()
+                return c.get_actor_id(), c.get_task_id()
+
+        a = A.remote()
+        aid, tid = ray_tpu.get(a.ident.remote())
+        assert aid == a.actor_id.hex()
+        assert tid
