@@ -1,5 +1,6 @@
 from ray_tpu.serve.api import (delete, deployment, run, shutdown,
                                get_deployment, get_handle,
+                               get_deployment_handle,
                                list_deployments, status)
 from ray_tpu.serve.multiplex import (get_multiplexed_model_id,
                                      multiplexed)
@@ -13,4 +14,5 @@ __all__ = ["deployment", "run", "shutdown", "get_deployment", "get_handle",
            "list_deployments", "status", "delete", "DAGDriver",
            "json_request", "json_to_ndarray", "batch",
            "multiplexed", "get_multiplexed_model_id",
+           "get_deployment_handle",
            "AutoscalingConfig", "DeploymentConfig", "StreamingResponse"]

@@ -146,6 +146,13 @@ def get_handle(name: str) -> DeploymentHandle:
     return get_or_create_handle(name)
 
 
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "") -> DeploymentHandle:
+    """Exact-shape parity with the reference's accessor; this runtime
+    has a single default app, so app_name is accepted and ignored."""
+    return get_handle(deployment_name)
+
+
 def get_deployment(name: str) -> Dict[str, Any]:
     info = ray_tpu.get(
         get_or_create_controller().list_deployments.remote())

@@ -17,3 +17,26 @@ __all__ = [
     "FIFOScheduler", "AsyncHyperBandScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
 ]
+
+
+def run(trainable, *, config=None, num_samples: int = 1,
+        metric: str = "loss", mode: str = "min", search_alg=None,
+        scheduler=None, max_concurrent_trials: int = 4,
+        resources_per_trial=None, storage_path=None, name=None,
+        time_budget_s=None):
+    """Functional entry point (reference: tune.run) — a thin wrapper
+    over Tuner(...).fit() returning the ResultGrid. The Tuner API is
+    the primary surface; this exists for the classic call shape."""
+    from ray_tpu.air import RunConfig
+    tc = TuneConfig(metric=metric, mode=mode, search_alg=search_alg,
+                    scheduler=scheduler,
+                    max_concurrent_trials=max_concurrent_trials,
+                    num_samples=num_samples,
+                    resources_per_trial=resources_per_trial,
+                    time_budget_s=time_budget_s)
+    return Tuner(trainable, param_space=config, tune_config=tc,
+                 run_config=RunConfig(storage_path=storage_path,
+                                      name=name)).fit()
+
+
+__all__.append("run")

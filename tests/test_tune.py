@@ -230,3 +230,22 @@ def test_experiment_state_resume(rt, tmp_path):
     assert len(done) == 3   # all complete after resume
     vals = sorted(t.last_result["loss"] for t in grid2.trials)
     assert vals == [1.0, 2.0, 99.0]
+
+
+def test_functional_tune_run(rt):
+    """tune.run functional alias (reference call shape)."""
+    from ray_tpu import tune
+    from ray_tpu.air import session
+
+    def trainable(config):
+        session.report({"loss": (config["x"] - 1) ** 2})
+
+    grid = tune.run(trainable,
+                    config={"x": tune.uniform(-2, 2)},
+                    num_samples=8, metric="loss", mode="min",
+                    search_alg=tune.BasicVariantGenerator(
+                        {"x": tune.uniform(-2, 2)}, num_samples=8,
+                        seed=7),
+                    max_concurrent_trials=2)
+    assert len(grid) == 8
+    assert grid.get_best_result().metrics["loss"] < 2.0
