@@ -268,6 +268,13 @@ class Replica:
                 "total": self._total}
 
     def health_check(self):
+        """Controller liveness probe. A deployment class may define
+        its own ``check_health()`` (reference: user-defined health
+        checks, serve deployment_state) — an exception there marks
+        the replica unhealthy and the controller replaces it."""
+        fn = getattr(self.instance, "check_health", None)
+        if callable(fn):
+            fn()           # raising = unhealthy
         return True
 
 
@@ -457,10 +464,91 @@ class Controller:
                     self._publish_replicas(name, d)
                     await self._drain(d)
                     await self._autoscale(name, d)
+                    self._health_check(name, d)
             except Exception:  # noqa: BLE001 — keep reconciling
                 import traceback
                 traceback.print_exc()
             await asyncio.sleep(0.05)
+
+    # Probe-failure policy: definitive death replaces immediately;
+    # other errors and timeouts need this many CONSECUTIVE strikes
+    # (transient transport blips must not execute an expensive
+    # replica, e.g. a mesh gang with minutes of compile behind it).
+    _HEALTH_STRIKES = 3
+
+    def _health_check(self, name: str, d: Dict[str, Any]) -> None:
+        """Periodic replica health probing (reference: serve's
+        deployment-state health checks): every health_check_period_s
+        each replica's health_check() is pinged without blocking the
+        reconcile loop. A dead actor replaces the replica at once; a
+        user check_health() exception, other probe errors, or probe
+        timeouts replace it after _HEALTH_STRIKES consecutive
+        failures (killed, not drained — it is presumed broken)."""
+        cfg: DeploymentConfig = d["config"]
+        period = getattr(cfg, "health_check_period_s", 5.0)
+        if period <= 0:
+            return
+        now = time.time()
+        pending = d.setdefault("_health_pending", {})
+        strikes = d.setdefault("_health_strikes", {})
+
+        def strike(rid, h, definitive=False):
+            n = strikes.get(rid, 0) + 1
+            if definitive or n >= self._HEALTH_STRIKES:
+                strikes.pop(rid, None)
+                d["replicas"].pop(rid, None)
+                self._kill(h)
+                self._publish_replicas(name, d)
+                # the scale-to-target pass spawns the replacement
+            else:
+                strikes[rid] = n
+
+        # Resolve previously fired probes (non-blocking).
+        for rid, (ref, fut, deadline) in list(pending.items()):
+            h = d["replicas"].get(rid)
+            if h is None:
+                pending.pop(rid, None)
+                strikes.pop(rid, None)
+                continue
+            if fut.done():
+                pending.pop(rid, None)
+                try:
+                    fut.result()
+                    strikes.pop(rid, None)      # healthy: reset
+                except Exception as e:
+                    from ray_tpu.exceptions import ActorDiedError
+                    strike(rid, h,
+                           definitive=isinstance(e, ActorDiedError))
+            elif now > deadline:
+                # A replica saturated with long requests must not be
+                # executed for being busy — timeouts accumulate
+                # strikes and only a consecutive run replaces it.
+                pending.pop(rid, None)
+                strike(rid, h)
+        if now - d.get("_health_last", 0.0) < period:
+            return
+        d["_health_last"] = now
+        for rid, h in list(d["replicas"].items()):
+            if rid in pending:
+                continue
+            try:
+                ref = h.health_check.remote()
+                # The REF must stay alive alongside its future: eager
+                # GC frees the reply object the moment the last ref
+                # drops, which would fail every probe with
+                # ObjectLostError.
+                pending[rid] = (ref, ref.future(),
+                                now + max(3 * period, 30.0))
+            except Exception as e:
+                # Submit-time death is definitive in the distributed
+                # runtime (the route resolver raises ActorDiedError
+                # for known-dead actors): a swallowed one here would
+                # retry forever while the dead replica keeps counting
+                # toward target.
+                from ray_tpu.exceptions import ActorDiedError
+                if isinstance(e, ActorDiedError):
+                    strike(rid, h, definitive=True)
+                # other submission failures: next round retries
 
     async def _drain(self, d: Dict[str, Any]):
         """Kill draining replicas once idle (or past their deadline).

@@ -120,6 +120,7 @@ class DeploymentHandle:
 
     def _init_runtime_state(self):
         self._replicas: List = []
+        self._replica_ids: tuple = ()
         self._max_ongoing = 8
         self._version = -1
         self._fetched_at = 0.0
@@ -163,10 +164,21 @@ class DeploymentHandle:
             self._fetched_at = time.time()
 
     def _apply_locked(self, info):
+        rids = tuple(rid for rid, _ in info["replicas"])
         if info["version"] != self._version or \
-                len(info["replicas"]) != len(self._replicas):
+                rids != self._replica_ids:
+            # Compare replica IDENTITIES, not counts: a health-check
+            # replacement swaps a replica without bumping the version
+            # or changing the count, and a handle that kept routing to
+            # the dead actor would error until... forever. Surviving
+            # replicas KEEP their in-flight counts across the swap
+            # (zeroing them would over-admit onto saturated replicas).
+            old_counts = {rid: self._inflight.get(i, 0)
+                          for i, rid in enumerate(self._replica_ids)}
             self._replicas = [h for _, h in info["replicas"]]
-            self._inflight = {i: 0 for i in range(len(self._replicas))}
+            self._replica_ids = rids
+            self._inflight = {i: old_counts.get(rid, 0)
+                              for i, rid in enumerate(rids)}
             self._version = info["version"]
             # Replica indices shifted: stale model-affinity entries
             # would pin models to the wrong replica.

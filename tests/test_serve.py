@@ -663,3 +663,46 @@ def test_user_config_reconfigure_without_restart(serve_rt):
     assert out["threshold"] == 10 and out["hit"] is False
     # the SAME instance served both configs: no replica restart
     assert out["mark"] == first["mark"]
+
+
+def test_unhealthy_replica_is_replaced(serve_rt):
+    """Controller health checks (reference: deployment-state health
+    checking): a replica whose user check_health() starts raising is
+    killed and replaced; traffic recovers on the fresh replica."""
+    import time
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Flaky:
+        def __init__(self):
+            self.born = time.time()
+            self.sick = False
+
+        def make_sick(self):
+            self.sick = True
+            return True
+
+        def check_health(self):
+            if self.sick:
+                raise RuntimeError("unhealthy")
+
+        def __call__(self, _):
+            return self.born
+
+    # fast health cadence for the test
+    dep = Flaky.options(name="Flaky")
+    dep.config.health_check_period_s = 0.3
+    h = serve.run(dep.bind(), timeout_s=120)
+    born1 = ray_tpu.get(h.remote(0))
+    assert ray_tpu.get(h.make_sick.remote())
+    deadline = time.time() + 30
+    born2 = born1
+    while time.time() < deadline:
+        try:
+            born2 = ray_tpu.get(h.remote(0), timeout=5)
+            if born2 != born1:
+                break
+        except Exception:
+            pass
+        time.sleep(0.3)
+    assert born2 != born1, "sick replica was never replaced"
