@@ -23,6 +23,20 @@ from jax.sharding import PartitionSpec as P
 
 from ray_tpu.mesh.sharding import ShardingRules
 from ray_tpu.models.kv_cache import PagedKVLayer
+from ray_tpu.ops.paged_attention import paged_decode_attention
+
+
+def _use_paged_kernel() -> bool:
+    """Pallas paged-attention on TPU; dense gather elsewhere (the
+    kernel's interpreter mode is correct but slow on CPU). Tests
+    force the kernel with RAY_TPU_PAGED_KERNEL=1."""
+    import os
+    v = os.environ.get("RAY_TPU_PAGED_KERNEL", "")
+    if v == "1":
+        return True
+    if v == "0":
+        return False
+    return jax.default_backend() == "tpu"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,28 +153,39 @@ class LlamaAttention(nn.Module):
             pv = pc.pages_v.at[page_idx, off].set(
                 v[:, 0].astype(pc.pages_v.dtype))
             new_cache = pc._replace(pages_k=pk, pages_v=pv)
-            # [B, max_pages, Pg, KH, D] -> [B, L, KH, D]; gathered
-            # index == logical sequence position by construction.
-            L = pc.page_table.shape[1] * Pg
-            kg = pk[pc.page_table].reshape(B, L, cfg.n_kv_heads, hd)
-            vg = pv[pc.page_table].reshape(B, L, cfg.n_kv_heads, hd)
-            # Grouped-query attention WITHOUT materializing repeated
-            # K/V: q reshapes to [B, T, KH, rep, D] and contracts
-            # against the grouped cache directly — at rep=8 (1.1B) a
-            # repeat would move 8x the KV bytes per step, the decode
-            # hot loop's dominant traffic.
-            rep = cfg.n_heads // cfg.n_kv_heads
-            qg = q.reshape(B, -1, cfg.n_kv_heads, rep, hd)
-            scores = jnp.einsum(
-                "btkrd,bskd->bkrts", qg.astype(jnp.float32),
-                kg.astype(jnp.float32)) / np.sqrt(hd)
-            valid = jnp.arange(L)[None] <= pos[:, None]    # [B, L]
-            scores = jnp.where(valid[:, None, None, None, :],
-                               scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1)
-            y = jnp.einsum("bkrts,bskd->btkrd",
-                           probs.astype(vg.dtype), vg)
-            y = y.reshape(B, -1, cfg.n_heads, hd)
+            if _use_paged_kernel():
+                # TPU: pallas paged-attention kernel — page table
+                # rides scalar prefetch; the page window is never
+                # materialized (ops/paged_attention.py).
+                y = paged_decode_attention(
+                    q[:, 0], pk, pv, pc.page_table, pos)
+                y = y.reshape(B, 1, cfg.n_heads, hd)
+            else:
+                # CPU/XLA fallback: gather the page window dense.
+                # [B, max_pages, Pg, KH, D] -> [B, L, KH, D]; gathered
+                # index == logical sequence position by construction.
+                L = pc.page_table.shape[1] * Pg
+                kg = pk[pc.page_table].reshape(
+                    B, L, cfg.n_kv_heads, hd)
+                vg = pv[pc.page_table].reshape(
+                    B, L, cfg.n_kv_heads, hd)
+                # Grouped-query attention WITHOUT materializing
+                # repeated K/V: q reshapes to [B, T, KH, rep, D] and
+                # contracts against the grouped cache directly — at
+                # rep=8 (1.1B) a repeat would move 8x the KV bytes
+                # per step, the decode hot loop's dominant traffic.
+                rep = cfg.n_heads // cfg.n_kv_heads
+                qg = q.reshape(B, -1, cfg.n_kv_heads, rep, hd)
+                scores = jnp.einsum(
+                    "btkrd,bskd->bkrts", qg.astype(jnp.float32),
+                    kg.astype(jnp.float32)) / np.sqrt(hd)
+                valid = jnp.arange(L)[None] <= pos[:, None]  # [B, L]
+                scores = jnp.where(valid[:, None, None, None, :],
+                                   scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1)
+                y = jnp.einsum("bkrts,bskd->btkrd",
+                               probs.astype(vg.dtype), vg)
+                y = y.reshape(B, -1, cfg.n_heads, hd)
         elif kv_cache is not None:
             # Decode path: append this step's K/V into the static cache.
             ck, cv = kv_cache

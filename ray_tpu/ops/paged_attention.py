@@ -1,0 +1,136 @@
+"""Pallas paged-attention decode kernel (the vLLM kernel, TPU-style).
+
+The continuous-batching engine's decode step attends each slot's
+single query against its KV pages. The XLA fallback (models/llama.py
+paged branch) GATHERS the whole page window into a dense
+[B, L, KH, D] tensor every step — at L=2048 that is the dominant HBM
+traffic of the decode loop. This kernel never materializes the
+window: the page table rides scalar prefetch
+(pltpu.PrefetchScalarGridSpec) and each grid step DMAs exactly one
+physical page per (slot, kv-head), accumulating flash-style online
+softmax in VMEM. Per-step traffic drops from O(B * L) gathered copies
+to O(B * L) page READS only — no gathered intermediate, no scatter of
+it back.
+
+Layout contract (matches models/kv_cache.py):
+  pages_k/pages_v: [n_pages, page_size, n_kv_heads, head_dim]
+  page_table:      [n_slots, max_pages] int32 (0 = null page)
+  positions:       [n_slots]            int32 — current decode
+                   position; the step attends keys 0..pos inclusive
+  q:               [n_slots, n_heads, head_dim] (grouped-query: head
+                   h uses kv head h // (n_heads // n_kv_heads))
+
+Grid (B, KH, n_pages_per_slot): the page dimension is innermost, so
+TPU executes it sequentially per (slot, head) and the online-softmax
+scratch carries across pages. Inactive slots point at the null page
+and mask everything — their outputs are ignored host-side.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+            m_sc, l_sc, acc_sc, *, page_size: int, scale: float):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_p = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    q = q_ref[0, 0].astype(jnp.float32)          # [rep, D]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)    # [Pg, D]
+    v = v_ref[0, :, 0, :].astype(jnp.float32)    # [Pg, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [rep, Pg]
+    pos = pos_ref[b]
+    kpos = p * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    s = jnp.where(kpos <= pos, s, _NEG_INF)
+
+    m_prev = m_sc[...]                            # [rep, 1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # Fully-masked pages keep exp() finite.
+    m_safe = jnp.maximum(m_new, -1e29)
+    alpha = jnp.exp(m_prev - m_safe)
+    pexp = jnp.exp(s - m_safe)                    # [rep, Pg]
+    l_sc[...] = l_sc[...] * alpha + \
+        jnp.sum(pexp, axis=1, keepdims=True)
+    acc_sc[...] = acc_sc[...] * alpha + jax.lax.dot_general(
+        pexp, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)       # [rep, D]
+    m_sc[...] = m_new
+
+    @pl.when(p == n_p - 1)
+    def _fin():
+        l = jnp.maximum(l_sc[...], 1e-30)
+        o_ref[0, 0] = (acc_sc[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention(q, pages_k, pages_v, page_table, positions,
+                           interpret: bool | None = None):
+    """One decode step of paged attention.
+
+    q: [B, H, D]; returns [B, H, D] in q.dtype. See module docstring
+    for the pool layout. Falls back transparently to interpreter mode
+    off-TPU (tests).
+    """
+    B, H, D = q.shape
+    n_pages, Pg, KH, Dk = pages_k.shape
+    assert D == Dk, (D, Dk)
+    rep = H // KH
+    max_pages = page_table.shape[1]
+    qg = q.reshape(B, KH, rep, D)
+    scale = 1.0 / (D ** 0.5)
+
+    grid = (B, KH, max_pages)
+    kernel = functools.partial(_kernel, page_size=Pg, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                # q block for this (slot, kv head): [1, 1, rep, D]
+                pl.BlockSpec((1, 1, rep, D),
+                             lambda b, h, p, pt, pos: (b, h, 0, 0)),
+                # ONE physical page of K for this kv head, chosen by
+                # the scalar-prefetched page table: [1, Pg, 1, D]
+                pl.BlockSpec((1, Pg, 1, D),
+                             lambda b, h, p, pt, pos:
+                             (pt[b, p], 0, h, 0)),
+                pl.BlockSpec((1, Pg, 1, D),
+                             lambda b, h, p, pt, pos:
+                             (pt[b, p], 0, h, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, rep, D),
+                lambda b, h, p, pt, pos: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rep, 1), jnp.float32),    # m
+                pltpu.VMEM((rep, 1), jnp.float32),    # l
+                pltpu.VMEM((rep, D), jnp.float32),    # acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, KH, rep, D), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(page_table, positions, qg, pages_k, pages_v)
+    return out.reshape(B, H, D)

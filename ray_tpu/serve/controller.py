@@ -491,6 +491,13 @@ class Controller:
         now = time.time()
         pending = d.setdefault("_health_pending", {})
         strikes = d.setdefault("_health_strikes", {})
+        # Replicas can leave d["replicas"] outside this function
+        # (scale-down, redeploy) with no probe pending; sweep their
+        # strike entries or the dict grows forever (rids are never
+        # reused).
+        for rid in list(strikes):
+            if rid not in d["replicas"]:
+                strikes.pop(rid, None)
 
         def strike(rid, h, definitive=False):
             n = strikes.get(rid, 0) + 1

@@ -62,11 +62,11 @@ class StreamingResponse:
     batches from the replica with long-polls; releases the handle's
     in-flight slot when the stream ends."""
 
-    def __init__(self, handle: "DeploymentHandle", replica, idx: int,
+    def __init__(self, handle: "DeploymentHandle", replica, rid,
                  req_id: str):
         self._handle = handle
         self._replica = replica
-        self._idx = idx
+        self._rid = rid
         self._req_id = req_id
         self._buf: List[Any] = []
         self._pos = 0          # chunks consumed from the replica
@@ -105,7 +105,7 @@ class StreamingResponse:
     def _release(self):
         if not self._released:
             self._released = True
-            self._handle._done(self._idx)
+            self._handle._done(self._rid)
 
     def __del__(self):
         self._release()
@@ -124,7 +124,7 @@ class DeploymentHandle:
         self._max_ongoing = 8
         self._version = -1
         self._fetched_at = 0.0
-        self._inflight: Dict[int, int] = {}   # idx -> count
+        self._inflight: Dict[Any, int] = {}   # replica id -> count
         self._poll_count = 0        # controller RPCs (regression tests)
         self._push_active = False
         self._subscriber = None
@@ -170,20 +170,25 @@ class DeploymentHandle:
             # Compare replica IDENTITIES, not counts: a health-check
             # replacement swaps a replica without bumping the version
             # or changing the count, and a handle that kept routing to
-            # the dead actor would error until... forever. Surviving
-            # replicas KEEP their in-flight counts across the swap
-            # (zeroing them would over-admit onto saturated replicas).
-            old_counts = {rid: self._inflight.get(i, 0)
-                          for i, rid in enumerate(self._replica_ids)}
+            # the dead actor would error until... forever. In-flight
+            # counts are KEYED by replica id, so survivors keep their
+            # counts across the swap (zeroing would over-admit onto
+            # saturated replicas) and completions of requests
+            # dispatched before the swap still decrement the right
+            # replica; only departed replicas' counts are dropped.
             self._replicas = [h for _, h in info["replicas"]]
             self._replica_ids = rids
-            self._inflight = {i: old_counts.get(rid, 0)
-                              for i, rid in enumerate(rids)}
+            live = set(rids)
+            self._inflight = {rid: c for rid, c in
+                              self._inflight.items() if rid in live}
             self._version = info["version"]
-            # Replica indices shifted: stale model-affinity entries
-            # would pin models to the wrong replica.
-            if getattr(self, "_mux_affinity", None):
-                self._mux_affinity.clear()
+            # Affinity is rid-keyed too: only models homed on a
+            # departed replica lose their pin (survivors keep their
+            # warm caches through the swap).
+            mux = getattr(self, "_mux_affinity", None)
+            if mux:
+                for mid in [m for m, r in mux.items() if r not in live]:
+                    del mux[mid]
         self._max_ongoing = info["max_ongoing"]
 
     def _refresh(self, force: bool = False):
@@ -199,19 +204,24 @@ class DeploymentHandle:
             self._apply_locked(info)
             self._fetched_at = time.time()
 
-    def _pick(self, model_id: Optional[str] = None) -> Optional[int]:
+    def _pick(self, model_id: Optional[str] = None):
         """Power-of-two-choices among replicas under the in-flight
         cap. Multiplexed requests prefer the replica that last served
         their model id (cache affinity — reference: the multiplexed
         routing policy in serve's router): affinity wins while that
         replica has capacity; otherwise the request spills to the
-        balanced choice and the affinity map learns the new home."""
+        balanced choice and the affinity map learns the new home.
+
+        Returns (replica_handle, replica_id) — the id is what the
+        caller must pass to _done(); indices shift when the replica
+        set changes, ids never do."""
         with self._lock:
             n = len(self._replicas)
             if n == 0:
                 return None
+            cnt = lambda i: self._inflight.get(self._replica_ids[i], 0)
             candidates = [i for i in range(n)
-                          if self._inflight.get(i, 0) < self._max_ongoing]
+                          if cnt(i) < self._max_ongoing]
             if not candidates:
                 return None
             idx = None
@@ -219,40 +229,45 @@ class DeploymentHandle:
                 mux = getattr(self, "_mux_affinity", None)
                 if mux is None:
                     mux = self._mux_affinity = {}
-                home = mux.get(model_id)
-                if home is not None and home in candidates:
-                    idx = home
+                home_rid = mux.get(model_id)     # affinity by rid
+                if home_rid in self._replica_ids:
+                    home = self._replica_ids.index(home_rid)
+                    if home in candidates:
+                        idx = home
             if idx is None:
                 if len(candidates) == 1:
                     idx = candidates[0]
                 else:
                     a, b = random.sample(candidates, 2)
-                    idx = a if self._inflight.get(a, 0) <= \
-                        self._inflight.get(b, 0) else b
+                    idx = a if cnt(a) <= cnt(b) else b
                 if model_id:
-                    self._mux_affinity[model_id] = idx
+                    self._mux_affinity[model_id] = \
+                        self._replica_ids[idx]
                     # Bound the affinity map (ids churn in LoRA-style
                     # fleets).
                     if len(self._mux_affinity) > 4096:
                         self._mux_affinity.pop(
                             next(iter(self._mux_affinity)))
-            self._inflight[idx] = self._inflight.get(idx, 0) + 1
-            return idx
+            rid = self._replica_ids[idx]
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            return self._replicas[idx], rid
 
-    def _done(self, idx: int):
+    def _done(self, rid):
         with self._lock:
-            if idx in self._inflight and self._inflight[idx] > 0:
-                self._inflight[idx] -= 1
+            if self._inflight.get(rid, 0) > 0:
+                self._inflight[rid] -= 1
 
     # --- calls -------------------------------------------------------------
 
     def _acquire_replica(self, model_id: Optional[str] = None):
+        """Returns (replica_handle, replica_id) with an in-flight
+        slot held; the caller owes a _done(replica_id)."""
         deadline = time.time() + 30
         while True:
             self._refresh()
-            idx = self._pick(model_id)
-            if idx is not None:
-                return idx
+            picked = self._pick(model_id)
+            if picked is not None:
+                return picked
             if time.time() > deadline:
                 raise TimeoutError(
                     f"No replica of {self._name!r} accepted the request "
@@ -262,35 +277,33 @@ class DeploymentHandle:
 
     def _route(self, method: str, args, kwargs,
                model_id: Optional[str] = None):
-        idx = self._acquire_replica(model_id)
-        replica = self._replicas[idx]
+        replica, rid = self._acquire_replica(model_id)
         ref = replica.handle_request.remote(method, args, kwargs)
-        self._watch_completion(ref, idx)
+        self._watch_completion(ref, rid)
         return ref
 
     def _route_stream(self, method: str, args, kwargs,
                       model_id: Optional[str] = None
                       ) -> "StreamingResponse":
         import uuid
-        idx = self._acquire_replica(model_id)
-        replica = self._replicas[idx]
+        replica, rid = self._acquire_replica(model_id)
         req_id = uuid.uuid4().hex
         try:
             ray_tpu.get(replica.handle_request_streaming.remote(
                 req_id, method, args, kwargs))
         except BaseException:
-            self._done(idx)      # failed start must release the slot
+            self._done(rid)      # failed start must release the slot
             raise
-        return StreamingResponse(self, replica, idx, req_id)
+        return StreamingResponse(self, replica, rid, req_id)
 
-    def _watch_completion(self, ref, idx: int):
+    def _watch_completion(self, ref, rid):
         def _wait():
             try:
                 ref.future().result()
             except Exception:
                 pass
             finally:
-                self._done(idx)
+                self._done(rid)
         threading.Thread(target=_wait, daemon=True).start()
 
     def remote(self, *args, **kwargs):
