@@ -206,6 +206,17 @@ class _ActorState:
         loop = asyncio.new_event_loop()
         self.loop = loop
         asyncio.set_event_loop(loop)
+        # The loop's DEFAULT executor sizes to min(32, cpus + 4) —
+        # on a small host that silently caps run_in_executor offloads
+        # (serve replicas run sync user methods there) far below the
+        # actor's declared max_concurrency. Size it to the actor's
+        # own concurrency; threads spawn lazily.
+        # + one thread per group: each group's pump parks a blocking
+        # box.get in this same pool while idle
+        from concurrent.futures import ThreadPoolExecutor
+        loop.set_default_executor(ThreadPoolExecutor(
+            max_workers=self.gm.max_concurrency + len(self.gm.boxes),
+            thread_name_prefix="actor-exec"))
         self._instantiate()
         # per-group semaphores bound concurrency independently
         sems = {g: asyncio.Semaphore(self.gm.size(g))

@@ -6,10 +6,15 @@ coalesces calls but decodes each batch to completion; this pool is the
 structure that lets requests join/leave the decode batch per token):
 
 - The KV pool is ONE static-shape array per layer,
-  ``[n_pages, page_size, n_kv_heads, head_dim]`` — XLA never sees a
+  ``[n_kv_heads, n_pages, page_size, head_dim]`` — XLA never sees a
   dynamic allocation; the host-side ``BlockAllocator`` hands page ids
   to sequences as they grow and reclaims them on completion or
-  preemption.
+  preemption. The layout is HEAD-MAJOR so one physical page for one
+  kv head is a contiguous ``[page_size, head_dim]`` tile — exactly
+  what the pallas decode kernel (ops/paged_attention.py) DMAs per
+  grid step, and a shape Mosaic can tile (last two dims divisible by
+  (8, 128) or full). Page-major ``[n_pages, Pg, KH, D]`` would force
+  a (1, Pg, 1, D) block whose sublane dim (1 of KH) Mosaic rejects.
 - Page 0 is the NULL page: inactive decode slots point their page
   table at it and harmlessly scatter their dead writes there, so the
   jitted decode step needs no ``lax.cond`` masking — every slot does
@@ -30,7 +35,7 @@ class PagedKVLayer(NamedTuple):
     """Per-layer view of the paged KV pool handed to the attention
     module (a pytree: safe to carry through jit/scan).
 
-    pages_k/pages_v: [n_pages, page_size, n_kv_heads, head_dim]
+    pages_k/pages_v: [n_kv_heads, n_pages, page_size, head_dim]
     page_table:      [n_slots, max_pages] int32 — logical page p of
                      slot s lives in physical page ``page_table[s, p]``
     """
@@ -40,12 +45,12 @@ class PagedKVLayer(NamedTuple):
 
     @property
     def page_size(self) -> int:
-        return self.pages_k.shape[1]
+        return self.pages_k.shape[2]
 
 
 def init_kv_pool(cfg, n_pages: int, page_size: int):
     """One (k, v) page pool per layer. Page 0 is reserved (null)."""
-    shape = (n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_kv_heads, n_pages, page_size, cfg.head_dim)
     return [(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
             for _ in range(cfg.n_layers)]
 

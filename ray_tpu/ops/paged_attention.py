@@ -13,17 +13,29 @@ to O(B * L) page READS only — no gathered intermediate, no scatter of
 it back.
 
 Layout contract (matches models/kv_cache.py):
-  pages_k/pages_v: [n_pages, page_size, n_kv_heads, head_dim]
+  pages_k/pages_v: [n_kv_heads, n_pages, page_size, head_dim] —
+                   HEAD-MAJOR so each grid step's block is one
+                   contiguous [page_size, head_dim] tile, which
+                   Mosaic can tile (page-major would put a size-1
+                   slice of n_kv_heads in the sublane dim)
   page_table:      [n_slots, max_pages] int32 (0 = null page)
   positions:       [n_slots]            int32 — current decode
                    position; the step attends keys 0..pos inclusive
   q:               [n_slots, n_heads, head_dim] (grouped-query: head
                    h uses kv head h // (n_heads // n_kv_heads))
 
-Grid (B, KH, n_pages_per_slot): the page dimension is innermost, so
-TPU executes it sequentially per (slot, head) and the online-softmax
-scratch carries across pages. Inactive slots point at the null page
-and mask everything — their outputs are ignored host-side.
+Grid (B, n_pages_per_slot): the page dimension is innermost, so TPU
+executes it sequentially per slot and the online-softmax scratch
+carries across pages. Each grid step processes ONE physical page for
+ALL kv heads at once — the block ``[KH, 1, Pg, D]`` is a strided but
+Mosaic-expressible slice of the head-major pool, so one step moves
+KH*(Pg*D) bytes per tensor (64KB at 1.1B shapes) instead of a 4KB
+single-head page, and the [KH, rep, Pg] score tile fills the VPU
+sublanes. (A first cut used grid (B, KH, pages) with one head-page
+per step: 4096 serialized 4KB DMAs measured 31ms/step at 1.1B-16-slot
+shapes vs 8.2ms for XLA's dense gather — DMA-issue latency-bound.)
+Inactive slots point at the null page and mask everything — their
+outputs are ignored host-side.
 """
 from __future__ import annotations
 
@@ -44,8 +56,8 @@ def _interpret() -> bool:
 def _kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
             m_sc, l_sc, acc_sc, *, page_size: int, scale: float):
     b = pl.program_id(0)
-    p = pl.program_id(2)
-    n_p = pl.num_programs(2)
+    p = pl.program_id(1)
+    n_p = pl.num_programs(1)
 
     @pl.when(p == 0)
     def _init():
@@ -53,35 +65,35 @@ def _kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         l_sc[...] = jnp.zeros_like(l_sc)
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    q = q_ref[0, 0].astype(jnp.float32)          # [rep, D]
-    k = k_ref[0, :, 0, :].astype(jnp.float32)    # [Pg, D]
-    v = v_ref[0, :, 0, :].astype(jnp.float32)    # [Pg, D]
+    q = q_ref[0].astype(jnp.float32)             # [KH, rep, D]
+    k = k_ref[:, 0].astype(jnp.float32)          # [KH, Pg, D]
+    v = v_ref[:, 0].astype(jnp.float32)          # [KH, Pg, D]
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale   # [rep, Pg]
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale   # [KH, rep, Pg]
     pos = pos_ref[b]
     kpos = p * page_size + jax.lax.broadcasted_iota(
-        jnp.int32, s.shape, 1)
+        jnp.int32, s.shape, 2)
     s = jnp.where(kpos <= pos, s, _NEG_INF)
 
-    m_prev = m_sc[...]                            # [rep, 1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_prev = m_sc[...]                            # [KH, rep, 1]
+    m_cur = jnp.max(s, axis=2, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
     # Fully-masked pages keep exp() finite.
     m_safe = jnp.maximum(m_new, -1e29)
     alpha = jnp.exp(m_prev - m_safe)
-    pexp = jnp.exp(s - m_safe)                    # [rep, Pg]
+    pexp = jnp.exp(s - m_safe)                    # [KH, rep, Pg]
     l_sc[...] = l_sc[...] * alpha + \
-        jnp.sum(pexp, axis=1, keepdims=True)
+        jnp.sum(pexp, axis=2, keepdims=True)
     acc_sc[...] = acc_sc[...] * alpha + jax.lax.dot_general(
-        pexp, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)       # [rep, D]
+        pexp, v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)       # [KH, rep, D]
     m_sc[...] = m_new
 
     @pl.when(p == n_p - 1)
     def _fin():
         l = jnp.maximum(l_sc[...], 1e-30)
-        o_ref[0, 0] = (acc_sc[...] / l).astype(o_ref.dtype)
+        o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -94,14 +106,14 @@ def paged_decode_attention(q, pages_k, pages_v, page_table, positions,
     off-TPU (tests).
     """
     B, H, D = q.shape
-    n_pages, Pg, KH, Dk = pages_k.shape
+    KH, n_pages, Pg, Dk = pages_k.shape
     assert D == Dk, (D, Dk)
     rep = H // KH
     max_pages = page_table.shape[1]
     qg = q.reshape(B, KH, rep, D)
     scale = 1.0 / (D ** 0.5)
 
-    grid = (B, KH, max_pages)
+    grid = (B, max_pages)
     kernel = functools.partial(_kernel, page_size=Pg, scale=scale)
     out = pl.pallas_call(
         kernel,
@@ -109,25 +121,25 @@ def paged_decode_attention(q, pages_k, pages_v, page_table, positions,
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                # q block for this (slot, kv head): [1, 1, rep, D]
-                pl.BlockSpec((1, 1, rep, D),
-                             lambda b, h, p, pt, pos: (b, h, 0, 0)),
-                # ONE physical page of K for this kv head, chosen by
-                # the scalar-prefetched page table: [1, Pg, 1, D]
-                pl.BlockSpec((1, Pg, 1, D),
-                             lambda b, h, p, pt, pos:
-                             (pt[b, p], 0, h, 0)),
-                pl.BlockSpec((1, Pg, 1, D),
-                             lambda b, h, p, pt, pos:
-                             (pt[b, p], 0, h, 0)),
+                # q block for this slot, every head: [1, KH, rep, D]
+                pl.BlockSpec((1, KH, rep, D),
+                             lambda b, p, pt, pos: (b, 0, 0, 0)),
+                # ONE physical page of K across ALL kv heads, chosen
+                # by the scalar-prefetched page table: [KH, 1, Pg, D]
+                pl.BlockSpec((KH, 1, Pg, D),
+                             lambda b, p, pt, pos:
+                             (0, pt[b, p], 0, 0)),
+                pl.BlockSpec((KH, 1, Pg, D),
+                             lambda b, p, pt, pos:
+                             (0, pt[b, p], 0, 0)),
             ],
             out_specs=pl.BlockSpec(
-                (1, 1, rep, D),
-                lambda b, h, p, pt, pos: (b, h, 0, 0)),
+                (1, KH, rep, D),
+                lambda b, p, pt, pos: (b, 0, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((rep, 1), jnp.float32),    # m
-                pltpu.VMEM((rep, 1), jnp.float32),    # l
-                pltpu.VMEM((rep, D), jnp.float32),    # acc
+                pltpu.VMEM((KH, rep, 1), jnp.float32),    # m
+                pltpu.VMEM((KH, rep, 1), jnp.float32),    # l
+                pltpu.VMEM((KH, rep, D), jnp.float32),    # acc
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, KH, rep, D), q.dtype),

@@ -481,6 +481,17 @@ class Executor:
         set_log_tag(f"actor={actor_id[:12]}")
         loop = slot.aloop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
+        # The loop's DEFAULT executor sizes to min(32, cpus + 4) —
+        # on a small host that silently caps every run_in_executor
+        # offload (serve replicas run sync user methods there) far
+        # below the actor's declared max_concurrency. Size it to the
+        # actor's own concurrency; threads spawn lazily.
+        # + one thread per group: each group's pump parks a blocking
+        # box.get in this same pool while idle
+        from concurrent.futures import ThreadPoolExecutor
+        loop.set_default_executor(ThreadPoolExecutor(
+            max_workers=slot.gm.max_concurrency + len(slot.gm.boxes),
+            thread_name_prefix=f"actor-exec-{actor_id[:8]}"))
         try:
             from ray_tpu._private.runtime_env import runtime_env_context
             with runtime_env_context(slot.runtime_env):

@@ -62,6 +62,7 @@ class _Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     preemptions: int = 0
     error: Optional[BaseException] = None
+    closed: bool = False         # _DONE delivered; drop late tokens
 
     @property
     def remaining(self) -> int:
@@ -101,9 +102,17 @@ class RequestHandle:
 class _Slot:
     req: _Request
     pages: List[int]             # physical page ids, logical order
-    pos: int                     # next KV write position
-    cur: int                     # last sampled token (next step input)
+    pos: int                     # next KV write position (host mirror;
+                                 # the device carries the live value)
+    cur: Optional[int]           # None until the slot's seed scatter
+                                 # is dispatched; afterwards a sentinel
+                                 # — the next-token input lives ON
+                                 # DEVICE (dev_cur), never read back
+                                 # for dispatching
     admit_seq: int               # LIFO preemption order
+    decoded: int = 0             # decode steps ridden (dispatch-time
+                                 # arithmetic, ahead of emission)
+    preempted: bool = False     # in-flight tokens must be discarded
 
 
 class LLMEngine:
@@ -131,6 +140,9 @@ class LLMEngine:
         self.K = chunk
         self.temperature = temperature
         self.eos_id = eos_id
+        # Run-ahead ceiling: one dispatch may decode up to this many
+        # steps before a host sync (the token buffer is [KMAX, S]).
+        self.KMAX = max(chunk, 128)
         # Page-table width == the attention gather window (L =
         # max_pages * page_size per slot), so cap it at what the model
         # can legally address rather than the whole pool.
@@ -145,7 +157,22 @@ class LLMEngine:
         self._rid = itertools.count()
         self._admit_seq = itertools.count()
         self._rng = jax.random.PRNGKey(seed)
-        self._pending = None      # in-flight chunk: (tokens_dev, riders)
+        # trailing readbacks: [(buf_dev, [(ix, slot, take), ...], steps)]
+        self._fetchq: "collections.deque" = collections.deque()
+        # in-flight prefills: [(firsts_dev, [(ix, slot, row), ...])]
+        self._pending_prefill: List = []
+        # Device-authoritative decode state: the next-token input and
+        # write position per slot LIVE ON DEVICE and chain dispatch to
+        # dispatch — no host readback sits on the decode critical
+        # path. Admission seeds rows via a jitted scatter (no sync);
+        # host readbacks trail for emission only.
+        self._dev_cur = jnp.zeros((max_slots,), jnp.int32)
+        self._dev_pos = jnp.zeros((max_slots,), jnp.int32)
+        # Without an eos the schedule is fully deterministic: slots
+        # retire by arithmetic at dispatch time and host syncs never
+        # gate scheduling. With an eos, completions depend on sampled
+        # tokens, so each iteration drains readbacks before planning.
+        self._deferred = eos_id is None
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
         self.stats: Dict[str, int] = collections.Counter()
@@ -156,6 +183,7 @@ class LLMEngine:
         # call, bucketed batch) up to this width
         self._max_prefill_batch = 4
         self._decode_fn = self._build_decode()
+        self._seed_fn = self._build_seed()
 
     # ---------------------------------------------------------- public
 
@@ -205,26 +233,89 @@ class LLMEngine:
             self._thread.join(timeout=30)
 
     def step(self) -> bool:
-        """One scheduler iteration, PIPELINED with the device:
+        """One scheduler iteration, DEVICE-PACED:
 
-            process chunk k's tokens  ->  admit  ->  grow/preempt
-                                     ->  dispatch chunk k+1
+            admit -> grow/preempt -> dispatch chunk k+1
+                  -> fetch chunk k's tokens (trailing)
 
-        Chunk k+1 is dispatched while chunk k's readback is consumed —
-        the device never waits on the host's ~70ms sync (decode feeds
-        its own next-token on-device; the host only needs tokens for
-        emission/completion, which tolerates one chunk of lag). This
-        is iteration-level scheduling with async output processing
-        (the vLLM multi-step idea, shaped for jax async dispatch).
-        Returns False when idle."""
+        Dispatch k+1 has NO data dependency on k's readback: the
+        next-token input and write positions chain on device
+        (dev_cur/dev_pos), admission seeds slot rows with a jitted
+        scatter, and — with no eos configured — completions are
+        dispatch-time arithmetic. The readback of chunk k then
+        overlaps chunk k+1's compute, so neither the device round
+        trip nor a slow host thread gates the token rate. With an
+        eos, sampled tokens decide completion, so the iteration
+        drains readbacks before planning (latency profile of the
+        classic chunked loop). Returns False when idle."""
         with self._lock:
-            self._process_pending_locked()
+            if not self._deferred:
+                self._drain_fetches_locked()   # emissions gate planning
             self._admit_locked()
             if not any(self.slots):
-                return self._pending is not None
-            self._grow_or_preempt_locked()
-            self._dispatch_chunk_locked()
+                if self._fetchq or self._pending_prefill:
+                    self._drain_fetches_locked(limit=1)
+                    return True
+                return False
+            steps = self._plan_steps_locked()
+            if steps:
+                self._grow_or_preempt_locked(steps)
+                self._dispatch_chunk_locked(steps)
+                if self._deferred:
+                    self._retire_planned_locked()
+            # trailing readback: block only on a dispatch OLDER than
+            # the one just queued (keep=1), so the fetch round trip
+            # overlaps the newest dispatch's compute — never its own
+            self._drain_fetches_locked(limit=1, keep=1)
             return True
+
+    def _plan_steps_locked(self) -> int:
+        """How many decode steps the next dispatch should run.
+
+        The host knows every slot's remaining budget, so when the
+        batch is FULL it runs ahead on-device to the next completion
+        event (min remaining over riders) — the only moment a
+        scheduling decision is possible — instead of syncing every
+        ``chunk`` steps. With a free slot, stick to ``chunk``-step
+        dispatches so arrivals are admitted promptly. Never sync more
+        often than ``chunk`` (a nearly-done slot rides a full window;
+        its surplus steps land in the null page and are discarded).
+        With an eos_id, run-ahead is bounded: tokens past an
+        unpredicted EOS are wasted work."""
+        rem = [self._owed(s) for s in self.slots
+               if s is not None and s.cur is not None]
+        if not rem:
+            return 0         # all occupied slots await their seed
+        # an unseeded slot joins at the next sync — treat it like a
+        # free slot and keep the quick cadence
+        free = any(s is None or s.cur is None for s in self.slots)
+        if free:
+            steps = self.K
+        else:
+            steps = max(self.K, min(rem))
+        if self.eos_id is not None:
+            steps = min(steps, 2 * self.K)
+        return max(1, min(steps, self.KMAX))
+
+    def _owed(self, slot: _Slot) -> int:
+        """Decode steps this slot still needs, by dispatch-time
+        arithmetic: the prefill emits token 1 of max_new_tokens, every
+        ridden step emits one more. Runs AHEAD of emission (which
+        trails with the readbacks) — with an eos the true need may be
+        less; emission then closes the request early."""
+        return slot.req.max_new_tokens - 1 - slot.decoded
+
+    def _retire_planned_locked(self):
+        """No-eos mode: free slots whose budget the dispatch just
+        consumed — their tokens are still in flight (emission trails)
+        but the SCHEDULE is deterministic, so the pages and the slot
+        go back to the pool without waiting for a readback."""
+        for i, slot in enumerate(self.slots):
+            if (slot is not None and slot.cur is not None
+                    and self._owed(slot) <= 0):
+                self.slots[i] = None
+                self.alloc.free(slot.pages)
+                # "completed" counts at request close (emission)
 
     # ------------------------------------------------------- scheduler
 
@@ -233,9 +324,13 @@ class LLMEngine:
             with self._work:
                 while (not self._stopped and not self._wait
                        and not any(self.slots)
-                       and self._pending is None):
+                       and not self._fetchq
+                       and not self._pending_prefill):
                     self._work.wait()
                 if self._stopped and not any(self.slots):
+                    # deliver every token already computed before
+                    # exiting — retired slots' readbacks still trail
+                    self._drain_fetches_locked()
                     return
             try:
                 self.step()
@@ -245,14 +340,31 @@ class LLMEngine:
 
     def _fail_all(self, e: BaseException):
         with self._lock:
-            for i, slot in enumerate(self.slots):
-                if slot is not None:
-                    slot.req.error = e
-                    slot.req.out_q.put(_DONE)
-                    self.slots[i] = None
-            for req in self._wait:
+            failed = set()
+
+            def fail(req):
+                if req.closed or id(req) in failed:
+                    return
+                failed.add(id(req))
                 req.error = e
                 req.out_q.put(_DONE)
+
+            for i, slot in enumerate(self.slots):
+                if slot is not None:
+                    fail(slot.req)
+                    self.slots[i] = None
+            # retired-at-dispatch requests whose tokens were still in
+            # flight live only in the readback queues
+            for _buf, riders, _steps in self._fetchq:
+                for _i, slot, _t in riders:
+                    fail(slot.req)
+            for _f, placements in self._pending_prefill:
+                for _ix, slot, _row in placements:
+                    fail(slot.req)
+            self._fetchq.clear()
+            self._pending_prefill.clear()
+            for req in self._wait:
+                fail(req)
             self._wait.clear()
             self._stopped = True
 
@@ -294,27 +406,55 @@ class LLMEngine:
                     req.error = e
                     req.out_q.put(_DONE)
                 continue
-            for (req, prompt, page_ids), first, ix in zip(
-                    group, firsts, free):
+            placements = []
+            for row, ((req, prompt, page_ids), ix) in enumerate(
+                    zip(group, free)):
                 slot = _Slot(req=req, pages=page_ids,
-                             pos=len(prompt), cur=first,
+                             pos=len(prompt), cur=None,
                              admit_seq=next(self._admit_seq))
                 self.slots[ix] = slot
                 self.stats["admitted"] += 1
-                self._emit(ix, [first])
+                placements.append((ix, slot, row))
+            # Seed the device decode state from the prefill output
+            # WITHOUT a host sync: scatter firsts/positions into
+            # dev_cur/dev_pos rows on-stream, after which the slots
+            # ride the very next dispatch.
+            B = self._max_prefill_batch
+            ixs = np.full((B,), self.S, np.int32)   # S = dropped row
+            rows = np.zeros((B,), np.int32)
+            posv = np.zeros((B,), np.int32)
+            for r, (ix, slot, row) in enumerate(placements):
+                ixs[r], rows[r], posv[r] = ix, row, slot.pos
+            self._dev_cur, self._dev_pos = self._seed_fn(
+                self._dev_cur, self._dev_pos, firsts,
+                jnp.asarray(ixs), jnp.asarray(rows), jnp.asarray(posv))
+            for ix, slot, _row in placements:
+                slot.cur = -1      # device-seeded: ridable
+            # firsts also stays on device for EMISSION: its readback
+            # rides the next trailing sync, so admission never stalls
+            # the decode stream on a host RTT
+            self._pending_prefill.append((firsts, placements))
 
-    def _grow_or_preempt_locked(self):
-        """Ensure every active slot's pages cover this chunk's writes;
-        evict the youngest slots if the pool runs dry."""
+    def _grow_or_preempt_locked(self, steps: int):
+        """Ensure every active slot's pages cover this dispatch's
+        writes; evict the youngest slots if the pool runs dry."""
         for i in sorted(
                 (i for i, s in enumerate(self.slots) if s is not None),
                 key=lambda i: self.slots[i].admit_seq):
             slot = self.slots[i]
             if slot is None:        # evicted by an elder slot's growth
                 continue
-            steps = min(self.K, slot.req.remaining)
-            need = -(-(slot.pos + steps) // self.Pg)
+            if slot.cur is None:
+                continue        # not riding this dispatch (seed not
+                                # yet scattered): writes nothing
+            eff = min(steps, max(1, self._owed(slot)))
+            need = -(-(slot.pos + eff) // self.Pg)
             while len(slot.pages) < need:
+                if self.slots[i] is not slot:
+                    # a preemption's drain closed THIS slot (eos /
+                    # budget in a trailing readback); growing the
+                    # detached object would leak its new pages
+                    break
                 got = self.alloc.alloc(need - len(slot.pages))
                 if got is not None:
                     slot.pages.extend(got)
@@ -331,62 +471,99 @@ class LLMEngine:
                 self._preempt_locked(victim)
 
     def _preempt_locked(self, ix: int):
-        slot = self.slots[ix]
+        # The victim's generated-so-far must be complete before the
+        # recompute prompt is frozen: drain every trailing readback
+        # (rare path — preemption already pays a full re-prefill).
+        victim = self.slots[ix]
+        self._drain_fetches_locked()
+        if self.slots[ix] is not victim:
+            # the drain closed the victim (eos / budget in a trailing
+            # readback): its pages are already freed — nothing to evict
+            return
+        slot = victim
         self.slots[ix] = None
+        slot.preempted = True     # in-flight rows are recomputed
         self.alloc.free(slot.pages)
         slot.req.preemptions += 1
         self.stats["preemptions"] += 1
         self._wait.appendleft(slot.req)   # front: re-admit first
 
-    def _dispatch_chunk_locked(self):
-        """Launch one K-step decode chunk asynchronously. The carry
-        (pages, per-slot cur token) lives on device; the host records
-        which slots rode the chunk and reads the tokens back NEXT
-        step, overlapped with the following chunk's compute."""
+    def _dispatch_chunk_locked(self, steps: int):
+        """Launch one decode dispatch of ``steps`` steps
+        asynchronously. The full carry — pages, per-slot write
+        position, per-slot next-token — lives on device and chains
+        into the next dispatch; the host ships only the page table.
+        The token buffer joins the trailing readback queue. ``steps``
+        is a runtime scalar to the jitted fori_loop — no recompile
+        per value."""
         pt = np.zeros((self.S, self.max_pages), np.int32)
-        pos = np.zeros((self.S,), np.int32)
-        cur = np.zeros((self.S,), np.int32)
         riders = []
         for i, slot in enumerate(self.slots):
-            if slot is None:
+            if slot is None or slot.cur is None:
                 continue
             pt[i, :len(slot.pages)] = slot.pages
-            pos[i] = slot.pos
-            cur[i] = slot.cur
-            riders.append((i, slot))
-        toks, self.pages, self._rng = self._decode_fn(
+            # tokens this slot still owes its client from THIS
+            # dispatch (the tail of an overshooting window is junk)
+            take = min(steps, max(0, self._owed(slot)))
+            riders.append((i, slot, take))
+        (toks, self.pages, self._rng, self._dev_pos,
+         self._dev_cur) = self._decode_fn(
             self.params, self.pages, jnp.asarray(pt),
-            jnp.asarray(pos), jnp.asarray(cur), self._rng)
-        # pos advances NOW (host mirror of the device carry); cur and
-        # emission land at processing time
-        for _i, slot in riders:
-            slot.pos += self.K
-        self._pending = (toks, riders)
+            self._dev_pos, self._dev_cur, self._rng,
+            jnp.int32(steps))
+        # host mirrors advance NOW; emission trails
+        for _i, slot, _t in riders:
+            slot.pos += steps
+            slot.decoded += steps
+        self._fetchq.append((toks, riders, steps))
         self.stats["chunks"] += 1
-        self.stats["decode_steps"] += self.K
+        self.stats["decode_steps"] += steps
 
-    def _process_pending_locked(self):
-        """Consume the previous chunk's tokens (the only device->host
-        sync). Runs while the NEXT chunk computes."""
-        if self._pending is None:
+    def _drain_fetches_locked(self, limit: Optional[int] = None,
+                              keep: int = 0):
+        """Trailing token readback: fetch up to ``limit`` outstanding
+        decode buffers (None = all) plus EVERY in-flight prefill's
+        firsts in one host sync each round, and emit to clients.
+        Blocking here never stalls the device — the next dispatch is
+        already queued behind the one being read."""
+        rounds = 0
+        while self._fetchq or self._pending_prefill:
+            if limit is not None and rounds >= limit:
+                return
+            if keep and len(self._fetchq) <= keep \
+                    and not self._pending_prefill:
+                # nothing older than the newest dispatch to read —
+                # blocking here would serialize fetch after compute
+                return
+            rounds += 1
+            batch = []
+            if len(self._fetchq) > keep:
+                batch.append(self._fetchq.popleft())
+            pend_pre, self._pending_prefill = self._pending_prefill, []
+            vals = jax.device_get(
+                [b[0] for b in batch] + [f for f, _ in pend_pre])
+            k = len(batch)
+            # prefill firsts FIRST: a slot's seeding prefill always
+            # precedes its first decode ride, and both can land in
+            # the same drain round
+            for (_f, placements), firsts in zip(pend_pre, vals[k:]):
+                for ix, slot, row in placements:
+                    if slot.preempted:
+                        continue
+                    self._emit_to(slot.req, [int(firsts[row])], ix)
+            for (_buf, riders, _steps), toks in zip(batch, vals):
+                for i, slot, take in riders:
+                    if slot.preempted:
+                        continue    # recomputed from scratch
+                    self._emit_to(slot.req, toks[:take, i].tolist(), i)
+
+    def _emit_to(self, req: _Request, tokens: List[int], ix: int):
+        """Deliver tokens to the request; close it when it hits eos
+        or its budget. In no-eos mode the slot/pages were already
+        retired at dispatch time; with an eos, closing here frees
+        them (the readback is what reveals the eos)."""
+        if req.closed:
             return
-        toks_dev, riders = self._pending
-        self._pending = None
-        toks = np.asarray(toks_dev)           # overlapped readback
-        for i, slot in riders:
-            if self.slots[i] is not slot:
-                continue      # preempted after dispatch: recompute
-            # host mirror of cur for the NEXT dispatch (the device
-            # already carried it forward internally during the chunk)
-            slot.cur = int(toks[-1, i])
-            accept = toks[:min(self.K, slot.req.remaining), i].tolist()
-            self._emit(i, accept)
-
-    def _emit(self, ix: int, tokens: List[int]):
-        """Deliver tokens to the request; close out the slot when the
-        request hits eos or its budget."""
-        slot = self.slots[ix]
-        req = slot.req
         done = False
         for t in tokens:
             t = int(t)
@@ -397,8 +574,11 @@ class LLMEngine:
                 done = True
                 break
         if done:
-            self.slots[ix] = None
-            self.alloc.free(slot.pages)
+            req.closed = True
+            slot = self.slots[ix]
+            if slot is not None and slot.req is req:
+                self.slots[ix] = None
+                self.alloc.free(slot.pages)
             self.stats["completed"] += 1
             req.out_q.put(_DONE)
 
@@ -435,7 +615,8 @@ class LLMEngine:
             self.pages, jnp.asarray(pids), self._rng)
         self.stats["prefills"] += 1
         self.stats["prefilled_seqs"] += n
-        return [int(t) for t in np.asarray(firsts)[:n]]
+        # device array: the caller reads rows back at the next sync
+        return firsts
 
     def _build_prefill(self, T0pad: int, B: int):
         model, cfg, Pg, temp = (self.model, self.cfg, self.Pg,
@@ -451,13 +632,17 @@ class LLMEngine:
             flat_ids = page_ids.reshape(-1)     # [B * n_prompt_pages]
             new_pages = []
             for (pk, pv), (ck, cv) in zip(pages, caches):
+                # dense cache [B, T0pad, KH, D] -> head-major pages
+                # [KH, B*npp, Pg, D] scattered at [:, flat_ids]
                 kp = ck.reshape(B * n_prompt_pages, Pg,
-                                cfg.n_kv_heads, cfg.head_dim)
+                                cfg.n_kv_heads, cfg.head_dim
+                                ).transpose(2, 0, 1, 3)
                 vp = cv.reshape(B * n_prompt_pages, Pg,
-                                cfg.n_kv_heads, cfg.head_dim)
+                                cfg.n_kv_heads, cfg.head_dim
+                                ).transpose(2, 0, 1, 3)
                 new_pages.append((
-                    pk.at[flat_ids].set(kp.astype(pk.dtype)),
-                    pv.at[flat_ids].set(vp.astype(pv.dtype))))
+                    pk.at[:, flat_ids].set(kp.astype(pk.dtype)),
+                    pv.at[:, flat_ids].set(vp.astype(pv.dtype))))
             last = logits[jnp.arange(B), true_lens - 1]    # [B, V]
             firsts = _pick_token(last, sub, temp)
             return firsts, new_pages, rng
@@ -465,12 +650,23 @@ class LLMEngine:
         return jax.jit(prefill, donate_argnums=(3,))
 
     def _build_decode(self):
-        model, K, temp = self.model, self.K, self.temperature
+        model, temp = self.model, self.temperature
+        KMAX, S = self.KMAX, self.S
         from ray_tpu.models.llama import _pick_token
 
-        def decode(params, pages, page_table, pos, cur, rng):
-            def body(carry, _):
-                pages, pos, cur, key = carry
+        def decode(params, pages, page_table, pos, cur, rng, steps):
+            # fori_loop with a RUNTIME bound: one executable serves
+            # every dispatch length (chunk-sized quick syncs and full
+            # run-ahead alike); tokens land in a fixed [KMAX, S]
+            # buffer, rows past `steps` stay zero and are never read.
+            # pos/cur are the DEVICE-authoritative per-slot state:
+            # they chain dispatch-to-dispatch (admission seeds rows
+            # via _build_seed's scatter), so no host readback ever
+            # sits between two dispatches.
+            buf0 = jnp.zeros((KMAX, S), jnp.int32)
+
+            def body(i, carry):
+                pages, pos, cur, key, buf = carry
                 key, sub = jax.random.split(key)
                 kv = [PagedKVLayer(pk, pv, page_table)
                       for pk, pv in pages]
@@ -478,12 +674,21 @@ class LLMEngine:
                     params, cur[:, None], kv_caches=kv, cache_len=pos)
                 nxt = _pick_token(logits[:, -1], sub, temp)
                 new_pages = [(c.pages_k, c.pages_v) for c in new_kv]
-                return (new_pages, pos + 1, nxt, key), nxt
-            (pages, _, _, key), toks = jax.lax.scan(
-                body, (pages, pos, cur, rng), None, length=K)
-            # the advanced key returns as device state: the host never
-            # runs jax.random.split between chunks (each split is a
-            # device dispatch — pure overhead on the decode hot loop)
-            return toks, pages, key        # toks: [K, S]
+                return (new_pages, pos + 1, nxt, key, buf.at[i].set(nxt))
+            pages, pos, cur, key, buf = jax.lax.fori_loop(
+                0, steps, body, (pages, pos, cur, rng, buf0))
+            # key/pos/cur return as device state: the host never syncs
+            # on them between dispatches
+            return buf, pages, key, pos, cur   # buf: [KMAX, S]
 
-        return jax.jit(decode, donate_argnums=(1,))
+        return jax.jit(decode, donate_argnums=(1, 3, 4))
+
+    def _build_seed(self):
+        """Jitted admission seeding: scatter a prefill batch's first
+        tokens and write positions into the device decode state.
+        Rows padded with ix == S drop (mode='drop') — one executable
+        regardless of how many slots the group filled."""
+        def seed(dev_cur, dev_pos, firsts, ixs, rows, posv):
+            return (dev_cur.at[ixs].set(firsts[rows], mode="drop"),
+                    dev_pos.at[ixs].set(posv, mode="drop"))
+        return jax.jit(seed, donate_argnums=(0, 1))

@@ -34,7 +34,7 @@ class LlamaDeployment:
     def __init__(self, config=None, params=None, max_new_tokens: int = 64,
                  temperature: float = 0.0, stream_chunk: int = 8,
                  use_engine: bool = True, max_slots: int = 16,
-                 page_size: int = 16, n_pages: Optional[int] = None,
+                 page_size: int = 64, n_pages: Optional[int] = None,
                  decode_chunk: Optional[int] = None,
                  eos_id: Optional[int] = None):
         import jax

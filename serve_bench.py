@@ -9,7 +9,7 @@ Drives a serve deployment wrapping the continuous-batching engine
 - streaming phase: tokens stream from the engine measuring
   time-to-first-token and steady-state streaming rate.
 
-Writes SERVE_BENCH_r04.json and prints it.
+Writes SERVE_BENCH_r05.json and prints it.
 
 Usage: python serve_bench.py [--model 7b|1b|tiny] [--out FILE]
 (7b needs ~14GB HBM; falls back to 1b automatically on OOM.)
@@ -40,20 +40,55 @@ def build_configs(name):
 PROMPT_LEN = 128
 GEN_TOKENS = 64
 SLOTS = 16          # continuous-batching decode width
-DECODE_CHUNK = 8    # tokens per device dispatch (host-sync amortizer)
+DECODE_CHUNK = 16   # tokens per device dispatch (host-sync amortizer:
+                    # each chunk pays one host round trip, ~84ms
+                    # through the axon tunnel on this rig)
 
 
-def make_server(cfg):
+LEGACY_BATCH = 8    # r03 legacy shape: @serve.batch coalescing width
+
+
+def make_server(cfg, use_engine=True):
     import ray_tpu
     from ray_tpu import serve
     from ray_tpu.serve.llm import LlamaDeployment
+
+    if not use_engine:
+        # The r03 decode-to-completion baseline, verbatim: whole-call
+        # batching via @serve.batch + one padded generate_batch per
+        # coalesced batch (SERVE_BENCH_r03.json's 774 tok/s shape).
+        @serve.deployment(max_ongoing_requests=64)
+        class LegacyServer:
+            def __init__(self):
+                self.inner = LlamaDeployment(
+                    config=cfg, max_new_tokens=GEN_TOKENS,
+                    use_engine=False)
+
+            @serve.batch(max_batch_size=LEGACY_BATCH,
+                         batch_wait_timeout_s=0.02)
+            async def __call__(self, prompts):
+                n = len(prompts)
+                padded = list(prompts) + \
+                    [prompts[0]] * (LEGACY_BATCH - n)
+                out = self.inner.generate_batch(padded)
+                return [o[len(p):] for o, p in
+                        zip(out[:n], prompts)]
+
+            def stream(self, prompt):
+                yield from self.inner.stream(prompt)
+
+            def engine_stats(self):
+                return {}
+
+        return serve.run(LegacyServer.bind(), timeout_s=600)
 
     @serve.deployment(max_ongoing_requests=64)
     class LlamaServer:
         def __init__(self):
             self.inner = LlamaDeployment(
                 config=cfg, max_new_tokens=GEN_TOKENS,
-                max_slots=SLOTS, page_size=16,
+                use_engine=use_engine,
+                max_slots=SLOTS, page_size=64,
                 decode_chunk=DECODE_CHUNK)
 
         def __call__(self, prompt):
@@ -139,7 +174,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="7b",
                     choices=["7b", "1b", "tiny"])
-    ap.add_argument("--out", default="SERVE_BENCH_r04.json")
+    ap.add_argument("--out", default="SERVE_BENCH_r05.json")
+    ap.add_argument("--legacy", action="store_true",
+                    help="decode-to-completion @serve.batch path "
+                         "(engine off) for A/B on the same load")
     args = ap.parse_args()
 
     import os
@@ -157,10 +195,12 @@ def main():
         label, cfg = build_configs(name)
         print(f"model: {label}", flush=True)
         try:
-            handle = make_server(cfg)
+            handle = make_server(cfg, use_engine=not args.legacy)
             rng = np.random.RandomState(0)
             result = bench(handle, rng, cfg)
             result["model"] = label
+            result["path"] = ("legacy_decode_to_completion"
+                              if args.legacy else "engine")
             break
         except Exception as e:   # noqa: BLE001
             msg = str(e)
@@ -173,11 +213,16 @@ def main():
     result["slots"] = SLOTS
     result["decode_chunk"] = DECODE_CHUNK
     result["gen_tokens"] = GEN_TOKENS
-    try:
-        result["engine"] = ray_tpu.get(
-            handle.engine_stats.remote(), timeout=60)
-    except Exception:
-        pass
+    if not args.legacy:
+        # (legacy path: engine_stats would lazily build an unused
+        # engine — allocating the whole KV pool — just to report zeros)
+        try:
+            result["engine"] = ray_tpu.get(
+                handle.engine_stats.remote(), timeout=60)
+        except Exception:
+            pass
+    if args.legacy and args.out == "SERVE_BENCH_r05.json":
+        args.out = "SERVE_BENCH_r05_legacy.json"
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))

@@ -13,28 +13,28 @@ from ray_tpu.ops.paged_attention import paged_decode_attention
 
 def _dense_ref(q, pages_k, pages_v, page_table, positions):
     B, H, D = q.shape
-    _, Pg, KH, _ = pages_k.shape
+    KH, _, Pg, _ = pages_k.shape
     L = page_table.shape[1] * Pg
     rep = H // KH
-    kg = pages_k[page_table].reshape(B, L, KH, D)
-    vg = pages_v[page_table].reshape(B, L, KH, D)
+    kg = pages_k[:, page_table].reshape(KH, B, L, D)
+    vg = pages_v[:, page_table].reshape(KH, B, L, D)
     qg = q.reshape(B, KH, rep, D).astype(np.float32)
-    s = np.einsum("bkrd,bskd->bkrs", qg,
+    s = np.einsum("bkrd,kbsd->bkrs", qg,
                   kg.astype(np.float32)) / np.sqrt(D)
     valid = np.arange(L)[None] <= np.asarray(positions)[:, None]
     s = np.where(valid[:, None, None, :], s, -1e30)
     s = s - s.max(axis=-1, keepdims=True)
     p = np.exp(s)
     p /= p.sum(axis=-1, keepdims=True)
-    o = np.einsum("bkrs,bskd->bkrd", p, vg.astype(np.float32))
+    o = np.einsum("bkrs,kbsd->bkrd", p, vg.astype(np.float32))
     return o.reshape(B, H, D)
 
 
 def _random_layout(rng, B, n_pages, max_pages, Pg, KH, D, H,
                    dtype=np.float32):
     # Page 0 is the null page; each slot gets a distinct page chain.
-    pages_k = rng.standard_normal((n_pages, Pg, KH, D)).astype(dtype)
-    pages_v = rng.standard_normal((n_pages, Pg, KH, D)).astype(dtype)
+    pages_k = rng.standard_normal((KH, n_pages, Pg, D)).astype(dtype)
+    pages_v = rng.standard_normal((KH, n_pages, Pg, D)).astype(dtype)
     perm = rng.permutation(n_pages - 1)[: B * max_pages] + 1
     page_table = perm.reshape(B, max_pages).astype(np.int32)
     positions = rng.integers(0, max_pages * Pg, size=B).astype(np.int32)
@@ -74,7 +74,7 @@ def test_position_zero_and_full():
                                rtol=2e-4, atol=2e-4)
     # Slot 0's output must equal V at position 0 exactly (softmax
     # over a single key).
-    v0 = pv[pt[0, 0], 0, 0]
+    v0 = pv[0, pt[0, 0], 0]
     np.testing.assert_allclose(np.asarray(out)[0, 0], v0,
                                rtol=1e-5, atol=1e-5)
 
