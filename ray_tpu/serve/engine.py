@@ -15,10 +15,15 @@ TPU/XLA design:
   BlockAllocator hands pages to sequences as they grow; completion or
   preemption returns them. Memory is bounded by the pool, not by
   max_slots x max_len.
-- Decode runs in chunks of ``chunk`` tokens per dispatch: one host
-  sync per chunk amortizes the ~70ms tunneled-device readback latency
-  (see generate_stream in models/llama.py) while keeping join/leave
-  granularity at ``chunk`` tokens.
+- Decode is DEVICE-PACED: per-slot next-token and write position live
+  on device and chain dispatch-to-dispatch; admission seeds slot rows
+  with an on-stream scatter; token readbacks trail asynchronously and
+  only ever block on a dispatch older than the newest one. With a
+  full batch the scheduler runs ahead to the next completion event
+  (dispatch-time arithmetic when no eos is configured), so the host
+  syncs exactly when a scheduling decision is possible — host round
+  trips (~84ms through a tunneled device) never gate the token rate.
+  Join/leave granularity under load is ``chunk`` tokens.
 - Preemption is recompute-based: when the pool runs dry the youngest
   slot is evicted, its pages freed, and the request requeued with
   prompt = original prompt + tokens generated so far, so clients see
