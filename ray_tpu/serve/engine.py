@@ -53,6 +53,15 @@ from ray_tpu.models.kv_cache import (BlockAllocator, PagedKVLayer,
 _DONE = object()
 
 
+def _dev_ready(buf) -> bool:
+    """True when a device array's computation has finished (readback
+    would not block). Conservative False when the runtime can't say."""
+    try:
+        return bool(buf.is_ready())
+    except Exception:
+        return False
+
+
 class RequestError(Exception):
     pass
 
@@ -256,6 +265,13 @@ class LLMEngine:
         with self._lock:
             if not self._deferred:
                 self._drain_fetches_locked()   # emissions gate planning
+            else:
+                # Opportunistic: read back anything already finished
+                # BEFORE admitting — free on a fast local device, and
+                # it gets completions to clients (whose resubmissions
+                # can then land during the upcoming dispatch) a full
+                # dispatch earlier. Never blocks.
+                self._drain_fetches_locked(ready_only=True)
             self._admit_locked()
             if not any(self.slots):
                 if self._fetchq or self._pending_prefill:
@@ -525,24 +541,37 @@ class LLMEngine:
         self.stats["decode_steps"] += steps
 
     def _drain_fetches_locked(self, limit: Optional[int] = None,
-                              keep: int = 0):
+                              keep: int = 0,
+                              ready_only: bool = False):
         """Trailing token readback: fetch up to ``limit`` outstanding
         decode buffers (None = all) plus EVERY in-flight prefill's
         firsts in one host sync each round, and emit to clients.
         Blocking here never stalls the device — the next dispatch is
         already queued behind the one being read."""
-        rounds = 0
+        blocking_rounds = 0
         while self._fetchq or self._pending_prefill:
-            if limit is not None and rounds >= limit:
+            front_ready = bool(self._fetchq) and \
+                _dev_ready(self._fetchq[0][0])
+            # A finished buffer is always read (free — no block): on a
+            # local device the previous dispatch is usually done by
+            # now, so emission stays prompt. The `keep` fence only
+            # protects STILL-COMPUTING dispatches — blocking on the
+            # one just queued would serialize fetch after compute.
+            take_buf = bool(self._fetchq) and (
+                front_ready or
+                (not ready_only and len(self._fetchq) > keep))
+            if not take_buf and not self._pending_prefill:
                 return
-            if keep and len(self._fetchq) <= keep \
-                    and not self._pending_prefill:
-                # nothing older than the newest dispatch to read —
-                # blocking here would serialize fetch after compute
-                return
-            rounds += 1
+            if not front_ready:
+                if ready_only and not take_buf:
+                    # prefills only: their device_get blocks on the
+                    # (older, quick) prefill — skip in ready-only mode
+                    return
+                if limit is not None and blocking_rounds >= limit:
+                    return
+                blocking_rounds += 1
             batch = []
-            if len(self._fetchq) > keep:
+            if take_buf:
                 batch.append(self._fetchq.popleft())
             pend_pre, self._pending_prefill = self._pending_prefill, []
             vals = jax.device_get(

@@ -224,3 +224,25 @@ def test_mixtral_through_engine():
     while eng.step():
         pass
     assert h.result() == want
+
+
+def test_run_ahead_dispatch_coalescing(tiny_model):
+    """Device-paced scheduling: with a full batch and no eos, the
+    engine runs ahead to the next completion event instead of syncing
+    every `chunk` steps — the whole generation should take a handful
+    of dispatches, not max_new/chunk of them."""
+    model, params = tiny_model
+    eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                    n_pages=32, chunk=4)
+    p1, p2 = [3, 1, 4, 1, 5], [2, 7, 1, 8]
+    h1 = eng.submit(p1, max_new_tokens=24)
+    h2 = eng.submit(p2, max_new_tokens=24)
+    while eng.step():
+        pass
+    assert h1.result() == _reference_completion(model, params, p1, 24)
+    assert h2.result() == _reference_completion(model, params, p2, 24)
+    # 2 slots x 24 tokens with aligned budgets: one quick chunk while
+    # admission fills, then run-ahead to the completion boundary.
+    # Chunked pacing would need ~6 dispatches per request stream.
+    assert eng.stats["chunks"] <= 4, dict(eng.stats)
+    assert eng.stats["decode_steps"] >= 23
