@@ -560,20 +560,29 @@ class LLMEngine:
             take_buf = bool(self._fetchq) and (
                 front_ready or
                 (not ready_only and len(self._fetchq) > keep))
-            if not take_buf and not self._pending_prefill:
+            # Prefill firsts ride along unless this is a ready-only
+            # sweep and any of them is still computing (a sweep must
+            # never block). Ordering stays safe: a rider's prefill is
+            # always older than its first decode buffer, so a READY
+            # front implies its riders' firsts are ready too — only
+            # NEWER prefills (whose slots ride no fetched buffer yet)
+            # can be withheld.
+            pre_ready = bool(self._pending_prefill) and (
+                not ready_only or all(
+                    _dev_ready(f) for f, _ in self._pending_prefill))
+            if not take_buf and not pre_ready:
                 return
-            if not front_ready:
-                if ready_only and not take_buf:
-                    # prefills only: their device_get blocks on the
-                    # (older, quick) prefill — skip in ready-only mode
-                    return
+            if take_buf and not front_ready:
                 if limit is not None and blocking_rounds >= limit:
                     return
                 blocking_rounds += 1
             batch = []
             if take_buf:
                 batch.append(self._fetchq.popleft())
-            pend_pre, self._pending_prefill = self._pending_prefill, []
+            pend_pre = []
+            if pre_ready:
+                pend_pre, self._pending_prefill = \
+                    self._pending_prefill, []
             vals = jax.device_get(
                 [b[0] for b in batch] + [f for f, _ in pend_pre])
             k = len(batch)

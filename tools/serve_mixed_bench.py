@@ -11,7 +11,7 @@ freed slot (reference being surpassed: python/ray/serve/batching.py —
 coalesced batches complete as a unit).
 
 Load: short "riders" (8 tokens) mixed with long "stragglers"
-(96 tokens), 3:1, under 16 concurrent clients. Metrics: useful tokens/s
+(128 tokens), 3:1, under 16 concurrent clients. Metrics: useful tokens/s
 and per-class p50. Writes ENGINE_MIXED json (VERDICT r5 #3: one
 artifact where engine > legacy).
 
@@ -165,8 +165,9 @@ def main():
     print("engine:", json.dumps(engine), flush=True)
     result = {
         "notes": (
-            "Mixed-length load (3:1 riders of 8 tokens to stragglers "
-            "of 96) on CPU: decode-to-completion batches run to their "
+            f"Mixed-length load (3:1 riders of {SHORT} tokens to "
+            f"stragglers of {LONG}) on CPU: "
+            "decode-to-completion batches run to their "
             "longest member, so riders queue behind stragglers; "
             "continuous batching retires riders immediately and "
             "refills the freed slots."),
