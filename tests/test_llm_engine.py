@@ -246,3 +246,39 @@ def test_run_ahead_dispatch_coalescing(tiny_model):
     # Chunked pacing would need ~6 dispatches per request stream.
     assert eng.stats["chunks"] <= 4, dict(eng.stats)
     assert eng.stats["decode_steps"] >= 23
+
+
+def test_shutdown_delivers_trailing_readbacks(tiny_model):
+    """No-eos mode retires slots at dispatch time while their tokens
+    are still in flight; shutdown must deliver every computed token
+    before the scheduler exits, or clients hang on result()."""
+    model, params = tiny_model
+    eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                    n_pages=32, chunk=4).start()
+    p = [11, 3, 5]
+    want = _reference_completion(model, params, p, 12)
+    h = eng.submit(p, max_new_tokens=12)
+    got = h.result()
+    eng.shutdown()
+    assert got == want
+
+
+def test_mixed_budgets_retire_independently(tiny_model):
+    """A short and a long request share the batch; the short one's
+    slot retires by arithmetic mid-run and is reusable while the long
+    one keeps decoding — both streams exact."""
+    model, params = tiny_model
+    eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                    n_pages=32, chunk=4)
+    p1, p2, p3 = [5, 1], [7, 2, 9], [4, 4, 8]
+    want1 = _reference_completion(model, params, p1, 4)
+    want2 = _reference_completion(model, params, p2, 30)
+    want3 = _reference_completion(model, params, p3, 6)
+    h1 = eng.submit(p1, max_new_tokens=4)
+    h2 = eng.submit(p2, max_new_tokens=30)
+    h3 = eng.submit(p3, max_new_tokens=6)   # reuses p1's retired slot
+    while eng.step():
+        pass
+    assert h1.result() == want1
+    assert h2.result() == want2
+    assert h3.result() == want3
