@@ -262,10 +262,22 @@ class Replica:
 
     def stats(self):
         self._reap_abandoned_streams()
-        return {"replica_id": self.replica_id,
-                "user_config": getattr(self, "_user_config", None),
-                "ongoing": self._ongoing,
-                "total": self._total}
+        out = {"replica_id": self.replica_id,
+               "user_config": getattr(self, "_user_config", None),
+               "ongoing": self._ongoing,
+               "total": self._total}
+        # Optional user metrics hook (reference: serve's
+        # record_metrics / RequestRouter stats): a deployment class
+        # may expose serve_stats() -> dict; merged under "user" so
+        # autoscaler/status surfaces see domain metrics (e.g. the
+        # LLM engine's slot occupancy and token counters).
+        fn = getattr(self.instance, "serve_stats", None)
+        if callable(fn):
+            try:
+                out["user"] = fn()
+            except Exception as e:   # visible, never fatal
+                out["user"] = {"error": repr(e)}
+        return out
 
     def health_check(self):
         """Controller liveness probe. A deployment class may define

@@ -97,6 +97,33 @@ class LlamaDeployment:
                     temperature=self.temperature, **opts).start()
             return self._engine
 
+    def serve_stats(self) -> dict:
+        """Replica metrics hook (merged into Replica.stats() under
+        "user"): engine counters plus live slot occupancy, without
+        forcing a lazy engine into existence."""
+        if not self.use_engine or self._engine is None:
+            return {"engine": None}
+        eng = self._engine
+        # Best-effort lock: the scheduler holds eng._lock across
+        # dispatch AND blocking readbacks (seconds under load), and
+        # this runs as a sync method ON the replica event loop —
+        # waiting here would stall request handling and make the
+        # controller's 2s-timeout stats polls misread a busy replica
+        # as idle. Lock-free reads of these ints/lists are safe
+        # (GIL), just possibly torn across fields.
+        locked = eng._lock.acquire(timeout=0.05)
+        try:
+            live = sum(1 for s in eng.slots if s is not None)
+            out = dict(eng.stats)
+            free, total = eng.alloc.n_free, eng.alloc.n_pages - 1
+        finally:
+            if locked:
+                eng._lock.release()
+        out.update(slots_live=live, slots_total=eng.S,
+                   pages_free=free, pages_total=total,
+                   consistent=locked)
+        return {"engine": out}
+
     def __call__(self, prompt_ids: List[int]) -> List[int]:
         """One request: token ids in, prompt+generated ids out."""
         if self.use_engine:

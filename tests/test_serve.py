@@ -746,3 +746,37 @@ def test_replica_concurrency_honors_max_ongoing(serve_rt):
     # ... and serial would be 2.4s; genuine overlap keeps it well
     # under half
     assert wall < 1.2, f"8 parallel 0.3s calls took {wall:.2f}s"
+
+
+def test_replica_stats_user_hook(serve_rt):
+    """A deployment exposing serve_stats() gets its metrics merged
+    into Replica.stats() under "user" — the path autoscaler/status
+    consumers read (LLM engine occupancy rides this hook)."""
+    from ray_tpu.serve.llm import LlamaDeployment
+    from ray_tpu.models.llama import llama_tiny
+
+    @serve.deployment(max_ongoing_requests=8)
+    class L:
+        def __init__(self):
+            self.inner = LlamaDeployment(
+                config=llama_tiny(), max_new_tokens=6,
+                max_slots=2, page_size=8, decode_chunk=2)
+
+        def __call__(self, p):
+            return self.inner(p)
+
+        def serve_stats(self):
+            return self.inner.serve_stats()
+
+    handle = serve.run(L.bind())
+    out = ray_tpu.get(handle.remote([3, 1, 4]), timeout=120)
+    assert len(out) == 9
+    from ray_tpu.serve.api import get_or_create_controller
+    controller = get_or_create_controller()
+    reps = ray_tpu.get(controller.get_replicas.remote("L"))
+    _rid, h = reps["replicas"][0]
+    stats = ray_tpu.get(h.stats.remote(), timeout=30)
+    eng = stats["user"]["engine"]
+    assert eng["completed"] >= 1
+    assert eng["slots_total"] == 2
+    assert eng["pages_free"] <= eng["pages_total"]
