@@ -68,6 +68,66 @@ class Dashboard:
         return web.Response(text=prometheus_text(),
                             content_type="text/plain")
 
+    async def _serve_status(self, request):
+        """Serve application view: deployment statuses plus live
+        per-replica stats (ongoing/total and any serve_stats() user
+        metrics, e.g. LLM engine slot occupancy). Empty when no serve
+        controller is running."""
+        from aiohttp import web
+        loop = asyncio.get_event_loop()
+
+        def collect():
+            import ray_tpu
+            from ray_tpu.serve import api as serve_api
+            try:
+                controller = ray_tpu.get_actor(
+                    serve_api.CONTROLLER_NAME)
+            except Exception:
+                return {"deployments": {}}
+            # Strictly read-only: use the handle we already resolved
+            # (serve.status()/list_deployments() would re-create a
+            # controller that a racing shutdown just killed).
+            try:
+                info = ray_tpu.get(
+                    controller.list_deployments.remote(), timeout=2)
+            except Exception:
+                return {"deployments": {}}
+            out = {"deployments": {}}
+            for name, d in info.items():
+                d = dict(d)
+                d["status"] = ("HEALTHY"
+                               if d["num_replicas"] >= max(
+                                   1, d["target"])
+                               else "UPDATING")
+                d["replica_stats"] = []
+                out["deployments"][name] = d
+            # Batch: all replica-stats refs first, ONE bounded get —
+            # a hung replica costs one timeout, not 2s x replicas.
+            pending = []     # (name, rid, ref)
+            for name in info:
+                try:
+                    reps = ray_tpu.get(
+                        controller.get_replicas.remote(name),
+                        timeout=2)
+                    for rid, h in reps["replicas"]:
+                        pending.append((name, rid, h.stats.remote()))
+                except Exception:
+                    pass
+            if pending:
+                try:
+                    vals = ray_tpu.get([r for _, _, r in pending],
+                                       timeout=2)
+                except Exception as e:
+                    vals = [{"replica_id": rid, "error": repr(e)}
+                            for _, rid, _ in pending]
+                for (name, rid, _), stats in zip(pending, vals):
+                    out["deployments"][name]["replica_stats"].append(
+                        stats)
+            return out
+
+        return web.json_response(
+            await loop.run_in_executor(None, collect))
+
     def _run(self):
         from aiohttp import web
         loop = asyncio.new_event_loop()
@@ -82,6 +142,7 @@ class Dashboard:
         app.router.add_get("/api/workers", self._workers)
         app.router.add_get("/api/nodes", self._nodes)
         app.router.add_get("/api/timeline", self._timeline)
+        app.router.add_get("/api/serve", self._serve_status)
         app.router.add_get("/metrics", self._metrics)
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())

@@ -74,6 +74,7 @@ td.mono { font-family: ui-monospace, monospace; font-size: 12px;
 </header>
 <main>
   <div class="tiles" id="tiles"></div>
+  <h2>Serve</h2><div id="serve"></div>
   <h2>Nodes</h2><div id="nodes"></div>
   <h2>Workers</h2><div id="workers"></div>
   <h2>Actors</h2><div id="actors"></div>
@@ -123,11 +124,12 @@ function resPair(total, avail, key) {
 }
 async function refresh() {
   try {
-    const [sum, workers, actors, tasks, objects, nodes] =
+    const [sum, workers, actors, tasks, objects, nodes, srv] =
       await Promise.all([
       j("/api/cluster_summary"), j("/api/workers"), j("/api/actors"),
       j("/api/tasks"), j("/api/objects"),
-      j("/api/nodes").catch(() => [])]);
+      j("/api/nodes").catch(() => []),
+      j("/api/serve").catch(() => ({deployments: {}}))]);
     const t = sum.resources_total || {}, a = sum.resources_available || {};
     const running = (sum.tasks || {}).RUNNING || 0;
     const finished = (sum.tasks || {}).FINISHED || 0;
@@ -139,6 +141,34 @@ async function refresh() {
       tile("Tasks running", running, `${fmt(finished)} finished`) +
       tile("Actors", Object.values(sum.actors || {})
                      .reduce((x, y) => x + y, 0));
+    // serve deployments: status, replicas, in-flight, and any
+    // serve_stats() user metrics (e.g. LLM engine slot occupancy)
+    const deps = Object.entries(srv.deployments || {}).map(
+      ([name, d]) => ({name, ...d}));
+    document.getElementById("serve").innerHTML = deps.length
+      ? table(deps, [
+        {label: "deployment", fn: r => esc(r.name)},
+        {label: "status", fn: r => pill(r.status === "HEALTHY",
+                                        esc(r.status))},
+        {label: "replicas", fn: r =>
+          `${fmt(r.num_replicas)} / ${fmt(r.target ?? r.num_replicas)}`},
+        {label: "in flight", fn: r => fmt((r.replica_stats || [])
+          .reduce((x, s) => x + (s.ongoing || 0), 0))},
+        {label: "served", fn: r => fmt((r.replica_stats || [])
+          .reduce((x, s) => x + (s.total || 0), 0))},
+        {label: "engine", fn: r => {
+          // aggregate across replicas; values are user-controlled
+          // (serve_stats hook) so they pass through esc() like
+          // every other column
+          const gs = (r.replica_stats || [])
+            .map(s => (s.user || {}).engine).filter(g => g);
+          if (!gs.length) return `<span class=muted>—</span>`;
+          const sum = k => gs.reduce((x, g) => x + (+g[k] || 0), 0);
+          return esc(`${fmt(sum("slots_live"))}/` +
+                     `${fmt(sum("slots_total"))} slots, ` +
+                     `${fmt(sum("completed"))} done`);
+        }}])
+      : `<span class=muted>no deployments</span>`;
     // per-node hardware rows (reporter_agent parity): cpu/mem/store
     // snapshots shipped with node heartbeats
     document.getElementById("nodes").innerHTML = table(nodes, [

@@ -271,6 +271,10 @@ class Replica:
         # may expose serve_stats() -> dict; merged under "user" so
         # autoscaler/status surfaces see domain metrics (e.g. the
         # LLM engine's slot occupancy and token counters).
+        # CONTRACT: the hook must be fast and non-blocking — stats()
+        # feeds 2s-timeout controller polls (drain/autoscale); a
+        # hook that blocks degrades them (timeouts are treated
+        # conservatively, never as idleness).
         fn = getattr(self.instance, "serve_stats", None)
         if callable(fn):
             try:
@@ -584,7 +588,11 @@ class Controller:
                 stats = ray_tpu.get(h.stats.remote(), timeout=2)
                 idle = stats["ongoing"] == 0
             except Exception:
-                idle = True
+                # Unreachable/slow stats (e.g. a user serve_stats()
+                # hook blocking) is NOT evidence of idleness — keep
+                # waiting; the 30s hard deadline below still bounds
+                # the drain.
+                idle = False
             if idle or time.time() - started > 30.0:
                 del d["draining"][rid]
                 self._kill(h)

@@ -238,3 +238,38 @@ def test_cross_lang_descriptor_registry(rt):
     cl.register_function("triple", lambda x: 3 * x)
     assert "triple" in cl.registered_functions()
     assert cl.resolve_descriptor("registry://triple")(4) == 12
+
+
+def test_dashboard_serve_endpoint(rt):
+    """/api/serve: deployment statuses + per-replica stats, including
+    the serve_stats() user-metrics hook."""
+    from ray_tpu import serve
+    from ray_tpu.dashboard import Dashboard
+
+    @serve.deployment(num_replicas=1)
+    class Hello:
+        def __call__(self, x):
+            return x + 1
+
+        def serve_stats(self):
+            return {"custom": 7}
+
+    try:
+        h = serve.run(Hello.bind())
+        assert ray_tpu.get(h.remote(1)) == 2
+        dash = Dashboard(port=0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{dash.port}/api/serve",
+                    timeout=30) as resp:
+                body = json.loads(resp.read())
+            d = body["deployments"]["Hello"]
+            assert d["status"] == "HEALTHY"
+            assert d["replica_stats"], d
+            rs = d["replica_stats"][0]
+            assert rs["total"] >= 1
+            assert rs["user"] == {"custom": 7}
+        finally:
+            dash.stop()
+    finally:
+        serve.shutdown()
