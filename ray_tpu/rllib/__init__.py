@@ -1,5 +1,6 @@
 """RL library: Algorithm/AlgorithmConfig surface with PPO/A2C (sync
-on-policy), DQN (off-policy replay), IMPALA (async actor-learner with
+on-policy), DQN (off-policy replay), SAC (continuous control, twin
+critics + auto temperature), IMPALA (async actor-learner with
 V-trace), offline BC/CQL over ray_tpu.data transition datasets, and
 multi-agent PPO (dict-keyed envs, per-policy mapping) over CPU rollout
 actors + jitted JAX learners (TPU when present).
@@ -8,7 +9,8 @@ from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithm import (Algorithm, AlgorithmConfig,
                                      register_env)
 from ray_tpu.rllib.dqn import DQN, DQNConfig
-from ray_tpu.rllib.env import CartPoleEnv, SignEnv
+from ray_tpu.rllib.env import (CartPoleEnv, PendulumEnv, ReachEnv,
+                               SignEnv)
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.multi_agent import (MultiAgentEnv, MultiAgentPPO,
                                        MultiAgentPPOConfig,
@@ -16,12 +18,14 @@ from ray_tpu.rllib.multi_agent import (MultiAgentEnv, MultiAgentPPO,
 from ray_tpu.rllib.offline import (BC, BCConfig, CQL, CQLConfig,
                                    episodes_to_dataset)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "register_env",
     "PPO", "PPOConfig", "A2C", "A2CConfig", "DQN", "DQNConfig",
-    "Impala", "ImpalaConfig", "BC", "BCConfig", "CQL", "CQLConfig",
+    "Impala", "ImpalaConfig", "SAC", "SACConfig",
+    "BC", "BCConfig", "CQL", "CQLConfig",
     "episodes_to_dataset", "MultiAgentEnv", "MultiAgentPPO",
     "MultiAgentPPOConfig", "MultiCartPole",
-    "CartPoleEnv", "SignEnv",
+    "CartPoleEnv", "PendulumEnv", "ReachEnv", "SignEnv",
 ]

@@ -34,13 +34,16 @@ def _q_net(obs_dim: int, num_actions: int, hidden: int):
 
 class ReplayBuffer:
     """Uniform FIFO replay (reference:
-    rllib/utils/replay_buffers/replay_buffer.py)."""
+    rllib/utils/replay_buffers/replay_buffer.py). Action storage is
+    parameterized so continuous learners (SAC) share this buffer:
+    scalar int32 actions by default, float vectors via action_shape."""
 
-    def __init__(self, capacity: int, obs_dim: int):
+    def __init__(self, capacity: int, obs_dim: int,
+                 action_shape: tuple = (), action_dtype=np.int32):
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros(capacity, np.int32)
+        self.actions = np.zeros((capacity,) + action_shape, action_dtype)
         self.rewards = np.zeros(capacity, np.float32)
         self.dones = np.zeros(capacity, np.bool_)
         self.size = 0

@@ -105,7 +105,100 @@ class SignEnv(Env):
         return self.obs, reward, self.t >= self.episode_len, {}
 
 
+class ContinuousEnv(Env):
+    """Continuous-action env contract: actions are float vectors of
+    shape (action_dim,) clipped to [action_low, action_high]."""
+
+    action_dim: int
+    action_low: float
+    action_high: float
+
+
+class PendulumEnv(ContinuousEnv):
+    """Classic inverted-pendulum swing-up (standard formulation used by
+    Pendulum-v1): obs = [cos th, sin th, th_dot], action = torque in
+    [-2, 2], reward = -(th^2 + 0.1 th_dot^2 + 0.001 a^2)."""
+
+    observation_dim = 3
+    num_actions = 0           # continuous: see action_dim
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def __init__(self, max_steps: int = 200):
+        self.max_speed = 8.0
+        self.dt = 0.05
+        self.g = 10.0
+        self.m = 1.0
+        self.length = 1.0
+        self.max_steps = max_steps
+        self._rng = np.random.RandomState(0)
+        self.th = 0.0
+        self.th_dot = 0.0
+        self.t = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self.th), np.sin(self.th),
+                         self.th_dot], np.float32)
+
+    def reset(self, seed=None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self.th = self._rng.uniform(-np.pi, np.pi)
+        self.th_dot = self._rng.uniform(-1.0, 1.0)
+        self.t = 0
+        return self._obs()
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          self.action_low, self.action_high))
+        th_norm = ((self.th + np.pi) % (2 * np.pi)) - np.pi
+        cost = th_norm ** 2 + 0.1 * self.th_dot ** 2 + 0.001 * u ** 2
+        self.th_dot += (3 * self.g / (2 * self.length) * np.sin(self.th)
+                        + 3.0 / (self.m * self.length ** 2) * u) * self.dt
+        self.th_dot = float(np.clip(self.th_dot, -self.max_speed,
+                                    self.max_speed))
+        self.th += self.th_dot * self.dt
+        self.t += 1
+        return self._obs(), -cost, self.t >= self.max_steps, {}
+
+
+class ReachEnv(ContinuousEnv):
+    """Trivially learnable continuous control (the SignEnv analogue for
+    off-policy continuous learners): observation is a random target in
+    [-1, 1]; reward = -(action - target)^2. Optimal policy copies the
+    observation; converges in a few hundred steps."""
+
+    observation_dim = 1
+    num_actions = 0
+    action_dim = 1
+    action_low = -1.0
+    action_high = 1.0
+
+    def __init__(self, episode_len: int = 8):
+        self.episode_len = episode_len
+        self._rng = np.random.RandomState(0)
+        self.t = 0
+        self.obs = None
+
+    def reset(self, seed=None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self.t = 0
+        self.obs = self._rng.uniform(-1, 1, size=1).astype(np.float32)
+        return self.obs
+
+    def step(self, action):
+        a = float(np.clip(np.asarray(action).reshape(-1)[0], -1.0, 1.0))
+        reward = -float((a - float(self.obs[0])) ** 2)
+        self.t += 1
+        self.obs = self._rng.uniform(-1, 1, size=1).astype(np.float32)
+        return self.obs, reward, self.t >= self.episode_len, {}
+
+
 ENV_REGISTRY = {
     "CartPole": CartPoleEnv,
     "Sign": SignEnv,
+    "Pendulum": PendulumEnv,
+    "Reach": ReachEnv,
 }

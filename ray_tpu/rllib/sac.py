@@ -1,0 +1,338 @@
+"""SAC: off-policy continuous control with twin critics and entropy
+maximization.
+
+Capability parity with the reference's SAC family
+(rllib/algorithms/sac/sac.py — replay-buffer training_step, twin
+soft-Q critics with polyak-averaged targets, tanh-squashed Gaussian
+policy, automatic temperature tuning against a target entropy of
+-action_dim). The learner is one jitted update (critics + actor +
+alpha in a single compiled step, TPU when present); rollout workers
+are CPU actors sampling from the current stochastic policy.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.dqn import ReplayBuffer
+from ray_tpu.rllib.env import ENV_REGISTRY
+
+_LOG_STD_MIN, _LOG_STD_MAX = -10.0, 2.0
+
+
+def _policy_net(action_dim: int, hidden: int):
+    import flax.linen as nn
+
+    class PolicyNet(nn.Module):
+        @nn.compact
+        def __call__(self, obs):
+            h = nn.relu(nn.Dense(hidden)(obs))
+            h = nn.relu(nn.Dense(hidden)(h))
+            mu = nn.Dense(action_dim)(h)
+            log_std = nn.Dense(action_dim)(h)
+            return mu, log_std.clip(_LOG_STD_MIN, _LOG_STD_MAX)
+
+    return PolicyNet()
+
+
+def _twin_q_net(hidden: int):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class TwinQ(nn.Module):
+        @nn.compact
+        def __call__(self, obs, action):
+            x = jnp.concatenate([obs, action], axis=-1)
+            qs = []
+            for _ in range(2):
+                h = nn.relu(nn.Dense(hidden)(x))
+                h = nn.relu(nn.Dense(hidden)(h))
+                qs.append(nn.Dense(1)(h)[..., 0])
+            return qs[0], qs[1]
+
+    return TwinQ()
+
+
+def _squash(mu, log_std, eps, scale, center):
+    """Tanh-squashed Gaussian sample and its log-prob (with the tanh
+    change-of-variables correction), affinely mapped to the action
+    range: a = center + tanh(pre) * scale."""
+    import jax
+    import jax.numpy as jnp
+
+    std = jnp.exp(log_std)
+    pre = mu + std * eps
+    # Gaussian log-prob of the pre-squash sample.
+    logp = (-0.5 * (eps ** 2) - log_std -
+            0.5 * jnp.log(2 * jnp.pi)).sum(axis=-1)
+    # log|d a/d pre| = log(1 - tanh(pre)^2) + log(scale), with the tanh
+    # term in its stable form 2*(log 2 - pre - softplus(-2*pre)).
+    logp -= (2 * (jnp.log(2.0) - pre - jax.nn.softplus(-2 * pre)) +
+             jnp.log(scale)).sum(axis=-1)
+    return jnp.tanh(pre) * scale + center, logp
+
+
+class SACRolloutWorker:
+    """CPU actor: samples from the current tanh-Gaussian policy."""
+
+    def __init__(self, env_name: str, hidden: int, seed: int):
+        self.env = ENV_REGISTRY[env_name]()
+        self.obs = self.env.reset(seed=seed)
+        self._rng = np.random.RandomState(seed)
+        self._params = None
+        self._model = _policy_net(self.env.action_dim, hidden)
+        self._apply = None
+        self._episode_reward = 0.0
+        self.completed_rewards: List[float] = []
+
+    def set_weights(self, params):
+        self._params = params
+
+    def sample(self, num_steps: int, random_actions: bool
+               ) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        if self._apply is None:
+            self._apply = jax.jit(self._model.apply)
+        env = self.env
+        scale = (env.action_high - env.action_low) / 2.0
+        center = (env.action_high + env.action_low) / 2.0
+        obs_b, nobs_b, act_b, rew_b, done_b = [], [], [], [], []
+        for _ in range(num_steps):
+            if random_actions:
+                action = self._rng.uniform(
+                    env.action_low, env.action_high,
+                    size=env.action_dim).astype(np.float32)
+            else:
+                mu, log_std = self._apply(
+                    self._params, jnp.asarray(self.obs[None]))
+                mu = np.asarray(mu[0])
+                std = np.exp(np.asarray(log_std[0]))
+                pre = mu + std * self._rng.randn(env.action_dim)
+                action = (np.tanh(pre) * scale + center).astype(
+                    np.float32)
+            next_obs, reward, done, _ = env.step(action)
+            obs_b.append(self.obs)
+            nobs_b.append(next_obs)
+            act_b.append(action)
+            rew_b.append(reward)
+            done_b.append(done)
+            self._episode_reward += reward
+            if done:
+                self.completed_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self.obs = env.reset()
+            else:
+                self.obs = next_obs
+        return {"obs": np.asarray(obs_b, np.float32),
+                "next_obs": np.asarray(nobs_b, np.float32),
+                "actions": np.asarray(act_b, np.float32),
+                "rewards": np.asarray(rew_b, np.float32),
+                "dones": np.asarray(done_b, np.bool_)}
+
+    def episode_rewards(self) -> List[float]:
+        return list(self.completed_rewards[-100:])
+
+
+class SACConfig(AlgorithmConfig):
+    def _defaults(self) -> Dict[str, Any]:
+        return {
+            "replay_buffer_capacity": 50_000,
+            "learning_starts": 256,
+            "train_batch_size": 128,
+            "num_sgd_iter_per_step": 16,
+            "tau": 0.005,                 # polyak target-critic rate
+            "initial_alpha": 0.1,
+            "auto_alpha": True,           # tune temperature to -action_dim
+            "rollout_fragment_length": 128,
+        }
+
+    def algo_class(self):
+        return SAC
+
+
+class SAC(Algorithm):
+    def _setup(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        env = ENV_REGISTRY[cfg.env]()
+        if getattr(env, "action_dim", 0) <= 0:
+            raise ValueError(
+                f"SAC needs a continuous-action env; {cfg.env!r} is "
+                "discrete (use DQN/PPO, or a ContinuousEnv)")
+        self._obs_dim = env.observation_dim
+        self._action_dim = env.action_dim
+        self._scale = float(env.action_high - env.action_low) / 2.0
+        self._center = float(env.action_high + env.action_low) / 2.0
+        self._policy = _policy_net(self._action_dim, cfg.hidden_size)
+        self._critic = _twin_q_net(cfg.hidden_size)
+        k0, k1, key = jax.random.split(jax.random.PRNGKey(cfg.seed), 3)
+        zo = jnp.zeros((1, self._obs_dim), jnp.float32)
+        za = jnp.zeros((1, self._action_dim), jnp.float32)
+        self._state = {
+            "pi": self._policy.init(k0, zo),
+            "q": self._critic.init(k1, zo, za),
+            "q_target": None,
+            "log_alpha": jnp.asarray(
+                np.log(cfg.initial_alpha), jnp.float32),
+        }
+        self._state["q_target"] = self._state["q"]
+        self._opt = optax.adam(cfg.lr)
+        self._opt_state = {
+            "pi": self._opt.init(self._state["pi"]),
+            "q": self._opt.init(self._state["q"]),
+            "alpha": self._opt.init(self._state["log_alpha"]),
+        }
+        self._key = key
+        self._rng = np.random.RandomState(cfg.seed)
+        self._buffer = ReplayBuffer(
+            cfg.replay_buffer_capacity, self._obs_dim,
+            action_shape=(self._action_dim,), action_dtype=np.float32)
+        worker_cls = ray_tpu.remote(num_cpus=1)(SACRolloutWorker)
+        self._workers = [
+            worker_cls.remote(cfg.env, cfg.hidden_size, cfg.seed + i)
+            for i in range(cfg.num_rollout_workers)]
+        self._sync_weights()
+        self._update = self._build_update()
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        policy, critic = self._policy, self._critic
+        gamma, tau = cfg.gamma, cfg.tau
+        scale, center = self._scale, self._center
+        target_entropy = -float(self._action_dim)
+        auto_alpha = cfg.auto_alpha
+        opt = self._opt
+
+        def critic_loss(q_params, state, batch, key):
+            mu, log_std = policy.apply(state["pi"], batch["next_obs"])
+            eps = jax.random.normal(key, mu.shape)
+            next_a, next_logp = _squash(mu, log_std, eps, scale, center)
+            tq1, tq2 = critic.apply(state["q_target"],
+                                    batch["next_obs"], next_a)
+            alpha = jnp.exp(state["log_alpha"])
+            next_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target = batch["rewards"] + gamma * next_v * \
+                (1.0 - batch["dones"].astype(jnp.float32))
+            target = jax.lax.stop_gradient(target)
+            q1, q2 = critic.apply(q_params, batch["obs"],
+                                  batch["actions"])
+            return jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+
+        def actor_loss(pi_params, state, batch, key):
+            mu, log_std = policy.apply(pi_params, batch["obs"])
+            eps = jax.random.normal(key, mu.shape)
+            a, logp = _squash(mu, log_std, eps, scale, center)
+            q1, q2 = critic.apply(state["q"], batch["obs"], a)
+            alpha = jax.lax.stop_gradient(jnp.exp(state["log_alpha"]))
+            return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+        def alpha_loss(log_alpha, logp):
+            ent_gap = jax.lax.stop_gradient(logp + target_entropy)
+            return -jnp.mean(log_alpha * ent_gap)
+
+        @jax.jit
+        def update(state, opt_state, batch, key):
+            kc, ka = jax.random.split(key)
+            closs, q_grads = jax.value_and_grad(critic_loss)(
+                state["q"], state, batch, kc)
+            upd, opt_state_q = opt.update(
+                q_grads, opt_state["q"], state["q"])
+            state = dict(state, q=optax.apply_updates(state["q"], upd))
+            (aloss, logp), pi_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(state["pi"], state, batch, ka)
+            upd, opt_state_pi = opt.update(
+                pi_grads, opt_state["pi"], state["pi"])
+            state = dict(state,
+                         pi=optax.apply_updates(state["pi"], upd))
+            opt_state = dict(opt_state, q=opt_state_q, pi=opt_state_pi)
+            if auto_alpha:
+                al_grad = jax.grad(alpha_loss)(state["log_alpha"], logp)
+                upd, opt_state_a = opt.update(
+                    al_grad, opt_state["alpha"], state["log_alpha"])
+                state = dict(state, log_alpha=optax.apply_updates(
+                    state["log_alpha"], upd))
+                opt_state = dict(opt_state, alpha=opt_state_a)
+            state = dict(state, q_target=jax.tree_util.tree_map(
+                lambda t, q: (1 - tau) * t + tau * q,
+                state["q_target"], state["q"]))
+            return state, opt_state, closs, aloss
+
+        return update
+
+    def _sync_weights(self):
+        import jax
+        host = jax.device_get(self._state["pi"])
+        ray_tpu.get([w.set_weights.remote(host) for w in self._workers])
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        t0 = time.time()
+        warmup = self._buffer.size < cfg.learning_starts
+        batches = ray_tpu.get([
+            w.sample.remote(cfg.rollout_fragment_length, warmup)
+            for w in self._workers])
+        for b in batches:
+            self._buffer.add_batch(b)
+        steps = sum(len(b["actions"]) for b in batches)
+        closses, alosses = [], []
+        if self._buffer.size >= cfg.learning_starts:
+            for _ in range(cfg.num_sgd_iter_per_step):
+                mb = self._buffer.sample(cfg.train_batch_size, self._rng)
+                mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                self._key, sub = jax.random.split(self._key)
+                self._state, self._opt_state, closs, aloss = \
+                    self._update(self._state, self._opt_state, mb, sub)
+                closses.append(float(closs))
+                alosses.append(float(aloss))
+            self._sync_weights()
+        rewards: List[float] = []
+        for w in self._workers:
+            rewards.extend(ray_tpu.get(w.episode_rewards.remote()))
+        return {
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else float("nan"),
+            "num_env_steps_sampled": steps,
+            "buffer_size": self._buffer.size,
+            "alpha": float(np.exp(float(self._state["log_alpha"]))),
+            "critic_loss": float(np.mean(closses)) if closses else None,
+            "actor_loss": float(np.mean(alosses)) if alosses else None,
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+        return {"state": jax.device_get(self._state)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+        s = state["state"]
+        s["log_alpha"] = jnp.asarray(s["log_alpha"])
+        self._state = s
+        self._opt_state = {
+            "pi": self._opt.init(self._state["pi"]),
+            "q": self._opt.init(self._state["q"]),
+            "alpha": self._opt.init(self._state["log_alpha"]),
+        }
+        self._sync_weights()
+
+    def stop(self):
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
