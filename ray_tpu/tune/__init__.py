@@ -1,6 +1,7 @@
 from ray_tpu.tune.search import (choice, grid_search, loguniform, qrandint,
                                  randint, uniform, BasicVariantGenerator,
-                                 BOHBSearcher, Searcher, SearcherAdapter,
+                                 BOHBSearcher, ConcurrencyLimiter,
+                                 Repeater, Searcher, SearcherAdapter,
                                  TPESearcher)
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
                                      HyperBandScheduler,
@@ -14,6 +15,7 @@ __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "qrandint", "BasicVariantGenerator", "TPESearcher",
     "BOHBSearcher", "Searcher", "SearcherAdapter",
+    "ConcurrencyLimiter", "Repeater",
     "FIFOScheduler", "AsyncHyperBandScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
 ]

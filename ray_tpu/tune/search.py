@@ -109,6 +109,19 @@ class Searcher:
                           error: bool = False):
         pass
 
+    def is_finished(self) -> bool:
+        """Whether None from suggest() means exhausted (True, the
+        default) or mere backpressure (ConcurrencyLimiter returns
+        False while slots are full). The trial runner keeps polling a
+        not-finished searcher as slots free up."""
+        return True
+
+    def release(self, trial_id: Optional[str]) -> None:
+        """Called by the trial runner when the trial for a suggest id
+        reaches a terminal state (on EVERY terminal path: completion,
+        error exhaustion, scheduler stop, time budget). Wrappers use
+        it to free capacity / close repeat groups."""
+
     def set_search_properties(self, metric: Optional[str],
                               mode: Optional[str],
                               config: Optional[Dict[str, Any]] = None
@@ -404,3 +417,145 @@ class BOHBSearcher(TPESearcher):
         # observations for this one suggestion.
         self._observed = self._by_budget[b]
         return self._suggest_tpe()
+
+
+def _forward_observe(searcher, config: Dict[str, Any], value: float,
+                     budget: Optional[int] = None):
+    """Forward an observation to a wrapped searcher, passing budget
+    through only when its observe() accepts one (BOHB's multi-fidelity
+    model needs it; TPE's does not)."""
+    fwd = getattr(searcher, "observe", None)
+    if fwd is None:
+        return
+    if budget is not None:
+        import inspect
+        try:
+            if "budget" in inspect.signature(fwd).parameters:
+                fwd(dict(config), value, budget=budget)
+                return
+        except (TypeError, ValueError):
+            pass
+    fwd(dict(config), value)
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap in-flight suggestions from a wrapped searcher (reference:
+    tune/search/concurrency_limiter.py). suggest() returns None while
+    the cap is reached — backpressure, not exhaustion; the trial
+    runner distinguishes the two via is_finished()."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = int(max_concurrent)
+        self._live: set = set()
+        self._finished = False
+        self.metric = getattr(searcher, "metric", None)
+        self.mode = getattr(searcher, "mode", "min")
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._finished or len(self._live) >= self.max_concurrent:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is None:
+            self._finished = True
+            return None
+        self._live.add(trial_id)
+        return cfg
+
+    def is_finished(self) -> bool:
+        return self._finished
+
+    def release(self, trial_id: Optional[str]):
+        self._live.discard(trial_id)
+        inner = getattr(self.searcher, "release", None)
+        if inner is not None:
+            inner(trial_id)
+
+    def observe(self, config: Dict[str, Any], value: float,
+                budget: Optional[int] = None):
+        _forward_observe(self.searcher, config, value, budget)
+
+    def set_search_properties(self, metric, mode, config=None) -> bool:
+        ok = self.searcher.set_search_properties(metric, mode, config)
+        self.metric = getattr(self.searcher, "metric", metric)
+        self.mode = getattr(self.searcher, "mode", mode or "min")
+        return ok
+
+
+class Repeater(Searcher):
+    """Evaluate each suggested config `repeat` times and feed the MEAN
+    objective back to the wrapped searcher (reference:
+    tune/search/repeater.py — de-noises stochastic objectives so the
+    model doesn't chase seed luck).
+
+    Group accounting rides release(): the runner releases every trial
+    on every terminal path, so a repeat that errors without reporting
+    still closes its slot and the group flushes with the values that
+    did arrive. (Limitation: a scheduler that REWRITES trial.config
+    mid-flight, e.g. a PBT exploit, makes that repeat's observation
+    land outside its group; the group still flushes on release with
+    the remaining repeats.)"""
+
+    def __init__(self, searcher: Searcher, repeat: int = 3):
+        self.searcher = searcher
+        self.repeat = int(repeat)
+        self._current: Optional[Dict[str, Any]] = None
+        self._handed_out = 0
+        self._finished = False
+        self._pending: Dict[str, List[float]] = {}
+        self._budgets: Dict[str, int] = {}
+        self._done_counts: Dict[str, int] = {}
+        self._sid2key: Dict[str, str] = {}
+        self.metric = getattr(searcher, "metric", None)
+        self.mode = getattr(searcher, "mode", "min")
+
+    @staticmethod
+    def _key(config: Dict[str, Any]) -> str:
+        import json
+        return json.dumps(config, sort_keys=True, default=str)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._finished:
+            return None
+        if self._current is None or self._handed_out >= self.repeat:
+            cfg = self.searcher.suggest(trial_id)
+            if cfg is None:
+                self._finished = True
+                return None
+            self._current, self._handed_out = cfg, 0
+            self._pending.setdefault(self._key(cfg), [])
+        self._handed_out += 1
+        self._sid2key[trial_id] = self._key(self._current)
+        return dict(self._current)
+
+    def is_finished(self) -> bool:
+        return self._finished
+
+    def observe(self, config: Dict[str, Any], value: float,
+                budget: Optional[int] = None):
+        k = self._key(config)
+        self._pending.setdefault(k, []).append(float(value))
+        if budget is not None:
+            self._budgets[k] = max(self._budgets.get(k, 0),
+                                   int(budget))
+
+    def release(self, trial_id: Optional[str]):
+        k = self._sid2key.pop(trial_id, None)
+        if k is None:
+            return
+        self._done_counts[k] = self._done_counts.get(k, 0) + 1
+        if self._done_counts[k] < self.repeat:
+            return
+        del self._done_counts[k]
+        vals = self._pending.pop(k, [])
+        budget = self._budgets.pop(k, None)
+        if vals:
+            import json
+            _forward_observe(self.searcher, json.loads(k),
+                             sum(vals) / len(vals), budget)
+
+    def set_search_properties(self, metric, mode, config=None) -> bool:
+        ok = self.searcher.set_search_properties(metric, mode, config)
+        self.metric = getattr(self.searcher, "metric", metric)
+        self.mode = getattr(self.searcher, "mode", mode or "min")
+        return ok

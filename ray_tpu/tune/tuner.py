@@ -133,6 +133,22 @@ class Tuner:
         # A restored experiment re-runs its unfinished trials; the
         # search budget was already spent in the original run.
         searcher_done = bool(self._restored_trials)
+        suggest_seq = 0
+        suggest_ids: Dict[str, str] = {}   # trial_id -> suggest id
+        finished_ids: set = set()
+
+        def finish(trial: Trial):
+            """Searcher bookkeeping for EVERY terminal path (normal
+            completion, error exhaustion, scheduler stop, time
+            budget): feed the observation, then release the suggest
+            slot (ConcurrencyLimiter capacity / Repeater groups)."""
+            if trial.trial_id in finished_ids:
+                return
+            finished_ids.add(trial.trial_id)
+            self._observe(searcher, trial, tc)
+            release = getattr(searcher, "release", None)
+            if release is not None:
+                release(suggest_ids.get(trial.trial_id))
 
         start_time = time.time()
         while True:
@@ -145,11 +161,18 @@ class Tuner:
             while not searcher_done and \
                     len(running) + len(pending) < \
                     tc.max_concurrent_trials:
-                cfg = searcher.suggest(f"t{len(trials)}")
+                sid = f"t{suggest_seq}"
+                cfg = searcher.suggest(sid)
                 if cfg is None:
-                    searcher_done = True
+                    # None means exhausted UNLESS the searcher reports
+                    # it is merely backpressured (ConcurrencyLimiter).
+                    fin = getattr(searcher, "is_finished", None)
+                    if fin is None or fin():
+                        searcher_done = True
                     break
+                suggest_seq += 1
                 t = Trial(config=cfg)
+                suggest_ids[t.trial_id] = sid
                 trials.append(t)
                 pending.append(t)
             # Launch up to the concurrency cap.
@@ -177,6 +200,7 @@ class Tuner:
                                                    trials)
                     if decision == STOP:
                         self._stop_trial(trial, STOPPED)
+                        finish(trial)
                         break
                 if trial.state != RUNNING:
                     continue
@@ -204,7 +228,7 @@ class Tuner:
                     else:
                         self._stop_trial(trial, TERMINATED)
                         scheduler.on_trial_complete(trial, trials)
-                    self._observe(searcher, trial, tc)
+                    finish(trial)
                     self._save_experiment_state(trials)
 
             if tc.time_budget_s is not None and \
@@ -212,6 +236,7 @@ class Tuner:
                 for t in trials:
                     if not t.finished:
                         self._stop_trial(t, STOPPED)
+                        finish(t)
                 break
             if not made_progress:
                 time.sleep(0.01)

@@ -124,3 +124,105 @@ def test_bohb_beats_random(rt):
                                      max_t=6))
     assert bohb_best <= random_best, (bohb_best, random_best)
     assert bohb_best < 0.15, bohb_best    # actually near the optimum
+
+def test_concurrency_limiter_caps_inflight(rt):
+    """The limiter never has more than max_concurrent live trials, and
+    the whole search budget still completes (None under backpressure
+    must not be read as exhaustion)."""
+    from ray_tpu.air import RunConfig
+    from ray_tpu.tune import (BasicVariantGenerator, ConcurrencyLimiter,
+                              TuneConfig, Tuner, uniform)
+
+    class _Spy(BasicVariantGenerator):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.live = 0
+            self.max_live = 0
+
+        def suggest(self, trial_id):
+            cfg = super().suggest(trial_id)
+            if cfg is not None:
+                self.live += 1
+                self.max_live = max(self.max_live, self.live)
+            return cfg
+
+    inner = _Spy({"x": uniform(-1, 1), "y": uniform(-1, 1)},
+                 num_samples=6, seed=0)
+    limiter = ConcurrencyLimiter(inner, max_concurrent=1)
+    orig_release = limiter.release
+
+    def release(tid):
+        inner.live -= 1
+        orig_release(tid)
+    limiter.release = release
+    grid = Tuner(
+        _toy,
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               search_alg=limiter,
+                               max_concurrent_trials=4),
+        run_config=RunConfig(),
+    ).fit()
+    # All 6 ran even though the limiter said None repeatedly...
+    assert len(grid.trials) == 6
+    assert all(t.last_result is not None for t in grid.trials)
+    # ...but never more than one at a time was live.
+    assert inner.max_live == 1
+
+
+def test_repeater_averages_into_inner(rt):
+    """Each config runs `repeat` times; the inner searcher sees ONE
+    observation per config, the mean of its repeats."""
+    from ray_tpu.air import RunConfig
+    from ray_tpu.tune import (Repeater, TPESearcher, TuneConfig, Tuner,
+                              uniform)
+
+    space = {"x": uniform(-1, 1), "y": uniform(-1, 1)}
+    inner = TPESearcher(space, metric="loss", mode="min",
+                        num_samples=3, seed=0)
+    seen = []
+    inner.observe = lambda cfg, v: seen.append((cfg, v))
+    rep = Repeater(inner, repeat=2)
+    grid = Tuner(
+        _toy,
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               search_alg=rep,
+                               max_concurrent_trials=2),
+        run_config=RunConfig(),
+    ).fit()
+    assert len(grid.trials) == 6            # 3 configs x 2 repeats
+    assert len(seen) == 3                   # one mean per config
+    # The mean actually is the mean of the repeats of that config.
+    by_cfg = {}
+    for t in grid.trials:
+        key = (round(t.config["x"], 6), round(t.config["y"], 6))
+        by_cfg.setdefault(key, []).append(
+            min(r["loss"] for r in t.results))
+    for cfg, v in seen:
+        key = (round(cfg["x"], 6), round(cfg["y"], 6))
+        vals = by_cfg[key]
+        assert abs(v - sum(vals) / len(vals)) < 1e-9
+
+
+def test_limiter_releases_on_scheduler_stop(rt):
+    """Regression: a scheduler-stopped trial must release its limiter
+    slot, or a max_concurrent=1 search wedges after the first stop."""
+    from ray_tpu.air import RunConfig
+    from ray_tpu.tune import (BasicVariantGenerator, ConcurrencyLimiter,
+                              FIFOScheduler, TuneConfig, Tuner, uniform)
+
+    class _StopEverything(FIFOScheduler):
+        def on_result(self, trial, result, trials):
+            return "STOP"
+
+    limiter = ConcurrencyLimiter(
+        BasicVariantGenerator({"x": uniform(-1, 1)}, num_samples=3,
+                              seed=0),
+        max_concurrent=1)
+    tc = TuneConfig(metric="loss", mode="min", search_alg=limiter,
+                    max_concurrent_trials=4)
+    tc.scheduler = _StopEverything("loss", "min")
+    grid = Tuner(_toy, tune_config=tc,
+                 run_config=RunConfig()).fit()
+    # every config in the budget ran despite each being stopped early
+    assert len(grid.trials) == 3
+    assert not limiter._live
