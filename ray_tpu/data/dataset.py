@@ -96,6 +96,26 @@ def _block_sum(block: Block, key):
 
 
 @ray_tpu.remote(num_cpus=0.25)
+def _block_moments(block: Block, key):
+    """(count, mean, M2) partials for std() — centered second moment
+    per block avoids catastrophic cancellation at large means."""
+    getter = _key_getter(key)
+    vals = np.asarray([float(getter(r)) for r in block], np.float64)
+    if vals.size == 0:
+        return (0, 0.0, 0.0)
+    mean = float(vals.mean())
+    return (int(vals.size), mean, float(((vals - mean) ** 2).sum()))
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _sample_block(block: Block, fraction: float, seed: int,
+                  block_idx: int) -> Block:
+    rng = np.random.RandomState((seed + block_idx) & 0x7FFFFFFF)
+    keep = rng.random_sample(len(block)) < fraction
+    return [r for r, k in zip(block, keep) if k]
+
+
+@ray_tpu.remote(num_cpus=0.25)
 def _truncate_block(block: Block, k: int) -> Block:
     return block[:k]
 
@@ -599,10 +619,14 @@ class Dataset:
         shuffle, then applies the final per-partition permutation."""
         ds = self.materialize()
         n = max(1, len(ds._block_refs))
+        if seed is None:
+            # An unseeded shuffle must actually vary call-to-call.
+            import os
+            seed = int.from_bytes(os.urandom(4), "little")
         if strategy == "push" or (
                 strategy == "auto" and n > PUSH_SHUFFLE_THRESHOLD):
             return ds._random_shuffle_push(seed, n)
-        base = seed if seed is not None else 0
+        base = seed
         splitter = _random_split.options(num_returns=n)
         all_parts = [splitter.remote(b, base + i, n)
                      for i, b in enumerate(ds._block_refs)]
@@ -666,6 +690,95 @@ class Dataset:
         return [Dataset([_concat_parts.remote(
                     *[parts[j] for parts in all_parts])])
                 for j in range(n)]
+
+    def train_test_split(self, test_size: Union[int, float], *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        """(train, test) split by global row cut (reference:
+        Dataset.train_test_split). Same map/reduce slice graph as
+        split() — rows never visit the driver."""
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        ds, lens = ds._block_lengths()
+        total = sum(lens)
+        n_test = int(total * test_size) if isinstance(test_size, float) \
+            else int(test_size)
+        if not 0 <= n_test <= total:
+            raise ValueError(
+                f"test_size {test_size} out of range for {total} rows")
+        cuts = [(0, total - n_test), (total - n_test, total)]
+        all_parts = _shuffle_slices(ds._block_refs, lens, cuts)
+        return tuple(
+            Dataset([_concat_parts.remote(
+                *[parts[j] for parts in all_parts])])
+            for j in range(2))
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: Dataset.random_sample),
+        one task per block with a per-block-index derived seed so
+        blocks draw independent sequences."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0,1]: {fraction}")
+        import os
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
+        ds = self.materialize()
+        return Dataset([_sample_block.remote(b, fraction, seed, i)
+                        for i, b in enumerate(ds._block_refs)])
+
+    def std(self, key: Optional[Union[str, Callable]] = None,
+            ddof: int = 1) -> float:
+        """Sample standard deviation via per-block (count, mean, M2)
+        partials merged with Chan's pairwise update — no
+        sum-of-squares cancellation (reference: Dataset.std)."""
+        ds = self.materialize()
+        parts = ray_tpu.get([_block_moments.remote(b, key)
+                             for b in ds._block_refs])
+        n, mean, m2 = 0, 0.0, 0.0
+        for bn, bmean, bm2 in parts:
+            if bn == 0:
+                continue
+            delta = bmean - mean
+            tot = n + bn
+            mean += delta * bn / tot
+            m2 += bm2 + delta * delta * n * bn / tot
+            n = tot
+        if n - ddof <= 0:
+            return float("nan")
+        return float((m2 / (n - ddof)) ** 0.5)
+
+    # --- column ops over record rows --------------------------------------
+
+    def add_column(self, name: str,
+                   fn: Callable[[Dict[str, Any]], Any]) -> "Dataset":
+        """Reference: Dataset.add_column — derive a new field per row."""
+        def add(row):
+            out = dict(row)
+            out[name] = fn(row)
+            return out
+        return self.map(add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        drop = set(cols)
+        return self.map(lambda row: {k: v for k, v in row.items()
+                                     if k not in drop})
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        keep = list(cols)
+        return self.map(lambda row: {k: row[k] for k in keep})
+
+    def to_random_access(self, key: Union[str, Callable], *,
+                         num_workers: int = 2):
+        """Serve this dataset as a key->row store: sorted by ``key``,
+        pinned across accessor actors, O(log n) routed lookups
+        (reference: Dataset.to_random_access_dataset ->
+        random_access_dataset.py)."""
+        from ray_tpu.data.random_access import RandomAccessDataset
+        sorted_ds = self.sort(key).materialize()
+        return RandomAccessDataset(sorted_ds, key,
+                                   num_workers=num_workers,
+                                   _sorted=True)
 
     def window(self, *, blocks_per_window: int = 2):
         """Streaming windows (reference: Dataset.window ->

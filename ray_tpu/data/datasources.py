@@ -247,42 +247,9 @@ def write_numpy(ds: Dataset, path: str,
     return _write(ds, path, "numpy", column)
 
 
-class RandomAccessDataset:
-    """O(log n) point lookups on a sorted-by-key dataset (reference:
-    python/ray/data/random_access_dataset.py — sorted blocks + binary
-    search within the owning block)."""
-
-    def __init__(self, ds: Dataset, key: str):
-        from ray_tpu.data.dataset import _sample_keys
-        self._key = key
-        # distributed sample-sort: rows never visit the driver; only
-        # each block's first key (the bound) does
-        sorted_ds = ds.sort(key)
-        self._blocks: List[ray_tpu.ObjectRef] = []
-        self._bounds: List[Any] = []   # first key of each block
-        firsts = ray_tpu.get(
-            [_sample_keys.remote(b, key, 1)
-             for b in sorted_ds._block_refs])
-        for b, f in zip(sorted_ds._block_refs, firsts):
-            if f:                      # skip empty blocks
-                self._blocks.append(b)
-                self._bounds.append(f[0])
-
-    def get(self, key_value: Any) -> Optional[Dict[str, Any]]:
-        import bisect
-        if not self._blocks:
-            return None
-        i = bisect.bisect_right(self._bounds, key_value) - 1
-        if i < 0:
-            return None
-        block = ray_tpu.get(self._blocks[i])
-        lo = bisect.bisect_left([r[self._key] for r in block], key_value)
-        if lo < len(block) and block[lo][self._key] == key_value:
-            return block[lo]
-        return None
-
-    def multiget(self, keys: List[Any]) -> List[Optional[Dict[str, Any]]]:
-        return [self.get(k) for k in keys]
+# Actor-served key->row store; canonical home is
+# ray_tpu/data/random_access.py (re-exported here for back-compat).
+from ray_tpu.data.random_access import RandomAccessDataset  # noqa: E402,F401
 
 
 def from_torch(dataset, parallelism: int = 8) -> Dataset:

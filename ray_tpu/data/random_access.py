@@ -1,0 +1,174 @@
+"""Random-access (key -> row) serving over a sorted Dataset.
+
+Capability parity with the reference's RandomAccessDataset
+(python/ray/data/random_access_dataset.py: sort by key, pin the
+sorted blocks in a pool of actors, route each lookup to the actor
+holding the covering block via binary search over block boundaries).
+Same shape here: the dataset is sample-sorted once, each accessor
+actor pins a contiguous slice of the sorted blocks in memory, and
+the driver-side handle binary-searches per-block key ranges to route
+gets; multiget batches per actor so a fan-out of keys costs one
+actor call per touched actor, not one per key.
+"""
+from __future__ import annotations
+
+import bisect
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+
+
+def _key_fn(key: Union[str, Callable]) -> Callable[[Any], Any]:
+    if callable(key):
+        return key
+    return lambda row: row[key]
+
+
+class _RandomAccessWorker:
+    """Pins sorted blocks in process memory; serves bisect lookups."""
+
+    def __init__(self, key: Union[str, Callable]):
+        self._key = _key_fn(key)
+        self._blocks: Dict[int, List[Any]] = {}
+        self._keys: Dict[int, List[Any]] = {}
+        self.num_gets = 0
+
+    def load(self, block_idx: int, block: List[Any]):
+        """Pin a block; returns (row_count, first_key) so the build
+        needs no second pass over the rows for routing bounds."""
+        rows = list(block)
+        self._blocks[block_idx] = rows
+        keys = [self._key(r) for r in rows]
+        self._keys[block_idx] = keys
+        return len(rows), (keys[0] if keys else None)
+
+    def get(self, block_idx: int, k: Any) -> Optional[Any]:
+        self.num_gets += 1
+        keys = self._keys.get(block_idx)
+        if not keys:
+            return None
+        i = bisect.bisect_left(keys, k)
+        if i < len(keys) and keys[i] == k:
+            return self._blocks[block_idx][i]
+        return None
+
+    def multiget(self, block_idxs: List[int],
+                 ks: List[Any]) -> List[Optional[Any]]:
+        return [self.get(b, k) for b, k in zip(block_idxs, ks)]
+
+    def stats(self) -> Dict[str, Any]:
+        return {"num_blocks": len(self._blocks),
+                "num_rows": sum(len(b) for b in self._blocks.values()),
+                "num_gets": self.num_gets}
+
+
+class RandomAccessDataset:
+    """Handle returned by Dataset.to_random_access() (also directly
+    constructible from an unsorted dataset, which it sorts first)."""
+
+    def __init__(self, ds, key: Union[str, Callable],
+                 num_workers: int = 2, _sorted: bool = False):
+        sorted_ds = ds if _sorted else ds.sort(key).materialize()
+        blocks = sorted_ds._block_refs
+        worker_cls = ray_tpu.remote(num_cpus=0.25)(_RandomAccessWorker)
+        self._workers = [worker_cls.remote(key)
+                         for _ in range(max(1, num_workers))]
+        # Contiguous block slices per worker keep each actor's pinned
+        # range compact (one actor per lookup, like the reference's
+        # block->actor assignment).
+        self._owner: List[int] = []
+        loads = []
+        for i, ref in enumerate(blocks):
+            w = min(i * len(self._workers) // max(1, len(blocks)),
+                    len(self._workers) - 1)
+            self._owner.append(w)
+            loads.append(self._workers[w].load.remote(i, ref))
+        loaded = ray_tpu.get(loads)
+        # Routing mins come straight from the load pass (blocks are
+        # already sorted, so each block's first key is its lower bound).
+        self._mins: List[Any] = []
+        self._blocks_with_rows: List[int] = []
+        for i, (n, first) in enumerate(loaded):
+            if n:
+                self._blocks_with_rows.append(i)
+                self._mins.append(first)
+        self._num_rows = sum(n for n, _ in loaded)
+
+    def _route(self, k: Any) -> List[int]:
+        """Candidate block indices for key k: the covering block, plus
+        the next one (duplicate runs of k may spill over a boundary
+        whose min equals k)."""
+        if not self._mins:
+            return []
+        j = bisect.bisect_right(self._mins, k) - 1
+        out = []
+        if j >= 0:
+            out.append(self._blocks_with_rows[j])
+        if j + 1 < len(self._mins) and self._mins[j + 1] == k:
+            out.append(self._blocks_with_rows[j + 1])
+        return out
+
+    def get(self, k: Any) -> Optional[Any]:
+        """Blocking point lookup."""
+        return ray_tpu.get(self.get_async(k))
+
+    def get_async(self, k: Any):
+        """ObjectRef to the row with key k (None if absent)."""
+        cands = self._route(k)
+        if not cands:
+            return ray_tpu.put(None)
+        b = cands[0]
+        ref = self._workers[self._owner[b]].get.remote(b, k)
+        if len(cands) == 1:
+            return ref
+        return _first_hit.remote(
+            ref, self._workers[self._owner[cands[1]]].get.remote(
+                cands[1], k))
+
+    def multiget(self, ks: List[Any]) -> List[Optional[Any]]:
+        """Batched lookup: one actor call per touched actor."""
+        per_worker: Dict[int, List[int]] = {}
+        routed: List[Optional[tuple]] = []
+        for i, k in enumerate(ks):
+            cands = self._route(k)
+            if not cands:
+                routed.append(None)
+                continue
+            w = self._owner[cands[0]]
+            per_worker.setdefault(w, [])
+            per_worker[w].append(i)
+            routed.append((w, cands))
+        calls = {}
+        for w, idxs in per_worker.items():
+            calls[w] = self._workers[w].multiget.remote(
+                [routed[i][1][0] for i in idxs],
+                [ks[i] for i in idxs])
+        results: List[Optional[Any]] = [None] * len(ks)
+        for w, idxs in per_worker.items():
+            vals = ray_tpu.get(calls[w])
+            for i, v in zip(idxs, vals):
+                results[i] = v
+        # Boundary-straddling duplicates: retry misses on the spillover
+        # block (rare; one extra call per miss).
+        for i, k in enumerate(ks):
+            if results[i] is None and routed[i] is not None and \
+                    len(routed[i][1]) > 1:
+                b = routed[i][1][1]
+                results[i] = ray_tpu.get(
+                    self._workers[self._owner[b]].get.remote(b, k))
+        return results
+
+    def stats(self) -> str:
+        per = ray_tpu.get([w.stats.remote() for w in self._workers])
+        lines = [f"RandomAccessDataset: {self._num_rows} rows, "
+                 f"{len(self._owner)} blocks, {len(per)} workers"]
+        for i, s in enumerate(per):
+            lines.append(f"  worker {i}: {s['num_rows']} rows in "
+                         f"{s['num_blocks']} blocks, "
+                         f"{s['num_gets']} gets")
+        return "\n".join(lines)
+
+
+@ray_tpu.remote(num_cpus=0.25)
+def _first_hit(a, b):
+    return a if a is not None else b

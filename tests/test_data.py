@@ -305,3 +305,89 @@ def test_map_groups_list_return_flattens(rt):
     assert len(out) == 6                       # flattened, not nested
     assert all(set(r) == {"k", "v2"} for r in out)
     assert sorted(r["v2"] for r in out) == [0, 2, 4, 6, 8, 10]
+
+
+def test_random_access_actor_serving(rt):
+    """The rebuilt RandomAccessDataset pins blocks in accessor actors:
+    lookups route by block bounds, multiget batches per actor, and the
+    actors record their get counts."""
+    from ray_tpu import data
+    ds = data.from_items(
+        [{"id": i, "val": i * 7} for i in range(100)][::-1],
+        parallelism=8)
+    rad = ds.to_random_access("id", num_workers=2)
+    assert rad.get(13) == {"id": 13, "val": 91}
+    assert ray_tpu.get(rad.get_async(99)) == {"id": 99, "val": 693}
+    assert rad.get(-5) is None and rad.get(1000) is None
+    got = rad.multiget(list(range(0, 100, 9)) + [555])
+    assert got[:-1] == [{"id": i, "val": i * 7}
+                       for i in range(0, 100, 9)]
+    assert got[-1] is None
+    s = rad.stats()
+    assert "workers" in s and "gets" in s
+
+
+def test_train_test_split_and_random_sample(rt):
+    from ray_tpu import data
+    ds = data.from_items(list(range(100)), parallelism=5)
+    train, test = ds.train_test_split(0.2)
+    assert train.count() == 80 and test.count() == 20
+    # rows partition exactly (order-preserving cut)
+    assert sorted(train.take_all() + test.take_all()) == \
+        list(range(100))
+    tr2, te2 = ds.train_test_split(30, shuffle=True, seed=0)
+    assert tr2.count() == 70 and te2.count() == 30
+    assert sorted(tr2.take_all() + te2.take_all()) == list(range(100))
+
+    sampled = ds.random_sample(0.3, seed=1).take_all()
+    assert 10 <= len(sampled) <= 55
+    assert set(sampled) <= set(range(100))
+
+
+def test_std_and_column_ops(rt):
+    import numpy as np
+    from ray_tpu import data
+    vals = list(np.random.RandomState(0).randn(60))
+    ds = data.from_items([{"x": float(v)} for v in vals],
+                         parallelism=4)
+    assert abs(ds.std("x") - float(np.std(vals, ddof=1))) < 1e-9
+    ds2 = ds.add_column("y", lambda r: r["x"] * 2)
+    row = ds2.take(1)[0]
+    assert row["y"] == row["x"] * 2
+    assert set(ds2.select_columns(["y"]).take(1)[0]) == {"y"}
+    assert set(ds2.drop_columns(["x"]).take(1)[0]) == {"y"}
+
+
+def test_random_sample_is_independent_across_blocks(rt):
+    """Regression: per-block RNGs must draw independent sequences (a
+    shared seed once produced identical keep-patterns per block) and
+    unseeded sampling must vary call to call."""
+    from ray_tpu import data
+    ds = data.from_items(list(range(100)), parallelism=100)
+    # 1-row blocks: a correlated sampler keeps all or none.
+    n = len(ds.random_sample(0.5, seed=1).take_all())
+    assert 20 < n < 80, n
+    a = ds.random_sample(0.5).take_all()
+    b = ds.random_sample(0.5).take_all()
+    assert a != b    # unseeded draws differ across calls
+
+
+def test_std_large_mean_no_cancellation(rt):
+    """Regression: sum-of-squares cancellation made std collapse to 0
+    at large means; Chan-merged centered moments must not."""
+    import numpy as np
+    from ray_tpu import data
+    rng = np.random.RandomState(0)
+    vals = (1e8 + rng.randn(300) * 0.001).tolist()
+    ds = data.from_items([{"x": v} for v in vals], parallelism=6)
+    got = ds.std("x")
+    want = float(np.std(vals, ddof=1))
+    assert abs(got - want) / want < 1e-6, (got, want)
+
+
+def test_unseeded_shuffle_varies(rt):
+    from ray_tpu import data
+    ds = data.from_items(list(range(200)), parallelism=4)
+    a = ds.random_shuffle().take_all()
+    b = ds.random_shuffle().take_all()
+    assert a != b and sorted(a) == sorted(b) == list(range(200))
