@@ -8,11 +8,12 @@ from ray_tpu.serve.drivers import (DAGDriver, json_request,
                                    json_to_ndarray)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.ingress import ingress, route
 from ray_tpu.serve.router import StreamingResponse
 
 __all__ = ["deployment", "run", "shutdown", "get_deployment", "get_handle",
            "list_deployments", "status", "delete", "DAGDriver",
            "json_request", "json_to_ndarray", "batch",
            "multiplexed", "get_multiplexed_model_id",
-           "get_deployment_handle",
+           "get_deployment_handle", "ingress", "route",
            "AutoscalingConfig", "DeploymentConfig", "StreamingResponse"]

@@ -42,6 +42,19 @@ class HTTPProxy:
             self._handles[name] = h
         return h
 
+    async def _read_payload(self, request):
+        """(payload, error_response): JSON body for body-carrying
+        verbs, query dict otherwise."""
+        from aiohttp import web
+        if request.method in ("POST", "PUT", "PATCH") and \
+                request.can_read_body:
+            try:
+                return await request.json(), None
+            except json.JSONDecodeError:
+                return None, web.json_response(
+                    {"error": "body must be JSON"}, status=400)
+        return dict(request.query) or None, None
+
     async def _dispatch(self, request):
         from aiohttp import web
         name = request.match_info["deployment"]
@@ -49,14 +62,9 @@ class HTTPProxy:
         if handle is None:
             return web.json_response(
                 {"error": f"no deployment {name!r}"}, status=404)
-        if request.method == "POST" and request.can_read_body:
-            try:
-                payload = await request.json()
-            except json.JSONDecodeError:
-                return web.json_response(
-                    {"error": "body must be JSON"}, status=400)
-        else:
-            payload = dict(request.query) or None
+        payload, err = await self._read_payload(request)
+        if err is not None:
+            return err
         # Streaming is transport metadata: opt in via the query string
         # ONLY (?stream=1). POST bodies are never inspected or
         # modified — a deployment may legitimately take a "stream" key.
@@ -122,6 +130,44 @@ class HTTPProxy:
             pass               # disconnect mid-stream: close quietly
         return resp
 
+    async def _dispatch_route(self, request):
+        """Subpath requests go to @serve.ingress deployments: the
+        replica-side handle_route dispatcher matches the path template
+        and verb (reference: FastAPI ingress routing,
+        serve/http_adapters.py)."""
+        from aiohttp import web
+        name = request.match_info["deployment"]
+        handle = self._handle_for(name)
+        if handle is None:
+            return web.json_response(
+                {"error": f"no deployment {name!r}"}, status=404)
+        subpath = "/" + request.match_info["tail"]
+        payload, err = await self._read_payload(request)
+        if err is not None:
+            return err
+        try:
+            ref = handle.handle_route.remote(request.method, subpath,
+                                             payload)
+            loop = asyncio.get_event_loop()
+            result = await loop.run_in_executor(
+                self._pool, lambda: ray_tpu.get(ref, timeout=60))
+            return web.json_response({"result": result})
+        except Exception as e:  # noqa: BLE001
+            msg = str(e)
+            if "no attribute 'handle_route'" in msg:
+                # Subpath on a deployment that isn't @serve.ingress.
+                return web.json_response(
+                    {"error": f"deployment {name!r} has no HTTP "
+                              f"routes (not @serve.ingress)"},
+                    status=404)
+            # handle_route raises LookupError("404: ...")/("405: ...");
+            # remote wrapping may prefix the message, so take the
+            # FIRST status marker in the string.
+            import re
+            m = re.search(r"\b(40[45]): ", msg)
+            status = int(m.group(1)) if m else 500
+            return web.json_response({"error": msg}, status=status)
+
     async def _health(self, request):
         from aiohttp import web
         return web.json_response({"status": "ok",
@@ -135,6 +181,8 @@ class HTTPProxy:
         app = web.Application()
         app.router.add_get("/-/healthz", self._health)
         app.router.add_route("*", "/{deployment}", self._dispatch)
+        app.router.add_route("*", "/{deployment}/{tail:.+}",
+                             self._dispatch_route)
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())
         site = web.TCPSite(runner, self.host, self.port)

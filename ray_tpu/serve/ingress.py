@@ -1,0 +1,115 @@
+"""Per-deployment HTTP routing (ingress).
+
+Capability parity with the reference's FastAPI ingress
+(serve/api.py @serve.ingress + serve/http_adapters.py: a deployment
+class whose methods are HTTP routes, path templates and all). No
+FastAPI in this image, so the router is in-house: @serve.route marks
+methods with a path template + verb set, @serve.ingress compiles the
+route table onto the class and injects handle_route(), which the HTTP
+proxy calls for any request with a subpath under the deployment.
+
+Contract: routed methods are called as ``method(payload, **path_params)``
+where payload is the JSON body (POST/PUT/PATCH) or the query-string
+dict (GET/DELETE), or None when absent; ``{name}`` path segments bind
+as keyword arguments (strings).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_SEG = re.compile(r"\{([a-zA-Z_][a-zA-Z0-9_]*)\}")
+
+
+def route(path: str, methods=("GET",)) -> Callable:
+    """Mark a deployment method as an HTTP route, e.g.
+    ``@serve.route("/users/{uid}", methods=["GET"])``."""
+    if not path.startswith("/"):
+        raise ValueError(f"route path must start with '/': {path!r}")
+    if isinstance(methods, str):
+        raise TypeError(
+            f"methods must be a list/tuple of verbs, not a string "
+            f"(got {methods!r} — did you mean methods=[{methods!r}]?)")
+    verbs = tuple(m.upper() for m in methods)
+    known = {"GET", "POST", "PUT", "PATCH", "DELETE", "HEAD",
+             "OPTIONS"}
+    bad = [v for v in verbs if v not in known]
+    if bad:
+        raise ValueError(f"unknown HTTP methods {bad}")
+
+    def deco(fn):
+        fn.__serve_route__ = (path, verbs)
+        return fn
+
+    return deco
+
+
+def _compile(path: str) -> "re.Pattern":
+    out, last = [], 0
+    for m in _SEG.finditer(path):
+        out.append(re.escape(path[last:m.start()]))
+        out.append(f"(?P<{m.group(1)}>[^/]+)")
+        last = m.end()
+    out.append(re.escape(path[last:]))
+    return re.compile("^" + "".join(out) + "/?$")
+
+
+def ingress(cls):
+    """Class decorator compiling the @route table and injecting the
+    dispatcher the proxy targets. Stacks under @serve.deployment:
+
+        @serve.deployment
+        @serve.ingress
+        class Api:
+            @serve.route("/items/{item_id}")
+            def get_item(self, payload, item_id): ...
+    """
+    table = []
+    for name in dir(cls):
+        fn = getattr(cls, name, None)
+        meta = getattr(fn, "__serve_route__", None)
+        if meta is not None:
+            path, verbs = meta
+            table.append((_compile(path), verbs, name, path))
+    if not table:
+        raise ValueError(
+            f"@serve.ingress on {cls.__name__}: no @serve.route-marked "
+            "methods found")
+    for reserved in ("handle_route", "serve_routes"):
+        if reserved in vars(cls):
+            raise ValueError(
+                f"@serve.ingress on {cls.__name__}: the class already "
+                f"defines {reserved}(), which ingress would overwrite")
+    # Most-specific-first: fewer {param} segments beat more (so the
+    # literal /users/me beats /users/{uid}), longer literal text
+    # breaks ties.
+    table.sort(key=lambda t: (len(_SEG.findall(t[3])),
+                              -len(_SEG.sub("", t[3]))))
+    cls.__serve_routes__ = table
+
+    def handle_route(self, http_method: str, subpath: str,
+                     payload: Optional[Any] = None):
+        verb = http_method.upper()
+        path_matched = False
+        for pat, verbs, attr, _raw in type(self).__serve_routes__:
+            m = pat.match(subpath)
+            if m is None:
+                continue
+            path_matched = True
+            if verb not in verbs:
+                continue
+            return getattr(self, attr)(payload, **m.groupdict())
+        if path_matched:
+            raise LookupError(
+                f"405: method {verb} not allowed for {subpath!r}")
+        raise LookupError(f"404: no route matches {subpath!r}")
+
+    cls.handle_route = handle_route
+
+    def serve_routes(self) -> Dict[str, Tuple[str, ...]]:
+        """Route table introspection (shown by the dashboard)."""
+        return {raw: verbs
+                for _p, verbs, _a, raw in type(self).__serve_routes__}
+
+    cls.serve_routes = serve_routes
+    return cls

@@ -780,3 +780,125 @@ def test_replica_stats_user_hook(serve_rt):
     assert eng["completed"] >= 1
     assert eng["slots_total"] == 2
     assert eng["pages_free"] <= eng["pages_total"]
+
+
+def test_ingress_routing(serve_rt):
+    """@serve.ingress + @serve.route: path templates, verbs, 404/405,
+    and specificity ordering — the reference's FastAPI-ingress
+    capability on the in-house router (serve/ingress.py)."""
+    import urllib.request
+    import urllib.error
+    import json as _json
+    from ray_tpu.serve.http_proxy import start_http, stop_http
+
+    @serve.deployment
+    @serve.ingress
+    class Store:
+        def __init__(self):
+            self.items = {"1": "apple"}
+
+        @serve.route("/items/{item_id}")
+        def get_item(self, payload, item_id):
+            if item_id not in self.items:
+                raise LookupError(f"404: no item {item_id}")
+            return {"item": self.items[item_id]}
+
+        @serve.route("/items", methods=["POST"])
+        def add_item(self, payload):
+            self.items[payload["id"]] = payload["name"]
+            return {"count": len(self.items)}
+
+        @serve.route("/items/special")
+        def special(self, payload):
+            return {"item": "unicorn"}
+
+    serve.run(Store.bind())
+    proxy = start_http(port=0)
+    base = f"http://127.0.0.1:{proxy.port}/Store"
+    try:
+        with urllib.request.urlopen(f"{base}/items/1",
+                                    timeout=30) as r:
+            assert _json.loads(r.read()) == {"result":
+                                             {"item": "apple"}}
+        # longest-pattern-first: the literal route wins over {item_id}
+        with urllib.request.urlopen(f"{base}/items/special",
+                                    timeout=30) as r:
+            assert _json.loads(r.read())["result"]["item"] == "unicorn"
+        req = urllib.request.Request(
+            f"{base}/items", method="POST",
+            data=_json.dumps({"id": "2", "name": "pear"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert _json.loads(r.read()) == {"result": {"count": 2}}
+        with urllib.request.urlopen(f"{base}/items/2", timeout=30) as r:
+            assert _json.loads(r.read())["result"]["item"] == "pear"
+        try:
+            urllib.request.urlopen(f"{base}/nope", timeout=30)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        try:
+            req = urllib.request.Request(f"{base}/items/1",
+                                         method="DELETE")
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected 405"
+        except urllib.error.HTTPError as e:
+            assert e.code == 405
+    finally:
+        stop_http()
+
+
+def test_ingress_requires_routes():
+    with pytest.raises(ValueError, match="no @serve.route"):
+        @serve.ingress
+        class Empty:
+            pass
+
+
+def test_ingress_error_mapping(serve_rt):
+    """Subpaths on non-ingress deployments 404 cleanly; status markers
+    map by FIRST occurrence (a path containing '405:' can't flip a
+    404); decoration-time validation fails fast."""
+    import urllib.request
+    import urllib.error
+    from ray_tpu.serve.http_proxy import start_http, stop_http
+
+    @serve.deployment
+    def plain(payload=None):
+        return "ok"
+
+    @serve.deployment
+    @serve.ingress
+    class Api:
+        @serve.route("/x/{v}")
+        def x(self, payload, v):
+            return {"v": v}
+
+    serve.run(plain.bind())
+    serve.run(Api.bind())
+    proxy = start_http(port=0)
+    try:
+        for url, want in [
+                (f"http://127.0.0.1:{proxy.port}/plain/sub/path", 404),
+                (f"http://127.0.0.1:{proxy.port}/Api/a/b/c", 404)]:
+            try:
+                urllib.request.urlopen(url, timeout=30)
+                assert False, f"expected {want} for {url}"
+            except urllib.error.HTTPError as e:
+                assert e.code == want, (url, e.code)
+    finally:
+        stop_http()
+
+    with pytest.raises(TypeError, match="not a string"):
+        serve.route("/x", methods="POST")
+    with pytest.raises(ValueError, match="unknown HTTP"):
+        serve.route("/x", methods=["FETCH"])
+    with pytest.raises(ValueError, match="would overwrite"):
+        @serve.ingress
+        class Clashing:
+            @serve.route("/a")
+            def a(self, payload):
+                return 1
+
+            def handle_route(self):
+                return 2
