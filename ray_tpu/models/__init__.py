@@ -13,6 +13,9 @@ from ray_tpu.models.mixtral import (Mixtral, MixtralConfig,
                                     mixtral_8x7b, mixtral_sharding_rules,
                                     mixtral_tiny, moe_aux_loss)
 from ray_tpu.models.resnet import ResNet, ResNetConfig, resnet50, resnet18
+from ray_tpu.models.vit import (ViT, ViTConfig, classification_loss,
+                                vit_base_16, vit_sharding_rules,
+                                vit_tiny)
 
 __all__ = [
     "T5", "T5Config", "t5_small", "t5_tiny", "t5_sharding_rules",
@@ -21,6 +24,8 @@ __all__ = [
     "bert_sharding_rules", "mask_tokens", "mlm_loss",
     "GPT2", "GPT2Config", "gpt2_sharding_rules", "gpt2_124m",
     "ResNet", "ResNetConfig", "resnet50", "resnet18",
+    "ViT", "ViTConfig", "vit_base_16", "vit_tiny",
+    "vit_sharding_rules", "classification_loss",
     "Llama", "LlamaConfig", "llama2_7b", "llama_tiny",
     "llama_sharding_rules", "generate",
     "Mixtral", "MixtralConfig", "mixtral_8x7b", "mixtral_tiny",

@@ -1,0 +1,165 @@
+"""ViT: vision transformer for image classification, TPU-native flax.
+
+Widens the vision side of models/ beyond ResNet (the reference
+framework ships no models; BASELINE.md's vision obligation is
+image-classification train/predict throughput, which ResNet covers —
+ViT adds the patchify-encoder shape that dominates modern image
+fleets and maps straight onto the MXU: the patch embedding is one
+strided conv, everything after is the same dense encoder stack as
+BERT). Same conventions as bert.py/gpt2.py: fp32 LayerNorms around
+cfg.dtype matmuls, attention through ops.attention, sharding declared
+as logical-axis rules.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.mesh.sharding import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    num_classes: int = 1000
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    dropout: float = 0.0
+    pool: str = "cls"            # "cls" token or "mean" of patches
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def vit_base_16(**overrides) -> ViTConfig:
+    return ViTConfig(**overrides)
+
+
+def vit_tiny(**overrides) -> ViTConfig:
+    d = dict(image_size=32, patch_size=8, num_classes=10, dim=64,
+             n_layers=2, n_heads=2, hidden_dim=128,
+             dtype=jnp.float32)
+    d.update(overrides)
+    return ViTConfig(**d)
+
+
+class ViTBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        B, T, C = x.shape
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        qkv = nn.Dense(3 * cfg.dim, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype,
+                       name="qkv")(h.astype(cfg.dtype))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, T, cfg.n_heads, cfg.head_dim)
+
+        from ray_tpu.ops.attention import multi_head_attention
+        a = multi_head_attention(heads(q), heads(k), heads(v),
+                                 causal=False,
+                                 impl=cfg.attention_impl)
+        a = nn.Dense(cfg.dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype,
+                     name="out")(a.reshape(B, T, C))
+        if cfg.dropout > 0:
+            a = nn.Dropout(cfg.dropout)(a, deterministic=deterministic)
+        x = x + a
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_ffn")(x)
+        h = nn.Dense(cfg.hidden_dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype,
+                     name="ffn_in")(h.astype(cfg.dtype))
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="ffn_out")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return x + h
+
+
+class ViT(nn.Module):
+    """Patchify -> pre-LN encoder -> pooled classification logits.
+
+    __call__(images[B,H,W,C]) -> logits [B, num_classes] (fp32).
+    """
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, deterministic: bool = True):
+        cfg = self.config
+        B = images.shape[0]
+        # Patch embedding = one strided conv: the [P,P,C]->dim
+        # projection is a single big matmul per patch grid on the MXU.
+        x = nn.Conv(cfg.dim,
+                    kernel_size=(cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size),
+                    padding="VALID", dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype,
+                    name="patch_embed")(images.astype(cfg.dtype))
+        x = x.reshape(B, -1, cfg.dim)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.dim), cfg.param_dtype)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, cfg.dim)).astype(x.dtype),
+             x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(0.02),
+                         (1, cfg.num_patches + 1, cfg.dim),
+                         cfg.param_dtype)
+        x = x + pos.astype(x.dtype)
+        for i in range(cfg.n_layers):
+            x = ViTBlock(cfg, name=f"block_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        if cfg.pool == "mean":
+            pooled = x[:, 1:].mean(axis=1)
+        else:
+            pooled = x[:, 0]
+        logits = nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                          param_dtype=cfg.param_dtype,
+                          name="head")(pooled.astype(jnp.float32))
+        return logits
+
+
+def classification_loss(logits, labels):
+    """Mean softmax cross-entropy over int labels."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    gold = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return -gold.mean()
+
+
+def vit_sharding_rules(fsdp: bool = True) -> ShardingRules:
+    """Megatron-style TP + optional FSDP for the encoder (same stance
+    as bert_sharding_rules: qkv/ffn_in column-parallel, out/ffn_out
+    row-parallel; patch embed and head are small — fsdp-only)."""
+    f = "fsdp" if fsdp else None
+    return ShardingRules([
+        (r"patch_embed/kernel$", P(None, None, None, f)),
+        (r"(cls_token|pos_embed)$", P(None, None, None)),
+        (r"(qkv|ffn_in)/kernel$", P(f, "tensor")),
+        (r"(out|ffn_out)/kernel$", P("tensor", f)),
+        (r"head/kernel$", P(f, None)),
+        (r"bias$", P(None)),
+        (r"(ln_\w+|scale)$", P(None)),
+        (r".*", P(None)),
+    ])
