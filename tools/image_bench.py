@@ -24,6 +24,11 @@ REF_PREDICT_IMG_S = 183.19    # 16 GPUs, benchmarks.rst:133-135
 
 def main():
     import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # env alone doesn't always override the axon plugin; the
+        # config update must land before any device use (same guard
+        # as bench.py)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
     import optax
