@@ -314,6 +314,23 @@ class SAC(Algorithm):
             "time_this_iter_s": time.time() - t0,
         }
 
+    def compute_action(self, obs, deterministic: bool = True
+                       ) -> np.ndarray:
+        """Action for one observation from the learned policy (the
+        tanh-squashed mean when deterministic, a sample otherwise),
+        mapped to the env's action range."""
+        import jax
+        import jax.numpy as jnp
+        mu, log_std = self._policy.apply(self._state["pi"],
+                                         jnp.asarray(obs)[None])
+        mu = np.asarray(mu[0])
+        if not deterministic:
+            self._key, sub = jax.random.split(self._key)
+            mu = mu + np.exp(np.asarray(log_std[0])) * \
+                np.asarray(jax.random.normal(sub, mu.shape))
+        return (np.tanh(mu) * self._scale +
+                self._center).astype(np.float32)
+
     def get_state(self) -> Dict[str, Any]:
         import jax
         return {"state": jax.device_get(self._state)}

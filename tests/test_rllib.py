@@ -322,3 +322,26 @@ def test_sac_checkpoint_roundtrip(rt, tmp_path):
         assert result["training_iteration"] == 2
     finally:
         algo2.stop()
+
+
+def test_sac_compute_action(rt):
+    from ray_tpu.rllib import SACConfig
+    algo = (SACConfig().environment(env="Reach")
+            .rollouts(num_rollout_workers=1,
+                      rollout_fragment_length=16)
+            .training(learning_starts=8).build())
+    try:
+        algo.train()
+        import numpy as np
+        a = algo.compute_action(np.array([0.5], np.float32))
+        assert a.shape == (1,) and -1.0 <= float(a[0]) <= 1.0
+        # deterministic is repeatable; stochastic varies
+        b = algo.compute_action(np.array([0.5], np.float32))
+        assert np.array_equal(a, b)
+        s1 = algo.compute_action(np.array([0.5], np.float32),
+                                 deterministic=False)
+        s2 = algo.compute_action(np.array([0.5], np.float32),
+                                 deterministic=False)
+        assert not np.array_equal(s1, s2)
+    finally:
+        algo.stop()
