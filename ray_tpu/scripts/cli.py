@@ -18,16 +18,31 @@ import click
 from ray_tpu.scripts.head_daemon import address_file_path
 
 
+def _load_file_token():
+    """Adopt the daemon-minted cluster token from the address file so
+    same-machine CLI clients authenticate (an explicit
+    RAY_TPU_cluster_token env var wins)."""
+    if os.environ.get("RAY_TPU_cluster_token"):
+        return
+    from ray_tpu.scripts.head_daemon import read_address_file
+    _addr, token, _pid = read_address_file()
+    if token:
+        from ray_tpu._private.config import GlobalConfig
+        if not GlobalConfig.cluster_token:
+            GlobalConfig.apply_system_config({"cluster_token": token})
+
+
 def _resolve_address(address):
+    _load_file_token()
     if address:
         return address
     env = os.environ.get("RAY_TPU_ADDRESS")
     if env:
         return env
-    path = address_file_path()
-    if os.path.exists(path):
-        with open(path) as f:
-            return f.read().strip()
+    from ray_tpu.scripts.head_daemon import read_address_file
+    addr, _token, _pid = read_address_file()
+    if addr:
+        return addr
     raise click.ClickException(
         "No running cluster found: pass --address, set RAY_TPU_ADDRESS, "
         "or run `ray-tpu start --head` first.")
@@ -101,14 +116,36 @@ def start(head, address, num_workers, resources, store_capacity, block):
 @click.option("--address", default=None)
 def stop(address):
     """Stop the running cluster."""
+    from ray_tpu.scripts.head_daemon import read_address_file
+    file_addr, _token, pid = read_address_file()
+    # The pid/file belong to the LOCAL daemon: only touch them when
+    # that is the cluster being stopped (no explicit --address, or an
+    # --address matching the file), never when stopping a remote one.
+    local_target = address is None or address == file_addr
     try:
         client = _head_client(address)
         client.call("shutdown", timeout=5)
     except Exception:
         pass
-    path = address_file_path()
-    if os.path.exists(path):
-        os.remove(path)
+    # The daemon wrapper outlives the head's RPC shutdown: signal it
+    # so the process tree actually exits (it removes the address file
+    # itself on the way out).
+    if local_target and pid:
+        import signal as _signal
+        try:
+            os.kill(pid, _signal.SIGTERM)
+            for _ in range(50):
+                try:
+                    os.kill(pid, 0)
+                except OSError:
+                    break
+                time.sleep(0.1)
+        except OSError:
+            pass
+    if local_target:
+        path = address_file_path()
+        if os.path.exists(path):
+            os.remove(path)
     click.echo("Stopped.")
 
 
@@ -253,6 +290,110 @@ def timeline(output):
     import ray_tpu
     path = ray_tpu.timeline(output)
     click.echo(f"Wrote {path}")
+
+
+@cli.group("serve")
+def serve_group():
+    """Serve deployments from the command line (reference: the
+    `serve run/status/shutdown` CLI, serve/scripts.py)."""
+
+
+def _serve_attach(address, standalone_ok=False):
+    """Driver attach for serve subcommands: join the running cluster.
+    Only `serve run` may fall back to starting a local runtime
+    (standalone_ok); status/shutdown are queries and must not spawn a
+    cluster just to report there is nothing to query."""
+    import ray_tpu
+    try:
+        addr = _resolve_address(address)
+    except click.ClickException:
+        if not standalone_ok:
+            raise
+        addr = None
+    ray_tpu.init(address=addr, ignore_reinit_error=True)
+    return ray_tpu
+
+
+@serve_group.command("run")
+@click.argument("target")
+@click.option("--address", default=None)
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", default=8000, show_default=True, type=int)
+@click.option("--blocking/--no-blocking", default=True,
+              show_default=True)
+def serve_run_cmd(target, address, host, port, blocking):
+    """Import TARGET (module:attr — a deployment or bound node), run
+    it, and expose the HTTP proxy."""
+    import importlib
+    sys.path.insert(0, os.getcwd())
+    mod_name, _, attr = target.partition(":")
+    if not attr:
+        raise click.ClickException(
+            f"target must be module:attr, got {target!r}")
+    module = importlib.import_module(mod_name)
+    try:
+        app = getattr(module, attr)
+    except AttributeError:
+        raise click.ClickException(
+            f"{mod_name!r} has no attribute {attr!r}")
+    _serve_attach(address, standalone_ok=True)
+    from ray_tpu import serve as serve_api
+    from ray_tpu.serve.api import Deployment
+    from ray_tpu.serve.http_proxy import start_http
+    if isinstance(app, Deployment):
+        app = app.bind()
+    serve_api.run(app)
+    names = sorted(serve_api.list_deployments())
+    if not blocking:
+        # The HTTP proxy lives in THIS process; advertising an
+        # endpoint that dies on exit would be a lie. Deploy-only.
+        click.echo(f"Deployed {names} (replicas stay up on the "
+                   f"cluster; run without --no-blocking to serve "
+                   f"HTTP, or reach them via serve handles)")
+        return
+    proxy = start_http(host, port)
+    click.echo(f"Serving {names} at http://{host}:{proxy.port}/"
+               f"<deployment>")
+    click.echo("Ctrl-C to stop.")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        click.echo("Shutting down.")
+        serve_api.shutdown()
+
+
+@serve_group.command("status")
+@click.option("--address", default=None)
+def serve_status_cmd(address):
+    """Deployment + replica status (reference: `serve status`)."""
+    ray_tpu = _serve_attach(address)
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+    try:
+        ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        raise click.ClickException("Serve is not running here")
+    from ray_tpu import serve as serve_api
+    click.echo(json.dumps(serve_api.status(), indent=2, default=str))
+
+
+@serve_group.command("shutdown")
+@click.option("--address", default=None)
+@click.option("--yes", "-y", is_flag=True,
+              help="Skip the confirmation prompt.")
+def serve_shutdown_cmd(address, yes):
+    """Tear down all deployments (reference: `serve shutdown`)."""
+    if not yes:
+        click.confirm("Shut down all serve deployments?", abort=True)
+    ray_tpu = _serve_attach(address)
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+    try:
+        ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        raise click.ClickException("Serve is not running here")
+    from ray_tpu import serve as serve_api
+    serve_api.shutdown()
+    click.echo("Serve shut down.")
 
 
 def main():

@@ -16,6 +16,38 @@ def address_file_path() -> str:
     return os.path.join("/tmp", "ray_tpu", "head_address")
 
 
+def write_address_file(address: str, token: str) -> str:
+    """Persist the head address + cluster token + daemon pid for
+    external clients (CLI, drivers on this machine). The token is the
+    cluster's RPC secret, so the file is 0600 (redis-password-file
+    analogue); the pid lets `stop` terminate the daemon wrapper."""
+    path = address_file_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        json.dump({"address": address, "token": token,
+                   "pid": os.getpid()}, f)
+    return path
+
+
+def read_address_file():
+    """(address, token|None, pid|None) from the address file; accepts
+    the legacy plain "host:port" format (token/pid None)."""
+    path = address_file_path()
+    if not os.path.exists(path):
+        return None, None, None
+    with open(path) as f:
+        raw = f.read().strip()
+    if raw.startswith("{"):
+        try:
+            blob = json.loads(raw)
+            return (blob.get("address"), blob.get("token") or None,
+                    blob.get("pid"))
+        except json.JSONDecodeError:
+            return None, None, None
+    return (raw or None), None, None
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--num-workers", type=int, default=2)
@@ -29,10 +61,9 @@ def main():
                      resources_per_worker=json.loads(args.resources),
                      store_capacity=args.store_capacity)
     nm.wait_for_workers(args.num_workers)
-    path = address_file_path()
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
-        f.write(nm.head_address)
+    from ray_tpu._private.config import GlobalConfig
+    path = write_address_file(nm.head_address,
+                              GlobalConfig.cluster_token)
     # stdout line parsed by the CLI parent.
     print(f"RAY_TPU_HEAD_ADDRESS={nm.head_address}", flush=True)
 

@@ -138,5 +138,55 @@ def test_cli_registers_ops_commands():
     out = proc.stdout
     for cmd in ("start", "stop", "status", "submit", "logs", "memory",
                 "metrics", "list", "timeline", "dashboard",
-                "client-proxy"):
+                "client-proxy", "serve"):
         assert cmd in out, f"missing CLI command {cmd}"
+
+
+def test_cli_serve_run_status_shutdown(cluster, tmp_path, monkeypatch):
+    """serve run/status/shutdown CLI against a running cluster
+    (reference: serve/scripts.py CLI)."""
+    import textwrap as tw
+    import ray_tpu._private.worker as worker_mod
+
+    (tmp_path / "cli_app.py").write_text(tw.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment
+        def hello(payload=None):
+            return {"hello": payload}
+    """))
+    monkeypatch.chdir(tmp_path)
+    addr = cluster.node.head_address
+    runner = CliRunner()
+    try:
+        res = runner.invoke(cli, ["serve", "run", "cli_app:hello",
+                                  "--address", addr, "--no-blocking",
+                                  "--port", "0"])
+        assert res.exit_code == 0, res.output
+        assert "hello" in res.output and "Deployed" in res.output
+
+        res = runner.invoke(cli, ["serve", "status",
+                                  "--address", addr])
+        assert res.exit_code == 0, res.output
+        assert "hello" in res.output and "HEALTHY" in res.output
+
+        res = runner.invoke(cli, ["serve", "shutdown", "-y",
+                                  "--address", addr])
+        assert res.exit_code == 0, res.output
+
+        res = runner.invoke(cli, ["serve", "status",
+                                  "--address", addr])
+        assert res.exit_code != 0      # controller gone
+    finally:
+        from ray_tpu import serve as serve_api
+        from ray_tpu.serve.http_proxy import stop_http
+        try:
+            stop_http()
+        except Exception:
+            pass
+        try:
+            serve_api.shutdown()
+        except Exception:
+            pass
+        if worker_mod.is_initialized():
+            worker_mod.shutdown()
