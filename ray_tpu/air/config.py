@@ -74,3 +74,7 @@ class RunConfig:
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
     verbose: int = 1
+    # Stopping condition: a tune.Stopper, a {"metric": threshold}
+    # dict, or a callable(trial_id, result) -> bool (reference:
+    # air.RunConfig.stop -> tune/stopper/).
+    stop: Optional[Any] = None

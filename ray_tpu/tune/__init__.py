@@ -7,6 +7,10 @@ from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
                                      HyperBandScheduler,
                                      MedianStoppingRule,
                                      PopulationBasedTraining)
+from ray_tpu.tune.stopper import (CombinedStopper,
+                                  ExperimentPlateauStopper,
+                                  MaximumIterationStopper, Stopper,
+                                  TimeoutStopper, TrialPlateauStopper)
 from ray_tpu.tune.tuner import TuneConfig, Tuner, ResultGrid
 from ray_tpu.tune.trial import Trial
 
@@ -16,6 +20,9 @@ __all__ = [
     "qrandint", "BasicVariantGenerator", "TPESearcher",
     "BOHBSearcher", "Searcher", "SearcherAdapter",
     "ConcurrencyLimiter", "Repeater",
+    "Stopper", "MaximumIterationStopper", "TimeoutStopper",
+    "TrialPlateauStopper", "ExperimentPlateauStopper",
+    "CombinedStopper",
     "FIFOScheduler", "AsyncHyperBandScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
 ]

@@ -1,0 +1,172 @@
+"""Experiment/trial stopping conditions.
+
+Capability parity with the reference's tune.stopper
+(python/ray/tune/stopper/: Stopper ABC with __call__(trial_id,
+result) + stop_all(), and the shipped implementations —
+MaximumIterationStopper, TimeoutStopper, TrialPlateauStopper,
+ExperimentPlateauStopper, CombinedStopper). Wired through
+RunConfig(stop=...), which also accepts the reference's dict
+({"metric": threshold}) and bare-callable forms.
+"""
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Callable, Dict, Optional, Union
+
+
+class Stopper:
+    """Per-result stopping decision. __call__ returns True to stop
+    THAT trial; stop_all() True ends the whole experiment."""
+
+    def __call__(self, trial_id: str, result: Dict[str, Any]) -> bool:
+        raise NotImplementedError
+
+    def stop_all(self) -> bool:
+        return False
+
+
+class MaximumIterationStopper(Stopper):
+    def __init__(self, max_iter: int):
+        self.max_iter = int(max_iter)
+
+    def __call__(self, trial_id, result):
+        return result.get("training_iteration", 0) >= self.max_iter
+
+
+class TimeoutStopper(Stopper):
+    """Stops the EXPERIMENT after a wall-clock budget, measured from
+    the first stopping check (fit() start), not construction."""
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = float(timeout_s)
+        self._deadline: Optional[float] = None
+
+    def _arm(self):
+        if self._deadline is None:
+            self._deadline = time.monotonic() + self.timeout_s
+
+    def __call__(self, trial_id, result):
+        self._arm()
+        return False
+
+    def stop_all(self) -> bool:
+        self._arm()
+        return time.monotonic() >= self._deadline
+
+
+class TrialPlateauStopper(Stopper):
+    """Stops a trial whose metric stopped moving: std of the last
+    ``num_results`` values below ``std`` (after ``grace_period``
+    results)."""
+
+    def __init__(self, metric: str, std: float = 0.01,
+                 num_results: int = 4, grace_period: int = 4,
+                 mode: Optional[str] = None,
+                 metric_threshold: Optional[float] = None):
+        self.metric = metric
+        self.std = float(std)
+        self.num_results = int(num_results)
+        self.grace_period = int(grace_period)
+        if metric_threshold is not None and mode not in ("min", "max"):
+            raise ValueError(
+                "metric_threshold requires mode='min' or 'max'")
+        self.mode = mode
+        self.metric_threshold = metric_threshold
+        self._history: Dict[str, collections.deque] = {}
+        self._seen: Dict[str, int] = {}
+
+    def __call__(self, trial_id, result):
+        if self.metric not in result:
+            return False
+        if self.metric_threshold is not None:
+            # Only stop plateaued trials still on the WRONG side of
+            # the threshold (the reference's mode+metric_threshold
+            # pairing): a trial that already reached it keeps going.
+            v = float(result[self.metric])
+            reached = (v <= self.metric_threshold
+                       if self.mode == "min"
+                       else v >= self.metric_threshold)
+            if reached:
+                return False
+        h = self._history.setdefault(
+            trial_id, collections.deque(maxlen=self.num_results))
+        h.append(float(result[self.metric]))
+        self._seen[trial_id] = self._seen.get(trial_id, 0) + 1
+        if self._seen[trial_id] < self.grace_period or \
+                len(h) < self.num_results:
+            return False
+        mean = sum(h) / len(h)
+        var = sum((v - mean) ** 2 for v in h) / len(h)
+        return var ** 0.5 <= self.std
+
+
+class ExperimentPlateauStopper(Stopper):
+    """Ends the experiment when the best value of ``metric`` has not
+    improved by more than ``tol`` for ``patience`` consecutive
+    results (across ALL trials)."""
+
+    def __init__(self, metric: str, mode: str = "min",
+                 tol: float = 0.0, patience: int = 8):
+        self.metric = metric
+        self.mode = mode
+        self.tol = float(tol)
+        self.patience = int(patience)
+        self._best: Optional[float] = None
+        self._stale = 0
+
+    def __call__(self, trial_id, result):
+        if self.metric not in result:
+            return False
+        v = float(result[self.metric])
+        improved = self._best is None or (
+            v < self._best - self.tol if self.mode == "min"
+            else v > self._best + self.tol)
+        if improved:
+            self._best = v
+            self._stale = 0
+        else:
+            self._stale += 1
+        return False
+
+    def stop_all(self) -> bool:
+        return self._stale >= self.patience
+
+
+class CombinedStopper(Stopper):
+    def __init__(self, *stoppers: Stopper):
+        self.stoppers = stoppers
+
+    def __call__(self, trial_id, result):
+        return any(s(trial_id, result) for s in self.stoppers)
+
+    def stop_all(self) -> bool:
+        return any(s.stop_all() for s in self.stoppers)
+
+
+def coerce_stopper(stop: Union[None, Stopper, Callable,
+                               Dict[str, Any]]) -> Optional[Stopper]:
+    """RunConfig(stop=...) accepts a Stopper, a dict of
+    metric->threshold (stop when result[metric] >= threshold, the
+    reference's dict form), or a callable(trial_id, result)->bool."""
+    if stop is None or isinstance(stop, Stopper):
+        return stop
+    if isinstance(stop, dict):
+        thresholds = dict(stop)
+
+        class _DictStopper(Stopper):
+            def __call__(self, trial_id, result):
+                return any(k in result and result[k] >= v
+                           for k, v in thresholds.items())
+
+        return _DictStopper()
+    if callable(stop):
+        fn = stop
+
+        class _FnStopper(Stopper):
+            def __call__(self, trial_id, result):
+                return bool(fn(trial_id, result))
+
+        return _FnStopper()
+    raise TypeError(f"stop must be a Stopper, dict, or callable; "
+                    f"got {type(stop).__name__}")

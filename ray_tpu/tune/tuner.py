@@ -129,6 +129,10 @@ class Tuner:
         searcher = tc.search_alg or BasicVariantGenerator(
             self.param_space, num_samples=tc.num_samples)
 
+        from ray_tpu.tune.stopper import coerce_stopper
+        stopper = coerce_stopper(getattr(self.run_config, "stop",
+                                         None))
+
         trials: List[Trial] = list(self._restored_trials)
         # A restored experiment re-runs its unfinished trials; the
         # search budget was already spent in the original run.
@@ -151,6 +155,7 @@ class Tuner:
                 release(suggest_ids.get(trial.trial_id))
 
         start_time = time.time()
+        stop_experiment = False
         while True:
             running = [t for t in trials if t.state == RUNNING]
             pending = [t for t in trials if t.state == PENDING]
@@ -198,7 +203,18 @@ class Tuner:
                         trial.checkpoint = ckpt
                     decision = scheduler.on_result(trial, metrics,
                                                    trials)
-                    if decision == STOP:
+                    stopper_says = False
+                    if stopper is not None:
+                        stopper_says = stopper(trial.trial_id,
+                                               metrics)
+                        # Reports arrive in bursts (a fast trial can
+                        # deliver many per poll), so the experiment-
+                        # wide condition must be consulted per result
+                        # too, not just once per event-loop pass.
+                        if stopper.stop_all():
+                            stop_experiment = True
+                    if decision == STOP or stopper_says or \
+                            stop_experiment:
                         self._stop_trial(trial, STOPPED)
                         finish(trial)
                         break
@@ -231,8 +247,10 @@ class Tuner:
                     finish(trial)
                     self._save_experiment_state(trials)
 
-            if tc.time_budget_s is not None and \
-                    time.time() - start_time > tc.time_budget_s:
+            over_budget = tc.time_budget_s is not None and \
+                time.time() - start_time > tc.time_budget_s
+            if over_budget or stop_experiment or (
+                    stopper is not None and stopper.stop_all()):
                 for t in trials:
                     if not t.finished:
                         self._stop_trial(t, STOPPED)

@@ -249,3 +249,90 @@ def test_functional_tune_run(rt):
                     max_concurrent_trials=2)
     assert len(grid) == 8
     assert grid.get_best_result().metrics["loss"] < 2.0
+
+
+def test_stopper_dict_and_max_iteration(rt):
+    """RunConfig(stop=...): the dict threshold form and
+    MaximumIterationStopper both cut trials short (reference:
+    tune/stopper/)."""
+    from ray_tpu.air import RunConfig, session
+    from ray_tpu.tune import (MaximumIterationStopper, TuneConfig,
+                              Tuner)
+
+    def loop(config):
+        for it in range(50):
+            session.report({"score": it})
+
+    grid = Tuner(loop, param_space={"x": 1},
+                 tune_config=TuneConfig(metric="score", mode="max"),
+                 run_config=RunConfig(stop={"score": 5})).fit()
+    t = grid.trials[0]
+    assert t.last_result["score"] == 5          # stopped at threshold
+    assert len(t.results) <= 7
+
+    grid = Tuner(loop, param_space={"x": 1},
+                 tune_config=TuneConfig(metric="score", mode="max"),
+                 run_config=RunConfig(
+                     stop=MaximumIterationStopper(3))).fit()
+    assert grid.trials[0].last_result["training_iteration"] == 3
+
+
+def test_trial_plateau_and_experiment_stoppers(rt):
+    from ray_tpu.air import RunConfig, session
+    from ray_tpu.tune import (CombinedStopper,
+                              ExperimentPlateauStopper,
+                              TrialPlateauStopper, TuneConfig, Tuner)
+
+    def plateau(config):
+        for it in range(60):
+            session.report({"loss": 1.0 if it > 4 else 10.0 - it})
+
+    grid = Tuner(plateau, param_space={"x": 1},
+                 tune_config=TuneConfig(metric="loss", mode="min"),
+                 run_config=RunConfig(stop=TrialPlateauStopper(
+                     "loss", std=1e-6, num_results=3,
+                     grace_period=3))).fit()
+    assert len(grid.trials[0].results) < 20     # plateau detected
+
+    stopper = CombinedStopper(
+        ExperimentPlateauStopper("loss", mode="min", patience=4))
+    grid = Tuner(plateau, param_space={"x": 1},
+                 tune_config=TuneConfig(metric="loss", mode="min"),
+                 run_config=RunConfig(stop=stopper)).fit()
+    assert len(grid.trials[0].results) < 30     # experiment ended
+
+
+def test_stopper_callable_form(rt):
+    from ray_tpu.air import RunConfig, session
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    def loop(config):
+        for it in range(50):
+            session.report({"v": it})
+
+    grid = Tuner(loop, param_space={"x": 1},
+                 tune_config=TuneConfig(metric="v", mode="max"),
+                 run_config=RunConfig(
+                     stop=lambda tid, r: r["v"] >= 2)).fit()
+    assert grid.trials[0].last_result["v"] == 2
+
+
+def test_trial_plateau_metric_threshold(rt):
+    """mode+metric_threshold pairing: a plateaued trial that already
+    reached the threshold is NOT stopped."""
+    from ray_tpu.air import RunConfig, session
+    from ray_tpu.tune import TrialPlateauStopper, TuneConfig, Tuner
+
+    def good_plateau(config):
+        for it in range(20):
+            session.report({"loss": 0.01})     # flat but GOOD
+
+    grid = Tuner(good_plateau, param_space={"x": 1},
+                 tune_config=TuneConfig(metric="loss", mode="min"),
+                 run_config=RunConfig(stop=TrialPlateauStopper(
+                     "loss", std=1e-6, num_results=3, grace_period=3,
+                     mode="min", metric_threshold=0.5))).fit()
+    assert len(grid.trials[0].results) == 20   # ran to completion
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="metric_threshold"):
+        TrialPlateauStopper("loss", metric_threshold=0.5)
