@@ -11,9 +11,11 @@ from ray_tpu.train.compose import (make_composed_loss,
                                    put_composed_batch)
 from ray_tpu.train.trainer import BaseTrainer, JaxTrainer, DataParallelTrainer
 from ray_tpu.train.torch import TorchTrainer
+from ray_tpu.train.huggingface import HuggingFaceTrainer
 
 __all__ = ["gang", "BaseTrainer", "JaxTrainer", "DataParallelTrainer",
-           "TorchTrainer", "SklearnTrainer", "XGBoostTrainer",
+           "TorchTrainer", "HuggingFaceTrainer",
+           "SklearnTrainer", "XGBoostTrainer",
            "LightGBMTrainer", "Predictor", "JaxPredictor",
            "SklearnPredictor", "BatchPredictor",
            "ScalingConfig", "RunConfig", "FailureConfig",

@@ -1,0 +1,101 @@
+"""HuggingFace transformers integration for the train gang.
+
+Capability parity with the reference's HuggingFaceTrainer
+(python/ray/train/huggingface/huggingface_trainer.py — a
+DataParallelTrainer whose workers each build a transformers.Trainer
+via trainer_init_per_worker, train under the torch process group, and
+stream HF logs back as session reports; train/huggingface/_huggingface
+_utils.py's TrainReportCallback). Same shape here on the gloo
+TorchTrainer gang: rank 0's logs become session.report()s and the
+final report carries the model state as an AIR Checkpoint.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ray_tpu.train.torch import TorchTrainer, checkpoint_from_model
+
+
+def _report_callback():
+    """transformers.TrainerCallback streaming HF log events into
+    session.report (rank 0 only — one report stream per gang, like
+    the reference's TrainReportCallback)."""
+    import transformers
+
+    from ray_tpu.air import session
+
+    class _Report(transformers.TrainerCallback):
+        def on_log(self, args, state, control, logs=None, **kw):
+            if not state.is_world_process_zero or not logs:
+                return
+            metrics = {k: v for k, v in logs.items()
+                       if isinstance(v, (int, float))}
+            metrics["step"] = state.global_step
+            metrics["epoch"] = float(state.epoch or 0.0)
+            session.report(metrics)
+
+    return _Report()
+
+
+class HuggingFaceTrainer(TorchTrainer):
+    """Distributed transformers.Trainer over the worker gang.
+
+    ``trainer_init_per_worker(config) -> transformers.Trainer`` runs
+    on every gang member AFTER the torch process group is up, so the
+    Trainer's accelerate state adopts the gloo group and gradients
+    sync across workers; per-rank data sharding is the HF Trainer's
+    own DistributedSampler behavior.
+    """
+
+    def __init__(self, trainer_init_per_worker: Callable,
+                 **kwargs):
+        def train_loop(config):
+            import os
+
+            import torch.distributed as dist
+
+            from ray_tpu.air import session
+
+            env_keys = ("MASTER_ADDR", "MASTER_PORT", "RANK",
+                        "WORLD_SIZE", "LOCAL_RANK",
+                        "ACCELERATE_USE_CPU")
+            saved = {k: os.environ.get(k) for k in env_keys}
+            if dist.is_available() and dist.is_initialized() and \
+                    dist.get_world_size() > 1:
+                # accelerate discovers distributed state from the
+                # environment, not from the live process group: hand
+                # it THIS gang's coordinates (MASTER_* from this
+                # fit's TCP rendezvous — never a previous fit's).
+                from ray_tpu.train.torch import _RDZV_KEY
+                rdzv = config.get(_RDZV_KEY, "")
+                if rdzv.startswith("tcp://"):
+                    host, _, port = rdzv[len("tcp://"):].rpartition(":")
+                    os.environ["MASTER_ADDR"] = host
+                    os.environ["MASTER_PORT"] = port
+                os.environ["RANK"] = str(dist.get_rank())
+                os.environ["WORLD_SIZE"] = str(dist.get_world_size())
+                os.environ["LOCAL_RANK"] = str(dist.get_rank())
+                os.environ["ACCELERATE_USE_CPU"] = "true"
+            try:
+                trainer = trainer_init_per_worker(config)
+                trainer.add_callback(_report_callback())
+                result = trainer.train()
+                final = {"train_loss": float(result.training_loss),
+                         "global_step":
+                             int(trainer.state.global_step),
+                         "world_size": int(trainer.args.world_size)}
+                is_zero = trainer.state.is_world_process_zero
+                ckpt = checkpoint_from_model(trainer.model) \
+                    if is_zero else None
+                session.report(final, checkpoint=ckpt)
+            finally:
+                # Worker processes outlive this fit: stale RANK/
+                # WORLD_SIZE/MASTER_* would make accelerate in a LATER
+                # workload rendezvous against a dead port.
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+
+        super().__init__(train_loop, **kwargs)

@@ -115,6 +115,13 @@ class BaseTrainer:
                             dedicated_processes=want_gang)
         latest_ckpt = resume_ckpt
         last_metrics: Optional[Dict[str, Any]] = None
+        # RunConfig(stop=...) applies to plain trainer fits too (the
+        # reference runs trainers as tune trials, so stop conditions
+        # reach them either way); rank 0's report stream drives it.
+        from ray_tpu.tune.stopper import coerce_stopper
+        stopper = coerce_stopper(getattr(self.run_config, "stop",
+                                         None))
+        stop_requested = False
         try:
             run_refs = group.start_run(self._loop, self._config,
                                        self._mesh_axes(), resume_ckpt,
@@ -122,20 +129,38 @@ class BaseTrainer:
                                        self._use_jax_distributed(group))
             done = [False] * sc.num_workers
             error: Optional[BaseException] = None
-            while not all(done) and error is None:
+            while not all(done) and error is None and \
+                    not stop_requested:
                 polls = group.poll_all()
                 for rank, p in enumerate(polls):
                     for metrics, ckpt in p["reports"]:
                         if rank == 0:
                             last_metrics = metrics
                             history.append(metrics)
+                            if stopper is not None and (
+                                    stopper("train", metrics) or
+                                    stopper.stop_all()):
+                                # Reports arrive in bursts; drop the
+                                # rest of the batch or a fast loop
+                                # blows straight past the condition.
+                                stop_requested = True
+                                break
                         if ckpt is not None and rank == 0:
                             latest_ckpt = ckpt
                     done[rank] = p["done"]
                     if p["error"] is not None:
                         error = p["error"]
-                if error is None and not all(done):
+                    if stop_requested:
+                        break
+                if error is None and not all(done) and \
+                        not stop_requested:
                     time.sleep(0.01)
+            if stop_requested and error is None:
+                # Condition met: the gang is torn down in finally; the
+                # result carries everything reported so far.
+                return Result(metrics=last_metrics,
+                              checkpoint=latest_ckpt,
+                              metrics_history=list(history))
             if error is None:
                 # Surface any run() failure not seen via poll.
                 try:

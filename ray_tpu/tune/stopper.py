@@ -79,16 +79,6 @@ class TrialPlateauStopper(Stopper):
     def __call__(self, trial_id, result):
         if self.metric not in result:
             return False
-        if self.metric_threshold is not None:
-            # Only stop plateaued trials still on the WRONG side of
-            # the threshold (the reference's mode+metric_threshold
-            # pairing): a trial that already reached it keeps going.
-            v = float(result[self.metric])
-            reached = (v <= self.metric_threshold
-                       if self.mode == "min"
-                       else v >= self.metric_threshold)
-            if reached:
-                return False
         h = self._history.setdefault(
             trial_id, collections.deque(maxlen=self.num_results))
         h.append(float(result[self.metric]))
@@ -96,6 +86,18 @@ class TrialPlateauStopper(Stopper):
         if self._seen[trial_id] < self.grace_period or \
                 len(h) < self.num_results:
             return False
+        if self.metric_threshold is not None:
+            # Reference pairing (tune/stopper/trial_plateau.py): the
+            # plateau stop applies only to trials whose metric has
+            # CONVERGED PAST the threshold — "reached the target and
+            # stopped improving". A plateaued-but-bad trial keeps its
+            # budget (it may still escape).
+            v = float(result[self.metric])
+            reached = (v <= self.metric_threshold
+                       if self.mode == "min"
+                       else v >= self.metric_threshold)
+            if not reached:
+                return False
         mean = sum(h) / len(h)
         var = sum((v - mean) ** 2 for v in h) / len(h)
         return var ** 0.5 <= self.std

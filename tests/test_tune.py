@@ -318,21 +318,32 @@ def test_stopper_callable_form(rt):
 
 
 def test_trial_plateau_metric_threshold(rt):
-    """mode+metric_threshold pairing: a plateaued trial that already
-    reached the threshold is NOT stopped."""
+    """mode+metric_threshold pairing (reference semantics,
+    tune/stopper/trial_plateau.py): the plateau stop applies only to
+    trials that CONVERGED PAST the threshold; a plateaued-but-bad
+    trial keeps running."""
     from ray_tpu.air import RunConfig, session
     from ray_tpu.tune import TrialPlateauStopper, TuneConfig, Tuner
 
-    def good_plateau(config):
-        for it in range(20):
-            session.report({"loss": 0.01})     # flat but GOOD
+    def flat(val):
+        def loop(config):
+            for it in range(20):
+                session.report({"loss": val})
+        return loop
 
-    grid = Tuner(good_plateau, param_space={"x": 1},
-                 tune_config=TuneConfig(metric="loss", mode="min"),
-                 run_config=RunConfig(stop=TrialPlateauStopper(
-                     "loss", std=1e-6, num_results=3, grace_period=3,
-                     mode="min", metric_threshold=0.5))).fit()
-    assert len(grid.trials[0].results) == 20   # ran to completion
+    def run(val):
+        return Tuner(flat(val), param_space={"x": 1},
+                     tune_config=TuneConfig(metric="loss",
+                                            mode="min"),
+                     run_config=RunConfig(stop=TrialPlateauStopper(
+                         "loss", std=1e-6, num_results=3,
+                         grace_period=3, mode="min",
+                         metric_threshold=0.5))).fit()
+
+    # converged past the threshold and flat -> stopped early
+    assert len(run(0.01).trials[0].results) < 20
+    # flat but BAD (never reached 0.5) -> keeps its budget
+    assert len(run(2.0).trials[0].results) == 20
     import pytest as _pytest
     with _pytest.raises(ValueError, match="metric_threshold"):
         TrialPlateauStopper("loss", metric_threshold=0.5)
