@@ -130,6 +130,17 @@ class A2C:
     def get_policy_params(self):
         return self.params
 
+    def compute_action(self, obs):
+        """Greedy action from the learned policy (reference:
+        Policy.compute_single_action)."""
+        from ray_tpu.rllib.algorithm import greedy_action
+        return greedy_action(self, obs)
+
+    def evaluate(self, num_episodes: int = 5, seed: int = 1000):
+        """Deterministic rollout eval (reference: Algorithm.evaluate)."""
+        from ray_tpu.rllib.algorithm import rollout_evaluate
+        return rollout_evaluate(self, num_episodes, seed)
+
     def stop(self):
         for w in self.workers:
             ray_tpu.kill(w)

@@ -91,6 +91,51 @@ class AlgorithmConfig:
         return self.algo_class()(self.copy())
 
 
+def greedy_action(algo, obs) -> int:
+    """Shared greedy compute_action for the discrete learners: jits
+    the policy apply once per algorithm instance and argmaxes the
+    head — handles both (logits, value) actor-critic outputs (PPO/
+    A2C/IMPALA) and plain Q outputs (DQN)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    fn = getattr(algo, "_eval_apply", None)
+    if fn is None:
+        model = getattr(algo, "model", None) or algo._model
+        algo._eval_params_attr = ("params" if hasattr(algo, "params")
+                                  else "_params")
+        fn = algo._eval_apply = jax.jit(model.apply)
+    out = fn(getattr(algo, algo._eval_params_attr),
+             jnp.asarray(obs)[None])
+    head = out[0] if isinstance(out, tuple) else out
+    return int(np.asarray(head[0]).argmax())
+
+
+def rollout_evaluate(algo, num_episodes: int = 5,
+                     seed: int = 1000) -> Dict[str, Any]:
+    """Deterministic policy evaluation by env rollout (reference:
+    Algorithm.evaluate / evaluation WorkerSet — here the driver rolls
+    out with algo.compute_action, enough for the builtin envs)."""
+    env = ENV_REGISTRY[algo.config.env]()
+    returns, lengths = [], []
+    for ep in range(num_episodes):
+        obs = env.reset(seed=seed + ep)
+        done, total, n = False, 0.0, 0
+        while not done:
+            obs, reward, done, _ = env.step(algo.compute_action(obs))
+            total += reward
+            n += 1
+        returns.append(total)
+        lengths.append(n)
+    return {"evaluation": {
+        "episode_reward_mean": float(sum(returns) / len(returns)),
+        "episode_reward_min": float(min(returns)),
+        "episode_reward_max": float(max(returns)),
+        "episode_len_mean": float(sum(lengths) / len(lengths)),
+        "episodes_this_iter": num_episodes,
+    }}
+
+
 class Algorithm:
     """One-iteration-at-a-time trainer (Trainable contract)."""
 
@@ -106,6 +151,18 @@ class Algorithm:
 
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
+
+    def compute_action(self, obs):
+        """Action for one observation from the learned policy
+        (deterministic; reference: Policy.compute_single_action)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement compute_action")
+
+    def evaluate(self, num_episodes: int = 5,
+                 seed: int = 1000) -> Dict[str, Any]:
+        """Roll out the deterministic policy (reference:
+        Algorithm.evaluate)."""
+        return rollout_evaluate(self, num_episodes, seed)
 
     def train(self) -> Dict[str, Any]:
         result = self.training_step()

@@ -256,6 +256,12 @@ class DQN(Algorithm):
             "time_this_iter_s": time.time() - t0,
         }
 
+    def compute_action(self, obs) -> int:
+        """Greedy argmax-Q action (reference:
+        Policy.compute_single_action with explore=False)."""
+        from ray_tpu.rllib.algorithm import greedy_action
+        return greedy_action(self, obs)
+
     def get_state(self) -> Dict[str, Any]:
         import jax
         return {"params": jax.device_get(self._params),

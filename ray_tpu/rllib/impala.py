@@ -172,6 +172,11 @@ class Impala(Algorithm):
             "time_this_iter_s": time.time() - t0,
         }
 
+    def compute_action(self, obs) -> int:
+        """Greedy action from the learner policy."""
+        from ray_tpu.rllib.algorithm import greedy_action
+        return greedy_action(self, obs)
+
     def get_state(self) -> Dict[str, Any]:
         import jax
         return {"params": jax.device_get(self._params)}

@@ -345,3 +345,48 @@ def test_sac_compute_action(rt):
         assert not np.array_equal(s1, s2)
     finally:
         algo.stop()
+
+
+def test_evaluate_across_algorithms(rt):
+    """compute_action + Algorithm.evaluate parity surface: greedy
+    rollouts work for the on-policy (PPO), value-based (DQN), and
+    continuous (SAC) families (reference: Algorithm.evaluate)."""
+    from ray_tpu.rllib import DQNConfig, PPOConfig, SACConfig
+
+    ppo = PPOConfig(env="Sign", num_rollout_workers=1,
+                    rollout_fragment_length=256, lr=1e-2,
+                    entropy_coef=0.0, seed=1).build()
+    try:
+        for _ in range(4):
+            ppo.train()
+        ev = ppo.evaluate(num_episodes=3)["evaluation"]
+        # trained PPO on Sign: near-perfect (16); random is ~0
+        assert ev["episode_reward_mean"] > 8, ev
+        assert ev["episodes_this_iter"] == 3
+        assert ev["episode_len_mean"] == 16.0
+    finally:
+        ppo.stop()
+
+    dqn = (DQNConfig().environment(env="Sign")
+           .rollouts(num_rollout_workers=1,
+                     rollout_fragment_length=64)
+           .training(learning_starts=32).build())
+    try:
+        dqn.train()
+        a = dqn.compute_action(np.array([0.7], np.float32))
+        assert a in (0, 1)
+        ev = dqn.evaluate(num_episodes=2)["evaluation"]
+        assert -16 <= ev["episode_reward_mean"] <= 16
+    finally:
+        dqn.stop()
+
+    sac = (SACConfig().environment(env="Reach")
+           .rollouts(num_rollout_workers=1,
+                     rollout_fragment_length=32)
+           .training(learning_starts=16).build())
+    try:
+        sac.train()
+        ev = sac.evaluate(num_episodes=2)["evaluation"]
+        assert ev["episode_reward_mean"] <= 0     # Reach rewards <= 0
+    finally:
+        sac.stop()
