@@ -57,6 +57,7 @@ def xla_attention(q, k, v, causal: bool = True,
 def multi_head_attention(q, k, v, causal: bool = True,
                          impl: str = "auto",
                          bias: Optional[jax.Array] = None) -> jax.Array:
+    was_auto = impl == "auto"
     if impl == "auto":
         # Measured on v5e (fwd+bwd, H=12 D=64): at T=1024 the pallas
         # kernel wins for B>=8 (B=24: 43.2% vs 34.3% MFU — XLA's
@@ -69,11 +70,18 @@ def multi_head_attention(q, k, v, causal: bool = True,
                            (T >= 2048 or (T >= 1024 and B >= 8))) \
             else "xla"
     if impl == "flash":
-        try:
-            from ray_tpu.ops.flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=causal)
-        except Exception:
-            return xla_attention(q, k, v, causal=causal, bias=bias)
+        from ray_tpu.ops.flash_attention import flash_attention
+        if was_auto:
+            # auto picked flash opportunistically: a pallas/libtpu
+            # hiccup falls back to XLA rather than failing the model.
+            try:
+                return flash_attention(q, k, v, causal=causal)
+            except Exception:
+                return xla_attention(q, k, v, causal=causal,
+                                     bias=bias)
+        # Explicitly requested flash must not silently become XLA
+        # (benchmarks and kernel tests would record the wrong path).
+        return flash_attention(q, k, v, causal=causal)
     if impl == "ring":
         raise ValueError(
             "impl='ring' must be invoked through "
