@@ -26,8 +26,9 @@ from ray_tpu.dag import (DAGNode, FunctionNode, InputAttributeNode,
 from ray_tpu.workflow.storage import WorkflowStorage
 
 __all__ = ["init", "run", "run_async", "resume", "resume_all",
-           "cancel", "WorkflowCancelledError", "get_status",
-           "get_output", "list_all", "delete", "WorkflowStatus"]
+           "cancel", "continuation", "WorkflowCancelledError",
+           "get_status", "get_output", "list_all", "delete",
+           "WorkflowStatus"]
 
 
 class WorkflowCancelledError(RuntimeError):
@@ -197,6 +198,68 @@ def _run_step(func, args, kwargs):
     return func(*args, **kwargs)
 
 
+class _Continuation:
+    """A step's request to expand into a sub-workflow (reference:
+    workflow.continuation — the step's return value becomes the
+    sub-DAG's output; enables recursion/loops in durable DAGs)."""
+
+    def __init__(self, dag: DAGNode):
+        self.dag = dag
+
+
+def continuation(dag: DAGNode) -> "_Continuation":
+    """Return this from a workflow step to continue into ``dag``: the
+    engine expands the sub-DAG in place, persisting each sub-step, and
+    the step's consumers receive the sub-DAG's output. Sub-step ids
+    derive from the parent step id + structural position, so a resumed
+    workflow re-expands deterministically and reuses sub-step
+    checkpoints (assumes the step builds the same DAG on re-run, the
+    reference's assumption too)."""
+    if not isinstance(dag, DAGNode):
+        raise TypeError(
+            f"continuation() takes a bound DAG node, got "
+            f"{type(dag).__name__}")
+    return _Continuation(dag)
+
+
+def _expand_continuation(state: "_WorkflowState", parent_sid: str,
+                         cont: _Continuation
+                         ) -> Tuple[str, List[str]]:
+    """Merge cont's sub-DAG into the running state under
+    deterministic ids; returns (sub-output step id the parent aliases
+    to, all new step ids). Ids stay BOUNDED under recursion: a long
+    parent id collapses to its digest, so depth-10k loops neither
+    nest checkpoint directories nor exceed NAME_MAX."""
+    import hashlib
+    sub = _state_from_dag(cont.dag, state.input_args,
+                          state.input_kwargs)
+    prefix = parent_sid if len(parent_sid) <= 48 else \
+        "c" + hashlib.sha1(parent_sid.encode()).hexdigest()[:16]
+    mapping: Dict[str, str] = {}
+    for idx, old_sid in enumerate(sub.steps):
+        name = old_sid.rsplit("-", 1)[0]
+        mapping[old_sid] = f"{prefix}~{idx}-{name}"
+
+    def rename(v):
+        if isinstance(v, _StepRef):
+            return _StepRef(mapping[v.step_id])
+        if isinstance(v, list):
+            return [rename(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(rename(x) for x in v)
+        if isinstance(v, dict):
+            return {k: rename(x) for k, x in v.items()}
+        return v
+
+    for old_sid, spec in sub.steps.items():
+        new_sid = mapping[old_sid]
+        state.steps[new_sid] = _StepSpec(
+            new_sid, spec.func, rename(spec.args),
+            rename(spec.kwargs), spec.options,
+            is_output_list=spec.is_output_list)
+    return mapping[sub.output_step], list(mapping.values())
+
+
 def _execute_state(state: _WorkflowState, workflow_id: str,
                    storage: WorkflowStorage) -> Any:
     """Driver-side event loop: submit dependency-ready steps, checkpoint
@@ -214,9 +277,17 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
         sid: storage.load_step_result(workflow_id, sid)
         for sid in done & needed}
 
+    def get_result(sid: str):
+        """Step result, loading the checkpoint lazily on first use
+        (adopted continuation sub-steps and resumed steps only pay
+        deserialization when a consumer actually needs them)."""
+        if sid not in results:
+            results[sid] = storage.load_step_result(workflow_id, sid)
+        return results[sid]
+
     def substitute(v):
         if isinstance(v, _StepRef):
-            return results[v.step_id]
+            return get_result(v.step_id)
         if isinstance(v, _InputRef):
             return _project_input(v, state.input_args, state.input_kwargs)
         if isinstance(v, list):
@@ -228,8 +299,47 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
         return v
 
     pending: Dict[Any, str] = {}  # ObjectRef -> step_id
+    # parent step -> sub-output step it expanded into (continuation)
+    aliases: Dict[str, str] = {}
+    expanded: set = set()
 
     run_step = ray_tpu.remote(_run_step)
+
+    def land(sid: str, value: Any):
+        """A step produced a CONCRETE value: checkpoint it and cascade
+        through any continuation parents aliased to it."""
+        while True:
+            storage.save_step_result(workflow_id, sid, value)
+            results[sid] = value
+            done.add(sid)
+            parent = next((p for p, s in aliases.items() if s == sid),
+                          None)
+            if parent is None:
+                return
+            del aliases[parent]
+            sid = parent
+
+    def handle_result(sid: str, value: Any) -> None:
+        if isinstance(value, _Continuation):
+            # The step expands instead of completing: merge the
+            # sub-DAG and alias this step to its output. Nothing is
+            # checkpointed for the parent yet — a resume re-runs it
+            # and re-expands to the SAME sub-step ids, picking up
+            # whatever sub-steps already checkpointed.
+            sub_out, new_ids = _expand_continuation(state, sid, value)
+            aliases[sid] = sub_out
+            expanded.add(sid)
+            # A resumed run re-expands over sub-steps that already
+            # checkpointed: adopt them (results load lazily on
+            # first use, same pruning stance as the resume path).
+            for nsid in new_ids:
+                if storage.has_step(workflow_id, nsid):
+                    done.add(nsid)
+            if sub_out in done:
+                del aliases[sid]
+                land(sid, get_result(sub_out))
+            return
+        land(sid, value)
 
     def check_cancel():
         if storage.get_status(workflow_id) == WorkflowStatus.CANCELED:
@@ -244,8 +354,9 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
             raise WorkflowCancelledError(workflow_id)
 
     def ready_steps():
-        for sid, spec in state.steps.items():
-            if sid in done or sid in pending.values():
+        for sid, spec in list(state.steps.items()):
+            if sid in done or sid in expanded or \
+                    sid in pending.values():
                 continue
             if all(d in done for d in spec.dependencies()):
                 yield sid, spec
@@ -260,6 +371,8 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
                 value = ray_tpu.get(ready[0])
             except Exception:
                 continue
+            if isinstance(value, _Continuation):
+                continue       # re-expanded by the resume's re-run
             storage.save_step_result(workflow_id, sid, value)
 
     while True:
@@ -271,10 +384,7 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
             progressed = False
             for sid, spec in list(ready_steps()):
                 if spec.is_output_list:
-                    results[sid] = substitute(spec.args[0])
-                    storage.save_step_result(workflow_id, sid,
-                                             results[sid])
-                    done.add(sid)
+                    land(sid, substitute(spec.args[0]))
                     progressed = True
                     continue
                 args = substitute(spec.args)
@@ -305,9 +415,7 @@ def _execute_state(state: _WorkflowState, workflow_id: str,
         except BaseException:
             drain_pending()
             raise
-        storage.save_step_result(workflow_id, sid, value)
-        results[sid] = value
-        done.add(sid)
+        handle_result(sid, value)
 
     return results[state.output_step]
 

@@ -219,3 +219,96 @@ def test_cancel_then_resume(rt, tmp_path):
     open(gate, "w").write("go")      # let the slow step finish fast
     out = workflow.resume(wid)
     assert out == "a!"
+
+
+def test_continuation_recursion(wf):
+    """workflow.continuation: a step returning a sub-DAG expands in
+    place — recursive factorial (reference: workflow.continuation)."""
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    @ray_tpu.remote
+    def fact(n):
+        if n <= 1:
+            return 1
+        return workflow.continuation(mul.bind(n, fact.bind(n - 1)))
+
+    assert wf.run(fact.bind(5), workflow_id="wc1") == 120
+    assert wf.get_status("wc1") == WorkflowStatus.SUCCESSFUL
+
+
+def test_continuation_output_feeds_consumers(wf):
+    """A continuation in the MIDDLE of a DAG: its consumers receive
+    the sub-DAG's output, not the continuation object."""
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    def expand(x):
+        return workflow.continuation(double.bind(x))
+
+    @ray_tpu.remote
+    def add_one(x):
+        return x + 1
+
+    assert wf.run(add_one.bind(expand.bind(10)),
+                  workflow_id="wc2") == 21
+
+
+def test_continuation_resume_reuses_sub_checkpoints(wf, tmp_path):
+    """Crash mid-sub-workflow: resume re-runs the expanding parent
+    (never checkpointed), re-expands to the SAME sub-step ids, and
+    adopts already-checkpointed sub-steps instead of re-running them."""
+    marker = tmp_path / "allow"
+    counter = tmp_path / "count"
+
+    @ray_tpu.remote
+    def base():
+        import os
+        n = int(counter.read_text()) if os.path.exists(
+            str(counter)) else 0
+        counter.write_text(str(n + 1))
+        return 7
+
+    @ray_tpu.remote
+    def flaky(x):
+        import os
+        if not os.path.exists(str(marker)):
+            raise RuntimeError("boom")
+        return x * 10
+
+    @ray_tpu.remote
+    def expand():
+        return workflow.continuation(flaky.bind(base.bind()))
+
+    dag = expand.bind()
+    with pytest.raises(Exception):
+        wf.run(dag, workflow_id="wc3")
+    assert wf.get_status("wc3") == WorkflowStatus.FAILED
+    assert counter.read_text() == "1"        # base ran once
+
+    marker.write_text("ok")
+    assert wf.resume("wc3") == 70
+    # base's checkpoint was adopted on re-expansion, not re-executed
+    assert counter.read_text() == "1"
+
+
+def test_continuation_type_check():
+    with pytest.raises(TypeError, match="bound DAG"):
+        workflow.continuation(42)
+
+
+def test_continuation_deep_recursion_bounded_ids(wf):
+    """Regression: sub-step ids once nested a path component per
+    recursion level (ENAMETOOLONG ~depth 550); long parent ids now
+    collapse to digests, so deep tail recursion just works."""
+    @ray_tpu.remote
+    def countdown(n, acc):
+        if n == 0:
+            return acc
+        return workflow.continuation(countdown.bind(n - 1, acc + n))
+
+    assert wf.run(countdown.bind(600, 0),
+                  workflow_id="wc-deep") == 600 * 601 // 2
