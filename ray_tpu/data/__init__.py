@@ -12,7 +12,8 @@ from ray_tpu.data.datasources import (RandomAccessDataset,
                                       from_torch, read_binary_files,
                                       read_numpy, read_parquet,
                                       read_text, to_pandas, write_csv,
-                                      write_json, write_numpy)
+                                      write_json, write_numpy,
+                                      write_parquet)
 from ray_tpu.data.pipeline import DatasetPipeline
 
 
@@ -27,5 +28,5 @@ __all__ = [
     "from_huggingface", "range", "range_dataset",
     "read_csv", "read_json", "read_text", "read_binary_files",
     "read_numpy", "read_parquet", "to_pandas",
-    "write_csv", "write_json", "write_numpy",
+    "write_csv", "write_json", "write_numpy", "write_parquet",
 ]

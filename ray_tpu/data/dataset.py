@@ -885,6 +885,25 @@ class Dataset:
         from ray_tpu.data.datasources import write_csv
         return write_csv(self, path)
 
+    def write_parquet(self, path: str) -> str:
+        from ray_tpu.data.datasources import write_parquet
+        return write_parquet(self, path)
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        """Field -> type-name mapping sampled from the first non-empty
+        block (reference: Dataset.schema()). Scalar-row datasets
+        report {"value": <type>}; None when the dataset is empty."""
+        ds = self.materialize()
+        for ref in ds._block_refs:
+            block = ray_tpu.get(_truncate_block.remote(ref, 1))
+            if not block:
+                continue
+            row = block[0]
+            if isinstance(row, dict):
+                return {k: type(v).__name__ for k, v in row.items()}
+            return {"value": type(row).__name__}
+        return None
+
     def write_json(self, path: str) -> str:
         from ray_tpu.data.datasources import write_json
         return write_json(self, path)

@@ -147,6 +147,14 @@ def _union_fields(ds: Dataset) -> List[str]:
     return fields
 
 
+def _normalize_rows(block) -> List[Dict[str, Any]]:
+    """Record rows pass through; scalar rows wrap as {"value": r}
+    (the shared convention across every writer)."""
+    if block and isinstance(block[0], dict):
+        return block
+    return [{"value": r} for r in block]
+
+
 @ray_tpu.remote(num_cpus=0.25)
 def _write_block(block, path: str, fmt: str, column: Optional[str],
                  fields: Optional[List[str]] = None):
@@ -154,8 +162,7 @@ def _write_block(block, path: str, fmt: str, column: Optional[str],
     data/_internal write path — rows never pass through the driver)."""
     if fmt == "csv":
         import csv
-        rows = block if block and isinstance(block[0], dict) \
-            else [{"value": r} for r in block]
+        rows = _normalize_rows(block)
         with open(path, "w", newline="") as f:
             # one dataset-wide schema: every part file has the same
             # header, so parts concatenate cleanly downstream
@@ -174,10 +181,18 @@ def _write_block(block, path: str, fmt: str, column: Optional[str],
         else:
             arr = np.asarray(block)
         np.save(path, arr)
+    elif fmt == "parquet":
+        import pandas as pd
+        # Dataset-wide column union (same stance as csv): every part
+        # file carries one schema, so standard parquet dataset
+        # readers (pyarrow/Spark/DuckDB) accept the directory.
+        pd.DataFrame(_normalize_rows(block),
+                     columns=fields or None).to_parquet(path)
     return path
 
 
-_EXT = {"csv": "csv", "json": "json", "numpy": "npy"}
+_EXT = {"csv": "csv", "json": "json", "numpy": "npy",
+        "parquet": "parquet"}
 
 
 def _write(ds: Dataset, path: str, fmt: str,
@@ -188,7 +203,8 @@ def _write(ds: Dataset, path: str, fmt: str,
     at a time into a single file (constant driver memory)."""
     dir_mode = path.endswith(os.sep) or os.path.isdir(path)
     ds = ds.materialize()
-    fields = _union_fields(ds) if fmt == "csv" else None
+    fields = _union_fields(ds) if fmt in ("csv", "parquet") \
+        else None
     if dir_mode:
         os.makedirs(path, exist_ok=True)
         outs = [_write_block.remote(
@@ -199,6 +215,16 @@ def _write(ds: Dataset, path: str, fmt: str,
         ray_tpu.get(outs)
         return path
     # Single file: stream one block at a time through the driver.
+    if fmt == "parquet":
+        # One parquet file can't be appended to, so the whole dataset
+        # is on the driver either way (use the directory form for
+        # datasets larger than driver RAM) — fetch blocks in one
+        # batched get rather than serially.
+        import pandas as pd
+        frames = [pd.DataFrame(_normalize_rows(b), columns=fields)
+                  for b in ray_tpu.get(list(ds._block_refs))]
+        pd.concat(frames, ignore_index=True).to_parquet(path)
+        return path
     if fmt == "json":
         import json
         with open(path, "w") as f:
@@ -216,9 +242,7 @@ def _write(ds: Dataset, path: str, fmt: str,
             w.writeheader()
             for b in ds._block_refs:
                 block = ray_tpu.get(b)
-                w.writerows(
-                    block if block and isinstance(block[0], dict)
-                    else [{"value": r} for r in block])
+                w.writerows(_normalize_rows(block))
         return path
     # numpy: one array file needs the whole array once
     parts = []
@@ -236,6 +260,19 @@ def _write(ds: Dataset, path: str, fmt: str,
 
 def write_csv(ds: Dataset, path: str) -> str:
     return _write(ds, path, "csv")
+
+
+def write_parquet(ds: Dataset, path: str) -> str:
+    """Reference: Dataset.write_parquet — one part file per block in
+    directory mode, a single file otherwise."""
+    try:
+        import pyarrow  # noqa: F401
+    except ImportError:
+        raise ImportError(
+            "write_parquet requires pyarrow, which is not available "
+            "in this environment; use write_csv/write_json.") \
+            from None
+    return _write(ds, path, "parquet")
 
 
 def write_json(ds: Dataset, path: str) -> str:
