@@ -20,6 +20,7 @@ from ray_tpu.api import (
     get,
     get_actor,
     kill,
+    nodes,
     put,
     remote,
     timeline,
@@ -54,6 +55,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "cancel", "kill", "get_actor", "ObjectRef", "ActorClass", "ActorHandle",
     "RemoteFunction", "cluster_resources", "available_resources",
+    "nodes",
     "timeline", "method", "exceptions", "TaskError", "ActorDiedError",
     "ObjectLostError", "GetTimeoutError", "TaskCancelledError",
 ]

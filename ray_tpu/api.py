@@ -349,6 +349,13 @@ def available_resources() -> Dict[str, float]:
     return global_worker().runtime.available_resources()
 
 
+def nodes():
+    """Cluster node table (reference: ray.nodes() — the same rows the
+    state API's list_nodes serves)."""
+    from ray_tpu.state import list_nodes
+    return list_nodes()
+
+
 def timeline(filename: Optional[str] = None):
     from ray_tpu._private import profiling
     return profiling.chrome_trace(filename)

@@ -147,6 +147,54 @@ def _union_fields(ds: Dataset) -> List[str]:
     return fields
 
 
+@ray_tpu.remote(num_cpus=0.25)
+def _block_field_kinds(block) -> Dict[str, str]:
+    """field -> coarse kind ('bool'|'int'|'float'|'str'|'other') for
+    the parquet type union (O(blocks) dicts to the driver)."""
+    kinds: Dict[str, str] = {}
+    order = {"bool": 0, "int": 1, "float": 2, "str": 3, "other": 4}
+
+    def kind_of(v):
+        if isinstance(v, bool):
+            return "bool"
+        if isinstance(v, int):
+            return "int"
+        if isinstance(v, float):
+            return "float"
+        if isinstance(v, str):
+            return "str"
+        return "other"
+
+    for r in _normalize_rows(block):
+        for k, v in r.items():
+            nk = kind_of(v)
+            if k not in kinds or order[nk] > order[kinds[k]]:
+                # promotion: bool < int < float < str < other; a
+                # mixed int/float column unifies to float, anything
+                # with strings to str
+                kinds[k] = nk
+    return kinds
+
+
+_PANDAS_DTYPE = {"bool": "boolean", "int": "Int64",
+                 "float": "float64", "str": "string"}
+
+
+def _union_dtypes(ds: Dataset) -> Dict[str, str]:
+    """Dataset-wide field -> pandas (nullable) dtype, so every parquet
+    part file carries the SAME physical schema: a part missing a
+    column writes typed nulls, not NaN-inferred float64."""
+    order = {"bool": 0, "int": 1, "float": 2, "str": 3, "other": 4}
+    kinds: Dict[str, str] = {}
+    for part in ray_tpu.get([_block_field_kinds.remote(b)
+                             for b in ds._block_refs]):
+        for k, nk in part.items():
+            if k not in kinds or order[nk] > order[kinds[k]]:
+                kinds[k] = nk
+    return {k: _PANDAS_DTYPE[v] for k, v in kinds.items()
+            if v in _PANDAS_DTYPE}
+
+
 def _normalize_rows(block) -> List[Dict[str, Any]]:
     """Record rows pass through; scalar rows wrap as {"value": r}
     (the shared convention across every writer)."""
@@ -157,7 +205,8 @@ def _normalize_rows(block) -> List[Dict[str, Any]]:
 
 @ray_tpu.remote(num_cpus=0.25)
 def _write_block(block, path: str, fmt: str, column: Optional[str],
-                 fields: Optional[List[str]] = None):
+                 fields: Optional[List[str]] = None,
+                 dtypes: Optional[Dict[str, str]] = None):
     """Sink task: one output file per block (reference: write_* tasks,
     data/_internal write path — rows never pass through the driver)."""
     if fmt == "csv":
@@ -183,11 +232,16 @@ def _write_block(block, path: str, fmt: str, column: Optional[str],
         np.save(path, arr)
     elif fmt == "parquet":
         import pandas as pd
-        # Dataset-wide column union (same stance as csv): every part
-        # file carries one schema, so standard parquet dataset
-        # readers (pyarrow/Spark/DuckDB) accept the directory.
-        pd.DataFrame(_normalize_rows(block),
-                     columns=fields or None).to_parquet(path)
+        # Dataset-wide column AND dtype union (same stance as csv):
+        # every part file carries one physical schema — a part
+        # missing a column writes typed nulls, not NaN-cast float64 —
+        # so standard parquet dataset readers (pyarrow/Spark/DuckDB)
+        # accept the directory.
+        df = pd.DataFrame(_normalize_rows(block),
+                          columns=fields or None)
+        if dtypes:
+            df = df.astype(dtypes)
+        df.to_parquet(path)
     return path
 
 
@@ -205,12 +259,13 @@ def _write(ds: Dataset, path: str, fmt: str,
     ds = ds.materialize()
     fields = _union_fields(ds) if fmt in ("csv", "parquet") \
         else None
+    dtypes = _union_dtypes(ds) if fmt == "parquet" else None
     if dir_mode:
         os.makedirs(path, exist_ok=True)
         outs = [_write_block.remote(
                     b, os.path.join(
                         path, f"part-{i:05d}.{_EXT[fmt]}"),
-                    fmt, column, fields)
+                    fmt, column, fields, dtypes)
                 for i, b in enumerate(ds._block_refs)]
         ray_tpu.get(outs)
         return path
@@ -223,7 +278,10 @@ def _write(ds: Dataset, path: str, fmt: str,
         import pandas as pd
         frames = [pd.DataFrame(_normalize_rows(b), columns=fields)
                   for b in ray_tpu.get(list(ds._block_refs))]
-        pd.concat(frames, ignore_index=True).to_parquet(path)
+        df = pd.concat(frames, ignore_index=True)
+        if dtypes:
+            df = df.astype(dtypes)
+        df.to_parquet(path)
         return path
     if fmt == "json":
         import json

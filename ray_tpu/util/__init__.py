@@ -1,5 +1,11 @@
+from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (placement_group,
                                           remove_placement_group)
+from ray_tpu.util.queue import Queue
+# Submodules reachable as attributes (reference: ray.util.metrics /
+# ray.util.collective / ray.util.iter usage style).
+from ray_tpu.util import (collective, iter, metrics,  # noqa: F401,A004
+                          tracing)
 from ray_tpu._private.task_spec import (
     DefaultSchedulingStrategy,
     NodeAffinitySchedulingStrategy,
@@ -9,8 +15,12 @@ from ray_tpu._private.task_spec import (
 )
 
 __all__ = [
+    "ActorPool", "Queue",
     "placement_group", "remove_placement_group",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
     "SpreadSchedulingStrategy", "DefaultSchedulingStrategy",
     "SliceAffinitySchedulingStrategy",
+    # Submodules (collective/iter/metrics/tracing) stay reachable as
+    # attributes but are deliberately NOT in __all__: star-importing a
+    # module named `iter` would shadow the builtin.
 ]

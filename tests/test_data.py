@@ -433,3 +433,12 @@ def test_parquet_parts_share_one_schema(rt, tmp_path):
     table = pq.read_table(out)      # raises on schema mismatch
     assert set(table.column_names) == {"a", "b"}
     assert table.num_rows == 4
+    # PHYSICAL schemas match too: a part missing a column writes
+    # typed nulls, not NaN-inferred float64, so strict readers
+    # (DuckDB, Spark sans mergeSchema) accept the directory
+    import os
+    import pyarrow.parquet as _pq
+    parts = sorted(os.listdir(out))
+    schemas = [_pq.read_schema(out + p) for p in parts]
+    assert all(s.equals(schemas[0]) for s in schemas[1:]), schemas
+    assert "int64" in str(schemas[0].field("a").type)
