@@ -19,7 +19,8 @@ class TrainContext:
     def __init__(self, world_rank: int, world_size: int,
                  report_fn, mesh=None, trial_info: Optional[Dict] = None,
                  checkpoint: Optional[Checkpoint] = None,
-                 config: Optional[Dict[str, Any]] = None):
+                 config: Optional[Dict[str, Any]] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.report_fn = report_fn
@@ -27,6 +28,7 @@ class TrainContext:
         self.trial_info = trial_info or {}
         self.loaded_checkpoint = checkpoint
         self.config = config or {}
+        self.datasets = datasets or {}
 
 
 def _require_ctx() -> TrainContext:
@@ -67,6 +69,19 @@ def get_world_size() -> int:
 def get_mesh():
     """The jax device mesh built for this gang (None for CPU loops)."""
     return _require_ctx().mesh
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a dataset passed to the trainer via
+    ``datasets={name: ds}`` (reference: session.get_dataset_shard —
+    equal-row shards, iterate with iter_batches /
+    iter_torch_batches / iter_device_batches)."""
+    ctx = _require_ctx()
+    if name not in ctx.datasets:
+        raise KeyError(
+            f"no dataset {name!r} was passed to the trainer "
+            f"(available: {sorted(ctx.datasets)})")
+    return ctx.datasets[name]
 
 
 def get_trial_info() -> Dict[str, Any]:

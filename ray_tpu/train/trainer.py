@@ -39,12 +39,17 @@ class BaseTrainer:
                  train_loop_config: Optional[Dict[str, Any]] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
-                 resume_from_checkpoint: Optional[Checkpoint] = None):
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
         self._loop = train_loop_per_worker
         self._config = train_loop_config or {}
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self._resume = resume_from_checkpoint
+        # name -> ray_tpu.data.Dataset, sharded per worker at fit()
+        # (reference: DataParallelTrainer datasets kwarg +
+        # session.get_dataset_shard)
+        self._datasets = datasets or {}
 
     # Subclasses decide the mesh the gang builds (None = no device mesh).
     def _mesh_axes(self) -> Optional[Dict[str, int]]:
@@ -122,11 +127,23 @@ class BaseTrainer:
         stopper = coerce_stopper(getattr(self.run_config, "stop",
                                          None))
         stop_requested = False
+        datasets_per_rank = None
+        if self._datasets:
+            # Equal-row shards per worker (slice task graph — rows
+            # never visit the driver); each rank sees only its shard
+            # via session.get_dataset_shard(name).
+            per_name = {name: ds.split(sc.num_workers)
+                        for name, ds in self._datasets.items()}
+            datasets_per_rank = [
+                {name: shards[rank]
+                 for name, shards in per_name.items()}
+                for rank in range(sc.num_workers)]
         try:
             run_refs = group.start_run(self._loop, self._config,
                                        self._mesh_axes(), resume_ckpt,
                                        self._backend_setup(),
-                                       self._use_jax_distributed(group))
+                                       self._use_jax_distributed(group),
+                                       datasets_per_rank)
             done = [False] * sc.num_workers
             error: Optional[BaseException] = None
             while not all(done) and error is None and \

@@ -49,7 +49,8 @@ class TrainWorker:
             mesh_axes: Optional[Dict[str, int]],
             resume_checkpoint: Optional[Checkpoint],
             backend_setup: Optional[Callable] = None,
-            gang_bootstrap: Optional[Dict[str, Any]] = None) -> str:
+            gang_bootstrap: Optional[Dict[str, Any]] = None,
+            datasets: Optional[Dict[str, Any]] = None) -> str:
         if gang_bootstrap is not None:
             # Join the jax.distributed gang BEFORE any jax computation:
             # after this, jax.devices() spans every member's chips and
@@ -75,7 +76,8 @@ class TrainWorker:
         ctx = air_session.TrainContext(
             world_rank=self.rank, world_size=self.world_size,
             report_fn=report_fn, mesh=mesh,
-            checkpoint=resume_checkpoint, config=config)
+            checkpoint=resume_checkpoint, config=config,
+            datasets=datasets)
         air_session.set_context(ctx)
         try:
             if _takes_arg(loop_fn):
@@ -198,7 +200,8 @@ class WorkerGroup:
                 gang.PROCESS_UUID not in ids)
 
     def start_run(self, loop_fn, config, mesh_axes, resume_checkpoint,
-                  backend_setup=None, jax_distributed=False):
+                  backend_setup=None, jax_distributed=False,
+                  datasets_per_rank=None):
         gang_bootstrap = None
         if jax_distributed:
             coordinator = ray_tpu.get(
@@ -207,8 +210,10 @@ class WorkerGroup:
                               "num_processes": self.num_workers}
         return [w.run.remote(loop_fn, config, mesh_axes,
                              resume_checkpoint, backend_setup,
-                             gang_bootstrap)
-                for w in self.workers]
+                             gang_bootstrap,
+                             datasets_per_rank[rank]
+                             if datasets_per_rank else None)
+                for rank, w in enumerate(self.workers)]
 
     def poll_all(self) -> List[Dict[str, Any]]:
         return ray_tpu.get([w.poll.remote() for w in self.workers])

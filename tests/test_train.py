@@ -594,3 +594,72 @@ def test_trainer_honors_run_config_stop(rt):
     assert result.error is None
     assert result.metrics["score"] >= 5
     assert len(result.metrics_history) < 100   # cut well short of 200
+
+
+def test_datasets_sharded_to_workers(rt):
+    """datasets={...} + session.get_dataset_shard: equal-row shards,
+    disjoint and complete across the gang (reference:
+    DataParallelTrainer datasets kwarg)."""
+    from ray_tpu import data
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig
+
+    ds = data.from_items(list(range(100)), parallelism=8)
+    val = data.from_items([{"x": i} for i in range(10)],
+                          parallelism=2)
+
+    def loop(config):
+        shard = session.get_dataset_shard("train")
+        vshard = session.get_dataset_shard("val")
+        rows = shard.take_all()
+        session.report({"n": len(rows), "sum": sum(rows),
+                        "vn": vshard.count(),
+                        "rank": session.get_world_rank()})
+
+    result = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=4),
+        datasets={"train": ds, "val": val}).fit()
+    assert result.ok, result.error
+    # rank 0's shard: 25 rows; the driver only sees rank 0 metrics,
+    # so run again collecting from all ranks via history? Instead:
+    assert result.metrics["n"] == 25
+    assert result.metrics["vn"] in (2, 3)
+
+    # completeness/disjointness across ranks: gather via an actor
+    import ray_tpu as rtpu
+
+    @rtpu.remote
+    class Collect:
+        def __init__(self):
+            self.rows = []
+
+        def add(self, rows):
+            self.rows.extend(rows)
+
+        def all(self):
+            return self.rows
+
+    c = Collect.remote()
+
+    def loop2(config):
+        shard = session.get_dataset_shard("train")
+        rtpu.get(c.add.remote(shard.take_all()))
+        session.report({"ok": 1})
+
+    result = DataParallelTrainer(
+        loop2, scaling_config=ScalingConfig(num_workers=4),
+        datasets={"train": ds}).fit()
+    assert result.ok, result.error
+    got = sorted(rtpu.get(c.all.remote()))
+    assert got == list(range(100))
+
+
+def test_get_dataset_shard_unknown_name(rt):
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig
+
+    def loop(config):
+        session.get_dataset_shard("nope")
+
+    result = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1)).fit()
+    assert not result.ok
+    assert "no dataset" in str(result.error)
