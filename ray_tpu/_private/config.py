@@ -63,10 +63,12 @@ define_flag("borrow_grace_s", float, 3.0,
 define_flag("bulk_pull_global_slots", int, 2,
             "Cluster-wide cap on concurrent bulk pulls. On shared/"
             "virtualized hosts concurrent bulk memory traffic "
-            "degrades superlinearly (measured 0.8s solo vs 28s x4 for "
-            "a 1 GiB copy), so transfers are serialized near the "
-            "host's effective bandwidth; raise on real multi-host "
-            "clusters where each node has its own memory bus.")
+            "degrades superlinearly (originally 0.8s solo vs 28s x4 "
+            "for a 1 GiB copy; reproduce on any host with "
+            "tools/bench_broadcast_degradation.py), so transfers are "
+            "serialized near the host's effective bandwidth; raise "
+            "on real multi-host clusters where each node has its own "
+            "memory bus.")
 define_flag("default_max_retries", int, 3,
             "Default max_retries for normal tasks.")
 define_flag("actor_restart_backoff_ms", int, 0,

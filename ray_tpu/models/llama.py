@@ -140,36 +140,35 @@ class LlamaAttention(nn.Module):
 
         new_cache = None
         if isinstance(kv_cache, PagedKVLayer):
-            # Paged decode (continuous batching): T == 1, per-slot
-            # positions. Scatter this step's K/V into the slot's
-            # current page, then attend over the slot's gathered page
-            # window. Inactive slots carry page_table rows of 0 (the
-            # null page) — their writes land there and their outputs
-            # are ignored host-side, so no lax.cond is needed.
+            # Paged attention (continuous batching) with per-slot
+            # positions. T == 1 is the decode step; T > 1 is a
+            # chunked-prefill chunk whose tokens APPEND AT OFFSET
+            # (possibly mid-page, possibly spanning pages). Scatter
+            # this chunk's K/V into the slots' pages, then attend
+            # each query over its slot's gathered page window under
+            # a causal mask on absolute positions. Inactive slots
+            # carry page_table rows of 0 (the null page) — their
+            # writes land there and their outputs are ignored
+            # host-side, so no lax.cond is needed.
             pc = kv_cache
             pos = cache_len                       # [B] int32
             Pg = pc.page_size
-            bidx = jnp.arange(B)
-            page_idx = pc.page_table[bidx, pos // Pg]      # [B]
-            off = pos % Pg
-            # Head-major pool [KH, n_pages, Pg, D]: scatter each
-            # slot's new K/V as a [KH, B, D] update at [:, page, off].
-            kT = k[:, 0].astype(pc.pages_k.dtype).transpose(1, 0, 2)
-            vT = v[:, 0].astype(pc.pages_v.dtype).transpose(1, 0, 2)
-            pk = pc.pages_k.at[:, page_idx, off].set(kT)
-            pv = pc.pages_v.at[:, page_idx, off].set(vT)
+            from ray_tpu.ops.paged_attention import paged_append
+            pk, pv = paged_append(pc.pages_k, pc.pages_v,
+                                  pc.page_table, pos, k, v)
             new_cache = pc._replace(pages_k=pk, pages_v=pv)
-            if _use_paged_kernel():
-                # TPU: pallas paged-attention kernel — page table
-                # rides scalar prefetch; the page window is never
-                # materialized (ops/paged_attention.py).
+            if T == 1 and _use_paged_kernel():
+                # TPU decode: pallas paged-attention kernel — page
+                # table rides scalar prefetch; the page window is
+                # never materialized (ops/paged_attention.py).
                 y = paged_decode_attention(
                     q[:, 0], pk, pv, pc.page_table, pos)
                 y = y.reshape(B, 1, cfg.n_heads, hd)
             else:
-                # CPU/XLA fallback: gather the page window dense.
-                # [KH, B, max_pages, Pg, D] -> [KH, B, L, D]; gathered
-                # index == logical sequence position by construction.
+                # CPU/XLA fallback and chunk prefill: gather the page
+                # window dense. [KH, B, max_pages, Pg, D] ->
+                # [KH, B, L, D]; gathered index == logical sequence
+                # position by construction.
                 L = pc.page_table.shape[1] * Pg
                 kg = pk[:, pc.page_table].reshape(
                     cfg.n_kv_heads, B, L, hd)
@@ -185,8 +184,12 @@ class LlamaAttention(nn.Module):
                 scores = jnp.einsum(
                     "btkrd,kbsd->bkrts", qg.astype(jnp.float32),
                     kg.astype(jnp.float32)) / np.sqrt(hd)
-                valid = jnp.arange(L)[None] <= pos[:, None]  # [B, L]
-                scores = jnp.where(valid[:, None, None, None, :],
+                # causal over absolute positions: query t of slot b
+                # sits at pos[b] + t and sees keys 0..pos[b]+t
+                q_pos = pos[:, None] + jnp.arange(T)[None]   # [B, T]
+                valid = jnp.arange(L)[None, None] <= \
+                    q_pos[:, :, None]                        # [B, T, L]
+                scores = jnp.where(valid[:, None, None],
                                    scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1)
                 y = jnp.einsum("bkrts,kbsd->btkrd",

@@ -53,6 +53,45 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def paged_append(pages_k, pages_v, page_table, pos, k, v):
+    """Scatter a [B, T] chunk of new K/V into the head-major page pool
+    at each slot's current write offset (append-at-offset: the chunk
+    may START mid-page and SPAN page boundaries — the partial-prompt
+    case chunked prefill creates).
+
+    pages_k/pages_v: [KH, n_pages, Pg, D] (head-major pool)
+    page_table:      [B, max_pages] int32 (0 = null page)
+    pos:             [B] int32 — first token of the chunk lands at
+                     logical position ``pos[b]``
+    k/v:             [B, T, KH, D] new keys/values
+
+    Token t of row b goes to physical page
+    ``page_table[b, (pos[b]+t) // Pg]`` at offset ``(pos[b]+t) % Pg``.
+    Positions past the row's allocated pages resolve to page-table
+    entries of 0 (the null page), so oversized/padding tails scatter
+    harmlessly — the same null-page discipline the decode step uses
+    for inactive slots. Logical positions are clamped to the
+    addressable window so a padded tail can never alias another
+    slot's pages through index clamping.
+    """
+    B, T = k.shape[:2]
+    Pg = pages_k.shape[2]
+    max_pages = page_table.shape[1]
+    tpos = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None]  # [B, T]
+    tpos = jnp.minimum(tpos, max_pages * Pg - 1)
+    pidx = jnp.take_along_axis(page_table, tpos // Pg, axis=1)  # [B, T]
+    off = tpos % Pg
+    flat_p = pidx.reshape(-1)
+    flat_o = off.reshape(-1)
+    # [B, T, KH, D] -> [KH, B*T, D] to match the head-major pool.
+    kT = k.astype(pages_k.dtype).reshape(B * T, -1, k.shape[-1]
+                                         ).transpose(1, 0, 2)
+    vT = v.astype(pages_v.dtype).reshape(B * T, -1, v.shape[-1]
+                                         ).transpose(1, 0, 2)
+    return (pages_k.at[:, flat_p, flat_o].set(kT),
+            pages_v.at[:, flat_p, flat_o].set(vT))
+
+
 def _kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
             m_sc, l_sc, acc_sc, *, page_size: int, scale: float):
     b = pl.program_id(0)

@@ -24,6 +24,20 @@ TPU/XLA design:
   syncs exactly when a scheduling decision is possible — host round
   trips (~84ms through a tunneled device) never gate the token rate.
   Join/leave granularity under load is ``chunk`` tokens.
+- Prefill is CHUNKED and interleaved with decode: prompts advance by
+  at most ``prefill_chunk`` tokens per scheduling round (a shared
+  per-round token budget packed across up to ``_max_prefill_batch``
+  mid-prefill slots), and every round dispatches the prefill chunk
+  immediately followed by a short decode chunk, so in-flight decode
+  never stalls for a whole prompt the way monolithic padded-batch
+  prefill stalls it. Admission only needs pages for the FIRST chunk
+  (chunk-budget admission), later chunks grow pages like decode
+  does. A request's first token is sampled by the chunk that
+  consumes the END of its prompt and is emitted to the stream right
+  then — TTFT is one prompt-prefill, not prompt-prefill plus a
+  decode-chunk drain. The round planner itself is pure and
+  device-free (serve/scheduler.py) so CPU tests drive it
+  deterministically.
 - Preemption is recompute-based: when the pool runs dry the youngest
   slot is evicted, its pages freed, and the request requeued with
   prompt = original prompt + tokens generated so far, so clients see
@@ -41,6 +55,7 @@ import dataclasses
 import itertools
 import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -49,6 +64,7 @@ import numpy as np
 
 from ray_tpu.models.kv_cache import (BlockAllocator, PagedKVLayer,
                                      init_kv_pool)
+from ray_tpu.serve.scheduler import StepPlan, SlotView, plan_step
 
 _DONE = object()
 
@@ -77,6 +93,8 @@ class _Request:
     preemptions: int = 0
     error: Optional[BaseException] = None
     closed: bool = False         # _DONE delivered; drop late tokens
+    t_submit: float = 0.0        # monotonic clock at submit()
+    t_first: Optional[float] = None   # first token EMITTED to stream
 
     @property
     def remaining(self) -> int:
@@ -111,6 +129,16 @@ class RequestHandle:
             pass
         return list(self._req.generated)
 
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Submit-to-first-emission latency, stamped when the first
+        token is PUT ON THE REQUEST STREAM (end of this request's
+        prefill) — not when a decode chunk later drains. None until
+        the first token is out."""
+        if self._req.t_first is None:
+            return None
+        return self._req.t_first - self._req.t_submit
+
 
 @dataclasses.dataclass
 class _Slot:
@@ -124,9 +152,17 @@ class _Slot:
                                  # DEVICE (dev_cur), never read back
                                  # for dispatching
     admit_seq: int               # LIFO preemption order
+    prompt: List[int] = dataclasses.field(default_factory=list)
+                                 # recompute-prompt snapshot being
+                                 # prefilled (chunk by chunk)
+    prefilled: int = 0           # prompt tokens whose KV is in pages
     decoded: int = 0             # decode steps ridden (dispatch-time
                                  # arithmetic, ahead of emission)
     preempted: bool = False     # in-flight tokens must be discarded
+
+    @property
+    def prefill_remaining(self) -> int:
+        return len(self.prompt) - self.prefilled
 
 
 class LLMEngine:
@@ -139,11 +175,19 @@ class LLMEngine:
     page_size: tokens per KV page.
     n_pages: physical pages in the pool (page 0 reserved as null).
     chunk: decode steps per device dispatch (host-sync amortization).
+    prefill_chunk: prompt-token budget per scheduling round, shared
+        across the mid-prefill slots scheduled that round. Prompts
+        longer than this prefill over several rounds with decode
+        chunks interleaved between them, so a long arrival cannot
+        stall in-flight streams; smaller values tighten decode
+        latency under prefill load, larger values finish prompts
+        (and thus first tokens) in fewer rounds.
     """
 
     def __init__(self, model, params, *, max_slots: int = 8,
                  page_size: int = 16, n_pages: int = 256,
-                 chunk: int = 4, temperature: float = 0.0,
+                 chunk: int = 4, prefill_chunk: Optional[int] = None,
+                 temperature: float = 0.0,
                  eos_id: Optional[int] = None, seed: int = 0,
                  max_prefill_compiles: int = 16):
         self.model = model
@@ -152,6 +196,7 @@ class LLMEngine:
         self.S = max_slots
         self.Pg = page_size
         self.K = chunk
+        self.PC = max(1, int(prefill_chunk or 256))
         self.temperature = temperature
         self.eos_id = eos_id
         # Run-ahead ceiling: one dispatch may decode up to this many
@@ -190,12 +235,24 @@ class LLMEngine:
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
         self.stats: Dict[str, int] = collections.Counter()
+        # Chunked prefill compiles one executable per pow2 chunk
+        # bucket (floor page_size, cap prefill_chunk) — a handful of
+        # variants total, vs the old one-per-prompt-length cache
+        # whose misses were measured as multi-second p99 stalls.
         self._prefill_cache: "collections.OrderedDict" = \
             collections.OrderedDict()
         self._max_prefill_compiles = max_prefill_compiles
-        # same-length waiting requests prefill together (one jitted
-        # call, bucketed batch) up to this width
+        # mid-prefill slots share each round's token budget up to
+        # this batch width (one jitted call, fixed row count)
         self._max_prefill_batch = 4
+        # dispatch-order trace for tests/debugging: ("prefill",
+        # ((slot, tokens), ...)) and ("decode", steps) entries in the
+        # order the device will execute them
+        self.sched_trace: "collections.deque" = \
+            collections.deque(maxlen=4096)
+        # submit->first-emission latencies (seconds), most recent
+        self.ttfts_s: "collections.deque" = \
+            collections.deque(maxlen=4096)
         self._decode_fn = self._build_decode()
         self._seed_fn = self._build_seed()
 
@@ -218,7 +275,8 @@ class LLMEngine:
             raise RequestError(
                 f"prompt+completion {total} exceeds model "
                 f"max_seq_len {self.cfg.max_seq_len}")
-        req = _Request(next(self._rid), prompt_ids, max_new_tokens)
+        req = _Request(next(self._rid), prompt_ids, max_new_tokens,
+                       t_submit=time.monotonic())
         with self._work:
             if self._stopped:
                 raise RequestError("engine stopped")
@@ -249,14 +307,19 @@ class LLMEngine:
     def step(self) -> bool:
         """One scheduler iteration, DEVICE-PACED:
 
-            admit -> grow/preempt -> dispatch chunk k+1
+            admit -> plan round -> dispatch prefill chunk
+                  -> grow/preempt -> dispatch decode chunk k+1
                   -> fetch chunk k's tokens (trailing)
 
-        Dispatch k+1 has NO data dependency on k's readback: the
-        next-token input and write positions chain on device
-        (dev_cur/dev_pos), admission seeds slot rows with a jitted
-        scatter, and — with no eos configured — completions are
-        dispatch-time arithmetic. The readback of chunk k then
+        The round packs a prefill chunk AND a decode chunk: both are
+        dispatched asynchronously back to back, so the device
+        pipeline interleaves ``P D P D ...`` and in-flight decode is
+        delayed by at most one bounded prefill chunk per round —
+        never by a whole prompt. Decode dispatch k+1 has NO data
+        dependency on k's readback: the next-token input and write
+        positions chain on device (dev_cur/dev_pos), seeding rides a
+        jitted scatter, and — with no eos configured — completions
+        are dispatch-time arithmetic. The readback of chunk k then
         overlaps chunk k+1's compute, so neither the device round
         trip nor a slow host thread gates the token rate. With an
         eos, sampled tokens decide completion, so the iteration
@@ -278,10 +341,12 @@ class LLMEngine:
                     self._drain_fetches_locked(limit=1)
                     return True
                 return False
-            steps = self._plan_steps_locked()
-            if steps:
-                self._grow_or_preempt_locked(steps)
-                self._dispatch_chunk_locked(steps)
+            plan = self._plan_steps_locked()
+            if plan.prefill:
+                self._dispatch_prefill_locked(plan.prefill)
+            if plan.decode_steps:
+                self._grow_or_preempt_locked(plan.decode_steps)
+                self._dispatch_chunk_locked(plan.decode_steps)
                 if self._deferred:
                     self._retire_planned_locked()
             # trailing readback: block only on a dispatch OLDER than
@@ -290,33 +355,25 @@ class LLMEngine:
             self._drain_fetches_locked(limit=1, keep=1)
             return True
 
-    def _plan_steps_locked(self) -> int:
-        """How many decode steps the next dispatch should run.
-
-        The host knows every slot's remaining budget, so when the
-        batch is FULL it runs ahead on-device to the next completion
-        event (min remaining over riders) — the only moment a
-        scheduling decision is possible — instead of syncing every
-        ``chunk`` steps. With a free slot, stick to ``chunk``-step
-        dispatches so arrivals are admitted promptly. Never sync more
-        often than ``chunk`` (a nearly-done slot rides a full window;
-        its surplus steps land in the null page and are discarded).
-        With an eos_id, run-ahead is bounded: tokens past an
-        unpredicted EOS are wasted work."""
-        rem = [self._owed(s) for s in self.slots
-               if s is not None and s.cur is not None]
-        if not rem:
-            return 0         # all occupied slots await their seed
-        # an unseeded slot joins at the next sync — treat it like a
-        # free slot and keep the quick cadence
-        free = any(s is None or s.cur is None for s in self.slots)
-        if free:
-            steps = self.K
-        else:
-            steps = max(self.K, min(rem))
-        if self.eos_id is not None:
-            steps = min(steps, 2 * self.K)
-        return max(1, min(steps, self.KMAX))
+    def _plan_steps_locked(self) -> StepPlan:
+        """Plan this round with the pure, device-free planner
+        (serve/scheduler.py plan_step): which mid-prefill slots
+        advance under the shared ``prefill_chunk`` token budget, and
+        how many decode steps ride behind them. Run-ahead-to-next-
+        completion, quick cadence while admission work is pending,
+        and the eos bound all live in the planner — this wrapper only
+        snapshots slot state."""
+        views = [SlotView(sid=i, admit_seq=s.admit_seq,
+                          prompt_remaining=s.prefill_remaining,
+                          owed=self._owed(s) if s.cur is not None
+                          else 0,
+                          seeded=s.cur is not None)
+                 for i, s in enumerate(self.slots) if s is not None]
+        return plan_step(views, total_slots=self.S,
+                         prefill_budget=self.PC, decode_chunk=self.K,
+                         max_run_ahead=self.KMAX,
+                         prefill_batch=self._max_prefill_batch,
+                         eos_bounded=self.eos_id is not None)
 
     def _owed(self, slot: _Slot) -> int:
         """Decode steps this slot still needs, by dispatch-time
@@ -390,71 +447,75 @@ class LLMEngine:
             self._stopped = True
 
     def _admit_locked(self):
+        """Chunk-budget admission: a waiting request takes a free
+        slot as soon as pages for its FIRST prefill chunk exist —
+        not its whole prompt. The prompt then advances chunk by
+        chunk in the scheduling rounds (no monolithic padded-batch
+        prefill, no same-padded-length grouping: the chunked prefill
+        call batches mixed lengths and offsets natively). FIFO:
+        admission never reorders past the queue head."""
         while self._wait:
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
                 return
-            # Batched prefill: take the FIFO PREFIX of the wait queue
-            # sharing the head request's padded length (fixed-shape
-            # serving traffic batches fully; mixed lengths degrade to
-            # batch 1 — never reordering past a different-length
-            # request keeps admission fair).
-            head_pad = -(-max(1, len(self._wait[0].recompute_prompt))
-                         // self.Pg) * self.Pg
-            group = []
-            for req in self._wait:
-                if len(group) >= min(len(free), self._max_prefill_batch):
-                    break
-                prompt = req.recompute_prompt
-                pad = -(-max(1, len(prompt)) // self.Pg) * self.Pg
-                if pad != head_pad:
-                    break
-                n0 = max(1, -(-len(prompt) // self.Pg))
-                page_ids = self.alloc.alloc(n0)
-                if page_ids is None:
-                    break      # pool dry: wait for completions
-                group.append((req, prompt, page_ids))
-            if not group:
-                return
-            for _ in group:
-                self._wait.popleft()
-            try:
-                firsts = self._prefill_batch(
-                    [(p, pids) for _, p, pids in group], head_pad)
-            except BaseException as e:
-                for req, _p, pids in group:
-                    self.alloc.free(pids)
-                    req.error = e
-                    req.out_q.put(_DONE)
+            req = self._wait[0]
+            prompt = req.recompute_prompt
+            first = max(1, min(len(prompt), self.PC))
+            page_ids = self.alloc.alloc(-(-first // self.Pg))
+            if page_ids is None:
+                return         # pool dry: wait for completions
+            self._wait.popleft()
+            slot = _Slot(req=req, pages=page_ids, pos=0, cur=None,
+                         admit_seq=next(self._admit_seq),
+                         prompt=prompt)
+            self.slots[free[0]] = slot
+            self.stats["admitted"] += 1
+
+    def _dispatch_prefill_locked(self, grants):
+        """Execute this round's prefill grants: grow each granted
+        slot's pages to cover its chunk (evicting the youngest OTHER
+        slot when the pool runs dry, exactly like decode growth),
+        then dispatch ONE batched chunked-prefill call for every
+        surviving grant. Rows carry independent start offsets and
+        lengths, so mixed prompt lengths and mid-prompt resumptions
+        batch together."""
+        rows = []
+        for g in grants:
+            slot = self.slots[g.sid]
+            if slot is None:
+                continue       # evicted by an earlier grant's growth
+            take = min(g.tokens, slot.prefill_remaining)
+            if take <= 0:
                 continue
-            placements = []
-            for row, ((req, prompt, page_ids), ix) in enumerate(
-                    zip(group, free)):
-                slot = _Slot(req=req, pages=page_ids,
-                             pos=len(prompt), cur=None,
-                             admit_seq=next(self._admit_seq))
-                self.slots[ix] = slot
-                self.stats["admitted"] += 1
-                placements.append((ix, slot, row))
-            # Seed the device decode state from the prefill output
-            # WITHOUT a host sync: scatter firsts/positions into
-            # dev_cur/dev_pos rows on-stream, after which the slots
-            # ride the very next dispatch.
-            B = self._max_prefill_batch
-            ixs = np.full((B,), self.S, np.int32)   # S = dropped row
-            rows = np.zeros((B,), np.int32)
-            posv = np.zeros((B,), np.int32)
-            for r, (ix, slot, row) in enumerate(placements):
-                ixs[r], rows[r], posv[r] = ix, row, slot.pos
-            self._dev_cur, self._dev_pos = self._seed_fn(
-                self._dev_cur, self._dev_pos, firsts,
-                jnp.asarray(ixs), jnp.asarray(rows), jnp.asarray(posv))
-            for ix, slot, _row in placements:
-                slot.cur = -1      # device-seeded: ridable
-            # firsts also stays on device for EMISSION: its readback
-            # rides the next trailing sync, so admission never stalls
-            # the decode stream on a host RTT
-            self._pending_prefill.append((firsts, placements))
+            need = -(-(slot.prefilled + take) // self.Pg)
+            evicted = False
+            while len(slot.pages) < need:
+                if self.slots[g.sid] is not slot:
+                    evicted = True
+                    break
+                got = self.alloc.alloc(need - len(slot.pages))
+                if got is not None:
+                    slot.pages.extend(got)
+                    break
+                victim = max(
+                    (j for j, s in enumerate(self.slots)
+                     if s is not None and j != g.sid),
+                    key=lambda j: self.slots[j].admit_seq,
+                    default=None)
+                if victim is None:
+                    # alone and still can't grow: submit() guarantees
+                    # a lone request fits, so this is a logic error
+                    raise RuntimeError(
+                        "page pool exhausted by one slot")
+                self._preempt_locked(victim)
+            if not evicted and self.slots[g.sid] is slot:
+                rows.append((g.sid, slot, take))
+        # a LATER grant's growth can evict an EARLIER grant's slot
+        # (victim choice is global youngest) — refilter before dispatch
+        rows = [(ix, slot, take) for ix, slot, take in rows
+                if self.slots[ix] is slot]
+        if rows:
+            self._prefill_batch(rows)
 
     def _grow_or_preempt_locked(self, steps: int):
         """Ensure every active slot's pages cover this dispatch's
@@ -527,6 +588,10 @@ class LLMEngine:
             # dispatch (the tail of an overshooting window is junk)
             take = min(steps, max(0, self._owed(slot)))
             riders.append((i, slot, take))
+        if not riders:
+            # every planned rider was preempted by this round's
+            # prefill growth — an empty dispatch would decode junk
+            return
         (toks, self.pages, self._rng, self._dev_pos,
          self._dev_cur) = self._decode_fn(
             self.params, self.pages, jnp.asarray(pt),
@@ -537,6 +602,7 @@ class LLMEngine:
             slot.pos += steps
             slot.decoded += steps
         self._fetchq.append((toks, riders, steps))
+        self.sched_trace.append(("decode", steps))
         self.stats["chunks"] += 1
         self.stats["decode_steps"] += steps
 
@@ -610,6 +676,12 @@ class LLMEngine:
         done = False
         for t in tokens:
             t = int(t)
+            if req.t_first is None:
+                # TTFT is stamped HERE — the moment the token reaches
+                # the request stream — not when a later decode chunk
+                # drains (the accounting bug the r05 bench carried)
+                req.t_first = time.monotonic()
+                self.ttfts_s.append(req.t_first - req.t_submit)
             req.generated.append(t)
             req.out_q.put(t)
             if ((self.eos_id is not None and t == self.eos_id)
@@ -627,70 +699,110 @@ class LLMEngine:
 
     # ----------------------------------------------------- jitted fns
 
-    def _prefill_batch(self, items, T0pad: int) -> List[int]:
-        """Prefill up to _max_prefill_batch same-padded-length prompts
-        in ONE jitted call (bucketed batch: pad rows with dummies that
-        scatter into the null page). items: [(prompt, page_ids), ...]"""
-        n = len(items)
-        # FIXED batch width: one executable per prompt length (dummy
-        # rows scatter into the null page). Bucketed widths would
-        # compile B=1/2/4 variants lazily — measured as multi-second
-        # p99 stalls mid-load; a few dummy prefill rows are far
-        # cheaper than a retrace.
+    def _prefill_batch(self, rows) -> None:
+        """Dispatch ONE chunked-prefill call advancing up to
+        ``_max_prefill_batch`` slots' prompts by their granted
+        lengths. rows: [(slot index, slot, take), ...].
+
+        Each row appends ``take`` prompt tokens AT ITS OWN OFFSET
+        into its own pages (the paged-KV append-at-offset path:
+        chunks start mid-page and span pages), so mixed lengths,
+        mixed offsets, and resumed prompts share one executable —
+        the old path compiled one executable per padded prompt
+        length, measured as multi-second p99 stalls on cache misses.
+        The chunk width is bucketed to a power of two (floor
+        page_size, cap prefill_chunk): a handful of variants total.
+        Rows whose chunk ENDS the prompt sample the request's first
+        token from the chunk logits; it is seeded into the device
+        decode state with an on-stream scatter (no host sync) and
+        queued for emission at the next trailing readback — the
+        first streamed token goes out at end-of-prompt-prefill,
+        never after a decode-chunk drain. Unused batch rows point at
+        the null page and are dropped by the seed scatter."""
         B = self._max_prefill_batch
-        n_pages = T0pad // self.Pg
-        fn = self._prefill_cache.get((T0pad, B))
+        mx = max(take for _ix, _s, take in rows)
+        T = max(1, min(self.PC, self.Pg))
+        while T < mx:
+            T *= 2
+        T = min(T, self.PC)
+        fn = self._prefill_cache.get(T)
         if fn is None:
-            fn = self._build_prefill(T0pad, B)
-            self._prefill_cache[(T0pad, B)] = fn
+            fn = self._build_prefill(T)
+            self._prefill_cache[T] = fn
             while len(self._prefill_cache) > self._max_prefill_compiles:
                 self._prefill_cache.popitem(last=False)
-        self._prefill_cache.move_to_end((T0pad, B))
-        ids = np.zeros((B, T0pad), np.int32)
-        lens = np.ones((B,), np.int32)
-        pids = np.zeros((B, n_pages), np.int32)   # dummies -> null page
-        for r, (prompt, page_ids) in enumerate(items):
-            ids[r, :len(prompt)] = prompt
-            lens[r] = len(prompt)
-            pids[r, :len(page_ids)] = page_ids
+        self._prefill_cache.move_to_end(T)
+        ids = np.zeros((B, T), np.int32)
+        start = np.zeros((B,), np.int32)
+        last_idx = np.zeros((B,), np.int32)
+        pt = np.zeros((B, self.max_pages), np.int32)  # dummies -> null
+        for r, (_ix, slot, take) in enumerate(rows):
+            ids[r, :take] = slot.prompt[
+                slot.prefilled:slot.prefilled + take]
+            start[r] = slot.prefilled
+            last_idx[r] = take - 1
+            pt[r, :len(slot.pages)] = slot.pages
         firsts, self.pages, self._rng = fn(
-            self.params, jnp.asarray(ids), jnp.asarray(lens),
-            self.pages, jnp.asarray(pids), self._rng)
+            self.params, self.pages, jnp.asarray(ids),
+            jnp.asarray(start), jnp.asarray(last_idx),
+            jnp.asarray(pt), self._rng)
+        placements = []
+        for r, (ix, slot, take) in enumerate(rows):
+            slot.prefilled += take
+            slot.pos = slot.prefilled
+            if slot.prefill_remaining == 0:
+                placements.append((ix, slot, r))
+        # Seed the device decode state for rows that FINISHED their
+        # prompt WITHOUT a host sync: scatter firsts/positions into
+        # dev_cur/dev_pos rows on-stream, after which the slots ride
+        # the very next decode dispatch.
+        ixs = np.full((B,), self.S, np.int32)   # S = dropped row
+        rws = np.zeros((B,), np.int32)
+        posv = np.zeros((B,), np.int32)
+        for r, (ix, slot, row) in enumerate(placements):
+            ixs[r], rws[r], posv[r] = ix, row, slot.pos
+        self._dev_cur, self._dev_pos = self._seed_fn(
+            self._dev_cur, self._dev_pos, firsts,
+            jnp.asarray(ixs), jnp.asarray(rws), jnp.asarray(posv))
+        for ix, slot, _row in placements:
+            slot.cur = -1      # device-seeded: ridable
+        # firsts also stays on device for EMISSION: its readback
+        # rides the next trailing sync, so prefill never stalls the
+        # decode stream on a host RTT. Queued even with no finished
+        # rows so drains (and preemption barriers) can sync on every
+        # in-flight prefill dispatch.
+        self._pending_prefill.append((firsts, placements))
+        self.sched_trace.append(
+            ("prefill", tuple((ix, take) for ix, _s, take in rows)))
         self.stats["prefills"] += 1
-        self.stats["prefilled_seqs"] += n
-        # device array: the caller reads rows back at the next sync
-        return firsts
+        self.stats["prefill_tokens"] += sum(
+            take for _ix, _s, take in rows)
+        self.stats["prefilled_seqs"] += len(placements)
 
-    def _build_prefill(self, T0pad: int, B: int):
-        model, cfg, Pg, temp = (self.model, self.cfg, self.Pg,
-                                self.temperature)
-        n_prompt_pages = T0pad // Pg
-        from ray_tpu.models.llama import _pick_token, init_kv_caches
+    def _build_prefill(self, T: int):
+        """One chunked-prefill executable for chunk width ``T``:
+        [B, T] token ids at per-row start offsets scatter into the
+        rows' pages (append-at-offset) and attend causally over each
+        row's own page window. The row's last real position samples
+        a candidate first token — junk for rows mid-prompt, consumed
+        only for rows that just finished their prompt."""
+        model, temp = self.model, self.temperature
+        B = self._max_prefill_batch
+        from ray_tpu.models.llama import _pick_token
 
-        def prefill(params, ids, true_lens, pages, page_ids, rng):
+        def prefill(params, pages, ids, start, last_idx, page_table,
+                    rng):
             rng, sub = jax.random.split(rng)
-            caches = init_kv_caches(cfg, B, T0pad)
-            logits, caches = model.apply(params, ids,
-                                         kv_caches=caches, cache_len=0)
-            flat_ids = page_ids.reshape(-1)     # [B * n_prompt_pages]
-            new_pages = []
-            for (pk, pv), (ck, cv) in zip(pages, caches):
-                # dense cache [B, T0pad, KH, D] -> head-major pages
-                # [KH, B*npp, Pg, D] scattered at [:, flat_ids]
-                kp = ck.reshape(B * n_prompt_pages, Pg,
-                                cfg.n_kv_heads, cfg.head_dim
-                                ).transpose(2, 0, 1, 3)
-                vp = cv.reshape(B * n_prompt_pages, Pg,
-                                cfg.n_kv_heads, cfg.head_dim
-                                ).transpose(2, 0, 1, 3)
-                new_pages.append((
-                    pk.at[:, flat_ids].set(kp.astype(pk.dtype)),
-                    pv.at[:, flat_ids].set(vp.astype(pv.dtype))))
-            last = logits[jnp.arange(B), true_lens - 1]    # [B, V]
+            kv = [PagedKVLayer(pk, pv, page_table)
+                  for pk, pv in pages]
+            logits, new_kv = model.apply(params, ids, kv_caches=kv,
+                                         cache_len=start)
+            new_pages = [(c.pages_k, c.pages_v) for c in new_kv]
+            last = logits[jnp.arange(B), last_idx]        # [B, V]
             firsts = _pick_token(last, sub, temp)
             return firsts, new_pages, rng
 
-        return jax.jit(prefill, donate_argnums=(3,))
+        return jax.jit(prefill, donate_argnums=(1,))
 
     def _build_decode(self):
         model, temp = self.model, self.temperature

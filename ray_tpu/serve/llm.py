@@ -36,6 +36,7 @@ class LlamaDeployment:
                  use_engine: bool = True, max_slots: int = 16,
                  page_size: int = 64, n_pages: Optional[int] = None,
                  decode_chunk: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
                  eos_id: Optional[int] = None):
         import jax
         from ray_tpu.models.llama import llama_tiny
@@ -66,7 +67,7 @@ class LlamaDeployment:
         self._engine_opts = dict(
             max_slots=max_slots, page_size=page_size,
             n_pages=n_pages, chunk=decode_chunk or stream_chunk,
-            eos_id=eos_id)
+            prefill_chunk=prefill_chunk, eos_id=eos_id)
 
     def setup_mesh(self, mesh):
         """Called by the serve replica when cfg.mesh is set: shard the

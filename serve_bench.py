@@ -7,11 +7,21 @@ Drives a serve deployment wrapping the continuous-batching engine
   engine; requests join/leave the paged-KV decode batch at token
   granularity (no whole-call batch coalescing, no convoy effect);
 - streaming phase: tokens stream from the engine measuring
-  time-to-first-token and steady-state streaming rate.
+  time-to-first-token and steady-state streaming rate. TTFT is
+  reported two ways: client-observed (first stream item through the
+  full serve stack) and engine-internal (stamped the moment the
+  first token is EMITTED to the request stream — end of that
+  request's prefill, the chunked-prefill scheduling target).
 
-Writes SERVE_BENCH_r05.json and prints it.
+--ab runs BOTH paths in this one process — the engine and the r03
+decode-to-completion @serve.batch baseline — against the same load
+shape, and writes a single artifact with both results plus ratio
+fields. No more cross-round comparisons against a different chip
+day (the r05 artifact's caveat).
 
-Usage: python serve_bench.py [--model 7b|1b|tiny] [--out FILE]
+Usage: python serve_bench.py [--model 7b|1b|tiny] [--ab] [--out FILE]
+       [--requests N] [--threads N] [--gen-tokens N] [--prompt-len N]
+       [--slots N] [--decode-chunk N] [--prefill-chunk N]
 (7b needs ~14GB HBM; falls back to 1b automatically on OOM.)
 """
 import argparse
@@ -43,16 +53,18 @@ SLOTS = 16          # continuous-batching decode width
 DECODE_CHUNK = 16   # tokens per device dispatch (host-sync amortizer:
                     # each chunk pays one host round trip, ~84ms
                     # through the axon tunnel on this rig)
-
+PREFILL_CHUNK = 128  # prompt tokens per scheduling round (chunked
+                     # prefill: decode interleaves between chunks)
 
 LEGACY_BATCH = 8    # r03 legacy shape: @serve.batch coalescing width
 
 
-def make_server(cfg, use_engine=True):
+def make_server(cfg, knobs, use_engine=True):
     import ray_tpu
     from ray_tpu import serve
     from ray_tpu.serve.llm import LlamaDeployment
 
+    gen_tokens = knobs["gen_tokens"]
     if not use_engine:
         # The r03 decode-to-completion baseline, verbatim: whole-call
         # batching via @serve.batch + one padded generate_batch per
@@ -61,7 +73,7 @@ def make_server(cfg, use_engine=True):
         class LegacyServer:
             def __init__(self):
                 self.inner = LlamaDeployment(
-                    config=cfg, max_new_tokens=GEN_TOKENS,
+                    config=cfg, max_new_tokens=gen_tokens,
                     use_engine=False)
 
             @serve.batch(max_batch_size=LEGACY_BATCH,
@@ -80,16 +92,20 @@ def make_server(cfg, use_engine=True):
             def engine_stats(self):
                 return {}
 
+            def engine_ttfts(self):
+                return []
+
         return serve.run(LegacyServer.bind(), timeout_s=600)
 
     @serve.deployment(max_ongoing_requests=64)
     class LlamaServer:
         def __init__(self):
             self.inner = LlamaDeployment(
-                config=cfg, max_new_tokens=GEN_TOKENS,
+                config=cfg, max_new_tokens=gen_tokens,
                 use_engine=use_engine,
-                max_slots=SLOTS, page_size=64,
-                decode_chunk=DECODE_CHUNK)
+                max_slots=knobs["slots"], page_size=64,
+                decode_chunk=knobs["decode_chunk"],
+                prefill_chunk=knobs["prefill_chunk"])
 
         def __call__(self, prompt):
             # joins the engine's decode batch at the next chunk
@@ -102,13 +118,20 @@ def make_server(cfg, use_engine=True):
         def engine_stats(self):
             return dict(self.inner.engine().stats)
 
+        def engine_ttfts(self):
+            # submit->first-emission latencies stamped INSIDE the
+            # engine at stream-put time (end of each request's
+            # prefill) — immune to client/transport skew
+            return [float(x) for x in self.inner.engine().ttfts_s]
+
     return serve.run(LlamaServer.bind(), timeout_s=600)
 
 
-def bench(handle, rng, cfg):
+def bench(handle, rng, cfg, knobs):
     import ray_tpu
 
-    plen = min(PROMPT_LEN, cfg.max_seq_len - GEN_TOKENS)
+    gen_tokens = knobs["gen_tokens"]
+    plen = min(knobs["prompt_len"], cfg.max_seq_len - gen_tokens)
 
     def prompt():
         return rng.randint(1, cfg.vocab_size - 1, size=plen).tolist()
@@ -119,8 +142,8 @@ def bench(handle, rng, cfg):
     compile_s = time.time() - t0
     print(f"warmup+compile: {compile_s:.1f}s", flush=True)
 
-    # --- throughput: 64 requests from 16 threads -------------------
-    n_req, n_threads = 64, 16
+    # --- throughput: n_req requests from n_threads threads ----------
+    n_req, n_threads = knobs["requests"], knobs["threads"]
     latencies = []
     lat_lock = threading.Lock()
 
@@ -131,33 +154,38 @@ def bench(handle, rng, cfg):
             with lat_lock:
                 latencies.append(time.time() - t)
 
+    counts = [n_req // n_threads + (1 if i < n_req % n_threads else 0)
+              for i in range(n_threads)]
     t0 = time.time()
-    threads = [threading.Thread(target=client,
-                                args=(n_req // n_threads,))
-               for _ in range(n_threads)]
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in counts if c]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
     wall = time.time() - t0
-    throughput = n_req * GEN_TOKENS / wall
+    throughput = n_req * gen_tokens / wall
     lat_ms = sorted(x * 1000 for x in latencies)
     p50 = statistics.median(lat_ms)
     p99 = lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))]
 
     # --- streaming: time-to-first-token + token rate ---------------
+    # Client-observed TTFT: wall time until the first STREAM ITEM
+    # arrives. With chunked prefill the engine emits the first token
+    # at end-of-prompt-prefill, so this now measures prefill latency,
+    # not prefill + decode-chunk drain (the r05 accounting gap).
     ttfts, rates = [], []
     for _ in range(3):
         t0 = time.time()
         it = iter(handle.stream.options(stream=True).remote(prompt()))
-        first = next(it)
+        next(it)
         ttfts.append(time.time() - t0)
         n = 1
         for _tok in it:
             n += 1
         dt = time.time() - t0
         rates.append(n / dt)
-    return {
+    out = {
         "throughput_tok_s": round(throughput, 1),
         "p50_ms": round(p50, 1),
         "p99_ms": round(p99, 1),
@@ -168,17 +196,94 @@ def bench(handle, rng, cfg):
         "compile_s": round(compile_s, 1),
         "prompt_len": plen,
     }
+    # Engine-internal TTFT over the whole run (throughput + stream
+    # phases): stamped at first emission to each request's stream.
+    try:
+        eng_ttfts = ray_tpu.get(handle.engine_ttfts.remote(),
+                                timeout=60)
+    except Exception:
+        eng_ttfts = []
+    if eng_ttfts:
+        out["engine_ttft_ms"] = round(min(eng_ttfts) * 1000, 1)
+        out["engine_ttft_p50_ms"] = round(
+            statistics.median(eng_ttfts) * 1000, 1)
+    return out
+
+
+def run_path(args, knobs, use_engine):
+    """Serve + bench one path (engine or legacy), with the 7b->1b OOM
+    fallback; leaves serve SHUT DOWN so --ab can run the other path
+    in this same process (serve.run/shutdown cycling is what
+    tests/test_serve.py exercises)."""
+    import ray_tpu
+    from ray_tpu import serve
+    order = {"7b": ["7b", "1b"], "1b": ["1b"],
+             "tiny": ["tiny"]}[args.model]
+    result = None
+    for name in order:
+        label, cfg = build_configs(name)
+        path = "engine" if use_engine else "legacy_decode_to_completion"
+        print(f"model: {label} path: {path}", flush=True)
+        try:
+            handle = make_server(cfg, knobs, use_engine=use_engine)
+            rng = np.random.RandomState(0)
+            result = bench(handle, rng, cfg, knobs)
+            result["model"] = label
+            result["path"] = path
+            break
+        except Exception as e:   # noqa: BLE001
+            msg = str(e)
+            oom = "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower()
+            print(f"{label} failed ({msg[:200]})", flush=True)
+            serve.shutdown()
+            if not oom or name == order[-1]:
+                raise
+    result["gen_tokens"] = knobs["gen_tokens"]
+    if use_engine:
+        result["slots"] = knobs["slots"]
+        result["decode_chunk"] = knobs["decode_chunk"]
+        result["prefill_chunk"] = knobs["prefill_chunk"]
+        # (legacy path: engine_stats would lazily build an unused
+        # engine — allocating the whole KV pool — just to report zeros)
+        try:
+            result["engine"] = ray_tpu.get(
+                handle.engine_stats.remote(), timeout=60)
+        except Exception:
+            pass
+    else:
+        result["batch"] = LEGACY_BATCH
+    serve.shutdown()
+    return result
+
+
+def _ratio(a, b):
+    return round(a / b, 2) if b else None
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="7b",
                     choices=["7b", "1b", "tiny"])
-    ap.add_argument("--out", default="SERVE_BENCH_r05.json")
+    ap.add_argument("--out", default=None)
     ap.add_argument("--legacy", action="store_true",
                     help="decode-to-completion @serve.batch path "
                          "(engine off) for A/B on the same load")
+    ap.add_argument("--ab", action="store_true",
+                    help="run engine AND legacy paths in THIS process "
+                         "and write one artifact with both + ratios")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--threads", type=int, default=16)
+    ap.add_argument("--gen-tokens", type=int, default=GEN_TOKENS)
+    ap.add_argument("--prompt-len", type=int, default=PROMPT_LEN)
+    ap.add_argument("--slots", type=int, default=SLOTS)
+    ap.add_argument("--decode-chunk", type=int, default=DECODE_CHUNK)
+    ap.add_argument("--prefill-chunk", type=int, default=PREFILL_CHUNK)
     args = ap.parse_args()
+    knobs = dict(requests=args.requests, threads=args.threads,
+                 gen_tokens=args.gen_tokens,
+                 prompt_len=args.prompt_len, slots=args.slots,
+                 decode_chunk=args.decode_chunk,
+                 prefill_chunk=args.prefill_chunk)
 
     import os
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -188,46 +293,32 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import ray_tpu
     ray_tpu.init()
-    order = {"7b": ["7b", "1b"], "1b": ["1b"],
-             "tiny": ["tiny"]}[args.model]
-    result = None
-    for name in order:
-        label, cfg = build_configs(name)
-        print(f"model: {label}", flush=True)
-        try:
-            handle = make_server(cfg, use_engine=not args.legacy)
-            rng = np.random.RandomState(0)
-            result = bench(handle, rng, cfg)
-            result["model"] = label
-            result["path"] = ("legacy_decode_to_completion"
-                              if args.legacy else "engine")
-            break
-        except Exception as e:   # noqa: BLE001
-            msg = str(e)
-            oom = "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower()
-            print(f"{label} failed ({msg[:200]})", flush=True)
-            from ray_tpu import serve
-            serve.shutdown()
-            if not oom or name == order[-1]:
-                raise
-    result["slots"] = SLOTS
-    result["decode_chunk"] = DECODE_CHUNK
-    result["gen_tokens"] = GEN_TOKENS
-    if not args.legacy:
-        # (legacy path: engine_stats would lazily build an unused
-        # engine — allocating the whole KV pool — just to report zeros)
-        try:
-            result["engine"] = ray_tpu.get(
-                handle.engine_stats.remote(), timeout=60)
-        except Exception:
-            pass
-    if args.legacy and args.out == "SERVE_BENCH_r05.json":
-        args.out = "SERVE_BENCH_r05_legacy.json"
-    with open(args.out, "w") as f:
+
+    if args.ab:
+        eng = run_path(args, knobs, use_engine=True)
+        leg = run_path(args, knobs, use_engine=False)
+        result = {
+            "engine_continuous_batching": eng,
+            "legacy_decode_to_completion": leg,
+            "throughput_ratio": _ratio(eng["throughput_tok_s"],
+                                       leg["throughput_tok_s"]),
+            "p50_ratio": _ratio(eng["p50_ms"], leg["p50_ms"]),
+            "ttft_ratio": _ratio(eng["ttft_ms"], leg["ttft_ms"]),
+            "notes": "Same-session A/B: both paths served and "
+                     "measured in ONE process against the same load "
+                     "shape (serve_bench.py --ab). TTFT is "
+                     "client-observed first stream item; the engine "
+                     "path also reports engine-internal "
+                     "first-emission TTFT.",
+        }
+        out = args.out or "SERVE_BENCH_ab.json"
+    else:
+        result = run_path(args, knobs, use_engine=not args.legacy)
+        out = args.out or ("SERVE_BENCH_r05_legacy.json" if args.legacy
+                           else "SERVE_BENCH_r05.json")
+    with open(out, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
-    from ray_tpu import serve
-    serve.shutdown()
     ray_tpu.shutdown()
 
 

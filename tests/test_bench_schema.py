@@ -1,0 +1,86 @@
+"""Bench-artifact schema gate: every checked-in SERVE_BENCH_*.json /
+BENCH_*.json must validate, so cross-round comparisons can trust the
+field names and types. Also pins the checker's own failure modes —
+a validator that passes everything is worse than none."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+CHECKER = REPO / "tools" / "check_bench_schema.py"
+
+sys.path.insert(0, str(REPO / "tools"))
+import check_bench_schema as cbs  # noqa: E402
+
+
+def test_checked_in_artifacts_validate():
+    """The real gate: the repo's own artifacts, via the CLI."""
+    proc = subprocess.run(
+        [sys.executable, str(CHECKER)], cwd=str(REPO),
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all valid" in proc.stdout
+
+
+def _problems_for(name, obj, tmp_path):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    problems = []
+    cbs.check_file(str(p), problems)
+    return problems
+
+
+def test_rejects_missing_metric_field(tmp_path):
+    good = {"throughput_tok_s": 1.0, "p50_ms": 2.0, "p99_ms": 3.0,
+            "ttft_ms": 4.0, "stream_tok_s": 5.0}
+    assert _problems_for("SERVE_BENCH_x.json", good, tmp_path) == []
+    bad = dict(good)
+    del bad["ttft_ms"]
+    probs = _problems_for("SERVE_BENCH_x.json", bad, tmp_path)
+    assert probs and "ttft_ms" in probs[0]
+
+
+def test_rejects_string_typed_number(tmp_path):
+    bad = {"throughput_tok_s": "1260.4", "p50_ms": 2.0, "p99_ms": 3.0,
+           "ttft_ms": 4.0, "stream_tok_s": 5.0}
+    probs = _problems_for("SERVE_BENCH_x.json", bad, tmp_path)
+    assert any("throughput_tok_s" in p for p in probs)
+
+
+def test_ab_requires_both_sections_and_ratio(tmp_path):
+    res = {"throughput_tok_s": 1.0, "p50_ms": 2.0, "p99_ms": 3.0,
+           "ttft_ms": 4.0, "stream_tok_s": 5.0}
+    ok = {"engine_continuous_batching": res,
+          "legacy_decode_to_completion": res,
+          "throughput_ratio": 1.5}
+    assert _problems_for("SERVE_BENCH_ab.json", ok, tmp_path) == []
+    no_ratio = {k: v for k, v in ok.items()
+                if not k.endswith("_ratio")}
+    assert _problems_for("SERVE_BENCH_ab.json", no_ratio, tmp_path)
+    no_leg = {"engine_continuous_batching": res,
+              "throughput_ratio": 1.5}
+    assert _problems_for("SERVE_BENCH_ab.json", no_leg, tmp_path)
+
+
+def test_bench_wrapper_and_flat_metric(tmp_path):
+    wrapper = {"n": 3, "cmd": "python bench.py", "rc": 0,
+               "tail": "...", "parsed": {"metric": "m", "value": 1.0}}
+    assert _problems_for("BENCH_x.json", wrapper, tmp_path) == []
+    # rc == 0 with no parsed payload is a broken round
+    broken = dict(wrapper, parsed=None)
+    assert _problems_for("BENCH_x.json", broken, tmp_path)
+    flat = {"metric": "m", "value": 2.5, "unit": "tok/s"}
+    assert _problems_for("BENCH_SELF_x.json", flat, tmp_path) == []
+    assert _problems_for("BENCH_SELF_x.json",
+                         {"metric": "m"}, tmp_path)
+
+
+def test_unreadable_json_is_a_problem(tmp_path):
+    p = tmp_path / "BENCH_bad.json"
+    p.write_text("{not json")
+    problems = []
+    cbs.check_file(str(p), problems)
+    assert problems and "unreadable" in problems[0]

@@ -16,6 +16,7 @@ import pytest
 from ray_tpu.models.kv_cache import BlockAllocator
 from ray_tpu.models.llama import Llama, generate, llama_tiny
 from ray_tpu.serve.engine import LLMEngine, RequestError
+from ray_tpu.serve.scheduler import PrefillGrant, SlotView, plan_step
 
 
 @pytest.fixture(scope="module")
@@ -282,3 +283,194 @@ def test_mixed_budgets_retire_independently(tiny_model):
     assert h1.result() == want1
     assert h2.result() == want2
     assert h3.result() == want3
+
+
+# ----------------------------------------------------- chunked prefill
+
+
+def test_prompt_shorter_than_chunk(tiny_model):
+    """A prompt under prefill_chunk finishes in ONE chunk: admitted,
+    prefilled, and seeded in a single round, with TTFT stamped at the
+    first emission."""
+    model, params = tiny_model
+    eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                    n_pages=32, chunk=4, prefill_chunk=16)
+    prompt = [5, 9, 2, 7, 11]
+    want = _reference_completion(model, params, prompt, 10)
+    h = eng.submit(prompt, max_new_tokens=10)
+    eng.step()
+    assert eng.stats["prefills"] == 1
+    assert eng.stats["prefilled_seqs"] == 1
+    while eng.step():
+        pass
+    assert h.result() == want
+    assert h.ttft_s is not None and h.ttft_s > 0
+    assert len(eng.ttfts_s) == 1
+
+
+def test_prompt_spanning_many_chunks(tiny_model):
+    """A prompt of 3+ chunks prefills over several rounds and still
+    matches the dense reference exactly (append-at-offset + causal
+    masking make chunk boundaries invisible)."""
+    model, params = tiny_model
+    eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                    n_pages=64, chunk=4, prefill_chunk=8)
+    prompt = list(range(1, 29))           # 28 tokens: chunks 8/8/8/4
+    want = _reference_completion(model, params, prompt, 8)
+    h = eng.submit(prompt, max_new_tokens=8)
+    while eng.step():
+        pass
+    assert h.result() == want
+    assert eng.stats["prefills"] >= 4     # one dispatch per chunk
+    assert eng.stats["prefill_tokens"] == 28
+    assert eng.alloc.n_free == eng.alloc.n_pages - 1
+
+
+def test_slot_exhaustion_mid_prefill(tiny_model):
+    """Every slot busy while a long prompt is mid-prefill: the extra
+    request waits for a completion, then admits; all streams exact."""
+    model, params = tiny_model
+    eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                    n_pages=64, chunk=2, prefill_chunk=8)
+    pa, pb, pc = [1, 2], list(range(1, 25)), [7, 3]
+    wa = _reference_completion(model, params, pa, 6)
+    wb = _reference_completion(model, params, pb, 10)
+    wc = _reference_completion(model, params, pc, 6)
+    ha = eng.submit(pa, max_new_tokens=6)
+    hb = eng.submit(pb, max_new_tokens=10)
+    hc = eng.submit(pc, max_new_tokens=6)
+    eng.step()
+    # both slots taken (pa seeded-or-prefilling, pb mid-prefill);
+    # pc has nowhere to go yet
+    assert all(s is not None for s in eng.slots)
+    assert len(eng._wait) == 1
+    assert any(s is not None and s.prefill_remaining > 0
+               for s in eng.slots)
+    while eng.step():
+        pass
+    assert ha.result() == wa
+    assert hb.result() == wb
+    assert hc.result() == wc
+    assert eng.alloc.n_free == eng.alloc.n_pages - 1
+
+
+def test_preempt_partially_prefilled_recompute(tiny_model):
+    """A request evicted MID-PREFILL requeues with its untouched
+    prompt (nothing generated yet) and recomputes to the exact
+    reference stream."""
+    model, params = tiny_model
+    eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                    n_pages=64, chunk=2, prefill_chunk=8)
+    prompt = list(range(1, 25))           # 24 tokens: 3 chunks
+    want = _reference_completion(model, params, prompt, 6)
+    h = eng.submit(prompt, max_new_tokens=6)
+    eng.step()                            # admit + FIRST chunk only
+    with eng._lock:
+        (ix,) = [i for i, s in enumerate(eng.slots) if s is not None]
+        slot = eng.slots[ix]
+        assert 0 < slot.prefilled < len(prompt)
+        eng._preempt_locked(ix)
+        # recompute path: nothing was generated, so the requeued
+        # prompt is the original, whole
+        assert list(eng._wait)[0].recompute_prompt == prompt
+    assert eng.stats["preemptions"] == 1
+    while eng.step():
+        pass
+    assert h.result() == want
+    assert h._req.preemptions == 1
+    assert eng.alloc.n_free == eng.alloc.n_pages - 1
+
+
+def test_decode_interleaved_between_prefill_chunks(tiny_model):
+    """THE chunked-prefill property: while a long prompt prefills
+    chunk by chunk, decode dispatches for the active stream land
+    BETWEEN its chunks — the in-flight stream never stalls for the
+    whole prompt. Asserted on the engine's dispatch-order trace."""
+    model, params = tiny_model
+    eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                    n_pages=64, chunk=2, prefill_chunk=8)
+    p1 = [1, 2]
+    w1 = _reference_completion(model, params, p1, 40)
+    h1 = eng.submit(p1, max_new_tokens=40)
+    for _ in range(3):                    # h1 decoding solo
+        eng.step()
+    p2 = list(range(1, 33))               # 32 tokens: 4 chunks of 8
+    w2 = _reference_completion(model, params, p2, 4)
+    h2 = eng.submit(p2, max_new_tokens=4)
+    while eng.step():
+        pass
+    assert h1.result() == w1
+    assert h2.result() == w2
+    trace = list(eng.sched_trace)
+    pf = [i for i, (kind, _) in enumerate(trace) if kind == "prefill"]
+    assert len(pf) >= 5                   # p1's one + p2's four
+    # between every pair of consecutive prefill chunks there is at
+    # least one decode dispatch
+    for a, b in zip(pf, pf[1:]):
+        assert any(trace[i][0] == "decode" for i in range(a + 1, b)), \
+            trace[a:b + 1]
+
+
+# ------------------------------------------------------- pure planner
+
+
+_PLAN = dict(total_slots=4, prefill_budget=16, decode_chunk=4,
+             max_run_ahead=128, prefill_batch=4, eos_bounded=False)
+
+
+def test_planner_long_prompt_takes_whole_budget():
+    views = [SlotView(sid=0, admit_seq=0, prompt_remaining=100,
+                      owed=0, seeded=False),
+             SlotView(sid=1, admit_seq=1, prompt_remaining=3,
+                      owed=0, seeded=False)]
+    plan = plan_step(views, **_PLAN)
+    assert plan.prefill == (PrefillGrant(0, 16),)   # FIFO, all budget
+    assert plan.decode_steps == 0                   # nothing seeded
+
+
+def test_planner_packs_short_prompts_into_one_round():
+    views = [SlotView(sid=i, admit_seq=i, prompt_remaining=n,
+                      owed=0, seeded=False)
+             for i, n in enumerate([5, 6, 9])]
+    plan = plan_step(views, **_PLAN)
+    assert plan.prefill == (PrefillGrant(0, 5), PrefillGrant(1, 6),
+                            PrefillGrant(2, 5))     # 16-token budget
+
+
+def test_planner_decode_rides_behind_prefill():
+    views = [SlotView(sid=0, admit_seq=0, prompt_remaining=0,
+                      owed=50, seeded=True),
+             SlotView(sid=1, admit_seq=1, prompt_remaining=40,
+                      owed=0, seeded=False)]
+    plan = plan_step(views, **_PLAN)
+    assert plan.prefill == (PrefillGrant(1, 16),)
+    assert plan.decode_steps == 4         # quick cadence, no run-ahead
+
+
+def test_planner_run_ahead_when_full_and_seeded():
+    views = [SlotView(sid=0, admit_seq=0, prompt_remaining=0,
+                      owed=50, seeded=True),
+             SlotView(sid=1, admit_seq=1, prompt_remaining=0,
+                      owed=20, seeded=True)]
+    plan = plan_step(views, **dict(_PLAN, total_slots=2))
+    assert plan.prefill == ()
+    assert plan.decode_steps == 20        # to the next completion
+    bounded = plan_step(views, **dict(_PLAN, total_slots=2,
+                                      eos_bounded=True))
+    assert bounded.decode_steps == 8      # 2 x decode_chunk cap
+
+
+def test_planner_prefill_batch_width_cap():
+    views = [SlotView(sid=i, admit_seq=i, prompt_remaining=1,
+                      owed=0, seeded=False) for i in range(6)]
+    plan = plan_step(views, **dict(_PLAN, total_slots=8))
+    assert len(plan.prefill) == 4         # prefill_batch
+    assert [g.sid for g in plan.prefill] == [0, 1, 2, 3]
+
+
+def test_planner_validates_budgets():
+    with pytest.raises(ValueError):
+        plan_step([], **dict(_PLAN, prefill_budget=0))
+    with pytest.raises(ValueError):
+        plan_step([], **dict(_PLAN, decode_chunk=0))
+    assert plan_step([], **_PLAN).idle

@@ -131,3 +131,58 @@ def test_llama_decode_paths_agree(monkeypatch):
         a = step("1")
         b = step("0")
     np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_paged_append_mid_page_span():
+    """Append-at-offset: a chunk starting mid-page and spanning a page
+    boundary lands token-exact in the right (page, offset) cells and
+    touches nothing else."""
+    from ray_tpu.ops.paged_attention import paged_append
+    rng = np.random.default_rng(3)
+    B, T, KH, D, Pg, n_pages, max_pages = 2, 6, 2, 8, 4, 16, 4
+    pk = rng.standard_normal((KH, n_pages, Pg, D)).astype(np.float32)
+    pv = rng.standard_normal((KH, n_pages, Pg, D)).astype(np.float32)
+    pt = np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.int32)
+    pos = np.array([3, 5], np.int32)      # both start mid-page
+    k = rng.standard_normal((B, T, KH, D)).astype(np.float32)
+    v = rng.standard_normal((B, T, KH, D)).astype(np.float32)
+    nk, nv = paged_append(jnp.asarray(pk), jnp.asarray(pv),
+                          jnp.asarray(pt), jnp.asarray(pos),
+                          jnp.asarray(k), jnp.asarray(v))
+    ref_k, ref_v = pk.copy(), pv.copy()
+    for b in range(B):
+        for t in range(T):
+            p = pos[b] + t
+            ref_k[:, pt[b, p // Pg], p % Pg] = k[b, t]
+            ref_v[:, pt[b, p // Pg], p % Pg] = v[b, t]
+    np.testing.assert_array_equal(np.asarray(nk), ref_k)
+    np.testing.assert_array_equal(np.asarray(nv), ref_v)
+
+
+def test_paged_append_tail_hits_null_page_only():
+    """Positions past a slot's allocated pages resolve to page-table
+    zeros (the null page) and clamped indices — an oversized padding
+    tail can corrupt NO allocated page of any slot."""
+    from ray_tpu.ops.paged_attention import paged_append
+    rng = np.random.default_rng(4)
+    B, T, KH, D, Pg, n_pages, max_pages = 1, 8, 1, 4, 4, 8, 2
+    pk = rng.standard_normal((KH, n_pages, Pg, D)).astype(np.float32)
+    pv = rng.standard_normal((KH, n_pages, Pg, D)).astype(np.float32)
+    pt = np.zeros((B, max_pages), np.int32)
+    pt[0, 0] = 3                          # ONE allocated page
+    pos = np.array([2], np.int32)         # 8-token chunk overruns it
+    k = rng.standard_normal((B, T, KH, D)).astype(np.float32)
+    v = rng.standard_normal((B, T, KH, D)).astype(np.float32)
+    nk, nv = paged_append(jnp.asarray(pk), jnp.asarray(pv),
+                          jnp.asarray(pt), jnp.asarray(pos),
+                          jnp.asarray(k), jnp.asarray(v))
+    nk, nv = np.asarray(nk), np.asarray(nv)
+    # page 3 got its two in-window tokens
+    np.testing.assert_array_equal(nk[:, 3, 2], k[0, 0])
+    np.testing.assert_array_equal(nk[:, 3, 3], k[0, 1])
+    # every page except the null page and page 3 is untouched
+    for pg in range(1, n_pages):
+        if pg == 3:
+            continue
+        np.testing.assert_array_equal(nk[:, pg], pk[:, pg])
+        np.testing.assert_array_equal(nv[:, pg], pv[:, pg])

@@ -1,0 +1,104 @@
+"""Reproduce the bulk-pull concurrency degradation behind the
+``bulk_pull_global_slots`` flag (_private/config.py) as a runnable
+artifact instead of prose.
+
+On shared/virtualized hosts, concurrent bulk memory traffic degrades
+superlinearly: the measurement that set the flag's default saw a
+1 GiB copy take 0.8s solo vs 28s with four concurrent pullers. This
+tool reproduces the SHAPE of that measurement on localhost — N
+worker processes each timing the same large buffer copy, solo and
+concurrently — and prints one JSON row suitable for checking in next
+to the other bench artifacts.
+
+The absolute numbers are host-dependent (a dedicated box with a
+private memory bus may show degradation_x near the concurrency
+count, i.e. plain bandwidth sharing; the pathological case is
+shared/virtualized hosts where it overshoots badly). What the flag
+relies on is degradation_x exceeding 1 by enough that serializing
+transfers near the host's effective bandwidth wins.
+
+Usage: python tools/bench_broadcast_degradation.py
+           [--size-mb 512] [--concurrency 4] [--iters 3] [--out FILE]
+"""
+import argparse
+import json
+import multiprocessing as mp
+import time
+
+
+def _copy_worker(size_mb: int, iters: int, q):
+    """Time `iters` full copies of a size_mb buffer; report the best
+    (least-contended snapshot of this worker's achievable rate)."""
+    import numpy as np
+    src = np.random.default_rng(0).integers(
+        0, 255, size=size_mb * 1024 * 1024, dtype=np.uint8)
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        dst = src.copy()
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+        del dst
+    q.put(best)
+
+
+def timed_run(n_workers: int, size_mb: int, iters: int):
+    """Run n_workers concurrent copy workers; return (wall_s,
+    per-worker best copy times). Processes, not threads: the copy
+    must contend on the memory bus, not the GIL."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_copy_worker,
+                         args=(size_mb, iters, q))
+             for _ in range(n_workers)]
+    t0 = time.perf_counter()
+    for p in procs:
+        p.start()
+    results = [q.get() for _ in procs]
+    for p in procs:
+        p.join()
+    return time.perf_counter() - t0, results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=int, default=512,
+                    help="buffer size per worker (the original "
+                         "measurement used 1024)")
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON row to this file")
+    args = ap.parse_args()
+
+    # warmup run pays the spawn + page-fault cost outside the clock
+    timed_run(1, min(args.size_mb, 64), 1)
+
+    _, solo = timed_run(1, args.size_mb, args.iters)
+    solo_s = solo[0]
+    _, conc = timed_run(args.concurrency, args.size_mb, args.iters)
+    worst_s = max(conc)
+
+    row = {
+        "metric": "bulk_copy_concurrency_degradation",
+        "value": round(worst_s / solo_s, 2),
+        "unit": "x_slowdown_vs_solo",
+        "size_mb": args.size_mb,
+        "concurrency": args.concurrency,
+        "solo_copy_s": round(solo_s, 3),
+        "concurrent_worst_copy_s": round(worst_s, 3),
+        "concurrent_all_s": [round(x, 3) for x in sorted(conc)],
+        "note": "reproduces the measurement behind "
+                "bulk_pull_global_slots (_private/config.py): "
+                "concurrent bulk memory traffic vs one solo copy "
+                "on this host",
+    }
+    out = json.dumps(row)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Validate checked-in benchmark artifacts against their schemas.
+
+The repo accumulates SERVE_BENCH_*.json and BENCH_*.json rounds; the
+driver and later sessions compare across them, so a silently
+malformed artifact (renamed field, string-typed number, missing
+ratio) corrupts comparisons long after the session that wrote it.
+This checker pins the required fields/types for each artifact
+family:
+
+- BENCH_*.json wrapper: {n:int, cmd:str, rc:int, tail:str,
+  parsed: {metric:str, value:number, ...}|null} (parsed required
+  when rc == 0)
+- flat metric row (BENCH_SELF_*.json, tool outputs):
+  {metric:str, value:number, unit:str}
+- SERVE_BENCH flat result: {throughput_tok_s, p50_ms, p99_ms,
+  ttft_ms, stream_tok_s} all numeric
+- SERVE_BENCH A/B: {engine_continuous_batching: result,
+  legacy_decode_to_completion: result-or-sourced-baseline} plus at
+  least one *_ratio field
+
+Usage: python tools/check_bench_schema.py [FILES...]
+       (no FILES: validates every SERVE_BENCH_*.json / BENCH_*.json
+       in the repo root)
+Exit 0 when every file validates; 1 otherwise, listing each problem.
+"""
+import glob
+import json
+import os
+import sys
+
+NUM = (int, float)
+
+SERVE_RESULT_REQUIRED = {
+    "throughput_tok_s": NUM,
+    "p50_ms": NUM,
+    "p99_ms": NUM,
+    "ttft_ms": NUM,
+    "stream_tok_s": NUM,
+}
+
+FLAT_METRIC_REQUIRED = {
+    "metric": str,
+    "value": NUM,
+    "unit": str,
+}
+
+BENCH_WRAPPER_REQUIRED = {
+    "n": int,
+    "cmd": str,
+    "rc": int,
+    "tail": str,
+}
+
+
+def _check_fields(obj, required, where, problems):
+    for key, typ in required.items():
+        if key not in obj:
+            problems.append(f"{where}: missing required field "
+                            f"'{key}'")
+        elif not isinstance(obj[key], typ) or isinstance(obj[key],
+                                                         bool):
+            problems.append(
+                f"{where}: field '{key}' must be "
+                f"{getattr(typ, '__name__', 'number')}, got "
+                f"{type(obj[key]).__name__}")
+
+
+def check_serve_bench(obj, name, problems):
+    if "engine_continuous_batching" in obj:
+        # A/B artifact: engine section is a full result; the legacy
+        # section is either a same-session result or a sourced
+        # baseline (r05 imported r03's numbers with a "source" note)
+        # — both carry the metric quintet.
+        eng = obj.get("engine_continuous_batching")
+        leg = obj.get("legacy_decode_to_completion")
+        if not isinstance(eng, dict):
+            problems.append(f"{name}: engine_continuous_batching "
+                            "must be an object")
+        else:
+            _check_fields(eng, SERVE_RESULT_REQUIRED,
+                          f"{name}:engine_continuous_batching",
+                          problems)
+        if not isinstance(leg, dict):
+            problems.append(f"{name}: A/B artifact missing "
+                            "legacy_decode_to_completion object")
+        else:
+            _check_fields(leg, SERVE_RESULT_REQUIRED,
+                          f"{name}:legacy_decode_to_completion",
+                          problems)
+        ratios = [k for k, v in obj.items()
+                  if k.endswith("_ratio") and isinstance(v, NUM)]
+        if not ratios:
+            problems.append(f"{name}: A/B artifact has no numeric "
+                            "*_ratio field")
+    else:
+        _check_fields(obj, SERVE_RESULT_REQUIRED, name, problems)
+
+
+def check_bench(obj, name, problems):
+    if "metric" in obj:            # flat metric row (BENCH_SELF_*)
+        _check_fields(obj, FLAT_METRIC_REQUIRED, name, problems)
+        return
+    _check_fields(obj, BENCH_WRAPPER_REQUIRED, name, problems)
+    parsed = obj.get("parsed")
+    if parsed is None:
+        if obj.get("rc") == 0:
+            problems.append(f"{name}: rc == 0 but parsed is null")
+        return
+    if not isinstance(parsed, dict):
+        problems.append(f"{name}: parsed must be an object or null")
+        return
+    _check_fields(parsed, {"metric": str, "value": NUM},
+                  f"{name}:parsed", problems)
+
+
+def check_file(path, problems):
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        problems.append(f"{name}: unreadable ({e})")
+        return
+    if not isinstance(obj, dict):
+        problems.append(f"{name}: top level must be a JSON object")
+        return
+    if name.startswith("SERVE_BENCH"):
+        check_serve_bench(obj, name, problems)
+    else:
+        check_bench(obj, name, problems)
+
+
+def main(argv):
+    files = argv[1:]
+    if not files:
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        files = sorted(glob.glob(os.path.join(root,
+                                              "SERVE_BENCH_*.json")) +
+                       glob.glob(os.path.join(root, "BENCH_*.json")))
+    if not files:
+        print("no bench artifacts found")
+        return 0
+    problems = []
+    for path in files:
+        check_file(path, problems)
+    for p in problems:
+        print(f"FAIL {p}")
+    print(f"checked {len(files)} artifact(s): "
+          f"{'all valid' if not problems else f'{len(problems)} problem(s)'}")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
