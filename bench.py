@@ -1,6 +1,8 @@
-"""Headline benchmark: GPT-2-124M SPMD training throughput on local TPU chips.
+"""Headline benchmark: SPMD training throughput on local TPU chips.
 
-Prints ONE JSON line:
+Models (``--model``): ``gpt2`` (default, GPT-2-124M) and
+``llama-1.1b`` (TinyLlama-1.1B shape — GQA + SwiGLU, the serving
+family's training path). Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 vs_baseline is measured MFU / 0.40 (the north-star target from BASELINE.md:
@@ -42,7 +44,23 @@ def peak_flops(device) -> float:
     return 1e11
 
 
-def _devices_or_die(timeout_s: float = 120.0):
+_METRICS_BY_MODEL = {
+    "gpt2": "gpt2_124m_train_tokens_per_sec_per_chip",
+    "llama-1.1b": "llama_1_1b_train_tokens_per_sec_per_chip",
+}
+
+
+def _model_arg(argv) -> str:
+    if "--model" in argv:
+        name = argv[argv.index("--model") + 1]
+        if name not in _METRICS_BY_MODEL:
+            raise SystemExit(f"unknown --model {name!r} "
+                             f"(choices: {sorted(_METRICS_BY_MODEL)})")
+        return name
+    return "gpt2"
+
+
+def _devices_or_die(metric: str, timeout_s: float = 120.0):
     """Device init goes through the axon tunnel, which can wedge and
     block jax.devices() forever — fail FAST with a diagnosable JSON
     line instead of hanging the whole bench run."""
@@ -59,7 +77,7 @@ def _devices_or_die(timeout_s: float = 120.0):
     th.join(timeout_s)
     if "devices" not in out:
         print(json.dumps({
-            "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+            "metric": metric,
             "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0,
             "error": f"TPU backend unreachable: jax.devices() did not "
                      f"return within {timeout_s:.0f}s (axon tunnel "
@@ -68,7 +86,7 @@ def _devices_or_die(timeout_s: float = 120.0):
     return out["devices"]
 
 
-def main():
+def main(model_name: str = "gpt2"):
     import jax
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # env alone doesn't always override the axon plugin (smoke
@@ -79,29 +97,67 @@ def main():
     import optax
 
     from ray_tpu.mesh import create_mesh
-    from ray_tpu.models import GPT2, gpt2_124m, gpt2_sharding_rules
-    from ray_tpu.models.gpt2 import (flops_per_token,
-                                     linear_cross_entropy)
     from ray_tpu.train.spmd import (TrainState, make_train_step,
                                     put_batch, shard_state)
 
-    devices = _devices_or_die()
+    metric = _METRICS_BY_MODEL[model_name]
+    devices = _devices_or_die(metric)
     n_chips = len(devices)
     on_tpu = devices[0].platform == "tpu"
 
     seq = 1024
-    # Measured sweep on v5e (tools/mfu_sweep.py / mfu_round2.py): batch
-    # 24 + packed flash attention (blk 1024) + lse-gather CE is the
-    # per-chip sweet spot — 53.2% MFU; batch 32 regresses (fp32 logits
-    # thrash HBM) and the scan-chunked fused CE loses to XLA's own
-    # scheduling of the one big projection.
-    batch = 24 * n_chips if on_tpu else 2
-    cfg = gpt2_124m() if on_tpu else gpt2_124m(n_layer=2, n_embd=128,
-                                               n_head=4, vocab_size=1024,
-                                               n_ctx=seq)
-    model = GPT2(cfg)
+    if model_name == "llama-1.1b":
+        from ray_tpu.models.llama import (Llama, LlamaConfig,
+                                          llama_flops_per_token,
+                                          llama_sharding_rules,
+                                          llama_tiny)
+        if on_tpu:
+            # TinyLlama-1.1B shape: GQA (32q/4kv) + SwiGLU. remat:
+            # fp32 master params + adam state already cost ~13GB of a
+            # v5e's 16GB HBM, so activations must be cheap.
+            cfg = LlamaConfig(vocab_size=32000, max_seq_len=seq,
+                              dim=2048, n_layers=22, n_heads=32,
+                              n_kv_heads=4, hidden_dim=5632,
+                              remat=True)
+            batch = 8 * n_chips
+        else:
+            cfg = llama_tiny(max_seq_len=seq)
+            batch = 2
+        model = Llama(cfg)
+        rules = llama_sharding_rules(fsdp=on_tpu)
+
+        def loss_fn(params, b):
+            x, y = b["ids"][:, :-1], b["ids"][:, 1:]
+            logits, _ = model.apply(params, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y).mean()
+
+        fpt = llama_flops_per_token(cfg, seq)
+    else:
+        from ray_tpu.models import GPT2, gpt2_124m, gpt2_sharding_rules
+        from ray_tpu.models.gpt2 import (flops_per_token,
+                                         linear_cross_entropy)
+        # Measured sweep on v5e (tools/mfu_sweep.py / mfu_round2.py):
+        # batch 24 + packed flash attention (blk 1024) + lse-gather CE
+        # is the per-chip sweet spot — 53.2% MFU; batch 32 regresses
+        # (fp32 logits thrash HBM) and the scan-chunked fused CE loses
+        # to XLA's own scheduling of the one big projection.
+        batch = 24 * n_chips if on_tpu else 2
+        cfg = gpt2_124m() if on_tpu else gpt2_124m(
+            n_layer=2, n_embd=128, n_head=4, vocab_size=1024,
+            n_ctx=seq)
+        model = GPT2(cfg)
+        rules = gpt2_sharding_rules(fsdp=False)
+
+        def loss_fn(params, b):
+            x, y = b["ids"][:, :-1], b["ids"][:, 1:]
+            feats = model.apply(params, x, return_features=True)
+            return linear_cross_entropy(feats, params["params"]["wte"],
+                                        y)
+
+        fpt = flops_per_token(cfg, seq)
+
     mesh = create_mesh({"data": -1}, devices=devices)
-    rules = gpt2_sharding_rules(fsdp=False)
 
     ids = jnp.zeros((batch, seq + 1), dtype=jnp.int32)
     params = jax.jit(lambda: model.init(jax.random.PRNGKey(0),
@@ -109,17 +165,18 @@ def main():
     optimizer = optax.adamw(3e-4, weight_decay=0.1)
     state = shard_state(TrainState.create(params, optimizer), rules, mesh)
 
-    def loss_fn(params, b):
-        x, y = b["ids"][:, :-1], b["ids"][:, 1:]
-        feats = model.apply(params, x, return_features=True)
-        return linear_cross_entropy(feats, params["params"]["wte"], y)
-
     train_step = make_train_step(loss_fn, optimizer)
     rng = np.random.RandomState(0)
     data = rng.randint(0, cfg.vocab_size, size=(batch, seq + 1),
                        dtype=np.int32)
 
-    with jax.set_mesh(mesh):
+    # All shardings below are explicit NamedShardings; the ambient
+    # mesh only helps newer jax pick collective layouts, and older
+    # releases don't have the context manager at all.
+    import contextlib
+    mesh_ctx = (jax.set_mesh(mesh) if hasattr(jax, "set_mesh")
+                else contextlib.nullcontext())
+    with mesh_ctx:
         b = put_batch({"ids": jnp.asarray(data)}, mesh)
         # Warmup / compile. NOTE: a host fetch (float()) is the only
         # reliable execution barrier on tunneled devices —
@@ -137,11 +194,10 @@ def main():
     tokens = batch * seq * n_steps
     tok_per_s = tokens / dt
     tok_per_s_chip = tok_per_s / n_chips
-    fpt = flops_per_token(cfg, seq)
     mfu = (tok_per_s_chip * fpt) / peak_flops(devices[0])
 
     print(json.dumps({
-        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": round(tok_per_s_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
@@ -155,14 +211,14 @@ def main():
     }))
 
 
-def _error_line(msg: str) -> str:
+def _error_line(msg: str, metric: str) -> str:
     return json.dumps({
-        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0,
         "error": msg})
 
 
-def supervise() -> int:
+def supervise(model_name: str = "gpt2") -> int:
     """Run the measurement in a killable child process, retrying on
     failure. Each child is a fresh OS process, so every attempt fully
     re-initializes the JAX backend (the only way to recover from a
@@ -171,11 +227,14 @@ def supervise() -> int:
     child_budget = float(os.environ.get("BENCH_CHILD_TIMEOUT", "900"))
     backoffs = [30.0, 60.0, 120.0]
     errors = []
+    metric = _METRICS_BY_MODEL[model_name]
+    child_cmd = [sys.executable, os.path.abspath(__file__), "--child",
+                 "--model", model_name]
     for i in range(attempts):
         t0 = time.monotonic()
         try:
             proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
+                child_cmd,
                 capture_output=True, text=True, timeout=child_budget,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
         except subprocess.TimeoutExpired:
@@ -209,7 +268,7 @@ def supervise() -> int:
     cpu_sanity = None
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child"],
+            child_cmd,
             capture_output=True, text=True, timeout=600,
             cwd=os.path.dirname(os.path.abspath(__file__)),
             env={**os.environ, "JAX_PLATFORMS": "cpu",
@@ -222,7 +281,7 @@ def supervise() -> int:
         pass
     out = json.loads(_error_line(
         f"all {attempts} attempts failed: "
-        + " | ".join(errors)[:1200]))
+        + " | ".join(errors)[:1200], metric))
     if cpu_sanity and cpu_sanity.get("value", 0) > 0:
         out["cpu_sanity"] = {
             "tokens_per_sec": cpu_sanity["value"],
@@ -236,6 +295,6 @@ def supervise() -> int:
 
 if __name__ == "__main__":
     if "--child" in sys.argv:
-        main()
+        main(_model_arg(sys.argv))
     else:
-        sys.exit(supervise())
+        sys.exit(supervise(_model_arg(sys.argv)))

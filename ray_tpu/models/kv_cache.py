@@ -29,6 +29,7 @@ from __future__ import annotations
 from typing import List, NamedTuple, Optional, Sequence
 
 import jax.numpy as jnp
+import numpy as np
 
 
 class PagedKVLayer(NamedTuple):
@@ -75,6 +76,8 @@ class BlockAllocator:
         return len(self._free)
 
     def alloc(self, n: int) -> Optional[List[int]]:
+        if n < 0:
+            raise ValueError(f"cannot alloc {n} pages")
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
@@ -82,10 +85,28 @@ class BlockAllocator:
         return out
 
     def free(self, pages: Sequence[int]) -> None:
+        """Return pages to the free list. Rejects — atomically, before
+        any page is accepted — frees of the null page (0), ids outside
+        the pool, pages already free (double free), and the same page
+        listed twice in one call. Silent acceptance of any of these
+        corrupts the pool: the page would later be handed to two
+        sequences whose KV scatters then overwrite each other — and
+        once the prefix cache shares refcounted pages across
+        sequences, a stray free is a cross-REQUEST corruption, not
+        just a self-corruption."""
+        seen = set()
         for p in pages:
+            if not isinstance(p, (int, np.integer)):
+                raise ValueError(f"page id {p!r} is not an int")
             if not 0 < p < self.n_pages:
-                raise ValueError(f"bad page id {p}")
+                raise ValueError(
+                    f"bad page id {p} (null page 0 and ids >= "
+                    f"{self.n_pages} are never freeable)")
             if p in self._free_set:
                 raise ValueError(f"double free of page {p}")
+            if p in seen:
+                raise ValueError(
+                    f"page {p} listed twice in one free() call")
+            seen.add(p)
         self._free.extend(pages)
         self._free_set.update(pages)

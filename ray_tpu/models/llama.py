@@ -548,3 +548,14 @@ def llama_param_count(cfg: LlamaConfig) -> int:
                  3 * cfg.dim * cfg.hidden_dim + 2 * cfg.dim)
     return (cfg.vocab_size * cfg.dim + cfg.n_layers * per_layer +
             cfg.dim)
+
+
+def llama_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Training FLOPs per token: the standard 6N matmul estimate
+    (fwd 2N + bwd 4N) plus the attention-score term 12·L·H·hd·T that
+    6N misses because QK^T/AV scale with sequence length, not param
+    count. This is the denominator MFU is quoted against (PaLM
+    appendix B convention), so bench MFU numbers are comparable to
+    published ones."""
+    return (6.0 * llama_param_count(cfg) +
+            12.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim * seq_len)

@@ -64,6 +64,7 @@ import numpy as np
 
 from ray_tpu.models.kv_cache import (BlockAllocator, PagedKVLayer,
                                      init_kv_pool)
+from ray_tpu.serve.prefix_cache import PrefixCache
 from ray_tpu.serve.scheduler import StepPlan, SlotView, plan_step
 
 _DONE = object()
@@ -159,6 +160,9 @@ class _Slot:
     decoded: int = 0             # decode steps ridden (dispatch-time
                                  # arithmetic, ahead of emission)
     preempted: bool = False     # in-flight tokens must be discarded
+    shared: int = 0              # leading pages owned by the prefix
+                                 # cache (read-only: COW — scatters
+                                 # may only target pages >= shared)
 
     @property
     def prefill_remaining(self) -> int:
@@ -182,6 +186,10 @@ class LLMEngine:
         stall in-flight streams; smaller values tighten decode
         latency under prefill load, larger values finish prompts
         (and thus first tokens) in fewer rounds.
+    prefix_cache: share KV pages of identical page-aligned prompt
+        prefixes across requests (radix tree + refcounts + LRU
+        eviction, serve/prefix_cache.py). Repeated system-prompt /
+        few-shot prefixes then admit at near-zero prefill cost.
     """
 
     def __init__(self, model, params, *, max_slots: int = 8,
@@ -189,7 +197,8 @@ class LLMEngine:
                  chunk: int = 4, prefill_chunk: Optional[int] = None,
                  temperature: float = 0.0,
                  eos_id: Optional[int] = None, seed: int = 0,
-                 max_prefill_compiles: int = 16):
+                 max_prefill_compiles: int = 16,
+                 prefix_cache: bool = False):
         self.model = model
         self.cfg = model.config
         self.params = params
@@ -209,6 +218,16 @@ class LLMEngine:
                              -(-self.cfg.max_seq_len // page_size))
         self.alloc = BlockAllocator(n_pages)
         self.pages = init_kv_pool(self.cfg, n_pages, page_size)
+        # Radix-tree prefix KV cache (serve/prefix_cache.py): retired
+        # prompts' full pages enter the tree instead of the free list;
+        # admission matches the longest cached prefix and skips its
+        # prefill. Refcounted + LRU-evicted, so it costs nothing under
+        # memory pressure. Off by default: sharing only pays when
+        # prompts actually share page-aligned prefixes.
+        self.prefix_cache = (PrefixCache(self.alloc, page_size)
+                             if prefix_cache else None)
+        self._copy_page_fn = (self._build_copy_page()
+                              if prefix_cache else None)
         self.slots: List[Optional[_Slot]] = [None] * max_slots
         self._wait: "collections.deque[_Request]" = collections.deque()
         self._lock = threading.Lock()
@@ -392,7 +411,7 @@ class LLMEngine:
             if (slot is not None and slot.cur is not None
                     and self._owed(slot) <= 0):
                 self.slots[i] = None
-                self.alloc.free(slot.pages)
+                self._free_slot_pages_locked(slot, retire=True)
                 # "completed" counts at request close (emission)
 
     # ------------------------------------------------------- scheduler
@@ -453,23 +472,76 @@ class LLMEngine:
         chunk in the scheduling rounds (no monolithic padded-batch
         prefill, no same-padded-length grouping: the chunked prefill
         call batches mixed lengths and offsets natively). FIFO:
-        admission never reorders past the queue head."""
+        admission never reorders past the queue head.
+
+        With the prefix cache on, admission first matches the longest
+        cached page-aligned prefix: the slot's page table points at
+        those shared pages read-only, prefill RESUMES at the matched
+        offset (the existing mid-offset chunked-prefill path), and
+        the round's prefill budget only ever pays for the tokens
+        actually computed — skipped tokens never enter
+        ``prompt_remaining``. A fully-cached prompt copies its final
+        matched page into a private page (COW: the model still needs
+        the last position's logits to sample the first token, and
+        that one-token re-prefill must not scatter into a shared
+        page). When the pool is dry, refcount-0 cached pages are
+        evicted LRU-first before admission gives up."""
         while self._wait:
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
                 return
             req = self._wait[0]
             prompt = req.recompute_prompt
-            first = max(1, min(len(prompt), self.PC))
-            page_ids = self.alloc.alloc(-(-first // self.Pg))
+            shared_pages: List[int] = []
+            matched = 0
+            copy_src: Optional[int] = None
+            if self.prefix_cache is not None:
+                shared_pages, matched = self.prefix_cache.match(prompt)
+                if matched and matched == len(prompt):
+                    # whole prompt cached: re-prefill only the LAST
+                    # token, into a private copy of the final page
+                    copy_src = shared_pages.pop()
+                    matched -= 1
+            start = matched
+            first = max(1, min(len(prompt) - start, self.PC))
+            need = -(-(start + first) // self.Pg) - len(shared_pages)
+            page_ids = self.alloc.alloc(need)
+            if page_ids is None and self.prefix_cache is not None:
+                # reclaim LRU refcount-0 cached pages before failing
+                if self.prefix_cache.evict(
+                        need - self.alloc.n_free) > 0:
+                    page_ids = self.alloc.alloc(need)
             if page_ids is None:
-                return         # pool dry: wait for completions
+                # pool dry: hand the matched references back and wait
+                if self.prefix_cache is not None:
+                    if copy_src is not None:
+                        shared_pages = shared_pages + [copy_src]
+                    if shared_pages:
+                        self.prefix_cache.release(shared_pages)
+                return         # wait for completions
+            if copy_src is not None:
+                # duplicate the boundary page on-stream before any
+                # write can target it, then drop the borrowed ref
+                self.pages = self._copy_page_fn(
+                    self.pages, jnp.int32(copy_src),
+                    jnp.int32(page_ids[0]))
+                self.prefix_cache.release([copy_src])
             self._wait.popleft()
-            slot = _Slot(req=req, pages=page_ids, pos=0, cur=None,
+            slot = _Slot(req=req, pages=shared_pages + page_ids,
+                         pos=start, cur=None,
                          admit_seq=next(self._admit_seq),
-                         prompt=prompt)
+                         prompt=prompt, prefilled=start,
+                         shared=len(shared_pages))
             self.slots[free[0]] = slot
             self.stats["admitted"] += 1
+            if self.prefix_cache is not None:
+                self.prefix_cache.account(start, len(prompt) - start)
+                self.stats["cache_hit_tokens"] += start
+                self.stats["cache_miss_tokens"] += len(prompt) - start
+                if start:
+                    self.stats["cache_hit_admissions"] += 1
+                    self.sched_trace.append(
+                        ("cache_hit", (free[0], start)))
 
     def _dispatch_prefill_locked(self, grants):
         """Execute this round's prefill grants: grow each granted
@@ -487,6 +559,7 @@ class LLMEngine:
             take = min(g.tokens, slot.prefill_remaining)
             if take <= 0:
                 continue
+            self._check_cow_locked(slot, slot.prefilled)
             need = -(-(slot.prefilled + take) // self.Pg)
             evicted = False
             while len(slot.pages) < need:
@@ -497,6 +570,11 @@ class LLMEngine:
                 if got is not None:
                     slot.pages.extend(got)
                     break
+                if (self.prefix_cache is not None
+                        and self.prefix_cache.evict(
+                            need - len(slot.pages)
+                            - self.alloc.n_free) > 0):
+                    continue    # reclaimed cached pages; retry alloc
                 victim = max(
                     (j for j, s in enumerate(self.slots)
                      if s is not None and j != g.sid),
@@ -541,6 +619,11 @@ class LLMEngine:
                 if got is not None:
                     slot.pages.extend(got)
                     break
+                if (self.prefix_cache is not None
+                        and self.prefix_cache.evict(
+                            need - len(slot.pages)
+                            - self.alloc.n_free) > 0):
+                    continue    # reclaimed cached pages; retry alloc
                 victim = max(
                     (j for j, s in enumerate(self.slots)
                      if s is not None and j != i),
@@ -551,6 +634,50 @@ class LLMEngine:
                     # lone request fits, so this is a logic error
                     raise RuntimeError("page pool exhausted by one slot")
                 self._preempt_locked(victim)
+
+    def _check_cow_locked(self, slot: _Slot, write_pos: int) -> None:
+        """Copy-on-write invariant: pool pages are donated to jitted
+        calls and scattered into IN PLACE, so a write may only ever
+        target a page the slot exclusively owns. Shared (cache-owned)
+        pages are the slot's leading ``slot.shared`` page-table
+        entries and must sit strictly behind the write frontier."""
+        if slot.shared and write_pos < slot.shared * self.Pg:
+            raise RuntimeError(
+                f"COW violation: slot for rid={slot.req.rid} would "
+                f"scatter at pos {write_pos} into shared page index "
+                f"{write_pos // self.Pg} (< {slot.shared} cache-owned "
+                f"pages)")
+
+    def _free_slot_pages_locked(self, slot: _Slot,
+                                *, retire: bool) -> None:
+        """Return a slot's pages. Without the prefix cache this is a
+        plain free. With it: shared pages only ever drop a reference
+        (the tree keeps the KV); on retirement the finished prompt's
+        full pages are INSERTED into the radix tree instead of freed
+        (private ones donated, shared ones deduped), and only the
+        boundary/generated tail goes back to the allocator."""
+        if self.prefix_cache is None:
+            self.alloc.free(slot.pages)
+            return
+        if retire:
+            n_full = min(len(slot.prompt) // self.Pg, len(slot.pages))
+            self.prefix_cache.insert(slot.prompt,
+                                     slot.pages[:n_full], slot.shared)
+            tail = slot.pages[n_full:]
+            if tail:
+                self.alloc.free(tail)
+        else:
+            self.prefix_cache.release(slot.pages[:slot.shared])
+            priv = slot.pages[slot.shared:]
+            if priv:
+                self.alloc.free(priv)
+
+    def prefix_stats(self) -> Optional[Dict[str, Any]]:
+        """Prefix-cache counters (None when the cache is off)."""
+        if self.prefix_cache is None:
+            return None
+        with self._lock:
+            return self.prefix_cache.stats()
 
     def _preempt_locked(self, ix: int):
         # The victim's generated-so-far must be complete before the
@@ -565,7 +692,10 @@ class LLMEngine:
         slot = victim
         self.slots[ix] = None
         slot.preempted = True     # in-flight rows are recomputed
-        self.alloc.free(slot.pages)
+        # retire=False: a preemption must NEVER free shared pages —
+        # other sequences' page tables may point at them; their
+        # references are dropped and the tree keeps the KV
+        self._free_slot_pages_locked(slot, retire=False)
         slot.req.preemptions += 1
         self.stats["preemptions"] += 1
         self._wait.appendleft(slot.req)   # front: re-admit first
@@ -583,6 +713,7 @@ class LLMEngine:
         for i, slot in enumerate(self.slots):
             if slot is None or slot.cur is None:
                 continue
+            self._check_cow_locked(slot, slot.pos)
             pt[i, :len(slot.pages)] = slot.pages
             # tokens this slot still owes its client from THIS
             # dispatch (the tail of an overshooting window is junk)
@@ -693,7 +824,7 @@ class LLMEngine:
             slot = self.slots[ix]
             if slot is not None and slot.req is req:
                 self.slots[ix] = None
-                self.alloc.free(slot.pages)
+                self._free_slot_pages_locked(slot, retire=True)
             self.stats["completed"] += 1
             req.out_q.put(_DONE)
 
@@ -837,6 +968,19 @@ class LLMEngine:
             return buf, pages, key, pos, cur   # buf: [KMAX, S]
 
         return jax.jit(decode, donate_argnums=(1, 3, 4))
+
+    def _build_copy_page(self):
+        """Jitted whole-page copy across every layer's K and V pool:
+        the prefix cache's one COW copy, used when an admission's
+        prompt is FULLY cached — the final matched page is duplicated
+        into a private page so the one-token re-prefill (the model
+        needs the last position's logits) never scatters into a
+        shared page. src/dst are traced scalars: one executable."""
+        def copy(pages, src, dst):
+            return [(pk.at[:, dst].set(pk[:, src]),
+                     pv.at[:, dst].set(pv[:, src]))
+                    for pk, pv in pages]
+        return jax.jit(copy, donate_argnums=(0,))
 
     def _build_seed(self):
         """Jitted admission seeding: scatter a prefill batch's first

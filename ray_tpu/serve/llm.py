@@ -37,7 +37,8 @@ class LlamaDeployment:
                  page_size: int = 64, n_pages: Optional[int] = None,
                  decode_chunk: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 prefix_cache: bool = False):
         import jax
         from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
@@ -67,7 +68,8 @@ class LlamaDeployment:
         self._engine_opts = dict(
             max_slots=max_slots, page_size=page_size,
             n_pages=n_pages, chunk=decode_chunk or stream_chunk,
-            prefill_chunk=prefill_chunk, eos_id=eos_id)
+            prefill_chunk=prefill_chunk, eos_id=eos_id,
+            prefix_cache=prefix_cache)
 
     def setup_mesh(self, mesh):
         """Called by the serve replica when cfg.mesh is set: shard the
@@ -123,6 +125,8 @@ class LlamaDeployment:
         out.update(slots_live=live, slots_total=eng.S,
                    pages_free=free, pages_total=total,
                    consistent=locked)
+        if eng.prefix_cache is not None:
+            out["prefix_cache"] = eng.prefix_cache.stats()
         return {"engine": out}
 
     def __call__(self, prompt_ids: List[int]) -> List[int]:

@@ -25,7 +25,11 @@ Policy, in order:
   ``min(prompt_remaining, budget_left)`` tokens until the round's
   token budget or the prefill batch width runs out. A long prompt
   takes the whole budget for several rounds; several short prompts
-  pack into one round.
+  pack into one round. ``prompt_remaining`` is net of any tokens the
+  prefix cache (serve/prefix_cache.py) satisfied at admission — a
+  cache-hit slot enters mid-prompt, so the round's budget only ever
+  pays for tokens actually computed; skipped prefix tokens never
+  consume it.
 - Decode steps: if any seeded slot exists, decode rides every round.
   While admission work is pending (a free slot, an unseeded slot, a
   prefill grant this round) the cadence stays at ``decode_chunk`` so

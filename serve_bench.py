@@ -19,9 +19,18 @@ shape, and writes a single artifact with both results plus ratio
 fields. No more cross-round comparisons against a different chip
 day (the r05 artifact's caveat).
 
+--shared-prefix-len makes every prompt open with the SAME token
+prefix (system-prompt / few-shot load shape) — the case the radix-tree
+prefix KV cache (serve/prefix_cache.py) exists for. It implies
+--prefix-cache unless overridden; with --ab it adds a THIRD run
+(engine with the cache off, same load) so the artifact carries a
+cache-on vs cache-off engine-TTFT ratio measured in one session.
+
 Usage: python serve_bench.py [--model 7b|1b|tiny] [--ab] [--out FILE]
        [--requests N] [--threads N] [--gen-tokens N] [--prompt-len N]
        [--slots N] [--decode-chunk N] [--prefill-chunk N]
+       [--page-size N] [--shared-prefix-len N]
+       [--prefix-cache | --no-prefix-cache]
 (7b needs ~14GB HBM; falls back to 1b automatically on OOM.)
 """
 import argparse
@@ -95,6 +104,9 @@ def make_server(cfg, knobs, use_engine=True):
             def engine_ttfts(self):
                 return []
 
+            def engine_prefix_stats(self):
+                return None
+
         return serve.run(LegacyServer.bind(), timeout_s=600)
 
     @serve.deployment(max_ongoing_requests=64)
@@ -103,9 +115,11 @@ def make_server(cfg, knobs, use_engine=True):
             self.inner = LlamaDeployment(
                 config=cfg, max_new_tokens=gen_tokens,
                 use_engine=use_engine,
-                max_slots=knobs["slots"], page_size=64,
+                max_slots=knobs["slots"],
+                page_size=knobs["page_size"],
                 decode_chunk=knobs["decode_chunk"],
-                prefill_chunk=knobs["prefill_chunk"])
+                prefill_chunk=knobs["prefill_chunk"],
+                prefix_cache=knobs["prefix_cache"])
 
         def __call__(self, prompt):
             # joins the engine's decode batch at the next chunk
@@ -124,6 +138,9 @@ def make_server(cfg, knobs, use_engine=True):
             # prefill) — immune to client/transport skew
             return [float(x) for x in self.inner.engine().ttfts_s]
 
+        def engine_prefix_stats(self):
+            return self.inner.engine().prefix_stats()
+
     return serve.run(LlamaServer.bind(), timeout_s=600)
 
 
@@ -132,9 +149,20 @@ def bench(handle, rng, cfg, knobs):
 
     gen_tokens = knobs["gen_tokens"]
     plen = min(knobs["prompt_len"], cfg.max_seq_len - gen_tokens)
+    # Shared-prefix load shape: every prompt opens with the SAME
+    # tokens (system prompt / few-shot preamble), tails random. The
+    # prefix comes from its own fixed-seed RNG so cache-on and
+    # cache-off runs see the IDENTICAL prefix; at least one tail
+    # token stays random so requests are distinct.
+    shared = min(knobs["shared_prefix_len"], plen - 1)
+    prefix = (np.random.RandomState(12345)
+              .randint(1, cfg.vocab_size - 1, size=shared).tolist()
+              if shared > 0 else [])
 
     def prompt():
-        return rng.randint(1, cfg.vocab_size - 1, size=plen).tolist()
+        tail = rng.randint(1, cfg.vocab_size - 1,
+                           size=plen - len(prefix)).tolist()
+        return prefix + tail
 
     # --- warmup / compile (one batched decode + one stream step) ----
     t0 = time.time()
@@ -207,6 +235,12 @@ def bench(handle, rng, cfg, knobs):
         out["engine_ttft_ms"] = round(min(eng_ttfts) * 1000, 1)
         out["engine_ttft_p50_ms"] = round(
             statistics.median(eng_ttfts) * 1000, 1)
+        # the prefix-cache A/B compares MEANS: min/p50 hide the
+        # per-request prefill work the cache actually removes
+        out["engine_ttft_mean_ms"] = round(
+            statistics.mean(eng_ttfts) * 1000, 2)
+    if shared > 0:
+        out["shared_prefix_len"] = shared
     return out
 
 
@@ -243,6 +277,8 @@ def run_path(args, knobs, use_engine):
         result["slots"] = knobs["slots"]
         result["decode_chunk"] = knobs["decode_chunk"]
         result["prefill_chunk"] = knobs["prefill_chunk"]
+        result["page_size"] = knobs["page_size"]
+        result["prefix_cache_enabled"] = knobs["prefix_cache"]
         # (legacy path: engine_stats would lazily build an unused
         # engine — allocating the whole KV pool — just to report zeros)
         try:
@@ -250,6 +286,14 @@ def run_path(args, knobs, use_engine):
                 handle.engine_stats.remote(), timeout=60)
         except Exception:
             pass
+        if knobs["prefix_cache"]:
+            try:
+                ps = ray_tpu.get(handle.engine_prefix_stats.remote(),
+                                 timeout=60)
+                if ps:
+                    result["prefix_cache"] = ps
+            except Exception:
+                pass
     else:
         result["batch"] = LEGACY_BATCH
     serve.shutdown()
@@ -278,12 +322,30 @@ def main():
     ap.add_argument("--slots", type=int, default=SLOTS)
     ap.add_argument("--decode-chunk", type=int, default=DECODE_CHUNK)
     ap.add_argument("--prefill-chunk", type=int, default=PREFILL_CHUNK)
+    ap.add_argument("--page-size", type=int, default=64,
+                    help="KV page size in tokens (smaller pages make "
+                         "short shared prefixes cacheable: matching "
+                         "is page-granular)")
+    ap.add_argument("--shared-prefix-len", type=int, default=0,
+                    help="every prompt opens with this many IDENTICAL "
+                         "tokens (system-prompt load shape); implies "
+                         "--prefix-cache unless overridden")
+    ap.add_argument("--prefix-cache",
+                    action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="radix-tree prefix KV cache in the engine "
+                         "(default: on iff --shared-prefix-len > 0)")
     args = ap.parse_args()
+    prefix_cache = (args.shared_prefix_len > 0
+                    if args.prefix_cache is None else args.prefix_cache)
     knobs = dict(requests=args.requests, threads=args.threads,
                  gen_tokens=args.gen_tokens,
                  prompt_len=args.prompt_len, slots=args.slots,
                  decode_chunk=args.decode_chunk,
-                 prefill_chunk=args.prefill_chunk)
+                 prefill_chunk=args.prefill_chunk,
+                 page_size=args.page_size,
+                 shared_prefix_len=args.shared_prefix_len,
+                 prefix_cache=prefix_cache)
 
     import os
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -311,6 +373,17 @@ def main():
                      "path also reports engine-internal "
                      "first-emission TTFT.",
         }
+        if knobs["prefix_cache"] and knobs["shared_prefix_len"] > 0:
+            # third run: SAME engine path + load, prefix cache OFF —
+            # the cache's own A/B, free of engine-vs-legacy effects
+            off = run_path(args, dict(knobs, prefix_cache=False),
+                           use_engine=True)
+            result["engine_prefix_cache_off"] = off
+            on_ms = eng.get("engine_ttft_mean_ms")
+            off_ms = off.get("engine_ttft_mean_ms")
+            if on_ms and off_ms:
+                # < 1.0 means the cache lowered mean prefill latency
+                result["prefix_ttft_ratio"] = round(on_ms / off_ms, 3)
         out = args.out or "SERVE_BENCH_ab.json"
     else:
         result = run_path(args, knobs, use_engine=not args.legacy)

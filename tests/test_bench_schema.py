@@ -65,6 +65,50 @@ def test_ab_requires_both_sections_and_ratio(tmp_path):
     assert _problems_for("SERVE_BENCH_ab.json", no_leg, tmp_path)
 
 
+_PC = {"hit_tokens": 608, "miss_tokens": 352, "hit_rate": 0.63,
+       "evictions": 0, "cached_pages": 44}
+
+
+def test_prefix_cache_block_validated_when_present(tmp_path):
+    res = {"throughput_tok_s": 1.0, "p50_ms": 2.0, "p99_ms": 3.0,
+           "ttft_ms": 4.0, "stream_tok_s": 5.0}
+    ok = dict(res, prefix_cache=dict(_PC))
+    assert _problems_for("SERVE_BENCH_x.json", ok, tmp_path) == []
+    for field in _PC:
+        bad = dict(res, prefix_cache={k: v for k, v in _PC.items()
+                                      if k != field})
+        probs = _problems_for("SERVE_BENCH_x.json", bad, tmp_path)
+        assert any(field in p for p in probs), field
+    typed = dict(res, prefix_cache=dict(_PC, hit_rate="0.63"))
+    assert _problems_for("SERVE_BENCH_x.json", typed, tmp_path)
+    not_obj = dict(res, prefix_cache=[1, 2])
+    assert _problems_for("SERVE_BENCH_x.json", not_obj, tmp_path)
+
+
+def test_prefix_cache_ab_requires_stats_and_ratio(tmp_path):
+    res = {"throughput_tok_s": 1.0, "p50_ms": 2.0, "p99_ms": 3.0,
+           "ttft_ms": 4.0, "stream_tok_s": 5.0}
+    eng = dict(res, prefix_cache=dict(_PC))
+    ok = {"engine_continuous_batching": eng,
+          "legacy_decode_to_completion": dict(res),
+          "engine_prefix_cache_off": dict(res),
+          "throughput_ratio": 1.5, "prefix_ttft_ratio": 0.75}
+    assert _problems_for("SERVE_BENCH_ab.json", ok, tmp_path) == []
+    # cache-off section present but engine carries no cache stats
+    no_stats = dict(ok, engine_continuous_batching=dict(res))
+    probs = _problems_for("SERVE_BENCH_ab.json", no_stats, tmp_path)
+    assert any("no prefix_cache stats" in p for p in probs)
+    # missing the dedicated ratio
+    no_ratio = {k: v for k, v in ok.items()
+                if k != "prefix_ttft_ratio"}
+    probs = _problems_for("SERVE_BENCH_ab.json", no_ratio, tmp_path)
+    assert any("prefix_ttft_ratio" in p for p in probs)
+    # the off section is itself a full serve result
+    bad_off = dict(ok, engine_prefix_cache_off={"ttft_ms": 1.0})
+    probs = _problems_for("SERVE_BENCH_ab.json", bad_off, tmp_path)
+    assert any("engine_prefix_cache_off" in p for p in probs)
+
+
 def test_bench_wrapper_and_flat_metric(tmp_path):
     wrapper = {"n": 3, "cmd": "python bench.py", "rc": 0,
                "tail": "...", "parsed": {"metric": "m", "value": 1.0}}

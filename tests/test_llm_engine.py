@@ -55,6 +55,24 @@ def test_allocator_basics():
         a.free([0])                # null page is never freeable
 
 
+def test_allocator_free_validation_is_atomic():
+    a = BlockAllocator(8)
+    got = a.alloc(4)
+    with pytest.raises(ValueError):
+        a.free([got[0], got[0]])   # same page twice in one call
+    with pytest.raises(ValueError):
+        a.free([got[1], 99])       # out-of-range id
+    with pytest.raises(ValueError):
+        a.free([got[2], 2.5])      # non-int id
+    # nothing was accepted from the rejected calls: freeing the batch
+    # cleanly still works (no partial state)
+    assert a.n_free == 3
+    a.free(got)
+    assert a.n_free == 7
+    with pytest.raises(ValueError):
+        a.alloc(-1)
+
+
 # ------------------------------------------------------------------ parity
 
 

@@ -44,6 +44,16 @@ FLAT_METRIC_REQUIRED = {
     "unit": str,
 }
 
+# serve results carry this block whenever the radix-tree prefix KV
+# cache was on (serve/prefix_cache.py stats())
+PREFIX_CACHE_REQUIRED = {
+    "hit_tokens": NUM,
+    "miss_tokens": NUM,
+    "hit_rate": NUM,
+    "evictions": NUM,
+    "cached_pages": NUM,
+}
+
 BENCH_WRAPPER_REQUIRED = {
     "n": int,
     "cmd": str,
@@ -65,6 +75,17 @@ def _check_fields(obj, required, where, problems):
                 f"{type(obj[key]).__name__}")
 
 
+def _check_serve_result(obj, where, problems):
+    _check_fields(obj, SERVE_RESULT_REQUIRED, where, problems)
+    pc = obj.get("prefix_cache")
+    if pc is not None:
+        if not isinstance(pc, dict):
+            problems.append(f"{where}: prefix_cache must be an object")
+        else:
+            _check_fields(pc, PREFIX_CACHE_REQUIRED,
+                          f"{where}:prefix_cache", problems)
+
+
 def check_serve_bench(obj, name, problems):
     if "engine_continuous_batching" in obj:
         # A/B artifact: engine section is a full result; the legacy
@@ -77,23 +98,43 @@ def check_serve_bench(obj, name, problems):
             problems.append(f"{name}: engine_continuous_batching "
                             "must be an object")
         else:
-            _check_fields(eng, SERVE_RESULT_REQUIRED,
-                          f"{name}:engine_continuous_batching",
-                          problems)
+            _check_serve_result(eng,
+                                f"{name}:engine_continuous_batching",
+                                problems)
         if not isinstance(leg, dict):
             problems.append(f"{name}: A/B artifact missing "
                             "legacy_decode_to_completion object")
         else:
-            _check_fields(leg, SERVE_RESULT_REQUIRED,
-                          f"{name}:legacy_decode_to_completion",
-                          problems)
+            _check_serve_result(leg,
+                                f"{name}:legacy_decode_to_completion",
+                                problems)
         ratios = [k for k, v in obj.items()
                   if k.endswith("_ratio") and isinstance(v, NUM)]
         if not ratios:
             problems.append(f"{name}: A/B artifact has no numeric "
                             "*_ratio field")
+        off = obj.get("engine_prefix_cache_off")
+        if off is not None:
+            # prefix-cache A/B: the cache-off run is a full engine
+            # result, and the cache-on engine section must actually
+            # carry cache stats plus a dedicated ratio — otherwise
+            # the third run proves nothing
+            if not isinstance(off, dict):
+                problems.append(f"{name}: engine_prefix_cache_off "
+                                "must be an object")
+            else:
+                _check_serve_result(
+                    off, f"{name}:engine_prefix_cache_off", problems)
+            if isinstance(eng, dict) and "prefix_cache" not in eng:
+                problems.append(
+                    f"{name}: has engine_prefix_cache_off but the "
+                    "engine section carries no prefix_cache stats")
+            if not isinstance(obj.get("prefix_ttft_ratio"), NUM):
+                problems.append(
+                    f"{name}: prefix-cache A/B artifact missing "
+                    "numeric prefix_ttft_ratio")
     else:
-        _check_fields(obj, SERVE_RESULT_REQUIRED, name, problems)
+        _check_serve_result(obj, name, problems)
 
 
 def check_bench(obj, name, problems):
