@@ -64,6 +64,7 @@ import numpy as np
 
 from ray_tpu.models.kv_cache import (BlockAllocator, PagedKVLayer,
                                      init_kv_pool)
+from ray_tpu.serve import spec_decode
 from ray_tpu.serve.prefix_cache import PrefixCache
 from ray_tpu.serve.scheduler import StepPlan, SlotView, plan_step
 
@@ -163,6 +164,13 @@ class _Slot:
     shared: int = 0              # leading pages owned by the prefix
                                  # cache (read-only: COW — scatters
                                  # may only target pages >= shared)
+    spec: Optional[Any] = None   # per-slot n-gram proposer
+                                 # (spec_decode.NGramIndex); dies with
+                                 # the slot on preemption, rebuilt at
+                                 # re-admission — no stale drafts
+    spec_pending: List[int] = dataclasses.field(default_factory=list)
+                                 # drafts proposed at plan time,
+                                 # consumed by this round's verify
 
     @property
     def prefill_remaining(self) -> int:
@@ -190,6 +198,22 @@ class LLMEngine:
         prefixes across requests (radix tree + refcounts + LRU
         eviction, serve/prefix_cache.py). Repeated system-prompt /
         few-shot prefixes then admit at near-zero prefill cost.
+    spec_len: speculative decoding (serve/spec_decode.py) — up to
+        this many prompt-lookup draft tokens per slot per round,
+        verified by ONE batched multi-token forward pass through the
+        paged ``T>=1`` branch; the longest argmax-matching draft
+        prefix (plus one bonus token) is kept, rejections roll back
+        by clamping the slot's KV offset. 0 (default) disables.
+        Greedy-only: sampling (temperature > 0) would need
+        distribution-preserving rejection sampling, so speculation
+        is silently disabled then — the accepted stream must stay
+        bit-identical to non-speculative decode. Spec rounds are
+        host-synchronous (acceptance gates the next dispatch), so
+        the engine drains readbacks every round like the eos path.
+    spec_ngram: suffix n-gram order for the prompt-lookup proposer.
+    spec_proposer: test seam — a zero-arg factory returning an
+        object with the NGramIndex protocol (sync/propose), built
+        once per admitted slot.
     """
 
     def __init__(self, model, params, *, max_slots: int = 8,
@@ -198,7 +222,9 @@ class LLMEngine:
                  temperature: float = 0.0,
                  eos_id: Optional[int] = None, seed: int = 0,
                  max_prefill_compiles: int = 16,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 spec_len: int = 0, spec_ngram: int = 3,
+                 spec_proposer=None):
         self.model = model
         self.cfg = model.config
         self.params = params
@@ -228,6 +254,20 @@ class LLMEngine:
                              if prefix_cache else None)
         self._copy_page_fn = (self._build_copy_page()
                               if prefix_cache else None)
+        # Speculative decoding (serve/spec_decode.py): greedy-only —
+        # verification accepts drafts against the argmax, so with
+        # sampling it would skew the output distribution. Silently
+        # off at temperature > 0 (docs/serving.md).
+        if spec_len < 0:
+            raise ValueError("spec_len must be >= 0")
+        if spec_len and spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1")
+        self.spec_len = spec_len if temperature <= 0.0 else 0
+        self.spec_ngram = spec_ngram
+        self._proposer_factory = (
+            spec_proposer if spec_proposer is not None
+            else (lambda: spec_decode.NGramIndex(spec_ngram)))
+        self._verify_fn = None       # built on first spec dispatch
         self.slots: List[Optional[_Slot]] = [None] * max_slots
         self._wait: "collections.deque[_Request]" = collections.deque()
         self._lock = threading.Lock()
@@ -345,8 +385,14 @@ class LLMEngine:
         drains readbacks before planning (latency profile of the
         classic chunked loop). Returns False when idle."""
         with self._lock:
-            if not self._deferred:
-                self._drain_fetches_locked()   # emissions gate planning
+            if not self._deferred or self.spec_len:
+                # eos mode: emissions gate planning. Spec mode: the
+                # proposer's context and the verify's input token are
+                # HOST state (req.generated), so every round syncs to
+                # the device before planning — speculation trades the
+                # deferred pipeline's async pacing for multi-token
+                # dispatches.
+                self._drain_fetches_locked()
             else:
                 # Opportunistic: read back anything already finished
                 # BEFORE admitting — free on a fast local device, and
@@ -363,7 +409,9 @@ class LLMEngine:
             plan = self._plan_steps_locked()
             if plan.prefill:
                 self._dispatch_prefill_locked(plan.prefill)
-            if plan.decode_steps:
+            if plan.spec:
+                self._dispatch_spec_locked(plan.spec)
+            elif plan.decode_steps:
                 self._grow_or_preempt_locked(plan.decode_steps)
                 self._dispatch_chunk_locked(plan.decode_steps)
                 if self._deferred:
@@ -381,18 +429,45 @@ class LLMEngine:
         how many decode steps ride behind them. Run-ahead-to-next-
         completion, quick cadence while admission work is pending,
         and the eos bound all live in the planner — this wrapper only
-        snapshots slot state."""
+        snapshots slot state (plus, with speculation on, one
+        prompt-lookup proposal per seeded slot)."""
+        if self.spec_len:
+            self._propose_spec_locked()
         views = [SlotView(sid=i, admit_seq=s.admit_seq,
                           prompt_remaining=s.prefill_remaining,
                           owed=self._owed(s) if s.cur is not None
                           else 0,
-                          seeded=s.cur is not None)
+                          seeded=s.cur is not None,
+                          spec_drafts=len(s.spec_pending))
                  for i, s in enumerate(self.slots) if s is not None]
         return plan_step(views, total_slots=self.S,
                          prefill_budget=self.PC, decode_chunk=self.K,
                          max_run_ahead=self.KMAX,
                          prefill_batch=self._max_prefill_batch,
-                         eos_bounded=self.eos_id is not None)
+                         eos_bounded=self.eos_id is not None,
+                         spec_enabled=bool(self.spec_len))
+
+    def _propose_spec_locked(self):
+        """Refresh each seeded slot's prompt-lookup proposal. Runs
+        AFTER the round's full drain, so ``req.generated`` is exactly
+        the device's token stream: the proposer syncs its rolling
+        index with the unseen tail and drafts up to ``spec_len``
+        continuation tokens. A slot whose remaining budget is 1
+        proposes nothing — the verify's bonus token already covers
+        it."""
+        for s in self.slots:
+            if s is None:
+                continue
+            s.spec_pending = []
+            if (s.cur is None or s.preempted or s.req.closed
+                    or not s.req.generated):
+                continue
+            if s.spec is None:
+                s.spec = self._proposer_factory()
+            s.spec.sync(s.req.prompt + s.req.generated)
+            room = min(self.spec_len, s.req.remaining - 1)
+            if room > 0:
+                s.spec_pending = [int(t) for t in s.spec.propose(room)]
 
     def _owed(self, slot: _Slot) -> int:
         """Decode steps this slot still needs, by dispatch-time
@@ -737,6 +812,170 @@ class LLMEngine:
         self.stats["chunks"] += 1
         self.stats["decode_steps"] += steps
 
+    def _dispatch_spec_locked(self, grants):
+        """One batched draft-and-verify dispatch (speculative
+        decoding, serve/spec_decode.py). Every granted slot's row is
+        ``[cur, d_1 .. d_k]`` — its last emitted token plus up to
+        ``spec_len`` prompt-lookup drafts — scored in ONE forward
+        pass through the paged multi-token branch at the slot's own
+        offset (the same append-at-offset path chunked prefill uses).
+        Row i's argmax at position j is the true greedy token after
+        its j-th input token, so the longest draft prefix matching
+        the argmax is accepted, plus the argmax after it (bonus
+        token): between 1 and k+1 tokens per slot per dispatch, each
+        one EXACTLY what non-speculative greedy decode would have
+        produced.
+
+        Rollback is free: the verify scattered KV for every input
+        token, but positions past the accepted frontier hold tokens
+        the model rejected — the slot's write offset is CLAMPED to
+        ``pos + accepted + 1`` and the garbage beyond it is
+        overwritten by later dispatches before any query's causal
+        window can reach it (a key at position p is only attended
+        once some query sits at >= p, and every later dispatch
+        rewrites positions from the clamped frontier up before
+        attending). Pages stay owned by the slot. COW discipline
+        from the prefix cache is asserted per row: the verify writes
+        from ``slot.pos``, which page-aligned matching keeps
+        strictly past the shared (refcounted) pages, so
+        verification never scatters into a page another sequence
+        reads.
+
+        Host-synchronous by construction: acceptance decides the
+        next dispatch's input token and offset, so the argmax
+        readback blocks here (spec trades the deferred pipeline's
+        async pacing for multi-token dispatches)."""
+        T = self.spec_len + 1
+        if self._verify_fn is None:
+            self._verify_fn = self._build_verify(T)
+        rows = []
+        for g in grants:
+            slot = self.slots[g.sid]
+            if (slot is None or slot.cur is None
+                    or not slot.req.generated):
+                continue       # evicted / reseated since planning
+            drafts = slot.spec_pending[:max(0, g.drafts)]
+            self._check_cow_locked(slot, slot.pos)
+            # grow pages to cover every verify write (cur + drafts),
+            # exactly like prefill growth: prefix-cache eviction
+            # first, then youngest-other preemption
+            need = -(-(slot.pos + len(drafts) + 1) // self.Pg)
+            evicted = False
+            while len(slot.pages) < need:
+                if self.slots[g.sid] is not slot:
+                    evicted = True
+                    break
+                got = self.alloc.alloc(need - len(slot.pages))
+                if got is not None:
+                    slot.pages.extend(got)
+                    break
+                if (self.prefix_cache is not None
+                        and self.prefix_cache.evict(
+                            need - len(slot.pages)
+                            - self.alloc.n_free) > 0):
+                    continue
+                victim = max(
+                    (j for j, s in enumerate(self.slots)
+                     if s is not None and j != g.sid),
+                    key=lambda j: self.slots[j].admit_seq,
+                    default=None)
+                if victim is None:
+                    # submit() sized the pool for prompt+completion,
+                    # and pos + drafts + 1 never exceeds that
+                    raise RuntimeError(
+                        "page pool exhausted by one slot")
+                self._preempt_locked(victim)
+            if not evicted and self.slots[g.sid] is slot:
+                rows.append((g.sid, slot, drafts))
+        # a later grant's growth can evict an earlier grant's slot
+        rows = [(ix, slot, d) for ix, slot, d in rows
+                if self.slots[ix] is slot]
+        if not rows:
+            return
+        ids = np.zeros((self.S, T), np.int32)
+        start = np.zeros((self.S,), np.int32)
+        pt = np.zeros((self.S, self.max_pages), np.int32)
+        for i, slot, drafts in rows:
+            ids[i, 0] = slot.req.generated[-1]
+            if drafts:
+                ids[i, 1:1 + len(drafts)] = drafts
+            start[i] = slot.pos
+            pt[i, :len(slot.pages)] = slot.pages
+        out_dev, self.pages = self._verify_fn(
+            self.params, self.pages, jnp.asarray(ids),
+            jnp.asarray(start), jnp.asarray(pt))
+        out = np.asarray(out_dev)    # host sync: acceptance gates
+        m = spec_decode.metrics()
+        self.stats["spec_rounds"] += 1
+        # surviving slots' device decode state is reseeded with the
+        # accepted frontier via the admission scatter (mode='drop'
+        # rows padded with ix == S)
+        ixs = np.full((self.S,), self.S, np.int32)
+        toks = np.zeros((self.S,), np.int32)
+        posv = np.zeros((self.S,), np.int32)
+        n_seed = 0
+        for i, slot, drafts in rows:
+            row = out[i]
+            a = 0
+            while a < len(drafts) and drafts[a] == int(row[a]):
+                a += 1
+            produced = a + 1
+            proposed = len(drafts)
+            self.sched_trace.append(("spec", i, proposed, a))
+            self.stats["spec_riders"] += 1
+            self.stats["spec_proposed"] += proposed
+            self.stats["spec_accepted"] += a
+            self.stats["spec_rejected"] += proposed - a
+            self.stats["spec_tokens"] += produced
+            if proposed:
+                m["proposed"].inc(proposed)
+                if a:
+                    m["accepted"].inc(a)
+                if proposed - a:
+                    m["rejected"].inc(proposed - a)
+                m["accept_rate"].observe(a / proposed)
+            slot.spec_pending = []
+            slot.pos += produced       # rollback clamp: KV frontier
+            slot.decoded += produced   # = accepted + bonus, not k+1
+            self._emit_to(slot.req, [int(t) for t in row[:produced]],
+                          i)
+            if self.slots[i] is slot:  # not closed by the emission
+                ixs[n_seed] = i
+                toks[n_seed] = int(row[a])
+                posv[n_seed] = slot.pos
+                n_seed += 1
+        if n_seed:
+            self._dev_cur, self._dev_pos = self._seed_fn(
+                self._dev_cur, self._dev_pos, jnp.asarray(toks),
+                jnp.asarray(ixs),
+                jnp.arange(self.S, dtype=jnp.int32),
+                jnp.asarray(posv))
+
+    def spec_stats(self) -> Optional[Dict[str, Any]]:
+        """Speculative-decoding counters (None when speculation is
+        off). ``tokens_per_dispatch`` is emitted tokens per
+        (slot, verify-dispatch) ride — > 1.0 means speculation beat
+        the one-token-per-forward-pass decode floor."""
+        if not self.spec_len:
+            return None
+        with self._lock:
+            s = self.stats
+            proposed = s["spec_proposed"]
+            riders = s["spec_riders"]
+            return {
+                "spec_len": self.spec_len,
+                "spec_ngram": self.spec_ngram,
+                "rounds": s["spec_rounds"],
+                "proposed_tokens": proposed,
+                "accepted_tokens": s["spec_accepted"],
+                "rejected_tokens": s["spec_rejected"],
+                "accept_rate": round(s["spec_accepted"] / proposed, 4)
+                if proposed else 0.0,
+                "tokens_per_dispatch":
+                    round(s["spec_tokens"] / riders, 4)
+                    if riders else 0.0,
+            }
+
     def _drain_fetches_locked(self, limit: Optional[int] = None,
                               keep: int = 0,
                               ready_only: bool = False):
@@ -934,6 +1173,29 @@ class LLMEngine:
             return firsts, new_pages, rng
 
         return jax.jit(prefill, donate_argnums=(1,))
+
+    def _build_verify(self, T: int):
+        """One spec-verify executable for row width ``T`` (=
+        ``spec_len + 1``): [S, T] rows of [cur, drafts...] scatter
+        into each slot's pages at its own offset and attend causally
+        over the slot's page window — the exact chunked-prefill path,
+        reused at decode offsets. Greedy by construction: position
+        j's argmax is the token plain temperature-0 decode would
+        have emitted after input j, so acceptance is a pure prefix
+        compare on the host. No rng threading — speculation is
+        disabled at temperature > 0."""
+        model = self.model
+
+        def verify(params, pages, ids, start, page_table):
+            kv = [PagedKVLayer(pk, pv, page_table)
+                  for pk, pv in pages]
+            logits, new_kv = model.apply(params, ids, kv_caches=kv,
+                                         cache_len=start)
+            new_pages = [(c.pages_k, c.pages_v) for c in new_kv]
+            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                    new_pages)
+
+        return jax.jit(verify, donate_argnums=(1,))
 
     def _build_decode(self):
         model, temp = self.model, self.temperature

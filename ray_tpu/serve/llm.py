@@ -38,7 +38,8 @@ class LlamaDeployment:
                  decode_chunk: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  eos_id: Optional[int] = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 spec_len: int = 0, spec_ngram: int = 3):
         import jax
         from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
@@ -69,7 +70,8 @@ class LlamaDeployment:
             max_slots=max_slots, page_size=page_size,
             n_pages=n_pages, chunk=decode_chunk or stream_chunk,
             prefill_chunk=prefill_chunk, eos_id=eos_id,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache,
+            spec_len=spec_len, spec_ngram=spec_ngram)
 
     def setup_mesh(self, mesh):
         """Called by the serve replica when cfg.mesh is set: shard the

@@ -37,6 +37,18 @@ Policy, in order:
   full, fully-seeded batch the plan runs ahead to the next completion
   event (min owed over riders) exactly as before. With an eos the
   run-ahead is bounded — tokens past an unpredicted eos are wasted.
+- Spec lane (``spec_enabled``, serve/spec_decode.py): when any seeded
+  slot carries draft tokens this round, ONE batched verify dispatch
+  replaces the decode chunk — every seeded slot rides it (a slot with
+  zero drafts degrades to a plain one-token step inside the same
+  dispatch), so speculation never forks the device schedule. Draft
+  counts are clamped so a verify can never emit past a slot's
+  remaining budget (``owed``) nor past ``max_run_ahead`` (spec rounds
+  count against the same run-ahead ceiling as decode). When NO slot
+  has a proposal the round degrades to the plain decode lane — held
+  to quick cadence, since running ahead would decode past every
+  future proposal window — and the prefill lane is computed first
+  either way: speculation never starves chunked prefill.
 """
 from __future__ import annotations
 
@@ -52,6 +64,8 @@ class SlotView:
     prompt_remaining: int    # prompt tokens not yet prefilled
     owed: int                # decode steps still owed (seeded slots)
     seeded: bool             # riding decode dispatches already
+    spec_drafts: int = 0     # draft tokens proposed this round
+                             # (prompt-lookup, serve/spec_decode.py)
 
     @property
     def prefilling(self) -> bool:
@@ -65,25 +79,41 @@ class PrefillGrant:
 
 
 @dataclasses.dataclass(frozen=True)
+class SpecGrant:
+    """One slot's ride on this round's batched verify dispatch.
+    ``drafts`` is the number of proposed tokens to verify — 0 means
+    the slot rides as a plain one-token step (its row is just
+    [cur])."""
+    sid: int
+    drafts: int
+
+
+@dataclasses.dataclass(frozen=True)
 class StepPlan:
     prefill: Tuple[PrefillGrant, ...]
     decode_steps: int
+    spec: Tuple[SpecGrant, ...] = ()
 
     @property
     def idle(self) -> bool:
-        return not self.prefill and self.decode_steps == 0
+        return (not self.prefill and self.decode_steps == 0
+                and not self.spec)
 
 
 def plan_step(slots: Sequence[SlotView], *, total_slots: int,
               prefill_budget: int, decode_chunk: int,
               max_run_ahead: int, prefill_batch: int,
-              eos_bounded: bool) -> StepPlan:
+              eos_bounded: bool,
+              spec_enabled: bool = False) -> StepPlan:
     """Plan one scheduling round. Pure: no device, no clock, no
     engine state — everything it needs is in the arguments.
 
     slots: occupied slots only (free slots are ``total_slots`` minus
     ``len(slots)``). Returns the prefill grants (FIFO, budget-packed)
-    and the decode step count for this round (0 = no decode dispatch).
+    and either the decode step count (0 = no decode dispatch) or, when
+    ``spec_enabled`` and any seeded slot proposed drafts, the spec
+    grants for one batched verify dispatch (decode_steps is then 0 —
+    the lanes are exclusive per round).
     """
     if prefill_budget < 1:
         raise ValueError("prefill_budget must be >= 1")
@@ -100,13 +130,34 @@ def plan_step(slots: Sequence[SlotView], *, total_slots: int,
         grants.append(PrefillGrant(v.sid, take))
         budget -= take
 
-    rem = [v.owed for v in slots if v.seeded]
-    if not rem:
+    seeded = sorted((v for v in slots if v.seeded),
+                    key=lambda v: v.admit_seq)
+    if not seeded:
         return StepPlan(tuple(grants), 0)
+
+    if spec_enabled and any(v.spec_drafts > 0 for v in seeded):
+        # Spec lane: ONE batched verify covering every seeded slot
+        # (zero-draft rows are plain one-token steps), replacing this
+        # round's decode chunk. A verify emits between 1 and
+        # drafts + 1 tokens per slot, so drafts are clamped to the
+        # slot's remaining budget minus the guaranteed bonus token
+        # and to the run-ahead ceiling the decode lane honors.
+        spec = tuple(
+            SpecGrant(v.sid, max(0, min(v.spec_drafts, v.owed - 1,
+                                        max_run_ahead - 1)))
+            for v in seeded)
+        return StepPlan(tuple(grants), 0, spec)
+
+    rem = [v.owed for v in seeded]
     quick = (len(slots) < total_slots
              or any(not v.seeded for v in slots)
              or bool(grants))
-    steps = decode_chunk if quick else max(decode_chunk, min(rem))
+    # Spec mode keeps the decode lane on quick cadence even with a
+    # full batch: run-ahead would decode past every future proposal
+    # window before the host proposer gets another round (speculation
+    # trades run-ahead pipelining for multi-token dispatches).
+    steps = (decode_chunk if quick or spec_enabled
+             else max(decode_chunk, min(rem)))
     if eos_bounded:
         steps = min(steps, 2 * decode_chunk)
     return StepPlan(tuple(grants), max(1, min(steps, max_run_ahead)))

@@ -1,0 +1,138 @@
+"""Model-free speculative decoding: prompt-lookup n-gram proposer.
+
+Steady-state decode advances every slot exactly one token per model
+dispatch — the per-token latency floor is one full forward pass.
+Draft-and-verify decoding raises tokens-per-dispatch without a draft
+model: the HOST proposes the next few tokens by looking the current
+n-gram suffix up in the slot's own prompt+generated history (prompt
+lookup / n-gram self-speculation — free on the repetitive suffixes
+that dominate extraction, code-edit, and multi-turn-chat loads), and
+the engine scores all ``spec_len + 1`` positions in ONE batched
+forward pass through the existing paged multi-token branch
+(ops/paged_attention.paged_append + the llama.py paged ``T>=1``
+path). The longest draft prefix matching the greedy argmax is
+accepted, plus the argmax token after it (the standard bonus token),
+so a verify dispatch yields between 1 and ``spec_len + 1`` tokens.
+
+Exactness: every emitted token IS a greedy argmax of the model's own
+logits over the same KV the plain decode step would see — drafts only
+decide how many of those argmaxes one dispatch gets to keep, never
+what they are. At temperature 0 the accepted stream is therefore
+token-identical to non-speculative decode (enforced by
+tests/test_spec_decode.py). Rejected positions cost nothing to state:
+the engine rolls back by clamping the slot's KV write offset — the
+garbage KV beyond the new frontier is overwritten before any query
+can attend to it, and the pages stay owned by the slot.
+
+The proposer here is pure host-side bookkeeping (no jax): a rolling
+index from every ``ngram``-token window to its most recent earlier
+occurrence, extended incrementally as tokens emit. ``propose`` is
+O(1) per call; ``sync`` is O(new tokens).
+
+Metrics (util/metrics.py, Prometheus text via the dashboard):
+proposed/accepted/rejected token counters plus a per-verify
+accept-rate histogram.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+PROPOSED_TOKENS = "serve_spec_proposed_tokens"
+ACCEPTED_TOKENS = "serve_spec_accepted_tokens"
+REJECTED_TOKENS = "serve_spec_rejected_tokens"
+ACCEPT_RATE = "serve_spec_accept_rate"
+
+_METRICS: Optional[dict] = None
+
+
+def metrics() -> dict:
+    """Lazy module-level metric singletons, re-created if a test's
+    ``clear_registry()`` dropped them (same discipline as
+    serve/prefix_cache.py: registration is global per process, values
+    live on the instances)."""
+    global _METRICS
+    from ray_tpu.util import metrics as m
+    if (_METRICS is None
+            or m.registry().get(PROPOSED_TOKENS)
+            is not _METRICS["proposed"]):
+        _METRICS = {
+            "proposed": m.Counter(
+                PROPOSED_TOKENS,
+                "Draft tokens proposed to verify dispatches"),
+            "accepted": m.Counter(
+                ACCEPTED_TOKENS,
+                "Draft tokens accepted (matched the greedy argmax)"),
+            "rejected": m.Counter(
+                REJECTED_TOKENS,
+                "Draft tokens rejected (rolled back by clamping the "
+                "slot's KV offset)"),
+            "accept_rate": m.Histogram(
+                ACCEPT_RATE,
+                "Per-slot-per-verify draft accept rate",
+                boundaries=[0.1, 0.25, 0.5, 0.75, 0.9, 1.0]),
+        }
+    return _METRICS
+
+
+class NGramIndex:
+    """Rolling n-gram index over one slot's prompt+generated tokens.
+
+    Maps every ``n``-token window to the position just PAST its most
+    recent occurrence, keeping one generation of history per gram so
+    the current suffix (always the newest occurrence of itself) can
+    still find its previous one. ``propose(k)`` returns the up-to-k
+    tokens that followed the suffix's previous occurrence — the
+    prompt-lookup draft.
+
+    The engine keeps one per slot and calls ``sync`` with the full
+    context each round; only the unseen tail is consumed, so a slot's
+    index costs O(1) per generated token over its lifetime. Preemption
+    discards the slot (and this index) wholesale; re-admission builds
+    a fresh one from the recompute prompt — mid-flight state can never
+    leak across an eviction.
+    """
+
+    def __init__(self, n: int = 3):
+        if n < 1:
+            raise ValueError("ngram order must be >= 1")
+        self.n = n
+        self._tokens: List[int] = []
+        # gram -> index just past its latest occurrence, and the one
+        # before that (the suffix gram's latest occurrence is itself)
+        self._last: Dict[Tuple[int, ...], int] = {}
+        self._prev: Dict[Tuple[int, ...], int] = {}
+
+    def __len__(self) -> int:
+        return len(self._tokens)
+
+    def sync(self, context: Sequence[int]) -> None:
+        """Extend the index with ``context``'s unseen tail. The caller
+        always passes the slot's full prompt+generated stream; tokens
+        already indexed are skipped, so this never re-scans."""
+        if len(context) < len(self._tokens):
+            raise ValueError(
+                f"context shrank: indexed {len(self._tokens)} tokens "
+                f"but got {len(context)}")
+        for t in context[len(self._tokens):]:
+            self._tokens.append(int(t))
+            if len(self._tokens) >= self.n:
+                gram = tuple(self._tokens[-self.n:])
+                if gram in self._last:
+                    self._prev[gram] = self._last[gram]
+                self._last[gram] = len(self._tokens)
+        return None
+
+    def propose(self, k: int) -> List[int]:
+        """Draft up to ``k`` tokens continuing the current suffix from
+        its most recent earlier occurrence; [] when the suffix has
+        never occurred before (or the context is shorter than the
+        gram). Drafts are hints only — verification decides."""
+        if k <= 0 or len(self._tokens) < self.n:
+            return []
+        tail = tuple(self._tokens[-self.n:])
+        end = self._last.get(tail)
+        if end == len(self._tokens):     # newest occurrence is us
+            end = self._prev.get(tail)
+        if end is None:
+            return []
+        return list(self._tokens[end:end + k])

@@ -26,20 +26,44 @@ prefix KV cache (serve/prefix_cache.py) exists for. It implies
 (engine with the cache off, same load) so the artifact carries a
 cache-on vs cache-off engine-TTFT ratio measured in one session.
 
+--spec-len enables model-free speculative decoding in the engine
+(prompt-lookup drafts, serve/spec_decode.py) and adds a `spec` block
+(accept_rate, tokens_per_dispatch) to the engine result; with --ab it
+adds a THIRD run (engine with speculation off, same load) so the
+artifact carries a spec-on vs spec-off throughput ratio measured in
+one session. --prompt-period makes each prompt's tail cycle with that
+period — the repetitive-suffix load shape speculation exists for.
+
+Every artifact records the git sha it was produced from.
+
 Usage: python serve_bench.py [--model 7b|1b|tiny] [--ab] [--out FILE]
        [--requests N] [--threads N] [--gen-tokens N] [--prompt-len N]
        [--slots N] [--decode-chunk N] [--prefill-chunk N]
        [--page-size N] [--shared-prefix-len N]
        [--prefix-cache | --no-prefix-cache]
+       [--spec-len N] [--spec-ngram N] [--prompt-period N]
 (7b needs ~14GB HBM; falls back to 1b automatically on OOM.)
 """
 import argparse
 import json
 import statistics
+import subprocess
 import threading
 import time
 
 import numpy as np
+
+
+def git_sha():
+    """Short sha of the checkout the artifact was produced from, so
+    SERVE_BENCH_*.json files are attributable across rounds."""
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:   # noqa: BLE001 — no git / not a checkout
+        return "unknown"
 
 
 def build_configs(name):
@@ -107,6 +131,9 @@ def make_server(cfg, knobs, use_engine=True):
             def engine_prefix_stats(self):
                 return None
 
+            def engine_spec_stats(self):
+                return None
+
         return serve.run(LegacyServer.bind(), timeout_s=600)
 
     @serve.deployment(max_ongoing_requests=64)
@@ -119,7 +146,9 @@ def make_server(cfg, knobs, use_engine=True):
                 page_size=knobs["page_size"],
                 decode_chunk=knobs["decode_chunk"],
                 prefill_chunk=knobs["prefill_chunk"],
-                prefix_cache=knobs["prefix_cache"])
+                prefix_cache=knobs["prefix_cache"],
+                spec_len=knobs["spec_len"],
+                spec_ngram=knobs["spec_ngram"])
 
         def __call__(self, prompt):
             # joins the engine's decode batch at the next chunk
@@ -141,6 +170,9 @@ def make_server(cfg, knobs, use_engine=True):
         def engine_prefix_stats(self):
             return self.inner.engine().prefix_stats()
 
+        def engine_spec_stats(self):
+            return self.inner.engine().spec_stats()
+
     return serve.run(LlamaServer.bind(), timeout_s=600)
 
 
@@ -159,9 +191,21 @@ def bench(handle, rng, cfg, knobs):
               .randint(1, cfg.vocab_size - 1, size=shared).tolist()
               if shared > 0 else [])
 
+    period = knobs["prompt_period"]
+
     def prompt():
-        tail = rng.randint(1, cfg.vocab_size - 1,
-                           size=plen - len(prefix)).tolist()
+        n_tail = plen - len(prefix)
+        if period > 0:
+            # repetitive-suffix load shape (extraction / code-edit /
+            # multi-turn): each request's tail cycles its own random
+            # pattern, so prompt-lookup speculation has structure to
+            # find while requests stay distinct
+            pat = rng.randint(1, cfg.vocab_size - 1,
+                              size=min(period, n_tail))
+            tail = np.tile(pat, -(-n_tail // len(pat)))[:n_tail].tolist()
+        else:
+            tail = rng.randint(1, cfg.vocab_size - 1,
+                               size=n_tail).tolist()
         return prefix + tail
 
     # --- warmup / compile (one batched decode + one stream step) ----
@@ -294,6 +338,16 @@ def run_path(args, knobs, use_engine):
                     result["prefix_cache"] = ps
             except Exception:
                 pass
+        if knobs["spec_len"] > 0:
+            result["spec_len"] = knobs["spec_len"]
+            result["spec_ngram"] = knobs["spec_ngram"]
+            try:
+                ss = ray_tpu.get(handle.engine_spec_stats.remote(),
+                                 timeout=60)
+                if ss:
+                    result["spec"] = ss
+            except Exception:
+                pass
     else:
         result["batch"] = LEGACY_BATCH
     serve.shutdown()
@@ -335,6 +389,17 @@ def main():
                     default=None,
                     help="radix-tree prefix KV cache in the engine "
                          "(default: on iff --shared-prefix-len > 0)")
+    ap.add_argument("--spec-len", type=int, default=0,
+                    help="draft tokens per slot per round for "
+                         "prompt-lookup speculative decoding "
+                         "(0 = off; greedy-only, exact parity)")
+    ap.add_argument("--spec-ngram", type=int, default=3,
+                    help="suffix n-gram order for the prompt-lookup "
+                         "proposer")
+    ap.add_argument("--prompt-period", type=int, default=0,
+                    help="cycle each prompt's tail with this period "
+                         "(repetitive-suffix load shape speculation "
+                         "targets; 0 = fully random tails)")
     args = ap.parse_args()
     prefix_cache = (args.shared_prefix_len > 0
                     if args.prefix_cache is None else args.prefix_cache)
@@ -345,7 +410,9 @@ def main():
                  prefill_chunk=args.prefill_chunk,
                  page_size=args.page_size,
                  shared_prefix_len=args.shared_prefix_len,
-                 prefix_cache=prefix_cache)
+                 prefix_cache=prefix_cache,
+                 spec_len=args.spec_len, spec_ngram=args.spec_ngram,
+                 prompt_period=args.prompt_period)
 
     import os
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -384,11 +451,22 @@ def main():
             if on_ms and off_ms:
                 # < 1.0 means the cache lowered mean prefill latency
                 result["prefix_ttft_ratio"] = round(on_ms / off_ms, 3)
+        if knobs["spec_len"] > 0:
+            # third (or fourth) run: SAME engine path + load,
+            # speculation OFF — spec's own A/B, free of
+            # engine-vs-legacy effects
+            off = run_path(args, dict(knobs, spec_len=0),
+                           use_engine=True)
+            result["engine_spec_off"] = off
+            # > 1.0 means speculation raised same-load throughput
+            result["spec_throughput_ratio"] = _ratio(
+                eng["throughput_tok_s"], off["throughput_tok_s"])
         out = args.out or "SERVE_BENCH_ab.json"
     else:
         result = run_path(args, knobs, use_engine=not args.legacy)
         out = args.out or ("SERVE_BENCH_r05_legacy.json" if args.legacy
                            else "SERVE_BENCH_r05.json")
+    result["git_sha"] = git_sha()
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))

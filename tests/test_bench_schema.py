@@ -109,6 +109,61 @@ def test_prefix_cache_ab_requires_stats_and_ratio(tmp_path):
     assert any("engine_prefix_cache_off" in p for p in probs)
 
 
+_SP = {"proposed_tokens": 120, "accepted_tokens": 90,
+       "rejected_tokens": 30, "accept_rate": 0.75,
+       "tokens_per_dispatch": 1.8}
+
+
+def test_spec_block_validated_when_present(tmp_path):
+    res = {"throughput_tok_s": 1.0, "p50_ms": 2.0, "p99_ms": 3.0,
+           "ttft_ms": 4.0, "stream_tok_s": 5.0}
+    ok = dict(res, spec=dict(_SP))
+    assert _problems_for("SERVE_BENCH_x.json", ok, tmp_path) == []
+    for field in _SP:
+        bad = dict(res, spec={k: v for k, v in _SP.items()
+                              if k != field})
+        probs = _problems_for("SERVE_BENCH_x.json", bad, tmp_path)
+        assert any(field in p for p in probs), field
+    typed = dict(res, spec=dict(_SP, accept_rate="0.75"))
+    assert _problems_for("SERVE_BENCH_x.json", typed, tmp_path)
+    not_obj = dict(res, spec=[1, 2])
+    assert _problems_for("SERVE_BENCH_x.json", not_obj, tmp_path)
+
+
+def test_spec_ab_requires_stats_and_ratio(tmp_path):
+    res = {"throughput_tok_s": 1.0, "p50_ms": 2.0, "p99_ms": 3.0,
+           "ttft_ms": 4.0, "stream_tok_s": 5.0}
+    eng = dict(res, spec=dict(_SP))
+    ok = {"engine_continuous_batching": eng,
+          "legacy_decode_to_completion": dict(res),
+          "engine_spec_off": dict(res),
+          "throughput_ratio": 1.5, "spec_throughput_ratio": 1.3}
+    assert _problems_for("SERVE_BENCH_ab.json", ok, tmp_path) == []
+    # spec-off section present but engine carries no spec stats
+    no_stats = dict(ok, engine_continuous_batching=dict(res))
+    probs = _problems_for("SERVE_BENCH_ab.json", no_stats, tmp_path)
+    assert any("no spec stats" in p for p in probs)
+    # missing the dedicated ratio
+    no_ratio = {k: v for k, v in ok.items()
+                if k != "spec_throughput_ratio"}
+    probs = _problems_for("SERVE_BENCH_ab.json", no_ratio, tmp_path)
+    assert any("spec_throughput_ratio" in p for p in probs)
+    # the off section is itself a full serve result
+    bad_off = dict(ok, engine_spec_off={"ttft_ms": 1.0})
+    probs = _problems_for("SERVE_BENCH_ab.json", bad_off, tmp_path)
+    assert any("engine_spec_off" in p for p in probs)
+
+
+def test_git_sha_must_be_string_when_present(tmp_path):
+    res = {"throughput_tok_s": 1.0, "p50_ms": 2.0, "p99_ms": 3.0,
+           "ttft_ms": 4.0, "stream_tok_s": 5.0}
+    ok = dict(res, git_sha="abc1234")
+    assert _problems_for("SERVE_BENCH_x.json", ok, tmp_path) == []
+    bad = dict(res, git_sha=1234)
+    probs = _problems_for("SERVE_BENCH_x.json", bad, tmp_path)
+    assert any("git_sha" in p for p in probs)
+
+
 def test_bench_wrapper_and_flat_metric(tmp_path):
     wrapper = {"n": 3, "cmd": "python bench.py", "rc": 0,
                "tail": "...", "parsed": {"metric": "m", "value": 1.0}}

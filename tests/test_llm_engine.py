@@ -492,3 +492,45 @@ def test_planner_validates_budgets():
     with pytest.raises(ValueError):
         plan_step([], **dict(_PLAN, decode_chunk=0))
     assert plan_step([], **_PLAN).idle
+
+
+def test_planner_unbounded_run_ahead_clamps_to_ceiling():
+    """eos_bounded=False tail: with a full seeded batch the plan runs
+    ahead to the next completion, but never past max_run_ahead — the
+    device token buffer is [KMAX, S]-sized."""
+    views = [SlotView(sid=0, admit_seq=0, prompt_remaining=0,
+                      owed=500, seeded=True),
+             SlotView(sid=1, admit_seq=1, prompt_remaining=0,
+                      owed=400, seeded=True)]
+    plan = plan_step(views, **dict(_PLAN, total_slots=2))
+    assert plan.decode_steps == 128     # max_run_ahead, not min(owed)
+
+
+def test_planner_unbounded_tail_never_below_one():
+    """owed can reach 0 mid-flight in no-eos mode (deferred
+    retirement waits on a trailing readback); the lane must still
+    dispatch >= 1 step, never 0 or negative."""
+    views = [SlotView(sid=0, admit_seq=0, prompt_remaining=0,
+                      owed=0, seeded=True)]
+    plan = plan_step(views, **dict(_PLAN, total_slots=1))
+    assert plan.decode_steps >= 1
+    views = [SlotView(sid=0, admit_seq=0, prompt_remaining=0,
+                      owed=0, seeded=True),
+             SlotView(sid=1, admit_seq=1, prompt_remaining=0,
+                      owed=9, seeded=True)]
+    plan = plan_step(views, **dict(_PLAN, total_slots=2))
+    assert plan.decode_steps >= 1
+
+
+def test_planner_all_slots_mid_prefill_decode_lane_empty():
+    """A round where every slot is still prefilling: the decode lane
+    must be EMPTY (0 steps), not negative, and the round must not
+    read as idle — prefill work was granted."""
+    views = [SlotView(sid=i, admit_seq=i, prompt_remaining=r,
+                      owed=0, seeded=False)
+             for i, r in enumerate([10, 20, 30, 40])]
+    plan = plan_step(views, **_PLAN)
+    assert plan.decode_steps == 0
+    assert plan.spec == ()
+    assert plan.prefill
+    assert not plan.idle

@@ -54,6 +54,16 @@ PREFIX_CACHE_REQUIRED = {
     "cached_pages": NUM,
 }
 
+# serve results carry this block whenever speculative decoding was on
+# (serve/engine.py spec_stats())
+SPEC_REQUIRED = {
+    "proposed_tokens": NUM,
+    "accepted_tokens": NUM,
+    "rejected_tokens": NUM,
+    "accept_rate": NUM,
+    "tokens_per_dispatch": NUM,
+}
+
 BENCH_WRAPPER_REQUIRED = {
     "n": int,
     "cmd": str,
@@ -84,6 +94,13 @@ def _check_serve_result(obj, where, problems):
         else:
             _check_fields(pc, PREFIX_CACHE_REQUIRED,
                           f"{where}:prefix_cache", problems)
+    sp = obj.get("spec")
+    if sp is not None:
+        if not isinstance(sp, dict):
+            problems.append(f"{where}: spec must be an object")
+        else:
+            _check_fields(sp, SPEC_REQUIRED, f"{where}:spec",
+                          problems)
 
 
 def check_serve_bench(obj, name, problems):
@@ -133,8 +150,31 @@ def check_serve_bench(obj, name, problems):
                 problems.append(
                     f"{name}: prefix-cache A/B artifact missing "
                     "numeric prefix_ttft_ratio")
+        off = obj.get("engine_spec_off")
+        if off is not None:
+            # spec-decode A/B: the spec-off run is a full engine
+            # result, the spec-on engine section must actually carry
+            # spec stats plus a dedicated throughput ratio
+            if not isinstance(off, dict):
+                problems.append(f"{name}: engine_spec_off must be "
+                                "an object")
+            else:
+                _check_serve_result(
+                    off, f"{name}:engine_spec_off", problems)
+            if isinstance(eng, dict) and "spec" not in eng:
+                problems.append(
+                    f"{name}: has engine_spec_off but the engine "
+                    "section carries no spec stats")
+            if not isinstance(obj.get("spec_throughput_ratio"), NUM):
+                problems.append(
+                    f"{name}: spec A/B artifact missing numeric "
+                    "spec_throughput_ratio")
     else:
         _check_serve_result(obj, name, problems)
+    # attribution: optional on old artifacts, but never mistyped
+    sha = obj.get("git_sha")
+    if sha is not None and not isinstance(sha, str):
+        problems.append(f"{name}: git_sha must be a string")
 
 
 def check_bench(obj, name, problems):
