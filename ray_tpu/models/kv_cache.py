@@ -75,6 +75,20 @@ class BlockAllocator:
     def n_free(self) -> int:
         return len(self._free)
 
+    def occupancy(self) -> int:
+        """Pages currently handed out (the null page never counts).
+        At engine quiescence this must equal the prefix cache's
+        resident page count — every other page is a leak."""
+        return (self.n_pages - 1) - len(self._free)
+
+    def leak_report(self) -> List[int]:
+        """Page ids some owner still holds (not on the free list).
+        Diff this against the set of legitimately-held pages (e.g.
+        the prefix cache's nodes) to name leaked pages in test
+        failures instead of just counting them."""
+        return [p for p in range(1, self.n_pages)
+                if p not in self._free_set]
+
     def alloc(self, n: int) -> Optional[List[int]]:
         if n < 0:
             raise ValueError(f"cannot alloc {n} pages")

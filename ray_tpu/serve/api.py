@@ -14,6 +14,12 @@ import ray_tpu
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.controller import (CONTROLLER_NAME, Controller,
                                       get_or_create_controller)
+# Typed request-lifecycle errors (serve/errors.py): part of the serve
+# API surface — clients branch on them, the proxy maps them to HTTP
+# statuses (429/504/503/499), and they import without jax.
+from ray_tpu.serve.errors import (DeadlineExceeded,  # noqa: F401
+                                  EngineOverloaded, EngineShutdown,
+                                  RequestCancelled, RequestError)
 from ray_tpu.serve.router import (DeploymentHandle, clear_handle_cache,
                                   get_or_create_handle)
 

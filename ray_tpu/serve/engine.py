@@ -65,10 +65,54 @@ import numpy as np
 from ray_tpu.models.kv_cache import (BlockAllocator, PagedKVLayer,
                                      init_kv_pool)
 from ray_tpu.serve import spec_decode
+# Typed lifecycle errors live in a jax-free module (serve/errors.py)
+# so the HTTP proxy and clients can import them without the device
+# stack; RequestError is re-exported here for existing call sites.
+from ray_tpu.serve.errors import (DeadlineExceeded, EngineOverloaded,
+                                  EngineShutdown, RequestCancelled,
+                                  RequestError)
+from ray_tpu.serve.faults import EngineFault
 from ray_tpu.serve.prefix_cache import PrefixCache
 from ray_tpu.serve.scheduler import StepPlan, SlotView, plan_step
 
 _DONE = object()
+
+SHED_TOTAL = "serve_engine_shed_total"
+CANCELLED_TOTAL = "serve_engine_cancelled_total"
+DEADLINE_TOTAL = "serve_engine_deadline_exceeded_total"
+CONTAINED_TOTAL = "serve_engine_contained_faults_total"
+RETRIES_TOTAL = "serve_engine_retries_total"
+
+_METRICS: Optional[dict] = None
+
+
+def _metrics() -> dict:
+    """Lazy module-level lifecycle metric singletons, re-created if a
+    test's ``clear_registry()`` dropped them (same pattern as
+    serve/prefix_cache.py)."""
+    global _METRICS
+    from ray_tpu.util import metrics
+    if (_METRICS is None
+            or metrics.registry().get(SHED_TOTAL)
+            is not _METRICS["shed"]):
+        _METRICS = {
+            "shed": metrics.Counter(
+                SHED_TOTAL, "Requests rejected at submit because the "
+                "admission queue was at max_queued"),
+            "cancelled": metrics.Counter(
+                CANCELLED_TOTAL,
+                "Requests aborted by the client (cancel/disconnect)"),
+            "deadline_exceeded": metrics.Counter(
+                DEADLINE_TOTAL,
+                "Requests expired by their per-request deadline"),
+            "contained_faults": metrics.Counter(
+                CONTAINED_TOTAL, "Dispatch/readback faults contained "
+                "to one request instead of failing the engine"),
+            "retries": metrics.Counter(
+                RETRIES_TOTAL, "Innocent requests requeued after a "
+                "contained fault (bounded retry policy)"),
+        }
+    return _METRICS
 
 
 def _dev_ready(buf) -> bool:
@@ -78,10 +122,6 @@ def _dev_ready(buf) -> bool:
         return bool(buf.is_ready())
     except Exception:
         return False
-
-
-class RequestError(Exception):
-    pass
 
 
 @dataclasses.dataclass
@@ -97,6 +137,10 @@ class _Request:
     closed: bool = False         # _DONE delivered; drop late tokens
     t_submit: float = 0.0        # monotonic clock at submit()
     t_first: Optional[float] = None   # first token EMITTED to stream
+    deadline: Optional[float] = None  # absolute monotonic deadline
+    attempts: int = 0            # requeues after contained faults
+    t_earliest: float = 0.0      # retry backoff: no re-admission
+                                 # before this monotonic instant
 
     @property
     def remaining(self) -> int:
@@ -112,8 +156,30 @@ class _Request:
 class RequestHandle:
     """Client-side view of a submitted request."""
 
-    def __init__(self, req: _Request):
+    def __init__(self, req: _Request,
+                 engine: Optional["LLMEngine"] = None):
         self._req = req
+        self._engine = engine
+
+    def cancel(self) -> bool:
+        """Abort the request at whatever phase it is in — queued,
+        mid-prefill, decoding, or mid-speculation. Its slot frees,
+        its pages return to the allocator (shared prefix pages only
+        drop their reference), and any ``stream()``/``result()``
+        consumer unblocks with ``RequestCancelled``. Returns False
+        when the request had already finished (tokens delivered or
+        failed) — cancellation after completion is a no-op."""
+        if self._engine is None:
+            return False
+        return self._engine._cancel(self._req)
+
+    @property
+    def done(self) -> bool:
+        return self._req.closed
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._req.error
 
     def stream(self):
         """Yield generated token ids as they are produced."""
@@ -214,6 +280,21 @@ class LLMEngine:
     spec_proposer: test seam — a zero-arg factory returning an
         object with the NGramIndex protocol (sync/propose), built
         once per admitted slot.
+    max_queued: bounded admission — with more than this many
+        requests already waiting, ``submit`` fails fast with
+        ``EngineOverloaded`` (shed counter + 429 at the proxy)
+        instead of queueing into silent TTFT collapse. None
+        (default) keeps the queue unbounded.
+    max_retries: bounded retry policy for fault containment — an
+        innocent request swept up in another request's dispatch
+        fault is requeued (recompute, like preemption) at most this
+        many times before it fails too.
+    retry_backoff_s: base of the exponential re-admission backoff
+        after a contained fault (``backoff * 2**(attempt-1)``).
+    shed_retry_after_s: the Retry-After hint carried by
+        ``EngineOverloaded`` (surfaced as the HTTP header).
+    fault_injector: test-only seam (serve/faults.py FaultInjector);
+        None in production — every site is then a no-op.
     """
 
     def __init__(self, model, params, *, max_slots: int = 8,
@@ -224,7 +305,12 @@ class LLMEngine:
                  max_prefill_compiles: int = 16,
                  prefix_cache: bool = False,
                  spec_len: int = 0, spec_ngram: int = 3,
-                 spec_proposer=None):
+                 spec_proposer=None,
+                 max_queued: Optional[int] = None,
+                 max_retries: int = 2,
+                 retry_backoff_s: float = 0.02,
+                 shed_retry_after_s: float = 1.0,
+                 fault_injector=None):
         self.model = model
         self.cfg = model.config
         self.params = params
@@ -294,6 +380,16 @@ class LLMEngine:
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
         self.stats: Dict[str, int] = collections.Counter()
+        # Request-lifecycle knobs: bounded admission + bounded retry
+        if max_queued is not None and max_queued < 0:
+            raise ValueError("max_queued must be >= 0 or None")
+        self.max_queued = max_queued
+        self.max_retries = max(0, int(max_retries))
+        self.retry_backoff_s = max(0.0, float(retry_backoff_s))
+        self.shed_retry_after_s = float(shed_retry_after_s)
+        self._injector = fault_injector
+        self._round = 0              # scheduling-round counter (the
+                                     # fault seam's deterministic clock)
         # Chunked prefill compiles one executable per pow2 chunk
         # bucket (floor page_size, cap prefill_chunk) — a handful of
         # variants total, vs the old one-per-prompt-length cache
@@ -318,12 +414,23 @@ class LLMEngine:
     # ---------------------------------------------------------- public
 
     def submit(self, prompt_ids: List[int],
-               max_new_tokens: int = 64) -> RequestHandle:
+               max_new_tokens: int = 64,
+               deadline_s: Optional[float] = None) -> RequestHandle:
+        """Queue one request. ``deadline_s`` (relative, seconds) sets
+        a hard completion deadline: the request fails with
+        ``DeadlineExceeded`` at whatever phase it is in — queued,
+        mid-prefill, decoding, mid-speculation — the first scheduling
+        round after the deadline passes, and its resources free
+        immediately. With ``max_queued`` configured, a full admission
+        queue sheds the request with ``EngineOverloaded`` instead of
+        accepting unbounded latency."""
         prompt_ids = [int(t) for t in prompt_ids]
         if not prompt_ids:
             raise RequestError("empty prompt")
         if max_new_tokens < 1:
             raise RequestError("max_new_tokens must be >= 1")
+        if deadline_s is not None and deadline_s <= 0:
+            raise RequestError("deadline_s must be > 0")
         total = len(prompt_ids) + max_new_tokens
         need = -(-total // self.Pg)
         if need > self.alloc.n_pages - 1:
@@ -336,13 +443,23 @@ class LLMEngine:
                 f"max_seq_len {self.cfg.max_seq_len}")
         req = _Request(next(self._rid), prompt_ids, max_new_tokens,
                        t_submit=time.monotonic())
+        if deadline_s is not None:
+            req.deadline = req.t_submit + deadline_s
         with self._work:
             if self._stopped:
-                raise RequestError("engine stopped")
+                raise EngineShutdown("engine stopped")
+            if (self.max_queued is not None
+                    and len(self._wait) >= self.max_queued):
+                self.stats["shed"] += 1
+                _metrics()["shed"].inc()
+                raise EngineOverloaded(
+                    f"admission queue full ({len(self._wait)} waiting"
+                    f" >= max_queued={self.max_queued}); request shed",
+                    retry_after_s=self.shed_retry_after_s)
             self._wait.append(req)
             self.stats["submitted"] += 1
             self._work.notify()
-        return RequestHandle(req)
+        return RequestHandle(req, self)
 
     def start(self) -> "LLMEngine":
         """Run the scheduler loop in a daemon thread."""
@@ -353,15 +470,150 @@ class LLMEngine:
         return self
 
     def shutdown(self):
+        """Stop the engine and FAIL everything still queued or in
+        flight with a typed ``EngineShutdown`` — no ``stream()``/
+        ``result()`` consumer may be left blocked. Tokens already
+        computed (trailing readbacks of retired slots) are delivered
+        first, so a request that effectively finished still resolves
+        cleanly. Idempotent."""
+        err = EngineShutdown("engine stopped")
         with self._work:
             self._stopped = True
-            for req in self._wait:
-                req.error = RequestError("engine stopped")
-                req.out_q.put(_DONE)
-            self._wait.clear()
             self._work.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        with self._work:
+            # deliver what the device already produced before the axe
+            try:
+                self._drain_fetches_locked()
+            except Exception:
+                pass     # device gone: typed failure below still lands
+            for i, slot in enumerate(self.slots):
+                if slot is not None:
+                    self._teardown_slot_locked(i, err)
+            for _buf, riders, _steps in self._fetchq:
+                for _i, slot, _t in riders:
+                    self._fail_req_locked(slot.req, err)
+            for _f, placements in self._pending_prefill:
+                for _ix, slot, _row in placements:
+                    self._fail_req_locked(slot.req, err)
+            self._fetchq.clear()
+            self._pending_prefill.clear()
+            while self._wait:
+                self._fail_req_locked(self._wait.popleft(), err)
+
+    def _cancel(self, req: _Request,
+                error: Optional[BaseException] = None) -> bool:
+        """Abort ``req`` at any phase (RequestHandle.cancel). Queued:
+        removed and failed on the spot. Slotted (mid-prefill,
+        decoding, mid-speculation): torn down synchronously — the
+        lock serializes against the scheduler, and freeing pages
+        under an in-flight dispatch is safe because device execution
+        is stream-ordered (the same argument _retire_planned_locked
+        rests on); trailing readbacks skip the closed request.
+        Already-retired requests with tokens still in flight just
+        close. Returns False iff the request had already finished."""
+        err = error or RequestCancelled(
+            f"request {req.rid} cancelled by client")
+        with self._work:
+            if req.closed:
+                return False
+            try:
+                self._wait.remove(req)
+                self._fail_req_locked(req, err, "cancelled")
+                return True
+            except ValueError:
+                pass
+            for i, slot in enumerate(self.slots):
+                if slot is not None and slot.req is req:
+                    self._teardown_slot_locked(i, err, "cancelled")
+                    self._work.notify()
+                    return True
+            self._fail_req_locked(req, err, "cancelled")
+            return True
+
+    def _fail_req_locked(self, req: _Request, err: BaseException,
+                         count: Optional[str] = None) -> None:
+        """Resolve a request's consumers with a typed error, exactly
+        once. ``count`` names the stats/metrics counter to bump."""
+        if req.closed:
+            return
+        req.closed = True
+        req.error = err
+        req.out_q.put(_DONE)
+        if count:
+            self.stats[count] += 1
+            m = _metrics().get(count)
+            if m is not None:
+                m.inc()
+
+    def _teardown_slot_locked(self, ix: int, err: BaseException,
+                              count: Optional[str] = None) -> None:
+        """Fail a slotted request and free every resource it holds:
+        the slot, its private pages (back to the allocator), and its
+        shared prefix-page references (the tree keeps the KV).
+        ``preempted`` is set so in-flight readback rows for this slot
+        are discarded rather than emitted."""
+        slot = self.slots[ix]
+        self.slots[ix] = None
+        slot.preempted = True
+        self._free_slot_pages_locked(slot, retire=False)
+        self._fail_req_locked(slot.req, err, count)
+
+    def _reap_deadlines_locked(self) -> None:
+        """Expire requests whose deadline passed — queued or slotted
+        alike — with ``DeadlineExceeded``. Runs at the top of every
+        scheduling round, so enforcement granularity is one round."""
+        now = time.monotonic()
+        for req in [r for r in self._wait if r.deadline is not None
+                    and now >= r.deadline]:
+            self._wait.remove(req)
+            self._fail_req_locked(req, DeadlineExceeded(
+                f"request {req.rid} missed its deadline while "
+                f"queued"), "deadline_exceeded")
+        for i, slot in enumerate(self.slots):
+            if slot is None or slot.req.closed:
+                continue
+            if (slot.req.deadline is not None
+                    and now >= slot.req.deadline):
+                self._teardown_slot_locked(i, DeadlineExceeded(
+                    f"request {slot.req.rid} missed its deadline "
+                    f"after {len(slot.req.generated)} tokens"),
+                    "deadline_exceeded")
+
+    def _fire(self, site: str, sid: Optional[int] = None,
+              rid: Optional[int] = None) -> None:
+        """Fault-injection site (no-op without an injector)."""
+        if self._injector is not None:
+            self._injector.fire(site, self._round, sid, rid)
+
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """BlockAllocator.alloc behind the fault seam: an injected
+        exhaustion makes the pool look dry for this one call,
+        steering the caller into its real evict/preempt/wait
+        recovery path."""
+        if (self._injector is not None
+                and self._injector.exhausted(self._round)):
+            return None
+        return self.alloc.alloc(n)
+
+    def lifecycle_stats(self) -> Dict[str, Any]:
+        """Request-lifecycle knobs + counters (bench artifacts and
+        the replica stats hook read this)."""
+        with self._lock:
+            s = self.stats
+            return {
+                "max_queued": self.max_queued,
+                "max_retries": self.max_retries,
+                "retry_backoff_s": self.retry_backoff_s,
+                "shed": s["shed"],
+                "cancelled": s["cancelled"],
+                "deadline_exceeded": s["deadline_exceeded"],
+                "contained_faults": s["contained_faults"],
+                "retries": s["retries"],
+                "retry_exhausted": s["retry_exhausted"],
+                "fault_failed": s["fault_failed"],
+            }
 
     def step(self) -> bool:
         """One scheduler iteration, DEVICE-PACED:
@@ -383,8 +635,20 @@ class LLMEngine:
         trip nor a slow host thread gates the token rate. With an
         eos, sampled tokens decide completion, so the iteration
         drains readbacks before planning (latency profile of the
-        classic chunked loop). Returns False when idle."""
+        classic chunked loop). Returns False when idle.
+
+        Failure containment: an ``EngineFault`` out of a dispatch
+        section (fault-injection sites, or the now-attributable
+        pool-exhausted-by-one-slot path) is handled HERE — the
+        culprit request fails, the other participants of that
+        dispatch requeue-or-fail under the bounded retry policy —
+        and the engine keeps serving. Only non-attributable errors
+        still escape to ``_fail_all`` via ``_loop``."""
         with self._lock:
+            self._round += 1
+            self._fire("step")     # global-fault site: escapes to
+                                   # _fail_all, like real device loss
+            self._reap_deadlines_locked()
             if not self._deferred or self.spec_len:
                 # eos mode: emissions gate planning. Spec mode: the
                 # proposer's context and the verify's input token are
@@ -405,22 +669,90 @@ class LLMEngine:
                 if self._fetchq or self._pending_prefill:
                     self._drain_fetches_locked(limit=1)
                     return True
-                return False
+                # non-empty queue with nothing admitted = retry
+                # backoff or a transiently dry pool: still working
+                return bool(self._wait)
             plan = self._plan_steps_locked()
-            if plan.prefill:
-                self._dispatch_prefill_locked(plan.prefill)
-            if plan.spec:
-                self._dispatch_spec_locked(plan.spec)
-            elif plan.decode_steps:
-                self._grow_or_preempt_locked(plan.decode_steps)
-                self._dispatch_chunk_locked(plan.decode_steps)
-                if self._deferred:
-                    self._retire_planned_locked()
+            try:
+                if plan.prefill:
+                    self._dispatch_prefill_locked(plan.prefill)
+            except EngineFault as e:
+                e.sids = sorted({g.sid for g in plan.prefill}
+                                | set(e.sids))
+                self._contain_fault_locked(e)
+                return True
+            try:
+                if plan.spec:
+                    self._dispatch_spec_locked(plan.spec)
+                elif plan.decode_steps:
+                    riders = [i for i, s in enumerate(self.slots)
+                              if s is not None and s.cur is not None]
+                    self._grow_or_preempt_locked(plan.decode_steps)
+                    self._dispatch_chunk_locked(plan.decode_steps)
+                    if self._deferred:
+                        self._retire_planned_locked()
+            except EngineFault as e:
+                part = ({g.sid for g in plan.spec} if plan.spec
+                        else set(riders))
+                e.sids = sorted(part | set(e.sids))
+                self._contain_fault_locked(e)
+                return True
             # trailing readback: block only on a dispatch OLDER than
             # the one just queued (keep=1), so the fetch round trip
             # overlaps the newest dispatch's compute — never its own
             self._drain_fetches_locked(limit=1, keep=1)
             return True
+
+    def _contain_fault_locked(self, e: EngineFault) -> None:
+        """Per-slot failure containment: fail ONLY the culprit (the
+        request the fault is attributable to) with the underlying
+        error; every other slot that was participating in the
+        poisoned dispatch is requeued tail-of-queue (recompute, like
+        preemption) under the bounded retry policy — ``max_retries``
+        attempts with exponential backoff — instead of dying with
+        it. A fault with no culprit (whole-dispatch transient)
+        requeues every participant. Replaces the old blanket
+        ``_fail_all`` for everything short of genuine global errors
+        (device loss), which still take that path."""
+        self.stats["contained_faults"] += 1
+        _metrics()["contained_faults"].inc()
+        # settle trailing readbacks first: a requeued request
+        # recomputes from prompt + generated, which must be complete
+        self._drain_fetches_locked()
+        for sid in sorted(set(e.sids)):
+            slot = self.slots[sid] if 0 <= sid < self.S else None
+            if slot is None:
+                continue       # drain closed it, or already gone
+            if sid == e.culprit_sid:
+                self._teardown_slot_locked(sid, e.original,
+                                           "fault_failed")
+            else:
+                self._requeue_after_fault_locked(sid, e)
+
+    def _requeue_after_fault_locked(self, sid: int,
+                                    e: EngineFault) -> None:
+        """Requeue an innocent participant of a faulted dispatch,
+        bounded: past ``max_retries`` attempts the request fails too
+        (a poisoned batch must not retry forever). Tail of the queue
+        — a faulting batch must not starve fresh arrivals — with
+        exponential backoff gating re-admission."""
+        slot = self.slots[sid]
+        req = slot.req
+        req.attempts += 1
+        if req.attempts > self.max_retries:
+            self._teardown_slot_locked(sid, RequestError(
+                f"request {req.rid} failed after "
+                f"{req.attempts - 1} retries (last fault: "
+                f"{e.original!r})"), "retry_exhausted")
+            return
+        self.slots[sid] = None
+        slot.preempted = True     # in-flight rows are recomputed
+        self._free_slot_pages_locked(slot, retire=False)
+        req.t_earliest = (time.monotonic() + self.retry_backoff_s
+                          * (2 ** (req.attempts - 1)))
+        self._wait.append(req)
+        self.stats["retries"] += 1
+        _metrics()["retries"].inc()
 
     def _plan_steps_locked(self) -> StepPlan:
         """Plan this round with the pure, device-free planner
@@ -433,10 +765,14 @@ class LLMEngine:
         prompt-lookup proposal per seeded slot)."""
         if self.spec_len:
             self._propose_spec_locked()
+        # owed clamped at 0: an eos-mode rider can overshoot its
+        # budget while emission trails, and cancelled/expired slots
+        # are torn down before planning ever sees them — the planner
+        # contract (serve/scheduler.py) is owed >= 0
         views = [SlotView(sid=i, admit_seq=s.admit_seq,
                           prompt_remaining=s.prefill_remaining,
-                          owed=self._owed(s) if s.cur is not None
-                          else 0,
+                          owed=max(0, self._owed(s))
+                          if s.cur is not None else 0,
                           seeded=s.cur is not None,
                           spec_drafts=len(s.spec_pending))
                  for i, s in enumerate(self.slots) if s is not None]
@@ -499,25 +835,38 @@ class LLMEngine:
                        and not self._fetchq
                        and not self._pending_prefill):
                     self._work.wait()
-                if self._stopped and not any(self.slots):
+                if self._stopped:
                     # deliver every token already computed before
-                    # exiting — retired slots' readbacks still trail
+                    # exiting — retired slots' readbacks still trail;
+                    # shutdown() then fails whatever remains in
+                    # flight with EngineShutdown
                     self._drain_fetches_locked()
                     return
             try:
                 self.step()
-            except BaseException as e:   # fail every in-flight request
+            except EngineFault as e:
+                # attributable fault outside a dispatch section
+                # (defensive — step() normally contains these)
+                with self._lock:
+                    self._contain_fault_locked(e)
+            except BaseException as e:   # global: fail every request
                 self._fail_all(e)
                 return
 
     def _fail_all(self, e: BaseException):
+        """Global failure (device loss, scheduler bug): every queued
+        and in-flight request fails with the error. Attributable
+        faults never reach here — they are contained per-slot in
+        step() — so this is the path of last resort."""
         with self._lock:
+            self.stats["failed_all"] += 1
             failed = set()
 
             def fail(req):
                 if req.closed or id(req) in failed:
                     return
                 failed.add(id(req))
+                req.closed = True
                 req.error = e
                 req.out_q.put(_DONE)
 
@@ -525,6 +874,8 @@ class LLMEngine:
                 if slot is not None:
                     fail(slot.req)
                     self.slots[i] = None
+                    slot.preempted = True
+                    self._free_slot_pages_locked(slot, retire=False)
             # retired-at-dispatch requests whose tokens were still in
             # flight live only in the readback queues
             for _buf, riders, _steps in self._fetchq:
@@ -566,6 +917,16 @@ class LLMEngine:
             if not free:
                 return
             req = self._wait[0]
+            if req.closed:
+                # cancelled/expired while queued by a path that left
+                # it in place — drop, never admit
+                self._wait.popleft()
+                continue
+            if req.t_earliest and time.monotonic() < req.t_earliest:
+                # retry backoff after a contained fault. FIFO is the
+                # admission contract, so a backing-off head delays
+                # everything behind it too.
+                return
             prompt = req.recompute_prompt
             shared_pages: List[int] = []
             matched = 0
@@ -580,12 +941,12 @@ class LLMEngine:
             start = matched
             first = max(1, min(len(prompt) - start, self.PC))
             need = -(-(start + first) // self.Pg) - len(shared_pages)
-            page_ids = self.alloc.alloc(need)
+            page_ids = self._alloc(need)
             if page_ids is None and self.prefix_cache is not None:
                 # reclaim LRU refcount-0 cached pages before failing
                 if self.prefix_cache.evict(
                         need - self.alloc.n_free) > 0:
-                    page_ids = self.alloc.alloc(need)
+                    page_ids = self._alloc(need)
             if page_ids is None:
                 # pool dry: hand the matched references back and wait
                 if self.prefix_cache is not None:
@@ -634,6 +995,8 @@ class LLMEngine:
             take = min(g.tokens, slot.prefill_remaining)
             if take <= 0:
                 continue
+            self._fire("dispatch_prefill", sid=g.sid,
+                       rid=slot.req.rid)
             self._check_cow_locked(slot, slot.prefilled)
             need = -(-(slot.prefilled + take) // self.Pg)
             evicted = False
@@ -641,7 +1004,7 @@ class LLMEngine:
                 if self.slots[g.sid] is not slot:
                     evicted = True
                     break
-                got = self.alloc.alloc(need - len(slot.pages))
+                got = self._alloc(need - len(slot.pages))
                 if got is not None:
                     slot.pages.extend(got)
                     break
@@ -656,10 +1019,12 @@ class LLMEngine:
                     key=lambda j: self.slots[j].admit_seq,
                     default=None)
                 if victim is None:
-                    # alone and still can't grow: submit() guarantees
-                    # a lone request fits, so this is a logic error
-                    raise RuntimeError(
-                        "page pool exhausted by one slot")
+                    # alone and still can't grow — attributable to
+                    # THIS request: contained, not _fail_all
+                    raise EngineFault(RequestError(
+                        f"request {slot.req.rid}: page pool "
+                        f"exhausted by one slot"),
+                        culprit_sid=g.sid, culprit_rid=slot.req.rid)
                 self._preempt_locked(victim)
             if not evicted and self.slots[g.sid] is slot:
                 rows.append((g.sid, slot, take))
@@ -690,7 +1055,7 @@ class LLMEngine:
                     # budget in a trailing readback); growing the
                     # detached object would leak its new pages
                     break
-                got = self.alloc.alloc(need - len(slot.pages))
+                got = self._alloc(need - len(slot.pages))
                 if got is not None:
                     slot.pages.extend(got)
                     break
@@ -705,9 +1070,12 @@ class LLMEngine:
                     key=lambda j: self.slots[j].admit_seq,
                     default=None)
                 if victim is None:
-                    # alone and still can't grow: submit() guarantees a
-                    # lone request fits, so this is a logic error
-                    raise RuntimeError("page pool exhausted by one slot")
+                    # alone and still can't grow — attributable to
+                    # THIS request: contained, not _fail_all
+                    raise EngineFault(RequestError(
+                        f"request {slot.req.rid}: page pool "
+                        f"exhausted by one slot"),
+                        culprit_sid=i, culprit_rid=slot.req.rid)
                 self._preempt_locked(victim)
 
     def _check_cow_locked(self, slot: _Slot, write_pos: int) -> None:
@@ -788,6 +1156,7 @@ class LLMEngine:
         for i, slot in enumerate(self.slots):
             if slot is None or slot.cur is None:
                 continue
+            self._fire("dispatch_decode", sid=i, rid=slot.req.rid)
             self._check_cow_locked(slot, slot.pos)
             pt[i, :len(slot.pages)] = slot.pages
             # tokens this slot still owes its client from THIS
@@ -855,6 +1224,7 @@ class LLMEngine:
                     or not slot.req.generated):
                 continue       # evicted / reseated since planning
             drafts = slot.spec_pending[:max(0, g.drafts)]
+            self._fire("dispatch_spec", sid=g.sid, rid=slot.req.rid)
             self._check_cow_locked(slot, slot.pos)
             # grow pages to cover every verify write (cur + drafts),
             # exactly like prefill growth: prefix-cache eviction
@@ -865,7 +1235,7 @@ class LLMEngine:
                 if self.slots[g.sid] is not slot:
                     evicted = True
                     break
-                got = self.alloc.alloc(need - len(slot.pages))
+                got = self._alloc(need - len(slot.pages))
                 if got is not None:
                     slot.pages.extend(got)
                     break
@@ -881,9 +1251,12 @@ class LLMEngine:
                     default=None)
                 if victim is None:
                     # submit() sized the pool for prompt+completion,
-                    # and pos + drafts + 1 never exceeds that
-                    raise RuntimeError(
-                        "page pool exhausted by one slot")
+                    # and pos + drafts + 1 never exceeds that —
+                    # attributable to THIS request, so contained
+                    raise EngineFault(RequestError(
+                        f"request {slot.req.rid}: page pool "
+                        f"exhausted by one slot"),
+                        culprit_sid=g.sid, culprit_rid=slot.req.rid)
                 self._preempt_locked(victim)
             if not evicted and self.slots[g.sid] is slot:
                 rows.append((g.sid, slot, drafts))
@@ -1029,12 +1402,37 @@ class LLMEngine:
                 for ix, slot, row in placements:
                     if slot.preempted:
                         continue
+                    try:
+                        self._fire("readback", sid=ix,
+                                   rid=slot.req.rid)
+                    except EngineFault as e:
+                        self._fail_rider_locked(ix, slot, e.original)
+                        continue
                     self._emit_to(slot.req, [int(firsts[row])], ix)
             for (_buf, riders, _steps), toks in zip(batch, vals):
                 for i, slot, take in riders:
                     if slot.preempted:
                         continue    # recomputed from scratch
+                    try:
+                        self._fire("readback", sid=i,
+                                   rid=slot.req.rid)
+                    except EngineFault as e:
+                        self._fail_rider_locked(i, slot, e.original)
+                        continue
                     self._emit_to(slot.req, toks[:take, i].tolist(), i)
+
+    def _fail_rider_locked(self, ix: int, slot: _Slot,
+                           err: BaseException) -> None:
+        """A fault while emitting ONE rider's tokens (readback/
+        emission path) fails only that request: its slot — if still
+        live; no-eos mode retires slots at dispatch time — is torn
+        down, every other rider's emission proceeds untouched."""
+        self.stats["contained_faults"] += 1
+        _metrics()["contained_faults"].inc()
+        if self.slots[ix] is slot and not slot.preempted:
+            self._teardown_slot_locked(ix, err, "fault_failed")
+        else:
+            self._fail_req_locked(slot.req, err, "fault_failed")
 
     def _emit_to(self, req: _Request, tokens: List[int], ix: int):
         """Deliver tokens to the request; close it when it hits eos

@@ -1,0 +1,203 @@
+"""Deterministic fault injection + quiescence invariants for the
+LLM engine.
+
+The engine's failure-containment contract (serve/engine.py) is only
+worth anything if it can be PROVEN: after any mix of cancels,
+deadlines, and injected faults, only the targeted requests fail,
+survivors stay token-identical to greedy decode, and every resource
+(allocator pages, prefix-cache refcounts, slots) returns to
+baseline. This module is the harness for that proof — the serving
+analogue of the cluster layer's fault tooling
+(tests/test_fault_tooling.py).
+
+Two pieces:
+
+- ``FaultInjector`` — a test-only seam the engine consults at named
+  sites. Plans are matched on (site, round, sid) and fire a bounded
+  number of times, so a test can say "raise a readback error for
+  slot 1 on round 3" and get exactly that, deterministically (the
+  LRU ticks, round counter, and FIFO admission make engine rounds
+  reproducible on CPU).
+- ``EngineFault`` — the attribution envelope the engine's dispatch
+  paths raise/convert to. ``culprit_sid``/``culprit_rid`` name the
+  one request the fault belongs to; ``sids`` lists every slot that
+  was participating in the failed dispatch so containment can
+  requeue the innocent rest under the retry policy.
+- ``check_quiesced`` — the invariant checker: asserts a drained
+  engine is back at baseline (allocator occupancy == prefix-cache
+  residency, zero refcounts, no orphaned slots, empty queues).
+
+Sites the engine consults (all no-ops without an injector):
+
+========================  ==================================================
+site                      fires
+========================  ==================================================
+``alloc``                 before every ``BlockAllocator.alloc`` — a
+                          matching ``exhaust`` plan makes it return None
+                          (pool-dry behavior: evict/preempt/wait paths)
+``dispatch_prefill``      per prefill row, before the batched call
+``dispatch_decode``       per decode rider, before the batched call
+``dispatch_spec``         per spec row, before the batched verify
+``readback``              per rider, as its tokens are emitted host-side
+``step``                  top of every scheduling round (global faults)
+========================  ==================================================
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, List, Optional
+
+
+class EngineFault(Exception):
+    """A fault attributable to (at most) one request.
+
+    ``culprit_sid``/``culprit_rid``: the slot/request the fault
+    belongs to (None = nobody in particular — e.g. a whole-dispatch
+    transient). ``sids``: every slot participating in the failed
+    dispatch; containment fails the culprit and requeues the rest
+    under the bounded retry policy. ``original`` is the underlying
+    error delivered to the failed request's consumer.
+    """
+
+    def __init__(self, original: BaseException,
+                 culprit_sid: Optional[int] = None,
+                 culprit_rid: Optional[int] = None,
+                 sids: Optional[List[int]] = None):
+        super().__init__(str(original))
+        self.original = original
+        self.culprit_sid = culprit_sid
+        self.culprit_rid = culprit_rid
+        self.sids = list(sids) if sids is not None else (
+            [culprit_sid] if culprit_sid is not None else [])
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One planned fault. ``round`` is the engine's scheduling-round
+    counter at which to start firing (None = any round); ``sid``
+    restricts per-row sites to one slot (None = any row); ``times``
+    bounds how often it fires (so recovery is observable)."""
+    site: str
+    kind: str = "raise"            # "raise" | "exhaust" | "sleep"
+    exc: Optional[BaseException] = None
+    round: Optional[int] = None
+    sid: Optional[int] = None
+    times: int = 1
+    sleep_s: float = 0.0
+    fired: int = 0
+
+    def matches(self, site: str, rnd: int, sid: Optional[int]) -> bool:
+        if self.fired >= self.times or site != self.site:
+            return False
+        if self.round is not None and rnd < self.round:
+            return False
+        if self.sid is not None and sid != self.sid:
+            return False
+        return True
+
+
+class FaultInjector:
+    """Deterministic fault seam. Construct, plan faults, hand to
+    ``LLMEngine(fault_injector=...)``; inspect ``log`` afterwards."""
+
+    def __init__(self):
+        self.plans: List[FaultPlan] = []
+        self.log: List[tuple] = []     # (site, round, sid, kind)
+
+    # ------------------------------------------------------- planning
+
+    def inject(self, site: str, *, exc: Optional[BaseException] = None,
+               round: Optional[int] = None, sid: Optional[int] = None,
+               times: int = 1) -> FaultPlan:
+        """Raise ``exc`` (default RuntimeError) when ``site`` fires."""
+        plan = FaultPlan(site=site, kind="raise",
+                         exc=exc or RuntimeError(
+                             f"injected fault at {site}"),
+                         round=round, sid=sid, times=times)
+        self.plans.append(plan)
+        return plan
+
+    def exhaust_alloc(self, *, round: Optional[int] = None,
+                      times: int = 1) -> FaultPlan:
+        """Make the next ``times`` allocator calls report a dry pool
+        (returns None), exercising evict/preempt/wait recovery."""
+        plan = FaultPlan(site="alloc", kind="exhaust", round=round,
+                         times=times)
+        self.plans.append(plan)
+        return plan
+
+    def slow(self, site: str, sleep_s: float, *,
+             round: Optional[int] = None, sid: Optional[int] = None,
+             times: int = 1) -> FaultPlan:
+        """Delay at ``site`` (deadline/timeout tests)."""
+        plan = FaultPlan(site=site, kind="sleep", sleep_s=sleep_s,
+                         round=round, sid=sid, times=times)
+        self.plans.append(plan)
+        return plan
+
+    # ------------------------------------------------- engine-facing
+
+    def fire(self, site: str, rnd: int, sid: Optional[int] = None,
+             rid: Optional[int] = None) -> None:
+        """Called by the engine at per-row/global sites. Raises the
+        planned exception — wrapped in ``EngineFault`` with the row's
+        attribution when a sid is in scope — or sleeps, or no-ops."""
+        for plan in self.plans:
+            if plan.kind == "exhaust" or not plan.matches(site, rnd,
+                                                          sid):
+                continue
+            plan.fired += 1
+            self.log.append((site, rnd, sid, plan.kind))
+            if plan.kind == "sleep":
+                time.sleep(plan.sleep_s)
+                continue
+            if sid is not None:
+                raise EngineFault(plan.exc, culprit_sid=sid,
+                                  culprit_rid=rid)
+            raise plan.exc
+
+    def exhausted(self, rnd: int) -> bool:
+        """Allocator seam: True = pretend the pool is dry this call."""
+        for plan in self.plans:
+            if plan.kind == "exhaust" and plan.matches("alloc", rnd,
+                                                       None):
+                plan.fired += 1
+                self.log.append(("alloc", rnd, None, "exhaust"))
+                return True
+        return False
+
+
+def check_quiesced(eng, expect_cached_pages: Optional[int] = None
+                   ) -> None:
+    """Assert a drained engine returned to baseline. Valid once no
+    request is queued or in flight (all handles resolved/failed).
+
+    Invariants:
+    - every slot is free (no orphaned slots after cancels/faults);
+    - admission queue and readback queues are empty;
+    - allocator occupancy == prefix-cache resident pages (pages are
+      either free or owned by the tree — anything else leaked);
+    - every cached page's refcount is 0 (no dangling slot refs);
+    - the prefix tree's structural invariants hold.
+    """
+    live = [i for i, s in enumerate(eng.slots) if s is not None]
+    assert not live, f"orphaned slots after drain: {live}"
+    assert not eng._wait, \
+        f"admission queue not drained: {len(eng._wait)} waiting"
+    assert not eng._fetchq and not eng._pending_prefill, \
+        "readback queues not drained"
+    cached = (eng.prefix_cache.cached_pages
+              if eng.prefix_cache is not None else 0)
+    occ = eng.alloc.occupancy()
+    assert occ == cached, (
+        f"allocator occupancy {occ} != prefix-cache residency "
+        f"{cached}: leaked pages {sorted(eng.alloc.leak_report())[:16]}")
+    if expect_cached_pages is not None:
+        assert cached == expect_cached_pages, (cached,
+                                               expect_cached_pages)
+    if eng.prefix_cache is not None:
+        for page in list(eng.prefix_cache._nodes):
+            r = eng.prefix_cache.ref_of(page)
+            assert r == 0, f"cached page {page} still has refcount {r}"
+        eng.prefix_cache.check_invariants()

@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 from ray_tpu.serve.api import get_handle, list_deployments
+from ray_tpu.serve.errors import classify_http_status, retry_after_s
 
 
 class HTTPProxy:
@@ -32,6 +33,29 @@ class HTTPProxy:
         from concurrent.futures import ThreadPoolExecutor
         self._pool = ThreadPoolExecutor(
             max_workers=128, thread_name_prefix="serve-proxy")
+
+    @staticmethod
+    def _error_response(e: BaseException):
+        """Map request-lifecycle failures to their HTTP contract
+        (serve/errors.py classify_http_status, matching BY NAME
+        across the remote-call wrapping): EngineOverloaded -> 429 +
+        Retry-After, DeadlineExceeded / ray_tpu.get timeout -> 504,
+        EngineShutdown -> 503, RequestCancelled -> 499, everything
+        else stays a 500. Always a clean JSON body — a timeout must
+        not surface as a 500 with a traceback."""
+        from aiohttp import web
+        status = classify_http_status(e)
+        body = {"error": str(e) or type(e).__name__,
+                "type": type(e).__name__}
+        if status == 504:
+            body["error"] = (str(e)
+                             or "upstream timed out before replying")
+        headers = {}
+        if status == 429:
+            headers["Retry-After"] = str(
+                max(1, int(round(retry_after_s(e)))))
+        return web.json_response(body, status=status,
+                                 headers=headers)
 
     def _handle_for(self, name: str):
         h = self._handles.get(name)
@@ -82,8 +106,13 @@ class HTTPProxy:
             result = await loop.run_in_executor(
                 self._pool, lambda: ray_tpu.get(ref, timeout=60))
             return web.json_response({"result": result})
+        except asyncio.CancelledError:
+            # client disconnected mid-request (aiohttp cancels the
+            # handler): there is nobody to answer — the 499-style
+            # outcome is the closed connection itself
+            raise
         except Exception as e:  # noqa: BLE001
-            return web.json_response({"error": str(e)}, status=500)
+            return self._error_response(e)
 
     async def _dispatch_stream(self, request, handle, payload):
         """Chunked-transfer streaming: each chunk from the deployment's
@@ -95,10 +124,6 @@ class HTTPProxy:
         sr = await loop.run_in_executor(
             self._pool, lambda: method.remote(payload)
             if payload is not None else method.remote())
-        resp = web.StreamResponse(
-            headers={"Content-Type": "application/x-ndjson"})
-        resp.enable_chunked_encoding()
-        await resp.prepare(request)
         it = iter(sr)
 
         def _next():
@@ -106,18 +131,32 @@ class HTTPProxy:
                 return True, next(it)
             except StopIteration:
                 return False, None
+        # Pull the FIRST chunk before committing chunked encoding:
+        # request-lifecycle failures that fire before any token
+        # (shed at submit -> 429, deadline while queued -> 504) then
+        # map to real status codes instead of a 200 with an error
+        # line buried in the stream.
+        try:
+            more, first = await loop.run_in_executor(self._pool,
+                                                     _next)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            return self._error_response(e)
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"})
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
         # Once prepare() has committed chunked encoding we can never
         # return a second (json) response: mid-stream failures become a
         # terminal {"error": ...} line on the stream itself.
         try:
-            while True:
-                more, chunk = await loop.run_in_executor(self._pool,
-                                                         _next)
-                if not more:
-                    break
+            while more:
                 await resp.write(
-                    (json.dumps({"chunk": chunk}, default=str) +
+                    (json.dumps({"chunk": first}, default=str) +
                      "\n").encode())
+                more, first = await loop.run_in_executor(self._pool,
+                                                         _next)
         except Exception as e:  # noqa: BLE001
             try:
                 await resp.write(
@@ -165,8 +204,10 @@ class HTTPProxy:
             # FIRST status marker in the string.
             import re
             m = re.search(r"\b(40[45]): ", msg)
-            status = int(m.group(1)) if m else 500
-            return web.json_response({"error": msg}, status=status)
+            if m:
+                return web.json_response({"error": msg},
+                                         status=int(m.group(1)))
+            return self._error_response(e)
 
     async def _health(self, request):
         from aiohttp import web

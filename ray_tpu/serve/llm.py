@@ -39,7 +39,11 @@ class LlamaDeployment:
                  prefill_chunk: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  prefix_cache: bool = False,
-                 spec_len: int = 0, spec_ngram: int = 3):
+                 spec_len: int = 0, spec_ngram: int = 3,
+                 deadline_s: Optional[float] = None,
+                 max_queued: Optional[int] = None,
+                 max_retries: int = 2,
+                 retry_backoff_s: float = 0.02):
         import jax
         from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
@@ -66,12 +70,20 @@ class LlamaDeployment:
         self._engine = None
         import threading
         self._engine_lock = threading.Lock()
+        # Request-lifecycle defaults (serve/engine.py hardening):
+        # deadline_s is the deployment-wide per-request deadline
+        # (per-call dict payloads can override); max_queued bounds
+        # admission so overload sheds fast (EngineOverloaded -> 429
+        # at the proxy) instead of silently collapsing TTFT.
+        self.deadline_s = deadline_s
         self._engine_opts = dict(
             max_slots=max_slots, page_size=page_size,
             n_pages=n_pages, chunk=decode_chunk or stream_chunk,
             prefill_chunk=prefill_chunk, eos_id=eos_id,
             prefix_cache=prefix_cache,
-            spec_len=spec_len, spec_ngram=spec_ngram)
+            spec_len=spec_len, spec_ngram=spec_ngram,
+            max_queued=max_queued, max_retries=max_retries,
+            retry_backoff_s=retry_backoff_s)
 
     def setup_mesh(self, mesh):
         """Called by the serve replica when cfg.mesh is set: shard the
@@ -126,17 +138,39 @@ class LlamaDeployment:
                 eng._lock.release()
         out.update(slots_live=live, slots_total=eng.S,
                    pages_free=free, pages_total=total,
-                   consistent=locked)
+                   consistent=locked,
+                   max_queued=eng.max_queued,
+                   max_retries=eng.max_retries,
+                   retry_backoff_s=eng.retry_backoff_s)
         if eng.prefix_cache is not None:
             out["prefix_cache"] = eng.prefix_cache.stats()
         return {"engine": out}
 
+    def _request_args(self, payload):
+        """(prompt_ids, max_new_tokens, deadline_s): a request is a
+        plain token-id list, or a dict carrying per-request lifecycle
+        overrides ({"prompt_ids": [...], "max_new_tokens": n,
+        "deadline_s": s}) — what the HTTP proxy posts through."""
+        if isinstance(payload, dict):
+            prompt_ids = payload.get("prompt_ids",
+                                     payload.get("prompt"))
+            if prompt_ids is None:
+                raise ValueError(
+                    "request dict needs a 'prompt_ids' key")
+            mnt = int(payload.get("max_new_tokens",
+                                  self.max_new_tokens))
+            dl = payload.get("deadline_s", self.deadline_s)
+            return list(prompt_ids), mnt, (
+                float(dl) if dl is not None else None)
+        return list(payload), self.max_new_tokens, self.deadline_s
+
     def __call__(self, prompt_ids: List[int]) -> List[int]:
         """One request: token ids in, prompt+generated ids out."""
         if self.use_engine:
+            ids, mnt, dl = self._request_args(prompt_ids)
             gen = self.engine().submit(
-                prompt_ids, max_new_tokens=self.max_new_tokens).result()
-            return list(prompt_ids) + gen
+                ids, max_new_tokens=mnt, deadline_s=dl).result()
+            return list(ids) + gen
         import jax.numpy as jnp
         from ray_tpu.models.llama import generate
         prompt = jnp.asarray([prompt_ids], jnp.int32)
@@ -151,9 +185,19 @@ class LlamaDeployment:
         generator in a StreamingResponse and the HTTP proxy in a
         chunked ndjson response)."""
         if self.use_engine:
-            yield from self.engine().submit(
-                prompt_ids,
-                max_new_tokens=self.max_new_tokens).stream()
+            ids, mnt, dl = self._request_args(prompt_ids)
+            h = self.engine().submit(ids, max_new_tokens=mnt,
+                                     deadline_s=dl)
+            try:
+                yield from h.stream()
+            except GeneratorExit:
+                # The client disconnected: the replica abandons the
+                # stream and garbage-collects this generator
+                # (controller.py _drain_sync), which closes it here.
+                # Cancel so the slot and its KV pages free NOW — an
+                # abandoned stream must not decode to completion.
+                h.cancel()
+                raise
             return
         import jax.numpy as jnp
         from ray_tpu.models.llama import generate_stream

@@ -148,7 +148,12 @@ def plan_step(slots: Sequence[SlotView], *, total_slots: int,
             for v in seeded)
         return StepPlan(tuple(grants), 0, spec)
 
-    rem = [v.owed for v in seeded]
+    # Defensive clamp: cancelled/expired slots are torn down before
+    # the engine snapshots views, so they never appear here at all —
+    # but an eos-mode rider's owed can still arrive negative (decoded
+    # past budget while emission trails) and must not drag min(rem)
+    # below the 1-step floor.
+    rem = [max(0, v.owed) for v in seeded]
     quick = (len(slots) < total_slots
              or any(not v.seeded for v in slots)
              or bool(grants))

@@ -34,6 +34,16 @@ artifact carries a spec-on vs spec-off throughput ratio measured in
 one session. --prompt-period makes each prompt's tail cycle with that
 period — the repetitive-suffix load shape speculation exists for.
 
+--lifecycle runs the request-lifecycle smoke instead of the
+throughput A/B: an UNSATURATED pass (bounded-queue engine, light
+client load) then an OVERLOAD burst against a small admission queue
+(--max-queued), with injected cancels and sub-millisecond-deadline
+probes riding along. The artifact records shed/admitted counts and
+latencies from the client side plus the engine's own lifecycle
+counters (shed/cancelled/deadline_exceeded), and the headline ratio:
+admitted p50 under overload vs unsaturated p50 — bounded admission
+is working when that ratio stays ~1 while excess load 429s fast.
+
 Every artifact records the git sha it was produced from.
 
 Usage: python serve_bench.py [--model 7b|1b|tiny] [--ab] [--out FILE]
@@ -42,6 +52,7 @@ Usage: python serve_bench.py [--model 7b|1b|tiny] [--ab] [--out FILE]
        [--page-size N] [--shared-prefix-len N]
        [--prefix-cache | --no-prefix-cache]
        [--spec-len N] [--spec-ngram N] [--prompt-period N]
+       [--lifecycle] [--max-queued N]
 (7b needs ~14GB HBM; falls back to 1b automatically on OOM.)
 """
 import argparse
@@ -134,6 +145,9 @@ def make_server(cfg, knobs, use_engine=True):
             def engine_spec_stats(self):
                 return None
 
+            def engine_lifecycle_stats(self):
+                return None
+
         return serve.run(LegacyServer.bind(), timeout_s=600)
 
     @serve.deployment(max_ongoing_requests=64)
@@ -148,7 +162,8 @@ def make_server(cfg, knobs, use_engine=True):
                 prefill_chunk=knobs["prefill_chunk"],
                 prefix_cache=knobs["prefix_cache"],
                 spec_len=knobs["spec_len"],
-                spec_ngram=knobs["spec_ngram"])
+                spec_ngram=knobs["spec_ngram"],
+                max_queued=knobs.get("max_queued"))
 
         def __call__(self, prompt):
             # joins the engine's decode batch at the next chunk
@@ -172,6 +187,32 @@ def make_server(cfg, knobs, use_engine=True):
 
         def engine_spec_stats(self):
             return self.inner.engine().spec_stats()
+
+        def engine_lifecycle_stats(self):
+            # knobs + shed/cancelled/deadline_exceeded counters
+            # (engine.py lifecycle_stats) for the artifact
+            return self.inner.engine().lifecycle_stats()
+
+        def probe(self, payload):
+            # dict payload path: per-request deadline_s / max_new
+            # overrides ride through LlamaDeployment._request_args
+            return self.inner(payload)
+
+        def cancel_probe(self, payload, after_s):
+            # Injected cancel: submit straight to the engine, let it
+            # run for after_s, then cancel — the deterministic stand-in
+            # for a client disconnect. Returns the outcome class name
+            # so the bench can count cancels vs. races with completion.
+            ids, mnt, dl = self.inner._request_args(payload)
+            h = self.inner.engine().submit(
+                ids, max_new_tokens=mnt, deadline_s=dl)
+            time.sleep(after_s)
+            h.cancel()
+            try:
+                h.result()
+                return "completed"
+            except Exception as e:   # noqa: BLE001 — outcome, not error
+                return type(e).__name__
 
     return serve.run(LlamaServer.bind(), timeout_s=600)
 
@@ -330,6 +371,11 @@ def run_path(args, knobs, use_engine):
                 handle.engine_stats.remote(), timeout=60)
         except Exception:
             pass
+        try:
+            result["lifecycle"] = ray_tpu.get(
+                handle.engine_lifecycle_stats.remote(), timeout=60)
+        except Exception:
+            pass
         if knobs["prefix_cache"]:
             try:
                 ps = ray_tpu.get(handle.engine_prefix_stats.remote(),
@@ -351,6 +397,197 @@ def run_path(args, knobs, use_engine):
     else:
         result["batch"] = LEGACY_BATCH
     serve.shutdown()
+    return result
+
+
+def _percentile(sorted_ms, frac):
+    return sorted_ms[min(len(sorted_ms) - 1,
+                         int(len(sorted_ms) * frac))]
+
+
+def run_lifecycle(args, knobs):
+    """Request-lifecycle smoke: unsaturated pass, then an overload
+    burst against a bounded admission queue with injected cancels and
+    deadline probes riding along.
+
+    Two serve sessions (max_queued is an engine-construction knob):
+    phase A serves UNBOUNDED and lightly loaded for the baseline p50;
+    phase B serves with --max-queued and more client threads than
+    slots+queue can hold, so excess submits shed fast with
+    EngineOverloaded (the proxy's 429) while admitted requests keep
+    near-baseline latency — that containment is what the
+    admitted_p50_ratio field measures."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.errors import classify_http_status
+
+    label, cfg = build_configs(args.model)
+    gen_tokens = knobs["gen_tokens"]
+    plen = min(knobs["prompt_len"], cfg.max_seq_len - gen_tokens)
+    slots = knobs["slots"]
+    rng = np.random.RandomState(0)
+
+    def prompt():
+        return rng.randint(1, cfg.vocab_size - 1, size=plen).tolist()
+
+    def timed_clients(handle, n_threads, prompts_per_thread=None,
+                      admit_target=None, wall_limit_s=120.0):
+        """Fire requests from n_threads; returns [(outcome, ms)].
+        With `admit_target`, threads keep firing until that many
+        requests were ADMITTED (completed) — shed attempts don't
+        count, so the burst holds the engine at steady-state
+        saturation for the whole measurement window instead of
+        draining its budget through fast 429s. A shed thread pauses
+        one engine retry-backoff before re-arming (a client honoring
+        Retry-After), which bounds the shed count."""
+        rows, lock = [], threading.Lock()
+        admitted = [0]
+        t_start = time.time()
+
+        def worker(prompts):
+            while True:
+                if admit_target is not None:
+                    with lock:
+                        done = (admitted[0] >= admit_target
+                                or time.time() - t_start > wall_limit_s)
+                        p = None if done else prompt()
+                    if p is None:
+                        return
+                elif prompts:
+                    p = prompts.pop()
+                else:
+                    return
+                t = time.time()
+                try:
+                    ray_tpu.get(handle.remote(p), timeout=3600)
+                    outcome = "ok"
+                except Exception as e:   # noqa: BLE001 — classified
+                    outcome = classify_http_status(e)
+                ms = (time.time() - t) * 1000
+                with lock:
+                    rows.append((outcome, ms))
+                    if outcome == "ok":
+                        admitted[0] += 1
+                if outcome == 429:
+                    time.sleep(0.02)
+
+        threads = [threading.Thread(target=worker, args=(
+            [prompt() for _ in range(prompts_per_thread)]
+            if prompts_per_thread else None,))
+            for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return rows
+
+    # --- phase A: unsaturated baseline (unbounded queue) ------------
+    # Slot-width concurrency: every request goes straight into a slot
+    # (no admission queueing, no shedding) while paying the same
+    # batched-decode round costs as phase B's admitted requests, so
+    # admitted_p50_ratio isolates what overload ADDS — queue wait.
+    unsat_threads = max(1, slots)
+    unsat_requests = max(2 * unsat_threads, 16)
+    print(f"model: {label} lifecycle phase A: {unsat_requests} req / "
+          f"{unsat_threads} threads, queue unbounded", flush=True)
+    handle = make_server(cfg, dict(knobs, max_queued=None),
+                         use_engine=True)
+    t0 = time.time()
+    ray_tpu.get(handle.remote(prompt()), timeout=3600)
+    compile_s = time.time() - t0
+    rows = timed_clients(handle, unsat_threads,
+                         prompts_per_thread=-(-unsat_requests
+                                              // unsat_threads))
+    serve.shutdown()
+    ok_ms = sorted(ms for o, ms in rows if o == "ok")
+    assert ok_ms, f"unsaturated phase produced no completions: {rows}"
+    unsat = {
+        "p50_ms": round(statistics.median(ok_ms), 1),
+        "p99_ms": round(_percentile(ok_ms, 0.99), 1),
+        "requests": len(ok_ms),
+        "client_threads": unsat_threads,
+        "compile_s": round(compile_s, 1),
+    }
+
+    # --- phase B: overload burst against a bounded queue ------------
+    mq = args.max_queued
+    over_threads = max(knobs["threads"], slots + mq + 2)
+    admit_target = knobs["requests"]
+    print(f"lifecycle phase B: {admit_target} admitted-request "
+          f"target / {over_threads} threads, max_queued={mq}",
+          flush=True)
+    handle = make_server(cfg, dict(knobs, max_queued=mq),
+                         use_engine=True)
+    ray_tpu.get(handle.remote(prompt()), timeout=3600)
+    rows = timed_clients(handle, over_threads,
+                         admit_target=admit_target)
+    admitted = sorted(ms for o, ms in rows if o == "ok")
+    shed = sorted(ms for o, ms in rows if o == 429)
+    other = [o for o, _ in rows if o not in ("ok", 429)]
+
+    # --- injected cancels + deadline probes (same bounded server) ---
+    cancel_outcomes = []
+    for _ in range(4):
+        payload = {"prompt_ids": prompt(),
+                   "max_new_tokens": min(64, cfg.max_seq_len - plen)}
+        cancel_outcomes.append(ray_tpu.get(
+            handle.cancel_probe.remote(payload, 0.01), timeout=120))
+    deadline_statuses = []
+    for _ in range(4):
+        payload = {"prompt_ids": prompt(), "deadline_s": 1e-4}
+        try:
+            ray_tpu.get(handle.probe.remote(payload), timeout=120)
+            deadline_statuses.append("ok")
+        except Exception as e:   # noqa: BLE001 — classified
+            deadline_statuses.append(classify_http_status(e))
+
+    lifecycle = ray_tpu.get(handle.engine_lifecycle_stats.remote(),
+                            timeout=60)
+    serve.shutdown()
+
+    assert admitted, f"overload phase admitted nothing: {rows[:8]}"
+    over = {
+        "attempts": len(rows),
+        "admitted": len(admitted),
+        "shed": len(shed),
+        "other_errors": len(other),
+        "admitted_p50_ms": round(statistics.median(admitted), 1),
+        "admitted_p99_ms": round(_percentile(admitted, 0.99), 1),
+        "shed_p50_ms": (round(statistics.median(shed), 1)
+                        if shed else None),
+        "client_threads": over_threads,
+        "cancel_probes": len(cancel_outcomes),
+        "cancelled": cancel_outcomes.count("RequestCancelled"),
+        "deadline_probes": len(deadline_statuses),
+        "deadline_exceeded": deadline_statuses.count(504),
+    }
+    ratio = _ratio(over["admitted_p50_ms"], unsat["p50_ms"])
+    result = {
+        "unsaturated": unsat,
+        "overloaded": over,
+        "admitted_p50_ratio": ratio,
+        "lifecycle": lifecycle,
+        "model": label,
+        "gen_tokens": gen_tokens,
+        "prompt_len": plen,
+        "slots": slots,
+        "max_queued": mq,
+        "decode_chunk": knobs["decode_chunk"],
+        "prefill_chunk": knobs["prefill_chunk"],
+        "notes": "Request-lifecycle smoke (serve_bench.py "
+                 "--lifecycle): baseline at slot-width concurrency "
+                 "(no admission queueing) then an overload burst "
+                 "against max_queued admission; excess load sheds "
+                 "fast (EngineOverloaded -> 429 at the proxy) while "
+                 "admitted p50 stays near baseline "
+                 "(admitted_p50_ratio). Cancels are injected via "
+                 "engine-handle cancel_probe; deadline probes use a "
+                 "sub-millisecond per-request deadline_s.",
+    }
+    if ratio is not None and not 0.9 <= ratio <= 1.1:
+        print(f"WARNING: admitted p50 ratio {ratio} outside "
+              "[0.9, 1.1] — overload latency not comparable to "
+              "baseline", flush=True)
     return result
 
 
@@ -400,6 +637,14 @@ def main():
                     help="cycle each prompt's tail with this period "
                          "(repetitive-suffix load shape speculation "
                          "targets; 0 = fully random tails)")
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="request-lifecycle smoke: unsaturated pass "
+                         "then an overload burst against --max-queued "
+                         "with injected cancels + deadline probes")
+    ap.add_argument("--max-queued", type=int, default=2,
+                    help="admission-queue bound for the --lifecycle "
+                         "overload phase (excess submits shed with "
+                         "EngineOverloaded / HTTP 429)")
     args = ap.parse_args()
     prefix_cache = (args.shared_prefix_len > 0
                     if args.prefix_cache is None else args.prefix_cache)
@@ -422,6 +667,16 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import ray_tpu
     ray_tpu.init()
+
+    if args.lifecycle:
+        result = run_lifecycle(args, knobs)
+        result["git_sha"] = git_sha()
+        out = args.out or "SERVE_BENCH_lifecycle_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result))
+        ray_tpu.shutdown()
+        return
 
     if args.ab:
         eng = run_path(args, knobs, use_engine=True)

@@ -154,6 +154,90 @@ def test_spec_ab_requires_stats_and_ratio(tmp_path):
     assert any("engine_spec_off" in p for p in probs)
 
 
+_LC = {"max_queued": 2, "max_retries": 2, "retry_backoff_s": 0.02,
+       "shed": 18, "cancelled": 4, "deadline_exceeded": 4,
+       "contained_faults": 0, "retries": 0, "retry_exhausted": 0,
+       "fault_failed": 0}
+
+
+def test_lifecycle_block_validated_when_present(tmp_path):
+    res = {"throughput_tok_s": 1.0, "p50_ms": 2.0, "p99_ms": 3.0,
+           "ttft_ms": 4.0, "stream_tok_s": 5.0}
+    ok = dict(res, lifecycle=dict(_LC))
+    assert _problems_for("SERVE_BENCH_x.json", ok, tmp_path) == []
+    # unbounded admission reports max_queued: null — still valid
+    unbounded = dict(res, lifecycle=dict(_LC, max_queued=None))
+    assert _problems_for("SERVE_BENCH_x.json", unbounded,
+                         tmp_path) == []
+    for field in ("max_queued", "max_retries", "retry_backoff_s",
+                  "shed", "cancelled", "deadline_exceeded"):
+        bad = dict(res, lifecycle={k: v for k, v in _LC.items()
+                                   if k != field})
+        probs = _problems_for("SERVE_BENCH_x.json", bad, tmp_path)
+        assert any(field in p for p in probs), field
+    typed = dict(res, lifecycle=dict(_LC, shed="18"))
+    assert _problems_for("SERVE_BENCH_x.json", typed, tmp_path)
+    not_obj = dict(res, lifecycle=[1, 2])
+    assert _problems_for("SERVE_BENCH_x.json", not_obj, tmp_path)
+
+
+def _lifecycle_smoke():
+    return {
+        "unsaturated": {"p50_ms": 50.0, "p99_ms": 80.0,
+                        "requests": 16, "client_threads": 4},
+        "overloaded": {"attempts": 64, "admitted": 30, "shed": 18,
+                       "other_errors": 0, "admitted_p50_ms": 52.0,
+                       "admitted_p99_ms": 90.0, "shed_p50_ms": 2.0,
+                       "client_threads": 16},
+        "admitted_p50_ratio": 1.04,
+        "lifecycle": dict(_LC),
+        "git_sha": "abc1234",
+    }
+
+
+def test_lifecycle_smoke_artifact_validates(tmp_path):
+    ok = _lifecycle_smoke()
+    assert _problems_for("SERVE_BENCH_lifecycle_cpu_smoke.json", ok,
+                         tmp_path) == []
+
+
+def test_lifecycle_smoke_requires_measured_shedding(tmp_path):
+    # shed == 0 on either side means the overload burst never
+    # overloaded: a broken run, not evidence of bounded admission
+    no_client_shed = _lifecycle_smoke()
+    no_client_shed["overloaded"]["shed"] = 0
+    probs = _problems_for("SERVE_BENCH_lifecycle_cpu_smoke.json",
+                          no_client_shed, tmp_path)
+    assert any("shed nothing" in p for p in probs)
+    no_engine_shed = _lifecycle_smoke()
+    no_engine_shed["lifecycle"]["shed"] = 0
+    probs = _problems_for("SERVE_BENCH_lifecycle_cpu_smoke.json",
+                          no_engine_shed, tmp_path)
+    assert any("shed counter is 0" in p for p in probs)
+
+
+def test_lifecycle_smoke_requires_sections_and_bounded_queue(tmp_path):
+    for missing in ("unsaturated", "overloaded", "lifecycle",
+                    "admitted_p50_ratio"):
+        bad = {k: v for k, v in _lifecycle_smoke().items()
+               if k != missing}
+        probs = _problems_for("SERVE_BENCH_lifecycle_cpu_smoke.json",
+                              bad, tmp_path)
+        assert probs, missing
+    # a lifecycle smoke against an UNBOUNDED queue proves nothing
+    unbounded = _lifecycle_smoke()
+    unbounded["lifecycle"]["max_queued"] = None
+    probs = _problems_for("SERVE_BENCH_lifecycle_cpu_smoke.json",
+                          unbounded, tmp_path)
+    assert any("max_queued" in p for p in probs)
+    # overloaded section missing its admitted p50
+    no_p50 = _lifecycle_smoke()
+    del no_p50["overloaded"]["admitted_p50_ms"]
+    probs = _problems_for("SERVE_BENCH_lifecycle_cpu_smoke.json",
+                          no_p50, tmp_path)
+    assert any("admitted_p50_ms" in p for p in probs)
+
+
 def test_git_sha_must_be_string_when_present(tmp_path):
     res = {"throughput_tok_s": 1.0, "p50_ms": 2.0, "p99_ms": 3.0,
            "ttft_ms": 4.0, "stream_tok_s": 5.0}

@@ -31,6 +31,32 @@ def tiny_model():
     return model, params
 
 
+@pytest.fixture(autouse=True)
+def _no_page_leaks(monkeypatch):
+    """Invariant net under EVERY scenario in this file: once a test
+    ends, each engine it built must have its allocator back at
+    baseline — occupied pages exactly the prefix-cache residents
+    (zero without a cache). A cancelled/failed/preempted path that
+    drops a page shows up here, with the leaked ids named."""
+    created = []
+    orig = LLMEngine.__init__
+
+    def record(self, *args, **kwargs):
+        orig(self, *args, **kwargs)
+        created.append(self)
+
+    monkeypatch.setattr(LLMEngine, "__init__", record)
+    yield
+    for eng in created:
+        cached = (eng.prefix_cache.cached_pages
+                  if eng.prefix_cache is not None else 0)
+        occ = eng.alloc.occupancy()
+        assert occ == cached, (
+            f"engine leaked pages at teardown: occupancy {occ} != "
+            f"prefix-cache residency {cached}; leaked ids "
+            f"{sorted(eng.alloc.leak_report())[:16]}")
+
+
 def _reference_completion(model, params, prompt, n):
     out = generate(model, params, jnp.asarray([prompt], jnp.int32),
                    max_new_tokens=n, temperature=0.0)

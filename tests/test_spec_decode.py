@@ -306,6 +306,45 @@ def test_spec_disabled_under_sampling(tiny_model):
     assert not [t for t in eng.sched_trace if t[0] == "spec"]
 
 
+def test_spec_cancel_mid_speculation(tiny_model):
+    """Cancelling a slot while the spec lane is active: its freed
+    pages must never be touched by the in-flight verify's rollback
+    (stream ordering — the same argument as retire-at-dispatch), the
+    surviving slot stays token-identical to greedy decode, and the
+    allocator returns to baseline."""
+    from ray_tpu.serve.errors import RequestCancelled
+    from ray_tpu.serve.faults import check_quiesced
+    model, params = tiny_model
+    p1 = list(REP_PROMPT)
+    p2 = list(REP_PROMPT[2:])
+    want1 = _reference_completion(model, params, p1, 24)
+    eng = LLMEngine(model, params, max_slots=4, page_size=8,
+                    n_pages=64, chunk=2, spec_len=4, spec_ngram=2)
+    h1 = eng.submit(p1, max_new_tokens=24)      # slot 0: survivor
+    h2 = eng.submit(p2, max_new_tokens=24)      # slot 1: cancelled
+    # step until speculation has actually dispatched and the victim
+    # is mid-flight (slot live, verify rounds running)
+    for _ in range(64):
+        eng.step()
+        if ([t for t in eng.sched_trace if t[0] == "spec"]
+                and eng.slots[1] is not None
+                and eng.slots[1].req is h2._req):
+            break
+    else:
+        raise AssertionError("spec lane never engaged")
+    assert h2.cancel() is True
+    assert eng.slots[1] is None                 # slot + pages freed NOW
+    while eng.step():
+        pass
+    assert h1.result() == want1
+    with pytest.raises(RequestCancelled):
+        h2.result()
+    assert len(h2._req.generated) < 24
+    assert eng.stats["cancelled"] == 1
+    assert eng.spec_stats()["rounds"] > 0
+    check_quiesced(eng)
+
+
 def test_spec_off_by_default_and_validates(tiny_model):
     model, params = tiny_model
     eng = LLMEngine(model, params, max_slots=2, page_size=8,

@@ -17,6 +17,17 @@ family:
 - SERVE_BENCH A/B: {engine_continuous_batching: result,
   legacy_decode_to_completion: result-or-sourced-baseline} plus at
   least one *_ratio field
+- SERVE_BENCH lifecycle smoke (serve_bench.py --lifecycle):
+  {unsaturated, overloaded, admitted_p50_ratio, lifecycle} — the
+  overload burst must have MEASURED shedding (shed > 0 both
+  client-side and in the engine counters), else the artifact proves
+  nothing about bounded admission
+
+Engine serve results may also carry a `lifecycle` block
+(engine.lifecycle_stats()): retry-policy knobs
+(max_queued/max_retries/retry_backoff_s) + request-lifecycle
+counters (shed/cancelled/deadline_exceeded/...), validated whenever
+present.
 
 Usage: python tools/check_bench_schema.py [FILES...]
        (no FILES: validates every SERVE_BENCH_*.json / BENCH_*.json
@@ -64,6 +75,30 @@ SPEC_REQUIRED = {
     "tokens_per_dispatch": NUM,
 }
 
+# engine serve results carry this block (engine.py lifecycle_stats):
+# retry-policy knobs + lifecycle counters. max_queued is validated
+# separately (int when bounded, null when admission is unbounded).
+LIFECYCLE_REQUIRED = {
+    "max_retries": int,
+    "retry_backoff_s": NUM,
+    "shed": NUM,
+    "cancelled": NUM,
+    "deadline_exceeded": NUM,
+}
+
+LIFECYCLE_UNSAT_REQUIRED = {
+    "p50_ms": NUM,
+    "p99_ms": NUM,
+    "requests": int,
+}
+
+LIFECYCLE_OVER_REQUIRED = {
+    "attempts": int,
+    "admitted": int,
+    "shed": NUM,
+    "admitted_p50_ms": NUM,
+}
+
 BENCH_WRAPPER_REQUIRED = {
     "n": int,
     "cmd": str,
@@ -101,9 +136,81 @@ def _check_serve_result(obj, where, problems):
         else:
             _check_fields(sp, SPEC_REQUIRED, f"{where}:spec",
                           problems)
+    lc = obj.get("lifecycle")
+    if lc is not None:
+        _check_lifecycle_block(lc, f"{where}:lifecycle", problems)
+
+
+def _check_lifecycle_block(lc, where, problems,
+                           require_bounded=False):
+    if not isinstance(lc, dict):
+        problems.append(f"{where}: lifecycle must be an object")
+        return
+    _check_fields(lc, LIFECYCLE_REQUIRED, where, problems)
+    mq = lc.get("max_queued", "missing")
+    if mq == "missing":
+        problems.append(f"{where}: missing required field "
+                        "'max_queued'")
+    elif require_bounded:
+        if not isinstance(mq, int) or isinstance(mq, bool):
+            problems.append(f"{where}: field 'max_queued' must be a "
+                            "bounded int in a lifecycle-smoke "
+                            f"artifact, got {type(mq).__name__}")
+    elif mq is not None and (not isinstance(mq, int)
+                             or isinstance(mq, bool)):
+        problems.append(f"{where}: field 'max_queued' must be int "
+                        f"or null, got {type(mq).__name__}")
+
+
+def check_lifecycle_smoke(obj, name, problems):
+    """serve_bench.py --lifecycle artifact: unsaturated baseline +
+    overload burst + engine lifecycle counters. Shedding must have
+    actually HAPPENED (shed > 0 on both sides) — a lifecycle artifact
+    whose overload phase never shed is a broken run, not evidence of
+    bounded admission."""
+    unsat = obj.get("unsaturated")
+    over = obj.get("overloaded")
+    if not isinstance(unsat, dict):
+        problems.append(f"{name}: unsaturated must be an object")
+    else:
+        _check_fields(unsat, LIFECYCLE_UNSAT_REQUIRED,
+                      f"{name}:unsaturated", problems)
+    if not isinstance(over, dict):
+        problems.append(f"{name}: overloaded must be an object")
+    else:
+        _check_fields(over, LIFECYCLE_OVER_REQUIRED,
+                      f"{name}:overloaded", problems)
+        shed = over.get("shed")
+        if isinstance(shed, NUM) and not isinstance(shed, bool) \
+                and shed <= 0:
+            problems.append(f"{name}: overload phase shed nothing "
+                            "(overloaded.shed == 0)")
+    if not isinstance(obj.get("admitted_p50_ratio"), NUM):
+        problems.append(f"{name}: lifecycle artifact missing numeric "
+                        "admitted_p50_ratio")
+    lc = obj.get("lifecycle")
+    if lc is None:
+        problems.append(f"{name}: lifecycle artifact missing the "
+                        "engine lifecycle block")
+    else:
+        _check_lifecycle_block(lc, f"{name}:lifecycle", problems,
+                               require_bounded=True)
+        if isinstance(lc, dict):
+            shed = lc.get("shed")
+            if isinstance(shed, NUM) and not isinstance(shed, bool) \
+                    and shed <= 0:
+                problems.append(f"{name}: engine shed counter is 0 "
+                                "in a lifecycle-smoke artifact")
 
 
 def check_serve_bench(obj, name, problems):
+    if "unsaturated" in obj or "overloaded" in obj:
+        # lifecycle smoke family (serve_bench.py --lifecycle)
+        check_lifecycle_smoke(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
     if "engine_continuous_batching" in obj:
         # A/B artifact: engine section is a full result; the legacy
         # section is either a same-session result or a sourced
