@@ -2,9 +2,9 @@ from ray_tpu.serve.api import (delete, deployment, run, shutdown,
                                get_deployment, get_handle,
                                get_deployment_handle,
                                list_deployments, status)
-from ray_tpu.serve.errors import (DeadlineExceeded, EngineOverloaded,
-                                  EngineShutdown, RequestCancelled,
-                                  RequestError)
+from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
+                                  EngineOverloaded, EngineShutdown,
+                                  RequestCancelled, RequestError)
 from ray_tpu.serve.multiplex import (get_multiplexed_model_id,
                                      multiplexed)
 from ray_tpu.serve.drivers import (DAGDriver, json_request,
@@ -21,4 +21,4 @@ __all__ = ["deployment", "run", "shutdown", "get_deployment", "get_handle",
            "get_deployment_handle", "ingress", "route",
            "AutoscalingConfig", "DeploymentConfig", "StreamingResponse",
            "RequestError", "RequestCancelled", "DeadlineExceeded",
-           "EngineOverloaded", "EngineShutdown"]
+           "EngineOverloaded", "EngineShutdown", "EngineDraining"]

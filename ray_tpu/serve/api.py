@@ -18,8 +18,9 @@ from ray_tpu.serve.controller import (CONTROLLER_NAME, Controller,
 # API surface — clients branch on them, the proxy maps them to HTTP
 # statuses (429/504/503/499), and they import without jax.
 from ray_tpu.serve.errors import (DeadlineExceeded,  # noqa: F401
-                                  EngineOverloaded, EngineShutdown,
-                                  RequestCancelled, RequestError)
+                                  EngineDraining, EngineOverloaded,
+                                  EngineShutdown, RequestCancelled,
+                                  RequestError)
 from ray_tpu.serve.router import (DeploymentHandle, clear_handle_cache,
                                   get_or_create_handle)
 

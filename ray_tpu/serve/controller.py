@@ -283,6 +283,23 @@ class Replica:
                 out["user"] = {"error": repr(e)}
         return out
 
+    def load_report(self):
+        """Compact load snapshot for the controller's replica table:
+        the deployment's ``load_report()`` hook (the LLM engine/pool
+        publishes free slots, queue depth, outstanding tokens), plus
+        the generic in-flight count. None-able fields stay absent —
+        a replica without the hook still reports ``ongoing``."""
+        out = {"ongoing": self._ongoing}
+        fn = getattr(self.instance, "load_report", None)
+        if callable(fn):
+            try:
+                rpt = fn()
+                if rpt:
+                    out.update(rpt)
+            except Exception:    # hook failure must not mark us dead
+                pass
+        return out
+
     def health_check(self):
         """Controller liveness probe. A deployment class may define
         its own ``check_health()`` (reference: user-defined health
@@ -390,7 +407,12 @@ class Controller:
         cfg = d["config"]
         return {"version": d["version"],
                 "replicas": list(d["replicas"].items()),
-                "max_ongoing": cfg.max_ongoing_requests}
+                "max_ongoing": cfg.max_ongoing_requests,
+                # per-replica load snapshots (engine/pool
+                # load_report), refreshed by the control loop; rides
+                # the polling path only — pub/sub pushes stay scale-
+                # event-driven so load churn can't flood the hub
+                "loads": dict(d.get("loads") or {})}
 
     def _publish_replicas(self, name: str, d: Dict[str, Any]):
         """Push the replica table to the head's pub/sub hub so handles
@@ -480,11 +502,39 @@ class Controller:
                     self._publish_replicas(name, d)
                     await self._drain(d)
                     await self._autoscale(name, d)
+                    self._poll_loads(d)
                     self._health_check(name, d)
             except Exception:  # noqa: BLE001 — keep reconciling
                 import traceback
                 traceback.print_exc()
             await asyncio.sleep(0.05)
+
+    # Load-table refresh cadence: snapshots are routing HINTS — a
+    # tie-break, not an admission gate — so a second of staleness
+    # costs one suboptimal route, and polling faster would just tax
+    # replicas with stats traffic.
+    _LOAD_POLL_S = 1.0
+
+    def _poll_loads(self, d: Dict[str, Any]) -> None:
+        """Refresh the per-replica load-snapshot table (the
+        ``Replica.load_report`` passthrough of the engine/pool
+        ``load_report()``). Handles read it via ``get_replicas`` and
+        use queue depth / outstanding tokens as the P2C tie-break."""
+        now = time.time()
+        if now - d.get("_loads_polled_at", 0.0) < self._LOAD_POLL_S:
+            return
+        d["_loads_polled_at"] = now
+        reps = list(d["replicas"].items())
+        if not reps:
+            d["loads"] = {}
+            return
+        refs = [h.load_report.remote() for _, h in reps]
+        try:
+            reports = ray_tpu.get(refs, timeout=2)
+        except Exception:
+            return     # keep the previous table: stale beats absent
+        d["loads"] = {rid: rpt for (rid, _), rpt
+                      in zip(reps, reports) if rpt}
 
     # Probe-failure policy: definitive death replaces immediately;
     # other errors and timeouts need this many CONSECUTIVE strikes

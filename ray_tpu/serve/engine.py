@@ -68,9 +68,9 @@ from ray_tpu.serve import spec_decode
 # Typed lifecycle errors live in a jax-free module (serve/errors.py)
 # so the HTTP proxy and clients can import them without the device
 # stack; RequestError is re-exported here for existing call sites.
-from ray_tpu.serve.errors import (DeadlineExceeded, EngineOverloaded,
-                                  EngineShutdown, RequestCancelled,
-                                  RequestError)
+from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
+                                  EngineOverloaded, EngineShutdown,
+                                  RequestCancelled, RequestError)
 from ray_tpu.serve.faults import EngineFault
 from ray_tpu.serve.prefix_cache import PrefixCache
 from ray_tpu.serve.scheduler import StepPlan, SlotView, plan_step
@@ -378,6 +378,7 @@ class LLMEngine:
         # tokens, so each iteration drains readbacks before planning.
         self._deferred = eos_id is None
         self._stopped = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
         self.stats: Dict[str, int] = collections.Counter()
         # Request-lifecycle knobs: bounded admission + bounded retry
@@ -448,6 +449,10 @@ class LLMEngine:
         with self._work:
             if self._stopped:
                 raise EngineShutdown("engine stopped")
+            if self._draining:
+                raise EngineDraining(
+                    "engine draining: finishing in-flight work, "
+                    "admitting nothing new")
             if (self.max_queued is not None
                     and len(self._wait) >= self.max_queued):
                 self.stats["shed"] += 1
@@ -468,6 +473,98 @@ class LLMEngine:
                 target=self._loop, name="llm-engine", daemon=True)
             self._thread.start()
         return self
+
+    def drain(self) -> None:
+        """Enter drain mode: admit nothing new, finish everything
+        already queued or in flight. Direct ``submit`` calls fail
+        typed ``EngineDraining`` (503 at the proxy); pool routing
+        skips draining replicas entirely. Idempotent. Pair with
+        ``wait_idle`` then ``shutdown`` for a graceful restart."""
+        with self._work:
+            self._draining = True
+            self._work.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def is_idle(self) -> bool:
+        """True when no request is queued, slotted, or trailing in a
+        readback — the state a draining replica must reach before it
+        can restart without failing anyone."""
+        with self._lock:
+            return (not self._wait and not any(self.slots)
+                    and not self._fetchq
+                    and not self._pending_prefill)
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        """Block until ``is_idle`` (or timeout). Returns the final
+        idleness — False means in-flight work outlived the budget and
+        the caller decides whether to axe it (``shutdown``)."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while not self.is_idle():
+            if time.monotonic() >= deadline:
+                return self.is_idle()
+            time.sleep(0.005)
+        return True
+
+    def load_report(self) -> Dict[str, Any]:
+        """Compact load snapshot for pool routing: free capacity,
+        queue pressure, outstanding token work, and the prefix-cache
+        digest (``PrefixCache.digest``) that longest-prefix affinity
+        matches against.
+
+        Best-effort consistency by design: tries the engine lock
+        briefly, and otherwise reads lock-free — the scheduler
+        mutates these fields under the GIL, so individual reads are
+        safe and routing only needs freshness, not atomicity. A
+        torn read costs one suboptimal route, never correctness."""
+        def compute() -> Dict[str, Any]:
+            outstanding = 0
+            free_slots = 0
+            for slot in list(self.slots):
+                if slot is None:
+                    free_slots += 1
+                    continue
+                req = slot.req
+                outstanding += max(0, len(slot.prompt)
+                                   - slot.prefilled)
+                outstanding += max(0, req.max_new_tokens
+                                   - len(req.generated))
+            waiting = list(self._wait)
+            for req in waiting:
+                outstanding += len(req.prompt) + req.max_new_tokens
+            return {
+                "free_slots": free_slots,
+                "free_pages": self.alloc.n_free,
+                "queue_depth": len(waiting),
+                "outstanding_tokens": outstanding,
+                "max_queued": self.max_queued,
+                "shed_retry_after_s": self.shed_retry_after_s,
+                "draining": self._draining,
+                "stopped": self._stopped,
+                "prefix_digest": (self.prefix_cache.digest()
+                                  if self.prefix_cache is not None
+                                  else frozenset()),
+            }
+        if self._lock.acquire(timeout=0.02):
+            try:
+                return compute()
+            finally:
+                self._lock.release()
+        for _ in range(3):
+            try:
+                return compute()
+            except RuntimeError:     # dict/deque mutated mid-iteration
+                continue
+        return {"free_slots": 0, "free_pages": self.alloc.n_free,
+                "queue_depth": len(self._wait),
+                "outstanding_tokens": 0,
+                "max_queued": self.max_queued,
+                "shed_retry_after_s": self.shed_retry_after_s,
+                "draining": self._draining,
+                "stopped": self._stopped,
+                "prefix_digest": frozenset()}
 
     def shutdown(self):
         """Stop the engine and FAIL everything still queued or in
@@ -967,6 +1064,12 @@ class LLMEngine:
                          pos=start, cur=None,
                          admit_seq=next(self._admit_seq),
                          prompt=prompt, prefilled=start,
+                         # re-admission after preemption/fault-requeue:
+                         # tokens already delivered count against the
+                         # budget, or _owed() over-schedules by that
+                         # many steps and run-ahead growth walks past
+                         # max_seq_len (and the page-table width)
+                         decoded=len(req.generated),
                          shared=len(shared_pages))
             self.slots[free[0]] = slot
             self.stats["admitted"] += 1

@@ -1,0 +1,770 @@
+"""EnginePool: N LLMEngine replicas behaving as ONE logical engine.
+
+After chunked prefill, the radix prefix cache, spec decode, and
+lifecycle hardening, the serving stack still terminated in a single
+``LLMEngine`` — one replica was both the throughput ceiling and the
+blast radius. This module is the data-parallel control plane that
+removes that ceiling the way the reference runtime scales serving:
+many identical accelerator-bound workers behind a thin, load-aware
+router (Ray's replica sets + power-of-two-choices; Podracer-style
+TPU fleets).
+
+Routing policy, in precedence order (``_route``):
+
+1. **Session stickiness** — a ``session_id`` keeps hitting the
+   replica that served it last (its KV prefix lives there), unless
+   that replica is gone or saturated.
+2. **Longest-prefix affinity** — each replica's ``load_report()``
+   carries a digest of its radix prefix cache (rolling path hashes,
+   ``prefix_cache.path_hashes``). The prompt is hashed once and the
+   replica holding its longest cached prefix wins, so the PR-2 radix
+   cache COMPOUNDS across the fleet instead of fragmenting: without
+   affinity, a shared system prompt gets re-prefilled on every
+   replica it happens to land on.
+3. **Spill** — when the affinity target is saturated (bounded queue
+   full), the request spills to the least-loaded healthy replica
+   instead of queueing behind its hot spot. The spill target then
+   caches the prefix too, so sustained hot prefixes replicate
+   themselves exactly as wide as their load requires.
+4. **Power-of-two-choices** on least outstanding tokens — the
+   classic load-balancing result: sampling two replicas and taking
+   the lighter one gets within a constant of optimal at O(1) cost.
+
+Replica lifecycle, owned by the pool:
+
+- **Draining** (``drain(idx)``): the replica admits nothing new
+  (direct submits fail typed ``EngineDraining``), finishes in-flight
+  work, shuts down, and is rebuilt from the factory — a rolling
+  config update with zero failed requests when work fits the drain
+  budget.
+- **Failure recovery**: when a replica dies (device loss, injected
+  ``ReplicaKilled``, any global ``_fail_all``), requests that have
+  not streamed a single token resubmit transparently to a healthy
+  replica (at-most-once delivery holds: nothing was observed, so
+  the retry cannot duplicate). Requests that already streamed fail
+  TYPED with ``EngineShutdown`` — replaying a partial greedy stream
+  exactly-once cannot be guaranteed, so the pool refuses to guess.
+- **Aggregate shed**: when every healthy replica sheds, the pool
+  raises one ``EngineOverloaded`` whose ``retry_after_s`` is the MAX
+  over replicas — an honest Retry-After even when only the slowest
+  replica is the bottleneck (the proxy maps it to 429).
+
+The pool mirrors the single-engine surface the deployment layer uses
+(``submit``/``stats``/``ttfts_s``/``prefix_stats``/``spec_stats``/
+``lifecycle_stats``/``shutdown``), so ``num_engine_replicas=N`` is a
+one-knob change in ``serve/llm.py``.
+"""
+from __future__ import annotations
+
+import collections
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
+                                  EngineOverloaded, EngineShutdown,
+                                  RequestCancelled, RequestError)
+from ray_tpu.serve.prefix_cache import path_hashes
+
+ROUTED = "serve_pool_routed_total"
+AFFINITY_HITS = "serve_pool_affinity_hits_total"
+STICKY_HITS = "serve_pool_sticky_hits_total"
+SPILLS = "serve_pool_spills_total"
+REQUEUES = "serve_pool_requeues_total"
+REPLICA_DEATHS = "serve_pool_replica_deaths_total"
+DRAINS = "serve_pool_drains_total"
+RESTARTS = "serve_pool_restarts_total"
+ALL_SHED = "serve_pool_all_shed_total"
+FREE_SLOTS = "serve_pool_replica_free_slots"
+QUEUE_DEPTH = "serve_pool_replica_queue_depth"
+
+_METRICS: Optional[dict] = None
+
+
+def _metrics() -> dict:
+    """Lazy module-level metric singletons, re-created if a test's
+    ``clear_registry()`` dropped them (same pattern as the engine and
+    prefix-cache modules)."""
+    global _METRICS
+    from ray_tpu.util import metrics
+    if (_METRICS is None
+            or metrics.registry().get(ROUTED)
+            is not _METRICS["routed"]):
+        _METRICS = {
+            "routed": metrics.Counter(
+                ROUTED, "Requests routed by the engine pool"),
+            "affinity_hits": metrics.Counter(
+                AFFINITY_HITS, "Routes landing on a replica already "
+                "holding a prefix of the prompt"),
+            "sticky_hits": metrics.Counter(
+                STICKY_HITS, "Routes resolved by session stickiness"),
+            "spills": metrics.Counter(
+                SPILLS, "Affinity targets saturated; request spilled "
+                "to another replica"),
+            "requeues": metrics.Counter(
+                REQUEUES, "Unstreamed requests resubmitted after a "
+                "replica death"),
+            "replica_deaths": metrics.Counter(
+                REPLICA_DEATHS, "Replica engines observed dead"),
+            "drains": metrics.Counter(
+                DRAINS, "Replica drains started"),
+            "restarts": metrics.Counter(
+                RESTARTS, "Replica engines rebuilt from the factory"),
+            "all_shed": metrics.Counter(
+                ALL_SHED, "Pool-aggregate sheds (every healthy "
+                "replica refused admission)"),
+            "free_slots": metrics.Gauge(
+                FREE_SLOTS, "Free decode slots per replica",
+                tag_keys=("replica",)),
+            "queue_depth": metrics.Gauge(
+                QUEUE_DEPTH, "Admission queue depth per replica",
+                tag_keys=("replica",)),
+        }
+    return _METRICS
+
+
+HEALTHY = "healthy"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class _Replica:
+    """One pool slot: a live engine plus its lifecycle state.
+    ``generation`` counts factory rebuilds (drain restarts + failure
+    restarts) so tests can assert a replica was actually replaced."""
+
+    __slots__ = ("idx", "engine", "state", "deaths", "generation")
+
+    def __init__(self, idx: int, engine, state: str = HEALTHY,
+                 deaths: int = 0, generation: int = 0):
+        self.idx = idx
+        self.engine = engine
+        self.state = state
+        self.deaths = deaths
+        self.generation = generation
+
+
+class PoolRequestHandle:
+    """Client-side view of a pooled request. Mirrors the engine's
+    ``RequestHandle`` surface (stream/result/cancel/done/error/
+    ttft_s) and adds the recovery loop: iterating ``stream()`` (or
+    ``result()``) transparently resubmits the request to a healthy
+    replica when its replica dies BEFORE any token was delivered;
+    after first delivery a replica death fails typed
+    ``EngineShutdown`` — never a silent hang, never a duplicated
+    token."""
+
+    def __init__(self, pool: "EnginePool", prompt: List[int],
+                 max_new_tokens: int, deadline_s: Optional[float],
+                 session_id: Optional[str]):
+        self._pool = pool
+        self._prompt = prompt
+        self._mnt = max_new_tokens
+        self._deadline_s = deadline_s
+        self._session_id = session_id
+        self._t0 = time.monotonic()
+        self._t_first: Optional[float] = None
+        self._rep: Optional[_Replica] = None
+        self._inner = None
+        self._generated: List[int] = []
+        self._resubmits = 0
+        self._error: Optional[BaseException] = None
+        self._finished = False
+        self._cancelled = False
+
+    # ------------------------------------------------------- consuming
+
+    def stream(self):
+        """Yield generated token ids; recover across replica deaths
+        while the at-most-once guard allows (zero tokens delivered)."""
+        while True:
+            rep, inner = self._rep, self._inner
+            try:
+                for tok in inner.stream():
+                    if self._t_first is None:
+                        self._t_first = time.monotonic()
+                    self._generated.append(tok)
+                    yield tok
+                self._finished = True
+                return
+            except GeneratorExit:
+                # consumer closed the stream (disconnect): not a
+                # failure, and certainly not a resubmission trigger
+                raise
+            except (RequestCancelled, DeadlineExceeded,
+                    EngineOverloaded, EngineDraining) as e:
+                # request-level outcomes: the pool never second-
+                # guesses an explicit cancel/deadline/shed
+                self._fail(e)
+                raise
+            except BaseException as e:
+                # EngineShutdown, a contained-fault wrapper, or the
+                # RAW global error a _fail_all delivered (e.g.
+                # ReplicaKilled). Replica death is judged by the
+                # engine, not the exception type.
+                if not self._pool._note_replica_death(rep):
+                    self._fail(e)
+                    raise
+                if self._generated or self._cancelled:
+                    err = EngineShutdown(
+                        f"replica {rep.idx} died after "
+                        f"{len(self._generated)} streamed tokens; a "
+                        f"partial stream cannot be replayed "
+                        f"at-most-once")
+                    self._fail(err)
+                    raise err from e
+                self._resubmit(e)      # raises typed when impossible
+
+    def result(self) -> List[int]:
+        """Block until completion; return all generated token ids."""
+        for _ in self.stream():
+            pass
+        return list(self._generated)
+
+    # ------------------------------------------------------- lifecycle
+
+    def cancel(self) -> bool:
+        self._cancelled = True
+        inner = self._inner
+        return inner.cancel() if inner is not None else False
+
+    @property
+    def done(self) -> bool:
+        return self._finished or self._error is not None
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Submit-to-first-token as the CLIENT saw it — spans
+        resubmissions, unlike the per-engine stamp."""
+        if self._t_first is None:
+            return None
+        return self._t_first - self._t0
+
+    @property
+    def replica_idx(self) -> Optional[int]:
+        return self._rep.idx if self._rep is not None else None
+
+    # -------------------------------------------------------- internal
+
+    def _fail(self, err: BaseException) -> None:
+        self._error = err
+
+    def _remaining_deadline(self,
+                            cause: BaseException) -> Optional[float]:
+        if self._deadline_s is None:
+            return None
+        left = self._deadline_s - (time.monotonic() - self._t0)
+        if left <= 0:
+            err = DeadlineExceeded(
+                "deadline elapsed while recovering from a replica "
+                "death")
+            self._fail(err)
+            raise err from cause
+        return left
+
+    def _resubmit(self, cause: BaseException) -> None:
+        if self._cancelled:
+            err = RequestCancelled("request cancelled")
+            self._fail(err)
+            raise err from cause
+        if self._resubmits >= self._pool.max_resubmits:
+            err = EngineShutdown(
+                f"request resubmitted {self._resubmits} times "
+                f"without completing; giving up")
+            self._fail(err)
+            raise err from cause
+        deadline = self._remaining_deadline(cause)
+        self._resubmits += 1
+        self._pool._count_requeue()
+        try:
+            self._rep, self._inner = self._pool._submit_once(
+                self._prompt, self._mnt, deadline, self._session_id)
+        except BaseException as e:
+            self._fail(e)
+            raise
+
+    def _attach(self, rep: _Replica, inner) -> None:
+        self._rep, self._inner = rep, inner
+
+
+class EnginePool:
+    """N ``LLMEngine`` replicas as one logical engine (module
+    docstring has the full routing + lifecycle contract).
+
+    Parameters
+    ----------
+    engine_factory: ``f(replica_idx) -> LLMEngine`` building ONE
+        replica (not started; the pool starts it). Called again on
+        drain-restart and failure-restart, so config changes in the
+        factory roll out via ``rolling_restart``.
+    num_replicas: pool width.
+    auto_restart: rebuild dead replicas in the background. Off by
+        default so tests (and capacity accounting) see deterministic
+        pool shapes; deployments turn it on.
+    max_resubmits: per-request cap on death-triggered resubmissions
+        (default ``num_replicas``): a request that outlives that many
+        replicas fails typed instead of looping.
+    seed: P2C sampling seed (deterministic tests).
+    """
+
+    def __init__(self, engine_factory: Callable[[int], Any],
+                 num_replicas: int, *,
+                 auto_restart: bool = False,
+                 max_resubmits: Optional[int] = None,
+                 max_sticky_sessions: int = 4096,
+                 seed: int = 0):
+        if num_replicas < 1:
+            raise ValueError("num_replicas must be >= 1")
+        self._factory = engine_factory
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self._auto_restart = auto_restart
+        self.max_resubmits = (max_resubmits if max_resubmits
+                              is not None else num_replicas)
+        self._max_sticky = max_sticky_sessions
+        self._sticky: "collections.OrderedDict[str, int]" = \
+            collections.OrderedDict()
+        # pool-level routing/lifecycle counters (the engines keep
+        # their own ``stats``; ``EnginePool.stats`` aggregates those)
+        self.route_stats: Dict[str, int] = collections.Counter()
+        self._stopped = False
+        self._replicas: List[_Replica] = []
+        for i in range(num_replicas):
+            eng = engine_factory(i)
+            eng.start()
+            self._replicas.append(_Replica(i, eng))
+
+    # --------------------------------------------------------- public
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self._replicas)
+
+    def engines(self) -> List[Any]:
+        """Every replica engine, regardless of state (quiescence
+        checks cover dead replicas too — a crash must not leak)."""
+        return [r.engine for r in self._replicas]
+
+    def replica(self, idx: int) -> _Replica:
+        return self._replicas[idx]
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas
+                       if r.state == HEALTHY)
+
+    def submit(self, prompt_ids: Sequence[int],
+               max_new_tokens: int = 64,
+               deadline_s: Optional[float] = None,
+               session_id: Optional[str] = None) -> PoolRequestHandle:
+        """Route and queue one request (engine ``submit`` signature
+        plus ``session_id`` for stickiness). Raises exactly like a
+        single engine: validation ``RequestError`` immediately,
+        pool-aggregate ``EngineOverloaded`` when every healthy
+        replica sheds, ``EngineShutdown`` when none is left."""
+        if self._stopped:
+            raise EngineShutdown("engine pool stopped")
+        prompt = [int(t) for t in prompt_ids]
+        handle = PoolRequestHandle(self, prompt, max_new_tokens,
+                                   deadline_s, session_id)
+        rep, inner = self._submit_once(prompt, max_new_tokens,
+                                       deadline_s, session_id)
+        handle._attach(rep, inner)
+        return handle
+
+    def shutdown(self) -> None:
+        """Stop every replica; queued/in-flight requests fail typed
+        ``EngineShutdown`` (per-engine contract). Idempotent."""
+        self._stopped = True
+        for rep in self._replicas:
+            try:
+                rep.engine.shutdown()
+            except Exception:
+                pass
+            rep.state = DEAD
+
+    # ------------------------------------------------------- lifecycle
+
+    def drain(self, idx: int, timeout_s: float = 30.0) -> bool:
+        """Gracefully restart replica ``idx``: stop admitting, let
+        in-flight work finish (up to ``timeout_s``), shut down, and
+        rebuild from the factory. Returns True when the drain
+        completed with no work left (nobody failed); False when the
+        budget expired and stragglers were axed — those fail typed
+        and unstreamed ones recover via resubmission, so the restart
+        still converges."""
+        with self._lock:
+            rep = self._replicas[idx]
+            if rep.state != HEALTHY:
+                raise RuntimeError(
+                    f"replica {idx} is {rep.state}; only a healthy "
+                    f"replica can drain")
+            rep.state = DRAINING
+            self.route_stats["drains"] += 1
+            self._drop_sticky_locked(idx)
+        _metrics()["drains"].inc()
+        eng = rep.engine
+        eng.drain()
+        clean = eng.wait_idle(timeout_s)
+        try:
+            eng.shutdown()
+        except Exception:
+            pass
+        self._rebuild(idx)
+        return clean
+
+    def rolling_restart(self, timeout_s: float = 30.0) -> bool:
+        """Drain-restart every replica in sequence (a config rollout
+        when the factory closes over new knobs). True iff every
+        drain was clean."""
+        clean = True
+        for idx in range(len(self._replicas)):
+            clean = self.drain(idx, timeout_s) and clean
+        return clean
+
+    def restart_dead(self) -> int:
+        """Rebuild every DEAD replica now (manual counterpart of
+        ``auto_restart``). Returns how many were rebuilt."""
+        with self._lock:
+            dead = [r.idx for r in self._replicas if r.state == DEAD]
+        for idx in dead:
+            self._rebuild(idx)
+        return len(dead)
+
+    def _rebuild(self, idx: int) -> None:
+        old = self._replicas[idx]
+        eng = self._factory(idx)
+        eng.start()
+        with self._lock:
+            self._replicas[idx] = _Replica(
+                idx, eng, HEALTHY, deaths=old.deaths,
+                generation=old.generation + 1)
+            self.route_stats["restarts"] += 1
+        _metrics()["restarts"].inc()
+
+    def _note_replica_death(self, rep: _Replica) -> bool:
+        """Judge (and record) a replica death. True iff ``rep``'s
+        engine has globally stopped — the discriminator between
+        request-level failures (engine alive; not the pool's
+        business) and replica-level ones (recoverable by routing
+        around the corpse)."""
+        if not getattr(rep.engine, "_stopped", False):
+            return False
+        restart = False
+        transitioned = False
+        with self._lock:
+            if (self._replicas[rep.idx] is rep
+                    and rep.state != DEAD):
+                rep.state = DEAD
+                rep.deaths += 1
+                transitioned = True
+                self.route_stats["replica_deaths"] += 1
+                self._drop_sticky_locked(rep.idx)
+                restart = self._auto_restart and not self._stopped
+        if transitioned:
+            _metrics()["replica_deaths"].inc()
+        # idempotent: unblocks every remaining consumer typed and
+        # frees whatever the dead scheduler left behind
+        try:
+            rep.engine.shutdown()
+        except Exception:
+            pass
+        if restart:
+            threading.Thread(target=self._rebuild, args=(rep.idx,),
+                             name=f"pool-restart-{rep.idx}",
+                             daemon=True).start()
+        return True
+
+    def _drop_sticky_locked(self, idx: int) -> None:
+        for k in [k for k, v in self._sticky.items() if v == idx]:
+            del self._sticky[k]
+
+    def _count_requeue(self) -> None:
+        with self._lock:
+            self.route_stats["requeues"] += 1
+        _metrics()["requeues"].inc()
+
+    # --------------------------------------------------------- routing
+
+    def _submit_once(self, prompt: List[int], max_new_tokens: int,
+                     deadline_s: Optional[float],
+                     session_id: Optional[str]):
+        """Route + submit until one replica accepts. Replicas that
+        shed/die/drain between the snapshot and the submit are
+        excluded and routing retries; when nothing accepts, the
+        failure is typed and aggregated (module docstring)."""
+        exclude: set = set()
+        shed: List[EngineOverloaded] = []
+        while True:
+            rep, decision = self._route(prompt, session_id, exclude)
+            if rep is None:
+                hints = decision.get("hints", [])
+                hints += [e.retry_after_s for e in shed]
+                if hints:
+                    with self._lock:
+                        self.route_stats["all_shed"] += 1
+                    _metrics()["all_shed"].inc()
+                    err = EngineOverloaded(
+                        f"all healthy replicas shed (retry hints "
+                        f"{sorted(set(round(h, 3) for h in hints))})",
+                        retry_after_s=max(hints))
+                    if shed:
+                        raise err from shed[-1]
+                    raise err
+                raise EngineShutdown("no healthy replicas in pool")
+            try:
+                inner = rep.engine.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    deadline_s=deadline_s)
+            except EngineOverloaded as e:
+                shed.append(e)
+                exclude.add(rep.idx)
+                continue
+            except (EngineShutdown, EngineDraining):
+                # raced a death/drain after the snapshot
+                self._note_replica_death(rep)
+                exclude.add(rep.idx)
+                continue
+            self._record_route(rep, decision, session_id)
+            return rep, inner
+
+    def _route(self, prompt: List[int], session_id: Optional[str],
+               exclude: set):
+        """Pick a replica (or ``(None, {"hints": [...]})`` when none
+        can admit). Lock discipline: the replica table is read under
+        the pool lock; ``load_report()`` calls happen OUTSIDE it (they
+        briefly take each engine's lock)."""
+        with self._lock:
+            reps = [r for r in self._replicas
+                    if r.state == HEALTHY and r.idx not in exclude]
+            sticky_idx = (self._sticky.get(session_id)
+                          if session_id is not None else None)
+        if not reps:
+            return None, {"hints": []}
+        reports = {r.idx: r.engine.load_report() for r in reps}
+        m = _metrics()
+        for r in reps:
+            rep_report = reports[r.idx]
+            tags = {"replica": str(r.idx)}
+            m["free_slots"].set(rep_report["free_slots"], tags=tags)
+            m["queue_depth"].set(rep_report["queue_depth"],
+                                 tags=tags)
+        live = [r for r in reps
+                if not reports[r.idx]["stopped"]
+                and not reports[r.idx]["draining"]]
+        if not live:
+            return None, {"hints": []}
+
+        def saturated(r: _Replica) -> bool:
+            rpt = reports[r.idx]
+            return (rpt["max_queued"] is not None
+                    and rpt["queue_depth"] >= rpt["max_queued"])
+
+        open_reps = [r for r in live if not saturated(r)]
+        if not open_reps:
+            return None, {"hints": [
+                reports[r.idx]["shed_retry_after_s"] for r in live]}
+
+        # longest cached prefix per replica, page-granular (page size
+        # can differ across generations, so hash per distinct Pg)
+        hashes_by_pg: Dict[int, List[int]] = {}
+        match_pages: Dict[int, int] = {}
+        for r in live:
+            digest = reports[r.idx]["prefix_digest"]
+            if not digest:
+                match_pages[r.idx] = 0
+                continue
+            pg = r.engine.Pg
+            hs = hashes_by_pg.get(pg)
+            if hs is None:
+                hs = hashes_by_pg[pg] = path_hashes(prompt, pg)
+            k = 0
+            for h in hs:
+                if h not in digest:
+                    break
+                k += 1
+            match_pages[r.idx] = k
+
+        outstanding = {r.idx: reports[r.idx]["outstanding_tokens"]
+                       for r in live}
+
+        # 1. session stickiness
+        if sticky_idx is not None:
+            for r in open_reps:
+                if r.idx == sticky_idx:
+                    return r, {"kind": "sticky",
+                               "pages": match_pages.get(r.idx, 0)}
+
+        # 2. longest-prefix affinity (scored over ALL live replicas:
+        #    a saturated best target means spill, not a blind miss)
+        best, best_pages = None, 0
+        for r in live:
+            k = match_pages.get(r.idx, 0)
+            if k > best_pages or (k == best_pages and k > 0
+                                  and best is not None
+                                  and outstanding[r.idx]
+                                  < outstanding[best.idx]):
+                best, best_pages = r, k
+        spilled = False
+        if best is not None and best_pages > 0:
+            if not saturated(best):
+                return best, {"kind": "affinity",
+                              "pages": best_pages}
+            spilled = True     # hot replica is full: overflow
+
+        # 3. power-of-two-choices on least outstanding tokens
+        if len(open_reps) == 1:
+            pick = open_reps[0]
+        else:
+            a, b = self._rng.sample(open_reps, 2)
+            pick = a if (outstanding[a.idx], a.idx) <= (
+                outstanding[b.idx], b.idx) else b
+        return pick, {"kind": "p2c", "spilled": spilled,
+                      "pages": match_pages.get(pick.idx, 0)}
+
+    def _record_route(self, rep: _Replica, decision: Dict[str, Any],
+                      session_id: Optional[str]) -> None:
+        m = _metrics()
+        with self._lock:
+            self.route_stats["routed"] += 1
+            self.route_stats[f"route_{decision['kind']}"] += 1
+            if decision.get("pages", 0) > 0:
+                # an affinity HIT is a route landing on a replica
+                # that already holds >= 1 page of this prompt's
+                # prefix — whichever rule picked it
+                self.route_stats["affinity_hits"] += 1
+                self.route_stats["affinity_hit_pages"] += \
+                    decision["pages"]
+            if decision["kind"] == "sticky":
+                self.route_stats["sticky_hits"] += 1
+            if decision.get("spilled"):
+                self.route_stats["spills"] += 1
+            if session_id is not None:
+                self._sticky[session_id] = rep.idx
+                self._sticky.move_to_end(session_id)
+                while len(self._sticky) > self._max_sticky:
+                    self._sticky.popitem(last=False)
+        m["routed"].inc()
+        if decision.get("pages", 0) > 0:
+            m["affinity_hits"].inc()
+        if decision["kind"] == "sticky":
+            m["sticky_hits"].inc()
+        if decision.get("spilled"):
+            m["spills"].inc()
+
+    # ---------------------------------------------------- aggregation
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """Summed engine counters across replicas (the single-engine
+        ``stats`` surface, fleet-wide)."""
+        total: Dict[str, int] = collections.Counter()
+        for rep in self._replicas:
+            total.update(rep.engine.stats)
+        return total
+
+    @property
+    def ttfts_s(self) -> List[float]:
+        out: List[float] = []
+        for rep in self._replicas:
+            out.extend(rep.engine.ttfts_s)
+        return out
+
+    def load_reports(self) -> Dict[int, Dict[str, Any]]:
+        return {r.idx: r.engine.load_report()
+                for r in self._replicas if r.state != DEAD}
+
+    def load_report(self) -> Dict[str, Any]:
+        """Pool-aggregate load snapshot (the single-engine
+        ``load_report`` surface, summed over live replicas — what the
+        serve controller's replica table stores for cross-replica
+        routing hints). No digest: prefix affinity is an intra-pool
+        decision; the deployment-level router only needs pressure."""
+        reports = list(self.load_reports().values())
+        agg = {"free_slots": 0, "free_pages": 0, "queue_depth": 0,
+               "outstanding_tokens": 0, "draining": False,
+               "stopped": not reports, "max_queued": None,
+               "shed_retry_after_s": 1.0,
+               "n_replicas": len(self._replicas),
+               "healthy_replicas": self.healthy_count()}
+        for rpt in reports:
+            agg["free_slots"] += rpt["free_slots"]
+            agg["free_pages"] += rpt["free_pages"]
+            agg["queue_depth"] += rpt["queue_depth"]
+            agg["outstanding_tokens"] += rpt["outstanding_tokens"]
+            agg["shed_retry_after_s"] = max(
+                agg["shed_retry_after_s"], rpt["shed_retry_after_s"])
+        return agg
+
+    def pool_stats(self) -> Dict[str, Any]:
+        """Routing/lifecycle counters + per-replica snapshot — the
+        pool block in serve stats and bench artifacts."""
+        with self._lock:
+            counters = dict(self.route_stats)
+            reps = [{"idx": r.idx, "state": r.state,
+                     "deaths": r.deaths,
+                     "generation": r.generation}
+                    for r in self._replicas]
+        routed = counters.get("routed", 0)
+        counters["affinity_hit_rate"] = round(
+            counters.get("affinity_hits", 0) / routed, 4) \
+            if routed else 0.0
+        counters["spill_rate"] = round(
+            counters.get("spills", 0) / routed, 4) if routed else 0.0
+        counters["n_replicas"] = len(reps)
+        counters["replicas"] = reps
+        return counters
+
+    def _agg_numeric(self, per_replica: List[Optional[Dict[str, Any]]]
+                     ) -> Optional[Dict[str, Any]]:
+        dicts = [d for d in per_replica if d]
+        if not dicts:
+            return None
+        out: Dict[str, Any] = {}
+        for d in dicts:
+            for k, v in d.items():
+                if isinstance(v, bool) or not isinstance(
+                        v, (int, float)):
+                    out.setdefault(k, v)
+                else:
+                    out[k] = out.get(k, 0) + v
+        return out
+
+    def prefix_stats(self) -> Optional[Dict[str, Any]]:
+        out = self._agg_numeric(
+            [r.engine.prefix_stats() for r in self._replicas])
+        if out:
+            total = out.get("hit_tokens", 0) + out.get(
+                "miss_tokens", 0)
+            out["hit_rate"] = round(
+                out.get("hit_tokens", 0) / total, 4) if total else 0.0
+        return out
+
+    def spec_stats(self) -> Optional[Dict[str, Any]]:
+        out = self._agg_numeric(
+            [r.engine.spec_stats() for r in self._replicas])
+        if out:
+            proposed = out.get("proposed", 0)
+            out["accept_rate"] = round(
+                out.get("accepted", 0) / proposed, 4) \
+                if proposed else 0.0
+            disp = out.get("dispatches", 0)
+            if "tokens_per_dispatch" in out:
+                out["tokens_per_dispatch"] = round(
+                    (out.get("accepted", 0) + disp) / disp, 4) \
+                    if disp else 0.0
+        return out
+
+    def lifecycle_stats(self) -> Dict[str, Any]:
+        per = [r.engine.lifecycle_stats() for r in self._replicas]
+        out = self._agg_numeric(per) or {}
+        # knobs are per-replica config, not summable: report rep 0's
+        for knob in ("max_queued", "max_retries", "retry_backoff_s"):
+            if per:
+                out[knob] = per[0].get(knob)
+        return out

@@ -52,6 +52,13 @@ class EngineShutdown(RequestError):
     """The engine stopped while the request was queued/in flight."""
 
 
+class EngineDraining(RequestError):
+    """The replica is draining (finishing in-flight work before a
+    restart) and admits nothing new. Routers skip draining replicas,
+    so a client only sees this when talking to a replica directly.
+    HTTP: 503 — retry lands on a healthy replica."""
+
+
 def classify_http_status(exc: BaseException) -> int:
     """Map an exception (possibly wrapped by the remote-call layer:
     ``TaskError.cause`` / ``__cause__`` chains, or stringly re-raised)
@@ -67,6 +74,7 @@ def classify_http_status(exc: BaseException) -> int:
         "DeadlineExceeded": 504,
         "GetTimeoutError": 504,
         "EngineShutdown": 503,
+        "EngineDraining": 503,
         "RequestCancelled": 499,
     }
     seen = set()
@@ -90,9 +98,16 @@ def classify_http_status(exc: BaseException) -> int:
 
 
 def retry_after_s(exc: BaseException, default: float = 1.0) -> float:
-    """Best-effort Retry-After extraction across wrapping layers."""
+    """Best-effort Retry-After extraction across wrapping layers.
+
+    Takes the MAX over every hint found along the cause chain, not the
+    first: a pool-aggregate ``EngineOverloaded`` chains the last
+    per-replica shed as its ``__cause__``, and an honest Retry-After
+    must cover the slowest replica, not whichever wrapper the walker
+    happened to visit first."""
     seen = set()
     stack = [exc]
+    best = None
     while stack:
         e = stack.pop()
         if e is None or id(e) in seen:
@@ -100,7 +115,7 @@ def retry_after_s(exc: BaseException, default: float = 1.0) -> float:
         seen.add(id(e))
         v = getattr(e, "retry_after_s", None)
         if isinstance(v, (int, float)):
-            return float(v)
+            best = float(v) if best is None else max(best, float(v))
         stack.extend([getattr(e, "cause", None), e.__cause__,
                       e.__context__])
-    return default
+    return default if best is None else best

@@ -49,6 +49,14 @@ import time
 from typing import Any, List, Optional
 
 
+class ReplicaKilled(Exception):
+    """Marker exception for an injected whole-replica death (the
+    moral equivalent of a device loss / process kill). Raised at the
+    ``step`` site with no sid in scope, it escapes the scheduler
+    loop's containment and lands in ``_fail_all`` — the engine stops
+    and every request fails, exactly like a real replica crash."""
+
+
 class EngineFault(Exception):
     """A fault attributable to (at most) one request.
 
@@ -127,6 +135,22 @@ class FaultInjector:
         self.plans.append(plan)
         return plan
 
+    def kill_replica(self, *, round: Optional[int] = None
+                     ) -> FaultPlan:
+        """Plan a whole-replica death at scheduling round ``round``
+        (None = next round): fires ``ReplicaKilled`` at the global
+        ``step`` site, which bypasses per-slot containment and takes
+        the entire engine down via ``_fail_all``. This is the pool's
+        replica-failure drill — recovery (resubmission of unstarted
+        requests, typed failure of partially-streamed ones) is the
+        EnginePool's job, not the dead engine's."""
+        plan = FaultPlan(site="step", kind="raise",
+                         exc=ReplicaKilled(
+                             "injected replica death"),
+                         round=round, times=1)
+        self.plans.append(plan)
+        return plan
+
     def slow(self, site: str, sleep_s: float, *,
              round: Optional[int] = None, sid: Optional[int] = None,
              times: int = 1) -> FaultPlan:
@@ -201,3 +225,13 @@ def check_quiesced(eng, expect_cached_pages: Optional[int] = None
             r = eng.prefix_cache.ref_of(page)
             assert r == 0, f"cached page {page} still has refcount {r}"
         eng.prefix_cache.check_invariants()
+
+
+def check_pool_quiesced(pool) -> None:
+    """Pool-wide quiescence: every replica engine — healthy, draining,
+    or dead — must individually pass ``check_quiesced``. A dead
+    replica's ``_fail_all`` frees slot pages and drops prefix refs,
+    so even a crash leaves allocator occupancy == cache residency;
+    anything else is a leak the pool masked instead of contained."""
+    for eng in pool.engines():
+        check_quiesced(eng)

@@ -40,9 +40,16 @@ class HTTPProxy:
         (serve/errors.py classify_http_status, matching BY NAME
         across the remote-call wrapping): EngineOverloaded -> 429 +
         Retry-After, DeadlineExceeded / ray_tpu.get timeout -> 504,
-        EngineShutdown -> 503, RequestCancelled -> 499, everything
-        else stays a 500. Always a clean JSON body — a timeout must
-        not surface as a 500 with a traceback."""
+        EngineShutdown / EngineDraining -> 503, RequestCancelled ->
+        499, everything else stays a 500. Always a clean JSON body —
+        a timeout must not surface as a 500 with a traceback.
+
+        Retry-After honesty: ``retry_after_s`` takes the MAX hint
+        over the whole cause chain, so an engine-pool shed (one
+        aggregate EngineOverloaded chaining per-replica sheds)
+        advertises the slowest replica's hint; the ceiling below
+        means the header never tells a client to return before the
+        hint says capacity could be back."""
         from aiohttp import web
         status = classify_http_status(e)
         body = {"error": str(e) or type(e).__name__,
@@ -53,7 +60,7 @@ class HTTPProxy:
         headers = {}
         if status == 429:
             headers["Retry-After"] = str(
-                max(1, int(round(retry_after_s(e)))))
+                max(1, -(-int(retry_after_s(e) * 1000) // 1000)))
         return web.json_response(body, status=status,
                                  headers=headers)
 

@@ -43,7 +43,9 @@ class LlamaDeployment:
                  deadline_s: Optional[float] = None,
                  max_queued: Optional[int] = None,
                  max_retries: int = 2,
-                 retry_backoff_s: float = 0.02):
+                 retry_backoff_s: float = 0.02,
+                 num_engine_replicas: int = 1,
+                 pool_auto_restart: bool = True):
         import jax
         from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
@@ -76,6 +78,13 @@ class LlamaDeployment:
         # admission so overload sheds fast (EngineOverloaded -> 429
         # at the proxy) instead of silently collapsing TTFT.
         self.deadline_s = deadline_s
+        # Data-parallel engine pool (serve/engine_pool.py): N engines
+        # behind prefix-affinity routing behave as one logical
+        # engine. 1 = plain single engine, no pool in the path.
+        if num_engine_replicas < 1:
+            raise ValueError("num_engine_replicas must be >= 1")
+        self.num_engine_replicas = num_engine_replicas
+        self.pool_auto_restart = pool_auto_restart
         self._engine_opts = dict(
             max_slots=max_slots, page_size=page_size,
             n_pages=n_pages, chunk=decode_chunk or stream_chunk,
@@ -103,15 +112,33 @@ class LlamaDeployment:
             if self._engine is None:
                 from ray_tpu.serve.engine import LLMEngine
                 opts = dict(self._engine_opts)
+                # max_slots/n_pages are PER-REPLICA: each pool member
+                # is a full engine, so num_engine_replicas=N scales
+                # aggregate slots and KV pages N-fold (data-parallel
+                # replication adds capacity; it does not reshard one
+                # engine's budget).
                 if opts["n_pages"] is None:
                     # full residency by default: every slot can reach
                     # prompt+completion without preemption
                     per_seq = -(-self.cfg.max_seq_len
                                 // opts["page_size"])
                     opts["n_pages"] = opts["max_slots"] * per_seq + 1
-                self._engine = LLMEngine(
-                    self.model, self.params,
-                    temperature=self.temperature, **opts).start()
+                if self.num_engine_replicas > 1:
+                    from ray_tpu.serve.engine_pool import EnginePool
+
+                    def factory(idx, _opts=opts):
+                        return LLMEngine(
+                            self.model, self.params,
+                            temperature=self.temperature,
+                            seed=idx, **_opts)
+
+                    self._engine = EnginePool(
+                        factory, self.num_engine_replicas,
+                        auto_restart=self.pool_auto_restart)
+                else:
+                    self._engine = LLMEngine(
+                        self.model, self.params,
+                        temperature=self.temperature, **opts).start()
             return self._engine
 
     def serve_stats(self) -> dict:
@@ -121,6 +148,36 @@ class LlamaDeployment:
         if not self.use_engine or self._engine is None:
             return {"engine": None}
         eng = self._engine
+        from ray_tpu.serve.engine_pool import EnginePool
+        if isinstance(eng, EnginePool):
+            out: dict = dict(eng.stats)
+            slots_live = slots_total = 0
+            pages_free = pages_total = 0
+            for rep_eng in eng.engines():
+                locked = rep_eng._lock.acquire(timeout=0.05)
+                try:
+                    slots_live += sum(1 for s in rep_eng.slots
+                                      if s is not None)
+                    slots_total += rep_eng.S
+                    pages_free += rep_eng.alloc.n_free
+                    pages_total += rep_eng.alloc.n_pages - 1
+                finally:
+                    if locked:
+                        rep_eng._lock.release()
+            out.update(slots_live=slots_live,
+                       slots_total=slots_total,
+                       pages_free=pages_free,
+                       pages_total=pages_total,
+                       consistent=False,
+                       max_queued=self._engine_opts["max_queued"],
+                       max_retries=self._engine_opts["max_retries"],
+                       retry_backoff_s=self._engine_opts[
+                           "retry_backoff_s"],
+                       pool=eng.pool_stats())
+            ps = eng.prefix_stats()
+            if ps:
+                out["prefix_cache"] = ps
+            return {"engine": out}
         # Best-effort lock: the scheduler holds eng._lock across
         # dispatch AND blocking readbacks (seconds under load), and
         # this runs as a sync method ON the replica event loop —
@@ -146,11 +203,26 @@ class LlamaDeployment:
             out["prefix_cache"] = eng.prefix_cache.stats()
         return {"engine": out}
 
+    def load_report(self) -> Optional[dict]:
+        """Compact load snapshot for the controller's replica table
+        (engine or pool-aggregate; None before the lazy engine
+        exists — an idle replica carries no load)."""
+        if not self.use_engine or self._engine is None:
+            return None
+        rpt = dict(self._engine.load_report())
+        # the digest is an intra-pool affinity signal, not something
+        # the deployment-level replica table needs to carry around
+        rpt.pop("prefix_digest", None)
+        return rpt
+
     def _request_args(self, payload):
-        """(prompt_ids, max_new_tokens, deadline_s): a request is a
-        plain token-id list, or a dict carrying per-request lifecycle
-        overrides ({"prompt_ids": [...], "max_new_tokens": n,
-        "deadline_s": s}) — what the HTTP proxy posts through."""
+        """(prompt_ids, max_new_tokens, deadline_s, session_id): a
+        request is a plain token-id list, or a dict carrying
+        per-request lifecycle/routing overrides ({"prompt_ids":
+        [...], "max_new_tokens": n, "deadline_s": s, "session_id":
+        "u123"}) — what the HTTP proxy posts through. session_id
+        drives engine-pool stickiness and is ignored by a single
+        engine."""
         if isinstance(payload, dict):
             prompt_ids = payload.get("prompt_ids",
                                      payload.get("prompt"))
@@ -160,16 +232,24 @@ class LlamaDeployment:
             mnt = int(payload.get("max_new_tokens",
                                   self.max_new_tokens))
             dl = payload.get("deadline_s", self.deadline_s)
+            sid = payload.get("session_id")
             return list(prompt_ids), mnt, (
-                float(dl) if dl is not None else None)
-        return list(payload), self.max_new_tokens, self.deadline_s
+                float(dl) if dl is not None else None), (
+                str(sid) if sid is not None else None)
+        return (list(payload), self.max_new_tokens, self.deadline_s,
+                None)
+
+    def _submit(self, ids, mnt, dl, sid=None):
+        kw: Dict[str, Any] = dict(max_new_tokens=mnt, deadline_s=dl)
+        if sid is not None and self.num_engine_replicas > 1:
+            kw["session_id"] = sid
+        return self.engine().submit(ids, **kw)
 
     def __call__(self, prompt_ids: List[int]) -> List[int]:
         """One request: token ids in, prompt+generated ids out."""
         if self.use_engine:
-            ids, mnt, dl = self._request_args(prompt_ids)
-            gen = self.engine().submit(
-                ids, max_new_tokens=mnt, deadline_s=dl).result()
+            ids, mnt, dl, sid = self._request_args(prompt_ids)
+            gen = self._submit(ids, mnt, dl, sid).result()
             return list(ids) + gen
         import jax.numpy as jnp
         from ray_tpu.models.llama import generate
@@ -185,9 +265,8 @@ class LlamaDeployment:
         generator in a StreamingResponse and the HTTP proxy in a
         chunked ndjson response)."""
         if self.use_engine:
-            ids, mnt, dl = self._request_args(prompt_ids)
-            h = self.engine().submit(ids, max_new_tokens=mnt,
-                                     deadline_s=dl)
+            ids, mnt, dl, sid = self._request_args(prompt_ids)
+            h = self._submit(ids, mnt, dl, sid)
             try:
                 yield from h.stream()
             except GeneratorExit:

@@ -72,13 +72,43 @@ def _metrics() -> dict:
     return _METRICS
 
 
+def _child_hash(parent_hash: int, chunk: Tuple[int, ...]) -> int:
+    """Rolling path hash: a node's hash commits to the full token path
+    root->node, not just its own chunk. ``hash`` over int tuples is
+    deterministic (ints hash to themselves; tuple combining does not
+    use PYTHONHASHSEED), so two trees that cached the same prefix
+    compute the same value."""
+    return hash((parent_hash, chunk))
+
+
+def path_hashes(tokens: Sequence[int], page_size: int) -> List[int]:
+    """The rolling path hashes a prompt WOULD occupy in a tree with
+    this ``page_size`` — one per full page chunk, in prefix order.
+
+    This is the routing-side mirror of the tree's per-node ``phash``:
+    an EnginePool hashes an incoming prompt once, then compares
+    against each replica's ``digest()`` set to find which replica
+    holds the longest cached prefix, without shipping token ids or
+    walking a remote tree."""
+    h = 0
+    out: List[int] = []
+    for i in range(0, (len(tokens) // page_size) * page_size,
+                   page_size):
+        h = _child_hash(h, tuple(int(t) for t in
+                                 tokens[i:i + page_size]))
+        out.append(h)
+    return out
+
+
 class _Node:
     """One radix-tree node = one full page of tokens = one physical
     page. ``chunk`` is the ``page_size``-tuple of token ids this edge
     spells; ``tick`` is the LRU stamp (monotonic counter, not wall
-    clock, so tests are deterministic)."""
+    clock, so tests are deterministic); ``phash`` is the rolling path
+    hash (see ``path_hashes``) used for pool prefix-affinity digests."""
 
-    __slots__ = ("chunk", "page", "parent", "children", "tick")
+    __slots__ = ("chunk", "page", "parent", "children", "tick",
+                 "phash")
 
     def __init__(self, chunk: Tuple[int, ...], page: int,
                  parent: "_Node", tick: int):
@@ -87,6 +117,8 @@ class _Node:
         self.parent = parent
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.tick = tick
+        self.phash = (_child_hash(parent.phash, chunk)
+                      if parent is not None else 0)
 
 
 class PrefixCache:
@@ -127,6 +159,14 @@ class PrefixCache:
     def evictable_pages(self) -> int:
         """Refcount-0 resident pages (the reclaimable pool)."""
         return sum(1 for p in self._nodes if self._ref.get(p, 0) == 0)
+
+    def digest(self) -> frozenset:
+        """Compact content fingerprint of the tree: the set of rolling
+        path hashes of every resident node. An EnginePool intersects a
+        prompt's ``path_hashes`` with this set to compute, per replica,
+        how many leading pages are already cached — the longest-prefix
+        affinity signal. O(nodes); no token ids leave the replica."""
+        return frozenset(n.phash for n in self._nodes.values())
 
     def _chunks(self, tokens: Sequence[int]):
         for i in range(0, (len(tokens) // self.Pg) * self.Pg, self.Pg):
@@ -281,6 +321,8 @@ class PrefixCache:
         for page, node in self._nodes.items():
             assert node.page == page, (node.page, page)
             assert node.parent.children.get(node.chunk) is node
+            assert node.phash == _child_hash(node.parent.phash,
+                                             node.chunk)
             assert self._ref.get(page, 0) >= 0
             assert page not in getattr(self.alloc, "_free_set", ()), \
                 f"cached page {page} is also on the free list"

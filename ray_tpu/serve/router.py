@@ -125,6 +125,9 @@ class DeploymentHandle:
         self._version = -1
         self._fetched_at = 0.0
         self._inflight: Dict[Any, int] = {}   # replica id -> count
+        self._loads: Dict[Any, dict] = {}     # replica id -> load
+                                              # snapshot (controller
+                                              # _poll_loads table)
         self._poll_count = 0        # controller RPCs (regression tests)
         self._push_active = False
         self._subscriber = None
@@ -190,6 +193,27 @@ class DeploymentHandle:
                 for mid in [m for m, r in mux.items() if r not in live]:
                     del mux[mid]
         self._max_ongoing = info["max_ongoing"]
+        # Load snapshots ride the polling path only (pushes stay
+        # scale-event-driven), so a push payload without them must
+        # not wipe the last-known table.
+        if "loads" in info:
+            self._loads = info["loads"] or {}
+
+    def replica_loads(self) -> Dict[Any, dict]:
+        """Last-known per-replica load snapshots (engine/pool
+        ``load_report`` via the controller's table)."""
+        with self._lock:
+            return dict(self._loads)
+
+    def _load_key(self, i: int):
+        """Routing tie-break from the load table: queue pressure
+        first, outstanding token work second. Missing snapshot ==
+        zero — absence of evidence must not repel traffic."""
+        rpt = self._loads.get(self._replica_ids[i])
+        if not rpt:
+            return (0, 0)
+        return (rpt.get("queue_depth", 0),
+                rpt.get("outstanding_tokens", 0))
 
     def _refresh(self, force: bool = False):
         with self._lock:
@@ -239,7 +263,16 @@ class DeploymentHandle:
                     idx = candidates[0]
                 else:
                     a, b = random.sample(candidates, 2)
-                    idx = a if cnt(a) <= cnt(b) else b
+                    if cnt(a) != cnt(b):
+                        idx = a if cnt(a) < cnt(b) else b
+                    else:
+                        # equal in-flight: break the tie on the
+                        # controller's load table (engine queue
+                        # depth / outstanding tokens), so a replica
+                        # whose ENGINE is backed up stops looking
+                        # identical to an idle one
+                        idx = a if (self._load_key(a)
+                                    <= self._load_key(b)) else b
                 if model_id:
                     self._mux_affinity[model_id] = \
                         self._replica_ids[idx]

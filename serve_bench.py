@@ -56,6 +56,7 @@ Usage: python serve_bench.py [--model 7b|1b|tiny] [--ab] [--out FILE]
 (7b needs ~14GB HBM; falls back to 1b automatically on OOM.)
 """
 import argparse
+import itertools
 import json
 import statistics
 import subprocess
@@ -77,17 +78,20 @@ def git_sha():
         return "unknown"
 
 
-def build_configs(name):
+def build_configs(name, max_seq_len=None):
     import jax.numpy as jnp
     from ray_tpu.models.llama import LlamaConfig
     if name == "7b":
         return "llama2-7b-bf16", LlamaConfig(
-            max_seq_len=256, param_dtype=jnp.bfloat16)
+            max_seq_len=max_seq_len or 256, param_dtype=jnp.bfloat16)
     if name == "1b":
         return "llama-1.1b-bf16", LlamaConfig(
-            max_seq_len=256, dim=2048, n_layers=22, n_heads=16,
-            n_kv_heads=16, hidden_dim=5632, param_dtype=jnp.bfloat16)
+            max_seq_len=max_seq_len or 256, dim=2048, n_layers=22,
+            n_heads=16, n_kv_heads=16, hidden_dim=5632,
+            param_dtype=jnp.bfloat16)
     from ray_tpu.models.llama import llama_tiny
+    if max_seq_len:
+        return "llama-tiny", llama_tiny(max_seq_len=max_seq_len)
     return "llama-tiny", llama_tiny()
 
 
@@ -163,7 +167,10 @@ def make_server(cfg, knobs, use_engine=True):
                 prefix_cache=knobs["prefix_cache"],
                 spec_len=knobs["spec_len"],
                 spec_ngram=knobs["spec_ngram"],
-                max_queued=knobs.get("max_queued"))
+                max_queued=knobs.get("max_queued"),
+                n_pages=knobs.get("kv_pages"),
+                eos_id=knobs.get("eos_id"),
+                num_engine_replicas=knobs.get("replicas", 1))
 
         def __call__(self, prompt):
             # joins the engine's decode batch at the next chunk
@@ -193,6 +200,27 @@ def make_server(cfg, knobs, use_engine=True):
             # (engine.py lifecycle_stats) for the artifact
             return self.inner.engine().lifecycle_stats()
 
+        def engine_pool_stats(self):
+            # routing counters + per-replica states when the engine is
+            # an EnginePool (num_engine_replicas > 1); None otherwise
+            eng = self.inner.engine()
+            return (eng.pool_stats()
+                    if hasattr(eng, "pool_stats") else None)
+
+        def warmup(self, prompt):
+            # Pool-aware warmup: every replica compiles its jitted
+            # step and caches the shared prefix BEFORE the measured
+            # window. Routed warmup would affinity-pin to one replica,
+            # leaving the others to compile mid-measurement.
+            eng = self.inner.engine()
+            if hasattr(eng, "engines"):
+                for e in eng.engines():
+                    e.submit(list(prompt),
+                             max_new_tokens=gen_tokens).result()
+            else:
+                self.inner(prompt)
+            return True
+
         def probe(self, payload):
             # dict payload path: per-request deadline_s / max_new
             # overrides ride through LlamaDeployment._request_args
@@ -203,9 +231,8 @@ def make_server(cfg, knobs, use_engine=True):
             # run for after_s, then cancel — the deterministic stand-in
             # for a client disconnect. Returns the outcome class name
             # so the bench can count cancels vs. races with completion.
-            ids, mnt, dl = self.inner._request_args(payload)
-            h = self.inner.engine().submit(
-                ids, max_new_tokens=mnt, deadline_s=dl)
+            ids, mnt, dl, sid = self.inner._request_args(payload)
+            h = self.inner._submit(ids, mnt, dl, sid)
             time.sleep(after_s)
             h.cancel()
             try:
@@ -233,8 +260,35 @@ def bench(handle, rng, cfg, knobs):
               if shared > 0 else [])
 
     period = knobs["prompt_period"]
+    # Multi-session load shape (--prompt-pool W): requests draw from
+    # W fixed distinct prompts (W "sessions", each re-asking with its
+    # own long context) instead of a fresh random tail per request.
+    # Reuse is what the radix cache — and the pool's prefix-affinity
+    # sharding of it — exists for; the pool comes from its own fixed
+    # seed so every arm of an A/B sees the identical session set.
+    pool_n = knobs.get("prompt_pool") or 0
+    pool_order = knobs.get("prompt_order") or "random"
+    session_prompts = []
+    if pool_n > 0:
+        prng = np.random.RandomState(54321)
+        for _ in range(pool_n):
+            tail = prng.randint(1, cfg.vocab_size - 1,
+                                size=plen - len(prefix)).tolist()
+            session_prompts.append(prefix + tail)
+    session_seq = itertools.count()
 
     def prompt():
+        if session_prompts:
+            if pool_order == "cyclic":
+                # round-robin over the sessions (a fixed agent set
+                # taking turns): each context is re-asked only after
+                # every other one — the adversarial pattern for one
+                # LRU cache, the natural one for an affinity-sharded
+                # fleet where each session has a home replica
+                k = next(session_seq) % len(session_prompts)
+            else:
+                k = int(rng.randint(len(session_prompts)))
+            return list(session_prompts[k])
         n_tail = plen - len(prefix)
         if period > 0:
             # repetitive-suffix load shape (extraction / code-edit /
@@ -251,7 +305,11 @@ def bench(handle, rng, cfg, knobs):
 
     # --- warmup / compile (one batched decode + one stream step) ----
     t0 = time.time()
-    ray_tpu.get(handle.remote(prompt()), timeout=3600)
+    if knobs.get("replicas", 1) > 1:
+        # per-replica warmup: compile + prefix-seed EVERY replica
+        ray_tpu.get(handle.warmup.remote(prompt()), timeout=3600)
+    else:
+        ray_tpu.get(handle.remote(prompt()), timeout=3600)
     compile_s = time.time() - t0
     print(f"warmup+compile: {compile_s:.1f}s", flush=True)
 
@@ -326,6 +384,10 @@ def bench(handle, rng, cfg, knobs):
             statistics.mean(eng_ttfts) * 1000, 2)
     if shared > 0:
         out["shared_prefix_len"] = shared
+    if pool_n > 0:
+        out["prompt_pool"] = pool_n
+        out["prompt_order"] = pool_order
+    out["max_seq_len"] = cfg.max_seq_len
     return out
 
 
@@ -340,7 +402,8 @@ def run_path(args, knobs, use_engine):
              "tiny": ["tiny"]}[args.model]
     result = None
     for name in order:
-        label, cfg = build_configs(name)
+        label, cfg = build_configs(name,
+                                   knobs.get("max_seq_len"))
         path = "engine" if use_engine else "legacy_decode_to_completion"
         print(f"model: {label} path: {path}", flush=True)
         try:
@@ -364,6 +427,8 @@ def run_path(args, knobs, use_engine):
         result["prefill_chunk"] = knobs["prefill_chunk"]
         result["page_size"] = knobs["page_size"]
         result["prefix_cache_enabled"] = knobs["prefix_cache"]
+        if knobs.get("kv_pages") is not None:
+            result["kv_pages_per_replica"] = knobs["kv_pages"]
         # (legacy path: engine_stats would lazily build an unused
         # engine — allocating the whole KV pool — just to report zeros)
         try:
@@ -376,6 +441,15 @@ def run_path(args, knobs, use_engine):
                 handle.engine_lifecycle_stats.remote(), timeout=60)
         except Exception:
             pass
+        if knobs.get("replicas", 1) > 1:
+            result["num_engine_replicas"] = knobs["replicas"]
+            try:
+                ps = ray_tpu.get(handle.engine_pool_stats.remote(),
+                                 timeout=60)
+                if ps:
+                    result["pool"] = ps
+            except Exception:
+                pass
         if knobs["prefix_cache"]:
             try:
                 ps = ray_tpu.get(handle.engine_prefix_stats.remote(),
@@ -421,7 +495,8 @@ def run_lifecycle(args, knobs):
     from ray_tpu import serve
     from ray_tpu.serve.errors import classify_http_status
 
-    label, cfg = build_configs(args.model)
+    label, cfg = build_configs(args.model,
+                               knobs.get("max_seq_len"))
     gen_tokens = knobs["gen_tokens"]
     plen = min(knobs["prompt_len"], cfg.max_seq_len - gen_tokens)
     slots = knobs["slots"]
@@ -591,6 +666,92 @@ def run_lifecycle(args, knobs):
     return result
 
 
+def run_pool_kill():
+    """Replica-kill recovery run for the pool artifact: a 2-replica
+    EnginePool built DIRECTLY (no serve hop — the kill round must be
+    deterministic), FaultInjector kills replica 0 mid-decode.
+
+    Contract being measured (ISSUE acceptance: zero lost requests):
+    - requests that had not streamed a token resubmit to the survivor
+      and complete TOKEN-IDENTICALLY to the single-engine reference;
+    - requests that had already streamed fail TYPED (EngineShutdown);
+    - nothing hangs and nothing is silently dropped (lost == 0);
+    - every survivor quiesces with zero leaked pages.
+
+    Always runs the tiny model: this phase checks recovery accounting,
+    not throughput, and must stay cheap on CPU."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.llama import Llama, generate, llama_tiny
+    from ray_tpu.serve.engine import LLMEngine
+    from ray_tpu.serve.engine_pool import EnginePool
+    from ray_tpu.serve.errors import EngineShutdown
+    from ray_tpu.serve.faults import FaultInjector, check_pool_quiesced
+
+    cfg = llama_tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+    inj = FaultInjector()
+    inj.kill_replica(round=6)
+
+    def factory(idx):
+        # injector only on replica 0's first generation: the death is
+        # injected once, the survivor stays clean
+        return LLMEngine(model, params, max_slots=2, page_size=16,
+                         n_pages=64, chunk=2, prefill_chunk=16,
+                         temperature=0.0, eos_id=-1, seed=idx,
+                         fault_injector=inj if idx == 0 else None)
+
+    n_req, n_new = 8, 20
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, cfg.vocab_size - 1, size=12).tolist()
+               for _ in range(n_req)]
+    want = [np.asarray(generate(
+        model, params, jnp.asarray([p], jnp.int32),
+        max_new_tokens=n_new, temperature=0.0))[0, len(p):].tolist()
+        for p in prompts]
+
+    pool = EnginePool(factory, 2)
+    outcomes = [None] * n_req
+
+    def consume(i):
+        try:
+            outcomes[i] = ("ok", pool.submit(
+                prompts[i], max_new_tokens=n_new).result())
+        except EngineShutdown:
+            outcomes[i] = ("failed_typed", None)
+        except Exception as e:   # noqa: BLE001 — accounted as lost
+            outcomes[i] = ("lost", type(e).__name__)
+
+    threads = [threading.Thread(target=consume, args=(i,))
+               for i in range(n_req)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    hung = sum(t.is_alive() for t in threads)
+    completed = sum(1 for o in outcomes
+                    if o is not None and o[0] == "ok")
+    failed_typed = sum(1 for o in outcomes
+                       if o is not None and o[0] == "failed_typed")
+    identical = all(o[1] == want[i]
+                    for i, o in enumerate(outcomes)
+                    if o is not None and o[0] == "ok")
+    rs = dict(pool.pool_stats())
+    pool.shutdown()
+    check_pool_quiesced(pool)
+    return {
+        "requests": n_req,
+        "completed": completed,
+        "failed_typed": failed_typed,
+        "resubmitted": int(rs.get("requeues", 0)),
+        "replica_deaths": int(rs.get("replica_deaths", 0)),
+        "token_identical": bool(identical),
+        "lost": n_req - completed - failed_typed + hung,
+    }
+
+
 def _ratio(a, b):
     return round(a / b, 2) if b else None
 
@@ -637,6 +798,46 @@ def main():
                     help="cycle each prompt's tail with this period "
                          "(repetitive-suffix load shape speculation "
                          "targets; 0 = fully random tails)")
+    ap.add_argument("--prompt-pool", type=int, default=0,
+                    help="multi-session load shape: draw every "
+                         "request from this many FIXED distinct "
+                         "prompts (sessions re-asking with their own "
+                         "long context). Sized past one replica's "
+                         "radix-cache capacity but under the pool "
+                         "aggregate, it is the regime prefix-affinity "
+                         "routing exists for (0 = fresh random tails)")
+    ap.add_argument("--prompt-order", default="random",
+                    choices=["random", "cyclic"],
+                    help="session selection order under --prompt-pool:"
+                         " random draws, or cyclic round-robin (each "
+                         "session re-asks only after every other one "
+                         "— LRU-adversarial for a single cache, "
+                         "natural for affinity-sharded replicas)")
+    ap.add_argument("--eos-id", type=int, default=None,
+                    help="engine eos token id (eos-BOUNDED decode "
+                         "scheduling, the realistic serving mode: "
+                         "chunked decode rounds with per-round "
+                         "drains instead of the no-eos deferred "
+                         "run-ahead; -1 = eos configured but never "
+                         "sampled)")
+    ap.add_argument("--max-seq-len", type=int, default=None,
+                    help="override the model config's max_seq_len "
+                         "(tiny defaults to 128; longer contexts "
+                         "raise the per-miss re-prefill cost the "
+                         "prefix cache / pool affinity removes)")
+    ap.add_argument("--kv-pages", type=int, default=None,
+                    help="PER-REPLICA KV pool size in pages (default: "
+                         "full residency for max_slots). Sizing this "
+                         "below slots*seq_len makes the paged pool the "
+                         "bottleneck: chunk-budget admission "
+                         "overcommits, preemption recomputes — the "
+                         "regime where a replica pool's AGGREGATE KV "
+                         "(N replicas = N pools) is what scales")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="engine replicas behind one deployment "
+                         "(EnginePool). With --ab runs pool-vs-single "
+                         "A/B on the same load and adds a replica-kill "
+                         "recovery phase to the artifact")
     ap.add_argument("--lifecycle", action="store_true",
                     help="request-lifecycle smoke: unsaturated pass "
                          "then an overload burst against --max-queued "
@@ -657,7 +858,11 @@ def main():
                  shared_prefix_len=args.shared_prefix_len,
                  prefix_cache=prefix_cache,
                  spec_len=args.spec_len, spec_ngram=args.spec_ngram,
-                 prompt_period=args.prompt_period)
+                 prompt_period=args.prompt_period,
+                 prompt_pool=args.prompt_pool,
+                 prompt_order=args.prompt_order,
+                 replicas=args.replicas, kv_pages=args.kv_pages,
+                 eos_id=args.eos_id, max_seq_len=args.max_seq_len)
 
     import os
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -672,6 +877,46 @@ def main():
         result = run_lifecycle(args, knobs)
         result["git_sha"] = git_sha()
         out = args.out or "SERVE_BENCH_lifecycle_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result))
+        ray_tpu.shutdown()
+        return
+
+    if args.ab and args.replicas > 1:
+        # Pool-vs-single A/B: SAME engine path and load shape, the
+        # only delta is num_engine_replicas — so pool_throughput_ratio
+        # isolates what data parallelism adds (and what routing
+        # costs). Plus an in-process replica-kill recovery phase.
+        pool = run_path(args, knobs, use_engine=True)
+        single = run_path(args, dict(knobs, replicas=1),
+                          use_engine=True)
+        pstats = pool.get("pool") or {}
+        result = {
+            "engine_pool": pool,
+            "engine_single": single,
+            "replicas": args.replicas,
+            "pool_throughput_ratio": _ratio(
+                pool["throughput_tok_s"], single["throughput_tok_s"]),
+            "affinity_hit_rate": pstats.get("affinity_hit_rate"),
+            "spill_rate": pstats.get("spill_rate"),
+            "single_prefix_hit_rate": (single.get("prefix_cache")
+                                       or {}).get("hit_rate"),
+            "notes": "Same-session pool-vs-single A/B (serve_bench.py "
+                     "--ab --replicas N): one deployment backed by an "
+                     "EnginePool of N engine replicas with "
+                     "prefix-affinity + P2C routing vs the identical "
+                     "single-engine deployment, same shared-prefix "
+                     "load. replica_kill is an in-process "
+                     "FaultInjector run: replica 0 dies mid-decode; "
+                     "unstarted requests resubmit to the survivor "
+                     "token-identically, partially-streamed ones fail "
+                     "typed EngineShutdown, lost must be 0.",
+        }
+        print("replica-kill recovery phase", flush=True)
+        result["replica_kill"] = run_pool_kill()
+        out = args.out or "SERVE_BENCH_pool_cpu_smoke.json"
+        result["git_sha"] = git_sha()
         with open(out, "w") as f:
             json.dump(result, f, indent=1)
         print(json.dumps(result))

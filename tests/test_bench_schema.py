@@ -267,3 +267,75 @@ def test_unreadable_json_is_a_problem(tmp_path):
     problems = []
     cbs.check_file(str(p), problems)
     assert problems and "unreadable" in problems[0]
+
+_POOL = {"routed": 64, "affinity_hits": 50, "affinity_hit_rate": 0.78,
+         "spill_rate": 0.05, "n_replicas": 2,
+         "replicas": [{"idx": 0, "state": "healthy", "deaths": 0,
+                       "generation": 0},
+                      {"idx": 1, "state": "healthy", "deaths": 0,
+                       "generation": 0}]}
+_KILL = {"requests": 8, "completed": 6, "failed_typed": 2,
+         "resubmitted": 5, "replica_deaths": 1,
+         "token_identical": True, "lost": 0}
+
+
+def _pool_ab():
+    res = {"throughput_tok_s": 1.0, "p50_ms": 2.0, "p99_ms": 3.0,
+           "ttft_ms": 4.0, "stream_tok_s": 5.0}
+    return {"engine_pool": dict(res, pool=json.loads(
+                json.dumps(_POOL))),
+            "engine_single": dict(res),
+            "replicas": 2, "pool_throughput_ratio": 1.6,
+            "affinity_hit_rate": 0.78, "spill_rate": 0.05,
+            "replica_kill": dict(_KILL), "git_sha": "abc1234"}
+
+
+def test_pool_ab_artifact_validates(tmp_path):
+    assert _problems_for("SERVE_BENCH_pool_cpu_smoke.json",
+                         _pool_ab(), tmp_path) == []
+
+
+def test_pool_ab_requires_sections_ratios_and_stats(tmp_path):
+    for missing in ("engine_single", "pool_throughput_ratio",
+                    "affinity_hit_rate", "spill_rate",
+                    "replica_kill"):
+        bad = {k: v for k, v in _pool_ab().items() if k != missing}
+        probs = _problems_for("SERVE_BENCH_pool_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any(missing in p for p in probs), missing
+    # the pool section must carry its routing-stats block
+    no_stats = _pool_ab()
+    del no_stats["engine_pool"]["pool"]
+    probs = _problems_for("SERVE_BENCH_pool_cpu_smoke.json",
+                          no_stats, tmp_path)
+    assert any("no pool routing-stats" in p for p in probs)
+    # ... with a non-empty replicas list
+    no_reps = _pool_ab()
+    no_reps["engine_pool"]["pool"]["replicas"] = []
+    probs = _problems_for("SERVE_BENCH_pool_cpu_smoke.json",
+                          no_reps, tmp_path)
+    assert any("non-empty list" in p for p in probs)
+    # a one-replica "pool A/B" is not an A/B
+    one = dict(_pool_ab(), replicas=1)
+    probs = _problems_for("SERVE_BENCH_pool_cpu_smoke.json",
+                          one, tmp_path)
+    assert any("int >= 2" in p for p in probs)
+
+
+def test_pool_ab_kill_run_must_lose_nothing(tmp_path):
+    lossy = _pool_ab()
+    lossy["replica_kill"]["lost"] = 1
+    probs = _problems_for("SERVE_BENCH_pool_cpu_smoke.json",
+                          lossy, tmp_path)
+    assert any("failover must lose none" in p for p in probs)
+    mangled = _pool_ab()
+    mangled["replica_kill"]["token_identical"] = False
+    probs = _problems_for("SERVE_BENCH_pool_cpu_smoke.json",
+                          mangled, tmp_path)
+    assert any("not token-identical" in p for p in probs)
+    # a kill run that killed nothing proves nothing
+    no_kill = _pool_ab()
+    no_kill["replica_kill"]["replica_deaths"] = 0
+    probs = _problems_for("SERVE_BENCH_pool_cpu_smoke.json",
+                          no_kill, tmp_path)
+    assert any("killed no replica" in p for p in probs)

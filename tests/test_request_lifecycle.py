@@ -642,7 +642,9 @@ def test_proxy_error_response_contract():
     r = HTTPProxy._error_response(
         EngineOverloaded("queue full", retry_after_s=2.4))
     assert r.status == 429
-    assert r.headers["Retry-After"] == "2"
+    # Ceiling, not round: the header must never invite a client
+    # back before the hint says capacity could exist (2.4s -> "3").
+    assert r.headers["Retry-After"] == "3"
     body = json.loads(r.text)
     assert body["type"] == "EngineOverloaded"
     assert body["error"] == "queue full"

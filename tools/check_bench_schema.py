@@ -22,6 +22,11 @@ family:
   overload burst must have MEASURED shedding (shed > 0 both
   client-side and in the engine counters), else the artifact proves
   nothing about bounded admission
+- SERVE_BENCH pool A/B (serve_bench.py --ab --replicas N):
+  {engine_pool: result+pool block, engine_single: result, replicas,
+  pool_throughput_ratio, affinity_hit_rate, spill_rate,
+  replica_kill} — the kill run must have lost == 0 and
+  token_identical true (failover may fail typed, never drop)
 
 Engine serve results may also carry a `lifecycle` block
 (engine.lifecycle_stats()): retry-policy knobs
@@ -73,6 +78,30 @@ SPEC_REQUIRED = {
     "rejected_tokens": NUM,
     "accept_rate": NUM,
     "tokens_per_dispatch": NUM,
+}
+
+# pool A/B artifacts carry this block (engine_pool.py pool_stats()):
+# routing counters + derived rates. The replicas list is validated
+# separately (per-replica state rows).
+POOL_STATS_REQUIRED = {
+    "routed": NUM,
+    "affinity_hits": NUM,
+    "affinity_hit_rate": NUM,
+    "spill_rate": NUM,
+    "n_replicas": int,
+}
+
+# pool A/B artifacts carry this block (serve_bench.py run_pool_kill):
+# an in-process replica-kill recovery run. lost MUST be zero — a
+# nonzero count means a request hung or silently vanished when its
+# replica died, which is exactly what the pool exists to prevent.
+REPLICA_KILL_REQUIRED = {
+    "requests": int,
+    "completed": int,
+    "failed_typed": int,
+    "resubmitted": NUM,
+    "replica_deaths": NUM,
+    "lost": int,
 }
 
 # engine serve results carry this block (engine.py lifecycle_stats):
@@ -203,10 +232,79 @@ def check_lifecycle_smoke(obj, name, problems):
                                 "in a lifecycle-smoke artifact")
 
 
+def check_pool_ab(obj, name, problems):
+    """serve_bench.py --ab --replicas N artifact: pool-vs-single A/B
+    (both full engine serve results), pool routing rates, and a
+    replica-kill recovery run. The kill run must have lost == 0 and
+    token_identical == true — anything else means the pool dropped or
+    corrupted a request during failover and the artifact documents a
+    regression, not a feature."""
+    pool = obj.get("engine_pool")
+    single = obj.get("engine_single")
+    if not isinstance(pool, dict):
+        problems.append(f"{name}: engine_pool must be an object")
+    else:
+        _check_serve_result(pool, f"{name}:engine_pool", problems)
+        ps = pool.get("pool")
+        if not isinstance(ps, dict):
+            problems.append(f"{name}: engine_pool carries no pool "
+                            "routing-stats block")
+        else:
+            _check_fields(ps, POOL_STATS_REQUIRED,
+                          f"{name}:engine_pool:pool", problems)
+            reps = ps.get("replicas")
+            if not isinstance(reps, list) or not reps:
+                problems.append(f"{name}:engine_pool:pool: replicas "
+                                "must be a non-empty list")
+    if not isinstance(single, dict):
+        problems.append(f"{name}: pool A/B artifact missing "
+                        "engine_single object")
+    else:
+        _check_serve_result(single, f"{name}:engine_single", problems)
+    for key in ("pool_throughput_ratio", "affinity_hit_rate",
+                "spill_rate"):
+        v = obj.get(key)
+        if not isinstance(v, NUM) or isinstance(v, bool):
+            problems.append(f"{name}: pool A/B artifact missing "
+                            f"numeric {key}")
+    reps = obj.get("replicas")
+    if not isinstance(reps, int) or isinstance(reps, bool) \
+            or reps < 2:
+        problems.append(f"{name}: replicas must be an int >= 2 "
+                        "(a pool A/B with one replica is not an A/B)")
+    kill = obj.get("replica_kill")
+    if not isinstance(kill, dict):
+        problems.append(f"{name}: pool A/B artifact missing the "
+                        "replica_kill recovery block")
+    else:
+        _check_fields(kill, REPLICA_KILL_REQUIRED,
+                      f"{name}:replica_kill", problems)
+        lost = kill.get("lost")
+        if isinstance(lost, int) and not isinstance(lost, bool) \
+                and lost != 0:
+            problems.append(f"{name}: replica_kill lost {lost} "
+                            "request(s) — failover must lose none")
+        if kill.get("token_identical") is not True:
+            problems.append(f"{name}: replica_kill resubmissions "
+                            "were not token-identical")
+        deaths = kill.get("replica_deaths")
+        if isinstance(deaths, NUM) and not isinstance(deaths, bool) \
+                and deaths <= 0:
+            problems.append(f"{name}: replica_kill run killed no "
+                            "replica (replica_deaths == 0)")
+
+
 def check_serve_bench(obj, name, problems):
     if "unsaturated" in obj or "overloaded" in obj:
         # lifecycle smoke family (serve_bench.py --lifecycle)
         check_lifecycle_smoke(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
+    if "engine_pool" in obj:
+        # pool A/B family (serve_bench.py --ab --replicas N)
+        check_pool_ab(obj, name, problems)
         sha = obj.get("git_sha")
         if sha is not None and not isinstance(sha, str):
             problems.append(f"{name}: git_sha must be a string")
