@@ -195,6 +195,12 @@ class SimulatedTPUCloud:
         self._qrs: Dict[str, Dict[str, Any]] = {}
         self._subnet = 0     # monotonic: deleted slices never reuse IPs
 
+    @property
+    def provision_delay_s(self) -> float:
+        """The modeled slice spin-up time (capacity providers use it
+        to compute honest remaining-ETA hints)."""
+        return self._delay
+
     def create_queued_resource(self, name: str, accelerator_type: str
                                ) -> Dict[str, Any]:
         if accelerator_type not in TPU_TOPOLOGIES:
@@ -310,6 +316,125 @@ class TPUPodProvider(NodeProvider):
         list: host 0 is the jax.distributed coordinator)."""
         q = self.cloud.describe(node_id)
         return list(q["node_ips"]) if q else []
+
+
+# ---------------------------------------------------------------------------
+# Replica-capacity providers (serve-pool autoscaler seam)
+# ---------------------------------------------------------------------------
+
+
+class CapacityUnavailable(RuntimeError):
+    """The provider cannot grant more capacity right now (stockout /
+    configured ceiling). The autoscaler records the denial and keeps
+    serving at the current size."""
+
+
+class ReplicaCapacityProvider:
+    """Capacity seam between the serve-pool autoscaler
+    (``serve/pool_autoscaler.py``) and whatever actually holds chips.
+
+    The autoscaler never builds a replica out of thin air: it
+    ``request()``s capacity, polls ``ready()`` on the returned ticket
+    (provisioning a TPU slice takes real minutes; the delay is part
+    of the control problem, not an implementation detail), builds the
+    replica only once the ticket is ready, and ``release()``s the
+    ticket when the replica is later retired. ``eta_s`` is the honest
+    remaining-provisioning estimate the pool folds into all-shed
+    Retry-After hints so clients are never invited back before
+    capacity exists.
+    """
+
+    def request(self) -> str:
+        """Ask for capacity for ONE replica. Returns an opaque
+        ticket. Raises ``CapacityUnavailable`` on a hard denial."""
+        raise NotImplementedError
+
+    def ready(self, ticket: str) -> bool:
+        """True when the ticket's capacity is provisioned."""
+        raise NotImplementedError
+
+    def eta_s(self, ticket: str) -> float:
+        """Remaining provisioning time estimate, seconds (0 when
+        ready; best-effort floor when the backend is stalled)."""
+        return 0.0
+
+    def release(self, ticket: str) -> None:
+        """Return the ticket's capacity (scale-down / abandoned
+        request). Idempotent."""
+
+
+class ImmediateCapacityProvider(ReplicaCapacityProvider):
+    """Capacity that already exists (spare chips on the host, or unit
+    tests): every request is granted and instantly ready, up to an
+    optional ceiling of simultaneously-granted tickets."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self._granted: set = set()
+        self._n = 0
+
+    def request(self) -> str:
+        with self._lock:
+            if (self._capacity is not None
+                    and len(self._granted) >= self._capacity):
+                raise CapacityUnavailable(
+                    f"capacity ceiling {self._capacity} reached")
+            self._n += 1
+            ticket = f"immediate-{self._n}"
+            self._granted.add(ticket)
+            return ticket
+
+    def ready(self, ticket: str) -> bool:
+        return True
+
+    def release(self, ticket: str) -> None:
+        with self._lock:
+            self._granted.discard(ticket)
+
+
+class TPUSliceCapacityProvider(ReplicaCapacityProvider):
+    """One replica == one TPU slice, provisioned through the
+    queued-resource lifecycle (``SimulatedTPUCloud`` in CI; a real
+    backend implements the same four methods against the Cloud TPU
+    API). A ticket is the queued-resource name; ``ready`` polls its
+    state to READY, and ``release`` deletes the slice."""
+
+    def __init__(self, cloud: Optional[SimulatedTPUCloud] = None,
+                 accelerator_type: str = "v5e-1",
+                 name_prefix: str = "pool"):
+        if accelerator_type not in TPU_TOPOLOGIES:
+            raise ValueError(
+                f"unknown accelerator_type {accelerator_type!r}")
+        self.cloud = cloud or SimulatedTPUCloud()
+        self.accelerator_type = accelerator_type
+        self._prefix = name_prefix
+
+    def request(self) -> str:
+        name = (f"{self._prefix}-{self.accelerator_type}-"
+                f"{uuid.uuid4().hex[:6]}")
+        self.cloud.create_queued_resource(name, self.accelerator_type)
+        return name
+
+    def ready(self, ticket: str) -> bool:
+        q = self.cloud.describe(ticket)
+        return bool(q and q["state"] == QR_READY)
+
+    def eta_s(self, ticket: str) -> float:
+        q = self.cloud.describe(ticket)
+        if q is None:
+            return 0.0
+        if q["state"] == QR_READY:
+            return 0.0
+        delay = getattr(self.cloud, "provision_delay_s", 0.0)
+        remaining = q["create_time"] + delay - time.time()
+        # past the modeled delay but still not READY = stockout; keep
+        # a non-zero floor so Retry-After never promises capacity the
+        # cloud hasn't granted
+        return max(remaining, 0.5)
+
+    def release(self, ticket: str) -> None:
+        self.cloud.delete_queued_resource(ticket)
 
 
 def tpu_node_types(*accelerator_types: str,

@@ -409,6 +409,11 @@ class LLMEngine:
         # submit->first-emission latencies (seconds), most recent
         self.ttfts_s: "collections.deque" = \
             collections.deque(maxlen=4096)
+        # exponentially-weighted TTFT (None until the first token is
+        # emitted): the autoscaler's SLO signal — a windowed mean
+        # would hide a fresh latency regression behind old samples
+        self._ttft_ewma: Optional[float] = None
+        self._ttft_ewma_alpha = 0.2
         self._decode_fn = self._build_decode()
         self._seed_fn = self._build_seed()
 
@@ -488,6 +493,16 @@ class LLMEngine:
     def draining(self) -> bool:
         return self._draining
 
+    def reset_latency_stats(self) -> None:
+        """Forget TTFT samples and the EWMA accumulated so far.
+        For warmup scrubbing: a deployment compiles a replica with a
+        throwaway request before it joins the fleet, and that
+        compile-priced TTFT is not client experience — left in the
+        EWMA it reads as a permanent SLO breach to the autoscaler."""
+        with self._lock:
+            self.ttfts_s.clear()
+            self._ttft_ewma = None
+
     def is_idle(self) -> bool:
         """True when no request is queued, slotted, or trailing in a
         readback — the state a draining replica must reach before it
@@ -536,11 +551,14 @@ class LLMEngine:
                 outstanding += len(req.prompt) + req.max_new_tokens
             return {
                 "free_slots": free_slots,
+                "total_slots": len(self.slots),
                 "free_pages": self.alloc.n_free,
                 "queue_depth": len(waiting),
                 "outstanding_tokens": outstanding,
                 "max_queued": self.max_queued,
                 "shed_retry_after_s": self.shed_retry_after_s,
+                "shed_total": self.stats.get("shed", 0),
+                "ttft_ewma_s": self._ttft_ewma,
                 "draining": self._draining,
                 "stopped": self._stopped,
                 "prefix_digest": (self.prefix_cache.digest()
@@ -557,11 +575,14 @@ class LLMEngine:
                 return compute()
             except RuntimeError:     # dict/deque mutated mid-iteration
                 continue
-        return {"free_slots": 0, "free_pages": self.alloc.n_free,
+        return {"free_slots": 0, "total_slots": len(self.slots),
+                "free_pages": self.alloc.n_free,
                 "queue_depth": len(self._wait),
                 "outstanding_tokens": 0,
                 "max_queued": self.max_queued,
                 "shed_retry_after_s": self.shed_retry_after_s,
+                "shed_total": self.stats.get("shed", 0),
+                "ttft_ewma_s": self._ttft_ewma,
                 "draining": self._draining,
                 "stopped": self._stopped,
                 "prefix_digest": frozenset()}
@@ -1552,7 +1573,11 @@ class LLMEngine:
                 # the request stream — not when a later decode chunk
                 # drains (the accounting bug the r05 bench carried)
                 req.t_first = time.monotonic()
-                self.ttfts_s.append(req.t_first - req.t_submit)
+                ttft = req.t_first - req.t_submit
+                self.ttfts_s.append(ttft)
+                a = self._ttft_ewma_alpha
+                self._ttft_ewma = ttft if self._ttft_ewma is None \
+                    else a * ttft + (1 - a) * self._ttft_ewma
             req.generated.append(t)
             req.out_q.put(t)
             if ((self.eos_id is not None and t == self.eos_id)

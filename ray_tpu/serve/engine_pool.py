@@ -64,7 +64,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
                                   EngineOverloaded, EngineShutdown,
-                                  RequestCancelled, RequestError)
+                                  PoolDegraded, RequestCancelled,
+                                  RequestError)
 from ray_tpu.serve.prefix_cache import path_hashes
 
 ROUTED = "serve_pool_routed_total"
@@ -127,6 +128,15 @@ def _metrics() -> dict:
 HEALTHY = "healthy"
 DRAINING = "draining"
 DEAD = "dead"
+# Scale-down tombstone: the replica was drained and shut down ON
+# PURPOSE and will not be rebuilt; its slot index may be reused by a
+# later scale-up. Kept in the table so pool-wide quiescence checks
+# still cover its engine.
+RETIRED = "retired"
+# Crash-loop terminal state: the replica died ``max_restarts`` times
+# and the pool stopped rebuilding it. Routing skips it; a human (or
+# ``restart_dead()``) has to intervene.
+DEGRADED = "degraded"
 
 
 class _Replica:
@@ -309,6 +319,14 @@ class EnginePool:
     max_resubmits: per-request cap on death-triggered resubmissions
         (default ``num_replicas``): a request that outlives that many
         replicas fails typed instead of looping.
+    restart_backoff_s / restart_backoff_max_s: exponential backoff
+        between auto-restarts of a dying replica (base doubles per
+        death, capped). Without it a crash-looping factory rebuilds
+        hot in a tight loop.
+    max_restarts: per-replica death cap; once exceeded the replica
+        parks in ``DEGRADED`` instead of rebuilding, and a pool with
+        no healthy replicas left raises typed ``PoolDegraded``.
+        ``None`` = unlimited (the pre-backoff behavior).
     seed: P2C sampling seed (deterministic tests).
     """
 
@@ -317,6 +335,9 @@ class EnginePool:
                  auto_restart: bool = False,
                  max_resubmits: Optional[int] = None,
                  max_sticky_sessions: int = 4096,
+                 restart_backoff_s: float = 0.05,
+                 restart_backoff_max_s: float = 5.0,
+                 max_restarts: Optional[int] = 5,
                  seed: int = 0):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
@@ -327,6 +348,16 @@ class EnginePool:
         self.max_resubmits = (max_resubmits if max_resubmits
                               is not None else num_replicas)
         self._max_sticky = max_sticky_sessions
+        self.restart_backoff_s = max(0.0, float(restart_backoff_s))
+        self.restart_backoff_max_s = max(
+            self.restart_backoff_s, float(restart_backoff_max_s))
+        self.max_restarts = max_restarts
+        # installed by an attached PoolAutoscaler: returns the ETA (s)
+        # until in-flight provisioned capacity joins the pool, so an
+        # all-shed Retry-After never invites a client back BEFORE the
+        # capacity that would serve it exists
+        self.capacity_hint_fn: Optional[Callable[[], float]] = None
+        self._autoscaler = None      # attached PoolAutoscaler, if any
         self._sticky: "collections.OrderedDict[str, int]" = \
             collections.OrderedDict()
         # pool-level routing/lifecycle counters (the engines keep
@@ -357,6 +388,20 @@ class EnginePool:
         with self._lock:
             return sum(1 for r in self._replicas
                        if r.state == HEALTHY)
+
+    def active_count(self) -> int:
+        """Replicas currently holding capacity (anything but a
+        scale-down tombstone) — the autoscaler's notion of pool
+        size, and the bench's chip-count at any instant."""
+        with self._lock:
+            return sum(1 for r in self._replicas
+                       if r.state != RETIRED)
+
+    @property
+    def degraded(self) -> bool:
+        """True when any replica burned through its restart cap."""
+        with self._lock:
+            return any(r.state == DEGRADED for r in self._replicas)
 
     def submit(self, prompt_ids: Sequence[int],
                max_new_tokens: int = 64,
@@ -398,6 +443,14 @@ class EnginePool:
         budget expired and stragglers were axed — those fail typed
         and unstreamed ones recover via resubmission, so the restart
         still converges."""
+        clean = self._drain_out(idx, timeout_s)
+        self._rebuild(idx)
+        return clean
+
+    def _drain_out(self, idx: int, timeout_s: float) -> bool:
+        """The health-gated half of a drain: stop admitting, wait for
+        in-flight work (bounded), shut down. Shared by ``drain``
+        (which rebuilds after) and ``retire`` (which doesn't)."""
         with self._lock:
             rep = self._replicas[idx]
             if rep.state != HEALTHY:
@@ -415,8 +468,91 @@ class EnginePool:
             eng.shutdown()
         except Exception:
             pass
-        self._rebuild(idx)
         return clean
+
+    # -------------------------------------------------------- scaling
+
+    def add_replica(self) -> int:
+        """Scale up by one: build a fresh engine from the factory,
+        reusing a retired slot index when one exists (its generation
+        bumps) or appending a new one. Returns the replica index."""
+        if self._stopped:
+            raise EngineShutdown("engine pool stopped")
+        with self._lock:
+            retired = [r for r in self._replicas
+                       if r.state == RETIRED]
+            idx = retired[0].idx if retired else len(self._replicas)
+        if retired:
+            self._rebuild(idx)
+        else:
+            eng = self._factory(idx)
+            eng.start()
+            with self._lock:
+                self._replicas.append(_Replica(idx, eng))
+        with self._lock:
+            self.route_stats["replicas_added"] += 1
+        return idx
+
+    def retire(self, idx: int, timeout_s: float = 30.0) -> bool:
+        """Scale down replica ``idx`` through the SAME health-gated
+        drain path as a rolling restart — admit nothing new, finish
+        in-flight work, shut down — but leave a ``RETIRED`` tombstone
+        instead of rebuilding. In-flight requests either complete
+        normally (clean drain) or fail typed / resubmit under the
+        at-most-once rule (budget expired), exactly like ``drain``.
+        Returns the drain's cleanliness."""
+        with self._lock:
+            healthy = sum(1 for r in self._replicas
+                          if r.state == HEALTHY)
+            if healthy <= 1 and self._replicas[idx].state == HEALTHY:
+                raise RuntimeError(
+                    "refusing to retire the last healthy replica")
+        clean = self._drain_out(idx, timeout_s)
+        with self._lock:
+            self._replicas[idx].state = RETIRED
+            self.route_stats["replicas_retired"] += 1
+        return clean
+
+    def scale_down(self, n: int = 1,
+                   timeout_s: float = 30.0) -> List[int]:
+        """Retire the ``n`` least-loaded healthy replicas (by
+        outstanding tokens), never going below one healthy replica.
+        Returns the retired indices."""
+        with self._lock:
+            candidates = [r for r in self._replicas
+                          if r.state == HEALTHY]
+        n = min(n, len(candidates) - 1)
+        if n <= 0:
+            return []
+        load = []
+        for r in candidates:
+            try:
+                rpt = r.engine.load_report()
+                load.append((rpt.get("outstanding_tokens", 0), r.idx))
+            except Exception:
+                load.append((0, r.idx))
+        load.sort()
+        out = []
+        for _, idx in load[:n]:
+            try:
+                self.retire(idx, timeout_s)
+            except RuntimeError:
+                continue       # raced a death; replica count moved
+            out.append(idx)
+        return out
+
+    def scale_to(self, n: int, timeout_s: float = 30.0) -> int:
+        """Converge the pool to ``n`` active replicas (adds via the
+        factory, removes via ``scale_down``'s drain path). Returns
+        the resulting active count."""
+        if n < 1:
+            raise ValueError("scale_to target must be >= 1")
+        while self.active_count() < n:
+            self.add_replica()
+        excess = self.active_count() - n
+        if excess > 0:
+            self.scale_down(excess, timeout_s)
+        return self.active_count()
 
     def rolling_restart(self, timeout_s: float = 30.0) -> bool:
         """Drain-restart every replica in sequence (a config rollout
@@ -428,10 +564,12 @@ class EnginePool:
         return clean
 
     def restart_dead(self) -> int:
-        """Rebuild every DEAD replica now (manual counterpart of
-        ``auto_restart``). Returns how many were rebuilt."""
+        """Rebuild every DEAD (and crash-loop DEGRADED — this is the
+        manual override) replica now. Returns how many were
+        rebuilt."""
         with self._lock:
-            dead = [r.idx for r in self._replicas if r.state == DEAD]
+            dead = [r.idx for r in self._replicas
+                    if r.state in (DEAD, DEGRADED)]
         for idx in dead:
             self._rebuild(idx)
         return len(dead)
@@ -459,13 +597,21 @@ class EnginePool:
         transitioned = False
         with self._lock:
             if (self._replicas[rep.idx] is rep
-                    and rep.state != DEAD):
+                    and rep.state not in (DEAD, DEGRADED, RETIRED)):
                 rep.state = DEAD
                 rep.deaths += 1
                 transitioned = True
                 self.route_stats["replica_deaths"] += 1
                 self._drop_sticky_locked(rep.idx)
                 restart = self._auto_restart and not self._stopped
+                if (restart and self.max_restarts is not None
+                        and rep.deaths > self.max_restarts):
+                    # crash loop: stop feeding the factory — park the
+                    # replica DEGRADED until a human (or restart_dead)
+                    # intervenes
+                    restart = False
+                    rep.state = DEGRADED
+                    self.route_stats["crash_loops"] += 1
         if transitioned:
             _metrics()["replica_deaths"].inc()
         # idempotent: unblocks every remaining consumer typed and
@@ -475,10 +621,29 @@ class EnginePool:
         except Exception:
             pass
         if restart:
-            threading.Thread(target=self._rebuild, args=(rep.idx,),
+            # exponential backoff before the rebuild: first death
+            # restarts after backoff_s, each further death doubles it
+            # (capped), so a crash-looping factory cannot spin hot
+            backoff = min(self.restart_backoff_max_s,
+                          self.restart_backoff_s
+                          * (2 ** (rep.deaths - 1)))
+            threading.Thread(target=self._backoff_rebuild,
+                             args=(rep, backoff),
                              name=f"pool-restart-{rep.idx}",
                              daemon=True).start()
         return True
+
+    def _backoff_rebuild(self, rep: _Replica, backoff_s: float
+                         ) -> None:
+        if backoff_s > 0:
+            time.sleep(backoff_s)
+        with self._lock:
+            # the world may have moved during the backoff: pool
+            # stopped, replica replaced, or manually rebuilt already
+            if (self._stopped or self._replicas[rep.idx] is not rep
+                    or rep.state != DEAD):
+                return
+        self._rebuild(rep.idx)
 
     def _drop_sticky_locked(self, idx: int) -> None:
         for k in [k for k, v in self._sticky.items() if v == idx]:
@@ -509,6 +674,17 @@ class EnginePool:
                     with self._lock:
                         self.route_stats["all_shed"] += 1
                     _metrics()["all_shed"].inc()
+                    # Retry-After honesty under autoscaling: when
+                    # capacity is already provisioning, the hint must
+                    # cover its remaining ETA — never invite a client
+                    # back before a replica exists to serve it
+                    if self.capacity_hint_fn is not None:
+                        try:
+                            eta = float(self.capacity_hint_fn())
+                        except Exception:
+                            eta = 0.0
+                        if eta > 0:
+                            hints.append(eta)
                     err = EngineOverloaded(
                         f"all healthy replicas shed (retry hints "
                         f"{sorted(set(round(h, 3) for h in hints))})",
@@ -516,6 +692,11 @@ class EnginePool:
                     if shed:
                         raise err from shed[-1]
                     raise err
+                if self.degraded:
+                    raise PoolDegraded(
+                        "no healthy replicas: the pool burned through "
+                        "its crash-loop restart budget "
+                        f"(max_restarts={self.max_restarts})")
                 raise EngineShutdown("no healthy replicas in pool")
             try:
                 inner = rep.engine.submit(
@@ -554,6 +735,15 @@ class EnginePool:
             m["free_slots"].set(rep_report["free_slots"], tags=tags)
             m["queue_depth"].set(rep_report["queue_depth"],
                                  tags=tags)
+        # A replica can die while IDLE — engine thread gone with no
+        # in-flight handle around to trip the death path. Routing is
+        # the other place a corpse becomes visible: note the death
+        # here so auto-restart/crash-loop accounting fires instead of
+        # the replica sitting "healthy" in the table forever while
+        # every route skips it.
+        for r in reps:
+            if reports[r.idx]["stopped"]:
+                self._note_replica_death(r)
         live = [r for r in reps
                 if not reports[r.idx]["stopped"]
                 and not reports[r.idx]["draining"]]
@@ -677,7 +867,8 @@ class EnginePool:
 
     def load_reports(self) -> Dict[int, Dict[str, Any]]:
         return {r.idx: r.engine.load_report()
-                for r in self._replicas if r.state != DEAD}
+                for r in self._replicas
+                if r.state in (HEALTHY, DRAINING)}
 
     def load_report(self) -> Dict[str, Any]:
         """Pool-aggregate load snapshot (the single-engine
@@ -690,7 +881,10 @@ class EnginePool:
                "outstanding_tokens": 0, "draining": False,
                "stopped": not reports, "max_queued": None,
                "shed_retry_after_s": 1.0,
+               "total_slots": 0, "shed_total": 0,
+               "ttft_ewma_s": None,
                "n_replicas": len(self._replicas),
+               "active_replicas": self.active_count(),
                "healthy_replicas": self.healthy_count()}
         for rpt in reports:
             agg["free_slots"] += rpt["free_slots"]
@@ -699,6 +893,15 @@ class EnginePool:
             agg["outstanding_tokens"] += rpt["outstanding_tokens"]
             agg["shed_retry_after_s"] = max(
                 agg["shed_retry_after_s"], rpt["shed_retry_after_s"])
+            agg["total_slots"] += rpt.get("total_slots", 0)
+            agg["shed_total"] += rpt.get("shed_total", 0)
+            # worst replica wins: the SLO is violated if ANY replica's
+            # first-token latency drifted, and routing can only
+            # partially steer around a slow one
+            ewma = rpt.get("ttft_ewma_s")
+            if ewma is not None:
+                agg["ttft_ewma_s"] = ewma if agg["ttft_ewma_s"] \
+                    is None else max(agg["ttft_ewma_s"], ewma)
         return agg
 
     def pool_stats(self) -> Dict[str, Any]:
@@ -717,7 +920,14 @@ class EnginePool:
         counters["spill_rate"] = round(
             counters.get("spills", 0) / routed, 4) if routed else 0.0
         counters["n_replicas"] = len(reps)
+        counters["active_replicas"] = sum(
+            1 for r in reps if r["state"] != RETIRED)
+        counters["degraded"] = any(
+            r["state"] == DEGRADED for r in reps)
         counters["replicas"] = reps
+        scaler = self._autoscaler
+        if scaler is not None:
+            counters["autoscale"] = scaler.stats()
         return counters
 
     def _agg_numeric(self, per_replica: List[Optional[Dict[str, Any]]]

@@ -52,6 +52,16 @@ class EngineShutdown(RequestError):
     """The engine stopped while the request was queued/in flight."""
 
 
+class PoolDegraded(EngineShutdown):
+    """The replica pool hit its crash-loop restart cap: one or more
+    replicas died repeatedly, automatic rebuilding stopped for them,
+    and no healthy replica remains to take the request. Distinct from
+    a plain ``EngineShutdown`` so operators (and tests) can tell "the
+    pool was stopped" from "the pool burned through its restart
+    budget" — the latter needs a human or an autoscaler, not a retry.
+    HTTP: 503 (inherits ``EngineShutdown`` classification)."""
+
+
 class EngineDraining(RequestError):
     """The replica is draining (finishing in-flight work before a
     restart) and admits nothing new. Routers skip draining replicas,
@@ -74,6 +84,7 @@ def classify_http_status(exc: BaseException) -> int:
         "DeadlineExceeded": 504,
         "GetTimeoutError": 504,
         "EngineShutdown": 503,
+        "PoolDegraded": 503,
         "EngineDraining": 503,
         "RequestCancelled": 499,
     }

@@ -45,7 +45,12 @@ class LlamaDeployment:
                  max_retries: int = 2,
                  retry_backoff_s: float = 0.02,
                  num_engine_replicas: int = 1,
-                 pool_auto_restart: bool = True):
+                 pool_auto_restart: bool = True,
+                 autoscale: bool = False,
+                 autoscale_max_replicas: Optional[int] = None,
+                 autoscale_policy: Optional[Dict[str, Any]] = None,
+                 autoscale_interval_s: float = 0.5,
+                 autoscale_provider=None):
         import jax
         from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
@@ -85,6 +90,26 @@ class LlamaDeployment:
             raise ValueError("num_engine_replicas must be >= 1")
         self.num_engine_replicas = num_engine_replicas
         self.pool_auto_restart = pool_auto_restart
+        # SLO-driven pool autoscaling (serve/pool_autoscaler.py):
+        # num_engine_replicas becomes the FLOOR, autoscale_max_replicas
+        # the ceiling, and a PoolAutoscaler drives the pool between
+        # them on queue/shed/TTFT pressure. autoscale_policy overrides
+        # individual SLOPolicy fields (e.g. {"ttft_slo_s": 0.2});
+        # autoscale_provider supplies the capacity backend (default:
+        # ImmediateCapacityProvider — capacity already on the host).
+        self.autoscale = autoscale
+        self.autoscale_max_replicas = (
+            autoscale_max_replicas
+            if autoscale_max_replicas is not None
+            else max(num_engine_replicas, 4))
+        if self.autoscale and \
+                self.autoscale_max_replicas < num_engine_replicas:
+            raise ValueError("autoscale_max_replicas must be >= "
+                             "num_engine_replicas")
+        self.autoscale_policy = dict(autoscale_policy or {})
+        self.autoscale_interval_s = autoscale_interval_s
+        self.autoscale_provider = autoscale_provider
+        self._autoscaler = None
         self._engine_opts = dict(
             max_slots=max_slots, page_size=page_size,
             n_pages=n_pages, chunk=decode_chunk or stream_chunk,
@@ -123,7 +148,7 @@ class LlamaDeployment:
                     per_seq = -(-self.cfg.max_seq_len
                                 // opts["page_size"])
                     opts["n_pages"] = opts["max_slots"] * per_seq + 1
-                if self.num_engine_replicas > 1:
+                if self.num_engine_replicas > 1 or self.autoscale:
                     from ray_tpu.serve.engine_pool import EnginePool
 
                     def factory(idx, _opts=opts):
@@ -135,11 +160,27 @@ class LlamaDeployment:
                     self._engine = EnginePool(
                         factory, self.num_engine_replicas,
                         auto_restart=self.pool_auto_restart)
+                    if self.autoscale:
+                        from ray_tpu.serve.pool_autoscaler import (
+                            PoolAutoscaler, SLOPolicy)
+                        policy = SLOPolicy(
+                            min_replicas=self.num_engine_replicas,
+                            max_replicas=self.autoscale_max_replicas,
+                            **self.autoscale_policy)
+                        self._autoscaler = PoolAutoscaler(
+                            self._engine, policy,
+                            self.autoscale_provider).run(
+                                self.autoscale_interval_s)
                 else:
                     self._engine = LLMEngine(
                         self.model, self.params,
                         temperature=self.temperature, **opts).start()
             return self._engine
+
+    def autoscaler(self):
+        """The attached PoolAutoscaler (None until the lazy engine is
+        built or when autoscale=False)."""
+        return self._autoscaler
 
     def serve_stats(self) -> dict:
         """Replica metrics hook (merged into Replica.stats() under

@@ -1,0 +1,416 @@
+"""SLO-driven closed-loop autoscaling for the EnginePool.
+
+PR 5 made replica count a knob; this module makes it a CONTROL
+VARIABLE. The pool already exposes everything a controller needs —
+``load_report()`` aggregates queue depth, shed totals, free-slot
+fraction, and a worst-replica TTFT EWMA; ``add_replica`` grows the
+fleet; ``scale_down`` retires replicas through the health-gated drain
+path — and the autoscaler closes the loop against a declarative SLO
+policy, the Ray-paper architecture (demand-driven scaling as part of
+the runtime control plane) applied to the serving tier.
+
+Control loop (``tick()``, normally run by a background thread):
+
+1. **Harvest capacity**: poll pending provisioning tickets; every
+   ticket that became ready turns into a live replica via
+   ``pool.add_replica()``.
+2. **Sense**: read ``pool.load_report()``; derive the shed RATE from
+   the monotone shed counter; compute queue-per-replica and the
+   free-slot fraction.
+3. **Decide** (``_decide``): scale UP when any pressure signal fires
+   (queue per replica above ``queue_high``, any shedding, TTFT EWMA
+   over the SLO, or scarce free slots with a backlog); scale DOWN
+   only when the pool has been COMPLETELY quiet (no queue, no sheds,
+   ample free slots) for ``idle_stable_s`` continuously; otherwise
+   HOLD. The gap between ``queue_high`` and
+   ``queue_low`` plus the idle-stability window is the hysteresis
+   band that keeps a noisy workload from flapping the fleet.
+4. **Act**, clamped by min/max bounds and per-direction cooldowns:
+   scale-up REQUESTS capacity from a pluggable
+   ``ReplicaCapacityProvider`` (a TPU slice takes real minutes to
+   provision — the replica joins on a later tick, step 1); scale-down
+   retires the least-loaded replicas via ``pool.scale_down`` — the
+   SAME drain path as a rolling restart, so in-flight requests finish
+   token-identically and nothing is lost.
+
+Retry-After honesty: while capacity is provisioning the autoscaler
+installs ``capacity_eta_s`` as the pool's ``capacity_hint_fn``, so an
+all-shed ``EngineOverloaded`` carries a hint covering the remaining
+provisioning time — a shed NEVER invites the client back before the
+capacity that would serve it exists.
+
+Failure interplay: replica deaths are the pool's problem
+(auto-restart with exponential backoff, PR 6 satellite); the
+autoscaler only sees the resulting capacity dip through the same load
+signals and responds by provisioning more. A crash-looped DEGRADED
+replica therefore gets replaced by economics, not by special-casing.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import (CapacityUnavailable,
+                                              ImmediateCapacityProvider,
+                                              ReplicaCapacityProvider)
+
+SCALE_UP = "serve_pool_scale_up_total"
+SCALE_DOWN = "serve_pool_scale_down_total"
+SCALE_HOLD = "serve_pool_scale_hold_total"
+TARGET_REPLICAS = "serve_pool_target_replicas"
+
+_METRICS: Optional[dict] = None
+
+
+def _metrics() -> dict:
+    """Lazy module-level metric singletons, re-created if a test's
+    ``clear_registry()`` dropped them (same pattern as the engine,
+    pool, and prefix-cache modules)."""
+    global _METRICS
+    from ray_tpu.util import metrics
+    if (_METRICS is None
+            or metrics.registry().get(SCALE_UP)
+            is not _METRICS["scale_up"]):
+        _METRICS = {
+            "scale_up": metrics.Counter(
+                SCALE_UP, "Autoscaler scale-up decisions (replicas "
+                "requested)"),
+            "scale_down": metrics.Counter(
+                SCALE_DOWN, "Autoscaler scale-down decisions "
+                "(replicas retired)"),
+            "scale_hold": metrics.Counter(
+                SCALE_HOLD, "Autoscaler ticks that held the current "
+                "size (inside the hysteresis band or cooldown)"),
+            "target_replicas": metrics.Gauge(
+                TARGET_REPLICAS, "Autoscaler's current target "
+                "replica count (live + provisioning)"),
+        }
+    return _METRICS
+
+
+@dataclasses.dataclass
+class SLOPolicy:
+    """Declarative scaling policy: WHAT the operator wants (bounds,
+    SLO, stability) — the controller derives the when/how.
+
+    Scale-up triggers (any one fires):
+    - ``queue_high``: admission-queue depth per healthy replica.
+    - ``shed_rate_high``: sheds/second; the default 0.0 means ANY
+      shedding is an SLO event worth paying chips for.
+    - ``ttft_slo_s``: worst-replica TTFT EWMA budget (None = no TTFT
+      term).
+    - ``free_slot_frac_low``: free-slot fraction floor — scarce slots
+      WITH a backlog means saturation is imminent.
+
+    Scale-down requires ALL of: zero queue, zero shed rate, free-slot
+    fraction at/above ``free_slot_frac_high`` — sustained for
+    ``idle_stable_s``. TTFT is deliberately NOT part of the idle
+    test: the EWMA is a lagging indicator, and an otherwise-idle pool
+    must not be pinned at size by the memory of a past slow burst
+    (a breach still forces scale-UP). Queue per replica between
+    ``queue_low`` and ``queue_high`` always holds (hysteresis band).
+
+    ``cooldown_up_s``/``cooldown_down_s`` are per-direction refractory
+    periods; down is much longer because adding capacity is urgent
+    while removing it is merely thrifty.
+    """
+    min_replicas: int = 1
+    max_replicas: int = 4
+    queue_high: float = 2.0
+    queue_low: float = 0.5
+    shed_rate_high: float = 0.0
+    ttft_slo_s: Optional[float] = None
+    free_slot_frac_low: float = 0.1
+    free_slot_frac_high: float = 0.6
+    idle_stable_s: float = 5.0
+    cooldown_up_s: float = 2.0
+    cooldown_down_s: float = 10.0
+    scale_up_step: int = 1
+    scale_down_step: int = 1
+    drain_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                "max_replicas must be >= min_replicas")
+        if self.queue_low > self.queue_high:
+            raise ValueError("queue_low must be <= queue_high "
+                             "(hysteresis band)")
+
+
+class PoolAutoscaler:
+    """Drives ``pool`` toward its SLO under ``policy`` using capacity
+    from ``provider``. ``time_fn`` is injectable so policy tests run
+    on a fake clock. Construction attaches the scaler to the pool
+    (``pool_stats()`` grows an ``autoscale`` block; all-shed
+    Retry-After hints start covering provisioning ETAs) but does NOT
+    start the loop — call ``run()`` or drive ``tick()`` manually.
+    """
+
+    def __init__(self, pool, policy: Optional[SLOPolicy] = None,
+                 provider: Optional[ReplicaCapacityProvider] = None,
+                 *, time_fn: Callable[[], float] = time.monotonic):
+        self.pool = pool
+        self.policy = policy or SLOPolicy()
+        self.provider = provider or ImmediateCapacityProvider()
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._pending: List[str] = []        # provisioning tickets
+        self._ticket_by_idx: Dict[int, str] = {}
+        self._last_up = -math.inf
+        self._last_down = -math.inf
+        self._idle_since: Optional[float] = None
+        self._last_shed_total: Optional[int] = None
+        self._last_tick_t: Optional[float] = None
+        self.counts: Dict[str, int] = {
+            "ticks": 0, "scale_ups": 0, "scale_downs": 0,
+            "holds": 0, "denied": 0, "replicas_added": 0,
+            "replicas_retired": 0}
+        self.last_decision: str = "none"
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # timeline of (t, active, target) at decision points — the
+        # bench samples this for the replica-count artifact
+        self.timeline: List[tuple] = []
+        pool._autoscaler = self
+        pool.capacity_hint_fn = self.capacity_eta_s
+
+    # -------------------------------------------------------- sensing
+
+    def capacity_eta_s(self) -> float:
+        """Remaining ETA until ALL in-flight provisioning lands (0
+        when nothing is pending). The pool folds this into all-shed
+        Retry-After hints."""
+        with self._lock:
+            pending = list(self._pending)
+        eta = 0.0
+        for t in pending:
+            try:
+                eta = max(eta, self.provider.eta_s(t))
+            except Exception:
+                pass
+        return eta
+
+    def target_replicas(self) -> int:
+        """Live capacity plus capacity already on order."""
+        with self._lock:
+            pending = len(self._pending)
+        return self.pool.active_count() + pending
+
+    def signals(self) -> Dict[str, Any]:
+        """One sensed sample: the pool aggregate plus derived rates.
+        ``shed_rate`` comes from the monotone ``shed_total`` counter
+        differenced against the previous tick (clamped at 0: a
+        retiring replica takes its counter with it)."""
+        now = self._time()
+        rpt = self.pool.load_report()
+        healthy = max(1, rpt.get("healthy_replicas", 1))
+        total_slots = rpt.get("total_slots", 0)
+        free_frac = (rpt.get("free_slots", 0) / total_slots
+                     if total_slots else 1.0)
+        shed_total = rpt.get("shed_total", 0)
+        dt = (now - self._last_tick_t
+              if self._last_tick_t is not None else None)
+        if self._last_shed_total is None or not dt or dt <= 0:
+            shed_rate = 0.0
+        else:
+            shed_rate = max(0, shed_total
+                            - self._last_shed_total) / dt
+        self._last_shed_total = shed_total
+        self._last_tick_t = now
+        return {
+            "now": now,
+            "stopped": rpt.get("stopped", False),
+            "queue_depth": rpt.get("queue_depth", 0),
+            "queue_per_replica":
+                rpt.get("queue_depth", 0) / healthy,
+            "shed_rate": shed_rate,
+            "free_slot_frac": free_frac,
+            "ttft_ewma_s": rpt.get("ttft_ewma_s"),
+            "healthy_replicas": rpt.get("healthy_replicas", 0),
+        }
+
+    # ------------------------------------------------------- deciding
+
+    def _decide(self, sig: Dict[str, Any]) -> str:
+        """Pure policy: map one sensed sample to "up" | "down" |
+        "hold" (bounds/cooldowns are applied by ``tick``, not here,
+        so tests can probe the policy surface directly)."""
+        p = self.policy
+        ttft = sig.get("ttft_ewma_s")
+        ttft_breach = (p.ttft_slo_s is not None and ttft is not None
+                       and ttft > p.ttft_slo_s)
+        pressure = (sig["queue_per_replica"] > p.queue_high
+                    or sig["shed_rate"] > p.shed_rate_high
+                    or ttft_breach
+                    or (sig["free_slot_frac"] < p.free_slot_frac_low
+                        and sig["queue_depth"] > 0))
+        if pressure:
+            self._idle_since = None
+            return "up"
+        # TTFT deliberately absent here: a breach already returned
+        # "up" above, and the EWMA is a LAGGING indicator — an idle
+        # pool (no queue, no sheds, ample slots) must not be pinned
+        # at size by the memory of a past slow burst
+        idle = (sig["queue_depth"] == 0
+                and sig["shed_rate"] == 0
+                and sig["free_slot_frac"] >= p.free_slot_frac_high
+                and sig["queue_per_replica"] <= p.queue_low)
+        if not idle:
+            # inside the hysteresis band: neither pressured enough to
+            # pay for chips nor quiet enough to give them back
+            self._idle_since = None
+            return "hold"
+        if self._idle_since is None:
+            self._idle_since = sig["now"]
+        if sig["now"] - self._idle_since < p.idle_stable_s:
+            return "hold"
+        return "down"
+
+    # --------------------------------------------------------- acting
+
+    def tick(self) -> str:
+        """One control iteration (harvest -> sense -> decide -> act).
+        Returns the ACTED decision: "up"/"down" when capacity moved
+        or was ordered, else "hold"."""
+        if getattr(self.pool, "_stopped", False):
+            return "hold"
+        self._harvest_ready()
+        sig = self.signals()
+        if sig["stopped"]:
+            return "hold"
+        p = self.policy
+        now = sig["now"]
+        decision = self._decide(sig)
+        target = self.target_replicas()
+        acted = "hold"
+        if decision == "up":
+            if (now - self._last_up >= p.cooldown_up_s
+                    and target < p.max_replicas):
+                k = min(p.scale_up_step, p.max_replicas - target)
+                requested = self._request_capacity(k)
+                if requested:
+                    self._last_up = now
+                    with self._lock:
+                        self.counts["scale_ups"] += requested
+                    _metrics()["scale_up"].inc(requested)
+                    acted = "up"
+        elif decision == "down":
+            with self._lock:
+                pending = len(self._pending)
+            if (pending == 0
+                    and now - self._last_down >= p.cooldown_down_s
+                    and target > p.min_replicas):
+                k = min(p.scale_down_step, target - p.min_replicas)
+                retired = self.pool.scale_down(
+                    k, timeout_s=p.drain_timeout_s)
+                if retired:
+                    self._last_down = now
+                    self._idle_since = None
+                    self._release(retired)
+                    with self._lock:
+                        self.counts["scale_downs"] += len(retired)
+                        self.counts["replicas_retired"] += \
+                            len(retired)
+                    _metrics()["scale_down"].inc(len(retired))
+                    acted = "down"
+        if acted == "hold":
+            with self._lock:
+                self.counts["holds"] += 1
+            _metrics()["scale_hold"].inc()
+        with self._lock:
+            self.counts["ticks"] += 1
+            self.last_decision = acted
+        target = self.target_replicas()
+        _metrics()["target_replicas"].set(target)
+        self.timeline.append((now, self.pool.active_count(), target))
+        return acted
+
+    def _harvest_ready(self) -> None:
+        """Turn every provisioned ticket into a live replica."""
+        with self._lock:
+            pending = list(self._pending)
+        for ticket in pending:
+            try:
+                if not self.provider.ready(ticket):
+                    continue
+            except Exception:
+                continue
+            idx = self.pool.add_replica()
+            with self._lock:
+                self._pending.remove(ticket)
+                self._ticket_by_idx[idx] = ticket
+                self.counts["replicas_added"] += 1
+
+    def _request_capacity(self, k: int) -> int:
+        """Order ``k`` replicas' worth of capacity; returns how many
+        the provider granted tickets for."""
+        granted = 0
+        for _ in range(k):
+            try:
+                ticket = self.provider.request()
+            except CapacityUnavailable:
+                with self._lock:
+                    self.counts["denied"] += 1
+                break
+            with self._lock:
+                self._pending.append(ticket)
+            granted += 1
+        return granted
+
+    def _release(self, retired_idxs: List[int]) -> None:
+        """Give retired replicas' capacity back to the provider
+        (replicas the pool was BORN with carry no ticket and nothing
+        is released for them)."""
+        for idx in retired_idxs:
+            with self._lock:
+                ticket = self._ticket_by_idx.pop(idx, None)
+            if ticket is not None:
+                try:
+                    self.provider.release(ticket)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------ lifecycle
+
+    def run(self, interval_s: float = 0.5) -> "PoolAutoscaler":
+        """Start the control loop in a daemon thread."""
+        if self._thread is None:
+            self._stop.clear()
+
+            def loop():
+                while not self._stop.is_set():
+                    try:
+                        self.tick()
+                    except Exception:
+                        pass       # a broken tick must not kill the loop
+                    self._stop.wait(interval_s)
+
+            self._thread = threading.Thread(
+                target=loop, name="pool-autoscaler", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the loop (the pool keeps its current size)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+            self._thread = None
+
+    def stats(self) -> Dict[str, Any]:
+        """The ``autoscale`` block in ``pool_stats()`` / artifacts."""
+        with self._lock:
+            out = dict(self.counts)
+            out["pending"] = len(self._pending)
+            out["last_decision"] = self.last_decision
+        out["target_replicas"] = self.target_replicas()
+        out["min_replicas"] = self.policy.min_replicas
+        out["max_replicas"] = self.policy.max_replicas
+        return out

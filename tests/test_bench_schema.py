@@ -322,6 +322,104 @@ def test_pool_ab_requires_sections_ratios_and_stats(tmp_path):
     assert any("int >= 2" in p for p in probs)
 
 
+_ARM = {"requests": 400, "completed": 396, "shed": 4, "errors": 0,
+        "shed_events": 9, "retry_after_violations": 0,
+        "slo_attainment": 0.95, "chip_seconds": 40.0,
+        "ttft_p50_ms": 120.0, "ttft_p95_ms": 600.0}
+
+
+def _autoscale():
+    auto = dict(_ARM, replica_timeline=[[0.0, 1], [3.2, 2], [4.1, 3],
+                                        [18.5, 2], [21.0, 1]],
+                replicas_min_seen=1, replicas_max_seen=3,
+                scale_ups=2, scale_downs=2, holds=80, denied=0)
+    return {"trace": "bursty", "seed": 0, "replicas_min": 1,
+            "replicas_max": 4,
+            "slo": {"ttft_ms": 1000.0, "attainment_floor": 0.9},
+            "autoscale": auto,
+            "static_max": dict(_ARM, chip_seconds=84.0),
+            "chip_seconds_ratio": 0.48, "ttft_p50_ratio": 1.1,
+            "git_sha": "abc1234"}
+
+
+def test_autoscale_artifact_validates(tmp_path):
+    assert _problems_for("SERVE_BENCH_autoscale_cpu_smoke.json",
+                         _autoscale(), tmp_path) == []
+
+
+def test_autoscale_requires_sections_and_fields(tmp_path):
+    for missing in ("trace", "seed", "slo", "replicas_min",
+                    "replicas_max", "chip_seconds_ratio"):
+        bad = {k: v for k, v in _autoscale().items() if k != missing}
+        probs = _problems_for("SERVE_BENCH_autoscale_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any(missing in p for p in probs), missing
+    for field in ("requests", "slo_attainment", "chip_seconds",
+                  "retry_after_violations", "ttft_p50_ms"):
+        bad = _autoscale()
+        del bad["autoscale"][field]
+        probs = _problems_for("SERVE_BENCH_autoscale_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any(field in p for p in probs), field
+        bad = _autoscale()
+        del bad["static_max"][field]
+        probs = _problems_for("SERVE_BENCH_autoscale_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any(field in p for p in probs), field
+
+
+def test_autoscale_refuses_attainment_below_recorded_floor(tmp_path):
+    # the floor the run RECORDED is the contract: an artifact whose
+    # autoscale arm missed its own floor documents an SLO breach
+    bad = _autoscale()
+    bad["autoscale"]["slo_attainment"] = 0.8
+    probs = _problems_for("SERVE_BENCH_autoscale_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("below the run's own recorded floor" in p
+               for p in probs)
+    # the static arm is a BASELINE, not a contract: it may miss
+    ok = _autoscale()
+    ok["static_max"]["slo_attainment"] = 0.5
+    assert _problems_for("SERVE_BENCH_autoscale_cpu_smoke.json",
+                         ok, tmp_path) == []
+
+
+def test_autoscale_refuses_retry_after_violations(tmp_path):
+    bad = _autoscale()
+    bad["autoscale"]["retry_after_violations"] = 2
+    probs = _problems_for("SERVE_BENCH_autoscale_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("Retry-After violation" in p for p in probs)
+
+
+def test_autoscale_requires_scaling_timeline(tmp_path):
+    missing = _autoscale()
+    del missing["autoscale"]["replica_timeline"]
+    probs = _problems_for("SERVE_BENCH_autoscale_cpu_smoke.json",
+                          missing, tmp_path)
+    assert any("replica_timeline" in p for p in probs)
+    empty = _autoscale()
+    empty["autoscale"]["replica_timeline"] = []
+    probs = _problems_for("SERVE_BENCH_autoscale_cpu_smoke.json",
+                          empty, tmp_path)
+    assert any("non-empty" in p for p in probs)
+    # a flat timeline means the pool never scaled: the artifact
+    # proves nothing about autoscaling
+    flat = _autoscale()
+    flat["autoscale"]["replica_timeline"] = [[0.0, 2], [20.0, 2]]
+    probs = _problems_for("SERVE_BENCH_autoscale_cpu_smoke.json",
+                          flat, tmp_path)
+    assert any("flat" in p for p in probs)
+
+
+def test_autoscale_refuses_chip_seconds_ratio_ge_one(tmp_path):
+    bad = _autoscale()
+    bad["chip_seconds_ratio"] = 1.0
+    probs = _problems_for("SERVE_BENCH_autoscale_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("chip_seconds_ratio" in p for p in probs)
+
+
 def test_pool_ab_kill_run_must_lose_nothing(tmp_path):
     lossy = _pool_ab()
     lossy["replica_kill"]["lost"] = 1

@@ -679,3 +679,214 @@ def test_cancel_through_pool_handle(tiny_model):
         h.result()
     pool.shutdown()
     check_pool_quiesced(pool)
+
+
+# -------------------------------- auto-restart backoff + crash loops
+
+
+def test_auto_restart_backoff_doubles_and_caps(monkeypatch):
+    """Each successive death of the same replica doubles the rebuild
+    backoff until the cap — a crash-looping factory must not spin
+    hot. The sleep itself is spied out so the test is timing-free."""
+    backoffs = []
+    orig = EnginePool._backoff_rebuild
+
+    def spy(self, rep, backoff_s):
+        backoffs.append(backoff_s)
+        orig(self, rep, 0.0)          # skip the real sleep
+
+    monkeypatch.setattr(EnginePool, "_backoff_rebuild", spy)
+    fakes = {}
+
+    def factory(i):
+        f = FakeEngine(i)
+        fakes[i] = f
+        return f
+
+    pool = EnginePool(factory, 2, auto_restart=True,
+                      restart_backoff_s=0.1,
+                      restart_backoff_max_s=0.4,
+                      max_restarts=None)
+    for _ in range(4):
+        rep = pool.replica(0)
+        rep.engine._stopped = True
+        pool._note_replica_death(rep)
+        deadline = time.monotonic() + 5.0
+        while pool.replica(0).state != HEALTHY \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert pool.replica(0).state == HEALTHY
+    assert backoffs == pytest.approx([0.1, 0.2, 0.4, 0.4])
+    assert pool.replica(0).deaths == 4
+    pool.shutdown()
+
+
+def test_crash_loop_cap_parks_replica_degraded():
+    """Past max_restarts the pool stops feeding the factory: the
+    replica parks DEGRADED (skipped by routing), a full-pool outage
+    surfaces as typed PoolDegraded (HTTP 503), and restart_dead() is
+    the manual override that clears the state."""
+    from ray_tpu.serve.engine_pool import DEGRADED
+    from ray_tpu.serve.errors import (PoolDegraded,
+                                      classify_http_status)
+    fakes = {}
+
+    def factory(i):
+        f = FakeEngine(i)
+        fakes[i] = f
+        return f
+
+    pool = EnginePool(factory, 1, auto_restart=True,
+                      restart_backoff_s=0.0, max_restarts=1)
+    # death 1: within budget, auto-rebuilds
+    rep = pool.replica(0)
+    rep.engine._stopped = True
+    pool._note_replica_death(rep)
+    deadline = time.monotonic() + 5.0
+    while pool.replica(0).state != HEALTHY \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert pool.replica(0).state == HEALTHY
+    # death 2: budget burned -> DEGRADED, no rebuild
+    rep = pool.replica(0)
+    rep.engine._stopped = True
+    pool._note_replica_death(rep)
+    assert pool.replica(0).state == DEGRADED
+    assert pool.degraded is True
+    assert pool.route_stats["crash_loops"] == 1
+    assert pool.pool_stats()["degraded"] is True
+    with pytest.raises(PoolDegraded) as ei:
+        pool.submit([1, 2])
+    assert classify_http_status(ei.value) == 503
+    # PoolDegraded IS an EngineShutdown: existing handlers still match
+    assert isinstance(ei.value, EngineShutdown)
+    # manual intervention: restart_dead rebuilds DEGRADED replicas too
+    assert pool.restart_dead() == 1
+    assert pool.replica(0).state == HEALTHY
+    assert pool.submit([1, 2]).result() == [1, 2]
+    pool.shutdown()
+
+
+def test_backoff_rebuild_aborts_when_world_moved():
+    """A rebuild sleeping out its backoff must re-check the world:
+    if the pool stopped meanwhile, no zombie replica may be built."""
+    fakes = {}
+
+    def factory(i):
+        f = FakeEngine(i)
+        fakes[i] = f
+        return f
+
+    pool = EnginePool(factory, 1, auto_restart=True,
+                      restart_backoff_s=0.2, max_restarts=None)
+    rep = pool.replica(0)
+    rep.engine._stopped = True
+    pool._note_replica_death(rep)     # restart thread now sleeping
+    pool.shutdown()                   # ... and the pool stops
+    time.sleep(0.4)
+    assert pool.replica(0).state == DEAD
+    assert pool.route_stats["restarts"] == 0
+
+
+# ------------------------------------------ drain racing with death
+
+
+def test_resubmit_after_death_skips_draining_replica():
+    """The satellite race, deterministic at the fakes layer: replica
+    2 is mid-drain when replica 0 dies; the orphaned request must
+    resubmit to the remaining HEALTHY replica — a draining replica
+    is finishing its last requests, never accepting new ones."""
+    fakes = [FakeEngine(0, outstanding=0),
+             FakeEngine(1, outstanding=50),
+             FakeEngine(2, outstanding=5)]
+    fakes[0].die_on_failure = True
+    fakes[0].script.append(FakeHandle(fakes[0], [],
+                                      RuntimeError("device lost")))
+    fakes[1].script.append([7, 8])
+    pool = _fake_pool(fakes)
+    pool.replica(2).state = DRAINING
+    fakes[2]._draining = True
+    h = pool.submit([1, 2])           # least loaded: replica 0
+    assert h.replica_idx == 0
+    assert h.result() == [7, 8]
+    assert h.replica_idx == 1         # NOT the draining replica
+    assert fakes[2].submits == []
+    assert pool.route_stats["requeues"] == 1
+    pool.replica(2).state = HEALTHY
+    pool.shutdown()
+
+
+def test_drain_racing_replica_death_quiesces_leak_free(tiny_model):
+    """End-to-end race: replica 1 drains WHILE replica 0 dies
+    mid-decode. Every in-flight request either completes
+    token-identically to the single-engine reference or fails typed
+    EngineShutdown (post-stream deaths) — none lost, none landed on
+    the draining replica's corpse, and every engine ever built
+    quiesces with zero leaked pages (autouse fixture + explicit
+    check)."""
+    import numpy as np
+    model, params = tiny_model
+    inj = FaultInjector()
+    inj.kill_replica(round=6)
+
+    def factory(idx):
+        return LLMEngine(model, params, max_slots=2, page_size=16,
+                         n_pages=64, chunk=2, prefill_chunk=16,
+                         temperature=0.0, eos_id=-1, seed=idx,
+                         fault_injector=inj if idx == 0 else None)
+
+    pool = EnginePool(factory, 3)
+    rng = np.random.RandomState(23)
+    prompts = [rng.randint(1, 1000, size=10).tolist()
+               for _ in range(8)]
+    want = [_reference_completion(model, params, p, 20)
+            for p in prompts]
+    handles = [pool.submit(p, max_new_tokens=20) for p in prompts]
+    drainer = threading.Thread(target=lambda: pool.drain(1))
+    drainer.start()
+    completed = typed = 0
+    for h, w in zip(handles, want):
+        try:
+            assert h.result() == w    # token-identical or typed
+            completed += 1
+        except EngineShutdown:
+            typed += 1
+    drainer.join(timeout=60)
+    assert not drainer.is_alive()
+    assert completed + typed == len(handles)   # lost == 0
+    assert completed >= 1
+    assert pool.route_stats["replica_deaths"] >= 1
+    assert pool.route_stats["drains"] == 1
+    pool.shutdown()
+    check_pool_quiesced(pool)
+
+
+def test_idle_replica_death_detected_at_route_time():
+    """A replica that dies with NO in-flight requests has no handle
+    around to trip the death path — routing is where the corpse
+    becomes visible. The next submit must note the death (DEAD state,
+    auto-restart scheduled) instead of leaving a 'healthy' zombie the
+    router silently skips forever."""
+    built = []
+
+    def factory(i):
+        eng = FakeEngine(i)
+        built.append(eng)
+        return eng
+
+    pool = EnginePool(factory, 2, auto_restart=True,
+                      restart_backoff_s=0.0)
+    # replica 0's engine dies while idle: nothing in flight, nobody
+    # observes it
+    built[0]._stopped = True
+    h = pool.submit([1, 2, 3])          # routes around the corpse
+    assert h.replica_idx == 1
+    assert pool.route_stats["replica_deaths"] == 1
+    deadline = time.monotonic() + 5.0
+    while (pool.replica(0).generation == 0
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert pool.replica(0).state == HEALTHY
+    assert pool.replica(0).generation == 1
+    assert len(built) == 3              # rebuild used the factory
+    pool.shutdown()

@@ -27,6 +27,12 @@ family:
   pool_throughput_ratio, affinity_hit_rate, spill_rate,
   replica_kill} — the kill run must have lost == 0 and
   token_identical true (failover may fail typed, never drop)
+- SERVE_BENCH autoscale (serve_bench.py --autoscale): {trace, seed,
+  slo, autoscale, static_max, chip_seconds_ratio} — REFUSED when
+  autoscale SLO attainment is below the floor the run itself
+  recorded, when any Retry-After violation occurred, when the
+  replica timeline is missing/flat, or when the autoscaled arm
+  consumed >= the static arm's chip-seconds
 
 Engine serve results may also carry a `lifecycle` block
 (engine.lifecycle_stats()): retry-policy knobs
@@ -102,6 +108,20 @@ REPLICA_KILL_REQUIRED = {
     "resubmitted": NUM,
     "replica_deaths": NUM,
     "lost": int,
+}
+
+# autoscale artifacts carry one of these per arm (serve_bench.py
+# run_autoscale): SLO attainment is graded over ALL arrivals, and
+# retry_after_violations counts sheds whose Retry-After hint was
+# shorter than the remaining provisioning ETA at that moment.
+AUTOSCALE_ARM_REQUIRED = {
+    "requests": int,
+    "completed": int,
+    "shed": NUM,
+    "ttft_p50_ms": NUM,
+    "slo_attainment": NUM,
+    "chip_seconds": NUM,
+    "retry_after_violations": NUM,
 }
 
 # engine serve results carry this block (engine.py lifecycle_stats):
@@ -294,7 +314,95 @@ def check_pool_ab(obj, name, problems):
                             "replica (replica_deaths == 0)")
 
 
+def check_autoscale(obj, name, problems):
+    """serve_bench.py --autoscale artifact: one arrival trace, two
+    arms (SLO-driven autoscaled pool vs static pool at max). The
+    checker REFUSES artifacts that fail the run's own recorded
+    contract — attainment below the floor the run was configured
+    with, any Retry-After violation, a missing/flat replica
+    timeline, or chip-seconds >= the static arm (an autoscaler that
+    saves nothing while risking SLO is a regression, not a feature).
+    """
+    for key, typ in (("trace", str), ("seed", int),
+                     ("replicas_min", int), ("replicas_max", int)):
+        v = obj.get(key)
+        if not isinstance(v, typ) or isinstance(v, bool):
+            problems.append(f"{name}: autoscale artifact missing "
+                            f"{typ.__name__} field '{key}'")
+    slo = obj.get("slo")
+    floor = None
+    if not isinstance(slo, dict):
+        problems.append(f"{name}: autoscale artifact missing slo "
+                        "object")
+    else:
+        for key in ("ttft_ms", "attainment_floor"):
+            if not isinstance(slo.get(key), NUM) \
+                    or isinstance(slo.get(key), bool):
+                problems.append(f"{name}:slo: missing numeric "
+                                f"'{key}'")
+        floor = slo.get("attainment_floor")
+    auto = obj.get("autoscale")
+    static = obj.get("static_max")
+    if not isinstance(auto, dict):
+        problems.append(f"{name}: autoscale must be an object")
+    else:
+        _check_fields(auto, AUTOSCALE_ARM_REQUIRED,
+                      f"{name}:autoscale", problems)
+        for key in ("scale_ups", "scale_downs"):
+            if not isinstance(auto.get(key), int) \
+                    or isinstance(auto.get(key), bool):
+                problems.append(f"{name}:autoscale: missing int "
+                                f"'{key}'")
+        tl = auto.get("replica_timeline")
+        if not isinstance(tl, list) or not tl:
+            problems.append(f"{name}:autoscale: replica_timeline "
+                            "must be a non-empty list")
+        else:
+            counts = [row[1] for row in tl
+                      if isinstance(row, list) and len(row) == 2
+                      and isinstance(row[1], int)]
+            if len(counts) != len(tl):
+                problems.append(f"{name}:autoscale: replica_timeline "
+                                "rows must be [t, n] pairs")
+            elif min(counts) == max(counts):
+                problems.append(f"{name}:autoscale: replica_timeline "
+                                "is flat — the pool never scaled")
+        att = auto.get("slo_attainment")
+        if isinstance(att, NUM) and not isinstance(att, bool) \
+                and isinstance(floor, NUM) and att < floor:
+            problems.append(
+                f"{name}: autoscale SLO attainment {att} is below "
+                f"the run's own recorded floor {floor}")
+        rv = auto.get("retry_after_violations")
+        if isinstance(rv, NUM) and not isinstance(rv, bool) \
+                and rv != 0:
+            problems.append(
+                f"{name}: {rv} Retry-After violation(s) — a shed "
+                "hint invited a client back before capacity existed")
+    if not isinstance(static, dict):
+        problems.append(f"{name}: static_max must be an object")
+    else:
+        _check_fields(static, AUTOSCALE_ARM_REQUIRED,
+                      f"{name}:static_max", problems)
+    ratio = obj.get("chip_seconds_ratio")
+    if not isinstance(ratio, NUM) or isinstance(ratio, bool):
+        problems.append(f"{name}: autoscale artifact missing numeric "
+                        "chip_seconds_ratio")
+    elif ratio >= 1.0:
+        problems.append(
+            f"{name}: chip_seconds_ratio {ratio} >= 1.0 — the "
+            "autoscaled arm consumed no fewer chip-seconds than "
+            "static-max")
+
+
 def check_serve_bench(obj, name, problems):
+    if "autoscale" in obj and "static_max" in obj:
+        # autoscale family (serve_bench.py --autoscale)
+        check_autoscale(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
     if "unsaturated" in obj or "overloaded" in obj:
         # lifecycle smoke family (serve_bench.py --lifecycle)
         check_lifecycle_smoke(obj, name, problems)
