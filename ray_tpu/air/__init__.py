@@ -1,8 +1,10 @@
-from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.checkpoint import Checkpoint, InvalidCheckpointError
+from ray_tpu.air.checkpoint_manager import CheckpointManager
 from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
                                 ScalingConfig)
 from ray_tpu.air.result import Result
 from ray_tpu.air import session
 
-__all__ = ["Checkpoint", "ScalingConfig", "RunConfig", "FailureConfig",
+__all__ = ["Checkpoint", "CheckpointManager", "InvalidCheckpointError",
+           "ScalingConfig", "RunConfig", "FailureConfig",
            "CheckpointConfig", "Result", "session"]

@@ -31,6 +31,12 @@ class ScalingConfig:
     topology: Optional[str] = None
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
+    # Elastic floor: with a capacity oracle on the trainer, a restart
+    # may proceed with as few as min_workers gang members when
+    # preemption shrank capacity (data-parallel reshard), growing back
+    # toward num_workers when capacity returns. None = num_workers
+    # (non-elastic: a restart always waits for full capacity).
+    min_workers: Optional[int] = None
     # Multi-host: bootstrap jax.distributed across the gang so the mesh
     # spans every member's devices. None = auto (on when num_workers>1
     # and the gang landed in distinct OS processes); True = require
@@ -56,8 +62,25 @@ class ScalingConfig:
 
 @dataclasses.dataclass
 class FailureConfig:
-    """max_failures: gang restarts before giving up (-1 = infinite)."""
+    """Gang fault-tolerance policy.
+
+    max_failures: gang restarts before giving up (-1 = infinite). The
+        budget counts consecutive failures WITHOUT durable progress: a
+        failure arriving with a newer checkpoint than the previous
+        failure's resets the count, so intermittent faults on a long
+        run don't exhaust the budget despite real forward progress.
+    worker_progress_deadline_s: heartbeat deadline — if a live worker
+        reports no progress (no session.report / session.heartbeat)
+        for this long, the gang is declared wedged and elastically
+        restarted instead of stalling fit() forever. None disables.
+    max_preemptions: preemption-driven restarts before giving up
+        (-1 = infinite). Preemptions drain through a checkpoint and
+        never consume the failure budget — capacity loss is not an
+        application fault.
+    """
     max_failures: int = 0
+    worker_progress_deadline_s: Optional[float] = None
+    max_preemptions: int = -1
 
 
 @dataclasses.dataclass

@@ -20,7 +20,9 @@ class TrainContext:
                  report_fn, mesh=None, trial_info: Optional[Dict] = None,
                  checkpoint: Optional[Checkpoint] = None,
                  config: Optional[Dict[str, Any]] = None,
-                 datasets: Optional[Dict[str, Any]] = None):
+                 datasets: Optional[Dict[str, Any]] = None,
+                 heartbeat_fn=None, preempt_fn=None,
+                 attempt: int = 0):
         self.world_rank = world_rank
         self.world_size = world_size
         self.report_fn = report_fn
@@ -29,6 +31,11 @@ class TrainContext:
         self.loaded_checkpoint = checkpoint
         self.config = config or {}
         self.datasets = datasets or {}
+        self.attempt = attempt
+        # Gang-supervision hooks (set by TrainWorker): touch the
+        # progress heartbeat / read the preemption notice.
+        self.heartbeat_fn = heartbeat_fn
+        self.preempt_fn = preempt_fn
 
 
 def _require_ctx() -> TrainContext:
@@ -49,13 +56,44 @@ def set_context(ctx: Optional[TrainContext]):
 
 def report(metrics: Dict[str, Any],
            checkpoint: Optional[Checkpoint] = None) -> None:
-    """Report metrics (and optionally a checkpoint) to the trainer."""
-    _require_ctx().report_fn(dict(metrics), checkpoint)
+    """Report metrics (and optionally a checkpoint) to the trainer.
+    Counts as progress for the gang heartbeat deadline."""
+    ctx = _require_ctx()
+    if ctx.heartbeat_fn is not None:
+        ctx.heartbeat_fn()
+    ctx.report_fn(dict(metrics), checkpoint)
 
 
 def get_checkpoint() -> Optional[Checkpoint]:
     """The checkpoint to resume from (set on restart), else None."""
     return _require_ctx().loaded_checkpoint
+
+
+def get_attempt() -> int:
+    """The trainer-assigned attempt id of this gang: 0 for the first
+    launch, incremented on every elastic restart. Monotonic across the
+    whole fit, which makes it a fencing token — a loop superseded by a
+    restart can compare its attempt against the newest started one."""
+    return _require_ctx().attempt
+
+
+def heartbeat() -> None:
+    """Touch this worker's progress heartbeat without reporting
+    metrics. Long steps (big compiles, slow data fetches) call this so
+    the trainer's progress deadline doesn't mistake them for a hang;
+    ``report()`` touches it implicitly."""
+    ctx = _require_ctx()
+    if ctx.heartbeat_fn is not None:
+        ctx.heartbeat_fn()
+
+
+def preempted() -> bool:
+    """True once a preemption notice has been delivered to this gang:
+    the slice is going away after a grace window. A well-behaved loop
+    checkpoints immediately and returns (drains); the trainer then
+    resumes elastically on whatever capacity remains."""
+    ctx = _require_ctx()
+    return bool(ctx.preempt_fn()) if ctx.preempt_fn is not None else False
 
 
 def get_world_rank() -> int:

@@ -172,6 +172,7 @@ TPU_TOPOLOGIES: Dict[str, Any] = {
 QR_PROVISIONING = "PROVISIONING"
 QR_READY = "READY"
 QR_DELETING = "DELETING"
+QR_PREEMPTING = "PREEMPTING"
 
 
 class SimulatedTPUCloud:
@@ -185,7 +186,14 @@ class SimulatedTPUCloud:
     ``provision_delay_s`` models slice spin-up; ``capacity`` models
     stockouts per accelerator type (create beyond it parks the queued
     resource in PROVISIONING forever — exactly how real stockouts
-    surface)."""
+    surface).
+
+    Preemption model: ``preempt(name, grace_s, stockout_s)`` moves a
+    READY slice to PREEMPTING; the slice keeps serving through the
+    grace window (a real notice arrives before the slice dies), then
+    vanishes. An optional post-preemption stockout window blocks new
+    READY promotions of that accelerator type — preempted capacity is
+    usually gone precisely because the region ran out of it."""
 
     def __init__(self, provision_delay_s: float = 0.0,
                  capacity: Optional[Dict[str, int]] = None):
@@ -194,6 +202,10 @@ class SimulatedTPUCloud:
         self._capacity = dict(capacity or {})
         self._qrs: Dict[str, Dict[str, Any]] = {}
         self._subnet = 0     # monotonic: deleted slices never reuse IPs
+        # accel type -> wall time before which no new slice goes READY
+        self._stockout_until: Dict[str, float] = {}
+        # Event log of every preemption (tests/harnesses assert on it).
+        self.preemptions: List[Dict[str, Any]] = []
 
     @property
     def provision_delay_s(self) -> float:
@@ -235,13 +247,68 @@ class SimulatedTPUCloud:
             q = self._qrs.get(name)
             if q is None:
                 return None
+            now = time.time()
+            if q["state"] == QR_PREEMPTING and \
+                    now >= q["preempt_deadline"]:
+                # Grace window over: the slice is gone, exactly as if
+                # the cloud reclaimed it out from under the workload.
+                self._qrs.pop(name, None)
+                return None
             if q["state"] == QR_PROVISIONING and \
-                    time.time() - q["create_time"] >= self._delay:
-                cap = self._capacity.get(q["accelerator_type"])
-                if cap is None or self._ready_count(
-                        q["accelerator_type"]) < cap:
+                    now - q["create_time"] >= self._delay:
+                accel = q["accelerator_type"]
+                cap = self._capacity.get(accel)
+                stocked_out = now < self._stockout_until.get(accel, 0.0)
+                if not stocked_out and (
+                        cap is None or self._ready_count(accel) < cap):
                     q["state"] = QR_READY
             return dict(q)
+
+    def preempt(self, name: str, grace_s: float = 0.0,
+                stockout_s: float = 0.0) -> Dict[str, Any]:
+        """Preempt a slice: READY -> PREEMPTING for ``grace_s`` (the
+        advance notice real clouds deliver), then gone. ``stockout_s``
+        additionally blocks READY promotion of this accelerator type —
+        the capacity squeeze that caused the preemption persists."""
+        with self._lock:
+            q = self._qrs.get(name)
+            if q is None:
+                raise ValueError(f"unknown queued resource {name!r}")
+            now = time.time()
+            q["state"] = QR_PREEMPTING
+            q["preempt_deadline"] = now + grace_s
+            accel = q["accelerator_type"]
+            if stockout_s > 0:
+                self._stockout_until[accel] = max(
+                    self._stockout_until.get(accel, 0.0),
+                    now + stockout_s)
+            self.preemptions.append({
+                "name": name, "accelerator_type": accel,
+                "time": now, "grace_s": grace_s,
+                "stockout_s": stockout_s})
+            return dict(q)
+
+    def preemption_notice(self, name: str) -> Optional[float]:
+        """Seconds of grace remaining for a PREEMPTING slice (what the
+        in-VM metadata server exposes on real TPUs); None when no
+        notice is active for ``name``."""
+        with self._lock:
+            q = self._qrs.get(name)
+            if q is None or q["state"] != QR_PREEMPTING:
+                return None
+            return max(0.0, q["preempt_deadline"] - time.time())
+
+    def ready_slice_count(self, accelerator_type: str) -> int:
+        """READY slices of one accelerator type — the natural elastic
+        capacity oracle for a trainer whose workers each ride one
+        slice. Runs expirations first so a lapsed grace window is not
+        counted as live capacity."""
+        with self._lock:
+            names = list(self._qrs)
+        for n in names:
+            self.describe(n)
+        with self._lock:
+            return self._ready_count(accelerator_type)
 
     def delete_queued_resource(self, name: str) -> None:
         with self._lock:

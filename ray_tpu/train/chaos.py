@@ -1,0 +1,412 @@
+"""Seeded chaos for elastic training: schedules, injection, gating.
+
+The trainer's preemption-tolerance story (durable async checkpoints +
+heartbeat gang supervision + elastic restart, see train/trainer.py and
+air/checkpoint_manager.py) is only worth anything if it survives an
+adversarial run — this module is the proof harness, the training
+analogue of the serving layer's fault seam (serve/faults.py).
+
+Three pieces:
+
+- ``ChaosEvent`` / ``make_schedule(seed, ...)`` — a deterministic
+  schedule of faults keyed to training STEPS (not wall time, so runs
+  are reproducible across machine speeds). A schedule always carries
+  at least one of every requested kind:
+
+  ============  =====================================================
+  kind          what fires
+  ============  =====================================================
+  ``kill``      hard actor kill of one gang member (host crash)
+  ``hang``      one member wedges — alive, answering polls, making
+                zero progress (the failure mode only a heartbeat
+                deadline catches)
+  ``preempt``   a TPU slice gets a preemption notice with a real
+                grace window, then vanishes; capacity stays stocked
+                out for a while (SimulatedTPUCloud.preempt)
+  ``torn_ckpt`` a torn checkpoint directory appears at a step NEWER
+                than the last durable commit (the litter a
+                non-atomic writer leaves when the plug is pulled),
+                then the gang is crashed — resume must skip it
+  ============  =====================================================
+
+- ``ChaosInjector`` — a driver-side watcher thread that observes the
+  live trainer (``last_seen_step`` / ``restarts``) and fires events
+  when the run reaches their step.
+- worker-side gates (``check_generation`` / ``hang_gate``) the chaos
+  train loop calls each step. The GENERATION file solves the zombie
+  problem of an in-process runtime: ``ray_tpu.kill`` marks an actor
+  dead but cannot stop its running thread, so a superseded loop must
+  stop ITSELF. The file holds the newest STARTED attempt id (the
+  trainer-assigned, monotonic ``session.get_attempt()`` token): every
+  gang fences its own attempt at loop start, the injector fences
+  ``restarts + 1`` just before it kills (so the victim's thread stops
+  within one step even before the replacement boots), and any loop
+  whose attempt is older than the file's raises ``StaleGeneration``
+  (its CheckpointManager pre-commit hook checks the same token, so a
+  zombie can never commit a checkpoint either). Fencing on the
+  trainer's own attempt counter — not an injector-side bump — is what
+  makes this race-free: a freshly launched gang can never observe a
+  token newer than its own.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import random
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+KINDS = ("kill", "hang", "preempt", "torn_ckpt")
+
+GEN_FILE = "GENERATION"
+
+
+class StaleGeneration(RuntimeError):
+    """Raised by a superseded train loop (its gang was torn down and a
+    newer attempt owns the run). Never reaches the trainer of the NEW
+    attempt — the raising actor is already dead to it."""
+
+
+class HangReleased(RuntimeError):
+    """Raised by a formerly-wedged loop once its hang file is removed
+    (the gang it belonged to is long gone; the loop must not resume)."""
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    """One planned fault. Fires when the trainer's last reported step
+    reaches ``at_step``."""
+    kind: str
+    at_step: int
+    rank: int = 1                  # kill/hang target (clamped to gang)
+    grace_s: float = 2.0           # preempt: notice -> slice death
+    stockout_s: float = 0.5        # preempt: READY promotions blocked
+    fired: bool = False
+    fired_at_step: Optional[int] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "at_step": self.at_step,
+                "rank": self.rank, "grace_s": self.grace_s,
+                "stockout_s": self.stockout_s, "fired": self.fired,
+                "fired_at_step": self.fired_at_step}
+
+
+def make_schedule(seed: int, steps_total: int, checkpoint_interval: int,
+                  kinds=KINDS, extra: int = 0,
+                  grace_s: float = 2.0,
+                  stockout_s: float = 0.5) -> List[ChaosEvent]:
+    """Deterministic schedule: ≥1 event of every kind in ``kinds``
+    plus ``extra`` more, spaced at least one checkpoint interval
+    apart inside (interval, steps_total - 2*interval] so no event
+    fires before the first durable commit or too close to the end to
+    observe recovery. Same seed ⇒ identical schedule."""
+    if checkpoint_interval < 1:
+        raise ValueError("checkpoint_interval must be >= 1")
+    n = len(kinds) + extra
+    lo = checkpoint_interval + 1
+    hi = steps_total - 2 * checkpoint_interval
+    if hi - lo < n * checkpoint_interval:
+        raise ValueError(
+            f"steps_total={steps_total} too small for {n} events "
+            f"spaced {checkpoint_interval} apart in [{lo}, {hi})")
+    rng = random.Random(seed)
+    ordered = list(kinds) + [rng.choice(list(kinds))
+                             for _ in range(extra)]
+    rng.shuffle(ordered)
+    span = (hi - lo) // n
+    events = []
+    for i, kind in enumerate(ordered):
+        base = lo + i * span
+        jitter = rng.randrange(max(1, span - checkpoint_interval))
+        events.append(ChaosEvent(
+            kind=kind, at_step=base + jitter,
+            rank=rng.randint(0, 3),
+            grace_s=grace_s, stockout_s=stockout_s))
+    return events
+
+
+# ---------------------------------------------------------------------------
+# Worker-side gates (called from inside the chaos train loop)
+# ---------------------------------------------------------------------------
+
+# In-process bookkeeping shared by every gang generation (the local
+# runtime hosts all actors in one process): hang tickets already
+# consumed, and each attempt's resume step (rank 0 appends at loop
+# start — the lost-progress measurement's ground truth).
+_consumed_lock = threading.Lock()
+_consumed_hangs: set = set()
+RESUMES: List[int] = []
+
+
+def reset_measurements() -> None:
+    """Clear cross-run module state (call per harness run/test)."""
+    with _consumed_lock:
+        _consumed_hangs.clear()
+    del RESUMES[:]
+
+
+def generation(control_dir: str) -> int:
+    """The newest attempt id known to have started (0 when none)."""
+    try:
+        with open(os.path.join(control_dir, GEN_FILE)) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def fence(control_dir: str, attempt: int) -> int:
+    """Record that ``attempt`` has started: advance the generation
+    file to it (monotonic — an older writer can never move it back).
+    Returns the resulting generation."""
+    path = os.path.join(control_dir, GEN_FILE)
+    cur = generation(control_dir)
+    if attempt <= cur:
+        return cur
+    tmp = f"{path}.tmp-{uuid.uuid4().hex}"
+    with open(tmp, "w") as f:
+        f.write(str(attempt))
+    os.replace(tmp, path)
+    return attempt
+
+
+def check_generation(control_dir: str, attempt: int) -> None:
+    """Raise ``StaleGeneration`` when a newer attempt has started —
+    this loop's gang was torn down and it must stop itself. Called
+    every step AND from the CheckpointManager pre-commit hook (a
+    zombie may not commit, ever)."""
+    if control_dir and generation(control_dir) > attempt:
+        raise StaleGeneration(
+            f"gang attempt {attempt} superseded by attempt "
+            f"{generation(control_dir)}")
+
+
+def _hang_path(control_dir: str, rank: int) -> str:
+    return os.path.join(control_dir, f"hang-{rank}")
+
+
+def hang_gate(control_dir: str, rank: int) -> None:
+    """Wedge this worker while its hang file exists: no heartbeat, no
+    reports, but the actor keeps answering polls — progress death,
+    not liveness death. Each hang file is a one-shot ticket (consumed
+    in-process) so the replacement gang doesn't re-wedge on the same
+    file; once the injector removes the file the wedged loop raises
+    instead of resuming — it belongs to a dead gang."""
+    if not control_dir:
+        return
+    path = _hang_path(control_dir, rank)
+    if not os.path.exists(path):
+        return
+    try:
+        with open(path) as f:
+            ticket = f.read().strip()
+    except OSError:
+        return
+    with _consumed_lock:
+        if ticket in _consumed_hangs:
+            return
+        _consumed_hangs.add(ticket)
+    while os.path.exists(path):
+        time.sleep(0.02)
+    raise HangReleased(f"rank {rank} released from hang {ticket}")
+
+
+# ---------------------------------------------------------------------------
+# Driver-side injector
+# ---------------------------------------------------------------------------
+
+
+class ChaosInjector:
+    """Watcher thread firing a schedule against a live trainer.
+
+    Needs the trainer (step/restart observability + the active gang),
+    the control dir the loop's gates watch, the checkpoint root (torn
+    injection), and — for preemption events — the SimulatedTPUCloud
+    plus the queued-resource names backing the gang's slices.
+    """
+
+    def __init__(self, trainer, schedule: List[ChaosEvent],
+                 control_dir: str, ckpt_root: str,
+                 checkpoint_interval: int,
+                 cloud=None, slices: Optional[List[str]] = None,
+                 accelerator_type: str = "v5e-1",
+                 backfill: bool = True,
+                 poll_s: float = 0.01):
+        self.trainer = trainer
+        self.schedule = sorted(schedule, key=lambda e: e.at_step)
+        self.control_dir = control_dir
+        self.ckpt_root = ckpt_root
+        self.interval = checkpoint_interval
+        self.cloud = cloud
+        self.slices = list(slices or [])
+        self.accel = accelerator_type
+        self.backfill = backfill
+        self.poll_s = poll_s
+        self.fail_steps: List[int] = []      # last_seen at each restart
+        self.log: List[Dict[str, Any]] = []
+        self._active_hangs: List[str] = []
+        self._backfills = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="chaos-injector",
+                                        daemon=True)
+        os.makedirs(control_dir, exist_ok=True)
+
+    def start(self) -> "ChaosInjector":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30)
+        # Release any still-wedged zombie and fence stragglers.
+        self._clear_hangs()
+        fence(self.control_dir, self.trainer.restarts + 1)
+
+    def injected_counts(self) -> Dict[str, int]:
+        out = {k: 0 for k in KINDS}
+        for e in self.schedule:
+            if e.fired:
+                out[e.kind] += 1
+        return out
+
+    # ------------------------------------------------------------ loop
+
+    def _run(self) -> None:
+        last_restarts = self.trainer.restarts
+        while not self._stop.is_set():
+            t = self.trainer
+            if t.restarts != last_restarts:
+                # A gang went down (our doing or the trainer's own
+                # supervision): record where, free its hang. Zombie
+                # fencing needs no action here — the replacement gang
+                # fences its own (newer) attempt id at loop start.
+                last_restarts = t.restarts
+                self.fail_steps.append(t.last_seen_step or 0)
+                self._clear_hangs()
+            step = t.last_seen_step
+            if step is not None and not t._preempt_pending:
+                for ev in self.schedule:
+                    if ev.fired or step < ev.at_step:
+                        continue
+                    if self._fire(ev, step):
+                        ev.fired = True
+                        ev.fired_at_step = step
+                        self.log.append(ev.as_dict())
+                    break   # at most one event per tick
+            time.sleep(self.poll_s)
+
+    def _clear_hangs(self) -> None:
+        for p in self._active_hangs:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        del self._active_hangs[:]
+
+    def _fire(self, ev: ChaosEvent, step: int) -> bool:
+        try:
+            if ev.kind == "kill":
+                return self._fire_kill(ev)
+            if ev.kind == "hang":
+                return self._fire_hang(ev)
+            if ev.kind == "preempt":
+                return self._fire_preempt(ev)
+            if ev.kind == "torn_ckpt":
+                return self._fire_torn(ev)
+        except Exception as e:  # noqa: BLE001 - injection must not die
+            logger.warning("chaos event %s failed to fire: %s",
+                           ev.kind, e)
+            return False
+        return False
+
+    def _fire_kill(self, ev: ChaosEvent) -> bool:
+        group = self.trainer._active_group
+        if group is None:
+            return False
+        rank = ev.rank % group.num_workers
+        # Fence FIRST: the killed actor's thread survives the kill in
+        # an in-process runtime; advancing the generation to the NEXT
+        # attempt id stops it (and its checkpoint commits) within one
+        # step. The replacement gang launches with exactly that id, so
+        # it is never fenced by its own predecessor's teardown.
+        fence(self.control_dir, self.trainer.restarts + 1)
+        group.kill_worker(rank)
+        return True
+
+    def _fire_hang(self, ev: ChaosEvent) -> bool:
+        group = self.trainer._active_group
+        if group is None:
+            return False
+        rank = ev.rank % group.num_workers
+        path = _hang_path(self.control_dir, rank)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"hang-{uuid.uuid4().hex}")
+        os.replace(tmp, path)
+        self._active_hangs.append(path)
+        return True
+
+    def _fire_preempt(self, ev: ChaosEvent) -> bool:
+        if self.cloud is None or self.trainer._active_group is None:
+            return False
+        victim = None
+        for name in self.slices:
+            q = self.cloud.describe(name)
+            if q is not None and q["state"] == "READY":
+                victim = name
+                break
+        if victim is None:
+            return False
+        self.cloud.preempt(victim, grace_s=ev.grace_s,
+                           stockout_s=ev.stockout_s)
+        self.trainer.notify_preemption(grace_s=ev.grace_s)
+        if self.backfill:
+            # The cloud backfills capacity eventually; the new slice
+            # sits in PROVISIONING until the stockout window passes,
+            # which is what lets the gang regrow later.
+            self._backfills += 1
+            name = f"chaos-backfill-{self._backfills}"
+            self.cloud.create_queued_resource(name, self.accel)
+            self.slices.append(name)
+        return True
+
+    def _fire_torn(self, ev: ChaosEvent) -> bool:
+        """Plant a torn checkpoint NEWER than the last durable commit
+        — a manifest whose hash no longer matches its payload, i.e. a
+        directory a non-atomic writer would have left — then crash the
+        gang. Resume must deep-verify, skip it, and land on the last
+        real commit."""
+        from ray_tpu.air.checkpoint import (MANIFEST_FILE,
+                                            MANIFEST_FORMAT)
+        from ray_tpu.air.checkpoint_manager import (CheckpointManager,
+                                                    step_dir_name)
+        mgr = CheckpointManager(self.ckpt_root)
+        try:
+            last = mgr.latest_step()
+        finally:
+            mgr.close()
+        if last is None:
+            return False
+        torn_step = last + self.interval
+        torn = os.path.join(self.ckpt_root, step_dir_name(torn_step))
+        os.makedirs(torn, exist_ok=True)
+        with open(os.path.join(torn, "meta.pkl"), "wb") as f:
+            f.write(b"\x00torn payload\x00")
+        manifest = {"format": MANIFEST_FORMAT, "step": torn_step,
+                    "wall_time": 0.0,
+                    "files": {"meta.pkl": {
+                        "sha256": "0" * 64,
+                        "bytes": 14}}}
+        with open(os.path.join(torn, MANIFEST_FILE), "w") as f:
+            json.dump(manifest, f)
+        group = self.trainer._active_group
+        if group is not None:
+            fence(self.control_dir, self.trainer.restarts + 1)
+            group.kill_worker(0)
+        return True

@@ -18,7 +18,8 @@ import logging
 import time
 from typing import Any, Callable, Dict, Optional
 
-from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.checkpoint import (Checkpoint, InvalidCheckpointError,
+                                    load_manifest)
 from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
                                 ScalingConfig)
 from ray_tpu.air.result import Result
@@ -32,6 +33,67 @@ class TrainingFailedError(RuntimeError):
     pass
 
 
+class GangPreempted(RuntimeError):
+    """Internal control flow: the gang drained (or was forced out)
+    after a preemption notice. Never consumes the failure budget —
+    capacity loss is the platform's doing, not the application's."""
+
+    def __init__(self, msg: str,
+                 latest_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(msg)
+        self.latest_checkpoint = latest_checkpoint
+
+
+class GangResized(RuntimeError):
+    """Internal control flow: a gang running below its requested size
+    restarts voluntarily because capacity returned (elastic regrow)."""
+
+    def __init__(self, msg: str,
+                 latest_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(msg)
+        self.latest_checkpoint = latest_checkpoint
+
+
+def _ckpt_step(ckpt: Optional[Checkpoint]) -> Optional[int]:
+    """Cheap step extraction: dict payload key or directory manifest —
+    never deserializes array payloads."""
+    if ckpt is None:
+        return None
+    step = None
+    if ckpt._data is not None:
+        step = ckpt._data.get("step")
+    elif ckpt._path is not None:
+        try:
+            step = load_manifest(ckpt._path).get("step")
+        except InvalidCheckpointError:
+            step = None
+    return step if isinstance(step, int) and not isinstance(step, bool) \
+        else None
+
+
+def _rollback_history(history: list, ckpt: Optional[Checkpoint]) -> None:
+    """Exactly-once step semantics for metrics_history across elastic
+    restarts: the un-checkpointed tail of the failed attempt never
+    durably happened, so drop reported steps beyond the resume
+    checkpoint's step — the restarted gang will recompute and re-report
+    them. Without this, every restart replays up to a checkpoint
+    interval of duplicate steps into the history.
+
+    No checkpoint at all means NOTHING durably happened: the restarted
+    gang starts from scratch and will re-report every step, so the
+    whole history must go."""
+    step = _ckpt_step(ckpt)
+    if step is None:
+        if ckpt is None:
+            del history[:]
+        return
+    history[:] = [m for m in history
+                  if not (isinstance(m, dict)
+                          and isinstance(m.get("step"), int)
+                          and not isinstance(m.get("step"), bool)
+                          and m["step"] > step)]
+
+
 class BaseTrainer:
     def __init__(self,
                  train_loop_per_worker: Callable,
@@ -40,7 +102,9 @@ class BaseTrainer:
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None,
-                 datasets: Optional[Dict[str, Any]] = None):
+                 datasets: Optional[Dict[str, Any]] = None,
+                 elastic_capacity_fn: Optional[Callable[[], int]] = None,
+                 elastic_wait_s: float = 30.0):
         self._loop = train_loop_per_worker
         self._config = train_loop_config or {}
         self.scaling_config = scaling_config or ScalingConfig()
@@ -50,6 +114,38 @@ class BaseTrainer:
         # (reference: DataParallelTrainer datasets kwarg +
         # session.get_dataset_shard)
         self._datasets = datasets or {}
+        # Elastic capacity oracle: () -> currently-available worker
+        # count (e.g. READY slices on a SimulatedTPUCloud). When set,
+        # a restart may proceed at reduced size down to
+        # ScalingConfig.min_workers while capacity is out, and the
+        # gang voluntarily regrows when capacity returns.
+        self._capacity_fn = elastic_capacity_fn
+        self._elastic_wait_s = elastic_wait_s
+        # Live-run state for external supervision (chaos harness /
+        # preemption watcher): the active gang + preemption notice.
+        self._active_group: Optional[WorkerGroup] = None
+        self._preempt_pending = False
+        self._preempt_deadline: Optional[float] = None
+        # Observability for tests/harnesses.
+        self.restarts = 0
+        self.preemptions = 0
+        self.resizes = 0
+        self.world_sizes: list = []
+        self.last_seen_step: Optional[int] = None
+
+    def notify_preemption(self, grace_s: float = 5.0) -> bool:
+        """Deliver a preemption notice to the running gang: every
+        member's ``session.preempted()`` turns True so loops can
+        checkpoint-now and drain; if the gang has not drained when the
+        grace window closes, it is torn down anyway (the slice is
+        gone either way). Returns False when no gang is running."""
+        group = self._active_group
+        if group is None:
+            return False
+        self._preempt_deadline = time.time() + grace_s
+        self._preempt_pending = True
+        group.notify_preemption()
+        return True
 
     # Subclasses decide the mesh the gang builds (None = no device mesh).
     def _mesh_axes(self) -> Optional[Dict[str, int]]:
@@ -77,6 +173,28 @@ class BaseTrainer:
                 "gang — start a multiprocess Cluster).")
         return can
 
+    def _await_capacity(self) -> int:
+        """Gang size for the next attempt. Without a capacity oracle:
+        always the requested size. With one: wait (bounded) for at
+        least the elastic floor, then take min(requested, available)
+        — the data-parallel reshard size."""
+        sc = self.scaling_config
+        if self._capacity_fn is None:
+            return sc.num_workers
+        floor = sc.num_workers if sc.min_workers is None \
+            else max(1, min(sc.min_workers, sc.num_workers))
+        deadline = time.time() + self._elastic_wait_s
+        while True:
+            avail = int(self._capacity_fn())
+            if avail >= floor:
+                return max(floor, min(sc.num_workers, avail))
+            if time.time() >= deadline:
+                raise TrainingFailedError(
+                    f"elastic capacity wait timed out: {avail} "
+                    f"worker(s) available < floor {floor} after "
+                    f"{self._elastic_wait_s}s")
+            time.sleep(0.05)
+
     def fit(self) -> Result:
         from ray_tpu._private.usage_stats import record_library_usage
         record_library_usage("train")
@@ -84,13 +202,50 @@ class BaseTrainer:
                           FailureConfig())
         max_failures = failure_config.max_failures
         attempt = 0
+        last_fail_step: Optional[int] = None
         latest_ckpt = self._resume
         history: list = []
         while True:
             try:
-                return self._run_once(latest_ckpt, history)
+                num_workers = self._await_capacity()
+                return self._run_once(latest_ckpt, history, num_workers)
+            except GangPreempted as e:
+                self.preemptions += 1
+                self.restarts += 1
+                latest_ckpt = e.latest_checkpoint or latest_ckpt
+                _rollback_history(history, latest_ckpt)
+                mp = failure_config.max_preemptions
+                if mp != -1 and self.preemptions > mp:
+                    logger.error("Preemption budget exhausted (%d)", mp)
+                    return Result(
+                        metrics=history[-1] if history else None,
+                        checkpoint=latest_ckpt,
+                        error=e, metrics_history=history)
+                logger.warning(
+                    "Gang preempted (%s); elastic resume %d from %s",
+                    e, self.preemptions, latest_ckpt)
+            except GangResized as e:
+                self.resizes += 1
+                self.restarts += 1
+                latest_ckpt = e.latest_checkpoint or latest_ckpt
+                _rollback_history(history, latest_ckpt)
+                logger.info("Capacity returned; regrowing gang from %s",
+                            latest_ckpt)
             except TrainingFailedError as e:
                 cause = e.__cause__ or e
+                new_ckpt = getattr(e, "latest_checkpoint",
+                                   None) or latest_ckpt
+                new_step = _ckpt_step(new_ckpt)
+                # Durable forward progress since the previous failure
+                # resets the retry budget: max_failures bounds
+                # CONSECUTIVE unproductive restarts, so intermittent
+                # faults on a long run can't exhaust it while the run
+                # is actually advancing.
+                if new_step is not None and last_fail_step is not None \
+                        and new_step > last_fail_step:
+                    attempt = 0
+                if new_step is not None:
+                    last_fail_step = new_step
                 if max_failures != -1 and attempt >= max_failures:
                     logger.error("Training failed permanently: %s", cause)
                     return Result(
@@ -98,8 +253,9 @@ class BaseTrainer:
                         checkpoint=latest_ckpt,
                         error=cause, metrics_history=history)
                 attempt += 1
-                latest_ckpt = getattr(e, "latest_checkpoint",
-                                      None) or latest_ckpt
+                self.restarts += 1
+                latest_ckpt = new_ckpt
+                _rollback_history(history, latest_ckpt)
                 logger.warning(
                     "Gang failure (%s); elastic restart %d/%s from %s",
                     cause, attempt,
@@ -107,15 +263,21 @@ class BaseTrainer:
                     latest_ckpt)
 
     def _run_once(self, resume_ckpt: Optional[Checkpoint],
-                  history: list) -> Result:
+                  history: list,
+                  num_workers: Optional[int] = None) -> Result:
         sc = self.scaling_config
+        if num_workers is None:
+            num_workers = sc.num_workers
+        failure_config = (self.run_config.failure_config or
+                          FailureConfig())
+        progress_deadline = failure_config.worker_progress_deadline_s
         # Gang trainers get dedicated FRESH worker processes so
         # jax.distributed bootstrap (and re-bootstrap after an elastic
         # restart) is reliable — a process joins one coordinator ever.
         want_gang = (sc.jax_distributed is not False and
-                     sc.num_workers > 1 and
+                     num_workers > 1 and
                      self._mesh_axes() is not None)
-        group = WorkerGroup(sc.num_workers, sc.worker_resources(),
+        group = WorkerGroup(num_workers, sc.worker_resources(),
                             sc.placement_strategy,
                             dedicated_processes=want_gang)
         latest_ckpt = resume_ckpt
@@ -131,20 +293,30 @@ class BaseTrainer:
         if self._datasets:
             # Equal-row shards per worker (slice task graph — rows
             # never visit the driver); each rank sees only its shard
-            # via session.get_dataset_shard(name).
-            per_name = {name: ds.split(sc.num_workers)
+            # via session.get_dataset_shard(name). Resharded to the
+            # CURRENT gang size on every elastic restart.
+            per_name = {name: ds.split(num_workers)
                         for name, ds in self._datasets.items()}
             datasets_per_rank = [
                 {name: shards[rank]
                  for name, shards in per_name.items()}
-                for rank in range(sc.num_workers)]
+                for rank in range(num_workers)]
+        self._preempt_pending = False
+        self._preempt_deadline = None
+        self._active_group = group
+        self.world_sizes.append(num_workers)
+        last_regrow_check = time.time()
         try:
+            # The attempt id doubles as a fencing token: restarts is
+            # monotonic, so a loop from a torn-down gang can detect it
+            # has been superseded (session.get_attempt()).
             run_refs = group.start_run(self._loop, self._config,
                                        self._mesh_axes(), resume_ckpt,
                                        self._backend_setup(),
                                        self._use_jax_distributed(group),
-                                       datasets_per_rank)
-            done = [False] * sc.num_workers
+                                       datasets_per_rank,
+                                       attempt=self.restarts)
+            done = [False] * num_workers
             error: Optional[BaseException] = None
             while not all(done) and error is None and \
                     not stop_requested:
@@ -154,6 +326,11 @@ class BaseTrainer:
                         if rank == 0:
                             last_metrics = metrics
                             history.append(metrics)
+                            step = metrics.get("step") if \
+                                isinstance(metrics, dict) else None
+                            if isinstance(step, int) and \
+                                    not isinstance(step, bool):
+                                self.last_seen_step = step
                             if stopper is not None and (
                                     stopper("train", metrics) or
                                     stopper.stop_all()):
@@ -169,9 +346,53 @@ class BaseTrainer:
                         error = p["error"]
                     if stop_requested:
                         break
+                now = time.time()
+                if error is None and progress_deadline:
+                    # Heartbeat supervision: a member that is alive
+                    # (answers polls) but reports no progress past the
+                    # deadline is wedged — restart the gang instead of
+                    # polling forever. Dead members already surfaced
+                    # through their poll entry's error.
+                    for rank, p in enumerate(polls):
+                        lp = p.get("last_progress")
+                        if (not p["done"] and not p.get("dead")
+                                and lp is not None
+                                and now - lp > progress_deadline):
+                            error = TimeoutError(
+                                f"worker {rank} made no progress for "
+                                f"{now - lp:.2f}s (deadline "
+                                f"{progress_deadline}s): wedged")
+                            break
+                if self._preempt_pending and error is None and \
+                        not stop_requested and not all(done) and \
+                        now > (self._preempt_deadline or now):
+                    # Grace window closed without a full drain: the
+                    # slice is going away regardless — take whatever
+                    # checkpoint the gang managed to flush.
+                    raise GangPreempted(
+                        "grace window expired before the gang "
+                        "drained", latest_checkpoint=latest_ckpt)
+                if error is None and self._capacity_fn is not None \
+                        and num_workers < sc.num_workers \
+                        and not self._preempt_pending \
+                        and not stop_requested \
+                        and now - last_regrow_check > 0.25:
+                    last_regrow_check = now
+                    if int(self._capacity_fn()) >= sc.num_workers:
+                        raise GangResized(
+                            f"capacity returned ({sc.num_workers} "
+                            f"available, running {num_workers})",
+                            latest_checkpoint=latest_ckpt)
                 if error is None and not all(done) and \
                         not stop_requested:
                     time.sleep(0.01)
+            if self._preempt_pending and error is None and \
+                    not stop_requested:
+                # Clean drain: every member saw the notice, flushed a
+                # checkpoint, and returned inside the grace window.
+                raise GangPreempted("gang drained after preemption "
+                                    "notice",
+                                    latest_checkpoint=latest_ckpt)
             if stop_requested and error is None:
                 # Condition met: the gang is torn down in finally; the
                 # result carries everything reported so far.
@@ -191,6 +412,7 @@ class BaseTrainer:
             return Result(metrics=last_metrics, checkpoint=latest_ckpt,
                           metrics_history=list(history))
         finally:
+            self._active_group = None
             group.shutdown()
 
 

@@ -32,6 +32,10 @@ class TrainWorker:
         self._lock = threading.Lock()
         self._done = False
         self._error: Optional[BaseException] = None
+        # Gang supervision: last observed progress (reports/heartbeats)
+        # and the preemption notice flag the loop polls.
+        self._last_progress = time.time()
+        self._preempt = False
 
     def process_identity(self) -> str:
         """Collision-free per-process id (PIDs/hostnames repeat across
@@ -50,7 +54,8 @@ class TrainWorker:
             resume_checkpoint: Optional[Checkpoint],
             backend_setup: Optional[Callable] = None,
             gang_bootstrap: Optional[Dict[str, Any]] = None,
-            datasets: Optional[Dict[str, Any]] = None) -> str:
+            datasets: Optional[Dict[str, Any]] = None,
+            attempt: int = 0) -> str:
         if gang_bootstrap is not None:
             # Join the jax.distributed gang BEFORE any jax computation:
             # after this, jax.devices() spans every member's chips and
@@ -73,11 +78,22 @@ class TrainWorker:
             with self._lock:
                 self._buffer.append((metrics, checkpoint))
 
+        def heartbeat_fn():
+            with self._lock:
+                self._last_progress = time.time()
+
+        def preempt_fn():
+            with self._lock:
+                return self._preempt
+
+        with self._lock:
+            self._last_progress = time.time()
         ctx = air_session.TrainContext(
             world_rank=self.rank, world_size=self.world_size,
             report_fn=report_fn, mesh=mesh,
             checkpoint=resume_checkpoint, config=config,
-            datasets=datasets)
+            datasets=datasets, heartbeat_fn=heartbeat_fn,
+            preempt_fn=preempt_fn, attempt=attempt)
         air_session.set_context(ctx)
         try:
             if _takes_arg(loop_fn):
@@ -95,12 +111,25 @@ class TrainWorker:
             air_session.set_context(None)
 
     def poll(self):
-        """Drain buffered (metrics, checkpoint) reports + status."""
+        """Drain buffered (metrics, checkpoint) reports + status.
+        ``last_progress`` is the wall time of the newest report or
+        heartbeat (the trainer's hang detector input); poll() itself
+        deliberately does NOT count — a wedged loop keeps answering
+        polls, which is exactly why liveness != progress."""
         with self._lock:
             out = list(self._buffer)
             self._buffer.clear()
             return {"reports": out, "done": self._done,
-                    "error": self._error}
+                    "error": self._error, "dead": False,
+                    "last_progress": self._last_progress,
+                    "preempted": self._preempt}
+
+    def request_preemption(self):
+        """Deliver a preemption notice: session.preempted() turns True
+        on this worker so the loop can checkpoint-now and drain."""
+        with self._lock:
+            self._preempt = True
+        return True
 
     def shutdown_marker(self):
         return True
@@ -201,7 +230,7 @@ class WorkerGroup:
 
     def start_run(self, loop_fn, config, mesh_axes, resume_checkpoint,
                   backend_setup=None, jax_distributed=False,
-                  datasets_per_rank=None):
+                  datasets_per_rank=None, attempt=0):
         gang_bootstrap = None
         if jax_distributed:
             coordinator = ray_tpu.get(
@@ -212,11 +241,56 @@ class WorkerGroup:
                              resume_checkpoint, backend_setup,
                              gang_bootstrap,
                              datasets_per_rank[rank]
-                             if datasets_per_rank else None)
+                             if datasets_per_rank else None,
+                             attempt)
                 for rank, w in enumerate(self.workers)]
 
     def poll_all(self) -> List[Dict[str, Any]]:
-        return ray_tpu.get([w.poll.remote() for w in self.workers])
+        """Poll every gang member with per-worker error isolation: a
+        dead actor yields a ``dead: True`` entry instead of blowing up
+        the whole poll, so survivors' buffered reports (metrics AND
+        checkpoints) still reach the trainer on the round a member
+        dies — the difference between resuming from the last committed
+        step and replaying a whole checkpoint interval."""
+        refs: List[Any] = []
+        for w in self.workers:
+            try:
+                refs.append(w.poll.remote())
+            except Exception as e:  # noqa: BLE001 - submit-time death
+                refs.append(e)
+        out: List[Dict[str, Any]] = []
+        for ref in refs:
+            if isinstance(ref, Exception):
+                err: Optional[BaseException] = ref
+            else:
+                try:
+                    out.append(ray_tpu.get(ref))
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    err = e
+            out.append({"reports": [], "done": False, "error": err,
+                        "dead": True, "last_progress": None,
+                        "preempted": False})
+        return out
+
+    def notify_preemption(self) -> int:
+        """Fan the preemption notice out to every reachable member.
+        Returns how many acknowledged (dead members are skipped — they
+        are already beyond saving)."""
+        acked = 0
+        for w in self.workers:
+            try:
+                ray_tpu.get(w.request_preemption.remote())
+                acked += 1
+            except Exception:  # noqa: BLE001
+                pass
+        return acked
+
+    def kill_worker(self, rank: int) -> None:
+        """Hard-kill one gang member's actor (chaos harness seam — the
+        moral equivalent of a host crash, distinct from an exception
+        the loop raises itself)."""
+        ray_tpu.kill(self.workers[rank])
 
     def shutdown(self):
         for w in self.workers:

@@ -437,3 +437,75 @@ def test_pool_ab_kill_run_must_lose_nothing(tmp_path):
     probs = _problems_for("SERVE_BENCH_pool_cpu_smoke.json",
                           no_kill, tmp_path)
     assert any("killed no replica" in p for p in probs)
+
+
+# ---------------------------------------------------------------------------
+# TRAIN_CHAOS family (tools/chaos_train.py artifacts)
+# ---------------------------------------------------------------------------
+
+
+def _chaos_ok():
+    return {
+        "seed": 45, "steps_total": 120, "checkpoint_interval": 6,
+        "workers": 2, "restarts": 5, "preemptions": 1, "resizes": 1,
+        "duplicate_steps": 0, "missing_steps": 0, "max_lost_steps": 6,
+        "loss_max_abs_err": 0.0, "final_step": 119, "wall_s": 4.9,
+        "injected": {"kill": 1, "hang": 1, "preempt": 1,
+                     "torn_ckpt": 1},
+        "schedule": [{"kind": "kill", "at_step": 15, "rank": 0,
+                      "fired": True}],
+        "elastic": {"min_world": 1, "max_world": 2},
+        "git_sha": "abc1234",
+    }
+
+
+def test_train_chaos_valid_artifact_passes(tmp_path):
+    assert _problems_for("TRAIN_CHAOS_x.json", _chaos_ok(),
+                         tmp_path) == []
+
+
+def test_train_chaos_rejects_zero_injected_faults(tmp_path):
+    bad = _chaos_ok()
+    bad["injected"] = {k: 0 for k in bad["injected"]}
+    probs = _problems_for("TRAIN_CHAOS_x.json", bad, tmp_path)
+    assert any("zero faults" in p for p in probs)
+
+
+def test_train_chaos_rejects_duplicate_and_missing_steps(tmp_path):
+    dup = dict(_chaos_ok(), duplicate_steps=3)
+    probs = _problems_for("TRAIN_CHAOS_x.json", dup, tmp_path)
+    assert any("duplicate" in p for p in probs)
+    miss = dict(_chaos_ok(), missing_steps=2)
+    probs = _problems_for("TRAIN_CHAOS_x.json", miss, tmp_path)
+    assert any("missing" in p for p in probs)
+
+
+def test_train_chaos_rejects_lost_progress_beyond_interval(tmp_path):
+    bad = dict(_chaos_ok(), max_lost_steps=7)
+    probs = _problems_for("TRAIN_CHAOS_x.json", bad, tmp_path)
+    assert any("checkpoint interval" in p for p in probs)
+    # Exactly one interval is the contract boundary: allowed.
+    edge = dict(_chaos_ok(), max_lost_steps=6)
+    assert _problems_for("TRAIN_CHAOS_x.json", edge, tmp_path) == []
+
+
+def test_train_chaos_rejects_missing_seed(tmp_path):
+    bad = _chaos_ok()
+    del bad["seed"]
+    probs = _problems_for("TRAIN_CHAOS_x.json", bad, tmp_path)
+    assert any("seed" in p for p in probs)
+
+
+def test_train_chaos_rejects_loss_divergence(tmp_path):
+    bad = dict(_chaos_ok(), loss_max_abs_err=0.25)
+    probs = _problems_for("TRAIN_CHAOS_x.json", bad, tmp_path)
+    assert any("diverged" in p for p in probs)
+
+
+def test_train_chaos_requires_elastic_block(tmp_path):
+    bad = _chaos_ok()
+    del bad["elastic"]
+    probs = _problems_for("TRAIN_CHAOS_x.json", bad, tmp_path)
+    assert any("elastic" in p for p in probs)
+    bad = dict(_chaos_ok(), elastic={"min_world": 1})
+    assert _problems_for("TRAIN_CHAOS_x.json", bad, tmp_path)

@@ -1,0 +1,137 @@
+"""CheckpointManager tests: async saves, torn-checkpoint resolution,
+retention (reference analogue: train/tests/test_checkpoint_manager.py,
+re-targeted at the durable/elastic design of air/checkpoint_manager.py)."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.air.checkpoint import (MANIFEST_FILE, MANIFEST_FORMAT,
+                                    load_manifest)
+from ray_tpu.air.checkpoint_manager import CheckpointManager, step_dir_name
+
+
+def _plant_torn(root, step, payload=b"\x00torn\x00"):
+    """A directory that shallow-passes (sizes match) but deep-fails
+    (hash mismatch) — what a torn copy or bit rot looks like."""
+    torn = os.path.join(root, step_dir_name(step))
+    os.makedirs(torn, exist_ok=True)
+    with open(os.path.join(torn, "meta.pkl"), "wb") as f:
+        f.write(payload)
+    manifest = {"format": MANIFEST_FORMAT, "step": step, "wall_time": 0.0,
+                "files": {"meta.pkl": {"sha256": "0" * 64,
+                                       "bytes": len(payload)}}}
+    with open(os.path.join(torn, MANIFEST_FILE), "w") as f:
+        json.dump(manifest, f)
+    return torn
+
+
+def test_save_async_is_nonblocking_and_snapshots(tmp_path):
+    """The acceptance test for async checkpointing: save_async returns
+    while the commit is still in flight, and the committed bytes are
+    the values AT THE REQUESTED STEP even if the loop mutates its
+    arrays immediately after."""
+    gate = threading.Event()
+    mgr = CheckpointManager(str(tmp_path),
+                            pre_commit_hook=lambda s: gate.wait(10))
+    try:
+        w = np.arange(4.0)
+        t0 = time.monotonic()
+        handle = mgr.save_async({"w": w, "step": 5}, 5)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0, "save_async blocked on the commit"
+        assert not handle.done(), "commit is gated; cannot be done yet"
+        w += 100.0            # train step overlapping the save
+        gate.set()
+        assert handle.wait(10)
+        assert handle.committed and handle.error is None
+        assert load_manifest(handle.path)["step"] == 5
+        committed = np.asarray(mgr.latest_complete().to_dict()["w"])
+        np.testing.assert_array_equal(committed, np.arange(4.0))
+    finally:
+        gate.set()
+        mgr.close()
+
+
+def test_latest_complete_skips_torn_directory(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    try:
+        mgr.save({"w": np.zeros(2), "step": 0}, 0)
+        mgr.save({"w": np.ones(2), "step": 6}, 6)
+        _plant_torn(str(tmp_path), 12)
+        ck = mgr.latest_complete()
+        assert ck is not None
+        assert load_manifest(ck._path)["step"] == 6
+        assert mgr.latest_step() == 6
+    finally:
+        mgr.close()
+
+
+def test_latest_complete_none_when_only_torn(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    try:
+        _plant_torn(str(tmp_path), 3)
+        assert mgr.latest_complete() is None
+        assert mgr.latest_step() is None
+    finally:
+        mgr.close()
+
+
+def test_tmp_litter_is_invisible(tmp_path):
+    """`.tmp-*` staging litter (a crash mid-write) must never appear in
+    scans or resolution."""
+    mgr = CheckpointManager(str(tmp_path))
+    try:
+        mgr.save({"step": 2}, 2)
+        os.makedirs(str(tmp_path / ".tmp-step_00000009-dead"))
+        assert mgr.steps() == [2]
+        assert mgr.latest_step() == 2
+    finally:
+        mgr.close()
+
+
+def test_keep_last_k_prunes_only_complete(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_k=2)
+    try:
+        torn = _plant_torn(str(tmp_path), 1)
+        for step in (6, 12, 18, 24):
+            mgr.save({"step": step}, step)
+        kept = sorted(n for n in os.listdir(str(tmp_path))
+                      if n.startswith("step_"))
+        assert kept == [step_dir_name(1), step_dir_name(18),
+                        step_dir_name(24)]
+        assert os.path.isdir(torn), \
+            "torn directories are evidence, never pruned"
+    finally:
+        mgr.close()
+
+
+def test_writer_error_propagates(tmp_path):
+    boom = RuntimeError("disk on fire")
+
+    def hook(step):
+        raise boom
+
+    mgr = CheckpointManager(str(tmp_path), pre_commit_hook=hook)
+    try:
+        handle = mgr.save_async({"step": 1}, 1)
+        handle.wait(10)
+        assert handle.error is boom and not handle.committed
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            mgr.wait(10)
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            mgr.save({"step": 2}, 2)
+        assert mgr.latest_complete() is None, \
+            "a failed save must not leave a committed directory"
+    finally:
+        mgr.close()
+
+
+def test_save_after_close_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.close()
+    with pytest.raises(RuntimeError):
+        mgr.save_async({"step": 0}, 0)

@@ -1,0 +1,286 @@
+"""Chaos/elastic-training tests: seeded schedules, heartbeat hang
+detection, retry-budget semantics, preemption drain + regrow, and the
+full harness smoke (train/chaos.py + tools/chaos_train.py)."""
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from ray_tpu.air import (Checkpoint, FailureConfig, RunConfig,
+                         ScalingConfig, session)
+from ray_tpu.train import DataParallelTrainer, chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Schedule + worker-side gates
+# ---------------------------------------------------------------------------
+
+
+def test_make_schedule_deterministic_and_covering():
+    a = chaos.make_schedule(11, 120, 6)
+    b = chaos.make_schedule(11, 120, 6)
+    assert [e.as_dict() for e in a] == [e.as_dict() for e in b]
+    assert {e.kind for e in a} == set(chaos.KINDS)
+    ordered = sorted(e.at_step for e in a)
+    assert ordered[0] > 6, "no event before the first durable commit"
+    assert ordered[-1] <= 120 - 2 * 6
+    assert all(y - x >= 1 for x, y in zip(ordered, ordered[1:]))
+    # A different seed gives a different schedule.
+    c = chaos.make_schedule(12, 120, 6)
+    assert [e.as_dict() for e in a] != [e.as_dict() for e in c]
+
+
+def test_make_schedule_rejects_small_window():
+    with pytest.raises(ValueError):
+        chaos.make_schedule(0, 30, 6)
+    with pytest.raises(ValueError):
+        chaos.make_schedule(0, 100, 0)
+
+
+def test_fence_is_monotonic(tmp_path):
+    ctrl = str(tmp_path)
+    assert chaos.generation(ctrl) == 0
+    chaos.check_generation(ctrl, 0)          # no newer attempt: fine
+    assert chaos.fence(ctrl, 2) == 2
+    assert chaos.fence(ctrl, 1) == 2, "fence never regresses"
+    chaos.check_generation(ctrl, 2)
+    chaos.check_generation(ctrl, 5)          # newer-than-file is fine
+    with pytest.raises(chaos.StaleGeneration):
+        chaos.check_generation(ctrl, 1)
+
+
+def test_hang_gate_blocks_then_raises_and_is_one_shot(tmp_path):
+    chaos.reset_measurements()
+    ctrl = str(tmp_path)
+    path = os.path.join(ctrl, "hang-0")
+    with open(path, "w") as f:
+        f.write("ticket-1")
+    raised = []
+
+    def victim():
+        try:
+            chaos.hang_gate(ctrl, 0)
+        except chaos.HangReleased as e:
+            raised.append(e)
+
+    th = threading.Thread(target=victim, daemon=True)
+    th.start()
+    time.sleep(0.15)
+    assert th.is_alive(), "hang_gate must wedge while the file exists"
+    os.remove(path)
+    th.join(5)
+    assert raised, "released loop must raise, not resume"
+    # The ticket was consumed in-process: a replacement gang seeing the
+    # same ticket again must NOT re-wedge.
+    with open(path, "w") as f:
+        f.write("ticket-1")
+    chaos.hang_gate(ctrl, 0)                 # returns immediately
+    os.remove(path)
+    chaos.reset_measurements()
+
+
+# ---------------------------------------------------------------------------
+# Gang supervision
+# ---------------------------------------------------------------------------
+
+
+def test_hung_worker_detected_by_progress_deadline(rt):
+    """A worker that answers polls but stops reporting/heartbeating is
+    a PROGRESS death, not a liveness death — only the progress deadline
+    can catch it. Without detection this fit would hang forever, so
+    completion is the proof."""
+    def loop(config):
+        ckpt = session.get_checkpoint()
+        start = ckpt["step"] + 1 if ckpt else 0
+        wedge = session.get_world_rank() == 1 and \
+            session.get_attempt() == 0
+        for k in range(start, 12):
+            time.sleep(0.02)
+            if wedge and k == 2:
+                # Alive (the actor still answers polls) but silent:
+                # no report, no heartbeat. Bounded so the superseded
+                # thread eventually exits in the in-process runtime.
+                time.sleep(15)
+                raise RuntimeError("zombie past its usefulness")
+            if session.get_world_rank() == 0:
+                session.report(
+                    {"step": k},
+                    checkpoint=Checkpoint.from_dict({"step": k}))
+            else:
+                session.heartbeat()
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(failure_config=FailureConfig(
+            max_failures=1, worker_progress_deadline_s=0.4)))
+    t0 = time.monotonic()
+    result = trainer.fit()
+    assert result.ok, result.error
+    assert trainer.restarts == 1
+    assert time.monotonic() - t0 >= 0.4, \
+        "detection cannot precede the deadline"
+    steps = [m["step"] for m in result.metrics_history
+             if "step" in m]
+    assert steps == list(range(12)), steps
+
+
+def test_poll_all_isolates_dead_worker(rt):
+    """One dead actor yields a dead entry; the survivor's buffered
+    reports still come through the same poll."""
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    def loop(config):
+        session.report({"rank": session.get_world_rank()})
+        time.sleep(1.0)
+
+    group = WorkerGroup(2, {"CPU": 1})
+    try:
+        group.start_run(loop, {}, None, None)
+        time.sleep(0.2)                      # let both report
+        group.kill_worker(0)
+        polls = group.poll_all()
+        assert len(polls) == 2
+        assert polls[0]["dead"] and polls[0]["error"] is not None
+        assert not polls[1]["dead"]
+        reports = [m for m, _ in polls[1]["reports"]]
+        assert {"rank": 1} in reports
+    finally:
+        group.shutdown()
+
+
+def test_retry_budget_resets_on_durable_progress(rt):
+    """max_failures bounds CONSECUTIVE unproductive restarts: a crash
+    that arrives with a newer checkpoint than the previous crash resets
+    the budget, so three spaced crashes survive max_failures=1."""
+    def loop(config):
+        ckpt = session.get_checkpoint()
+        start = ckpt["step"] + 1 if ckpt else 0
+        att = session.get_attempt()
+        for k in range(start, 20):
+            session.report(
+                {"step": k},
+                checkpoint=Checkpoint.from_dict({"step": k}))
+            if (k, att) in ((5, 0), (11, 1), (17, 2)):
+                raise RuntimeError(f"intermittent fault at {k}")
+
+    trainer = DataParallelTrainer(
+        loop,
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=1)))
+    result = trainer.fit()
+    assert result.ok, result.error
+    assert trainer.restarts == 3
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps == list(range(20)), steps
+
+
+def test_retry_budget_exhausts_without_progress(rt):
+    """The same budget still refuses a fault loop that makes no durable
+    progress between failures."""
+    def loop(config):
+        ckpt = session.get_checkpoint()
+        start = ckpt["step"] + 1 if ckpt else 0
+        for k in range(start, 20):
+            if k >= 5:
+                # Crash BEFORE any report at 5+: every attempt fails
+                # with the same latest checkpoint (step 4) — zero
+                # durable progress between failures.
+                raise RuntimeError("hard fault at 5")
+            session.report(
+                {"step": k},
+                checkpoint=Checkpoint.from_dict({"step": k}))
+
+    trainer = DataParallelTrainer(
+        loop,
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=1)))
+    result = trainer.fit()
+    assert not result.ok
+    assert "hard fault" in str(result.error)
+    assert trainer.restarts == 1
+
+
+def test_preemption_drain_and_elastic_shrink_regrow(rt):
+    """Preemption notice -> checkpoint-now drain -> resume at reduced
+    size -> voluntary regrow when capacity returns. Steps stay
+    exactly-once across both transitions."""
+    cap = {"n": 2}
+    total = 60
+
+    def loop(config):
+        ckpt = session.get_checkpoint()
+        start = ckpt["step"] + 1 if ckpt else 0
+        for k in range(start, total):
+            time.sleep(0.02)
+            if session.get_world_rank() == 0:
+                session.report(
+                    {"step": k, "world": session.get_world_size()},
+                    checkpoint=Checkpoint.from_dict({"step": k}))
+            else:
+                session.heartbeat()
+            if session.preempted():
+                return                        # drained
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, min_workers=1),
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=2)),
+        elastic_capacity_fn=lambda: cap["n"],
+        elastic_wait_s=10.0)
+
+    def driver():
+        while (trainer.last_seen_step or 0) < 10:
+            time.sleep(0.01)
+        cap["n"] = 1                          # capacity squeezed...
+        trainer.notify_preemption(grace_s=2.0)
+        while (trainer.last_seen_step or 0) < 30:
+            time.sleep(0.01)
+        cap["n"] = 2                          # ...and back
+
+    th = threading.Thread(target=driver, daemon=True)
+    th.start()
+    result = trainer.fit()
+    th.join(10)
+    assert result.ok, result.error
+    assert trainer.preemptions == 1
+    assert trainer.resizes >= 1, "gang never regrew"
+    assert min(trainer.world_sizes) == 1
+    assert trainer.world_sizes[0] == 2 and trainer.world_sizes[-1] == 2
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps == list(range(total)), steps
+    worlds = {m["step"]: m["world"] for m in result.metrics_history}
+    assert 1 in worlds.values() and 2 in worlds.values(), \
+        "history must show both gang sizes"
+
+
+# ---------------------------------------------------------------------------
+# Full harness smoke
+# ---------------------------------------------------------------------------
+
+
+def test_run_chaos_smoke_produces_valid_artifact(rt, tmp_path):
+    """End-to-end: the seeded chaos run completes, every hard invariant
+    in run_chaos passes, and the artifact satisfies the TRAIN_CHAOS
+    schema family."""
+    import json
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_bench_schema as cbs
+    from tools.chaos_train import run_chaos
+
+    artifact = run_chaos(workdir=str(tmp_path / "chaos"))
+    for kind in chaos.KINDS:
+        assert artifact["injected"][kind] >= 1
+    out = tmp_path / "TRAIN_CHAOS_test.json"
+    out.write_text(json.dumps(artifact))
+    problems = []
+    cbs.check_file(str(out), problems)
+    assert not problems, problems

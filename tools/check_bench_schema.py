@@ -34,6 +34,15 @@ family:
   replica timeline is missing/flat, or when the autoscaled arm
   consumed >= the static arm's chip-seconds
 
+- TRAIN_CHAOS_*.json (tools/chaos_train.py): seeded chaos run
+  against a real elastic training fit. REFUSED when the run
+  injected zero faults (a chaos artifact without chaos proves
+  nothing), when any step appears in the metrics history twice or
+  goes missing (exactly-once resume contract), when more than one
+  checkpoint interval of progress was lost at any restart, when the
+  seed is missing (the run must be reproducible), or when the loss
+  curve diverged from the deterministic replay.
+
 Engine serve results may also carry a `lifecycle` block
 (engine.lifecycle_stats()): retry-policy knobs
 (max_queued/max_retries/retry_backoff_s) + request-lifecycle
@@ -41,8 +50,8 @@ counters (shed/cancelled/deadline_exceeded/...), validated whenever
 present.
 
 Usage: python tools/check_bench_schema.py [FILES...]
-       (no FILES: validates every SERVE_BENCH_*.json / BENCH_*.json
-       in the repo root)
+       (no FILES: validates every SERVE_BENCH_*.json / BENCH_*.json /
+       TRAIN_CHAOS_*.json in the repo root)
 Exit 0 when every file validates; 1 otherwise, listing each problem.
 """
 import glob
@@ -153,6 +162,26 @@ BENCH_WRAPPER_REQUIRED = {
     "cmd": str,
     "rc": int,
     "tail": str,
+}
+
+# chaos-training artifacts (tools/chaos_train.py): the fault mix, the
+# recovery counters, and the exactly-once/lost-progress invariants the
+# run asserted. `injected` is validated separately (per-kind counts),
+# as are the refusal rules below.
+TRAIN_CHAOS_REQUIRED = {
+    "seed": int,
+    "steps_total": int,
+    "checkpoint_interval": int,
+    "workers": int,
+    "restarts": int,
+    "preemptions": int,
+    "resizes": int,
+    "duplicate_steps": int,
+    "missing_steps": int,
+    "max_lost_steps": int,
+    "loss_max_abs_err": NUM,
+    "final_step": int,
+    "wall_s": NUM,
 }
 
 
@@ -490,6 +519,70 @@ def check_serve_bench(obj, name, problems):
         problems.append(f"{name}: git_sha must be a string")
 
 
+def check_train_chaos(obj, name, problems):
+    """tools/chaos_train.py artifact: a seeded chaos schedule ran
+    against a real elastic training fit. The checker REFUSES artifacts
+    whose run violated the preemption-tolerance contract the harness
+    exists to prove — zero injected faults, duplicate or missing steps
+    in the final history, more than one checkpoint interval of lost
+    progress at any restart, a loss curve that diverged from the
+    deterministic replay, or a missing seed (irreproducible chaos is
+    an anecdote, not a test)."""
+    _check_fields(obj, TRAIN_CHAOS_REQUIRED, name, problems)
+    inj = obj.get("injected")
+    if not isinstance(inj, dict):
+        problems.append(f"{name}: chaos artifact missing the "
+                        "'injected' fault-count object")
+    else:
+        total = 0
+        for kind, n in inj.items():
+            if not isinstance(n, int) or isinstance(n, bool):
+                problems.append(f"{name}:injected: count for "
+                                f"{kind!r} must be int")
+            else:
+                total += n
+        if total == 0:
+            problems.append(f"{name}: chaos run injected zero faults "
+                            "— the artifact proves nothing")
+    sched = obj.get("schedule")
+    if not isinstance(sched, list) or not sched:
+        problems.append(f"{name}: schedule must be a non-empty list")
+    dup = obj.get("duplicate_steps")
+    if isinstance(dup, int) and not isinstance(dup, bool) and dup != 0:
+        problems.append(f"{name}: {dup} duplicate step(s) in the "
+                        "metrics history — resume replayed steps it "
+                        "had already durably reported")
+    miss = obj.get("missing_steps")
+    if isinstance(miss, int) and not isinstance(miss, bool) \
+            and miss != 0:
+        problems.append(f"{name}: {miss} step(s) missing from the "
+                        "metrics history — resume skipped work")
+    lost = obj.get("max_lost_steps")
+    interval = obj.get("checkpoint_interval")
+    if isinstance(lost, int) and isinstance(interval, int) \
+            and not isinstance(lost, bool) and lost > interval:
+        problems.append(
+            f"{name}: a restart lost {lost} steps of progress, more "
+            f"than one checkpoint interval ({interval}) — durable "
+            "checkpoints are not keeping up")
+    err = obj.get("loss_max_abs_err")
+    if isinstance(err, NUM) and not isinstance(err, bool) \
+            and err > 1e-5:
+        problems.append(
+            f"{name}: loss curve diverged from the deterministic "
+            f"replay (max abs err {err}) — resumed state != "
+            "checkpointed state")
+    elastic = obj.get("elastic")
+    if not isinstance(elastic, dict) or \
+            not isinstance(elastic.get("min_world"), int) or \
+            not isinstance(elastic.get("max_world"), int):
+        problems.append(f"{name}: chaos artifact missing the elastic "
+                        "{min_world, max_world} block")
+    sha = obj.get("git_sha")
+    if sha is not None and not isinstance(sha, str):
+        problems.append(f"{name}: git_sha must be a string")
+
+
 def check_bench(obj, name, problems):
     if "metric" in obj:            # flat metric row (BENCH_SELF_*)
         _check_fields(obj, FLAT_METRIC_REQUIRED, name, problems)
@@ -518,7 +611,9 @@ def check_file(path, problems):
     if not isinstance(obj, dict):
         problems.append(f"{name}: top level must be a JSON object")
         return
-    if name.startswith("SERVE_BENCH"):
+    if name.startswith("TRAIN_CHAOS"):
+        check_train_chaos(obj, name, problems)
+    elif name.startswith("SERVE_BENCH"):
         check_serve_bench(obj, name, problems)
     else:
         check_bench(obj, name, problems)
@@ -531,7 +626,9 @@ def main(argv):
             os.path.abspath(__file__)))
         files = sorted(glob.glob(os.path.join(root,
                                               "SERVE_BENCH_*.json")) +
-                       glob.glob(os.path.join(root, "BENCH_*.json")))
+                       glob.glob(os.path.join(root, "BENCH_*.json")) +
+                       glob.glob(os.path.join(root,
+                                              "TRAIN_CHAOS_*.json")))
     if not files:
         print("no bench artifacts found")
         return 0
