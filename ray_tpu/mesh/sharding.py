@@ -85,6 +85,67 @@ class ShardingRules:
             lambda path, leaf: self.spec_for(
                 "/".join(_path_str(p) for p in path), leaf), tree)
 
+    def matches(self, name: str, leaf: Any) -> bool:
+        """True iff some rule (not the implicit replicate default)
+        covers this leaf. Scalars count as matched: replicating a
+        scalar is always right."""
+        shape = getattr(leaf, "shape", ())
+        if not shape or int(np.prod(shape)) <= 1:
+            return True
+        return any(pat.search(name) for pat, _ in self._rules)
+
+    def unmatched_paths(self, tree, min_ndim: int = 2) -> List[str]:
+        """Parameter paths that fell through to the implicit replicate
+        default. Only leaves with ``ndim >= min_ndim`` are reported:
+        1-D norm scales / biases legitimately replicate, but a matrix
+        nobody wrote a rule for is almost always a sharding bug —
+        silently replicated, it costs full-size HBM on every device."""
+        out = []
+        for name, leaf in _flatten_with_paths(tree):
+            shape = getattr(leaf, "shape", ())
+            if len(shape) < min_ndim:
+                continue
+            if not self.matches(name, leaf):
+                out.append(name)
+        return out
+
+
+def match_partition_rules(rules: Union["ShardingRules", Rules], tree,
+                          *, on_unmatched: str = "raise",
+                          min_ndim: int = 2) -> Any:
+    """Apply regex partition rules to a parameter pytree, refusing to
+    let a large tensor silently end up replicated.
+
+    ``rules`` is a ShardingRules or a raw ``[(regex, PartitionSpec)]``
+    list. Returns a pytree of PartitionSpecs (same structure as
+    ``tree``). Every leaf with ``ndim >= min_ndim`` must be covered by
+    an explicit rule; uncovered paths are handled per ``on_unmatched``:
+
+    - ``"raise"`` (default): ValueError listing every unmatched path —
+      the safe mode for model weights, where an unnoticed fall-through
+      to replication wastes a full weight copy per device.
+    - ``"warn"``: print one warning naming the paths, then replicate.
+    - ``"ignore"``: replicate silently (the pre-existing behavior).
+    """
+    if on_unmatched not in ("raise", "warn", "ignore"):
+        raise ValueError(
+            f"on_unmatched must be 'raise'|'warn'|'ignore', "
+            f"got {on_unmatched!r}")
+    if not isinstance(rules, ShardingRules):
+        rules = ShardingRules(rules)
+    if on_unmatched != "ignore":
+        unmatched = rules.unmatched_paths(tree, min_ndim=min_ndim)
+        if unmatched:
+            msg = (f"match_partition_rules: {len(unmatched)} "
+                   f"parameter(s) with ndim >= {min_ndim} matched no "
+                   f"rule and would be REPLICATED on every device: "
+                   + ", ".join(sorted(unmatched)))
+            if on_unmatched == "raise":
+                raise ValueError(msg)
+            import warnings
+            warnings.warn(msg, stacklevel=2)
+    return rules.tree_specs(tree)
+
 
 def _path_str(p) -> str:
     if isinstance(p, jax.tree_util.DictKey):

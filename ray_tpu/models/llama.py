@@ -541,6 +541,25 @@ def llama_sharding_rules(fsdp: bool = True) -> ShardingRules:
     ])
 
 
+def llama_tp_validate(cfg: LlamaConfig, tp: int) -> None:
+    """Check that ``cfg`` divides evenly over a ``tp``-way tensor mesh
+    under llama_sharding_rules: heads and kv heads (head-sharded
+    attention + KV pool), hidden_dim (column/row-parallel MLP), and
+    vocab (vocab-parallel embedding / tied logits). Raises ValueError
+    naming the offending dimension — GSPMD would otherwise pad or
+    fall back to unexpected reshards silently."""
+    if tp <= 0:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    for what, n in (("n_heads", cfg.n_heads),
+                    ("n_kv_heads", cfg.n_kv_heads),
+                    ("hidden_dim", cfg.hidden_dim),
+                    ("vocab_size", cfg.vocab_size)):
+        if n % tp:
+            raise ValueError(
+                f"tensor parallelism tp={tp} does not divide "
+                f"{what}={n} for this Llama config")
+
+
 def llama_param_count(cfg: LlamaConfig) -> int:
     per_layer = (cfg.dim * cfg.n_heads * cfg.head_dim +
                  2 * cfg.dim * cfg.n_kv_heads * cfg.head_dim +

@@ -197,6 +197,26 @@ def mixtral_sharding_rules(fsdp: bool = True) -> ShardingRules:
     ])
 
 
+def mixtral_tp_validate(cfg: MixtralConfig, tp: int,
+                        ep: int = 1) -> None:
+    """Check ``cfg`` divides over a ``tp``-way tensor x ``ep``-way
+    expert mesh under mixtral_sharding_rules: attention like Llama,
+    expert hidden dim over tensor, expert count over expert. Raises
+    ValueError naming the offending dimension."""
+    from ray_tpu.models.llama import llama_tp_validate
+    llama_tp_validate(cfg.attention_config(), tp)
+    if ep <= 0:
+        raise ValueError(f"ep must be >= 1, got {ep}")
+    if cfg.num_experts % ep:
+        raise ValueError(
+            f"expert parallelism ep={ep} does not divide "
+            f"num_experts={cfg.num_experts}")
+    if cfg.hidden_dim % tp:
+        raise ValueError(
+            f"tensor parallelism tp={tp} does not divide expert "
+            f"hidden_dim={cfg.hidden_dim}")
+
+
 def moe_aux_loss(variables) -> jnp.ndarray:
     """Mean load-balance loss over layers (add `mutable=['losses']` to
     apply, then weight this into the training loss)."""

@@ -53,6 +53,61 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+class PagedShapeError(ValueError):
+    """Typed shape/dtype mismatch between a KV chunk and the page pool.
+
+    Raised at trace time by ``paged_append`` — shapes are static under
+    jit, so every check below fires before lowering, replacing the
+    opaque XLA scatter errors (dimension-numbers mismatches deep in
+    HLO) these bugs used to surface as. The message names the operand
+    and both shapes so a head-count or head-dim mismatch (the classic
+    tensor-parallel wiring bug: sharded pool, unsharded chunk) reads
+    as what it is.
+    """
+
+
+def _check_append_shapes(pages_k, pages_v, page_table, pos, k, v):
+    if pages_k.ndim != 4 or pages_v.ndim != 4:
+        raise PagedShapeError(
+            f"pages_k/pages_v must be rank-4 [KH, n_pages, Pg, D]; "
+            f"got pages_k {pages_k.shape}, pages_v {pages_v.shape}")
+    if pages_k.shape != pages_v.shape:
+        raise PagedShapeError(
+            f"pages_k and pages_v disagree: {pages_k.shape} vs "
+            f"{pages_v.shape}")
+    if k.ndim != 4 or v.ndim != 4:
+        raise PagedShapeError(
+            f"k/v chunks must be rank-4 [B, T, KH, D]; got k "
+            f"{k.shape}, v {v.shape}")
+    if k.shape != v.shape:
+        raise PagedShapeError(
+            f"k and v chunks disagree: {k.shape} vs {v.shape}")
+    KH, _, _, D = pages_k.shape
+    if k.shape[2] != KH:
+        raise PagedShapeError(
+            f"chunk has {k.shape[2]} kv heads but the page pool holds "
+            f"{KH} (pool {pages_k.shape}, chunk {k.shape}) — under "
+            f"tensor parallelism both must be the per-device count")
+    if k.shape[3] != D:
+        raise PagedShapeError(
+            f"chunk head_dim {k.shape[3]} != pool head_dim {D} "
+            f"(pool {pages_k.shape}, chunk {k.shape})")
+    if page_table.ndim != 2:
+        raise PagedShapeError(
+            f"page_table must be rank-2 [B, max_pages]; got "
+            f"{page_table.shape}")
+    if page_table.shape[0] != k.shape[0]:
+        raise PagedShapeError(
+            f"page_table has {page_table.shape[0]} rows but the chunk "
+            f"has batch {k.shape[0]}")
+    if not jnp.issubdtype(page_table.dtype, jnp.integer):
+        raise PagedShapeError(
+            f"page_table must be integer, got {page_table.dtype}")
+    if pos.shape != (k.shape[0],):
+        raise PagedShapeError(
+            f"pos must be [B]={k.shape[0]}; got shape {pos.shape}")
+
+
 def paged_append(pages_k, pages_v, page_table, pos, k, v):
     """Scatter a [B, T] chunk of new K/V into the head-major page pool
     at each slot's current write offset (append-at-offset: the chunk
@@ -73,7 +128,11 @@ def paged_append(pages_k, pages_v, page_table, pos, k, v):
     for inactive slots. Logical positions are clamped to the
     addressable window so a padded tail can never alias another
     slot's pages through index clamping.
+
+    Raises :class:`PagedShapeError` at trace time on any rank / head /
+    head-dim / batch mismatch between the chunk and the pool.
     """
+    _check_append_shapes(pages_k, pages_v, page_table, pos, k, v)
     B, T = k.shape[:2]
     Pg = pages_k.shape[2]
     max_pages = page_table.shape[1]

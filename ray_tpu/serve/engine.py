@@ -310,9 +310,19 @@ class LLMEngine:
                  max_retries: int = 2,
                  retry_backoff_s: float = 0.02,
                  shed_retry_after_s: float = 1.0,
+                 sharding=None,
                  fault_injector=None):
         self.model = model
         self.cfg = model.config
+        # Tensor-parallel placement (serve/sharding.py
+        # EngineSharding): weights go down per the family's partition
+        # rules, the KV pool head-shards over the ``tensor`` axis, and
+        # every host->device operand commits replicated via _h2d.
+        # Everything below the placement layer is sharding-oblivious —
+        # same planner, same jitted step structure, same page tables.
+        self._sharding = sharding
+        if sharding is not None:
+            params = sharding.shard_params(params)
         self.params = params
         self.S = max_slots
         self.Pg = page_size
@@ -330,6 +340,8 @@ class LLMEngine:
                              -(-self.cfg.max_seq_len // page_size))
         self.alloc = BlockAllocator(n_pages)
         self.pages = init_kv_pool(self.cfg, n_pages, page_size)
+        if sharding is not None:
+            self.pages = sharding.place_kv_pool(self.pages)
         # Radix-tree prefix KV cache (serve/prefix_cache.py): retired
         # prompts' full pages enter the tree instead of the free list;
         # admission matches the longest cached prefix and skips its
@@ -360,7 +372,7 @@ class LLMEngine:
         self._work = threading.Condition(self._lock)
         self._rid = itertools.count()
         self._admit_seq = itertools.count()
-        self._rng = jax.random.PRNGKey(seed)
+        self._rng = self._h2d(jax.random.PRNGKey(seed))
         # trailing readbacks: [(buf_dev, [(ix, slot, take), ...], steps)]
         self._fetchq: "collections.deque" = collections.deque()
         # in-flight prefills: [(firsts_dev, [(ix, slot, row), ...])]
@@ -370,8 +382,8 @@ class LLMEngine:
         # dispatch — no host readback sits on the decode critical
         # path. Admission seeds rows via a jitted scatter (no sync);
         # host readbacks trail for emission only.
-        self._dev_cur = jnp.zeros((max_slots,), jnp.int32)
-        self._dev_pos = jnp.zeros((max_slots,), jnp.int32)
+        self._dev_cur = self._h2d(jnp.zeros((max_slots,), jnp.int32))
+        self._dev_pos = self._h2d(jnp.zeros((max_slots,), jnp.int32))
         # Without an eos the schedule is fully deterministic: slots
         # retire by arithmetic at dispatch time and host syncs never
         # gate scheduling. With an eos, completions depend on sampled
@@ -416,6 +428,26 @@ class LLMEngine:
         self._ttft_ewma_alpha = 0.2
         self._decode_fn = self._build_decode()
         self._seed_fn = self._build_seed()
+
+    def _h2d(self, x):
+        """Host->device for dispatch operands (page tables, token
+        chunks, positions, rng keys). Unsharded: plain jnp.asarray
+        (byte-identical to the pre-TP engine). Sharded: commit
+        REPLICATED onto the replica's mesh — an uncommitted
+        single-device array would make every jitted call re-broadcast
+        it from device 0 and spam donation warnings."""
+        if self._sharding is None:
+            return jnp.asarray(x)
+        return self._sharding.replicate(jnp.asarray(x))
+
+    def _constrain_kv(self, pages):
+        """Pin a jitted step's output KV pool to the head-sharded
+        layout (no-op unsharded). Keeps GSPMD from ever resharding
+        the pool mid-graph — resharding would break the
+        donate-and-alias discipline AND introduce KV collectives."""
+        if self._sharding is None:
+            return pages
+        return self._sharding.constrain_kv(pages)
 
     # ---------------------------------------------------------- public
 
@@ -561,6 +593,8 @@ class LLMEngine:
                 "ttft_ewma_s": self._ttft_ewma,
                 "draining": self._draining,
                 "stopped": self._stopped,
+                "tp": (self._sharding.tp
+                       if self._sharding is not None else 1),
                 "prefix_digest": (self.prefix_cache.digest()
                                   if self.prefix_cache is not None
                                   else frozenset()),
@@ -585,6 +619,8 @@ class LLMEngine:
                 "ttft_ewma_s": self._ttft_ewma,
                 "draining": self._draining,
                 "stopped": self._stopped,
+                "tp": (self._sharding.tp
+                       if self._sharding is not None else 1),
                 "prefix_digest": frozenset()}
 
     def shutdown(self):
@@ -1077,8 +1113,8 @@ class LLMEngine:
                 # duplicate the boundary page on-stream before any
                 # write can target it, then drop the borrowed ref
                 self.pages = self._copy_page_fn(
-                    self.pages, jnp.int32(copy_src),
-                    jnp.int32(page_ids[0]))
+                    self.pages, self._h2d(jnp.int32(copy_src)),
+                    self._h2d(jnp.int32(page_ids[0])))
                 self.prefix_cache.release([copy_src])
             self._wait.popleft()
             slot = _Slot(req=req, pages=shared_pages + page_ids,
@@ -1293,9 +1329,9 @@ class LLMEngine:
             return
         (toks, self.pages, self._rng, self._dev_pos,
          self._dev_cur) = self._decode_fn(
-            self.params, self.pages, jnp.asarray(pt),
+            self.params, self.pages, self._h2d(pt),
             self._dev_pos, self._dev_cur, self._rng,
-            jnp.int32(steps))
+            self._h2d(jnp.int32(steps)))
         # host mirrors advance NOW; emission trails
         for _i, slot, _t in riders:
             slot.pos += steps
@@ -1399,8 +1435,8 @@ class LLMEngine:
             start[i] = slot.pos
             pt[i, :len(slot.pages)] = slot.pages
         out_dev, self.pages = self._verify_fn(
-            self.params, self.pages, jnp.asarray(ids),
-            jnp.asarray(start), jnp.asarray(pt))
+            self.params, self.pages, self._h2d(ids),
+            self._h2d(start), self._h2d(pt))
         out = np.asarray(out_dev)    # host sync: acceptance gates
         m = spec_decode.metrics()
         self.stats["spec_rounds"] += 1
@@ -1443,10 +1479,10 @@ class LLMEngine:
                 n_seed += 1
         if n_seed:
             self._dev_cur, self._dev_pos = self._seed_fn(
-                self._dev_cur, self._dev_pos, jnp.asarray(toks),
-                jnp.asarray(ixs),
-                jnp.arange(self.S, dtype=jnp.int32),
-                jnp.asarray(posv))
+                self._dev_cur, self._dev_pos, self._h2d(toks),
+                self._h2d(ixs),
+                self._h2d(jnp.arange(self.S, dtype=jnp.int32)),
+                self._h2d(posv))
 
     def spec_stats(self) -> Optional[Dict[str, Any]]:
         """Speculative-decoding counters (None when speculation is
@@ -1639,9 +1675,9 @@ class LLMEngine:
             last_idx[r] = take - 1
             pt[r, :len(slot.pages)] = slot.pages
         firsts, self.pages, self._rng = fn(
-            self.params, self.pages, jnp.asarray(ids),
-            jnp.asarray(start), jnp.asarray(last_idx),
-            jnp.asarray(pt), self._rng)
+            self.params, self.pages, self._h2d(ids),
+            self._h2d(start), self._h2d(last_idx),
+            self._h2d(pt), self._rng)
         placements = []
         for r, (ix, slot, take) in enumerate(rows):
             slot.prefilled += take
@@ -1659,7 +1695,7 @@ class LLMEngine:
             ixs[r], rws[r], posv[r] = ix, row, slot.pos
         self._dev_cur, self._dev_pos = self._seed_fn(
             self._dev_cur, self._dev_pos, firsts,
-            jnp.asarray(ixs), jnp.asarray(rws), jnp.asarray(posv))
+            self._h2d(ixs), self._h2d(rws), self._h2d(posv))
         for ix, slot, _row in placements:
             slot.cur = -1      # device-seeded: ridable
         # firsts also stays on device for EMISSION: its readback
@@ -1684,6 +1720,7 @@ class LLMEngine:
         only for rows that just finished their prompt."""
         model, temp = self.model, self.temperature
         B = self._max_prefill_batch
+        constrain = self._constrain_kv
         from ray_tpu.models.llama import _pick_token
 
         def prefill(params, pages, ids, start, last_idx, page_table,
@@ -1693,7 +1730,8 @@ class LLMEngine:
                   for pk, pv in pages]
             logits, new_kv = model.apply(params, ids, kv_caches=kv,
                                          cache_len=start)
-            new_pages = [(c.pages_k, c.pages_v) for c in new_kv]
+            new_pages = constrain(
+                [(c.pages_k, c.pages_v) for c in new_kv])
             last = logits[jnp.arange(B), last_idx]        # [B, V]
             firsts = _pick_token(last, sub, temp)
             return firsts, new_pages, rng
@@ -1711,13 +1749,15 @@ class LLMEngine:
         compare on the host. No rng threading — speculation is
         disabled at temperature > 0."""
         model = self.model
+        constrain = self._constrain_kv
 
         def verify(params, pages, ids, start, page_table):
             kv = [PagedKVLayer(pk, pv, page_table)
                   for pk, pv in pages]
             logits, new_kv = model.apply(params, ids, kv_caches=kv,
                                          cache_len=start)
-            new_pages = [(c.pages_k, c.pages_v) for c in new_kv]
+            new_pages = constrain(
+                [(c.pages_k, c.pages_v) for c in new_kv])
             return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
                     new_pages)
 
@@ -1726,6 +1766,7 @@ class LLMEngine:
     def _build_decode(self):
         model, temp = self.model, self.temperature
         KMAX, S = self.KMAX, self.S
+        constrain = self._constrain_kv
         from ray_tpu.models.llama import _pick_token
 
         def decode(params, pages, page_table, pos, cur, rng, steps):
@@ -1747,7 +1788,11 @@ class LLMEngine:
                 logits, new_kv = model.apply(
                     params, cur[:, None], kv_caches=kv, cache_len=pos)
                 nxt = _pick_token(logits[:, -1], sub, temp)
-                new_pages = [(c.pages_k, c.pages_v) for c in new_kv]
+                # pin the loop-carried pool to the head-sharded layout
+                # so the carry's sharding is loop-invariant (GSPMD
+                # would otherwise be free to reshard mid-carry)
+                new_pages = constrain(
+                    [(c.pages_k, c.pages_v) for c in new_kv])
                 return (new_pages, pos + 1, nxt, key, buf.at[i].set(nxt))
             pages, pos, cur, key, buf = jax.lax.fori_loop(
                 0, steps, body, (pages, pos, cur, rng, buf0))
@@ -1763,11 +1808,16 @@ class LLMEngine:
         prompt is FULLY cached — the final matched page is duplicated
         into a private page so the one-token re-prefill (the model
         needs the last position's logits) never scatters into a
-        shared page. src/dst are traced scalars: one executable."""
+        shared page. src/dst are traced scalars: one executable.
+        Under tensor parallelism the copy stays device-local: axis 0
+        (the sharded kv-head axis) is untouched, each device
+        duplicates its own head shard of the page."""
+        constrain = self._constrain_kv
+
         def copy(pages, src, dst):
-            return [(pk.at[:, dst].set(pk[:, src]),
-                     pv.at[:, dst].set(pv[:, src]))
-                    for pk, pv in pages]
+            return constrain([(pk.at[:, dst].set(pk[:, src]),
+                               pv.at[:, dst].set(pv[:, src]))
+                              for pk, pv in pages])
         return jax.jit(copy, donate_argnums=(0,))
 
     def _build_seed(self):

@@ -885,7 +885,12 @@ class EnginePool:
                "ttft_ewma_s": None,
                "n_replicas": len(self._replicas),
                "active_replicas": self.active_count(),
-               "healthy_replicas": self.healthy_count()}
+               "healthy_replicas": self.healthy_count(),
+               # 2-D scale-out stamp: tp devices per replica x
+               # n_replicas slices — uniform across a pool (replicas
+               # are interchangeable), so the max IS the value
+               "tp": max((rpt.get("tp", 1) for rpt in reports),
+                         default=1)}
         for rpt in reports:
             agg["free_slots"] += rpt["free_slots"]
             agg["free_pages"] += rpt["free_pages"]

@@ -46,6 +46,8 @@ class LlamaDeployment:
                  retry_backoff_s: float = 0.02,
                  num_engine_replicas: int = 1,
                  pool_auto_restart: bool = True,
+                 tensor_parallel: int = 1,
+                 expert_parallel: int = 1,
                  autoscale: bool = False,
                  autoscale_max_replicas: Optional[int] = None,
                  autoscale_policy: Optional[Dict[str, Any]] = None,
@@ -90,6 +92,23 @@ class LlamaDeployment:
             raise ValueError("num_engine_replicas must be >= 1")
         self.num_engine_replicas = num_engine_replicas
         self.pool_auto_restart = pool_auto_restart
+        # Tensor/expert parallelism WITHIN a replica
+        # (serve/sharding.py EngineSharding): each engine shards its
+        # weights + head-sharded KV pool over tp*ep devices.
+        # Composes orthogonally with num_engine_replicas — 2-D
+        # scale-out: shard within a slice x replicate across slices
+        # (replica_device_groups hands each pool member its own
+        # device group). Validated eagerly so a non-dividing config
+        # fails at deployment construction, not first request.
+        if tensor_parallel < 1 or expert_parallel < 1:
+            raise ValueError("tensor_parallel/expert_parallel must "
+                             "be >= 1")
+        self.tensor_parallel = int(tensor_parallel)
+        self.expert_parallel = int(expert_parallel)
+        if self.tensor_parallel > 1 or self.expert_parallel > 1:
+            from ray_tpu.serve.sharding import validate_tp
+            validate_tp(self.cfg, self.tensor_parallel,
+                        self.expert_parallel)
         # SLO-driven pool autoscaling (serve/pool_autoscaler.py):
         # num_engine_replicas becomes the FLOOR, autoscale_max_replicas
         # the ceiling, and a PoolAutoscaler drives the pool between
@@ -148,6 +167,24 @@ class LlamaDeployment:
                     per_seq = -(-self.cfg.max_seq_len
                                 // opts["page_size"])
                     opts["n_pages"] = opts["max_slots"] * per_seq + 1
+                per = self.tensor_parallel * self.expert_parallel
+
+                def _replica_sharding(idx):
+                    # One EngineSharding per replica over its own
+                    # device group (2-D scale-out). Recomputed on
+                    # restart/scale-up for whatever idx the pool
+                    # hands us — the group assignment is pure
+                    # arithmetic, so a rebuilt replica idx lands on
+                    # the same devices its predecessor used.
+                    if per == 1:
+                        return None
+                    from ray_tpu.serve.sharding import (
+                        EngineSharding, replica_device_groups)
+                    group = replica_device_groups(idx + 1, per)[idx]
+                    return EngineSharding.build(
+                        self.cfg, tp=self.tensor_parallel,
+                        ep=self.expert_parallel, devices=group)
+
                 if self.num_engine_replicas > 1 or self.autoscale:
                     from ray_tpu.serve.engine_pool import EnginePool
 
@@ -155,7 +192,9 @@ class LlamaDeployment:
                         return LLMEngine(
                             self.model, self.params,
                             temperature=self.temperature,
-                            seed=idx, **_opts)
+                            seed=idx,
+                            sharding=_replica_sharding(idx),
+                            **_opts)
 
                     self._engine = EnginePool(
                         factory, self.num_engine_replicas,
@@ -174,7 +213,9 @@ class LlamaDeployment:
                 else:
                     self._engine = LLMEngine(
                         self.model, self.params,
-                        temperature=self.temperature, **opts).start()
+                        temperature=self.temperature,
+                        sharding=_replica_sharding(0),
+                        **opts).start()
             return self._engine
 
     def autoscaler(self):

@@ -55,6 +55,15 @@ from __future__ import annotations
 import dataclasses
 from typing import Sequence, Tuple
 
+# Device-count-agnosticism CONTRACT, test-enforced
+# (tests/test_scheduler_guard.py): the planner may import nothing
+# beyond this list — in particular never jax / jaxlib / numpy — and
+# never reads device topology. One StepPlan must drive a 1-chip engine
+# and an N-way tensor-parallel engine identically; the moment a device
+# count leaks in here, sharded and unsharded replicas plan different
+# rounds and token parity dies.
+ALLOWED_IMPORTS = frozenset({"__future__", "dataclasses", "typing"})
+
 
 @dataclasses.dataclass(frozen=True)
 class SlotView:

@@ -1,0 +1,214 @@
+"""Tensor-parallel sharding layer for the serving engine.
+
+One replica of the serving engine stops meaning "one chip" here: an
+:class:`EngineSharding` binds a model config to a 1-D ``tensor`` mesh
+(2-D ``expert`` x ``tensor`` for Mixtral) over an ICI slice, resolves
+the family's regex partition rules through the strict
+``match_partition_rules`` gate (a matrix nobody wrote a rule for can
+never silently replicate), and places both the weights and the paged
+KV pool:
+
+- Weights follow Megatron discipline (``models/llama.py``
+  ``llama_sharding_rules``): column-parallel wq/wk/wv/w1/w3,
+  row-parallel wo/w2, vocab-parallel embeddings; Mixtral adds
+  expert-parallel w1/w3/w2 over the ``expert`` axis with a replicated
+  router (``mixtral_sharding_rules``).
+- The KV pool is HEAD-sharded: the head-major layout
+  ``[n_kv_heads, n_pages, page_size, head_dim]`` shards axis 0 over
+  ``tensor``, so every KV operation the engine performs —
+  ``paged_append`` scatter, decode gather, spec-verify, prefix-cache
+  page copy — indexes only the page/offset axes and stays
+  device-local. No KV collectives exist; the only cross-device
+  traffic is the two standard psums per layer (row-parallel wo / w2
+  reductions) plus the exact vocab-parallel logit reduction.
+
+Everything host-side is device-count-agnostic by construction: the
+scheduler plans in tokens and slots (it cannot even import jax —
+``serve/scheduler.py`` ALLOWED_IMPORTS), the prefix cache and block
+allocator track page NUMBERS (one logical page = one shard-local tile
+on every device), and the spec decoder proposes token ids. One
+``StepPlan`` drives a 1-chip and an N-way engine identically, which is
+what the tp=1 vs tp=4 token-parity tests enforce.
+
+Composition with the replica pool is 2-D scale-out: shard within a
+slice x replicate across slices. ``replica_device_groups`` partitions
+the host's devices into per-replica groups; each pool replica builds
+its own EngineSharding over its group and reports one ``load_report``
+either way, so ``EnginePool`` and the autoscaler compose unchanged.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.mesh.device_mesh import create_mesh
+from ray_tpu.mesh.sharding import (ShardingRules, match_partition_rules,
+                                   infer_sharding)
+
+# KV pool layout contract (models/kv_cache.py): axis 0 is n_kv_heads,
+# the ONLY sharded axis — pages/offsets stay whole on every device.
+KV_POOL_SPEC = P("tensor", None, None, None)
+
+
+class ShardingConfigError(ValueError):
+    """Engine sharding that cannot work: a model dimension that does
+    not divide over the requested mesh, a device count that does not
+    cover it, or rules that leave a large tensor unmatched."""
+
+
+def family_sharding_rules(cfg) -> ShardingRules:
+    """Serving partition rules for a model config, by family.
+
+    fsdp=False on purpose: a serving replica shards over ``tensor``
+    (and ``expert`` for MoE) only — data parallelism is the replica
+    POOL's job (one whole mesh per replica), not an in-mesh axis.
+    """
+    from ray_tpu.models.mixtral import (MixtralConfig,
+                                        mixtral_sharding_rules)
+    if isinstance(cfg, MixtralConfig):
+        return mixtral_sharding_rules(fsdp=False)
+    from ray_tpu.models.llama import llama_sharding_rules
+    return llama_sharding_rules(fsdp=False)
+
+
+def validate_tp(cfg, tp: int, ep: int = 1) -> None:
+    """Family-dispatched divisibility check; ShardingConfigError on
+    any dimension that does not divide the mesh."""
+    from ray_tpu.models.mixtral import MixtralConfig, mixtral_tp_validate
+    try:
+        if isinstance(cfg, MixtralConfig):
+            mixtral_tp_validate(cfg, tp, ep)
+        else:
+            from ray_tpu.models.llama import llama_tp_validate
+            if ep != 1:
+                raise ValueError(
+                    f"expert parallelism ep={ep} needs an MoE config, "
+                    f"got {type(cfg).__name__}")
+            llama_tp_validate(cfg, tp)
+    except ValueError as e:
+        raise ShardingConfigError(str(e)) from None
+
+
+class EngineSharding:
+    """A serving replica's mesh + partition rules + placement helpers.
+
+    Built once per replica via :meth:`build`; the engine uses it to
+    place weights and the KV pool at startup and to pin shardings at
+    every host->device boundary. ``tp=1, ep=1`` is legal and places
+    everything on one device — the degenerate mesh the parity tests
+    lean on.
+    """
+
+    def __init__(self, mesh: Mesh, rules: ShardingRules, *,
+                 tp: int, ep: int = 1):
+        self.mesh = mesh
+        self.rules = rules
+        self.tp = int(tp)
+        self.ep = int(ep)
+        self.kv_sharding = NamedSharding(mesh, KV_POOL_SPEC)
+        self.replicated = NamedSharding(mesh, P())
+
+    @classmethod
+    def build(cls, cfg, *, tp: int = 1, ep: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None,
+              rules: Optional[ShardingRules] = None) -> "EngineSharding":
+        """Validate ``cfg`` against a ``tp`` x ``ep`` mesh and build it.
+
+        ``devices`` defaults to the first ``tp*ep`` of
+        ``jax.devices()``; passing an explicit subset is how pool
+        replicas land on disjoint slices (``replica_device_groups``).
+        """
+        validate_tp(cfg, tp, ep)
+        n_need = tp * ep
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        if len(devices) < n_need:
+            raise ShardingConfigError(
+                f"tp={tp} x ep={ep} needs {n_need} devices, have "
+                f"{len(devices)}")
+        mesh = create_mesh({"tensor": tp, "expert": ep},
+                           devices=devices[:n_need])
+        if rules is None:
+            rules = family_sharding_rules(cfg)
+        return cls(mesh, rules, tp=tp, ep=ep)
+
+    # -- placement ---------------------------------------------------
+
+    def shard_params(self, params):
+        """Device-put the weight pytree per the rules, through the
+        strict unmatched-path gate: every >=2-D tensor must be covered
+        by an explicit rule or this raises (ShardingConfigError) —
+        a silently replicated weight matrix costs a full copy of
+        itself in every device's HBM."""
+        try:
+            match_partition_rules(self.rules, params,
+                                  on_unmatched="raise")
+        except ValueError as e:
+            raise ShardingConfigError(str(e)) from None
+        shardings = infer_sharding(params, self.rules, self.mesh)
+        return jax.device_put(params, shardings)
+
+    def place_kv_pool(self, pages: List[Any]):
+        """Head-shard the paged KV pool: each layer's (pages_k,
+        pages_v) splits axis 0 (kv heads) over ``tensor``. Page
+        indices and in-page offsets are global coordinates valid on
+        every device, so the host-side allocator / prefix cache /
+        page tables need no changes."""
+        return [tuple(jax.device_put(t, self.kv_sharding)
+                      for t in layer) for layer in pages]
+
+    def replicate(self, x):
+        """Commit a host value to the mesh replicated — the placement
+        for page tables, positions, token chunks, and RNG keys (small
+        operands every device needs whole)."""
+        return jax.device_put(x, self.replicated)
+
+    def constrain_kv(self, pages):
+        """Inside-jit sharding constraint pinning a KV pool pytree to
+        the head-sharded layout. Uses the concrete NamedSharding, so
+        it binds without a mesh context manager; applied to every
+        jitted step's output pool it guarantees GSPMD can never
+        reshard the pool (which would both break donation aliasing
+        and introduce the KV collectives this layer exists to
+        avoid)."""
+        return jax.tree_util.tree_map(
+            lambda t: jax.lax.with_sharding_constraint(
+                t, self.kv_sharding), pages)
+
+    def describe(self) -> dict:
+        return {"tp": self.tp, "ep": self.ep,
+                "devices": int(self.tp * self.ep)}
+
+
+def replica_device_groups(n_replicas: int, devices_per_replica: int,
+                          devices: Optional[Sequence[jax.Device]] = None,
+                          ) -> List[List[jax.Device]]:
+    """Partition the host's devices into per-replica groups for 2-D
+    scale-out (replicate across slices x shard within a slice).
+
+    Groups are disjoint while devices last; once exhausted they wrap
+    around (replica i reuses the group at ``i % n_full_groups``) —
+    time-sharing devices is meaningless on real chips but exactly
+    what a forced-multi-device CPU host mesh wants for pool tests.
+    """
+    if n_replicas <= 0 or devices_per_replica <= 0:
+        raise ShardingConfigError(
+            f"need n_replicas >= 1 and devices_per_replica >= 1, got "
+            f"{n_replicas} x {devices_per_replica}")
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if len(devices) < devices_per_replica:
+        raise ShardingConfigError(
+            f"devices_per_replica={devices_per_replica} exceeds the "
+            f"{len(devices)} visible devices")
+    n_full = len(devices) // devices_per_replica
+    groups = []
+    for i in range(n_replicas):
+        j = i if i < n_full else i % n_full
+        lo = j * devices_per_replica
+        groups.append(devices[lo:lo + devices_per_replica])
+    return groups

@@ -44,7 +44,17 @@ counters (shed/cancelled/deadline_exceeded), and the headline ratio:
 admitted p50 under overload vs unsaturated p50 — bounded admission
 is working when that ratio stays ~1 while excess load 429s fast.
 
-Every artifact records the git sha it was produced from.
+--tp N shards every engine replica N-way over an ICI mesh
+(serve/sharding.py: Megatron column/row-parallel weights,
+head-sharded paged KV — no KV collectives); it composes with
+--replicas into the 2-D replica x tp layout. --tp-ab runs the
+tensor-parallel A/B instead: the identical engine + greedy load at
+tp=1 and sharded tp-way, with a token-parity check spanning plain
+decode, prefix-cache hits, and speculative decoding — the artifact
+fails schema validation unless the outputs are token-identical.
+
+Every artifact records the git sha it was produced from, plus the
+mesh shape it ran on ({tp, replicas}).
 
 Usage: python serve_bench.py [--model 7b|1b|tiny] [--ab] [--out FILE]
        [--requests N] [--threads N] [--gen-tokens N] [--prompt-len N]
@@ -52,7 +62,7 @@ Usage: python serve_bench.py [--model 7b|1b|tiny] [--ab] [--out FILE]
        [--page-size N] [--shared-prefix-len N]
        [--prefix-cache | --no-prefix-cache]
        [--spec-len N] [--spec-ngram N] [--prompt-period N]
-       [--lifecycle] [--max-queued N]
+       [--lifecycle] [--max-queued N] [--tp N] [--tp-ab]
 (7b needs ~14GB HBM; falls back to 1b automatically on OOM.)
 """
 import argparse
@@ -170,7 +180,8 @@ def make_server(cfg, knobs, use_engine=True):
                 max_queued=knobs.get("max_queued"),
                 n_pages=knobs.get("kv_pages"),
                 eos_id=knobs.get("eos_id"),
-                num_engine_replicas=knobs.get("replicas", 1))
+                num_engine_replicas=knobs.get("replicas", 1),
+                tensor_parallel=knobs.get("tp", 1))
 
         def __call__(self, prompt):
             # joins the engine's decode batch at the next chunk
@@ -1125,8 +1136,146 @@ def run_autoscale(args):
     return result
 
 
+def run_tp_ab(args):
+    """Tensor-parallel A/B (serve_bench.py --tp-ab): the SAME engine,
+    load shape, and greedy sampling run twice — once on a single chip
+    (tp=1) and once sharded tp-way over the mesh (serve/sharding.py:
+    Megatron column/row-parallel weights, head-sharded paged KV). The
+    engines are built DIRECTLY (no serve hop) so the parity check is
+    deterministic.
+
+    The load covers all three dispatch paths the sharded engine must
+    keep token-identical: plain continuous-batching decode, a shared
+    prefix re-asked so the radix cache serves hits, and a repetitive
+    prompt under prompt-lookup speculation (propose / verify /
+    rollback). The artifact REFUSES (via tools/check_bench_schema.py)
+    to exist without the mesh stamp or with any output divergence —
+    a tensor-parallel engine that changes tokens is a broken engine,
+    whatever its throughput.
+
+    Always the tiny model (fp32 so the per-device psum reduction
+    order cannot flip a greedy argmax tie): this run proves the
+    PARITY and composition contract; chip-scaling numbers come from
+    the on-chip sweep."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.llama import Llama, llama_tiny
+    from ray_tpu.serve.engine import LLMEngine
+    from ray_tpu.serve.sharding import EngineSharding
+
+    tp = args.tp if args.tp > 1 else 4
+    gen_tokens = min(args.gen_tokens, 16)
+    # n_kv_heads must divide tp-way (the tiny default of 2 stops at
+    # tp=2); fp32 keeps greedy argmax ties out of the parity check
+    cfg = llama_tiny(n_kv_heads=max(4, tp), dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))
+
+    rng = np.random.RandomState(args.seed + 31)
+    plain = [rng.randint(1, cfg.vocab_size - 1, size=12).tolist()
+             for _ in range(4)]
+    shared = rng.randint(1, cfg.vocab_size - 1, size=24).tolist()
+    tails = [rng.randint(1, cfg.vocab_size - 1, size=6).tolist()
+             for _ in range(3)]
+    repetitive = ([5, 6, 7, 8] * 8)[:24]
+    prompts = plain + [shared + t for t in tails] + [repetitive]
+
+    def arm(sharding):
+        eng = LLMEngine(model, params, max_slots=4, page_size=8,
+                        n_pages=96, chunk=4, prefill_chunk=16,
+                        temperature=0.0, seed=args.seed,
+                        prefix_cache=True, spec_len=4,
+                        sharding=sharding)
+        eng.start()
+        t0 = time.time()
+        # seeds the prefix cache so the tail requests HIT it, and
+        # compiles the jitted steps outside the measured window
+        eng.submit(shared + tails[0],
+                   max_new_tokens=gen_tokens).result()
+        compile_s = time.time() - t0
+        t0 = time.time()
+        handles = [eng.submit(p, max_new_tokens=gen_tokens)
+                   for p in prompts]
+        outs = [h.result() for h in handles]
+        wall = time.time() - t0
+        total = len(prompts) * gen_tokens
+        res = {
+            "throughput_tok_s": round(total / wall, 1),
+            "per_token_ms": round(wall * 1000 / total, 2),
+            "requests": len(prompts),
+            "gen_tokens": gen_tokens,
+            "wall_s": round(wall, 2),
+            "compile_s": round(compile_s, 1),
+            "devices": sharding.describe()["devices"]
+            if sharding is not None else 1,
+        }
+        pc = eng.prefix_stats()
+        if pc:
+            res["prefix_cache"] = pc
+        sp = eng.spec_stats()
+        if sp:
+            res["spec"] = sp
+        eng.shutdown()
+        return outs, res
+
+    print("tp A/B: tp=1 arm", flush=True)
+    base_outs, base = arm(None)
+    print(f"tp A/B: tp={tp} arm", flush=True)
+    sh = EngineSharding.build(cfg, tp=tp)
+    tp_outs, tpn = arm(sh)
+    identical = base_outs == tp_outs
+    if not identical:
+        print("WARNING: tp arm diverged from single-chip greedy "
+              "outputs — the artifact will fail schema validation",
+              flush=True)
+    return {
+        "tp_ab": {
+            "tp1": base,
+            "tpn": tpn,
+            "parity": {"token_identical": bool(identical),
+                       "checked": len(prompts)},
+            "per_token_ratio": _ratio(tpn["per_token_ms"],
+                                      base["per_token_ms"]),
+            "throughput_ratio": _ratio(tpn["throughput_tok_s"],
+                                       base["throughput_tok_s"]),
+        },
+        "mesh": {"tp": tp, "replicas": 1},
+        "model": "llama-tiny",
+        "n_kv_heads": cfg.n_kv_heads,
+        "notes": "Tensor-parallel A/B (serve_bench.py --tp-ab): the "
+                 "identical engine + greedy load run at tp=1 and "
+                 "sharded tp-way (Megatron-sharded weights, "
+                 "head-sharded paged KV, serve/sharding.py). The "
+                 "load exercises plain decode, prefix-cache hit "
+                 "resume, and speculative propose/verify/rollback; "
+                 "parity.token_identical must be true. On a CPU "
+                 "host mesh the latency ratio carries no scaling "
+                 "signal (emulated devices share the same cores); "
+                 "per_token_ratio earns its keep on a real ICI "
+                 "mesh.",
+    }
+
+
 def _ratio(a, b):
     return round(a / b, 2) if b else None
+
+
+def _stamp(result, args, replicas=None):
+    """Attribution every artifact carries: the RNG seed, the git sha,
+    and the mesh shape the run was placed on (tp = tensor-parallel
+    width per replica, replicas = data-parallel engine replicas) —
+    cross-round comparisons are meaningless without knowing how many
+    chips each number came from."""
+    result["seed"] = args.seed
+    result["git_sha"] = git_sha()
+    # a run that already recorded its actual placement (e.g. --tp-ab
+    # defaulting to a 4-way mesh) keeps its own stamp
+    result.setdefault("mesh",
+                      {"tp": args.tp,
+                       "replicas": (args.replicas if replicas is None
+                                    else replicas)})
+    return result
 
 
 def main():
@@ -1211,6 +1360,19 @@ def main():
                          "(EnginePool). With --ab runs pool-vs-single "
                          "A/B on the same load and adds a replica-kill "
                          "recovery phase to the artifact")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel width per engine replica "
+                         "(serve/sharding.py: Megatron-sharded "
+                         "weights, head-sharded paged KV over a 1-D "
+                         "tp mesh; composes with --replicas into the "
+                         "2-D replica x tp layout). Must divide the "
+                         "model's heads / kv heads / hidden dim")
+    ap.add_argument("--tp-ab", action="store_true",
+                    help="tensor-parallel A/B: the identical engine "
+                         "+ greedy load at tp=1 and sharded tp-way "
+                         "(--tp, default 4), with a token-parity "
+                         "check across plain decode, prefix-cache "
+                         "hits, and speculative decoding")
     ap.add_argument("--lifecycle", action="store_true",
                     help="request-lifecycle smoke: unsaturated pass "
                          "then an overload burst against --max-queued "
@@ -1280,9 +1442,19 @@ def main():
                  prompt_order=args.prompt_order,
                  replicas=args.replicas, kv_pages=args.kv_pages,
                  eos_id=args.eos_id, max_seq_len=args.max_seq_len,
-                 seed=args.seed)
+                 seed=args.seed, tp=args.tp)
 
     import os
+    if (args.tp > 1 or args.tp_ab) \
+            and os.environ.get("JAX_PLATFORMS") == "cpu" \
+            and "host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # sharded arms need a multi-device mesh; on a CPU smoke that
+        # means forcing host devices BEFORE jax initializes (same
+        # trick as tests/conftest.py)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # env alone doesn't always override the axon plugin: the
         # config update must land before any device use
@@ -1291,10 +1463,19 @@ def main():
     import ray_tpu
     ray_tpu.init()
 
+    if args.tp_ab:
+        result = _stamp(run_tp_ab(args), args)
+        out = args.out or "SERVE_BENCH_tp_ab_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result))
+        ray_tpu.shutdown()
+        return
+
     if args.autoscale:
-        result = run_autoscale(args)
-        result["seed"] = args.seed
-        result["git_sha"] = git_sha()
+        # the autoscaled arm peaks at --autoscale-max replicas
+        result = _stamp(run_autoscale(args), args,
+                        replicas=args.autoscale_max)
         out = args.out or "SERVE_BENCH_autoscale_cpu_smoke.json"
         with open(out, "w") as f:
             json.dump(result, f, indent=1)
@@ -1303,9 +1484,7 @@ def main():
         return
 
     if args.lifecycle:
-        result = run_lifecycle(args, knobs)
-        result["seed"] = args.seed
-        result["git_sha"] = git_sha()
+        result = _stamp(run_lifecycle(args, knobs), args)
         out = args.out or "SERVE_BENCH_lifecycle_cpu_smoke.json"
         with open(out, "w") as f:
             json.dump(result, f, indent=1)
@@ -1346,8 +1525,7 @@ def main():
         print("replica-kill recovery phase", flush=True)
         result["replica_kill"] = run_pool_kill(args.seed)
         out = args.out or "SERVE_BENCH_pool_cpu_smoke.json"
-        result["seed"] = args.seed
-        result["git_sha"] = git_sha()
+        _stamp(result, args)
         with open(out, "w") as f:
             json.dump(result, f, indent=1)
         print(json.dumps(result))
@@ -1397,8 +1575,7 @@ def main():
         result = run_path(args, knobs, use_engine=not args.legacy)
         out = args.out or ("SERVE_BENCH_r05_legacy.json" if args.legacy
                            else "SERVE_BENCH_r05.json")
-    result["seed"] = args.seed
-    result["git_sha"] = git_sha()
+    _stamp(result, args)
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))

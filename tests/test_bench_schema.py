@@ -440,6 +440,101 @@ def test_pool_ab_kill_run_must_lose_nothing(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# tp A/B family (serve_bench.py --tp-ab artifacts)
+# ---------------------------------------------------------------------------
+
+
+_TP_ARM = {"throughput_tok_s": 35.0, "per_token_ms": 28.5,
+           "requests": 8, "gen_tokens": 16, "devices": 1,
+           "wall_s": 3.6, "compile_s": 9.1}
+
+
+def _tp_ab():
+    return {"tp_ab": {"tp1": dict(_TP_ARM),
+                      "tpn": dict(_TP_ARM, devices=4,
+                                  per_token_ms=40.0),
+                      "parity": {"token_identical": True,
+                                 "checked": 8},
+                      "per_token_ratio": 1.4,
+                      "throughput_ratio": 0.71},
+            "mesh": {"tp": 4, "replicas": 1},
+            "model": "llama-tiny", "git_sha": "abc1234"}
+
+
+def test_tp_ab_artifact_validates(tmp_path):
+    assert _problems_for("SERVE_BENCH_tp_ab_cpu_smoke.json",
+                         _tp_ab(), tmp_path) == []
+
+
+def test_tp_ab_refuses_missing_or_malformed_mesh(tmp_path):
+    # a tensor-parallel artifact without its mesh stamp proves nothing
+    no_mesh = {k: v for k, v in _tp_ab().items() if k != "mesh"}
+    probs = _problems_for("SERVE_BENCH_tp_ab_cpu_smoke.json",
+                          no_mesh, tmp_path)
+    assert any("mesh stamp" in p for p in probs)
+    one_chip = _tp_ab()
+    one_chip["mesh"]["tp"] = 1
+    probs = _problems_for("SERVE_BENCH_tp_ab_cpu_smoke.json",
+                          one_chip, tmp_path)
+    assert any("tp must be >= 2" in p for p in probs)
+    typed = _tp_ab()
+    typed["mesh"]["tp"] = "4"
+    probs = _problems_for("SERVE_BENCH_tp_ab_cpu_smoke.json",
+                          typed, tmp_path)
+    assert any("mesh" in p and "tp" in p for p in probs)
+
+
+def test_tp_ab_refuses_non_parity(tmp_path):
+    # token-identical greedy output across widths IS the contract
+    diverged = _tp_ab()
+    diverged["tp_ab"]["parity"]["token_identical"] = False
+    probs = _problems_for("SERVE_BENCH_tp_ab_cpu_smoke.json",
+                          diverged, tmp_path)
+    assert any("not token-identical" in p for p in probs)
+    empty = _tp_ab()
+    empty["tp_ab"]["parity"]["checked"] = 0
+    probs = _problems_for("SERVE_BENCH_tp_ab_cpu_smoke.json",
+                          empty, tmp_path)
+    assert any("checked nothing" in p for p in probs)
+    no_parity = _tp_ab()
+    del no_parity["tp_ab"]["parity"]
+    probs = _problems_for("SERVE_BENCH_tp_ab_cpu_smoke.json",
+                          no_parity, tmp_path)
+    assert any("parity block" in p for p in probs)
+
+
+def test_tp_ab_requires_arms_and_ratio(tmp_path):
+    no_arm = _tp_ab()
+    del no_arm["tp_ab"]["tpn"]
+    probs = _problems_for("SERVE_BENCH_tp_ab_cpu_smoke.json",
+                          no_arm, tmp_path)
+    assert any("tpn" in p for p in probs)
+    no_field = _tp_ab()
+    del no_field["tp_ab"]["tp1"]["per_token_ms"]
+    probs = _problems_for("SERVE_BENCH_tp_ab_cpu_smoke.json",
+                          no_field, tmp_path)
+    assert any("per_token_ms" in p for p in probs)
+    no_ratio = _tp_ab()
+    del no_ratio["tp_ab"]["per_token_ratio"]
+    probs = _problems_for("SERVE_BENCH_tp_ab_cpu_smoke.json",
+                          no_ratio, tmp_path)
+    assert any("per_token_ratio" in p for p in probs)
+
+
+def test_mesh_stamp_validated_when_present_elsewhere(tmp_path):
+    # pre-stamp artifacts (no mesh) keep passing; a malformed stamp
+    # never does
+    res = {"throughput_tok_s": 1.0, "p50_ms": 2.0, "p99_ms": 3.0,
+           "ttft_ms": 4.0, "stream_tok_s": 5.0}
+    ok = dict(res, mesh={"tp": 1, "replicas": 2})
+    assert _problems_for("SERVE_BENCH_x.json", ok, tmp_path) == []
+    typed = dict(res, mesh={"tp": "1", "replicas": 2})
+    assert _problems_for("SERVE_BENCH_x.json", typed, tmp_path)
+    zero = dict(res, mesh={"tp": 1, "replicas": 0})
+    assert _problems_for("SERVE_BENCH_x.json", zero, tmp_path)
+
+
+# ---------------------------------------------------------------------------
 # TRAIN_CHAOS family (tools/chaos_train.py artifacts)
 # ---------------------------------------------------------------------------
 

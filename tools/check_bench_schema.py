@@ -27,6 +27,12 @@ family:
   pool_throughput_ratio, affinity_hit_rate, spill_rate,
   replica_kill} — the kill run must have lost == 0 and
   token_identical true (failover may fail typed, never drop)
+- SERVE_BENCH tp A/B (serve_bench.py --tp-ab): {tp_ab: {tp1, tpn,
+  parity, per_token_ratio}, mesh} — REFUSED when the mesh stamp is
+  missing (or tp < 2: a tensor-parallel A/B without a mesh proves
+  nothing), or when the parity check failed / checked nothing — a
+  sharded engine that changes greedy tokens is broken, whatever its
+  throughput
 - SERVE_BENCH autoscale (serve_bench.py --autoscale): {trace, seed,
   slo, autoscale, static_max, chip_seconds_ratio} — REFUSED when
   autoscale SLO attainment is below the floor the run itself
@@ -155,6 +161,16 @@ LIFECYCLE_OVER_REQUIRED = {
     "admitted": int,
     "shed": NUM,
     "admitted_p50_ms": NUM,
+}
+
+# tp A/B artifacts carry one of these per arm (serve_bench.py
+# run_tp_ab): the same engine + load at tp=1 and sharded tp-way.
+TP_ARM_REQUIRED = {
+    "throughput_tok_s": NUM,
+    "per_token_ms": NUM,
+    "requests": int,
+    "gen_tokens": int,
+    "devices": int,
 }
 
 BENCH_WRAPPER_REQUIRED = {
@@ -424,7 +440,80 @@ def check_autoscale(obj, name, problems):
             "static-max")
 
 
+def _check_mesh(obj, name, problems, required=False,
+                min_tp=1):
+    """Mesh-shape stamp {tp, replicas}: REQUIRED on tp A/B artifacts
+    (min_tp=2 — a tensor-parallel artifact without its mesh proves
+    nothing), validated-if-present everywhere else so artifacts from
+    before the stamp keep passing."""
+    mesh = obj.get("mesh")
+    if mesh is None:
+        if required:
+            problems.append(f"{name}: missing the mesh stamp "
+                            "({tp, replicas})")
+        return
+    if not isinstance(mesh, dict):
+        problems.append(f"{name}: mesh must be an object")
+        return
+    for key, floor in (("tp", min_tp), ("replicas", 1)):
+        v = mesh.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            problems.append(f"{name}:mesh: missing int '{key}'")
+        elif v < floor:
+            problems.append(f"{name}:mesh: {key} must be >= {floor}, "
+                            f"got {v}")
+
+
+def check_tp_ab(obj, name, problems):
+    """serve_bench.py --tp-ab artifact: the identical engine + greedy
+    load at tp=1 and sharded tp-way. The checker REFUSES artifacts
+    without the mesh stamp (tp >= 2) or whose parity check failed or
+    checked nothing — token-identical greedy output across widths IS
+    the tensor-parallel contract; an artifact that can't prove it
+    documents a broken engine."""
+    _check_mesh(obj, name, problems, required=True, min_tp=2)
+    ab = obj.get("tp_ab")
+    if not isinstance(ab, dict):
+        problems.append(f"{name}: tp_ab must be an object")
+        return
+    for arm in ("tp1", "tpn"):
+        sec = ab.get(arm)
+        if not isinstance(sec, dict):
+            problems.append(f"{name}:tp_ab: missing {arm} arm object")
+        else:
+            _check_fields(sec, TP_ARM_REQUIRED, f"{name}:tp_ab:{arm}",
+                          problems)
+    parity = ab.get("parity")
+    if not isinstance(parity, dict):
+        problems.append(f"{name}:tp_ab: missing the parity block")
+    else:
+        if parity.get("token_identical") is not True:
+            problems.append(
+                f"{name}: tp arm was not token-identical to the "
+                "single-chip arm — a sharded engine that changes "
+                "greedy tokens is broken")
+        checked = parity.get("checked")
+        if not isinstance(checked, int) or isinstance(checked, bool) \
+                or checked < 1:
+            problems.append(f"{name}:tp_ab: parity checked nothing "
+                            "(parity.checked must be int >= 1)")
+    ratio = ab.get("per_token_ratio")
+    if not isinstance(ratio, NUM) or isinstance(ratio, bool):
+        problems.append(f"{name}: tp A/B artifact missing numeric "
+                        "per_token_ratio")
+
+
 def check_serve_bench(obj, name, problems):
+    if "tp_ab" in obj:
+        # tensor-parallel A/B family (serve_bench.py --tp-ab)
+        check_tp_ab(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
+    # every other family: the mesh stamp is optional (pre-stamp
+    # artifacts) but never malformed
+    _check_mesh(obj, name, problems)
     if "autoscale" in obj and "static_max" in obj:
         # autoscale family (serve_bench.py --autoscale)
         check_autoscale(obj, name, problems)
