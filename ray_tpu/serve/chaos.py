@@ -1,0 +1,377 @@
+"""Seeded chaos for the serving pool: schedules, injection, gating.
+
+The serving stack's availability story is spread over four PRs —
+replica death + token-identical resubmit (engine pool), SLO-driven
+scaling against a capacity provider that can say no (pool
+autoscaler), typed degradation with honest Retry-After (errors /
+proxy), and hang -> death escalation (watchdog). Each piece has its
+own tests; this module is the ADVERSARIAL proof that they compose: a
+deterministic fault campaign fired against a live multi-replica pool
+under trace load, mirroring the training side's harness
+(train/chaos.py) at the serving layer's seams (serve/faults.py).
+
+Schedule kinds (``make_schedule`` always plans >= 1 of each):
+
+==================  ====================================================
+kind                what fires
+==================  ====================================================
+``kill``            whole-replica death at the next scheduling round
+                    (``FaultInjector.kill_replica``) — the pool's
+                    resubmit drill
+``hang``            one replica's scheduler wedges INSIDE a round,
+                    holding the engine lock, making zero progress but
+                    answering lock-free probes — the failure only the
+                    watchdog's progress deadline catches. Backed by a
+                    releasable ``hang`` plan, so teardown can unwedge
+                    the zombie and prove the generation fence
+``slow``            a bounded delay at the step site — progress
+                    continues, the heartbeat keeps moving, and the
+                    watchdog must NOT fire (false-positive control)
+``readback``        an injected per-rider readback fault — contained
+                    by the engine (culprit fails typed, innocents
+                    requeue), never escalating to replica death
+``stockout``        the capacity provider denies requests for a
+                    window (``CapacityUnavailable``) while the
+                    autoscaler may be mid-scale-up
+``kill_during_drain``  a replica is killed WHILE a scale-down drain
+                    is in flight on it — the three-way race between
+                    drain, death, and resubmission
+==================  ====================================================
+
+Events are keyed to campaign wall time (serving has no global step
+counter); the SCHEDULE — order, kinds, targets, windows — is
+deterministic from the seed, which is what the artifact stamps and
+the schema gate checks.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import (CapacityUnavailable,
+                                              ReplicaCapacityProvider)
+from ray_tpu.serve.engine_pool import HEALTHY
+
+logger = logging.getLogger(__name__)
+
+KINDS = ("kill", "hang", "slow", "readback", "stockout",
+         "kill_during_drain")
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    """One planned fault. Fires when the campaign clock reaches
+    ``at_s`` (seconds since ``ChaosInjector.start``)."""
+    kind: str
+    at_s: float
+    duration_s: float = 0.5        # slow: delay; stockout: window
+    fired: bool = False
+    fired_at_s: Optional[float] = None
+    target_idx: Optional[int] = None   # replica hit (filled at fire)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "at_s": round(self.at_s, 4),
+                "duration_s": self.duration_s, "fired": self.fired,
+                "fired_at_s": (round(self.fired_at_s, 4)
+                               if self.fired_at_s is not None
+                               else None),
+                "target_idx": self.target_idx}
+
+
+def make_schedule(seed: int, duration_s: float, kinds=KINDS,
+                  extra: int = 0, slow_s: float = 0.2,
+                  stockout_s: float = 0.5) -> List[ChaosEvent]:
+    """Deterministic schedule: >= 1 event of every kind in ``kinds``
+    plus ``extra`` more, spread over (0.1, 0.8) * ``duration_s`` so
+    nothing fires before the load warms up or too late to observe
+    recovery before the campaign ends. Same seed => identical
+    schedule."""
+    n = len(kinds) + extra
+    lo, hi = 0.1 * duration_s, 0.8 * duration_s
+    span = (hi - lo) / n
+    if span <= 0:
+        raise ValueError(
+            f"duration_s={duration_s} too small for {n} events")
+    rng = random.Random(seed)
+    ordered = list(kinds) + [rng.choice(list(kinds))
+                             for _ in range(extra)]
+    rng.shuffle(ordered)
+    events = []
+    for i, kind in enumerate(ordered):
+        at = lo + i * span + rng.random() * span * 0.5
+        dur = slow_s if kind == "slow" else stockout_s
+        events.append(ChaosEvent(kind=kind, at_s=at, duration_s=dur))
+    return events
+
+
+class StockoutCapacityProvider(ReplicaCapacityProvider):
+    """Capacity provider wrapper with an injectable stockout window:
+    while the window is open every ``request`` raises
+    ``CapacityUnavailable`` (and is counted), after it the base
+    provider answers again. The chaos ``stockout`` event opens the
+    window mid-campaign, so an autoscaler scale-up attempt lands on a
+    denial exactly like a real provisioning stockout."""
+
+    def __init__(self, base: ReplicaCapacityProvider,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self._base = base
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._until = 0.0
+        self.denied = 0
+
+    def set_stockout(self, duration_s: float) -> None:
+        with self._lock:
+            self._until = self._time() + duration_s
+
+    def stocked_out(self) -> bool:
+        with self._lock:
+            return self._time() < self._until
+
+    def request(self) -> str:
+        with self._lock:
+            if self._time() < self._until:
+                self.denied += 1
+                raise CapacityUnavailable(
+                    "injected capacity stockout")
+        return self._base.request()
+
+    def ready(self, ticket: str) -> bool:
+        return self._base.ready(ticket)
+
+    def eta_s(self, ticket: str) -> float:
+        return self._base.eta_s(ticket)
+
+    def release(self, ticket: str) -> None:
+        self._base.release(ticket)
+
+
+def release_all_hangs(pool) -> int:
+    """Release every ``hang`` plan on every replica engine's injector
+    (current engines only — callers tracking corpse engines from
+    before a rebuild release those via their own registry). Call in
+    EVERY chaos/teardown path."""
+    n = 0
+    for eng in pool.engines():
+        inj = getattr(eng, "_injector", None)
+        if inj is not None:
+            n += inj.release_all()
+    return n
+
+
+class ChaosInjector:
+    """Watcher thread firing a schedule against a live EnginePool.
+
+    Targets are chosen seeded among the HEALTHY replicas at fire
+    time; each replica engine must carry a ``FaultInjector``
+    (``LLMEngine(fault_injector=...)`` — the harness factory wires
+    one per build, including rebuilds). ``provider`` (a
+    ``StockoutCapacityProvider``) backs stockout events;
+    ``kill_during_drain`` needs >= 2 healthy replicas at fire time.
+
+    ``stop()`` joins the watcher AND every drain thread it spawned,
+    then releases every hang — a campaign can never leak a wedged
+    thread past teardown.
+    """
+
+    def __init__(self, pool, schedule: List[ChaosEvent], *,
+                 seed: int = 0,
+                 provider: Optional[StockoutCapacityProvider] = None,
+                 drain_timeout_s: float = 5.0,
+                 poll_s: float = 0.01,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self.pool = pool
+        self.schedule = sorted(schedule, key=lambda e: e.at_s)
+        self.provider = provider
+        self.drain_timeout_s = drain_timeout_s
+        self.poll_s = poll_s
+        self._time = time_fn
+        self._rng = random.Random(seed)
+        self.log: List[Dict[str, Any]] = []
+        self._t0: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="serve-chaos",
+                                        daemon=True)
+        self._drains: List[threading.Thread] = []
+        # replicas retired/killed through the drain race — the
+        # harness asserts resubmits never landed on them
+        self.drain_victims: List[int] = []
+
+    def start(self) -> "ChaosInjector":
+        self._t0 = self._time()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30)
+        for t in self._drains:
+            t.join(timeout=self.drain_timeout_s + 30)
+        release_all_hangs(self.pool)
+
+    def injected_counts(self) -> Dict[str, int]:
+        out = {k: 0 for k in KINDS}
+        for e in self.schedule:
+            if e.fired:
+                out[e.kind] = out.get(e.kind, 0) + 1
+        return out
+
+    # ------------------------------------------------------------ loop
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            elapsed = self._time() - self._t0
+            for ev in self.schedule:
+                if ev.fired or elapsed < ev.at_s:
+                    continue
+                if self._fire(ev):
+                    ev.fired = True
+                    ev.fired_at_s = elapsed
+                    self.log.append(ev.as_dict())
+                break   # at most one event per tick (fired or not:
+                        # an unfireable event retries next tick
+                        # without starving the ones behind it)
+            if all(e.fired for e in self.schedule):
+                return
+            time.sleep(self.poll_s)
+
+    def _pick_healthy(self, min_healthy: int = 1,
+                      clean_only: bool = False):
+        """A seeded pick among HEALTHY replicas whose engine carries
+        an injector (None when fewer than ``min_healthy`` qualify —
+        the event retries next tick). Prefers replicas with no
+        pending unfired plans so concurrent events don't stack on
+        one victim (a pending kill on the hang target would take the
+        replica down BEFORE the wedge); ``clean_only`` makes that a
+        requirement instead of a preference."""
+        with self.pool._lock:
+            reps = [r for r in self.pool._replicas
+                    if r.state == HEALTHY
+                    and getattr(r.engine, "_injector", None)
+                    is not None]
+        if len(reps) < min_healthy:
+            return None
+        clean = [r for r in reps
+                 if all(p.fired >= p.times
+                        for p in r.engine._injector.plans)]
+        if clean_only and not clean:
+            return None
+        return self._rng.choice(clean or reps)
+
+    def _fire(self, ev: ChaosEvent) -> bool:
+        try:
+            if ev.kind == "kill":
+                return self._fire_kill(ev)
+            if ev.kind == "hang":
+                return self._fire_hang(ev)
+            if ev.kind == "slow":
+                return self._fire_slow(ev)
+            if ev.kind == "readback":
+                return self._fire_readback(ev)
+            if ev.kind == "stockout":
+                return self._fire_stockout(ev)
+            if ev.kind == "kill_during_drain":
+                return self._fire_kill_during_drain(ev)
+        except Exception as e:  # noqa: BLE001 - injection must not die
+            logger.warning("chaos event %s failed to fire: %s",
+                           ev.kind, e)
+            return False
+        return False
+
+    def _fire_kill(self, ev: ChaosEvent) -> bool:
+        rep = self._pick_healthy()
+        if rep is None:
+            return False
+        ev.target_idx = rep.idx
+        rep.engine._injector.kill_replica()
+        return True
+
+    def _fire_hang(self, ev: ChaosEvent) -> bool:
+        # Wedge at the step site: the scheduler thread parks holding
+        # the engine lock with its heartbeat already touched this
+        # round — from here on the age only grows, which is exactly
+        # the signal the watchdog escalates on.
+        rep = self._pick_healthy(clean_only=True)
+        if rep is None:
+            return False
+        ev.target_idx = rep.idx
+        rep.engine._injector.hang("step")
+        return True
+
+    def _fire_slow(self, ev: ChaosEvent) -> bool:
+        # A delay below the suspect threshold: rounds keep completing,
+        # the heartbeat keeps moving — long-but-moving must NOT wedge.
+        rep = self._pick_healthy()
+        if rep is None:
+            return False
+        ev.target_idx = rep.idx
+        rep.engine._injector.slow("step", ev.duration_s)
+        return True
+
+    def _fire_readback(self, ev: ChaosEvent) -> bool:
+        rep = self._pick_healthy()
+        if rep is None:
+            return False
+        ev.target_idx = rep.idx
+        # The engine CONTAINS a readback fault: exactly the culprit
+        # request fails — with this exception — and innocents requeue.
+        # The stable message is the harness's marker for telling the
+        # planned casualty apart from an actually-lost request.
+        rep.engine._injector.inject(
+            "readback",
+            exc=RuntimeError("injected readback fault"))
+        return True
+
+    def _fire_stockout(self, ev: ChaosEvent) -> bool:
+        if self.provider is None:
+            return False
+        self.provider.set_stockout(ev.duration_s)
+        # Probe the denial so the stockout is OBSERVED even when the
+        # autoscaler happens not to scale up inside the window (the
+        # provider-level denial is the real event; an autoscaler
+        # request in the window lands on the same refusal).
+        try:
+            ticket = self.provider.request()
+        except CapacityUnavailable:
+            pass
+        else:   # pragma: no cover - window must be open here
+            self.provider.release(ticket)
+            return False
+        return True
+
+    def _fire_kill_during_drain(self, ev: ChaosEvent) -> bool:
+        # The three-way race: start a scale-down drain on a replica,
+        # then kill it mid-drain. The pool must (a) fail/resubmit its
+        # in-flight work under the at-most-once rule, (b) never route
+        # a resubmit back to the draining corpse, (c) quiesce
+        # leak-free.
+        rep = self._pick_healthy(min_healthy=2, clean_only=True)
+        if rep is None:
+            return False
+        ev.target_idx = rep.idx
+        self.drain_victims.append(rep.idx)
+
+        def _drain():
+            try:
+                self.pool.retire(rep.idx,
+                                 timeout_s=self.drain_timeout_s)
+            except Exception:   # noqa: BLE001 - last-healthy guard,
+                pass            # pool shut down, etc.
+
+        t = threading.Thread(target=_drain,
+                             name=f"chaos-drain-{rep.idx}",
+                             daemon=True)
+        t.start()
+        self._drains.append(t)
+        # kill lands while the drain is (very likely) still in
+        # flight; if the drain already finished, the kill plan hits a
+        # stopped engine and simply never fires — still a valid race
+        # outcome, and the event counts as fired either way
+        time.sleep(min(0.05, self.drain_timeout_s / 4))
+        rep.engine._injector.kill_replica()
+        return True

@@ -293,6 +293,12 @@ class LLMEngine:
         after a contained fault (``backoff * 2**(attempt-1)``).
     shed_retry_after_s: the Retry-After hint carried by
         ``EngineOverloaded`` (surfaced as the HTTP header).
+    admit_timeout_s: bound on how long ``submit`` may wait for the
+        scheduler lock before shedding typed ``EngineOverloaded``.
+        None (default) blocks indefinitely; set it when a watchdog
+        guards the engine so callers racing a WEDGED scheduler
+        (serve/watchdog.py) shed-and-reroute instead of parking on
+        a lock only teardown would release.
     fault_injector: test-only seam (serve/faults.py FaultInjector);
         None in production — every site is then a no-op.
     """
@@ -310,6 +316,7 @@ class LLMEngine:
                  max_retries: int = 2,
                  retry_backoff_s: float = 0.02,
                  shed_retry_after_s: float = 1.0,
+                 admit_timeout_s: Optional[float] = None,
                  sharding=None,
                  fault_injector=None):
         self.model = model
@@ -391,6 +398,18 @@ class LLMEngine:
         self._deferred = eos_id is None
         self._stopped = False
         self._draining = False
+        # Progress heartbeat (watchdog signal, serve/watchdog.py):
+        # touched lock-free at the top of every scheduling round, at
+        # every dispatch completion, and at every readback drain — so
+        # a long-but-moving prefill keeps it fresh while a wedged
+        # dispatch (hung XLA call, stuck transfer) lets it go stale.
+        # Plain float assignment: GIL-atomic, no lock required.
+        self._hb = time.monotonic()
+        # Zombie fence: set by force_kill(). A wedged step thread
+        # that later wakes finds this and may neither commit tokens
+        # (its requests are closed) nor publish pages into the prefix
+        # cache (retire-path inserts divert to plain frees).
+        self._force_killed = False
         self._thread: Optional[threading.Thread] = None
         self.stats: Dict[str, int] = collections.Counter()
         # Request-lifecycle knobs: bounded admission + bounded retry
@@ -400,6 +419,9 @@ class LLMEngine:
         self.max_retries = max(0, int(max_retries))
         self.retry_backoff_s = max(0.0, float(retry_backoff_s))
         self.shed_retry_after_s = float(shed_retry_after_s)
+        if admit_timeout_s is not None and admit_timeout_s <= 0:
+            raise ValueError("admit_timeout_s must be > 0 or None")
+        self.admit_timeout_s = admit_timeout_s
         self._injector = fault_injector
         self._round = 0              # scheduling-round counter (the
                                      # fault seam's deterministic clock)
@@ -483,7 +505,26 @@ class LLMEngine:
                        t_submit=time.monotonic())
         if deadline_s is not None:
             req.deadline = req.t_submit + deadline_s
-        with self._work:
+        # Bounded admission-lock acquire: the scheduler holds this
+        # lock across whole rounds, and a WEDGED scheduler (hung
+        # dispatch — see serve/watchdog.py) holds it forever. With a
+        # timeout configured, a stalled acquire sheds typed
+        # EngineOverloaded instead of parking the caller on a lock
+        # only teardown would release — the pool treats the shed as
+        # "exclude this replica and route on".
+        if self.admit_timeout_s is not None:
+            acquired = self._work.acquire(
+                timeout=self.admit_timeout_s)
+        else:
+            acquired = self._work.acquire()
+        if not acquired:
+            self.stats["admit_timeouts"] += 1
+            raise EngineOverloaded(
+                f"admission lock unavailable for "
+                f"{self.admit_timeout_s}s (scheduler stalled); "
+                f"request shed",
+                retry_after_s=self.shed_retry_after_s)
+        try:
             if self._stopped:
                 raise EngineShutdown("engine stopped")
             if self._draining:
@@ -501,6 +542,8 @@ class LLMEngine:
             self._wait.append(req)
             self.stats["submitted"] += 1
             self._work.notify()
+        finally:
+            self._work.release()
         return RequestHandle(req, self)
 
     def start(self) -> "LLMEngine":
@@ -593,6 +636,10 @@ class LLMEngine:
                 "ttft_ewma_s": self._ttft_ewma,
                 "draining": self._draining,
                 "stopped": self._stopped,
+                "heartbeat_age_s": time.monotonic() - self._hb,
+                "has_work": bool(waiting or any(self.slots)
+                                 or self._fetchq
+                                 or self._pending_prefill),
                 "tp": (self._sharding.tp
                        if self._sharding is not None else 1),
                 "prefix_digest": (self.prefix_cache.digest()
@@ -619,9 +666,55 @@ class LLMEngine:
                 "ttft_ewma_s": self._ttft_ewma,
                 "draining": self._draining,
                 "stopped": self._stopped,
+                "heartbeat_age_s": time.monotonic() - self._hb,
+                "has_work": bool(self._wait or any(self.slots)
+                                 or self._fetchq
+                                 or self._pending_prefill),
                 "tp": (self._sharding.tp
                        if self._sharding is not None else 1),
                 "prefix_digest": frozenset()}
+
+    def force_kill(self, err: Optional[BaseException] = None) -> None:
+        """Out-of-band kill for a WEDGED engine (watchdog escalation,
+        serve/watchdog.py). A wedged scheduler thread is parked INSIDE
+        ``step()`` HOLDING ``self._lock`` — every fault site fires
+        under it — so ``shutdown()``'s lock-then-join would deadlock.
+        This path takes NO lock: it sets the zombie fence + stop flag
+        (GIL-atomic assignments) and fails every consumer so blocked
+        ``stream()`` callers unblock immediately and the pool can
+        resubmit. Resource cleanup (slot pages) happens later, when
+        the wedge releases and the zombie thread unwinds — call
+        ``shutdown()`` again after that for the final teardown.
+
+        Zombie fence: after this, a step thread that later wakes
+        cannot commit tokens (requests are closed; ``_emit_to``
+        drops), cannot dispatch (the post-fire ``_stopped`` checks
+        abandon the round), and cannot publish pages into the prefix
+        cache (retire-path inserts divert to plain frees)."""
+        err = err or EngineShutdown(
+            "engine force-killed: wedged (no scheduler progress)")
+        self._force_killed = True
+        self._stopped = True
+
+        def fail(req):
+            if req.closed:
+                return
+            req.closed = True
+            req.error = err
+            req.out_q.put(_DONE)
+
+        for slot in list(self.slots):
+            if slot is not None:
+                fail(slot.req)
+        for item in list(self._fetchq):
+            for _i, slot, _t in item[1]:
+                fail(slot.req)
+        for item in list(self._pending_prefill):
+            for _ix, slot, _row in item[1]:
+                fail(slot.req)
+        for req in list(self._wait):
+            fail(req)
+        self.stats["force_killed"] += 1
 
     def shutdown(self):
         """Stop the engine and FAIL everything still queued or in
@@ -629,13 +722,28 @@ class LLMEngine:
         ``result()`` consumer may be left blocked. Tokens already
         computed (trailing readbacks of retired slots) are delivered
         first, so a request that effectively finished still resolves
-        cleanly. Idempotent."""
+        cleanly. Idempotent.
+
+        After a ``force_kill`` the scheduler thread may still be
+        wedged inside ``step()`` holding the engine lock, so this
+        path must not block on it: the join is short and a
+        still-alive thread defers the final resource cleanup to a
+        later ``shutdown()`` call (after the wedge releases —
+        ``FaultInjector.release_all()`` in tests)."""
         err = EngineShutdown("engine stopped")
-        with self._work:
-            self._stopped = True
-            self._work.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
+        if self._force_killed:
+            # consumers already failed lock-free; taking the lock
+            # here would deadlock against the wedged step thread
+            if self._thread is not None:
+                self._thread.join(timeout=1.0)
+                if self._thread.is_alive():
+                    return      # still wedged: cleanup deferred
+        else:
+            with self._work:
+                self._stopped = True
+                self._work.notify_all()
+            if self._thread is not None:
+                self._thread.join(timeout=30)
         with self._work:
             # deliver what the device already produced before the axe
             try:
@@ -800,8 +908,15 @@ class LLMEngine:
         still escape to ``_fail_all`` via ``_loop``."""
         with self._lock:
             self._round += 1
+            self._hb = time.monotonic()   # progress heartbeat: a new
+                                          # round means the previous
+                                          # one completed
             self._fire("step")     # global-fault site: escapes to
                                    # _fail_all, like real device loss
+            if self._stopped:
+                # force-killed while wedged at the step site: the
+                # zombie fence forbids any further work this round
+                return False
             self._reap_deadlines_locked()
             if not self._deferred or self.spec_len:
                 # eos mode: emissions gate planning. Spec mode: the
@@ -1192,6 +1307,9 @@ class LLMEngine:
         # (victim choice is global youngest) — refilter before dispatch
         rows = [(ix, slot, take) for ix, slot, take in rows
                 if self.slots[ix] is slot]
+        if self._stopped:
+            return     # force-killed mid-loop (zombie fence): the
+                       # released thread must not dispatch
         if rows:
             self._prefill_batch(rows)
 
@@ -1262,6 +1380,11 @@ class LLMEngine:
         if self.prefix_cache is None:
             self.alloc.free(slot.pages)
             return
+        if retire and self._force_killed:
+            # zombie fence: a force-killed engine's late retirement
+            # must not publish pages into the prefix cache — drop
+            # shared references and free private pages instead
+            retire = False
         if retire:
             n_full = min(len(slot.prompt) // self.Pg, len(slot.pages))
             self.prefix_cache.insert(slot.prompt,
@@ -1323,9 +1446,10 @@ class LLMEngine:
             # dispatch (the tail of an overshooting window is junk)
             take = min(steps, max(0, self._owed(slot)))
             riders.append((i, slot, take))
-        if not riders:
+        if not riders or self._stopped:
             # every planned rider was preempted by this round's
-            # prefill growth — an empty dispatch would decode junk
+            # prefill growth — an empty dispatch would decode junk —
+            # or the engine was force-killed mid-loop (zombie fence)
             return
         (toks, self.pages, self._rng, self._dev_pos,
          self._dev_cur) = self._decode_fn(
@@ -1340,6 +1464,7 @@ class LLMEngine:
         self.sched_trace.append(("decode", steps))
         self.stats["chunks"] += 1
         self.stats["decode_steps"] += steps
+        self._hb = time.monotonic()   # dispatch completed: progress
 
     def _dispatch_spec_locked(self, grants):
         """One batched draft-and-verify dispatch (speculative
@@ -1423,8 +1548,8 @@ class LLMEngine:
         # a later grant's growth can evict an earlier grant's slot
         rows = [(ix, slot, d) for ix, slot, d in rows
                 if self.slots[ix] is slot]
-        if not rows:
-            return
+        if not rows or self._stopped:
+            return     # nothing to verify, or force-killed mid-loop
         ids = np.zeros((self.S, T), np.int32)
         start = np.zeros((self.S,), np.int32)
         pt = np.zeros((self.S, self.max_pages), np.int32)
@@ -1438,6 +1563,7 @@ class LLMEngine:
             self.params, self.pages, self._h2d(ids),
             self._h2d(start), self._h2d(pt))
         out = np.asarray(out_dev)    # host sync: acceptance gates
+        self._hb = time.monotonic()  # verify completed: progress
         m = spec_decode.metrics()
         self.stats["spec_rounds"] += 1
         # surviving slots' device decode state is reseeded with the
@@ -1554,6 +1680,7 @@ class LLMEngine:
                     self._pending_prefill, []
             vals = jax.device_get(
                 [b[0] for b in batch] + [f for f, _ in pend_pre])
+            self._hb = time.monotonic()   # readback completed
             k = len(batch)
             # prefill firsts FIRST: a slot's seeding prefill always
             # precedes its first decode ride, and both can land in
@@ -1710,6 +1837,9 @@ class LLMEngine:
         self.stats["prefill_tokens"] += sum(
             take for _ix, _s, take in rows)
         self.stats["prefilled_seqs"] += len(placements)
+        self._hb = time.monotonic()   # dispatch completed: a long
+                                      # prompt prefilling chunk by
+                                      # chunk is moving, not wedged
 
     def _build_prefill(self, T: int):
         """One chunked-prefill executable for chunk width ``T``:

@@ -79,6 +79,9 @@ RESTARTS = "serve_pool_restarts_total"
 ALL_SHED = "serve_pool_all_shed_total"
 FREE_SLOTS = "serve_pool_replica_free_slots"
 QUEUE_DEPTH = "serve_pool_replica_queue_depth"
+SUSPECTS = "serve_pool_suspect_total"
+WEDGED = "serve_pool_wedged_total"
+WEDGE_LATENCY = "serve_pool_wedge_detect_latency_s"
 
 _METRICS: Optional[dict] = None
 
@@ -121,6 +124,17 @@ def _metrics() -> dict:
             "queue_depth": metrics.Gauge(
                 QUEUE_DEPTH, "Admission queue depth per replica",
                 tag_keys=("replica",)),
+            "suspects": metrics.Counter(
+                SUSPECTS, "Replicas quarantined SUSPECT by the "
+                "watchdog (stale heartbeat with work pending)"),
+            "wedged": metrics.Counter(
+                WEDGED, "Replicas declared WEDGED and force-killed "
+                "by the watchdog"),
+            "wedge_latency": metrics.Histogram(
+                WEDGE_LATENCY, "Seconds from last observed progress "
+                "to the WEDGED declaration",
+                boundaries=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                            10.0, 30.0)),
         }
     return _METRICS
 
@@ -128,6 +142,12 @@ def _metrics() -> dict:
 HEALTHY = "healthy"
 DRAINING = "draining"
 DEAD = "dead"
+# Watchdog quarantine (serve/watchdog.py): the replica's progress
+# heartbeat went stale WITH work pending. Routing, capacity counts,
+# and the autoscaler's healthy_replicas signal all skip it for free
+# (everything filters on HEALTHY); the watchdog either clears it back
+# to HEALTHY on probed progress or escalates to the death path.
+SUSPECT = "suspect"
 # Scale-down tombstone: the replica was drained and shut down ON
 # PURPOSE and will not be rebuilt; its slot index may be reused by a
 # later scale-up. Kept in the table so pool-wide quiescence checks
@@ -358,6 +378,7 @@ class EnginePool:
         # capacity that would serve it exists
         self.capacity_hint_fn: Optional[Callable[[], float]] = None
         self._autoscaler = None      # attached PoolAutoscaler, if any
+        self._watchdog = None        # attached PoolWatchdog, if any
         self._sticky: "collections.OrderedDict[str, int]" = \
             collections.OrderedDict()
         # pool-level routing/lifecycle counters (the engines keep
@@ -585,6 +606,60 @@ class EnginePool:
             self.route_stats["restarts"] += 1
         _metrics()["restarts"].inc()
 
+    # -------------------------------------------------- watchdog hooks
+
+    def mark_suspect(self, rep: _Replica) -> bool:
+        """HEALTHY -> SUSPECT (watchdog quarantine). The replica
+        immediately stops counting as capacity everywhere — routing,
+        ``healthy_count``, scale-down candidacy, autoscaler signals —
+        because they all filter on HEALTHY. Returns False when the
+        replica moved on (died, drained, replaced) since observed."""
+        with self._lock:
+            if (self._replicas[rep.idx] is not rep
+                    or rep.state != HEALTHY):
+                return False
+            rep.state = SUSPECT
+            self.route_stats["suspects"] += 1
+            self._drop_sticky_locked(rep.idx)
+        _metrics()["suspects"].inc()
+        return True
+
+    def clear_suspect(self, rep: _Replica) -> bool:
+        """SUSPECT -> HEALTHY: the probe saw progress (heartbeat
+        advanced or work drained) — a long-but-moving dispatch, not a
+        wedge. The replica resumes taking traffic."""
+        with self._lock:
+            if (self._replicas[rep.idx] is not rep
+                    or rep.state != SUSPECT):
+                return False
+            rep.state = HEALTHY
+        return True
+
+    def mark_wedged(self, rep: _Replica,
+                    err: Optional[BaseException] = None,
+                    stalled_for_s: Optional[float] = None) -> bool:
+        """Declare a silent replica WEDGED and drive the EXISTING
+        death path: ``force_kill`` the engine out-of-band (lock-free —
+        the wedged scheduler thread holds the engine lock), which
+        unblocks every consumer typed so unstreamed requests resubmit
+        token-identically, then ``_note_replica_death`` marks it DEAD,
+        counts the death, and schedules the backoff rebuild with a
+        generation bump. Healthy replicas are never touched."""
+        with self._lock:
+            if (self._replicas[rep.idx] is not rep
+                    or rep.state not in (HEALTHY, SUSPECT)):
+                return False
+            self.route_stats["wedged"] += 1
+        m = _metrics()
+        m["wedged"].inc()
+        if stalled_for_s is not None:
+            m["wedge_latency"].observe(stalled_for_s)
+        try:
+            rep.engine.force_kill(err)
+        except Exception:
+            pass
+        return self._note_replica_death(rep)
+
     def _note_replica_death(self, rep: _Replica) -> bool:
         """Judge (and record) a replica death. True iff ``rep``'s
         engine has globally stopped — the discriminator between
@@ -645,6 +720,28 @@ class EnginePool:
                 return
         self._rebuild(rep.idx)
 
+    def _restart_eta_s(self) -> float:
+        """Honest Retry-After for a pool with no healthy replica: the
+        max of any in-flight provisioning ETA (autoscaler hint) and
+        the longest pending auto-restart backoff — the soonest moment
+        a retry could plausibly find capacity."""
+        eta = 0.0
+        if self.capacity_hint_fn is not None:
+            try:
+                eta = max(eta, float(self.capacity_hint_fn()))
+            except Exception:
+                pass
+        if self._auto_restart:
+            with self._lock:
+                dead_deaths = [r.deaths for r in self._replicas
+                               if r.state == DEAD]
+            for deaths in dead_deaths:
+                eta = max(eta, min(
+                    self.restart_backoff_max_s,
+                    self.restart_backoff_s
+                    * (2 ** max(0, deaths - 1))))
+        return eta
+
     def _drop_sticky_locked(self, idx: int) -> None:
         for k in [k for k, v in self._sticky.items() if v == idx]:
             del self._sticky[k]
@@ -692,12 +789,21 @@ class EnginePool:
                     if shed:
                         raise err from shed[-1]
                     raise err
+                # No healthy replica and nobody shed: a bare 503
+                # would tell the client nothing — attach the honest
+                # restart/provisioning ETA so the proxy can emit
+                # Retry-After on the degraded path too.
+                eta = self._restart_eta_s()
                 if self.degraded:
                     raise PoolDegraded(
                         "no healthy replicas: the pool burned through "
                         "its crash-loop restart budget "
-                        f"(max_restarts={self.max_restarts})")
-                raise EngineShutdown("no healthy replicas in pool")
+                        f"(max_restarts={self.max_restarts})",
+                        retry_after_s=eta if eta > 0 else None)
+                err = EngineShutdown("no healthy replicas in pool")
+                if eta > 0:
+                    err.retry_after_s = eta
+                raise err
             try:
                 inner = rep.engine.submit(
                     prompt, max_new_tokens=max_new_tokens,
@@ -927,12 +1033,17 @@ class EnginePool:
         counters["n_replicas"] = len(reps)
         counters["active_replicas"] = sum(
             1 for r in reps if r["state"] != RETIRED)
+        counters["suspect_replicas"] = sum(
+            1 for r in reps if r["state"] == SUSPECT)
         counters["degraded"] = any(
             r["state"] == DEGRADED for r in reps)
         counters["replicas"] = reps
         scaler = self._autoscaler
         if scaler is not None:
             counters["autoscale"] = scaler.stats()
+        wd = self._watchdog
+        if wd is not None:
+            counters["watchdog"] = wd.stats()
         return counters
 
     def _agg_numeric(self, per_replica: List[Optional[Dict[str, Any]]]

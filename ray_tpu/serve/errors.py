@@ -59,7 +59,15 @@ class PoolDegraded(EngineShutdown):
     a plain ``EngineShutdown`` so operators (and tests) can tell "the
     pool was stopped" from "the pool burned through its restart
     budget" — the latter needs a human or an autoscaler, not a retry.
-    HTTP: 503 (inherits ``EngineShutdown`` classification)."""
+    HTTP: 503 (inherits ``EngineShutdown`` classification), plus
+    Retry-After when the pool can estimate a restart/provisioning ETA
+    (``retry_after_s``; None = no honest hint, bare 503)."""
+
+    def __init__(self, msg: str,
+                 retry_after_s: "float | None" = None):
+        super().__init__(msg)
+        if retry_after_s is not None:
+            self.retry_after_s = float(retry_after_s)
 
 
 class EngineDraining(RequestError):

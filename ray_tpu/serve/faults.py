@@ -45,6 +45,7 @@ site                      fires
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Any, List, Optional
 
@@ -87,13 +88,16 @@ class FaultPlan:
     restricts per-row sites to one slot (None = any row); ``times``
     bounds how often it fires (so recovery is observable)."""
     site: str
-    kind: str = "raise"            # "raise" | "exhaust" | "sleep"
+    kind: str = "raise"    # "raise" | "exhaust" | "sleep" | "hang"
     exc: Optional[BaseException] = None
     round: Optional[int] = None
     sid: Optional[int] = None
     times: int = 1
     sleep_s: float = 0.0
     fired: int = 0
+    # "hang" plans park the firing thread on this event until
+    # release_all() sets it — unlike "sleep", the wedge is cancellable
+    event: Optional[threading.Event] = None
 
     def matches(self, site: str, rnd: int, sid: Optional[int]) -> bool:
         if self.fired >= self.times or site != self.site:
@@ -160,6 +164,32 @@ class FaultInjector:
         self.plans.append(plan)
         return plan
 
+    def hang(self, site: str, *, round: Optional[int] = None,
+             sid: Optional[int] = None, times: int = 1) -> FaultPlan:
+        """Wedge the firing thread at ``site`` until ``release_all()``
+        (or ``plan.event.set()``). Unlike ``slow``'s un-cancellable
+        ``time.sleep``, a hang can be RELEASED at teardown, so a
+        watchdog-kill test doesn't leak a live sleeping thread — and
+        the released zombie resuming inside ``step()`` is exactly the
+        stale-generation vector the fencing tests need."""
+        plan = FaultPlan(site=site, kind="hang", round=round, sid=sid,
+                         times=times, event=threading.Event())
+        self.plans.append(plan)
+        return plan
+
+    def release_all(self) -> int:
+        """Release every hang plan (fired or not). Call this in EVERY
+        chaos/teardown path — a test that kills a wedged engine still
+        owns the thread parked inside it. Returns how many plans were
+        newly released."""
+        n = 0
+        for plan in self.plans:
+            if plan.kind == "hang" and plan.event is not None \
+                    and not plan.event.is_set():
+                plan.event.set()
+                n += 1
+        return n
+
     # ------------------------------------------------- engine-facing
 
     def fire(self, site: str, rnd: int, sid: Optional[int] = None,
@@ -175,6 +205,11 @@ class FaultInjector:
             self.log.append((site, rnd, sid, plan.kind))
             if plan.kind == "sleep":
                 time.sleep(plan.sleep_s)
+                continue
+            if plan.kind == "hang":
+                # The log entry above lands BEFORE the wait, so a
+                # watchdog test can confirm the wedge is in place.
+                plan.event.wait()
                 continue
             if sid is not None:
                 raise EngineFault(plan.exc, culprit_sid=sid,

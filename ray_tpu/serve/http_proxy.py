@@ -61,6 +61,17 @@ class HTTPProxy:
         if status == 429:
             headers["Retry-After"] = str(
                 max(1, -(-int(retry_after_s(e) * 1000) // 1000)))
+        elif status == 503:
+            # Degraded/draining pools attach a restart/provisioning
+            # ETA when they have one (PoolDegraded.retry_after_s,
+            # EngineShutdown with an autoscaler hint) — surface it
+            # instead of a bare 503. No hint along the chain
+            # (default=0.0) means no header: an invented Retry-After
+            # is worse than none.
+            hint = retry_after_s(e, default=0.0)
+            if hint > 0:
+                headers["Retry-After"] = str(
+                    max(1, -(-int(hint * 1000) // 1000)))
         return web.json_response(body, status=status,
                                  headers=headers)
 

@@ -52,7 +52,9 @@ class LlamaDeployment:
                  autoscale_max_replicas: Optional[int] = None,
                  autoscale_policy: Optional[Dict[str, Any]] = None,
                  autoscale_interval_s: float = 0.5,
-                 autoscale_provider=None):
+                 autoscale_provider=None,
+                 engine_stall_deadline_s: Optional[float] = None,
+                 watchdog_interval_s: Optional[float] = None):
         import jax
         from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
@@ -129,6 +131,19 @@ class LlamaDeployment:
         self.autoscale_interval_s = autoscale_interval_s
         self.autoscale_provider = autoscale_provider
         self._autoscaler = None
+        # Pool watchdog (serve/watchdog.py): a replica whose scheduler
+        # stops making progress for engine_stall_deadline_s (with work
+        # pending) is quarantined (SUSPECT), probed, then force-killed
+        # and rebuilt through the pool's death path. None = watchdog
+        # off (single-engine deployments have no survivor to resubmit
+        # to, so the per-request deadline is the only backstop there).
+        if engine_stall_deadline_s is not None \
+                and engine_stall_deadline_s <= 0:
+            raise ValueError(
+                "engine_stall_deadline_s must be > 0 (or None)")
+        self.engine_stall_deadline_s = engine_stall_deadline_s
+        self.watchdog_interval_s = watchdog_interval_s
+        self._watchdog = None
         self._engine_opts = dict(
             max_slots=max_slots, page_size=page_size,
             n_pages=n_pages, chunk=decode_chunk or stream_chunk,
@@ -136,7 +151,11 @@ class LlamaDeployment:
             prefix_cache=prefix_cache,
             spec_len=spec_len, spec_ngram=spec_ngram,
             max_queued=max_queued, max_retries=max_retries,
-            retry_backoff_s=retry_backoff_s)
+            retry_backoff_s=retry_backoff_s,
+            # with a watchdog guarding the pool, a submit racing a
+            # wedged scheduler sheds-and-reroutes instead of parking
+            # on the wedged engine's lock
+            admit_timeout_s=engine_stall_deadline_s)
 
     def setup_mesh(self, mesh):
         """Called by the serve replica when cfg.mesh is set: shard the
@@ -210,6 +229,14 @@ class LlamaDeployment:
                             self._engine, policy,
                             self.autoscale_provider).run(
                                 self.autoscale_interval_s)
+                    if self.engine_stall_deadline_s is not None:
+                        from ray_tpu.serve.watchdog import PoolWatchdog
+                        self._watchdog = PoolWatchdog(
+                            self._engine,
+                            stall_deadline_s=(
+                                self.engine_stall_deadline_s),
+                            poll_interval_s=(
+                                self.watchdog_interval_s)).run()
                 else:
                     self._engine = LLMEngine(
                         self.model, self.params,
@@ -222,6 +249,11 @@ class LlamaDeployment:
         """The attached PoolAutoscaler (None until the lazy engine is
         built or when autoscale=False)."""
         return self._autoscaler
+
+    def watchdog(self):
+        """The attached PoolWatchdog (None until the lazy engine is
+        built or when engine_stall_deadline_s is None)."""
+        return self._watchdog
 
     def serve_stats(self) -> dict:
         """Replica metrics hook (merged into Replica.stats() under

@@ -1,0 +1,248 @@
+"""Progress watchdog for the EnginePool: detect wedged replicas and
+escalate hang -> death.
+
+The pool's availability story (PR 5) only triggers when a replica
+RAISES: at-most-once token-identical resubmit rides the exception out
+of a dead engine's ``_fail_all``. A replica that wedges SILENTLY — a
+deadlocked dispatch, a stuck host<->device transfer, an XLA call that
+never returns — raises nothing: it keeps its HEALTHY state, keeps
+attracting prefix-affinity traffic, and strands every request it
+holds until per-request deadlines fire one by one. Ray detects
+liveness (heartbeats, ``num_heartbeats_timeout``) instead of
+inferring it from silence; PR 7 built the training-side mirror
+(``worker_progress_deadline_s``). This module closes the serving
+side.
+
+Signal: every engine carries a PROGRESS heartbeat (``LLMEngine._hb``)
+touched lock-free at the top of each scheduling round, at every
+dispatch completion, and at every readback drain — so a
+long-but-moving prefill keeps it fresh while a wedge lets it go
+stale. ``load_report()`` exposes ``heartbeat_age_s`` + ``has_work``
+and deliberately works WITHOUT the engine lock (brief try, then
+lock-free reads), so it doubles as the probe: it returns even from an
+engine whose scheduler thread is parked holding the lock, and the
+watchdog judges PROGRESS (heartbeat advanced / work drained), never
+responsiveness.
+
+Escalation ladder, per replica, driven by ``tick()``:
+
+1. HEALTHY, heartbeat stale past ``stall_deadline_s/2`` WITH work
+   pending -> **SUSPECT** (``pool.mark_suspect``). Routing, capacity
+   counts, and the autoscaler's signals all skip a SUSPECT replica
+   immediately — a maybe-dead replica must not count as capacity.
+   An idle engine parks on its condition variable with a stale
+   heartbeat and NO work: never suspected.
+2. SUSPECT, probe shows progress (heartbeat advanced since the
+   suspicion, or the work drained) -> back to **HEALTHY**
+   (``pool.clear_suspect``). False alarms cost a few routing skips,
+   nothing else.
+3. SUSPECT, still silent at ``stall_deadline_s`` -> **WEDGED**
+   (``pool.mark_wedged``): the engine is force-killed OUT-OF-BAND
+   (lock-free — the wedged thread holds the engine lock, so the
+   graceful path would deadlock) and the EXISTING death path runs:
+   consumers unblock typed, unstreamed requests resubmit
+   token-identically to survivors, the pool marks the replica DEAD
+   and rebuilds it with a generation bump. The zombie step thread
+   that later wakes finds itself fenced (``_force_killed``): it
+   cannot commit tokens, cannot dispatch, cannot touch the prefix
+   cache.
+
+Healthy replicas are never probed into restarts: the watchdog only
+ever acts on the one stale replica, and every transition re-checks
+identity + state under the pool lock.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.serve.engine_pool import HEALTHY, SUSPECT
+from ray_tpu.serve.errors import EngineShutdown
+
+
+class ReplicaWedged(EngineShutdown):
+    """The watchdog declared this request's replica wedged (no
+    scheduler progress past ``stall_deadline_s``) and force-killed
+    it. Subclasses ``EngineShutdown`` so the pool handle's recovery
+    path treats it exactly like any other replica death: unstreamed
+    requests resubmit, partially-streamed ones fail typed."""
+
+
+class PoolWatchdog:
+    """Monitors an ``EnginePool``'s replicas for scheduler progress
+    and escalates silence: SUSPECT at half the deadline, WEDGED (->
+    force-kill -> death path) at ``stall_deadline_s``.
+
+    Parameters
+    ----------
+    pool: the EnginePool to watch. Construction attaches the
+        watchdog (``pool_stats()`` grows a ``watchdog`` block) but
+        does NOT start the loop — call ``run()`` or drive ``tick()``
+        manually (tests use a fake ``time_fn``).
+    stall_deadline_s: silence budget. A replica with work pending
+        and no heartbeat movement for this long is declared wedged.
+    suspect_after_s: quarantine threshold (default: half the
+        deadline). Must leave room for at least one probe between
+        SUSPECT and WEDGED.
+    poll_interval_s: tick cadence of ``run()`` (default: an eighth
+        of the deadline, floored at 10ms) — several probes fit
+        inside the deadline, so detection lands WITHIN it.
+    time_fn: injectable clock (fake-clock policy tests).
+    """
+
+    def __init__(self, pool, *, stall_deadline_s: float = 5.0,
+                 suspect_after_s: Optional[float] = None,
+                 poll_interval_s: Optional[float] = None,
+                 time_fn: Callable[[], float] = time.monotonic):
+        if stall_deadline_s <= 0:
+            raise ValueError("stall_deadline_s must be > 0")
+        self.pool = pool
+        self.stall_deadline_s = float(stall_deadline_s)
+        self.suspect_after_s = (float(suspect_after_s)
+                                if suspect_after_s is not None
+                                else self.stall_deadline_s / 2)
+        if not 0 < self.suspect_after_s <= self.stall_deadline_s:
+            raise ValueError(
+                "suspect_after_s must be in (0, stall_deadline_s]")
+        self.poll_interval_s = (float(poll_interval_s)
+                                if poll_interval_s is not None
+                                else max(0.01,
+                                         self.stall_deadline_s / 8))
+        self._time = time_fn
+        self._lock = threading.Lock()
+        # idx -> (replica object, heartbeat age when suspected):
+        # identity pins the suspicion to THIS incarnation — a rebuilt
+        # replica at the same index starts clean
+        self._suspects: Dict[int, tuple] = {}
+        self.counts: Dict[str, int] = {
+            "ticks": 0, "suspected": 0, "recovered": 0, "wedged": 0}
+        self.log: List[Dict[str, Any]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        pool._watchdog = self
+
+    # ------------------------------------------------------------ tick
+
+    def tick(self) -> None:
+        """One watchdog pass over every HEALTHY/SUSPECT replica."""
+        with self._lock:
+            self.counts["ticks"] += 1
+        if getattr(self.pool, "_stopped", False):
+            return
+        with self.pool._lock:
+            reps = [r for r in self.pool._replicas
+                    if r.state in (HEALTHY, SUSPECT)]
+        live_idxs = set()
+        for rep in reps:
+            live_idxs.add(rep.idx)
+            try:
+                rpt = rep.engine.load_report()
+            except Exception:
+                continue     # a failing probe is not progress, but
+                             # the heartbeat judges — try next tick
+            if rpt.get("stopped"):
+                # died idle since the last route — same corpse
+                # detection routing does
+                self.pool._note_replica_death(rep)
+                self._forget(rep.idx)
+                continue
+            hb_age = rpt.get("heartbeat_age_s")
+            has_work = rpt.get("has_work")
+            if hb_age is None or has_work is None:
+                continue     # engine without the heartbeat surface
+            if rep.state == HEALTHY:
+                if has_work and hb_age >= self.suspect_after_s:
+                    if self.pool.mark_suspect(rep):
+                        with self._lock:
+                            self._suspects[rep.idx] = (rep, hb_age)
+                            self.counts["suspected"] += 1
+                        self._log("suspect", rep, hb_age)
+                continue
+            # SUSPECT: probe verdict. Progress = the heartbeat moved
+            # (its age shrank vs. what we recorded — it only grows
+            # while wedged) or the work drained away.
+            with self._lock:
+                tracked = self._suspects.get(rep.idx)
+            if tracked is None or tracked[0] is not rep:
+                # suspected by a previous watchdog incarnation, or
+                # tracking lost: adopt it now and judge next tick
+                with self._lock:
+                    self._suspects[rep.idx] = (rep, hb_age)
+                continue
+            suspected_hb_age = tracked[1]
+            if not has_work or hb_age < suspected_hb_age:
+                if self.pool.clear_suspect(rep):
+                    with self._lock:
+                        self.counts["recovered"] += 1
+                    self._log("recovered", rep, hb_age)
+                self._forget(rep.idx)
+                continue
+            if hb_age >= self.stall_deadline_s:
+                err = ReplicaWedged(
+                    f"replica {rep.idx} wedged: no scheduler "
+                    f"progress for {hb_age:.2f}s "
+                    f"(stall deadline {self.stall_deadline_s}s); "
+                    f"force-killed by the watchdog")
+                if self.pool.mark_wedged(rep, err,
+                                         stalled_for_s=hb_age):
+                    with self._lock:
+                        self.counts["wedged"] += 1
+                    self._log("wedged", rep, hb_age)
+                self._forget(rep.idx)
+        # drop tracking for replicas that left the HEALTHY/SUSPECT
+        # set behind our back (drained, killed, replaced)
+        with self._lock:
+            for idx in [i for i in self._suspects
+                        if i not in live_idxs]:
+                del self._suspects[idx]
+
+    def _forget(self, idx: int) -> None:
+        with self._lock:
+            self._suspects.pop(idx, None)
+
+    def _log(self, event: str, rep, hb_age: float) -> None:
+        self.log.append({"event": event, "replica": rep.idx,
+                         "generation": rep.generation,
+                         "heartbeat_age_s": round(hb_age, 4),
+                         "t": self._time()})
+
+    # ------------------------------------------------------ lifecycle
+
+    def run(self, interval_s: Optional[float] = None
+            ) -> "PoolWatchdog":
+        """Start the watch loop in a daemon thread."""
+        if self._thread is None:
+            self._stop.clear()
+            interval = (float(interval_s) if interval_s is not None
+                        else self.poll_interval_s)
+
+            def loop():
+                while not self._stop.is_set():
+                    try:
+                        self.tick()
+                    except Exception:
+                        pass   # a broken tick must not kill the loop
+                    self._stop.wait(interval)
+
+            self._thread = threading.Thread(
+                target=loop, name="pool-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+            self._thread = None
+
+    def stats(self) -> Dict[str, Any]:
+        """The ``watchdog`` block in ``pool_stats()`` / artifacts."""
+        with self._lock:
+            out = dict(self.counts)
+            out["active_suspects"] = len(self._suspects)
+        out["stall_deadline_s"] = self.stall_deadline_s
+        out["suspect_after_s"] = self.suspect_after_s
+        out["poll_interval_s"] = self.poll_interval_s
+        return out

@@ -604,3 +604,108 @@ def test_train_chaos_requires_elastic_block(tmp_path):
     assert any("elastic" in p for p in probs)
     bad = dict(_chaos_ok(), elastic={"min_world": 1})
     assert _problems_for("TRAIN_CHAOS_x.json", bad, tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# SERVE_CHAOS family (tools/chaos_serve.py artifacts)
+# ---------------------------------------------------------------------------
+
+
+def _serve_chaos_ok():
+    return {
+        "seed": 47,
+        "mesh": {"tp": 1, "replicas": 3},
+        "knobs": {"duration_s": 3.0, "stall_deadline_s": 1.0},
+        "schedule": [{"kind": "hang", "at_s": 0.9, "fired": True,
+                      "target_idx": 2}],
+        "injected": {"kill": 1, "hang": 1, "slow": 1, "readback": 1,
+                     "stockout": 1, "kill_during_drain": 1},
+        "requests": {"admitted": 360, "completed": 356,
+                     "failed_typed": 3, "failed_injected": 1,
+                     "lost": 0, "mismatched": 0, "shed": 220},
+        "attainment": 0.9889, "attainment_floor": 0.5,
+        "wedge": {"detected": True, "detect_stall_age_s": 1.06,
+                  "within_deadline": True},
+        "watchdog": {"ticks": 96, "suspected": 1, "recovered": 0,
+                     "wedged": 1},
+        "quiesced": True, "wall_s": 6.6, "git_sha": "abc1234",
+    }
+
+
+def test_serve_chaos_valid_artifact_passes(tmp_path):
+    assert _problems_for("SERVE_CHAOS_x.json", _serve_chaos_ok(),
+                         tmp_path) == []
+
+
+def test_serve_chaos_rejects_lost_requests(tmp_path):
+    bad = _serve_chaos_ok()
+    bad["requests"]["lost"] = 1
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("LOST" in p for p in probs)
+
+
+def test_serve_chaos_rejects_mismatched_completions(tmp_path):
+    bad = _serve_chaos_ok()
+    bad["requests"]["mismatched"] = 2
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("not token-identical" in p for p in probs)
+
+
+def test_serve_chaos_rejects_undetected_or_late_wedge(tmp_path):
+    undetected = _serve_chaos_ok()
+    undetected["wedge"]["detected"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", undetected, tmp_path)
+    assert any("undetected" in p for p in probs)
+    late = _serve_chaos_ok()
+    late["wedge"]["within_deadline"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", late, tmp_path)
+    assert any("past the stall deadline" in p for p in probs)
+    gone = _serve_chaos_ok()
+    del gone["wedge"]
+    probs = _problems_for("SERVE_CHAOS_x.json", gone, tmp_path)
+    assert any("wedge" in p for p in probs)
+
+
+def test_serve_chaos_rejects_faultless_campaign(tmp_path):
+    # a campaign that never fired its headline faults proves nothing
+    for kind in ("kill", "hang", "stockout"):
+        bad = _serve_chaos_ok()
+        bad["injected"][kind] = 0
+        probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+        assert any(f"never fired a {kind!r}" in p
+                   for p in probs), kind
+    # a slow-step that never fired is only a lost false-positive
+    # control, not a refusal
+    ok = _serve_chaos_ok()
+    ok["injected"]["slow"] = 0
+    assert _problems_for("SERVE_CHAOS_x.json", ok, tmp_path) == []
+
+
+def test_serve_chaos_rejects_attainment_below_recorded_floor(tmp_path):
+    bad = _serve_chaos_ok()
+    bad["attainment"] = 0.4
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("below the run's own recorded floor" in p
+               for p in probs)
+
+
+def test_serve_chaos_rejects_missing_seed_or_mesh(tmp_path):
+    no_seed = _serve_chaos_ok()
+    del no_seed["seed"]
+    probs = _problems_for("SERVE_CHAOS_x.json", no_seed, tmp_path)
+    assert any("seed" in p for p in probs)
+    no_mesh = _serve_chaos_ok()
+    del no_mesh["mesh"]
+    probs = _problems_for("SERVE_CHAOS_x.json", no_mesh, tmp_path)
+    assert any("mesh stamp" in p for p in probs)
+
+
+def test_serve_chaos_rejects_unquiesced_or_idle_pool(tmp_path):
+    leaky = _serve_chaos_ok()
+    leaky["quiesced"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", leaky, tmp_path)
+    assert any("quiesce" in p for p in probs)
+    idle = _serve_chaos_ok()
+    idle["requests"]["admitted"] = 0
+    probs = _problems_for("SERVE_CHAOS_x.json", idle, tmp_path)
+    assert any("zero requests" in p for p in probs)

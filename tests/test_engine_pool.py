@@ -890,3 +890,57 @@ def test_idle_replica_death_detected_at_route_time():
     assert pool.replica(0).generation == 1
     assert len(built) == 3              # rebuild used the factory
     pool.shutdown()
+
+
+def test_scale_down_drain_vs_kill_vs_resubmit_three_way():
+    """The full three-way race, deterministic at the fakes layer:
+    the autoscaler's scale_down is mid-drain on replica 2 (wait_idle
+    gated open) when replica 0 dies with an unstreamed request in
+    flight — the resubmit must land on replica 1, the only remaining
+    HEALTHY replica. Replica 2 is then killed WHILE draining: a
+    drained-and-killed replica must never receive a resubmission,
+    and the retire converges instead of wedging the scale-down."""
+    import threading as _t
+    gate = _t.Event()
+    fakes = [FakeEngine(0, outstanding=5),
+             FakeEngine(1, outstanding=50),
+             FakeEngine(2, outstanding=0)]
+    fakes[2].wait_idle = lambda timeout_s=30.0: (
+        gate.wait(timeout_s), True)[1]
+    fakes[0].die_on_failure = True
+    fakes[0].script.append(FakeHandle(fakes[0], [],
+                                      RuntimeError("device lost")))
+    fakes[1].script.append([7, 8])
+    pool = _fake_pool(fakes)
+    # arm the scale-down: least-loaded healthy replica is 2
+    retired = []
+    scaler = _t.Thread(target=lambda: retired.extend(
+        pool.scale_down(1, timeout_s=10.0)))
+    scaler.start()
+    deadline = time.monotonic() + 5.0
+    while (pool.replica(2).state != DRAINING
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    assert pool.replica(2).state == DRAINING
+    # replica 0 (5 outstanding vs 50) takes the request and dies;
+    # the resubmit races the in-progress drain
+    h = pool.submit([1, 2])
+    assert h.replica_idx == 0
+    assert h.result() == [7, 8]
+    assert h.replica_idx == 1          # NOT the draining replica
+    # now the draining replica is killed mid-drain
+    fakes[2]._stopped = True
+    pool._note_replica_death(pool.replica(2))
+    gate.set()
+    scaler.join(timeout=10.0)
+    assert not scaler.is_alive()
+    assert retired == [2]
+    # the drained-and-killed replica saw zero submissions, ever
+    assert fakes[2].submits == []
+    assert pool.route_stats["requeues"] == 1
+    assert pool.route_stats["replica_deaths"] == 2
+    assert pool.route_stats["replicas_retired"] == 1
+    assert pool.replica(1).state == HEALTHY
+    from ray_tpu.serve.engine_pool import RETIRED
+    assert pool.replica(2).state == RETIRED
+    pool.shutdown()

@@ -1,0 +1,390 @@
+"""Serving chaos harness: a seeded fault campaign against a live
+multi-replica engine pool under trace load.
+
+Runs a real EnginePool (llama_tiny replicas, fp32 greedy so every
+completion has ONE correct answer) with an attached PoolWatchdog and
+PoolAutoscaler while a seeded ChaosInjector (serve/chaos.py) fires
+replica kills, a dispatch hang (wedge), slow steps, readback faults,
+a capacity stockout, and a kill-during-drain race. Client threads
+keep submitting throughout.
+
+After the campaign it PROVES the pool's availability contract:
+
+- zero admitted requests lost: every submitted request either
+  completes token-identically to the greedy reference or fails with
+  a TYPED lifecycle error (and sheds carry an honest Retry-After);
+- the wedged replica is detected within the stall deadline and
+  replaced without restarting any replica the campaign didn't touch;
+- a slow (but moving) replica never trips the watchdog;
+- the released zombie is fenced: no tokens committed, no prefix
+  pages published, and every engine ever built — including corpses
+  replaced mid-run — quiesces leak-free;
+- attainment (completed / admitted) stays above a recorded floor.
+
+Writes a SERVE_CHAOS json artifact gated by
+tools/check_bench_schema.py (serve_chaos family).
+
+Run: JAX_PLATFORMS=cpu python tools/chaos_serve.py [--seed N] [--out FILE]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ATTAINMENT_FLOOR = 0.5
+
+
+def _reference_completion(model, params, prompt, n):
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.models.llama import generate
+    out = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                   max_new_tokens=n, temperature=0.0)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
+              max_new_tokens=10, stall_deadline_s=1.0,
+              watchdog_poll_s=0.05, drain_timeout_s=2.0,
+              attainment_floor=ATTAINMENT_FLOOR):
+    """One seeded serving chaos run. Returns the artifact dict after
+    hard-asserting the availability contract (the schema checker
+    re-refuses the same violations on the checked-in artifact)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.autoscaler.node_provider import (
+        ImmediateCapacityProvider)
+    from ray_tpu.models.llama import Llama, llama_tiny
+    from ray_tpu.serve import chaos
+    from ray_tpu.serve.engine import LLMEngine
+    from ray_tpu.serve.engine_pool import EnginePool
+    from ray_tpu.serve.errors import (DeadlineExceeded,
+                                      EngineDraining,
+                                      EngineOverloaded,
+                                      EngineShutdown,
+                                      RequestCancelled,
+                                      retry_after_s)
+    from ray_tpu.serve.faults import (FaultInjector,
+                                      check_pool_quiesced,
+                                      check_quiesced)
+    from ray_tpu.serve.pool_autoscaler import (PoolAutoscaler,
+                                               SLOPolicy)
+    from ray_tpu.serve.watchdog import PoolWatchdog
+
+    import jax
+    cfg = llama_tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+
+    # Prompt set + greedy ground truth (computed before the campaign;
+    # fp32 greedy decode is replica-independent, so "token-identical
+    # after resubmission" has one right answer).
+    shared = [3, 1, 4, 1, 5, 9, 2, 6]
+    prompts = [shared + [10 + i, 20 + i] for i in range(8)]
+    want = {tuple(p): _reference_completion(model, params, p,
+                                            max_new_tokens)
+            for p in prompts}
+
+    # Every engine ever built — including corpses the pool replaced —
+    # goes through the teardown + quiescence check at the end.
+    all_engines = []
+
+    def factory(idx):
+        inj = FaultInjector()
+        eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                        n_pages=64, chunk=4, temperature=0.0,
+                        seed=idx, prefix_cache=True,
+                        admit_timeout_s=0.25,
+                        fault_injector=inj)
+        all_engines.append(eng)
+        # Warm the jitted prefill/decode/prefix-copy paths BEFORE
+        # the replica joins the pool (deployments do the same — see
+        # reset_latency_stats): a cold engine's first dispatch holds
+        # the scheduler lock through seconds of XLA compilation with
+        # zero heartbeat movement, which a progress watchdog rightly
+        # cannot tell apart from a wedge.
+        eng.start()
+        try:
+            eng.submit(prompts[0], max_new_tokens=4).result()
+            eng.submit(prompts[1], max_new_tokens=4).result()
+        except EngineShutdown:
+            # teardown raced a late auto-restart rebuild and stopped
+            # this engine mid-warmup; hand it back un-warmed — the
+            # pool it would join is stopping too
+            pass
+        eng.reset_latency_stats()
+        return eng
+
+    pool = EnginePool(factory, replicas, auto_restart=True,
+                      restart_backoff_s=0.02, seed=seed)
+    watchdog = PoolWatchdog(pool, stall_deadline_s=stall_deadline_s,
+                            poll_interval_s=watchdog_poll_s).run()
+    provider = chaos.StockoutCapacityProvider(
+        ImmediateCapacityProvider())
+    policy = SLOPolicy(min_replicas=replicas,
+                       max_replicas=replicas + 1,
+                       cooldown_up_s=0.2, cooldown_down_s=60.0,
+                       idle_stable_s=60.0,
+                       drain_timeout_s=drain_timeout_s)
+    autoscaler = PoolAutoscaler(pool, policy, provider).run(0.1)
+
+    schedule = chaos.make_schedule(seed, duration_s)
+    baseline_gen = {r.idx: r.generation for r in pool._replicas}
+    injector = chaos.ChaosInjector(pool, schedule, seed=seed,
+                                   provider=provider,
+                                   drain_timeout_s=drain_timeout_s)
+
+    # -------------------------------------------------- trace load
+    results = {"completed": 0, "failed_typed": 0,
+               "failed_injected": 0, "lost": 0,
+               "mismatched": 0, "shed": 0}
+    failures = []            # (type name, retry_after hint or None)
+    res_lock = threading.Lock()
+    stop_load = threading.Event()
+    typed = (RequestCancelled, DeadlineExceeded, EngineOverloaded,
+             EngineDraining, EngineShutdown)
+
+    def client(ci):
+        import random as _random
+        rng = _random.Random(seed * 1000 + ci)
+        while not stop_load.is_set():
+            prompt = prompts[rng.randrange(len(prompts))]
+            try:
+                h = pool.submit(prompt,
+                                max_new_tokens=max_new_tokens)
+            except EngineOverloaded as e:
+                with res_lock:
+                    results["shed"] += 1
+                    failures.append((type(e).__name__,
+                                     retry_after_s(e, default=0.0)))
+                time.sleep(0.05)
+                continue
+            except EngineShutdown as e:
+                # pre-admission typed refusal (pool mid-teardown)
+                with res_lock:
+                    results["shed"] += 1
+                    failures.append((type(e).__name__,
+                                     retry_after_s(e, default=0.0)))
+                time.sleep(0.05)
+                continue
+            # admitted: from here on, lost == contract violation
+            try:
+                toks = h.result()
+            except typed as e:
+                with res_lock:
+                    results["failed_typed"] += 1
+                    failures.append((type(e).__name__,
+                                     retry_after_s(e, default=0.0)))
+                continue
+            except BaseException as e:  # noqa: BLE001
+                with res_lock:
+                    if "injected readback fault" in str(e):
+                        # the contained fault's planned culprit —
+                        # exactly one request per injection may land
+                        # here (the campaign asserts the count)
+                        results["failed_injected"] += 1
+                    else:
+                        results["lost"] += 1
+                        failures.append((type(e).__name__, None))
+                continue
+            with res_lock:
+                if toks == want[tuple(prompt)]:
+                    results["completed"] += 1
+                else:
+                    results["mismatched"] += 1
+
+    threads = [threading.Thread(target=client, args=(i,),
+                                name=f"chaos-client-{i}",
+                                daemon=True)
+               for i in range(clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    injector.start()
+
+    # Run until every event fired AND the wedge was detected (or a
+    # hard wall). The wedge needs stall_deadline_s of silence after
+    # the hang fires, so the campaign outlives the schedule.
+    deadline = t0 + duration_s + stall_deadline_s + 30.0
+    while time.time() < deadline:
+        if all(e.fired for e in injector.schedule) \
+                and watchdog.counts["wedged"] >= 1:
+            break
+        time.sleep(0.05)
+    # let in-flight resubmissions settle on the survivors
+    time.sleep(0.3)
+    stop_load.set()
+    for t in threads:
+        t.join(timeout=30)
+
+    # ---------------------------------------------------- teardown
+    injector.stop()            # joins drains, releases current hangs
+    # corpse engines replaced mid-run still own wedged threads:
+    # release their hangs too, then give every zombie a beat to
+    # unwind through the generation fence and exit
+    for eng in all_engines:
+        if eng._injector is not None:
+            eng._injector.release_all()
+    autoscaler.stop()
+    watchdog.stop()
+    pool.shutdown()
+    for eng in all_engines:
+        eng.shutdown()         # idempotent; completes the deferred
+        #                        cleanup of force-killed corpses
+    wall = time.time() - t0
+
+    # --------------------------------------------------- invariants
+    counts = injector.injected_counts()
+    for kind in chaos.KINDS:
+        assert counts[kind] >= 1, f"schedule never fired a {kind}"
+    admitted = (results["completed"] + results["failed_typed"]
+                + results["failed_injected"] + results["lost"]
+                + results["mismatched"])
+    assert admitted > 0, "campaign saw no admitted requests"
+    assert results["failed_injected"] <= counts["readback"], (
+        f"{results['failed_injected']} requests hit an injected "
+        f"readback fault but only {counts['readback']} were planned "
+        f"(containment leaked past the culprit)")
+    assert results["lost"] == 0, (
+        f"{results['lost']} admitted requests lost (untyped "
+        f"failure); failure types seen: {[n for n, _ in failures]}")
+    assert results["mismatched"] == 0, \
+        f"{results['mismatched']} completions diverged from greedy"
+    # sheds/refusals must carry an honest hint or none — never a lie;
+    # EngineOverloaded specifically contracts a positive Retry-After
+    for name, hint in failures:
+        if name == "EngineOverloaded":
+            assert hint and hint > 0, \
+                "shed without a Retry-After hint"
+
+    wd = watchdog.stats()
+    assert wd["wedged"] >= 1, "injected hang was never detected"
+    wedge_events = [e for e in watchdog.log if e["event"] == "wedged"]
+    detect_age = max(e["heartbeat_age_s"] for e in wedge_events)
+    # detected WITHIN the deadline: the stall age at detection is the
+    # deadline plus at most a few poll intervals of scheduling noise
+    # (generous slack for a loaded CPU box)
+    assert detect_age >= stall_deadline_s * 0.9
+    assert detect_age <= stall_deadline_s + 2.0, \
+        f"wedge detected only after {detect_age:.2f}s stall"
+
+    # untouched replicas were never restarted: generation moved only
+    # where the campaign aimed a kill / hang / drain race
+    touched = {e.target_idx for e in injector.schedule
+               if e.kind in ("kill", "hang", "kill_during_drain")
+               and e.target_idx is not None}
+    with pool._lock:
+        gen_moves = {r.idx: r.generation - baseline_gen.get(r.idx, 0)
+                     for r in pool._replicas}
+    for idx, moved in gen_moves.items():
+        if idx not in touched and idx in baseline_gen:
+            assert moved == 0, \
+                f"healthy replica {idx} was restarted ({moved}x)"
+
+    # leak-free quiescence: the pool AND every corpse engine
+    check_pool_quiesced(pool)
+    for eng in all_engines:
+        check_quiesced(eng)
+
+    attainment = results["completed"] / admitted
+    assert attainment >= attainment_floor, \
+        f"attainment {attainment:.3f} below floor {attainment_floor}"
+
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=10
+        ).stdout.strip() or None
+    except Exception:  # noqa: BLE001
+        sha = None
+
+    pool_stats = pool.pool_stats()
+    artifact = {
+        "notes": (
+            "Seeded chaos against a live multi-replica serving pool "
+            "under trace load: replica kill, dispatch hang escalated "
+            "hang->death by the watchdog, slow-but-moving step "
+            "(false-positive control), contained readback fault, "
+            "capacity stockout mid-autoscale, and a kill-during-"
+            "drain race. Invariants checked: zero admitted requests "
+            "lost (complete token-identically or fail typed with an "
+            "honest Retry-After), wedge detected within the stall "
+            "deadline without restarting untouched replicas, "
+            "leak-free pool quiescence including zombie corpses, "
+            "attainment above the recorded floor."),
+        "seed": seed,
+        "mesh": {"tp": 1, "replicas": replicas},
+        "knobs": {
+            "duration_s": duration_s, "clients": clients,
+            "max_new_tokens": max_new_tokens,
+            "stall_deadline_s": stall_deadline_s,
+            "suspect_after_s": watchdog.suspect_after_s,
+            "watchdog_poll_s": watchdog_poll_s,
+            "drain_timeout_s": drain_timeout_s,
+        },
+        "schedule": [e.as_dict() for e in injector.schedule],
+        "injected": counts,
+        "requests": dict(results, admitted=admitted),
+        "attainment": round(attainment, 4),
+        "attainment_floor": attainment_floor,
+        "wedge": {
+            "detected": True,
+            "detect_stall_age_s": round(detect_age, 4),
+            "within_deadline": True,
+        },
+        "watchdog": wd,
+        "counters": {
+            "pool": {k: v for k, v in pool_stats.items()
+                     if k not in ("watchdog", "autoscale")},
+            "suspects_total": pool_stats.get("suspects", 0),
+            "wedged_total": pool_stats.get("wedged", 0),
+            "autoscaler": autoscaler.stats(),
+            "provider_denied": provider.denied,
+        },
+        "quiesced": True,
+        "wall_s": round(wall, 2),
+        "git_sha": sha,
+    }
+    return artifact
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=47)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--clients", type=int, default=3)
+    ap.add_argument("--stall-deadline", type=float, default=1.0)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    artifact = run_chaos(
+        seed=args.seed, replicas=args.replicas,
+        duration_s=args.duration, clients=args.clients,
+        stall_deadline_s=args.stall_deadline)
+    print(json.dumps(artifact, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+        # Self-gate: the artifact must pass its own schema family.
+        from tools import check_bench_schema as cbs
+        problems = []
+        cbs.check_file(args.out, problems)
+        for p in problems:
+            print(f"SCHEMA FAIL {p}")
+        if problems:
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

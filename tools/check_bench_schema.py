@@ -49,6 +49,18 @@ family:
   seed is missing (the run must be reproducible), or when the loss
   curve diverged from the deterministic replay.
 
+- SERVE_CHAOS_*.json (tools/chaos_serve.py): seeded fault campaign
+  against a live multi-replica serving pool under trace load.
+  REFUSED when any admitted request was LOST (hung or vanished
+  untyped — the pool contract is complete token-identically or fail
+  typed), when any completion mismatched its single-engine greedy
+  reference, when the campaign never fired a kill / hang / stockout
+  (chaos without chaos proves nothing), when the injected wedge went
+  undetected or was detected past the stall deadline, when SLO
+  attainment fell below the floor the run recorded, when the pool
+  did not quiesce leak-free, or when the seed or the mesh stamp is
+  missing (irreproducible chaos is an anecdote, not a test).
+
 Engine serve results may also carry a `lifecycle` block
 (engine.lifecycle_stats()): retry-policy knobs
 (max_queued/max_retries/retry_backoff_s) + request-lifecycle
@@ -57,7 +69,7 @@ present.
 
 Usage: python tools/check_bench_schema.py [FILES...]
        (no FILES: validates every SERVE_BENCH_*.json / BENCH_*.json /
-       TRAIN_CHAOS_*.json in the repo root)
+       TRAIN_CHAOS_*.json / SERVE_CHAOS_*.json in the repo root)
 Exit 0 when every file validates; 1 otherwise, listing each problem.
 """
 import glob
@@ -171,6 +183,28 @@ TP_ARM_REQUIRED = {
     "requests": int,
     "gen_tokens": int,
     "devices": int,
+}
+
+# serve-chaos artifacts (tools/chaos_serve.py): campaign shape +
+# outcome. The `requests` ledger, the `injected` fault counts, the
+# `wedge` verdict, and the refusal rules are validated separately.
+SERVE_CHAOS_REQUIRED = {
+    "seed": int,
+    "attainment": NUM,
+    "attainment_floor": NUM,
+    "wall_s": NUM,
+}
+
+# every admitted request must land in exactly one of these buckets;
+# `lost` is the one the checker refuses on.
+SERVE_CHAOS_REQUESTS_REQUIRED = {
+    "admitted": int,
+    "completed": int,
+    "failed_typed": int,
+    "failed_injected": int,
+    "lost": int,
+    "mismatched": int,
+    "shed": int,
 }
 
 BENCH_WRAPPER_REQUIRED = {
@@ -672,6 +706,93 @@ def check_train_chaos(obj, name, problems):
         problems.append(f"{name}: git_sha must be a string")
 
 
+def check_serve_chaos(obj, name, problems):
+    """tools/chaos_serve.py artifact: a seeded fault campaign ran
+    against a live multi-replica pool. The checker REFUSES artifacts
+    whose run violated the availability contract the harness exists
+    to prove — any lost or mismatched admitted request, a campaign
+    that never fired its headline faults, an undetected or late
+    wedge, attainment below the recorded floor, a pool that failed
+    to quiesce, or a missing seed/mesh stamp."""
+    _check_fields(obj, SERVE_CHAOS_REQUIRED, name, problems)
+    _check_mesh(obj, name, problems, required=True)
+    inj = obj.get("injected")
+    if not isinstance(inj, dict):
+        problems.append(f"{name}: chaos artifact missing the "
+                        "'injected' fault-count object")
+    else:
+        for kind, n in inj.items():
+            if not isinstance(n, int) or isinstance(n, bool):
+                problems.append(f"{name}:injected: count for "
+                                f"{kind!r} must be int")
+        for kind in ("kill", "hang", "stockout"):
+            n = inj.get(kind)
+            if isinstance(n, int) and not isinstance(n, bool) \
+                    and n < 1:
+                problems.append(
+                    f"{name}: campaign never fired a {kind!r} fault "
+                    "— the artifact proves nothing about it")
+    sched = obj.get("schedule")
+    if not isinstance(sched, list) or not sched:
+        problems.append(f"{name}: schedule must be a non-empty list")
+    req = obj.get("requests")
+    if not isinstance(req, dict):
+        problems.append(f"{name}: chaos artifact missing the "
+                        "'requests' outcome ledger")
+    else:
+        _check_fields(req, SERVE_CHAOS_REQUESTS_REQUIRED,
+                      f"{name}:requests", problems)
+        lost = req.get("lost")
+        if isinstance(lost, int) and not isinstance(lost, bool) \
+                and lost != 0:
+            problems.append(
+                f"{name}: {lost} admitted request(s) LOST — every "
+                "admitted request must complete token-identically "
+                "or fail typed")
+        mm = req.get("mismatched")
+        if isinstance(mm, int) and not isinstance(mm, bool) \
+                and mm != 0:
+            problems.append(
+                f"{name}: {mm} completion(s) mismatched the greedy "
+                "reference — failover was not token-identical")
+        adm = req.get("admitted")
+        if isinstance(adm, int) and not isinstance(adm, bool) \
+                and adm <= 0:
+            problems.append(f"{name}: campaign admitted zero "
+                            "requests — the pool served no load")
+    wedge = obj.get("wedge")
+    if not isinstance(wedge, dict):
+        problems.append(f"{name}: chaos artifact missing the "
+                        "'wedge' detection block")
+    else:
+        if wedge.get("detected") is not True:
+            problems.append(
+                f"{name}: the injected wedge went undetected — the "
+                "watchdog never escalated hang to death")
+        if wedge.get("within_deadline") is not True:
+            problems.append(
+                f"{name}: wedge detection landed past the stall "
+                "deadline")
+        age = wedge.get("detect_stall_age_s")
+        if not isinstance(age, NUM) or isinstance(age, bool):
+            problems.append(f"{name}:wedge: missing numeric "
+                            "'detect_stall_age_s'")
+    att = obj.get("attainment")
+    floor = obj.get("attainment_floor")
+    if isinstance(att, NUM) and not isinstance(att, bool) \
+            and isinstance(floor, NUM) and not isinstance(floor, bool) \
+            and att < floor:
+        problems.append(
+            f"{name}: attainment {att} is below the run's own "
+            f"recorded floor {floor}")
+    if obj.get("quiesced") is not True:
+        problems.append(f"{name}: pool did not quiesce leak-free "
+                        "after the campaign")
+    sha = obj.get("git_sha")
+    if sha is not None and not isinstance(sha, str):
+        problems.append(f"{name}: git_sha must be a string")
+
+
 def check_bench(obj, name, problems):
     if "metric" in obj:            # flat metric row (BENCH_SELF_*)
         _check_fields(obj, FLAT_METRIC_REQUIRED, name, problems)
@@ -702,6 +823,8 @@ def check_file(path, problems):
         return
     if name.startswith("TRAIN_CHAOS"):
         check_train_chaos(obj, name, problems)
+    elif name.startswith("SERVE_CHAOS"):
+        check_serve_chaos(obj, name, problems)
     elif name.startswith("SERVE_BENCH"):
         check_serve_bench(obj, name, problems)
     else:
@@ -717,7 +840,9 @@ def main(argv):
                                               "SERVE_BENCH_*.json")) +
                        glob.glob(os.path.join(root, "BENCH_*.json")) +
                        glob.glob(os.path.join(root,
-                                              "TRAIN_CHAOS_*.json")))
+                                              "TRAIN_CHAOS_*.json")) +
+                       glob.glob(os.path.join(root,
+                                              "SERVE_CHAOS_*.json")))
     if not files:
         print("no bench artifacts found")
         return 0
