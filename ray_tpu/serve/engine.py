@@ -64,7 +64,7 @@ import numpy as np
 
 from ray_tpu.models.kv_cache import (BlockAllocator, PagedKVLayer,
                                      init_kv_pool)
-from ray_tpu.serve import spec_decode
+from ray_tpu.serve import obs, spec_decode
 # Typed lifecycle errors live in a jax-free module (serve/errors.py)
 # so the HTTP proxy and clients can import them without the device
 # stack; RequestError is re-exported here for existing call sites.
@@ -141,6 +141,11 @@ class _Request:
     attempts: int = 0            # requeues after contained faults
     t_earliest: float = 0.0      # retry backoff: no re-admission
                                  # before this monotonic instant
+    trace_id: Optional[str] = None    # request-scope trace id (minted
+                                 # at the HTTP proxy, survives pool
+                                 # resubmits)
+    t_last_emit: Optional[float] = None   # last stream emission (for
+                                 # the inter-token phase histogram)
 
     @property
     def remaining(self) -> int:
@@ -318,7 +323,9 @@ class LLMEngine:
                  shed_retry_after_s: float = 1.0,
                  admit_timeout_s: Optional[float] = None,
                  sharding=None,
-                 fault_injector=None):
+                 fault_injector=None,
+                 events: bool = True,
+                 flight_dir: Optional[str] = None):
         self.model = model
         self.cfg = model.config
         # Tensor-parallel placement (serve/sharding.py
@@ -435,11 +442,18 @@ class LLMEngine:
         # mid-prefill slots share each round's token budget up to
         # this batch width (one jitted call, fixed row count)
         self._max_prefill_batch = 4
-        # dispatch-order trace for tests/debugging: ("prefill",
-        # ((slot, tokens), ...)) and ("decode", steps) entries in the
-        # order the device will execute them
-        self.sched_trace: "collections.deque" = \
-            collections.deque(maxlen=4096)
+        # Typed lifecycle event log (serve/obs.py): lock-free bounded
+        # ring recording every request phase and scheduler action.
+        # ``events=False`` is the A/B arm proving the log costs
+        # nothing measurable. ``sched_trace`` stays as a compat view
+        # rendering the four legacy dispatch-order tuple kinds.
+        self.events = obs.EventLog(8192, name="engine",
+                                   enabled=events)
+        self._obs_enabled = bool(events)
+        self.sched_trace = obs.SchedTraceView(self.events)
+        # Flight recorder sink: when set, EngineFault containment and
+        # whole-engine failure dump a postmortem bundle here.
+        self.flight_dir = flight_dir
         # submit->first-emission latencies (seconds), most recent
         self.ttfts_s: "collections.deque" = \
             collections.deque(maxlen=4096)
@@ -475,7 +489,8 @@ class LLMEngine:
 
     def submit(self, prompt_ids: List[int],
                max_new_tokens: int = 64,
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Queue one request. ``deadline_s`` (relative, seconds) sets
         a hard completion deadline: the request fails with
         ``DeadlineExceeded`` at whatever phase it is in — queued,
@@ -502,9 +517,13 @@ class LLMEngine:
                 f"prompt+completion {total} exceeds model "
                 f"max_seq_len {self.cfg.max_seq_len}")
         req = _Request(next(self._rid), prompt_ids, max_new_tokens,
-                       t_submit=time.monotonic())
+                       t_submit=time.monotonic(), trace_id=trace_id)
         if deadline_s is not None:
             req.deadline = req.t_submit + deadline_s
+        self.events.append("submit", rid=req.rid, t=req.t_submit,
+                           data={"trace_id": trace_id,
+                                 "prompt_len": len(prompt_ids),
+                                 "max_new_tokens": max_new_tokens})
         # Bounded admission-lock acquire: the scheduler holds this
         # lock across whole rounds, and a WEDGED scheduler (hung
         # dispatch — see serve/watchdog.py) holds it forever. With a
@@ -519,6 +538,8 @@ class LLMEngine:
             acquired = self._work.acquire()
         if not acquired:
             self.stats["admit_timeouts"] += 1
+            self.events.append("shed", rid=req.rid,
+                               data={"why": "admit_timeout"})
             raise EngineOverloaded(
                 f"admission lock unavailable for "
                 f"{self.admit_timeout_s}s (scheduler stalled); "
@@ -535,6 +556,8 @@ class LLMEngine:
                     and len(self._wait) >= self.max_queued):
                 self.stats["shed"] += 1
                 _metrics()["shed"].inc()
+                self.events.append("shed", rid=req.rid,
+                                   data={"why": "queue_full"})
                 raise EngineOverloaded(
                     f"admission queue full ({len(self._wait)} waiting"
                     f" >= max_queued={self.max_queued}); request shed",
@@ -693,6 +716,7 @@ class LLMEngine:
         cache (retire-path inserts divert to plain frees)."""
         err = err or EngineShutdown(
             "engine force-killed: wedged (no scheduler progress)")
+        self.events.append("force_kill", data={"error": repr(err)})
         self._force_killed = True
         self._stopped = True
 
@@ -803,6 +827,8 @@ class LLMEngine:
         req.closed = True
         req.error = err
         req.out_q.put(_DONE)
+        self.events.append(count or "failed", rid=req.rid,
+                           data={"error": repr(err)})
         if count:
             self.stats[count] += 1
             m = _metrics().get(count)
@@ -911,6 +937,8 @@ class LLMEngine:
             self._hb = time.monotonic()   # progress heartbeat: a new
                                           # round means the previous
                                           # one completed
+            _pm = obs.phase_metrics() if self._obs_enabled else None
+            _t0 = self._hb
             self._fire("step")     # global-fault site: escapes to
                                    # _fail_all, like real device loss
             if self._stopped:
@@ -941,7 +969,11 @@ class LLMEngine:
                 # non-empty queue with nothing admitted = retry
                 # backoff or a transiently dry pool: still working
                 return bool(self._wait)
+            _tp = time.monotonic() if _pm is not None else 0.0
             plan = self._plan_steps_locked()
+            if _pm is not None:
+                _pm["plan"].observe(time.monotonic() - _tp)
+            _td = time.monotonic() if _pm is not None else 0.0
             try:
                 if plan.prefill:
                     self._dispatch_prefill_locked(plan.prefill)
@@ -966,10 +998,14 @@ class LLMEngine:
                 e.sids = sorted(part | set(e.sids))
                 self._contain_fault_locked(e)
                 return True
+            if _pm is not None:
+                _pm["dispatch"].observe(time.monotonic() - _td)
             # trailing readback: block only on a dispatch OLDER than
             # the one just queued (keep=1), so the fetch round trip
             # overlaps the newest dispatch's compute — never its own
             self._drain_fetches_locked(limit=1, keep=1)
+            if _pm is not None:
+                _pm["round_wall"].observe(time.monotonic() - _t0)
             return True
 
     def _contain_fault_locked(self, e: EngineFault) -> None:
@@ -985,6 +1021,15 @@ class LLMEngine:
         (device loss), which still take that path."""
         self.stats["contained_faults"] += 1
         _metrics()["contained_faults"].inc()
+        self.events.append("fault", rid=e.culprit_rid,
+                           sid=e.culprit_sid,
+                           data={"sids": list(e.sids),
+                                 "error": repr(e.original)})
+        if self.flight_dir is not None:
+            # postmortem bundle while the fault context is still live
+            # (probing is lock-free, so holding self._lock is fine)
+            obs.dump_flight_bundle(self.flight_dir, "engine-fault",
+                                   engine=self)
         # settle trailing readbacks first: a requeued request
         # recomputes from prompt + generated, which must be complete
         self._drain_fetches_locked()
@@ -1022,6 +1067,8 @@ class LLMEngine:
         self._wait.append(req)
         self.stats["retries"] += 1
         _metrics()["retries"].inc()
+        self.events.append("requeue", rid=req.rid, sid=sid,
+                           data={"attempts": req.attempts})
 
     def _plan_steps_locked(self) -> StepPlan:
         """Plan this round with the pure, device-free planner
@@ -1127,6 +1174,13 @@ class LLMEngine:
         and in-flight request fails with the error. Attributable
         faults never reach here — they are contained per-slot in
         step() — so this is the path of last resort."""
+        self.events.append("fail_all", data={"error": repr(e)})
+        if self.flight_dir is not None:
+            # the engine is about to lose everything it knows: dump
+            # the postmortem BEFORE teardown clears the queues
+            obs.dump_flight_bundle(self.flight_dir, "engine-fail-all",
+                                   engine=self,
+                                   extra={"error": repr(e)})
         with self._lock:
             self.stats["failed_all"] += 1
             failed = set()
@@ -1245,14 +1299,25 @@ class LLMEngine:
                          shared=len(shared_pages))
             self.slots[free[0]] = slot
             self.stats["admitted"] += 1
+            _now = time.monotonic()
+            self.events.append("admit", rid=req.rid, sid=free[0],
+                               t=_now,
+                               data={"cached": start,
+                                     "pages": len(slot.pages)})
+            if self._obs_enabled and not req.generated \
+                    and not req.attempts and not req.preemptions:
+                # first admission only: re-admissions after
+                # preemption/fault would double-count the wait
+                obs.phase_metrics()["queue_wait"].observe(
+                    max(0.0, _now - req.t_submit))
             if self.prefix_cache is not None:
                 self.prefix_cache.account(start, len(prompt) - start)
                 self.stats["cache_hit_tokens"] += start
                 self.stats["cache_miss_tokens"] += len(prompt) - start
                 if start:
                     self.stats["cache_hit_admissions"] += 1
-                    self.sched_trace.append(
-                        ("cache_hit", (free[0], start)))
+                    self.events.append("cache_hit", rid=req.rid,
+                                       sid=free[0], data=start)
 
     def _dispatch_prefill_locked(self, grants):
         """Execute this round's prefill grants: grow each granted
@@ -1424,6 +1489,8 @@ class LLMEngine:
         self._free_slot_pages_locked(slot, retire=False)
         slot.req.preemptions += 1
         self.stats["preemptions"] += 1
+        self.events.append("preempt", rid=slot.req.rid, sid=ix,
+                           data={"preemptions": slot.req.preemptions})
         self._wait.appendleft(slot.req)   # front: re-admit first
 
     def _dispatch_chunk_locked(self, steps: int):
@@ -1461,7 +1528,7 @@ class LLMEngine:
             slot.pos += steps
             slot.decoded += steps
         self._fetchq.append((toks, riders, steps))
-        self.sched_trace.append(("decode", steps))
+        self.events.append("decode", data=steps)
         self.stats["chunks"] += 1
         self.stats["decode_steps"] += steps
         self._hb = time.monotonic()   # dispatch completed: progress
@@ -1580,7 +1647,8 @@ class LLMEngine:
                 a += 1
             produced = a + 1
             proposed = len(drafts)
-            self.sched_trace.append(("spec", i, proposed, a))
+            self.events.append("spec", rid=slot.req.rid, sid=i,
+                               data=(proposed, a))
             self.stats["spec_riders"] += 1
             self.stats["spec_proposed"] += proposed
             self.stats["spec_accepted"] += a
@@ -1678,9 +1746,16 @@ class LLMEngine:
             if pre_ready:
                 pend_pre, self._pending_prefill = \
                     self._pending_prefill, []
+            _t_rb = time.monotonic()
             vals = jax.device_get(
                 [b[0] for b in batch] + [f for f, _ in pend_pre])
             self._hb = time.monotonic()   # readback completed
+            self.events.append(
+                "readback",
+                data={"bufs": len(batch) + len(pend_pre)})
+            if self._obs_enabled:
+                obs.phase_metrics()["readback"].observe(
+                    self._hb - _t_rb)
             k = len(batch)
             # prefill firsts FIRST: a slot's seeding prefill always
             # precedes its first decode ride, and both can land in
@@ -1729,6 +1804,7 @@ class LLMEngine:
         if req.closed:
             return
         done = False
+        n_put = 0
         for t in tokens:
             t = int(t)
             if req.t_first is None:
@@ -1741,12 +1817,27 @@ class LLMEngine:
                 a = self._ttft_ewma_alpha
                 self._ttft_ewma = ttft if self._ttft_ewma is None \
                     else a * ttft + (1 - a) * self._ttft_ewma
+                self.events.append("first_token", rid=req.rid,
+                                   sid=ix, t=req.t_first,
+                                   data={"ttft_s": ttft})
+                if self._obs_enabled:
+                    obs.phase_metrics()["ttft"].observe(ttft)
             req.generated.append(t)
             req.out_q.put(t)
+            n_put += 1
             if ((self.eos_id is not None and t == self.eos_id)
                     or req.remaining <= 0):
                 done = True
                 break
+        if n_put:
+            _now = time.monotonic()
+            self.events.append("emit", rid=req.rid, sid=ix, t=_now,
+                               data={"n": n_put})
+            if self._obs_enabled and req.t_last_emit is not None:
+                # mean gap per token over this readback batch
+                obs.phase_metrics()["inter_token"].observe(
+                    max(0.0, _now - req.t_last_emit) / n_put)
+            req.t_last_emit = _now
         if done:
             req.closed = True
             slot = self.slots[ix]
@@ -1754,6 +1845,8 @@ class LLMEngine:
                 self.slots[ix] = None
                 self._free_slot_pages_locked(slot, retire=True)
             self.stats["completed"] += 1
+            self.events.append("retire", rid=req.rid, sid=ix,
+                               data={"generated": len(req.generated)})
             req.out_q.put(_DONE)
 
     # ----------------------------------------------------- jitted fns
@@ -1831,8 +1924,10 @@ class LLMEngine:
         # rows so drains (and preemption barriers) can sync on every
         # in-flight prefill dispatch.
         self._pending_prefill.append((firsts, placements))
-        self.sched_trace.append(
-            ("prefill", tuple((ix, take) for ix, _s, take in rows)))
+        self.events.append(
+            "prefill",
+            rid=tuple(slot.req.rid for _ix, slot, _t in rows),
+            data=tuple((ix, take) for ix, _s, take in rows))
         self.stats["prefills"] += 1
         self.stats["prefill_tokens"] += sum(
             take for _ix, _s, take in rows)

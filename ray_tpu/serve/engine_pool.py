@@ -62,6 +62,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ray_tpu.serve import obs
 from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
                                   EngineOverloaded, EngineShutdown,
                                   PoolDegraded, RequestCancelled,
@@ -187,12 +188,14 @@ class PoolRequestHandle:
 
     def __init__(self, pool: "EnginePool", prompt: List[int],
                  max_new_tokens: int, deadline_s: Optional[float],
-                 session_id: Optional[str]):
+                 session_id: Optional[str],
+                 trace_id: Optional[str] = None):
         self._pool = pool
         self._prompt = prompt
         self._mnt = max_new_tokens
         self._deadline_s = deadline_s
         self._session_id = session_id
+        self._trace_id = trace_id
         self._t0 = time.monotonic()
         self._t_first: Optional[float] = None
         self._rep: Optional[_Replica] = None
@@ -310,10 +313,11 @@ class PoolRequestHandle:
             raise err from cause
         deadline = self._remaining_deadline(cause)
         self._resubmits += 1
-        self._pool._count_requeue()
+        self._pool._count_requeue(trace_id=self._trace_id)
         try:
             self._rep, self._inner = self._pool._submit_once(
-                self._prompt, self._mnt, deadline, self._session_id)
+                self._prompt, self._mnt, deadline, self._session_id,
+                trace_id=self._trace_id)
         except BaseException as e:
             self._fail(e)
             raise
@@ -384,6 +388,11 @@ class EnginePool:
         # pool-level routing/lifecycle counters (the engines keep
         # their own ``stats``; ``EnginePool.stats`` aggregates those)
         self.route_stats: Dict[str, int] = collections.Counter()
+        # typed pool event log (serve/obs.py): routing decisions,
+        # resubmits, drains, SUSPECT/WEDGED transitions, replica
+        # deaths/restarts, autoscaler decisions — one ring per pool,
+        # merged with engine rings by the trace exporter
+        self.events = obs.EventLog(2048, name="pool")
         self._stopped = False
         self._replicas: List[_Replica] = []
         for i in range(num_replicas):
@@ -427,19 +436,24 @@ class EnginePool:
     def submit(self, prompt_ids: Sequence[int],
                max_new_tokens: int = 64,
                deadline_s: Optional[float] = None,
-               session_id: Optional[str] = None) -> PoolRequestHandle:
+               session_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> PoolRequestHandle:
         """Route and queue one request (engine ``submit`` signature
-        plus ``session_id`` for stickiness). Raises exactly like a
-        single engine: validation ``RequestError`` immediately,
-        pool-aggregate ``EngineOverloaded`` when every healthy
-        replica sheds, ``EngineShutdown`` when none is left."""
+        plus ``session_id`` for stickiness and ``trace_id`` for
+        request-scope tracing — the id survives replica-death
+        resubmits because the handle re-sends it). Raises exactly
+        like a single engine: validation ``RequestError``
+        immediately, pool-aggregate ``EngineOverloaded`` when every
+        healthy replica sheds, ``EngineShutdown`` when none is
+        left."""
         if self._stopped:
             raise EngineShutdown("engine pool stopped")
         prompt = [int(t) for t in prompt_ids]
         handle = PoolRequestHandle(self, prompt, max_new_tokens,
-                                   deadline_s, session_id)
+                                   deadline_s, session_id, trace_id)
         rep, inner = self._submit_once(prompt, max_new_tokens,
-                                       deadline_s, session_id)
+                                       deadline_s, session_id,
+                                       trace_id=trace_id)
         handle._attach(rep, inner)
         return handle
 
@@ -481,6 +495,7 @@ class EnginePool:
             rep.state = DRAINING
             self.route_stats["drains"] += 1
             self._drop_sticky_locked(idx)
+        self.events.append("drain", sid=idx)
         _metrics()["drains"].inc()
         eng = rep.engine
         eng.drain()
@@ -604,6 +619,8 @@ class EnginePool:
                 idx, eng, HEALTHY, deaths=old.deaths,
                 generation=old.generation + 1)
             self.route_stats["restarts"] += 1
+        self.events.append("restart", sid=idx,
+                           data={"generation": old.generation + 1})
         _metrics()["restarts"].inc()
 
     # -------------------------------------------------- watchdog hooks
@@ -621,6 +638,7 @@ class EnginePool:
             rep.state = SUSPECT
             self.route_stats["suspects"] += 1
             self._drop_sticky_locked(rep.idx)
+        self.events.append("suspect", sid=rep.idx)
         _metrics()["suspects"].inc()
         return True
 
@@ -633,6 +651,7 @@ class EnginePool:
                     or rep.state != SUSPECT):
                 return False
             rep.state = HEALTHY
+        self.events.append("suspect_cleared", sid=rep.idx)
         return True
 
     def mark_wedged(self, rep: _Replica,
@@ -650,6 +669,9 @@ class EnginePool:
                     or rep.state not in (HEALTHY, SUSPECT)):
                 return False
             self.route_stats["wedged"] += 1
+        self.events.append("wedged", sid=rep.idx,
+                           data={"stalled_for_s": stalled_for_s,
+                                 "error": repr(err) if err else None})
         m = _metrics()
         m["wedged"].inc()
         if stalled_for_s is not None:
@@ -688,6 +710,9 @@ class EnginePool:
                     rep.state = DEGRADED
                     self.route_stats["crash_loops"] += 1
         if transitioned:
+            self.events.append("replica_death", sid=rep.idx,
+                               data={"deaths": rep.deaths,
+                                     "state": rep.state})
             _metrics()["replica_deaths"].inc()
         # idempotent: unblocks every remaining consumer typed and
         # frees whatever the dead scheduler left behind
@@ -746,16 +771,20 @@ class EnginePool:
         for k in [k for k, v in self._sticky.items() if v == idx]:
             del self._sticky[k]
 
-    def _count_requeue(self) -> None:
+    def _count_requeue(self, trace_id: Optional[str] = None) -> None:
         with self._lock:
             self.route_stats["requeues"] += 1
+        self.events.append("resubmit",
+                           data={"trace_id": trace_id}
+                           if trace_id is not None else None)
         _metrics()["requeues"].inc()
 
     # --------------------------------------------------------- routing
 
     def _submit_once(self, prompt: List[int], max_new_tokens: int,
                      deadline_s: Optional[float],
-                     session_id: Optional[str]):
+                     session_id: Optional[str],
+                     trace_id: Optional[str] = None):
         """Route + submit until one replica accepts. Replicas that
         shed/die/drain between the snapshot and the submit are
         excluded and routing retries; when nothing accepts, the
@@ -805,9 +834,14 @@ class EnginePool:
                     err.retry_after_s = eta
                 raise err
             try:
-                inner = rep.engine.submit(
-                    prompt, max_new_tokens=max_new_tokens,
+                # trace_id only when set: fake engines in tests (and
+                # older engine builds) take the bare 3-arg signature
+                kw: Dict[str, Any] = dict(
+                    max_new_tokens=max_new_tokens,
                     deadline_s=deadline_s)
+                if trace_id is not None:
+                    kw["trace_id"] = trace_id
+                inner = rep.engine.submit(prompt, **kw)
             except EngineOverloaded as e:
                 shed.append(e)
                 exclude.add(rep.idx)
@@ -817,7 +851,8 @@ class EnginePool:
                 self._note_replica_death(rep)
                 exclude.add(rep.idx)
                 continue
-            self._record_route(rep, decision, session_id)
+            self._record_route(rep, decision, session_id,
+                               trace_id=trace_id)
             return rep, inner
 
     def _route(self, prompt: List[int], session_id: Optional[str],
@@ -924,7 +959,14 @@ class EnginePool:
                       "pages": match_pages.get(pick.idx, 0)}
 
     def _record_route(self, rep: _Replica, decision: Dict[str, Any],
-                      session_id: Optional[str]) -> None:
+                      session_id: Optional[str],
+                      trace_id: Optional[str] = None) -> None:
+        self.events.append(
+            "route", sid=rep.idx,
+            data={"kind": decision["kind"],
+                  "pages": decision.get("pages", 0),
+                  "spilled": bool(decision.get("spilled")),
+                  "trace_id": trace_id})
         m = _metrics()
         with self._lock:
             self.route_stats["routed"] += 1

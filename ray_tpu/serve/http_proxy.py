@@ -84,6 +84,25 @@ class HTTPProxy:
             self._handles[name] = h
         return h
 
+    @staticmethod
+    def _mint_trace_id(request, payload):
+        """Request-scope trace id, OPT-IN only: honor an
+        ``X-Trace-Id`` header, or mint one when span tracing is
+        enabled process-wide. Returns the id (after injecting it
+        into a dict payload that lacks one) or None — the default
+        path never touches the payload, preserving the exact-echo
+        body contract."""
+        tid = request.headers.get("X-Trace-Id")
+        if tid is None:
+            from ray_tpu.util import tracing
+            if not tracing.is_enabled():
+                return None
+            from ray_tpu.serve import obs
+            tid = obs.mint_trace_id()
+        if isinstance(payload, dict):
+            payload.setdefault("trace_id", tid)
+        return tid
+
     async def _read_payload(self, request):
         """(payload, error_response): JSON body for body-carrying
         verbs, query dict otherwise."""
@@ -109,21 +128,28 @@ class HTTPProxy:
             return err
         # Streaming is transport metadata: opt in via the query string
         # ONLY (?stream=1). POST bodies are never inspected or
-        # modified — a deployment may legitimately take a "stream" key.
+        # modified — a deployment may legitimately take a "stream"
+        # key. (Exception, equally opt-in: an X-Trace-Id header or
+        # process-wide tracing injects a "trace_id" key so the id
+        # can ride through pool routing into the engine event log.)
         stream = request.query.get("stream") in ("1", "true")
         if stream and request.method != "POST":
             payload.pop("stream", None)     # strip it from query args
             payload = payload or None
+        tid = self._mint_trace_id(request, payload)
         try:
             if stream:
                 return await self._dispatch_stream(request, handle,
-                                                   payload)
+                                                   payload,
+                                                   trace_id=tid)
             ref = handle.remote(payload) if payload is not None \
                 else handle.remote()
             loop = asyncio.get_event_loop()
             result = await loop.run_in_executor(
                 self._pool, lambda: ray_tpu.get(ref, timeout=60))
-            return web.json_response({"result": result})
+            headers = {"X-Trace-Id": tid} if tid else None
+            return web.json_response({"result": result},
+                                     headers=headers)
         except asyncio.CancelledError:
             # client disconnected mid-request (aiohttp cancels the
             # handler): there is nobody to answer — the 499-style
@@ -132,7 +158,8 @@ class HTTPProxy:
         except Exception as e:  # noqa: BLE001
             return self._error_response(e)
 
-    async def _dispatch_stream(self, request, handle, payload):
+    async def _dispatch_stream(self, request, handle, payload,
+                               trace_id=None):
         """Chunked-transfer streaming: each chunk from the deployment's
         generator is one newline-delimited JSON line (reference:
         serve/_private/http_util.py streaming responses)."""
@@ -161,8 +188,10 @@ class HTTPProxy:
             raise
         except Exception as e:  # noqa: BLE001
             return self._error_response(e)
-        resp = web.StreamResponse(
-            headers={"Content-Type": "application/x-ndjson"})
+        headers = {"Content-Type": "application/x-ndjson"}
+        if trace_id:
+            headers["X-Trace-Id"] = trace_id
+        resp = web.StreamResponse(headers=headers)
         resp.enable_chunked_encoding()
         await resp.prepare(request)
         # Once prepare() has committed chunked encoding we can never

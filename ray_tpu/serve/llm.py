@@ -330,13 +330,15 @@ class LlamaDeployment:
         return rpt
 
     def _request_args(self, payload):
-        """(prompt_ids, max_new_tokens, deadline_s, session_id): a
-        request is a plain token-id list, or a dict carrying
-        per-request lifecycle/routing overrides ({"prompt_ids":
-        [...], "max_new_tokens": n, "deadline_s": s, "session_id":
-        "u123"}) — what the HTTP proxy posts through. session_id
-        drives engine-pool stickiness and is ignored by a single
-        engine."""
+        """(prompt_ids, max_new_tokens, deadline_s, session_id,
+        trace_id): a request is a plain token-id list, or a dict
+        carrying per-request lifecycle/routing overrides
+        ({"prompt_ids": [...], "max_new_tokens": n, "deadline_s": s,
+        "session_id": "u123", "trace_id": "ab12..."}) — what the
+        HTTP proxy posts through. session_id drives engine-pool
+        stickiness and is ignored by a single engine; trace_id is
+        the proxy-minted request-scope id stamped into the engine
+        event log (serve/obs.py)."""
         if isinstance(payload, dict):
             prompt_ids = payload.get("prompt_ids",
                                      payload.get("prompt"))
@@ -347,23 +349,27 @@ class LlamaDeployment:
                                   self.max_new_tokens))
             dl = payload.get("deadline_s", self.deadline_s)
             sid = payload.get("session_id")
+            tid = payload.get("trace_id")
             return list(prompt_ids), mnt, (
                 float(dl) if dl is not None else None), (
-                str(sid) if sid is not None else None)
+                str(sid) if sid is not None else None), (
+                str(tid) if tid is not None else None)
         return (list(payload), self.max_new_tokens, self.deadline_s,
-                None)
+                None, None)
 
-    def _submit(self, ids, mnt, dl, sid=None):
+    def _submit(self, ids, mnt, dl, sid=None, tid=None):
         kw: Dict[str, Any] = dict(max_new_tokens=mnt, deadline_s=dl)
         if sid is not None and self.num_engine_replicas > 1:
             kw["session_id"] = sid
+        if tid is not None:
+            kw["trace_id"] = tid
         return self.engine().submit(ids, **kw)
 
     def __call__(self, prompt_ids: List[int]) -> List[int]:
         """One request: token ids in, prompt+generated ids out."""
         if self.use_engine:
-            ids, mnt, dl, sid = self._request_args(prompt_ids)
-            gen = self._submit(ids, mnt, dl, sid).result()
+            ids, mnt, dl, sid, tid = self._request_args(prompt_ids)
+            gen = self._submit(ids, mnt, dl, sid, tid).result()
             return list(ids) + gen
         import jax.numpy as jnp
         from ray_tpu.models.llama import generate
@@ -379,8 +385,8 @@ class LlamaDeployment:
         generator in a StreamingResponse and the HTTP proxy in a
         chunked ndjson response)."""
         if self.use_engine:
-            ids, mnt, dl, sid = self._request_args(prompt_ids)
-            h = self._submit(ids, mnt, dl, sid)
+            ids, mnt, dl, sid, tid = self._request_args(prompt_ids)
+            h = self._submit(ids, mnt, dl, sid, tid)
             try:
                 yield from h.stream()
             except GeneratorExit:

@@ -329,6 +329,18 @@ class PoolAutoscaler:
         target = self.target_replicas()
         _metrics()["target_replicas"].set(target)
         self.timeline.append((now, self.pool.active_count(), target))
+        if acted != "hold":
+            # capacity moved: put the decision on the pool's event
+            # timeline (holds would drown the ring at one per tick)
+            log = getattr(self.pool, "events", None)
+            if log is not None:
+                log.append("autoscale", data={
+                    "decision": acted, "target": target,
+                    "queue_per_replica":
+                        round(sig["queue_per_replica"], 4),
+                    "shed_rate": round(sig["shed_rate"], 4),
+                    "free_slot_frac":
+                        round(sig["free_slot_frac"], 4)})
         return acted
 
     def _harvest_ready(self) -> None:

@@ -57,6 +57,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu.serve import obs
 from ray_tpu.serve.engine_pool import HEALTHY, SUSPECT
 from ray_tpu.serve.errors import EngineShutdown
 
@@ -66,7 +67,13 @@ class ReplicaWedged(EngineShutdown):
     scheduler progress past ``stall_deadline_s``) and force-killed
     it. Subclasses ``EngineShutdown`` so the pool handle's recovery
     path treats it exactly like any other replica death: unstreamed
-    requests resubmit, partially-streamed ones fail typed."""
+    requests resubmit, partially-streamed ones fail typed.
+
+    ``bundle_path`` carries the flight-recorder bundle the watchdog
+    dumped BEFORE the kill (None when recording is disabled or the
+    dump failed) — the postmortem travels with the escalation."""
+
+    bundle_path: Optional[str] = None
 
 
 class PoolWatchdog:
@@ -89,12 +96,22 @@ class PoolWatchdog:
         of the deadline, floored at 10ms) — several probes fit
         inside the deadline, so detection lands WITHIN it.
     time_fn: injectable clock (fake-clock policy tests).
+    flight_dir: flight-recorder output directory. A WEDGED
+        escalation dumps a postmortem bundle of the dying replica
+        HERE *before* the force-kill (the engine's ring and counters
+        are still intact, and the probe is lock-free so the wedged
+        scheduler thread holding the engine lock cannot deadlock
+        it), then attaches the bundle path to the ``ReplicaWedged``
+        it raises and to the ``wedged`` log entry. Defaults to
+        ``obs.default_flight_dir()``; pass ``flight_dir=False`` to
+        disable recording.
     """
 
     def __init__(self, pool, *, stall_deadline_s: float = 5.0,
                  suspect_after_s: Optional[float] = None,
                  poll_interval_s: Optional[float] = None,
-                 time_fn: Callable[[], float] = time.monotonic):
+                 time_fn: Callable[[], float] = time.monotonic,
+                 flight_dir: Any = None):
         if stall_deadline_s <= 0:
             raise ValueError("stall_deadline_s must be > 0")
         self.pool = pool
@@ -109,6 +126,9 @@ class PoolWatchdog:
                                 if poll_interval_s is not None
                                 else max(0.01,
                                          self.stall_deadline_s / 8))
+        if flight_dir is None:
+            flight_dir = obs.default_flight_dir()
+        self.flight_dir: Optional[str] = flight_dir or None
         self._time = time_fn
         self._lock = threading.Lock()
         # idx -> (replica object, heartbeat age when suspected):
@@ -184,11 +204,17 @@ class PoolWatchdog:
                     f"progress for {hb_age:.2f}s "
                     f"(stall deadline {self.stall_deadline_s}s); "
                     f"force-killed by the watchdog")
+                # Flight recorder BEFORE the kill: the wedged
+                # engine's event ring / counters are still intact,
+                # and the probe is lock-free, so this cannot hang
+                # on the lock the stuck scheduler thread holds.
+                err.bundle_path = self._record_flight(rep, hb_age)
                 if self.pool.mark_wedged(rep, err,
                                          stalled_for_s=hb_age):
                     with self._lock:
                         self.counts["wedged"] += 1
-                    self._log("wedged", rep, hb_age)
+                    self._log("wedged", rep, hb_age,
+                              bundle=err.bundle_path)
                 self._forget(rep.idx)
         # drop tracking for replicas that left the HEALTHY/SUSPECT
         # set behind our back (drained, killed, replaced)
@@ -201,11 +227,29 @@ class PoolWatchdog:
         with self._lock:
             self._suspects.pop(idx, None)
 
-    def _log(self, event: str, rep, hb_age: float) -> None:
-        self.log.append({"event": event, "replica": rep.idx,
-                         "generation": rep.generation,
-                         "heartbeat_age_s": round(hb_age, 4),
-                         "t": self._time()})
+    def _record_flight(self, rep, hb_age: float) -> Optional[str]:
+        """Dump a postmortem bundle for ``rep`` (best-effort: a
+        recorder failure must never block the escalation)."""
+        if self.flight_dir is None:
+            return None
+        try:
+            return obs.dump_flight_bundle(
+                self.flight_dir, f"wedged-r{rep.idx}",
+                engine=rep.engine, pool=self.pool, watchdog=self,
+                extra={"replica": rep.idx,
+                       "generation": rep.generation,
+                       "heartbeat_age_s": round(hb_age, 4),
+                       "stall_deadline_s": self.stall_deadline_s})
+        except Exception:
+            return None
+
+    def _log(self, event: str, rep, hb_age: float, **extra) -> None:
+        entry = {"event": event, "replica": rep.idx,
+                 "generation": rep.generation,
+                 "heartbeat_age_s": round(hb_age, 4),
+                 "t": self._time()}
+        entry.update(extra)
+        self.log.append(entry)
 
     # ------------------------------------------------------ lifecycle
 

@@ -150,10 +150,18 @@ class Histogram(Metric):
                     for k, v in self._counts.items()]
 
 
+def _escape_label(v) -> str:
+    """Prometheus label-value escaping (exposition format spec):
+    backslash, double-quote, and newline must be escaped or a value
+    like 'say "hi"' corrupts every line after it."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_tags(tags: Tuple) -> str:
     if not tags:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in tags)
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in tags)
     return "{" + inner + "}"
 
 

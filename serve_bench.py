@@ -242,8 +242,8 @@ def make_server(cfg, knobs, use_engine=True):
             # run for after_s, then cancel — the deterministic stand-in
             # for a client disconnect. Returns the outcome class name
             # so the bench can count cancels vs. races with completion.
-            ids, mnt, dl, sid = self.inner._request_args(payload)
-            h = self.inner._submit(ids, mnt, dl, sid)
+            ids, mnt, dl, sid, tid = self.inner._request_args(payload)
+            h = self.inner._submit(ids, mnt, dl, sid, tid)
             time.sleep(after_s)
             h.cancel()
             try:
@@ -763,6 +763,82 @@ def run_pool_kill(seed=0):
     }
 
 
+def run_trace(args):
+    """Request-scope trace capture (bare ``--trace``): drive a small
+    engine with the typed event log ON, export the ring as a
+    Chrome/Perfetto ``trace_events`` timeline plus a per-request
+    phase index (admit -> queue -> prefill chunks -> decode rounds ->
+    readback -> retire), and prove the recorder is free with an
+    events-on vs events-off A/B over the identical load.
+
+    Always the tiny model: this phase documents WHERE time goes, not
+    how much of it there is — it must stay cheap on CPU. max_slots
+    is sized BELOW the request count so queue_wait is a real phase
+    in the capture, not a zero."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.llama import Llama, llama_tiny
+    from ray_tpu.serve import obs
+    from ray_tpu.serve.engine import LLMEngine
+
+    cfg = llama_tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+    n_req, n_new = 6, 16
+    rng = np.random.RandomState(args.seed + 11)
+    prompts = [rng.randint(1, cfg.vocab_size - 1, size=24).tolist()
+               for _ in range(n_req)]
+
+    def arm(events_on):
+        eng = LLMEngine(model, params, max_slots=2, page_size=16,
+                        n_pages=128, chunk=4, prefill_chunk=16,
+                        temperature=0.0, eos_id=-1, seed=args.seed,
+                        events=events_on).start()
+        # compile the jitted step OUTSIDE the measured window
+        eng.submit(prompts[0], max_new_tokens=2).result()
+        t0 = time.monotonic()
+        handles = [eng.submit(p, max_new_tokens=n_new,
+                              trace_id=obs.mint_trace_id())
+                   for p in prompts]
+        toks = sum(len(h.result()) for h in handles)
+        wall = time.monotonic() - t0
+        evs = eng.events.snapshot()
+        eng.shutdown()
+        return toks / max(wall, 1e-9), evs
+
+    tput_on, evs = arm(True)
+    tput_off, _ = arm(False)
+
+    requests = {}
+    for rid, ph in obs.request_phases(evs).items():
+        requests[str(rid)] = {
+            k: ph.get(k) for k in
+            ("trace_id", "outcome", "n_tokens", "queue_wait_s",
+             "prefill_s", "decode_s", "ttft_s", "total_s",
+             "submit", "first_token", "end")}
+    return {
+        "model": "llama-tiny",
+        "requests_n": len(requests),
+        "gen_tokens": n_new,
+        "requests": requests,
+        "events": obs.as_dicts(evs),
+        "trace_events": obs.chrome_trace({"engine": evs}),
+        "overhead": {
+            "tokens_s_events_on": round(tput_on, 2),
+            "tokens_s_events_off": round(tput_off, 2),
+            "ratio": round(tput_on / max(tput_off, 1e-9), 4),
+        },
+        "notes": "Request-scope trace capture (serve_bench.py "
+                 "--trace): typed engine event log exported as "
+                 "Chrome/Perfetto trace_events (load into "
+                 "ui.perfetto.dev) plus a per-request phase index. "
+                 "overhead.ratio is events-on vs events-off "
+                 "throughput on the identical load — the recorder "
+                 "must be free.",
+    }
+
+
 def make_trace(name, duration_s, base_rps, peak_rps, seed,
                n_tenants=4):
     """Arrival schedule [(t_offset_s, tenant_or_None), ...] for one
@@ -1027,9 +1103,13 @@ def run_autoscale(args):
         print("warm stash empty: cold replica build", flush=True)
         return _build_engine(idx + 100)
 
-    events = make_trace(args.trace, args.trace_duration,
+    # --trace doubles as the capture flag; anything that isn't a
+    # known arrival shape means "default shape" here
+    shape = (args.trace if args.trace in
+             ("diurnal", "bursty", "multitenant") else "bursty")
+    events = make_trace(shape, args.trace_duration,
                         args.base_rps, args.peak_rps, args.seed)
-    print(f"trace {args.trace}: {len(events)} arrivals over "
+    print(f"trace {shape}: {len(events)} arrivals over "
           f"{args.trace_duration}s (base {args.base_rps} rps, peak "
           f"{args.peak_rps} rps)", flush=True)
 
@@ -1099,7 +1179,7 @@ def run_autoscale(args):
     static = _arm_summary(rows2, samples2, slo_s)
 
     result = {
-        "trace": args.trace,
+        "trace": shape,
         "model": "llama-tiny",
         "trace_duration_s": args.trace_duration,
         "base_rps": args.base_rps,
@@ -1391,9 +1471,17 @@ def main():
                          "autoscaled pool AND a static pool at max, "
                          "emit SLO attainment + replica timeline + "
                          "chip-seconds for both")
-    ap.add_argument("--trace", default="bursty",
-                    choices=["diurnal", "bursty", "multitenant"],
-                    help="arrival-trace shape for --autoscale")
+    ap.add_argument("--trace", nargs="?", const="capture",
+                    default="bursty",
+                    help="bare --trace: run the request-scope trace "
+                         "capture instead of a throughput bench — "
+                         "drive a small engine with the typed event "
+                         "log on, emit a SERVE_TRACE artifact "
+                         "(Chrome/Perfetto trace_events + per-request "
+                         "phase index + events-on/off overhead A/B), "
+                         "self-gated by tools/check_bench_schema.py. "
+                         "With a value (diurnal|bursty|multitenant): "
+                         "the arrival-trace shape for --autoscale")
     ap.add_argument("--autoscale-min", type=int, default=1,
                     help="pool floor (autoscaled arm starts here)")
     ap.add_argument("--autoscale-max", type=int, default=4,
@@ -1462,6 +1550,31 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import ray_tpu
     ray_tpu.init()
+
+    if args.trace == "capture" and not args.autoscale:
+        result = _stamp(run_trace(args), args)
+        from tools.trace_report import report
+        result["report"] = report(result)
+        out = args.out or "SERVE_TRACE_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        # self-gate: a malformed trace artifact fails its OWN run
+        from tools import check_bench_schema as cbs
+        problems = []
+        cbs.check_file(out, problems)
+        for p in problems:
+            print(f"SCHEMA FAIL {p}")
+        # the full artifact is bulky (every event twice); print the
+        # headline blocks only
+        print(json.dumps({k: result[k] for k in
+                          ("requests_n", "overhead", "seed", "mesh")},
+                         default=str))
+        print(json.dumps({"ttft_check":
+                          result["report"]["ttft_check"]}))
+        ray_tpu.shutdown()
+        if problems:
+            raise SystemExit(1)
+        return
 
     if args.tp_ab:
         result = _stamp(run_tp_ab(args), args)

@@ -709,3 +709,134 @@ def test_serve_chaos_rejects_unquiesced_or_idle_pool(tmp_path):
     idle["requests"]["admitted"] = 0
     probs = _problems_for("SERVE_CHAOS_x.json", idle, tmp_path)
     assert any("zero requests" in p for p in probs)
+
+
+def test_serve_chaos_flight_recorder_validated_if_present(tmp_path):
+    # campaigns predating the recorder carry no block and still pass
+    assert _problems_for("SERVE_CHAOS_x.json", _serve_chaos_ok(),
+                         tmp_path) == []
+    ok = _serve_chaos_ok()
+    ok["flight_recorder"] = {"dir": "/tmp/f", "bundles": 3,
+                             "reasons": ["engine-fail-all", "wedged-r1"],
+                             "kill_explained": True,
+                             "hang_explained": True}
+    assert _problems_for("SERVE_CHAOS_x.json", ok, tmp_path) == []
+    empty = _serve_chaos_ok()
+    empty["flight_recorder"] = {"bundles": 0, "kill_explained": True,
+                                "hang_explained": True}
+    probs = _problems_for("SERVE_CHAOS_x.json", empty, tmp_path)
+    assert any("no flight bundles" in p for p in probs)
+    for key, what in (("kill_explained", "kill"),
+                      ("hang_explained", "hang")):
+        bad = _serve_chaos_ok()
+        bad["flight_recorder"] = {"bundles": 2, "kill_explained": True,
+                                  "hang_explained": True}
+        bad["flight_recorder"][key] = False
+        probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+        assert any(f"no bundle explains the injected {what}" in p
+                   for p in probs), key
+
+
+# ---------------------------------------------------------------------------
+# SERVE_TRACE family (serve_bench.py --trace artifacts)
+# ---------------------------------------------------------------------------
+
+
+def _serve_trace_ok():
+    events = [
+        {"seq": 0, "t": 10.0, "type": "submit", "rid": 1, "sid": None,
+         "data": {"trace_id": "a" * 16}},
+        {"seq": 1, "t": 10.1, "type": "admit", "rid": 1, "sid": 0,
+         "data": None},
+        {"seq": 2, "t": 10.15, "type": "prefill", "rid": [1],
+         "sid": None, "data": [[0, 8]]},
+        {"seq": 3, "t": 10.3, "type": "first_token", "rid": 1,
+         "sid": 0, "data": {"ttft_s": 0.3}},
+        {"seq": 4, "t": 10.6, "type": "retire", "rid": 1, "sid": 0,
+         "data": None},
+    ]
+    return {
+        "seed": 0,
+        "mesh": {"tp": 1, "replicas": 1},
+        "requests": {"1": {"trace_id": "a" * 16, "outcome": "retire",
+                           "ttft_s": 0.3, "total_s": 0.6}},
+        "events": events,
+        "trace_events": [{"name": "process_name", "ph": "M", "pid": 1,
+                          "tid": 0, "args": {"name": "engine"}}],
+        "overhead": {"tokens_s_events_on": 100.0,
+                     "tokens_s_events_off": 101.0, "ratio": 0.99},
+        "report": {"ttft_check": {"n": 1, "max_abs_err_s": 0.0,
+                                  "within_1ms": True}},
+        "git_sha": "abc1234",
+    }
+
+
+def test_serve_trace_valid_artifact_passes(tmp_path):
+    assert _problems_for("SERVE_TRACE_x.json", _serve_trace_ok(),
+                         tmp_path) == []
+
+
+def test_serve_trace_rejects_unordered_timestamps(tmp_path):
+    bad = _serve_trace_ok()
+    bad["events"][3]["t"] = 10.05       # earlier than its predecessor
+    probs = _problems_for("SERVE_TRACE_x.json", bad, tmp_path)
+    assert any("BACKWARDS" in p for p in probs)
+    bad = _serve_trace_ok()
+    bad["events"][2]["seq"] = 0         # seq must strictly increase
+    probs = _problems_for("SERVE_TRACE_x.json", bad, tmp_path)
+    assert any("not increasing" in p for p in probs)
+
+
+def test_serve_trace_rejects_orphan_rids(tmp_path):
+    scalar = _serve_trace_ok()
+    scalar["events"][4]["rid"] = 99
+    probs = _problems_for("SERVE_TRACE_x.json", scalar, tmp_path)
+    assert any("orphan" in p and "'99'" in p for p in probs)
+    # list rids (batched prefill) are checked element-wise
+    batched = _serve_trace_ok()
+    batched["events"][2]["rid"] = [1, 7]
+    probs = _problems_for("SERVE_TRACE_x.json", batched, tmp_path)
+    assert any("orphan" in p and "'7'" in p for p in probs)
+
+
+def test_serve_trace_rejects_missing_seed_or_mesh(tmp_path):
+    no_seed = _serve_trace_ok()
+    del no_seed["seed"]
+    probs = _problems_for("SERVE_TRACE_x.json", no_seed, tmp_path)
+    assert any("seed" in p for p in probs)
+    no_mesh = _serve_trace_ok()
+    del no_mesh["mesh"]
+    probs = _problems_for("SERVE_TRACE_x.json", no_mesh, tmp_path)
+    assert any("mesh stamp" in p for p in probs)
+
+
+def test_serve_trace_rejects_empty_capture(tmp_path):
+    empty_req = _serve_trace_ok()
+    empty_req["requests"] = {}
+    probs = _problems_for("SERVE_TRACE_x.json", empty_req, tmp_path)
+    assert any("captured no requests" in p for p in probs)
+    empty_ev = _serve_trace_ok()
+    empty_ev["events"] = []
+    probs = _problems_for("SERVE_TRACE_x.json", empty_ev, tmp_path)
+    assert any("events list is empty" in p for p in probs)
+
+
+def test_serve_trace_rejects_failed_ttft_cross_check(tmp_path):
+    bad = _serve_trace_ok()
+    bad["report"]["ttft_check"] = {"n": 3, "max_abs_err_s": 0.01,
+                                   "within_1ms": False}
+    probs = _problems_for("SERVE_TRACE_x.json", bad, tmp_path)
+    assert any("TTFT" in p and "1ms" in p for p in probs)
+    # a report with zero cross-checked requests is a capture problem
+    # handled elsewhere, not a cross-check failure
+    ok = _serve_trace_ok()
+    ok["report"]["ttft_check"] = {"n": 0, "max_abs_err_s": None,
+                                  "within_1ms": False}
+    assert _problems_for("SERVE_TRACE_x.json", ok, tmp_path) == []
+
+
+def test_serve_trace_rejects_missing_overhead_fields(tmp_path):
+    bad = _serve_trace_ok()
+    del bad["overhead"]["ratio"]
+    probs = _problems_for("SERVE_TRACE_x.json", bad, tmp_path)
+    assert any("overhead" in p and "ratio" in p for p in probs)

@@ -13,6 +13,7 @@ prefix-cache touch, leak-free quiescence). The chaos campaign itself
 schema family.
 """
 import json
+import os
 import sys
 import threading
 import time
@@ -165,6 +166,9 @@ class HBFakeEngine:
 def _wd_pool(clock, n=2, **kw):
     fakes = [HBFakeEngine(i, clock) for i in range(n)]
     pool = EnginePool(lambda i: fakes[i], n)
+    # keep fake-clock tests hermetic: no flight bundles under /tmp
+    # unless a test opts in with an explicit dir
+    kw.setdefault("flight_dir", False)
     wd = PoolWatchdog(pool, time_fn=clock, **kw)
     return fakes, pool, wd
 
@@ -193,10 +197,47 @@ def test_ladder_suspect_then_wedge_drives_death_path():
     assert isinstance(fakes[0].force_kill_err, ReplicaWedged)
     assert pool.route_stats["wedged"] == 1
     assert pool.route_stats["replica_deaths"] == 1
+    # flight recording was disabled: the escalation still carries
+    # the (absent) bundle path rather than failing
+    assert fakes[0].force_kill_err.bundle_path is None
     # the healthy replica was never probed into a restart
     assert fakes[1].force_kills == 0
     assert pool.replica(1).state == HEALTHY
     assert pool.replica(1).generation == 0
+    pool.shutdown()
+
+
+def test_wedge_dumps_flight_bundle_before_kill(tmp_path):
+    """Escalation with recording on: the watchdog dumps a postmortem
+    bundle BEFORE force-killing, stamps its path on the ReplicaWedged
+    error and the log entry, and the bundle tolerates a fake engine
+    (best-effort probes)."""
+    from ray_tpu.serve import obs
+    clock = FakeClock()
+    fakes, pool, wd = _wd_pool(clock, stall_deadline_s=10.0,
+                               flight_dir=str(tmp_path))
+    fakes[0].has_work = True
+    clock.advance(6.0)
+    wd.tick()
+    clock.advance(5.0)
+    wd.tick()
+    err = fakes[0].force_kill_err
+    assert isinstance(err, ReplicaWedged)
+    assert err.bundle_path is not None and \
+        os.path.isdir(err.bundle_path)
+    (wedge,) = [e for e in wd.log if e["event"] == "wedged"]
+    assert wedge["bundle"] == err.bundle_path
+    b = obs.load_flight_bundle(err.bundle_path)
+    assert b["reason"] == "wedged-r0"
+    assert b["extra"]["replica"] == 0
+    assert b["extra"]["stall_deadline_s"] == 10.0
+    # HBFakeEngine has no event log; load_report still lands and the
+    # recorded heartbeat gap explains the escalation
+    assert b["engine"]["heartbeat_gap_s"] >= 10.0 * 0.9
+    # the dump precedes the kill: the pool snapshot still shows the
+    # replica alive — the bundle is the last look at the wedged state
+    assert b["pool"]["pool_stats"].get("replica_deaths", 0) == 0
+    assert pool.route_stats["replica_deaths"] == 1
     pool.shutdown()
 
 
@@ -344,13 +385,16 @@ def _warm_engine_factory(model, params, inj_for):
     return factory
 
 
-def test_injected_hang_escalates_to_death_within_deadline(tiny_model):
+def test_injected_hang_escalates_to_death_within_deadline(
+        tiny_model, tmp_path):
     """The tentpole end-to-end: a `hang` fault plan parks replica 0's
     scheduler thread mid-step (lock held, heartbeat frozen, work
     pending). The watchdog must declare it wedged within the stall
     deadline, force-kill it out-of-band, leave the healthy replica
     untouched, and the pool must land every in-flight request either
-    token-identically on the survivor or typed."""
+    token-identically on the survivor or typed. The escalation must
+    leave a flight bundle — dumped lock-free while the wedged thread
+    still HOLDS the engine lock — that explains the hang."""
     model, params = tiny_model
     stall = 1.0
     inj = FaultInjector()
@@ -358,7 +402,8 @@ def test_injected_hang_escalates_to_death_within_deadline(tiny_model):
         model, params, lambda idx: inj if idx == 0 else None)
     pool = EnginePool(factory, 2)
     watchdog = PoolWatchdog(pool, stall_deadline_s=stall,
-                            poll_interval_s=0.05).run()
+                            poll_interval_s=0.05,
+                            flight_dir=str(tmp_path)).run()
     try:
         prompts = [[3, 1, 4, 1, 10 + i, 20 + i] for i in range(6)]
         want = [_reference_completion(model, params, p, 12)
@@ -395,6 +440,18 @@ def test_injected_hang_escalates_to_death_within_deadline(tiny_model):
                         if e["event"] == "wedged"]
         assert wedge_events and \
             wedge_events[0]["heartbeat_age_s"] >= stall * 0.9
+        # the postmortem bundle was written BEFORE the force-kill,
+        # with the wedged scheduler still holding the engine lock,
+        # and its heartbeat gap explains the escalation
+        from ray_tpu.serve import obs
+        bundle_path = wedge_events[0]["bundle"]
+        assert bundle_path is not None and os.path.isdir(bundle_path)
+        bundle = obs.load_flight_bundle(bundle_path)
+        assert bundle["reason"].startswith("wedged-r0")
+        assert bundle["engine"]["heartbeat_gap_s"] >= stall * 0.9
+        # the event tail survived the death: the typed log shows the
+        # engine was mid-flight (admits/prefills), then went silent
+        assert bundle["engine"]["events"], "bundle lost the event tail"
         for t in threads:
             t.join(timeout=60)
         assert all(not t.is_alive() for t in threads), "request hung"

@@ -96,6 +96,57 @@ def test_usage_stats_gating(monkeypatch):
     assert payload["schema_version"]
 
 
+def test_cross_process_jsonl_span_merge(tmp_path):
+    """End-to-end over the file sink alone: a child process inherits
+    RAY_TPU_TRACE_DIR, self-enables via _maybe_enable_from_env, emits
+    a span into its own pid-named JSONL file, and the driver's
+    get_spans() merges it back alongside locally recorded spans."""
+    import json
+    import subprocess
+    import sys
+
+    trace_dir = str(tmp_path / "traces")
+    tracing.setup_tracing(trace_dir=trace_dir)
+    try:
+        with tracing.span("driver.side", kind="test"):
+            pass
+        child = (
+            "from ray_tpu.util import tracing\n"
+            "assert tracing._maybe_enable_from_env()\n"
+            "with tracing.span('child.side', kind='test') as s:\n"
+            "    pass\n"
+            "print(s.trace_id)\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", child], capture_output=True,
+            text=True, timeout=60,
+            env=dict(os.environ, RAY_TPU_TRACE_DIR=trace_dir,
+                     JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, proc.stderr
+        child_trace_id = proc.stdout.strip()
+
+        # the child left a pid-named JSONL shard in the shared dir
+        shards = [f for f in os.listdir(trace_dir)
+                  if f.endswith(".jsonl")
+                  and f != f"{os.getpid()}.jsonl"]
+        assert shards, "child process wrote no span shard"
+        with open(os.path.join(trace_dir, shards[0])) as f:
+            raw = [json.loads(ln) for ln in f if ln.strip()]
+        assert any(s["name"] == "child.side" for s in raw)
+
+        spans = tracing.get_spans()
+        names = {s["name"] for s in spans}
+        assert {"driver.side", "child.side"} <= names
+        merged = next(s for s in spans if s["name"] == "child.side")
+        assert merged["trace_id"] == child_trace_id
+        assert merged["end_time"] >= merged["start_time"]
+        # without worker shards only the local span remains
+        local = tracing.get_spans(include_workers=False)
+        assert {s["name"] for s in local} == {"driver.side"}
+    finally:
+        tracing.teardown_tracing()
+
+
 def test_distributed_tracing_collects_worker_spans():
     """Worker-side execute spans must reach the driver via the shared
     trace dir (cross-process sink)."""

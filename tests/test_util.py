@@ -114,6 +114,41 @@ def test_metrics_and_prometheus(rt):
     clear_registry()
 
 
+def test_prometheus_histogram_tags_sum_and_count():
+    """Tagged Histogram exposition: per-tag cumulative le buckets with
+    the +Inf terminator, plus _sum/_count per tag set."""
+    clear_registry()
+    h = Histogram("phase_s", "phase latency", boundaries=[0.1, 1.0],
+                  tag_keys=("stage",))
+    h.observe(0.25, tags={"stage": "plan"})
+    h.observe(0.5, tags={"stage": "plan"})
+    h.observe(0.05, tags={"stage": "readback"})
+    text = prometheus_text()
+    assert 'phase_s_bucket{stage="plan",le="0.1"} 0' in text
+    assert 'phase_s_bucket{stage="plan",le="1.0"} 2' in text
+    assert 'phase_s_bucket{stage="plan",le="+Inf"} 2' in text
+    assert 'phase_s_sum{stage="plan"} 0.75' in text
+    assert 'phase_s_count{stage="plan"} 2' in text
+    assert 'phase_s_bucket{stage="readback",le="0.1"} 1' in text
+    assert 'phase_s_count{stage="readback"} 1' in text
+    clear_registry()
+
+
+def test_prometheus_label_escaping():
+    """Label values with quotes/backslashes/newlines must be escaped
+    per the exposition format or they corrupt every following line."""
+    from ray_tpu.util.metrics import _escape_label
+    assert _escape_label('a\\b "c"\nd') == 'a\\\\b \\"c\\"\\nd'
+    clear_registry()
+    c = Counter("weird_total", "w", tag_keys=("q",))
+    c.inc(tags={"q": 'a\\b "c"\nd'})
+    text = prometheus_text()
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("weird_total{"))
+    assert line == 'weird_total{q="a\\\\b \\"c\\"\\nd"} 1.0'
+    clear_registry()
+
+
 def test_state_api(rt):
     from ray_tpu import state
 

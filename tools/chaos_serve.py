@@ -19,7 +19,11 @@ After the campaign it PROVES the pool's availability contract:
 - the released zombie is fenced: no tokens committed, no prefix
   pages published, and every engine ever built — including corpses
   replaced mid-run — quiesces leak-free;
-- attainment (completed / admitted) stays above a recorded floor.
+- attainment (completed / admitted) stays above a recorded floor;
+- every headline fault left a flight-recorder bundle (serve/obs.py)
+  that EXPLAINS it: the killed replica's event tail ends at the
+  ReplicaKilled death, the wedge bundle records the heartbeat gap
+  that justified the hang->death escalation.
 
 Writes a SERVE_CHAOS json artifact gated by
 tools/check_bench_schema.py (serve_chaos family).
@@ -54,10 +58,22 @@ def _reference_completion(model, params, prompt, n):
 def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
               max_new_tokens=10, stall_deadline_s=1.0,
               watchdog_poll_s=0.05, drain_timeout_s=2.0,
-              attainment_floor=ATTAINMENT_FLOOR):
+              attainment_floor=ATTAINMENT_FLOOR, flight_dir=None):
     """One seeded serving chaos run. Returns the artifact dict after
     hard-asserting the availability contract (the schema checker
-    re-refuses the same violations on the checked-in artifact)."""
+    re-refuses the same violations on the checked-in artifact).
+
+    Every faulted replica leaves a flight-recorder bundle
+    (serve/obs.py) in ``flight_dir`` (a fresh temp dir by default):
+    a kill dumps from the dying engine's ``_fail_all``, the wedge
+    dumps from the watchdog BEFORE the force-kill, and the campaign
+    end dumps a pool-level postmortem. The run asserts the bundles
+    EXPLAIN the injected faults — the kill bundle's event tail ends
+    at the ReplicaKilled death, the wedge bundle shows the heartbeat
+    gap that justified the escalation."""
+    import glob
+    import tempfile
+
     import jax.numpy as jnp
 
     from ray_tpu.autoscaler.node_provider import (
@@ -72,6 +88,7 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
                                       EngineShutdown,
                                       RequestCancelled,
                                       retry_after_s)
+    from ray_tpu.serve import obs
     from ray_tpu.serve.faults import (FaultInjector,
                                       check_pool_quiesced,
                                       check_quiesced)
@@ -80,6 +97,9 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
     from ray_tpu.serve.watchdog import PoolWatchdog
 
     import jax
+    if flight_dir is None:
+        flight_dir = tempfile.mkdtemp(prefix="chaos-flight-")
+
     cfg = llama_tiny(dtype=jnp.float32)
     model = Llama(cfg)
     params = model.init(jax.random.PRNGKey(0),
@@ -104,7 +124,8 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
                         n_pages=64, chunk=4, temperature=0.0,
                         seed=idx, prefix_cache=True,
                         admit_timeout_s=0.25,
-                        fault_injector=inj)
+                        fault_injector=inj,
+                        flight_dir=flight_dir)
         all_engines.append(eng)
         # Warm the jitted prefill/decode/prefix-copy paths BEFORE
         # the replica joins the pool (deployments do the same — see
@@ -127,7 +148,8 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
     pool = EnginePool(factory, replicas, auto_restart=True,
                       restart_backoff_s=0.02, seed=seed)
     watchdog = PoolWatchdog(pool, stall_deadline_s=stall_deadline_s,
-                            poll_interval_s=watchdog_poll_s).run()
+                            poll_interval_s=watchdog_poll_s,
+                            flight_dir=flight_dir).run()
     provider = chaos.StockoutCapacityProvider(
         ImmediateCapacityProvider())
     policy = SLOPolicy(min_replicas=replicas,
@@ -299,6 +321,55 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
     assert attainment >= attainment_floor, \
         f"attainment {attainment:.3f} below floor {attainment_floor}"
 
+    # --------------------------------------------- flight recorder
+    # Kill bundles were dumped by the dying engines' _fail_all and
+    # the wedge bundle by the watchdog BEFORE its force-kill; close
+    # the campaign with a pool-level postmortem, then assert the
+    # bundles on disk EXPLAIN each injected fault.
+    obs.dump_flight_bundle(flight_dir, "campaign-end", pool=pool,
+                           watchdog=watchdog,
+                           extra={"injected": counts})
+    bundles = []
+    for bdir in sorted(glob.glob(os.path.join(flight_dir, "*"))):
+        if not os.path.isdir(bdir):
+            continue
+        try:
+            b = obs.load_flight_bundle(bdir)
+        except Exception:  # noqa: BLE001  half-written dir: skip
+            continue
+        eng_b = b.get("engine") or {}
+        evs = eng_b.get("events") or []
+        last = evs[-1] if evs else {}
+        bundles.append({
+            "path": os.path.basename(bdir),
+            "reason": b.get("reason"),
+            "heartbeat_gap_s": eng_b.get("heartbeat_gap_s"),
+            "n_events": len(evs),
+            "last_event": last.get("type"),
+            "last_error": (last.get("data") or {}).get("error")
+            if isinstance(last.get("data"), dict) else None,
+        })
+    # kill explained: the dying engine's event tail ends at the
+    # injected death, naming the fault that took it down
+    kills = [b for b in bundles
+             if b["reason"] == "engine-fail-all"
+             and b["last_event"] == "fail_all"
+             and "ReplicaKilled" in (b["last_error"] or "")]
+    assert kills, (
+        "no flight bundle explains the injected kill (want an "
+        "engine-fail-all bundle whose last event is fail_all "
+        f"carrying ReplicaKilled); saw: {bundles}")
+    # hang explained: the watchdog's pre-kill bundle records the
+    # heartbeat gap that justified the hang->death escalation
+    wedges = [b for b in bundles
+              if str(b["reason"]).startswith("wedged")
+              and isinstance(b["heartbeat_gap_s"], (int, float))
+              and b["heartbeat_gap_s"] >= stall_deadline_s * 0.9]
+    assert wedges, (
+        "no flight bundle explains the injected hang (want a "
+        "wedged-r* bundle whose heartbeat_gap_s >= "
+        f"{stall_deadline_s * 0.9:.2f}s); saw: {bundles}")
+
     try:
         sha = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
@@ -351,6 +422,14 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
             "wedged_total": pool_stats.get("wedged", 0),
             "autoscaler": autoscaler.stats(),
             "provider_denied": provider.denied,
+        },
+        "flight_recorder": {
+            "dir": flight_dir,
+            "bundles": len(bundles),
+            "reasons": sorted({str(b["reason"]) for b in bundles}),
+            "kill_explained": True,
+            "hang_explained": True,
+            "summaries": bundles,
         },
         "quiesced": True,
         "wall_s": round(wall, 2),

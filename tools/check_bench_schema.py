@@ -49,6 +49,16 @@ family:
   seed is missing (the run must be reproducible), or when the loss
   curve diverged from the deterministic replay.
 
+- SERVE_TRACE_*.json (serve_bench.py --trace): request-scope trace
+  capture — the typed engine event log (serve/obs.py) exported as
+  Chrome/Perfetto trace_events plus a per-request phase index and an
+  events-on/off overhead A/B. REFUSED when event timestamps are out
+  of order (an unordered trace lies about causality), when an event
+  names a request id absent from the request index (orphan — the
+  phase index silently lost work), when the seed or mesh stamp is
+  missing, or when the in-artifact report's TTFT cross-check
+  (recomputed-from-spans vs engine-stamped) diverged past 1ms.
+
 - SERVE_CHAOS_*.json (tools/chaos_serve.py): seeded fault campaign
   against a live multi-replica serving pool under trace load.
   REFUSED when any admitted request was LOST (hung or vanished
@@ -69,7 +79,8 @@ present.
 
 Usage: python tools/check_bench_schema.py [FILES...]
        (no FILES: validates every SERVE_BENCH_*.json / BENCH_*.json /
-       TRAIN_CHAOS_*.json / SERVE_CHAOS_*.json in the repo root)
+       TRAIN_CHAOS_*.json / SERVE_CHAOS_*.json / SERVE_TRACE_*.json
+       in the repo root)
 Exit 0 when every file validates; 1 otherwise, listing each problem.
 """
 import glob
@@ -788,6 +799,119 @@ def check_serve_chaos(obj, name, problems):
     if obj.get("quiesced") is not True:
         problems.append(f"{name}: pool did not quiesce leak-free "
                         "after the campaign")
+    # flight-recorder block (validated-if-present; campaigns predating
+    # the recorder carry no block and still pass): the run must have
+    # collected at least one bundle and proven the bundles explain the
+    # injected kill and hang
+    fr = obj.get("flight_recorder")
+    if fr is not None:
+        if not isinstance(fr, dict):
+            problems.append(f"{name}: flight_recorder must be an "
+                            "object")
+        else:
+            n = fr.get("bundles")
+            if not isinstance(n, int) or isinstance(n, bool) \
+                    or n < 1:
+                problems.append(
+                    f"{name}:flight_recorder: campaign collected no "
+                    "flight bundles")
+            for key, what in (("kill_explained", "kill"),
+                              ("hang_explained", "hang")):
+                if fr.get(key) is not True:
+                    problems.append(
+                        f"{name}:flight_recorder: no bundle explains "
+                        f"the injected {what}")
+    sha = obj.get("git_sha")
+    if sha is not None and not isinstance(sha, str):
+        problems.append(f"{name}: git_sha must be a string")
+
+
+SERVE_TRACE_REQUIRED = {
+    "requests": dict,
+    "events": list,
+    "trace_events": list,
+    "overhead": dict,
+    "seed": int,
+}
+
+
+def check_serve_trace(obj, name, problems):
+    """serve_bench.py --trace artifact: the typed engine event log
+    exported as a Chrome/Perfetto timeline plus a per-request phase
+    index. The checker REFUSES artifacts whose timeline cannot be
+    trusted: timestamps out of order (a trace that lies about
+    ordering is worse than none), events naming request ids absent
+    from the request index (orphans — the phase index silently lost
+    work), a missing seed/mesh stamp (irreproducible), a failed
+    TTFT cross-check, or a recorder whose measured overhead was not
+    recorded."""
+    _check_fields(obj, SERVE_TRACE_REQUIRED, name, problems)
+    _check_mesh(obj, name, problems, required=True)
+    requests = obj.get("requests")
+    events = obj.get("events")
+    if isinstance(requests, dict) and not requests:
+        problems.append(f"{name}: request index is empty — the "
+                        "trace captured no requests")
+    if isinstance(events, list):
+        if not events:
+            problems.append(f"{name}: events list is empty")
+        last_seq, last_t = None, None
+        known = set(requests) if isinstance(requests, dict) else set()
+        orphans = set()
+        for i, ev in enumerate(events):
+            if not isinstance(ev, dict):
+                problems.append(f"{name}:events[{i}]: not an object")
+                continue
+            seq, t = ev.get("seq"), ev.get("t")
+            if not isinstance(seq, int) or isinstance(seq, bool):
+                problems.append(f"{name}:events[{i}]: missing int "
+                                "'seq'")
+                continue
+            if not isinstance(t, NUM) or isinstance(t, bool):
+                problems.append(f"{name}:events[{i}]: missing "
+                                "numeric 't'")
+                continue
+            if not isinstance(ev.get("type"), str):
+                problems.append(f"{name}:events[{i}]: missing str "
+                                "'type'")
+            if last_seq is not None and seq <= last_seq:
+                problems.append(
+                    f"{name}:events[{i}]: seq {seq} not increasing "
+                    f"(prev {last_seq})")
+            if last_t is not None and t < last_t:
+                problems.append(
+                    f"{name}:events[{i}]: timestamp {t} goes "
+                    f"BACKWARDS (prev {last_t}) — unordered trace")
+            last_seq, last_t = seq, t
+            rid = ev.get("rid")
+            rids = rid if isinstance(rid, list) else (
+                [] if rid is None else [rid])
+            for r in rids:
+                if str(r) not in known:
+                    orphans.add(str(r))
+        for r in sorted(orphans):
+            problems.append(
+                f"{name}: event references request id {r!r} absent "
+                "from the request index (orphan)")
+    overhead = obj.get("overhead")
+    if isinstance(overhead, dict):
+        _check_fields(overhead,
+                      {"tokens_s_events_on": NUM,
+                       "tokens_s_events_off": NUM,
+                       "ratio": NUM},
+                      f"{name}:overhead", problems)
+    # validated-if-present: the in-artifact report's TTFT cross-check
+    # (tools/trace_report.py) must not have FAILED — phase spans that
+    # cannot reproduce the engine-stamped TTFT are untrustworthy
+    rep = obj.get("report")
+    if isinstance(rep, dict):
+        chk = rep.get("ttft_check")
+        if isinstance(chk, dict) and chk.get("n", 0) and \
+                chk.get("within_1ms") is not True:
+            problems.append(
+                f"{name}: TTFT recomputed from phase spans diverged "
+                f"from the engine stamp by more than 1ms "
+                f"(max_abs_err_s={chk.get('max_abs_err_s')})")
     sha = obj.get("git_sha")
     if sha is not None and not isinstance(sha, str):
         problems.append(f"{name}: git_sha must be a string")
@@ -825,6 +949,8 @@ def check_file(path, problems):
         check_train_chaos(obj, name, problems)
     elif name.startswith("SERVE_CHAOS"):
         check_serve_chaos(obj, name, problems)
+    elif name.startswith("SERVE_TRACE"):
+        check_serve_trace(obj, name, problems)
     elif name.startswith("SERVE_BENCH"):
         check_serve_bench(obj, name, problems)
     else:
@@ -842,7 +968,9 @@ def main(argv):
                        glob.glob(os.path.join(root,
                                               "TRAIN_CHAOS_*.json")) +
                        glob.glob(os.path.join(root,
-                                              "SERVE_CHAOS_*.json")))
+                                              "SERVE_CHAOS_*.json")) +
+                       glob.glob(os.path.join(root,
+                                              "SERVE_TRACE_*.json")))
     if not files:
         print("no bench artifacts found")
         return 0

@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Turn a SERVE_TRACE artifact (serve_bench.py --trace) into a
+per-request phase breakdown and a p50/p99 critical-path table.
+
+The artifact carries three views of the same run (serve/obs.py):
+``events`` (the raw typed event log), ``requests`` (per-request phase
+index derived from it), and ``trace_events`` (Chrome/Perfetto
+timeline). This report reads the first two and CROSS-CHECKS them:
+each request's TTFT is recomputed from its raw submit/first_token
+event timestamps and compared against the engine-stamped ``ttft_s``
+riding in the first_token event — they must agree to within 1ms or
+the phase spans don't mean what they claim (ISSUE 10 acceptance).
+
+Usage: python tools/trace_report.py SERVE_TRACE_cpu_smoke.json
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+PHASES = ("queue_wait_s", "ttft_s", "decode_s", "total_s")
+
+
+def _pct(xs: List[float], q: float) -> Optional[float]:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def _events_by_rid(events: List[Dict[str, Any]]
+                   ) -> Dict[str, Dict[str, Dict[str, Any]]]:
+    """rid -> {etype: first event of that type} for scalar-rid
+    events (prefill events carry a rid LIST and index no single
+    request)."""
+    out: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for ev in events:
+        rid = ev.get("rid")
+        if rid is None or isinstance(rid, list):
+            continue
+        slot = out.setdefault(str(rid), {})
+        slot.setdefault(ev["type"], ev)
+    return out
+
+
+def report(artifact: Dict[str, Any]) -> Dict[str, Any]:
+    """Phase breakdown + percentiles + the TTFT cross-check.
+    Pure function over the artifact dict (serve_bench calls it
+    in-process; ``main`` feeds it a loaded file)."""
+    requests: Dict[str, Any] = artifact.get("requests", {})
+    events: List[Dict[str, Any]] = artifact.get("events", [])
+    by_rid = _events_by_rid(events)
+
+    rows: List[Dict[str, Any]] = []
+    errs: List[float] = []
+    for rid, ph in sorted(requests.items(),
+                          key=lambda kv: str(kv[0])):
+        row = {"rid": rid, "trace_id": ph.get("trace_id"),
+               "outcome": ph.get("outcome"),
+               "n_tokens": ph.get("n_tokens")}
+        for k in PHASES:
+            v = ph.get(k)
+            row[k] = round(v, 6) if isinstance(v, (int, float)) \
+                else None
+        evs = by_rid.get(rid, {})
+        sub, ft = evs.get("submit"), evs.get("first_token")
+        if sub is not None and ft is not None:
+            recomputed = ft["t"] - sub["t"]
+            recorded = (ft.get("data") or {}).get("ttft_s")
+            row["ttft_recomputed_s"] = round(recomputed, 6)
+            if isinstance(recorded, (int, float)):
+                err = abs(recomputed - recorded)
+                row["ttft_err_s"] = round(err, 6)
+                errs.append(err)
+        rows.append(row)
+
+    percentiles: Dict[str, Any] = {}
+    for k in PHASES:
+        xs = [r[k] for r in rows
+              if isinstance(r.get(k), (int, float))]
+        if xs:
+            percentiles[k] = {
+                "p50": round(_pct(xs, 0.50), 6),
+                "p99": round(_pct(xs, 0.99), 6),
+                "max": round(max(xs), 6), "n": len(xs)}
+    return {
+        "requests": rows,
+        "phase_percentiles": percentiles,
+        "ttft_check": {
+            "n": len(errs),
+            "max_abs_err_s": round(max(errs), 6) if errs else None,
+            "within_1ms": bool(errs) and max(errs) < 1e-3,
+        },
+    }
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v * 1e3:8.2f}"     # seconds -> ms columns
+    return str(v)
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 2:
+        print(__doc__.strip().splitlines()[-1], file=sys.stderr)
+        return 2
+    with open(argv[1]) as f:
+        artifact = json.load(f)
+    rep = report(artifact)
+
+    cols = ("rid", "outcome", "n_tokens", "queue_wait_s", "ttft_s",
+            "decode_s", "total_s", "ttft_err_s")
+    print("per-request phases (ms):")
+    print("  " + "  ".join(f"{c:>12}" for c in cols))
+    for row in rep["requests"]:
+        print("  " + "  ".join(
+            f"{_fmt(row.get(c)):>12}" for c in cols))
+    print("\ncritical-path percentiles (ms):")
+    for k, p in rep["phase_percentiles"].items():
+        print(f"  {k:>14}  p50={p['p50'] * 1e3:8.2f}  "
+              f"p99={p['p99'] * 1e3:8.2f}  "
+              f"max={p['max'] * 1e3:8.2f}  (n={p['n']})")
+    chk = rep["ttft_check"]
+    print(f"\nttft cross-check: n={chk['n']} "
+          f"max_abs_err={chk['max_abs_err_s']}s "
+          f"within_1ms={chk['within_1ms']}")
+    overhead = artifact.get("overhead")
+    if overhead:
+        print(f"recorder overhead: on={overhead['tokens_s_events_on']}"
+              f" tok/s off={overhead['tokens_s_events_off']} tok/s "
+              f"ratio={overhead['ratio']}")
+    return 0 if chk["within_1ms"] or chk["n"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
