@@ -35,7 +35,13 @@ def _use_paged_kernel() -> bool:
     4.2ms (XLA aliases the pool update in place across iterations)
     while the kernel steps run at 7.5ms: the pallas custom call
     defeats the loop-carry aliasing of the 67MB/layer pools and
-    buys a full pool copy per step. Until that aliasing is proven
+    buys a full pool copy per step. Re-examined under the engine's
+    OVERLAPPED hot loop (serve_bench.py --overlap-ab --paged-kernel):
+    the ranking does NOT flip back — overlap hides host readback
+    latency behind device compute, but the aliasing defeat is a
+    compile-time property of the dispatched computation itself, so
+    the per-step pool copy is still paid on-device where no amount
+    of host overlap can cover it. Until that aliasing is proven
     through the custom call, the gather is the right default on
     every backend; RAY_TPU_PAGED_KERNEL=1 forces the kernel (and
     =0 forces the gather) for experiments and tests."""

@@ -53,6 +53,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import itertools
+import os
 import queue
 import threading
 import time
@@ -306,6 +307,21 @@ class LLMEngine:
         a lock only teardown would release.
     fault_injector: test-only seam (serve/faults.py FaultInjector);
         None in production — every site is then a no-op.
+    overlap: overlapped hot loop (default on). Each round plans and
+        dispatches round N+1 from the PREVIOUS round's token frontier
+        while round N still executes on device — the pre-plan drain
+        only reads buffers the device has already finished, so the
+        host never blocks before planning even in eos mode.
+        Completion detection moves to readback time: a slot may
+        over-decode past a late-revealed eos by at most one decode
+        chunk (the planner caps stale riders, serve/scheduler.py),
+        emission truncates at the eos exactly as before, and the
+        overshot KV frontier is reclaimed by the same
+        clamp-and-reseed machinery spec-decode rollback uses.
+        ``overlap=False`` restores the lockstep loop (full blocking
+        drain before planning in eos/spec mode — the PR-10 latency
+        profile). Env ``RAY_TPU_OVERLAP=0``/``1`` force-overrides
+        the knob for A/B runs without touching call sites.
     """
 
     def __init__(self, model, params, *, max_slots: int = 8,
@@ -325,7 +341,8 @@ class LLMEngine:
                  sharding=None,
                  fault_injector=None,
                  events: bool = True,
-                 flight_dir: Optional[str] = None):
+                 flight_dir: Optional[str] = None,
+                 overlap: Optional[bool] = None):
         self.model = model
         self.cfg = model.config
         # Tensor-parallel placement (serve/sharding.py
@@ -401,8 +418,15 @@ class LLMEngine:
         # Without an eos the schedule is fully deterministic: slots
         # retire by arithmetic at dispatch time and host syncs never
         # gate scheduling. With an eos, completions depend on sampled
-        # tokens, so each iteration drains readbacks before planning.
+        # tokens — the LOCKSTEP loop drains readbacks before planning
+        # every round; the OVERLAPPED loop (default) plans from the
+        # stale frontier instead and detects eos at readback time.
         self._deferred = eos_id is None
+        _env = os.environ.get("RAY_TPU_OVERLAP", "")
+        if _env in ("0", "1"):
+            self.overlap = _env == "1"
+        else:
+            self.overlap = True if overlap is None else bool(overlap)
         self._stopped = False
         self._draining = False
         # Progress heartbeat (watchdog signal, serve/watchdog.py):
@@ -660,6 +684,13 @@ class LLMEngine:
                 "draining": self._draining,
                 "stopped": self._stopped,
                 "heartbeat_age_s": time.monotonic() - self._hb,
+                # readback accounting: dispatches whose tokens are
+                # still in flight. The overlapped loop holds this at
+                # <= 2 (double-buffered) in steady state; a growing
+                # depth means the trailing drain is starved.
+                "fetchq_depth": len(self._fetchq),
+                "pending_prefills": len(self._pending_prefill),
+                "overlap": self.overlap,
                 "has_work": bool(waiting or any(self.slots)
                                  or self._fetchq
                                  or self._pending_prefill),
@@ -690,6 +721,9 @@ class LLMEngine:
                 "draining": self._draining,
                 "stopped": self._stopped,
                 "heartbeat_age_s": time.monotonic() - self._hb,
+                "fetchq_depth": len(self._fetchq),
+                "pending_prefills": len(self._pending_prefill),
+                "overlap": self.overlap,
                 "has_work": bool(self._wait or any(self.slots)
                                  or self._fetchq
                                  or self._pending_prefill),
@@ -920,10 +954,22 @@ class LLMEngine:
         jitted scatter, and — with no eos configured — completions
         are dispatch-time arithmetic. The readback of chunk k then
         overlaps chunk k+1's compute, so neither the device round
-        trip nor a slow host thread gates the token rate. With an
-        eos, sampled tokens decide completion, so the iteration
-        drains readbacks before planning (latency profile of the
-        classic chunked loop). Returns False when idle.
+        trip nor a slow host thread gates the token rate.
+
+        With an eos the loop is DOUBLE-BUFFERED (``overlap=True``,
+        the default): the pre-plan drain is a non-blocking sweep, so
+        round N+1 is planned from round N's (stale) frontier and its
+        dispatches are committed while round N still executes; the
+        trailing drain at the bottom then blocks on the OLDER of the
+        two in-flight dispatches only (keep=1), pinning the pipeline
+        depth at two and revealing each round's tokens at most one
+        round late. A late-revealed eos costs at most one discarded
+        decode chunk per slot — the planner caps stale riders
+        (serve/scheduler.py) and emission truncates exactly where
+        lockstep would. ``overlap=False`` restores the lockstep
+        profile: sampled tokens decide completion, so the iteration
+        drains readbacks fully before planning (the classic chunked
+        loop). Returns False when idle.
 
         Failure containment: an ``EngineFault`` out of a dispatch
         section (fault-injection sites, or the now-attributable
@@ -946,13 +992,29 @@ class LLMEngine:
                 # zombie fence forbids any further work this round
                 return False
             self._reap_deadlines_locked()
-            if not self._deferred or self.spec_len:
-                # eos mode: emissions gate planning. Spec mode: the
-                # proposer's context and the verify's input token are
-                # HOST state (req.generated), so every round syncs to
-                # the device before planning — speculation trades the
-                # deferred pipeline's async pacing for multi-token
-                # dispatches.
+            _tg = time.monotonic()
+            if self.overlap:
+                # Overlapped hot loop: plan round N+1 from the STALE
+                # token frontier while round N still runs on device.
+                # This sweep only reads buffers the device already
+                # finished — it NEVER blocks, in eos mode either.
+                # Completion detection moves to the trailing drain:
+                # emission truncates at a late-revealed eos, the
+                # planner caps stale riders at one decode chunk
+                # (serve/scheduler.py SlotView.stale), and the
+                # overshot KV frontier is reclaimed by the same
+                # clamp-and-reseed machinery spec rollback uses. Spec
+                # mode still syncs, but at its own dispatch
+                # (_dispatch_spec_locked) — acceptance gates the NEXT
+                # verify, not this round's prefill/decode lanes.
+                self._drain_fetches_locked(ready_only=True)
+            elif not self._deferred or self.spec_len:
+                # Lockstep eos mode: emissions gate planning. Spec
+                # mode: the proposer's context and the verify's input
+                # token are HOST state (req.generated), so every
+                # round syncs to the device before planning —
+                # speculation trades the deferred pipeline's async
+                # pacing for multi-token dispatches.
                 self._drain_fetches_locked()
             else:
                 # Opportunistic: read back anything already finished
@@ -961,6 +1023,7 @@ class LLMEngine:
                 # can then land during the upcoming dispatch) a full
                 # dispatch earlier. Never blocks.
                 self._drain_fetches_locked(ready_only=True)
+            _gap = time.monotonic() - _tg
             self._admit_locked()
             if not any(self.slots):
                 if self._fetchq or self._pending_prefill:
@@ -969,10 +1032,12 @@ class LLMEngine:
                 # non-empty queue with nothing admitted = retry
                 # backoff or a transiently dry pool: still working
                 return bool(self._wait)
-            _tp = time.monotonic() if _pm is not None else 0.0
+            _tp = time.monotonic()
             plan = self._plan_steps_locked()
+            _tpe = time.monotonic()
+            _gap += _tpe - _tp
             if _pm is not None:
-                _pm["plan"].observe(time.monotonic() - _tp)
+                _pm["plan"].observe(_tpe - _tp)
             _td = time.monotonic() if _pm is not None else 0.0
             try:
                 if plan.prefill:
@@ -1004,8 +1069,23 @@ class LLMEngine:
             # the one just queued (keep=1), so the fetch round trip
             # overlaps the newest dispatch's compute — never its own
             self._drain_fetches_locked(limit=1, keep=1)
+            _now = time.monotonic()
+            # Per-round pipeline accounting: host_gap is the time the
+            # host spent GATING this round's dispatches (pre-plan
+            # drain + plan) — the fraction of round wall during which
+            # the device could not be fed. The lockstep eos loop pays
+            # a full device sync here every round; the overlapped
+            # loop pays only a ready-buffer sweep. trace_report
+            # derives overlap efficiency from these events; the
+            # serve_phase_host_gap_s histogram is the aggregate
+            # cross-check.
+            self.events.append("round", data={
+                "host_gap_s": round(_gap, 6),
+                "wall_s": round(_now - _t0, 6),
+                "overlap": self.overlap})
             if _pm is not None:
-                _pm["round_wall"].observe(time.monotonic() - _t0)
+                _pm["round_wall"].observe(_now - _t0)
+                _pm["host_gap"].observe(_gap)
             return True
 
     def _contain_fault_locked(self, e: EngineFault) -> None:
@@ -1081,6 +1161,18 @@ class LLMEngine:
         prompt-lookup proposal per seeded slot)."""
         if self.spec_len:
             self._propose_spec_locked()
+        # Stale-frontier depth per slot: decode steps dispatched but
+        # not yet read back (the overlapped loop plans BEFORE the
+        # trailing drain reveals them). The planner uses it to cap
+        # eos-bounded run-ahead so a late-revealed eos discards at
+        # most one decode chunk per slot. Identity-checked against
+        # the live slot: a freed-and-reseated slot's old rides are
+        # not ITS staleness.
+        stale = [0] * self.S
+        for _buf, riders, steps in self._fetchq:
+            for i, slot, _take in riders:
+                if 0 <= i < self.S and self.slots[i] is slot:
+                    stale[i] += steps
         # owed clamped at 0: an eos-mode rider can overshoot its
         # budget while emission trails, and cancelled/expired slots
         # are torn down before planning ever sees them — the planner
@@ -1090,7 +1182,8 @@ class LLMEngine:
                           owed=max(0, self._owed(s))
                           if s.cur is not None else 0,
                           seeded=s.cur is not None,
-                          spec_drafts=len(s.spec_pending))
+                          spec_drafts=len(s.spec_pending),
+                          stale=stale[i])
                  for i, s in enumerate(self.slots) if s is not None]
         return plan_step(views, total_slots=self.S,
                          prefill_budget=self.PC, decode_chunk=self.K,
@@ -1100,13 +1193,21 @@ class LLMEngine:
                          spec_enabled=bool(self.spec_len))
 
     def _propose_spec_locked(self):
-        """Refresh each seeded slot's prompt-lookup proposal. Runs
-        AFTER the round's full drain, so ``req.generated`` is exactly
-        the device's token stream: the proposer syncs its rolling
-        index with the unseen tail and drafts up to ``spec_len``
-        continuation tokens. A slot whose remaining budget is 1
-        proposes nothing — the verify's bonus token already covers
-        it."""
+        """Refresh each seeded slot's prompt-lookup proposal. In the
+        lockstep loop this runs AFTER the round's full drain, so
+        ``req.generated`` is exactly the device's token stream. In
+        the overlapped loop it runs from the STALE frontier —
+        ``req.generated`` may trail the device by up to one round's
+        undrained chunks. That is safe by construction: proposals
+        are hints the batched verify re-derives from the true argmax
+        (a draft positioned against an outdated context simply gets
+        rejected), and the proposer's monotonic-context contract
+        (spec_decode.NGramIndex.sync) still holds because
+        ``prompt + generated`` only ever grows. The proposer syncs
+        its rolling index with the unseen tail and drafts up to
+        ``spec_len`` continuation tokens. A slot whose remaining
+        budget is 1 proposes nothing — the verify's bonus token
+        already covers it."""
         for s in self.slots:
             if s is None:
                 continue
@@ -1565,7 +1666,19 @@ class LLMEngine:
         Host-synchronous by construction: acceptance decides the
         next dispatch's input token and offset, so the argmax
         readback blocks here (spec trades the deferred pipeline's
-        async pacing for multi-token dispatches)."""
+        async pacing for multi-token dispatches). Under the
+        overlapped loop the round's planning ran from the stale
+        frontier, so the TRUE frontier is settled HERE instead —
+        the verify's row 0 is ``generated[-1]``, which must be the
+        device's latest token, not the host mirror's."""
+        if self.overlap:
+            # settle every trailing readback before freezing rows:
+            # drafts proposed against the stale frontier are mere
+            # hints (a mispositioned draft just gets rejected), but
+            # the verify INPUT must be exact. This blocks only in
+            # spec mode — the plain decode/prefill lanes never pay
+            # it.
+            self._drain_fetches_locked()
         T = self.spec_len + 1
         if self._verify_fn is None:
             self._verify_fn = self._build_verify(T)
@@ -1747,6 +1860,14 @@ class LLMEngine:
                 pend_pre, self._pending_prefill = \
                     self._pending_prefill, []
             _t_rb = time.monotonic()
+            # Touch the heartbeat BEFORE the blocking get as well as
+            # after: a drain working through several buffers blocks
+            # once per buffer, and each iteration boundary is real
+            # progress — without the pre-get touch a slow-but-moving
+            # multi-buffer readback under load reads as one long
+            # stall and rides the watchdog ladder to SUSPECT/WEDGED
+            # (serve/watchdog.py judges heartbeat AGE, not activity).
+            self._hb = _t_rb
             vals = jax.device_get(
                 [b[0] for b in batch] + [f for f, _ in pend_pre])
             self._hb = time.monotonic()   # readback completed

@@ -54,7 +54,8 @@ class LlamaDeployment:
                  autoscale_interval_s: float = 0.5,
                  autoscale_provider=None,
                  engine_stall_deadline_s: Optional[float] = None,
-                 watchdog_interval_s: Optional[float] = None):
+                 watchdog_interval_s: Optional[float] = None,
+                 overlap: Optional[bool] = None):
         import jax
         from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
@@ -155,7 +156,10 @@ class LlamaDeployment:
             # with a watchdog guarding the pool, a submit racing a
             # wedged scheduler sheds-and-reroutes instead of parking
             # on the wedged engine's lock
-            admit_timeout_s=engine_stall_deadline_s)
+            admit_timeout_s=engine_stall_deadline_s,
+            # overlapped hot loop (engine.py): None defers to the
+            # engine default (on) and the RAY_TPU_OVERLAP override
+            overlap=overlap)
 
     def setup_mesh(self, mesh):
         """Called by the serve replica when cfg.mesh is set: shard the

@@ -527,6 +527,7 @@ READBACK = "serve_phase_readback_s"
 ROUND_WALL = "serve_phase_round_wall_s"
 TTFT = "serve_phase_ttft_s"
 INTER_TOKEN = "serve_phase_inter_token_s"
+HOST_GAP = "serve_phase_host_gap_s"
 
 _PHASE_BOUNDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                  0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
@@ -563,6 +564,12 @@ def phase_metrics() -> Dict[str, Any]:
             "inter_token": metrics.Histogram(
                 INTER_TOKEN, "Mean gap between emitted tokens "
                 "(per readback batch)",
+                boundaries=_PHASE_BOUNDS),
+            "host_gap": metrics.Histogram(
+                HOST_GAP, "Host time gating dispatch per round "
+                "(pre-plan readback drain + planner): the device "
+                "idles for this span under the lockstep loop, and "
+                "for ~none of it under the overlapped loop",
                 boundaries=_PHASE_BOUNDS),
         }
     return _METRICS

@@ -37,6 +37,11 @@ Policy, in order:
   full, fully-seeded batch the plan runs ahead to the next completion
   event (min owed over riders) exactly as before. With an eos the
   run-ahead is bounded — tokens past an unpredicted eos are wasted.
+  Under the engine's OVERLAPPED loop the views may trail the device
+  frontier (``SlotView.stale``: dispatched-but-undrained steps); any
+  stale eos-bounded rider tightens the cap to one ``decode_chunk``,
+  which bounds the worst-case discard on a late-revealed eos to one
+  chunk per slot.
 - Spec lane (``spec_enabled``, serve/spec_decode.py): when any seeded
   slot carries draft tokens this round, ONE batched verify dispatch
   replaces the decode chunk — every seeded slot rides it (a slot with
@@ -75,6 +80,13 @@ class SlotView:
     seeded: bool             # riding decode dispatches already
     spec_drafts: int = 0     # draft tokens proposed this round
                              # (prompt-lookup, serve/spec_decode.py)
+    stale: int = 0           # decode steps dispatched but not yet
+                             # read back: under the engine's
+                             # overlapped loop the view may TRAIL the
+                             # device frontier by up to one round —
+                             # this is the depth of that trail. 0
+                             # under the lockstep loop (the pre-plan
+                             # drain settles everything).
 
     @property
     def prefilling(self) -> bool:
@@ -174,4 +186,13 @@ def plan_step(slots: Sequence[SlotView], *, total_slots: int,
              else max(decode_chunk, min(rem)))
     if eos_bounded:
         steps = min(steps, 2 * decode_chunk)
+        if any(v.stale > 0 for v in seeded):
+            # Stale-frontier discard bound (overlapped loop): a rider
+            # with undrained steps may already be past its eos
+            # without the host knowing. Capping the next dispatch at
+            # ONE decode chunk — together with the engine's trailing
+            # drain, which blocks once the pipeline is two dispatches
+            # deep — bounds the tokens ever discarded on a
+            # late-revealed eos to at most one decode chunk per slot.
+            steps = min(steps, decode_chunk)
     return StepPlan(tuple(grants), max(1, min(steps, max_run_ahead)))

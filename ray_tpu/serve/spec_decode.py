@@ -108,7 +108,18 @@ class NGramIndex:
     def sync(self, context: Sequence[int]) -> None:
         """Extend the index with ``context``'s unseen tail. The caller
         always passes the slot's full prompt+generated stream; tokens
-        already indexed are skipped, so this never re-scans."""
+        already indexed are skipped, so this never re-scans.
+
+        Stale-frontier contract (the engine's overlapped loop): the
+        context may TRAIL the device frontier by up to one round's
+        undrained decode steps, and consecutive syncs may pass the
+        identical context (no drain landed between rounds — the tail
+        is then empty and this is a no-op). What it may never do is
+        SHRINK: ``prompt + generated`` is append-only for a live
+        slot, and a preempted slot discards this index wholesale
+        rather than rewinding it. Shrinkage means the engine fed a
+        different request's stream into this slot's index — raise
+        loudly."""
         if len(context) < len(self._tokens):
             raise ValueError(
                 f"context shrank: indexed {len(self._tokens)} tokens "

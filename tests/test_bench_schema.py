@@ -521,6 +521,95 @@ def test_tp_ab_requires_arms_and_ratio(tmp_path):
     assert any("per_token_ratio" in p for p in probs)
 
 
+# ------------------------------------------------ overlap A/B family
+
+
+def _overlap_arm(frac, ttft):
+    return {"throughput_tok_s": 7000.0, "wall_s": 0.04,
+            "requests": 6, "gen_tokens": 48, "rounds": 10,
+            "host_gap_s": 0.001, "round_wall_s": 0.038,
+            "host_gap_fraction": frac, "ttft_p50_s": ttft}
+
+
+def _overlap_ab():
+    return {"overlap_ab": {"lockstep": _overlap_arm(0.03, 0.022),
+                           "overlapped": _overlap_arm(0.011, 0.020),
+                           "parity": {"token_identical": True,
+                                      "checked": 6},
+                           "host_gap_fraction_ratio": 0.37,
+                           "ttft_p50_ratio": 0.91},
+            "mesh": {"tp": 1, "replicas": 1}, "seed": 0,
+            "model": "llama-tiny", "git_sha": "abc1234"}
+
+
+def test_overlap_ab_artifact_validates(tmp_path):
+    assert _problems_for("SERVE_BENCH_overlap_ab_cpu_smoke.json",
+                         _overlap_ab(), tmp_path) == []
+
+
+def test_overlap_ab_refuses_missing_stamp(tmp_path):
+    no_mesh = {k: v for k, v in _overlap_ab().items() if k != "mesh"}
+    probs = _problems_for("SERVE_BENCH_overlap_ab_cpu_smoke.json",
+                          no_mesh, tmp_path)
+    assert any("mesh stamp" in p for p in probs)
+    no_seed = {k: v for k, v in _overlap_ab().items() if k != "seed"}
+    probs = _problems_for("SERVE_BENCH_overlap_ab_cpu_smoke.json",
+                          no_seed, tmp_path)
+    assert any("seed" in p for p in probs)
+
+
+def test_overlap_ab_refuses_non_parity(tmp_path):
+    # an overlapped loop that changes greedy tokens is broken,
+    # whatever its pipeline efficiency
+    diverged = _overlap_ab()
+    diverged["overlap_ab"]["parity"]["token_identical"] = False
+    probs = _problems_for("SERVE_BENCH_overlap_ab_cpu_smoke.json",
+                          diverged, tmp_path)
+    assert any("not token-identical" in p for p in probs)
+    empty = _overlap_ab()
+    empty["overlap_ab"]["parity"]["checked"] = 0
+    probs = _problems_for("SERVE_BENCH_overlap_ab_cpu_smoke.json",
+                          empty, tmp_path)
+    assert any("checked nothing" in p for p in probs)
+    no_parity = _overlap_ab()
+    del no_parity["overlap_ab"]["parity"]
+    probs = _problems_for("SERVE_BENCH_overlap_ab_cpu_smoke.json",
+                          no_parity, tmp_path)
+    assert any("parity" in p for p in probs)
+
+
+def test_overlap_ab_refuses_non_improving_host_gap(tmp_path):
+    # equal fractions: NOT strictly lower -> refused
+    flat = _overlap_ab()
+    flat["overlap_ab"]["overlapped"]["host_gap_fraction"] = 0.03
+    probs = _problems_for("SERVE_BENCH_overlap_ab_cpu_smoke.json",
+                          flat, tmp_path)
+    assert any("not strictly below" in p for p in probs)
+    worse = _overlap_ab()
+    worse["overlap_ab"]["overlapped"]["host_gap_fraction"] = 0.05
+    probs = _problems_for("SERVE_BENCH_overlap_ab_cpu_smoke.json",
+                          worse, tmp_path)
+    assert any("not strictly below" in p for p in probs)
+
+
+def test_overlap_ab_requires_arms_and_ratio(tmp_path):
+    no_arm = _overlap_ab()
+    del no_arm["overlap_ab"]["overlapped"]
+    probs = _problems_for("SERVE_BENCH_overlap_ab_cpu_smoke.json",
+                          no_arm, tmp_path)
+    assert any("overlapped" in p for p in probs)
+    no_field = _overlap_ab()
+    del no_field["overlap_ab"]["lockstep"]["host_gap_fraction"]
+    probs = _problems_for("SERVE_BENCH_overlap_ab_cpu_smoke.json",
+                          no_field, tmp_path)
+    assert any("host_gap_fraction" in p for p in probs)
+    no_ratio = _overlap_ab()
+    del no_ratio["overlap_ab"]["host_gap_fraction_ratio"]
+    probs = _problems_for("SERVE_BENCH_overlap_ab_cpu_smoke.json",
+                          no_ratio, tmp_path)
+    assert any("host_gap_fraction_ratio" in p for p in probs)
+
+
 def test_mesh_stamp_validated_when_present_elsewhere(tmp_path):
     # pre-stamp artifacts (no mesh) keep passing; a malformed stamp
     # never does

@@ -372,7 +372,7 @@ def test_phase_metrics_singleton_and_rebuild():
     m1 = obs.phase_metrics()
     assert obs.phase_metrics() is m1
     assert set(m1) == {"queue_wait", "plan", "dispatch", "readback",
-                       "round_wall", "ttft", "inter_token"}
+                       "round_wall", "host_gap", "ttft", "inter_token"}
     m1["ttft"].observe(0.12)
     text = metrics.prometheus_text()
     assert "serve_phase_ttft_s_bucket" in text

@@ -263,6 +263,38 @@ def test_suspect_recovers_on_heartbeat_progress():
     pool.shutdown()
 
 
+def test_progressing_readback_drain_never_escalates():
+    """The overlapped hot loop's blocking readback drain touches the
+    heartbeat BEFORE each device_get as well as after
+    (engine._drain_fetches_locked), so a slow-but-PROGRESSING
+    multi-buffer readback presents as a stream of sub-threshold
+    heartbeat ages — it must ride the ladder nowhere, for as long as
+    it keeps moving. The moment the touches stop (a genuine hang
+    inside one get) the normal ladder takes over."""
+    clock = FakeClock()
+    fakes, pool, wd = _wd_pool(clock, stall_deadline_s=10.0)
+    fakes[0].has_work = True
+    # each buffer of the drain costs 4s of wall — slow, but every
+    # iteration boundary refreshes the heartbeat the way the
+    # pre-get touch does
+    for _ in range(8):                     # 32s >> stall deadline
+        clock.advance(4.0)                 # 4 < suspect_after (5)
+        fakes[0].touch()
+        wd.tick()
+        assert pool.replica(0).state == HEALTHY
+    assert wd.counts["suspected"] == 0
+    assert fakes[0].force_kills == 0
+    # the readback genuinely hangs: touches stop, ladder engages
+    clock.advance(6.0)
+    wd.tick()
+    assert pool.replica(0).state == SUSPECT
+    clock.advance(5.0)
+    wd.tick()
+    assert pool.replica(0).state == DEAD
+    assert fakes[0].force_kills == 1
+    pool.shutdown()
+
+
 def test_suspect_recovers_when_work_drains():
     clock = FakeClock()
     fakes, pool, wd = _wd_pool(clock, stall_deadline_s=10.0)

@@ -120,9 +120,14 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
 
     def factory(idx):
         inj = FaultInjector()
+        # eos_id=-1: eos-BOUNDED scheduling with an id that never
+        # samples, so the campaign drives the overlapped
+        # double-buffered hot loop (stale-frontier planning, trailing
+        # drain) — the loop production engines run — while the greedy
+        # references stay full-length
         eng = LLMEngine(model, params, max_slots=2, page_size=8,
                         n_pages=64, chunk=4, temperature=0.0,
-                        seed=idx, prefix_cache=True,
+                        seed=idx, prefix_cache=True, eos_id=-1,
                         admit_timeout_s=0.25,
                         fault_injector=inj,
                         flight_dir=flight_dir)
@@ -403,6 +408,11 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
             "suspect_after_s": watchdog.suspect_after_s,
             "watchdog_poll_s": watchdog_poll_s,
             "drain_timeout_s": drain_timeout_s,
+            # the replica engines ran the overlapped double-buffered
+            # hot loop in eos-bounded mode (factory: eos_id=-1)
+            "overlap": all(getattr(e, "overlap", False)
+                           for e in all_engines),
+            "eos_bounded": True,
         },
         "schedule": [e.as_dict() for e in injector.schedule],
         "injected": counts,

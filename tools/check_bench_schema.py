@@ -33,6 +33,14 @@ family:
   nothing), or when the parity check failed / checked nothing — a
   sharded engine that changes greedy tokens is broken, whatever its
   throughput
+- SERVE_BENCH overlap A/B (serve_bench.py --overlap-ab):
+  {overlap_ab: {lockstep, overlapped, parity,
+  host_gap_fraction_ratio}, mesh, seed} — REFUSED when the
+  seed/mesh stamp is missing, when the parity check failed or
+  checked nothing (an overlapped loop that changes greedy tokens is
+  broken), or when the overlapped arm's host_gap_fraction is not
+  STRICTLY below the lockstep arm's (an overlap that doesn't shrink
+  the host gap measured nothing)
 - SERVE_BENCH autoscale (serve_bench.py --autoscale): {trace, seed,
   slo, autoscale, static_max, chip_seconds_ratio} — REFUSED when
   autoscale SLO attainment is below the floor the run itself
@@ -194,6 +202,21 @@ TP_ARM_REQUIRED = {
     "requests": int,
     "gen_tokens": int,
     "devices": int,
+}
+
+# overlap A/B artifacts carry one of these per arm (serve_bench.py
+# run_overlap_ab): the same engine + greedy eos-bounded load under
+# the lockstep loop and the double-buffered overlapped loop.
+OVERLAP_ARM_REQUIRED = {
+    "throughput_tok_s": NUM,
+    "wall_s": NUM,
+    "requests": int,
+    "gen_tokens": int,
+    "rounds": int,
+    "host_gap_s": NUM,
+    "round_wall_s": NUM,
+    "host_gap_fraction": NUM,
+    "ttft_p50_s": NUM,
 }
 
 # serve-chaos artifacts (tools/chaos_serve.py): campaign shape +
@@ -548,7 +571,74 @@ def check_tp_ab(obj, name, problems):
                         "per_token_ratio")
 
 
+def check_overlap_ab(obj, name, problems):
+    """serve_bench.py --overlap-ab artifact: the identical engine +
+    greedy eos-bounded load under the lockstep hot loop (full
+    pre-plan readback drain) and the double-buffered overlapped loop
+    (stale-frontier planning). The checker REFUSES artifacts without
+    their seed/mesh stamp, whose parity check failed or checked
+    nothing (an overlapped loop that changes greedy tokens is a
+    broken engine, whatever its pipeline efficiency), or whose
+    overlapped host-gap fraction is not STRICTLY below the lockstep
+    arm's — an overlap that doesn't shrink the host gap measured
+    nothing."""
+    _check_mesh(obj, name, problems, required=True)
+    if not isinstance(obj.get("seed"), int) \
+            or isinstance(obj.get("seed"), bool):
+        problems.append(f"{name}: overlap A/B artifact missing int "
+                        "'seed'")
+    ab = obj.get("overlap_ab")
+    if not isinstance(ab, dict):
+        problems.append(f"{name}: overlap_ab must be an object")
+        return
+    fracs = {}
+    for arm in ("lockstep", "overlapped"):
+        sec = ab.get(arm)
+        if not isinstance(sec, dict):
+            problems.append(f"{name}:overlap_ab: missing {arm} arm "
+                            "object")
+        else:
+            _check_fields(sec, OVERLAP_ARM_REQUIRED,
+                          f"{name}:overlap_ab:{arm}", problems)
+            frac = sec.get("host_gap_fraction")
+            if isinstance(frac, NUM) and not isinstance(frac, bool):
+                fracs[arm] = frac
+    parity = ab.get("parity")
+    if not isinstance(parity, dict):
+        problems.append(f"{name}:overlap_ab: missing the parity "
+                        "block")
+    else:
+        if parity.get("token_identical") is not True:
+            problems.append(
+                f"{name}: overlapped arm was not token-identical to "
+                "the lockstep arm — an overlapped loop that changes "
+                "greedy tokens is broken")
+        checked = parity.get("checked")
+        if not isinstance(checked, int) or isinstance(checked, bool) \
+                or checked < 1:
+            problems.append(f"{name}:overlap_ab: parity checked "
+                            "nothing (parity.checked must be int "
+                            ">= 1)")
+    if len(fracs) == 2 and fracs["overlapped"] >= fracs["lockstep"]:
+        problems.append(
+            f"{name}: overlapped host_gap_fraction "
+            f"{fracs['overlapped']} is not strictly below the "
+            f"lockstep arm's {fracs['lockstep']} — the overlap "
+            "measured no pipeline win")
+    ratio = ab.get("host_gap_fraction_ratio")
+    if not isinstance(ratio, NUM) or isinstance(ratio, bool):
+        problems.append(f"{name}: overlap A/B artifact missing "
+                        "numeric host_gap_fraction_ratio")
+
+
 def check_serve_bench(obj, name, problems):
+    if "overlap_ab" in obj:
+        # overlapped hot-loop A/B family (serve_bench.py --overlap-ab)
+        check_overlap_ab(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
     if "tp_ab" in obj:
         # tensor-parallel A/B family (serve_bench.py --tp-ab)
         check_tp_ab(obj, name, problems)

@@ -88,11 +88,54 @@ def report(artifact: Dict[str, Any]) -> Dict[str, Any]:
     return {
         "requests": rows,
         "phase_percentiles": percentiles,
+        "rounds": _round_stats(events),
         "ttft_check": {
             "n": len(errs),
             "max_abs_err_s": round(max(errs), 6) if errs else None,
             "within_1ms": bool(errs) and max(errs) < 1e-3,
         },
+    }
+
+
+def _round_stats(events: List[Dict[str, Any]]
+                 ) -> Optional[Dict[str, Any]]:
+    """Per-round pipeline health, from the engine's typed "round"
+    events (one per scheduler round, serve/engine.py): how much of
+    each round the HOST gated dispatch (pre-plan readback drain +
+    planner = ``host_gap_s``) versus the round's wall clock.
+    ``overlap_efficiency`` = 1 - sum(gap)/sum(wall) — the fraction of
+    round time the device pipeline stayed fed; the same quantity the
+    ``serve_phase_host_gap_s`` histogram (serve/obs.py) accumulates,
+    recomputed here from raw events so the two sources cross-check.
+    None when the artifact predates round events."""
+    gaps: List[float] = []
+    walls: List[float] = []
+    overlap = None
+    for ev in events:
+        if ev.get("type") != "round":
+            continue
+        d = ev.get("data") or {}
+        g, w = d.get("host_gap_s"), d.get("wall_s")
+        if isinstance(g, (int, float)) and isinstance(w, (int, float)):
+            gaps.append(g)
+            walls.append(w)
+            overlap = d.get("overlap", overlap)
+    if not gaps:
+        return None
+    total_gap, total_wall = sum(gaps), sum(walls)
+    frac = total_gap / total_wall if total_wall else None
+    return {
+        "n": len(gaps),
+        "overlap": overlap,
+        "host_gap_total_s": round(total_gap, 6),
+        "round_wall_total_s": round(total_wall, 6),
+        "host_gap_fraction": (round(frac, 6)
+                              if frac is not None else None),
+        "overlap_efficiency": (round(1.0 - frac, 6)
+                               if frac is not None else None),
+        "host_gap_p50_s": round(_pct(gaps, 0.50), 6),
+        "host_gap_p99_s": round(_pct(gaps, 0.99), 6),
+        "round_wall_p50_s": round(_pct(walls, 0.50), 6),
     }
 
 
@@ -124,6 +167,15 @@ def main(argv: List[str]) -> int:
         print(f"  {k:>14}  p50={p['p50'] * 1e3:8.2f}  "
               f"p99={p['p99'] * 1e3:8.2f}  "
               f"max={p['max'] * 1e3:8.2f}  (n={p['n']})")
+    rd = rep.get("rounds")
+    if rd:
+        print(f"\nscheduler rounds (n={rd['n']}, "
+              f"overlap={rd['overlap']}):")
+        print(f"  host_gap p50={rd['host_gap_p50_s'] * 1e3:8.2f}ms  "
+              f"p99={rd['host_gap_p99_s'] * 1e3:8.2f}ms  "
+              f"round_wall p50={rd['round_wall_p50_s'] * 1e3:8.2f}ms")
+        print(f"  host_gap_fraction={rd['host_gap_fraction']}  "
+              f"overlap_efficiency={rd['overlap_efficiency']}")
     chk = rep["ttft_check"]
     print(f"\nttft cross-check: n={chk['n']} "
           f"max_abs_err={chk['max_abs_err_s']}s "
