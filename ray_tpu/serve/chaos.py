@@ -61,6 +61,23 @@ logger = logging.getLogger(__name__)
 KINDS = ("kill", "hang", "slow", "readback", "stockout",
          "kill_during_drain")
 
+# Cross-process campaign (tools/chaos_serve.py --fleet): replicas are
+# real OS processes behind the fleet control plane (serve/fleet/).
+#
+# ==================   =================================================
+# ``kill_agent``       SIGKILL one replica-agent PROCESS — the router
+#                      must suspect, get the death directory-confirmed
+#                      (lease expiry), and resubmit token-identically
+# ``partition``        one agent's network drops both ways (inbound
+#                      gate + outbound renew skip) — it must SELF-FENCE
+#                      when its lease lapses so it can never
+#                      double-serve a request the router resubmitted
+# ``directory_restart``  SIGKILL the directory and restart it on the
+#                      same port — membership recovers from agent
+#                      re-advertisement; clients must not notice
+# ==================   =================================================
+FLEET_KINDS = ("kill_agent", "partition", "directory_restart")
+
 
 @dataclasses.dataclass
 class ChaosEvent:
@@ -106,6 +123,89 @@ def make_schedule(seed: int, duration_s: float, kinds=KINDS,
         dur = slow_s if kind == "slow" else stockout_s
         events.append(ChaosEvent(kind=kind, at_s=at, duration_s=dur))
     return events
+
+
+def make_fleet_schedule(seed: int, duration_s: float,
+                        kinds=FLEET_KINDS, extra: int = 0,
+                        partition_s: float = 1.0
+                        ) -> List[ChaosEvent]:
+    """Deterministic cross-process schedule: same contract as
+    ``make_schedule`` (>= 1 of each kind, seeded order and timing)
+    with ``partition_s`` as the partition window."""
+    base = make_schedule(seed, duration_s, kinds=kinds, extra=extra)
+    for ev in base:
+        if ev.kind == "partition":
+            ev.duration_s = partition_s
+    return base
+
+
+class FleetChaosInjector:
+    """Watcher thread firing a fleet schedule through harness-owned
+    fault operations. The harness owns the OS processes, so injection
+    is delegated: ``ops[kind](event, rng) -> target-or-None`` performs
+    the fault and returns a target label (recorded in the log) or
+    None when it can't fire yet (the event retries next tick, same as
+    ``ChaosInjector``)."""
+
+    def __init__(self, schedule: List[ChaosEvent],
+                 ops: Dict[str, Callable[[ChaosEvent, random.Random],
+                                         Optional[str]]], *,
+                 seed: int = 0, poll_s: float = 0.02,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self.schedule = sorted(schedule, key=lambda e: e.at_s)
+        self.ops = ops
+        self.poll_s = poll_s
+        self._time = time_fn
+        self._rng = random.Random(seed)
+        self.log: List[Dict[str, Any]] = []
+        self._t0: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="fleet-chaos",
+                                        daemon=True)
+
+    def start(self) -> "FleetChaosInjector":
+        self._t0 = self._time()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+    def done(self) -> bool:
+        return all(e.fired for e in self.schedule)
+
+    def injected_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.schedule:
+            if e.fired:
+                out[e.kind] = out.get(e.kind, 0) + 1
+        return out
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            elapsed = self._time() - self._t0
+            for ev in self.schedule:
+                if ev.fired or elapsed < ev.at_s:
+                    continue
+                op = self.ops.get(ev.kind)
+                try:
+                    target = op(ev, self._rng) if op else None
+                except Exception as e:  # noqa: BLE001 - keep firing
+                    logger.warning("fleet chaos %s failed: %s",
+                                   ev.kind, e)
+                    target = None
+                if target is not None:
+                    ev.fired = True
+                    ev.fired_at_s = elapsed
+                    d = ev.as_dict()
+                    d["target"] = target
+                    self.log.append(d)
+                break
+            if self.done():
+                return
+            time.sleep(self.poll_s)
 
 
 class StockoutCapacityProvider(ReplicaCapacityProvider):

@@ -67,6 +67,8 @@ from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
                                   EngineOverloaded, EngineShutdown,
                                   PoolDegraded, RequestCancelled,
                                   RequestError)
+from ray_tpu.serve.fleet.routing import (Candidate, ResubmitPolicy,
+                                         select_candidate)
 from ray_tpu.serve.prefix_cache import path_hashes
 
 ROUTED = "serve_pool_routed_total"
@@ -80,6 +82,7 @@ RESTARTS = "serve_pool_restarts_total"
 ALL_SHED = "serve_pool_all_shed_total"
 FREE_SLOTS = "serve_pool_replica_free_slots"
 QUEUE_DEPTH = "serve_pool_replica_queue_depth"
+CAPACITY_HINT_ERRORS = "serve_pool_capacity_hint_errors_total"
 SUSPECTS = "serve_pool_suspect_total"
 WEDGED = "serve_pool_wedged_total"
 WEDGE_LATENCY = "serve_pool_wedge_detect_latency_s"
@@ -125,6 +128,9 @@ def _metrics() -> dict:
             "queue_depth": metrics.Gauge(
                 QUEUE_DEPTH, "Admission queue depth per replica",
                 tag_keys=("replica",)),
+            "capacity_hint_errors": metrics.Counter(
+                CAPACITY_HINT_ERRORS, "capacity_hint_fn raised; the "
+                "pool fell back to the pending-backoff ETA"),
             "suspects": metrics.Counter(
                 SUSPECTS, "Replicas quarantined SUSPECT by the "
                 "watchdog (stale heartbeat with work pending)"),
@@ -176,7 +182,7 @@ class _Replica:
         self.generation = generation
 
 
-class PoolRequestHandle:
+class PoolRequestHandle(ResubmitPolicy):
     """Client-side view of a pooled request. Mirrors the engine's
     ``RequestHandle`` surface (stream/result/cancel/done/error/
     ttft_s) and adds the recovery loop: iterating ``stream()`` (or
@@ -184,27 +190,20 @@ class PoolRequestHandle:
     replica when its replica dies BEFORE any token was delivered;
     after first delivery a replica death fails typed
     ``EngineShutdown`` — never a silent hang, never a duplicated
-    token."""
+    token. The at-most-once guard itself (budget, deadline carry,
+    partial-stream refusal) is ``fleet.routing.ResubmitPolicy``,
+    shared with the process-separated ``FleetRouter``."""
 
     def __init__(self, pool: "EnginePool", prompt: List[int],
                  max_new_tokens: int, deadline_s: Optional[float],
                  session_id: Optional[str],
                  trace_id: Optional[str] = None):
+        super().__init__(prompt, max_new_tokens, deadline_s,
+                         session_id, trace_id,
+                         max_resubmits=pool.max_resubmits)
         self._pool = pool
-        self._prompt = prompt
-        self._mnt = max_new_tokens
-        self._deadline_s = deadline_s
-        self._session_id = session_id
-        self._trace_id = trace_id
-        self._t0 = time.monotonic()
-        self._t_first: Optional[float] = None
         self._rep: Optional[_Replica] = None
         self._inner = None
-        self._generated: List[int] = []
-        self._resubmits = 0
-        self._error: Optional[BaseException] = None
-        self._finished = False
-        self._cancelled = False
 
     # ------------------------------------------------------- consuming
 
@@ -215,9 +214,7 @@ class PoolRequestHandle:
             rep, inner = self._rep, self._inner
             try:
                 for tok in inner.stream():
-                    if self._t_first is None:
-                        self._t_first = time.monotonic()
-                    self._generated.append(tok)
+                    self._note_token(tok)
                     yield tok
                 self._finished = True
                 return
@@ -240,20 +237,9 @@ class PoolRequestHandle:
                     self._fail(e)
                     raise
                 if self._generated or self._cancelled:
-                    err = EngineShutdown(
-                        f"replica {rep.idx} died after "
-                        f"{len(self._generated)} streamed tokens; a "
-                        f"partial stream cannot be replayed "
-                        f"at-most-once")
-                    self._fail(err)
-                    raise err from e
+                    raise self._partial_stream_error(
+                        str(rep.idx), e) from e
                 self._resubmit(e)      # raises typed when impossible
-
-    def result(self) -> List[int]:
-        """Block until completion; return all generated token ids."""
-        for _ in self.stream():
-            pass
-        return list(self._generated)
 
     # ------------------------------------------------------- lifecycle
 
@@ -263,56 +249,22 @@ class PoolRequestHandle:
         return inner.cancel() if inner is not None else False
 
     @property
-    def done(self) -> bool:
-        return self._finished or self._error is not None
-
-    @property
-    def error(self) -> Optional[BaseException]:
-        return self._error
-
-    @property
-    def ttft_s(self) -> Optional[float]:
-        """Submit-to-first-token as the CLIENT saw it — spans
-        resubmissions, unlike the per-engine stamp."""
-        if self._t_first is None:
-            return None
-        return self._t_first - self._t0
-
-    @property
     def replica_idx(self) -> Optional[int]:
         return self._rep.idx if self._rep is not None else None
 
+    @property
+    def replica_tag(self) -> Optional[str]:
+        """``idx:generation`` of the serving replica incarnation —
+        a resubmit that lands on a rebuilt replica of the SAME idx
+        still shows a different tag (the X-Replica header value)."""
+        rep = self._rep
+        return (f"{rep.idx}:{rep.generation}"
+                if rep is not None else None)
+
     # -------------------------------------------------------- internal
 
-    def _fail(self, err: BaseException) -> None:
-        self._error = err
-
-    def _remaining_deadline(self,
-                            cause: BaseException) -> Optional[float]:
-        if self._deadline_s is None:
-            return None
-        left = self._deadline_s - (time.monotonic() - self._t0)
-        if left <= 0:
-            err = DeadlineExceeded(
-                "deadline elapsed while recovering from a replica "
-                "death")
-            self._fail(err)
-            raise err from cause
-        return left
-
     def _resubmit(self, cause: BaseException) -> None:
-        if self._cancelled:
-            err = RequestCancelled("request cancelled")
-            self._fail(err)
-            raise err from cause
-        if self._resubmits >= self._pool.max_resubmits:
-            err = EngineShutdown(
-                f"request resubmitted {self._resubmits} times "
-                f"without completing; giving up")
-            self._fail(err)
-            raise err from cause
-        deadline = self._remaining_deadline(cause)
-        self._resubmits += 1
+        deadline = self._check_resubmit(cause)
         self._pool._count_requeue(trace_id=self._trace_id)
         try:
             self._rep, self._inner = self._pool._submit_once(
@@ -755,7 +707,16 @@ class EnginePool:
             try:
                 eta = max(eta, float(self.capacity_hint_fn()))
             except Exception:
-                pass
+                # a raising provider hint must not poison the ETA:
+                # fall back to the pending-backoff estimate below
+                _metrics()["capacity_hint_errors"].inc()
+        return max(eta, self._pending_backoff_eta_s())
+
+    def _pending_backoff_eta_s(self) -> float:
+        """Longest pending auto-restart backoff — the capacity ETA
+        the pool can always compute from its own state, used as the
+        fallback whenever ``capacity_hint_fn`` raises."""
+        eta = 0.0
         if self._auto_restart:
             with self._lock:
                 dead_deaths = [r.deaths for r in self._replicas
@@ -808,7 +769,11 @@ class EnginePool:
                         try:
                             eta = float(self.capacity_hint_fn())
                         except Exception:
-                            eta = 0.0
+                            # broken hint provider: fall back to the
+                            # pool's own pending-backoff ETA rather
+                            # than silently dropping the signal
+                            _metrics()["capacity_hint_errors"].inc()
+                            eta = self._pending_backoff_eta_s()
                         if eta > 0:
                             hints.append(eta)
                     err = EngineOverloaded(
@@ -885,78 +850,20 @@ class EnginePool:
         for r in reps:
             if reports[r.idx]["stopped"]:
                 self._note_replica_death(r)
-        live = [r for r in reps
-                if not reports[r.idx]["stopped"]
-                and not reports[r.idx]["draining"]]
-        if not live:
-            return None, {"hints": []}
-
-        def saturated(r: _Replica) -> bool:
-            rpt = reports[r.idx]
-            return (rpt["max_queued"] is not None
-                    and rpt["queue_depth"] >= rpt["max_queued"])
-
-        open_reps = [r for r in live if not saturated(r)]
-        if not open_reps:
-            return None, {"hints": [
-                reports[r.idx]["shed_retry_after_s"] for r in live]}
-
-        # longest cached prefix per replica, page-granular (page size
-        # can differ across generations, so hash per distinct Pg)
-        hashes_by_pg: Dict[int, List[int]] = {}
-        match_pages: Dict[int, int] = {}
-        for r in live:
-            digest = reports[r.idx]["prefix_digest"]
-            if not digest:
-                match_pages[r.idx] = 0
-                continue
-            pg = r.engine.Pg
-            hs = hashes_by_pg.get(pg)
-            if hs is None:
-                hs = hashes_by_pg[pg] = path_hashes(prompt, pg)
-            k = 0
-            for h in hs:
-                if h not in digest:
-                    break
-                k += 1
-            match_pages[r.idx] = k
-
-        outstanding = {r.idx: reports[r.idx]["outstanding_tokens"]
-                       for r in live}
-
-        # 1. session stickiness
-        if sticky_idx is not None:
-            for r in open_reps:
-                if r.idx == sticky_idx:
-                    return r, {"kind": "sticky",
-                               "pages": match_pages.get(r.idx, 0)}
-
-        # 2. longest-prefix affinity (scored over ALL live replicas:
-        #    a saturated best target means spill, not a blind miss)
-        best, best_pages = None, 0
-        for r in live:
-            k = match_pages.get(r.idx, 0)
-            if k > best_pages or (k == best_pages and k > 0
-                                  and best is not None
-                                  and outstanding[r.idx]
-                                  < outstanding[best.idx]):
-                best, best_pages = r, k
-        spilled = False
-        if best is not None and best_pages > 0:
-            if not saturated(best):
-                return best, {"kind": "affinity",
-                              "pages": best_pages}
-            spilled = True     # hot replica is full: overflow
-
-        # 3. power-of-two-choices on least outstanding tokens
-        if len(open_reps) == 1:
-            pick = open_reps[0]
-        else:
-            a, b = self._rng.sample(open_reps, 2)
-            pick = a if (outstanding[a.idx], a.idx) <= (
-                outstanding[b.idx], b.idx) else b
-        return pick, {"kind": "p2c", "spilled": spilled,
-                      "pages": match_pages.get(pick.idx, 0)}
+        # selection itself is the shared fleet.routing core: the same
+        # sticky -> affinity/spill -> P2C policy the FleetRouter runs
+        # over the directory's advertised reports
+        by_key = {r.idx: r for r in reps}
+        cands = [Candidate(r.idx, reports[r.idx],
+                           getattr(r.engine, "Pg", 0))
+                 for r in reps
+                 if not reports[r.idx]["stopped"]
+                 and not reports[r.idx]["draining"]]
+        pick, decision = select_candidate(
+            cands, prompt, sticky_key=sticky_idx, rng=self._rng)
+        if pick is None:
+            return None, decision
+        return by_key[pick.key], decision
 
     def _record_route(self, rep: _Replica, decision: Dict[str, Any],
                       session_id: Optional[str],

@@ -1,0 +1,45 @@
+"""Distributed fleet control plane: the EnginePool split into three
+processes behind a pluggable transport.
+
+- ``directory``: FleetDirectory — membership keyed by replica id +
+  generation, lease-based liveness, monotonic fencing tokens.
+- ``agent``: ReplicaAgent — wraps one LLMEngine per host, renews its
+  lease, self-fences when the lease lapses.
+- ``router``: FleetRouter — routes by advertised digests/load, treats
+  transport errors as replica death candidates (suspect →
+  directory-confirmed dead → token-identical resubmit).
+- ``transport``: the seam — in-process loopback, length-prefixed
+  JSON-over-socket, and a seeded fault-injecting wrapper.
+- ``routing``: the selection + resubmit core shared with EnginePool.
+- ``wire``: the JSON wire schema (envelopes carry trace ids so
+  ``obs.request_phases()`` still reconstructs end-to-end).
+
+Attribute access is lazy (PEP 562): ``engine_pool`` imports
+``fleet.routing`` for the shared core, while ``fleet.agent`` imports
+``watchdog`` which imports ``engine_pool`` — eager re-exports here
+would close that cycle mid-import.
+"""
+import importlib
+
+_EXPORTS = {
+    "FleetDirectory": "directory", "DirectoryClient": "directory",
+    "ReplicaAgent": "agent", "AgentClient": "agent",
+    "ScriptedEngine": "agent",
+    "FleetRouter": "router",
+    "LoopbackTransport": "transport", "SocketTransport": "transport",
+    "SocketServer": "transport", "FaultyTransport": "transport",
+    "TransportError": "transport", "TransportTimeout": "transport",
+    "AgentFenced": "wire", "StaleFencingToken": "wire",
+    "UnknownMember": "wire",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(f"{__name__}.{mod}"),
+                   name)

@@ -1,0 +1,809 @@
+"""ReplicaAgent: one engine per host, lease-fenced, self-policing.
+
+The data-plane half of the fleet split. An agent owns exactly one
+``LLMEngine``, registers it with the FleetDirectory, and keeps the
+lease alive by renewing every third of the TTL (each renewal
+piggybacks the engine's prefix digest + load report — the directory
+is how the router sees this host). Three failure behaviors carry the
+correctness story:
+
+- **Self-fencing.** The agent tracks its own lease deadline from its
+  own clock. When renewals stop landing (partition, directory crash
+  + slow recovery) and the deadline passes, the agent fences ITSELF:
+  new submits fail typed ``AgentFenced`` and every in-flight request
+  is cancelled. By the time the router (via the directory) declares
+  this replica dead and resubmits its requests elsewhere, the
+  partitioned agent has already stopped producing tokens — a
+  resubmitted request can never be double-served, whichever side of
+  the partition you watch from. A fenced agent re-joins by
+  re-registering under ``generation+1`` with a fresh request table.
+
+- **Idempotent admission.** Every submit carries a router-minted
+  request key; duplicate delivery (retried or transport-duplicated
+  frames) returns the EXISTING request id instead of admitting
+  twice. Polls are cursor-based, so a duplicated poll re-reads
+  instead of double-consuming. Together these make the transport's
+  at-least-once retries safe on an at-most-once engine.
+
+- **Local watchdog.** ``watchdog.AgentWatchdog`` probes the engine's
+  progress heartbeat; a wedge is flight-dumped, force-killed, and
+  REPORTED on the next renewal (``wedged=True``) before the agent
+  rebuilds its engine under a new generation — the pool-side ladder,
+  relocated to the only process that can still see the engine.
+
+``ScriptedEngine`` is a deterministic no-jax stand-in engine
+(``scripted_completion`` is its pure ground truth) so the
+cross-process tier-1 smoke runs in milliseconds; the real campaign
+runs llama_tiny fp32 greedy in every agent process.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.serve import obs
+from ray_tpu.serve.errors import (EngineDraining, EngineShutdown,
+                                  RequestCancelled)
+from ray_tpu.serve.fleet import wire
+from ray_tpu.serve.fleet.directory import DirectoryClient
+from ray_tpu.serve.fleet.transport import Transport
+from ray_tpu.serve.fleet.wire import AgentFenced, StaleFencingToken
+
+ACTIVE = "active"
+FENCED = "fenced"
+
+
+def scripted_completion(prompt: List[int],
+                        max_new_tokens: int) -> List[int]:
+    """Pure deterministic completion: the ScriptedEngine's ground
+    truth, computable in any process without the model."""
+    x = 0
+    for t in prompt:
+        x = (x * 31 + int(t) + 7) % 100003
+    out = []
+    for _ in range(max_new_tokens):
+        x = (x * 1103515245 + 12345) % 100003
+        out.append(x % 997)
+    return out
+
+
+class _ScriptedHandle:
+    def __init__(self, eng: "ScriptedEngine", prompt: List[int],
+                 n: int):
+        self._eng = eng
+        self._tokens = scripted_completion(prompt, n)
+        self._cancelled = False
+
+    def stream(self):
+        for tok in self._tokens:
+            if self._cancelled:
+                raise RequestCancelled("request cancelled")
+            if self._eng._stopped:
+                raise (self._eng._kill_err
+                       or EngineShutdown("engine stopped"))
+            time.sleep(self._eng.token_delay_s)
+            self._eng._hb = time.monotonic()
+            yield tok
+
+    def result(self) -> List[int]:
+        return list(self.stream())
+
+    def cancel(self) -> bool:
+        self._cancelled = True
+        return True
+
+
+class ScriptedEngine:
+    """Deterministic, model-free engine with the surface the agent
+    (and the routing core) needs: submit/stream, load_report with
+    heartbeat + digest keys, drain/force_kill/shutdown. Token i of a
+    request is a pure function of the prompt, so cross-process
+    token-identity checks have one right answer with zero startup
+    cost."""
+
+    def __init__(self, *, page_size: int = 8,
+                 token_delay_s: float = 0.002,
+                 max_queued: Optional[int] = None):
+        self.Pg = page_size
+        self.token_delay_s = token_delay_s
+        self.max_queued = max_queued
+        self._stopped = False
+        self._draining = False
+        self._kill_err: Optional[BaseException] = None
+        self._hb = time.monotonic()
+        self._active = 0
+        self._lock = threading.Lock()
+
+    def start(self) -> "ScriptedEngine":
+        return self
+
+    def submit(self, prompt_ids, max_new_tokens: int = 16,
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> _ScriptedHandle:
+        if self._stopped:
+            raise EngineShutdown("engine stopped")
+        if self._draining:
+            raise EngineDraining("engine draining")
+        with self._lock:
+            self._active += 1
+        self._hb = time.monotonic()
+        return _ScriptedHandle(self, list(prompt_ids),
+                               max_new_tokens)
+
+    def request_done(self) -> None:
+        with self._lock:
+            self._active = max(0, self._active - 1)
+
+    def load_report(self) -> Dict[str, Any]:
+        with self._lock:
+            active = self._active
+        return {"free_slots": max(0, 4 - active), "total_slots": 4,
+                "free_pages": 64, "queue_depth": active,
+                "outstanding_tokens": active * 8,
+                "max_queued": self.max_queued,
+                "shed_retry_after_s": 0.05, "shed_total": 0,
+                "ttft_ewma_s": None, "draining": self._draining,
+                "stopped": self._stopped,
+                "heartbeat_age_s": time.monotonic() - self._hb,
+                "fetchq_depth": 0, "pending_prefills": 0,
+                "overlap": False, "has_work": active > 0, "tp": 1,
+                "prefix_digest": frozenset()}
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        self._draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._active == 0:
+                    return True
+            time.sleep(0.005)
+        with self._lock:
+            return self._active == 0
+
+    def force_kill(self, err: Optional[BaseException] = None) -> None:
+        self._kill_err = err
+        self._stopped = True
+
+    def shutdown(self) -> None:
+        self._stopped = True
+
+
+class ReplicaAgent:
+    """One engine + its lease, behind a transport handler."""
+
+    def __init__(self, replica_id: str,
+                 engine_factory: Callable[[int], Any],
+                 directory: DirectoryClient, *,
+                 addr: Optional[List[Any]] = None,
+                 generation: int = 0,
+                 renew_period_s: Optional[float] = None,
+                 stall_deadline_s: Optional[float] = None,
+                 flight_dir: Any = None,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self.replica_id = replica_id
+        self._factory = engine_factory
+        self._directory = directory
+        self.addr = addr if addr is not None else ["loopback",
+                                                   replica_id]
+        self.generation = int(generation)
+        self._renew_period_s = renew_period_s
+        self._stall_deadline_s = stall_deadline_s
+        self.flight_dir = flight_dir
+        self._now = time_fn
+        self._lock = threading.Lock()
+        self.engine: Any = None
+        self.state = ACTIVE
+        self.fence = 0
+        self.lease_ttl_s = 0.0
+        self._lease_deadline = 0.0
+        self._draining = False
+        self._partition_until = 0.0
+        self._wedge_err: Optional[BaseException] = None
+        self._reqs: Dict[str, Dict[str, Any]] = {}
+        self._by_key: Dict[str, str] = {}
+        self._seq = 0
+        self._stop = threading.Event()
+        self._renew_thread: Optional[threading.Thread] = None
+        self._watchdog = None
+        self.events = obs.EventLog(1024, name=f"agent-{replica_id}")
+        self.counters = {"submits": 0, "dup_submits": 0,
+                         "refused_fenced": 0, "refused_stale_fence":
+                         0, "polls": 0, "self_fences": 0,
+                         "reregisters": 0, "wedges": 0,
+                         "cancelled_on_fence": 0}
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> "ReplicaAgent":
+        if self.engine is None:
+            self.engine = self._factory(self.generation)
+            if hasattr(self.engine, "start"):
+                self.engine.start()
+        self._register(min_fence=0)
+        if self._renew_thread is None:
+            self._renew_thread = threading.Thread(
+                target=self._renew_loop,
+                name=f"agent-renew-{self.replica_id}", daemon=True)
+            self._renew_thread.start()
+        if (self._stall_deadline_s is not None
+                and self._watchdog is None):
+            from ray_tpu.serve.watchdog import AgentWatchdog
+            self._watchdog = AgentWatchdog(
+                lambda: self.engine, self._on_wedge,
+                stall_deadline_s=self._stall_deadline_s,
+                flight_dir=self.flight_dir).run()
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        t = self._renew_thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._renew_thread = None
+        if self.engine is not None:
+            try:
+                self.engine.shutdown()
+            except Exception:
+                pass
+
+    # ----------------------------------------------------- lease logic
+
+    def _register(self, min_fence: int) -> None:
+        r = self._directory.register(
+            self.replica_id, self.addr, self.generation,
+            page_size=getattr(self.engine, "Pg", 0),
+            min_fence=min_fence)
+        with self._lock:
+            self.fence = int(r["fence"])
+            self.lease_ttl_s = float(r["lease_ttl_s"])
+            self._lease_deadline = self._now() + self.lease_ttl_s
+            self.state = ACTIVE
+        self.events.append("registered",
+                           data={"fence": self.fence,
+                                 "generation": self.generation})
+
+    def partitioned(self) -> bool:
+        return self._now() < self._partition_until
+
+    def reachable(self) -> bool:
+        """SocketServer gate: False while partitioned — inbound
+        frames are dropped without a response."""
+        return not self.partitioned()
+
+    def _renew_payload(self) -> Dict[str, Any]:
+        digest: List[int] = []
+        load: Dict[str, Any] = {}
+        try:
+            rpt = dict(self.engine.load_report())
+            digest = sorted(rpt.pop("prefix_digest", ()) or ())
+            load = _json_safe(rpt)
+        except Exception:
+            pass
+        return {"digest": digest, "load": load}
+
+    def _renew_loop(self) -> None:
+        while not self._stop.is_set():
+            period = (self._renew_period_s
+                      if self._renew_period_s is not None
+                      else max(0.02, self.lease_ttl_s / 3.0))
+            self._stop.wait(period)
+            if self._stop.is_set():
+                return
+            self.renew_once()
+
+    def renew_once(self) -> bool:
+        """One renewal attempt + the self-fencing judgement. Split
+        out of the loop so tests can drive it deterministically."""
+        wedge = self._wedge_err
+        if not self.partitioned() and self.state == ACTIVE:
+            adv = self._renew_payload()
+            t_call = self._now()
+            try:
+                self._directory.renew(
+                    self.replica_id, self.fence,
+                    digest=adv["digest"], load=adv["load"],
+                    wedged=wedge is not None)
+                with self._lock:
+                    self._lease_deadline = t_call + self.lease_ttl_s
+                if wedge is not None:
+                    self._rebuild_after_wedge()
+                return True
+            except (wire.UnknownMember, StaleFencingToken):
+                # directory restarted (lost our membership) or we
+                # were superseded: membership recovers from agent
+                # re-advertisement — re-register, SAME generation
+                # (requests in flight are healthy; a directory
+                # restart must be invisible to clients)
+                try:
+                    self._reregister(bump_generation=False)
+                except Exception:
+                    pass
+                return False
+            except Exception:
+                pass    # transport trouble: judged below
+        if (self.state == ACTIVE
+                and self._now() > self._lease_deadline):
+            self._self_fence("lease lapsed without renewal")
+        if self.state == FENCED and not self.partitioned():
+            # fenced agents re-join as a fresh incarnation
+            try:
+                self._reregister(bump_generation=True)
+            except Exception:
+                pass
+        return False
+
+    def _reregister(self, bump_generation: bool) -> None:
+        old_fence = self.fence
+        if bump_generation:
+            self.generation += 1
+            with self._lock:
+                self._reqs.clear()
+                self._by_key.clear()
+        self._register(min_fence=old_fence)
+        self.counters["reregisters"] += 1
+        self.events.append(
+            "reregistered",
+            data={"fence": self.fence,
+                  "generation": self.generation,
+                  "bumped": bump_generation})
+
+    def _self_fence(self, reason: str) -> None:
+        with self._lock:
+            if self.state == FENCED:
+                return
+            self.state = FENCED
+            self.counters["self_fences"] += 1
+            active = [rec for rec in self._reqs.values()
+                      if not rec["done"] and rec["error"] is None]
+            for rec in active:
+                rec["error"] = wire.err(AgentFenced(
+                    f"agent {self.replica_id} self-fenced: "
+                    f"{reason}"))["error"]
+                self.counters["cancelled_on_fence"] += 1
+        # cancel outside the lock: handle.cancel takes engine locks
+        for rec in active:
+            try:
+                rec["handle"].cancel()
+            except Exception:
+                pass
+        self.events.append("self_fence",
+                           data={"reason": reason,
+                                 "fence": self.fence,
+                                 "generation": self.generation,
+                                 "cancelled": len(active)})
+        if self.flight_dir:
+            try:
+                obs.dump_flight_bundle(
+                    self.flight_dir,
+                    f"self-fenced-{self.replica_id}",
+                    engine=self.engine, pool=self,
+                    extra={"replica_id": self.replica_id,
+                           "reason": reason, "fence": self.fence,
+                           "generation": self.generation,
+                           "lease_overdue_s": round(
+                               self._now() - self._lease_deadline,
+                               4),
+                           "cancelled_in_flight": len(active)})
+            except Exception:
+                pass
+
+    def _on_wedge(self, err: BaseException) -> None:
+        self._wedge_err = err
+        self.counters["wedges"] += 1
+        self.events.append("wedged", data={"err": str(err)})
+
+    def _rebuild_after_wedge(self) -> None:
+        """Wedge was reported on a successful renewal: replace the
+        corpse under a new generation and re-register."""
+        self._wedge_err = None
+        self.generation += 1
+        with self._lock:
+            self._reqs.clear()
+            self._by_key.clear()
+        old = self.engine
+        self.engine = self._factory(self.generation)
+        if hasattr(self.engine, "start"):
+            self.engine.start()
+        try:
+            if old is not None:
+                old.shutdown()
+        except Exception:
+            pass
+        self._reregister_engine_swap()
+
+    def _reregister_engine_swap(self) -> None:
+        try:
+            self._register(min_fence=self.fence)
+            self.counters["reregisters"] += 1
+        except Exception:
+            pass
+
+    # ----------------------------------------------------- RPC surface
+
+    def handle(self, method: str, args: Dict[str, Any],
+               trace_id: Optional[str] = None) -> Any:
+        fn = getattr(self, "rpc_" + method, None)
+        if fn is None:
+            raise EngineShutdown(f"agent has no method {method}")
+        if method == "submit":
+            return fn(trace_id=trace_id, **args)
+        return fn(**args)
+
+    def rpc_ping(self) -> Dict[str, Any]:
+        return {"ok": True, "replica_id": self.replica_id,
+                "generation": self.generation, "state": self.state}
+
+    def rpc_submit(self, key: str, prompt_ids: List[int],
+                   max_new_tokens: int,
+                   deadline_s: Optional[float] = None,
+                   fence: Optional[int] = None,
+                   trace_id: Optional[str] = None) -> Dict[str, Any]:
+        if self.state == FENCED:
+            self.counters["refused_fenced"] += 1
+            raise AgentFenced(
+                f"agent {self.replica_id} is fenced (lease lapsed); "
+                f"refusing admission")
+        if self._draining:
+            raise EngineDraining(
+                f"agent {self.replica_id} is draining")
+        if fence is not None and int(fence) != self.fence:
+            self.counters["refused_stale_fence"] += 1
+            raise StaleFencingToken(
+                f"submit quoted fence {fence}; agent "
+                f"{self.replica_id} holds fence {self.fence}")
+        with self._lock:
+            rid = self._by_key.get(key)
+            if rid is not None:
+                # duplicate delivery (transport retry or injected
+                # dup): hand back the SAME request, admit nothing
+                self.counters["dup_submits"] += 1
+                return {"rid": rid, "dedup": True,
+                        "generation": self.generation}
+        kw: Dict[str, Any] = dict(max_new_tokens=int(max_new_tokens),
+                                  deadline_s=deadline_s)
+        if trace_id is not None:
+            kw["trace_id"] = trace_id
+        inner = self.engine.submit(list(prompt_ids), **kw)
+        with self._lock:
+            # lost the race to a duplicate that admitted first?
+            # (submit is serialized per connection, but loopback +
+            # dup wrapper can interleave): keep the first admission
+            prev = self._by_key.get(key)
+            if prev is not None:
+                self.counters["dup_submits"] += 1
+                rid = prev
+                dup_inner = inner
+            else:
+                self._seq += 1
+                rid = (f"{self.replica_id}.g{self.generation}"
+                       f".{self._seq}")
+                rec = {"rid": rid, "key": key, "tokens": [],
+                       "done": False, "error": None,
+                       "handle": inner, "trace_id": trace_id}
+                self._reqs[rid] = rec
+                self._by_key[key] = rid
+                dup_inner = None
+        if dup_inner is not None:
+            try:
+                dup_inner.cancel()
+            except Exception:
+                pass
+            return {"rid": rid, "dedup": True,
+                    "generation": self.generation}
+        self.counters["submits"] += 1
+        threading.Thread(target=self._pump, args=(rec,),
+                         name=f"agent-pump-{rid}",
+                         daemon=True).start()
+        return {"rid": rid, "dedup": False,
+                "generation": self.generation}
+
+    def _pump(self, rec: Dict[str, Any]) -> None:
+        """Drain the engine stream into the poll buffer."""
+        try:
+            for tok in rec["handle"].stream():
+                with self._lock:
+                    rec["tokens"].append(int(tok))
+            with self._lock:
+                rec["done"] = True
+        except BaseException as e:
+            with self._lock:
+                if rec["error"] is None:
+                    rec["error"] = wire.err(e)["error"]
+        finally:
+            done_hook = getattr(self.engine, "request_done", None)
+            if done_hook is not None:
+                try:
+                    done_hook()
+                except Exception:
+                    pass
+
+    def rpc_poll(self, rid: str, cursor: int = 0) -> Dict[str, Any]:
+        self.counters["polls"] += 1
+        with self._lock:
+            rec = self._reqs.get(rid)
+            if rec is None:
+                raise EngineShutdown(
+                    f"unknown rid {rid}: the agent re-registered "
+                    f"under a new generation (its requests were "
+                    f"fenced)")
+            cursor = max(0, int(cursor))
+            return {"tokens": rec["tokens"][cursor:],
+                    "done": rec["done"], "error": rec["error"],
+                    "generation": self.generation}
+
+    def rpc_cancel(self, rid: str) -> Dict[str, Any]:
+        with self._lock:
+            rec = self._reqs.get(rid)
+        if rec is None:
+            return {"cancelled": False}
+        try:
+            return {"cancelled": bool(rec["handle"].cancel())}
+        except Exception:
+            return {"cancelled": False}
+
+    def rpc_load_report(self) -> Dict[str, Any]:
+        rpt = dict(self.engine.load_report())
+        rpt["prefix_digest"] = sorted(rpt.get("prefix_digest", ())
+                                      or ())
+        rpt.update(replica_id=self.replica_id,
+                   generation=self.generation, fence=self.fence,
+                   state=self.state)
+        return _json_safe(rpt)
+
+    def rpc_stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"counters": dict(self.counters),
+                               "state": self.state,
+                               "generation": self.generation,
+                               "fence": self.fence}
+        eng = self.engine
+        for name in ("stats", "ttfts_s", "prefix_stats",
+                     "spec_stats", "lifecycle_stats"):
+            try:
+                v = getattr(eng, name, None)
+                v = v() if callable(v) else v
+                out[name] = _json_safe(v)
+            except Exception:
+                out[name] = None
+        if self._watchdog is not None:
+            out["watchdog"] = self._watchdog.stats()
+        return out
+
+    def rpc_drain(self, timeout_s: float = 5.0) -> Dict[str, Any]:
+        """Graceful scale-down: refuse admissions, wait for in-flight
+        work, deregister."""
+        self._draining = True
+        clean = True
+        try:
+            if hasattr(self.engine, "drain"):
+                clean = bool(self.engine.drain(timeout_s))
+        except Exception:
+            clean = False
+        deadline = self._now() + max(0.0, timeout_s)
+        while self._now() < deadline:
+            with self._lock:
+                if all(rec["done"] or rec["error"] is not None
+                       for rec in self._reqs.values()):
+                    break
+            time.sleep(0.005)
+        try:
+            self._directory.deregister(self.replica_id, self.fence)
+        except Exception:
+            clean = False
+        self.events.append("drained", data={"clean": clean})
+        return {"clean": clean}
+
+    def rpc_quiesce(self) -> Dict[str, Any]:
+        """Remote quiescence probe: the cross-process face of
+        ``faults.check_quiesced``."""
+        eng = self.engine
+        if hasattr(eng, "alloc"):
+            from ray_tpu.serve.faults import check_quiesced
+            try:
+                check_quiesced(eng)
+                return {"ok": True}
+            except AssertionError as e:
+                return {"ok": False, "error": str(e)}
+        with self._lock:
+            pending = [r for r, rec in self._reqs.items()
+                       if not rec["done"] and rec["error"] is None]
+        return {"ok": not pending,
+                "error": (f"{len(pending)} requests still in "
+                          f"flight" if pending else None)}
+
+    def rpc_fence(self, reason: str = "forced by operator"
+                  ) -> Dict[str, Any]:
+        self._self_fence(reason)
+        return {"state": self.state}
+
+    def rpc_inject_partition(self,
+                             duration_s: float) -> Dict[str, Any]:
+        """Chaos seam: cut this agent off both ways — inbound frames
+        drop (``reachable`` gate) and outbound renewals stop — for
+        ``duration_s`` seconds."""
+        self._partition_until = self._now() + float(duration_s)
+        self.events.append("partitioned",
+                           data={"duration_s": duration_s})
+        return {"until_s": duration_s}
+
+    def rpc_shutdown(self) -> Dict[str, Any]:
+        threading.Thread(target=self.shutdown, daemon=True).start()
+        return {"ok": True}
+
+    # ---------------------------------------------------- obs plumbing
+
+    def pool_stats(self) -> Dict[str, Any]:
+        """Lets ``obs.dump_flight_bundle(pool=agent)`` record the
+        agent the way it records a pool."""
+        return {"replica_id": self.replica_id, "state": self.state,
+                "generation": self.generation, "fence": self.fence,
+                "counters": dict(self.counters)}
+
+
+def _json_safe(v: Any) -> Any:
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+class AgentClient:
+    """Typed client wrapper over any transport to an agent."""
+
+    def __init__(self, transport: Transport,
+                 timeout_s: float = 5.0):
+        self._t = transport
+        self._timeout_s = timeout_s
+
+    def ping(self) -> Dict[str, Any]:
+        return self._t.call("ping", {}, timeout_s=self._timeout_s)
+
+    def submit(self, key: str, prompt_ids: List[int],
+               max_new_tokens: int,
+               deadline_s: Optional[float] = None,
+               fence: Optional[int] = None,
+               trace_id: Optional[str] = None,
+               timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        return self._t.call(
+            "submit",
+            {"key": key, "prompt_ids": list(prompt_ids),
+             "max_new_tokens": max_new_tokens,
+             "deadline_s": deadline_s, "fence": fence},
+            timeout_s=(timeout_s if timeout_s is not None
+                       else self._timeout_s),
+            trace_id=trace_id)
+
+    def poll(self, rid: str, cursor: int = 0,
+             trace_id: Optional[str] = None,
+             timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        return self._t.call(
+            "poll", {"rid": rid, "cursor": cursor},
+            timeout_s=(timeout_s if timeout_s is not None
+                       else self._timeout_s),
+            trace_id=trace_id)
+
+    def cancel(self, rid: str) -> Dict[str, Any]:
+        return self._t.call("cancel", {"rid": rid},
+                            timeout_s=self._timeout_s)
+
+    def load_report(self) -> Dict[str, Any]:
+        return self._t.call("load_report", {},
+                            timeout_s=self._timeout_s)
+
+    def stats(self) -> Dict[str, Any]:
+        return self._t.call("stats", {}, timeout_s=self._timeout_s)
+
+    def drain(self, timeout_s: float = 5.0) -> Dict[str, Any]:
+        return self._t.call("drain", {"timeout_s": timeout_s},
+                            timeout_s=timeout_s + 2.0)
+
+    def quiesce(self) -> Dict[str, Any]:
+        return self._t.call("quiesce", {},
+                            timeout_s=self._timeout_s)
+
+    def fence(self, reason: str = "forced") -> Dict[str, Any]:
+        return self._t.call("fence", {"reason": reason},
+                            timeout_s=self._timeout_s)
+
+    def inject_partition(self, duration_s: float) -> Dict[str, Any]:
+        return self._t.call("inject_partition",
+                            {"duration_s": duration_s},
+                            timeout_s=self._timeout_s)
+
+    def shutdown(self) -> Dict[str, Any]:
+        return self._t.call("shutdown", {},
+                            timeout_s=self._timeout_s)
+
+
+def _tiny_engine_factory(flight_dir: Optional[str]):
+    """The chaos harness's llama_tiny fp32 greedy engine, built
+    identically in every agent process so completions are
+    token-identical across hosts (and to the harness's reference)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import Llama, llama_tiny
+    from ray_tpu.serve.engine import LLMEngine
+
+    cfg = llama_tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+
+    def factory(generation: int) -> LLMEngine:
+        eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                        n_pages=64, chunk=4, temperature=0.0,
+                        seed=0, prefix_cache=True, eos_id=-1,
+                        admit_timeout_s=0.25,
+                        flight_dir=flight_dir)
+        eng.start()
+        # warm the jitted paths BEFORE the replica joins the fleet
+        # (a cold first dispatch looks exactly like a wedge)
+        eng.submit([3, 1, 4, 1, 5, 9, 2, 6],
+                   max_new_tokens=4).result()
+        eng.reset_latency_stats()
+        return eng
+
+    return factory
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """Subprocess entry: ``python -m ray_tpu.serve.fleet.agent
+    --replica-id r0 --directory-port N [--model fake|tiny]``. Prints
+    ``READY <port>`` once registered and warm."""
+    import argparse
+
+    from ray_tpu.serve.fleet.transport import (SocketServer,
+                                               SocketTransport)
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replica-id", required=True)
+    ap.add_argument("--generation", type=int, default=0)
+    ap.add_argument("--directory-host", default="127.0.0.1")
+    ap.add_argument("--directory-port", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--model", choices=("fake", "tiny"),
+                    default="fake")
+    ap.add_argument("--token-delay-s", type=float, default=0.002)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--stall-deadline-s", type=float, default=None)
+    ap.add_argument("--flight-dir", default=None)
+    args = ap.parse_args(argv)
+
+    if args.model == "fake":
+        def factory(generation: int) -> ScriptedEngine:
+            return ScriptedEngine(page_size=args.page_size,
+                                  token_delay_s=args.token_delay_s)
+    else:
+        factory = _tiny_engine_factory(args.flight_dir)
+
+    directory = DirectoryClient(SocketTransport(
+        (args.directory_host, args.directory_port)))
+    agent = ReplicaAgent(
+        args.replica_id, factory, directory,
+        generation=args.generation,
+        stall_deadline_s=args.stall_deadline_s,
+        flight_dir=args.flight_dir)
+    server = SocketServer(agent.handle, host=args.host,
+                          port=args.port, gate=agent.reachable)
+    agent.addr = ["tcp", server.addr[0], server.addr[1]]
+    agent.start()
+    print(f"READY {server.addr[1]}", flush=True)
+    try:
+        while not agent._stop.is_set():
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        agent.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,299 @@
+"""FleetDirectory: lease-fenced membership for the serving fleet.
+
+The control-plane half of the GCS split: a small service owning WHO
+is in the fleet, nothing else. State per member, keyed by replica id:
+
+- **generation** — the agent's incarnation counter (bumped every time
+  the agent rebuilds its engine or re-registers after being fenced).
+  A register from an OLDER generation than the directory has ever
+  seen for that id is a zombie and is rejected.
+- **fencing token** — strictly monotonic across the WHOLE directory
+  (one counter), (re)issued at registration. Every subsequent write
+  (renew, deregister) must quote it; a stale token is rejected typed
+  ``StaleFencingToken``. Agents pass their last token back as
+  ``min_fence`` when re-registering, so monotonicity survives a
+  directory crash/restart even though the table does not: the new
+  directory's counter jumps past every token it ever issued.
+- **lease** — liveness is a time-bounded claim, renewed by heartbeat.
+  An expired lease makes the member a DEATH CANDIDATE; it is only
+  removed when someone (the router) asks ``confirm_dead`` — the
+  directory never guesses, and a late renewal before confirmation
+  revives the lease (counted, for the curious).
+- **advertisements** — each renewal piggybacks the agent's prefix
+  digest and load report, which is what the router routes on.
+
+The directory holds NO request state and NO engine state, which is
+why crash/restart is cheap: agents notice ``UnknownMember`` on their
+next renewal and re-register, and the membership table rebuilds
+itself from the fleet within one lease period.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve.fleet.transport import Transport
+from ray_tpu.serve.fleet.wire import (StaleFencingToken,
+                                      UnknownMember)
+
+
+class _Member:
+    __slots__ = ("replica_id", "addr", "generation", "fence",
+                 "lease_expires", "digest", "load", "page_size",
+                 "wedged", "registered_at")
+
+    def __init__(self, replica_id: str, addr: List[Any],
+                 generation: int, fence: int, lease_expires: float,
+                 page_size: int, registered_at: float):
+        self.replica_id = replica_id
+        self.addr = addr
+        self.generation = generation
+        self.fence = fence
+        self.lease_expires = lease_expires
+        self.digest: List[int] = []
+        self.load: Dict[str, Any] = {}
+        self.page_size = page_size
+        self.wedged = False
+        self.registered_at = registered_at
+
+
+class FleetDirectory:
+    """Membership table + fencing authority. Thread-safe; exposes
+    ``handle`` as the transport handler."""
+
+    def __init__(self, lease_ttl_s: float = 1.0,
+                 time_fn=time.monotonic):
+        self.lease_ttl_s = float(lease_ttl_s)
+        self._now = time_fn
+        self._lock = threading.Lock()
+        self._members: Dict[str, _Member] = {}
+        # replica_id -> highest generation ever confirmed dead or
+        # retired; zombie registrations at or below it are rejected
+        self._tombstones: Dict[str, int] = {}
+        self._fence_counter = 0
+        self.counters = {"registers": 0, "renews": 0,
+                         "stale_fence_rejects": 0,
+                         "unknown_member_rejects": 0,
+                         "zombie_register_rejects": 0,
+                         "late_renewals": 0, "confirmed_dead": 0,
+                         "deregisters": 0, "wedges_reported": 0}
+
+    # ----------------------------------------------------- RPC surface
+
+    def handle(self, method: str, args: Dict[str, Any],
+               trace_id: Optional[str] = None) -> Any:
+        fn = getattr(self, "rpc_" + method, None)
+        if fn is None:
+            raise UnknownMember(f"directory has no method {method}")
+        return fn(**args)
+
+    def rpc_ping(self) -> Dict[str, Any]:
+        return {"ok": True, "members": len(self._members)}
+
+    def rpc_register(self, replica_id: str, addr: List[Any],
+                     generation: int, page_size: int = 0,
+                     min_fence: int = 0) -> Dict[str, Any]:
+        with self._lock:
+            tomb = self._tombstones.get(replica_id)
+            if tomb is not None and generation <= tomb:
+                self.counters["zombie_register_rejects"] += 1
+                raise StaleFencingToken(
+                    f"register of {replica_id} gen {generation} "
+                    f"rejected: generation <= {tomb} was already "
+                    f"confirmed dead")
+            cur = self._members.get(replica_id)
+            if cur is not None and generation < cur.generation:
+                self.counters["zombie_register_rejects"] += 1
+                raise StaleFencingToken(
+                    f"register of {replica_id} gen {generation} "
+                    f"rejected: gen {cur.generation} is current")
+            self._fence_counter = max(self._fence_counter,
+                                      int(min_fence)) + 1
+            fence = self._fence_counter
+            now = self._now()
+            self._members[replica_id] = _Member(
+                replica_id, list(addr), int(generation), fence,
+                now + self.lease_ttl_s, int(page_size), now)
+            self.counters["registers"] += 1
+            return {"fence": fence, "generation": int(generation),
+                    "lease_ttl_s": self.lease_ttl_s}
+
+    def rpc_renew(self, replica_id: str, fence: int,
+                  digest: Optional[List[int]] = None,
+                  load: Optional[Dict[str, Any]] = None,
+                  wedged: bool = False) -> Dict[str, Any]:
+        with self._lock:
+            m = self._members.get(replica_id)
+            if m is None:
+                self.counters["unknown_member_rejects"] += 1
+                raise UnknownMember(
+                    f"renew from unregistered {replica_id} (directory "
+                    f"restart or confirmed death); re-register")
+            if int(fence) != m.fence:
+                self.counters["stale_fence_rejects"] += 1
+                raise StaleFencingToken(
+                    f"renew of {replica_id} with fence {fence} "
+                    f"rejected: current fence is {m.fence}")
+            now = self._now()
+            if now > m.lease_expires:
+                self.counters["late_renewals"] += 1
+            m.lease_expires = now + self.lease_ttl_s
+            if digest is not None:
+                m.digest = list(digest)
+            if load is not None:
+                m.load = dict(load)
+            if wedged and not m.wedged:
+                self.counters["wedges_reported"] += 1
+            m.wedged = bool(wedged)
+            self.counters["renews"] += 1
+            return {"lease_ttl_s": self.lease_ttl_s}
+
+    def rpc_deregister(self, replica_id: str,
+                       fence: int) -> Dict[str, Any]:
+        with self._lock:
+            m = self._members.get(replica_id)
+            if m is None:
+                raise UnknownMember(f"{replica_id} not registered")
+            if int(fence) != m.fence:
+                self.counters["stale_fence_rejects"] += 1
+                raise StaleFencingToken(
+                    f"deregister of {replica_id} with fence {fence} "
+                    f"rejected: current fence is {m.fence}")
+            del self._members[replica_id]
+            self._tombstones[replica_id] = max(
+                self._tombstones.get(replica_id, -1), m.generation)
+            self.counters["deregisters"] += 1
+            return {"ok": True}
+
+    def rpc_confirm_dead(self, replica_id: str,
+                         fence: int) -> Dict[str, Any]:
+        """Adjudicate a router's suspicion. Dead means: unknown id,
+        a superseded fence (the incarnation the router talked to is
+        gone), or an expired lease (which this call then reaps). A
+        member with a live lease is NOT dead, however the transport
+        to it looked from the router's side."""
+        with self._lock:
+            m = self._members.get(replica_id)
+            if m is None:
+                return {"dead": True, "reason": "unknown"}
+            if int(fence) != m.fence:
+                return {"dead": True, "reason": "superseded",
+                        "current_fence": m.fence}
+            now = self._now()
+            if now <= m.lease_expires:
+                return {"dead": False,
+                        "lease_remaining_s":
+                            m.lease_expires - now}
+            del self._members[replica_id]
+            self._tombstones[replica_id] = max(
+                self._tombstones.get(replica_id, -1), m.generation)
+            self.counters["confirmed_dead"] += 1
+            return {"dead": True, "reason": "lease_expired",
+                    "expired_for_s": now - m.lease_expires}
+
+    def rpc_snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            now = self._now()
+            members = [{
+                "replica_id": m.replica_id, "addr": m.addr,
+                "generation": m.generation, "fence": m.fence,
+                "lease_remaining_s": m.lease_expires - now,
+                "expired": now > m.lease_expires,
+                "wedged": m.wedged, "digest": m.digest,
+                "load": m.load, "page_size": m.page_size,
+            } for m in self._members.values()]
+            return {"members": members,
+                    "fence_counter": self._fence_counter,
+                    "lease_ttl_s": self.lease_ttl_s}
+
+    def rpc_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"members": len(self._members),
+                    "fence_counter": self._fence_counter,
+                    "tombstones": dict(self._tombstones),
+                    "counters": dict(self.counters)}
+
+
+class DirectoryClient:
+    """Typed client wrapper over any transport to a directory."""
+
+    def __init__(self, transport: Transport,
+                 timeout_s: float = 2.0):
+        self._t = transport
+        self._timeout_s = timeout_s
+
+    def ping(self) -> Dict[str, Any]:
+        return self._t.call("ping", {}, timeout_s=self._timeout_s)
+
+    def register(self, replica_id: str, addr: List[Any],
+                 generation: int, page_size: int = 0,
+                 min_fence: int = 0) -> Dict[str, Any]:
+        return self._t.call(
+            "register",
+            {"replica_id": replica_id, "addr": addr,
+             "generation": generation, "page_size": page_size,
+             "min_fence": min_fence},
+            timeout_s=self._timeout_s)
+
+    def renew(self, replica_id: str, fence: int,
+              digest: Optional[List[int]] = None,
+              load: Optional[Dict[str, Any]] = None,
+              wedged: bool = False) -> Dict[str, Any]:
+        return self._t.call(
+            "renew",
+            {"replica_id": replica_id, "fence": fence,
+             "digest": digest, "load": load, "wedged": wedged},
+            timeout_s=self._timeout_s)
+
+    def deregister(self, replica_id: str,
+                   fence: int) -> Dict[str, Any]:
+        return self._t.call(
+            "deregister",
+            {"replica_id": replica_id, "fence": fence},
+            timeout_s=self._timeout_s)
+
+    def confirm_dead(self, replica_id: str,
+                     fence: int) -> Dict[str, Any]:
+        return self._t.call(
+            "confirm_dead",
+            {"replica_id": replica_id, "fence": fence},
+            timeout_s=self._timeout_s)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return self._t.call("snapshot", {},
+                            timeout_s=self._timeout_s)
+
+    def stats(self) -> Dict[str, Any]:
+        return self._t.call("stats", {}, timeout_s=self._timeout_s)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """Subprocess entry: ``python -m ray_tpu.serve.fleet.directory
+    --port N``. Prints ``READY <port>`` once listening."""
+    import argparse
+    import sys
+
+    from ray_tpu.serve.fleet.transport import SocketServer
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--lease-ttl-s", type=float, default=1.0)
+    args = ap.parse_args(argv)
+
+    directory = FleetDirectory(lease_ttl_s=args.lease_ttl_s)
+    server = SocketServer(directory.handle, host=args.host,
+                          port=args.port)
+    print(f"READY {server.addr[1]}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,590 @@
+"""FleetRouter: the pool's routing brain, re-homed over a transport.
+
+The router owns NO engines. It reads the directory's membership
+snapshot (lease-fenced members advertising prefix digests + load
+reports), runs the SAME selection policy as ``EnginePool``
+(``fleet.routing.select_candidate``: sticky → affinity/spill → P2C)
+and speaks to the chosen ``ReplicaAgent`` through a transport with
+per-call timeouts and exponential-backoff retries.
+
+Failure semantics — the PR 5/9 recovery path, stretched across
+processes:
+
+- A **transport error** is only a DEATH CANDIDATE. The router can't
+  distinguish a dead agent from a slow network, so it never judges
+  alone: it asks the directory (``confirm_dead``), which answers
+  from lease state. Alive → keep polling the same request (the agent
+  is still running it). Dead → the standard at-most-once path: zero
+  tokens delivered resubmits token-identically to another agent,
+  anything else fails typed ``EngineShutdown``.
+- **Streaming over RPC is cursor-polled**: submit returns a request
+  id, ``poll(rid, cursor)`` returns the tokens past the cursor. A
+  duplicated or retried poll re-reads instead of re-consuming, and a
+  duplicated submit is deduplicated agent-side by the router-minted
+  request key — so the transport may deliver at-least-once while the
+  fleet serves at-most-once.
+- Every **confirmed death dumps a flight bundle** (router events +
+  the directory's verdict), so a cross-process kill is explained
+  with the same evidence chain as an in-process one.
+"""
+from __future__ import annotations
+
+import collections
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.serve import obs
+from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
+                                  EngineOverloaded, EngineShutdown,
+                                  RequestCancelled)
+from ray_tpu.serve.fleet import wire
+from ray_tpu.serve.fleet.agent import AgentClient
+from ray_tpu.serve.fleet.directory import DirectoryClient
+from ray_tpu.serve.fleet.routing import (Candidate, ResubmitPolicy,
+                                         select_candidate)
+from ray_tpu.serve.fleet.transport import (Transport,
+                                           TransportError)
+
+
+class _Member:
+    """Router-side view of one directory member (one incarnation:
+    replica id + fence)."""
+
+    __slots__ = ("replica_id", "addr", "generation", "fence",
+                 "page_size", "report")
+
+    def __init__(self, m: Dict[str, Any]):
+        self.replica_id = m["replica_id"]
+        self.addr = tuple(m["addr"])
+        self.generation = int(m["generation"])
+        self.fence = int(m["fence"])
+        self.page_size = int(m.get("page_size") or 0)
+        rpt = dict(m.get("load") or {})
+        rpt["prefix_digest"] = frozenset(m.get("digest") or ())
+        rpt.setdefault("outstanding_tokens", 0)
+        rpt.setdefault("queue_depth", 0)
+        rpt.setdefault("max_queued", None)
+        rpt.setdefault("shed_retry_after_s", 0.05)
+        self.report = rpt
+
+
+class FleetRequestHandle(ResubmitPolicy):
+    """Fleet-side request handle: the pool handle's surface
+    (stream/result/cancel/done/error/ttft_s) implemented by polling
+    the serving agent, with the shared at-most-once resubmit core."""
+
+    def __init__(self, router: "FleetRouter", prompt: List[int],
+                 max_new_tokens: int, deadline_s: Optional[float],
+                 session_id: Optional[str],
+                 trace_id: Optional[str]):
+        super().__init__(prompt, max_new_tokens, deadline_s,
+                         session_id, trace_id,
+                         max_resubmits=router.max_resubmits)
+        self._router = router
+        self._member: Optional[_Member] = None
+        self._rid: Optional[str] = None
+        self._cursor = 0
+
+    # ------------------------------------------------------- consuming
+
+    def stream(self):
+        r = self._router
+        while True:
+            death_cause: Optional[BaseException] = None
+            patience = r.transport_patience_s
+            t_trouble: Optional[float] = None
+            try:
+                while True:
+                    try:
+                        resp = r._agent(self._member).poll(
+                            self._rid, cursor=self._cursor,
+                            trace_id=self._trace_id,
+                            timeout_s=r.call_timeout_s)
+                    except TransportError as e:
+                        # death candidate: the directory adjudicates
+                        verdict = r._confirm_dead(self._member, e)
+                        if verdict is True:
+                            raise
+                        now = time.monotonic()
+                        if t_trouble is None:
+                            t_trouble = now
+                        if now - t_trouble > patience:
+                            raise EngineShutdown(
+                                f"agent {self._member.replica_id} "
+                                f"unreachable for {patience:.1f}s "
+                                f"and the directory cannot confirm "
+                                f"its death") from e
+                        # alive (or inconclusive): the agent may
+                        # still be serving this request — re-poll
+                        time.sleep(r.retry_backoff_s)
+                        continue
+                    t_trouble = None
+                    if resp.get("error") is not None:
+                        # tokens riding a failed response were never
+                        # delivered — discard them so a zero-delivery
+                        # request stays eligible for resubmission
+                        wire.raise_error(resp["error"])
+                    for tok in resp["tokens"]:
+                        self._cursor += 1
+                        self._note_token(tok)
+                        yield tok
+                    if resp.get("done"):
+                        self._finished = True
+                        return
+                    time.sleep(r.poll_interval_s)
+            except GeneratorExit:
+                raise
+            except (RequestCancelled, DeadlineExceeded) as e:
+                self._fail(e)
+                raise
+            except (TransportError, EngineShutdown, EngineDraining,
+                    wire.WireError) as e:
+                # the serving incarnation is gone: confirmed dead
+                # over the transport, fenced (AgentFenced is an
+                # EngineDraining), force-killed (its raw error
+                # crosses as a WireError), or rebuilt (unknown rid)
+                death_cause = e
+            except EngineOverloaded as e:
+                self._fail(e)
+                raise
+            r._note_request_death(self._member, death_cause,
+                                  trace_id=self._trace_id)
+            if self._generated or self._cancelled:
+                raise self._partial_stream_error(
+                    self._member.replica_id,
+                    death_cause) from death_cause
+            self._resubmit(death_cause)
+
+    # ------------------------------------------------------- lifecycle
+
+    def cancel(self) -> bool:
+        self._cancelled = True
+        member, rid = self._member, self._rid
+        if member is None or rid is None:
+            return False
+        try:
+            return bool(self._router._agent(member).cancel(rid)
+                        .get("cancelled"))
+        except Exception:
+            return False
+
+    @property
+    def replica_idx(self) -> Optional[str]:
+        return (self._member.replica_id
+                if self._member is not None else None)
+
+    @property
+    def replica_tag(self) -> Optional[str]:
+        """``<replica_id>:<generation>`` of the serving agent — what
+        the HTTP proxy echoes as ``X-Replica``."""
+        if self._member is None:
+            return None
+        return f"{self._member.replica_id}:{self._member.generation}"
+
+    # -------------------------------------------------------- internal
+
+    def _resubmit(self, cause: BaseException) -> None:
+        deadline = self._check_resubmit(cause)
+        self._router._count_requeue(trace_id=self._trace_id)
+        try:
+            self._member, self._rid = self._router._submit_once(
+                self._prompt, self._mnt, deadline, self._session_id,
+                self._trace_id,
+                exclude={self._member.replica_id})
+            self._cursor = 0
+        except BaseException as e:
+            self._fail(e)
+            raise
+
+    def _attach(self, member: _Member, rid: str) -> None:
+        self._member, self._rid = member, rid
+        self._cursor = 0
+
+
+class FleetRouter:
+    """Routes requests to ReplicaAgents by the FleetDirectory's
+    advertised state. Mirrors the EnginePool submit surface so the
+    deployment layer can swap ``fleet=`` for ``num_engine_replicas``.
+
+    Parameters
+    ----------
+    directory: DirectoryClient over any transport.
+    transport_factory: ``f(addr_tuple) -> Transport`` building the
+        client leg to one agent (loopback registry or socket dial);
+        transports are cached per address.
+    call_timeout_s / submit_retries / retry_backoff_s: per-RPC
+        deadline and exponential-backoff retry (backoff doubles per
+        attempt). Retried submits reuse the SAME request key, so the
+        agent admits at most once however many frames arrive.
+    max_resubmits: per-request cap on death-triggered resubmissions.
+    snapshot_ttl_s: how long a directory snapshot is trusted before
+        re-fetching; a failed refresh falls back to the stale cache
+        (bounded staleness beats unavailability — this is what makes
+        a directory restart invisible to in-flight clients).
+    transport_patience_s: how long a request keeps re-polling an
+        unreachable agent that the directory refuses to declare dead
+        before failing typed.
+    """
+
+    def __init__(self, directory: DirectoryClient,
+                 transport_factory: Callable[[Tuple], Transport], *,
+                 seed: int = 0, call_timeout_s: float = 2.0,
+                 submit_retries: int = 2,
+                 retry_backoff_s: float = 0.02,
+                 max_resubmits: int = 3,
+                 snapshot_ttl_s: float = 0.05,
+                 poll_interval_s: float = 0.004,
+                 transport_patience_s: float = 10.0,
+                 max_sticky_sessions: int = 4096,
+                 flight_dir: Any = None):
+        self._directory = directory
+        self._transport_factory = transport_factory
+        self._rng = random.Random(seed)
+        self.call_timeout_s = float(call_timeout_s)
+        self.submit_retries = int(submit_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.max_resubmits = int(max_resubmits)
+        self.snapshot_ttl_s = float(snapshot_ttl_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.transport_patience_s = float(transport_patience_s)
+        self._max_sticky = max_sticky_sessions
+        self.flight_dir = flight_dir
+        self._lock = threading.Lock()
+        self._clients: Dict[Tuple, AgentClient] = {}
+        self._snapshot_cache: Optional[Dict[str, _Member]] = None
+        self._snapshot_t = 0.0
+        self._sticky: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
+        self._dead_seen: set = set()
+        self._seq = 0
+        self._rseq = 0
+        self.events = obs.EventLog(2048, name="router")
+        self.counters = {"routed": 0, "requeues": 0,
+                         "deaths_confirmed": 0, "suspects": 0,
+                         "confirm_inconclusive": 0,
+                         "stale_snapshots": 0, "all_shed": 0,
+                         "submit_retries": 0}
+        self._stopped = False
+
+    # --------------------------------------------------------- submit
+
+    def submit(self, prompt_ids, max_new_tokens: int = 64,
+               deadline_s: Optional[float] = None,
+               session_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> FleetRequestHandle:
+        if self._stopped:
+            raise EngineShutdown("fleet router stopped")
+        prompt = list(prompt_ids)
+        h = FleetRequestHandle(self, prompt, max_new_tokens,
+                               deadline_s, session_id, trace_id)
+        member, rid = self._submit_once(prompt, max_new_tokens,
+                                        deadline_s, session_id,
+                                        trace_id, exclude=set())
+        h._attach(member, rid)
+        return h
+
+    def _mint_key(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return f"req-{id(self):x}-{self._seq}"
+
+    def _submit_once(self, prompt: List[int], max_new_tokens: int,
+                     deadline_s: Optional[float],
+                     session_id: Optional[str],
+                     trace_id: Optional[str],
+                     exclude: set) -> Tuple[_Member, str]:
+        """Route + submit until one agent admits; typed aggregate
+        failure when nothing can (the pool's ``_submit_once``, over
+        a transport)."""
+        exclude = set(exclude)
+        shed: List[EngineOverloaded] = []
+        while True:
+            members = self._members(exclude)
+            sticky_id = (self._sticky.get(session_id)
+                         if session_id is not None else None)
+            cands = [Candidate(m.replica_id, m.report, m.page_size)
+                     for m in members.values()]
+            pick, decision = select_candidate(
+                cands, prompt, sticky_key=sticky_id, rng=self._rng)
+            if pick is None:
+                hints = list(decision.get("hints", []))
+                hints += [e.retry_after_s for e in shed]
+                if hints:
+                    self.counters["all_shed"] += 1
+                    err = EngineOverloaded(
+                        f"all live agents shed (retry hints "
+                        f"{sorted(set(round(h, 3) for h in hints))})",
+                        retry_after_s=max(hints))
+                    if shed:
+                        raise err from shed[-1]
+                    raise err
+                err2 = EngineShutdown(
+                    "no live agents in the fleet directory")
+                # an honest hint: a lease period from now is the
+                # soonest a restarted agent could re-advertise
+                snap = self._snapshot_cache
+                err2.retry_after_s = (
+                    self._lease_ttl_hint() if snap is not None
+                    else 1.0)
+                raise err2
+            member = members[pick.key]
+            key = self._mint_key()
+            try:
+                resp = self._call_with_retry(
+                    lambda c, m=member, k=key: c.submit(
+                        k, prompt, max_new_tokens,
+                        deadline_s=deadline_s, fence=m.fence,
+                        trace_id=trace_id,
+                        timeout_s=self.call_timeout_s),
+                    member)
+            except TransportError as e:
+                self._suspect(member, e)
+                verdict = self._confirm_dead(member, e)
+                if verdict is not True:
+                    # transient or unconfirmable: skip it this round
+                    self._invalidate_snapshot()
+                exclude.add(member.replica_id)
+                continue
+            except EngineOverloaded as e:
+                shed.append(e)
+                exclude.add(member.replica_id)
+                continue
+            except (EngineShutdown, EngineDraining) as e:
+                # fenced / draining / stale fence: refresh and reroute
+                self._invalidate_snapshot()
+                self._note_request_death(member, e,
+                                         trace_id=trace_id,
+                                         submit_side=True)
+                exclude.add(member.replica_id)
+                continue
+            self._record_route(member, decision, session_id,
+                               trace_id=trace_id)
+            return member, resp["rid"]
+
+    def _call_with_retry(self, fn: Callable[[AgentClient], Any],
+                         member: _Member) -> Any:
+        """Per-call timeout + exponential backoff. Only transport
+        errors retry (typed refusals are answers); the LAST error
+        propagates for the caller's suspect path."""
+        backoff = self.retry_backoff_s
+        client = self._agent(member)
+        last: Optional[TransportError] = None
+        for attempt in range(self.submit_retries + 1):
+            try:
+                return fn(client)
+            except TransportError as e:
+                last = e
+                if attempt < self.submit_retries:
+                    self.counters["submit_retries"] += 1
+                    time.sleep(backoff)
+                    backoff *= 2
+        raise last
+
+    # ------------------------------------------------------ membership
+
+    def _members(self, exclude: set) -> Dict[str, _Member]:
+        snap = self._snapshot()
+        return {rid: m for rid, m in snap.items()
+                if rid not in exclude}
+
+    def _snapshot(self) -> Dict[str, _Member]:
+        now = time.monotonic()
+        with self._lock:
+            cached = self._snapshot_cache
+            if (cached is not None
+                    and now - self._snapshot_t < self.snapshot_ttl_s):
+                return cached
+        try:
+            raw = self._directory.snapshot()
+        except Exception:
+            # directory unreachable (crashed / restarting): serve
+            # from the stale cache — bounded staleness keeps clients
+            # flowing through a directory restart
+            self.counters["stale_snapshots"] += 1
+            with self._lock:
+                return dict(self._snapshot_cache or {})
+        members: Dict[str, _Member] = {}
+        for m in raw.get("members", []):
+            if m.get("expired") or m.get("wedged"):
+                continue
+            if (m.get("load") or {}).get("state") == "fenced":
+                continue
+            mm = _Member(m)
+            rpt = mm.report
+            if rpt.get("stopped") or rpt.get("draining"):
+                continue
+            members[mm.replica_id] = mm
+        self._lease_ttl = float(raw.get("lease_ttl_s", 1.0))
+        with self._lock:
+            self._snapshot_cache = members
+            self._snapshot_t = now
+        return members
+
+    def _lease_ttl_hint(self) -> float:
+        return getattr(self, "_lease_ttl", 1.0)
+
+    def _invalidate_snapshot(self) -> None:
+        with self._lock:
+            self._snapshot_t = 0.0
+
+    def _agent(self, member: _Member) -> AgentClient:
+        with self._lock:
+            c = self._clients.get(member.addr)
+            if c is None:
+                c = AgentClient(
+                    self._transport_factory(member.addr),
+                    timeout_s=self.call_timeout_s)
+                self._clients[member.addr] = c
+            return c
+
+    # -------------------------------------------------- death handling
+
+    def _suspect(self, member: _Member, cause: BaseException) -> None:
+        self.counters["suspects"] += 1
+        self.events.append("suspect", sid=member.replica_id,
+                           data={"fence": member.fence,
+                                 "cause": type(cause).__name__})
+
+    def _confirm_dead(self, member: _Member,
+                      cause: BaseException) -> Optional[bool]:
+        """Ask the directory whether this incarnation is dead.
+        True/False on a verdict, None when the directory itself is
+        unreachable (inconclusive — NEVER grounds for a resubmit)."""
+        try:
+            v = self._directory.confirm_dead(member.replica_id,
+                                             member.fence)
+        except Exception:
+            self.counters["confirm_inconclusive"] += 1
+            return None
+        if not v.get("dead"):
+            return False
+        self._on_confirmed_death(member, v, cause)
+        return True
+
+    def _on_confirmed_death(self, member: _Member,
+                            verdict: Dict[str, Any],
+                            cause: BaseException) -> None:
+        tag = (member.replica_id, member.fence)
+        with self._lock:
+            if tag in self._dead_seen:
+                return
+            self._dead_seen.add(tag)
+            self.counters["deaths_confirmed"] += 1
+            for k in [k for k, v in self._sticky.items()
+                      if v == member.replica_id]:
+                del self._sticky[k]
+        self._invalidate_snapshot()
+        self.events.append(
+            "member_dead", sid=member.replica_id,
+            data={"fence": member.fence,
+                  "generation": member.generation,
+                  "reason": verdict.get("reason"),
+                  "cause": type(cause).__name__})
+        if self.flight_dir:
+            try:
+                obs.dump_flight_bundle(
+                    self.flight_dir,
+                    f"agent-dead-{member.replica_id}", pool=self,
+                    extra={"replica_id": member.replica_id,
+                           "fence": member.fence,
+                           "generation": member.generation,
+                           "verdict": verdict,
+                           "cause": repr(cause)})
+            except Exception:
+                pass
+
+    def _note_request_death(self, member: _Member,
+                            cause: BaseException,
+                            trace_id: Optional[str] = None,
+                            submit_side: bool = False) -> None:
+        self.events.append(
+            "replica_death", sid=member.replica_id,
+            data={"cause": type(cause).__name__,
+                  "submit_side": submit_side,
+                  "trace_id": trace_id})
+
+    def _count_requeue(self, trace_id: Optional[str] = None) -> None:
+        with self._lock:
+            self.counters["requeues"] += 1
+        self.events.append("resubmit",
+                           data={"trace_id": trace_id}
+                           if trace_id is not None else None)
+
+    def _record_route(self, member: _Member,
+                      decision: Dict[str, Any],
+                      session_id: Optional[str],
+                      trace_id: Optional[str] = None) -> None:
+        self.events.append(
+            "route", sid=member.replica_id,
+            data={"kind": decision["kind"],
+                  "pages": decision.get("pages", 0),
+                  "spilled": bool(decision.get("spilled")),
+                  "trace_id": trace_id})
+        with self._lock:
+            self.counters["routed"] += 1
+            if session_id is not None:
+                self._sticky[session_id] = member.replica_id
+                self._sticky.move_to_end(session_id)
+                while len(self._sticky) > self._max_sticky:
+                    self._sticky.popitem(last=False)
+
+    # ---------------------------------------------------- aggregation
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """Engine-surface counter mirror — deployment/bench code
+        that does ``dict(engine.stats)`` works on a router too."""
+        with self._lock:
+            return dict(self.counters)
+
+    def load_report(self) -> Dict[str, Any]:
+        """Fleet-aggregate load report (the pool's shape, summed
+        over live members' advertised reports)."""
+        members = self._snapshot()
+        out: Dict[str, Any] = {
+            "replicas": len(members), "free_slots": 0,
+            "queue_depth": 0, "outstanding_tokens": 0,
+            "draining": False, "stopped": not members}
+        for m in members.values():
+            for k in ("free_slots", "queue_depth",
+                      "outstanding_tokens"):
+                v = m.report.get(k)
+                if isinstance(v, (int, float)):
+                    out[k] += v
+        return out
+
+    def pool_stats(self) -> Dict[str, Any]:
+        """Router-side observability block (named pool_stats so
+        ``obs.dump_flight_bundle(pool=router)`` records it)."""
+        with self._lock:
+            out = {"counters": dict(self.counters),
+                   "sticky_sessions": len(self._sticky),
+                   "dead_seen": len(self._dead_seen)}
+        try:
+            out["directory"] = self._directory.stats()
+        except Exception:
+            out["directory"] = None
+        return out
+
+    def member_stats(self) -> Dict[str, Any]:
+        """Per-agent stats over the transport (loopback fleets use
+        this for deployment-level aggregation)."""
+        out = {}
+        for rid, m in self._snapshot().items():
+            try:
+                out[rid] = self._agent(m).stats()
+            except Exception:
+                out[rid] = None
+        return out
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        with self._lock:
+            clients = list(self._clients.values())
+        for c in clients:
+            try:
+                c._t.close()
+            except Exception:
+                pass

@@ -137,6 +137,19 @@ class HTTPProxy:
             payload.pop("stream", None)     # strip it from query args
             payload = payload or None
         tid = self._mint_trace_id(request, payload)
+        # X-Replica, OPT-IN like X-Trace-Id: a request header (any
+        # value) asks which replica incarnation served the call; the
+        # flag rides the dict payload to the deployment, which
+        # answers {"ids": ..., "replica": "<id>:<gen>"} — popped
+        # back out here into the response header so the JSON body
+        # stays identical to the non-opted response. Dict payloads
+        # only (same rule as trace_id injection: the proxy never
+        # invents a payload shape), unary only (a stream's replica
+        # can change mid-flight on resubmit).
+        echo_rep = (not stream and isinstance(payload, dict)
+                    and "X-Replica" in request.headers)
+        if echo_rep:
+            payload.setdefault("echo_replica", True)
         try:
             if stream:
                 return await self._dispatch_stream(request, handle,
@@ -147,9 +160,15 @@ class HTTPProxy:
             loop = asyncio.get_event_loop()
             result = await loop.run_in_executor(
                 self._pool, lambda: ray_tpu.get(ref, timeout=60))
-            headers = {"X-Trace-Id": tid} if tid else None
+            headers = {}
+            if tid:
+                headers["X-Trace-Id"] = tid
+            if echo_rep and isinstance(result, dict) \
+                    and "replica" in result:
+                headers["X-Replica"] = str(result.pop("replica"))
+                result = result.get("ids", result)
             return web.json_response({"result": result},
-                                     headers=headers)
+                                     headers=headers or None)
         except asyncio.CancelledError:
             # client disconnected mid-request (aiohttp cancels the
             # handler): there is nobody to answer — the 499-style

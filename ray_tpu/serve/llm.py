@@ -55,7 +55,9 @@ class LlamaDeployment:
                  autoscale_provider=None,
                  engine_stall_deadline_s: Optional[float] = None,
                  watchdog_interval_s: Optional[float] = None,
-                 overlap: Optional[bool] = None):
+                 overlap: Optional[bool] = None,
+                 fleet: int = 0,
+                 fleet_lease_ttl_s: float = 2.0):
         import jax
         from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
@@ -145,6 +147,28 @@ class LlamaDeployment:
         self.engine_stall_deadline_s = engine_stall_deadline_s
         self.watchdog_interval_s = watchdog_interval_s
         self._watchdog = None
+        # Fleet control plane (serve/fleet/): fleet=N swaps the
+        # in-process EnginePool for a loopback fleet — a
+        # FleetDirectory, N lease-renewing ReplicaAgents (one engine
+        # each), and a FleetRouter as the deployment's engine
+        # object. Same routing/resubmit core as the pool, but every
+        # replica sits behind the transport seam and the
+        # lease/fencing state machine, so deployment-level tests
+        # exercise exactly the control plane the cross-process
+        # harness (tools/chaos_serve.py --fleet) kills for real.
+        if fleet < 0:
+            raise ValueError("fleet must be >= 0")
+        if fleet and num_engine_replicas > 1:
+            raise ValueError(
+                "fleet= and num_engine_replicas>1 are exclusive — "
+                "the fleet IS the replica set")
+        if fleet and autoscale:
+            raise ValueError("fleet does not support autoscale yet "
+                             "(the autoscaler drives EnginePool)")
+        self.fleet = int(fleet)
+        self.fleet_lease_ttl_s = float(fleet_lease_ttl_s)
+        self._fleet_agents: Dict[str, Any] = {}
+        self._fleet_directory = None
         self._engine_opts = dict(
             max_slots=max_slots, page_size=page_size,
             n_pages=n_pages, chunk=decode_chunk or stream_chunk,
@@ -208,7 +232,40 @@ class LlamaDeployment:
                         self.cfg, tp=self.tensor_parallel,
                         ep=self.expert_parallel, devices=group)
 
-                if self.num_engine_replicas > 1 or self.autoscale:
+                if self.fleet:
+                    from ray_tpu.serve.fleet.agent import ReplicaAgent
+                    from ray_tpu.serve.fleet.directory import (
+                        DirectoryClient, FleetDirectory)
+                    from ray_tpu.serve.fleet.router import FleetRouter
+                    from ray_tpu.serve.fleet.transport import (
+                        LoopbackTransport)
+                    self._fleet_directory = FleetDirectory(
+                        lease_ttl_s=self.fleet_lease_ttl_s)
+                    dc = DirectoryClient(LoopbackTransport(
+                        self._fleet_directory.handle))
+                    agents = self._fleet_agents
+
+                    def tf(addr):
+                        # loopback addr = ["loopback", replica_id]
+                        return LoopbackTransport(agents[addr[1]].handle)
+
+                    for i in range(self.fleet):
+                        rid = f"r{i}"
+
+                        def factory(gen, _i=i, _opts=opts):
+                            return LLMEngine(
+                                self.model, self.params,
+                                temperature=self.temperature,
+                                seed=_i,
+                                sharding=_replica_sharding(_i),
+                                **_opts)
+
+                        agents[rid] = ReplicaAgent(
+                            rid, factory, dc,
+                            stall_deadline_s=(
+                                self.engine_stall_deadline_s)).start()
+                    self._engine = FleetRouter(dc, tf)
+                elif self.num_engine_replicas > 1 or self.autoscale:
                     from ray_tpu.serve.engine_pool import EnginePool
 
                     def factory(idx, _opts=opts):
@@ -266,6 +323,17 @@ class LlamaDeployment:
         if not self.use_engine or self._engine is None:
             return {"engine": None}
         eng = self._engine
+        if self.fleet:
+            # FleetRouter: members are behind the transport seam, so
+            # the aggregate comes from their ADVERTISED reports (the
+            # directory snapshot), not from reaching into engine
+            # locks — the same information a remote router would
+            # have.
+            out = dict(eng.load_report())
+            out.update(consistent=False,
+                       max_queued=self._engine_opts["max_queued"],
+                       fleet=eng.pool_stats())
+            return {"engine": out}
         from ray_tpu.serve.engine_pool import EnginePool
         if isinstance(eng, EnginePool):
             out: dict = dict(eng.stats)
@@ -363,18 +431,35 @@ class LlamaDeployment:
 
     def _submit(self, ids, mnt, dl, sid=None, tid=None):
         kw: Dict[str, Any] = dict(max_new_tokens=mnt, deadline_s=dl)
-        if sid is not None and self.num_engine_replicas > 1:
+        if sid is not None and (self.num_engine_replicas > 1
+                                or self.fleet):
             kw["session_id"] = sid
         if tid is not None:
             kw["trace_id"] = tid
         return self.engine().submit(ids, **kw)
 
     def __call__(self, prompt_ids: List[int]) -> List[int]:
-        """One request: token ids in, prompt+generated ids out."""
+        """One request: token ids in, prompt+generated ids out.
+
+        A dict payload with ``"echo_replica": true`` (injected by the
+        HTTP proxy when the client sends an ``X-Replica`` request
+        header) gets ``{"ids": [...], "replica": "<id>:<gen>"}``
+        back instead of the bare list — the tag names which replica
+        incarnation actually served the request (pool ``idx:gen``,
+        fleet ``replica_id:generation``, single engine ``0:0``), so
+        a client can see a failover land on a different
+        incarnation."""
         if self.use_engine:
             ids, mnt, dl, sid, tid = self._request_args(prompt_ids)
-            gen = self._submit(ids, mnt, dl, sid, tid).result()
-            return list(ids) + gen
+            h = self._submit(ids, mnt, dl, sid, tid)
+            gen = h.result()
+            out = list(ids) + gen
+            if isinstance(prompt_ids, dict) \
+                    and prompt_ids.get("echo_replica"):
+                return {"ids": out,
+                        "replica": getattr(h, "replica_tag", None)
+                        or "0:0"}
+            return out
         import jax.numpy as jnp
         from ray_tpu.models.llama import generate
         prompt = jnp.asarray([prompt_ids], jnp.int32)

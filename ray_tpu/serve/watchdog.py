@@ -290,3 +290,110 @@ class PoolWatchdog:
         out["suspect_after_s"] = self.suspect_after_s
         out["poll_interval_s"] = self.poll_interval_s
         return out
+
+
+class AgentWatchdog:
+    """Agent-local watchdog: the same progress ladder as
+    ``PoolWatchdog``, scoped to the ONE engine a ``ReplicaAgent``
+    owns. In the fleet split the pool-side watchdog cannot see across
+    the process boundary, so each agent watches its own engine and
+    REPORTS the verdict outward: on a wedge it dumps the flight
+    bundle, force-kills the engine (same out-of-band, lock-free kill)
+    and invokes ``on_wedge(err)`` — the agent flags ``wedged=True``
+    on its next lease renewal so the directory (and through it the
+    router) learns of the wedge without ever probing the engine."""
+
+    def __init__(self, get_engine: Callable[[], Any],
+                 on_wedge: Callable[[BaseException], None], *,
+                 stall_deadline_s: float = 5.0,
+                 poll_interval_s: Optional[float] = None,
+                 time_fn: Callable[[], float] = time.monotonic,
+                 flight_dir: Any = None):
+        if stall_deadline_s <= 0:
+            raise ValueError("stall_deadline_s must be > 0")
+        self._get_engine = get_engine
+        self._on_wedge = on_wedge
+        self.stall_deadline_s = float(stall_deadline_s)
+        self.poll_interval_s = (float(poll_interval_s)
+                                if poll_interval_s is not None
+                                else max(0.01,
+                                         self.stall_deadline_s / 8))
+        if flight_dir is None:
+            flight_dir = obs.default_flight_dir()
+        self.flight_dir: Optional[str] = flight_dir or None
+        self._time = time_fn
+        self.counts: Dict[str, int] = {"ticks": 0, "wedged": 0}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def tick(self) -> Optional[ReplicaWedged]:
+        """One probe; returns the escalation when it fires."""
+        self.counts["ticks"] += 1
+        eng = self._get_engine()
+        if eng is None or getattr(eng, "_stopped", False):
+            return None
+        try:
+            rpt = eng.load_report()
+        except Exception:
+            return None
+        hb_age = rpt.get("heartbeat_age_s")
+        if (not rpt.get("has_work") or hb_age is None
+                or hb_age < self.stall_deadline_s):
+            return None
+        err = ReplicaWedged(
+            f"agent engine wedged: no scheduler progress for "
+            f"{hb_age:.2f}s (stall deadline "
+            f"{self.stall_deadline_s}s); force-killed by the agent "
+            f"watchdog")
+        if self.flight_dir is not None:
+            try:
+                err.bundle_path = obs.dump_flight_bundle(
+                    self.flight_dir, "wedged-agent", engine=eng,
+                    extra={"heartbeat_age_s": round(hb_age, 4),
+                           "stall_deadline_s":
+                               self.stall_deadline_s})
+            except Exception:
+                err.bundle_path = None
+        try:
+            eng.force_kill(err)
+        except Exception:
+            pass
+        self.counts["wedged"] += 1
+        try:
+            self._on_wedge(err)
+        except Exception:
+            pass
+        return err
+
+    def run(self, interval_s: Optional[float] = None
+            ) -> "AgentWatchdog":
+        if self._thread is None:
+            self._stop.clear()
+            interval = (float(interval_s) if interval_s is not None
+                        else self.poll_interval_s)
+
+            def loop():
+                while not self._stop.is_set():
+                    try:
+                        self.tick()
+                    except Exception:
+                        pass
+                    self._stop.wait(interval)
+
+            self._thread = threading.Thread(
+                target=loop, name="agent-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+            self._thread = None
+
+    def stats(self) -> Dict[str, Any]:
+        out = dict(self.counts)
+        out["stall_deadline_s"] = self.stall_deadline_s
+        out["poll_interval_s"] = self.poll_interval_s
+        return out

@@ -181,7 +181,8 @@ def make_server(cfg, knobs, use_engine=True):
                 n_pages=knobs.get("kv_pages"),
                 eos_id=knobs.get("eos_id"),
                 num_engine_replicas=knobs.get("replicas", 1),
-                tensor_parallel=knobs.get("tp", 1))
+                tensor_parallel=knobs.get("tp", 1),
+                fleet=knobs.get("fleet", 0))
 
         def __call__(self, prompt):
             # joins the engine's decode batch at the next chunk
@@ -459,6 +460,21 @@ def run_path(args, knobs, use_engine):
                                  timeout=60)
                 if ps:
                     result["pool"] = ps
+            except Exception:
+                pass
+        if knobs.get("fleet"):
+            # the stamp a SERVE_FLEET_CHAOS artifact carries, minus
+            # process separation: a bench fleet runs loopback
+            result["topology"] = {
+                "agents": knobs["fleet"],
+                "transport": "loopback",
+                "processes": {"directory": "in-process",
+                              "agents": "in-process"}}
+            try:
+                ps = ray_tpu.get(handle.engine_pool_stats.remote(),
+                                 timeout=60)
+                if ps:
+                    result["fleet"] = ps
             except Exception:
                 pass
         if knobs["prefix_cache"]:
@@ -1603,6 +1619,13 @@ def main():
                          "(EnginePool). With --ab runs pool-vs-single "
                          "A/B on the same load and adds a replica-kill "
                          "recovery phase to the artifact")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="back the deployment with a loopback fleet "
+                         "of N lease-renewing replica agents behind "
+                         "a FleetRouter (serve/fleet/) instead of an "
+                         "in-process EnginePool; the fleet topology "
+                         "is stamped into the artifact. Exclusive "
+                         "with --replicas > 1")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel width per engine replica "
                          "(serve/sharding.py: Megatron-sharded "
@@ -1709,7 +1732,7 @@ def main():
                  prompt_order=args.prompt_order,
                  replicas=args.replicas, kv_pages=args.kv_pages,
                  eos_id=args.eos_id, max_seq_len=args.max_seq_len,
-                 seed=args.seed, tp=args.tp)
+                 seed=args.seed, tp=args.tp, fleet=args.fleet)
 
     import os
     if (args.tp > 1 or args.tp_ab) \
@@ -1796,6 +1819,21 @@ def main():
     if args.lifecycle:
         result = _stamp(run_lifecycle(args, knobs), args)
         out = args.out or "SERVE_BENCH_lifecycle_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result))
+        ray_tpu.shutdown()
+        return
+
+    if args.fleet:
+        # One engine-path run with the deployment backed by the
+        # loopback fleet control plane (LlamaDeployment fleet=N):
+        # same bench load as a pool run, the delta is every request
+        # crossing the lease/fencing state machine and the transport
+        # seam. run_path stamps the fleet topology into the result.
+        result = _stamp(run_path(args, knobs, use_engine=True),
+                        args, replicas=args.fleet)
+        out = args.out or "SERVE_BENCH_fleet_cpu_smoke.json"
         with open(out, "w") as f:
             json.dump(result, f, indent=1)
         print(json.dumps(result))

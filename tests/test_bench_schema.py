@@ -929,3 +929,104 @@ def test_serve_trace_rejects_missing_overhead_fields(tmp_path):
     del bad["overhead"]["ratio"]
     probs = _problems_for("SERVE_TRACE_x.json", bad, tmp_path)
     assert any("overhead" in p and "ratio" in p for p in probs)
+
+
+# ---------------------------------------------------------------------------
+# SERVE_FLEET_CHAOS family (tools/chaos_serve.py --fleet artifacts)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_chaos_ok():
+    return {
+        "seed": 47,
+        "topology": {"agents": 3, "transport": "tcp-json-v1",
+                     "processes": {"directory": 1,
+                                   "agents_spawned": 4},
+                     "model": "fake", "lease_ttl_s": 1.0},
+        "knobs": {"duration_s": 4.0},
+        "schedule": [{"kind": "kill_agent", "at_s": 0.9,
+                      "fired": True}],
+        "injected": {"kill_agent": 1, "partition": 1,
+                     "directory_restart": 1},
+        "requests": {"admitted": 250, "completed": 246,
+                     "failed_typed": 2, "lost": 0, "mismatched": 0,
+                     "shed": 9, "resubmitted_ok": 2},
+        "attainment": 0.98, "attainment_floor": 0.5,
+        "flight_recorder": {"bundles": 5,
+                            "kill_explained": True,
+                            "partition_explained": True,
+                            "directory_restart_explained": True,
+                            "faults_explained": True},
+        "quiesced": True, "wall_s": 5.1, "git_sha": "abc1234",
+    }
+
+
+def test_fleet_chaos_valid_artifact_passes(tmp_path):
+    assert _problems_for("SERVE_FLEET_CHAOS_x.json",
+                         _fleet_chaos_ok(), tmp_path) == []
+
+
+def test_fleet_chaos_rejects_lost_or_mismatched(tmp_path):
+    bad = _fleet_chaos_ok()
+    bad["requests"]["lost"] = 1
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("LOST" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    bad["requests"]["mismatched"] = 2
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("mismatched" in p for p in probs)
+
+
+def test_fleet_chaos_rejects_missing_seed_or_topology(tmp_path):
+    bad = _fleet_chaos_ok()
+    del bad["seed"]
+    assert any("seed" in p for p in _problems_for(
+        "SERVE_FLEET_CHAOS_x.json", bad, tmp_path))
+    bad = _fleet_chaos_ok()
+    del bad["topology"]
+    assert any("topology" in p for p in _problems_for(
+        "SERVE_FLEET_CHAOS_x.json", bad, tmp_path))
+    bad = _fleet_chaos_ok()
+    del bad["topology"]["processes"]
+    assert any("processes" in p for p in _problems_for(
+        "SERVE_FLEET_CHAOS_x.json", bad, tmp_path))
+    bad = _fleet_chaos_ok()
+    bad["topology"]["agents"] = 1   # one agent proves no failover
+    assert _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+
+
+def test_fleet_chaos_rejects_unfired_fault_kind(tmp_path):
+    bad = _fleet_chaos_ok()
+    bad["injected"]["directory_restart"] = 0
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("directory_restart" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    del bad["injected"]["partition"]
+    assert any("partition" in p for p in _problems_for(
+        "SERVE_FLEET_CHAOS_x.json", bad, tmp_path))
+
+
+def test_fleet_chaos_rejects_unexplained_fault(tmp_path):
+    bad = _fleet_chaos_ok()
+    bad["flight_recorder"]["partition_explained"] = False
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("partition" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    del bad["flight_recorder"]
+    assert any("flight_recorder" in p for p in _problems_for(
+        "SERVE_FLEET_CHAOS_x.json", bad, tmp_path))
+
+
+def test_fleet_chaos_rejects_no_resubmit_proof_or_unquiesced(tmp_path):
+    bad = _fleet_chaos_ok()
+    bad["requests"]["resubmitted_ok"] = 0
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("resubmit" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    bad["quiesced"] = False
+    assert any("quiesce" in p for p in _problems_for(
+        "SERVE_FLEET_CHAOS_x.json", bad, tmp_path))
+    bad = _fleet_chaos_ok()
+    bad["attainment"] = 0.4     # below its own recorded floor
+    assert any("floor" in p for p in _problems_for(
+        "SERVE_FLEET_CHAOS_x.json", bad, tmp_path))

@@ -188,6 +188,59 @@ def test_http_proxy(serve_rt):
         stop_http()
 
 
+def test_http_proxy_x_replica_header(serve_rt):
+    """Opt-in X-Replica: a request header asks which replica
+    incarnation served the call; the proxy injects the echo flag
+    into dict payloads, pops the deployment's answer into the
+    response header, and keeps the JSON body identical to the
+    non-opted response. No opt-in (or a deployment that ignores the
+    flag) -> no header, payload untouched."""
+    import urllib.request
+    import json as _json
+    from ray_tpu.serve.http_proxy import start_http, stop_http
+
+    @serve.deployment
+    def rep(payload):
+        if isinstance(payload, dict) and payload.get("echo_replica"):
+            return {"ids": [1, 2], "replica": "r1:3"}
+        return [1, 2]
+
+    @serve.deployment
+    def plain(payload):
+        return {"echoed": payload}
+
+    serve.run(rep.bind())
+    serve.run(plain.bind())
+    proxy = start_http(port=0)
+    try:
+        def post(path, body, replica_header):
+            headers = {"Content-Type": "application/json"}
+            if replica_header:
+                headers["X-Replica"] = "1"
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{proxy.port}/{path}",
+                method="POST", data=_json.dumps(body).encode(),
+                headers=headers)
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return (resp.headers.get("X-Replica"),
+                        _json.loads(resp.read()))
+
+        # opted in: header echoed, body bare (identical to no-opt)
+        hdr, body = post("rep", {"prompt_ids": [0]}, True)
+        assert hdr == "r1:3"
+        assert body == {"result": [1, 2]}
+        # not opted in: payload untouched, no header
+        hdr, body = post("rep", {"prompt_ids": [0]}, False)
+        assert hdr is None and body == {"result": [1, 2]}
+        # opted in but the deployment ignores the flag: the proxy
+        # must not invent a header
+        hdr, body = post("plain", {"msg": "hi"}, True)
+        assert hdr is None
+        assert body["result"]["echoed"]["msg"] == "hi"
+    finally:
+        stop_http()
+
+
 def test_llama_llm_deployment(serve_rt):
     """North-star path: Llama JAX replicas behind serve (tiny config)."""
     from ray_tpu.serve.llm import LlamaDeployment

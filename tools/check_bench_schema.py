@@ -79,6 +79,16 @@ family:
   did not quiesce leak-free, or when the seed or the mesh stamp is
   missing (irreproducible chaos is an anecdote, not a test).
 
+- SERVE_FLEET_CHAOS_*.json (tools/chaos_serve.py --fleet): the same
+  seeded campaign re-run against the distributed fleet control plane
+  (serve/fleet/) with every replica a real OS process behind a
+  socket transport. REFUSED when any admitted request was lost or
+  mismatched, when the campaign never fired one of its fault kinds
+  (agent SIGKILL / partition / directory crash-restart), when any
+  injected fault lacks a flight-bundle explanation, when no request
+  completed via the token-identical resubmit path, when the fleet
+  failed to quiesce, or when the seed / topology stamp is missing.
+
 Engine serve results may also carry a `lifecycle` block
 (engine.lifecycle_stats()): retry-policy knobs
 (max_queued/max_retries/retry_backoff_s) + request-lifecycle
@@ -87,8 +97,9 @@ present.
 
 Usage: python tools/check_bench_schema.py [FILES...]
        (no FILES: validates every SERVE_BENCH_*.json / BENCH_*.json /
-       TRAIN_CHAOS_*.json / SERVE_CHAOS_*.json / SERVE_TRACE_*.json
-       in the repo root)
+       TRAIN_CHAOS_*.json / SERVE_CHAOS_*.json /
+       SERVE_FLEET_CHAOS_*.json / SERVE_TRACE_*.json in the repo
+       root)
 Exit 0 when every file validates; 1 otherwise, listing each problem.
 """
 import glob
@@ -239,6 +250,27 @@ SERVE_CHAOS_REQUESTS_REQUIRED = {
     "lost": int,
     "mismatched": int,
     "shed": int,
+}
+
+# fleet-chaos artifacts (tools/chaos_serve.py --fleet): the
+# cross-process campaign — replica agents as real OS processes behind
+# the lease-fenced fleet control plane. Topology, fault counts, and
+# the per-fault flight-bundle explanations are validated separately.
+FLEET_CHAOS_REQUIRED = {
+    "seed": int,
+    "attainment": NUM,
+    "attainment_floor": NUM,
+    "wall_s": NUM,
+}
+
+FLEET_CHAOS_REQUESTS_REQUIRED = {
+    "admitted": int,
+    "completed": int,
+    "failed_typed": int,
+    "lost": int,
+    "mismatched": int,
+    "shed": int,
+    "resubmitted_ok": int,
 }
 
 BENCH_WRAPPER_REQUIRED = {
@@ -916,6 +948,124 @@ def check_serve_chaos(obj, name, problems):
         problems.append(f"{name}: git_sha must be a string")
 
 
+def check_fleet_chaos(obj, name, problems):
+    """tools/chaos_serve.py --fleet artifact: the seeded chaos
+    campaign re-run with replicas as real OS processes behind the
+    fleet control plane (serve/fleet/). The checker REFUSES
+    artifacts whose run violated the cross-process availability
+    contract — any lost or mismatched admitted request, a campaign
+    that never fired one of its fault kinds (agent SIGKILL,
+    partition, directory crash/restart), any injected fault without
+    a flight-bundle explanation, a fleet that failed to quiesce, or
+    a missing seed/topology stamp."""
+    _check_fields(obj, FLEET_CHAOS_REQUIRED, name, problems)
+    topo = obj.get("topology")
+    if not isinstance(topo, dict):
+        problems.append(f"{name}: fleet artifact missing the "
+                        "'topology' stamp")
+    else:
+        n = topo.get("agents")
+        if not isinstance(n, int) or isinstance(n, bool) or n < 2:
+            problems.append(
+                f"{name}:topology: 'agents' must be an int >= 2 "
+                "(a one-agent fleet proves nothing about failover)")
+        if not isinstance(topo.get("transport"), str):
+            problems.append(f"{name}:topology: missing 'transport' "
+                            "stamp")
+        if not isinstance(topo.get("processes"), dict):
+            problems.append(f"{name}:topology: missing 'processes' "
+                            "stamp (the campaign must record that "
+                            "replicas ran as separate OS processes)")
+    inj = obj.get("injected")
+    if not isinstance(inj, dict):
+        problems.append(f"{name}: fleet artifact missing the "
+                        "'injected' fault-count object")
+    else:
+        for kind, n in inj.items():
+            if not isinstance(n, int) or isinstance(n, bool):
+                problems.append(f"{name}:injected: count for "
+                                f"{kind!r} must be int")
+        for kind in ("kill_agent", "partition", "directory_restart"):
+            n = inj.get(kind)
+            if not isinstance(n, int) or isinstance(n, bool) \
+                    or n < 1:
+                problems.append(
+                    f"{name}: campaign never fired a {kind!r} fault "
+                    "— the artifact proves nothing about it")
+    sched = obj.get("schedule")
+    if not isinstance(sched, list) or not sched:
+        problems.append(f"{name}: schedule must be a non-empty list")
+    req = obj.get("requests")
+    if not isinstance(req, dict):
+        problems.append(f"{name}: fleet artifact missing the "
+                        "'requests' outcome ledger")
+    else:
+        _check_fields(req, FLEET_CHAOS_REQUESTS_REQUIRED,
+                      f"{name}:requests", problems)
+        lost = req.get("lost")
+        if isinstance(lost, int) and not isinstance(lost, bool) \
+                and lost != 0:
+            problems.append(
+                f"{name}: {lost} admitted request(s) LOST — every "
+                "admitted request must complete token-identically "
+                "or fail typed, across process boundaries")
+        mm = req.get("mismatched")
+        if isinstance(mm, int) and not isinstance(mm, bool) \
+                and mm != 0:
+            problems.append(
+                f"{name}: {mm} completion(s) mismatched the "
+                "reference — cross-process failover was not "
+                "token-identical")
+        adm = req.get("admitted")
+        if isinstance(adm, int) and not isinstance(adm, bool) \
+                and adm <= 0:
+            problems.append(f"{name}: campaign admitted zero "
+                            "requests — the fleet served no load")
+        rs = req.get("resubmitted_ok")
+        if isinstance(rs, int) and not isinstance(rs, bool) \
+                and rs < 1:
+            problems.append(
+                f"{name}: no request completed via the resubmit "
+                "path — the campaign never proved token-identical "
+                "failover")
+    att = obj.get("attainment")
+    floor = obj.get("attainment_floor")
+    if isinstance(att, NUM) and not isinstance(att, bool) \
+            and isinstance(floor, NUM) and not isinstance(floor, bool) \
+            and att < floor:
+        problems.append(
+            f"{name}: attainment {att} is below the run's own "
+            f"recorded floor {floor}")
+    if obj.get("quiesced") is not True:
+        problems.append(f"{name}: fleet did not quiesce leak-free "
+                        "after the campaign")
+    # the flight recorder block is REQUIRED for fleet campaigns:
+    # every injected fault must carry its explanation
+    fr = obj.get("flight_recorder")
+    if not isinstance(fr, dict):
+        problems.append(f"{name}: fleet artifact missing the "
+                        "'flight_recorder' block")
+    else:
+        n = fr.get("bundles")
+        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+            problems.append(
+                f"{name}:flight_recorder: campaign collected no "
+                "flight bundles")
+        for key, what in (
+                ("kill_explained", "agent SIGKILL"),
+                ("partition_explained", "partition self-fence"),
+                ("directory_restart_explained",
+                 "directory crash/restart"),
+                ("faults_explained", "complete fault set")):
+            if fr.get(key) is not True:
+                problems.append(
+                    f"{name}:flight_recorder: no bundle explains "
+                    f"the injected {what}")
+    sha = obj.get("git_sha")
+    if sha is not None and not isinstance(sha, str):
+        problems.append(f"{name}: git_sha must be a string")
+
+
 SERVE_TRACE_REQUIRED = {
     "requests": dict,
     "events": list,
@@ -1037,6 +1187,8 @@ def check_file(path, problems):
         return
     if name.startswith("TRAIN_CHAOS"):
         check_train_chaos(obj, name, problems)
+    elif name.startswith("SERVE_FLEET_CHAOS"):
+        check_fleet_chaos(obj, name, problems)
     elif name.startswith("SERVE_CHAOS"):
         check_serve_chaos(obj, name, problems)
     elif name.startswith("SERVE_TRACE"):
@@ -1059,6 +1211,8 @@ def main(argv):
                                               "TRAIN_CHAOS_*.json")) +
                        glob.glob(os.path.join(root,
                                               "SERVE_CHAOS_*.json")) +
+                       glob.glob(os.path.join(root,
+                                              "SERVE_FLEET_CHAOS_*.json")) +
                        glob.glob(os.path.join(root,
                                               "SERVE_TRACE_*.json")))
     if not files:
