@@ -72,11 +72,28 @@ KINDS = ("kill", "hang", "slow", "readback", "stockout",
 #                      gate + outbound renew skip) — it must SELF-FENCE
 #                      when its lease lapses so it can never
 #                      double-serve a request the router resubmitted
-# ``directory_restart``  SIGKILL the directory and restart it on the
-#                      same port — membership recovers from agent
-#                      re-advertisement; clients must not notice
+# ``directory_restart``  SIGKILL the current primary and restart it
+#                      on the same port + data dir — membership
+#                      recovers from the WAL/snapshot (not from agent
+#                      re-advertisement); clients must not notice
+# ``primary_kill``     SIGKILL the primary PERMANENTLY — the hot
+#                      standby must promote (epoch bump folded into
+#                      the fence counter so no token regresses) and a
+#                      post-failover canary must complete
+#                      token-identically through the promoted
+#                      directory
+# ``torn_wal_restart``  SIGKILL the current primary, append a TORN
+#                      half-record to its WAL (the crash-mid-write
+#                      case), restart — the tail must be detected and
+#                      truncated, never replayed, and membership must
+#                      still recover
+# ``autoscale_churn``  a FleetCapacityProvider spawns a real agent
+#                      process mid-campaign (spawn -> register ->
+#                      warm), the router harvests it, then drains +
+#                      retires it while load continues
 # ==================   =================================================
-FLEET_KINDS = ("kill_agent", "partition", "directory_restart")
+FLEET_KINDS = ("kill_agent", "partition", "directory_restart",
+               "primary_kill", "torn_wal_restart", "autoscale_churn")
 
 
 @dataclasses.dataclass

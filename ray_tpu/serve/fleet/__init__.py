@@ -13,6 +13,13 @@ processes behind a pluggable transport.
 - ``routing``: the selection + resubmit core shared with EnginePool.
 - ``wire``: the JSON wire schema (envelopes carry trace ids so
   ``obs.request_phases()`` still reconstructs end-to-end).
+- ``wal``: crash-durable directory state — append-only checksummed
+  WAL + atomic-rename snapshots (PR 7 torn-file discipline).
+- ``replication``: hot-standby delta streaming, standby promotion
+  with epoch-folded fencing, and the ordered-endpoint failover
+  client routers/agents hold.
+- ``provider``: fleet-integrated autoscaler capacity — tickets that
+  spawn/retire real agent processes (or loopback agents in-process).
 
 Attribute access is lazy (PEP 562): ``engine_pool`` imports
 ``fleet.routing`` for the shared core, while ``fleet.agent`` imports
@@ -30,7 +37,12 @@ _EXPORTS = {
     "SocketServer": "transport", "FaultyTransport": "transport",
     "TransportError": "transport", "TransportTimeout": "transport",
     "AgentFenced": "wire", "StaleFencingToken": "wire",
-    "UnknownMember": "wire",
+    "UnknownMember": "wire", "NotPrimary": "wire",
+    "DirectoryWAL": "wal",
+    "Replicator": "replication", "StandbyMonitor": "replication",
+    "FailoverDirectoryClient": "replication",
+    "FleetCapacityProvider": "provider",
+    "LoopbackAgentProvider": "provider",
 }
 
 __all__ = sorted(_EXPORTS)

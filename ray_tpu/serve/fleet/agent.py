@@ -180,6 +180,7 @@ class ReplicaAgent:
                  renew_period_s: Optional[float] = None,
                  stall_deadline_s: Optional[float] = None,
                  flight_dir: Any = None,
+                 register_patience_s: float = 60.0,
                  time_fn: Callable[[], float] = time.monotonic):
         self.replica_id = replica_id
         self._factory = engine_factory
@@ -188,6 +189,7 @@ class ReplicaAgent:
                                                    replica_id]
         self.generation = int(generation)
         self._renew_period_s = renew_period_s
+        self._register_patience_s = float(register_patience_s)
         self._stall_deadline_s = stall_deadline_s
         self.flight_dir = flight_dir
         self._now = time_fn
@@ -220,7 +222,22 @@ class ReplicaAgent:
             self.engine = self._factory(self.generation)
             if hasattr(self.engine, "start"):
                 self.engine.start()
-        self._register(min_fence=0)
+        # the control plane may be mid-failover at boot (old primary
+        # dead, standby not yet promoted): every endpoint then answers
+        # TransportError or NotPrimary. That is a TRANSIENT condition
+        # — retry through it. Typed rejections (tombstoned
+        # generation) are permanent and propagate immediately.
+        deadline = self._now() + self._register_patience_s
+        while True:
+            try:
+                self._register(min_fence=0)
+                break
+            except (wire.StaleFencingToken, wire.AgentFenced):
+                raise
+            except Exception:   # noqa: BLE001
+                if self._now() >= deadline:
+                    raise
+                self._stop.wait(0.2)
         if self._renew_thread is None:
             self._renew_thread = threading.Thread(
                 target=self._renew_loop,
@@ -765,7 +782,12 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--replica-id", required=True)
     ap.add_argument("--generation", type=int, default=0)
     ap.add_argument("--directory-host", default="127.0.0.1")
-    ap.add_argument("--directory-port", type=int, required=True)
+    ap.add_argument("--directory-port", type=int, default=None)
+    ap.add_argument("--directory", action="append", default=None,
+                    metavar="HOST:PORT",
+                    help="ordered directory endpoint (repeatable: "
+                         "primary first, then standbys; the agent "
+                         "fails over client-side)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--model", choices=("fake", "tiny"),
@@ -783,8 +805,21 @@ def main(argv: Optional[List[str]] = None) -> None:
     else:
         factory = _tiny_engine_factory(args.flight_dir)
 
-    directory = DirectoryClient(SocketTransport(
-        (args.directory_host, args.directory_port)))
+    endpoints = []
+    for spec in (args.directory or []):
+        host, _, port = spec.rpartition(":")
+        endpoints.append((host or "127.0.0.1", int(port)))
+    if not endpoints:
+        if args.directory_port is None:
+            ap.error("need --directory or --directory-port")
+        endpoints = [(args.directory_host, args.directory_port)]
+    if len(endpoints) == 1:
+        directory = DirectoryClient(SocketTransport(endpoints[0]))
+    else:
+        from ray_tpu.serve.fleet.replication import (
+            FailoverDirectoryClient)
+        directory = FailoverDirectoryClient(
+            [SocketTransport(ep) for ep in endpoints])
     agent = ReplicaAgent(
         args.replica_id, factory, directory,
         generation=args.generation,

@@ -11,9 +11,9 @@ is in the fleet, nothing else. State per member, keyed by replica id:
   (one counter), (re)issued at registration. Every subsequent write
   (renew, deregister) must quote it; a stale token is rejected typed
   ``StaleFencingToken``. Agents pass their last token back as
-  ``min_fence`` when re-registering, so monotonicity survives a
-  directory crash/restart even though the table does not: the new
-  directory's counter jumps past every token it ever issued.
+  ``min_fence`` when re-registering, so monotonicity survives even a
+  directory that lost its table: the new directory's counter jumps
+  past every token it ever issued.
 - **lease** — liveness is a time-bounded claim, renewed by heartbeat.
   An expired lease makes the member a DEATH CANDIDATE; it is only
   removed when someone (the router) asks ``confirm_dead`` — the
@@ -22,20 +22,47 @@ is in the fleet, nothing else. State per member, keyed by replica id:
 - **advertisements** — each renewal piggybacks the agent's prefix
   digest and load report, which is what the router routes on.
 
-The directory holds NO request state and NO engine state, which is
-why crash/restart is cheap: agents notice ``UnknownMember`` on their
-next renewal and re-register, and the membership table rebuilds
-itself from the fleet within one lease period.
+Durability and availability are layered on without changing that
+contract:
+
+- ``data_dir=`` arms a **write-ahead log + snapshot** (``wal.py``):
+  membership, generations, tombstones, and the fencing-token
+  high-water mark are logged before the mutating RPC answers, so a
+  crash-restarted directory recovers authoritative state immediately
+  instead of waiting out a re-advertisement window. Torn WAL tails
+  are truncated, never replayed. Leases are re-armed with a full TTL
+  at recovery — monotonic clocks don't survive the process, so a
+  deadline stamped by the dead incarnation proves nothing.
+- ``role="standby"`` makes this directory a **hot standby**: it
+  applies replicated deltas (``rpc_repl_apply`` / ``rpc_repl_sync``)
+  but answers every adjudicating RPC — register, renew, deregister,
+  confirm_dead, snapshot — with typed ``NotPrimary`` so two
+  directories can never both arbitrate. ``rpc_promote`` flips it to
+  primary with an epoch bump FOLDED INTO the fence counter
+  (``+ FENCE_EPOCH_STRIDE``): even if the dying primary issued
+  tokens the standby never saw replicated, no token the new primary
+  issues can regress below them.
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.serve.fleet.transport import Transport
-from ray_tpu.serve.fleet.wire import (StaleFencingToken,
+from ray_tpu.serve.fleet.wire import (NotPrimary, StaleFencingToken,
                                       UnknownMember)
+
+# Fence-counter jump applied at standby promotion: an upper bound on
+# the tokens an async-replicating primary could have issued without
+# the deltas reaching the standby before it died. Registers replicate
+# one delta each, so the true gap is the replication queue depth;
+# 1024 documents "a lot of margin" without threatening the int.
+FENCE_EPOCH_STRIDE = 1024
+
+PRIMARY = "primary"
+STANDBY = "standby"
 
 
 class _Member:
@@ -63,7 +90,11 @@ class FleetDirectory:
     ``handle`` as the transport handler."""
 
     def __init__(self, lease_ttl_s: float = 1.0,
-                 time_fn=time.monotonic):
+                 time_fn=time.monotonic, *,
+                 data_dir: Optional[str] = None,
+                 snapshot_every: int = 64,
+                 role: str = PRIMARY,
+                 replicator=None):
         self.lease_ttl_s = float(lease_ttl_s)
         self._now = time_fn
         self._lock = threading.Lock()
@@ -72,12 +103,130 @@ class FleetDirectory:
         # retired; zombie registrations at or below it are rejected
         self._tombstones: Dict[str, int] = {}
         self._fence_counter = 0
+        self.role = role
+        self.epoch = 0
+        self._repl_last_seq = 0
+        self._replicator = replicator
+        self.events: collections.deque = collections.deque(
+            maxlen=4096)
         self.counters = {"registers": 0, "renews": 0,
                          "stale_fence_rejects": 0,
                          "unknown_member_rejects": 0,
                          "zombie_register_rejects": 0,
                          "late_renewals": 0, "confirmed_dead": 0,
-                         "deregisters": 0, "wedges_reported": 0}
+                         "deregisters": 0, "wedges_reported": 0,
+                         "not_primary_rejects": 0,
+                         "recovered_members": 0,
+                         "wal_torn_truncated": 0,
+                         "repl_applied": 0, "repl_syncs": 0,
+                         "repl_gaps": 0,
+                         "repl_stale_epoch_rejects": 0,
+                         "promotions": 0}
+        self._wal = None
+        if data_dir is not None:
+            from ray_tpu.serve.fleet.wal import DirectoryWAL
+            self._wal = DirectoryWAL(data_dir,
+                                     snapshot_every=snapshot_every)
+            self._recover()
+
+    # ------------------------------------------------- durable state
+
+    def _event(self, kind: str, **fields) -> None:
+        ev = {"t": round(self._now(), 4), "kind": kind,
+              "epoch": self.epoch}
+        ev.update(fields)
+        self.events.append(ev)
+
+    def _durable_payload(self) -> Dict[str, Any]:
+        return {
+            "members": [{"replica_id": m.replica_id, "addr": m.addr,
+                         "generation": m.generation,
+                         "fence": m.fence,
+                         "page_size": m.page_size}
+                        for m in self._members.values()],
+            "tombstones": dict(self._tombstones),
+            "fence_counter": self._fence_counter,
+            "epoch": self.epoch,
+            "role": self.role,
+        }
+
+    def _persist(self, record: Dict[str, Any]) -> None:
+        if self._wal is None:
+            return
+        if self._wal.append(record):
+            self._wal.snapshot(self._durable_payload())
+
+    def _replicate(self, record: Dict[str, Any]) -> None:
+        if self._replicator is not None and self.role == PRIMARY:
+            self._replicator.publish(self.epoch, record)
+
+    def _apply_record(self, rec: Dict[str, Any],
+                      now: float) -> None:
+        """Apply one WAL/replication record (idempotent under
+        replay). Caller holds the lock."""
+        op = rec.get("op")
+        if op == "member":
+            rid = rec["replica_id"]
+            fence = int(rec["fence"])
+            self._members[rid] = _Member(
+                rid, list(rec["addr"]), int(rec["generation"]),
+                fence, now + self.lease_ttl_s,
+                int(rec.get("page_size", 0)), now)
+            self._fence_counter = max(self._fence_counter, fence)
+        elif op == "tombstone":
+            rid = rec["replica_id"]
+            gen = int(rec["generation"])
+            self._tombstones[rid] = max(
+                self._tombstones.get(rid, -1), gen)
+            m = self._members.get(rid)
+            if m is not None and m.generation <= gen:
+                del self._members[rid]
+        elif op == "promote":
+            self.epoch = max(self.epoch, int(rec["epoch"]))
+            self._fence_counter = max(self._fence_counter,
+                                      int(rec["fence_counter"]))
+            self.role = rec.get("role", self.role)
+
+    def _recover(self) -> None:
+        snap, records = self._wal.load()
+        now = self._now()
+        with self._lock:
+            if snap is not None:
+                for row in snap.get("members", ()):
+                    self._apply_record(dict(row, op="member"), now)
+                for rid, gen in (snap.get("tombstones") or
+                                 {}).items():
+                    self._tombstones[rid] = max(
+                        self._tombstones.get(rid, -1), int(gen))
+                self._fence_counter = max(
+                    self._fence_counter,
+                    int(snap.get("fence_counter", 0)))
+                self.epoch = max(self.epoch,
+                                 int(snap.get("epoch", 0)))
+                self.role = snap.get("role", self.role)
+            for rec in records:
+                self._apply_record(rec, now)
+            # tombstones beat membership whatever order they landed
+            for rid, gen in self._tombstones.items():
+                m = self._members.get(rid)
+                if m is not None and m.generation <= gen:
+                    del self._members[rid]
+            self.counters["recovered_members"] = len(self._members)
+            self.counters["wal_torn_truncated"] = \
+                self._wal.stats["torn_records_truncated"]
+            if self._members or snap is not None or records:
+                self._event("recover",
+                            members=len(self._members),
+                            fence_counter=self._fence_counter,
+                            torn_truncated=self.counters[
+                                "wal_torn_truncated"])
+
+    def _require_primary(self, op: str) -> None:
+        if self.role != PRIMARY:
+            self.counters["not_primary_rejects"] += 1
+            raise NotPrimary(
+                f"{op} refused: this directory is a standby "
+                f"(epoch {self.epoch}); ask the primary")
 
     # ----------------------------------------------------- RPC surface
 
@@ -89,12 +238,14 @@ class FleetDirectory:
         return fn(**args)
 
     def rpc_ping(self) -> Dict[str, Any]:
-        return {"ok": True, "members": len(self._members)}
+        return {"ok": True, "members": len(self._members),
+                "role": self.role, "epoch": self.epoch}
 
     def rpc_register(self, replica_id: str, addr: List[Any],
                      generation: int, page_size: int = 0,
                      min_fence: int = 0) -> Dict[str, Any]:
         with self._lock:
+            self._require_primary("register")
             tomb = self._tombstones.get(replica_id)
             if tomb is not None and generation <= tomb:
                 self.counters["zombie_register_rejects"] += 1
@@ -116,6 +267,13 @@ class FleetDirectory:
                 replica_id, list(addr), int(generation), fence,
                 now + self.lease_ttl_s, int(page_size), now)
             self.counters["registers"] += 1
+            rec = {"op": "member", "replica_id": replica_id,
+                   "addr": list(addr), "generation": int(generation),
+                   "fence": fence, "page_size": int(page_size)}
+            self._persist(rec)
+            self._replicate(rec)
+            self._event("fence_issued", replica_id=replica_id,
+                        generation=int(generation), fence=fence)
             return {"fence": fence, "generation": int(generation),
                     "lease_ttl_s": self.lease_ttl_s}
 
@@ -124,6 +282,7 @@ class FleetDirectory:
                   load: Optional[Dict[str, Any]] = None,
                   wedged: bool = False) -> Dict[str, Any]:
         with self._lock:
+            self._require_primary("renew")
             m = self._members.get(replica_id)
             if m is None:
                 self.counters["unknown_member_rejects"] += 1
@@ -147,11 +306,15 @@ class FleetDirectory:
                 self.counters["wedges_reported"] += 1
             m.wedged = bool(wedged)
             self.counters["renews"] += 1
+            # renewals are NOT persisted: leases are re-armed fresh at
+            # recovery (a dead clock's deadline proves nothing), and
+            # digest/load are soft state the next renewal repaints
             return {"lease_ttl_s": self.lease_ttl_s}
 
     def rpc_deregister(self, replica_id: str,
                        fence: int) -> Dict[str, Any]:
         with self._lock:
+            self._require_primary("deregister")
             m = self._members.get(replica_id)
             if m is None:
                 raise UnknownMember(f"{replica_id} not registered")
@@ -164,6 +327,10 @@ class FleetDirectory:
             self._tombstones[replica_id] = max(
                 self._tombstones.get(replica_id, -1), m.generation)
             self.counters["deregisters"] += 1
+            rec = {"op": "tombstone", "replica_id": replica_id,
+                   "generation": m.generation}
+            self._persist(rec)
+            self._replicate(rec)
             return {"ok": True}
 
     def rpc_confirm_dead(self, replica_id: str,
@@ -174,6 +341,7 @@ class FleetDirectory:
         member with a live lease is NOT dead, however the transport
         to it looked from the router's side."""
         with self._lock:
+            self._require_primary("confirm_dead")
             m = self._members.get(replica_id)
             if m is None:
                 return {"dead": True, "reason": "unknown"}
@@ -189,11 +357,19 @@ class FleetDirectory:
             self._tombstones[replica_id] = max(
                 self._tombstones.get(replica_id, -1), m.generation)
             self.counters["confirmed_dead"] += 1
+            rec = {"op": "tombstone", "replica_id": replica_id,
+                   "generation": m.generation}
+            self._persist(rec)
+            self._replicate(rec)
             return {"dead": True, "reason": "lease_expired",
                     "expired_for_s": now - m.lease_expires}
 
     def rpc_snapshot(self) -> Dict[str, Any]:
         with self._lock:
+            # routing reads are adjudication too: a standby's view
+            # may be behind the primary's, so it refuses rather than
+            # serving stale authority
+            self._require_primary("snapshot")
             now = self._now()
             members = [{
                 "replica_id": m.replica_id, "addr": m.addr,
@@ -205,14 +381,127 @@ class FleetDirectory:
             } for m in self._members.values()]
             return {"members": members,
                     "fence_counter": self._fence_counter,
-                    "lease_ttl_s": self.lease_ttl_s}
+                    "lease_ttl_s": self.lease_ttl_s,
+                    "epoch": self.epoch}
 
     def rpc_stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {"members": len(self._members),
+            out = {"members": len(self._members),
+                   "fence_counter": self._fence_counter,
+                   "tombstones": dict(self._tombstones),
+                   "counters": dict(self.counters),
+                   "role": self.role, "epoch": self.epoch}
+            if self._wal is not None:
+                out["wal"] = dict(self._wal.stats)
+            return out
+
+    def rpc_events(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"events": list(self.events)}
+
+    def rpc_role(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"role": self.role, "epoch": self.epoch,
                     "fence_counter": self._fence_counter,
-                    "tombstones": dict(self._tombstones),
-                    "counters": dict(self.counters)}
+                    "members": len(self._members)}
+
+    # ------------------------------------------------- replication
+
+    def rpc_repl_sync(self, epoch: int, seq: int,
+                      state: Dict[str, Any]) -> Dict[str, Any]:
+        """Full-state bootstrap from the primary. Replaces the
+        standby's membership view wholesale (the primary's table IS
+        the truth while it lives)."""
+        with self._lock:
+            if self.role == PRIMARY or int(epoch) < self.epoch:
+                self.counters["repl_stale_epoch_rejects"] += 1
+                raise StaleFencingToken(
+                    f"repl_sync at epoch {epoch} rejected: this "
+                    f"directory is {self.role} at epoch "
+                    f"{self.epoch}")
+            now = self._now()
+            self._members.clear()
+            for row in state.get("members", ()):
+                self._apply_record(dict(row, op="member"), now)
+            for rid, gen in (state.get("tombstones")
+                             or {}).items():
+                self._tombstones[rid] = max(
+                    self._tombstones.get(rid, -1), int(gen))
+            self._fence_counter = max(
+                self._fence_counter,
+                int(state.get("fence_counter", 0)))
+            self.epoch = max(self.epoch, int(epoch))
+            self._repl_last_seq = int(seq)
+            self.counters["repl_syncs"] += 1
+            if self._wal is not None:
+                self._wal.snapshot(self._durable_payload())
+            self._event("repl_sync",
+                        members=len(self._members),
+                        fence_counter=self._fence_counter)
+            return {"ok": True, "members": len(self._members)}
+
+    def rpc_repl_apply(self, epoch: int, seq: int,
+                       record: Dict[str, Any]) -> Dict[str, Any]:
+        """Apply one membership delta streamed by the primary."""
+        with self._lock:
+            if self.role == PRIMARY or int(epoch) < self.epoch:
+                self.counters["repl_stale_epoch_rejects"] += 1
+                raise StaleFencingToken(
+                    f"repl_apply at epoch {epoch} rejected: this "
+                    f"directory is {self.role} at epoch "
+                    f"{self.epoch}")
+            if int(seq) != self._repl_last_seq + 1:
+                self.counters["repl_gaps"] += 1
+            self._repl_last_seq = int(seq)
+            self._apply_record(record, self._now())
+            self._persist(record)
+            self.counters["repl_applied"] += 1
+            if record.get("op") == "member":
+                self._event("repl_member",
+                            replica_id=record.get("replica_id"),
+                            fence=int(record.get("fence", 0)))
+            return {"ok": True, "seq": self._repl_last_seq}
+
+    def rpc_promote(self, reason: str = "",
+                    min_fence: int = 0) -> Dict[str, Any]:
+        """Flip a standby to primary. The epoch bump is FOLDED INTO
+        the fence counter: the new primary's first token clears every
+        token the old primary could have issued unreplicated, so no
+        fencing token ever regresses across failover. Idempotent —
+        promoting a primary is a no-op answer, not an error."""
+        with self._lock:
+            if self.role == PRIMARY:
+                return {"promoted": False, "role": self.role,
+                        "epoch": self.epoch,
+                        "fence_counter": self._fence_counter}
+            fence_before = self._fence_counter
+            self.epoch += 1
+            self._fence_counter = max(self._fence_counter,
+                                      int(min_fence)) \
+                + FENCE_EPOCH_STRIDE
+            self.role = PRIMARY
+            now = self._now()
+            for m in self._members.values():
+                # replicated members get a fresh full lease: their
+                # agents have been renewing against the DEAD primary
+                # and deserve a whole TTL to find this one
+                m.lease_expires = now + self.lease_ttl_s
+            self.counters["promotions"] += 1
+            rec = {"op": "promote", "epoch": self.epoch,
+                   "fence_counter": self._fence_counter,
+                   "role": PRIMARY}
+            self._persist(rec)
+            if self._wal is not None:
+                self._wal.snapshot(self._durable_payload())
+            self._event("promote", reason=reason,
+                        fence_before=fence_before,
+                        fence_after=self._fence_counter,
+                        members=len(self._members))
+            return {"promoted": True, "role": self.role,
+                    "epoch": self.epoch,
+                    "fence_counter": self._fence_counter,
+                    "fence_before": fence_before,
+                    "members": len(self._members)}
 
 
 class DirectoryClient:
@@ -267,22 +556,70 @@ class DirectoryClient:
     def stats(self) -> Dict[str, Any]:
         return self._t.call("stats", {}, timeout_s=self._timeout_s)
 
+    def events(self) -> Dict[str, Any]:
+        return self._t.call("events", {}, timeout_s=self._timeout_s)
+
+    def role(self) -> Dict[str, Any]:
+        return self._t.call("role", {}, timeout_s=self._timeout_s)
+
+    def promote(self, reason: str = "",
+                min_fence: int = 0) -> Dict[str, Any]:
+        return self._t.call(
+            "promote", {"reason": reason, "min_fence": min_fence},
+            timeout_s=self._timeout_s)
+
 
 def main(argv: Optional[List[str]] = None) -> None:
     """Subprocess entry: ``python -m ray_tpu.serve.fleet.directory
-    --port N``. Prints ``READY <port>`` once listening."""
+    --port N [--data-dir D] [--role standby --peer H:P
+    --promote-after-s S] [--standby H:P]``. Prints ``READY <port>``
+    once listening."""
     import argparse
-    import sys
 
-    from ray_tpu.serve.fleet.transport import SocketServer
+    from ray_tpu.serve.fleet.transport import (SocketServer,
+                                               SocketTransport)
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--lease-ttl-s", type=float, default=1.0)
+    ap.add_argument("--data-dir", default=None,
+                    help="arm WAL+snapshot durability here")
+    ap.add_argument("--snapshot-every", type=int, default=64)
+    ap.add_argument("--role", choices=(PRIMARY, STANDBY),
+                    default=PRIMARY)
+    ap.add_argument("--standby", action="append", default=[],
+                    help="host:port of a standby to replicate to "
+                         "(primary side; repeatable)")
+    ap.add_argument("--peer", default=None,
+                    help="host:port of the primary to monitor "
+                         "(standby side)")
+    ap.add_argument("--promote-after-s", type=float, default=3.0)
     args = ap.parse_args(argv)
 
-    directory = FleetDirectory(lease_ttl_s=args.lease_ttl_s)
+    def _hp(s: str):
+        host, _, port = s.rpartition(":")
+        return host or "127.0.0.1", int(port)
+
+    replicator = None
+    if args.standby:
+        from ray_tpu.serve.fleet.replication import Replicator
+        replicator = Replicator(
+            [SocketTransport(_hp(s)) for s in args.standby])
+    directory = FleetDirectory(lease_ttl_s=args.lease_ttl_s,
+                               data_dir=args.data_dir,
+                               snapshot_every=args.snapshot_every,
+                               role=args.role,
+                               replicator=replicator)
+    if replicator is not None:
+        replicator.attach(directory)
+        replicator.start()
+    monitor = None
+    if args.role == STANDBY and args.peer:
+        from ray_tpu.serve.fleet.replication import StandbyMonitor
+        monitor = StandbyMonitor(
+            directory, SocketTransport(_hp(args.peer)),
+            promote_after_s=args.promote_after_s).start()
     server = SocketServer(directory.handle, host=args.host,
                           port=args.port)
     print(f"READY {server.addr[1]}", flush=True)
@@ -292,6 +629,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        if monitor is not None:
+            monitor.stop()
+        if replicator is not None:
+            replicator.stop()
         server.stop()
 
 

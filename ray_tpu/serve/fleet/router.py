@@ -33,7 +33,8 @@ import collections
 import random
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import (Any, Callable, Dict, Iterable, List, Optional,
+                    Tuple)
 
 from ray_tpu.serve import obs
 from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
@@ -210,7 +211,12 @@ class FleetRouter:
 
     Parameters
     ----------
-    directory: DirectoryClient over any transport.
+    directory: DirectoryClient over any transport — or a
+        ``replication.FailoverDirectoryClient`` over an ORDERED
+        endpoint list (primary first, standbys after), which layers
+        client-side failover UNDER the stale-snapshot fallback here:
+        the router only falls back to its cache when every endpoint
+        refused.
     transport_factory: ``f(addr_tuple) -> Transport`` building the
         client leg to one agent (loopback registry or socket dial);
         transports are cached per address.
@@ -260,12 +266,20 @@ class FleetRouter:
         self._dead_seen: set = set()
         self._seq = 0
         self._rseq = 0
+        # autoscaler surface: idx -> replica_id for members the
+        # autoscaler added (only these are scale_down candidates),
+        # and the capacity-ETA hint hook PoolAutoscaler installs
+        self._scaled: Dict[int, str] = {}
+        self._scale_seq = 0
+        self.capacity_hint_fn: Optional[Callable[[], float]] = None
         self.events = obs.EventLog(2048, name="router")
         self.counters = {"routed": 0, "requeues": 0,
                          "deaths_confirmed": 0, "suspects": 0,
                          "confirm_inconclusive": 0,
                          "stale_snapshots": 0, "all_shed": 0,
-                         "submit_retries": 0}
+                         "submit_retries": 0,
+                         "snapshot_hits": 0, "snapshot_misses": 0,
+                         "member_invalidations": 0}
         self._stopped = False
 
     # --------------------------------------------------------- submit
@@ -311,7 +325,14 @@ class FleetRouter:
             if pick is None:
                 hints = list(decision.get("hints", []))
                 hints += [e.retry_after_s for e in shed]
+                # provisioning honesty: when an autoscaler is mid
+                # scale-up, its ETA joins the hint pool — the max
+                # below then never invites a client back before the
+                # capacity that would serve it can exist
+                eta = self._capacity_eta()
                 if hints:
+                    if eta > 0:
+                        hints.append(eta)
                     self.counters["all_shed"] += 1
                     err = EngineOverloaded(
                         f"all live agents shed (retry hints "
@@ -323,11 +344,12 @@ class FleetRouter:
                 err2 = EngineShutdown(
                     "no live agents in the fleet directory")
                 # an honest hint: a lease period from now is the
-                # soonest a restarted agent could re-advertise
+                # soonest a restarted agent could re-advertise —
+                # unless provisioning is pending and further out
                 snap = self._snapshot_cache
-                err2.retry_after_s = (
-                    self._lease_ttl_hint() if snap is not None
-                    else 1.0)
+                base = (self._lease_ttl_hint() if snap is not None
+                        else 1.0)
+                err2.retry_after_s = max(base, eta)
                 raise err2
             member = members[pick.key]
             key = self._mint_key()
@@ -343,8 +365,11 @@ class FleetRouter:
                 self._suspect(member, e)
                 verdict = self._confirm_dead(member, e)
                 if verdict is not True:
-                    # transient or unconfirmable: skip it this round
-                    self._invalidate_snapshot()
+                    # transient or unconfirmable: evict only the
+                    # suspect from the cache — one flaky agent must
+                    # not force a directory round-trip for every
+                    # unrelated routing decision
+                    self._invalidate_member(member.replica_id)
                 exclude.add(member.replica_id)
                 continue
             except EngineOverloaded as e:
@@ -352,8 +377,8 @@ class FleetRouter:
                 exclude.add(member.replica_id)
                 continue
             except (EngineShutdown, EngineDraining) as e:
-                # fenced / draining / stale fence: refresh and reroute
-                self._invalidate_snapshot()
+                # fenced / draining / stale fence: evict + reroute
+                self._invalidate_member(member.replica_id)
                 self._note_request_death(member, e,
                                          trace_id=trace_id,
                                          submit_side=True)
@@ -395,7 +420,9 @@ class FleetRouter:
             cached = self._snapshot_cache
             if (cached is not None
                     and now - self._snapshot_t < self.snapshot_ttl_s):
+                self.counters["snapshot_hits"] += 1
                 return cached
+            self.counters["snapshot_misses"] += 1
         try:
             raw = self._directory.snapshot()
         except Exception:
@@ -428,6 +455,30 @@ class FleetRouter:
     def _invalidate_snapshot(self) -> None:
         with self._lock:
             self._snapshot_t = 0.0
+
+    def _invalidate_member(self, replica_id: str) -> None:
+        """Evict ONE member from the snapshot cache, leaving the
+        rest trusted until the TTL: a single suspect doesn't cost
+        everyone else a directory round-trip. The hit/miss counters
+        prove the cache still earns its keep under churn."""
+        with self._lock:
+            self.counters["member_invalidations"] += 1
+            cache = self._snapshot_cache
+            if cache is not None and replica_id in cache:
+                # copy-on-write: readers may be iterating the old map
+                cache = dict(cache)
+                del cache[replica_id]
+                self._snapshot_cache = cache
+
+    def _capacity_eta(self) -> float:
+        fn = self.capacity_hint_fn
+        if fn is None:
+            return 0.0
+        try:
+            eta = float(fn() or 0.0)
+        except Exception:
+            return 0.0
+        return eta if eta > 0 and eta != float("inf") else 0.0
 
     def _agent(self, member: _Member) -> AgentClient:
         with self._lock:
@@ -475,7 +526,7 @@ class FleetRouter:
             for k in [k for k, v in self._sticky.items()
                       if v == member.replica_id]:
                 del self._sticky[k]
-        self._invalidate_snapshot()
+        self._invalidate_member(member.replica_id)
         self.events.append(
             "member_dead", sid=member.replica_id,
             data={"fence": member.fence,
@@ -541,19 +592,92 @@ class FleetRouter:
 
     def load_report(self) -> Dict[str, Any]:
         """Fleet-aggregate load report (the pool's shape, summed
-        over live members' advertised reports)."""
+        over live members' advertised reports). Carries every key
+        ``PoolAutoscaler`` senses on, so the autoscaler can drive a
+        fleet exactly like an ``EnginePool``."""
         members = self._snapshot()
         out: Dict[str, Any] = {
-            "replicas": len(members), "free_slots": 0,
+            "replicas": len(members),
+            "healthy_replicas": len(members),
+            "free_slots": 0, "total_slots": 0,
             "queue_depth": 0, "outstanding_tokens": 0,
+            "shed_total": 0,
+            "ttft_ewma_s": None,
             "draining": False, "stopped": not members}
         for m in members.values():
-            for k in ("free_slots", "queue_depth",
-                      "outstanding_tokens"):
+            for k in ("free_slots", "total_slots", "queue_depth",
+                      "outstanding_tokens", "shed_total"):
                 v = m.report.get(k)
                 if isinstance(v, (int, float)):
                     out[k] += v
+            ttft = m.report.get("ttft_ewma_s")
+            if isinstance(ttft, (int, float)):
+                out["ttft_ewma_s"] = max(out["ttft_ewma_s"] or 0.0,
+                                         float(ttft))
+        with self._lock:
+            out["shed_total"] += self.counters["all_shed"]
         return out
+
+    # -------------------------------------------- autoscaler surface
+
+    def active_count(self) -> int:
+        """Live (routable) members — the autoscaler's notion of the
+        current scale."""
+        return len(self._snapshot())
+
+    def add_replica_for_ticket(self, ticket: str) -> int:
+        """Harvest hook: the agent behind ``ticket`` (its replica id)
+        registered itself with the directory, so 'adding' it to the
+        fleet is just refreshing the routing view and remembering it
+        as an autoscaler-owned scale-down candidate."""
+        with self._lock:
+            self._scale_seq += 1
+            idx = self._scale_seq
+            self._scaled[idx] = str(ticket)
+        self._invalidate_snapshot()
+        self.events.append("scale_up", sid=str(ticket),
+                           data={"idx": idx})
+        return idx
+
+    def add_replica(self) -> int:
+        return self.add_replica_for_ticket("")
+
+    def scale_down(self, k: int = 1,
+                   timeout_s: float = 15.0,
+                   rids: Optional[Iterable[str]] = None) -> List[int]:
+        """Retire ``k`` autoscaler-added agents: health-gated drain
+        (in-flight requests finish), lease retirement + tombstone
+        (the agent deregisters itself inside ``rpc_drain``), routing
+        eviction. Victims are the least-loaded scaled members; the
+        static floor is never touched. ``rids`` restricts the
+        candidate set (a caller retiring a SPECIFIC provisioned
+        agent, not just 'any k'). Returns the retired idxs — the
+        autoscaler releases their provider tickets (which reaps the
+        OS processes) from these."""
+        members = self._snapshot()
+        allow = None if rids is None else {str(r) for r in rids}
+        with self._lock:
+            cands = [(idx, rid) for idx, rid in self._scaled.items()
+                     if rid in members
+                     and (allow is None or rid in allow)]
+        cands.sort(key=lambda pair: (
+            members[pair[1]].report.get("outstanding_tokens", 0),
+            members[pair[1]].report.get("queue_depth", 0),
+            pair[0]))
+        retired: List[int] = []
+        for idx, rid in cands[:max(0, int(k))]:
+            m = members[rid]
+            try:
+                self._agent(m).drain(timeout_s=timeout_s)
+            except Exception:  # noqa: BLE001 - a dead agent is
+                pass           # already retired; the tombstone wins
+            with self._lock:
+                self._scaled.pop(idx, None)
+            self._invalidate_member(rid)
+            self.events.append("scale_down", sid=rid,
+                               data={"idx": idx})
+            retired.append(idx)
+        return retired
 
     def pool_stats(self) -> Dict[str, Any]:
         """Router-side observability block (named pool_stats so

@@ -232,6 +232,12 @@ class FaultyTransport(Transport):
     - ``delay_p`` / ``delay_s``: the call sleeps before executing.
     - ``partition()``: while partitioned, every call raises
       ``TransportError`` — the peer is unreachable both ways.
+    - ``replay_last()``: re-deliver the most recent successful call
+      verbatim, arbitrarily later — the DELAYED duplicate ``dup_p``
+      can't model (back-to-back dups land inside one lease window;
+      a held-then-replayed frame can straddle a renewal or even a
+      re-registration boundary, which is exactly what fencing tokens
+      exist to refuse).
     """
 
     def __init__(self, inner: Transport, *, seed: int = 0,
@@ -245,8 +251,9 @@ class FaultyTransport(Transport):
         self.delay_p = delay_p
         self.delay_s = delay_s
         self._partitioned_until: Optional[float] = None
+        self._last: Optional[Tuple[str, Dict[str, Any]]] = None
         self.stats = {"calls": 0, "dropped": 0, "duplicated": 0,
-                      "delayed": 0, "partitioned": 0}
+                      "delayed": 0, "partitioned": 0, "replayed": 0}
 
     def partition(self, duration_s: Optional[float] = None) -> None:
         """Cut the link (for ``duration_s`` seconds, or until
@@ -291,8 +298,25 @@ class FaultyTransport(Transport):
                 self.stats["duplicated"] += 1
             self._inner.call(method, args, timeout_s=timeout_s,
                              trace_id=trace_id)
-        return self._inner.call(method, args, timeout_s=timeout_s,
-                                trace_id=trace_id)
+        out = self._inner.call(method, args, timeout_s=timeout_s,
+                               trace_id=trace_id)
+        with self._lock:
+            self._last = (method, dict(args))
+        return out
+
+    def replay_last(self, *, timeout_s: Optional[float] = None):
+        """Re-deliver the last successful frame NOW (a duplicate the
+        network held onto). Returns the peer's fresh answer — which,
+        across a renewal/re-registration boundary, should be a typed
+        fencing refusal, not a lease extension."""
+        with self._lock:
+            held = self._last
+            self.stats["replayed"] += 1
+        if held is None:
+            raise TransportError("nothing to replay")
+        method, args = held
+        return self._inner.call(method, dict(args),
+                                timeout_s=timeout_s)
 
     def close(self) -> None:
         self._inner.close()

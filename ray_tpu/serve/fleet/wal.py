@@ -1,0 +1,233 @@
+"""Crash-durable directory state: write-ahead log + snapshot.
+
+The FleetDirectory's membership table is tiny but load-bearing: the
+fencing-token high-water mark and the tombstone set are the two
+pieces that must NEVER regress, even across a crash. This module
+gives the directory the training side's torn-file discipline
+(air/checkpoint.py) at control-plane scale:
+
+- **WAL** (``wal.log``): one mutation per line, each line carrying a
+  sha256 prefix over its own payload. Appends are flushed + fsynced
+  before the mutating RPC answers, so an acknowledged register /
+  tombstone / promotion survives SIGKILL. On recovery the log is
+  scanned front to back; the FIRST record that fails its checksum
+  (or json-decodes dirty, or lost its newline) marks the torn tail —
+  everything from that byte on is TRUNCATED, never replayed. A torn
+  record is a write the directory never acknowledged, so dropping it
+  is the only correct reading.
+- **Snapshot** (``snapshot.json``): periodic compaction. The payload
+  is staged to a ``.tmp-`` file, checksummed (checksum line first,
+  payload after — the same write-the-proof-last ordering as the
+  checkpoint manifest), fsynced, and atomically renamed over the old
+  snapshot; only then is the WAL truncated. A crash between those
+  two steps replays WAL records that are already IN the snapshot —
+  harmless, because every record type is idempotent under replay
+  (membership upserts, tombstone maxes, fence-counter maxes).
+
+Recovery = load snapshot (if its checksum verifies) + replay the
+surviving WAL suffix. What does NOT survive is wall-time: leases are
+stamped against the directory's monotonic clock, which resets with
+the process, so the directory re-arms every recovered member with a
+fresh full TTL instead of trusting a deadline from a dead clock.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.air.checkpoint import _fsync_dir
+
+WAL_NAME = "wal.log"
+SNAPSHOT_NAME = "snapshot.json"
+_TMP_PREFIX = ".tmp-"
+
+
+def _digest(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()[:16]
+
+
+class DirectoryWAL:
+    """Append-only mutation log + checksummed snapshot for one
+    directory's durable state. Thread-safe; the directory calls
+    ``append`` under its own lock anyway, but the WAL protects
+    itself so recovery tooling can share an instance."""
+
+    def __init__(self, data_dir: str, snapshot_every: int = 64):
+        self.data_dir = data_dir
+        self.snapshot_every = int(snapshot_every)
+        os.makedirs(data_dir, exist_ok=True)
+        self.wal_path = os.path.join(data_dir, WAL_NAME)
+        self.snapshot_path = os.path.join(data_dir, SNAPSHOT_NAME)
+        self._lock = threading.Lock()
+        self._appends_since_snapshot = 0
+        self.stats = {"appends": 0, "snapshots": 0,
+                      "torn_records_truncated": 0,
+                      "snapshot_checksum_rejects": 0}
+        self._fh = None
+
+    # ------------------------------------------------------------ write
+
+    def _open(self):
+        if self._fh is None:
+            self._fh = open(self.wal_path, "ab")
+        return self._fh
+
+    def append(self, record: Dict[str, Any]) -> bool:
+        """Durably append one mutation record. Returns True when the
+        caller should compact (``snapshot_every`` appends since the
+        last snapshot)."""
+        payload = json.dumps(record, separators=(",", ":"),
+                             sort_keys=True).encode("utf-8")
+        line = _digest(payload).encode("ascii") + b" " + payload \
+            + b"\n"
+        with self._lock:
+            fh = self._open()
+            fh.write(line)
+            fh.flush()
+            os.fsync(fh.fileno())
+            self.stats["appends"] += 1
+            self._appends_since_snapshot += 1
+            return self._appends_since_snapshot >= self.snapshot_every
+
+    def snapshot(self, payload: Dict[str, Any]) -> None:
+        """Atomically replace the snapshot with ``payload`` and
+        truncate the WAL (in that order: a crash between the two
+        replays snapshot-covered records, which replay is idempotent
+        under)."""
+        body = json.dumps(payload, separators=(",", ":"),
+                          sort_keys=True).encode("utf-8")
+        head = _digest(body).encode("ascii") + b"\n"
+        with self._lock:
+            stage = os.path.join(self.data_dir,
+                                 _TMP_PREFIX + SNAPSHOT_NAME)
+            with open(stage, "wb") as fh:
+                fh.write(head + body)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(stage, self.snapshot_path)
+            _fsync_dir(self.data_dir)
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            with open(self.wal_path, "wb") as fh:
+                fh.flush()
+                os.fsync(fh.fileno())
+            _fsync_dir(self.data_dir)
+            self._appends_since_snapshot = 0
+            self.stats["snapshots"] += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+    # ------------------------------------------------------------- read
+
+    def load(self) -> Tuple[Optional[Dict[str, Any]],
+                            List[Dict[str, Any]]]:
+        """Recover ``(snapshot_payload | None, wal_records)``. Detects
+        and truncates a torn WAL tail in place; a snapshot that fails
+        its checksum is ignored entirely (the WAL since the previous
+        good snapshot was already truncated with it, so the directory
+        falls back to agent re-advertisement — safe, just slower)."""
+        snap = self._load_snapshot()
+        records = self._load_wal()
+        return snap, records
+
+    def _load_snapshot(self) -> Optional[Dict[str, Any]]:
+        if not os.path.exists(self.snapshot_path):
+            return None
+        with open(self.snapshot_path, "rb") as fh:
+            head = fh.readline().strip()
+            body = fh.read()
+        try:
+            if head.decode("ascii") != _digest(body):
+                raise ValueError("checksum mismatch")
+            return json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            self.stats["snapshot_checksum_rejects"] += 1
+            return None
+
+    def _load_wal(self) -> List[Dict[str, Any]]:
+        if not os.path.exists(self.wal_path):
+            return []
+        records: List[Dict[str, Any]] = []
+        good_end = 0
+        torn = 0
+        with open(self.wal_path, "rb") as fh:
+            data = fh.read()
+        offset = 0
+        while offset < len(data):
+            nl = data.find(b"\n", offset)
+            if nl < 0:
+                torn += 1          # no newline: write died mid-record
+                break
+            line = data[offset:nl]
+            rec = self._parse_line(line)
+            if rec is None:
+                # checksum / shape failure: this record was never
+                # acknowledged — truncate HERE and stop. Anything
+                # after it rode a corrupted region and is equally
+                # untrustworthy.
+                torn += 1 + data.count(b"\n", nl + 1)
+                break
+            records.append(rec)
+            good_end = nl + 1
+            offset = nl + 1
+        if torn:
+            self.stats["torn_records_truncated"] += torn
+            with self._lock:
+                if self._fh is not None:
+                    self._fh.close()
+                    self._fh = None
+                with open(self.wal_path, "r+b") as fh:
+                    fh.truncate(good_end)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+        return records
+
+    @staticmethod
+    def _parse_line(line: bytes) -> Optional[Dict[str, Any]]:
+        parts = line.split(b" ", 1)
+        if len(parts) != 2:
+            return None
+        head, payload = parts
+        try:
+            if head.decode("ascii") != _digest(payload):
+                return None
+            rec = json.loads(payload.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return None
+        return rec if isinstance(rec, dict) else None
+
+
+def inject_torn_tail(data_dir: str,
+                     garbage: bytes = b'f00dfeedcafe4bad {"op":"mem'
+                     ) -> None:
+    """Test/chaos hook: append a partial (torn) record to the WAL,
+    simulating a crash mid-write. Recovery must truncate it."""
+    path = os.path.join(data_dir, WAL_NAME)
+    with open(path, "ab") as fh:
+        fh.write(garbage)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def wal_record_count(data_dir: str) -> int:
+    """Count intact records currently in the WAL (diagnostic)."""
+    path = os.path.join(data_dir, WAL_NAME)
+    if not os.path.exists(path):
+        return 0
+    n = 0
+    with open(path, "rb") as fh:
+        for line in fh:
+            if line.endswith(b"\n") and \
+                    DirectoryWAL._parse_line(line[:-1]) is not None:
+                n += 1
+    return n

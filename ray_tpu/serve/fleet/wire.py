@@ -60,12 +60,19 @@ class AgentFenced(EngineDraining):
     until it re-registers under a fresh generation."""
 
 
+class NotPrimary(EngineShutdown):
+    """The directory answering is a STANDBY: it replicates membership
+    but does not adjudicate it. Callers holding an ordered endpoint
+    list (``replication.FailoverDirectoryClient``) skip to the next
+    endpoint; a standalone caller treats it like any 503."""
+
+
 _WIRE_ERRORS = {
     cls.__name__: cls
     for cls in (RequestError, RequestCancelled, DeadlineExceeded,
                 EngineOverloaded, EngineShutdown, EngineDraining,
                 PoolDegraded, StaleFencingToken, UnknownMember,
-                AgentFenced)
+                AgentFenced, NotPrimary)
 }
 
 

@@ -144,9 +144,13 @@ class HTTPProxy:
         # back out here into the response header so the JSON body
         # stays identical to the non-opted response. Dict payloads
         # only (same rule as trace_id injection: the proxy never
-        # invents a payload shape), unary only (a stream's replica
-        # can change mid-flight on resubmit).
-        echo_rep = (not stream and isinstance(payload, dict)
+        # invents a payload shape). Streams opt in too: the
+        # deployment yields {"replica": ...} as its FIRST item,
+        # which the proxy lifts into the header before committing
+        # chunked encoding — the tag names the incarnation that
+        # ACCEPTED the stream (a mid-flight resubmit can move it;
+        # unary tags have the same admission-time meaning).
+        echo_rep = (isinstance(payload, dict)
                     and "X-Replica" in request.headers)
         if echo_rep:
             payload.setdefault("echo_replica", True)
@@ -210,6 +214,18 @@ class HTTPProxy:
         headers = {"Content-Type": "application/x-ndjson"}
         if trace_id:
             headers["X-Trace-Id"] = trace_id
+        # Opted-in streams lead with a {"replica": ...} marker item
+        # (llm.py stream()): lift it into the header while we still
+        # CAN set headers, then pull the real first token.
+        if more and isinstance(first, dict) and "replica" in first:
+            headers["X-Replica"] = str(first["replica"])
+            try:
+                more, first = await loop.run_in_executor(self._pool,
+                                                         _next)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                return self._error_response(e)
         resp = web.StreamResponse(headers=headers)
         resp.enable_chunked_encoding()
         await resp.prepare(request)

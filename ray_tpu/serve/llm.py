@@ -163,8 +163,17 @@ class LlamaDeployment:
                 "fleet= and num_engine_replicas>1 are exclusive — "
                 "the fleet IS the replica set")
         if fleet and autoscale:
-            raise ValueError("fleet does not support autoscale yet "
-                             "(the autoscaler drives EnginePool)")
+            # the autoscaler drives the ROUTER here: tickets
+            # provision loopback ReplicaAgents (fleet/provider.py),
+            # so the provider must be ours — tickets ARE replica ids
+            if autoscale_provider is not None:
+                raise ValueError(
+                    "fleet+autoscale builds its own "
+                    "LoopbackAgentProvider (tickets provision fleet "
+                    "agents); autoscale_provider is not accepted")
+            if self.autoscale_max_replicas < fleet:
+                raise ValueError("autoscale_max_replicas must be "
+                                 ">= fleet")
         self.fleet = int(fleet)
         self.fleet_lease_ttl_s = float(fleet_lease_ttl_s)
         self._fleet_agents: Dict[str, Any] = {}
@@ -265,6 +274,46 @@ class LlamaDeployment:
                             stall_deadline_s=(
                                 self.engine_stall_deadline_s)).start()
                     self._engine = FleetRouter(dc, tf)
+                    if self.autoscale:
+                        import itertools
+
+                        from ray_tpu.serve.fleet.provider import (
+                            LoopbackAgentProvider)
+                        from ray_tpu.serve.pool_autoscaler import (
+                            PoolAutoscaler, SLOPolicy)
+                        seq = itertools.count(self.fleet)
+
+                        def spawn_agent(rid, _opts=opts):
+                            # provisioning == building + starting a
+                            # loopback agent; inserted in the
+                            # transport map BEFORE start() so the
+                            # router can route the moment the
+                            # directory advertises it
+                            n = next(seq)
+
+                            def f(gen, _n=n):
+                                return LLMEngine(
+                                    self.model, self.params,
+                                    temperature=self.temperature,
+                                    seed=_n,
+                                    sharding=_replica_sharding(_n),
+                                    **_opts)
+
+                            a = ReplicaAgent(
+                                rid, f, dc,
+                                stall_deadline_s=(
+                                    self.engine_stall_deadline_s))
+                            agents[rid] = a
+                            return a.start()
+
+                        policy = SLOPolicy(
+                            min_replicas=self.fleet,
+                            max_replicas=self.autoscale_max_replicas,
+                            **self.autoscale_policy)
+                        self._autoscaler = PoolAutoscaler(
+                            self._engine, policy,
+                            LoopbackAgentProvider(spawn_agent)).run(
+                                self.autoscale_interval_s)
                 elif self.num_engine_replicas > 1 or self.autoscale:
                     from ray_tpu.serve.engine_pool import EnginePool
 
@@ -472,10 +521,21 @@ class LlamaDeployment:
         """Streaming request: yields each generated token id as soon
         as it is sampled (token-at-a-time decode; serve wraps this
         generator in a StreamingResponse and the HTTP proxy in a
-        chunked ndjson response)."""
+        chunked ndjson response).
+
+        ``"echo_replica": true`` in a dict payload makes the FIRST
+        yield ``{"replica": "<id>:<gen>"}`` instead of a token — the
+        proxy pops it into the ``X-Replica`` response header before
+        committing the chunked response, so streaming clients get
+        the same which-incarnation-served-me signal unary clients
+        do."""
         if self.use_engine:
             ids, mnt, dl, sid, tid = self._request_args(prompt_ids)
             h = self._submit(ids, mnt, dl, sid, tid)
+            if isinstance(prompt_ids, dict) \
+                    and prompt_ids.get("echo_replica"):
+                yield {"replica": getattr(h, "replica_tag", None)
+                       or "0:0"}
             try:
                 yield from h.stream()
             except GeneratorExit:

@@ -353,7 +353,14 @@ class PoolAutoscaler:
                     continue
             except Exception:
                 continue
-            idx = self.pool.add_replica()
+            # pools that track WHICH agent a ticket provisioned (the
+            # fleet router: ticket == replica id) take it here, so
+            # scale-down can retire exactly that agent later; the
+            # EnginePool builds anonymous replicas and ignores it
+            add_for = getattr(self.pool, "add_replica_for_ticket",
+                              None)
+            idx = (add_for(ticket) if add_for is not None
+                   else self.pool.add_replica())
             with self._lock:
                 self._pending.remove(ticket)
                 self._ticket_by_idx[idx] = ticket

@@ -1232,6 +1232,217 @@ def run_autoscale(args):
     return result
 
 
+def run_fleet_autoscale(args):
+    """Trace-driven autoscaling across PROCESS boundaries
+    (serve_bench.py --fleet N --autoscale): the --autoscale arrival
+    trace replayed against a FleetRouter whose capacity comes from a
+    FleetCapacityProvider — every scale-up SPAWNS a real ReplicaAgent
+    OS process (spawn -> register -> warm is the ETA-bearing
+    provisioning delay), every scale-down drains one through the
+    health-gated lease-retirement path (tombstoned in the directory)
+    and reaps its process.
+
+    Arms: ``autoscale`` (a static floor of --fleet agents, the
+    PoolAutoscaler free to grow to --autoscale-max) vs ``static_max``
+    (a fixed fleet at max — the capacity ceiling money could buy up
+    front). Agents run the deterministic scripted engine: the run
+    proves CONTROL behavior over the fleet control plane, not model
+    throughput. In-run gates: >=1 process spawned by a scale-up,
+    >=1 drained back down, no leaked agent process at exit."""
+    import os
+    import socket as _socket
+    import tempfile
+
+    from tools.chaos_serve import _spawn_fleet_proc, _wait_ready
+    from ray_tpu.serve.fleet.directory import DirectoryClient
+    from ray_tpu.serve.fleet.provider import FleetCapacityProvider
+    from ray_tpu.serve.fleet.router import FleetRouter
+    from ray_tpu.serve.fleet.transport import SocketTransport
+    from ray_tpu.serve.pool_autoscaler import (PoolAutoscaler,
+                                               SLOPolicy)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dport = s.getsockname()[1]
+    s.close()
+    lease_ttl_s = 1.0
+    data_dir = tempfile.mkdtemp(prefix="fleet-bench-dir-")
+    dproc = _spawn_fleet_proc(
+        ["ray_tpu.serve.fleet.directory", "--port", str(dport),
+         "--lease-ttl-s", str(lease_ttl_s), "--data-dir", data_dir],
+        env, repo)
+    _wait_ready(dproc, "directory")
+    endpoint = f"127.0.0.1:{dport}"
+
+    slo_s = args.ttft_slo_ms / 1000.0
+    gen_tokens = args.gen_tokens
+    plen = 12
+    token_delay_s = 0.02
+    floor = max(1, args.fleet)
+    prng = np.random.RandomState(args.seed)
+
+    def prompt_fn(_tenant):
+        return prng.randint(1, 900, size=plen).tolist()
+
+    shape = (args.trace if args.trace in
+             ("diurnal", "bursty", "multitenant") else "bursty")
+    events = make_trace(shape, args.trace_duration,
+                        args.base_rps, args.peak_rps, args.seed)
+    print(f"trace {shape}: {len(events)} arrivals over "
+          f"{args.trace_duration}s", flush=True)
+
+    def _mk_provider(prefix):
+        return FleetCapacityProvider(
+            [endpoint], model="fake", token_delay_s=token_delay_s,
+            rid_prefix=prefix, spawn_timeout_s=120.0, env=env)
+
+    def _mk_router():
+        return FleetRouter(
+            DirectoryClient(SocketTransport(("127.0.0.1", dport)),
+                            timeout_s=5.0),
+            lambda addr: SocketTransport((addr[1], addr[2])),
+            seed=args.seed, snapshot_ttl_s=0.05, call_timeout_s=10.0)
+
+    def _boot(provider, router, n, label):
+        tickets = [provider.request() for _ in range(n)]
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if all(provider.ready(t) for t in tickets):
+                break
+            time.sleep(0.05)
+        while (router.active_count() < n
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert router.active_count() >= n, (
+            f"{label}: only {router.active_count()} of {n} floor "
+            f"agents registered")
+        print(f"{label}: {n} agent processes up", flush=True)
+        return tickets
+
+    # --- arm 1: autoscaled fleet -----------------------------------
+    provider = _mk_provider("bench")
+    router = _mk_router()
+    _boot(provider, router, floor, "autoscale arm")
+    policy = SLOPolicy(
+        min_replicas=floor, max_replicas=args.autoscale_max,
+        queue_high=1.5, queue_low=0.25,
+        shed_rate_high=0.0, ttft_slo_s=slo_s,
+        free_slot_frac_low=0.15, free_slot_frac_high=0.5,
+        idle_stable_s=1.0,
+        cooldown_up_s=0.3, cooldown_down_s=1.2,
+        scale_up_step=2, drain_timeout_s=15.0)
+    scaler = PoolAutoscaler(router, policy, provider).run(
+        interval_s=0.1)
+    rows, samples = _replay_trace(
+        router, events, prompt_fn, gen_tokens, slo_s,
+        scaler.capacity_eta_s, "fleet_autoscale")
+    deadline = time.monotonic() + (
+        policy.idle_stable_s + policy.cooldown_down_s *
+        (args.autoscale_max - floor) + 10.0)
+    while (router.active_count() > floor
+           and time.monotonic() < deadline):
+        time.sleep(0.1)
+        samples.append((samples[-1][0] + 0.1 if samples else 0.0,
+                        router.active_count()))
+    scaler.stop()
+    auto_stats = scaler.stats()
+    directory_stats = router._directory.stats()
+    router.shutdown()
+    provider.stop_all()
+    auto = _arm_summary(rows, samples, slo_s)
+    auto["replica_timeline"] = _decimate_timeline(samples)
+    counts = [n for _, n in samples]
+    auto["replicas_min_seen"] = int(min(counts))
+    auto["replicas_max_seen"] = int(max(counts))
+    auto["scale_ups"] = auto_stats["scale_ups"]
+    auto["scale_downs"] = auto_stats["scale_downs"]
+    auto["holds"] = auto_stats["holds"]
+    auto["denied"] = auto_stats["denied"]
+    prov_auto = dict(provider.stats)
+
+    # the tentpole gates, asserted in-run: capacity MOVED as real
+    # processes, and none leaked
+    assert auto["replicas_max_seen"] > floor and \
+        auto_stats["scale_ups"] >= 1, (
+        f"autoscaler never spawned an agent process past the floor: "
+        f"{auto_stats}")
+    assert auto_stats["scale_downs"] >= 1, (
+        f"autoscaler never drained an agent back down: {auto_stats}")
+    assert prov_auto["spawned"] > floor, prov_auto
+    assert provider.live_count() == 0, (
+        f"provider leaked {provider.live_count()} agent processes")
+
+    # --- arm 2: static fleet at max --------------------------------
+    print("static-max arm", flush=True)
+    prng.seed(args.seed)            # identical prompt stream
+    provider2 = _mk_provider("st")
+    router2 = _mk_router()
+    _boot(provider2, router2, args.autoscale_max, "static arm")
+    rows2, samples2 = _replay_trace(
+        router2, events, prompt_fn, gen_tokens, slo_s, None,
+        "fleet_static_max")
+    router2.shutdown()
+    provider2.stop_all()
+    auto_end = samples[-1][0] if samples else 0.0
+    static_end = samples2[-1][0] if samples2 else 0.0
+    if auto_end > static_end:
+        samples2.append((auto_end, args.autoscale_max))
+    static = _arm_summary(rows2, samples2, slo_s)
+
+    dproc.kill()
+    dproc.wait(timeout=10)
+
+    return {
+        "trace": shape,
+        "model": "scripted-fake",
+        "trace_duration_s": args.trace_duration,
+        "base_rps": args.base_rps,
+        "peak_rps": args.peak_rps,
+        "arrivals": len(events),
+        "gen_tokens": gen_tokens,
+        "prompt_len": plen,
+        "replicas_min": floor,
+        "replicas_max": args.autoscale_max,
+        "provision_delay_s": None,
+        "slo": {"ttft_ms": args.ttft_slo_ms,
+                "attainment_floor": args.attainment_floor},
+        "autoscale": auto,
+        "static_max": static,
+        "chip_seconds_ratio": _ratio(auto["chip_seconds"],
+                                     static["chip_seconds"]),
+        "ttft_p50_ratio": _ratio(auto.get("ttft_p50_ms"),
+                                 static.get("ttft_p50_ms")),
+        "fleet": {
+            "transport": "tcp-json-v1",
+            "lease_ttl_s": lease_ttl_s,
+            "floor": floor,
+            "directory": directory_stats,
+            "provider_autoscale_arm": prov_auto,
+            "provider_static_arm": dict(provider2.stats),
+            "agent_processes_spawned":
+                prov_auto["spawned"] + provider2.stats["spawned"],
+        },
+        "notes": "Trace-driven FLEET autoscaling run (serve_bench.py "
+                 "--fleet N --autoscale): the same open-loop arrival "
+                 "trace as --autoscale, but capacity moves as real "
+                 "OS processes — a FleetCapacityProvider spawns "
+                 "ReplicaAgent subprocesses on scale-up "
+                 "(spawn -> register -> warm is the provisioning "
+                 "ETA) and retires them on scale-down through the "
+                 "health-gated drain + lease-retirement + tombstone "
+                 "path, all through the durable fleet directory. "
+                 "Gates: >=1 process spawned past the floor, >=1 "
+                 "drained back down, zero leaked processes, "
+                 "attainment over the floor, chip_seconds_ratio "
+                 "< 1.",
+    }
+
+
 def run_tp_ab(args):
     """Tensor-parallel A/B (serve_bench.py --tp-ab): the SAME engine,
     load shape, and greedy sampling run twice — once on a single chip
@@ -1794,6 +2005,28 @@ def main():
             json.dump(result, f, indent=1)
         # self-gate: a malformed or non-improving artifact fails its
         # OWN run (same discipline as the trace capture)
+        from tools import check_bench_schema as cbs
+        problems = []
+        cbs.check_file(out, problems)
+        for p in problems:
+            print(f"SCHEMA FAIL {p}")
+        print(json.dumps(result))
+        ray_tpu.shutdown()
+        if problems:
+            raise SystemExit(1)
+        return
+
+    if args.fleet and args.autoscale:
+        # combined: autoscaling where capacity is real agent
+        # PROCESSES behind the durable fleet directory
+        result = _stamp(run_fleet_autoscale(args), args,
+                        replicas=args.autoscale_max)
+        out = args.out or "SERVE_BENCH_fleet_autoscale_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        # self-gate: the artifact must pass the autoscale family
+        # checks (chip-seconds ratio, attainment, Retry-After) on
+        # its OWN run
         from tools import check_bench_schema as cbs
         problems = []
         cbs.check_file(out, problems)

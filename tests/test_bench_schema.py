@@ -938,24 +938,44 @@ def test_serve_trace_rejects_missing_overhead_fields(tmp_path):
 
 def _fleet_chaos_ok():
     return {
+        "schema_version": 2,
         "seed": 47,
         "topology": {"agents": 3, "transport": "tcp-json-v1",
-                     "processes": {"directory": 1,
+                     "processes": {"directory": 1, "standby": 1,
                                    "agents_spawned": 4},
                      "model": "fake", "lease_ttl_s": 1.0},
         "knobs": {"duration_s": 4.0},
         "schedule": [{"kind": "kill_agent", "at_s": 0.9,
                       "fired": True}],
         "injected": {"kill_agent": 1, "partition": 1,
-                     "directory_restart": 1},
+                     "directory_restart": 1,
+                     "torn_wal_restart": 1, "primary_kill": 1,
+                     "autoscale_churn": 1},
         "requests": {"admitted": 250, "completed": 246,
                      "failed_typed": 2, "lost": 0, "mismatched": 0,
                      "shed": 9, "resubmitted_ok": 2},
         "attainment": 0.98, "attainment_floor": 0.5,
+        "failover": {"promoted": True, "epoch_after": 1,
+                     "fence_before": 7, "fence_after": 1031,
+                     "canary": {"token_identical": True}},
+        "fence_monotonic": True,
+        "wal_recovery": {
+            "directory_restarts": [
+                {"recovered_from_wal": True,
+                 "recovered_members": 3}],
+            "torn_wal_restarts": [
+                {"torn_records_truncated": 1,
+                 "recovered_members": 3}],
+        },
+        "autoscale_churn": {"churns": [
+            {"rid": "auto-0", "state": "retired",
+             "absent_after_retire": True, "tombstoned": True}]},
         "flight_recorder": {"bundles": 5,
                             "kill_explained": True,
                             "partition_explained": True,
                             "directory_restart_explained": True,
+                            "torn_wal_explained": True,
+                            "failover_explained": True,
                             "faults_explained": True},
         "quiesced": True, "wall_s": 5.1, "git_sha": "abc1234",
     }
@@ -1030,3 +1050,69 @@ def test_fleet_chaos_rejects_no_resubmit_proof_or_unquiesced(tmp_path):
     bad["attainment"] = 0.4     # below its own recorded floor
     assert any("floor" in p for p in _problems_for(
         "SERVE_FLEET_CHAOS_x.json", bad, tmp_path))
+
+
+def test_fleet_chaos_v2_rejects_unversioned_artifact(tmp_path):
+    bad = _fleet_chaos_ok()
+    del bad["schema_version"]
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("schema_version" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    bad["schema_version"] = 1
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("schema_version" in p for p in probs)
+
+
+def test_fleet_chaos_v2_rejects_missing_failover_proof(tmp_path):
+    bad = _fleet_chaos_ok()
+    del bad["failover"]
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("failover" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    bad["failover"]["promoted"] = False
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("never promoted" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    bad["failover"]["canary"]["token_identical"] = False
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("canary" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    bad["fence_monotonic"] = False
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("fence_monotonic" in p for p in probs)
+
+
+def test_fleet_chaos_v2_rejects_missing_wal_recovery_proof(tmp_path):
+    bad = _fleet_chaos_ok()
+    del bad["wal_recovery"]
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("wal_recovery" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    bad["wal_recovery"]["directory_restarts"][0][
+        "recovered_from_wal"] = False
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("re-advertisement" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    bad["wal_recovery"]["torn_wal_restarts"][0][
+        "torn_records_truncated"] = 0
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("torn" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    bad["wal_recovery"]["torn_wal_restarts"] = []
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("truncate-don't-replay" in p for p in probs)
+
+
+def test_fleet_chaos_v2_rejects_incomplete_churn_lifecycle(tmp_path):
+    bad = _fleet_chaos_ok()
+    del bad["autoscale_churn"]
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("autoscale_churn" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    bad["autoscale_churn"]["churns"][0]["tombstoned"] = False
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("lifecycle" in p for p in probs)
+    bad = _fleet_chaos_ok()
+    bad["injected"]["primary_kill"] = 0
+    probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
+    assert any("primary_kill" in p for p in probs)

@@ -577,8 +577,16 @@ def test_llm_deployment_fleet_knob():
 
     with pytest.raises(ValueError):
         LlamaDeployment(fleet=2, num_engine_replicas=2)
+    # fleet+autoscale is now a supported combination (the deployment
+    # builds its own LoopbackAgentProvider); what stays rejected is
+    # handing in a foreign provider, whose tickets couldn't spawn
+    # fleet agents
     with pytest.raises(ValueError):
-        LlamaDeployment(fleet=2, autoscale=True)
+        LlamaDeployment(fleet=2, autoscale=True,
+                        autoscale_provider=object())
+    with pytest.raises(ValueError):
+        LlamaDeployment(fleet=3, autoscale=True,
+                        autoscale_max_replicas=2)
 
     d = LlamaDeployment(fleet=2, max_new_tokens=4, max_slots=4)
     try:

@@ -1,0 +1,758 @@
+"""Durability + availability tests for the fleet control plane.
+
+What PR 13 added on top of the lease-fenced directory, unit-tested
+where the chaos campaign can only spot-check:
+
+- WAL/snapshot units (``fleet/wal.py``): acknowledged mutations
+  survive a restart; a torn tail is truncated IN PLACE and never
+  replayed; compaction folds the log into a checksummed snapshot; a
+  corrupt snapshot is rejected wholesale while the WAL suffix still
+  replays.
+- replication/failover units (``fleet/replication.py``): the
+  primary's delta stream reaches the standby (and repairs itself
+  with a full sync after an outage); a standby refuses every
+  adjudicating RPC typed ``NotPrimary``; promotion folds the epoch
+  bump into the fence counter so no token regresses; the
+  ``FailoverDirectoryClient`` walks its endpoint list on transport
+  failures and ``NotPrimary`` but propagates real typed answers.
+- the delayed-duplicate attack (``FaultyTransport.replay_last``): a
+  renew frame held across a re-registration boundary must be refused
+  ``StaleFencingToken``, never extend the new lease.
+- clock skew both directions on fake clocks: renewals at TTL/3 keep
+  the lease alive under a fast directory clock (late renewals
+  revive, never kill), and a fast AGENT clock self-fences strictly
+  before the slow directory would confirm death (fencing stays
+  conservative under skew).
+- router cache surgery: per-member invalidation evicts ONE suspect
+  without a directory round-trip for the rest, with hit/miss
+  counters proving the cache still earns its keep; the capacity-ETA
+  hint rides the all-shed and no-members Retry-After paths.
+- ``LoopbackAgentProvider`` ticket lifecycle on a fake clock.
+- the deployment knob: ``fleet= + autoscale=`` builds a
+  ``PoolAutoscaler`` over the router with a
+  ``LoopbackAgentProvider`` and still serves token-identically.
+- a marker audit: any test that spawns OS processes (chaos campaign,
+  ``FleetCapacityProvider``) must be ``slow``-marked or explicitly
+  time-budgeted, so tier-1 stays fast by construction.
+"""
+import ast
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from ray_tpu.serve.errors import EngineOverloaded, EngineShutdown
+from ray_tpu.serve.fleet.agent import (ReplicaAgent, ScriptedEngine,
+                                       scripted_completion)
+from ray_tpu.serve.fleet.directory import (FENCE_EPOCH_STRIDE,
+                                           PRIMARY, STANDBY,
+                                           DirectoryClient,
+                                           FleetDirectory)
+from ray_tpu.serve.fleet.replication import (FailoverDirectoryClient,
+                                             Replicator,
+                                             StandbyMonitor)
+from ray_tpu.serve.fleet.router import FleetRouter
+from ray_tpu.serve.fleet.transport import (FaultyTransport,
+                                           LoopbackTransport,
+                                           Transport, TransportError)
+from ray_tpu.serve.fleet.wal import (DirectoryWAL, inject_torn_tail,
+                                     wal_record_count)
+from ray_tpu.serve.fleet.wire import NotPrimary, StaleFencingToken
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class _DeadTransport(Transport):
+    """Every call is a connection failure."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def call(self, method, args, *, timeout_s=None, trace_id=None):
+        self.calls += 1
+        raise TransportError("injected dead endpoint")
+
+
+# ------------------------------------------------------- WAL units
+
+
+def test_wal_acknowledged_mutations_survive_restart(tmp_path):
+    """Register + deregister land in the WAL before the RPC answers;
+    a fresh directory over the same data_dir recovers membership,
+    tombstones, and the fence high-water — with leases re-armed to a
+    FULL TTL (a dead clock's deadline proves nothing)."""
+    clock = FakeClock()
+    d = FleetDirectory(lease_ttl_s=1.0, time_fn=clock,
+                       data_dir=str(tmp_path))
+    dc = DirectoryClient(LoopbackTransport(d.handle))
+    f0 = dc.register("r0", ["loopback", "r0"], generation=0,
+                     page_size=8)["fence"]
+    f1 = dc.register("r1", ["loopback", "r1"], generation=2)["fence"]
+    dc.deregister("r1", f1)
+    # age r0's lease almost to death before the "crash"
+    clock.advance(0.9)
+    d._wal.close()
+
+    clock2 = FakeClock(1000.0)      # monotonic clock reset
+    d2 = FleetDirectory(lease_ttl_s=1.0, time_fn=clock2,
+                        data_dir=str(tmp_path))
+    dc2 = DirectoryClient(LoopbackTransport(d2.handle))
+    st = dc2.stats()
+    assert st["counters"]["recovered_members"] == 1
+    assert st["tombstones"] == {"r1": 2}
+    assert st["fence_counter"] >= max(f0, f1)
+    snap = dc2.snapshot()["members"]
+    assert [m["replica_id"] for m in snap] == ["r0"]
+    # full TTL re-armed, page_size recovered
+    assert snap[0]["lease_remaining_s"] == pytest.approx(1.0)
+    assert snap[0]["page_size"] == 8
+    # the recovered fence still adjudicates writes
+    assert dc2.renew("r0", f0) == {"lease_ttl_s": 1.0}
+    # and the tombstone still rejects the zombie generation
+    with pytest.raises(StaleFencingToken):
+        dc2.register("r1", ["loopback", "r1"], generation=2)
+
+
+def test_wal_torn_tail_truncated_never_replayed(tmp_path):
+    d = FleetDirectory(lease_ttl_s=1.0, data_dir=str(tmp_path))
+    dc = DirectoryClient(LoopbackTransport(d.handle))
+    dc.register("r0", ["loopback", "r0"], generation=0)
+    dc.register("r1", ["loopback", "r1"], generation=0)
+    intact = wal_record_count(str(tmp_path))
+    d._wal.close()
+    inject_torn_tail(str(tmp_path))
+
+    d2 = FleetDirectory(lease_ttl_s=1.0, data_dir=str(tmp_path))
+    st = d2.rpc_stats()
+    assert st["counters"]["recovered_members"] == 2
+    assert st["counters"]["wal_torn_truncated"] >= 1
+    # truncated IN PLACE: the file itself is clean again
+    assert wal_record_count(str(tmp_path)) == intact
+    with open(tmp_path / "wal.log", "rb") as fh:
+        assert fh.read().endswith(b"\n")
+
+
+def test_wal_mid_log_corruption_truncates_everything_after(tmp_path):
+    """The FIRST bad record marks the torn tail: records after it
+    rode a corrupted region and are equally untrustworthy."""
+    w = DirectoryWAL(str(tmp_path), snapshot_every=1000)
+    for i in range(5):
+        w.append({"op": "member", "replica_id": f"r{i}",
+                  "addr": ["loopback", f"r{i}"], "generation": 0,
+                  "fence": i + 1})
+    w.close()
+    # flip one byte inside record 2's payload
+    with open(tmp_path / "wal.log", "r+b") as fh:
+        data = fh.read()
+        lines = data.split(b"\n")
+        lines[2] = lines[2][:-3] + b"!" + lines[2][-2:]
+        fh.seek(0)
+        fh.write(b"\n".join(lines))
+        fh.truncate()
+
+    w2 = DirectoryWAL(str(tmp_path))
+    snap, records = w2.load()
+    assert snap is None
+    assert [r["replica_id"] for r in records] == ["r0", "r1"]
+    # records 2..4 all counted truncated, not just the corrupt one
+    assert w2.stats["torn_records_truncated"] == 3
+    assert wal_record_count(str(tmp_path)) == 2
+
+
+def test_wal_snapshot_compaction_and_replay_equivalence(tmp_path):
+    """snapshot_every appends trigger compaction: the WAL folds into
+    the snapshot and truncates, and recovery from snapshot + suffix
+    equals recovery from the full log."""
+    d = FleetDirectory(lease_ttl_s=1.0, data_dir=str(tmp_path),
+                       snapshot_every=4)
+    dc = DirectoryClient(LoopbackTransport(d.handle))
+    fences = {}
+    for i in range(6):
+        fences[f"r{i}"] = dc.register(
+            f"r{i}", ["loopback", f"r{i}"], generation=0)["fence"]
+    assert d._wal.stats["snapshots"] >= 1
+    # compaction truncated: only the post-snapshot suffix remains
+    assert wal_record_count(str(tmp_path)) == 2
+    d._wal.close()
+
+    d2 = FleetDirectory(lease_ttl_s=1.0, data_dir=str(tmp_path))
+    st = d2.rpc_stats()
+    assert st["counters"]["recovered_members"] == 6
+    assert st["fence_counter"] >= max(fences.values())
+
+
+def test_wal_corrupt_snapshot_rejected_wal_suffix_survives(tmp_path):
+    w = DirectoryWAL(str(tmp_path), snapshot_every=1000)
+    w.snapshot({"members": [{"replica_id": "ghost",
+                             "addr": ["loopback", "ghost"],
+                             "generation": 0, "fence": 9}],
+                "fence_counter": 9})
+    w.append({"op": "member", "replica_id": "r0",
+              "addr": ["loopback", "r0"], "generation": 0,
+              "fence": 10})
+    w.close()
+    # corrupt the snapshot BODY (checksum head no longer matches)
+    with open(tmp_path / "snapshot.json", "r+b") as fh:
+        head = fh.readline()
+        body = fh.read()
+        fh.seek(len(head))
+        fh.write(body[:-2] + b"XX")
+
+    w2 = DirectoryWAL(str(tmp_path))
+    snap, records = w2.load()
+    assert snap is None
+    assert w2.stats["snapshot_checksum_rejects"] == 1
+    # the WAL suffix after the bad snapshot still replays
+    assert [r["replica_id"] for r in records] == ["r0"]
+
+
+# --------------------------------------- replication + promotion
+
+
+def test_standby_refuses_adjudication_and_promotion_folds_fence():
+    clock = FakeClock()
+    sb = FleetDirectory(lease_ttl_s=1.0, time_fn=clock, role=STANDBY)
+    sc = DirectoryClient(LoopbackTransport(sb.handle))
+
+    with pytest.raises(NotPrimary):
+        sc.register("r0", ["loopback", "r0"], generation=0)
+    with pytest.raises(NotPrimary):
+        sc.renew("r0", 1)
+    with pytest.raises(NotPrimary):
+        sc.deregister("r0", 1)
+    with pytest.raises(NotPrimary):
+        sc.confirm_dead("r0", 1)
+    with pytest.raises(NotPrimary):
+        sc.snapshot()       # routing reads are adjudication too
+    assert sb.counters["not_primary_rejects"] == 5
+
+    # replicated state arrives while standby; promotion folds the
+    # epoch bump INTO the fence counter past anything the dead
+    # primary could have issued unreplicated
+    sb.rpc_repl_apply(epoch=0, seq=1,
+                      record={"op": "member", "replica_id": "r0",
+                              "addr": ["loopback", "r0"],
+                              "generation": 3, "fence": 7})
+    clock.advance(0.9)          # replicated lease nearly stale
+    out = sc.promote(reason="test")
+    assert out["promoted"] is True
+    assert out["epoch"] == 1
+    assert out["fence_counter"] >= 7 + FENCE_EPOCH_STRIDE
+    assert sb.role == PRIMARY
+    # promotion re-armed the replicated member with a FULL lease
+    m = sc.snapshot()["members"][0]
+    assert m["lease_remaining_s"] == pytest.approx(1.0)
+    # idempotent: promoting a primary is an answer, not an error
+    again = sc.promote()
+    assert again["promoted"] is False
+    assert again["epoch"] == 1
+    # the first post-failover token clears the folded high-water
+    f = sc.register("r1", ["loopback", "r1"], generation=0)["fence"]
+    assert f > 7 + FENCE_EPOCH_STRIDE
+
+
+def test_replicator_streams_deltas_and_full_sync_repair():
+    """Happy path: every delta reaches the standby. Outage path: the
+    unreachable standby is repaired with a FULL repl_sync on next
+    contact instead of replaying a gap."""
+    sb = FleetDirectory(lease_ttl_s=1.0, role=STANDBY)
+    link = FaultyTransport(LoopbackTransport(sb.handle), seed=3)
+    repl = Replicator([link], timeout_s=0.5)
+    prim = FleetDirectory(lease_ttl_s=1.0, replicator=repl)
+    repl.attach(prim).start()
+    pc = DirectoryClient(LoopbackTransport(prim.handle))
+    try:
+        f0 = pc.register("r0", ["loopback", "r0"],
+                         generation=0)["fence"]
+        deadline = time.monotonic() + 5
+        while len(sb._members) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert "r0" in sb._members
+        assert sb._members["r0"].fence == f0
+        assert repl.stats["syncs"] >= 1      # bootstrap sync
+
+        # outage: deltas bounce, the replicator marks needs_sync
+        link.partition()
+        pc.register("r1", ["loopback", "r1"], generation=0)
+        deadline = time.monotonic() + 5
+        while repl.stats["errors"] < 1 and \
+                time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert "r1" not in sb._members
+
+        # heal + one more delta: full-state repair carries BOTH
+        link.heal()
+        pc.register("r2", ["loopback", "r2"], generation=0)
+        deadline = time.monotonic() + 5
+        while len(sb._members) < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert set(sb._members) == {"r0", "r1", "r2"}
+        assert repl.stats["syncs"] >= 2
+        assert sb.counters["repl_syncs"] >= 2
+    finally:
+        repl.stop()
+
+
+def test_standby_monitor_promotes_only_after_seen_alive():
+    """A standby booted before its primary must NOT steal the throne
+    at startup; once the primary has been seen alive and then goes
+    silent past promote_after_s, the standby promotes itself."""
+    prim = FleetDirectory(lease_ttl_s=1.0)
+    sb = FleetDirectory(lease_ttl_s=1.0, role=STANDBY)
+
+    up = threading.Event()
+
+    class _GatedPing(Transport):
+        def __init__(self):
+            self._inner = LoopbackTransport(prim.handle)
+
+        def call(self, method, args, *, timeout_s=None,
+                 trace_id=None):
+            if not up.is_set():
+                raise TransportError("primary not up")
+            return self._inner.call(method, args,
+                                    timeout_s=timeout_s,
+                                    trace_id=trace_id)
+
+    mon = StandbyMonitor(sb, _GatedPing(), promote_after_s=0.08,
+                         poll_s=0.01).start()
+    try:
+        # primary never seen alive: no promotion however long it is
+        # unreachable
+        time.sleep(0.3)
+        assert sb.role == STANDBY
+        assert mon.stats["promoted"] == 0
+
+        up.set()                        # primary appears...
+        deadline = time.monotonic() + 5
+        while mon.stats["pings_ok"] == 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.005)
+        up.clear()                      # ...and dies for good
+        deadline = time.monotonic() + 5
+        while sb.role != PRIMARY and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert sb.role == PRIMARY
+        assert sb.epoch == 1
+        assert mon.stats["promoted"] == 1
+    finally:
+        mon.stop()
+
+
+def test_failover_client_walks_endpoints_but_typed_answers_stand():
+    prim = FleetDirectory(lease_ttl_s=1.0)
+    sb = FleetDirectory(lease_ttl_s=1.0, role=STANDBY)
+    dead = _DeadTransport()
+    fdc = FailoverDirectoryClient(
+        [dead, LoopbackTransport(sb.handle),
+         LoopbackTransport(prim.handle)], timeout_s=0.5)
+
+    # dead endpoint -> transport skip; standby -> NotPrimary skip;
+    # primary answers and becomes the sticky active endpoint
+    r = fdc.register("r0", ["loopback", "r0"], generation=0)
+    assert r["fence"] >= 1
+    assert fdc.active_index == 2
+    assert fdc.counters["transport_skips"] == 1
+    assert fdc.counters["not_primary_skips"] == 1
+    assert fdc.counters["failovers"] == 1
+
+    # subsequent calls start at the active endpoint: the dead one is
+    # never dialled again
+    dials_before = dead.calls
+    fdc.renew("r0", r["fence"])
+    assert dead.calls == dials_before
+
+    # a typed refusal from the REAL primary is an answer — it must
+    # propagate, not advance the endpoint list
+    with pytest.raises(StaleFencingToken):
+        fdc.renew("r0", r["fence"] + 99)
+    assert fdc.active_index == 2
+
+    # every endpoint refusing surfaces the LAST error
+    sb2 = FleetDirectory(lease_ttl_s=1.0, role=STANDBY)
+    only_refusers = FailoverDirectoryClient(
+        [_DeadTransport(), LoopbackTransport(sb2.handle)])
+    with pytest.raises(NotPrimary):
+        only_refusers.snapshot()
+
+    with pytest.raises(AttributeError):
+        fdc.not_a_directory_method()
+    with pytest.raises(ValueError):
+        FailoverDirectoryClient([])
+
+
+# --------------------------- delayed duplicates + clock skew
+
+
+def test_replay_last_renew_across_reregistration_is_fenced():
+    """The attack ``dup_p`` can't model: a renew frame the network
+    held across the agent's re-registration boundary. The replayed
+    frame quotes the SUPERSEDED fence, so the directory must refuse
+    it typed — and must NOT extend the new incarnation's lease."""
+    clock = FakeClock()
+    d = FleetDirectory(lease_ttl_s=1.0, time_fn=clock)
+    net = FaultyTransport(LoopbackTransport(d.handle), seed=5)
+    dc = DirectoryClient(net)
+
+    f0 = dc.register("r0", ["loopback", "r0"], generation=0)["fence"]
+    dc.renew("r0", f0)              # <- the frame the network holds
+
+    # within the same incarnation a delayed duplicate is harmless:
+    # it just re-extends the lease the agent already owns
+    clock.advance(0.3)
+    assert net.replay_last(timeout_s=0.5) == {"lease_ttl_s": 1.0}
+
+    # the agent is fenced + re-registers as generation 1 — over a
+    # DIFFERENT path (the faulty link is still holding its frame)
+    clock.advance(1.5)
+    assert d.rpc_confirm_dead(replica_id="r0",
+                              fence=f0)["dead"] is True
+    clean = DirectoryClient(LoopbackTransport(d.handle))
+    f1 = clean.register("r0", ["loopback", "r0"], generation=1,
+                        min_fence=f0)["fence"]
+    assert f1 > f0
+
+    # now the held frame lands PAST the boundary: typed refusal
+    clock.advance(0.5)
+    expires_before = d._members["r0"].lease_expires
+    with pytest.raises(StaleFencingToken):
+        net.replay_last(timeout_s=0.5)
+    assert net.stats["replayed"] == 2
+    # the refused replay extended nothing
+    assert d._members["r0"].lease_expires == expires_before
+    assert d.counters["stale_fence_rejects"] == 1
+
+
+def _skewed_pair(agent_clock, dir_clock, ttl=1.0):
+    d = FleetDirectory(lease_ttl_s=ttl, time_fn=dir_clock)
+    dc = DirectoryClient(LoopbackTransport(d.handle))
+    a = ReplicaAgent("r0", lambda g: ScriptedEngine(token_delay_s=0),
+                     dc, renew_period_s=3600.0, time_fn=agent_clock)
+    a.engine = a._factory(0)
+    a._register(min_fence=0)
+    return d, dc, a
+
+
+def test_clock_skew_fast_directory_late_renewals_revive():
+    """Directory clock runs 4x the agent's: renewals the agent sends
+    every TTL/3 (its clock) arrive 1.33 TTL apart (directory clock).
+    Each one is LATE — but a late renewal before confirm_dead
+    REVIVES the lease, so the member never flaps and the agent never
+    re-registers."""
+    aclk, dclk = FakeClock(), FakeClock()
+    d, dc, a = _skewed_pair(aclk, dclk)
+    fence0 = a.fence
+    for _ in range(6):
+        aclk.advance(1.0 / 3.0)
+        dclk.advance(4.0 / 3.0)
+        assert a.renew_once() is True
+    assert a.state == "active"
+    assert a.fence == fence0            # same incarnation throughout
+    assert a.counters["self_fences"] == 0
+    assert a.counters["reregisters"] == 0
+    assert d.counters["late_renewals"] == 6
+    assert d.counters["confirmed_dead"] == 0
+    assert dc.confirm_dead("r0", fence0)["dead"] is False
+
+
+def test_clock_skew_fast_agent_fences_before_directory_expiry():
+    """Agent clock runs 4x the directory's. While renewals flow the
+    lease holds (deadlines reset every period); when the directory
+    becomes unreachable the fast agent self-fences STRICTLY before
+    the slow directory's lease expires — fencing errs conservative,
+    so the agent can never believe itself alive after the directory
+    declared death."""
+    aclk, dclk = FakeClock(), FakeClock()
+    d, dc, a = _skewed_pair(aclk, dclk)
+    fence0 = a.fence
+    for _ in range(6):
+        aclk.advance(4.0 / 3.0)
+        dclk.advance(1.0 / 3.0)
+        assert a.renew_once() is True
+    assert a.state == "active"
+    assert a.counters["self_fences"] == 0
+    assert d.counters["late_renewals"] == 0
+
+    # directory gone: drop every renewal from here on
+    a.rpc_inject_partition(duration_s=10_000.0)
+    aclk.advance(1.2)                   # past the agent's deadline
+    dclk.advance(0.3)                   # directory lease still live
+    a.renew_once()
+    assert a.state == "fenced"
+    v = d.rpc_confirm_dead(replica_id="r0", fence=fence0)
+    assert v["dead"] is False           # fenced BEFORE expiry
+    assert v["lease_remaining_s"] > 0
+
+
+# --------------------------------------------- router cache + ETA
+
+
+def _cache_fleet(n=3, **router_kw):
+    d = FleetDirectory(lease_ttl_s=5.0)
+    dc = DirectoryClient(LoopbackTransport(d.handle))
+    agents = {}
+    for i in range(n):
+        rid = f"a{i}"
+        agents[rid] = ReplicaAgent(
+            rid, lambda g: ScriptedEngine(token_delay_s=0.0005),
+            dc, renew_period_s=0.05).start()
+    kw = dict(seed=7, snapshot_ttl_s=60.0, poll_interval_s=0.002)
+    kw.update(router_kw)
+    r = FleetRouter(dc, lambda addr: LoopbackTransport(
+        agents[addr[1]].handle), **kw)
+    return d, dc, agents, r
+
+
+def test_router_member_invalidation_is_surgical():
+    """Evicting one suspect must not cost everyone else a directory
+    round-trip: the rest of the snapshot stays cached (hits keep
+    accruing, misses don't) and routing simply excludes the evicted
+    member until the next refresh."""
+    d, dc, agents, r = _cache_fleet()
+    try:
+        h = r.submit([1, 2, 3], max_new_tokens=4)
+        assert h.result() == scripted_completion([1, 2, 3], 4)
+        misses0 = r.counters["snapshot_misses"]
+        assert misses0 >= 1
+
+        victim = h.replica_idx
+        r._invalidate_member(victim)
+        assert r.counters["member_invalidations"] == 1
+        # within the (long) TTL: served from cache, minus the victim
+        live = r._members(set())
+        assert victim not in live
+        assert len(live) == 2
+        for i in range(6):
+            hh = r.submit([i], max_new_tokens=2)
+            assert hh.replica_idx != victim
+            assert hh.result() == scripted_completion([i], 2)
+        assert r.counters["snapshot_misses"] == misses0
+        assert r.counters["snapshot_hits"] >= 7
+        # hit-rate under surgery stays overwhelmingly cached
+        hits, misses = (r.counters["snapshot_hits"],
+                        r.counters["snapshot_misses"])
+        assert hits / (hits + misses) > 0.7
+
+        # a full refresh (TTL expiry) restores the victim
+        r._invalidate_snapshot()
+        assert victim in r._members(set())
+    finally:
+        r.shutdown()
+        for a in agents.values():
+            a.shutdown()
+
+
+def test_capacity_eta_joins_all_shed_and_no_member_hints():
+    """While an autoscaler is mid scale-up, its provisioning ETA
+    must ride the Retry-After hint out of BOTH refusal paths — the
+    all-shed aggregate and the empty-fleet EngineShutdown — so no
+    client is invited back before capacity can exist."""
+    d = FleetDirectory(lease_ttl_s=1.0)
+    dc = DirectoryClient(LoopbackTransport(d.handle))
+    f = dc.register("a0", ["loopback", "a0"], generation=0)["fence"]
+    # advertise a saturated replica: queue full, tiny shed hint
+    dc.renew("a0", f, load={"max_queued": 1, "queue_depth": 3,
+                            "free_slots": 0, "total_slots": 4,
+                            "shed_retry_after_s": 0.05})
+    r = FleetRouter(dc, lambda addr: LoopbackTransport(
+        lambda *a: None), snapshot_ttl_s=0.0)
+    r.capacity_hint_fn = lambda: 7.5
+    with pytest.raises(EngineOverloaded) as ei:
+        r.submit([1], max_new_tokens=2)
+    assert ei.value.retry_after_s == 7.5    # ETA beats the shed hint
+    assert r.counters["all_shed"] == 1
+
+    # empty fleet: the shutdown hint is max(lease-ttl floor, ETA)
+    dc.deregister("a0", f)
+    with pytest.raises(EngineShutdown) as ei2:
+        r.submit([1], max_new_tokens=2)
+    assert ei2.value.retry_after_s == 7.5
+    # a broken hint fn degrades to the lease-ttl floor, not a crash
+    r.capacity_hint_fn = lambda: (_ for _ in ()).throw(RuntimeError)
+    with pytest.raises(EngineShutdown) as ei3:
+        r.submit([1], max_new_tokens=2)
+    assert ei3.value.retry_after_s == 1.0
+    r.shutdown()
+
+
+# ------------------------------------------- provider + deployment
+
+
+def test_loopback_agent_provider_ticket_lifecycle():
+    from ray_tpu.autoscaler.node_provider import CapacityUnavailable
+    from ray_tpu.serve.fleet.provider import LoopbackAgentProvider
+
+    clock = FakeClock()
+    built, downed = [], []
+
+    class _Agent:
+        def __init__(self, rid):
+            self.rid = rid
+            built.append(rid)
+
+        def shutdown(self):
+            downed.append(self.rid)
+
+    p = LoopbackAgentProvider(_Agent, provision_delay_s=5.0,
+                              rid_prefix="t", max_agents=2,
+                              time_fn=clock)
+    t1 = p.request()
+    assert t1 == "t-1"
+    assert p.ready(t1) is False
+    assert p.eta_s(t1) == pytest.approx(5.0)
+    clock.advance(2.0)
+    assert p.eta_s(t1) == pytest.approx(3.0)
+    assert built == []                  # nothing built early
+    clock.advance(3.0)
+    assert p.ready(t1) is True
+    assert built == ["t-1"]
+    assert p.ready(t1) is True          # idempotent, single build
+    assert built == ["t-1"]
+    assert p.eta_s(t1) == 0.0
+
+    p.request()
+    with pytest.raises(CapacityUnavailable):
+        p.request()                     # ceiling reached
+    assert p.stats["denied"] == 1
+
+    p.release(t1)
+    assert downed == ["t-1"]
+    p.release(t1)                       # idempotent
+    assert p.stats["released"] == 1
+    assert p.eta_s("t-404") == 0.0
+    assert p.ready("t-404") is False
+
+
+def test_llm_deployment_fleet_autoscale_serves_and_scales():
+    """fleet= + autoscale= attaches a PoolAutoscaler driving the
+    FleetRouter through a LoopbackAgentProvider — and the combined
+    stack still answers token-identically to a single engine."""
+    from ray_tpu.serve.fleet.provider import LoopbackAgentProvider
+    from ray_tpu.serve.llm import LlamaDeployment
+    from ray_tpu.serve.pool_autoscaler import PoolAutoscaler
+
+    d = LlamaDeployment(fleet=1, autoscale=True,
+                        autoscale_max_replicas=3,
+                        autoscale_interval_s=3600.0,
+                        max_new_tokens=4, max_slots=4)
+    ref = LlamaDeployment(max_new_tokens=4, max_slots=4)
+    try:
+        want = ref([1, 2, 3])
+        assert d([1, 2, 3]) == want
+        auto = d.autoscaler()
+        assert isinstance(auto, PoolAutoscaler)
+        assert isinstance(auto.provider, LoopbackAgentProvider)
+        assert auto.policy.min_replicas == 1
+        assert auto.policy.max_replicas == 3
+        assert d._engine.active_count() == 1
+
+        # drive one provisioning round by hand: ticket -> loopback
+        # agent -> registered member the router can route to
+        t = auto.provider.request()
+        assert auto.provider.ready(t) is True
+        idx = d._engine.add_replica_for_ticket(t)
+        assert d._engine.active_count() == 2
+        assert t in d._fleet_agents
+        out = [d({"prompt_ids": [9, 9], "echo_replica": True})
+               ["replica"].split(":")[0] for _ in range(16)]
+        assert t in out                 # the scaled agent serves
+        assert d({"prompt_ids": [1, 2, 3]}) == want
+
+        # retire it through the router: drain + tombstone + evict
+        assert d._engine.scale_down(1, rids=[t]) == [idx]
+        auto.provider.release(t)
+        assert d._engine.active_count() == 1
+        assert d._fleet_directory.rpc_stats()["tombstones"] == {t: 0}
+    finally:
+        if d._autoscaler is not None:
+            d._autoscaler.stop()
+        d._engine.shutdown()
+        for a in d._fleet_agents.values():
+            a.shutdown()
+        ref._engine.shutdown()
+
+
+# ----------------------------------------------------- marker audit
+
+
+_HEAVY = ("run_fleet_chaos", "FleetCapacityProvider",
+          "_spawn_fleet_proc", "subprocess.Popen",
+          "run_fleet_autoscale")
+_BUDGET_S = 5.0
+
+
+def _is_slow_marked(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if "slow" in ast.dump(dec):
+            return True
+    return False
+
+
+def _campaign_budgeted(fn: ast.FunctionDef) -> bool:
+    """A cross-process campaign is tier-1-eligible only when its
+    duration is explicitly bounded small."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "duration_s" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, (int, float)) and \
+                        kw.value.value <= _BUDGET_S:
+                    return True
+    return False
+
+
+def test_tier1_marker_audit_process_spawning_tests():
+    """Tier-1 stays fast by construction: every test whose body
+    mentions a process-spawning surface (the heavy-indicator list
+    above) must either carry @pytest.mark.slow or run a short,
+    explicitly budgeted campaign (duration_s <= 5)."""
+    tests_dir = Path(__file__).resolve().parent
+    offenders = []
+    for path in sorted(tests_dir.glob("test_*.py")):
+        src = path.read_text(encoding="utf-8")
+        if not any(ind in src for ind in _HEAVY):
+            continue
+        tree = ast.parse(src)
+        for fn in ast.walk(tree):
+            if not isinstance(fn, ast.FunctionDef) or \
+                    not fn.name.startswith("test_"):
+                continue
+            body_src = ast.get_source_segment(src, fn) or ""
+            if not any(ind in body_src for ind in _HEAVY):
+                continue
+            if _is_slow_marked(fn) or _campaign_budgeted(fn):
+                continue
+            offenders.append(f"{path.name}::{fn.name}")
+    assert not offenders, (
+        "process-spawning tests must be @pytest.mark.slow or run a "
+        f"campaign budgeted to duration_s <= {_BUDGET_S}: "
+        f"{offenders}")
+
+
+def test_checked_in_fleet_artifacts_pass_their_gates():
+    """The committed chaos + autoscale artifacts must keep passing
+    the schema gate the CI check runs — v2 fields and all."""
+    from tools import check_bench_schema as cbs
+
+    repo = Path(__file__).resolve().parents[1]
+    for name in ("SERVE_FLEET_CHAOS_cpu_smoke.json",
+                 "SERVE_BENCH_fleet_autoscale_cpu_smoke.json"):
+        path = repo / name
+        assert path.exists(), f"{name} missing from the repo root"
+        problems = []
+        cbs.check_file(str(path), problems)
+        assert not problems, problems
+        obj = json.loads(path.read_text())
+        assert obj.get("schema_version", 2) >= 1

@@ -474,6 +474,52 @@ def test_http_proxy_streaming(serve_rt):
         stop_http()
 
 
+def test_http_proxy_streaming_x_replica_header(serve_rt):
+    """Opt-in X-Replica on a STREAMING response: the deployment
+    leads with a {"replica": ...} marker chunk, the proxy lifts it
+    into the response header BEFORE the stream starts and never
+    emits it as a body chunk. Without the opt-in the stream is
+    byte-identical to before."""
+    import urllib.request
+
+    @serve.deployment
+    class Toks:
+        def __call__(self, payload):
+            if isinstance(payload, dict) \
+                    and payload.get("echo_replica"):
+                yield {"replica": "r7:2"}
+            for i in range(3):
+                yield i
+
+    serve.run(Toks.bind())
+    from ray_tpu.serve.http_proxy import start_http, stop_http
+    import json as _json
+    proxy = start_http(port=0)
+    try:
+        def post(replica_header):
+            headers = {"Content-Type": "application/json"}
+            if replica_header:
+                headers["X-Replica"] = "1"
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{proxy.port}/Toks?stream=1",
+                data=_json.dumps({"n": 3}).encode(),
+                headers=headers)
+            with urllib.request.urlopen(req, timeout=30) as r:
+                hdr = r.headers.get("X-Replica")
+                lines = [l for l in r.read().decode().splitlines()
+                         if l]
+            return hdr, [_json.loads(l)["chunk"] for l in lines]
+
+        hdr, chunks = post(True)
+        assert hdr == "r7:2"
+        assert chunks == [0, 1, 2]     # marker never leaks as a chunk
+        hdr, chunks = post(False)
+        assert hdr is None
+        assert chunks == [0, 1, 2]
+    finally:
+        stop_http()
+
+
 def test_streaming_failed_start_releases_slot(serve_rt):
     """A stream that fails to start (bad method) must release the
     handle's in-flight slot, or the handle wedges permanently."""

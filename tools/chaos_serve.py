@@ -33,6 +33,7 @@ Run: JAX_PLATFORMS=cpu python tools/chaos_serve.py [--seed N] [--out FILE]
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import os
 import subprocess
@@ -493,27 +494,32 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
                     partition_s=None, model="tiny",
                     token_delay_s=0.004,
                     attainment_floor=ATTAINMENT_FLOOR,
+                    promote_after_s=None,
                     flight_dir=None):
     """Cross-process fleet chaos: the PR-5/9 availability contract
-    re-proven with replicas as real OS processes behind the fleet
-    control plane (serve/fleet/).
+    re-proven with replicas as real OS processes behind the
+    DURABLE + REPLICATED fleet control plane (serve/fleet/).
 
-    Spawns a FleetDirectory subprocess and ``agents`` ReplicaAgent
-    subprocesses (each wrapping its own engine), routes trace load
-    through a FleetRouter over the socket transport, and fires a
-    seeded ``FLEET_KINDS`` schedule: SIGKILL an agent process, a
-    two-way network partition (the victim must self-fence when its
-    lease lapses), and a directory SIGKILL + same-port restart
-    (membership must recover from agent re-advertisement, invisibly
-    to clients). A supervisor restarts killed agents under a bumped
-    generation, exactly like a real fleet manager.
+    Topology: a WAL-backed primary FleetDirectory streaming deltas to
+    a hot-standby subprocess, ``agents`` ReplicaAgent subprocesses
+    (each wrapping its own engine) holding the ordered endpoint list,
+    trace load through a FleetRouter over the socket transport, and a
+    supervisor restarting killed agents under bumped generations.
+    The seeded ``FLEET_KINDS`` schedule fires: agent SIGKILL, two-way
+    partition (self-fence on lease lapse), current-primary SIGKILL +
+    same-port/same-data-dir restart (membership recovers from the
+    WAL, not re-advertisement), PERMANENT primary kill (the standby
+    must promote with the epoch bump folded into the fence counter;
+    a post-failover canary must complete token-identically), a torn
+    WAL tail injected between crash and restart (detected, truncated,
+    never replayed), and autoscaler-driven churn (a
+    FleetCapacityProvider spawns a real agent mid-campaign, the
+    router harvests then drains + retires it under load).
 
-    Gates: zero admitted requests lost, zero token mismatches, every
-    injected fault explained by a flight bundle (kill -> the router's
-    directory-confirmed ``agent-dead-*`` bundle; partition -> the
-    victim's ``self-fenced-*`` bundle dumped from its own process;
-    directory restart -> a harness bundle recording the recovered
-    membership), live agents quiesce leak-free at exit."""
+    Gates: zero admitted requests lost, zero token mismatches,
+    fencing tokens provably monotonic across failover (from the
+    surviving directory's event log), every injected fault explained
+    by a flight bundle, live agents quiesce leak-free at exit."""
     import glob
     import tempfile
 
@@ -569,22 +575,79 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
     spawned = []         # every Popen ever (teardown + pid stamp)
     killed = []          # {"rid", "member", "port", "t"}
     partitions = []      # {"rid", "port", "t", ...probe results}
-    dir_restarts = []    # {"gap_s", "recovery_s", ...}
+    dir_restarts = []    # current-primary crash/restart (WAL proof)
+    torn_restarts = []   # torn-tail crash/restart (truncation proof)
+    churns = []          # autoscale_churn lifecycle records
+    failover = {}        # the (single) permanent primary kill
 
-    def start_directory(port):
+    import socket as _socket
+
+    def _free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    if promote_after_s is None:
+        # must outlive a directory RESTART gap (READY in ~1s), or a
+        # routine crash/recover would trigger a spurious failover
+        promote_after_s = max(3.0, 3.0 * lease_ttl_s)
+    dport, sport = _free_port(), _free_port()
+    dirs = {
+        "d1": {"port": dport,
+               "data_dir": tempfile.mkdtemp(prefix="fleet-d1-"),
+               "flags": ["--standby", f"127.0.0.1:{sport}"]},
+        "d2": {"port": sport,
+               "data_dir": tempfile.mkdtemp(prefix="fleet-d2-"),
+               "flags": ["--role", "standby",
+                         "--peer", f"127.0.0.1:{dport}",
+                         "--promote-after-s",
+                         str(promote_after_s)]},
+    }
+    endpoints = [f"127.0.0.1:{dport}", f"127.0.0.1:{sport}"]
+
+    def start_directory(name):
+        rec = dirs[name]
         p = _spawn_fleet_proc(
-            ["ray_tpu.serve.fleet.directory", "--port", str(port),
-             "--lease-ttl-s", str(lease_ttl_s)], env, repo)
+            ["ray_tpu.serve.fleet.directory",
+             "--port", str(rec["port"]),
+             "--lease-ttl-s", str(lease_ttl_s),
+             "--data-dir", rec["data_dir"]] + rec["flags"],
+            env, repo)
         spawned.append(p)
-        return p, _wait_ready(p, "directory")
+        _wait_ready(p, f"directory-{name}")
+        rec["proc"] = p
+        return p
 
-    dir_proc, dport = start_directory(0)
+    # standby FIRST: its monitor promotes only after seeing the
+    # primary alive at least once, so boot order can't steal a throne
+    start_directory("d2")
+    start_directory("d1")
+
+    def dir_client(name, timeout_s=2.0):
+        return DirectoryClient(SocketTransport(
+            ("127.0.0.1", dirs[name]["port"])), timeout_s)
+
+    def current_primary():
+        """Which directory process currently adjudicates (None
+        mid-failover)."""
+        for name in ("d1", "d2"):
+            if dirs[name]["proc"].poll() is not None:
+                continue
+            try:
+                if dir_client(name).ping()["role"] == "primary":
+                    return name
+            except Exception:   # noqa: BLE001
+                continue
+        return None
 
     def spawn_agent(rid, generation):
         cmd = ["ray_tpu.serve.fleet.agent", "--replica-id", rid,
                "--generation", str(generation),
-               "--directory-port", str(dport),
                "--model", model, "--flight-dir", flight_dir]
+        for ep in endpoints:
+            cmd += ["--directory", ep]
         if model == "fake":
             cmd += ["--token-delay-s", str(token_delay_s)]
         p = _spawn_fleet_proc(cmd, env, repo)
@@ -607,6 +670,8 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
         with state_lock:
             procs[rid] = {"proc": p, "port": port, "generation": 0}
 
+    sup_errors = collections.deque(maxlen=32)
+
     def supervisor():
         """Restart SIGKILLed agents under a bumped generation (the
         fleet-manager role; the tombstoned old generation can never
@@ -616,17 +681,35 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
                 dead = [(rid, info) for rid, info in procs.items()
                         if info["proc"].poll() is not None]
             for rid, info in dead:
+                # the dead incarnation may have bumped its own
+                # generation (self-fence -> rejoin) far past what we
+                # spawned it with, and the tombstone burns everything
+                # at or below it — ask the directory, don't guess
+                gen = info["generation"] + 1
                 try:
-                    start_agent(rid, info["generation"] + 1)
-                except Exception:   # noqa: BLE001 directory may be
-                    time.sleep(0.1)  # mid-restart; retry next tick
+                    tomb = dc.stats()["tombstones"].get(rid)
+                    if tomb is not None:
+                        gen = max(gen, int(tomb) + 1)
+                except Exception:   # noqa: BLE001
+                    pass
+                try:
+                    start_agent(rid, gen)
+                except Exception as e:   # noqa: BLE001 directory may
+                    sup_errors.append(      # be mid-restart: retry
+                        f"{rid} gen{gen}: "
+                        f"{type(e).__name__}: {e}")
+                    time.sleep(0.1)
             stop_all.wait(0.05)
 
     sup = threading.Thread(target=supervisor, name="fleet-supervisor",
                            daemon=True)
     sup.start()
 
-    dc = DirectoryClient(SocketTransport(("127.0.0.1", dport)))
+    from ray_tpu.serve.fleet.replication import (
+        FailoverDirectoryClient)
+    dc = FailoverDirectoryClient(
+        [SocketTransport(("127.0.0.1", dport)),
+         SocketTransport(("127.0.0.1", sport))])
     router = FleetRouter(
         dc, lambda addr: SocketTransport((addr[1], addr[2])),
         seed=seed, snapshot_ttl_s=0.05, call_timeout_s=2.0,
@@ -744,55 +827,250 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
         return rid
 
     def op_directory_restart(ev, rng):
-        nonlocal dir_proc, dc
-        try:
-            regs_before = dc.stats()["counters"]["registers"]
-        except Exception:   # noqa: BLE001
-            regs_before = None
-        dir_proc.kill()
-        dir_proc.wait(timeout=10)
+        """Crash + same-port/same-data-dir restart of the CURRENT
+        primary: membership must recover from the WAL — immediately,
+        with no agent re-advertisement round."""
+        name = current_primary()
+        if name is None:
+            return None          # mid-failover: retry next tick
+        rec = dirs[name]
+        rec["proc"].kill()
+        rec["proc"].wait(timeout=10)
         t_down = time.time()
-        dir_proc, _ = start_directory(dport)   # SAME port
+        start_directory(name)
         gap_s = time.time() - t_down
-        # membership must recover from agent re-advertisement alone
         with state_lock:
             expect = {rid for rid, info in procs.items()
                       if info["proc"].poll() is None}
-        t_rec = None
-        deadline = time.time() + 3 * lease_ttl_s + 5.0
-        while time.time() < deadline:
-            try:
-                snap = dc.snapshot()
-            except TransportError:
-                time.sleep(0.02)
-                continue
-            got = {m["replica_id"] for m in snap["members"]
-                   if not m["expired"]}
-            if expect <= got:
-                t_rec = time.time() - t_down
-                break
-            time.sleep(0.02)
-        stats_after = dc.stats()
-        dir_restarts.append({
+        cl = dir_client(name)
+        stats_after = cl.stats()
+        got = {m["replica_id"]
+               for m in cl.snapshot()["members"]}
+        row = {
+            "directory": name,
             "gap_s": round(gap_s, 3),
-            "recovered_in_s": (round(t_rec, 3)
-                               if t_rec is not None else None),
+            # counted by _recover() in the NEW process, before any
+            # agent could have re-registered
+            "recovered_members":
+                stats_after["counters"]["recovered_members"],
+            "recovered_from_wal": expect <= got,
             "expected_members": sorted(expect),
-            "registers_before_crash": regs_before,
-            "registers_after_restart":
+            "members_at_probe": sorted(got),
+            "registers_at_probe":
                 stats_after["counters"]["registers"],
-        })
+            "wal": stats_after.get("wal"),
+        }
+        dir_restarts.append(row)
         obs.dump_flight_bundle(
             flight_dir, "directory-restart", pool=router,
-            extra=dict(dir_restarts[-1],
-                       directory_stats=stats_after))
-        return "directory"
+            extra=dict(row, directory_stats=stats_after))
+        return name
+
+    def op_torn_wal_restart(ev, rng):
+        """Crash the current primary, append a TORN half-record to
+        its WAL (crash-mid-write), restart: the tail must be detected
+        and truncated — never replayed — and membership must still
+        recover."""
+        from ray_tpu.serve.fleet.wal import inject_torn_tail
+        name = current_primary()
+        if name is None:
+            return None
+        rec = dirs[name]
+        try:
+            fence_before = dir_client(name).stats()["fence_counter"]
+        except Exception:   # noqa: BLE001
+            fence_before = None
+        rec["proc"].kill()
+        rec["proc"].wait(timeout=10)
+        inject_torn_tail(rec["data_dir"])
+        t_down = time.time()
+        start_directory(name)
+        cl = dir_client(name)
+        stats_after = cl.stats()
+        row = {
+            "directory": name,
+            "gap_s": round(time.time() - t_down, 3),
+            "torn_records_truncated":
+                stats_after["counters"]["wal_torn_truncated"],
+            "recovered_members":
+                stats_after["counters"]["recovered_members"],
+            "members_at_probe": sorted(
+                m["replica_id"]
+                for m in cl.snapshot()["members"]),
+            "fence_before_crash": fence_before,
+            "fence_after_recovery": stats_after["fence_counter"],
+            "wal": stats_after.get("wal"),
+        }
+        torn_restarts.append(row)
+        obs.dump_flight_bundle(
+            flight_dir, "torn-wal-restart", pool=router,
+            extra=dict(row, directory_stats=stats_after))
+        return name
+
+    def op_primary_kill(ev, rng):
+        """PERMANENT primary death: nothing restarts d1. The standby
+        must promote itself (epoch bump folded into the fence
+        counter) and a post-failover canary must complete
+        token-identically through the promoted directory."""
+        if failover:
+            return "noop-already-failed-over"
+        if current_primary() != "d1":
+            return "noop-already-failed-over"
+        try:
+            failover["fence_high_water_before"] = \
+                dir_client("d1").stats()["fence_counter"]
+        except Exception:   # noqa: BLE001
+            failover["fence_high_water_before"] = None
+        dirs["d1"]["proc"].kill()
+        dirs["d1"]["proc"].wait(timeout=10)
+        t_kill = time.time()
+        deadline = t_kill + promote_after_s + 60.0
+        promoted = False
+        while time.time() < deadline:
+            try:
+                if dir_client("d2").ping()["role"] == "primary":
+                    promoted = True
+                    break
+            except Exception:   # noqa: BLE001
+                pass
+            time.sleep(0.05)
+        failover["promoted"] = promoted
+        failover["promoted_in_s"] = round(time.time() - t_kill, 3)
+        if promoted:
+            st = dir_client("d2").stats()
+            failover["epoch_after"] = st["epoch"]
+            failover["fence_counter_after"] = st["fence_counter"]
+            # post-failover canary: a FRESH request routed and
+            # adjudicated entirely by the promoted directory. Right
+            # after promotion the whole fleet may still be
+            # self-fenced (leases lapsed while no primary answered
+            # renews) — typed sheds here are correct behavior, so
+            # retry until the agents re-register under the new
+            # primary
+            prompt = prompts[0]
+            canary_deadline = time.time() + 60.0
+            tries = 0
+            while True:
+                tries += 1
+                try:
+                    h = router.submit(
+                        prompt, max_new_tokens=max_new_tokens,
+                        trace_id="canary-post-failover")
+                    toks = h.result()
+                    failover["canary"] = {
+                        "token_identical":
+                            toks == want[tuple(prompt)],
+                        "served_by": h.replica_tag,
+                        "resubmits": h.resubmits,
+                        "tries": tries}
+                    break
+                except Exception as e:   # noqa: BLE001
+                    failover["canary"] = {
+                        "token_identical": False,
+                        "error": type(e).__name__,
+                        "tries": tries}
+                    if time.time() > canary_deadline:
+                        break
+                    time.sleep(0.1)
+            # stash the promoted log NOW: a later crash/restart op
+            # hitting d2 wipes its in-memory events (only durable
+            # state rides the WAL)
+            try:
+                failover["d2_events"] = \
+                    dir_client("d2").events()["events"]
+            except Exception:   # noqa: BLE001
+                failover["d2_events"] = []
+        obs.dump_flight_bundle(
+            flight_dir, "primary-failover", pool=router,
+            extra=dict(failover))
+        return "d1"
+
+    # ------------------------------------------- autoscaler churn
+    from ray_tpu.serve.fleet.provider import FleetCapacityProvider
+    provider = FleetCapacityProvider(
+        endpoints, model=model, token_delay_s=token_delay_s,
+        rid_prefix="churn", spawn_timeout_s=240.0, env=env)
+    churn_threads = []
+
+    def op_autoscale_churn(ev, rng):
+        """The autoscaler's lifecycle, driven end-to-end: provider
+        ticket -> real agent process (spawn -> register -> warm) ->
+        router harvest -> serve under load -> health-gated drain +
+        lease retirement + tombstone -> process reap. Churn agents
+        are provider-owned, NOT in ``procs``, so the supervisor never
+        resurrects a deliberately retired one."""
+        ticket = provider.request()
+        row = {"ticket": ticket, "state": "provisioning",
+               "t_request": round(time.time() - t0, 3)}
+        churns.append(row)
+
+        def _lifecycle():
+            t_spawn = time.time()
+            ready = False
+            while time.time() < t_spawn + 240.0 \
+                    and not stop_all.is_set():
+                try:
+                    ready = provider.ready(ticket)
+                except Exception as e:   # noqa: BLE001
+                    row["state"] = \
+                        f"spawn-failed:{type(e).__name__}"
+                    return
+                if ready:
+                    break
+                time.sleep(0.1)
+            if not ready:
+                row["state"] = "never-ready"
+                return
+            row["ready_in_s"] = round(time.time() - t_spawn, 3)
+            row["eta_hint_s"] = round(provider.eta_s(ticket), 3)
+            idx = router.add_replica_for_ticket(ticket)
+            row["added_idx"] = idx
+            row["state"] = "serving"
+            # let it take real traffic before retiring it
+            time.sleep(max(2.0 * lease_ttl_s, 1.0))
+            # the drain may race a failover window in which the
+            # agent is self-fenced (lease lapsed -> not routable):
+            # keep retrying until it rejoins and drains cleanly
+            retired = []
+            retire_deadline = time.time() + 90.0
+            while (not retired
+                   and time.time() < retire_deadline
+                   and not stop_all.is_set()):
+                retired = router.scale_down(1, rids=[ticket])
+                if not retired:
+                    time.sleep(0.2)
+            row["retired_idxs"] = retired
+            provider.release(ticket)
+            chk_deadline = time.time() + 30.0
+            while time.time() < chk_deadline:
+                try:
+                    snap = dc.snapshot()
+                    row["absent_after_retire"] = ticket not in {
+                        m["replica_id"] for m in snap["members"]}
+                    row["tombstoned"] = ticket in dc.stats()[
+                        "tombstones"]
+                    if (row.get("absent_after_retire")
+                            and row.get("tombstoned")):
+                        break
+                except Exception:   # noqa: BLE001
+                    pass
+                time.sleep(0.2)
+            row["state"] = "retired"
+
+        th = threading.Thread(target=_lifecycle,
+                              name=f"churn-{ticket}", daemon=True)
+        churn_threads.append(th)
+        th.start()
+        return ticket
 
     schedule = chaos.make_fleet_schedule(seed, duration_s,
                                          partition_s=partition_s)
     injector = chaos.FleetChaosInjector(
         schedule, {"kill_agent": op_kill, "partition": op_partition,
-                   "directory_restart": op_directory_restart},
+                   "directory_restart": op_directory_restart,
+                   "primary_kill": op_primary_kill,
+                   "torn_wal_restart": op_torn_wal_restart,
+                   "autoscale_churn": op_autoscale_churn},
         seed=seed)
 
     # -------------------------------------------------- trace load
@@ -891,6 +1169,10 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
                     resubmitted_ok[0] += 1
             else:
                 results["mismatched"] += 1
+    # autoscale churn settles before load stops: the retired agent
+    # must have drained while clients were still hammering the fleet
+    for th in churn_threads:
+        th.join(timeout=300)
     stop_load.set()
     for t in threads:
         t.join(timeout=60)
@@ -937,8 +1219,9 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
         if len(live) == agents:
             break
         time.sleep(0.2)
-    assert len(live) == agents, \
-        f"only {sorted(live)} of {agents} agents alive at exit"
+    assert len(live) == agents, (
+        f"only {sorted(live)} of {agents} agents alive at exit; "
+        f"supervisor errors: {list(sup_errors)}")
 
     agent_stats = {}
     for rid, info in sorted(live.items()):
@@ -1023,11 +1306,93 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
         # dumped by the agent's own process, not the harness
         assert fb[-1]["pid"] != os.getpid()
     for d in dir_restarts:
-        assert d["recovered_in_s"] is not None, (
-            f"membership never recovered after directory restart: "
-            f"{d}")
+        assert d["recovered_from_wal"], (
+            f"membership did not recover from the WAL after the "
+            f"directory restart: {d}")
+        assert d["recovered_members"] >= 1, (
+            f"restarted directory recovered an empty table: {d}")
         assert "directory-restart" in reasons
-    # the router bridged the directory outage from its stale cache
+    # torn WAL tail: detected, truncated, never replayed — and the
+    # rest of the log still recovered membership
+    assert torn_restarts, "schedule never fired a torn_wal_restart"
+    for d in torn_restarts:
+        assert d["torn_records_truncated"] >= 1, (
+            f"torn WAL tail was not detected/truncated: {d}")
+        assert d["recovered_members"] >= 1, (
+            f"torn-tail recovery lost the whole table: {d}")
+        assert (d["fence_before_crash"] is None
+                or d["fence_after_recovery"]
+                >= d["fence_before_crash"]), (
+            f"fence counter regressed across torn-WAL recovery: {d}")
+        assert "torn-wal-restart" in reasons
+    # permanent primary loss: the standby promoted and adjudicated a
+    # fresh token-identical canary
+    assert failover.get("promoted"), (
+        f"standby never promoted after the permanent primary kill: "
+        f"{failover}")
+    assert failover["canary"].get("token_identical"), (
+        f"post-failover canary did not complete token-identically: "
+        f"{failover['canary']}")
+    assert "primary-failover" in reasons
+    # fencing tokens are MONOTONIC across the failover, proven from
+    # the promoted directory's own event log: every fence it saw
+    # replicated, then the promote bump, then every fence it issued.
+    # Prefer the live log (it has post-failover issuances too) but
+    # fall back to the log stashed at promotion time — a later
+    # crash/restart op on d2 wipes in-memory events.
+    d2_events = failover.get("d2_events") or []
+    try:
+        live = dir_client("d2").events()["events"]
+        if any(e["kind"] == "promote" for e in live):
+            d2_events = live
+    except Exception:   # noqa: BLE001
+        pass
+    promote_evs = [e for e in d2_events if e["kind"] == "promote"]
+    assert promote_evs, "promoted directory logged no promote event"
+    pi = d2_events.index(promote_evs[0])
+    pre = [e["fence"] for e in d2_events[:pi]
+           if e["kind"] in ("repl_member", "fence_issued")]
+    post = [e["fence"] for e in d2_events[pi + 1:]
+            if e["kind"] == "fence_issued"]
+    bump = promote_evs[0]
+    assert bump["fence_after"] > bump["fence_before"], bump
+    assert bump["fence_after"] > max(pre, default=0), (
+        f"promotion bump {bump} does not clear the replicated "
+        f"high-water {max(pre, default=0)}")
+    hw = failover.get("fence_high_water_before")
+    if hw is not None:
+        assert bump["fence_after"] > hw, (
+            f"promotion bump {bump} does not clear the dead "
+            f"primary's high-water {hw}")
+    assert all(b > a for a, b in zip(post, post[1:])), (
+        f"post-failover issued fences not strictly increasing: "
+        f"{post}")
+    assert all(f > bump["fence_before"] for f in post), (
+        f"a post-failover fence fell below the pre-promotion "
+        f"counter: {post} vs {bump}")
+    # force one more issuance through the promoted directory so the
+    # proof never rests on vacuous emptiness
+    fr = dc.register("fence-canary", ["loopback", "fence-canary"],
+                     0, page_size=0, min_fence=0)
+    assert fr["fence"] > bump["fence_before"]
+    assert fr["fence"] >= bump["fence_after"]
+    if hw is not None:
+        assert fr["fence"] > hw
+    dc.deregister("fence-canary", fr["fence"])
+    fence_monotonic = True
+    # autoscaler churn: every provisioned agent served, then drained
+    # + retired durably (tombstoned, absent from membership)
+    assert churns, "schedule never fired an autoscale_churn"
+    for c in churns:
+        assert c["state"] == "retired", (
+            f"churn agent never completed its lifecycle: {c}")
+        assert c.get("absent_after_retire"), (
+            f"retired churn agent still in membership: {c}")
+        assert c.get("tombstoned"), (
+            f"retired churn agent left no tombstone: {c}")
+    assert provider.live_count() == 0, (
+        f"provider leaked {provider.live_count()} agent processes")
+    # the router bridged the directory outages from its stale cache
     assert router.counters["stale_snapshots"] >= 1, (
         "router never served from a stale snapshot during the "
         "directory outage")
@@ -1040,29 +1405,42 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
     except Exception:   # noqa: BLE001
         sha = None
 
+    dirs_spawned = 2 + len(dir_restarts) + len(torn_restarts)
     artifact = {
+        "schema_version": 2,
         "notes": (
-            "Seeded cross-process fleet chaos: replica agents as "
-            "real OS processes behind the lease-fenced fleet control "
-            "plane, under trace load through the socket transport. "
-            "Faults: agent SIGKILL (directory-confirmed death, "
+            "Seeded cross-process fleet chaos over a DURABLE, "
+            "REPLICATED control plane: replica agents as real OS "
+            "processes behind a primary+standby directory pair, "
+            "under trace load through the socket transport. Faults: "
+            "agent SIGKILL (directory-confirmed death, "
             "token-identical resubmit), two-way network partition "
             "(victim self-fences on lease lapse, refuses admission, "
             "rejoins under a bumped generation), directory SIGKILL + "
-            "same-port restart (membership recovers from agent "
-            "re-advertisement; clients ride the router's stale "
-            "snapshot). Gates: zero admitted requests lost, zero "
-            "token mismatches, every fault explained by a flight "
-            "bundle, live agents quiesce leak-free."),
+            "same-port restart (membership recovers from the "
+            "WAL/snapshot, not re-advertisement), torn-WAL-tail "
+            "crash (tail truncated, never replayed, fence counter "
+            "non-regressing), PERMANENT primary kill (standby "
+            "promotes with an epoch-folded fence bump; clients fail "
+            "over; fencing provably monotonic), and autoscaler "
+            "churn (provider-spawned agent serves, then drains + "
+            "retires tombstoned, mid-campaign). Gates: zero "
+            "admitted requests lost, zero token mismatches, every "
+            "fault explained by a flight bundle, live agents "
+            "quiesce leak-free."),
         "seed": seed,
         "topology": {
             "agents": agents,
             "transport": "tcp-json-v1",
-            "processes": {"directory": 1,
-                          "agents_spawned": len(spawned) - 1
-                          - len(dir_restarts)},
+            "directories": ["primary", "standby"],
+            "processes": {
+                "directories_spawned": dirs_spawned,
+                "agents_spawned": len(spawned) - dirs_spawned,
+                "churn_agents_spawned": provider.stats["spawned"],
+            },
             "model": model,
             "lease_ttl_s": lease_ttl_s,
+            "promote_after_s": promote_after_s,
         },
         "knobs": {
             "duration_s": duration_s, "clients": clients,
@@ -1087,10 +1465,20 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
             "kills": [{k2: v for k2, v in k.items()
                        if k2 != "member"} for k in killed],
             "partitions": partitions,
-            "directory_restarts": dir_restarts,
             "canaries": [{k2: v for k2, v in c.items()
                           if k2 not in ("handle", "prompt")}
                          for c in canaries],
+        },
+        "wal_recovery": {
+            "directory_restarts": dir_restarts,
+            "torn_wal_restarts": torn_restarts,
+        },
+        "failover": {k2: v for k2, v in failover.items()
+                     if k2 != "d2_events"},
+        "fence_monotonic": fence_monotonic,
+        "autoscale_churn": {
+            "churns": churns,
+            "provider": provider.stats,
         },
         "flight_recorder": {
             "dir": flight_dir,
@@ -1099,6 +1487,8 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
             "kill_explained": True,
             "partition_explained": True,
             "directory_restart_explained": True,
+            "torn_wal_explained": True,
+            "failover_explained": True,
             "faults_explained": True,
         },
         "quiesced": True,
@@ -1110,6 +1500,7 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
     stop_all.set()
     sup.join(timeout=30)
     router.shutdown()
+    provider.stop_all()
     for p in spawned:
         if p.poll() is None:
             p.kill()

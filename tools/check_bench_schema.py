@@ -955,10 +955,25 @@ def check_fleet_chaos(obj, name, problems):
     artifacts whose run violated the cross-process availability
     contract — any lost or mismatched admitted request, a campaign
     that never fired one of its fault kinds (agent SIGKILL,
-    partition, directory crash/restart), any injected fault without
-    a flight-bundle explanation, a fleet that failed to quiesce, or
-    a missing seed/topology stamp."""
+    partition, directory crash/restart, torn WAL tail, permanent
+    primary kill, autoscaler churn), any injected fault without a
+    flight-bundle explanation, a fleet that failed to quiesce, or a
+    missing seed/topology stamp. Schema v2 (the durable/replicated
+    control plane) additionally REFUSES campaigns without FAILOVER
+    PROOF (a standby actually promoted AND a post-failover canary
+    completed token-identically AND fencing stayed monotonic across
+    the promotion) or without WAL-RECOVERY PROOF (membership
+    recovered from the log — not re-advertisement — and a torn tail
+    was truncated, never replayed)."""
     _check_fields(obj, FLEET_CHAOS_REQUIRED, name, problems)
+    ver = obj.get("schema_version")
+    if not isinstance(ver, int) or isinstance(ver, bool) or ver < 2:
+        problems.append(
+            f"{name}: fleet-chaos artifacts must stamp "
+            "'schema_version' >= 2 — pre-durability campaigns prove "
+            "nothing about control-plane loss (re-run "
+            "tools/chaos_serve.py --fleet)")
+        ver = 0
     topo = obj.get("topology")
     if not isinstance(topo, dict):
         problems.append(f"{name}: fleet artifact missing the "
@@ -985,7 +1000,11 @@ def check_fleet_chaos(obj, name, problems):
             if not isinstance(n, int) or isinstance(n, bool):
                 problems.append(f"{name}:injected: count for "
                                 f"{kind!r} must be int")
-        for kind in ("kill_agent", "partition", "directory_restart"):
+        kinds = ("kill_agent", "partition", "directory_restart")
+        if ver >= 2:
+            kinds += ("torn_wal_restart", "primary_kill",
+                      "autoscale_churn")
+        for kind in kinds:
             n = inj.get(kind)
             if not isinstance(n, int) or isinstance(n, bool) \
                     or n < 1:
@@ -1051,19 +1070,130 @@ def check_fleet_chaos(obj, name, problems):
             problems.append(
                 f"{name}:flight_recorder: campaign collected no "
                 "flight bundles")
-        for key, what in (
-                ("kill_explained", "agent SIGKILL"),
-                ("partition_explained", "partition self-fence"),
-                ("directory_restart_explained",
-                 "directory crash/restart"),
-                ("faults_explained", "complete fault set")):
+        keys = (
+            ("kill_explained", "agent SIGKILL"),
+            ("partition_explained", "partition self-fence"),
+            ("directory_restart_explained",
+             "directory crash/restart"),
+            ("faults_explained", "complete fault set"))
+        if ver >= 2:
+            keys += (
+                ("torn_wal_explained", "torn WAL tail"),
+                ("failover_explained", "permanent primary kill"))
+        for key, what in keys:
             if fr.get(key) is not True:
                 problems.append(
                     f"{name}:flight_recorder: no bundle explains "
                     f"the injected {what}")
+    if ver >= 2:
+        _check_fleet_chaos_v2(obj, name, problems)
     sha = obj.get("git_sha")
     if sha is not None and not isinstance(sha, str):
         problems.append(f"{name}: git_sha must be a string")
+
+
+def _check_fleet_chaos_v2(obj, name, problems):
+    """The durability/failover proof obligations of schema v2."""
+    # failover proof: the standby PROMOTED and then adjudicated a
+    # fresh token-identical completion — no promotion, no artifact
+    fo = obj.get("failover")
+    if not isinstance(fo, dict):
+        problems.append(f"{name}: v2 artifact missing the "
+                        "'failover' proof block")
+    else:
+        if fo.get("promoted") is not True:
+            problems.append(
+                f"{name}:failover: the standby never promoted "
+                "after the permanent primary kill — the campaign "
+                "proves nothing about control-plane loss")
+        ep = fo.get("epoch_after")
+        if not isinstance(ep, int) or isinstance(ep, bool) \
+                or ep < 1:
+            problems.append(
+                f"{name}:failover: promotion must record an epoch "
+                "bump ('epoch_after' >= 1)")
+        can = fo.get("canary")
+        if not isinstance(can, dict) \
+                or can.get("token_identical") is not True:
+            problems.append(
+                f"{name}:failover: no post-failover canary "
+                "completed token-identically through the promoted "
+                "directory — availability after failover is "
+                "unproven")
+    if obj.get("fence_monotonic") is not True:
+        problems.append(
+            f"{name}: 'fence_monotonic' is not true — the run did "
+            "not prove fencing tokens survive the failover without "
+            "regressing")
+    # WAL-recovery proof: a crash-restarted directory recovered
+    # membership from its own log, and a torn tail was truncated
+    wr = obj.get("wal_recovery")
+    if not isinstance(wr, dict):
+        problems.append(f"{name}: v2 artifact missing the "
+                        "'wal_recovery' proof block")
+    else:
+        drs = wr.get("directory_restarts")
+        if not isinstance(drs, list) or not drs:
+            problems.append(
+                f"{name}:wal_recovery: no directory crash/restart "
+                "recorded — durability is unproven")
+        else:
+            for i, d in enumerate(drs):
+                if not isinstance(d, dict) \
+                        or d.get("recovered_from_wal") is not True:
+                    problems.append(
+                        f"{name}:wal_recovery[{i}]: membership did "
+                        "not recover from the WAL (agent "
+                        "re-advertisement is not durability)")
+                elif not (isinstance(d.get("recovered_members"),
+                                     int)
+                          and d["recovered_members"] >= 1):
+                    problems.append(
+                        f"{name}:wal_recovery[{i}]: restart "
+                        "recovered an empty membership table")
+        trs = wr.get("torn_wal_restarts")
+        if not isinstance(trs, list) or not trs:
+            problems.append(
+                f"{name}:wal_recovery: no torn-WAL-tail "
+                "crash/restart recorded — the truncate-don't-replay "
+                "discipline is unproven")
+        else:
+            for i, d in enumerate(trs):
+                if not isinstance(d, dict) \
+                        or not (isinstance(
+                            d.get("torn_records_truncated"), int)
+                            and d["torn_records_truncated"] >= 1):
+                    problems.append(
+                        f"{name}:torn_wal_restarts[{i}]: no torn "
+                        "record was detected/truncated")
+                elif not (isinstance(d.get("recovered_members"),
+                                     int)
+                          and d["recovered_members"] >= 1):
+                    problems.append(
+                        f"{name}:torn_wal_restarts[{i}]: torn-tail "
+                        "recovery lost the whole table")
+    # autoscaler-churn proof: a provider-provisioned agent served
+    # and then retired durably (drained, tombstoned, absent)
+    ac = obj.get("autoscale_churn")
+    if not isinstance(ac, dict):
+        problems.append(f"{name}: v2 artifact missing the "
+                        "'autoscale_churn' block")
+    else:
+        churns = ac.get("churns")
+        if not isinstance(churns, list) or not churns:
+            problems.append(
+                f"{name}:autoscale_churn: no churn lifecycle "
+                "recorded")
+        else:
+            for i, c in enumerate(churns):
+                if not isinstance(c, dict) \
+                        or c.get("state") != "retired" \
+                        or c.get("absent_after_retire") is not True \
+                        or c.get("tombstoned") is not True:
+                    problems.append(
+                        f"{name}:autoscale_churn[{i}]: churn agent "
+                        "did not complete its lifecycle (serve -> "
+                        "drain -> tombstoned retirement)")
 
 
 SERVE_TRACE_REQUIRED = {
