@@ -20,6 +20,9 @@ processes behind a pluggable transport.
   client routers/agents hold.
 - ``provider``: fleet-integrated autoscaler capacity — tickets that
   spawn/retire real agent processes (or loopback agents in-process).
+- ``telemetry``: the fleet observability plane — cursor-resumed
+  cross-process scrape, NTP-style clock alignment, trace stitching,
+  and cluster flight bundles.
 
 Attribute access is lazy (PEP 562): ``engine_pool`` imports
 ``fleet.routing`` for the shared core, while ``fleet.agent`` imports
@@ -43,6 +46,10 @@ _EXPORTS = {
     "FailoverDirectoryClient": "replication",
     "FleetCapacityProvider": "provider",
     "LoopbackAgentProvider": "provider",
+    "TelemetryCollector": "telemetry",
+    "ClockOffsetEstimator": "telemetry",
+    "merge_prometheus_texts": "telemetry",
+    "load_cluster_bundle": "telemetry",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -38,6 +38,7 @@ runs llama_tiny fp32 greedy in every agent process.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -511,6 +512,8 @@ class ReplicaAgent:
             return {"rid": rid, "dedup": True,
                     "generation": self.generation}
         self.counters["submits"] += 1
+        self.events.append("submit", rid=rid,
+                           data={"trace_id": trace_id, "key": key})
         threading.Thread(target=self._pump, args=(rec,),
                          name=f"agent-pump-{rid}",
                          daemon=True).start()
@@ -520,15 +523,29 @@ class ReplicaAgent:
     def _pump(self, rec: Dict[str, Any]) -> None:
         """Drain the engine stream into the poll buffer."""
         try:
+            first = True
             for tok in rec["handle"].stream():
+                if first:
+                    first = False
+                    self.events.append(
+                        "first_token", rid=rec["rid"],
+                        data={"trace_id": rec["trace_id"]})
                 with self._lock:
                     rec["tokens"].append(int(tok))
             with self._lock:
                 rec["done"] = True
+            self.events.append(
+                "retire", rid=rec["rid"],
+                data={"trace_id": rec["trace_id"],
+                      "n_tokens": len(rec["tokens"])})
         except BaseException as e:
             with self._lock:
                 if rec["error"] is None:
                     rec["error"] = wire.err(e)["error"]
+            self.events.append(
+                "failed", rid=rec["rid"],
+                data={"trace_id": rec["trace_id"],
+                      "error": type(e).__name__})
         finally:
             done_hook = getattr(self.engine, "request_done", None)
             if done_hook is not None:
@@ -645,6 +662,32 @@ class ReplicaAgent:
                            data={"duration_s": duration_s})
         return {"until_s": duration_s}
 
+    def rpc_telemetry(self, cursor: int = 0,
+                      limit: int = 256) -> Dict[str, Any]:
+        """The fleet scrape seam (serve/fleet/telemetry.py): this
+        process's Prometheus exposition, a cursored window of its
+        event log, and a clock sample the collector turns into an
+        NTP-style offset estimate. Served even while FENCED — an
+        operator needs telemetry from a sick member most of all."""
+        from ray_tpu.util import metrics
+        window, next_cursor, dropped = obs.event_window(
+            self.events.snapshot(), self.events.total, cursor, limit)
+        return {
+            "role": "agent",
+            "replica_id": self.replica_id,
+            "generation": self.generation,
+            "fence": self.fence,
+            "state": self.state,
+            "pid": os.getpid(),
+            "clock": {"mono": time.monotonic(),
+                      "wall": time.time()},
+            "metrics_text": metrics.prometheus_text(),
+            "events": obs.as_dicts(window),
+            "cursor": next_cursor,
+            "events_total": self.events.total,
+            "dropped": dropped,
+        }
+
     def rpc_shutdown(self) -> Dict[str, Any]:
         threading.Thread(target=self.shutdown, daemon=True).start()
         return {"ok": True}
@@ -730,6 +773,12 @@ class AgentClient:
     def inject_partition(self, duration_s: float) -> Dict[str, Any]:
         return self._t.call("inject_partition",
                             {"duration_s": duration_s},
+                            timeout_s=self._timeout_s)
+
+    def telemetry(self, cursor: int = 0,
+                  limit: int = 256) -> Dict[str, Any]:
+        return self._t.call("telemetry",
+                            {"cursor": cursor, "limit": limit},
                             timeout_s=self._timeout_s)
 
     def shutdown(self) -> Dict[str, Any]:

@@ -46,6 +46,7 @@ contract:
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -109,6 +110,9 @@ class FleetDirectory:
         self._replicator = replicator
         self.events: collections.deque = collections.deque(
             maxlen=4096)
+        # monotone per-process event counter: the telemetry scrape
+        # cursors over it exactly like an EventLog seq
+        self._event_seq = 0
         self.counters = {"registers": 0, "renews": 0,
                          "stale_fence_rejects": 0,
                          "unknown_member_rejects": 0,
@@ -132,8 +136,9 @@ class FleetDirectory:
     # ------------------------------------------------- durable state
 
     def _event(self, kind: str, **fields) -> None:
-        ev = {"t": round(self._now(), 4), "kind": kind,
-              "epoch": self.epoch}
+        ev = {"seq": self._event_seq, "t": round(self._now(), 4),
+              "kind": kind, "epoch": self.epoch}
+        self._event_seq += 1
         ev.update(fields)
         self.events.append(ev)
 
@@ -405,6 +410,51 @@ class FleetDirectory:
                     "fence_counter": self._fence_counter,
                     "members": len(self._members)}
 
+    def rpc_telemetry(self, cursor: int = 0,
+                      limit: int = 256) -> Dict[str, Any]:
+        """The fleet scrape seam, control-plane side. Served by
+        primaries AND standbys (no ``_require_primary`` — an
+        operator needs the standby's view during a failover most of
+        all). The directory's dict events are rendered in the common
+        telemetry event shape (seq/t/type/rid/data) so the collector
+        merges them with agent/router EventLog streams untranslated.
+        """
+        from ray_tpu.util import metrics
+        cursor = max(0, int(cursor))
+        limit = max(1, int(limit))
+        with self._lock:
+            evs = list(self.events)
+            total = self._event_seq
+            role = self.role
+            epoch = self.epoch
+            fence = self._fence_counter
+        oldest = evs[0]["seq"] if evs else total
+        dropped = max(0, oldest - cursor)
+        window = [e for e in evs if e["seq"] >= cursor][:limit]
+        next_cursor = (window[-1]["seq"] + 1) if window \
+            else max(cursor, total)
+        events = [{"seq": e["seq"], "t": e["t"], "type": e["kind"],
+                   "rid": e.get("replica_id"), "sid": None,
+                   "data": {k: v for k, v in e.items()
+                            if k not in ("seq", "t", "kind",
+                                         "replica_id")}}
+                  for e in window]
+        return {
+            "role": "directory",
+            "replica_id": f"directory-{role}",
+            "generation": epoch,
+            "fence": fence,
+            "state": role,
+            "pid": os.getpid(),
+            "clock": {"mono": time.monotonic(),
+                      "wall": time.time()},
+            "metrics_text": metrics.prometheus_text(),
+            "events": events,
+            "cursor": next_cursor,
+            "events_total": total,
+            "dropped": dropped,
+        }
+
     # ------------------------------------------------- replication
 
     def rpc_repl_sync(self, epoch: int, seq: int,
@@ -561,6 +611,12 @@ class DirectoryClient:
 
     def role(self) -> Dict[str, Any]:
         return self._t.call("role", {}, timeout_s=self._timeout_s)
+
+    def telemetry(self, cursor: int = 0,
+                  limit: int = 256) -> Dict[str, Any]:
+        return self._t.call("telemetry",
+                            {"cursor": cursor, "limit": limit},
+                            timeout_s=self._timeout_s)
 
     def promote(self, reason: str = "",
                 min_fence: int = 0) -> Dict[str, Any]:

@@ -193,7 +193,8 @@ class FailoverDirectoryClient:
 
     _METHODS = frozenset((
         "ping", "register", "renew", "deregister", "confirm_dead",
-        "snapshot", "stats", "events", "role", "promote"))
+        "snapshot", "stats", "events", "role", "promote",
+        "telemetry"))
 
     def __init__(self, transports: List[Transport],
                  timeout_s: float = 2.0):

@@ -273,6 +273,10 @@ class FleetRouter:
         self._scale_seq = 0
         self.capacity_hint_fn: Optional[Callable[[], float]] = None
         self.events = obs.EventLog(2048, name="router")
+        # attached by TelemetryCollector.attach(): confirmed deaths
+        # then pull a cluster-wide flight bundle, not just this
+        # process's view
+        self.telemetry_collector = None
         self.counters = {"routed": 0, "requeues": 0,
                          "deaths_confirmed": 0, "suspects": 0,
                          "confirm_inconclusive": 0,
@@ -291,6 +295,11 @@ class FleetRouter:
         if self._stopped:
             raise EngineShutdown("fleet router stopped")
         prompt = list(prompt_ids)
+        self.events.append(
+            "submit", sid=session_id,
+            data={"trace_id": trace_id,
+                  "n_prompt": len(prompt),
+                  "max_new_tokens": int(max_new_tokens)})
         h = FleetRequestHandle(self, prompt, max_new_tokens,
                                deadline_s, session_id, trace_id)
         member, rid = self._submit_once(prompt, max_new_tokens,
@@ -543,6 +552,17 @@ class FleetRouter:
                            "generation": member.generation,
                            "verdict": verdict,
                            "cause": repr(cause)})
+            except Exception:
+                pass
+        if self.telemetry_collector is not None:
+            try:
+                self.telemetry_collector.on_fault(
+                    f"agent-dead-{member.replica_id}",
+                    trigger={"kind": "confirmed_death",
+                             "replica_id": member.replica_id,
+                             "generation": member.generation,
+                             "fence": member.fence,
+                             "cause": type(cause).__name__})
             except Exception:
                 pass
 

@@ -22,6 +22,11 @@ class HTTPProxy:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self.host = host
         self.port = port
+        # Optional fleet TelemetryCollector (serve/fleet/telemetry.py):
+        # when attached, /-/metrics serves the CLUSTER exposition —
+        # every member's families re-labeled member=<name> plus
+        # collector health — instead of just this process's registry.
+        self.telemetry_collector = None
         self._handles: Dict[str, Any] = {}
         self._runner = None
         self._thread: Optional[threading.Thread] = None
@@ -296,6 +301,37 @@ class HTTPProxy:
         return web.json_response({"status": "ok",
                                   "deployments": list_deployments()})
 
+    def attach_telemetry(self, collector) -> "HTTPProxy":
+        """Point /-/metrics at a fleet ``TelemetryCollector`` so one
+        curl returns the whole cluster's exposition (per-member
+        labels + scrape/clock health) instead of only this
+        process's registry."""
+        self.telemetry_collector = collector
+        return self
+
+    async def _metrics(self, request):
+        """Prometheus exposition. With a fleet collector attached
+        this is the AGGREGATED view (member-labeled families from
+        every scraped process + collector health gauges); otherwise
+        it falls back to the local registry so the endpoint is
+        always live."""
+        from aiohttp import web
+        col = self.telemetry_collector
+        loop = asyncio.get_event_loop()
+        if col is not None:
+            # metrics_text() takes the collector lock and walks every
+            # member's scraped text: off the event loop.
+            text = await loop.run_in_executor(self._pool,
+                                              col.metrics_text)
+        else:
+            from ray_tpu.util import metrics
+            text = await loop.run_in_executor(self._pool,
+                                              metrics.prometheus_text)
+        return web.Response(
+            text=text,
+            content_type="text/plain",
+            charset="utf-8")
+
     def _run(self):
         from aiohttp import web
         loop = asyncio.new_event_loop()
@@ -303,6 +339,7 @@ class HTTPProxy:
         asyncio.set_event_loop(loop)
         app = web.Application()
         app.router.add_get("/-/healthz", self._health)
+        app.router.add_get("/-/metrics", self._metrics)
         app.router.add_route("*", "/{deployment}", self._dispatch)
         app.router.add_route("*", "/{deployment}/{tail:.+}",
                              self._dispatch_route)

@@ -44,6 +44,7 @@ import itertools
 import json
 import os
 import time
+import warnings
 from typing import Any, Dict, Iterable, List, Optional
 
 # Event tuple layout: (seq, t, etype, rid, sid, data)
@@ -129,6 +130,26 @@ def as_dicts(events: Iterable[tuple]) -> List[Dict[str, Any]]:
              else e[RID],
              "sid": e[SID], "data": _jsonable(e[DATA])}
             for e in events]
+
+
+def event_window(events: List[tuple], total: int, cursor: int,
+                 limit: int) -> tuple:
+    """Cursored read over a bounded ring snapshot: the scrape seam.
+
+    Returns ``(window, next_cursor, dropped)`` where ``window`` is
+    the (<= limit) events with ``seq >= cursor``, ``next_cursor``
+    resumes exactly after the last event handed out, and ``dropped``
+    counts events the ring already overwrote past the cursor — the
+    collector surfaces that as data loss instead of silently skipping.
+    """
+    cursor = max(0, int(cursor))
+    limit = max(1, int(limit))
+    oldest = events[0][SEQ] if events else total
+    dropped = max(0, oldest - cursor)
+    window = [e for e in events if e[SEQ] >= cursor][:limit]
+    next_cursor = (window[-1][SEQ] + 1) if window \
+        else max(cursor, total)
+    return window, next_cursor, dropped
 
 
 def _jsonable(x: Any) -> Any:
@@ -514,8 +535,52 @@ def dump_flight_bundle(dirpath: Optional[str], reason: str, *,
 
 
 def load_flight_bundle(bdir: str) -> Dict[str, Any]:
+    """Load a bundle for postmortem reading.
+
+    ``events.jsonl`` is parsed with the WAL torn-tail discipline
+    (serve/fleet/wal.py): the dumper may have died mid-append, so a
+    final line that does not parse — or a tail with no terminating
+    newline — marks a torn tail. It is truncated in place with a
+    warning and everything before it is returned; a postmortem reader
+    must never raise over the very crash it is documenting. A torn
+    line ANYWHERE but the tail is real corruption and still raises.
+    """
     with open(os.path.join(bdir, "bundle.json")) as f:
-        return json.load(f)
+        bundle = json.load(f)
+    epath = os.path.join(bdir, "events.jsonl")
+    if os.path.exists(epath):
+        events: List[Dict[str, Any]] = []
+        torn = 0
+        with open(epath, "r+") as f:
+            good_end = 0
+            raw = f.read()
+            lines = raw.split("\n")
+            # a non-empty final element means the last write lost its
+            # newline mid-append — that fragment is torn by definition
+            complete, fragment = lines[:-1], lines[-1]
+            for i, line in enumerate(complete):
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    if i != len(complete) - 1 or fragment:
+                        raise
+                    torn += 1
+                    break
+                good_end += len(line.encode("utf-8")) + 1
+            if fragment:
+                torn += 1
+            if torn:
+                warnings.warn(
+                    f"flight bundle {bdir}: events.jsonl has a torn "
+                    f"final line ({torn} record(s) truncated, "
+                    f"{len(events)} retained) — the dumper likely "
+                    f"died mid-append", RuntimeWarning,
+                    stacklevel=2)
+                f.seek(good_end)
+                f.truncate(good_end)
+        bundle["events_jsonl"] = events
+        bundle["events_torn_truncated"] = torn
+    return bundle
 
 
 # --------------------------------------------------------- phase metrics

@@ -45,15 +45,38 @@ def clear_registry():
 
 class Metric:
     TYPE = "untyped"
+    # Tag keys the exposition format itself claims for this type —
+    # user labels must not shadow them (e.g. "le" on histograms).
+    RESERVED_TAG_KEYS: Tuple[str, ...] = ()
 
     def __init__(self, name: str, description: str = "",
                  tag_keys: Sequence[str] = ()):
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys)
+        if len(set(self.tag_keys)) != len(self.tag_keys):
+            raise ValueError(
+                f"metric {name!r}: duplicate tag keys "
+                f"{list(self.tag_keys)}")
+        for reserved in self.RESERVED_TAG_KEYS:
+            if reserved in self.tag_keys:
+                raise ValueError(
+                    f"metric {name!r}: tag key {reserved!r} is "
+                    f"reserved by the {self.TYPE} exposition format")
         self._default_tags: Dict[str, str] = {}
         self._lock = threading.Lock()
         with _registry_lock:
+            prior = _registry.get(name)
+            if prior is not None and (
+                    prior.TYPE != self.TYPE
+                    or prior.tag_keys != self.tag_keys):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{prior.TYPE}{list(prior.tag_keys)}; refusing "
+                    f"colliding re-registration as "
+                    f"{self.TYPE}{list(self.tag_keys)} — a merged "
+                    f"scrape would expose two families under one "
+                    f"name")
             _registry[name] = self
 
     def set_default_tags(self, tags: Dict[str, str]):
@@ -118,6 +141,7 @@ class Gauge(Metric):
 
 class Histogram(Metric):
     TYPE = "histogram"
+    RESERVED_TAG_KEYS = ("le",)
 
     def __init__(self, name, description="",
                  boundaries: Sequence[float] = (), tag_keys=()):
@@ -166,12 +190,16 @@ def _fmt_tags(tags: Tuple) -> str:
 
 
 def prometheus_text() -> str:
-    """Prometheus exposition format for every registered metric."""
+    """Prometheus exposition format for every registered metric.
+
+    Deterministic: families sort by name and samples by their tag
+    tuple, so two scrapes of the same state are byte-identical and a
+    multi-process merged scrape is diffable."""
     lines: List[str] = []
-    for m in registry().values():
+    for _, m in sorted(registry().items()):
         lines.append(f"# HELP {m.name} {m.description}")
         lines.append(f"# TYPE {m.name} {m.TYPE}")
-        for tags, value in m._samples():
+        for tags, value in sorted(m._samples(), key=lambda kv: kv[0]):
             if isinstance(m, Histogram):
                 cum = 0
                 for bound, c in zip(m.boundaries + [float("inf")],

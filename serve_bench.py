@@ -1443,6 +1443,205 @@ def run_fleet_autoscale(args):
     }
 
 
+def run_fleet_trace(args):
+    """Cross-process trace capture (serve_bench.py --fleet N --trace):
+    the fleet observability plane's acceptance proof. A directory and
+    N ReplicaAgent OS processes serve a FleetRouter in THIS process;
+    a TelemetryCollector scrapes every role over the transport,
+    estimates per-member clock offsets NTP-style, and merges the
+    event logs onto one timebase. Mid-run the serving agent is
+    SIGKILLed before its first token, so the router's confirmed-death
+    path resubmits token-identically to a second agent — one trace_id
+    then spans >= 3 OS processes (router pid, victim agent pid,
+    resubmit agent pid), stitched on the aligned timebase with the
+    offset uncertainty stamped on every span.
+
+    In-run gates (the artifact also re-checks via
+    tools/check_bench_schema.py): the proof trace stitches across
+    >= 3 distinct pids, every member's offset uncertainty stays under
+    --fleet-offset-bound, and the kill is explained by exactly the
+    cluster flight bundle the death hook pulled."""
+    import os
+    import signal
+    import socket as _socket
+    import tempfile
+
+    from tools.chaos_serve import _spawn_fleet_proc, _wait_ready
+    from ray_tpu.serve import obs
+    from ray_tpu.serve.fleet.directory import DirectoryClient
+    from ray_tpu.serve.fleet.router import FleetRouter
+    from ray_tpu.serve.fleet.telemetry import TelemetryCollector
+    from ray_tpu.serve.fleet.transport import SocketTransport
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    n_agents = max(2, args.fleet)
+    lease_ttl_s = 0.6
+    token_delay_s = 0.25      # first token lands late enough that the
+    offset_bound_s = 0.05     # kill always beats it
+    gen_tokens = min(args.gen_tokens, 6)
+    prng = np.random.RandomState(args.seed)
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dport = s.getsockname()[1]
+    s.close()
+    data_dir = tempfile.mkdtemp(prefix="fleet-trace-dir-")
+    dproc = _spawn_fleet_proc(
+        ["ray_tpu.serve.fleet.directory", "--port", str(dport),
+         "--lease-ttl-s", str(lease_ttl_s), "--data-dir", data_dir],
+        env, repo)
+    _wait_ready(dproc, "directory")
+
+    procs = {}
+    for i in range(n_agents):
+        rid = f"tr{i}"
+        procs[rid] = _spawn_fleet_proc(
+            ["ray_tpu.serve.fleet.agent", "--replica-id", rid,
+             "--directory-port", str(dport), "--model", "fake",
+             "--token-delay-s", str(token_delay_s)],
+            env, repo)
+    for rid, p in procs.items():
+        _wait_ready(p, rid)
+
+    cluster_dir = tempfile.mkdtemp(prefix="fleet-trace-bundles-")
+    router = FleetRouter(
+        DirectoryClient(SocketTransport(("127.0.0.1", dport)),
+                        timeout_s=5.0),
+        lambda addr: SocketTransport((addr[1], addr[2])),
+        seed=args.seed, snapshot_ttl_s=0.05, call_timeout_s=2.0,
+        poll_interval_s=0.004)
+    col = TelemetryCollector(
+        router, events_per_scrape=512, cluster_dir=cluster_dir,
+        offset_bound_s=offset_bound_s).attach()
+
+    try:
+        deadline = time.monotonic() + 60.0
+        while (router.active_count() < n_agents
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert router.active_count() >= n_agents, (
+            f"only {router.active_count()} of {n_agents} agents "
+            f"registered")
+        col.scrape_once()       # baseline offsets for every role
+
+        def prompt():
+            return prng.randint(1, 900, size=8).tolist()
+
+        # --- the proof request: killed mid-flight, resubmitted ----
+        proof_tid = obs.mint_trace_id()
+        h = router.submit(prompt(), max_new_tokens=gen_tokens,
+                          trace_id=proof_tid)
+        victim = h.replica_idx
+        # capture the victim's submit event WHILE it can still be
+        # scraped — after the kill its log is gone
+        col.scrape_once()
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait(timeout=10)
+        print(f"killed serving agent {victim} "
+              f"(pid {procs[victim].pid}) before first token",
+              flush=True)
+        toks = h.result()       # rides the confirmed-death resubmit
+        survivor = h.replica_idx
+        assert survivor != victim, "resubmit landed on the dead agent"
+        requests = {proof_tid: {"outcome": "resubmitted",
+                                "n_tokens": len(toks),
+                                "killed": victim,
+                                "served_by": survivor}}
+
+        # --- undisturbed traced requests on the survivors ---------
+        for _ in range(3):
+            tid = obs.mint_trace_id()
+            hh = router.submit(prompt(), max_new_tokens=gen_tokens,
+                               trace_id=tid)
+            requests[tid] = {"outcome": "ok",
+                             "n_tokens": len(hh.result()),
+                             "served_by": hh.replica_idx}
+        col.scrape_once()       # survivor + router tail events
+
+        phases = col.request_phases()
+        for tid, row in requests.items():
+            row.update(phases.get(tid) or {})
+        proof = requests[proof_tid]
+        assert proof.get("n_processes", 0) >= 3, (
+            f"proof trace spans {proof.get('n_processes')} processes,"
+            f" need >= 3: {proof.get('spans')}")
+        members = col.members()
+        bad = {n: m["uncertainty_s"] for n, m in members.items()
+               if m["uncertainty_s"] is not None
+               and m["uncertainty_s"] > offset_bound_s}
+        assert not bad, f"offset uncertainty above bound: {bad}"
+        death_reason = f"agent-dead-{victim}"
+        explained = [b for b in col.bundles
+                     if b["reason"] == death_reason]
+        assert explained, (
+            f"no cluster bundle explains the kill: "
+            f"{[b['reason'] for b in col.bundles]}")
+
+        stitched = [tid for tid, row in requests.items()
+                    if row.get("stitched")]
+        result = {
+            "fleet": {
+                "transport": "tcp-json-v1",
+                "agents": n_agents,
+                "lease_ttl_s": lease_ttl_s,
+                "token_delay_s": token_delay_s,
+                "directory": router._directory.stats(),
+            },
+            "offset_bound_s": offset_bound_s,
+            "members": members,
+            "collector": col.health(),
+            "requests": requests,
+            "requests_n": len(requests),
+            "stitch": {
+                "traces": len(requests),
+                "stitched_traces": len(stitched),
+                "max_processes": max(
+                    row.get("n_processes", 0)
+                    for row in requests.values()),
+                "proof_trace_id": proof_tid,
+                "killed_replica": victim,
+                "resubmits": router.counters["requeues"],
+                "deaths_confirmed":
+                    router.counters["deaths_confirmed"],
+            },
+            "cluster_bundles": [
+                {"reason": b["reason"],
+                 "trigger_kind": (b.get("trigger") or {}).get(
+                     "kind")}
+                for b in col.bundles],
+            "events": col.merged_events(),
+            "trace_events": col.chrome_trace(),
+            # placement stamp: each agent process is one dp replica
+            "mesh": {"tp": 1, "replicas": n_agents},
+            "notes": "Cross-process trace capture (serve_bench.py "
+                     "--fleet N --trace): a TelemetryCollector "
+                     "scrapes directory + agent OS processes over "
+                     "the transport, aligns their monotonic clocks "
+                     "NTP-style (offset uncertainty = RTT/2, "
+                     "stamped per span), and merges the event logs. "
+                     "The proof request's serving agent is "
+                     "SIGKILLed before its first token; the "
+                     "confirmed-death resubmit lands on a second "
+                     "agent, so one trace_id stitches across >= 3 "
+                     "pids on the aligned timebase, and the kill is "
+                     "explained by the cluster flight bundle the "
+                     "death hook pulled.",
+        }
+        return result
+    finally:
+        router.shutdown()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        dproc.kill()
+        dproc.wait(timeout=10)
+
+
 def run_tp_ab(args):
     """Tensor-parallel A/B (serve_bench.py --tp-ab): the SAME engine,
     load shape, and greedy sampling run twice — once on a single chip
@@ -1963,6 +2162,26 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import ray_tpu
     ray_tpu.init()
+
+    if args.fleet and args.trace == "capture" and not args.autoscale:
+        result = _stamp(run_fleet_trace(args), args)
+        out = args.out or "SERVE_FLEET_TRACE_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        # self-gate: a malformed or unstitched artifact fails its
+        # OWN run
+        from tools import check_bench_schema as cbs
+        problems = []
+        cbs.check_file(out, problems)
+        for p in problems:
+            print(f"SCHEMA FAIL {p}")
+        print(json.dumps({k: result[k] for k in
+                          ("stitch", "collector", "seed", "mesh")},
+                         default=str))
+        ray_tpu.shutdown()
+        if problems:
+            raise SystemExit(1)
+        return
 
     if args.trace == "capture" and not args.autoscale:
         result = _stamp(run_trace(args), args)

@@ -1116,3 +1116,150 @@ def test_fleet_chaos_v2_rejects_incomplete_churn_lifecycle(tmp_path):
     bad["injected"]["primary_kill"] = 0
     probs = _problems_for("SERVE_FLEET_CHAOS_x.json", bad, tmp_path)
     assert any("primary_kill" in p for p in probs)
+
+
+# ---------------------------------------------------------------------------
+# SERVE_FLEET_TRACE family (serve_bench.py --fleet N --trace artifacts)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_trace_ok():
+    def member(role, pid, unc):
+        return {"role": role, "up": True, "pid": pid,
+                "generation": 0, "offset_s": 0.0001,
+                "uncertainty_s": unc, "events_total": 4,
+                "dropped": 0}
+
+    def span(member_name, role, pid, s, e):
+        return {"role": role, "replica_id": member_name, "pid": pid,
+                "generation": 0, "start_s": s, "end_s": e,
+                "offset_uncertainty_s": 0.0002,
+                "etypes": ["submit"], "rids": []}
+
+    tid = "f" * 16
+    proof = {
+        "trace_id": tid,
+        "spans": [span("router", "router", 100, 10.0, 10.4),
+                  span("tr0", "agent", 200, 10.01, 10.02),
+                  span("tr1", "agent", 300, 10.2, 10.4)],
+        "processes": [100, 200, 300], "n_processes": 3,
+        "members": ["router", "tr0", "tr1"], "stitched": True,
+        "events": 5, "outcome": "resubmitted", "n_tokens": 6,
+    }
+    events = [
+        {"member": "router", "role": "router", "pid": 100,
+         "generation": 0, "seq": 0, "t": 10.0, "local_t": 10.0,
+         "offset_uncertainty_s": 0.0, "type": "submit", "rid": None,
+         "data": {"trace_id": tid}},
+        {"member": "tr0", "role": "agent", "pid": 200,
+         "generation": 0, "seq": 0, "t": 10.011, "local_t": 10.01,
+         "offset_uncertainty_s": 0.0002, "type": "submit",
+         "rid": "tr0.g0.1", "data": {"trace_id": tid}},
+        {"member": "tr1", "role": "agent", "pid": 300,
+         "generation": 0, "seq": 0, "t": 10.19, "local_t": 10.2,
+         "offset_uncertainty_s": 0.0002, "type": "submit",
+         "rid": "tr1.g0.1", "data": {"trace_id": tid}},
+    ]
+    return {
+        "fleet": {"transport": "tcp-json-v1", "agents": 2,
+                  "lease_ttl_s": 0.6},
+        "offset_bound_s": 0.05,
+        "members": {"router": member("router", 100, 0.0),
+                    "directory": member("directory", 50, 0.0003),
+                    "tr0": member("agent", 200, 0.0002),
+                    "tr1": member("agent", 300, 0.0002)},
+        "collector": {"members": 4, "members_up": 4},
+        "requests": {tid: proof},
+        "stitch": {"traces": 1, "stitched_traces": 1,
+                   "max_processes": 3, "proof_trace_id": tid,
+                   "killed_replica": "tr0", "resubmits": 1,
+                   "deaths_confirmed": 1},
+        "events": events,
+        "trace_events": [{"ph": "M", "name": "process_name",
+                          "pid": 100, "tid": 0,
+                          "args": {"name": "router"}}],
+        "seed": 7,
+        "mesh": {"tp": 1, "replicas": 2},
+        "git_sha": "abc1234",
+    }
+
+
+def test_fleet_trace_valid_artifact_passes(tmp_path):
+    assert _problems_for("SERVE_FLEET_TRACE_x.json",
+                         _fleet_trace_ok(), tmp_path) == []
+
+
+def test_fleet_trace_rejects_offset_uncertainty_above_bound(tmp_path):
+    bad = _fleet_trace_ok()
+    bad["members"]["tr0"]["uncertainty_s"] = 0.2
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("exceeds the stamped bound" in p for p in probs)
+    # a span above the bound is refused even if the table passes
+    bad = _fleet_trace_ok()
+    tid = "f" * 16
+    bad["requests"][tid]["spans"][1]["offset_uncertainty_s"] = 0.2
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("span uncertainty" in p for p in probs)
+    # an up member with NO estimate cannot be placed at all
+    bad = _fleet_trace_ok()
+    bad["members"]["tr1"]["uncertainty_s"] = None
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("without a numeric offset uncertainty" in p
+               for p in probs)
+
+
+def test_fleet_trace_rejects_missing_member_coverage(tmp_path):
+    bad = _fleet_trace_ok()
+    del bad["members"]["directory"]
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("no 'directory' member" in p for p in probs)
+    # spans naming a member absent from the offset table are orphans
+    bad = _fleet_trace_ok()
+    del bad["members"]["tr1"]
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("absent from the offset table" in p for p in probs)
+
+
+def test_fleet_trace_rejects_unstitched_proof(tmp_path):
+    tid = "f" * 16
+    # proof trace collapsed to one process: refused
+    bad = _fleet_trace_ok()
+    req = bad["requests"][tid]
+    req["spans"] = [req["spans"][0]]
+    req["processes"], req["n_processes"] = [100], 1
+    req["stitched"] = False
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("did not stitch across >= 3 processes" in p
+               for p in probs)
+    # max_processes below 3 proves nothing about cross-process work
+    bad = _fleet_trace_ok()
+    bad["stitch"]["max_processes"] = 2
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("max_processes" in p for p in probs)
+    # a stitched flag disagreeing with the span pids is a lie
+    bad = _fleet_trace_ok()
+    bad["requests"][tid]["stitched"] = False
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("disagrees with" in p for p in probs)
+
+
+def test_fleet_trace_rejects_unaligned_timebase(tmp_path):
+    bad = _fleet_trace_ok()
+    bad["events"][2]["local_t"] = 9.0     # before its predecessor
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("BACKWARDS" in p for p in probs)
+    bad = _fleet_trace_ok()
+    bad["events"][1]["local_t"] = None    # unplaced event
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("local_t" in p for p in probs)
+
+
+def test_fleet_trace_rejects_empty_capture(tmp_path):
+    bad = _fleet_trace_ok()
+    bad["requests"] = {}
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("stitched nothing" in p for p in probs)
+    bad = _fleet_trace_ok()
+    bad["events"] = []
+    probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
+    assert any("events list is empty" in p for p in probs)

@@ -381,3 +381,58 @@ def test_phase_metrics_singleton_and_rebuild():
     m2 = obs.phase_metrics()
     assert m2 is not m1
     assert metrics.registry()["serve_phase_ttft_s"] is m2["ttft"]
+
+
+def test_event_window_cursor_resume_limit_and_dropped():
+    """The scrape seam: cursored reads over a ring snapshot resume
+    exactly, cap at limit, and COUNT overwritten events as dropped
+    instead of silently skipping them."""
+    log = obs.EventLog(capacity=8, name="win")
+    for i in range(5):
+        log.append("e", rid=i)
+    win, cur, dropped = obs.event_window(log.snapshot(), log.total,
+                                         0, limit=3)
+    assert [e[RID] for e in win] == [0, 1, 2]
+    assert cur == 3 and dropped == 0
+    win, cur, dropped = obs.event_window(log.snapshot(), log.total,
+                                         cur, limit=10)
+    assert [e[RID] for e in win] == [3, 4]
+    assert cur == 5 and dropped == 0
+    # caught up: empty window, cursor parks at total
+    win, cur, dropped = obs.event_window(log.snapshot(), log.total,
+                                         cur, limit=10)
+    assert win == [] and cur == 5 and dropped == 0
+    # ring wraps: seqs 0..4 are overwritten before the next read
+    for i in range(5, 13):
+        log.append("e", rid=i)
+    win, cur, dropped = obs.event_window(log.snapshot(), log.total,
+                                         0, limit=100)
+    assert dropped == 5                      # seqs 0..4 lost
+    assert [e[RID] for e in win] == list(range(5, 13))
+    assert cur == 13
+
+
+def test_load_flight_bundle_torn_final_line(tmp_path):
+    """The dumper can die mid-append: a torn FINAL events.jsonl line
+    is truncated (with a warning) and the rest returned; a torn line
+    anywhere else is real corruption and raises."""
+    eng = _FakeFlightEngine()
+    bdir = obs.dump_flight_bundle(str(tmp_path), "crash", engine=eng)
+    epath = os.path.join(bdir, "events.jsonl")
+    good = open(epath).read()
+    n_good = len(good.splitlines())
+    with open(epath, "a") as f:
+        f.write('{"stream": "engine", "ty')       # no newline
+    with pytest.warns(RuntimeWarning, match="torn"):
+        b = obs.load_flight_bundle(bdir)
+    assert b["events_torn_truncated"] == 1
+    assert len(b["events_jsonl"]) == n_good
+    # the torn tail was truncated IN PLACE: a second load is clean
+    assert open(epath).read() == good
+    b2 = obs.load_flight_bundle(bdir)
+    assert b2.get("events_torn_truncated", 0) == 0
+    # a complete-but-garbled line followed by valid records raises
+    with open(epath, "w") as f:
+        f.write('{"broken": \n' + good)
+    with pytest.raises(json.JSONDecodeError):
+        obs.load_flight_bundle(bdir)

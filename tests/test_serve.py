@@ -520,6 +520,81 @@ def test_http_proxy_streaming_x_replica_header(serve_rt):
         stop_http()
 
 
+def test_http_proxy_streaming_x_trace_id_echo(serve_rt):
+    """A caller-supplied X-Trace-Id comes back on the STREAMING
+    response headers (set before chunked encoding commits) and rides
+    the dict payload to the deployment, so cross-process stitching
+    can key on the id the client already holds."""
+    import urllib.request
+
+    seen = {}
+
+    @serve.deployment
+    class TokStream:
+        def __call__(self, payload):
+            seen["trace_id"] = (payload or {}).get("trace_id")
+            for i in range(2):
+                yield i
+
+    serve.run(TokStream.bind())
+    from ray_tpu.serve.http_proxy import start_http, stop_http
+    import json as _json
+    proxy = start_http(port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{proxy.port}/TokStream?stream=1",
+            data=_json.dumps({"n": 2}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Trace-Id": "t-stream-1"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            hdr = r.headers.get("X-Trace-Id")
+            lines = [l for l in r.read().decode().splitlines() if l]
+        assert hdr == "t-stream-1"
+        assert [_json.loads(l)["chunk"] for l in lines] == [0, 1]
+        assert seen["trace_id"] == "t-stream-1"
+        # no opt-in -> no header, payload untouched
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{proxy.port}/TokStream?stream=1",
+            data=_json.dumps({"n": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers.get("X-Trace-Id") is None
+            r.read()
+        assert seen["trace_id"] is None
+    finally:
+        stop_http()
+
+
+def test_http_proxy_metrics_endpoint(serve_rt):
+    """/-/metrics serves the local registry by default and the
+    aggregated fleet exposition once a collector is attached."""
+    import urllib.request
+    from ray_tpu.serve.http_proxy import start_http, stop_http
+    from ray_tpu.util import metrics
+
+    proxy = start_http(port=0)
+    try:
+        g = metrics.Gauge("proxy_smoke_gauge", "smoke")
+        g.set(3.0)
+        url = f"http://127.0.0.1:{proxy.port}/-/metrics"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            assert r.headers.get_content_type() == "text/plain"
+            text = r.read().decode()
+        assert "proxy_smoke_gauge 3.0" in text
+
+        class FakeCollector:
+            def metrics_text(self):
+                return ('serve_fleet_member_up{member="a0"} 1.0\n'
+                        'serve_fleet_members 2.0\n')
+
+        proxy.attach_telemetry(FakeCollector())
+        with urllib.request.urlopen(url, timeout=30) as r:
+            text = r.read().decode()
+        assert 'serve_fleet_member_up{member="a0"} 1.0' in text
+    finally:
+        stop_http()
+
+
 def test_streaming_failed_start_releases_slot(serve_rt):
     """A stream that fails to start (bad method) must release the
     handle's in-flight slot, or the handle wedges permanently."""

@@ -308,3 +308,48 @@ def test_dashboard_serve_endpoint(rt):
             dash.stop()
     finally:
         serve.shutdown()
+
+
+def test_prometheus_text_is_deterministic_and_sorted():
+    """The exposition is a merge input (fleet telemetry re-labels
+    and concatenates per-member scrapes): families must sort by name
+    and samples by tag tuple so two scrapes of the same state are
+    byte-identical and a multi-process merge is diffable."""
+    clear_registry()
+    # register out of order, touch tag sets out of order
+    Gauge("zz_last", "z").set(1.0)
+    c = Counter("aa_first_total", "a", tag_keys=("k",))
+    c.inc(tags={"k": "zebra"})
+    c.inc(tags={"k": "apple"})
+    Gauge("mm_mid", "m").set(2.0)
+    t1 = prometheus_text()
+    t2 = prometheus_text()
+    assert t1 == t2
+    fams = [ln.split()[2] for ln in t1.splitlines()
+            if ln.startswith("# HELP ")]
+    assert fams == sorted(fams) == ["aa_first_total", "mm_mid",
+                                    "zz_last"]
+    lines = t1.splitlines()
+    assert lines.index('aa_first_total{k="apple"} 1.0') \
+        < lines.index('aa_first_total{k="zebra"} 1.0')
+    clear_registry()
+
+
+def test_metric_rejects_label_name_collisions():
+    """One name must map to ONE family shape: re-registering with a
+    different type or tag schema would make a merged scrape expose
+    two families under one name."""
+    clear_registry()
+    Counter("col_total", "c", tag_keys=("route",))
+    # same name, same type, same tags: legal re-registration
+    Counter("col_total", "c", tag_keys=("route",))
+    with pytest.raises(ValueError):
+        Counter("col_total", "c", tag_keys=("path",))   # tag schema
+    with pytest.raises(ValueError):
+        Gauge("col_total", "c", tag_keys=("route",))    # type
+    with pytest.raises(ValueError):
+        Counter("dup_tags_total", "d", tag_keys=("a", "a"))
+    with pytest.raises(ValueError):
+        # "le" belongs to the histogram exposition itself
+        Histogram("h_s", "h", boundaries=[1.0], tag_keys=("le",))
+    clear_registry()

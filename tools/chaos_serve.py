@@ -715,6 +715,17 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
         seed=seed, snapshot_ttl_s=0.05, call_timeout_s=2.0,
         poll_interval_s=0.004, flight_dir=flight_dir)
 
+    # cluster flight recorder: the telemetry collector scrapes every
+    # role over the same transports the router routes on, aligns the
+    # per-process event streams onto the router clock, and cuts ONE
+    # cluster-wide bundle per fault (confirmed death via the router
+    # hook; self-fence / promote / recover via the scraped streams)
+    from ray_tpu.serve.fleet.telemetry import TelemetryCollector
+    cluster_dir = os.path.join(flight_dir, "cluster")
+    collector = TelemetryCollector(
+        router, events_per_scrape=512, cluster_dir=cluster_dir,
+        offset_bound_s=0.25).attach().run(interval_s=0.25)
+
     def router_member(rid):
         try:
             return router._snapshot().get(rid)
@@ -864,6 +875,14 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
         obs.dump_flight_bundle(
             flight_dir, "directory-restart", pool=router,
             extra=dict(row, directory_stats=stats_after))
+        # the fresh process's "recover" event lives only in its
+        # in-memory log, and the NEXT fault op may kill this process
+        # before the periodic scrape lands — checkpoint the cluster
+        # recorder while the op still holds it alive
+        try:
+            collector.scrape_once()
+        except Exception:   # noqa: BLE001
+            pass
         return name
 
     def op_torn_wal_restart(ev, rng):
@@ -905,6 +924,13 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
         obs.dump_flight_bundle(
             flight_dir, "torn-wal-restart", pool=router,
             extra=dict(row, directory_stats=stats_after))
+        # same as op_directory_restart: the torn-WAL "recover" event
+        # (carrying torn_truncated >= 1) dies with this process if a
+        # later primary_kill lands before the periodic scrape does
+        try:
+            collector.scrape_once()
+        except Exception:   # noqa: BLE001
+            pass
         return name
 
     def op_primary_kill(ev, rng):
@@ -983,6 +1009,12 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
         obs.dump_flight_bundle(
             flight_dir, "primary-failover", pool=router,
             extra=dict(failover))
+        # capture the promoted standby's "promote" event before a
+        # later restart op wipes its in-memory log
+        try:
+            collector.scrape_once()
+        except Exception:   # noqa: BLE001
+            pass
         return "d1"
 
     # ------------------------------------------- autoscaler churn
@@ -1397,6 +1429,50 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
         "router never served from a stale snapshot during the "
         "directory outage")
 
+    # ---------------------------------- cluster flight recorder
+    # beyond the per-process bundles above, each injected fault must
+    # be explained by ONE cluster bundle: merged offset-corrected
+    # event stream + clock-offset table from every reachable role
+    collector.stop()
+    try:
+        collector.scrape_once()   # drain events logged since the
+    except Exception:             # noqa: BLE001 last periodic tick
+        pass
+    cbundles = list(collector.bundles)
+    creasons = [str(b["reason"]) for b in cbundles]
+    for k in killed:
+        assert f"agent-dead-{k['rid']}" in creasons, (
+            f"no cluster bundle explains the SIGKILL of "
+            f"{k['rid']}; cluster reasons on disk: "
+            f"{sorted(set(creasons))}")
+    for p in partitions:
+        assert f"self_fence-{p['rid']}" in creasons, (
+            f"no cluster bundle explains the partition self-fence "
+            f"of {p['rid']}; cluster reasons on disk: "
+            f"{sorted(set(creasons))}")
+    recover_cb = [b for b in cbundles
+                  if str(b["reason"]).startswith("recover-")]
+    assert recover_cb, (
+        f"no cluster bundle explains any directory recovery; "
+        f"cluster reasons on disk: {sorted(set(creasons))}")
+    # torn-tail recovery is distinguishable in the trigger itself:
+    # the restarted primary's recover event counts truncated records
+    assert any(((b.get("trigger") or {}).get("data") or {})
+               .get("torn_truncated", 0) >= 1 for b in recover_cb), (
+        "no cluster bundle carries a recover trigger with a "
+        "truncated torn WAL tail")
+    assert any(r.startswith("promote-") for r in creasons), (
+        f"no cluster bundle explains the standby promotion; "
+        f"cluster reasons on disk: {sorted(set(creasons))}")
+    # every bundle must round-trip from disk: manifest + offset
+    # table + merged events (torn tails tolerated, never replayed)
+    from ray_tpu.serve.fleet.telemetry import load_cluster_bundle
+    for b in cbundles:
+        cb = load_cluster_bundle(b["path"])
+        assert cb["reason"] == b["reason"]
+        assert cb["members"], f"bundle {b['path']} has no members"
+    collector_health = collector.health()
+
     try:
         sha = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
@@ -1487,6 +1563,18 @@ def run_fleet_chaos(seed=47, agents=3, duration_s=4.0, clients=3,
             "kill_explained": True,
             "partition_explained": True,
             "directory_restart_explained": True,
+            "torn_wal_explained": True,
+            "failover_explained": True,
+            "faults_explained": True,
+        },
+        "cluster_flight_recorder": {
+            "dir": cluster_dir,
+            "bundles": len(cbundles),
+            "reasons": sorted(set(creasons)),
+            "collector": collector_health,
+            "kill_explained": True,
+            "partition_explained": True,
+            "recover_explained": True,
             "torn_wal_explained": True,
             "failover_explained": True,
             "faults_explained": True,

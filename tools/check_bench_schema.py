@@ -1085,6 +1085,35 @@ def check_fleet_chaos(obj, name, problems):
                 problems.append(
                     f"{name}:flight_recorder: no bundle explains "
                     f"the injected {what}")
+    # cluster flight recorder (validated-if-present; campaigns
+    # predating the telemetry collector carry no block and still
+    # pass): each fault class must also be explained by ONE
+    # cluster-wide bundle — merged offset-corrected event stream
+    # plus the clock-offset table from every reachable role
+    cfr = obj.get("cluster_flight_recorder")
+    if cfr is not None:
+        if not isinstance(cfr, dict):
+            problems.append(f"{name}: cluster_flight_recorder must "
+                            "be an object")
+        else:
+            n = cfr.get("bundles")
+            if not isinstance(n, int) or isinstance(n, bool) \
+                    or n < 1:
+                problems.append(
+                    f"{name}:cluster_flight_recorder: campaign "
+                    "collected no cluster bundles")
+            for key, what in (
+                    ("kill_explained", "agent SIGKILL"),
+                    ("partition_explained", "partition self-fence"),
+                    ("recover_explained", "directory recovery"),
+                    ("torn_wal_explained", "torn WAL tail"),
+                    ("failover_explained", "standby promotion"),
+                    ("faults_explained", "complete fault set")):
+                if cfr.get(key) is not True:
+                    problems.append(
+                        f"{name}:cluster_flight_recorder: no "
+                        f"cluster bundle explains the injected "
+                        f"{what}")
     if ver >= 2:
         _check_fleet_chaos_v2(obj, name, problems)
     sha = obj.get("git_sha")
@@ -1287,6 +1316,184 @@ def check_serve_trace(obj, name, problems):
         problems.append(f"{name}: git_sha must be a string")
 
 
+SERVE_FLEET_TRACE_REQUIRED = {
+    "fleet": dict,
+    "offset_bound_s": NUM,
+    "members": dict,
+    "collector": dict,
+    "requests": dict,
+    "stitch": dict,
+    "events": list,
+    "trace_events": list,
+    "seed": int,
+}
+
+
+def _check_fleet_trace_members(obj, name, problems):
+    """The clock-offset table: every scraped member must carry an
+    offset estimate whose RTT/2 uncertainty stays under the stamped
+    bound — an alignment looser than the bound makes cross-process
+    span ordering unfalsifiable."""
+    members = obj.get("members")
+    bound = obj.get("offset_bound_s")
+    if not isinstance(members, dict) or not members:
+        problems.append(f"{name}: members offset table is empty")
+        return set(), set()
+    roles = set()
+    pids = set()
+    for mname, m in members.items():
+        if not isinstance(m, dict):
+            problems.append(f"{name}:members[{mname}]: not an "
+                            "object")
+            continue
+        roles.add(m.get("role"))
+        if isinstance(m.get("pid"), int):
+            pids.add(m["pid"])
+        unc = m.get("uncertainty_s")
+        if m.get("up") and (not isinstance(unc, NUM)
+                            or isinstance(unc, bool)):
+            problems.append(
+                f"{name}:members[{mname}]: up member without a "
+                "numeric offset uncertainty_s — its events cannot "
+                "be placed on the aligned timebase")
+            continue
+        if isinstance(unc, NUM) and isinstance(bound, NUM) \
+                and unc > bound:
+            problems.append(
+                f"{name}:members[{mname}]: offset uncertainty "
+                f"{unc} exceeds the stamped bound {bound} — the "
+                "aligned timebase is not trustworthy")
+    for role in ("router", "directory", "agent"):
+        if role not in roles:
+            problems.append(
+                f"{name}: members table covers no '{role}' member "
+                "— the scrape missed a fleet role")
+    return set(members), pids
+
+
+def check_serve_fleet_trace(obj, name, problems):
+    """serve_bench.py --fleet N --trace artifact: the cross-process
+    stitching proof. The checker REFUSES artifacts whose alignment
+    or stitching cannot be trusted: a member whose clock-offset
+    uncertainty exceeds the stamped bound, a fleet role missing from
+    the offset table (scrape coverage hole), a proof trace spanning
+    fewer than 3 OS processes, a request index whose stitched flags
+    disagree with its span pids, spans naming members absent from
+    the offset table, or a merged stream out of order on the
+    collector timebase."""
+    _check_fields(obj, SERVE_FLEET_TRACE_REQUIRED, name, problems)
+    _check_mesh(obj, name, problems)
+    known_members, _ = _check_fleet_trace_members(obj, name,
+                                                 problems)
+    bound = obj.get("offset_bound_s")
+
+    requests = obj.get("requests")
+    if not isinstance(requests, dict) or not requests:
+        problems.append(f"{name}: request index is empty — the "
+                        "capture stitched nothing")
+        requests = {}
+    for tid, req in requests.items():
+        where = f"{name}:requests[{tid}]"
+        if not isinstance(req, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        spans = req.get("spans")
+        if not isinstance(spans, list) or not spans:
+            problems.append(f"{where}: missing spans — the trace id "
+                            "appears in no member's event log")
+            continue
+        span_pids = set()
+        for i, sp in enumerate(spans):
+            if not isinstance(sp, dict):
+                problems.append(f"{where}:spans[{i}]: not an object")
+                continue
+            if known_members and \
+                    sp.get("replica_id") not in known_members:
+                problems.append(
+                    f"{where}:spans[{i}]: member "
+                    f"{sp.get('replica_id')!r} absent from the "
+                    "offset table")
+            if isinstance(sp.get("pid"), int):
+                span_pids.add(sp["pid"])
+            s, e = sp.get("start_s"), sp.get("end_s")
+            if not isinstance(s, NUM) or not isinstance(e, NUM) \
+                    or e < s:
+                problems.append(f"{where}:spans[{i}]: span not a "
+                                f"forward interval ({s} .. {e})")
+            unc = sp.get("offset_uncertainty_s")
+            if not isinstance(unc, NUM) or isinstance(unc, bool):
+                problems.append(f"{where}:spans[{i}]: span missing "
+                                "its stamped offset uncertainty")
+            elif isinstance(bound, NUM) and unc > bound:
+                problems.append(
+                    f"{where}:spans[{i}]: span uncertainty {unc} "
+                    f"exceeds the bound {bound}")
+        n_proc = req.get("n_processes")
+        if isinstance(n_proc, int) and n_proc != len(span_pids):
+            problems.append(
+                f"{where}: claims {n_proc} processes but its spans "
+                f"name {len(span_pids)} distinct pids")
+        if bool(req.get("stitched")) != (len(span_pids) >= 2):
+            problems.append(
+                f"{where}: stitched={req.get('stitched')} disagrees "
+                f"with {len(span_pids)} span pids")
+
+    stitch = obj.get("stitch")
+    if isinstance(stitch, dict):
+        maxp = stitch.get("max_processes")
+        if not isinstance(maxp, int) or isinstance(maxp, bool) \
+                or maxp < 3:
+            problems.append(
+                f"{name}: stitch.max_processes must be an int >= 3 "
+                f"(got {maxp!r}) — no request crossed 3 OS "
+                "processes, so the capture proves nothing about "
+                "cross-process stitching")
+        st = stitch.get("stitched_traces")
+        if not isinstance(st, int) or isinstance(st, bool) or st < 1:
+            problems.append(f"{name}: stitch.stitched_traces must "
+                            f"be >= 1, got {st!r}")
+        proof = stitch.get("proof_trace_id")
+        if proof is not None:
+            prow = requests.get(str(proof))
+            if not isinstance(prow, dict):
+                problems.append(
+                    f"{name}: proof trace {proof!r} absent from "
+                    "the request index")
+            elif not prow.get("stitched") or \
+                    (prow.get("n_processes") or 0) < 3:
+                problems.append(
+                    f"{name}: proof trace {proof!r} did not stitch "
+                    "across >= 3 processes (unstitched trace ids "
+                    "are refused)")
+    else:
+        problems.append(f"{name}: stitch must be an object")
+
+    events = obj.get("events")
+    if isinstance(events, list):
+        if not events:
+            problems.append(f"{name}: merged events list is empty")
+        last = None
+        for i, ev in enumerate(events):
+            if not isinstance(ev, dict):
+                problems.append(f"{name}:events[{i}]: not an object")
+                continue
+            lt = ev.get("local_t")
+            if not isinstance(lt, NUM) or isinstance(lt, bool):
+                problems.append(f"{name}:events[{i}]: missing "
+                                "numeric 'local_t' (the aligned "
+                                "timebase)")
+                continue
+            if last is not None and lt < last:
+                problems.append(
+                    f"{name}:events[{i}]: local_t {lt} goes "
+                    f"BACKWARDS (prev {last}) — the merged stream "
+                    "is not on one timebase")
+            last = lt
+    sha = obj.get("git_sha")
+    if sha is not None and not isinstance(sha, str):
+        problems.append(f"{name}: git_sha must be a string")
+
+
 def check_bench(obj, name, problems):
     if "metric" in obj:            # flat metric row (BENCH_SELF_*)
         _check_fields(obj, FLAT_METRIC_REQUIRED, name, problems)
@@ -1319,6 +1526,8 @@ def check_file(path, problems):
         check_train_chaos(obj, name, problems)
     elif name.startswith("SERVE_FLEET_CHAOS"):
         check_fleet_chaos(obj, name, problems)
+    elif name.startswith("SERVE_FLEET_TRACE"):
+        check_serve_fleet_trace(obj, name, problems)
     elif name.startswith("SERVE_CHAOS"):
         check_serve_chaos(obj, name, problems)
     elif name.startswith("SERVE_TRACE"):
@@ -1343,6 +1552,8 @@ def main(argv):
                                               "SERVE_CHAOS_*.json")) +
                        glob.glob(os.path.join(root,
                                               "SERVE_FLEET_CHAOS_*.json")) +
+                       glob.glob(os.path.join(root,
+                                              "SERVE_FLEET_TRACE_*.json")) +
                        glob.glob(os.path.join(root,
                                               "SERVE_TRACE_*.json")))
     if not files:

@@ -11,11 +11,19 @@ event timestamps and compared against the engine-stamped ``ttft_s``
 riding in the first_token event — they must agree to within 1ms or
 the phase spans don't mean what they claim (ISSUE 10 acceptance).
 
+Given a DIRECTORY instead of a file, it reads a CLUSTER flight
+bundle (serve/fleet/telemetry.py dump_cluster_bundle): the trigger,
+member coverage and clock-offset table from the manifest, plus the
+tail of the merged offset-corrected event stream leading up to the
+fault — the "one artifact explains the fault" view.
+
 Usage: python tools/trace_report.py SERVE_TRACE_cpu_smoke.json
+       python tools/trace_report.py flight/cluster-<reason>-000000/
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 from typing import Any, Dict, List, Optional
 
@@ -139,6 +147,103 @@ def _round_stats(events: List[Dict[str, Any]]
     }
 
 
+def cluster_report(bundle: Dict[str, Any],
+                   tail: int = 20) -> Dict[str, Any]:
+    """Summarize one cluster flight bundle (the dict
+    ``fleet.telemetry.load_cluster_bundle`` returns): trigger,
+    coverage, the offset table, and the last ``tail`` merged events
+    before the bundle was cut. Pure function, like ``report``."""
+    events = bundle.get("events") or []
+    members = bundle.get("members") or {}
+    traces = set()
+    for ev in events:
+        d = ev.get("data")
+        if isinstance(d, dict) and d.get("trace_id"):
+            traces.add(str(d["trace_id"]))
+    return {
+        "reason": bundle.get("reason"),
+        "trigger": bundle.get("trigger"),
+        "coverage": bundle.get("coverage"),
+        "members": {
+            n: {k: m.get(k) for k in
+                ("role", "up", "pid", "generation", "offset_s",
+                 "uncertainty_s", "drift_s_per_s", "events_total",
+                 "dropped")}
+            for n, m in members.items()},
+        "events_total": len(events),
+        "events_torn_truncated": bundle.get(
+            "events_torn_truncated", 0),
+        "trace_ids": sorted(traces),
+        "tail": events[-tail:],
+    }
+
+
+def _cluster_main(bdir: str) -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from ray_tpu.serve.fleet.telemetry import load_cluster_bundle
+    rep = cluster_report(load_cluster_bundle(bdir))
+    print(f"cluster bundle: {rep['reason']}")
+    print(f"  trigger: {json.dumps(rep['trigger'], default=str)}")
+    cov = rep.get("coverage") or {}
+    print(f"  coverage: scraped={cov.get('scraped')} "
+          f"unreachable={cov.get('unreachable')}")
+    print("  member clock offsets:")
+    for n, m in sorted(rep["members"].items()):
+        off = m.get("offset_s")
+        unc = m.get("uncertainty_s")
+        print(f"    {n:>12}  role={m.get('role'):>9}  "
+              f"up={str(m.get('up')):>5}  pid={m.get('pid')}  "
+              f"offset={off if off is not None else '-'}  "
+              f"+-{unc if unc is not None else '-'}s")
+    torn = rep["events_torn_truncated"]
+    print(f"  merged events: {rep['events_total']}"
+          + (f" ({torn} torn line(s) truncated)" if torn else ""))
+    print(f"  trace ids seen: {rep['trace_ids']}")
+    print(f"  last {len(rep['tail'])} events on the aligned "
+          f"timebase:")
+    for ev in rep["tail"]:
+        print(f"    {ev.get('local_t')}  "
+              f"{ev.get('member')}:{ev.get('type')}  "
+              f"rid={ev.get('rid')}  "
+              f"{json.dumps(ev.get('data'), default=str)[:80]}")
+    return 0
+
+
+def _fleet_main(artifact: Dict[str, Any]) -> int:
+    """Render a --fleet --trace artifact: requests are cross-process
+    span sets on the collector-aligned timebase, not single-engine
+    phase rows."""
+    stitch = artifact["stitch"]
+    print(f"fleet trace: {stitch['traces']} request(s), "
+          f"{stitch['stitched_traces']} stitched across "
+          f"up to {stitch['max_processes']} OS processes "
+          f"(proof={stitch['proof_trace_id']})")
+    for tid, req in sorted((artifact.get("requests") or {}).items()):
+        spans = req.get("spans") or []
+        pids = sorted({s.get("pid") for s in spans})
+        print(f"\n  {tid}  outcome={req.get('outcome')}  "
+              f"n_tokens={req.get('n_tokens')}  "
+              f"processes={len(pids)}")
+        t0 = min((s["start_s"] for s in spans), default=0.0)
+        for s in sorted(spans, key=lambda s: s["start_s"]):
+            print(f"    {s.get('role', ''):>8}  "
+                  f"{s.get('replica_id', ''):>10}  "
+                  f"pid={s.get('pid')}  "
+                  f"+{(s['start_s'] - t0) * 1e3:8.3f}ms -> "
+                  f"+{(s['end_s'] - t0) * 1e3:8.3f}ms  "
+                  f"(+-{s.get('offset_uncertainty_s', 0) * 1e3:.3f}ms)"
+                  f"  {','.join(s.get('etypes') or [])}")
+    col = artifact.get("collector") or {}
+    if col:
+        print(f"\ncollector: members_up={col.get('members_up')}"
+              f"/{col.get('members')}  "
+              f"max_offset_uncertainty_s="
+              f"{col.get('max_offset_uncertainty_s')}  "
+              f"within_bound={col.get('offset_within_bound')}")
+    return 0 if stitch.get("stitched_traces", 0) >= 1 else 1
+
+
 def _fmt(v: Any) -> str:
     if v is None:
         return "-"
@@ -149,10 +254,15 @@ def _fmt(v: Any) -> str:
 
 def main(argv: List[str]) -> int:
     if len(argv) != 2:
-        print(__doc__.strip().splitlines()[-1], file=sys.stderr)
+        for line in __doc__.strip().splitlines()[-2:]:
+            print(line.strip(), file=sys.stderr)
         return 2
+    if os.path.isdir(argv[1]):
+        return _cluster_main(argv[1])
     with open(argv[1]) as f:
         artifact = json.load(f)
+    if "stitch" in artifact:
+        return _fleet_main(artifact)
     rep = report(artifact)
 
     cols = ("rid", "outcome", "n_tokens", "queue_wait_s", "ttft_s",
