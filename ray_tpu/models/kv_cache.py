@@ -23,6 +23,16 @@ structure that lets requests join/leave the decode batch per token):
   dynamic-gather/scatter HLO that tiles fine on TPU. A dedicated
   pallas paged-attention kernel can replace the gather later without
   changing this layout.
+- ``kv_dtype="int8"`` halves page bytes: pages store int8 with one
+  fp32 absmax scale per (kv_head, physical page) — shape
+  ``[n_kv_heads, n_pages, 1]`` so the scale shards with its
+  head-sharded page column under tensor parallelism. Scales travel
+  with page ids: the allocator, prefix cache, and COW path all deal
+  in page ids only, and every consumer that moves a page column
+  (copy-on-write, placement, donation) moves the matching scale
+  column in the same jitted op. Quantize/dequantize live in
+  ops/paged_attention.py; nothing outside it interprets the int8
+  payload.
 """
 from __future__ import annotations
 
@@ -30,6 +40,8 @@ from typing import List, NamedTuple, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
+
+KV_SCALE_DTYPE = jnp.float32
 
 
 class PagedKVLayer(NamedTuple):
@@ -39,21 +51,86 @@ class PagedKVLayer(NamedTuple):
     pages_k/pages_v: [n_kv_heads, n_pages, page_size, head_dim]
     page_table:      [n_slots, max_pages] int32 — logical page p of
                      slot s lives in physical page ``page_table[s, p]``
+    scales_k/scales_v: [n_kv_heads, n_pages, 1] fp32 per-page absmax
+                     scales when the pool is int8, else None. Optional
+                     LAST so fp pytrees keep their PR 1–14 structure.
     """
     pages_k: jnp.ndarray
     pages_v: jnp.ndarray
     page_table: jnp.ndarray
+    scales_k: Optional[jnp.ndarray] = None
+    scales_v: Optional[jnp.ndarray] = None
 
     @property
     def page_size(self) -> int:
         return self.pages_k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.scales_k is not None
 
-def init_kv_pool(cfg, n_pages: int, page_size: int):
-    """One (k, v) page pool per layer. Page 0 is reserved (null)."""
+
+def kv_layer_view(layer, page_table: jnp.ndarray) -> PagedKVLayer:
+    """Wrap one engine layer tuple — ``(pk, pv)`` fp or
+    ``(pk, pv, sk, sv)`` int8 — as the PagedKVLayer the attention
+    module consumes. Keeps the jitted engine builders dtype-agnostic:
+    they thread opaque tuples and only this view/store pair knows the
+    arity."""
+    if len(layer) == 2:
+        pk, pv = layer
+        return PagedKVLayer(pk, pv, page_table)
+    pk, pv, sk, sv = layer
+    return PagedKVLayer(pk, pv, page_table, sk, sv)
+
+
+def kv_layer_store(cache: PagedKVLayer):
+    """Inverse of kv_layer_view: the storage tuple (without the shared
+    page table) the engine carries between jitted steps."""
+    if cache.scales_k is None:
+        return (cache.pages_k, cache.pages_v)
+    return (cache.pages_k, cache.pages_v,
+            cache.scales_k, cache.scales_v)
+
+
+def init_kv_pool(cfg, n_pages: int, page_size: int,
+                 kv_dtype: str = "fp"):
+    """One page pool per layer. Page 0 is reserved (null).
+
+    fp:   [(pages_k, pages_v), ...] in cfg.dtype (unchanged layout).
+    int8: [(pages_k, pages_v, scales_k, scales_v), ...] — int8 pages
+          plus fp32 per-(head, page) absmax scales initialised to 0
+          (a 0 scale means "page holds nothing"; paged_append's
+          reset-on-offset-0 rule keeps that true across realloc
+          without any host-side scale bookkeeping).
+    """
     shape = (cfg.n_kv_heads, n_pages, page_size, cfg.head_dim)
-    return [(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+    if kv_dtype == "fp":
+        return [(jnp.zeros(shape, cfg.dtype),
+                 jnp.zeros(shape, cfg.dtype))
+                for _ in range(cfg.n_layers)]
+    if kv_dtype != "int8":
+        raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+    sshape = (cfg.n_kv_heads, n_pages, 1)
+    return [(jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+             jnp.zeros(sshape, KV_SCALE_DTYPE),
+             jnp.zeros(sshape, KV_SCALE_DTYPE))
             for _ in range(cfg.n_layers)]
+
+
+def kv_pool_page_bytes(cfg, page_size: int,
+                       kv_dtype: str = "fp") -> int:
+    """Bytes ONE physical page costs across all layers (k+v payload
+    plus, for int8, its two fp32 scales). The allocator multiplies
+    this by occupancy for the bytes view in load/leak reports — the
+    number the capacity A/B halves."""
+    if kv_dtype == "int8":
+        payload = 1
+        scale = 2 * cfg.n_kv_heads * 4
+    else:
+        payload = jnp.dtype(cfg.dtype).itemsize
+        scale = 0
+    per_layer = 2 * cfg.n_kv_heads * page_size * cfg.head_dim * payload
+    return cfg.n_layers * (per_layer + scale)
 
 
 class BlockAllocator:
@@ -62,12 +139,18 @@ class BlockAllocator:
     Page 0 is never handed out — it is the null page inactive slots
     write into. All-or-nothing alloc so a half-grown sequence never
     holds pages it cannot use.
+
+    ``page_bytes`` (optional) is the all-layer byte cost of one page
+    (see kv_pool_page_bytes); when set, occupancy gains a bytes view
+    so pool_stats/load_report/flight bundles show the memory the
+    dtype choice actually buys back.
     """
 
-    def __init__(self, n_pages: int):
+    def __init__(self, n_pages: int, page_bytes: Optional[int] = None):
         if n_pages < 2:
             raise ValueError("pool needs >= 2 pages (page 0 is null)")
         self.n_pages = n_pages
+        self.page_bytes = page_bytes
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
         self._free_set = set(self._free)
 
@@ -80,6 +163,19 @@ class BlockAllocator:
         At engine quiescence this must equal the prefix cache's
         resident page count — every other page is a leak."""
         return (self.n_pages - 1) - len(self._free)
+
+    def bytes_in_use(self) -> Optional[int]:
+        """occupancy() in bytes, or None when page_bytes is unknown."""
+        if self.page_bytes is None:
+            return None
+        return self.occupancy() * self.page_bytes
+
+    def bytes_total(self) -> Optional[int]:
+        """Whole-pool byte budget (null page included — it is real
+        memory), or None when page_bytes is unknown."""
+        if self.page_bytes is None:
+            return None
+        return self.n_pages * self.page_bytes
 
     def leak_report(self) -> List[int]:
         """Page ids some owner still holds (not on the free list).

@@ -44,9 +44,12 @@ def _use_paged_kernel() -> bool:
     of host overlap can cover it. Until that aliasing is proven
     through the custom call, the gather is the right default on
     every backend; RAY_TPU_PAGED_KERNEL=1 forces the kernel (and
-    =0 forces the gather) for experiments and tests."""
-    import os
-    return os.environ.get("RAY_TPU_PAGED_KERNEL", "") == "1"
+    =0 forces the gather) for experiments and tests. Junk values
+    raise EnvKnobError (util/envknobs.py) instead of silently
+    picking the default — a typo here would invalidate a whole
+    perf-triage session."""
+    from ray_tpu.util.envknobs import parse_paged_kernel_env
+    return parse_paged_kernel_env(default=False)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -160,15 +163,28 @@ class LlamaAttention(nn.Module):
             pos = cache_len                       # [B] int32
             Pg = pc.page_size
             from ray_tpu.ops.paged_attention import paged_append
-            pk, pv = paged_append(pc.pages_k, pc.pages_v,
-                                  pc.page_table, pos, k, v)
-            new_cache = pc._replace(pages_k=pk, pages_v=pv)
+            if pc.quantized:
+                # int8 pool: append quantizes in place and returns
+                # updated per-page scales, which travel WITH the
+                # pages through the cache pytree (COW, donation,
+                # placement all move them together).
+                pk, pv, sk, sv = paged_append(
+                    pc.pages_k, pc.pages_v, pc.page_table, pos, k, v,
+                    pc.scales_k, pc.scales_v)
+                new_cache = pc._replace(pages_k=pk, pages_v=pv,
+                                        scales_k=sk, scales_v=sv)
+            else:
+                pk, pv = paged_append(pc.pages_k, pc.pages_v,
+                                      pc.page_table, pos, k, v)
+                sk = sv = None
+                new_cache = pc._replace(pages_k=pk, pages_v=pv)
             if T == 1 and _use_paged_kernel():
                 # TPU decode: pallas paged-attention kernel — page
                 # table rides scalar prefetch; the page window is
-                # never materialized (ops/paged_attention.py).
+                # never materialized (ops/paged_attention.py). Int8
+                # pages dequantize in-register inside the kernel.
                 y = paged_decode_attention(
-                    q[:, 0], pk, pv, pc.page_table, pos)
+                    q[:, 0], pk, pv, pc.page_table, pos, sk, sv)
                 y = y.reshape(B, 1, cfg.n_heads, hd)
             else:
                 # CPU/XLA fallback and chunk prefill: gather the page
@@ -176,10 +192,21 @@ class LlamaAttention(nn.Module):
                 # [KH, B, L, D]; gathered index == logical sequence
                 # position by construction.
                 L = pc.page_table.shape[1] * Pg
-                kg = pk[:, pc.page_table].reshape(
-                    cfg.n_kv_heads, B, L, hd)
-                vg = pv[:, pc.page_table].reshape(
-                    cfg.n_kv_heads, B, L, hd)
+                kg = pk[:, pc.page_table]
+                vg = pv[:, pc.page_table]
+                if sk is not None:
+                    # dequantize the gathered window in fp32 using the
+                    # gathered per-page scales (value = q * s / 127) —
+                    # only the per-step [B, L] window ever exists in
+                    # fp, never the pool itself
+                    skg = sk[:, pc.page_table]  # [KH, B, MP, 1]
+                    svg = sv[:, pc.page_table]
+                    kg = kg.astype(jnp.float32) * \
+                        (skg * (1.0 / 127.0))[..., None]
+                    vg = vg.astype(jnp.float32) * \
+                        (svg * (1.0 / 127.0))[..., None]
+                kg = kg.reshape(cfg.n_kv_heads, B, L, hd)
+                vg = vg.reshape(cfg.n_kv_heads, B, L, hd)
                 # Grouped-query attention WITHOUT materializing
                 # repeated K/V: q reshapes to [B, T, KH, rep, D] and
                 # contracts against the grouped cache directly — at

@@ -48,6 +48,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# Int8 pages use a symmetric absmax code: value = q * scale / 127 with
+# q in [-127, 127] (-128 unused so the code is symmetric). One fp32
+# scale per (kv_head, physical page) — coarse enough to cost 4 bytes
+# per page per head, fine enough that one outlier page cannot poison
+# the whole pool's precision.
+_QMAX = 127.0
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -108,7 +115,22 @@ def _check_append_shapes(pages_k, pages_v, page_table, pos, k, v):
             f"pos must be [B]={k.shape[0]}; got shape {pos.shape}")
 
 
-def paged_append(pages_k, pages_v, page_table, pos, k, v):
+def _check_scale_shapes(pages_k, scales_k, scales_v):
+    KH, n_pages = pages_k.shape[:2]
+    want = (KH, n_pages, 1)
+    for name, s in (("scales_k", scales_k), ("scales_v", scales_v)):
+        if s.shape != want:
+            raise PagedShapeError(
+                f"{name} must be [KH, n_pages, 1]={want} to pair with "
+                f"pool {pages_k.shape}; got {s.shape}")
+    if pages_k.dtype != jnp.int8:
+        raise PagedShapeError(
+            f"per-page scales supplied but the pool is {pages_k.dtype}"
+            f", not int8 — scales only pair with quantized pools")
+
+
+def paged_append(pages_k, pages_v, page_table, pos, k, v,
+                 scales_k=None, scales_v=None):
     """Scatter a [B, T] chunk of new K/V into the head-major page pool
     at each slot's current write offset (append-at-offset: the chunk
     may START mid-page and SPAN page boundaries — the partial-prompt
@@ -129,10 +151,48 @@ def paged_append(pages_k, pages_v, page_table, pos, k, v):
     addressable window so a padded tail can never alias another
     slot's pages through index clamping.
 
+    Int8 pools pass ``scales_k``/``scales_v`` ([KH, n_pages, 1] fp32
+    per-page absmax) and get a 4-tuple back (pages + updated scales).
+    The append then does three scatters per tensor:
+
+    1. SCALE RESET: any token landing at in-page offset 0 marks its
+       page "starting over" — its old scale contribution came from a
+       previous owner (the allocator reuses page ids) and is zeroed.
+       This is the whole scale lifecycle: no host-side bookkeeping on
+       free/realloc, because the first write a fresh logical page ever
+       receives is always at offset 0.
+    2. RUNNING ABSMAX: per-token absmax is scatter-MAXed into the
+       (reset-adjusted) page scales — the page scale only grows while
+       a page is live, so earlier tokens stay representable.
+    3. REQUANTIZE + STORE: pages the chunk touches are re-coded from
+       the old scale to the new one (``round(q_old * s_old/s_new)``,
+       0 where the page was reset), then the chunk tokens are
+       quantized at the new scale and scattered on top. Duplicate
+       page entries write byte-identical values, so scatter order
+       cannot matter.
+
+    Quantized bytes are WRITE-HISTORY dependent: appending one token
+    at a time re-rounds earlier tokens at each scale growth, so an
+    incrementally-built page need not match a bulk-built one bit for
+    bit. That is why engine-level parity with fp KV is tolerance-gated
+    (docs/serving.md) while replica failover stays bit-exact (same
+    write history on every replica).
+
     Raises :class:`PagedShapeError` at trace time on any rank / head /
-    head-dim / batch mismatch between the chunk and the pool.
+    head-dim / batch mismatch between the chunk and the pool, or when
+    scales are supplied for a non-int8 pool (and vice versa).
     """
     _check_append_shapes(pages_k, pages_v, page_table, pos, k, v)
+    quantized = scales_k is not None or scales_v is not None
+    if quantized and (scales_k is None or scales_v is None):
+        raise PagedShapeError(
+            "scales_k and scales_v must be supplied together")
+    if not quantized and pages_k.dtype == jnp.int8:
+        raise PagedShapeError(
+            "int8 pool appended without its per-page scales — pass "
+            "scales_k/scales_v (kv_dtype='int8' wiring bug)")
+    if quantized:
+        _check_scale_shapes(pages_k, scales_k, scales_v)
     B, T = k.shape[:2]
     Pg = pages_k.shape[2]
     max_pages = page_table.shape[1]
@@ -143,18 +203,54 @@ def paged_append(pages_k, pages_v, page_table, pos, k, v):
     flat_p = pidx.reshape(-1)
     flat_o = off.reshape(-1)
     # [B, T, KH, D] -> [KH, B*T, D] to match the head-major pool.
-    kT = k.astype(pages_k.dtype).reshape(B * T, -1, k.shape[-1]
-                                         ).transpose(1, 0, 2)
-    vT = v.astype(pages_v.dtype).reshape(B * T, -1, v.shape[-1]
-                                         ).transpose(1, 0, 2)
-    return (pages_k.at[:, flat_p, flat_o].set(kT),
-            pages_v.at[:, flat_p, flat_o].set(vT))
+    kT = k.reshape(B * T, -1, k.shape[-1]).transpose(1, 0, 2)
+    vT = v.reshape(B * T, -1, v.shape[-1]).transpose(1, 0, 2)
+    if not quantized:
+        return (pages_k.at[:, flat_p, flat_o].set(
+                    kT.astype(pages_k.dtype)),
+                pages_v.at[:, flat_p, flat_o].set(
+                    vT.astype(pages_v.dtype)))
+
+    n_pages = pages_k.shape[1]
+    # (1) pages whose offset-0 slot this chunk writes start over.
+    reset = jnp.zeros((n_pages,), jnp.bool_).at[flat_p].max(
+        flat_o == 0)                                   # [n_pages]
+
+    def _one(pages, scales, xT):
+        xT32 = xT.astype(jnp.float32)                  # [KH, B*T, D]
+        s_base = jnp.where(reset[None, :, None], 0.0,
+                           scales.astype(jnp.float32))
+        # (2) running absmax, monotone while the page is live.
+        amax = jnp.max(jnp.abs(xT32), axis=2)          # [KH, B*T]
+        s_new = s_base.at[:, flat_p, 0].max(amax)      # [KH, n_pages, 1]
+        # (3a) re-code touched pages old-scale -> new-scale. Gathering
+        # per token (not per unique page) keeps this jit-static;
+        # duplicates recompute identical bytes.
+        old_q = pages[:, flat_p].astype(jnp.float32)   # [KH, BT, Pg, D]
+        sb = s_base[:, flat_p]                         # [KH, BT, 1]
+        sn = s_new[:, flat_p]
+        ratio = jnp.where(sn > 0.0, sb / jnp.maximum(sn, 1e-30), 0.0)
+        req = jnp.clip(jnp.round(old_q * ratio[..., None]),
+                       -_QMAX, _QMAX).astype(jnp.int8)
+        pages = pages.at[:, flat_p].set(req)
+        # (3b) quantize the chunk tokens at the new scale. A zero page
+        # scale implies the token itself is all-zero (absmax was maxed
+        # in above), so the guarded divide is exact, not a fudge.
+        inv = jnp.where(sn > 0.0, _QMAX / jnp.maximum(sn, 1e-30), 0.0)
+        q_tok = jnp.clip(jnp.round(xT32 * inv), -_QMAX, _QMAX
+                         ).astype(jnp.int8)
+        pages = pages.at[:, flat_p, flat_o].set(q_tok)
+        return pages, s_new.astype(scales.dtype)
+
+    new_pk, new_sk = _one(pages_k, scales_k, kT)
+    new_pv, new_sv = _one(pages_v, scales_v, vT)
+    return new_pk, new_pv, new_sk, new_sv
 
 
-def _kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-            m_sc, l_sc, acc_sc, *, page_size: int, scale: float):
-    b = pl.program_id(0)
-    p = pl.program_id(1)
+def _attend_page(b, p, pos_ref, q_ref, k, v, o_ref,
+                 m_sc, l_sc, acc_sc, *, page_size: int, scale: float):
+    """Shared flash-style online-softmax body: one physical page of
+    already-dequantized fp32 K/V for all kv heads."""
     n_p = pl.num_programs(1)
 
     @pl.when(p == 0)
@@ -164,8 +260,6 @@ def _kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
     q = q_ref[0].astype(jnp.float32)             # [KH, rep, D]
-    k = k_ref[:, 0].astype(jnp.float32)          # [KH, Pg, D]
-    v = v_ref[:, 0].astype(jnp.float32)          # [KH, Pg, D]
     s = jax.lax.dot_general(
         q, k, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32) * scale   # [KH, rep, Pg]
@@ -194,14 +288,45 @@ def _kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
 
 
+def _kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+            m_sc, l_sc, acc_sc, *, page_size: int, scale: float):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    k = k_ref[:, 0].astype(jnp.float32)          # [KH, Pg, D]
+    v = v_ref[:, 0].astype(jnp.float32)          # [KH, Pg, D]
+    _attend_page(b, p, pos_ref, q_ref, k, v, o_ref,
+                 m_sc, l_sc, acc_sc, page_size=page_size, scale=scale)
+
+
+def _kernel_q(pt_ref, pos_ref, q_ref, k_ref, v_ref, sk_ref, sv_ref,
+              o_ref, m_sc, l_sc, acc_sc, *, page_size: int,
+              scale: float):
+    """Int8 variant: the page's fp32 absmax scale rides its own tiny
+    block (chosen by the same scalar-prefetched page-table entry) and
+    the dequantize happens IN REGISTER right after the page DMA — the
+    fp window never exists in HBM or VMEM, so the kernel's memory
+    footprint is the halved int8 one."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    inv = 1.0 / _QMAX
+    sk = sk_ref[:, 0].astype(jnp.float32) * inv   # [KH, 1]
+    sv = sv_ref[:, 0].astype(jnp.float32) * inv
+    k = k_ref[:, 0].astype(jnp.float32) * sk[:, :, None]  # [KH, Pg, D]
+    v = v_ref[:, 0].astype(jnp.float32) * sv[:, :, None]
+    _attend_page(b, p, pos_ref, q_ref, k, v, o_ref,
+                 m_sc, l_sc, acc_sc, page_size=page_size, scale=scale)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention(q, pages_k, pages_v, page_table, positions,
+                           scales_k=None, scales_v=None,
                            interpret: bool | None = None):
     """One decode step of paged attention.
 
     q: [B, H, D]; returns [B, H, D] in q.dtype. See module docstring
     for the pool layout. Falls back transparently to interpreter mode
-    off-TPU (tests).
+    off-TPU (tests). Int8 pools pass scales_k/scales_v
+    ([KH, n_pages, 1] fp32) and get in-register dequantization.
     """
     B, H, D = q.shape
     KH, n_pages, Pg, Dk = pages_k.shape
@@ -210,27 +335,43 @@ def paged_decode_attention(q, pages_k, pages_v, page_table, positions,
     max_pages = page_table.shape[1]
     qg = q.reshape(B, KH, rep, D)
     scale = 1.0 / (D ** 0.5)
+    quantized = scales_k is not None
+    if quantized:
+        _check_scale_shapes(pages_k, scales_k, scales_v)
 
     grid = (B, max_pages)
-    kernel = functools.partial(_kernel, page_size=Pg, scale=scale)
+    page_spec = [
+        # ONE physical page of K/V across ALL kv heads, chosen by
+        # the scalar-prefetched page table: [KH, 1, Pg, D]
+        pl.BlockSpec((KH, 1, Pg, D),
+                     lambda b, p, pt, pos: (0, pt[b, p], 0, 0)),
+        pl.BlockSpec((KH, 1, Pg, D),
+                     lambda b, p, pt, pos: (0, pt[b, p], 0, 0)),
+    ]
+    in_specs = [
+        # q block for this slot, every head: [1, KH, rep, D]
+        pl.BlockSpec((1, KH, rep, D),
+                     lambda b, p, pt, pos: (b, 0, 0, 0)),
+    ] + page_spec
+    operands = [qg, pages_k, pages_v]
+    kern = _kernel
+    if quantized:
+        # the page's scale column follows the same page-table index
+        in_specs += [
+            pl.BlockSpec((KH, 1, 1),
+                         lambda b, p, pt, pos: (0, pt[b, p], 0)),
+            pl.BlockSpec((KH, 1, 1),
+                         lambda b, p, pt, pos: (0, pt[b, p], 0)),
+        ]
+        operands += [scales_k, scales_v]
+        kern = _kernel_q
+    kernel = functools.partial(kern, page_size=Pg, scale=scale)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                # q block for this slot, every head: [1, KH, rep, D]
-                pl.BlockSpec((1, KH, rep, D),
-                             lambda b, p, pt, pos: (b, 0, 0, 0)),
-                # ONE physical page of K across ALL kv heads, chosen
-                # by the scalar-prefetched page table: [KH, 1, Pg, D]
-                pl.BlockSpec((KH, 1, Pg, D),
-                             lambda b, p, pt, pos:
-                             (0, pt[b, p], 0, 0)),
-                pl.BlockSpec((KH, 1, Pg, D),
-                             lambda b, p, pt, pos:
-                             (0, pt[b, p], 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, KH, rep, D),
                 lambda b, p, pt, pos: (b, 0, 0, 0)),
@@ -242,5 +383,13 @@ def paged_decode_attention(q, pages_k, pages_v, page_table, positions,
         ),
         out_shape=jax.ShapeDtypeStruct((B, KH, rep, D), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(page_table, positions, qg, pages_k, pages_v)
+    )(page_table, positions, *operands)
     return out.reshape(B, H, D)
+
+
+def dequantize_pages(pages, scales):
+    """Debug/test helper: materialize the fp view of an int8 pool
+    (``q * s / 127``). NEVER used on the serving path — the whole
+    point of the int8 mode is that this tensor never exists there."""
+    return pages.astype(jnp.float32) * (
+        scales.astype(jnp.float32) / _QMAX)[..., None]

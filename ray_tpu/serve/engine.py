@@ -64,7 +64,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.models.kv_cache import (BlockAllocator, PagedKVLayer,
-                                     init_kv_pool)
+                                     init_kv_pool, kv_layer_store,
+                                     kv_layer_view, kv_pool_page_bytes)
 from ray_tpu.serve import obs, spec_decode
 # Typed lifecycle errors live in a jax-free module (serve/errors.py)
 # so the HTTP proxy and clients can import them without the device
@@ -114,6 +115,26 @@ def _metrics() -> dict:
                 "contained fault (bounded retry policy)"),
         }
     return _METRICS
+
+
+KV_BYTES_TOTAL = "serve_kv_bytes_total"
+
+_KV_GAUGE = None
+
+
+def _kv_bytes_gauge():
+    """Lazy singleton for the KV byte-budget gauge (same
+    clear_registry()-proof pattern as _metrics()). Tagged by kv_dtype
+    so an fp/int8 A/B in one process exposes both samples."""
+    global _KV_GAUGE
+    from ray_tpu.util import metrics
+    if (_KV_GAUGE is None
+            or metrics.registry().get(KV_BYTES_TOTAL) is not _KV_GAUGE):
+        _KV_GAUGE = metrics.Gauge(
+            KV_BYTES_TOTAL,
+            "Paged KV pool byte budget (all layers, incl. scales)",
+            tag_keys=("kv_dtype",))
+    return _KV_GAUGE
 
 
 def _dev_ready(buf) -> bool:
@@ -322,6 +343,15 @@ class LLMEngine:
         drain before planning in eos/spec mode — the PR-10 latency
         profile). Env ``RAY_TPU_OVERLAP=0``/``1`` force-overrides
         the knob for A/B runs without touching call sites.
+    kv_dtype: KV pool storage dtype. ``"fp"``/None stores cfg.dtype
+        pages (exact). ``"int8"`` stores quantized pages with one
+        fp32 absmax scale per (kv_head, physical page) — half the
+        page bytes, so a fixed byte budget holds ~2x the pages/slots
+        /prefix residency. Outputs are tolerance-equal to fp (greedy
+        token agreement gated in tests; spec accept-rate unchanged
+        within noise), NOT bit-equal: quantized bytes depend on
+        write history (docs/serving.md). Env ``RAY_TPU_KV_DTYPE``
+        overrides; junk values raise EnvKnobError.
     """
 
     def __init__(self, model, params, *, max_slots: int = 8,
@@ -342,7 +372,8 @@ class LLMEngine:
                  fault_injector=None,
                  events: bool = True,
                  flight_dir: Optional[str] = None,
-                 overlap: Optional[bool] = None):
+                 overlap: Optional[bool] = None,
+                 kv_dtype: Optional[str] = None):
         self.model = model
         self.cfg = model.config
         # Tensor-parallel placement (serve/sharding.py
@@ -369,10 +400,27 @@ class LLMEngine:
         # can legally address rather than the whole pool.
         self.max_pages = min(n_pages - 1,
                              -(-self.cfg.max_seq_len // page_size))
-        self.alloc = BlockAllocator(n_pages)
-        self.pages = init_kv_pool(self.cfg, n_pages, page_size)
+        # KV storage dtype: "fp" (cfg.dtype pages, PR 1-14 behavior)
+        # or "int8" (quantized pages + per-page scales, half the page
+        # bytes -> double the pages at a fixed byte budget). The env
+        # override RAY_TPU_KV_DTYPE wins over the constructor arg so
+        # bench/chaos harnesses can flip whole fleets; junk values in
+        # either raise typed errors (util/envknobs.py).
+        from ray_tpu.util.envknobs import resolve_kv_dtype
+        self.kv_dtype = resolve_kv_dtype(kv_dtype)
+        self.page_bytes = kv_pool_page_bytes(self.cfg, page_size,
+                                             self.kv_dtype)
+        self.alloc = BlockAllocator(n_pages,
+                                    page_bytes=self.page_bytes)
+        self.pages = init_kv_pool(self.cfg, n_pages, page_size,
+                                  self.kv_dtype)
         if sharding is not None:
             self.pages = sharding.place_kv_pool(self.pages)
+        # capacity gauge: the whole-pool byte budget this engine holds
+        # (per process — chaos/fleet runs sum across scrapes). Set
+        # once; pools are static-shape for the engine's lifetime.
+        _kv_bytes_gauge().set(float(n_pages * self.page_bytes),
+                              tags={"kv_dtype": self.kv_dtype})
         # Radix-tree prefix KV cache (serve/prefix_cache.py): retired
         # prompts' full pages enter the tree instead of the free list;
         # admission matches the longest cached prefix and skips its
@@ -675,6 +723,13 @@ class LLMEngine:
                 "free_slots": free_slots,
                 "total_slots": len(self.slots),
                 "free_pages": self.alloc.n_free,
+                # dtype-aware bytes view: the halving int8 buys shows
+                # up wherever load_report lands (autoscaler signals,
+                # pool_stats, flight bundles)
+                "kv_dtype": self.kv_dtype,
+                "kv_page_bytes": self.page_bytes,
+                "kv_bytes_in_use": self.alloc.bytes_in_use(),
+                "kv_bytes_total": self.alloc.bytes_total(),
                 "queue_depth": len(waiting),
                 "outstanding_tokens": outstanding,
                 "max_queued": self.max_queued,
@@ -712,6 +767,10 @@ class LLMEngine:
                 continue
         return {"free_slots": 0, "total_slots": len(self.slots),
                 "free_pages": self.alloc.n_free,
+                "kv_dtype": self.kv_dtype,
+                "kv_page_bytes": self.page_bytes,
+                "kv_bytes_in_use": self.alloc.bytes_in_use(),
+                "kv_bytes_total": self.alloc.bytes_total(),
                 "queue_depth": len(self._wait),
                 "outstanding_tokens": 0,
                 "max_queued": self.max_queued,
@@ -2072,12 +2131,13 @@ class LLMEngine:
         def prefill(params, pages, ids, start, last_idx, page_table,
                     rng):
             rng, sub = jax.random.split(rng)
-            kv = [PagedKVLayer(pk, pv, page_table)
-                  for pk, pv in pages]
+            # kv_layer_view/store keep this builder dtype-agnostic:
+            # fp layers are (pk, pv), int8 layers (pk, pv, sk, sv) —
+            # the scales ride the same donated tuple through the step
+            kv = [kv_layer_view(layer, page_table) for layer in pages]
             logits, new_kv = model.apply(params, ids, kv_caches=kv,
                                          cache_len=start)
-            new_pages = constrain(
-                [(c.pages_k, c.pages_v) for c in new_kv])
+            new_pages = constrain([kv_layer_store(c) for c in new_kv])
             last = logits[jnp.arange(B), last_idx]        # [B, V]
             firsts = _pick_token(last, sub, temp)
             return firsts, new_pages, rng
@@ -2098,12 +2158,10 @@ class LLMEngine:
         constrain = self._constrain_kv
 
         def verify(params, pages, ids, start, page_table):
-            kv = [PagedKVLayer(pk, pv, page_table)
-                  for pk, pv in pages]
+            kv = [kv_layer_view(layer, page_table) for layer in pages]
             logits, new_kv = model.apply(params, ids, kv_caches=kv,
                                          cache_len=start)
-            new_pages = constrain(
-                [(c.pages_k, c.pages_v) for c in new_kv])
+            new_pages = constrain([kv_layer_store(c) for c in new_kv])
             return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
                     new_pages)
 
@@ -2129,8 +2187,8 @@ class LLMEngine:
             def body(i, carry):
                 pages, pos, cur, key, buf = carry
                 key, sub = jax.random.split(key)
-                kv = [PagedKVLayer(pk, pv, page_table)
-                      for pk, pv in pages]
+                kv = [kv_layer_view(layer, page_table)
+                      for layer in pages]
                 logits, new_kv = model.apply(
                     params, cur[:, None], kv_caches=kv, cache_len=pos)
                 nxt = _pick_token(logits[:, -1], sub, temp)
@@ -2138,7 +2196,7 @@ class LLMEngine:
                 # so the carry's sharding is loop-invariant (GSPMD
                 # would otherwise be free to reshard mid-carry)
                 new_pages = constrain(
-                    [(c.pages_k, c.pages_v) for c in new_kv])
+                    [kv_layer_store(c) for c in new_kv])
                 return (new_pages, pos + 1, nxt, key, buf.at[i].set(nxt))
             pages, pos, cur, key, buf = jax.lax.fori_loop(
                 0, steps, body, (pages, pos, cur, rng, buf0))
@@ -2161,9 +2219,13 @@ class LLMEngine:
         constrain = self._constrain_kv
 
         def copy(pages, src, dst):
-            return constrain([(pk.at[:, dst].set(pk[:, src]),
-                               pv.at[:, dst].set(pv[:, src]))
-                              for pk, pv in pages])
+            # int8 layers are 4-tuples whose trailing scale tensors
+            # copy their (rank-3) page column the same way — COW gets
+            # the page's quantization scale for free, so a COW'd page
+            # dequantizes identically to its source
+            return constrain([tuple(t.at[:, dst].set(t[:, src])
+                                    for t in layer)
+                              for layer in pages])
         return jax.jit(copy, donate_argnums=(0,))
 
     def _build_seed(self):

@@ -57,7 +57,8 @@ class LlamaDeployment:
                  watchdog_interval_s: Optional[float] = None,
                  overlap: Optional[bool] = None,
                  fleet: int = 0,
-                 fleet_lease_ttl_s: float = 2.0):
+                 fleet_lease_ttl_s: float = 2.0,
+                 kv_dtype: Optional[str] = None):
         import jax
         from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
@@ -192,7 +193,11 @@ class LlamaDeployment:
             admit_timeout_s=engine_stall_deadline_s,
             # overlapped hot loop (engine.py): None defers to the
             # engine default (on) and the RAY_TPU_OVERLAP override
-            overlap=overlap)
+            overlap=overlap,
+            # KV storage dtype ("fp"/"int8"): int8 halves page bytes
+            # at tolerance-gated parity; every replica/fleet engine
+            # built from these opts inherits the same pool format
+            kv_dtype=kv_dtype)
 
     def setup_mesh(self, mesh):
         """Called by the serve replica when cfg.mesh is set: shard the

@@ -474,7 +474,13 @@ def _probe_engine(eng, tail: int) -> Dict[str, Any]:
     if alloc is not None:
         _probe(out, "allocator", lambda: {
             "n_pages": alloc.n_pages, "n_free": alloc.n_free,
-            "occupancy": alloc.occupancy()})
+            "occupancy": alloc.occupancy(),
+            # dtype-aware bytes view (None on pre-bytes allocators)
+            "page_bytes": getattr(alloc, "page_bytes", None),
+            "bytes_in_use": alloc.bytes_in_use()
+            if callable(getattr(alloc, "bytes_in_use", None)) else None,
+            "bytes_total": alloc.bytes_total()
+            if callable(getattr(alloc, "bytes_total", None)) else None})
     return out
 
 

@@ -50,6 +50,11 @@ from ray_tpu.mesh.sharding import (ShardingRules, match_partition_rules,
 # KV pool layout contract (models/kv_cache.py): axis 0 is n_kv_heads,
 # the ONLY sharded axis — pages/offsets stay whole on every device.
 KV_POOL_SPEC = P("tensor", None, None, None)
+# Int8 pools carry per-(kv_head, page) fp32 scales [KH, n_pages, 1]:
+# same head axis sharded, so each device holds exactly the scales for
+# its own page shards and quantize/dequantize stays device-local — no
+# new collectives enter the KV path.
+KV_SCALE_SPEC = P("tensor", None, None)
 
 
 class ShardingConfigError(ValueError):
@@ -108,7 +113,14 @@ class EngineSharding:
         self.tp = int(tp)
         self.ep = int(ep)
         self.kv_sharding = NamedSharding(mesh, KV_POOL_SPEC)
+        self.kv_scale_sharding = NamedSharding(mesh, KV_SCALE_SPEC)
         self.replicated = NamedSharding(mesh, P())
+
+    def _kv_sharding_for(self, t):
+        # rank dispatch: rank-4 page pools vs rank-3 scale tensors
+        # (int8 mode) — both head-sharded on axis 0
+        return (self.kv_scale_sharding if getattr(t, "ndim", 4) == 3
+                else self.kv_sharding)
 
     @classmethod
     def build(cls, cfg, *, tp: int = 1, ep: int = 1,
@@ -156,8 +168,10 @@ class EngineSharding:
         pages_v) splits axis 0 (kv heads) over ``tensor``. Page
         indices and in-page offsets are global coordinates valid on
         every device, so the host-side allocator / prefix cache /
-        page tables need no changes."""
-        return [tuple(jax.device_put(t, self.kv_sharding)
+        page tables need no changes. Int8 layers are 4-tuples (pages
+        + per-page scales); rank-3 scale tensors pin to KV_SCALE_SPEC
+        next to their head-sharded pages."""
+        return [tuple(jax.device_put(t, self._kv_sharding_for(t))
                       for t in layer) for layer in pages]
 
     def replicate(self, x):
@@ -173,10 +187,11 @@ class EngineSharding:
         jitted step's output pool it guarantees GSPMD can never
         reshard the pool (which would both break donation aliasing
         and introduce the KV collectives this layer exists to
-        avoid)."""
+        avoid). Rank-dispatches so int8 scale tensors pin to their
+        own spec alongside the pages."""
         return jax.tree_util.tree_map(
             lambda t: jax.lax.with_sharding_constraint(
-                t, self.kv_sharding), pages)
+                t, self._kv_sharding_for(t)), pages)
 
     def describe(self) -> dict:
         return {"tp": self.tp, "ep": self.ep,

@@ -1,0 +1,76 @@
+"""Strict parsers for ray_tpu environment knobs.
+
+Env vars are the last-resort override channel (CI, perf triage, chaos
+runs), which is exactly where a silently-ignored typo is most
+expensive: ``RAY_TPU_KV_DTYPE=int-8`` falling back to fp would make an
+A/B arm measure nothing. Every knob here therefore rejects junk with a
+typed error instead of defaulting.
+
+Kept dependency-free (stdlib only): models/ and serve/ both import
+this, so it must sit below either package to avoid cycles.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+KV_DTYPES = ("fp", "int8")
+
+
+class EnvKnobError(ValueError):
+    """An environment knob is set to a value ray_tpu cannot parse."""
+
+    def __init__(self, name: str, value: str, allowed) -> None:
+        self.name = name
+        self.value = value
+        self.allowed = tuple(allowed)
+        super().__init__(
+            "%s=%r is not a valid setting (allowed: %s). Unset it or "
+            "pick one of the allowed values — junk is rejected rather "
+            "than silently defaulted." %
+            (name, value, ", ".join(repr(a) for a in self.allowed)))
+
+
+def parse_bool_knob(name: str, default: bool = False) -> bool:
+    """A {unset, "", "0", "1"} switch. "" and unset mean *default*;
+    anything else but "0"/"1" is a typed error."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    if raw == "1":
+        return True
+    if raw == "0":
+        return False
+    raise EnvKnobError(name, raw, ("", "0", "1"))
+
+
+def parse_paged_kernel_env(default: bool = False) -> bool:
+    """RAY_TPU_PAGED_KERNEL: opt into the pallas decode kernel."""
+    return parse_bool_knob("RAY_TPU_PAGED_KERNEL", default)
+
+
+def parse_kv_dtype_env() -> Optional[str]:
+    """RAY_TPU_KV_DTYPE: pool storage dtype override, or None when the
+    knob is unset/empty (caller falls back to its constructor arg)."""
+    raw = os.environ.get("RAY_TPU_KV_DTYPE")
+    if raw is None or raw == "":
+        return None
+    if raw in KV_DTYPES:
+        return raw
+    raise EnvKnobError("RAY_TPU_KV_DTYPE", raw, ("",) + KV_DTYPES)
+
+
+def resolve_kv_dtype(arg: Optional[str]) -> str:
+    """Merge the constructor arg with the env override (env wins, so a
+    chaos/bench harness can flip a whole fleet without touching code).
+    Validates both sides."""
+    env = parse_kv_dtype_env()
+    if env is not None:
+        return env
+    if arg is None:
+        return "fp"
+    if arg not in KV_DTYPES:
+        raise ValueError(
+            "kv_dtype=%r is not supported (choose one of %s)" %
+            (arg, ", ".join(repr(d) for d in KV_DTYPES)))
+    return arg

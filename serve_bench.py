@@ -182,7 +182,8 @@ def make_server(cfg, knobs, use_engine=True):
                 eos_id=knobs.get("eos_id"),
                 num_engine_replicas=knobs.get("replicas", 1),
                 tensor_parallel=knobs.get("tp", 1),
-                fleet=knobs.get("fleet", 0))
+                fleet=knobs.get("fleet", 0),
+                kv_dtype=knobs.get("kv_dtype"))
 
         def __call__(self, prompt):
             # joins the engine's decode batch at the next chunk
@@ -1926,6 +1927,263 @@ def run_overlap_ab(args):
     return result
 
 
+def run_kvq_ab(args):
+    """Int8-KV capacity/parity A/B (serve_bench.py --kvq-ab): the SAME
+    engine, prompt mix, and greedy sampling run with fp KV pages and
+    with int8 pages + per-page scales (models/kv_cache.py,
+    ops/paged_attention.py), under one fixed page-pool BYTE budget.
+
+    Three sub-runs per arm:
+
+    PARITY (ample equal pages both arms — isolates numerics from
+    capacity): the tp-ab prompt mix (plain decode, shared-prefix
+    radix-cache hits, a repetitive prompt) decoded greedily under the
+    LOCKSTEP loop with manual stepping — fully deterministic, so the
+    recorded agreement is a number, not a sample. The model runs
+    fp32 (same reasoning as --tp-ab: the fp arm's argmax must be
+    free of its own tie-flips so every disagreement is attributable
+    to int8 rounding). Quantized KV is tolerance-equal, not
+    bit-equal (quantized bytes are write-history dependent —
+    docs/serving.md), so the gate is token AGREEMENT >= the recorded
+    floor, not identity. The floor is honest worst-case: a
+    random-weight 256-vocab model has near-uniform logits, where one
+    rounding flip is amplified and then compounds down the rest of
+    that request's stream; real checkpoints with peaked logits agree
+    far higher.
+
+    SPEC (the speculative quality gate): one strongly-cyclic prompt
+    per arm, long enough for greedy decode to lock its cycle, under
+    prompt-lookup speculation. Each arm's proposer drafts from ITS
+    OWN stream and is verified against ITS OWN argmax — the
+    self-consistency speculation actually depends on — so both arms
+    should accept ~all drafts; the gate is the int8 accept rate
+    within the recorded noise of fp. (Accept rates are NOT measured
+    on the mixed parity load: there proposals are lucky n-gram
+    matches against near-random tokens, and comparing luck across
+    arms gates nothing.)
+
+    CAPACITY (the headline — same byte budget both arms): each arm
+    gets the pages its dtype affords (budget // page_bytes), derives
+    its admission bound from them, and takes the same request burst.
+    This sub-run uses the model's native bf16 pages as the fp
+    baseline — the honest deployment comparison (~1.94x for
+    llama-tiny: int8 payload is half of bf16, per-page scales cost a
+    few percent), where the fp32 parity pool would flatter the ratio
+    to ~4x. The int8 arm fits ~2x the pages -> ~2x the effective
+    slots -> fewer sheds and higher prefix-cache residency after
+    retirement. Shed counts are DETERMINISTIC by construction: the
+    burst is submitted before the engine starts stepping, so
+    admission = the arm's capacity-derived bound, not a scheduling
+    race.
+
+    The artifact REFUSES (tools/check_bench_schema.py ``kvq_ab``
+    family) to exist without the byte-budget stamp, with a capacity
+    ratio < 1.9x, token agreement below the recorded floor, a spec
+    accept-rate drop beyond noise, an int8 arm that didn't shed
+    strictly fewer, or missing mesh/seed stamps."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.kv_cache import kv_pool_page_bytes
+    from ray_tpu.models.llama import Llama, llama_tiny
+    from ray_tpu.serve.engine import LLMEngine
+    from ray_tpu.serve.errors import EngineOverloaded
+
+    gen_tokens = min(args.gen_tokens, 16)
+    cfg = llama_tiny(dtype=jnp.float32)          # parity/spec arms
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))
+    cfg_cap = llama_tiny()                       # capacity arms: bf16
+    model_cap = Llama(cfg_cap)
+    params_cap = model_cap.init(jax.random.PRNGKey(args.seed),
+                                jnp.zeros((1, 8), jnp.int32))
+
+    page_size = 8
+    page_bytes = {dt: kv_pool_page_bytes(cfg_cap, page_size, dt)
+                  for dt in ("fp", "int8")}
+    # the fixed budget: what a 48-page bf16 pool costs. Both arms
+    # must fit inside it; the int8 arm converts the same bytes into
+    # ~2x the pages.
+    byte_budget = 48 * page_bytes["fp"]
+    arm_pages = {dt: byte_budget // page_bytes[dt]
+                 for dt in ("fp", "int8")}
+
+    rng = np.random.RandomState(args.seed + 53)
+    plain = [rng.randint(1, cfg.vocab_size - 1, size=12).tolist()
+             for _ in range(4)]
+    shared = rng.randint(1, cfg.vocab_size - 1, size=16).tolist()
+    tails = [rng.randint(1, cfg.vocab_size - 1, size=6).tolist()
+             for _ in range(3)]
+    repetitive = ([5, 6, 7, 8] * 6)[:20]
+    prompts = plain + [shared + t for t in tails] + [repetitive]
+    # pages one burst request needs end to end (prompt + completion)
+    req_tokens = max(len(p) for p in prompts) + gen_tokens
+    pages_per_req = -(-req_tokens // page_size)
+
+    def _drain(eng):
+        while eng.step():
+            pass
+
+    def parity_arm(dt):
+        # ample EQUAL pages both arms, lockstep loop, manual
+        # stepping: this sub-run measures numerics only — no
+        # capacity pressure, no thread-timing in the token stream
+        eng = LLMEngine(model, params, max_slots=4,
+                        page_size=page_size, n_pages=256, chunk=4,
+                        prefill_chunk=16, temperature=0.0,
+                        eos_id=-1, overlap=False,
+                        seed=args.seed, prefix_cache=True,
+                        kv_dtype=None if dt == "fp" else dt)
+        # warmup compiles + seeds the prefix cache outside the
+        # measured window
+        h0 = eng.submit(shared + tails[0], max_new_tokens=gen_tokens)
+        _drain(eng)
+        h0.result()
+        t0 = time.time()
+        hs = [eng.submit(list(p), max_new_tokens=gen_tokens)
+              for p in prompts]
+        _drain(eng)
+        outs = [h.result() for h in hs]
+        wall = time.time() - t0
+        eng.shutdown()
+        return outs, {
+            "wall_s": round(wall, 3),
+            "requests": len(prompts),
+            "gen_tokens": gen_tokens,
+        }
+
+    def spec_arm(dt):
+        # strongly-cyclic prompt, long budget: greedy decode locks a
+        # cycle, the prompt-lookup proposer drafts it, the batched
+        # verify confirms it — per-arm self-consistency, the thing
+        # int8 rounding could actually break
+        eng = LLMEngine(model, params, max_slots=2,
+                        page_size=page_size, n_pages=64, chunk=4,
+                        prefill_chunk=16, temperature=0.0,
+                        eos_id=-1, overlap=False,
+                        seed=args.seed, spec_len=4,
+                        kv_dtype=None if dt == "fp" else dt)
+        h = eng.submit([5, 6, 7, 8] * 5, max_new_tokens=40)
+        _drain(eng)
+        h.result()
+        sp = eng.spec_stats() or {}
+        eng.shutdown()
+        return sp.get("accept_rate"), sp.get("rounds")
+
+    def capacity_arm(dt):
+        n_pages = int(arm_pages[dt])
+        slots = max(1, (n_pages - 1) // pages_per_req)
+        eng = LLMEngine(model_cap, params_cap, max_slots=slots,
+                        page_size=page_size, n_pages=n_pages, chunk=4,
+                        prefill_chunk=16, temperature=0.0,
+                        seed=args.seed, prefix_cache=True,
+                        max_queued=slots,
+                        kv_dtype=None if dt == "fp" else dt)
+        # burst BEFORE stepping (engine not started): admitted =
+        # max_queued, everything past it sheds — a pure capacity
+        # count, no timing race
+        burst = [shared + t for t in tails] * 4 + plain * 2
+        sheds = 0
+        handles = []
+        for p in burst:
+            try:
+                handles.append(
+                    eng.submit(list(p), max_new_tokens=gen_tokens))
+            except EngineOverloaded:
+                sheds += 1
+        _drain(eng)
+        outs = [h.result() for h in handles]
+        rpt = eng.load_report()
+        pc = eng.prefix_stats() or {}
+        eng.shutdown()
+        assert rpt["kv_bytes_total"] <= byte_budget, (
+            dt, rpt["kv_bytes_total"], byte_budget)
+        return {
+            "n_pages": n_pages,
+            "effective_slots": slots,
+            "page_bytes": page_bytes[dt],
+            "kv_bytes_total": rpt["kv_bytes_total"],
+            "burst": len(burst),
+            "sheds": sheds,
+            "completed": len(outs),
+            "prefix_cached_pages": pc.get("cached_pages"),
+            "prefix_hit_rate": pc.get("hit_rate"),
+        }
+
+    # Everything below is deterministic (lockstep + manual stepping
+    # + pre-step bursts); the floors are recorded in the artifact so
+    # the gate travels with the numbers.
+    agreement_floor = 0.8
+    accept_noise = 0.15
+    print("kvq A/B: fp parity arm", flush=True)
+    fp_outs, fp_par = parity_arm("fp")
+    print("kvq A/B: int8 parity arm", flush=True)
+    i8_outs, i8_par = parity_arm("int8")
+    total = sum(len(o) for o in fp_outs)
+    agree = sum(x == y for a, b in zip(fp_outs, i8_outs)
+                for x, y in zip(a, b))
+    agreement = agree / total if total else 0.0
+    if agreement < agreement_floor:
+        print("WARNING: int8 token agreement below the recorded "
+              "floor — the artifact will fail schema validation",
+              flush=True)
+
+    print("kvq A/B: fp spec arm", flush=True)
+    fa, fp_rounds = spec_arm("fp")
+    print("kvq A/B: int8 spec arm", flush=True)
+    ia, i8_rounds = spec_arm("int8")
+
+    print("kvq A/B: fp capacity arm", flush=True)
+    fp_cap = capacity_arm("fp")
+    print("kvq A/B: int8 capacity arm", flush=True)
+    i8_cap = capacity_arm("int8")
+
+    return {
+        "kvq_ab": {
+            "byte_budget": int(byte_budget),
+            "page_size": page_size,
+            "fp": {"parity": fp_par, "capacity": fp_cap,
+                   "spec_rounds": fp_rounds},
+            "int8": {"parity": i8_par, "capacity": i8_cap,
+                     "spec_rounds": i8_rounds},
+            "parity": {
+                "token_agreement": round(agreement, 4),
+                "token_agreement_floor": agreement_floor,
+                "tokens_checked": total,
+                "spec_accept_rate_fp": fa,
+                "spec_accept_rate_int8": ia,
+                "spec_accept_noise": accept_noise,
+            },
+            "capacity_ratio": _ratio(i8_cap["n_pages"],
+                                     fp_cap["n_pages"]),
+            "slots_ratio": _ratio(i8_cap["effective_slots"],
+                                  fp_cap["effective_slots"]),
+            "shed_delta": fp_cap["sheds"] - i8_cap["sheds"],
+            "prefix_residency_delta": (
+                (i8_cap["prefix_cached_pages"] or 0)
+                - (fp_cap["prefix_cached_pages"] or 0)),
+        },
+        "mesh": {"tp": 1, "replicas": 1},
+        "model": "llama-tiny",
+        "notes": "Int8-KV A/B (serve_bench.py --kvq-ab): identical "
+                 "engine + greedy load with fp KV pages vs int8 "
+                 "pages + per-page absmax scales, at one fixed "
+                 "page-pool byte budget. Parity sub-run (equal ample "
+                 "pages, lockstep loop, fp32 model so the baseline "
+                 "argmax has no tie-flips of its own) gates token "
+                 "agreement >= the recorded floor — quantized KV is "
+                 "tolerance-equal, not bit-equal (write-history "
+                 "dependent rounding; docs/serving.md). Spec sub-run "
+                 "gates each arm's self-consistent accept rate on a "
+                 "cyclic prompt. Capacity sub-run converts the same "
+                 "bytes into each dtype's pages against the native "
+                 "bf16 baseline: the int8 arm runs ~2x the "
+                 "pages/slots, sheds fewer of the same deterministic "
+                 "burst, and retires with more prefix-cache pages "
+                 "resident.",
+    }
+
+
 def _ratio(a, b):
     return round(a / b, 2) if b else None
 
@@ -1944,6 +2202,18 @@ def _stamp(result, args, replicas=None):
                       {"tp": args.tp,
                        "replicas": (args.replicas if replicas is None
                                     else replicas)})
+    # KV representation stamp: which page dtype the run served from
+    # and which paged-attention backend read it. Numbers from an int8
+    # pool or the pallas kernel are not comparable to fp/gather runs
+    # without this. setdefault so runs that record several arms
+    # (e.g. --kvq-ab) keep their own richer stamp.
+    from ray_tpu.models.llama import _use_paged_kernel
+    from ray_tpu.util.envknobs import resolve_kv_dtype
+    result.setdefault("kv", {
+        "kv_dtype": resolve_kv_dtype(getattr(args, "kv_dtype", None)),
+        "paged_kernel": ("pallas" if _use_paged_kernel()
+                         else "gather"),
+    })
     return result
 
 
@@ -2065,6 +2335,21 @@ def main():
                          "for real TPUs (models/llama.py "
                          "_use_paged_kernel); off-TPU it runs the "
                          "interpreter and carries no ranking signal")
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=["fp", "int8"],
+                    help="paged KV pool element dtype for the engine "
+                         "path (int8 = quantized pages + per-page "
+                         "absmax scales, ~2x pages per byte; "
+                         "models/kv_cache.py). RAY_TPU_KV_DTYPE "
+                         "overrides; default fp")
+    ap.add_argument("--kvq-ab", action="store_true",
+                    help="int8-KV A/B: the identical engine + greedy "
+                         "load with fp pages and with int8 pages at "
+                         "ONE fixed page-pool byte budget — parity "
+                         "sub-run gates token agreement/spec accept "
+                         "rate, capacity sub-run proves ~2x pages/"
+                         "slots and fewer sheds from the same bytes; "
+                         "self-gated by tools/check_bench_schema.py")
     ap.add_argument("--lifecycle", action="store_true",
                     help="request-lifecycle smoke: unsaturated pass "
                          "then an overload burst against --max-queued "
@@ -2142,7 +2427,8 @@ def main():
                  prompt_order=args.prompt_order,
                  replicas=args.replicas, kv_pages=args.kv_pages,
                  eos_id=args.eos_id, max_seq_len=args.max_seq_len,
-                 seed=args.seed, tp=args.tp, fleet=args.fleet)
+                 seed=args.seed, tp=args.tp, fleet=args.fleet,
+                 kv_dtype=args.kv_dtype)
 
     import os
     if (args.tp > 1 or args.tp_ab) \
@@ -2224,6 +2510,25 @@ def main():
             json.dump(result, f, indent=1)
         # self-gate: a malformed or non-improving artifact fails its
         # OWN run (same discipline as the trace capture)
+        from tools import check_bench_schema as cbs
+        problems = []
+        cbs.check_file(out, problems)
+        for p in problems:
+            print(f"SCHEMA FAIL {p}")
+        print(json.dumps(result))
+        ray_tpu.shutdown()
+        if problems:
+            raise SystemExit(1)
+        return
+
+    if args.kvq_ab:
+        result = _stamp(run_kvq_ab(args), args)
+        out = args.out or "SERVE_BENCH_kvq_ab_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        # self-gate: an artifact missing its byte-budget stamp, below
+        # the 1.9x capacity ratio, or below the parity floor fails
+        # its OWN run
         from tools import check_bench_schema as cbs
         problems = []
         cbs.check_file(out, problems)

@@ -1263,3 +1263,139 @@ def test_fleet_trace_rejects_empty_capture(tmp_path):
     bad["events"] = []
     probs = _problems_for("SERVE_FLEET_TRACE_x.json", bad, tmp_path)
     assert any("events list is empty" in p for p in probs)
+
+
+# ---------------------------------------------------- kvq A/B family
+
+
+def _kvq_capacity(n_pages, slots, page_bytes, sheds):
+    return {"n_pages": n_pages, "effective_slots": slots,
+            "page_bytes": page_bytes,
+            "kv_bytes_total": n_pages * page_bytes,
+            "burst": 20, "sheds": sheds, "completed": 20 - sheds,
+            "prefix_cached_pages": 4, "prefix_hit_rate": 0.2}
+
+
+def _kvq_ab():
+    return {"kvq_ab": {"byte_budget": 98304, "page_size": 8,
+                       "fp": {"parity": {"wall_s": 0.03,
+                                         "requests": 8,
+                                         "gen_tokens": 16},
+                              "capacity": _kvq_capacity(
+                                  48, 9, 2048, 11)},
+                       "int8": {"parity": {"wall_s": 0.03,
+                                           "requests": 8,
+                                           "gen_tokens": 16},
+                                "capacity": _kvq_capacity(
+                                    93, 18, 1056, 2)},
+                       "parity": {"token_agreement": 0.85,
+                                  "token_agreement_floor": 0.8,
+                                  "tokens_checked": 128,
+                                  "spec_accept_rate_fp": 1.0,
+                                  "spec_accept_rate_int8": 1.0,
+                                  "spec_accept_noise": 0.15},
+                       "capacity_ratio": 1.94,
+                       "slots_ratio": 2.0,
+                       "shed_delta": 9},
+            "mesh": {"tp": 1, "replicas": 1}, "seed": 0,
+            "model": "llama-tiny", "git_sha": "abc1234"}
+
+
+def test_kvq_ab_artifact_validates(tmp_path):
+    assert _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                         _kvq_ab(), tmp_path) == []
+
+
+def test_kvq_ab_refuses_missing_stamp(tmp_path):
+    no_mesh = {k: v for k, v in _kvq_ab().items() if k != "mesh"}
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          no_mesh, tmp_path)
+    assert any("mesh stamp" in p for p in probs)
+    no_seed = {k: v for k, v in _kvq_ab().items() if k != "seed"}
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          no_seed, tmp_path)
+    assert any("seed" in p for p in probs)
+
+
+def test_kvq_ab_refuses_missing_byte_budget(tmp_path):
+    # a capacity claim without its budget proves nothing
+    no_budget = _kvq_ab()
+    del no_budget["kvq_ab"]["byte_budget"]
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          no_budget, tmp_path)
+    assert any("byte-budget" in p for p in probs)
+    typed = _kvq_ab()
+    typed["kvq_ab"]["byte_budget"] = "98304"
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          typed, tmp_path)
+    assert any("byte-budget" in p for p in probs)
+
+
+def test_kvq_ab_refuses_pool_over_budget(tmp_path):
+    over = _kvq_ab()
+    over["kvq_ab"]["int8"]["capacity"]["kv_bytes_total"] = 98305
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          over, tmp_path)
+    assert any("over the shared budget" in p for p in probs)
+
+
+def test_kvq_ab_refuses_low_capacity_ratio(tmp_path):
+    # int8 pages must buy ~2x the pages from the same bytes
+    low = _kvq_ab()
+    low["kvq_ab"]["capacity_ratio"] = 1.5
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          low, tmp_path)
+    assert any("< 1.9" in p for p in probs)
+    missing = _kvq_ab()
+    del missing["kvq_ab"]["capacity_ratio"]
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          missing, tmp_path)
+    assert any("capacity_ratio" in p for p in probs)
+
+
+def test_kvq_ab_refuses_agreement_below_recorded_floor(tmp_path):
+    low = _kvq_ab()
+    low["kvq_ab"]["parity"]["token_agreement"] = 0.7
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          low, tmp_path)
+    assert any("below the recorded floor" in p for p in probs)
+    unchecked = _kvq_ab()
+    unchecked["kvq_ab"]["parity"]["tokens_checked"] = 0
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          unchecked, tmp_path)
+    assert any("checked nothing" in p for p in probs)
+    no_floor = _kvq_ab()
+    del no_floor["kvq_ab"]["parity"]["token_agreement_floor"]
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          no_floor, tmp_path)
+    assert any("token_agreement_floor" in p for p in probs)
+
+
+def test_kvq_ab_refuses_spec_accept_drop(tmp_path):
+    drop = _kvq_ab()
+    drop["kvq_ab"]["parity"]["spec_accept_rate_int8"] = 0.5
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          drop, tmp_path)
+    assert any("accept-rate" in p for p in probs)
+
+
+def test_kvq_ab_refuses_non_improving_sheds(tmp_path):
+    # extra pages that don't absorb the burst bought no capacity
+    flat = _kvq_ab()
+    flat["kvq_ab"]["int8"]["capacity"]["sheds"] = 11
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          flat, tmp_path)
+    assert any("strictly fewer" in p for p in probs)
+
+
+def test_kvq_ab_requires_arms_and_fields(tmp_path):
+    no_arm = _kvq_ab()
+    del no_arm["kvq_ab"]["int8"]
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          no_arm, tmp_path)
+    assert any("int8 arm" in p for p in probs)
+    no_field = _kvq_ab()
+    del no_field["kvq_ab"]["fp"]["capacity"]["n_pages"]
+    probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
+                          no_field, tmp_path)
+    assert any("n_pages" in p for p in probs)

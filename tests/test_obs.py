@@ -331,8 +331,11 @@ def test_dump_and_load_flight_bundle(tmp_path):
     assert e["heartbeat_gap_s"] >= 2.5
     assert e["lifecycle"]["submitted"] == 5
     assert e["spec"] == {"spec_proposed": 10, "spec_accepted": 8}
+    # bytes view is None when the allocator wasn't priced (no
+    # page_bytes) — present but honest, never a fake 0
     assert e["allocator"] == {"n_pages": 64, "n_free": 60,
-                              "occupancy": 4 / 64}
+                              "occupancy": 4 / 64, "page_bytes": None,
+                              "bytes_in_use": None, "bytes_total": None}
     assert "ValueError" in b["extra"]["err"]
     # events.jsonl carries the same tail, one stream-tagged line each
     lines = [json.loads(ln) for ln in

@@ -1,0 +1,88 @@
+"""EQuARX-style int8 quantized psum (ops/quantized_collectives.py)
+on the forced 8-device CPU mesh.
+
+The op is groundwork — NOT wired into the serving engine — so these
+tests pin the numerics contract it must keep to ever be wired in:
+error within the analytic per-rank rounding bound (not a loose rtol),
+exact zeros for all-zero shards, dtype preservation, and a typed
+refusal of non-dividing shapes.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from ray_tpu.ops.quantized_collectives import (
+    dequantize_rowwise, quantize_rowwise, quantized_psum_error_bound,
+    quantized_psum_sharded)
+
+
+@pytest.fixture(scope="module")
+def mesh(cpu_mesh_devices):
+    return Mesh(np.array(cpu_mesh_devices[:8]), ("tensor",))
+
+
+def test_rowwise_roundtrip_half_step():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 256)), jnp.float32)
+    q, s = quantize_rowwise(x)
+    assert q.dtype == jnp.int8 and s.shape == (4, 1)
+    err = np.abs(np.asarray(dequantize_rowwise(q, s)) - np.asarray(x))
+    assert (err <= np.asarray(s) / 2.0 + 1e-7).all()
+
+
+def test_all_zero_rows_are_exact():
+    x = jnp.zeros((3, 64), jnp.float32)
+    q, s = quantize_rowwise(x)
+    assert (np.asarray(q) == 0).all() and (np.asarray(s) == 0).all()
+    assert (np.asarray(dequantize_rowwise(q, s)) == 0).all()
+
+
+def test_psum_within_analytic_bound(mesh):
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((8, 4, 128)).astype(np.float32)
+    out = np.asarray(quantized_psum_sharded(jnp.asarray(x), mesh))
+    exact = x.sum(axis=0)
+    bound = quantized_psum_error_bound(x)
+    err = np.abs(out - exact)
+    assert (err <= bound + 1e-6).all()
+    # and the bound is TIGHT enough to mean something: the observed
+    # error should be the same order, not 1000x smaller
+    assert err.max() > bound.max() / 100.0
+
+
+def test_psum_multiple_rows_per_rank(mesh):
+    # leading dim 16 over 8 ranks: each rank locally sums 2 rows
+    # before quantizing — one wire payload per rank, not per row
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((16, 64)).astype(np.float32)
+    out = np.asarray(quantized_psum_sharded(jnp.asarray(x), mesh))
+    exact = x.sum(axis=0)
+    local = x.reshape(8, 2, 64).sum(axis=1)   # per-rank partials
+    bound = quantized_psum_error_bound(local)
+    assert (np.abs(out - exact) <= bound + 1e-6).all()
+
+
+def test_dtype_preserved(mesh):
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((8, 32)), jnp.bfloat16)
+    out = quantized_psum_sharded(x, mesh)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_non_dividing_leading_dim_raises(mesh):
+    with pytest.raises(ValueError, match="does not shard"):
+        quantized_psum_sharded(jnp.zeros((7, 32), jnp.float32), mesh)
+
+
+def test_zero_shards_contribute_exactly_zero(mesh):
+    # one hot rank, seven zero ranks: the zero ranks' guarded divide
+    # must contribute exact zeros, so the sum equals the hot shard
+    # within ITS OWN rounding only
+    rng = np.random.default_rng(4)
+    x = np.zeros((8, 4, 64), np.float32)
+    x[3] = rng.standard_normal((4, 64))
+    out = np.asarray(quantized_psum_sharded(jnp.asarray(x), mesh))
+    bound = quantized_psum_error_bound(x[3:4])
+    assert (np.abs(out - x[3]) <= bound + 1e-7).all()

@@ -56,10 +56,40 @@ def _reference_completion(model, params, prompt, n):
     return np.asarray(out)[0, len(prompt):].tolist()
 
 
+def _reference_completions_int8(model, params, prompts, n):
+    """Greedy references for an int8-KV campaign: computed by a
+    REFERENCE ENGINE with the same knobs as the pool's replicas, not
+    by dense ``generate``.
+
+    Quantized KV is tolerance-equal to fp, never bit-equal, so a
+    dense-fp reference would turn honest rounding into "mismatched"
+    verdicts. What IS bit-exact — and what the chaos contract
+    actually protects — is failover: a request's quantized write
+    history (its token values, page-chunk boundaries, scale growth)
+    is identical on every replica with identical knobs, so a
+    resubmitted request must still complete token-identically to
+    this engine-derived reference (docs/serving.md, Failure
+    semantics)."""
+    from ray_tpu.serve.engine import LLMEngine
+    eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                    n_pages=64, chunk=4, temperature=0.0,
+                    seed=0, prefix_cache=True, eos_id=-1,
+                    kv_dtype="int8")
+    want = {}
+    for p in prompts:
+        h = eng.submit(list(p), max_new_tokens=n)
+        while eng.step():
+            pass
+        want[tuple(p)] = h.result()
+    eng.shutdown()
+    return want
+
+
 def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
               max_new_tokens=10, stall_deadline_s=1.0,
               watchdog_poll_s=0.05, drain_timeout_s=2.0,
-              attainment_floor=ATTAINMENT_FLOOR, flight_dir=None):
+              attainment_floor=ATTAINMENT_FLOOR, flight_dir=None,
+              kv_dtype=None):
     """One seeded serving chaos run. Returns the artifact dict after
     hard-asserting the availability contract (the schema checker
     re-refuses the same violations on the checked-in artifact).
@@ -108,12 +138,21 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
 
     # Prompt set + greedy ground truth (computed before the campaign;
     # fp32 greedy decode is replica-independent, so "token-identical
-    # after resubmission" has one right answer).
+    # after resubmission" has one right answer). An int8 campaign
+    # derives its references from a same-knobs reference ENGINE
+    # instead — the quantized write history is what replicas
+    # reproduce bit-for-bit, not the dense fp math.
+    from ray_tpu.util.envknobs import resolve_kv_dtype
+    kv_dtype = resolve_kv_dtype(kv_dtype)
     shared = [3, 1, 4, 1, 5, 9, 2, 6]
     prompts = [shared + [10 + i, 20 + i] for i in range(8)]
-    want = {tuple(p): _reference_completion(model, params, p,
-                                            max_new_tokens)
-            for p in prompts}
+    if kv_dtype == "int8":
+        want = _reference_completions_int8(model, params, prompts,
+                                           max_new_tokens)
+    else:
+        want = {tuple(p): _reference_completion(model, params, p,
+                                                max_new_tokens)
+                for p in prompts}
 
     # Every engine ever built — including corpses the pool replaced —
     # goes through the teardown + quiescence check at the end.
@@ -131,7 +170,8 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
                         seed=idx, prefix_cache=True, eos_id=-1,
                         admit_timeout_s=0.25,
                         fault_injector=inj,
-                        flight_dir=flight_dir)
+                        flight_dir=flight_dir,
+                        kv_dtype=kv_dtype)
         all_engines.append(eng)
         # Warm the jitted prefill/decode/prefix-copy paths BEFORE
         # the replica joins the pool (deployments do the same — see
@@ -414,6 +454,10 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
             "overlap": all(getattr(e, "overlap", False)
                            for e in all_engines),
             "eos_bounded": True,
+            # int8 campaigns adjudicate against a same-knobs
+            # reference ENGINE (quantized write history is replica-
+            # deterministic), fp against dense greedy decode
+            "kv_dtype": kv_dtype,
         },
         "schedule": [e.as_dict() for e in injector.schedule],
         "injected": counts,
@@ -1617,6 +1661,12 @@ def main():
                          "engines, fake = deterministic scripted "
                          "engines (fast smoke)")
     ap.add_argument("--lease-ttl", type=float, default=1.0)
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=("fp", "int8"),
+                    help="replica KV pool dtype (int8 = quantized "
+                         "pages; references switch to a same-knobs "
+                         "reference engine). In-process campaign "
+                         "only; --fleet agents stay fp")
     ap.add_argument("--out", default="")
     args = ap.parse_args()
     if args.fleet:
@@ -1628,7 +1678,8 @@ def main():
         artifact = run_chaos(
             seed=args.seed, replicas=args.replicas,
             duration_s=args.duration, clients=args.clients,
-            stall_deadline_s=args.stall_deadline)
+            stall_deadline_s=args.stall_deadline,
+            kv_dtype=args.kv_dtype)
     print(json.dumps(artifact, indent=1))
     if args.out:
         with open(args.out, "w") as f:

@@ -41,6 +41,18 @@ family:
   broken), or when the overlapped arm's host_gap_fraction is not
   STRICTLY below the lockstep arm's (an overlap that doesn't shrink
   the host gap measured nothing)
+- SERVE_BENCH kvq A/B (serve_bench.py --kvq-ab): {kvq_ab:
+  {byte_budget, fp, int8, parity, capacity_ratio}, mesh, seed} —
+  int8 paged-KV pages vs fp pages at ONE fixed page-pool byte
+  budget. REFUSED when the byte-budget stamp is missing (a capacity
+  claim without its budget proves nothing), when either arm's pool
+  exceeded the budget, when the capacity ratio is below 1.9x (the
+  whole point is ~2x pages from the same bytes), when greedy token
+  agreement fell below the floor the run itself recorded or checked
+  nothing, when the int8 spec accept-rate dropped beyond the
+  recorded noise bound, when the int8 arm did not shed strictly
+  fewer of the identical burst, or when the seed/mesh stamp is
+  missing.
 - SERVE_BENCH autoscale (serve_bench.py --autoscale): {trace, seed,
   slo, autoscale, static_max, chip_seconds_ratio} — REFUSED when
   autoscale SLO attainment is below the floor the run itself
@@ -228,6 +240,20 @@ OVERLAP_ARM_REQUIRED = {
     "round_wall_s": NUM,
     "host_gap_fraction": NUM,
     "ttft_p50_s": NUM,
+}
+
+# kvq A/B artifacts carry one of these per arm-capacity block
+# (serve_bench.py run_kvq_ab): the pages/slots the arm's dtype bought
+# from the shared byte budget and what happened to the identical
+# deterministic burst.
+KVQ_CAPACITY_REQUIRED = {
+    "n_pages": int,
+    "effective_slots": int,
+    "page_bytes": int,
+    "kv_bytes_total": int,
+    "burst": int,
+    "sheds": int,
+    "completed": int,
 }
 
 # serve-chaos artifacts (tools/chaos_serve.py): campaign shape +
@@ -663,7 +689,114 @@ def check_overlap_ab(obj, name, problems):
                         "numeric host_gap_fraction_ratio")
 
 
+def check_kvq_ab(obj, name, problems):
+    """serve_bench.py --kvq-ab artifact: the identical engine +
+    greedy load served from fp KV pages and from int8 pages +
+    per-page scales, under ONE fixed page-pool byte budget. The
+    checker REFUSES artifacts without the byte-budget stamp (a
+    capacity claim with no budget proves nothing), whose pools
+    exceeded the budget, whose capacity ratio is below 1.9x, whose
+    greedy token agreement fell below the floor the run recorded
+    (quantized KV is tolerance-equal, never bit-equal — the floor is
+    part of the artifact so the gate travels with the numbers),
+    whose spec accept-rate dropped beyond the recorded noise, whose
+    int8 arm did not shed strictly fewer of the identical burst, or
+    without the seed/mesh stamp."""
+    _check_mesh(obj, name, problems, required=True)
+    if not isinstance(obj.get("seed"), int) \
+            or isinstance(obj.get("seed"), bool):
+        problems.append(f"{name}: kvq A/B artifact missing int "
+                        "'seed'")
+    ab = obj.get("kvq_ab")
+    if not isinstance(ab, dict):
+        problems.append(f"{name}: kvq_ab must be an object")
+        return
+    budget = ab.get("byte_budget")
+    if not isinstance(budget, int) or isinstance(budget, bool) \
+            or budget < 1:
+        problems.append(f"{name}:kvq_ab: missing the byte-budget "
+                        "stamp (int byte_budget >= 1) — a capacity "
+                        "claim without its budget proves nothing")
+        budget = None
+    sheds = {}
+    for arm in ("fp", "int8"):
+        sec = ab.get(arm)
+        if not isinstance(sec, dict) \
+                or not isinstance(sec.get("capacity"), dict):
+            problems.append(f"{name}:kvq_ab: missing {arm} arm "
+                            "capacity block")
+            continue
+        cap = sec["capacity"]
+        _check_fields(cap, KVQ_CAPACITY_REQUIRED,
+                      f"{name}:kvq_ab:{arm}:capacity", problems)
+        used = cap.get("kv_bytes_total")
+        if budget is not None and isinstance(used, int) \
+                and not isinstance(used, bool) and used > budget:
+            problems.append(
+                f"{name}:kvq_ab: {arm} pool used {used} bytes, over "
+                f"the shared budget {budget} — the arms did not "
+                "compete for the same bytes")
+        if isinstance(cap.get("sheds"), int) \
+                and not isinstance(cap.get("sheds"), bool):
+            sheds[arm] = cap["sheds"]
+    if len(sheds) == 2 and sheds["int8"] >= sheds["fp"]:
+        problems.append(
+            f"{name}:kvq_ab: int8 arm shed {sheds['int8']} of the "
+            f"identical burst, not strictly fewer than the fp arm's "
+            f"{sheds['fp']} — the extra pages bought no capacity")
+    ratio = ab.get("capacity_ratio")
+    if not isinstance(ratio, NUM) or isinstance(ratio, bool):
+        problems.append(f"{name}: kvq A/B artifact missing numeric "
+                        "capacity_ratio")
+    elif ratio < 1.9:
+        problems.append(
+            f"{name}:kvq_ab: capacity_ratio {ratio} < 1.9 — int8 "
+            "pages must buy ~2x the pages from the same bytes "
+            "(per-page scales cost a few percent, not tens)")
+    parity = ab.get("parity")
+    if not isinstance(parity, dict):
+        problems.append(f"{name}:kvq_ab: missing the parity block")
+        return
+    agree = parity.get("token_agreement")
+    floor = parity.get("token_agreement_floor")
+    if not isinstance(agree, NUM) or isinstance(agree, bool) \
+            or not isinstance(floor, NUM) or isinstance(floor, bool):
+        problems.append(f"{name}:kvq_ab: parity must record numeric "
+                        "token_agreement AND token_agreement_floor "
+                        "(the gate travels with the artifact)")
+    elif agree < floor:
+        problems.append(
+            f"{name}:kvq_ab: token agreement {agree} below the "
+            f"recorded floor {floor} — int8 KV is tolerance-equal "
+            "by contract; an arm below its own floor is broken, "
+            "whatever its capacity")
+    checked = parity.get("tokens_checked")
+    if not isinstance(checked, int) or isinstance(checked, bool) \
+            or checked < 1:
+        problems.append(f"{name}:kvq_ab: parity checked nothing "
+                        "(parity.tokens_checked must be int >= 1)")
+    fa = parity.get("spec_accept_rate_fp")
+    ia = parity.get("spec_accept_rate_int8")
+    noise = parity.get("spec_accept_noise")
+    if isinstance(fa, NUM) and not isinstance(fa, bool) \
+            and isinstance(ia, NUM) and not isinstance(ia, bool) \
+            and isinstance(noise, NUM) \
+            and not isinstance(noise, bool) \
+            and ia < fa - noise:
+        problems.append(
+            f"{name}:kvq_ab: int8 spec accept-rate {ia} dropped more "
+            f"than the recorded noise bound {noise} below fp's {fa} "
+            "— quantized KV degraded the speculative verify")
+
+
 def check_serve_bench(obj, name, problems):
+    if "kvq_ab" in obj:
+        # int8-KV A/B family (serve_bench.py --kvq-ab)
+        check_kvq_ab(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
     if "overlap_ab" in obj:
         # overlapped hot-loop A/B family (serve_bench.py --overlap-ab)
         check_overlap_ab(obj, name, problems)
