@@ -133,6 +133,60 @@ def kv_pool_page_bytes(cfg, page_size: int,
     return cfg.n_layers * (per_layer + scale)
 
 
+def export_page_bytes(layers, page: int) -> List[List[bytes]]:
+    """Raw bytes of ONE physical page across every layer — the unit a
+    cross-replica KV pull ships. Each entry is the layer's column
+    tuple serialized in storage order: ``[k, v]`` for fp pools,
+    ``[k, v, sk, sv]`` for int8 (the per-page scales TRAVEL WITH the
+    payload — a page without its scale is garbage). ``t[:, page]`` is
+    the head-major column, so k/v blobs are ``[KH, Pg, D]`` and scale
+    blobs ``[KH, 1]``; blocks until any in-flight device computation
+    producing ``layers`` has settled."""
+    return [[np.asarray(t[:, page]).tobytes() for t in layer]
+            for layer in layers]
+
+
+def page_cols_from_bytes(cfg, page_size: int, kv_dtype: str,
+                         blobs: Sequence[Sequence[bytes]]):
+    """Inverse of ``export_page_bytes``: rebuild one page's per-layer
+    column arrays from raw bytes, shaped for a
+    ``pages.at[:, dst].set(col)`` landing — k/v ``[KH, Pg, D]``,
+    scales ``[KH, 1]``. Validates arity and byte counts so a
+    truncated or cross-dtype blob fails typed instead of landing
+    garbage KV."""
+    shape = (cfg.n_kv_heads, page_size, cfg.head_dim)
+    sshape = (cfg.n_kv_heads, 1)
+    if kv_dtype == "int8":
+        dts = (np.int8, np.int8,
+               np.dtype(KV_SCALE_DTYPE), np.dtype(KV_SCALE_DTYPE))
+        shapes = (shape, shape, sshape, sshape)
+    elif kv_dtype == "fp":
+        dts = (np.dtype(cfg.dtype), np.dtype(cfg.dtype))
+        shapes = (shape, shape)
+    else:
+        raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+    if len(blobs) != cfg.n_layers:
+        raise ValueError(
+            f"page payload has {len(blobs)} layers, pool has "
+            f"{cfg.n_layers}")
+    out = []
+    for li, layer_blobs in enumerate(blobs):
+        if len(layer_blobs) != len(dts):
+            raise ValueError(
+                f"layer {li}: {len(layer_blobs)} tensors, "
+                f"{kv_dtype} pool stores {len(dts)}")
+        cols = []
+        for b, dt, sh in zip(layer_blobs, dts, shapes):
+            want = int(np.prod(sh)) * np.dtype(dt).itemsize
+            if len(b) != want:
+                raise ValueError(
+                    f"layer {li}: {len(b)}-byte tensor, expected "
+                    f"{want} for shape {sh} {np.dtype(dt).name}")
+            cols.append(np.frombuffer(b, dtype=dt).reshape(sh))
+        out.append(tuple(cols))
+    return out
+
+
 class BlockAllocator:
     """Host-side free-list allocator over the physical page pool.
 

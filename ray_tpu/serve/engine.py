@@ -64,9 +64,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.models.kv_cache import (BlockAllocator, PagedKVLayer,
-                                     init_kv_pool, kv_layer_store,
-                                     kv_layer_view, kv_pool_page_bytes)
-from ray_tpu.serve import obs, spec_decode
+                                     export_page_bytes, init_kv_pool,
+                                     kv_layer_store, kv_layer_view,
+                                     kv_pool_page_bytes,
+                                     page_cols_from_bytes)
+from ray_tpu.serve import kv_migration, obs, spec_decode
 # Typed lifecycle errors live in a jax-free module (serve/errors.py)
 # so the HTTP proxy and clients can import them without the device
 # stack; RequestError is re-exported here for existing call sites.
@@ -168,6 +170,13 @@ class _Request:
                                  # resubmits)
     t_last_emit: Optional[float] = None   # last stream emission (for
                                  # the inter-token phase histogram)
+    pull: Optional[Dict[str, Any]] = None  # cross-replica KV pull
+                                 # hint from the router: {"hashes":
+                                 # [...], ...opaque fetcher fields}.
+                                 # Consumed EXACTLY ONCE at first
+                                 # admission — cleared before the
+                                 # pull starts, so a preemption or
+                                 # fault requeue can never re-pull.
 
     @property
     def remaining(self) -> int:
@@ -264,6 +273,16 @@ class _Slot:
     spec_pending: List[int] = dataclasses.field(default_factory=list)
                                  # drafts proposed at plan time,
                                  # consumed by this round's verify
+    pulling: bool = False        # PULLING phase: a background thread
+                                 # is pulling this request's prefix
+                                 # KV from a peer replica. The slot
+                                 # holds NO pages and rides NO
+                                 # dispatch; the planner skips it
+                                 # (SlotView.pulling) and the pull's
+                                 # completion requeues the request at
+                                 # the queue front for normal
+                                 # admission (local hit or plain
+                                 # prefill fallback).
 
     @property
     def prefill_remaining(self) -> int:
@@ -373,7 +392,8 @@ class LLMEngine:
                  events: bool = True,
                  flight_dir: Optional[str] = None,
                  overlap: Optional[bool] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 prefix_digest_max: int = 512):
         self.model = model
         self.cfg = model.config
         # Tensor-parallel placement (serve/sharding.py
@@ -431,6 +451,22 @@ class LLMEngine:
                              if prefix_cache else None)
         self._copy_page_fn = (self._build_copy_page()
                               if prefix_cache else None)
+        # Fleet prefix-cache digest advertisement cap: load reports
+        # ship at most this many path hashes, truncated prefix-closed
+        # longest/hottest-first (PrefixCache.digest) so fleet routing
+        # traffic stays bounded as the tree grows.
+        self.prefix_digest_max = max(0, int(prefix_digest_max))
+        # Cross-replica KV migration (serve/kv_migration.py). The
+        # REQUESTER side: ``kv_fetcher`` is injected by the pool/agent
+        # — a callable(pull_plan) -> payload dict or None — and a
+        # request submitted with a ``pull`` hint admits in the PULLING
+        # phase, overlapping the transfer with other slots' work. The
+        # DONOR side is the kv_pin_prefix/kv_export_pages/
+        # kv_release_pages trio a KVDonor drives. Stats mirror the
+        # process counters per engine (bench artifacts, pool_stats).
+        self.kv_fetcher: Optional[Any] = None
+        self.kv_migration_stats = kv_migration.new_stats()
+        self._write_page_fn = None   # built on first pulled landing
         # Speculative decoding (serve/spec_decode.py): greedy-only —
         # verification accepts drafts against the argmax, so with
         # sampling it would skew the output distribution. Silently
@@ -562,7 +598,8 @@ class LLMEngine:
     def submit(self, prompt_ids: List[int],
                max_new_tokens: int = 64,
                deadline_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> RequestHandle:
+               trace_id: Optional[str] = None,
+               pull: Optional[Dict[str, Any]] = None) -> RequestHandle:
         """Queue one request. ``deadline_s`` (relative, seconds) sets
         a hard completion deadline: the request fails with
         ``DeadlineExceeded`` at whatever phase it is in — queued,
@@ -570,7 +607,16 @@ class LLMEngine:
         round after the deadline passes, and its resources free
         immediately. With ``max_queued`` configured, a full admission
         queue sheds the request with ``EngineOverloaded`` instead of
-        accepting unbounded latency."""
+        accepting unbounded latency.
+
+        ``pull`` is a cross-replica KV pull hint from pool routing
+        (serve/kv_migration.py): a dict carrying at least ``hashes``
+        (the prompt's leading rolling path hashes a peer replica
+        advertised as resident) plus whatever opaque fields the
+        injected ``kv_fetcher`` needs to reach the donor. Admission
+        then enters the PULLING phase instead of recomputing the
+        prefix — see ``_admit_locked``. Ignored without a fetcher or
+        prefix cache."""
         prompt_ids = [int(t) for t in prompt_ids]
         if not prompt_ids:
             raise RequestError("empty prompt")
@@ -589,7 +635,8 @@ class LLMEngine:
                 f"prompt+completion {total} exceeds model "
                 f"max_seq_len {self.cfg.max_seq_len}")
         req = _Request(next(self._rid), prompt_ids, max_new_tokens,
-                       t_submit=time.monotonic(), trace_id=trace_id)
+                       t_submit=time.monotonic(), trace_id=trace_id,
+                       pull=pull)
         if deadline_s is not None:
             req.deadline = req.t_submit + deadline_s
         self.events.append("submit", rid=req.rid, t=req.t_submit,
@@ -751,9 +798,10 @@ class LLMEngine:
                                  or self._pending_prefill),
                 "tp": (self._sharding.tp
                        if self._sharding is not None else 1),
-                "prefix_digest": (self.prefix_cache.digest()
-                                  if self.prefix_cache is not None
-                                  else frozenset()),
+                "prefix_digest": (self.prefix_cache.digest(
+                    self.prefix_digest_max)
+                    if self.prefix_cache is not None
+                    else frozenset()),
             }
         if self._lock.acquire(timeout=0.02):
             try:
@@ -1091,6 +1139,17 @@ class LLMEngine:
                 # non-empty queue with nothing admitted = retry
                 # backoff or a transiently dry pool: still working
                 return bool(self._wait)
+            if all(s is None or s.pulling for s in self.slots):
+                # only PULLING slots live: nothing is dispatchable
+                # until a transfer lands or aborts. Park on the
+                # condition (the pull thread notifies on finish)
+                # instead of spinning rounds; readbacks of already-
+                # retired slots still drain.
+                if self._fetchq or self._pending_prefill:
+                    self._drain_fetches_locked(limit=1)
+                else:
+                    self._work.wait(timeout=0.01)
+                return True
             _tp = time.monotonic()
             plan = self._plan_steps_locked()
             _tpe = time.monotonic()
@@ -1242,7 +1301,8 @@ class LLMEngine:
                           if s.cur is not None else 0,
                           seeded=s.cur is not None,
                           spec_drafts=len(s.spec_pending),
-                          stale=stale[i])
+                          stale=stale[i],
+                          pulling=s.pulling)
                  for i, s in enumerate(self.slots) if s is not None]
         return plan_step(views, total_slots=self.S,
                          prefill_budget=self.PC, decode_chunk=self.K,
@@ -1394,7 +1454,20 @@ class LLMEngine:
         the last position's logits to sample the first token, and
         that one-token re-prefill must not scatter into a shared
         page). When the pool is dry, refcount-0 cached pages are
-        evicted LRU-first before admission gives up."""
+        evicted LRU-first before admission gives up.
+
+        A request carrying a router pull hint (``req.pull``) whose
+        prefix is NOT locally cached admits in the PULLING phase
+        instead: the slot is seated empty (no pages, no grants, the
+        planner skips it) while a background thread pulls the prefix
+        KV from the peer replica that advertised it
+        (serve/kv_migration.py). Transfer completion inserts the
+        pages into the prefix cache and requeues the request at the
+        queue FRONT, so the next admission round admits it through
+        THIS path as a plain local hit — mid-offset prefill resume,
+        COW boundary handling, and hit accounting all unchanged. An
+        aborted pull requeues without inserting anything: plain
+        prefill, never a wedge."""
         while self._wait:
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
@@ -1411,6 +1484,9 @@ class LLMEngine:
                 # everything behind it too.
                 return
             prompt = req.recompute_prompt
+            if req.pull is not None and self._try_pull_admit_locked(
+                    free[0], req, prompt):
+                continue       # PULLING slot seated; admit the rest
             shared_pages: List[int] = []
             matched = 0
             copy_src: Optional[int] = None
@@ -1479,6 +1555,197 @@ class LLMEngine:
                     self.events.append("cache_hit", rid=req.rid,
                                        sid=free[0], data=start)
 
+    # -------------------------------------------- KV migration (pull)
+
+    def _try_pull_admit_locked(self, sid: int, req: _Request,
+                               prompt: List[int]) -> bool:
+        """PULLING admission: seat ``req`` in slot ``sid`` with no
+        pages and spawn the background pull its router hint names.
+        The hint is consumed EXACTLY ONCE (cleared before any check
+        can bail), so no requeue path ever re-pulls. Declines — and
+        falls through to normal admission — when no fetcher/cache is
+        wired, the hint is empty, or the local tree already covers
+        the advertised run (then the pull would buy nothing)."""
+        pull = req.pull
+        req.pull = None          # consumed exactly once
+        if (self.kv_fetcher is None or self.prefix_cache is None
+                or req.generated or self._stopped or self._draining):
+            return False
+        try:
+            hashes = [int(h) for h in (pull.get("hashes") or ())]
+        except (AttributeError, TypeError, ValueError):
+            return False         # malformed hint: plain admission
+        if not hashes:
+            return False
+        have, _ = self.prefix_cache.match_hashes(hashes)
+        if have:
+            self.prefix_cache.release(have)
+        if len(have) >= len(hashes):
+            return False         # local cache already covers the hint
+        self._wait.popleft()
+        slot = _Slot(req=req, pages=[], pos=0, cur=None,
+                     admit_seq=next(self._admit_seq), prompt=prompt,
+                     prefilled=0, decoded=len(req.generated),
+                     pulling=True)
+        self.slots[sid] = slot
+        self.stats["kv_pull_admissions"] += 1
+        self.events.append("pull_start", rid=req.rid, sid=sid,
+                           data={"hashes": len(hashes),
+                                 "local": len(have)})
+        threading.Thread(target=self._run_pull,
+                         args=(sid, slot, pull), daemon=True,
+                         name=f"kv-pull-{req.rid}").start()
+        return True
+
+    def _run_pull(self, sid: int, slot: _Slot,
+                  pull: Dict[str, Any]) -> None:
+        """Background transfer for one PULLING slot, NO engine lock
+        held: the injected fetcher runs the chunked pull protocol
+        (kv_migration.pull_prefix — deadline, bounded retries, typed
+        abort) against the donor. Landing and requeue happen back
+        under the lock; any fetcher escape is an abort, never a
+        wedge."""
+        payload = None
+        try:
+            payload = self.kv_fetcher(pull)
+        except Exception:
+            payload = None
+        with self._work:
+            self._finish_pull_locked(sid, slot, payload)
+            self._work.notify_all()
+
+    def _finish_pull_locked(self, sid: int, slot: _Slot,
+                            payload: Optional[Dict[str, Any]]) -> None:
+        """Land a finished pull and requeue its request at the FRONT
+        of the admission queue: the next ``_admit_locked`` admits it
+        through the NORMAL path — a successful landing inserted the
+        pulled pages into the prefix cache, so admission matches them
+        as a local hit and resumes mid-offset prefill exactly like
+        any cached prefix; a failed pull admits as plain prefill
+        (fallback counted). Slot identity is validated first: cancel,
+        deadline reap, shutdown, or preemption may have torn the slot
+        down mid-transfer — the request's fate is already decided and
+        this result is dropped."""
+        if (self.slots[sid] is not slot or slot.preempted
+                or not slot.pulling):
+            return
+        slot.pulling = False
+        self.slots[sid] = None
+        req = slot.req
+        if req.closed or self._stopped:
+            return
+        landed = 0
+        if payload is not None:
+            landed = self._land_pulled_pages_locked(slot.prompt,
+                                                    payload)
+        if landed:
+            self.stats["kv_pull_landed"] += 1
+            self.events.append("pull_land", rid=req.rid, sid=sid,
+                               data={"pages": landed,
+                                     "wire_bytes":
+                                         payload.get("wire_bytes", 0)})
+        else:
+            kv_migration.count_fallback(self.kv_migration_stats)
+            self.stats["kv_pull_fallbacks"] += 1
+            self.events.append("pull_fallback", rid=req.rid, sid=sid)
+        self._wait.appendleft(req)   # front: admit before new arrivals
+
+    def _land_pulled_pages_locked(self, prompt: List[int],
+                                  payload: Dict[str, Any]) -> int:
+        """Write pulled page payloads into freshly allocated pool
+        pages and INSERT them into the prefix cache — the same
+        radix-tree insert retirement uses, so refcounts, COW
+        discipline, LRU order, and eviction see nothing new. Returns
+        pages landed; 0 (mismatched/truncated payload, allocator dry)
+        means fall back to plain prefill."""
+        if (payload.get("kv_dtype") != self.kv_dtype
+                or int(payload.get("page_size") or 0) != self.Pg
+                or int(payload.get("n_layers") or 0)
+                != self.cfg.n_layers):
+            return 0
+        n = min(int(payload.get("n_pages") or 0),
+                len(prompt) // self.Pg)
+        if n <= 0:
+            return 0
+        try:
+            # decode + validate BEFORE allocating: a malformed
+            # payload must not cost pool pages
+            cols = [page_cols_from_bytes(self.cfg, self.Pg,
+                                         self.kv_dtype, blobs)
+                    for blobs in payload["pages"][:n]]
+        except (ValueError, KeyError, TypeError):
+            return 0
+        page_ids = self._alloc(n)
+        if page_ids is None and self.prefix_cache.evict(
+                n - self.alloc.n_free) > 0:
+            page_ids = self._alloc(n)
+        if page_ids is None:
+            return 0
+        if self._write_page_fn is None:
+            self._write_page_fn = self._build_write_page()
+        for dst, page_cols in zip(page_ids, cols):
+            self.pages = self._write_page_fn(
+                self.pages, self._h2d(jnp.int32(dst)),
+                [tuple(self._h2d(c) for c in layer)
+                 for layer in page_cols])
+        self.prefix_cache.insert(prompt[:n * self.Pg], page_ids, 0)
+        self.stats["kv_pulled_pages"] += n
+        return n
+
+    def _build_write_page(self):
+        """Jitted whole-page landing write: scatter one pulled page's
+        per-layer columns (k/v payload and, for int8 pools, their
+        per-page scales — they travel together) into physical page
+        ``dst`` across every layer. dst is a traced scalar: one
+        executable for the whole pull. The donated pool update is the
+        same in-place discipline every other jitted step uses."""
+        constrain = self._constrain_kv
+
+        def write(pages, dst, cols):
+            return constrain(
+                [tuple(t.at[:, dst].set(c)
+                       for t, c in zip(layer, layer_cols))
+                 for layer, layer_cols in zip(pages, cols)])
+        return jax.jit(write, donate_argnums=(0,))
+
+    # ------------------------------------------- KV migration (donor)
+
+    def kv_pin_prefix(self, hashes: List[int]) -> List[int]:
+        """Donor side of a cross-replica pull: resolve rolling path
+        hashes to the longest resident page run and PIN it (refcount
+        increment via ``PrefixCache.match_hashes``) so eviction can
+        never yank a page mid-transfer. Caller owes one
+        ``kv_release_pages`` for the run. Empty when the prefix is
+        gone or the engine is stopped/draining — the KVDonor turns
+        that into a typed ``KVPullAborted``."""
+        with self._lock:
+            if (self.prefix_cache is None or self._stopped
+                    or self._draining):
+                return []
+            pages, _ = self.prefix_cache.match_hashes(hashes)
+            return pages
+
+    def kv_export_pages(self, pages: List[int]) -> List[Any]:
+        """Raw bytes of pinned pages, per page per layer (int8 scales
+        ride along — models/kv_cache.export_page_bytes). Under the
+        engine lock: pool buffers are donated to jitted calls, so an
+        unlocked read could touch an invalidated buffer mid-round.
+        A stopped donor refuses with the typed abort — in-process
+        pools must mirror what a dead peer process looks like over
+        the socket, or chaos kills would "succeed" off a corpse."""
+        with self._lock:
+            if self._stopped:
+                raise kv_migration.KVPullAborted(
+                    "donor engine stopped mid-transfer")
+            return [export_page_bytes(self.pages, int(p))
+                    for p in pages]
+
+    def kv_release_pages(self, pages: List[int]) -> None:
+        """Unpin a transfer's pages (drop the match_hashes refs)."""
+        with self._lock:
+            if self.prefix_cache is not None and pages:
+                self.prefix_cache.release(list(pages))
+
     def _dispatch_prefill_locked(self, grants):
         """Execute this round's prefill grants: grow each granted
         slot's pages to cover its chunk (evicting the youngest OTHER
@@ -1515,7 +1782,8 @@ class LLMEngine:
                     continue    # reclaimed cached pages; retry alloc
                 victim = max(
                     (j for j, s in enumerate(self.slots)
-                     if s is not None and j != g.sid),
+                     if s is not None and not s.pulling
+                     and j != g.sid),
                     key=lambda j: self.slots[j].admit_seq,
                     default=None)
                 if victim is None:
@@ -1569,7 +1837,8 @@ class LLMEngine:
                     continue    # reclaimed cached pages; retry alloc
                 victim = max(
                     (j for j, s in enumerate(self.slots)
-                     if s is not None and j != i),
+                     if s is not None and not s.pulling
+                     and j != i),
                     key=lambda j: self.slots[j].admit_seq,
                     default=None)
                 if victim is None:
@@ -1770,7 +2039,8 @@ class LLMEngine:
                     continue
                 victim = max(
                     (j for j, s in enumerate(self.slots)
-                     if s is not None and j != g.sid),
+                     if s is not None and not s.pulling
+                     and j != g.sid),
                     key=lambda j: self.slots[j].admit_seq,
                     default=None)
                 if victim is None:

@@ -62,7 +62,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from ray_tpu.serve import obs
+from ray_tpu.serve import kv_migration, obs
 from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
                                   EngineOverloaded, EngineShutdown,
                                   PoolDegraded, RequestCancelled,
@@ -314,6 +314,7 @@ class EnginePool:
                  restart_backoff_s: float = 0.05,
                  restart_backoff_max_s: float = 5.0,
                  max_restarts: Optional[int] = 5,
+                 share_prefixes: bool = False,
                  seed: int = 0):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
@@ -346,11 +347,22 @@ class EnginePool:
         # merged with engine rings by the trace exporter
         self.events = obs.EventLog(2048, name="pool")
         self._stopped = False
+        # Global prefix cache (share_prefixes=True): per-replica KV
+        # donors so a route landing on a cold replica PULLS the hot
+        # prefix's pages from the replica that already holds them
+        # instead of recomputing. Donors go through
+        # ``kv_migration.loopback_call`` — the JSON+b64 wire toll is
+        # paid even in-process, so the pool and the fleet share one
+        # transfer contract.
+        self._share_prefixes = bool(share_prefixes)
+        self._kv_donors: Dict[int, kv_migration.KVDonor] = {}
         self._replicas: List[_Replica] = []
         for i in range(num_replicas):
             eng = engine_factory(i)
             eng.start()
-            self._replicas.append(_Replica(i, eng))
+            rep = _Replica(i, eng)
+            self._replicas.append(rep)
+            self._wire_kv(rep)
 
     # --------------------------------------------------------- public
 
@@ -475,8 +487,10 @@ class EnginePool:
         else:
             eng = self._factory(idx)
             eng.start()
+            rep = _Replica(idx, eng)
             with self._lock:
-                self._replicas.append(_Replica(idx, eng))
+                self._replicas.append(rep)
+            self._wire_kv(rep)
         with self._lock:
             self.route_stats["replicas_added"] += 1
         return idx
@@ -571,6 +585,7 @@ class EnginePool:
                 idx, eng, HEALTHY, deaths=old.deaths,
                 generation=old.generation + 1)
             self.route_stats["restarts"] += 1
+        self._wire_kv(self._replicas[idx])
         self.events.append("restart", sid=idx,
                            data={"generation": old.generation + 1})
         _metrics()["restarts"].inc()
@@ -740,6 +755,82 @@ class EnginePool:
                            if trace_id is not None else None)
         _metrics()["requeues"].inc()
 
+    # ---------------------------------------------- prefix sharing
+
+    def _wire_kv(self, rep: _Replica) -> None:
+        """Register ``rep``'s engine as a KV donor and hand it a
+        fetcher that pulls from its siblings. Re-run on every
+        rebuild: the donor table must always point at the LIVE
+        engine for each slot (a transfer begun against the old
+        incarnation aborts typed on the fresh donor's empty
+        table)."""
+        if not self._share_prefixes:
+            return
+        eng = rep.engine
+        if not hasattr(eng, "kv_migration_stats"):
+            return
+        with self._lock:
+            self._kv_donors[rep.idx] = kv_migration.KVDonor(eng)
+        eng.kv_fetcher = lambda pull, e=eng: self._kv_fetch(e, pull)
+
+    def _kv_fetch(self, requester_engine,
+                  pull: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            donor = self._kv_donors.get(pull.get("replica_idx"))
+        if donor is None:
+            return None
+        try:
+            return kv_migration.pull_prefix(
+                kv_migration.loopback_call(donor),
+                pull.get("hashes") or [],
+                stats=requester_engine.kv_migration_stats)
+        except Exception:
+            return None
+
+    def _pull_hint(self, prompt: List[int], rep: _Replica,
+                   reports: Dict[int, Dict[str, Any]]
+                   ) -> Optional[Dict[str, Any]]:
+        """When a sibling replica advertises a strictly longer
+        cached prefix of this prompt than the routed target does,
+        name it as the donor — the target pulls instead of
+        recomputing. A hint only: any staleness degrades to plain
+        prefill on the target."""
+        Pg = getattr(rep.engine, "Pg", 0)
+        if Pg <= 0 or len(prompt) < Pg:
+            return None
+        chain = path_hashes(prompt, Pg)
+
+        def cover(idx: int) -> int:
+            have = reports.get(idx, {}).get("prefix_digest") \
+                or frozenset()
+            n = 0
+            for h in chain:
+                if h not in have:
+                    break
+                n += 1
+            return n
+
+        best_idx, best_n = None, cover(rep.idx)
+        for idx in reports:
+            if idx == rep.idx:
+                continue
+            n = cover(idx)
+            if n > best_n:
+                best_idx, best_n = idx, n
+        if best_idx is None:
+            return None
+        with self._lock:
+            self.route_stats["pull_hints"] += 1
+        return {"hashes": chain[:best_n], "replica_idx": best_idx}
+
+    def kv_migration_stats(self) -> Optional[Dict[str, Any]]:
+        """Summed cross-replica KV migration counters (pulls, pages,
+        wire bytes, aborts, fallbacks) — the ``kv_migration`` block
+        in pool stats, bench artifacts, and flight bundles."""
+        per = [getattr(r.engine, "kv_migration_stats", None)
+               for r in self._replicas]
+        return self._agg_numeric(per)
+
     # --------------------------------------------------------- routing
 
     def _submit_once(self, prompt: List[int], max_new_tokens: int,
@@ -806,6 +897,8 @@ class EnginePool:
                     deadline_s=deadline_s)
                 if trace_id is not None:
                     kw["trace_id"] = trace_id
+                if decision.get("pull") is not None:
+                    kw["pull"] = decision["pull"]
                 inner = rep.engine.submit(prompt, **kw)
             except EngineOverloaded as e:
                 shed.append(e)
@@ -863,7 +956,12 @@ class EnginePool:
             cands, prompt, sticky_key=sticky_idx, rng=self._rng)
         if pick is None:
             return None, decision
-        return by_key[pick.key], decision
+        rep = by_key[pick.key]
+        if self._share_prefixes:
+            hint = self._pull_hint(prompt, rep, reports)
+            if hint is not None:
+                decision = dict(decision, pull=hint)
+        return rep, decision
 
     def _record_route(self, rep: _Replica, decision: Dict[str, Any],
                       session_id: Optional[str],
@@ -987,6 +1085,9 @@ class EnginePool:
         counters["degraded"] = any(
             r["state"] == DEGRADED for r in reps)
         counters["replicas"] = reps
+        kv = self.kv_migration_stats()
+        if kv is not None:
+            counters["kv_migration"] = kv
         scaler = self._autoscaler
         if scaler is not None:
             counters["autoscale"] = scaler.stats()

@@ -43,13 +43,15 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from ray_tpu.serve import obs
+from ray_tpu.serve import kv_migration, obs
 from ray_tpu.serve.errors import (EngineDraining, EngineShutdown,
                                   RequestCancelled)
 from ray_tpu.serve.fleet import wire
 from ray_tpu.serve.fleet.directory import DirectoryClient
-from ray_tpu.serve.fleet.transport import Transport
-from ray_tpu.serve.fleet.wire import AgentFenced, StaleFencingToken
+from ray_tpu.serve.fleet.transport import (SocketTransport,
+                                           Transport)
+from ray_tpu.serve.fleet.wire import (AgentFenced, KVPullAborted,
+                                      StaleFencingToken)
 
 ACTIVE = "active"
 FENCED = "fenced"
@@ -121,7 +123,11 @@ class ScriptedEngine:
 
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                deadline_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> _ScriptedHandle:
+               trace_id: Optional[str] = None,
+               pull: Optional[Dict[str, Any]] = None
+               ) -> _ScriptedHandle:
+        # ``pull`` accepted for surface parity with LLMEngine and
+        # ignored: the scripted engine has no KV to migrate.
         if self._stopped:
             raise EngineShutdown("engine stopped")
         if self._draining:
@@ -182,10 +188,16 @@ class ReplicaAgent:
                  stall_deadline_s: Optional[float] = None,
                  flight_dir: Any = None,
                  register_patience_s: float = 60.0,
+                 peer_transport_factory: Optional[
+                     Callable[[Any], Transport]] = None,
                  time_fn: Callable[[], float] = time.monotonic):
         self.replica_id = replica_id
         self._factory = engine_factory
         self._directory = directory
+        # how this agent dials a DONOR peer for a KV pull: defaults
+        # to a TCP dial of the hint's ["tcp", host, port] addr; tests
+        # inject loopback resolution here
+        self._peer_tf = peer_transport_factory
         self.addr = addr if addr is not None else ["loopback",
                                                    replica_id]
         self.generation = int(generation)
@@ -209,6 +221,14 @@ class ReplicaAgent:
         self._stop = threading.Event()
         self._renew_thread: Optional[threading.Thread] = None
         self._watchdog = None
+        # Donor side of cross-replica KV migration
+        # (serve/kv_migration.py): rebuilt with the engine after a
+        # wedge so a transfer table can never outlive its pool. The
+        # env knob stretches each chunk export so chaos harnesses can
+        # kill a donor deterministically MID-pull.
+        self._kv_donor: Optional[kv_migration.KVDonor] = None
+        self._kv_chunk_delay_s = float(
+            os.environ.get("RAY_TPU_KV_CHUNK_DELAY_S", "0") or 0)
         self.events = obs.EventLog(1024, name=f"agent-{replica_id}")
         self.counters = {"submits": 0, "dup_submits": 0,
                          "refused_fenced": 0, "refused_stale_fence":
@@ -223,6 +243,7 @@ class ReplicaAgent:
             self.engine = self._factory(self.generation)
             if hasattr(self.engine, "start"):
                 self.engine.start()
+        self._wire_engine_kv()
         # the control plane may be mid-failover at boot (old primary
         # dead, standby not yet promoted): every endpoint then answers
         # TransportError or NotPrimary. That is a TRANSIENT condition
@@ -425,6 +446,7 @@ class ReplicaAgent:
         self.engine = self._factory(self.generation)
         if hasattr(self.engine, "start"):
             self.engine.start()
+        self._wire_engine_kv()
         try:
             if old is not None:
                 old.shutdown()
@@ -458,6 +480,7 @@ class ReplicaAgent:
                    max_new_tokens: int,
                    deadline_s: Optional[float] = None,
                    fence: Optional[int] = None,
+                   pull: Optional[Dict[str, Any]] = None,
                    trace_id: Optional[str] = None) -> Dict[str, Any]:
         if self.state == FENCED:
             self.counters["refused_fenced"] += 1
@@ -484,6 +507,12 @@ class ReplicaAgent:
                                   deadline_s=deadline_s)
         if trace_id is not None:
             kw["trace_id"] = trace_id
+        if pull is not None:
+            # router's cross-replica prefix hint: a HINT only — the
+            # engine declines it whenever its local cache already
+            # covers the prefix, and any pull failure degrades to
+            # plain prefill, so a stale hint costs nothing but time
+            kw["pull"] = pull
         inner = self.engine.submit(list(prompt_ids), **kw)
         with self._lock:
             # lost the race to a duplicate that admitted first?
@@ -578,6 +607,80 @@ class ReplicaAgent:
         except Exception:
             return {"cancelled": False}
 
+    # ------------------------------------------------- KV migration
+
+    def _wire_engine_kv(self) -> None:
+        """(Re)build the KV donor for the CURRENT engine and inject
+        the requester-side fetcher. Runs at start and after every
+        wedge rebuild: a donor kept across a rebuild would export
+        pages from a pool that no longer exists, and an in-flight
+        transfer against the old engine now lands on an empty
+        transfer table — a typed ``KVPullAborted``, never stale
+        bytes."""
+        eng = self.engine
+        if eng is None or not hasattr(eng, "kv_migration_stats"):
+            self._kv_donor = None
+            return
+        self._kv_donor = kv_migration.KVDonor(
+            eng, chunk_delay_s=self._kv_chunk_delay_s)
+        eng.kv_fetcher = self._kv_fetch
+
+    def _kv_donor_or_abort(self) -> "kv_migration.KVDonor":
+        if self.state == FENCED:
+            raise KVPullAborted(
+                f"donor {self.replica_id} is fenced; its pages may "
+                f"be reclaimed at any moment")
+        donor = self._kv_donor
+        if donor is None:
+            raise KVPullAborted(
+                f"agent {self.replica_id} has no KV pool to donate "
+                f"from")
+        return donor
+
+    def rpc_kv_pull_begin(self,
+                          hashes: List[int]) -> Dict[str, Any]:
+        return self._kv_donor_or_abort().begin(list(hashes))
+
+    def rpc_kv_pull_chunk(self, xfer_id: str,
+                          chunk_idx: int) -> Dict[str, Any]:
+        return self._kv_donor_or_abort().chunk(str(xfer_id),
+                                               int(chunk_idx))
+
+    def rpc_kv_pull_end(self, xfer_id: str) -> Dict[str, Any]:
+        donor = self._kv_donor
+        if donor is None:
+            return {"released": False}
+        return donor.end(str(xfer_id))
+
+    def _kv_fetch(self,
+                  pull: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Requester-side fetcher the engine calls from its PULLING
+        phase: dial the donor named in the pull hint and run the
+        chunked pull protocol. Returns None on ANY failure — the
+        engine then requeues for plain prefill, so a pull is never
+        worse than not having tried."""
+        addr = pull.get("addr")
+        if self._peer_tf is not None:
+            try:
+                t = self._peer_tf(tuple(addr or ()))
+            except Exception:
+                return None
+        elif (isinstance(addr, (list, tuple)) and len(addr) == 3
+                and addr[0] == "tcp"):
+            t = SocketTransport((addr[1], int(addr[2])))
+        else:
+            return None
+        try:
+            return kv_migration.pull_prefix(
+                lambda m, a: t.call(m, a),
+                pull.get("hashes") or [],
+                stats=getattr(self.engine, "kv_migration_stats",
+                              None))
+        except Exception:
+            return None
+        finally:
+            t.close()
+
     def rpc_load_report(self) -> Dict[str, Any]:
         rpt = dict(self.engine.load_report())
         rpt["prefix_digest"] = sorted(rpt.get("prefix_digest", ())
@@ -594,7 +697,8 @@ class ReplicaAgent:
                                "fence": self.fence}
         eng = self.engine
         for name in ("stats", "ttfts_s", "prefix_stats",
-                     "spec_stats", "lifecycle_stats"):
+                     "spec_stats", "lifecycle_stats",
+                     "kv_migration_stats"):
             try:
                 v = getattr(eng, name, None)
                 v = v() if callable(v) else v
@@ -727,16 +831,35 @@ class AgentClient:
                max_new_tokens: int,
                deadline_s: Optional[float] = None,
                fence: Optional[int] = None,
+               pull: Optional[Dict[str, Any]] = None,
                trace_id: Optional[str] = None,
                timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        args = {"key": key, "prompt_ids": list(prompt_ids),
+                "max_new_tokens": max_new_tokens,
+                "deadline_s": deadline_s, "fence": fence}
+        if pull is not None:
+            args["pull"] = pull
         return self._t.call(
-            "submit",
-            {"key": key, "prompt_ids": list(prompt_ids),
-             "max_new_tokens": max_new_tokens,
-             "deadline_s": deadline_s, "fence": fence},
+            "submit", args,
             timeout_s=(timeout_s if timeout_s is not None
                        else self._timeout_s),
             trace_id=trace_id)
+
+    def kv_pull_begin(self, hashes: List[int]) -> Dict[str, Any]:
+        return self._t.call("kv_pull_begin",
+                            {"hashes": list(hashes)},
+                            timeout_s=self._timeout_s)
+
+    def kv_pull_chunk(self, xfer_id: str,
+                      chunk_idx: int) -> Dict[str, Any]:
+        return self._t.call(
+            "kv_pull_chunk",
+            {"xfer_id": xfer_id, "chunk_idx": chunk_idx},
+            timeout_s=self._timeout_s)
+
+    def kv_pull_end(self, xfer_id: str) -> Dict[str, Any]:
+        return self._t.call("kv_pull_end", {"xfer_id": xfer_id},
+                            timeout_s=self._timeout_s)
 
     def poll(self, rid: str, cursor: int = 0,
              trace_id: Optional[str] = None,

@@ -100,6 +100,14 @@ class FleetDirectory:
         self._now = time_fn
         self._lock = threading.Lock()
         self._members: Dict[str, _Member] = {}
+        # Global prefix directory: page-path-hash -> replica ids
+        # currently advertising that hash in their digest. Soft
+        # state, repainted by every renewal and dropped with the
+        # member row (deregister, reap, supersession) — which IS the
+        # generation fence: a dead incarnation's holdings can never
+        # outlive its lease, so the router never dials a donor for
+        # pages a newer incarnation no longer holds.
+        self._prefix_index: Dict[int, set] = {}
         # replica_id -> highest generation ever confirmed dead or
         # retired; zombie registrations at or below it are rejected
         self._tombstones: Dict[str, int] = {}
@@ -125,7 +133,8 @@ class FleetDirectory:
                          "repl_applied": 0, "repl_syncs": 0,
                          "repl_gaps": 0,
                          "repl_stale_epoch_rejects": 0,
-                         "promotions": 0}
+                         "promotions": 0,
+                         "prefix_queries": 0, "prefix_hits": 0}
         self._wal = None
         if data_dir is not None:
             from ray_tpu.serve.fleet.wal import DirectoryWAL
@@ -173,6 +182,7 @@ class FleetDirectory:
         if op == "member":
             rid = rec["replica_id"]
             fence = int(rec["fence"])
+            self._drop_prefix_holdings(self._members.get(rid))
             self._members[rid] = _Member(
                 rid, list(rec["addr"]), int(rec["generation"]),
                 fence, now + self.lease_ttl_s,
@@ -185,6 +195,7 @@ class FleetDirectory:
                 self._tombstones.get(rid, -1), gen)
             m = self._members.get(rid)
             if m is not None and m.generation <= gen:
+                self._drop_prefix_holdings(m)
                 del self._members[rid]
         elif op == "promote":
             self.epoch = max(self.epoch, int(rec["epoch"]))
@@ -225,6 +236,32 @@ class FleetDirectory:
                             fence_counter=self._fence_counter,
                             torn_truncated=self.counters[
                                 "wal_torn_truncated"])
+
+    # --------------------------------------------- prefix directory
+
+    def _repaint_prefix_index(self, m: _Member,
+                              digest: List[int]) -> None:
+        """Replace ``m``'s advertised holdings with ``digest``.
+        Caller holds the lock."""
+        new = {int(h) for h in digest}
+        old = set(m.digest)
+        for h in old - new:
+            holders = self._prefix_index.get(h)
+            if holders is not None:
+                holders.discard(m.replica_id)
+                if not holders:
+                    del self._prefix_index[h]
+        for h in new - old:
+            self._prefix_index.setdefault(h, set()).add(
+                m.replica_id)
+        m.digest = sorted(new)
+
+    def _drop_prefix_holdings(self, m: Optional[_Member]) -> None:
+        """Tombstone a member's holdings with its membership row.
+        Caller holds the lock."""
+        if m is None:
+            return
+        self._repaint_prefix_index(m, [])
 
     def _require_primary(self, op: str) -> None:
         if self.role != PRIMARY:
@@ -268,6 +305,7 @@ class FleetDirectory:
                                       int(min_fence)) + 1
             fence = self._fence_counter
             now = self._now()
+            self._drop_prefix_holdings(cur)
             self._members[replica_id] = _Member(
                 replica_id, list(addr), int(generation), fence,
                 now + self.lease_ttl_s, int(page_size), now)
@@ -304,7 +342,7 @@ class FleetDirectory:
                 self.counters["late_renewals"] += 1
             m.lease_expires = now + self.lease_ttl_s
             if digest is not None:
-                m.digest = list(digest)
+                self._repaint_prefix_index(m, list(digest))
             if load is not None:
                 m.load = dict(load)
             if wedged and not m.wedged:
@@ -328,6 +366,7 @@ class FleetDirectory:
                 raise StaleFencingToken(
                     f"deregister of {replica_id} with fence {fence} "
                     f"rejected: current fence is {m.fence}")
+            self._drop_prefix_holdings(m)
             del self._members[replica_id]
             self._tombstones[replica_id] = max(
                 self._tombstones.get(replica_id, -1), m.generation)
@@ -358,6 +397,7 @@ class FleetDirectory:
                 return {"dead": False,
                         "lease_remaining_s":
                             m.lease_expires - now}
+            self._drop_prefix_holdings(m)
             del self._members[replica_id]
             self._tombstones[replica_id] = max(
                 self._tombstones.get(replica_id, -1), m.generation)
@@ -389,9 +429,48 @@ class FleetDirectory:
                     "lease_ttl_s": self.lease_ttl_s,
                     "epoch": self.epoch}
 
+    def rpc_prefix_holders(self, hashes: List[int],
+                           limit: int = 4) -> Dict[str, Any]:
+        """Who can donate this prefix? ``hashes`` is the requester's
+        rolling page-path-hash chain (prefix_cache.path_hashes order
+        — hash k covers pages 0..k). Holders are ranked by matched
+        CONTIGUOUS prefix length, longest donor first; members with
+        lapsed leases or a reported wedge never appear, however
+        recently they advertised. Primary-only, same staleness
+        argument as ``snapshot``."""
+        with self._lock:
+            self._require_primary("prefix_holders")
+            self.counters["prefix_queries"] += 1
+            chain = [int(h) for h in hashes]
+            out: List[Dict[str, Any]] = []
+            if chain:
+                now = self._now()
+                for rid in self._prefix_index.get(chain[0], ()):
+                    m = self._members.get(rid)
+                    if (m is None or now > m.lease_expires
+                            or m.wedged):
+                        continue
+                    n = 0
+                    for h in chain:
+                        if rid not in self._prefix_index.get(h, ()):
+                            break
+                        n += 1
+                    out.append({"replica_id": rid,
+                                "generation": m.generation,
+                                "fence": m.fence,
+                                "addr": list(m.addr),
+                                "n_matched": n})
+                out.sort(key=lambda r: (-r["n_matched"],
+                                        r["replica_id"]))
+                out = out[:max(1, int(limit))]
+            if out:
+                self.counters["prefix_hits"] += 1
+            return {"holders": out}
+
     def rpc_stats(self) -> Dict[str, Any]:
         with self._lock:
             out = {"members": len(self._members),
+                   "prefix_index_hashes": len(self._prefix_index),
                    "fence_counter": self._fence_counter,
                    "tombstones": dict(self._tombstones),
                    "counters": dict(self.counters),
@@ -471,6 +550,7 @@ class FleetDirectory:
                     f"{self.epoch}")
             now = self._now()
             self._members.clear()
+            self._prefix_index.clear()
             for row in state.get("members", ()):
                 self._apply_record(dict(row, op="member"), now)
             for rid, gen in (state.get("tombstones")
@@ -602,6 +682,13 @@ class DirectoryClient:
     def snapshot(self) -> Dict[str, Any]:
         return self._t.call("snapshot", {},
                             timeout_s=self._timeout_s)
+
+    def prefix_holders(self, hashes: List[int],
+                       limit: int = 4) -> Dict[str, Any]:
+        return self._t.call(
+            "prefix_holders",
+            {"hashes": list(hashes), "limit": limit},
+            timeout_s=self._timeout_s)
 
     def stats(self) -> Dict[str, Any]:
         return self._t.call("stats", {}, timeout_s=self._timeout_s)

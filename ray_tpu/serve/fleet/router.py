@@ -47,6 +47,7 @@ from ray_tpu.serve.fleet.routing import (Candidate, ResubmitPolicy,
                                          select_candidate)
 from ray_tpu.serve.fleet.transport import (Transport,
                                            TransportError)
+from ray_tpu.serve.prefix_cache import path_hashes
 
 
 class _Member:
@@ -283,7 +284,8 @@ class FleetRouter:
                          "stale_snapshots": 0, "all_shed": 0,
                          "submit_retries": 0,
                          "snapshot_hits": 0, "snapshot_misses": 0,
-                         "member_invalidations": 0}
+                         "member_invalidations": 0,
+                         "pull_hints": 0}
         self._stopped = False
 
     # --------------------------------------------------------- submit
@@ -361,13 +363,14 @@ class FleetRouter:
                 err2.retry_after_s = max(base, eta)
                 raise err2
             member = members[pick.key]
+            pull = self._pull_hint(prompt, member, members)
             key = self._mint_key()
             try:
                 resp = self._call_with_retry(
-                    lambda c, m=member, k=key: c.submit(
+                    lambda c, m=member, k=key, p=pull: c.submit(
                         k, prompt, max_new_tokens,
                         deadline_s=deadline_s, fence=m.fence,
-                        trace_id=trace_id,
+                        pull=p, trace_id=trace_id,
                         timeout_s=self.call_timeout_s),
                     member)
             except TransportError as e:
@@ -396,6 +399,49 @@ class FleetRouter:
             self._record_route(member, decision, session_id,
                                trace_id=trace_id)
             return member, resp["rid"]
+
+    def _pull_hint(self, prompt: List[int], member: _Member,
+                   members: Dict[str, _Member]
+                   ) -> Optional[Dict[str, Any]]:
+        """Global-prefix-cache routing: when some OTHER live member
+        advertises a strictly longer contiguous prefix of this
+        prompt than the chosen target does, attach a pull hint
+        naming that donor — the target then PULLS the pages instead
+        of recomputing them. Computed entirely from the snapshot's
+        piggybacked digests (no extra directory round-trip on the
+        submit path), and only a hint: a stale digest costs a failed
+        pull that degrades to plain prefill."""
+        Pg = member.page_size
+        if Pg <= 0 or len(prompt) < Pg:
+            return None
+        chain = path_hashes(prompt, Pg)
+        n_local = self._digest_cover(chain, member)
+        best: Optional[_Member] = None
+        best_n = n_local
+        for rid, m in members.items():
+            if rid == member.replica_id or m.page_size != Pg:
+                continue
+            n = self._digest_cover(chain, m)
+            if n > best_n:
+                best, best_n = m, n
+        if best is None:
+            return None
+        with self._lock:
+            self.counters["pull_hints"] += 1
+        return {"hashes": chain[:best_n],
+                "addr": list(best.addr),
+                "replica_id": best.replica_id,
+                "generation": best.generation}
+
+    @staticmethod
+    def _digest_cover(chain: List[int], m: _Member) -> int:
+        have = m.report.get("prefix_digest") or frozenset()
+        n = 0
+        for h in chain:
+            if h not in have:
+                break
+            n += 1
+        return n
 
     def _call_with_retry(self, fn: Callable[[AgentClient], Any],
                          member: _Member) -> Any:

@@ -33,6 +33,28 @@ from ray_tpu.serve.fleet import wire
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
+_max_frame = MAX_FRAME
+
+
+def max_frame_bytes() -> int:
+    """The fleet-wide frame ceiling every bulk payload must plan
+    around: KV migration chunks size themselves to fit under it
+    (serve/kv_migration.py) and telemetry scrapes bound their event
+    windows by it — one explicit knob instead of two implicit ones."""
+    return _max_frame
+
+
+def set_max_frame_bytes(n: int) -> int:
+    """Set the frame ceiling (tests shrink it to force the typed
+    oversize rejection without building 64 MiB payloads). Returns the
+    previous value so callers can restore it."""
+    global _max_frame
+    if int(n) < 1024:
+        raise ValueError(f"max frame of {n} bytes is below the 1 KiB "
+                         f"floor (control envelopes must always fit)")
+    prev = _max_frame
+    _max_frame = int(n)
+    return prev
 
 # handler(method, args, trace_id) -> JSON-serializable result
 Handler = Callable[[str, Dict[str, Any], Optional[str]], Any]
@@ -47,17 +69,20 @@ class TransportTimeout(TransportError):
 
 
 def send_frame(sock: socket.socket, payload: bytes) -> None:
-    if len(payload) > MAX_FRAME:
+    if len(payload) > _max_frame:
         raise TransportError(
-            f"frame of {len(payload)} bytes exceeds MAX_FRAME")
+            f"frame of {len(payload)} bytes exceeds the "
+            f"{_max_frame}-byte max-frame knob")
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
 def recv_frame(sock: socket.socket) -> bytes:
     head = _recv_exact(sock, _LEN.size)
     (n,) = _LEN.unpack(head)
-    if n > MAX_FRAME:
-        raise TransportError(f"peer announced {n}-byte frame")
+    if n > _max_frame:
+        raise TransportError(
+            f"peer announced {n}-byte frame over the "
+            f"{_max_frame}-byte max-frame knob")
     return _recv_exact(sock, n)
 
 

@@ -67,12 +67,23 @@ class NotPrimary(EngineShutdown):
     endpoint; a standalone caller treats it like any 503."""
 
 
+class KVPullAborted(EngineShutdown):
+    """A cross-replica KV pull cannot complete on the donor side: the
+    prefix is no longer resident, the transfer id is unknown (donor
+    restarted or the transfer's pin deadline lapsed), or the donor is
+    fenced/draining. TYPED so the requester distinguishes "donor
+    said no" (abort the pull, fall back to plain prefill immediately)
+    from a ``TransportError`` (donor may be alive; bounded retry
+    first). Never retried: the donor's answer cannot improve under
+    the same transfer."""
+
+
 _WIRE_ERRORS = {
     cls.__name__: cls
     for cls in (RequestError, RequestCancelled, DeadlineExceeded,
                 EngineOverloaded, EngineShutdown, EngineDraining,
                 PoolDegraded, StaleFencingToken, UnknownMember,
-                AgentFenced, NotPrimary)
+                AgentFenced, NotPrimary, KVPullAborted)
 }
 
 

@@ -470,6 +470,12 @@ def _probe_engine(eng, tail: int) -> Dict[str, Any]:
     pc = getattr(eng, "prefix_cache", None)
     if pc is not None and callable(getattr(pc, "stats", None)):
         _probe(out, "prefix", pc.stats)
+    kvm = getattr(eng, "kv_migration_stats", None)
+    if kvm:
+        # cross-replica KV pull counters: a migration fault's
+        # postmortem must show whether pages moved, aborted, or fell
+        # back to recompute
+        _probe(out, "kv_migration", lambda: dict(kvm))
     alloc = getattr(eng, "alloc", None)
     if alloc is not None:
         _probe(out, "allocator", lambda: {

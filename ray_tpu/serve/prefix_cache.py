@@ -168,13 +168,79 @@ class PrefixCache:
         """Refcount-0 resident pages (the reclaimable pool)."""
         return sum(1 for p in self._nodes if self._ref.get(p, 0) == 0)
 
-    def digest(self) -> frozenset:
+    def digest(self, limit: Optional[int] = None) -> frozenset:
         """Compact content fingerprint of the tree: the set of rolling
-        path hashes of every resident node. An EnginePool intersects a
+        path hashes of resident nodes. An EnginePool intersects a
         prompt's ``path_hashes`` with this set to compute, per replica,
         how many leading pages are already cached — the longest-prefix
-        affinity signal. O(nodes); no token ids leave the replica."""
-        return frozenset(n.phash for n in self._nodes.values())
+        affinity signal. O(nodes); no token ids leave the replica.
+
+        ``limit`` caps the advertisement so fleet load reports stay
+        bounded as the cache grows. The truncation is PREFIX-CLOSED:
+        affinity matching walks a prompt's path hashes root-first and
+        stops at the first absence, so advertising a deep node without
+        its ancestors would make the whole path invisible. Whole
+        root->node paths are kept, chosen deepest-first (longest
+        reusable prefix wins) then hottest-first (LRU tick) among
+        equal depths; a path that no longer fits the budget is skipped
+        in favor of shorter ones, so the budget is filled with the
+        longest/hottest prefixes that fit."""
+        if limit is None or len(self._nodes) <= limit:
+            return frozenset(n.phash for n in self._nodes.values())
+        if limit <= 0:
+            return frozenset()
+        depth: Dict[int, int] = {}
+        for n in self._nodes.values():
+            d, node = 0, n
+            while node is not self._root:
+                node = node.parent
+                d += 1
+            depth[n.page] = d
+        ranked = sorted(self._nodes.values(),
+                        key=lambda n: (-depth[n.page], -n.tick))
+        keep: set = set()
+        for n in ranked:
+            if len(keep) >= limit:
+                break
+            path = []
+            node = n
+            while node is not self._root and node.phash not in keep:
+                path.append(node.phash)
+                node = node.parent
+            if len(keep) + len(path) > limit:
+                continue           # doesn't fit: try shorter paths
+            keep.update(path)
+        return frozenset(keep)
+
+    def match_hashes(self, hashes: Sequence[int]
+                     ) -> Tuple[List[int], int]:
+        """Longest resident run of ``hashes`` (rolling path hashes in
+        prefix order, see ``path_hashes``), walking the tree WITHOUT
+        token ids — the donor side of a cross-replica KV pull resolves
+        a requester's advertised-digest match to physical pages with
+        only hashes on the wire.
+
+        Returns ``(pages, n_hashes_matched)``. Every returned page's
+        refcount is INCREMENTED (this is the transfer-lifetime PIN:
+        pinned pages can never be evicted mid-pull); the caller owes
+        one ``release`` per page. Matched nodes are LRU-touched."""
+        self._tick += 1
+        node = self._root
+        pages: List[int] = []
+        for h in hashes:
+            child = None
+            for c in node.children.values():
+                if c.phash == h:
+                    child = c
+                    break
+            if child is None:
+                break
+            child.tick = self._tick
+            pages.append(child.page)
+            node = child
+        for p in pages:
+            self._ref[p] = self._ref.get(p, 0) + 1
+        return pages, len(pages)
 
     def _chunks(self, tokens: Sequence[int]):
         for i in range(0, (len(tokens) // self.Pg) * self.Pg, self.Pg):

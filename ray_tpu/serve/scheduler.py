@@ -87,10 +87,20 @@ class SlotView:
                              # this is the depth of that trail. 0
                              # under the lockstep loop (the pre-plan
                              # drain settles everything).
+    pulling: bool = False    # PULLING phase: a cross-replica KV
+                             # prefix pull is in flight for this slot
+                             # (serve/kv_migration.py). It holds the
+                             # slot so admission order is preserved,
+                             # but must receive NO prefill grant —
+                             # its prompt either lands from the pull
+                             # or requeues for plain prefill. Unseeded
+                             # by construction, so the quick-cadence
+                             # rule already treats it as pending
+                             # admission work.
 
     @property
     def prefilling(self) -> bool:
-        return self.prompt_remaining > 0
+        return self.prompt_remaining > 0 and not self.pulling
 
 
 @dataclasses.dataclass(frozen=True)

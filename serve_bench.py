@@ -2184,6 +2184,235 @@ def run_kvq_ab(args):
     }
 
 
+def run_prefix_share_ab(args):
+    """Fleet-shared prefix cache A/B (serve_bench.py
+    --prefix-share-ab): the SAME 2-replica pool, multi-session
+    thrashing trace, and greedy sampling run with each replica's
+    prefix cache private (``share_prefixes=False``) vs fleet-shared
+    (``share_prefixes=True``: the router attaches cross-replica pull
+    hints and a cold replica PULLS the holder's pinned int8 pages +
+    per-page scales over the migration seam instead of recomputing
+    the prefix — serve/kv_migration.py, docs/serving.md).
+
+    The trace is built so local-only caching keeps LOSING: one
+    session stays warm on the holder replica (its re-touches keep the
+    donor pages MRU), the measured sessions are sticky-pinned to the
+    OTHER replica (established with a busy-tip: a long request held
+    on the warm replica tips P2C toward the cold one), and between
+    measured rounds two filler sessions churn the cold replica's page
+    pool hard enough to evict the shared prefix. So every measured
+    request faces a LOCAL miss with a fleet-wide hit: the local arm
+    re-prefills the whole shared prefix each round, the shared arm
+    pulls the pages and resumes prefill at the landed offset.
+
+    Recorded per arm: measured-request TTFTs (p50), the
+    kv_migration counters (pulls/pulled_pages/wire_bytes/aborts/
+    fallbacks), pull hints, and the cross-replica hit rate (pulled
+    pages landing on a replica that never computed them / the
+    measured rounds' prefix-page demand — identically 0.0 for the
+    local arm, where no page ever crosses a replica). Wire bytes are
+    the measured int8+scales payload, with the bf16-equivalent cost
+    of moving the same pages recorded alongside.
+
+    Decode from a pulled prefix must be TOKEN-IDENTICAL to decode
+    from a recomputed one (the pull lands the donor's exact quantized
+    bytes, and the donor wrote them with the same deterministic
+    chunked prefill the local arm would run), so the arms' measured
+    streams are compared and the artifact REFUSES
+    (tools/check_bench_schema.py ``prefix_share_ab`` family) to exist
+    with diverging streams, with a shared-arm cross-replica hit rate
+    not above the local arm's, with a TTFT p50 ratio >= 1.0, or
+    without its kv/mesh stamps."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.kv_cache import kv_pool_page_bytes
+    from ray_tpu.models.llama import Llama, llama_tiny
+    from ray_tpu.serve.engine import LLMEngine
+    from ray_tpu.serve.engine_pool import EnginePool
+
+    cfg = llama_tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))
+
+    page_size = 8
+    prefix_len = 96                   # 12 pages of shared prefix
+    prefix_pages = prefix_len // page_size
+    gen_tokens = 8
+    rounds = 5                        # round 0 is an unmeasured
+    # warmup: it compiles each arm's cold path (the pull landing
+    # write for the shared arm, nothing new for the local arm)
+    # outside the measured window, exactly like the other A/B arms'
+    # warmup submits
+    n_pages = 32                      # small enough that the fillers
+    # (two 15-page requests per round, run back to back) evict the
+    # cold replica's copy of the prefix between measured rounds — the
+    # thrash. Leaf-first LRU eviction may leave a page or two of the
+    # chain's head resident; the pull's insert recycles those
+    # duplicates through the normal radix insert path.
+
+    rng = np.random.RandomState(args.seed + 91)
+    shared = rng.randint(1, cfg.vocab_size - 1,
+                         size=prefix_len).tolist()
+    tails = [rng.randint(1, cfg.vocab_size - 1, size=8).tolist()
+             for _ in range(rounds)]
+    warm_tails = [rng.randint(1, cfg.vocab_size - 1, size=8).tolist()
+                  for _ in range(rounds + 1)]
+    pins = [rng.randint(1, cfg.vocab_size - 1, size=8).tolist()
+            for _ in range(rounds + 2)]
+    fillers = [[rng.randint(1, cfg.vocab_size - 1, size=112).tolist()
+                for _ in range(2)] for _ in range(rounds)]
+    busy_prompt = rng.randint(1, cfg.vocab_size - 1, size=16).tolist()
+
+    def run_arm(share):
+        def factory(idx):
+            return LLMEngine(model, params, max_slots=2,
+                             page_size=page_size, n_pages=n_pages,
+                             chunk=4, prefill_chunk=4,
+                             temperature=0.0, eos_id=-1,
+                             seed=args.seed, prefix_cache=True,
+                             kv_dtype="int8")
+        pool = EnginePool(factory, 2, share_prefixes=share,
+                          seed=args.seed)
+        try:
+            # warm one replica with the shared prefix (P2C on an idle
+            # pool is deterministic, but record the pick rather than
+            # assume it)
+            h = pool.submit(shared + warm_tails[0],
+                            max_new_tokens=gen_tokens,
+                            session_id="warm")
+            h.result()
+            warm_idx = h.replica_idx
+            cold_idx = 1 - warm_idx
+
+            # busy-tip: hold a long request on the warm replica so
+            # P2C routes the session-establishing pins to the cold
+            # one; stickiness then keeps every measured request there
+            sessions = [f"s{i}" for i in range(rounds)] + ["f0", "f1"]
+            for sid, pin in zip(sessions, pins):
+                for _ in range(20):
+                    busy = pool.submit(list(busy_prompt),
+                                       max_new_tokens=64,
+                                       session_id="warm")
+                    ph = pool.submit(list(pin), max_new_tokens=2,
+                                     session_id=sid)
+                    ph.result()
+                    busy.cancel()
+                    if ph.replica_idx == cold_idx:
+                        break
+                    pool._sticky.pop(sid, None)
+                else:
+                    raise RuntimeError(
+                        f"could not pin {sid} to the cold replica")
+
+            streams, ttfts = [], []
+            for r in range(rounds):
+                # keep the donor's copy MRU (identical load both arms)
+                pool.submit(shared + warm_tails[r + 1],
+                            max_new_tokens=2,
+                            session_id="warm").result()
+                # the measured request: local miss (fillers evicted
+                # the prefix), fleet-wide hit on the warm replica
+                h = pool.submit(shared + tails[r],
+                                max_new_tokens=gen_tokens,
+                                session_id=f"s{r}")
+                toks = h.result()
+                assert h.replica_idx == cold_idx, (
+                    "measured request left its sticky replica")
+                streams.append(list(toks))
+                ttfts.append(h.ttft_s)
+                # churn the cold replica's page pool so the next
+                # round misses locally again (back to back: the
+                # second filler's allocation evicts the measured
+                # request's freshly cached pages, not the first
+                # filler's live ones)
+                for f, sid in zip(fillers[r], ("f0", "f1")):
+                    pool.submit(list(f), max_new_tokens=gen_tokens,
+                                session_id=sid).result()
+
+            kv = dict(pool.kv_migration_stats() or {})
+            hints = pool.pool_stats().get("pull_hints", 0)
+        finally:
+            pool.shutdown()
+        demand = rounds * prefix_pages
+        ttfts = ttfts[1:]            # round 0 is warmup (compile)
+        return {
+            "streams": streams,
+            "ttft_s": [round(t, 4) for t in ttfts],
+            "ttft_p50_s": round(sorted(ttfts)[len(ttfts) // 2], 4),
+            "cross_replica_hit_rate": round(
+                kv.get("pulled_pages", 0) / demand, 4),
+            "pull_hints": hints,
+            "kv_migration": kv,
+        }
+
+    print("prefix-share A/B: local-cache-only arm", flush=True)
+    local = run_arm(False)
+    print("prefix-share A/B: fleet-shared arm", flush=True)
+    shared_arm = run_arm(True)
+
+    identical = local["streams"] == shared_arm["streams"]
+    ratio = _ratio(shared_arm["ttft_p50_s"], local["ttft_p50_s"])
+    if not identical:
+        print("WARNING: pulled-prefix decode diverged from recompute "
+              "— the artifact will fail schema validation", flush=True)
+    if shared_arm["cross_replica_hit_rate"] \
+            <= local["cross_replica_hit_rate"]:
+        print("WARNING: fleet-shared arm got no cross-replica hits — "
+              "the artifact will fail schema validation", flush=True)
+    if ratio is None or ratio >= 1.0:
+        print("WARNING: pulling did not beat recompute on TTFT p50 — "
+              "the artifact will fail schema validation", flush=True)
+
+    # the streams travel as counts (bulk lives in the comparison, not
+    # the artifact); wire bytes are the measured int8+scales payload
+    # vs what moving the SAME pages at the model's native bf16 would
+    # cost
+    for arm in (local, shared_arm):
+        arm["tokens"] = sum(len(s) for s in arm.pop("streams"))
+    pulled = shared_arm["kv_migration"].get("pulled_pages", 0)
+    wire_int8 = shared_arm["kv_migration"].get("wire_bytes", 0)
+    bf16_page = kv_pool_page_bytes(llama_tiny(), page_size, "fp")
+    from ray_tpu.models.llama import _use_paged_kernel
+    result = {
+        "prefix_share_ab": {
+            "page_size": page_size,
+            "prefix_len": prefix_len,
+            "prefix_pages": prefix_pages,
+            "rounds": rounds,
+            "gen_tokens": gen_tokens,
+            "local": local,
+            "shared": shared_arm,
+            "token_identical": identical,
+            "ttft_p50_ratio": ratio,
+            "wire_bytes_int8": int(wire_int8),
+            "wire_bytes_bf16_equiv": int(pulled * bf16_page),
+            "wire_ratio": _ratio(wire_int8, pulled * bf16_page),
+        },
+        "mesh": {"tp": 1, "replicas": 2},
+        "kv": {"kv_dtype": "int8",
+               "paged_kernel": ("pallas" if _use_paged_kernel()
+                                else "gather")},
+        "model": "llama-tiny",
+        "notes": "Fleet-shared prefix cache A/B (serve_bench.py "
+                 "--prefix-share-ab): identical 2-replica pool + "
+                 "multi-session thrashing trace with private per-"
+                 "replica prefix caches vs fleet-shared "
+                 "(share_prefixes=True). Fillers evict the cold "
+                 "replica's copy of the shared prefix every round, so "
+                 "the local arm re-prefills it each time while the "
+                 "shared arm pulls the holder's pinned int8 pages + "
+                 "per-page scales and resumes prefill at the landed "
+                 "offset. Pulled-prefix decode is gated token-"
+                 "identical to recompute; cross-replica hit rate is "
+                 "pulled pages over the measured prefix-page demand "
+                 "(identically 0 for the local arm); wire bytes are "
+                 "the measured int8 payload vs the bf16 cost of the "
+                 "same pages.",
+    }
+    return result
+
+
 def _ratio(a, b):
     return round(a / b, 2) if b else None
 
@@ -2350,6 +2579,16 @@ def main():
                          "rate, capacity sub-run proves ~2x pages/"
                          "slots and fewer sheds from the same bytes; "
                          "self-gated by tools/check_bench_schema.py")
+    ap.add_argument("--prefix-share-ab", action="store_true",
+                    help="fleet-shared prefix cache A/B: the SAME "
+                         "2-replica pool + multi-session thrashing "
+                         "trace with private per-replica prefix "
+                         "caches vs share_prefixes=True (cold "
+                         "replica PULLS the holder's pinned int8 "
+                         "pages instead of recomputing) — gates "
+                         "token identity, cross-replica hit rate, "
+                         "and TTFT p50 ratio; self-gated by "
+                         "tools/check_bench_schema.py")
     ap.add_argument("--lifecycle", action="store_true",
                     help="request-lifecycle smoke: unsaturated pass "
                          "then an overload burst against --max-queued "
@@ -2529,6 +2768,25 @@ def main():
         # self-gate: an artifact missing its byte-budget stamp, below
         # the 1.9x capacity ratio, or below the parity floor fails
         # its OWN run
+        from tools import check_bench_schema as cbs
+        problems = []
+        cbs.check_file(out, problems)
+        for p in problems:
+            print(f"SCHEMA FAIL {p}")
+        print(json.dumps(result))
+        ray_tpu.shutdown()
+        if problems:
+            raise SystemExit(1)
+        return
+
+    if args.prefix_share_ab:
+        result = _stamp(run_prefix_share_ab(args), args, replicas=2)
+        out = args.out or "SERVE_BENCH_prefix_share_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        # self-gate: a non-token-identical pulled arm, a shared arm
+        # with no cross-replica hits, or a missing kv/mesh stamp
+        # fails its OWN run
         from tools import check_bench_schema as cbs
         problems = []
         cbs.check_file(out, problems)

@@ -826,6 +826,107 @@ def test_serve_chaos_flight_recorder_validated_if_present(tmp_path):
                    for p in probs), key
 
 
+def _migration_drill():
+    # the kv_migration fault-drill block as tools/chaos_serve.py
+    # _run_migration_phases emits it
+    return {
+        "donor_kill_mid_pull": {
+            "prefix_pages": 12, "aborts": 1, "fallbacks": 1,
+            "completed_token_identical": True,
+            "busy_outcome": "completed",
+            "sacrifice_outcome": "completed"},
+        "peer_resume": {
+            "migrated_pages": 12, "pull_fallbacks": 0,
+            "resume_token_identical": True,
+            "peer_prefix_hit_tokens_delta": 96,
+            "busy_outcome": "completed"},
+        "requests": {"admitted": 8, "lost": 0, "mismatched": 0},
+        "flight": {"donor_kill_explained": True,
+                   "peer_resume_explained": True, "kill_bundles": 3},
+        "quiesced": True,
+    }
+
+
+def test_serve_chaos_kv_migration_validated_if_present(tmp_path):
+    # campaigns predating the migration drill carry no block and pass
+    assert _problems_for("SERVE_CHAOS_x.json", _serve_chaos_ok(),
+                         tmp_path) == []
+    ok = _serve_chaos_ok()
+    ok["kv_migration"] = _migration_drill()
+    assert _problems_for("SERVE_CHAOS_x.json", ok, tmp_path) == []
+    not_obj = _serve_chaos_ok()
+    not_obj["kv_migration"] = 7
+    probs = _problems_for("SERVE_CHAOS_x.json", not_obj, tmp_path)
+    assert any("must be an object" in p for p in probs)
+    for phase in ("donor_kill_mid_pull", "peer_resume", "flight"):
+        bad = _serve_chaos_ok()
+        bad["kv_migration"] = _migration_drill()
+        del bad["kv_migration"][phase]
+        probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+        assert any(f"'{phase}'" in p for p in probs), phase
+
+
+def test_serve_chaos_kv_migration_rejects_unexercised_abort(tmp_path):
+    # a donor kill that produced no plain-prefill fallback never
+    # exercised the abort path the drill exists to prove
+    bad = _serve_chaos_ok()
+    bad["kv_migration"] = _migration_drill()
+    bad["kv_migration"]["donor_kill_mid_pull"]["fallbacks"] = 0
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("no plain-prefill fallback" in p for p in probs)
+    bad = _serve_chaos_ok()
+    bad["kv_migration"] = _migration_drill()
+    bad["kv_migration"]["donor_kill_mid_pull"][
+        "completed_token_identical"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("did not complete token-identically" in p
+               for p in probs)
+
+
+def test_serve_chaos_kv_migration_rejects_recomputed_resume(tmp_path):
+    bad = _serve_chaos_ok()
+    bad["kv_migration"] = _migration_drill()
+    bad["kv_migration"]["peer_resume"]["migrated_pages"] = 0
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("nothing migrated" in p for p in probs)
+    bad = _serve_chaos_ok()
+    bad["kv_migration"] = _migration_drill()
+    bad["kv_migration"]["peer_resume"]["resume_token_identical"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("did not resume token-identically" in p for p in probs)
+    # zero prefix hit-tokens on the peer means the session was
+    # silently recomputed — the pages moved for nothing
+    bad = _serve_chaos_ok()
+    bad["kv_migration"] = _migration_drill()
+    bad["kv_migration"]["peer_resume"][
+        "peer_prefix_hit_tokens_delta"] = 0
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("recomputed, not resumed" in p for p in probs)
+
+
+def test_serve_chaos_kv_migration_rejects_losses_and_leaks(tmp_path):
+    for key in ("lost", "mismatched"):
+        bad = _serve_chaos_ok()
+        bad["kv_migration"] = _migration_drill()
+        bad["kv_migration"]["requests"][key] = 1
+        probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+        assert any(key in p and "migration drill" in p
+                   for p in probs), key
+    for key, what in (("donor_kill_explained", "donor kill"),
+                      ("peer_resume_explained", "peer resume")):
+        bad = _serve_chaos_ok()
+        bad["kv_migration"] = _migration_drill()
+        bad["kv_migration"]["flight"][key] = False
+        probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+        assert any(f"no flight bundle explains the {what}" in p
+                   for p in probs), key
+    bad = _serve_chaos_ok()
+    bad["kv_migration"] = _migration_drill()
+    bad["kv_migration"]["quiesced"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("did not quiesce leak-free" in p for p in probs)
+
+
 # ---------------------------------------------------------------------------
 # SERVE_TRACE family (serve_bench.py --trace artifacts)
 # ---------------------------------------------------------------------------
@@ -1399,3 +1500,136 @@ def test_kvq_ab_requires_arms_and_fields(tmp_path):
     probs = _problems_for("SERVE_BENCH_kvq_ab_cpu_smoke.json",
                           no_field, tmp_path)
     assert any("n_pages" in p for p in probs)
+
+
+# ------------------------------------------ prefix-share A/B family
+
+
+def _prefix_share_ab():
+    return {
+        "prefix_share_ab": {
+            "page_size": 8, "prefix_len": 96, "prefix_pages": 12,
+            "rounds": 5, "gen_tokens": 8,
+            "local": {
+                "ttft_s": [0.05, 0.05, 0.05, 0.05],
+                "ttft_p50_s": 0.05,
+                "cross_replica_hit_rate": 0.0, "pull_hints": 0,
+                "kv_migration": {"pulls": 0, "pulled_pages": 0,
+                                 "wire_bytes": 0, "aborts": 0,
+                                 "fallbacks": 0},
+                "tokens": 40},
+            "shared": {
+                "ttft_s": [0.04, 0.04, 0.04, 0.04],
+                "ttft_p50_s": 0.04,
+                "cross_replica_hit_rate": 1.0, "pull_hints": 5,
+                "kv_migration": {"pulls": 5, "pulled_pages": 60,
+                                 "wire_bytes": 85440, "aborts": 0,
+                                 "fallbacks": 0},
+                "tokens": 40},
+            "token_identical": True,
+            "ttft_p50_ratio": 0.8,
+            "wire_bytes_int8": 85440,
+            "wire_bytes_bf16_equiv": 122880,
+            "wire_ratio": 0.7,
+        },
+        "mesh": {"tp": 1, "replicas": 2},
+        "kv": {"kv_dtype": "int8", "paged_kernel": "gather"},
+        "seed": 0, "git_sha": "abc1234",
+    }
+
+
+def test_prefix_share_ab_artifact_validates(tmp_path):
+    assert _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                         _prefix_share_ab(), tmp_path) == []
+
+
+def test_prefix_share_ab_refuses_missing_stamps(tmp_path):
+    no_mesh = _prefix_share_ab()
+    del no_mesh["mesh"]
+    probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                          no_mesh, tmp_path)
+    assert any("mesh stamp" in p for p in probs)
+    no_kv = _prefix_share_ab()
+    del no_kv["kv"]
+    probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                          no_kv, tmp_path)
+    assert any("kv stamp" in p for p in probs)
+    no_seed = _prefix_share_ab()
+    del no_seed["seed"]
+    probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                          no_seed, tmp_path)
+    assert any("seed" in p for p in probs)
+
+
+def test_prefix_share_ab_refuses_token_divergence(tmp_path):
+    # a migration that changes greedy tokens is broken, whatever
+    # its TTFT — this is the gate that matters most
+    bad = _prefix_share_ab()
+    bad["prefix_share_ab"]["token_identical"] = False
+    probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("not token-identical" in p for p in probs)
+
+
+def test_prefix_share_ab_refuses_unmeasured_sharing(tmp_path):
+    # a shared arm whose hit rate is not strictly above the local
+    # arm's never pulled a page the local arm lacked
+    bad = _prefix_share_ab()
+    bad["prefix_share_ab"]["shared"]["cross_replica_hit_rate"] = 0.0
+    probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("not strictly above" in p for p in probs)
+    for key in ("pulls", "pulled_pages", "wire_bytes"):
+        bad = _prefix_share_ab()
+        bad["prefix_share_ab"]["shared"]["kv_migration"][key] = 0
+        probs = _problems_for(
+            "SERVE_BENCH_prefix_share_cpu_smoke.json", bad, tmp_path)
+        assert any("no migration actually happened" in p
+                   for p in probs), key
+
+
+def test_prefix_share_ab_refuses_non_improving_ttft(tmp_path):
+    bad = _prefix_share_ab()
+    bad["prefix_share_ab"]["ttft_p50_ratio"] = 1.0
+    probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("did not beat re-prefilling" in p for p in probs)
+    gone = _prefix_share_ab()
+    del gone["prefix_share_ab"]["ttft_p50_ratio"]
+    probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                          gone, tmp_path)
+    assert any("ttft_p50_ratio" in p for p in probs)
+
+
+def test_prefix_share_ab_refuses_wire_bytes_savings_loss(tmp_path):
+    # int8 pages + scales must land below the bf16 cost of the same
+    # pages, or the quantized payload saved nothing on the wire
+    bad = _prefix_share_ab()
+    bad["prefix_share_ab"]["wire_bytes_int8"] = 122880
+    probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("saved nothing on the wire" in p for p in probs)
+    for key in ("wire_bytes_int8", "wire_bytes_bf16_equiv"):
+        gone = _prefix_share_ab()
+        del gone["prefix_share_ab"][key]
+        probs = _problems_for(
+            "SERVE_BENCH_prefix_share_cpu_smoke.json", gone, tmp_path)
+        assert any(key in p for p in probs), key
+
+
+def test_prefix_share_ab_requires_arms_and_counters(tmp_path):
+    no_arm = _prefix_share_ab()
+    del no_arm["prefix_share_ab"]["local"]
+    probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                          no_arm, tmp_path)
+    assert any("local" in p and "arm" in p for p in probs)
+    no_field = _prefix_share_ab()
+    del no_field["prefix_share_ab"]["shared"]["ttft_p50_s"]
+    probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                          no_field, tmp_path)
+    assert any("ttft_p50_s" in p for p in probs)
+    no_km = _prefix_share_ab()
+    del no_km["prefix_share_ab"]["shared"]["kv_migration"]
+    probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
+                          no_km, tmp_path)
+    assert any("kv_migration counter block" in p for p in probs)

@@ -647,3 +647,52 @@ def test_fleet_full_campaign_tiny_model(tmp_path):
     problems = []
     cbs.check_fleet_chaos(art, "SERVE_FLEET_CHAOS_test", problems)
     assert not problems, problems
+
+
+def test_directory_prefix_holders_ranked_and_lease_filtered():
+    """The global prefix directory: digests piggyback on renewals,
+    holders rank by matched CONTIGUOUS prefix length, and lapsed /
+    wedged / superseded incarnations never appear — a requester can
+    only be pointed at donors that are provably alive under fencing."""
+    clock = FakeClock()
+    d = FleetDirectory(lease_ttl_s=1.0, time_fn=clock)
+    dc = DirectoryClient(LoopbackTransport(d.handle))
+    f = {}
+    for rid in ("r0", "r1", "r2"):
+        f[rid] = dc.register(rid, ["loopback", rid],
+                             generation=0)["fence"]
+    chain = [11, 22, 33, 44]
+    dc.renew("r0", f["r0"], digest=chain)           # whole chain
+    dc.renew("r1", f["r1"], digest=chain[:2])       # 2-page prefix
+    dc.renew("r2", f["r2"], digest=[11, 33, 44])    # hole after 1
+
+    out = dc.prefix_holders(chain)["holders"]
+    assert [h["replica_id"] for h in out] == ["r0", "r1", "r2"]
+    # contiguity, not overlap: r2 holds 3 of the hashes but only a
+    # 1-page contiguous prefix
+    assert [h["n_matched"] for h in out] == [4, 2, 1]
+    assert out[0]["fence"] == f["r0"]
+    assert [h["replica_id"]
+            for h in dc.prefix_holders(chain, limit=1)["holders"]] \
+        == ["r0"]
+    assert dc.prefix_holders([999])["holders"] == []
+
+    # a wedge report hides the member however fresh its digest is
+    dc.renew("r1", f["r1"], digest=chain[:2], wedged=True)
+    assert "r1" not in [h["replica_id"]
+                        for h in dc.prefix_holders(chain)["holders"]]
+
+    # lease lapse: recent advertisement, dead lease -> never a donor
+    clock.advance(1.5)
+    assert dc.prefix_holders(chain)["holders"] == []
+
+    # generation fencing: the NEXT incarnation starts with an EMPTY
+    # advertisement (its cache died with the process); the ghost
+    # digest of the dead generation must not survive re-registration
+    dc.confirm_dead("r0", f["r0"])
+    f2 = dc.register("r0", ["loopback", "r0"], generation=1,
+                     min_fence=f["r0"])["fence"]
+    assert dc.prefix_holders(chain)["holders"] == []
+    dc.renew("r0", f2, digest=chain)
+    out = dc.prefix_holders(chain)["holders"]
+    assert out[0]["generation"] == 1 and out[0]["fence"] == f2

@@ -373,3 +373,91 @@ def test_cache_off_by_default(tiny_model):
                                                [1, 2, 3], 4)
     # legacy accounting: everything back on the free list
     assert eng.alloc.n_free == eng.alloc.n_pages - 1
+
+
+# ------------------------------------------- digest advertisement cap
+
+
+def _digest_fixture():
+    """Three disjoint chains: A is 4 pages deep, B is 2, C is 1."""
+    from ray_tpu.serve.prefix_cache import path_hashes
+    alloc = BlockAllocator(32)
+    pc = PrefixCache(alloc, page_size=4)
+    A = [1] * 4 + [2] * 4 + [3] * 4 + [4] * 4
+    B = [5] * 4 + [6] * 4
+    C = [7] * 4
+    for toks, n in ((A, 4), (B, 2), (C, 1)):
+        pc.insert(toks, alloc.alloc(n), n_shared=0)
+    hA = frozenset(path_hashes(A, 4))
+    hB = frozenset(path_hashes(B, 4))
+    hC = frozenset(path_hashes(C, 4))
+    return pc, hA, hB, hC
+
+
+def test_digest_cap_is_prefix_closed_longest_first():
+    """The bounded advertisement keeps whole root->node paths,
+    longest prefix first, backfilling with shorter paths that still
+    fit — never a deep node without its ancestors (which affinity
+    matching, walking root-first, could not see at all)."""
+    from ray_tpu.serve.prefix_cache import path_hashes
+    pc, hA, hB, hC = _digest_fixture()
+    assert pc.digest() == hA | hB | hC            # uncapped: all
+    assert pc.digest(7) == hA | hB | hC           # cap >= nodes: all
+    assert pc.digest(4) == hA                     # deepest path wins
+    # budget 5: B's 2-hash path no longer fits after A; the 1-hash
+    # C path backfills instead of wasting the slot
+    assert pc.digest(5) == hA | hC
+    assert pc.digest(6) == hA | hB                # next-deepest fits
+    assert pc.digest(0) == frozenset()
+    # every capped advertisement is PREFIX-CLOSED: each kept hash's
+    # whole root path is kept too
+    chains = {tuple(path_hashes(t, 4)) for t in
+              ([1] * 4 + [2] * 4 + [3] * 4 + [4] * 4,
+               [5] * 4 + [6] * 4, [7] * 4)}
+    for limit in range(8):
+        d = pc.digest(limit)
+        assert len(d) <= limit
+        for chain in chains:
+            for i, h in enumerate(chain):
+                if h in d:
+                    assert set(chain[:i]) <= d, (
+                        f"limit {limit}: hash at depth {i} kept "
+                        f"without its ancestors")
+
+
+def test_digest_cap_prefers_hotter_chain_on_depth_tie():
+    from ray_tpu.serve.prefix_cache import path_hashes
+    alloc = BlockAllocator(16)
+    pc = PrefixCache(alloc, page_size=4)
+    D = [11] * 4 + [12] * 4
+    E = [13] * 4 + [14] * 4
+    pc.insert(D, alloc.alloc(2), n_shared=0)
+    pc.insert(E, alloc.alloc(2), n_shared=0)
+    # equal depth; E inserted later so it starts hotter
+    assert pc.digest(2) == frozenset(path_hashes(E, 4))
+    # touching D (a cache hit) makes it the hotter chain
+    got, _ = pc.match(D)
+    pc.release(got)
+    assert pc.digest(2) == frozenset(path_hashes(D, 4))
+
+
+def test_engine_load_report_bounds_digest(tiny_model):
+    model, params = tiny_model
+    eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                    n_pages=32, chunk=4, temperature=0.0,
+                    prefix_cache=True, prefix_digest_max=2)
+    try:
+        h = eng.submit(list(range(1, 41)), max_new_tokens=2)
+        _drain(eng)
+        h.result()
+        assert eng.prefix_cache.cached_pages > 2
+        rpt = eng.load_report()
+        digest = rpt["prefix_digest"]
+        assert len(digest) == 2
+        # the bounded digest is the prompt's LEADING pages — the
+        # prefix-closed head, not an arbitrary sample
+        from ray_tpu.serve.prefix_cache import path_hashes
+        assert digest == frozenset(
+            path_hashes(list(range(1, 41)), 8)[:2])
+    finally:
+        eng.shutdown()

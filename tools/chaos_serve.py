@@ -23,7 +23,12 @@ After the campaign it PROVES the pool's availability contract:
 - every headline fault left a flight-recorder bundle (serve/obs.py)
   that EXPLAINS it: the killed replica's event tail ends at the
   ReplicaKilled death, the wedge bundle records the heartbeat gap
-  that justified the hang->death escalation.
+  that justified the hang->death escalation;
+- cross-replica KV migration (share_prefixes) degrades, never
+  wedges: a donor killed mid-pull leaves the requester falling back
+  to plain prefill token-identically, and a session whose home
+  replica dies after its prefix migrated resumes token-identically
+  on the peer FROM the migrated pages — both flight-explained.
 
 Writes a SERVE_CHAOS json artifact gated by
 tools/check_bench_schema.py (serve_chaos family).
@@ -83,6 +88,321 @@ def _reference_completions_int8(model, params, prompts, n):
         want[tuple(p)] = h.result()
     eng.shutdown()
     return want
+
+
+def _run_migration_phases(model, params, flight_dir, seed, kv_dtype,
+                          max_new_tokens=8):
+    """KV-migration fault drill: two seeded phases, each against a
+    fresh 2-replica pool with ``share_prefixes=True``.
+
+    A. donor kill mid-pull — the transfer is stretched with a
+       per-chunk delay (one page per chunk), the donor replica is
+       killed while chunks are still in flight, and the requester
+       must FALL BACK to plain prefill and complete token-identically
+       (typed abort, never a wedge; zero lost, zero mismatched).
+    B. peer resume from migrated pages — a session's prefix is pulled
+       to a peer replica by normal hint-driven migration, the replica
+       that COMPUTED it is killed, and the session's next request
+       resumes on the peer hitting the MIGRATED pages (prefix
+       hit-token delta >= prefix length) token-identically. The peer
+       never recomputed the prefix: migration is its only source.
+
+    Both kills leave engine-fail-all flight bundles (ReplicaKilled in
+    the event tail); the drill dumps migration postmortems whose
+    event tails carry the pull_fallback / pull_land proof and asserts
+    the bundles on disk explain both faults. Every engine ever built
+    — including the corpses — must quiesce leak-free (the donor's
+    transfer pins are reclaimed by the pin-TTL GC even though the
+    requester aborted and never sent ``end``). Returns the
+    ``kv_migration`` artifact block."""
+    import glob
+
+    import numpy as np
+
+    from ray_tpu.serve import kv_migration, obs
+    from ray_tpu.serve.engine import LLMEngine
+    from ray_tpu.serve.engine_pool import EnginePool
+    from ray_tpu.serve.errors import (DeadlineExceeded,
+                                      EngineDraining,
+                                      EngineOverloaded,
+                                      EngineShutdown,
+                                      RequestCancelled)
+    from ray_tpu.serve.faults import FaultInjector, check_quiesced
+
+    typed = (RequestCancelled, DeadlineExceeded, EngineOverloaded,
+             EngineDraining, EngineShutdown)
+    Pg, prefix_pages = 8, 12
+    rng = np.random.RandomState(seed * 7 + 173)
+
+    def toks(n):
+        return rng.randint(1, 250, size=n).tolist()
+
+    shared = toks(Pg * prefix_pages)      # 96-token shared prefix
+    tail_w, tail_m = toks(8), toks(8)     # phase A tails
+    tail_a1, tail_b, tail_a2 = toks(8), toks(8), toks(8)  # phase B
+    busy = toks(16)       # short prompt, long decode: busy-tips P2C
+    pin = toks(12)        # unrelated pin prompt (no shared pages)
+    sac = toks(12)        # sacrificial: forces the armed kill to fire
+    mnt = max_new_tokens
+
+    def mk_engine(inj=None):
+        # same knobs everywhere — replicas AND the reference engine —
+        # so the int8 quantized write history is bit-identical and
+        # "token-identical" has one right answer (docs/serving.md)
+        return LLMEngine(model, params, max_slots=2, page_size=Pg,
+                         n_pages=48, chunk=4, prefill_chunk=4,
+                         temperature=0.0, eos_id=-1, seed=0,
+                         prefix_cache=True, kv_dtype=kv_dtype,
+                         fault_injector=inj, flight_dir=flight_dir)
+
+    # Greedy ground truth from a same-knobs reference engine.
+    ref = mk_engine()
+    want = {}
+    for p, n in [(shared + tail_w, 2), (shared + tail_m, mnt),
+                 (shared + tail_a1, 4), (shared + tail_b, mnt),
+                 (shared + tail_a2, mnt), (busy, 64), (pin, 4),
+                 (sac, 2)]:
+        h = ref.submit(list(p), max_new_tokens=n)
+        while ref.step():
+            pass
+        want[tuple(p)] = h.result()
+    ref.shutdown()
+
+    results = {"completed": 0, "failed_typed": 0, "lost": 0,
+               "mismatched": 0}
+
+    def settle(handle, prompt, may_fail_typed=False):
+        """Resolve a handle against the reference; returns the
+        outcome label and updates the loss/mismatch ledger."""
+        try:
+            out = handle.result()
+        except typed as e:
+            if not may_fail_typed:
+                results["lost"] += 1
+                return f"unexpected_typed:{type(e).__name__}"
+            results["failed_typed"] += 1
+            return f"typed:{type(e).__name__}"
+        except BaseException as e:  # noqa: BLE001
+            results["lost"] += 1
+            return f"untyped:{type(e).__name__}"
+        if out == want[tuple(prompt)]:
+            results["completed"] += 1
+            return "completed"
+        results["mismatched"] += 1
+        return "mismatched"
+
+    def mk_pool(engines):
+        def factory(idx):
+            eng = mk_engine(FaultInjector())
+            engines.append(eng)
+            eng.start()
+            # warm the jitted prefill/decode paths before joining so
+            # phase timing never stalls on XLA compilation
+            eng.submit(list(pin), max_new_tokens=4).result()
+            eng.reset_latency_stats()
+            return eng
+        return EnginePool(factory, 2, share_prefixes=True, seed=seed)
+
+    def pin_session(pool, sid, idx):
+        """Stick ``sid`` to replica ``idx`` with unrelated pin
+        requests (popping the sticky entry on wrong placement; the
+        busy replica tips P2C toward the target)."""
+        for _ in range(30):
+            h = pool.submit(list(pin), max_new_tokens=4,
+                            session_id=sid)
+            settle(h, pin)
+            if h.replica_idx == idx:
+                return
+            with pool._lock:
+                pool._sticky.pop(sid, None)
+        raise AssertionError(
+            f"could not pin session {sid} on replica {idx}")
+
+    # ------------------------------- phase A: donor kill mid-pull
+    engines_a = []
+    pool = mk_pool(engines_a)
+    hw = pool.submit(shared + tail_w, max_new_tokens=2,
+                     session_id="w")
+    settle(hw, shared + tail_w)
+    warm = hw.replica_idx
+    cold = 1 - warm
+    donor_eng = pool._replicas[warm].engine
+    cold_eng = pool._replicas[cold].engine
+    h_busy = pool.submit(list(busy), max_new_tokens=64,
+                         session_id="w")   # sticky -> warm replica
+    pin_session(pool, "m", cold)
+    # Stretch the transfer: one page per chunk, a delay per chunk —
+    # the 12-page pull now spans ~1s, so the kill below lands with
+    # chunks still in flight. Short pin TTL so teardown's GC check
+    # doesn't wait 30s to reclaim the aborted transfer's pins.
+    chaos_donor = kv_migration.KVDonor(
+        donor_eng, max_chunk_bytes=2048, chunk_delay_s=0.08,
+        pin_ttl_s=0.6)
+    with pool._lock:
+        pool._kv_donors[warm] = chaos_donor
+    hm = pool.submit(shared + tail_m, max_new_tokens=mnt,
+                     session_id="m")
+    assert hm.replica_idx == cold, "measured request left its pin"
+    time.sleep(0.3)               # well inside the ~1s transfer
+    donor_eng._injector.kill_replica()
+    # the armed kill fires at the donor's next scheduling round; a
+    # sacrificial request guarantees one even if the busy decode
+    # already drained
+    try:
+        h_sac = pool.submit(list(sac), max_new_tokens=2,
+                            session_id="w")
+        sac_outcome = settle(h_sac, sac, may_fail_typed=True)
+    except typed as e:            # kill won the submit race: typed
+        results["failed_typed"] += 1
+        sac_outcome = f"typed:{type(e).__name__}"
+    measured_outcome = settle(hm, shared + tail_m)
+    busy_outcome = settle(h_busy, busy, may_fail_typed=True)
+    stats_a = dict(cold_eng.kv_migration_stats)
+    assert stats_a.get("fallbacks", 0) >= 1, (
+        f"donor kill mid-pull produced no plain-prefill fallback "
+        f"(requester stats {stats_a})")
+    assert measured_outcome == "completed", (
+        f"measured request did not complete token-identically "
+        f"after the donor died mid-pull: {measured_outcome}")
+    obs.dump_flight_bundle(
+        flight_dir, "migration-donor-kill", engine=cold_eng,
+        pool=pool, extra={"phase": "donor_kill_mid_pull",
+                          "donor_idx": warm, "requester_idx": cold,
+                          "measured": measured_outcome})
+    pool.shutdown()
+    for eng in engines_a:
+        eng.shutdown()
+    # aborted transfer: the requester never sent end — the donor's
+    # pin-TTL GC must reclaim the pins or the corpse leaks
+    time.sleep(0.7)
+    assert chaos_donor.open_transfers() == 0, \
+        "pin-TTL GC left the aborted transfer pinned"
+    for eng in engines_a:
+        check_quiesced(eng)
+    phase_a = {
+        "prefix_pages": prefix_pages,
+        "aborts": stats_a.get("aborts", 0),
+        "fallbacks": stats_a.get("fallbacks", 0),
+        "completed_token_identical": measured_outcome == "completed",
+        "busy_outcome": busy_outcome,
+        "sacrifice_outcome": sac_outcome,
+    }
+
+    # --------------------- phase B: peer resume from migrated pages
+    engines_b = []
+    pool = mk_pool(engines_b)
+    ha = pool.submit(shared + tail_a1, max_new_tokens=4,
+                     session_id="a")
+    settle(ha, shared + tail_a1)
+    a_idx = ha.replica_idx
+    b_idx = 1 - a_idx
+    eng_a = pool._replicas[a_idx].engine
+    eng_b = pool._replicas[b_idx].engine
+    h_busy = pool.submit(list(busy), max_new_tokens=64,
+                         session_id="a")   # sticky -> replica A
+    pin_session(pool, "b", b_idx)
+    hb = pool.submit(shared + tail_b, max_new_tokens=mnt,
+                     session_id="b")
+    assert hb.replica_idx == b_idx, "migration request left its pin"
+    migrate_outcome = settle(hb, shared + tail_b)
+    busy_outcome_b = settle(h_busy, busy, may_fail_typed=True)
+    stats_b = dict(eng_b.kv_migration_stats)
+    assert migrate_outcome == "completed", (
+        f"hint-driven migration request diverged: {migrate_outcome}")
+    assert stats_b.get("pulled_pages", 0) >= prefix_pages, (
+        f"peer pulled {stats_b.get('pulled_pages', 0)} pages, want "
+        f">= {prefix_pages} (hint-driven migration never happened)")
+    assert stats_b.get("fallbacks", 0) == 0, (
+        f"unfaulted migration fell back: {stats_b}")
+    hit0 = (eng_b.prefix_stats() or {}).get("hit_tokens", 0)
+    eng_a._injector.kill_replica()
+    # session "a" was computed on A; its next request either admits
+    # to A and dies with it (pool resubmits) or routes straight to
+    # the survivor — both must land on B and hit the MIGRATED pages
+    try:
+        hr = pool.submit(shared + tail_a2, max_new_tokens=mnt,
+                         session_id="a")
+        resume_outcome = settle(hr, shared + tail_a2)
+    except typed as e:
+        results["lost"] += 1
+        resume_outcome = f"refused:{type(e).__name__}"
+    hit1 = (eng_b.prefix_stats() or {}).get("hit_tokens", 0)
+    assert resume_outcome == "completed", (
+        f"session did not resume token-identically on the peer "
+        f"after its home replica died: {resume_outcome}")
+    assert hit1 - hit0 >= Pg * prefix_pages, (
+        f"peer served only {hit1 - hit0} prefix hit-tokens on "
+        f"resume, want >= {Pg * prefix_pages}: the session was "
+        f"recomputed, not resumed from migrated pages")
+    obs.dump_flight_bundle(
+        flight_dir, "migration-peer-resume", engine=eng_b,
+        pool=pool, extra={"phase": "peer_resume",
+                          "killed_idx": a_idx, "peer_idx": b_idx,
+                          "hit_tokens_delta": hit1 - hit0})
+    pool.shutdown()
+    for eng in engines_b:
+        eng.shutdown()
+    for eng in engines_b:
+        check_quiesced(eng)
+    phase_b = {
+        "migrated_pages": stats_b.get("pulled_pages", 0),
+        "pull_fallbacks": stats_b.get("fallbacks", 0),
+        "resume_token_identical": resume_outcome == "completed",
+        "peer_prefix_hit_tokens_delta": hit1 - hit0,
+        "busy_outcome": busy_outcome_b,
+    }
+
+    assert results["lost"] == 0, \
+        f"migration drill lost {results['lost']} admitted requests"
+    assert results["mismatched"] == 0, (
+        f"{results['mismatched']} migration-drill completions "
+        f"diverged from greedy")
+
+    # ------------------------ the bundles on disk explain the drill
+    kill_bundles, fallback_seen, land_seen = 0, False, False
+    for bdir in sorted(glob.glob(os.path.join(flight_dir, "*"))):
+        if not os.path.isdir(bdir):
+            continue
+        try:
+            b = obs.load_flight_bundle(bdir)
+        except Exception:  # noqa: BLE001  half-written dir: skip
+            continue
+        evs = (b.get("engine") or {}).get("events") or []
+        names = {e.get("type") for e in evs}
+        last = evs[-1] if evs else {}
+        if (b.get("reason") == "engine-fail-all"
+                and last.get("type") == "fail_all"
+                and "ReplicaKilled" in str((last.get("data") or {})
+                                           .get("error"))):
+            kill_bundles += 1
+        if (b.get("reason") == "migration-donor-kill"
+                and "pull_fallback" in names):
+            fallback_seen = True
+        if (b.get("reason") == "migration-peer-resume"
+                and "pull_land" in names):
+            land_seen = True
+    assert kill_bundles >= 2, (
+        f"want >= 2 engine-fail-all/ReplicaKilled bundles (one per "
+        f"migration-drill kill), found {kill_bundles}")
+    assert fallback_seen, (
+        "no migration-donor-kill bundle carries a pull_fallback "
+        "event: the donor-kill fault is not flight-explained")
+    assert land_seen, (
+        "no migration-peer-resume bundle carries a pull_land event: "
+        "the migration is not flight-explained")
+
+    return {
+        "donor_kill_mid_pull": phase_a,
+        "peer_resume": phase_b,
+        "requests": dict(results,
+                         admitted=sum(results.values())),
+        "flight": {
+            "donor_kill_explained": True,
+            "peer_resume_explained": True,
+            "kill_bundles": kill_bundles,
+        },
+        "quiesced": True,
+    }
 
 
 def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
@@ -416,6 +736,16 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
         "wedged-r* bundle whose heartbeat_gap_s >= "
         f"{stall_deadline_s * 0.9:.2f}s); saw: {bundles}")
 
+    # -------------------------------------- KV migration fault drill
+    # Fresh 2-replica pools (share_prefixes=True): kill the donor
+    # mid-pull (requester falls back to plain prefill, token-
+    # identical), then kill a replica whose session resumes token-
+    # identically on a peer from MIGRATED prefix pages. Hard-asserts
+    # inside; the artifact records the proof.
+    migration = _run_migration_phases(model, params, flight_dir,
+                                      seed, kv_dtype,
+                                      max_new_tokens=8)
+
     try:
         sha = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
@@ -439,7 +769,13 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
             "honest Retry-After), wedge detected within the stall "
             "deadline without restarting untouched replicas, "
             "leak-free pool quiescence including zombie corpses, "
-            "attainment above the recorded floor."),
+            "attainment above the recorded floor. A KV-migration "
+            "fault drill follows the campaign: the donor replica is "
+            "killed mid-pull (requester falls back to plain prefill "
+            "and completes token-identically) and a replica is "
+            "killed after its prefix migrated to a peer (the session "
+            "resumes on the peer hitting the migrated pages, token-"
+            "identically); both faults are flight-explained."),
         "seed": seed,
         "mesh": {"tp": 1, "replicas": replicas},
         "knobs": {
@@ -486,6 +822,7 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
             "hang_explained": True,
             "summaries": bundles,
         },
+        "kv_migration": migration,
         "quiesced": True,
         "wall_s": round(wall, 2),
         "git_sha": sha,

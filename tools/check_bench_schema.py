@@ -53,6 +53,16 @@ family:
   recorded noise bound, when the int8 arm did not shed strictly
   fewer of the identical burst, or when the seed/mesh stamp is
   missing.
+- SERVE_BENCH prefix-share A/B (serve_bench.py --prefix-share-ab):
+  {prefix_share_ab: {local, shared, token_identical,
+  ttft_p50_ratio, wire_bytes_int8, wire_bytes_bf16_equiv}, mesh,
+  kv, seed} — private per-replica prefix caches vs the fleet-shared
+  global prefix cache (cold replica PULLS pinned pages over the
+  KV-migration seam instead of recomputing). REFUSED when the
+  pulled arm was not token-identical to recompute, when the shared
+  arm's cross-replica hit rate is not strictly above the local
+  arm's (or it pulled nothing), when the TTFT p50 ratio is missing
+  or >= 1.0, or when the kv/mesh/seed stamp is missing.
 - SERVE_BENCH autoscale (serve_bench.py --autoscale): {trace, seed,
   slo, autoscale, static_max, chip_seconds_ratio} — REFUSED when
   autoscale SLO attainment is below the floor the run itself
@@ -254,6 +264,27 @@ KVQ_CAPACITY_REQUIRED = {
     "burst": int,
     "sheds": int,
     "completed": int,
+}
+
+# prefix-share A/B artifacts carry one of these per arm
+# (serve_bench.py run_prefix_share_ab): the measured-request TTFTs
+# and the kv_migration counters for the private-cache arm vs the
+# fleet-shared arm on the identical thrashing trace.
+PREFIX_SHARE_ARM_REQUIRED = {
+    "ttft_p50_s": NUM,
+    "cross_replica_hit_rate": NUM,
+    "pull_hints": NUM,
+    "tokens": int,
+}
+
+# each arm's kv_migration block: the serve_kv_migration_*_total
+# counters as the pool aggregated them (serve/kv_migration.py)
+KV_MIGRATION_REQUIRED = {
+    "pulls": NUM,
+    "pulled_pages": NUM,
+    "wire_bytes": NUM,
+    "aborts": NUM,
+    "fallbacks": NUM,
 }
 
 # serve-chaos artifacts (tools/chaos_serve.py): campaign shape +
@@ -789,7 +820,113 @@ def check_kvq_ab(obj, name, problems):
             "— quantized KV degraded the speculative verify")
 
 
+def check_prefix_share_ab(obj, name, problems):
+    """serve_bench.py --prefix-share-ab artifact: the identical
+    2-replica pool + multi-session thrashing trace with private
+    per-replica prefix caches vs the fleet-shared global prefix
+    cache (cold replica PULLS the holder's pinned pages over the
+    KV-migration seam instead of recomputing — serve/kv_migration.py).
+    The checker REFUSES artifacts whose pulled arm was not
+    token-identical to recompute (a migration that changes greedy
+    tokens is broken, whatever its TTFT), whose shared-arm
+    cross-replica hit rate is not STRICTLY above the local arm's (a
+    sharing arm that never pulled measured nothing), whose shared arm
+    recorded no pulled pages or wire bytes, whose TTFT p50 ratio is
+    missing or >= 1.0 (pulling must beat re-prefilling the prefix, or
+    the artifact documents a regression), or without its kv/mesh/seed
+    stamps (wire bytes from an unstamped page dtype are not
+    comparable to anything)."""
+    _check_mesh(obj, name, problems, required=True)
+    if not isinstance(obj.get("seed"), int) \
+            or isinstance(obj.get("seed"), bool):
+        problems.append(f"{name}: prefix-share A/B artifact missing "
+                        "int 'seed'")
+    kv = obj.get("kv")
+    if not isinstance(kv, dict) or not isinstance(
+            kv.get("kv_dtype"), str):
+        problems.append(
+            f"{name}: missing the kv stamp ({{kv_dtype, "
+            "paged_kernel}}) — wire bytes from an unstamped page "
+            "dtype are not comparable")
+    ab = obj.get("prefix_share_ab")
+    if not isinstance(ab, dict):
+        problems.append(f"{name}: prefix_share_ab must be an object")
+        return
+    rates = {}
+    for arm in ("local", "shared"):
+        sec = ab.get(arm)
+        if not isinstance(sec, dict):
+            problems.append(f"{name}:prefix_share_ab: missing {arm} "
+                            "arm object")
+            continue
+        _check_fields(sec, PREFIX_SHARE_ARM_REQUIRED,
+                      f"{name}:prefix_share_ab:{arm}", problems)
+        km = sec.get("kv_migration")
+        if not isinstance(km, dict):
+            problems.append(f"{name}:prefix_share_ab:{arm}: missing "
+                            "the kv_migration counter block")
+        else:
+            _check_fields(km, KV_MIGRATION_REQUIRED,
+                          f"{name}:prefix_share_ab:{arm}:kv_migration",
+                          problems)
+        r = sec.get("cross_replica_hit_rate")
+        if isinstance(r, NUM) and not isinstance(r, bool):
+            rates[arm] = r
+    if ab.get("token_identical") is not True:
+        problems.append(
+            f"{name}: pulled-prefix decode was not token-identical "
+            "to recompute — a migration that changes greedy tokens "
+            "is broken, whatever its TTFT")
+    if len(rates) == 2 and rates["shared"] <= rates["local"]:
+        problems.append(
+            f"{name}:prefix_share_ab: shared-arm cross-replica hit "
+            f"rate {rates['shared']} is not strictly above the local "
+            f"arm's {rates['local']} — the fleet-shared cache never "
+            "pulled a page the local arm lacked")
+    shared = ab.get("shared")
+    if isinstance(shared, dict) \
+            and isinstance(shared.get("kv_migration"), dict):
+        km = shared["kv_migration"]
+        for key in ("pulls", "pulled_pages", "wire_bytes"):
+            v = km.get(key)
+            if isinstance(v, NUM) and not isinstance(v, bool) \
+                    and v <= 0:
+                problems.append(
+                    f"{name}:prefix_share_ab: shared arm recorded "
+                    f"{key} == 0 — no migration actually happened")
+    ratio = ab.get("ttft_p50_ratio")
+    if not isinstance(ratio, NUM) or isinstance(ratio, bool):
+        problems.append(f"{name}: prefix-share A/B artifact missing "
+                        "numeric ttft_p50_ratio")
+    elif ratio >= 1.0:
+        problems.append(
+            f"{name}:prefix_share_ab: ttft_p50_ratio {ratio} >= 1.0 "
+            "— pulling the prefix did not beat re-prefilling it")
+    wb = ab.get("wire_bytes_int8")
+    eq = ab.get("wire_bytes_bf16_equiv")
+    for key, v in (("wire_bytes_int8", wb),
+                   ("wire_bytes_bf16_equiv", eq)):
+        if not isinstance(v, int) or isinstance(v, bool):
+            problems.append(f"{name}:prefix_share_ab: missing int "
+                            f"'{key}'")
+    if isinstance(wb, int) and isinstance(eq, int) \
+            and not isinstance(wb, bool) and not isinstance(eq, bool) \
+            and eq > 0 and wb >= eq:
+        problems.append(
+            f"{name}:prefix_share_ab: int8 wire bytes {wb} are not "
+            f"below the bf16-equivalent {eq} — the quantized payload "
+            "saved nothing on the wire")
+
+
 def check_serve_bench(obj, name, problems):
+    if "prefix_share_ab" in obj:
+        # fleet-shared prefix cache A/B family (serve_bench.py
+        # --prefix-share-ab)
+        check_prefix_share_ab(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
     if "kvq_ab" in obj:
         # int8-KV A/B family (serve_bench.py --kvq-ab)
         check_kvq_ab(obj, name, problems)
@@ -979,7 +1116,12 @@ def check_serve_chaos(obj, name, problems):
     to prove — any lost or mismatched admitted request, a campaign
     that never fired its headline faults, an undetected or late
     wedge, attainment below the recorded floor, a pool that failed
-    to quiesce, or a missing seed/mesh stamp."""
+    to quiesce, or a missing seed/mesh stamp. When the artifact
+    carries a ``kv_migration`` fault-drill block it additionally
+    refuses a donor kill that produced no plain-prefill fallback, a
+    non-token-identical pull or resume, a resume that recomputed
+    instead of hitting migrated pages, and migration faults without
+    flight-bundle explanations."""
     _check_fields(obj, SERVE_CHAOS_REQUIRED, name, problems)
     _check_mesh(obj, name, problems, required=True)
     inj = obj.get("injected")
@@ -1076,6 +1218,83 @@ def check_serve_chaos(obj, name, problems):
                     problems.append(
                         f"{name}:flight_recorder: no bundle explains "
                         f"the injected {what}")
+    # KV-migration fault drill (validated-if-present; campaigns
+    # predating cross-replica prefix sharing carry no block and still
+    # pass): the checker REFUSES a drill where the donor kill
+    # produced no plain-prefill fallback, either phase lost or
+    # mismatched a request, the peer pulled no pages, the resumed
+    # session recomputed instead of hitting the migrated pages, or
+    # either fault is not flight-explained.
+    mig = obj.get("kv_migration")
+    if mig is not None:
+        if not isinstance(mig, dict):
+            problems.append(f"{name}: kv_migration must be an object")
+        else:
+            dk = mig.get("donor_kill_mid_pull")
+            if not isinstance(dk, dict):
+                problems.append(f"{name}:kv_migration: missing the "
+                                "'donor_kill_mid_pull' phase block")
+            else:
+                fb = dk.get("fallbacks")
+                if not isinstance(fb, int) or isinstance(fb, bool) \
+                        or fb < 1:
+                    problems.append(
+                        f"{name}:kv_migration: donor kill mid-pull "
+                        "produced no plain-prefill fallback — the "
+                        "abort path was never exercised")
+                if dk.get("completed_token_identical") is not True:
+                    problems.append(
+                        f"{name}:kv_migration: the pulling request "
+                        "did not complete token-identically after "
+                        "the donor died")
+            pr = mig.get("peer_resume")
+            if not isinstance(pr, dict):
+                problems.append(f"{name}:kv_migration: missing the "
+                                "'peer_resume' phase block")
+            else:
+                mp = pr.get("migrated_pages")
+                if not isinstance(mp, int) or isinstance(mp, bool) \
+                        or mp < 1:
+                    problems.append(
+                        f"{name}:kv_migration: peer resume pulled "
+                        "no pages — nothing migrated")
+                if pr.get("resume_token_identical") is not True:
+                    problems.append(
+                        f"{name}:kv_migration: session did not "
+                        "resume token-identically on the peer")
+                delta = pr.get("peer_prefix_hit_tokens_delta")
+                if not isinstance(delta, NUM) \
+                        or isinstance(delta, bool) or delta < 1:
+                    problems.append(
+                        f"{name}:kv_migration: resume served no "
+                        "prefix hit-tokens on the peer — the "
+                        "session was recomputed, not resumed from "
+                        "migrated pages")
+            mreq = mig.get("requests")
+            if isinstance(mreq, dict):
+                for key in ("lost", "mismatched"):
+                    v = mreq.get(key)
+                    if isinstance(v, int) and not isinstance(v, bool) \
+                            and v != 0:
+                        problems.append(
+                            f"{name}:kv_migration: {v} {key} "
+                            "request(s) in the migration drill")
+            mfl = mig.get("flight")
+            if not isinstance(mfl, dict):
+                problems.append(f"{name}:kv_migration: missing the "
+                                "'flight' explanation block")
+            else:
+                for key, what in (
+                        ("donor_kill_explained", "donor kill"),
+                        ("peer_resume_explained", "peer resume")):
+                    if mfl.get(key) is not True:
+                        problems.append(
+                            f"{name}:kv_migration: no flight bundle "
+                            f"explains the {what}")
+            if mig.get("quiesced") is not True:
+                problems.append(
+                    f"{name}:kv_migration: migration-drill pools "
+                    "did not quiesce leak-free")
     sha = obj.get("git_sha")
     if sha is not None and not isinstance(sha, str):
         problems.append(f"{name}: git_sha must be a string")
