@@ -32,15 +32,63 @@ BatchFormat = Union[List[Any], Dict[str, np.ndarray]]
 @ray_tpu.remote(num_cpus=0.5)
 def _apply_stages(block: Block, stages: Tuple) -> Block:
     for kind, fn in stages:
-        if kind == "map":
-            block = [fn(row) for row in block]
-        elif kind == "filter":
-            block = [row for row in block if fn(row)]
-        elif kind == "flat_map":
-            block = [out for row in block for out in fn(row)]
-        elif kind == "map_batches":
-            block = _apply_map_batches(block, fn)
+        block = _apply_one_stage(block, kind, fn)
     return block
+
+
+def _approx_block_bytes(block: Block) -> int:
+    """Cheap shallow payload estimate for stats reporting: exact for
+    numpy payloads, length-based for str/bytes, flat 8 bytes per
+    other scalar/row. An estimator, not an accountant — stats must
+    never cost a serialization pass."""
+    total = 0
+    for row in block:
+        vals = row.values() if isinstance(row, dict) else (row,)
+        for v in vals:
+            if isinstance(v, np.ndarray):
+                total += v.nbytes
+            elif isinstance(v, (str, bytes)):
+                total += len(v)
+            elif isinstance(v, (list, tuple)):
+                total += 8 * len(v)
+            else:
+                total += 8
+    return total
+
+
+@ray_tpu.remote(num_cpus=0.5)
+def _apply_stages_timed(block: Block, stages: Tuple):
+    """``_apply_stages`` with a per-stage execution report: for each
+    stage, rows in/out, approximate output bytes, and wall seconds —
+    the payload behind ``Dataset.stats_dict()`` (and the pipeline
+    stats the batch tier folds into its progress manifests). Two
+    returns: the transformed block, then the stats row."""
+    import time as _time
+    per_stage = []
+    for kind, fn in stages:
+        rows_in = len(block)
+        t0 = _time.perf_counter()
+        block = _apply_one_stage(block, kind, fn)
+        per_stage.append({
+            "stage": kind,
+            "rows_in": rows_in,
+            "rows_out": len(block),
+            "bytes_out": _approx_block_bytes(block),
+            "wall_s": _time.perf_counter() - t0,
+        })
+    return block, per_stage
+
+
+def _apply_one_stage(block: Block, kind: str, fn) -> Block:
+    if kind == "map":
+        return [fn(row) for row in block]
+    if kind == "filter":
+        return [row for row in block if fn(row)]
+    if kind == "flat_map":
+        return [out for row in block for out in fn(row)]
+    if kind == "map_batches":
+        return _apply_map_batches(block, fn)
+    raise ValueError(f"unknown stage kind {kind!r}")
 
 
 def _apply_map_batches(block: Block, spec) -> Block:
@@ -485,24 +533,73 @@ class Dataset:
 
     # --- execution --------------------------------------------------------
 
-    def materialize(self) -> "Dataset":
+    def materialize(self, *, collect_stats: bool = False) -> "Dataset":
         """Execute pending stages as one task per block. The transformed
         blocks stay in the object store as the task outputs — they are
         never pulled into (or re-serialized from) the driver, so
         downstream shuffle ops keep their no-driver-rows guarantee even
-        with lazy stages pending. Stage errors surface at first get."""
+        with lazy stages pending. Stage errors surface at first get.
+
+        ``collect_stats=True`` runs the timed execution path: each
+        block task also returns a per-stage report (rows in/out,
+        approximate bytes, wall seconds) that ``stats_dict()``
+        aggregates — the shape the batch tier embeds in its progress
+        manifests. Off by default: stats cost one extra ObjectRef per
+        block."""
         if not self._stages:
             return self
         import time as _time
         t0 = _time.perf_counter()
-        out = Dataset([_apply_stages.remote(b, self._stages)
-                       for b in self._block_refs])
+        stat_refs = None
+        if collect_stats:
+            timed = _apply_stages_timed.options(num_returns=2)
+            refs, stat_refs = [], []
+            for b in self._block_refs:
+                block_ref, stat_ref = timed.remote(b, self._stages)
+                refs.append(block_ref)
+                stat_refs.append(stat_ref)
+            out = Dataset(refs)
+        else:
+            out = Dataset([_apply_stages.remote(b, self._stages)
+                           for b in self._block_refs])
         out._exec_stats = {
             "stages": [k for k, _ in self._stages],
             "num_blocks": len(self._block_refs),
             "submit_s": round(_time.perf_counter() - t0, 4),
         }
+        if stat_refs is not None:
+            out._stage_stat_refs = stat_refs
         return out
+
+    def stats_dict(self) -> Optional[Dict[str, Any]]:
+        """Aggregated per-stage execution report from the last
+        ``materialize(collect_stats=True)``: for each stage, total
+        rows in/out, approximate output bytes, and summed wall
+        seconds across block tasks. None when the dataset was not
+        executed with stats collection (the cheap default path).
+        Fetching barriers on the block tasks — stats describe a
+        finished execution, not a plan."""
+        refs = getattr(self, "_stage_stat_refs", None)
+        if refs is None:
+            return None
+        per_block = ray_tpu.get(list(refs))
+        agg: List[Dict[str, Any]] = []
+        for reports in per_block:
+            for i, rpt in enumerate(reports):
+                if i >= len(agg):
+                    agg.append({"stage": rpt["stage"], "rows_in": 0,
+                                "rows_out": 0, "bytes_out": 0,
+                                "wall_s": 0.0})
+                agg[i]["rows_in"] += rpt["rows_in"]
+                agg[i]["rows_out"] += rpt["rows_out"]
+                agg[i]["bytes_out"] += rpt["bytes_out"]
+                agg[i]["wall_s"] += rpt["wall_s"]
+        for row in agg:
+            row["wall_s"] = round(row["wall_s"], 4)
+        return {"stages": agg,
+                "num_blocks": len(per_block),
+                "submit_s": getattr(self, "_exec_stats",
+                                    {}).get("submit_s")}
 
     def stats(self) -> str:
         """Execution summary (reference: Dataset.stats() — per-stage
@@ -516,6 +613,13 @@ class Dataset:
             lines.append(
                 f"  last execution: stages={ex['stages']} over "
                 f"{ex['num_blocks']} blocks, submit {ex['submit_s']}s")
+        if getattr(self, "_stage_stat_refs", None) is not None:
+            sd = self.stats_dict()
+            for row in sd["stages"]:
+                lines.append(
+                    f"  stage {row['stage']}: {row['rows_in']} -> "
+                    f"{row['rows_out']} rows, ~{row['bytes_out']} B, "
+                    f"{row['wall_s']}s")
         if not self._stages:
             # Row counts only for executed datasets: counting the
             # INPUT blocks of a pending filter/flat_map would report
@@ -585,6 +689,53 @@ class Dataset:
         lens = ray_tpu.get([_block_len.remote(b)
                             for b in ds._block_refs])
         return ds, lens
+
+    def split_oversized_blocks(
+            self, target_max_block_size: int, *,
+            collect_stats: bool = False) -> "Dataset":
+        """Cap block size at ``target_max_block_size`` rows: each
+        oversized block is sliced (remotely — rows never visit the
+        driver) into near-equal parts under the cap; conforming
+        blocks pass through by reference, untouched. Unlike
+        ``repartition`` this never merges or moves rows across
+        blocks, so it is cheap on mostly-conforming data — the map-
+        boundary guard the pipeline uses so one skewed source block
+        can't become one giant downstream burst
+        (``DatasetPipeline.map_batches(target_max_block_size=...)``,
+        the batch tier's prefill-burst bound).
+
+        ``collect_stats=True`` runs any pending stages on the timed
+        execution path and carries the per-stage report through the
+        split, so ``stats_dict()`` still describes the execution even
+        though splitting rebuilt the block list — without it, a
+        downstream ``materialize(collect_stats=True)`` would see no
+        pending stages and report nothing."""
+        if target_max_block_size < 1:
+            raise ValueError(
+                f"target_max_block_size must be >= 1, got "
+                f"{target_max_block_size}")
+        ds = self.materialize(collect_stats=collect_stats)
+        lens = ray_tpu.get([_block_len.remote(b)
+                            for b in ds._block_refs])
+        if all(n <= target_max_block_size for n in lens):
+            return ds
+        refs: List[ray_tpu.ObjectRef] = []
+        for ref, n in zip(ds._block_refs, lens):
+            if n <= target_max_block_size:
+                refs.append(ref)
+                continue
+            k = -(-n // target_max_block_size)
+            cuts = _even_cuts(n, k)
+            parts = _slice_block.options(
+                num_returns=k).remote(ref, cuts)
+            refs.extend(parts if isinstance(parts, list)
+                        else [parts])
+        out = Dataset(refs)
+        if getattr(ds, "_stage_stat_refs", None) is not None:
+            out._stage_stat_refs = ds._stage_stat_refs
+        if getattr(ds, "_exec_stats", None):
+            out._exec_stats = ds._exec_stats
+        return out
 
     def repartition(self, num_blocks: int,
                     strategy: str = "auto") -> "Dataset":

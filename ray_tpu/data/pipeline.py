@@ -34,21 +34,55 @@ class DatasetPipeline:
 
     # --- transforms (applied lazily per window) ---------------------------
 
-    def map(self, fn) -> "DatasetPipeline":
+    def map(self, fn, *,
+            target_max_block_size: Optional[int] = None
+            ) -> "DatasetPipeline":
         base = self._windows_fn
+        split = self._splitter(target_max_block_size)
         return DatasetPipeline(
-            lambda: (w.map(fn) for w in base()), self._length)
+            lambda: (split(w.map(fn)) for w in base()), self._length)
 
-    def map_batches(self, fn, **kwargs) -> "DatasetPipeline":
+    def map_batches(self, fn,
+                    target_max_block_size: Optional[int] = None,
+                    **kwargs) -> "DatasetPipeline":
+        """Per-window ``Dataset.map_batches``. With
+        ``target_max_block_size`` set, every window's output blocks
+        are re-split under that row cap at the map boundary
+        (``Dataset.split_oversized_blocks``): a skewed source block
+        — or a flat_map-style expansion inside ``fn`` — can't emerge
+        as one giant block that a downstream consumer (the batch
+        tier's prefill window, a device batch) must swallow whole."""
         base = self._windows_fn
+        split = self._splitter(target_max_block_size)
         return DatasetPipeline(
-            lambda: (w.map_batches(fn, **kwargs) for w in base()),
+            lambda: (split(w.map_batches(fn, **kwargs))
+                     for w in base()),
             self._length)
 
-    def filter(self, fn) -> "DatasetPipeline":
+    def filter(self, fn, *,
+               target_max_block_size: Optional[int] = None
+               ) -> "DatasetPipeline":
         base = self._windows_fn
+        split = self._splitter(target_max_block_size)
         return DatasetPipeline(
-            lambda: (w.filter(fn) for w in base()), self._length)
+            lambda: (split(w.filter(fn)) for w in base()),
+            self._length)
+
+    @staticmethod
+    def _splitter(target_max_block_size: Optional[int]):
+        """Identity when no cap is set; otherwise the map-boundary
+        block-size guard (splitting materializes the window's pending
+        stages — windows execute eagerly on consumption anyway, so
+        the barrier stays window-local). Stats collection rides along
+        so a consumer that reads ``stats_dict()`` per window (the
+        batch tier's progress manifests) still gets the per-stage
+        report the split's materialization would otherwise swallow;
+        cost is one extra ObjectRef per block, only when a cap is
+        set."""
+        if target_max_block_size is None:
+            return lambda w: w
+        return lambda w: w.split_oversized_blocks(
+            target_max_block_size, collect_stats=True)
 
     def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
         base = self._windows_fn

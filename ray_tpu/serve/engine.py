@@ -77,7 +77,8 @@ from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
                                   RequestCancelled, RequestError)
 from ray_tpu.serve.faults import EngineFault
 from ray_tpu.serve.prefix_cache import PrefixCache
-from ray_tpu.serve.scheduler import StepPlan, SlotView, plan_step
+from ray_tpu.serve.scheduler import (LANE_BATCH, LANE_ONLINE,
+                                     StepPlan, SlotView, plan_step)
 
 _DONE = object()
 
@@ -86,6 +87,8 @@ CANCELLED_TOTAL = "serve_engine_cancelled_total"
 DEADLINE_TOTAL = "serve_engine_deadline_exceeded_total"
 CONTAINED_TOTAL = "serve_engine_contained_faults_total"
 RETRIES_TOTAL = "serve_engine_retries_total"
+BATCH_TOKENS_TOTAL = "serve_batch_tokens_total"
+BATCH_PREEMPTED_TOTAL = "serve_batch_preempted_total"
 
 _METRICS: Optional[dict] = None
 
@@ -115,6 +118,13 @@ def _metrics() -> dict:
             "retries": metrics.Counter(
                 RETRIES_TOTAL, "Innocent requests requeued after a "
                 "contained fault (bounded retry policy)"),
+            "batch_tokens": metrics.Counter(
+                BATCH_TOKENS_TOTAL, "Tokens emitted to BATCH-lane "
+                "requests (the capacity the batch tier absorbed)"),
+            "batch_preempted": metrics.Counter(
+                BATCH_PREEMPTED_TOTAL, "BATCH-lane slots preempted "
+                "— yielded to online traffic or page pressure; the "
+                "request requeues and recomputes/prefix-resumes"),
         }
     return _METRICS
 
@@ -177,6 +187,14 @@ class _Request:
                                  # admission — cleared before the
                                  # pull starts, so a preemption or
                                  # fault requeue can never re-pull.
+    batch: bool = False          # BATCH lane (priority="batch",
+                                 # serve/batch_tier.py): preemptible
+                                 # offline work. Admits only behind
+                                 # every waiting online request, is
+                                 # the first preemption victim, and
+                                 # counts in its own queue-depth lane
+                                 # so the autoscaler never scales for
+                                 # preemptible backlog.
 
     @property
     def remaining(self) -> int:
@@ -196,6 +214,7 @@ class RequestHandle:
                  engine: Optional["LLMEngine"] = None):
         self._req = req
         self._engine = engine
+        self._drained = False
 
     def cancel(self) -> bool:
         """Abort the request at whatever phase it is in — queued,
@@ -228,9 +247,17 @@ class RequestHandle:
             yield item
 
     def result(self) -> List[int]:
-        """Block until completion; return all generated token ids."""
-        for _ in self.stream():
-            pass
+        """Block until completion; return all generated token ids.
+        Idempotent: once the stream has been drained (here or via
+        ``stream()`` running to completion elsewhere), repeat calls
+        return the cached tokens — or re-raise the terminal error —
+        instead of blocking on an already-consumed queue."""
+        if not self._drained:
+            self._drained = True
+            for _ in self.stream():
+                pass
+        if self._req.error is not None:
+            raise self._req.error
         return list(self._req.generated)
 
     @property
@@ -330,7 +357,13 @@ class LLMEngine:
         requests already waiting, ``submit`` fails fast with
         ``EngineOverloaded`` (shed counter + 429 at the proxy)
         instead of queueing into silent TTFT collapse. None
-        (default) keeps the queue unbounded.
+        (default) keeps the queue unbounded. Counts ONLY the online
+        lane: preemptible batch backlog lives under
+        ``max_queued_batch``.
+    max_queued_batch: the BATCH lane's own admission bound (None,
+        default, = unbounded — the no-TTFT-SLO deep queue of the
+        throughput profile; the batch driver bounds its own in-flight
+        window instead, serve/batch_tier.py).
     max_retries: bounded retry policy for fault containment — an
         innocent request swept up in another request's dispatch
         fault is requeued (recompute, like preemption) at most this
@@ -376,6 +409,7 @@ class LLMEngine:
     def __init__(self, model, params, *, max_slots: int = 8,
                  page_size: int = 16, n_pages: int = 256,
                  chunk: int = 4, prefill_chunk: Optional[int] = None,
+                 max_run_ahead: Optional[int] = None,
                  temperature: float = 0.0,
                  eos_id: Optional[int] = None, seed: int = 0,
                  max_prefill_compiles: int = 16,
@@ -383,6 +417,7 @@ class LLMEngine:
                  spec_len: int = 0, spec_ngram: int = 3,
                  spec_proposer=None,
                  max_queued: Optional[int] = None,
+                 max_queued_batch: Optional[int] = None,
                  max_retries: int = 2,
                  retry_backoff_s: float = 0.02,
                  shed_retry_after_s: float = 1.0,
@@ -414,7 +449,10 @@ class LLMEngine:
         self.eos_id = eos_id
         # Run-ahead ceiling: one dispatch may decode up to this many
         # steps before a host sync (the token buffer is [KMAX, S]).
-        self.KMAX = max(chunk, 128)
+        # The throughput profile (scheduler.SCHEDULER_PROFILES) sets
+        # it explicitly — batch decode tolerates longer syncs.
+        self.KMAX = (max(chunk, 128) if max_run_ahead is None
+                     else max(chunk, int(max_run_ahead)))
         # Page-table width == the attention gather window (L =
         # max_pages * page_size per slot), so cap it at what the model
         # can legally address rather than the whole pool.
@@ -531,6 +569,9 @@ class LLMEngine:
         if max_queued is not None and max_queued < 0:
             raise ValueError("max_queued must be >= 0 or None")
         self.max_queued = max_queued
+        if max_queued_batch is not None and max_queued_batch < 0:
+            raise ValueError("max_queued_batch must be >= 0 or None")
+        self.max_queued_batch = max_queued_batch
         self.max_retries = max(0, int(max_retries))
         self.retry_backoff_s = max(0.0, float(retry_backoff_s))
         self.shed_retry_after_s = float(shed_retry_after_s)
@@ -599,7 +640,8 @@ class LLMEngine:
                max_new_tokens: int = 64,
                deadline_s: Optional[float] = None,
                trace_id: Optional[str] = None,
-               pull: Optional[Dict[str, Any]] = None) -> RequestHandle:
+               pull: Optional[Dict[str, Any]] = None,
+               priority: str = LANE_ONLINE) -> RequestHandle:
         """Queue one request. ``deadline_s`` (relative, seconds) sets
         a hard completion deadline: the request fails with
         ``DeadlineExceeded`` at whatever phase it is in — queued,
@@ -608,6 +650,15 @@ class LLMEngine:
         immediately. With ``max_queued`` configured, a full admission
         queue sheds the request with ``EngineOverloaded`` instead of
         accepting unbounded latency.
+
+        ``priority`` selects the lane: ``"online"`` (default, the
+        latency-critical path) or ``"batch"`` (preemptible offline
+        work, serve/batch_tier.py). A batch request admits only when
+        no online request is waiting, yields its slot the moment
+        online traffic needs it (recompute/prefix-cache resume on
+        re-admission, token-identical), and is bounded by
+        ``max_queued_batch`` instead of ``max_queued`` — so a deep
+        batch backlog can neither shed nor delay online admission.
 
         ``pull`` is a cross-replica KV pull hint from pool routing
         (serve/kv_migration.py): a dict carrying at least ``hashes``
@@ -624,6 +675,10 @@ class LLMEngine:
             raise RequestError("max_new_tokens must be >= 1")
         if deadline_s is not None and deadline_s <= 0:
             raise RequestError("deadline_s must be > 0")
+        if priority not in (LANE_ONLINE, LANE_BATCH):
+            raise RequestError(
+                f"unknown priority {priority!r}; expected "
+                f"'{LANE_ONLINE}' or '{LANE_BATCH}'")
         total = len(prompt_ids) + max_new_tokens
         need = -(-total // self.Pg)
         if need > self.alloc.n_pages - 1:
@@ -636,13 +691,14 @@ class LLMEngine:
                 f"max_seq_len {self.cfg.max_seq_len}")
         req = _Request(next(self._rid), prompt_ids, max_new_tokens,
                        t_submit=time.monotonic(), trace_id=trace_id,
-                       pull=pull)
+                       pull=pull, batch=(priority == LANE_BATCH))
         if deadline_s is not None:
             req.deadline = req.t_submit + deadline_s
         self.events.append("submit", rid=req.rid, t=req.t_submit,
                            data={"trace_id": trace_id,
                                  "prompt_len": len(prompt_ids),
-                                 "max_new_tokens": max_new_tokens})
+                                 "max_new_tokens": max_new_tokens,
+                                 "lane": priority})
         # Bounded admission-lock acquire: the scheduler holds this
         # lock across whole rounds, and a WEDGED scheduler (hung
         # dispatch — see serve/watchdog.py) holds it forever. With a
@@ -671,16 +727,30 @@ class LLMEngine:
                 raise EngineDraining(
                     "engine draining: finishing in-flight work, "
                     "admitting nothing new")
-            if (self.max_queued is not None
-                    and len(self._wait) >= self.max_queued):
-                self.stats["shed"] += 1
-                _metrics()["shed"].inc()
-                self.events.append("shed", rid=req.rid,
-                                   data={"why": "queue_full"})
-                raise EngineOverloaded(
-                    f"admission queue full ({len(self._wait)} waiting"
-                    f" >= max_queued={self.max_queued}); request shed",
-                    retry_after_s=self.shed_retry_after_s)
+            # Per-lane bounded admission: the online bound counts
+            # only online requests (a deep preemptible batch backlog
+            # must never shed latency-critical traffic), and the
+            # batch lane carries its own, typically much deeper (or
+            # unbounded) budget — the throughput profile's
+            # no-TTFT-SLO deep queue.
+            bound = (self.max_queued_batch if req.batch
+                     else self.max_queued)
+            if bound is not None:
+                lane_depth = sum(1 for r in self._wait
+                                 if r.batch == req.batch)
+                if lane_depth >= bound:
+                    self.stats["shed"] += 1
+                    _metrics()["shed"].inc()
+                    self.events.append(
+                        "shed", rid=req.rid,
+                        data={"why": "queue_full",
+                              "lane": priority})
+                    raise EngineOverloaded(
+                        f"admission queue full ({lane_depth} "
+                        f"{priority} waiting >= "
+                        f"max_queued{'_batch' if req.batch else ''}="
+                        f"{bound}); request shed",
+                        retry_after_s=self.shed_retry_after_s)
             self._wait.append(req)
             self.stats["submitted"] += 1
             self._work.notify()
@@ -766,6 +836,7 @@ class LLMEngine:
             waiting = list(self._wait)
             for req in waiting:
                 outstanding += len(req.prompt) + req.max_new_tokens
+            q_batch = sum(1 for r in waiting if r.batch)
             return {
                 "free_slots": free_slots,
                 "total_slots": len(self.slots),
@@ -777,9 +848,19 @@ class LLMEngine:
                 "kv_page_bytes": self.page_bytes,
                 "kv_bytes_in_use": self.alloc.bytes_in_use(),
                 "kv_bytes_total": self.alloc.bytes_total(),
-                "queue_depth": len(waiting),
+                # Per-lane queue depth. ``queue_depth`` is the ONLINE
+                # lane only — the number routing saturation
+                # (Candidate.saturated vs max_queued) and the
+                # autoscaler compare against their online-lane
+                # bounds. Preemptible batch backlog is deliberately
+                # its own number: scaling the fleet up for work that
+                # yields instantly would defeat the tier.
+                "queue_depth": len(waiting) - q_batch,
+                "queue_depth_online": len(waiting) - q_batch,
+                "queue_depth_batch": q_batch,
                 "outstanding_tokens": outstanding,
                 "max_queued": self.max_queued,
+                "max_queued_batch": self.max_queued_batch,
                 "shed_retry_after_s": self.shed_retry_after_s,
                 "shed_total": self.stats.get("shed", 0),
                 "ttft_ewma_s": self._ttft_ewma,
@@ -820,8 +901,11 @@ class LLMEngine:
                 "kv_bytes_in_use": self.alloc.bytes_in_use(),
                 "kv_bytes_total": self.alloc.bytes_total(),
                 "queue_depth": len(self._wait),
+                "queue_depth_online": len(self._wait),
+                "queue_depth_batch": 0,
                 "outstanding_tokens": 0,
                 "max_queued": self.max_queued,
+                "max_queued_batch": self.max_queued_batch,
                 "shed_retry_after_s": self.shed_retry_after_s,
                 "shed_total": self.stats.get("shed", 0),
                 "ttft_ewma_s": self._ttft_ewma,
@@ -1302,7 +1386,8 @@ class LLMEngine:
                           seeded=s.cur is not None,
                           spec_drafts=len(s.spec_pending),
                           stale=stale[i],
-                          pulling=s.pulling)
+                          pulling=s.pulling,
+                          batch=s.req.batch)
                  for i, s in enumerate(self.slots) if s is not None]
         return plan_step(views, total_slots=self.S,
                          prefill_budget=self.PC, decode_chunk=self.K,
@@ -1434,6 +1519,58 @@ class LLMEngine:
             self._wait.clear()
             self._stopped = True
 
+    def _next_admit_locked(self) -> Optional[_Request]:
+        """Lane-aware head selection for admission. Drops closed
+        requests parked at the head (cancelled/expired while queued
+        by a path that left them in place — never admit), then picks
+        the first ONLINE request anywhere in the queue: FIFO within
+        each lane, but the online lane always outranks batch. Only
+        when no online request waits does the batch head admit.
+
+        The chosen request is rotated to the deque FRONT before
+        returning, so every existing ``popleft`` admission path
+        (plain admission, PULLING admission) stays correct without
+        threading an index through."""
+        while self._wait and self._wait[0].closed:
+            self._wait.popleft()
+        if not self._wait:
+            return None
+        head = self._wait[0]
+        if not head.batch:
+            return head
+        # batch head: any live online request deeper in the queue
+        # outranks it (closed entries are skipped in place — they
+        # drop when they surface at the head)
+        for k in range(1, len(self._wait)):
+            r = self._wait[k]
+            if r.closed or r.batch:
+                continue
+            del self._wait[k]
+            self._wait.appendleft(r)
+            return r
+        return head
+
+    def _victim_locked(self, exclude_sid: Optional[int] = None, *,
+                       batch_only: bool = False) -> Optional[int]:
+        """Preemption victim selection, one policy for every caller:
+        the youngest occupied slot, with BATCH slots strictly before
+        any online slot (bool sorts False < True, so the key
+        ``(batch, admit_seq)`` under ``max`` is batch-first,
+        youngest-first within the lane). ``exclude_sid`` protects
+        the slot whose growth is hunting (never self-evict); PULLING
+        slots are never victims (no pages to reclaim, and a
+        background thread owns them). ``batch_only=True`` restricts
+        the hunt to batch slots — the online-head admission path,
+        where online slots must never be evicted to admit."""
+        cands = (j for j, s in enumerate(self.slots)
+                 if s is not None and not s.pulling
+                 and j != exclude_sid
+                 and (s.req.batch or not batch_only))
+        return max(cands,
+                   key=lambda j: (self.slots[j].req.batch,
+                                  self.slots[j].admit_seq),
+                   default=None)
+
     def _admit_locked(self):
         """Chunk-budget admission: a waiting request takes a free
         slot as soon as pages for its FIRST prefill chunk exist —
@@ -1467,21 +1604,38 @@ class LLMEngine:
         THIS path as a plain local hit — mid-offset prefill resume,
         COW boundary handling, and hit accounting all unchanged. An
         aborted pull requeues without inserting anything: plain
-        prefill, never a wedge."""
+        prefill, never a wedge.
+
+        Priority lanes: the admitted head is the first ONLINE request
+        anywhere in the queue; batch requests admit only when no
+        online request waits (FIFO within each lane). When every slot
+        is taken and the online head is blocked, the youngest BATCH
+        slot is preempted on the spot — online traffic reclaims batch
+        capacity slot-by-slot the moment it arrives. While an online
+        head waits (for a slot or for pages), the lane order also
+        guarantees no batch request can slip past it into capacity it
+        frees."""
         while self._wait:
+            req = self._next_admit_locked()
+            if req is None:
+                return
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
+                if not req.batch:
+                    # online head blocked on a full batch: evict the
+                    # youngest BATCH slot (recompute / prefix-cache
+                    # resume on re-admission — token-identical) and
+                    # retry. Online slots are never preempted for
+                    # admission.
+                    victim = self._victim_locked(None, batch_only=True)
+                    if victim is not None:
+                        self._preempt_locked(victim)
+                        continue
                 return
-            req = self._wait[0]
-            if req.closed:
-                # cancelled/expired while queued by a path that left
-                # it in place — drop, never admit
-                self._wait.popleft()
-                continue
             if req.t_earliest and time.monotonic() < req.t_earliest:
                 # retry backoff after a contained fault. FIFO is the
-                # admission contract, so a backing-off head delays
-                # everything behind it too.
+                # admission contract (per lane), so a backing-off
+                # head delays everything behind it too.
                 return
             prompt = req.recompute_prompt
             if req.pull is not None and self._try_pull_admit_locked(
@@ -1749,7 +1903,8 @@ class LLMEngine:
     def _dispatch_prefill_locked(self, grants):
         """Execute this round's prefill grants: grow each granted
         slot's pages to cover its chunk (evicting the youngest OTHER
-        slot when the pool runs dry, exactly like decode growth),
+        slot — batch lane first — when the pool runs dry, exactly
+        like decode growth),
         then dispatch ONE batched chunked-prefill call for every
         surviving grant. Rows carry independent start offsets and
         lengths, so mixed prompt lengths and mid-prompt resumptions
@@ -1780,12 +1935,7 @@ class LLMEngine:
                             need - len(slot.pages)
                             - self.alloc.n_free) > 0):
                     continue    # reclaimed cached pages; retry alloc
-                victim = max(
-                    (j for j, s in enumerate(self.slots)
-                     if s is not None and not s.pulling
-                     and j != g.sid),
-                    key=lambda j: self.slots[j].admit_seq,
-                    default=None)
+                victim = self._victim_locked(g.sid)
                 if victim is None:
                     # alone and still can't grow — attributable to
                     # THIS request: contained, not _fail_all
@@ -1808,7 +1958,8 @@ class LLMEngine:
 
     def _grow_or_preempt_locked(self, steps: int):
         """Ensure every active slot's pages cover this dispatch's
-        writes; evict the youngest slots if the pool runs dry."""
+        writes; evict the youngest slots (batch lane first) if the
+        pool runs dry."""
         for i in sorted(
                 (i for i, s in enumerate(self.slots) if s is not None),
                 key=lambda i: self.slots[i].admit_seq):
@@ -1835,12 +1986,7 @@ class LLMEngine:
                             need - len(slot.pages)
                             - self.alloc.n_free) > 0):
                     continue    # reclaimed cached pages; retry alloc
-                victim = max(
-                    (j for j, s in enumerate(self.slots)
-                     if s is not None and not s.pulling
-                     and j != i),
-                    key=lambda j: self.slots[j].admit_seq,
-                    default=None)
+                victim = self._victim_locked(i)
                 if victim is None:
                     # alone and still can't grow — attributable to
                     # THIS request: contained, not _fail_all
@@ -1918,8 +2064,13 @@ class LLMEngine:
         self._free_slot_pages_locked(slot, retire=False)
         slot.req.preemptions += 1
         self.stats["preemptions"] += 1
+        if slot.req.batch:
+            self.stats["batch_preemptions"] += 1
+            _metrics()["batch_preempted"].inc()
         self.events.append("preempt", rid=slot.req.rid, sid=ix,
-                           data={"preemptions": slot.req.preemptions})
+                           data={"preemptions": slot.req.preemptions,
+                                 "lane": (LANE_BATCH if slot.req.batch
+                                          else LANE_ONLINE)})
         self._wait.appendleft(slot.req)   # front: re-admit first
 
     def _dispatch_chunk_locked(self, steps: int):
@@ -2021,7 +2172,7 @@ class LLMEngine:
             self._check_cow_locked(slot, slot.pos)
             # grow pages to cover every verify write (cur + drafts),
             # exactly like prefill growth: prefix-cache eviction
-            # first, then youngest-other preemption
+            # first, then youngest-other (batch-first) preemption
             need = -(-(slot.pos + len(drafts) + 1) // self.Pg)
             evicted = False
             while len(slot.pages) < need:
@@ -2037,12 +2188,7 @@ class LLMEngine:
                             need - len(slot.pages)
                             - self.alloc.n_free) > 0):
                     continue
-                victim = max(
-                    (j for j, s in enumerate(self.slots)
-                     if s is not None and not s.pulling
-                     and j != g.sid),
-                    key=lambda j: self.slots[j].admit_seq,
-                    default=None)
+                victim = self._victim_locked(g.sid)
                 if victim is None:
                     # submit() sized the pool for prompt+completion,
                     # and pos + drafts + 1 never exceeds that —
@@ -2263,14 +2409,23 @@ class LLMEngine:
                 # drains (the accounting bug the r05 bench carried)
                 req.t_first = time.monotonic()
                 ttft = req.t_first - req.t_submit
-                self.ttfts_s.append(ttft)
-                a = self._ttft_ewma_alpha
-                self._ttft_ewma = ttft if self._ttft_ewma is None \
-                    else a * ttft + (1 - a) * self._ttft_ewma
+                if not req.batch:
+                    # online SLO signals only: a batch request has no
+                    # TTFT SLO (it may sit queued for hours by
+                    # design), and folding its wait into ttfts_s /
+                    # the EWMA would poison the autoscaler's latency
+                    # signal and every bench percentile
+                    self.ttfts_s.append(ttft)
+                    a = self._ttft_ewma_alpha
+                    self._ttft_ewma = ttft if self._ttft_ewma is None \
+                        else a * ttft + (1 - a) * self._ttft_ewma
                 self.events.append("first_token", rid=req.rid,
                                    sid=ix, t=req.t_first,
-                                   data={"ttft_s": ttft})
-                if self._obs_enabled:
+                                   data={"ttft_s": ttft,
+                                         "lane": (LANE_BATCH
+                                                  if req.batch
+                                                  else LANE_ONLINE)})
+                if self._obs_enabled and not req.batch:
                     obs.phase_metrics()["ttft"].observe(ttft)
             req.generated.append(t)
             req.out_q.put(t)
@@ -2283,6 +2438,9 @@ class LLMEngine:
             _now = time.monotonic()
             self.events.append("emit", rid=req.rid, sid=ix, t=_now,
                                data={"n": n_put})
+            if req.batch:
+                self.stats["batch_tokens"] += n_put
+                _metrics()["batch_tokens"].inc(n_put)
             if self._obs_enabled and req.t_last_emit is not None:
                 # mean gap per token over this readback batch
                 obs.phase_metrics()["inter_token"].observe(

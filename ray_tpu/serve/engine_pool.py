@@ -70,6 +70,7 @@ from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
 from ray_tpu.serve.fleet.routing import (Candidate, ResubmitPolicy,
                                          select_candidate)
 from ray_tpu.serve.prefix_cache import path_hashes
+from ray_tpu.serve.scheduler import LANE_BATCH, LANE_ONLINE
 
 ROUTED = "serve_pool_routed_total"
 AFFINITY_HITS = "serve_pool_affinity_hits_total"
@@ -82,6 +83,7 @@ RESTARTS = "serve_pool_restarts_total"
 ALL_SHED = "serve_pool_all_shed_total"
 FREE_SLOTS = "serve_pool_replica_free_slots"
 QUEUE_DEPTH = "serve_pool_replica_queue_depth"
+BATCH_QUEUE_DEPTH = "serve_pool_replica_batch_queue_depth"
 CAPACITY_HINT_ERRORS = "serve_pool_capacity_hint_errors_total"
 SUSPECTS = "serve_pool_suspect_total"
 WEDGED = "serve_pool_wedged_total"
@@ -126,7 +128,13 @@ def _metrics() -> dict:
                 FREE_SLOTS, "Free decode slots per replica",
                 tag_keys=("replica",)),
             "queue_depth": metrics.Gauge(
-                QUEUE_DEPTH, "Admission queue depth per replica",
+                QUEUE_DEPTH, "Admission queue depth per replica "
+                "(ONLINE lane — the saturation/autoscaling signal)",
+                tag_keys=("replica",)),
+            "batch_queue_depth": metrics.Gauge(
+                BATCH_QUEUE_DEPTH, "BATCH-lane queue depth per "
+                "replica (preemptible backlog; excluded from "
+                "saturation and autoscaling signals)",
                 tag_keys=("replica",)),
             "capacity_hint_errors": metrics.Counter(
                 CAPACITY_HINT_ERRORS, "capacity_hint_fn raised; the "
@@ -197,11 +205,13 @@ class PoolRequestHandle(ResubmitPolicy):
     def __init__(self, pool: "EnginePool", prompt: List[int],
                  max_new_tokens: int, deadline_s: Optional[float],
                  session_id: Optional[str],
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 priority: str = LANE_ONLINE):
         super().__init__(prompt, max_new_tokens, deadline_s,
                          session_id, trace_id,
                          max_resubmits=pool.max_resubmits)
         self._pool = pool
+        self._priority = priority
         self._rep: Optional[_Replica] = None
         self._inner = None
 
@@ -269,7 +279,7 @@ class PoolRequestHandle(ResubmitPolicy):
         try:
             self._rep, self._inner = self._pool._submit_once(
                 self._prompt, self._mnt, deadline, self._session_id,
-                trace_id=self._trace_id)
+                trace_id=self._trace_id, priority=self._priority)
         except BaseException as e:
             self._fail(e)
             raise
@@ -401,7 +411,8 @@ class EnginePool:
                max_new_tokens: int = 64,
                deadline_s: Optional[float] = None,
                session_id: Optional[str] = None,
-               trace_id: Optional[str] = None) -> PoolRequestHandle:
+               trace_id: Optional[str] = None,
+               priority: str = LANE_ONLINE) -> PoolRequestHandle:
         """Route and queue one request (engine ``submit`` signature
         plus ``session_id`` for stickiness and ``trace_id`` for
         request-scope tracing — the id survives replica-death
@@ -409,15 +420,24 @@ class EnginePool:
         like a single engine: validation ``RequestError``
         immediately, pool-aggregate ``EngineOverloaded`` when every
         healthy replica sheds, ``EngineShutdown`` when none is
-        left."""
+        left.
+
+        ``priority=LANE_BATCH`` routes through the batch spill path:
+        least batch-backlog replica, skipping session stickiness and
+        prefix affinity entirely — batch work soaks whatever replica
+        is emptiest and NEVER claims (or pollutes) the sticky/affinity
+        placement online traffic depends on. The lane rides replica-
+        death resubmits unchanged."""
         if self._stopped:
             raise EngineShutdown("engine pool stopped")
         prompt = [int(t) for t in prompt_ids]
         handle = PoolRequestHandle(self, prompt, max_new_tokens,
-                                   deadline_s, session_id, trace_id)
+                                   deadline_s, session_id, trace_id,
+                                   priority=priority)
         rep, inner = self._submit_once(prompt, max_new_tokens,
                                        deadline_s, session_id,
-                                       trace_id=trace_id)
+                                       trace_id=trace_id,
+                                       priority=priority)
         handle._attach(rep, inner)
         return handle
 
@@ -836,15 +856,18 @@ class EnginePool:
     def _submit_once(self, prompt: List[int], max_new_tokens: int,
                      deadline_s: Optional[float],
                      session_id: Optional[str],
-                     trace_id: Optional[str] = None):
+                     trace_id: Optional[str] = None,
+                     priority: str = LANE_ONLINE):
         """Route + submit until one replica accepts. Replicas that
         shed/die/drain between the snapshot and the submit are
         excluded and routing retries; when nothing accepts, the
         failure is typed and aggregated (module docstring)."""
+        batch = priority == LANE_BATCH
         exclude: set = set()
         shed: List[EngineOverloaded] = []
         while True:
-            rep, decision = self._route(prompt, session_id, exclude)
+            rep, decision = self._route(prompt, session_id, exclude,
+                                        batch=batch)
             if rep is None:
                 hints = decision.get("hints", [])
                 hints += [e.retry_after_s for e in shed]
@@ -899,6 +922,10 @@ class EnginePool:
                     kw["trace_id"] = trace_id
                 if decision.get("pull") is not None:
                     kw["pull"] = decision["pull"]
+                if batch:
+                    # only when non-default: fake engines in tests
+                    # (and older builds) lack the priority kwarg
+                    kw["priority"] = priority
                 inner = rep.engine.submit(prompt, **kw)
             except EngineOverloaded as e:
                 shed.append(e)
@@ -914,11 +941,17 @@ class EnginePool:
             return rep, inner
 
     def _route(self, prompt: List[int], session_id: Optional[str],
-               exclude: set):
+               exclude: set, *, batch: bool = False):
         """Pick a replica (or ``(None, {"hints": [...]})`` when none
         can admit). Lock discipline: the replica table is read under
         the pool lock; ``load_report()`` calls happen OUTSIDE it (they
-        briefly take each engine's lock)."""
+        briefly take each engine's lock).
+
+        ``batch=True`` bypasses the sticky -> affinity -> P2C policy
+        entirely: the batch lane routes to the replica with the least
+        batch backlog (ties on outstanding tokens), reads — never
+        writes — placement state, and respects each replica's
+        ``max_queued_batch`` bound."""
         with self._lock:
             reps = [r for r in self._replicas
                     if r.state == HEALTHY and r.idx not in exclude]
@@ -934,6 +967,8 @@ class EnginePool:
             m["free_slots"].set(rep_report["free_slots"], tags=tags)
             m["queue_depth"].set(rep_report["queue_depth"],
                                  tags=tags)
+            m["batch_queue_depth"].set(
+                rep_report.get("queue_depth_batch", 0), tags=tags)
         # A replica can die while IDLE — engine thread gone with no
         # in-flight handle around to trip the death path. Routing is
         # the other place a corpse becomes visible: note the death
@@ -947,11 +982,14 @@ class EnginePool:
         # sticky -> affinity/spill -> P2C policy the FleetRouter runs
         # over the directory's advertised reports
         by_key = {r.idx: r for r in reps}
+        live = [r for r in reps
+                if not reports[r.idx]["stopped"]
+                and not reports[r.idx]["draining"]]
+        if batch:
+            return self._route_batch(live, reports)
         cands = [Candidate(r.idx, reports[r.idx],
                            getattr(r.engine, "Pg", 0))
-                 for r in reps
-                 if not reports[r.idx]["stopped"]
-                 and not reports[r.idx]["draining"]]
+                 for r in live]
         pick, decision = select_candidate(
             cands, prompt, sticky_key=sticky_idx, rng=self._rng)
         if pick is None:
@@ -962,6 +1000,35 @@ class EnginePool:
             if hint is not None:
                 decision = dict(decision, pull=hint)
         return rep, decision
+
+    def _route_batch(self, live: List[_Replica],
+                     reports: Dict[int, Dict[str, Any]]):
+        """Batch-lane spill routing: least batch backlog first, ties
+        on least outstanding token work — the lane flows wherever
+        capacity is idlest. Replicas whose batch lane is at its
+        ``max_queued_batch`` bound contribute a retry hint instead of
+        a queue position; when every replica is bound, the caller
+        aggregates those hints into one pool-level shed. Sticky and
+        affinity state is untouched: batch never claims a placement
+        online traffic could want."""
+        hints: List[float] = []
+        open_reps: List[_Replica] = []
+        for r in live:
+            rpt = reports[r.idx]
+            bound = rpt.get("max_queued_batch")
+            if (bound is not None
+                    and rpt.get("queue_depth_batch", 0) >= bound):
+                hints.append(rpt.get("shed_retry_after_s", 1.0))
+                continue
+            open_reps.append(r)
+        if not open_reps:
+            return None, {"hints": hints}
+        pick = min(open_reps,
+                   key=lambda r: (
+                       reports[r.idx].get("queue_depth_batch", 0),
+                       reports[r.idx].get("outstanding_tokens", 0),
+                       r.idx))
+        return pick, {"kind": "batch", "pages": 0, "spilled": False}
 
     def _record_route(self, rep: _Replica, decision: Dict[str, Any],
                       session_id: Optional[str],
@@ -987,7 +1054,12 @@ class EnginePool:
                 self.route_stats["sticky_hits"] += 1
             if decision.get("spilled"):
                 self.route_stats["spills"] += 1
-            if session_id is not None:
+            if (session_id is not None
+                    and decision["kind"] != "batch"):
+                # batch routes never write placement state: a batch
+                # job naming a session must not steal (or evict, via
+                # the LRU bound) the sticky entry online traffic
+                # relies on
                 self._sticky[session_id] = rep.idx
                 self._sticky.move_to_end(session_id)
                 while len(self._sticky) > self._max_sticky:
@@ -1031,6 +1103,7 @@ class EnginePool:
         decision; the deployment-level router only needs pressure."""
         reports = list(self.load_reports().values())
         agg = {"free_slots": 0, "free_pages": 0, "queue_depth": 0,
+               "queue_depth_batch": 0,
                "outstanding_tokens": 0, "draining": False,
                "stopped": not reports, "max_queued": None,
                "shed_retry_after_s": 1.0,
@@ -1048,6 +1121,8 @@ class EnginePool:
             agg["free_slots"] += rpt["free_slots"]
             agg["free_pages"] += rpt["free_pages"]
             agg["queue_depth"] += rpt["queue_depth"]
+            agg["queue_depth_batch"] += rpt.get(
+                "queue_depth_batch", 0)
             agg["outstanding_tokens"] += rpt["outstanding_tokens"]
             agg["shed_retry_after_s"] = max(
                 agg["shed_retry_after_s"], rpt["shed_retry_after_s"])

@@ -20,8 +20,9 @@ separate from its raylet I/O).
 
 Policy, in order:
 
-- Prefill grants: mid-prefill slots in admission order (FIFO —
-  admission never reorders, so neither does prefill) each receive
+- Prefill grants: mid-prefill slots in lane-then-admission order
+  (online lane first, FIFO within each lane — admission never
+  reorders within a lane, so neither does prefill) each receive
   ``min(prompt_remaining, budget_left)`` tokens until the round's
   token budget or the prefill batch width runs out. A long prompt
   takes the whole budget for several rounds; several short prompts
@@ -42,6 +43,15 @@ Policy, in order:
   stale eos-bounded rider tightens the cap to one ``decode_chunk``,
   which bounds the worst-case discard on a late-revealed eos to one
   chunk per slot.
+- Priority lanes (``SlotView.batch``, serve/batch_tier.py): offline
+  batch slots share the round with online traffic but never crowd it.
+  Prefill grants order ONLINE slots first (FIFO within the lane),
+  batch slots take whatever budget is left — a deep batch backlog can
+  never delay an online prompt's next chunk by more than the chunk
+  already in flight. Decode is lane-blind by design: a seeded batch
+  slot rides the same dispatch as everyone else (evicting it saves
+  nothing once its KV is resident — preemption happens in the engine
+  when pages or slots are actually contended, batch-first).
 - Spec lane (``spec_enabled``, serve/spec_decode.py): when any seeded
   slot carries draft tokens this round, ONE batched verify dispatch
   replaces the decode chunk — every seeded slot rides it (a slot with
@@ -68,6 +78,54 @@ from typing import Sequence, Tuple
 # count leaks in here, sharded and unsharded replicas plan different
 # rounds and token parity dies.
 ALLOWED_IMPORTS = frozenset({"__future__", "dataclasses", "typing"})
+
+# Priority lanes: every request carries one of these through
+# admission, planning, and preemption. ONLINE is the latency-critical
+# default; BATCH marks preemptible offline work (serve/batch_tier.py)
+# that soaks idle capacity and yields it slot-by-slot the moment
+# online traffic arrives.
+LANE_ONLINE = "online"
+LANE_BATCH = "batch"
+
+# Named knob presets for the two serving regimes. Pure data (the
+# import guard above applies): the engine/deployment layer maps these
+# onto its constructor knobs; the planner itself reads nothing here.
+#
+# - ``latency``: the defaults the online path has always run —
+#   short decode cadence, bounded admission queue, moderate prefill
+#   chunks so TTFT stays flat under interleave.
+# - ``throughput``: offline batch inference with no TTFT SLO — deep
+#   (unbounded) admission queue, large prefill chunks so prompt
+#   processing amortizes dispatch overhead, longer decode run-ahead.
+#   ``max_queued=None`` is deliberate: the batch driver bounds its own
+#   in-flight window (serve/batch_tier.py), so the engine queue depth
+#   is the driver's concurrency knob, not a shed boundary.
+SCHEDULER_PROFILES = {
+    "latency": {
+        "decode_chunk": 4,
+        "prefill_chunk": 256,
+        "max_run_ahead": 256,
+        "max_queued": 2,
+    },
+    "throughput": {
+        "decode_chunk": 16,
+        "prefill_chunk": 512,
+        "max_run_ahead": 512,
+        "max_queued": None,
+    },
+}
+
+
+def scheduler_profile(name):
+    """Knob preset for ``name`` ('latency' | 'throughput'): a fresh
+    dict the caller may mutate. Unknown names raise — a silently
+    defaulted profile would hide a typo'd deployment config."""
+    try:
+        return dict(SCHEDULER_PROFILES[name])
+    except KeyError:
+        raise ValueError(
+            f"unknown scheduler profile {name!r}; expected one of "
+            f"{sorted(SCHEDULER_PROFILES)}") from None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -97,6 +155,12 @@ class SlotView:
                              # by construction, so the quick-cadence
                              # rule already treats it as pending
                              # admission work.
+    batch: bool = False      # BATCH lane (priority=LANE_BATCH):
+                             # preemptible offline work. Prefill
+                             # grants order online slots first; the
+                             # engine preempts batch slots before any
+                             # online slot when pages or slots run
+                             # dry.
 
     @property
     def prefilling(self) -> bool:
@@ -153,8 +217,12 @@ def plan_step(slots: Sequence[SlotView], *, total_slots: int,
 
     grants = []
     budget = prefill_budget
+    # Lane-ordered prefill: every online slot (FIFO) ahead of every
+    # batch slot (FIFO) — a deep batch backlog mid-prefill must never
+    # consume the budget an online prompt's next chunk needs. bool
+    # sorts False < True, so (batch, admit_seq) is exactly that order.
     for v in sorted((v for v in slots if v.prefilling),
-                    key=lambda v: v.admit_seq):
+                    key=lambda v: (v.batch, v.admit_seq)):
         if budget <= 0 or len(grants) >= prefill_batch:
             break
         take = min(v.prompt_remaining, budget)

@@ -2413,6 +2413,301 @@ def run_prefix_share_ab(args):
     return result
 
 
+def _batch_bench_model(args):
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.llama import Llama, llama_tiny
+    cfg = llama_tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, params
+
+
+def run_batch_ab(args):
+    """Batch-tier profile A/B (serve_bench.py --batch-ab): the SAME
+    offline corpus driven through ``BatchInferenceJob`` on an engine
+    configured from each named scheduler profile —
+    ``engine_kwargs_for_profile('latency')`` (shallow online-tuned
+    queue, small decode chunks) vs ``'throughput'`` (deep no-TTFT-SLO
+    queue, big prefill chunks, long decode run-ahead). Greedy
+    sampling, so the arms must be TOKEN-IDENTICAL: a knob preset may
+    only move walltime, never tokens (the artifact REFUSES to exist
+    otherwise — tools/check_bench_schema.py ``batch_ab`` family).
+    Each arm runs an unmeasured warmup job first so jit compiles of
+    its chunk shapes land outside the measured window."""
+    from ray_tpu.serve.batch_tier import (BatchInferenceJob,
+                                          engine_kwargs_for_profile)
+    from ray_tpu.serve.engine import LLMEngine
+
+    cfg, model, params = _batch_bench_model(args)
+    rng = np.random.RandomState(args.seed + 17)
+    prompt_len, gen_tokens, rows = 8, 8, 16
+    corpus = [rng.randint(1, cfg.vocab_size - 1,
+                          size=prompt_len).tolist()
+              for _ in range(rows)]
+    warm = [rng.randint(1, cfg.vocab_size - 1,
+                        size=prompt_len).tolist() for _ in range(2)]
+
+    def run_arm(profile):
+        kw = engine_kwargs_for_profile(profile)
+        eng = LLMEngine(model, params, max_slots=4, page_size=8,
+                        n_pages=64, temperature=0.0, eos_id=-1,
+                        seed=args.seed, **kw).start()
+        try:
+            BatchInferenceJob(eng, warm, max_new_tokens=gen_tokens,
+                              max_in_flight=4, job_id="warmup").run()
+            t0 = time.perf_counter()
+            job = BatchInferenceJob(eng, corpus,
+                                    max_new_tokens=gen_tokens,
+                                    max_in_flight=8,
+                                    job_id=f"ab-{profile}")
+            streams = job.run()
+            wall = time.perf_counter() - t0
+            batch_tokens = eng.stats.get("batch_tokens", 0)
+        finally:
+            eng.shutdown()
+        toks = sum(len(s) for s in streams)
+        return streams, {
+            "profile": profile,
+            "engine_kwargs": dict(kw),
+            "rows": rows,
+            "tokens": toks,
+            "batch_lane_tokens": int(batch_tokens),
+            "wall_s": round(wall, 4),
+            "tokens_per_s": round(toks / wall, 2) if wall else None,
+        }
+
+    print("batch A/B: latency-profile arm", flush=True)
+    lat_streams, lat = run_arm("latency")
+    print("batch A/B: throughput-profile arm", flush=True)
+    thr_streams, thr = run_arm("throughput")
+    identical = lat_streams == thr_streams
+    if not identical:
+        print("WARNING: profile arms diverged token-wise — the "
+              "artifact will fail schema validation", flush=True)
+    return {
+        "batch_ab": {
+            "prompt_len": prompt_len,
+            "gen_tokens": gen_tokens,
+            "latency": lat,
+            "throughput": thr,
+            "token_identical": identical,
+            "tokens_per_s_ratio": _ratio(thr["tokens_per_s"],
+                                         lat["tokens_per_s"]),
+        },
+        "model": "llama-tiny",
+        "notes": "Batch-tier profile A/B (serve_bench.py --batch-ab):"
+                 " one offline corpus through BatchInferenceJob on an"
+                 " engine built from engine_kwargs_for_profile("
+                 "'latency') vs ('throughput'). Greedy arms are gated"
+                 " token-identical — profiles may move walltime only."
+                 " Per-arm warmup jobs keep chunk-shape compiles out"
+                 " of the measured window; tokens_per_s_ratio is the"
+                 " throughput arm over the latency arm.",
+    }
+
+
+def run_mixed_ab(args):
+    """Mixed online+batch A/B with a chaos leg (serve_bench.py
+    --mixed-ab): the SAME paced online trace replayed against (A) an
+    engine serving nothing else — the no-batch baseline — and (B) the
+    same engine while a ``BatchInferenceJob`` soaks every idle slot
+    on ``priority=LANE_BATCH``. The lane contract says colocation is
+    free for the online lane (batch admits behind it and is the first
+    preemption victim), so the artifact REFUSES to exist
+    (tools/check_bench_schema.py ``mixed_ab`` family) when the mixed
+    arm's SLO attainment falls more than the noise floor below the
+    baseline's, when the batch tier absorbed zero tokens, or when the
+    chaos leg violated exactly-once.
+
+    The chaos leg kills the batch driver mid-run (its submit path
+    raises after N rows, with rows committed AND in flight), then
+    resumes from the sha256 manifest: committed rows must never be
+    resubmitted (0 duplicates), every row must land (0 missing —
+    ``run()`` raises otherwise), and the resumed results must be
+    token-identical to the clean baseline batch run."""
+    from ray_tpu.serve.batch_tier import BatchInferenceJob
+    from ray_tpu.serve.engine import LLMEngine
+
+    cfg, model, params = _batch_bench_model(args)
+    rng = np.random.RandomState(args.seed + 29)
+    prompt_len, gen_tokens = 8, 8
+    n_online, online_gap_s = 10, 0.05
+    online = [rng.randint(1, cfg.vocab_size - 1,
+                          size=prompt_len).tolist()
+              for _ in range(n_online)]
+    batch_rows = [rng.randint(1, cfg.vocab_size - 1,
+                              size=prompt_len).tolist()
+                  for _ in range(12)]
+    warm = rng.randint(1, cfg.vocab_size - 1,
+                       size=prompt_len).tolist()
+    slo_s = args.ttft_slo_ms / 1000.0
+    crash_after = 5
+
+    def make_engine():
+        return LLMEngine(model, params, max_slots=2, page_size=8,
+                         n_pages=64, chunk=4, temperature=0.0,
+                         eos_id=-1, seed=args.seed).start()
+
+    def replay_online(eng):
+        handles = []
+        for p in online:
+            handles.append(eng.submit(list(p),
+                                      max_new_tokens=gen_tokens))
+            time.sleep(online_gap_s)
+        streams = [h.result() for h in handles]
+        ttfts = [h.ttft_s for h in handles]
+        return streams, ttfts
+
+    def summarize(ttfts):
+        ms = sorted(t * 1000.0 for t in ttfts)
+        return {
+            "ttft_p50_ms": round(ms[len(ms) // 2], 2),
+            "ttft_p99_ms": round(ms[-1], 2),
+            "slo_attainment": round(
+                sum(1 for t in ttfts if t <= slo_s) / len(ttfts), 4),
+        }
+
+    class _CrashingSubmit:
+        """Batch driver whose submit raises after N rows — the
+        mid-run kill, with committed and in-flight rows behind it."""
+
+        def __init__(self, eng, left):
+            self._eng, self._left = eng, left
+
+        def submit(self, *a, **kw):
+            if self._left <= 0:
+                raise RuntimeError("mixed-ab chaos kill")
+            self._left -= 1
+            return self._eng.submit(*a, **kw)
+
+    class _CountingSubmit:
+        def __init__(self, eng):
+            self._eng = eng
+            self.n = 0
+
+        def submit(self, *a, **kw):
+            self.n += 1
+            return self._eng.submit(*a, **kw)
+
+    import shutil
+    import tempfile
+    ckpt_dir = tempfile.mkdtemp(prefix="mixed_ab_ck_")
+    try:
+        # ---- arm A: online only, plus the clean batch reference
+        print("mixed A/B: no-batch baseline arm", flush=True)
+        eng = make_engine()
+        try:
+            eng.submit(list(warm), max_new_tokens=gen_tokens).result()
+            base_streams, base_ttfts = replay_online(eng)
+            batch_ref = BatchInferenceJob(
+                eng, batch_rows, max_new_tokens=gen_tokens,
+                max_in_flight=4, job_id="mixed-ref").run()
+        finally:
+            eng.shutdown()
+
+        # ---- arm B: same trace over a batch-soaked engine, with the
+        # batch driver killed mid-run and resumed from its manifest
+        print("mixed A/B: batch-soaked arm (chaos kill+resume)",
+              flush=True)
+        eng = make_engine()
+        chaos = {}
+        try:
+            eng.submit(list(warm), max_new_tokens=gen_tokens).result()
+
+            def drive_batch():
+                try:
+                    BatchInferenceJob(
+                        _CrashingSubmit(eng, crash_after), batch_rows,
+                        max_new_tokens=gen_tokens, max_in_flight=4,
+                        checkpoint_dir=ckpt_dir, checkpoint_every=2,
+                        job_id="mixed-chaos").run()
+                except RuntimeError as e:
+                    chaos["kill"] = str(e)
+                from ray_tpu.air.checkpoint import Checkpoint
+                committed = Checkpoint.from_directory(
+                    ckpt_dir).to_dict()["completed"]
+                chaos["committed_at_crash"] = len(committed)
+                target = _CountingSubmit(eng)
+                job = BatchInferenceJob(
+                    target, batch_rows, max_new_tokens=gen_tokens,
+                    max_in_flight=4, checkpoint_dir=ckpt_dir,
+                    checkpoint_every=2, job_id="mixed-chaos")
+                chaos["results"] = job.run()   # raises on missing rows
+                chaos["rows_resumed"] = job.stats["rows_resumed"]
+                chaos["resubmitted"] = target.n
+
+            t = threading.Thread(target=drive_batch, daemon=True)
+            t0 = time.perf_counter()
+            t.start()
+            mixed_streams, mixed_ttfts = replay_online(eng)
+            t.join(timeout=120)
+            mixed_wall = time.perf_counter() - t0
+            if t.is_alive():
+                raise RuntimeError("batch driver wedged in mixed arm")
+            batch_tokens = eng.stats.get("batch_tokens", 0)
+            preempted = eng.stats.get("batch_preemptions", 0)
+        finally:
+            eng.shutdown()
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    dup = chaos["committed_at_crash"] + chaos["resubmitted"] \
+        - len(batch_rows)
+    token_identical = (mixed_streams == base_streams
+                       and chaos["results"] == batch_ref)
+    base = summarize(base_ttfts)
+    mixed = summarize(mixed_ttfts)
+    mixed.update({
+        "batch_tokens": int(batch_tokens),
+        "batch_tokens_per_chip_s": round(
+            batch_tokens / mixed_wall, 2) if mixed_wall else None,
+        "batch_preemptions": int(preempted),
+    })
+    noise_floor = 0.15
+    if not token_identical:
+        print("WARNING: mixed arm diverged token-wise — the artifact "
+              "will fail schema validation", flush=True)
+    if mixed["slo_attainment"] < base["slo_attainment"] - noise_floor:
+        print("WARNING: online attainment sank under batch load — "
+              "the artifact will fail schema validation", flush=True)
+    if dup != 0:
+        print("WARNING: chaos resume duplicated rows — the artifact "
+              "will fail schema validation", flush=True)
+    return {
+        "mixed_ab": {
+            "online_requests": n_online,
+            "gen_tokens": gen_tokens,
+            "ttft_slo_ms": args.ttft_slo_ms,
+            "attainment_noise_floor": noise_floor,
+            "baseline": base,
+            "mixed": mixed,
+            "token_identical": token_identical,
+            "chaos": {
+                "kill": chaos.get("kill"),
+                "batch_rows": len(batch_rows),
+                "crash_after": crash_after,
+                "committed_at_crash": chaos["committed_at_crash"],
+                "rows_resumed": chaos["rows_resumed"],
+                "resubmitted": chaos["resubmitted"],
+                "dup_rows": int(dup),
+                "missing_rows": 0,   # run() raised otherwise
+            },
+        },
+        "model": "llama-tiny",
+        "notes": "Mixed online+batch A/B (serve_bench.py --mixed-ab):"
+                 " one paced online trace replayed against an idle"
+                 " engine (baseline) and the same engine soaked by a"
+                 " LANE_BATCH BatchInferenceJob whose driver is"
+                 " killed mid-run and resumed from its sha256"
+                 " manifest. Gated: online SLO attainment within the"
+                 " noise floor of the baseline, batch tokens absorbed"
+                 " > 0, chaos resume exactly-once (0 dup / 0 missing)"
+                 " and token-identical to the clean batch reference.",
+    }
+
+
 def _ratio(a, b):
     return round(a / b, 2) if b else None
 
@@ -2589,6 +2884,23 @@ def main():
                          "token identity, cross-replica hit rate, "
                          "and TTFT p50 ratio; self-gated by "
                          "tools/check_bench_schema.py")
+    ap.add_argument("--batch-ab", action="store_true",
+                    help="batch-tier profile A/B: one offline corpus "
+                         "through BatchInferenceJob on an engine "
+                         "built from the 'latency' vs 'throughput' "
+                         "scheduler profile — greedy arms gated "
+                         "token-identical; self-gated by "
+                         "tools/check_bench_schema.py")
+    ap.add_argument("--mixed-ab", action="store_true",
+                    help="mixed online+batch A/B: one paced online "
+                         "trace against an idle engine vs the same "
+                         "engine soaked by a LANE_BATCH batch job "
+                         "whose driver is chaos-killed mid-run and "
+                         "resumed from its manifest — gates online "
+                         "attainment within noise of the no-batch "
+                         "arm, batch tokens absorbed, and exactly-"
+                         "once resume (0 dup / 0 missing rows); "
+                         "self-gated by tools/check_bench_schema.py")
     ap.add_argument("--lifecycle", action="store_true",
                     help="request-lifecycle smoke: unsaturated pass "
                          "then an overload burst against --max-queued "
@@ -2787,6 +3099,42 @@ def main():
         # self-gate: a non-token-identical pulled arm, a shared arm
         # with no cross-replica hits, or a missing kv/mesh stamp
         # fails its OWN run
+        from tools import check_bench_schema as cbs
+        problems = []
+        cbs.check_file(out, problems)
+        for p in problems:
+            print(f"SCHEMA FAIL {p}")
+        print(json.dumps(result))
+        ray_tpu.shutdown()
+        if problems:
+            raise SystemExit(1)
+        return
+
+    if args.batch_ab:
+        result = _stamp(run_batch_ab(args), args, replicas=1)
+        out = args.out or "SERVE_BENCH_batch_ab_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        # self-gate: a token-diverging or zero-token profile arm
+        # fails its OWN run
+        from tools import check_bench_schema as cbs
+        problems = []
+        cbs.check_file(out, problems)
+        for p in problems:
+            print(f"SCHEMA FAIL {p}")
+        print(json.dumps(result))
+        ray_tpu.shutdown()
+        if problems:
+            raise SystemExit(1)
+        return
+
+    if args.mixed_ab:
+        result = _stamp(run_mixed_ab(args), args, replicas=1)
+        out = args.out or "SERVE_BENCH_mixed_ab_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        # self-gate: sunk online attainment, an idle batch lane, or a
+        # non-exactly-once chaos resume fails its OWN run
         from tools import check_bench_schema as cbs
         problems = []
         cbs.check_file(out, problems)

@@ -1633,3 +1633,176 @@ def test_prefix_share_ab_requires_arms_and_counters(tmp_path):
     probs = _problems_for("SERVE_BENCH_prefix_share_cpu_smoke.json",
                           no_km, tmp_path)
     assert any("kv_migration counter block" in p for p in probs)
+
+
+def _batch_ab():
+    return {
+        "batch_ab": {
+            "prompt_len": 8, "gen_tokens": 8,
+            "latency": {
+                "profile": "latency",
+                "engine_kwargs": {"chunk": 4, "prefill_chunk": 256,
+                                  "max_run_ahead": 256,
+                                  "max_queued": 2},
+                "rows": 16, "tokens": 128, "batch_lane_tokens": 144,
+                "wall_s": 0.02, "tokens_per_s": 6400.0},
+            "throughput": {
+                "profile": "throughput",
+                "engine_kwargs": {"chunk": 16, "prefill_chunk": 512,
+                                  "max_run_ahead": 512,
+                                  "max_queued": None},
+                "rows": 16, "tokens": 128, "batch_lane_tokens": 144,
+                "wall_s": 0.04, "tokens_per_s": 3200.0},
+            "token_identical": True,
+            "tokens_per_s_ratio": 0.5,
+        },
+        "mesh": {"tp": 1, "replicas": 1},
+        "kv": {"kv_dtype": "fp", "paged_kernel": "gather"},
+        "seed": 0, "git_sha": "abc1234",
+    }
+
+
+def test_batch_ab_artifact_validates(tmp_path):
+    assert _problems_for("SERVE_BENCH_batch_ab_cpu_smoke.json",
+                         _batch_ab(), tmp_path) == []
+
+
+def test_batch_ab_refuses_missing_stamps(tmp_path):
+    no_mesh = _batch_ab()
+    del no_mesh["mesh"]
+    probs = _problems_for("SERVE_BENCH_batch_ab_cpu_smoke.json",
+                          no_mesh, tmp_path)
+    assert any("mesh stamp" in p for p in probs)
+    no_seed = _batch_ab()
+    del no_seed["seed"]
+    probs = _problems_for("SERVE_BENCH_batch_ab_cpu_smoke.json",
+                          no_seed, tmp_path)
+    assert any("seed" in p for p in probs)
+
+
+def test_batch_ab_refuses_token_divergence(tmp_path):
+    bad = _batch_ab()
+    bad["batch_ab"]["token_identical"] = False
+    probs = _problems_for("SERVE_BENCH_batch_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("not token-identical" in p for p in probs)
+
+
+def test_batch_ab_refuses_idle_batch_lane(tmp_path):
+    # a "batch" bench whose requests never rode the batch lane
+    # measured the wrong thing
+    for key in ("tokens", "batch_lane_tokens"):
+        bad = _batch_ab()
+        bad["batch_ab"]["throughput"][key] = 0
+        probs = _problems_for("SERVE_BENCH_batch_ab_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any("never generated on the batch lane" in p
+                   for p in probs), key
+
+
+def test_batch_ab_requires_arms_and_ratio(tmp_path):
+    bad = _batch_ab()
+    del bad["batch_ab"]["latency"]
+    probs = _problems_for("SERVE_BENCH_batch_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("missing latency arm" in p for p in probs)
+    bad = _batch_ab()
+    del bad["batch_ab"]["tokens_per_s_ratio"]
+    probs = _problems_for("SERVE_BENCH_batch_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("tokens_per_s_ratio" in p for p in probs)
+
+
+def _mixed_ab():
+    return {
+        "mixed_ab": {
+            "online_requests": 10, "gen_tokens": 8,
+            "ttft_slo_ms": 1000.0,
+            "attainment_noise_floor": 0.15,
+            "baseline": {"ttft_p50_ms": 3.6, "ttft_p99_ms": 5.6,
+                         "slo_attainment": 1.0},
+            "mixed": {"ttft_p50_ms": 3.7, "ttft_p99_ms": 13.6,
+                      "slo_attainment": 1.0, "batch_tokens": 120,
+                      "batch_tokens_per_chip_s": 218.5,
+                      "batch_preemptions": 0},
+            "token_identical": True,
+            "chaos": {"kill": "chaos kill", "batch_rows": 12,
+                      "crash_after": 5, "committed_at_crash": 2,
+                      "rows_resumed": 2, "resubmitted": 10,
+                      "dup_rows": 0, "missing_rows": 0},
+        },
+        "mesh": {"tp": 1, "replicas": 1},
+        "kv": {"kv_dtype": "fp", "paged_kernel": "gather"},
+        "seed": 0, "git_sha": "abc1234",
+    }
+
+
+def test_mixed_ab_artifact_validates(tmp_path):
+    assert _problems_for("SERVE_BENCH_mixed_ab_cpu_smoke.json",
+                         _mixed_ab(), tmp_path) == []
+
+
+def test_mixed_ab_refuses_sunk_online_attainment(tmp_path):
+    # colocation must be ~free for the online lane: the mixed arm
+    # may not fall more than the noise floor below the baseline
+    bad = _mixed_ab()
+    bad["mixed_ab"]["mixed"]["slo_attainment"] = 0.7
+    probs = _problems_for("SERVE_BENCH_mixed_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("not free for the online lane" in p for p in probs)
+    low_base = _mixed_ab()
+    low_base["mixed_ab"]["baseline"]["slo_attainment"] = 0.4
+    low_base["mixed_ab"]["mixed"]["slo_attainment"] = 0.4
+    probs = _problems_for("SERVE_BENCH_mixed_ab_cpu_smoke.json",
+                          low_base, tmp_path)
+    assert any("gates nothing" in p for p in probs)
+
+
+def test_mixed_ab_refuses_idle_batch_tier(tmp_path):
+    bad = _mixed_ab()
+    bad["mixed_ab"]["mixed"]["batch_tokens"] = 0
+    probs = _problems_for("SERVE_BENCH_mixed_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("absorbed nothing" in p for p in probs)
+
+
+def test_mixed_ab_refuses_exactly_once_violations(tmp_path):
+    for key in ("dup_rows", "missing_rows"):
+        bad = _mixed_ab()
+        bad["mixed_ab"]["chaos"][key] = 1
+        probs = _problems_for("SERVE_BENCH_mixed_ab_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any("exactly-once resume violated" in p
+                   for p in probs), key
+    # the chaos ledger must reconcile: committed + resubmitted
+    # covers every row exactly once
+    bad = _mixed_ab()
+    bad["mixed_ab"]["chaos"]["resubmitted"] = 11
+    probs = _problems_for("SERVE_BENCH_mixed_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("does not reconcile" in p for p in probs)
+
+
+def test_mixed_ab_refuses_unmeasured_chaos_kill(tmp_path):
+    # a kill before the first manifest commit (or after the last)
+    # exercises no resume at all
+    for committed, resub in ((0, 12), (12, 0)):
+        bad = _mixed_ab()
+        bad["mixed_ab"]["chaos"]["committed_at_crash"] = committed
+        bad["mixed_ab"]["chaos"]["resubmitted"] = resub
+        probs = _problems_for("SERVE_BENCH_mixed_ab_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any("measures no resume" in p for p in probs), committed
+
+
+def test_mixed_ab_refuses_token_divergence_and_missing_leg(tmp_path):
+    bad = _mixed_ab()
+    bad["mixed_ab"]["token_identical"] = False
+    probs = _problems_for("SERVE_BENCH_mixed_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("not token-identical" in p for p in probs)
+    bad = _mixed_ab()
+    del bad["mixed_ab"]["chaos"]
+    probs = _problems_for("SERVE_BENCH_mixed_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("chaos" in p for p in probs)

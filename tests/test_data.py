@@ -442,3 +442,83 @@ def test_parquet_parts_share_one_schema(rt, tmp_path):
     schemas = [_pq.read_schema(out + p) for p in parts]
     assert all(s.equals(schemas[0]) for s in schemas[1:]), schemas
     assert "int64" in str(schemas[0].field("a").type)
+
+
+def test_split_oversized_blocks_caps_without_merging(rt):
+    from ray_tpu.data import Dataset
+    ds = Dataset([ray_tpu.put(list(range(10))),
+                  ray_tpu.put([100, 101]),
+                  ray_tpu.put(list(range(200, 207)))])
+    out = ds.split_oversized_blocks(4)
+    _, lens = out._block_lengths()
+    assert max(lens) <= 4
+    # near-equal parts, never merged across source blocks
+    assert out.take_all() == list(range(10)) + [100, 101] + \
+        list(range(200, 207))
+    # conforming blocks pass through by reference, untouched
+    small = Dataset([ray_tpu.put([1, 2]), ray_tpu.put([3])])
+    passed = small.split_oversized_blocks(4)
+    assert passed._block_refs == small._block_refs
+    with pytest.raises(ValueError):
+        ds.split_oversized_blocks(0)
+
+
+def test_split_oversized_blocks_executes_pending_stages(rt):
+    ds = rd.range(9).repartition(1).map(lambda x: x * 2)
+    out = ds.split_oversized_blocks(3)
+    _, lens = out._block_lengths()
+    assert lens == [3, 3, 3]
+    assert out.take_all() == [x * 2 for x in range(9)]
+
+
+def test_materialize_collect_stats_per_stage(rt):
+    ds = rd.range(20).map(lambda x: x + 1).filter(lambda x: x % 2)
+    mat = ds.materialize(collect_stats=True)
+    sd = mat.stats_dict()
+    assert [s["stage"] for s in sd["stages"]] == ["map", "filter"]
+    assert sd["stages"][0]["rows_in"] == 20
+    assert sd["stages"][0]["rows_out"] == 20
+    assert sd["stages"][1]["rows_out"] == 10
+    assert all(s["wall_s"] >= 0 for s in sd["stages"])
+    assert all(s["bytes_out"] > 0 for s in sd["stages"])
+    # the human report folds the same per-stage lines in
+    rep = mat.stats()
+    assert "stage map: 20 -> 20 rows" in rep
+    assert "stage filter: 20 -> 10 rows" in rep
+    # the cheap default path reports no per-stage stats
+    assert rd.range(4).map(lambda x: x).materialize().stats_dict() \
+        is None
+
+
+def test_pipeline_target_max_block_size_guard(rt):
+    pipe = rd.range(12).repartition(2).window(blocks_per_window=1)
+    pipe = pipe.map_batches(
+        lambda b: [x for v in b for x in [v, v]],
+        batch_size=None, target_max_block_size=3)
+    windows = list(pipe.iter_windows())
+    assert len(windows) == 2
+    for w in windows:
+        _, lens = w._block_lengths()
+        assert max(lens) <= 3
+    assert sorted(x for w in windows for x in w.take_all()) == \
+        sorted(x for v in range(12) for x in [v, v])
+
+
+def test_split_carries_stage_stats_through(rt):
+    # the split guard materializes the pending stages itself — the
+    # per-stage report must survive the block-list rebuild or a
+    # downstream stats_dict() reader (the batch tier's per-window
+    # manifests) sees nothing
+    ds = rd.range(9).repartition(1).map(lambda x: x * 2)
+    out = ds.split_oversized_blocks(3, collect_stats=True)
+    sd = out.stats_dict()
+    assert sd is not None
+    assert [s["stage"] for s in sd["stages"]] == ["map"]
+    assert sd["stages"][0]["rows_out"] == 9
+    # the pipeline guard turns stats collection on for its windows
+    pipe = rd.range(6).repartition(1).window(blocks_per_window=1)
+    pipe = pipe.map(lambda x: x + 1, target_max_block_size=2)
+    for w in pipe.iter_windows():
+        wsd = w.stats_dict()
+        assert wsd is not None and \
+            wsd["stages"][0]["stage"] == "map", wsd

@@ -277,6 +277,39 @@ PREFIX_SHARE_ARM_REQUIRED = {
     "tokens": int,
 }
 
+# batch-tier profile A/B artifacts carry one of these per arm
+# (serve_bench.py run_batch_ab): the same offline corpus through
+# BatchInferenceJob on an engine built from each scheduler profile.
+BATCH_AB_ARM_REQUIRED = {
+    "profile": str,
+    "rows": int,
+    "tokens": int,
+    "batch_lane_tokens": int,
+    "wall_s": NUM,
+    "tokens_per_s": NUM,
+}
+
+# mixed online+batch A/B artifacts carry one of these per arm
+# (serve_bench.py run_mixed_ab): the same paced online trace against
+# an idle engine vs one soaked by a LANE_BATCH batch job.
+MIXED_AB_ARM_REQUIRED = {
+    "ttft_p50_ms": NUM,
+    "ttft_p99_ms": NUM,
+    "slo_attainment": NUM,
+}
+
+# the mixed arm's chaos leg: batch driver killed mid-run, resumed
+# from the sha256 manifest — the exactly-once ledger the checker
+# refuses on.
+MIXED_AB_CHAOS_REQUIRED = {
+    "batch_rows": int,
+    "committed_at_crash": int,
+    "rows_resumed": int,
+    "resubmitted": int,
+    "dup_rows": int,
+    "missing_rows": int,
+}
+
 # each arm's kv_migration block: the serve_kv_migration_*_total
 # counters as the pool aggregated them (serve/kv_migration.py)
 KV_MIGRATION_REQUIRED = {
@@ -918,7 +951,166 @@ def check_prefix_share_ab(obj, name, problems):
             "saved nothing on the wire")
 
 
+def check_batch_ab(obj, name, problems):
+    """serve_bench.py --batch-ab artifact: one offline corpus through
+    BatchInferenceJob on an engine built from the 'latency' vs
+    'throughput' scheduler profile. The checker REFUSES artifacts
+    whose greedy arms were not token-identical (a knob preset may
+    move walltime, never tokens), whose arms generated zero tokens or
+    zero batch-lane tokens (a 'batch' bench that never rode the batch
+    lane measured nothing), or without seed/mesh stamps."""
+    _check_mesh(obj, name, problems, required=True)
+    if not isinstance(obj.get("seed"), int) \
+            or isinstance(obj.get("seed"), bool):
+        problems.append(f"{name}: batch A/B artifact missing int "
+                        "'seed'")
+    ab = obj.get("batch_ab")
+    if not isinstance(ab, dict):
+        problems.append(f"{name}: batch_ab must be an object")
+        return
+    for arm in ("latency", "throughput"):
+        sec = ab.get(arm)
+        if not isinstance(sec, dict):
+            problems.append(f"{name}:batch_ab: missing {arm} arm "
+                            "object")
+            continue
+        _check_fields(sec, BATCH_AB_ARM_REQUIRED,
+                      f"{name}:batch_ab:{arm}", problems)
+        for key in ("tokens", "batch_lane_tokens"):
+            v = sec.get(key)
+            if isinstance(v, NUM) and not isinstance(v, bool) \
+                    and v <= 0:
+                problems.append(
+                    f"{name}:batch_ab:{arm}: {key} == 0 — the arm "
+                    "never generated on the batch lane")
+    if ab.get("token_identical") is not True:
+        problems.append(
+            f"{name}: profile arms were not token-identical — a "
+            "scheduler knob preset may move walltime, never greedy "
+            "tokens")
+    ratio = ab.get("tokens_per_s_ratio")
+    if not isinstance(ratio, NUM) or isinstance(ratio, bool):
+        problems.append(f"{name}: batch A/B artifact missing numeric "
+                        "tokens_per_s_ratio")
+
+
+def check_mixed_ab(obj, name, problems):
+    """serve_bench.py --mixed-ab artifact: one paced online trace
+    against an idle engine (baseline) vs the same engine soaked by a
+    LANE_BATCH batch job with a chaos kill+resume leg. The checker
+    REFUSES artifacts whose mixed-arm SLO attainment fell more than
+    the recorded noise floor below the baseline's (colocation must be
+    ~free for the online lane), whose baseline attainment sits below
+    0.5 (an arm that misses most of its own SLO gates nothing),
+    whose batch lane absorbed zero tokens (nothing was colocated),
+    whose chaos leg duplicated or lost rows (dup_rows/missing_rows
+    != 0 — exactly-once violated), whose chaos ledger does not
+    reconcile (committed_at_crash + resubmitted != batch_rows), whose
+    arms were not token-identical to the clean references, or without
+    seed/mesh stamps."""
+    _check_mesh(obj, name, problems, required=True)
+    if not isinstance(obj.get("seed"), int) \
+            or isinstance(obj.get("seed"), bool):
+        problems.append(f"{name}: mixed A/B artifact missing int "
+                        "'seed'")
+    ab = obj.get("mixed_ab")
+    if not isinstance(ab, dict):
+        problems.append(f"{name}: mixed_ab must be an object")
+        return
+    atts = {}
+    for arm in ("baseline", "mixed"):
+        sec = ab.get(arm)
+        if not isinstance(sec, dict):
+            problems.append(f"{name}:mixed_ab: missing {arm} arm "
+                            "object")
+            continue
+        _check_fields(sec, MIXED_AB_ARM_REQUIRED,
+                      f"{name}:mixed_ab:{arm}", problems)
+        a = sec.get("slo_attainment")
+        if isinstance(a, NUM) and not isinstance(a, bool):
+            atts[arm] = a
+    floor = ab.get("attainment_noise_floor")
+    if not isinstance(floor, NUM) or isinstance(floor, bool):
+        problems.append(f"{name}:mixed_ab: missing numeric "
+                        "attainment_noise_floor")
+    elif len(atts) == 2:
+        if atts["baseline"] < 0.5:
+            problems.append(
+                f"{name}:mixed_ab: baseline attainment "
+                f"{atts['baseline']} < 0.5 — an arm missing most of "
+                "its own SLO gates nothing")
+        if atts["mixed"] < atts["baseline"] - floor:
+            problems.append(
+                f"{name}:mixed_ab: mixed-arm attainment "
+                f"{atts['mixed']} fell more than the noise floor "
+                f"{floor} below the baseline's {atts['baseline']} — "
+                "batch colocation is not free for the online lane")
+    mixed = ab.get("mixed")
+    if isinstance(mixed, dict):
+        bt = mixed.get("batch_tokens")
+        if not isinstance(bt, int) or isinstance(bt, bool):
+            problems.append(f"{name}:mixed_ab:mixed: missing int "
+                            "'batch_tokens'")
+        elif bt <= 0:
+            problems.append(
+                f"{name}:mixed_ab: batch_tokens == 0 — the batch "
+                "tier absorbed nothing, so nothing was colocated")
+    if ab.get("token_identical") is not True:
+        problems.append(
+            f"{name}: mixed arms were not token-identical to their "
+            "clean references — lane colocation or resume changed "
+            "greedy tokens")
+    chaos = ab.get("chaos")
+    if not isinstance(chaos, dict):
+        problems.append(f"{name}:mixed_ab: missing the chaos "
+                        "kill+resume leg")
+        return
+    _check_fields(chaos, MIXED_AB_CHAOS_REQUIRED,
+                  f"{name}:mixed_ab:chaos", problems)
+    for key in ("dup_rows", "missing_rows"):
+        v = chaos.get(key)
+        if isinstance(v, NUM) and not isinstance(v, bool) and v != 0:
+            problems.append(
+                f"{name}:mixed_ab:chaos: {key} == {v} — exactly-once "
+                "resume violated")
+    vals = {k: chaos.get(k) for k in ("batch_rows",
+                                      "committed_at_crash",
+                                      "resubmitted")}
+    if all(isinstance(v, int) and not isinstance(v, bool)
+           for v in vals.values()) \
+            and vals["committed_at_crash"] + vals["resubmitted"] \
+            != vals["batch_rows"]:
+        problems.append(
+            f"{name}:mixed_ab:chaos: ledger does not reconcile — "
+            f"committed_at_crash {vals['committed_at_crash']} + "
+            f"resubmitted {vals['resubmitted']} != batch_rows "
+            f"{vals['batch_rows']}")
+    if isinstance(chaos.get("committed_at_crash"), int) \
+            and isinstance(chaos.get("batch_rows"), int) \
+            and not 0 < chaos["committed_at_crash"] \
+            < chaos["batch_rows"]:
+        problems.append(
+            f"{name}:mixed_ab:chaos: committed_at_crash "
+            f"{chaos['committed_at_crash']} must sit strictly inside "
+            f"(0, batch_rows) — a kill before the first commit or "
+            "after the last measures no resume")
+
+
 def check_serve_bench(obj, name, problems):
+    if "batch_ab" in obj:
+        # batch-tier profile A/B family (serve_bench.py --batch-ab)
+        check_batch_ab(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
+    if "mixed_ab" in obj:
+        # mixed online+batch A/B family (serve_bench.py --mixed-ab)
+        check_mixed_ab(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
     if "prefix_share_ab" in obj:
         # fleet-shared prefix cache A/B family (serve_bench.py
         # --prefix-share-ab)
