@@ -78,7 +78,9 @@ from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
 from ray_tpu.serve.faults import EngineFault
 from ray_tpu.serve.prefix_cache import PrefixCache
 from ray_tpu.serve.scheduler import (LANE_BATCH, LANE_ONLINE,
-                                     StepPlan, SlotView, plan_step)
+                                     REPLICA_ROLES, ROLE_UNIFIED,
+                                     StepPlan, SlotView, plan_step,
+                                     role_plan_caps)
 
 _DONE = object()
 
@@ -428,7 +430,8 @@ class LLMEngine:
                  flight_dir: Optional[str] = None,
                  overlap: Optional[bool] = None,
                  kv_dtype: Optional[str] = None,
-                 prefix_digest_max: int = 512):
+                 prefix_digest_max: int = 512,
+                 role: str = ROLE_UNIFIED):
         self.model = model
         self.cfg = model.config
         # Tensor-parallel placement (serve/sharding.py
@@ -466,6 +469,17 @@ class LLMEngine:
         # either raise typed errors (util/envknobs.py).
         from ray_tpu.util.envknobs import resolve_kv_dtype
         self.kv_dtype = resolve_kv_dtype(kv_dtype)
+        # Disaggregation role (serve/scheduler.py REPLICA_ROLES):
+        # selects the planner knob clamps via role_plan_caps and is
+        # stamped into every load_report so routing, autoscaling, and
+        # flight bundles all see the same topology. Mutable on
+        # purpose — EnginePool stamps roles after construction so one
+        # engine factory serves both pools.
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"unknown replica role {role!r}; expected one of "
+                f"{sorted(REPLICA_ROLES)}")
+        self.role = role
         self.page_bytes = kv_pool_page_bytes(self.cfg, page_size,
                                              self.kv_dtype)
         self.alloc = BlockAllocator(n_pages,
@@ -611,6 +625,11 @@ class LLMEngine:
         # would hide a fresh latency regression behind old samples
         self._ttft_ewma: Optional[float] = None
         self._ttft_ewma_alpha = 0.2
+        # exponentially-weighted inter-token gap (online lane only):
+        # the decode pool's autoscaler signal, the latency twin of
+        # the TTFT EWMA above
+        self._itl_ewma: Optional[float] = None
+        self._itl_ewma_alpha = 0.2
         self._decode_fn = self._build_decode()
         self._seed_fn = self._build_seed()
 
@@ -789,6 +808,7 @@ class LLMEngine:
         with self._lock:
             self.ttfts_s.clear()
             self._ttft_ewma = None
+            self._itl_ewma = None
 
     def is_idle(self) -> bool:
         """True when no request is queued, slotted, or trailing in a
@@ -864,6 +884,8 @@ class LLMEngine:
                 "shed_retry_after_s": self.shed_retry_after_s,
                 "shed_total": self.stats.get("shed", 0),
                 "ttft_ewma_s": self._ttft_ewma,
+                "itl_ewma_s": self._itl_ewma,
+                "role": self.role,
                 "draining": self._draining,
                 "stopped": self._stopped,
                 "heartbeat_age_s": time.monotonic() - self._hb,
@@ -909,6 +931,8 @@ class LLMEngine:
                 "shed_retry_after_s": self.shed_retry_after_s,
                 "shed_total": self.stats.get("shed", 0),
                 "ttft_ewma_s": self._ttft_ewma,
+                "itl_ewma_s": self._itl_ewma,
+                "role": self.role,
                 "draining": self._draining,
                 "stopped": self._stopped,
                 "heartbeat_age_s": time.monotonic() - self._hb,
@@ -1389,9 +1413,18 @@ class LLMEngine:
                           pulling=s.pulling,
                           batch=s.req.batch)
                  for i, s in enumerate(self.slots) if s is not None]
+        # Role admission knobs (disaggregation): a prefill replica
+        # never runs ahead past one decode chunk, a decode replica's
+        # prefill lane shrinks to residual-tail size. Read per round
+        # so the pool can re-role a replica between requests.
+        caps = role_plan_caps(self.role, page_size=self.Pg,
+                              decode_chunk=self.K,
+                              prefill_budget=self.PC,
+                              max_run_ahead=self.KMAX)
         return plan_step(views, total_slots=self.S,
-                         prefill_budget=self.PC, decode_chunk=self.K,
-                         max_run_ahead=self.KMAX,
+                         prefill_budget=caps["prefill_budget"],
+                         decode_chunk=self.K,
+                         max_run_ahead=caps["max_run_ahead"],
                          prefill_batch=self._max_prefill_batch,
                          eos_bounded=self.eos_id is not None,
                          spec_enabled=bool(self.spec_len))
@@ -2441,10 +2474,19 @@ class LLMEngine:
             if req.batch:
                 self.stats["batch_tokens"] += n_put
                 _metrics()["batch_tokens"].inc(n_put)
-            if self._obs_enabled and req.t_last_emit is not None:
+            if req.t_last_emit is not None:
                 # mean gap per token over this readback batch
-                obs.phase_metrics()["inter_token"].observe(
-                    max(0.0, _now - req.t_last_emit) / n_put)
+                gap = max(0.0, _now - req.t_last_emit) / n_put
+                if self._obs_enabled:
+                    obs.phase_metrics()["inter_token"].observe(gap)
+                if not req.batch:
+                    # online lane only, like the TTFT EWMA: batch
+                    # streams run at whatever cadence the backlog
+                    # allows and would drown the decode pool's
+                    # latency signal
+                    a = self._itl_ewma_alpha
+                    self._itl_ewma = gap if self._itl_ewma is None \
+                        else a * gap + (1 - a) * self._itl_ewma
             req.t_last_emit = _now
         if done:
             req.closed = True

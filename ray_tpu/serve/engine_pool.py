@@ -70,7 +70,9 @@ from ray_tpu.serve.errors import (DeadlineExceeded, EngineDraining,
 from ray_tpu.serve.fleet.routing import (Candidate, ResubmitPolicy,
                                          select_candidate)
 from ray_tpu.serve.prefix_cache import path_hashes
-from ray_tpu.serve.scheduler import LANE_BATCH, LANE_ONLINE
+from ray_tpu.serve.scheduler import (LANE_BATCH, LANE_ONLINE,
+                                     REPLICA_ROLES, ROLE_DECODE,
+                                     ROLE_PREFILL, ROLE_UNIFIED)
 
 ROUTED = "serve_pool_routed_total"
 AFFINITY_HITS = "serve_pool_affinity_hits_total"
@@ -88,6 +90,15 @@ CAPACITY_HINT_ERRORS = "serve_pool_capacity_hint_errors_total"
 SUSPECTS = "serve_pool_suspect_total"
 WEDGED = "serve_pool_wedged_total"
 WEDGE_LATENCY = "serve_pool_wedge_detect_latency_s"
+DISAGG_HANDOFFS = "serve_disagg_handoffs_total"
+DISAGG_FALLBACKS = "serve_disagg_handoff_fallbacks_total"
+
+# Role sets the disaggregated router selects over: new prompts land
+# on the prefill side, handed-off streams on the decode side. UNIFIED
+# replicas serve both — they are the bridge that keeps a half-rolled
+# (or degraded) disaggregated pool available.
+_PREFILL_SIDE = (ROLE_PREFILL, ROLE_UNIFIED)
+_DECODE_SIDE = (ROLE_DECODE, ROLE_UNIFIED)
 
 _METRICS: Optional[dict] = None
 
@@ -150,6 +161,12 @@ def _metrics() -> dict:
                 "to the WEDGED declaration",
                 boundaries=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                             10.0, 30.0)),
+            "disagg_handoffs": metrics.Counter(
+                DISAGG_HANDOFFS, "Prefill->decode stream handoffs "
+                "submitted over the KV-migration path"),
+            "disagg_fallbacks": metrics.Counter(
+                DISAGG_FALLBACKS, "Handoffs aborted typed and fallen "
+                "back to decoding in place"),
         }
     return _METRICS
 
@@ -179,15 +196,18 @@ class _Replica:
     ``generation`` counts factory rebuilds (drain restarts + failure
     restarts) so tests can assert a replica was actually replaced."""
 
-    __slots__ = ("idx", "engine", "state", "deaths", "generation")
+    __slots__ = ("idx", "engine", "state", "deaths", "generation",
+                 "role")
 
     def __init__(self, idx: int, engine, state: str = HEALTHY,
-                 deaths: int = 0, generation: int = 0):
+                 deaths: int = 0, generation: int = 0,
+                 role: str = ROLE_UNIFIED):
         self.idx = idx
         self.engine = engine
         self.state = state
         self.deaths = deaths
         self.generation = generation
+        self.role = role
 
 
 class PoolRequestHandle(ResubmitPolicy):
@@ -214,12 +234,20 @@ class PoolRequestHandle(ResubmitPolicy):
         self._priority = priority
         self._rep: Optional[_Replica] = None
         self._inner = None
+        # Disaggregated two-leg service (set by the pool at submit
+        # when the request was split): leg 1 streams ONE bridging
+        # token from the prefill pool, leg 2 resumes the stream on a
+        # decode replica over the KV-migration handoff path.
+        self._disagg = False
 
     # ------------------------------------------------------- consuming
 
     def stream(self):
         """Yield generated token ids; recover across replica deaths
         while the at-most-once guard allows (zero tokens delivered)."""
+        if self._disagg:
+            yield from self._stream_disagg()
+            return
         while True:
             rep, inner = self._rep, self._inner
             try:
@@ -250,6 +278,164 @@ class PoolRequestHandle(ResubmitPolicy):
                     raise self._partial_stream_error(
                         str(rep.idx), e) from e
                 self._resubmit(e)      # raises typed when impossible
+
+    def _stream_disagg(self):
+        """Two-leg disaggregated stream. Leg 1 (already submitted by
+        the pool): one bridging token on the prefill side — the
+        engine retires the slot after it, publishing the prompt's
+        full KV pages into the donor's prefix cache. Leg 2: the rest
+        of the stream on the decode side, admitted with a
+        finished-prefill push hint so its KV lands over
+        ``kv_migration.pull_prefix`` (mid-offset resume at full
+        prompt length) instead of recomputing. Greedy fp32 decoding
+        is deterministic, so the stitched stream is token-identical
+        to single-replica service.
+
+        Failure contract (the tentpole's "cost time, never
+        correctness"): every way leg 2 can fail BEFORE its first
+        token is one typed abort that falls back to decoding in
+        place on the prefill replica (then, if the donor itself is
+        gone, to any healthy replica via plain prefill). After leg 2
+        streams, a death fails typed exactly like the base loop —
+        per-leg at-most-once."""
+        pool = self._pool
+        first: Optional[int] = None
+        # ---- leg 1: bridging token from the prefill pool
+        while True:
+            rep, inner = self._rep, self._inner
+            try:
+                for tok in inner.stream():
+                    self._note_token(tok)
+                    first = tok
+                break
+            except GeneratorExit:
+                raise
+            except (RequestCancelled, DeadlineExceeded,
+                    EngineOverloaded, EngineDraining) as e:
+                self._fail(e)
+                raise
+            except BaseException as e:
+                if not pool._note_replica_death(rep):
+                    self._fail(e)
+                    raise
+                if first is not None:
+                    break     # token landed; only the donor is gone
+                if self._cancelled:
+                    raise self._partial_stream_error(
+                        str(rep.idx), e) from e
+                deadline = self._check_resubmit(e)
+                pool._count_requeue(trace_id=self._trace_id)
+                try:
+                    self._rep, self._inner = pool._submit_leg(
+                        self._prompt, 1, deadline, None,
+                        trace_id=self._trace_id, roles=_PREFILL_SIDE,
+                        fallback_any=True)
+                except BaseException as e2:
+                    self._fail(e2)
+                    raise
+        if first is None:
+            # engine contract: a non-failing stream emits >= 1 token
+            err = EngineShutdown(
+                "prefill leg closed without a token")
+            self._fail(err)
+            raise err
+        yield first
+        if self._mnt <= 1 or self._cancelled:
+            self._finished = True
+            return
+        # ---- handoff: decode leg resumes at full prompt length
+        donor = self._rep
+        prompt2 = self._prompt + [first]
+        mnt2 = self._mnt - 1
+        self._rep = self._inner = None
+        self._hand_off(donor, prompt2, mnt2)
+        # ---- leg 2: stream on the decode side
+        leg2_tokens = 0
+        while True:
+            rep, inner = self._rep, self._inner
+            try:
+                for tok in inner.stream():
+                    if leg2_tokens == 0:
+                        pool._note_handoff_first_token(
+                            rep, trace_id=self._trace_id)
+                    leg2_tokens += 1
+                    self._note_token(tok)
+                    yield tok
+                self._finished = True
+                return
+            except GeneratorExit:
+                raise
+            except (RequestCancelled, DeadlineExceeded,
+                    EngineOverloaded, EngineDraining) as e:
+                self._fail(e)
+                raise
+            except BaseException as e:
+                if not pool._note_replica_death(rep):
+                    self._fail(e)
+                    raise
+                if leg2_tokens or self._cancelled:
+                    raise self._partial_stream_error(
+                        str(rep.idx), e) from e
+                self._check_resubmit(e)
+                pool._count_requeue(trace_id=self._trace_id)
+                self._hand_off(donor, prompt2, mnt2)
+
+    def _hand_off(self, donor: Optional[_Replica],
+                  prompt2: List[int], mnt2: int) -> None:
+        """Submit the decode leg: decode-side route with the
+        finished-prefill push hint, then the typed-abort fallback
+        ladder — decode in place on the donor, then any healthy
+        replica (plain prefill). Raises typed only when no replica
+        at all can take the stream."""
+        pool = self._pool
+        deadline = self._remaining_deadline(None) \
+            if self._deadline_s is not None else None
+        donor_live = (donor is not None
+                      and not getattr(donor.engine, "_stopped", True))
+        hint = None
+        if donor_live:
+            hint = kv_migration.prefill_push_hint(
+                self._prompt, getattr(donor.engine, "Pg", 0),
+                replica_idx=donor.idx)
+        try:
+            self._rep, self._inner = pool._submit_leg(
+                prompt2, mnt2, deadline, self._session_id,
+                trace_id=self._trace_id, roles=_DECODE_SIDE,
+                pull=hint,
+                exclude={donor.idx} if donor_live else None)
+            pool._note_handoff(donor, self._rep,
+                               trace_id=self._trace_id)
+            return
+        except (RequestCancelled, DeadlineExceeded) as e:
+            self._fail(e)
+            raise
+        except BaseException as e:
+            cause = e
+        # Typed abort -> decode in place on the prefill replica: its
+        # prefix cache already holds the prompt's pages, so this is a
+        # local-hit residual prefill, not a recompute.
+        pool._note_handoff_fallback(donor, cause,
+                                    trace_id=self._trace_id)
+        if donor_live:
+            try:
+                self._rep, self._inner = pool._submit_once(
+                    prompt2, mnt2, deadline, None,
+                    trace_id=self._trace_id, target_idx=donor.idx,
+                    record_sticky=False)
+                return
+            except (RequestCancelled, DeadlineExceeded) as e:
+                self._fail(e)
+                raise
+            except BaseException:
+                pass          # donor died under us: last rung below
+        # Donor gone too: any healthy replica, plain prefill.
+        try:
+            self._rep, self._inner = pool._submit_once(
+                prompt2, mnt2, deadline, self._session_id,
+                trace_id=self._trace_id)
+        except BaseException as e:
+            self._fail(e)
+            raise
 
     # ------------------------------------------------------- lifecycle
 
@@ -325,10 +511,38 @@ class EnginePool:
                  restart_backoff_max_s: float = 5.0,
                  max_restarts: Optional[int] = 5,
                  share_prefixes: bool = False,
+                 roles: Optional[Sequence[str]] = None,
+                 kv_pull_deadline_s: Optional[float] = None,
+                 kv_pull_backoff_s: Optional[float] = None,
                  seed: int = 0):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
+        if roles is None:
+            roles = [ROLE_UNIFIED] * num_replicas
+        else:
+            roles = list(roles)
+            if len(roles) != num_replicas:
+                raise ValueError(
+                    f"roles must name every replica: got "
+                    f"{len(roles)} roles for {num_replicas} replicas")
+            for role in roles:
+                if role not in REPLICA_ROLES:
+                    raise ValueError(
+                        f"unknown replica role {role!r}; expected "
+                        f"one of {sorted(REPLICA_ROLES)}")
+            if (any(r != ROLE_UNIFIED for r in roles)
+                    and not share_prefixes):
+                # the handoff path IS the share_prefixes KV wiring;
+                # a disaggregated pool without it would re-prefill
+                # every handed-off stream from scratch
+                raise ValueError(
+                    "role-disaggregated pools require "
+                    "share_prefixes=True (the KV handoff path)")
         self._factory = engine_factory
+        # Requester-side KV pull knob overrides (None = pull_prefix
+        # defaults), validated typed here at construction
+        self._kv_pull_knobs = kv_migration.validate_pull_knobs(
+            kv_pull_deadline_s, kv_pull_backoff_s)
         self._lock = threading.Lock()
         self._rng = random.Random(seed)
         self._auto_restart = auto_restart
@@ -366,13 +580,30 @@ class EnginePool:
         # transfer contract.
         self._share_prefixes = bool(share_prefixes)
         self._kv_donors: Dict[int, kv_migration.KVDonor] = {}
+        # role -> RolePoolView, registered by the views themselves:
+        # per-role autoscaler attachment points + pool_stats blocks
+        self._role_views: Dict[str, Any] = {}
         self._replicas: List[_Replica] = []
         for i in range(num_replicas):
             eng = engine_factory(i)
+            self._stamp_role(eng, roles[i])
             eng.start()
-            rep = _Replica(i, eng)
+            rep = _Replica(i, eng, role=roles[i])
             self._replicas.append(rep)
             self._wire_kv(rep)
+
+    @staticmethod
+    def _stamp_role(engine, role: str) -> None:
+        """Stamp a replica's role onto its engine AFTER the factory
+        built it — one ``f(idx)`` factory serves both pools, and the
+        role only steers dynamic decisions (planner caps via
+        ``role_plan_caps``, load_report stamp). Engines without the
+        attribute (test fakes) are left alone: routing treats a
+        missing role as unified."""
+        try:
+            engine.role = role
+        except Exception:
+            pass
 
     # --------------------------------------------------------- public
 
@@ -407,6 +638,25 @@ class EnginePool:
         with self._lock:
             return any(r.state == DEGRADED for r in self._replicas)
 
+    def disaggregated(self) -> bool:
+        """True while a healthy prefill-role replica exists — the
+        condition under which new online requests split into the
+        two-leg prefill -> decode service. Recomputed per submit on
+        purpose: when the last prefill replica dies, the pool
+        degrades to unified service instead of stranding traffic."""
+        with self._lock:
+            return any(r.role == ROLE_PREFILL and r.state == HEALTHY
+                       for r in self._replicas)
+
+    def role_counts(self) -> Dict[str, int]:
+        """Active (non-retired) replica count per role."""
+        out: Dict[str, int] = collections.Counter()
+        with self._lock:
+            for r in self._replicas:
+                if r.state != RETIRED:
+                    out[r.role] += 1
+        return dict(out)
+
     def submit(self, prompt_ids: Sequence[int],
                max_new_tokens: int = 64,
                deadline_s: Optional[float] = None,
@@ -434,12 +684,89 @@ class EnginePool:
         handle = PoolRequestHandle(self, prompt, max_new_tokens,
                                    deadline_s, session_id, trace_id,
                                    priority=priority)
+        if (priority == LANE_ONLINE and max_new_tokens > 1
+                and self.disaggregated()):
+            # Two-leg disaggregated service: leg 1 takes ONE token
+            # on the prefill side (session stickiness deliberately
+            # unused — a sticky entry must never pin a session to a
+            # prefill replica). If the prefill side cannot admit at
+            # all, serve unified below — disaggregation degrades,
+            # availability doesn't.
+            try:
+                rep, inner = self._submit_once(
+                    prompt, 1, deadline_s, None, trace_id=trace_id,
+                    roles=_PREFILL_SIDE)
+                handle._disagg = True
+                handle._attach(rep, inner)
+                return handle
+            except (EngineShutdown, PoolDegraded):
+                pass
         rep, inner = self._submit_once(prompt, max_new_tokens,
                                        deadline_s, session_id,
                                        trace_id=trace_id,
                                        priority=priority)
         handle._attach(rep, inner)
         return handle
+
+    def _submit_leg(self, prompt: List[int], max_new_tokens: int,
+                    deadline_s: Optional[float],
+                    session_id: Optional[str], *,
+                    trace_id: Optional[str] = None,
+                    roles: Optional[Sequence[str]] = None,
+                    pull: Optional[Dict[str, Any]] = None,
+                    exclude: Optional[set] = None,
+                    fallback_any: bool = False):
+        """One leg of a disaggregated request: a role-filtered
+        ``_submit_once``, optionally degrading to an unrestricted
+        route when the whole role side is gone (leg-1 resubmits —
+        a dead prefill pool must not strand a request a decode
+        replica could still serve, slowly, via plain prefill)."""
+        try:
+            return self._submit_once(prompt, max_new_tokens,
+                                     deadline_s, session_id,
+                                     trace_id=trace_id, roles=roles,
+                                     pull=pull, exclude=exclude)
+        except (EngineShutdown, PoolDegraded):
+            if not fallback_any:
+                raise
+            return self._submit_once(prompt, max_new_tokens,
+                                     deadline_s, session_id,
+                                     trace_id=trace_id)
+
+    # -------------------------------------------- handoff bookkeeping
+
+    def _note_handoff(self, donor: Optional[_Replica],
+                      target: _Replica,
+                      trace_id: Optional[str] = None) -> None:
+        with self._lock:
+            self.route_stats["disagg_handoffs"] += 1
+        self.events.append(
+            "handoff", sid=target.idx,
+            data={"from": donor.idx if donor is not None else None,
+                  "to": target.idx, "trace_id": trace_id})
+        _metrics()["disagg_handoffs"].inc()
+
+    def _note_handoff_first_token(self, target: _Replica,
+                                  trace_id: Optional[str] = None
+                                  ) -> None:
+        """First decode token on the new replica — the closing edge
+        of the handoff-latency interval tools/trace_report.py
+        derives (prefill-done is the ``handoff`` event above)."""
+        self.events.append("handoff_first_token", sid=target.idx,
+                           data={"to": target.idx,
+                                 "trace_id": trace_id})
+
+    def _note_handoff_fallback(self, donor: Optional[_Replica],
+                               cause: BaseException,
+                               trace_id: Optional[str] = None
+                               ) -> None:
+        with self._lock:
+            self.route_stats["disagg_handoff_fallbacks"] += 1
+        self.events.append(
+            "handoff_fallback",
+            sid=donor.idx if donor is not None else None,
+            data={"error": repr(cause), "trace_id": trace_id})
+        _metrics()["disagg_fallbacks"].inc()
 
     def shutdown(self) -> None:
         """Stop every replica; queued/in-flight requests fail typed
@@ -492,22 +819,31 @@ class EnginePool:
 
     # -------------------------------------------------------- scaling
 
-    def add_replica(self) -> int:
+    def add_replica(self, role: str = ROLE_UNIFIED) -> int:
         """Scale up by one: build a fresh engine from the factory,
         reusing a retired slot index when one exists (its generation
-        bumps) or appending a new one. Returns the replica index."""
+        bumps) or appending a new one. ``role`` places the new
+        capacity in a disaggregated pool's prefill or decode side
+        (default unified). Returns the replica index."""
         if self._stopped:
             raise EngineShutdown("engine pool stopped")
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"unknown replica role {role!r}; expected one of "
+                f"{sorted(REPLICA_ROLES)}")
         with self._lock:
             retired = [r for r in self._replicas
                        if r.state == RETIRED]
             idx = retired[0].idx if retired else len(self._replicas)
+            if retired:
+                retired[0].role = role  # _rebuild carries it over
         if retired:
             self._rebuild(idx)
         else:
             eng = self._factory(idx)
+            self._stamp_role(eng, role)
             eng.start()
-            rep = _Replica(idx, eng)
+            rep = _Replica(idx, eng, role=role)
             with self._lock:
                 self._replicas.append(rep)
             self._wire_kv(rep)
@@ -535,14 +871,17 @@ class EnginePool:
             self.route_stats["replicas_retired"] += 1
         return clean
 
-    def scale_down(self, n: int = 1,
-                   timeout_s: float = 30.0) -> List[int]:
+    def scale_down(self, n: int = 1, timeout_s: float = 30.0,
+                   role: Optional[str] = None) -> List[int]:
         """Retire the ``n`` least-loaded healthy replicas (by
-        outstanding tokens), never going below one healthy replica.
-        Returns the retired indices."""
+        outstanding tokens), never going below one healthy replica —
+        per ROLE when ``role`` is given (a per-role autoscaler must
+        never retire its side's last replica, even when the other
+        side has plenty). Returns the retired indices."""
         with self._lock:
             candidates = [r for r in self._replicas
-                          if r.state == HEALTHY]
+                          if r.state == HEALTHY
+                          and (role is None or r.role == role)]
         n = min(n, len(candidates) - 1)
         if n <= 0:
             return []
@@ -599,11 +938,12 @@ class EnginePool:
     def _rebuild(self, idx: int) -> None:
         old = self._replicas[idx]
         eng = self._factory(idx)
+        self._stamp_role(eng, old.role)
         eng.start()
         with self._lock:
             self._replicas[idx] = _Replica(
                 idx, eng, HEALTHY, deaths=old.deaths,
-                generation=old.generation + 1)
+                generation=old.generation + 1, role=old.role)
             self.route_stats["restarts"] += 1
         self._wire_kv(self._replicas[idx])
         self.events.append("restart", sid=idx,
@@ -803,7 +1143,8 @@ class EnginePool:
             return kv_migration.pull_prefix(
                 kv_migration.loopback_call(donor),
                 pull.get("hashes") or [],
-                stats=requester_engine.kv_migration_stats)
+                stats=requester_engine.kv_migration_stats,
+                **self._kv_pull_knobs)
         except Exception:
             return None
 
@@ -857,17 +1198,36 @@ class EnginePool:
                      deadline_s: Optional[float],
                      session_id: Optional[str],
                      trace_id: Optional[str] = None,
-                     priority: str = LANE_ONLINE):
+                     priority: str = LANE_ONLINE,
+                     roles: Optional[Sequence[str]] = None,
+                     pull: Optional[Dict[str, Any]] = None,
+                     exclude: Optional[set] = None,
+                     target_idx: Optional[int] = None,
+                     record_sticky: bool = True):
         """Route + submit until one replica accepts. Replicas that
         shed/die/drain between the snapshot and the submit are
         excluded and routing retries; when nothing accepts, the
-        failure is typed and aggregated (module docstring)."""
+        failure is typed and aggregated (module docstring).
+
+        Disaggregation extras: ``roles`` restricts routing to those
+        replica roles; ``pull`` attaches an explicit KV pull hint
+        (the finished-prefill push hint) overriding the routed one;
+        ``target_idx`` bypasses routing entirely and submits to ONE
+        named healthy replica (the decode-in-place fallback);
+        ``record_sticky=False`` keeps a route from writing session
+        placement state."""
         batch = priority == LANE_BATCH
-        exclude: set = set()
+        exclude = set(exclude) if exclude else set()
         shed: List[EngineOverloaded] = []
         while True:
-            rep, decision = self._route(prompt, session_id, exclude,
-                                        batch=batch)
+            if target_idx is not None:
+                rep, decision = self._route_direct(target_idx)
+            else:
+                rep, decision = self._route(prompt, session_id,
+                                            exclude, batch=batch,
+                                            roles=roles)
+            if rep is not None and pull is not None:
+                decision = dict(decision, pull=pull)
             if rep is None:
                 hints = decision.get("hints", [])
                 hints += [e.retry_after_s for e in shed]
@@ -928,20 +1288,41 @@ class EnginePool:
                     kw["priority"] = priority
                 inner = rep.engine.submit(prompt, **kw)
             except EngineOverloaded as e:
+                if target_idx is not None:
+                    raise       # the named target shed: no retry loop
                 shed.append(e)
                 exclude.add(rep.idx)
                 continue
-            except (EngineShutdown, EngineDraining):
+            except (EngineShutdown, EngineDraining) as e:
                 # raced a death/drain after the snapshot
                 self._note_replica_death(rep)
+                if target_idx is not None:
+                    raise
                 exclude.add(rep.idx)
                 continue
-            self._record_route(rep, decision, session_id,
+            self._record_route(rep, decision,
+                               session_id if record_sticky else None,
                                trace_id=trace_id)
             return rep, inner
 
+    def _route_direct(self, idx: int):
+        """Directly target replica ``idx`` (decode-in-place
+        fallback): no routing policy, no sticky write — just a
+        health check shaped like a route decision."""
+        with self._lock:
+            rep = (self._replicas[idx]
+                   if 0 <= idx < len(self._replicas) else None)
+            if rep is None or rep.state != HEALTHY:
+                rep = None
+        if rep is None:
+            raise EngineShutdown(
+                f"replica {idx} is not healthy; cannot decode in "
+                f"place")
+        return rep, {"kind": "direct", "pages": 0}
+
     def _route(self, prompt: List[int], session_id: Optional[str],
-               exclude: set, *, batch: bool = False):
+               exclude: set, *, batch: bool = False,
+               roles: Optional[Sequence[str]] = None):
         """Pick a replica (or ``(None, {"hints": [...]})`` when none
         can admit). Lock discipline: the replica table is read under
         the pool lock; ``load_report()`` calls happen OUTSIDE it (they
@@ -951,12 +1332,29 @@ class EnginePool:
         entirely: the batch lane routes to the replica with the least
         batch backlog (ties on outstanding tokens), reads — never
         writes — placement state, and respects each replica's
-        ``max_queued_batch`` bound."""
+        ``max_queued_batch`` bound. Batch never lands on a
+        prefill-only replica: backlog spills only into the
+        decode/unified pool, whose admission knobs can actually run
+        long decode streams.
+
+        ``roles`` (disaggregation) restricts candidates to those
+        replica roles."""
         with self._lock:
             reps = [r for r in self._replicas
-                    if r.state == HEALTHY and r.idx not in exclude]
+                    if r.state == HEALTHY and r.idx not in exclude
+                    and (roles is None or r.role in roles)
+                    and not (batch and r.role == ROLE_PREFILL)]
             sticky_idx = (self._sticky.get(session_id)
                           if session_id is not None else None)
+            if sticky_idx is not None:
+                srep = (self._replicas[sticky_idx]
+                        if sticky_idx < len(self._replicas) else None)
+                if srep is not None and srep.role == ROLE_PREFILL:
+                    # A sticky entry must never pin a session to a
+                    # prefill-only replica (e.g. written before the
+                    # replica was re-roled): drop it, don't follow it.
+                    del self._sticky[session_id]
+                    sticky_idx = None
         if not reps:
             return None, {"hints": []}
         reports = {r.idx: r.engine.load_report() for r in reps}
@@ -1090,18 +1488,36 @@ class EnginePool:
             out.extend(rep.engine.ttfts_s)
         return out
 
-    def load_reports(self) -> Dict[int, Dict[str, Any]]:
-        return {r.idx: r.engine.load_report()
-                for r in self._replicas
-                if r.state in (HEALTHY, DRAINING)}
+    def load_reports(self, role: Optional[str] = None
+                     ) -> Dict[int, Dict[str, Any]]:
+        with self._lock:
+            reps = [r for r in self._replicas
+                    if r.state in (HEALTHY, DRAINING)
+                    and (role is None or r.role == role)]
+        return {r.idx: r.engine.load_report() for r in reps}
 
-    def load_report(self) -> Dict[str, Any]:
+    def load_report(self, role: Optional[str] = None
+                    ) -> Dict[str, Any]:
         """Pool-aggregate load snapshot (the single-engine
         ``load_report`` surface, summed over live replicas — what the
         serve controller's replica table stores for cross-replica
         routing hints). No digest: prefix affinity is an intra-pool
-        decision; the deployment-level router only needs pressure."""
-        reports = list(self.load_reports().values())
+        decision; the deployment-level router only needs pressure.
+        ``role`` restricts the aggregate to one disaggregated side —
+        the view a per-role autoscaler senses."""
+        reports = list(self.load_reports(role).values())
+        with self._lock:
+            n = sum(1 for r in self._replicas
+                    if role is None or r.role == role)
+            active = sum(1 for r in self._replicas
+                         if r.state != RETIRED
+                         and (role is None or r.role == role))
+            healthy = sum(1 for r in self._replicas
+                          if r.state == HEALTHY
+                          and (role is None or r.role == role))
+            role_counts: Dict[str, int] = collections.Counter(
+                r.role for r in self._replicas
+                if r.state != RETIRED)
         agg = {"free_slots": 0, "free_pages": 0, "queue_depth": 0,
                "queue_depth_batch": 0,
                "outstanding_tokens": 0, "draining": False,
@@ -1109,9 +1525,12 @@ class EnginePool:
                "shed_retry_after_s": 1.0,
                "total_slots": 0, "shed_total": 0,
                "ttft_ewma_s": None,
-               "n_replicas": len(self._replicas),
-               "active_replicas": self.active_count(),
-               "healthy_replicas": self.healthy_count(),
+               "itl_ewma_s": None,
+               "role": role if role is not None else ROLE_UNIFIED,
+               "roles": dict(role_counts),
+               "n_replicas": n,
+               "active_replicas": active,
+               "healthy_replicas": healthy,
                # 2-D scale-out stamp: tp devices per replica x
                # n_replicas slices — uniform across a pool (replicas
                # are interchangeable), so the max IS the value
@@ -1135,6 +1554,10 @@ class EnginePool:
             if ewma is not None:
                 agg["ttft_ewma_s"] = ewma if agg["ttft_ewma_s"] \
                     is None else max(agg["ttft_ewma_s"], ewma)
+            itl = rpt.get("itl_ewma_s")
+            if itl is not None:
+                agg["itl_ewma_s"] = itl if agg["itl_ewma_s"] \
+                    is None else max(agg["itl_ewma_s"], itl)
         return agg
 
     def pool_stats(self) -> Dict[str, Any]:
@@ -1144,8 +1567,10 @@ class EnginePool:
             counters = dict(self.route_stats)
             reps = [{"idx": r.idx, "state": r.state,
                      "deaths": r.deaths,
-                     "generation": r.generation}
+                     "generation": r.generation,
+                     "role": r.role}
                     for r in self._replicas]
+            role_views = dict(self._role_views)
         routed = counters.get("routed", 0)
         counters["affinity_hit_rate"] = round(
             counters.get("affinity_hits", 0) / routed, 4) \
@@ -1159,6 +1584,8 @@ class EnginePool:
             1 for r in reps if r["state"] == SUSPECT)
         counters["degraded"] = any(
             r["state"] == DEGRADED for r in reps)
+        counters["roles"] = dict(collections.Counter(
+            r["role"] for r in reps if r["state"] != RETIRED))
         counters["replicas"] = reps
         kv = self.kv_migration_stats()
         if kv is not None:
@@ -1166,6 +1593,15 @@ class EnginePool:
         scaler = self._autoscaler
         if scaler is not None:
             counters["autoscale"] = scaler.stats()
+        # per-role autoscalers (disaggregation): one block per side,
+        # so both roles' scale decisions are visible in one snapshot
+        by_role = {}
+        for role, view in role_views.items():
+            vs = getattr(view, "_autoscaler", None)
+            if vs is not None:
+                by_role[role] = vs.stats()
+        if by_role:
+            counters["autoscale_by_role"] = by_role
         wd = self._watchdog
         if wd is not None:
             counters["watchdog"] = wd.stats()
@@ -1219,3 +1655,101 @@ class EnginePool:
             if per:
                 out[knob] = per[0].get(knob)
         return out
+
+    def _role_capacity_eta_s(self) -> float:
+        """Max in-flight provisioning ETA over the per-role
+        autoscalers — the pool-wide ``capacity_hint_fn`` when role
+        views are attached (either side's provisioning capacity can
+        end an all-shed)."""
+        eta = 0.0
+        for view in list(self._role_views.values()):
+            scaler = getattr(view, "_autoscaler", None)
+            if scaler is None:
+                continue
+            try:
+                eta = max(eta, float(scaler.capacity_eta_s()))
+            except Exception:
+                _metrics()["capacity_hint_errors"].inc()
+        return eta
+
+
+class _RoleEventLog:
+    """Event seam a RolePoolView hands its autoscaler: appends land
+    in the POOL's ring with the view's role injected into the data,
+    so both sides' scale decisions interleave in one log and stay
+    attributable."""
+
+    def __init__(self, log: obs.EventLog, role: str):
+        self._log = log
+        self._role = role
+
+    def append(self, etype: str, rid: Any = None, sid: Any = None,
+               data: Any = None, t: Optional[float] = None) -> None:
+        d = dict(data) if isinstance(data, dict) else (
+            {"data": data} if data is not None else {})
+        d["role"] = self._role
+        self._log.append(etype, rid=rid, sid=sid, data=d, t=t)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._log, name)
+
+
+class RolePoolView:
+    """One disaggregated side of an EnginePool, shaped like a pool.
+
+    ``PoolAutoscaler`` attaches to whatever it is given — ctor
+    side-effects (``pool._autoscaler``, ``pool.capacity_hint_fn``)
+    included — so two per-role scalers pointed at the SAME pool would
+    clobber each other. Each scaler instead gets a view: load_report
+    and counts filter to the role, ``add_replica``/``scale_down``
+    scale only this side, events are tagged with the role, and the
+    view registers itself on the pool so ``pool_stats`` shows both
+    sides' decisions (``autoscale_by_role``) and the pool's own
+    capacity hint becomes the max over the attached scalers' ETAs."""
+
+    def __init__(self, pool: EnginePool, role: str):
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"unknown replica role {role!r}; expected one of "
+                f"{sorted(REPLICA_ROLES)}")
+        self._pool = pool
+        self.role = role
+        # PoolAutoscaler ctor attachment points land HERE, per view
+        self._autoscaler = None
+        self.capacity_hint_fn: Optional[Callable[[], float]] = None
+        self.events = _RoleEventLog(pool.events, role)
+        pool._role_views[role] = self
+        pool.capacity_hint_fn = pool._role_capacity_eta_s
+
+    # pool surface the autoscaler senses -----------------------------
+
+    @property
+    def _stopped(self) -> bool:
+        return self._pool._stopped
+
+    @property
+    def add_replica_for_ticket(self):
+        # provider-harvest override, honored pool-wide if installed
+        return getattr(self._pool, "add_replica_for_ticket", None)
+
+    def load_report(self) -> Dict[str, Any]:
+        return self._pool.load_report(role=self.role)
+
+    def active_count(self) -> int:
+        with self._pool._lock:
+            return sum(1 for r in self._pool._replicas
+                       if r.state != RETIRED and r.role == self.role)
+
+    def healthy_count(self) -> int:
+        with self._pool._lock:
+            return sum(1 for r in self._pool._replicas
+                       if r.state == HEALTHY and r.role == self.role)
+
+    # pool surface the autoscaler actuates ---------------------------
+
+    def add_replica(self) -> int:
+        return self._pool.add_replica(role=self.role)
+
+    def scale_down(self, n: int = 1,
+                   timeout_s: float = 30.0) -> List[int]:
+        return self._pool.scale_down(n, timeout_s, role=self.role)

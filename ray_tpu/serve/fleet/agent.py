@@ -294,7 +294,8 @@ class ReplicaAgent:
         r = self._directory.register(
             self.replica_id, self.addr, self.generation,
             page_size=getattr(self.engine, "Pg", 0),
-            min_fence=min_fence)
+            min_fence=min_fence,
+            role=getattr(self.engine, "role", "unified"))
         with self._lock:
             self.fence = int(r["fence"])
             self.lease_ttl_s = float(r["lease_ttl_s"])

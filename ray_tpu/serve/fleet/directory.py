@@ -69,11 +69,12 @@ STANDBY = "standby"
 class _Member:
     __slots__ = ("replica_id", "addr", "generation", "fence",
                  "lease_expires", "digest", "load", "page_size",
-                 "wedged", "registered_at")
+                 "wedged", "registered_at", "role")
 
     def __init__(self, replica_id: str, addr: List[Any],
                  generation: int, fence: int, lease_expires: float,
-                 page_size: int, registered_at: float):
+                 page_size: int, registered_at: float,
+                 role: str = "unified"):
         self.replica_id = replica_id
         self.addr = addr
         self.generation = generation
@@ -84,6 +85,9 @@ class _Member:
         self.page_size = page_size
         self.wedged = False
         self.registered_at = registered_at
+        # scheduling role ("prefill"/"decode"/"unified") — unrelated
+        # to the directory's own PRIMARY/STANDBY role
+        self.role = role
 
 
 class FleetDirectory:
@@ -156,7 +160,8 @@ class FleetDirectory:
             "members": [{"replica_id": m.replica_id, "addr": m.addr,
                          "generation": m.generation,
                          "fence": m.fence,
-                         "page_size": m.page_size}
+                         "page_size": m.page_size,
+                         "role": m.role}
                         for m in self._members.values()],
             "tombstones": dict(self._tombstones),
             "fence_counter": self._fence_counter,
@@ -186,7 +191,8 @@ class FleetDirectory:
             self._members[rid] = _Member(
                 rid, list(rec["addr"]), int(rec["generation"]),
                 fence, now + self.lease_ttl_s,
-                int(rec.get("page_size", 0)), now)
+                int(rec.get("page_size", 0)), now,
+                role=rec.get("role", "unified"))
             self._fence_counter = max(self._fence_counter, fence)
         elif op == "tombstone":
             rid = rec["replica_id"]
@@ -285,9 +291,14 @@ class FleetDirectory:
 
     def rpc_register(self, replica_id: str, addr: List[Any],
                      generation: int, page_size: int = 0,
-                     min_fence: int = 0) -> Dict[str, Any]:
+                     min_fence: int = 0,
+                     role: str = "unified") -> Dict[str, Any]:
         with self._lock:
             self._require_primary("register")
+            if role not in ("prefill", "decode", "unified"):
+                raise ValueError(
+                    f"unknown replica role {role!r}; expected "
+                    f"prefill/decode/unified")
             tomb = self._tombstones.get(replica_id)
             if tomb is not None and generation <= tomb:
                 self.counters["zombie_register_rejects"] += 1
@@ -308,11 +319,13 @@ class FleetDirectory:
             self._drop_prefix_holdings(cur)
             self._members[replica_id] = _Member(
                 replica_id, list(addr), int(generation), fence,
-                now + self.lease_ttl_s, int(page_size), now)
+                now + self.lease_ttl_s, int(page_size), now,
+                role=role)
             self.counters["registers"] += 1
             rec = {"op": "member", "replica_id": replica_id,
                    "addr": list(addr), "generation": int(generation),
-                   "fence": fence, "page_size": int(page_size)}
+                   "fence": fence, "page_size": int(page_size),
+                   "role": role}
             self._persist(rec)
             self._replicate(rec)
             self._event("fence_issued", replica_id=replica_id,
@@ -423,6 +436,7 @@ class FleetDirectory:
                 "expired": now > m.lease_expires,
                 "wedged": m.wedged, "digest": m.digest,
                 "load": m.load, "page_size": m.page_size,
+                "role": m.role,
             } for m in self._members.values()]
             return {"members": members,
                     "fence_counter": self._fence_counter,
@@ -647,12 +661,13 @@ class DirectoryClient:
 
     def register(self, replica_id: str, addr: List[Any],
                  generation: int, page_size: int = 0,
-                 min_fence: int = 0) -> Dict[str, Any]:
+                 min_fence: int = 0,
+                 role: str = "unified") -> Dict[str, Any]:
         return self._t.call(
             "register",
             {"replica_id": replica_id, "addr": addr,
              "generation": generation, "page_size": page_size,
-             "min_fence": min_fence},
+             "min_fence": min_fence, "role": role},
             timeout_s=self._timeout_s)
 
     def renew(self, replica_id: str, fence: int,

@@ -69,7 +69,15 @@ class _Member:
         rpt.setdefault("queue_depth", 0)
         rpt.setdefault("max_queued", None)
         rpt.setdefault("shed_retry_after_s", 0.05)
+        # membership-level role backstops the load report: a member
+        # that has never renewed still routes with the role it
+        # registered under
+        rpt.setdefault("role", m.get("role", "unified"))
         self.report = rpt
+
+    @property
+    def role(self) -> str:
+        return self.report.get("role") or "unified"
 
 
 class FleetRequestHandle(ResubmitPolicy):
@@ -329,6 +337,14 @@ class FleetRouter:
             members = self._members(exclude)
             sticky_id = (self._sticky.get(session_id)
                          if session_id is not None else None)
+            if sticky_id is not None:
+                st = members.get(sticky_id)
+                if st is not None and st.role == "prefill":
+                    # a session must never pin to a prefill-only
+                    # member: its decode stream lives elsewhere
+                    with self._lock:
+                        self._sticky.pop(session_id, None)
+                    sticky_id = None
             cands = [Candidate(m.replica_id, m.report, m.page_size)
                      for m in members.values()]
             pick, decision = select_candidate(
@@ -641,7 +657,7 @@ class FleetRouter:
                   "trace_id": trace_id})
         with self._lock:
             self.counters["routed"] += 1
-            if session_id is not None:
+            if session_id is not None and member.role != "prefill":
                 self._sticky[session_id] = member.replica_id
                 self._sticky.move_to_end(session_id)
                 while len(self._sticky) > self._max_sticky:

@@ -322,6 +322,60 @@ def pull_prefix(call: Callable[[str, Dict[str, Any]], Any],
             "pages": pages, "wire_bytes": wire_bytes}
 
 
+def validate_pull_knobs(deadline_s: Optional[float] = None,
+                        backoff_s: Optional[float] = None
+                        ) -> Dict[str, float]:
+    """Typed validation for the requester-side pull knobs a
+    deployment plumbs through (``LlamaDeployment(kv_pull_deadline_s=,
+    kv_pull_backoff_s=)``). ``None`` means "use the ``pull_prefix``
+    default"; anything else must be a positive finite number — a junk
+    value fails HERE, at construction, not minutes later inside the
+    first cache-miss pull. Returns only the overridden knobs, ready
+    to splat into ``pull_prefix``."""
+    knobs: Dict[str, float] = {}
+    for name, val in (("deadline_s", deadline_s),
+                      ("backoff_s", backoff_s)):
+        if val is None:
+            continue
+        try:
+            f = float(val)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"kv pull {name} must be a positive number, "
+                f"got {val!r}") from None
+        if not (f > 0.0) or f != f or f == float("inf"):
+            raise ValueError(
+                f"kv pull {name} must be a positive finite number, "
+                f"got {val!r}")
+        knobs[name] = f
+    return knobs
+
+
+def prefill_push_hint(prompt: Sequence[int], page_size: int,
+                      **donor: Any) -> Optional[Dict[str, Any]]:
+    """Finished-prefill push hint: the donor-side twin of the cold
+    routing pull. When a prefill-role replica completes a prompt, the
+    pool hands the stream to a decode replica carrying THIS hint —
+    the full-page hash chain of exactly the prompt the donor just
+    retired into its prefix cache, plus the donor's address
+    (``replica_idx=`` in-process, ``addr=``/``replica_id=`` over the
+    fleet wire). The decode replica's admission pull then resumes at
+    full prompt length instead of recomputing it: a degenerate
+    "all pages pulled" prefill. Returns ``None`` when the prompt has
+    no full page — nothing worth shipping, plain prefill is cheaper
+    than a one-page wire round-trip says the PR 16 smoke."""
+    from ray_tpu.serve.prefix_cache import path_hashes
+    if page_size <= 0 or len(prompt) < page_size:
+        return None
+    n_full = len(prompt) // page_size
+    chain = path_hashes(list(prompt), page_size)[:n_full]
+    if not chain:
+        return None
+    hint: Dict[str, Any] = {"hashes": chain}
+    hint.update(donor)
+    return hint
+
+
 def count_fallback(stats: Optional[Dict[str, int]] = None) -> None:
     """One request fell back to plain prefill after its pull failed
     or its pulled pages could not land (allocator dry)."""

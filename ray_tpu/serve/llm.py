@@ -58,7 +58,12 @@ class LlamaDeployment:
                  overlap: Optional[bool] = None,
                  fleet: int = 0,
                  fleet_lease_ttl_s: float = 2.0,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 disaggregate: bool = False,
+                 prefill_replicas: Optional[int] = None,
+                 decode_replicas: Optional[int] = None,
+                 kv_pull_deadline_s: Optional[float] = None,
+                 kv_pull_backoff_s: Optional[float] = None):
         import jax
         from ray_tpu.models.llama import llama_tiny
         self.cfg = config or llama_tiny()
@@ -179,6 +184,51 @@ class LlamaDeployment:
         self.fleet_lease_ttl_s = float(fleet_lease_ttl_s)
         self._fleet_agents: Dict[str, Any] = {}
         self._fleet_directory = None
+        # Prefill/decode disaggregation (serve/engine_pool.py roles):
+        # the pool splits into a prefill pool (new requests, TTFT)
+        # and a decode pool (streams resumed over the KV-migration
+        # handoff) that scale independently. Junk knobs fail HERE,
+        # at construction, not on the first pulled page.
+        from ray_tpu.serve.kv_migration import validate_pull_knobs
+        validate_pull_knobs(kv_pull_deadline_s, kv_pull_backoff_s)
+        self.kv_pull_deadline_s = kv_pull_deadline_s
+        self.kv_pull_backoff_s = kv_pull_backoff_s
+        self.disaggregate = bool(disaggregate)
+        if not disaggregate and (prefill_replicas is not None
+                                 or decode_replicas is not None):
+            raise ValueError(
+                "prefill_replicas/decode_replicas require "
+                "disaggregate=True")
+        if disaggregate:
+            if fleet:
+                raise ValueError(
+                    "disaggregate=True and fleet= are exclusive — "
+                    "fleet members carry role metadata but the "
+                    "router serves them unified")
+            if not prefix_cache:
+                raise ValueError(
+                    "disaggregate=True requires prefix_cache=True "
+                    "(the handoff pulls the prefill replica's "
+                    "published pages)")
+            p = (int(prefill_replicas)
+                 if prefill_replicas is not None else 1)
+            d = (int(decode_replicas)
+                 if decode_replicas is not None else 1)
+            if p < 1 or d < 1:
+                raise ValueError("prefill_replicas and "
+                                 "decode_replicas must be >= 1")
+            if num_engine_replicas not in (1, p + d):
+                raise ValueError(
+                    f"num_engine_replicas={num_engine_replicas} "
+                    f"conflicts with prefill_replicas+decode_"
+                    f"replicas={p + d}; omit it (the role split "
+                    f"determines pool width)")
+            self.num_engine_replicas = p + d
+            self.prefill_replicas: Optional[int] = p
+            self.decode_replicas: Optional[int] = d
+        else:
+            self.prefill_replicas = None
+            self.decode_replicas = None
         self._engine_opts = dict(
             max_slots=max_slots, page_size=page_size,
             n_pages=n_pages, chunk=decode_chunk or stream_chunk,
@@ -319,7 +369,8 @@ class LlamaDeployment:
                             self._engine, policy,
                             LoopbackAgentProvider(spawn_agent)).run(
                                 self.autoscale_interval_s)
-                elif self.num_engine_replicas > 1 or self.autoscale:
+                elif (self.num_engine_replicas > 1 or self.autoscale
+                      or self.disaggregate):
                     from ray_tpu.serve.engine_pool import EnginePool
 
                     def factory(idx, _opts=opts):
@@ -330,10 +381,54 @@ class LlamaDeployment:
                             sharding=_replica_sharding(idx),
                             **_opts)
 
+                    pool_kw: Dict[str, Any] = dict(
+                        auto_restart=self.pool_auto_restart,
+                        kv_pull_deadline_s=self.kv_pull_deadline_s,
+                        kv_pull_backoff_s=self.kv_pull_backoff_s)
+                    if self.disaggregate:
+                        from ray_tpu.serve.scheduler import (
+                            ROLE_DECODE, ROLE_PREFILL)
+                        pool_kw.update(
+                            share_prefixes=True,
+                            roles=([ROLE_PREFILL]
+                                   * self.prefill_replicas
+                                   + [ROLE_DECODE]
+                                   * self.decode_replicas))
                     self._engine = EnginePool(
                         factory, self.num_engine_replicas,
-                        auto_restart=self.pool_auto_restart)
-                    if self.autoscale:
+                        **pool_kw)
+                    if self.autoscale and self.disaggregate:
+                        # one scaler per role over role-filtered pool
+                        # views, one shared capacity provider: the
+                        # prefill pool chases TTFT/queue, the decode
+                        # pool chases ITL/free slots, and they reach
+                        # DIFFERENT sizes on the same trace
+                        from ray_tpu.serve.engine_pool import (
+                            RolePoolView)
+                        from ray_tpu.serve.pool_autoscaler import (
+                            ImmediateCapacityProvider,
+                            PoolAutoscaler, SLOPolicy)
+                        ap = dict(self.autoscale_policy)
+                        pre_over = dict(ap.pop("prefill", {}))
+                        dec_over = dict(ap.pop("decode", {}))
+                        provider = (self.autoscale_provider
+                                    or ImmediateCapacityProvider())
+                        self._autoscaler = {}
+                        for role, floor, over in (
+                                (ROLE_PREFILL, self.prefill_replicas,
+                                 pre_over),
+                                (ROLE_DECODE, self.decode_replicas,
+                                 dec_over)):
+                            policy = SLOPolicy(
+                                min_replicas=floor,
+                                max_replicas=(
+                                    self.autoscale_max_replicas),
+                                **{**ap, **over})
+                            self._autoscaler[role] = PoolAutoscaler(
+                                RolePoolView(self._engine, role),
+                                policy, provider).run(
+                                    self.autoscale_interval_s)
+                    elif self.autoscale:
                         from ray_tpu.serve.pool_autoscaler import (
                             PoolAutoscaler, SLOPolicy)
                         policy = SLOPolicy(
@@ -362,7 +457,9 @@ class LlamaDeployment:
 
     def autoscaler(self):
         """The attached PoolAutoscaler (None until the lazy engine is
-        built or when autoscale=False)."""
+        built or when autoscale=False). Disaggregated deployments
+        return a ``{"prefill": ..., "decode": ...}`` dict — one
+        scaler per role."""
         return self._autoscaler
 
     def watchdog(self):

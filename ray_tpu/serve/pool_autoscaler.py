@@ -102,6 +102,10 @@ class SLOPolicy:
       shedding is an SLO event worth paying chips for.
     - ``ttft_slo_s``: worst-replica TTFT EWMA budget (None = no TTFT
       term).
+    - ``itl_slo_s``: worst-replica inter-token-latency EWMA budget
+      (None = no ITL term). The decode side of a disaggregated pool
+      scales on THIS plus free slots — its TTFT is the handoff, not
+      client experience.
     - ``free_slot_frac_low``: free-slot fraction floor — scarce slots
       WITH a backlog means saturation is imminent.
 
@@ -123,6 +127,7 @@ class SLOPolicy:
     queue_low: float = 0.5
     shed_rate_high: float = 0.0
     ttft_slo_s: Optional[float] = None
+    itl_slo_s: Optional[float] = None
     free_slot_frac_low: float = 0.1
     free_slot_frac_high: float = 0.6
     idle_stable_s: float = 5.0
@@ -232,6 +237,8 @@ class PoolAutoscaler:
             "shed_rate": shed_rate,
             "free_slot_frac": free_frac,
             "ttft_ewma_s": rpt.get("ttft_ewma_s"),
+            "itl_ewma_s": rpt.get("itl_ewma_s"),
+            "role": rpt.get("role"),
             "healthy_replicas": rpt.get("healthy_replicas", 0),
         }
 
@@ -245,9 +252,13 @@ class PoolAutoscaler:
         ttft = sig.get("ttft_ewma_s")
         ttft_breach = (p.ttft_slo_s is not None and ttft is not None
                        and ttft > p.ttft_slo_s)
+        itl = sig.get("itl_ewma_s")
+        itl_breach = (p.itl_slo_s is not None and itl is not None
+                      and itl > p.itl_slo_s)
         pressure = (sig["queue_per_replica"] > p.queue_high
                     or sig["shed_rate"] > p.shed_rate_high
                     or ttft_breach
+                    or itl_breach
                     or (sig["free_slot_frac"] < p.free_slot_frac_low
                         and sig["queue_depth"] > 0))
         if pressure:

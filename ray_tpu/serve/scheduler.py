@@ -87,6 +87,55 @@ ALLOWED_IMPORTS = frozenset({"__future__", "dataclasses", "typing"})
 LANE_ONLINE = "online"
 LANE_BATCH = "batch"
 
+# Replica roles for prefill/decode disaggregation
+# (serve/engine_pool.py). UNIFIED is the classic mixed replica;
+# PREFILL replicas take new prompts and hand finished prefills to the
+# decode pool over the KV-migration path; DECODE replicas own the
+# token streams after handoff. Pure data: the role changes nothing in
+# ``plan_step`` itself — it only selects the knob clamps below, which
+# the engine applies to the arguments it passes in.
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_UNIFIED = "unified"
+REPLICA_ROLES = frozenset({ROLE_PREFILL, ROLE_DECODE, ROLE_UNIFIED})
+
+
+def role_plan_caps(role, *, page_size, decode_chunk, prefill_budget,
+                   max_run_ahead):
+    """Role-adjusted planner knobs, pure data in -> data out.
+
+    - ``prefill``: refuses decode-phase growth. Run-ahead is clamped
+      to one decode chunk so a prefill replica never commits long
+      decode dispatches: its steady state is prompt chunks plus the
+      single bridging token each handoff needs, and anything longer
+      only delays the next waiting prompt (exactly the interference
+      disaggregation exists to remove).
+    - ``decode``: skips the prefill lane. The per-round prefill
+      budget collapses to one page plus one token — enough to absorb
+      a handoff's residual tail (``len(prompt) mod page_size`` plus
+      the bridging token always fits one round) and to crawl through
+      a full plain prefill when a fallback or chaos resubmit lands
+      here (correct, just slow — a hard refusal would strand exactly
+      the recovery paths that must keep working).
+    - ``unified``: knobs pass through untouched.
+
+    Unknown roles raise: a typo'd role silently planning as unified
+    would erase the disaggregation it was meant to configure.
+    """
+    if role not in REPLICA_ROLES:
+        raise ValueError(
+            f"unknown replica role {role!r}; expected one of "
+            f"{sorted(REPLICA_ROLES)}")
+    caps = {"prefill_budget": prefill_budget,
+            "max_run_ahead": max_run_ahead}
+    if role == ROLE_PREFILL:
+        caps["max_run_ahead"] = max(1, min(max_run_ahead,
+                                           decode_chunk))
+    elif role == ROLE_DECODE:
+        caps["prefill_budget"] = max(1, min(prefill_budget,
+                                            page_size + 1))
+    return caps
+
 # Named knob presets for the two serving regimes. Pure data (the
 # import guard above applies): the engine/deployment layer maps these
 # onto its constructor knobs; the planner itself reads nothing here.

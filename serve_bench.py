@@ -2413,6 +2413,268 @@ def run_prefix_share_ab(args):
     return result
 
 
+def run_disagg_ab(args):
+    """Prefill/decode disaggregation A/B (serve_bench.py
+    --disagg-ab): the SAME 2-replica pool, arrival trace, and greedy
+    sampling run unified (both replicas mixed prefill+decode) vs
+    disaggregated (1 prefill-role + 1 decode-role replica joined by
+    the KV-migration handoff path — serve/engine_pool.py roles,
+    docs/serving.md).
+
+    The trace is a decode-saturating arrival stream: short prompts,
+    long generations, arrivals landing every 50ms while earlier
+    streams are still decoding. That is the regime disaggregation
+    exists for — in the unified arm every new prompt's chunked
+    prefill interleaves with wide multi-step decode dispatches on
+    the same scheduler (prefill waits on decode rounds = TTFT
+    inflation; decode stalls during prefill rounds = ITL inflation),
+    while the disagg arm gives arrivals an interference-free prefill
+    replica and consolidates every stream onto one decode replica
+    whose batched dispatches amortize the per-round host sync.
+
+    Measured per arm: steady-state TTFT p50 (the LAST half of the
+    arrivals — the first half lands in a draining-in system),
+    tokens/s over the full trace, and the token streams. The disagg
+    arm additionally records handoffs, fallbacks, and the
+    kv_migration counters. Three gated phases ride along: token
+    identity (every stream must match the unified arm's exactly —
+    the handoff pull lands the prefill replica's exact pages),
+    per-role autoscaling (a prefill-heavy burst must scale the
+    prefill pool while the decode pool holds — different final
+    counts from the same trace), and a chaos arm (the decode replica
+    is killed before a handoff; the typed fallback must decode in
+    place on the prefill replica, token-identically). The artifact
+    REFUSES to exist (tools/check_bench_schema.py ``disagg_ab``
+    family) with diverging streams, zero handoffs, a TTFT p50 ratio
+    >= 1.0, a throughput ratio < 1.0, undiverged autoscaling, a
+    faultless chaos arm, or missing role/kv-pull/mesh/kv stamps."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.llama import Llama, generate, llama_tiny
+    from ray_tpu.serve.engine import LLMEngine
+    from ray_tpu.serve.engine_pool import EnginePool, RolePoolView
+    from ray_tpu.serve.pool_autoscaler import (PoolAutoscaler,
+                                               SLOPolicy)
+    from ray_tpu.serve.scheduler import ROLE_DECODE, ROLE_PREFILL
+
+    cfg = llama_tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))
+
+    page_size = 8
+    prompt_len = 48                  # 6 pages; prefill = 3 chunks
+    gen_tokens = 64                  # decode-saturating streams
+    n_requests = 16
+    gap_s = 0.05
+    max_slots = 12                   # wide decode batches: the
+    # consolidation the disagg arm wins on, and the interference the
+    # unified arm loses to
+    n_pages = 260
+    kv_pull = {"deadline_s": 5.0, "backoff_s": 0.02}
+
+    rng = np.random.RandomState(args.seed + 31)
+    prompts = [rng.randint(1, cfg.vocab_size - 1,
+                           size=prompt_len).tolist()
+               for _ in range(n_requests)]
+
+    def factory(idx):
+        return LLMEngine(model, params, max_slots=max_slots,
+                         page_size=page_size, n_pages=n_pages,
+                         chunk=4, prefill_chunk=16,
+                         temperature=0.0, eos_id=-1, seed=args.seed,
+                         prefix_cache=True, kv_dtype="fp")
+
+    def run_arm(roles):
+        pool = EnginePool(factory, 2, share_prefixes=True,
+                          roles=roles,
+                          kv_pull_deadline_s=kv_pull["deadline_s"],
+                          kv_pull_backoff_s=kv_pull["backoff_s"],
+                          seed=args.seed)
+        try:
+            for _ in range(2):       # compile both replicas' paths
+                pool.submit(list(prompts[0]),
+                            max_new_tokens=gen_tokens).result()
+            t0 = time.perf_counter()
+            handles = []
+            for p in prompts:
+                handles.append(pool.submit(
+                    list(p), max_new_tokens=gen_tokens))
+                time.sleep(gap_s)
+            streams = [list(h.result()) for h in handles]
+            wall = time.perf_counter() - t0
+            ttfts = [h.ttft_s
+                     for h in handles[len(handles) // 2:]]
+            ps = pool.pool_stats()
+            kv = dict(pool.kv_migration_stats() or {})
+        finally:
+            pool.shutdown()
+        toks = sum(len(s) for s in streams)
+        return {
+            "streams": streams,
+            "ttft_p50_s": round(
+                sorted(ttfts)[len(ttfts) // 2], 4),
+            "ttft_steady_s": [round(t, 4) for t in ttfts],
+            "tokens": toks,
+            "wall_s": round(wall, 3),
+            "tok_per_s": round(toks / wall, 1),
+            "handoffs": ps.get("disagg_handoffs", 0),
+            "handoff_fallbacks": ps.get("disagg_handoff_fallbacks",
+                                        0),
+            "roles": ps.get("roles", {}),
+            "kv_migration": kv,
+        }
+
+    print("disagg A/B: unified arm", flush=True)
+    unified = run_arm(None)
+    print("disagg A/B: prefill/decode arm", flush=True)
+    disagg = run_arm([ROLE_PREFILL, ROLE_DECODE])
+
+    identical = unified["streams"] == disagg["streams"]
+    ttft_ratio = _ratio(disagg["ttft_p50_s"], unified["ttft_p50_s"])
+    thpt_ratio = _ratio(disagg["tok_per_s"], unified["tok_per_s"])
+    if not identical:
+        print("WARNING: disagg streams diverged from unified — the "
+              "artifact will fail schema validation", flush=True)
+    if not disagg["handoffs"]:
+        print("WARNING: disagg arm made no handoffs — the artifact "
+              "will fail schema validation", flush=True)
+    if ttft_ratio is None or ttft_ratio >= 1.0:
+        print("WARNING: disaggregation did not beat unified TTFT "
+              "p50 — the artifact will fail schema validation",
+              flush=True)
+    if thpt_ratio is None or thpt_ratio < 1.0:
+        print("WARNING: disaggregation lost throughput vs unified — "
+              "the artifact will fail schema validation", flush=True)
+
+    # ---- per-role autoscaling: same trace, different verdicts -----
+    # A prefill-heavy burst against a 1+1 pool with one scaler per
+    # role: the prefill scaler (TTFT SLO it cannot meet) must grow
+    # its pool, the decode scaler (lenient ITL SLO, idle-biased) must
+    # hold — different final counts demonstrate the roles scale
+    # INDEPENDENTLY.
+    print("disagg A/B: per-role autoscale phase", flush=True)
+    pool = EnginePool(factory, 2, share_prefixes=True,
+                      roles=[ROLE_PREFILL, ROLE_DECODE],
+                      seed=args.seed)
+    scalers = {}
+    try:
+        scalers[ROLE_PREFILL] = PoolAutoscaler(
+            RolePoolView(pool, ROLE_PREFILL),
+            SLOPolicy(min_replicas=1, max_replicas=3,
+                      ttft_slo_s=0.001, cooldown_up_s=0.0))
+        scalers[ROLE_DECODE] = PoolAutoscaler(
+            RolePoolView(pool, ROLE_DECODE),
+            SLOPolicy(min_replicas=1, max_replicas=3,
+                      itl_slo_s=60.0, idle_stable_s=3600.0))
+        pool.submit(list(prompts[0]),
+                    max_new_tokens=gen_tokens).result()
+        hs = [pool.submit(list(p), max_new_tokens=gen_tokens)
+              for p in prompts[:6]]
+        decisions = {r: [] for r in scalers}
+        for _ in range(60):
+            for role, sc in scalers.items():
+                decisions[role].append(sc.tick())
+            if pool.role_counts().get(ROLE_PREFILL, 0) > 1:
+                break
+            time.sleep(0.05)
+        for h in hs:
+            h.result()
+        counts = pool.role_counts()
+        autoscale = {
+            role: {"start": 1, "final": counts.get(role, 0),
+                   "decisions": decisions[role],
+                   **{k: sc.stats()[k] for k in
+                      ("scale_ups", "scale_downs", "ticks")}}
+            for role, sc in scalers.items()}
+        autoscale["diverged"] = (
+            counts.get(ROLE_PREFILL, 0) != counts.get(ROLE_DECODE,
+                                                      0))
+    finally:
+        pool.shutdown()
+    if not autoscale["diverged"]:
+        print("WARNING: role pools did not diverge under the burst "
+              "— the artifact will fail schema validation",
+              flush=True)
+
+    # ---- chaos arm: decode replica killed before the handoff ------
+    # The handoff's typed abort ladder must decode in place on the
+    # prefill replica, token-identically to the no-fault reference.
+    print("disagg A/B: chaos arm (decode replica kill)", flush=True)
+    ref = np.asarray(generate(
+        model, params,
+        jnp.asarray([prompts[0]], jnp.int32),
+        max_new_tokens=gen_tokens,
+        temperature=0.0))[0, prompt_len:].tolist()
+    pool = EnginePool(factory, 2, share_prefixes=True,
+                      roles=[ROLE_PREFILL, ROLE_DECODE],
+                      seed=args.seed)
+    try:
+        pool.submit(list(prompts[1]),
+                    max_new_tokens=4).result()   # warm both paths
+        decode_idx = next(
+            i for i, r in enumerate(pool.pool_stats()["replicas"])
+            if r["role"] == ROLE_DECODE)
+        pool.engines()[decode_idx].shutdown()
+        toks = pool.submit(list(prompts[0]),
+                           max_new_tokens=gen_tokens).result()
+        ps = pool.pool_stats()
+        chaos = {
+            "faults_injected": 1,
+            "handoff_fallbacks": ps.get("disagg_handoff_fallbacks",
+                                        0),
+            "lost": 0,
+            "mismatched": 0 if list(toks) == ref else 1,
+            "token_identical": list(toks) == ref,
+        }
+    finally:
+        pool.shutdown()
+    if chaos["mismatched"] or not chaos["handoff_fallbacks"]:
+        print("WARNING: chaos arm did not recover token-identically "
+              "through the fallback — the artifact will fail schema "
+              "validation", flush=True)
+
+    # streams travel as counts; the bulk lived in the comparison
+    for arm in (unified, disagg):
+        arm.pop("streams")
+    from ray_tpu.models.llama import _use_paged_kernel
+    return {
+        "disagg_ab": {
+            "page_size": page_size,
+            "prompt_len": prompt_len,
+            "gen_tokens": gen_tokens,
+            "requests": n_requests,
+            "arrival_gap_s": gap_s,
+            "max_slots": max_slots,
+            "unified": unified,
+            "disagg": disagg,
+            "token_identical": identical,
+            "ttft_p50_ratio": ttft_ratio,
+            "throughput_ratio": thpt_ratio,
+            "kv_pull": kv_pull,
+            "autoscale": autoscale,
+            "chaos": chaos,
+        },
+        "mesh": {"tp": 1, "replicas": 2},
+        "kv": {"kv_dtype": "fp",
+               "paged_kernel": ("pallas" if _use_paged_kernel()
+                                else "gather")},
+        "model": "llama-tiny",
+        "notes": "Prefill/decode disaggregation A/B (serve_bench.py "
+                 "--disagg-ab): identical 2-replica pool + decode-"
+                 "saturating arrival trace served unified vs role-"
+                 "split (1 prefill + 1 decode joined by the KV-"
+                 "migration handoff). Steady-state TTFT p50 is the "
+                 "last half of the arrivals; throughput is tokens/s "
+                 "over the full trace at equal chip count. Streams "
+                 "are gated token-identical across the handoff; the "
+                 "autoscale phase must scale the roles apart on the "
+                 "same burst; the chaos arm kills the decode replica "
+                 "and must recover through the typed decode-in-place "
+                 "fallback.",
+    }
+
+
 def _batch_bench_model(args):
     import jax
     import jax.numpy as jnp
@@ -2884,6 +3146,17 @@ def main():
                          "token identity, cross-replica hit rate, "
                          "and TTFT p50 ratio; self-gated by "
                          "tools/check_bench_schema.py")
+    ap.add_argument("--disagg-ab", action="store_true",
+                    help="prefill/decode disaggregation A/B: the SAME "
+                         "2-replica pool + continuous-arrival trace "
+                         "unified vs role-split (prefill replica "
+                         "hands finished pages to the decode replica "
+                         "over kv_migration.pull_prefix) — gates "
+                         "token identity, handoffs > 0, steady-state "
+                         "TTFT p50 ratio < 1.0 and tokens/s >= "
+                         "unified; adds a per-role autoscale phase "
+                         "and a decode-kill chaos arm; self-gated by "
+                         "tools/check_bench_schema.py")
     ap.add_argument("--batch-ab", action="store_true",
                     help="batch-tier profile A/B: one offline corpus "
                          "through BatchInferenceJob on an engine "
@@ -3099,6 +3372,25 @@ def main():
         # self-gate: a non-token-identical pulled arm, a shared arm
         # with no cross-replica hits, or a missing kv/mesh stamp
         # fails its OWN run
+        from tools import check_bench_schema as cbs
+        problems = []
+        cbs.check_file(out, problems)
+        for p in problems:
+            print(f"SCHEMA FAIL {p}")
+        print(json.dumps(result))
+        ray_tpu.shutdown()
+        if problems:
+            raise SystemExit(1)
+        return
+
+    if args.disagg_ab:
+        result = _stamp(run_disagg_ab(args), args, replicas=2)
+        out = args.out or "SERVE_BENCH_disagg_ab_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        # self-gate: token divergence across the handoff, zero
+        # handoffs, a TTFT ratio that didn't improve, or a missing
+        # role/kv/mesh stamp fails its OWN run
         from tools import check_bench_schema as cbs
         problems = []
         cbs.check_file(out, problems)

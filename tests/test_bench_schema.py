@@ -1806,3 +1806,262 @@ def test_mixed_ab_refuses_token_divergence_and_missing_leg(tmp_path):
     probs = _problems_for("SERVE_BENCH_mixed_ab_cpu_smoke.json",
                           bad, tmp_path)
     assert any("chaos" in p for p in probs)
+
+
+# ---------------------------------------------------------------------------
+# disagg A/B family (serve_bench.py --disagg-ab)
+
+
+def _disagg_arm(ttft, toks_s, handoffs):
+    return {
+        "ttft_p50_s": ttft, "ttft_steady_s": [ttft] * 4,
+        "tokens": 1024, "wall_s": 2.5, "tok_per_s": toks_s,
+        "handoffs": handoffs, "handoff_fallbacks": 0,
+        "roles": ({"prefill": 1, "decode": 1} if handoffs
+                  else {"unified": 2}),
+        "kv_migration": {"pulls": handoffs, "pulled_pages": 96,
+                         "wire_bytes": 525312, "aborts": 0,
+                         "fallbacks": 0},
+    }
+
+
+def _disagg_ab():
+    return {
+        "disagg_ab": {
+            "page_size": 8, "prompt_len": 48, "gen_tokens": 64,
+            "requests": 16, "arrival_gap_s": 0.05, "max_slots": 12,
+            "unified": _disagg_arm(2.17, 340.5, 0),
+            "disagg": _disagg_arm(1.05, 522.7, 16),
+            "token_identical": True,
+            "ttft_p50_ratio": 0.48,
+            "throughput_ratio": 1.54,
+            "kv_pull": {"deadline_s": 5.0, "backoff_s": 0.02},
+            "autoscale": {
+                "prefill": {"start": 1, "final": 2,
+                            "decisions": ["up", "up"],
+                            "scale_ups": 2, "scale_downs": 0,
+                            "ticks": 2},
+                "decode": {"start": 1, "final": 1,
+                           "decisions": ["hold", "hold"],
+                           "scale_ups": 0, "scale_downs": 0,
+                           "ticks": 2},
+                "diverged": True},
+            "chaos": {"faults_injected": 1, "handoff_fallbacks": 1,
+                      "lost": 0, "mismatched": 0,
+                      "token_identical": True},
+        },
+        "mesh": {"tp": 1, "replicas": 2},
+        "kv": {"kv_dtype": "fp", "paged_kernel": "gather"},
+        "seed": 0, "git_sha": "abc1234",
+    }
+
+
+def test_disagg_ab_artifact_validates(tmp_path):
+    assert _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                         _disagg_ab(), tmp_path) == []
+
+
+def test_disagg_ab_refuses_missing_stamps(tmp_path):
+    for key, needle in (("mesh", "mesh stamp"), ("kv", "kv stamp"),
+                        ("seed", "seed")):
+        bad = _disagg_ab()
+        del bad[key]
+        probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any(needle in p for p in probs), key
+    no_pull = _disagg_ab()
+    del no_pull["disagg_ab"]["kv_pull"]
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          no_pull, tmp_path)
+    assert any("kv_pull stamp" in p for p in probs)
+    no_roles = _disagg_ab()
+    del no_roles["disagg_ab"]["disagg"]["roles"]
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          no_roles, tmp_path)
+    assert any("role stamp" in p for p in probs)
+
+
+def test_disagg_ab_refuses_token_divergence(tmp_path):
+    # a handoff that changes greedy tokens is broken, whatever its
+    # TTFT — this is the gate that matters most
+    bad = _disagg_ab()
+    bad["disagg_ab"]["token_identical"] = False
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("not token-identical" in p for p in probs)
+
+
+def test_disagg_ab_refuses_zero_handoffs(tmp_path):
+    bad = _disagg_ab()
+    bad["disagg_ab"]["disagg"]["handoffs"] = 0
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("zero handoffs" in p for p in probs)
+
+
+def test_disagg_ab_refuses_non_improving_ttft(tmp_path):
+    bad = _disagg_ab()
+    bad["disagg_ab"]["ttft_p50_ratio"] = 1.0
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("did not beat unified TTFT" in p for p in probs)
+    gone = _disagg_ab()
+    del gone["disagg_ab"]["ttft_p50_ratio"]
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          gone, tmp_path)
+    assert any("ttft_p50_ratio" in p for p in probs)
+
+
+def test_disagg_ab_refuses_throughput_loss(tmp_path):
+    # equal chip count both arms: a disagg arm below 1.0 paid
+    # tokens/chip-s for its TTFT
+    bad = _disagg_ab()
+    bad["disagg_ab"]["throughput_ratio"] = 0.9
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("tokens/chip-s" in p for p in probs)
+    gone = _disagg_ab()
+    del gone["disagg_ab"]["throughput_ratio"]
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          gone, tmp_path)
+    assert any("throughput_ratio" in p for p in probs)
+
+
+def test_disagg_ab_refuses_undiverged_autoscale(tmp_path):
+    bad = _disagg_ab()
+    bad["disagg_ab"]["autoscale"]["diverged"] = False
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("did not diverge" in p for p in probs)
+    idle = _disagg_ab()
+    idle["disagg_ab"]["autoscale"]["prefill"]["scale_ups"] = 0
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          idle, tmp_path)
+    assert any("no scaler made a scale-up decision" in p
+               for p in probs)
+    gone = _disagg_ab()
+    del gone["disagg_ab"]["autoscale"]
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          gone, tmp_path)
+    assert any("autoscale" in p for p in probs)
+
+
+def test_disagg_ab_refuses_faultless_or_lossy_chaos(tmp_path):
+    faultless = _disagg_ab()
+    faultless["disagg_ab"]["chaos"]["faults_injected"] = 0
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          faultless, tmp_path)
+    assert any("injected no faults" in p for p in probs)
+    no_fb = _disagg_ab()
+    no_fb["disagg_ab"]["chaos"]["handoff_fallbacks"] = 0
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          no_fb, tmp_path)
+    assert any("no typed handoff fallback" in p for p in probs)
+    for key in ("lost", "mismatched"):
+        bad = _disagg_ab()
+        bad["disagg_ab"]["chaos"][key] = 1
+        probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any("never correctness" in p for p in probs), key
+    diverged = _disagg_ab()
+    diverged["disagg_ab"]["chaos"]["token_identical"] = False
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          diverged, tmp_path)
+    assert any("decode-in-place fallback" in p for p in probs)
+    gone = _disagg_ab()
+    del gone["disagg_ab"]["chaos"]
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          gone, tmp_path)
+    assert any("chaos" in p for p in probs)
+
+
+def test_disagg_ab_requires_arms_and_counters(tmp_path):
+    no_arm = _disagg_ab()
+    del no_arm["disagg_ab"]["unified"]
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          no_arm, tmp_path)
+    assert any("unified" in p and "arm" in p for p in probs)
+    no_field = _disagg_ab()
+    del no_field["disagg_ab"]["disagg"]["ttft_p50_s"]
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          no_field, tmp_path)
+    assert any("ttft_p50_s" in p for p in probs)
+    no_km = _disagg_ab()
+    del no_km["disagg_ab"]["disagg"]["kv_migration"]
+    probs = _problems_for("SERVE_BENCH_disagg_ab_cpu_smoke.json",
+                          no_km, tmp_path)
+    assert any("kv_migration counter block" in p for p in probs)
+
+
+# ---------------------------------------------------------------------------
+# serve-chaos disagg drill block (validated-if-present)
+
+
+def _chaos_disagg_block():
+    return {
+        "prefill_kill_mid_handoff": {
+            "prompt_pages": 12, "aborts": 1, "fallbacks": 1,
+            "completed_token_identical": True},
+        "decode_kill_post_handoff": {
+            "streamed_before_kill": 2, "resubmits": 1,
+            "handoff_fallbacks": 1,
+            "completed_token_identical": True},
+        "requests": {"completed": 2, "failed_typed": 1, "lost": 0,
+                     "mismatched": 0, "admitted": 3},
+        "flight": {"prefill_kill_explained": True,
+                   "decode_kill_explained": True},
+        "quiesced": True,
+    }
+
+
+def test_serve_chaos_disagg_block_validates_when_present(tmp_path):
+    ok = _serve_chaos_ok()
+    ok["disagg"] = _chaos_disagg_block()
+    assert _problems_for("SERVE_CHAOS_x.json", ok, tmp_path) == []
+    # campaigns predating role-split pools carry no block: still fine
+    assert _problems_for("SERVE_CHAOS_x.json", _serve_chaos_ok(),
+                         tmp_path) == []
+
+
+def test_serve_chaos_disagg_refuses_unexercised_fallbacks(tmp_path):
+    bad = _serve_chaos_ok()
+    bad["disagg"] = _chaos_disagg_block()
+    bad["disagg"]["prefill_kill_mid_handoff"]["fallbacks"] = 0
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("no typed decode-in-place fallback" in p
+               for p in probs)
+    bad = _serve_chaos_ok()
+    bad["disagg"] = _chaos_disagg_block()
+    bad["disagg"]["decode_kill_post_handoff"]["resubmits"] = 0
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("no resubmit" in p for p in probs)
+
+
+def test_serve_chaos_disagg_refuses_divergence_and_loss(tmp_path):
+    for phase in ("prefill_kill_mid_handoff",
+                  "decode_kill_post_handoff"):
+        bad = _serve_chaos_ok()
+        bad["disagg"] = _chaos_disagg_block()
+        bad["disagg"][phase]["completed_token_identical"] = False
+        probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+        assert any("token-identically" in p for p in probs), phase
+    bad = _serve_chaos_ok()
+    bad["disagg"] = _chaos_disagg_block()
+    bad["disagg"]["requests"]["lost"] = 1
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("disagg" in p and "lost" in p for p in probs)
+
+
+def test_serve_chaos_disagg_requires_flight_and_quiesce(tmp_path):
+    for key, what in (("prefill_kill_explained", "prefill kill"),
+                      ("decode_kill_explained", "decode kill")):
+        bad = _serve_chaos_ok()
+        bad["disagg"] = _chaos_disagg_block()
+        bad["disagg"]["flight"][key] = False
+        probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+        assert any(f"explains the {what}" in p for p in probs), key
+    bad = _serve_chaos_ok()
+    bad["disagg"] = _chaos_disagg_block()
+    bad["disagg"]["quiesced"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("disagg" in p and "quiesce" in p for p in probs)

@@ -28,7 +28,14 @@ After the campaign it PROVES the pool's availability contract:
   wedges: a donor killed mid-pull leaves the requester falling back
   to plain prefill token-identically, and a session whose home
   replica dies after its prefix migrated resumes token-identically
-  on the peer FROM the migrated pages — both flight-explained.
+  on the peer FROM the migrated pages — both flight-explained;
+- prefill/decode disaggregation degrades the same way: a prefill
+  replica killed mid-handoff leaves the decode side aborting the
+  pull typed and prefilling in place, a decode replica killed
+  post-handoff fails the partial stream typed and the resubmit
+  lands decode-in-place on the prefill replica through the typed
+  handoff-fallback ladder — token-identical throughout, both
+  flight-explained.
 
 Writes a SERVE_CHAOS json artifact gated by
 tools/check_bench_schema.py (serve_chaos family).
@@ -405,6 +412,285 @@ def _run_migration_phases(model, params, flight_dir, seed, kv_dtype,
     }
 
 
+def _run_disagg_phases(model, params, flight_dir, seed, kv_dtype):
+    """Prefill/decode disaggregation fault drill: two seeded phases,
+    each against a fresh role-split pool (1 prefill + 1 decode
+    replica over the KV-migration handoff path —
+    serve/engine_pool.py roles).
+
+    A. prefill replica killed MID-HANDOFF — the handoff pull is
+       stretched with a per-chunk delay, the prefill (donor) replica
+       is killed while page chunks are still in flight, and the
+       decode replica must abort the pull TYPED and fall back to
+       prefilling in place, completing token-identically (the
+       tentpole's contract: disaggregation may cost time, never
+       correctness).
+    B. decode replica killed POST-HANDOFF — the decode leg is paced
+       with a per-round delay and killed after it has streamed >= 1
+       token. A partially-streamed request must fail typed (never a
+       silent hang, never a duplicated token); the client's resubmit
+       re-runs the two-leg service against the dead decode side and
+       must land decode-in-place on the prefill replica through the
+       typed handoff fallback, token-identically.
+
+    Both kills leave engine-fail-all flight bundles; the drill dumps
+    postmortems whose event tails carry the pull_fallback /
+    handoff_fallback proof and asserts the bundles on disk explain
+    both faults. Every engine ever built — including the corpses —
+    must quiesce leak-free. Returns the ``disagg`` artifact block."""
+    import glob
+
+    import numpy as np
+
+    from ray_tpu.serve import kv_migration, obs
+    from ray_tpu.serve.engine import LLMEngine
+    from ray_tpu.serve.engine_pool import EnginePool
+    from ray_tpu.serve.errors import (DeadlineExceeded,
+                                      EngineDraining,
+                                      EngineOverloaded,
+                                      EngineShutdown,
+                                      RequestCancelled)
+    from ray_tpu.serve.faults import FaultInjector, check_quiesced
+    from ray_tpu.serve.scheduler import ROLE_DECODE, ROLE_PREFILL
+
+    typed = (RequestCancelled, DeadlineExceeded, EngineOverloaded,
+             EngineDraining, EngineShutdown)
+    Pg, prompt_pages = 8, 12
+    rng = np.random.RandomState(seed * 11 + 271)
+
+    def toks(n):
+        return rng.randint(1, 250, size=n).tolist()
+
+    p_a = toks(Pg * prompt_pages)    # phase A: 96-token prompt
+    p_b = toks(Pg * prompt_pages)    # phase B: distinct prompt
+    pin = toks(12)                   # factory warmup prompt
+    sac = toks(12)                   # sacrificial: forces an armed
+    mnt_a, mnt_b = 8, 24             # kill to fire on an idle donor
+
+    def mk_engine(inj=None):
+        # same knobs everywhere — replicas AND the reference engine —
+        # so the int8 quantized write history is bit-identical and
+        # "token-identical" has one right answer (docs/serving.md).
+        # chunk=2 keeps decode rounds short so phase B's paced kill
+        # lands mid-stream with many rounds still to go.
+        return LLMEngine(model, params, max_slots=2, page_size=Pg,
+                         n_pages=48, chunk=2, prefill_chunk=8,
+                         temperature=0.0, eos_id=-1, seed=0,
+                         prefix_cache=True, kv_dtype=kv_dtype,
+                         fault_injector=inj, flight_dir=flight_dir)
+
+    ref = mk_engine()
+    want = {}
+    for p, n in [(p_a, mnt_a), (p_b, mnt_b)]:
+        h = ref.submit(list(p), max_new_tokens=n)
+        while ref.step():
+            pass
+        want[tuple(p)] = h.result()
+    ref.shutdown()
+
+    results = {"completed": 0, "failed_typed": 0, "lost": 0,
+               "mismatched": 0}
+
+    def mk_pool(engines):
+        def factory(idx):
+            eng = mk_engine(FaultInjector())
+            engines.append(eng)
+            eng.start()
+            eng.submit(list(pin), max_new_tokens=4).result()
+            eng.reset_latency_stats()
+            return eng
+        return EnginePool(factory, 2, share_prefixes=True,
+                          roles=[ROLE_PREFILL, ROLE_DECODE],
+                          seed=seed)
+
+    def consume(handle, box):
+        """Drive the handle on its own thread (the two-leg stream is
+        pulled by its consumer); box collects outcome or error."""
+        try:
+            box["tokens"] = handle.result()
+        except BaseException as e:  # noqa: BLE001
+            box["error"] = e
+
+    # -------------------- phase A: prefill replica killed mid-handoff
+    engines_a = []
+    pool = mk_pool(engines_a)
+    prefill_eng = pool._replicas[0].engine
+    decode_eng = pool._replicas[1].engine
+    # Stretch the handoff transfer: one page per chunk, a delay per
+    # chunk — the 12-page pull spans ~1s, so the kill lands with
+    # chunks still in flight. Short pin TTL so the aborted transfer's
+    # pins are reclaimed without waiting out the default 30s.
+    chaos_donor = kv_migration.KVDonor(
+        prefill_eng, max_chunk_bytes=2048, chunk_delay_s=0.08,
+        pin_ttl_s=0.6)
+    with pool._lock:
+        pool._kv_donors[0] = chaos_donor
+    h = pool.submit(list(p_a), max_new_tokens=mnt_a)
+    box_a = {}
+    t = threading.Thread(target=consume, args=(h, box_a), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while h.ttft_s is None and time.monotonic() < deadline:
+        time.sleep(0.005)          # leg 1 bridging token
+    assert h.ttft_s is not None, "prefill leg never produced a token"
+    time.sleep(0.3)                # well inside the ~1s stretched pull
+    prefill_eng._injector.kill_replica()
+    try:                           # idle donor: force a round so the
+        prefill_eng.submit(list(sac), max_new_tokens=2).result()
+    except BaseException:          # noqa: BLE001  armed kill fires
+        pass
+    t.join(timeout=30.0)
+    assert not t.is_alive(), "phase A request wedged after the kill"
+    if "error" in box_a:
+        results["lost"] += 1
+        outcome_a = f"failed:{type(box_a['error']).__name__}"
+    elif box_a.get("tokens") == want[tuple(p_a)]:
+        results["completed"] += 1
+        outcome_a = "completed"
+    else:
+        results["mismatched"] += 1
+        outcome_a = "mismatched"
+    stats_a = dict(decode_eng.kv_migration_stats)
+    assert stats_a.get("fallbacks", 0) >= 1, (
+        f"prefill kill mid-handoff produced no pull fallback on the "
+        f"decode replica (stats {stats_a})")
+    assert outcome_a == "completed", (
+        f"handed-off request did not complete token-identically "
+        f"after the prefill replica died mid-pull: {outcome_a}")
+    obs.dump_flight_bundle(
+        flight_dir, "disagg-prefill-kill", engine=decode_eng,
+        pool=pool, extra={"phase": "prefill_kill_mid_handoff",
+                          "killed_idx": 0, "decode_idx": 1,
+                          "outcome": outcome_a})
+    pool.shutdown()
+    for eng in engines_a:
+        eng.shutdown()
+    # aborted transfer: the decode side never sent end — the donor's
+    # pin-TTL GC must reclaim the pins or the corpse leaks
+    time.sleep(0.7)
+    assert chaos_donor.open_transfers() == 0, \
+        "pin-TTL GC left the aborted handoff transfer pinned"
+    for eng in engines_a:
+        check_quiesced(eng)
+    phase_a = {
+        "prompt_pages": prompt_pages,
+        "aborts": stats_a.get("aborts", 0),
+        "fallbacks": stats_a.get("fallbacks", 0),
+        "completed_token_identical": outcome_a == "completed",
+    }
+
+    # -------------------- phase B: decode replica killed post-handoff
+    engines_b = []
+    pool = mk_pool(engines_b)
+    prefill_eng = pool._replicas[0].engine
+    decode_eng = pool._replicas[1].engine
+    # pace the decode replica's rounds so the kill lands with most of
+    # the stream still to go (the armed kill fires at a round edge)
+    decode_eng._injector.slow("step", 0.03, times=1000)
+    h = pool.submit(list(p_b), max_new_tokens=mnt_b)
+    box_b = {}
+    t = threading.Thread(target=consume, args=(h, box_b), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 15.0
+    while len(h._generated) < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)          # leg 1 token + >= 1 decode token
+    assert len(h._generated) >= 2, \
+        "decode leg never streamed past the handoff"
+    decode_eng._injector.kill_replica()
+    t.join(timeout=30.0)
+    assert not t.is_alive(), "phase B request wedged after the kill"
+    err = box_b.get("error")
+    assert err is not None and isinstance(err, typed), (
+        f"partially-streamed request must fail TYPED after its "
+        f"decode replica died, got {box_b}")
+    results["failed_typed"] += 1
+    # the client resubmits: the two-leg service now finds the decode
+    # side dead and must fall back decode-in-place on the prefill
+    # replica through the typed handoff-fallback ladder
+    fb0 = pool.route_stats["disagg_handoff_fallbacks"]
+    try:
+        out = pool.submit(list(p_b), max_new_tokens=mnt_b).result()
+    except typed as e:
+        results["lost"] += 1
+        out = None
+        outcome_b = f"refused:{type(e).__name__}"
+    if out is not None:
+        if out == want[tuple(p_b)]:
+            results["completed"] += 1
+            outcome_b = "completed"
+        else:
+            results["mismatched"] += 1
+            outcome_b = "mismatched"
+    fallbacks_b = pool.route_stats["disagg_handoff_fallbacks"] - fb0
+    assert outcome_b == "completed", (
+        f"resubmitted stream did not re-prefill token-identically "
+        f"after the decode replica died: {outcome_b}")
+    assert fallbacks_b >= 1, (
+        "resubmit against the dead decode side took no typed "
+        "handoff fallback")
+    obs.dump_flight_bundle(
+        flight_dir, "disagg-decode-kill", engine=prefill_eng,
+        pool=pool, extra={"phase": "decode_kill_post_handoff",
+                          "killed_idx": 1, "prefill_idx": 0,
+                          "streamed_before_kill": len(h._generated),
+                          "outcome": outcome_b})
+    pool.shutdown()
+    for eng in engines_b:
+        eng.shutdown()
+    for eng in engines_b:
+        check_quiesced(eng)
+    phase_b = {
+        "streamed_before_kill": len(h._generated),
+        "resubmits": 1,
+        "handoff_fallbacks": fallbacks_b,
+        "completed_token_identical": outcome_b == "completed",
+    }
+
+    assert results["lost"] == 0, \
+        f"disagg drill lost {results['lost']} admitted requests"
+    assert results["mismatched"] == 0, (
+        f"{results['mismatched']} disagg-drill completions diverged "
+        f"from greedy")
+
+    # ------------------------ the bundles on disk explain the drill
+    pull_fb_seen, handoff_fb_seen = False, False
+    for bdir in sorted(glob.glob(os.path.join(flight_dir, "*"))):
+        if not os.path.isdir(bdir):
+            continue
+        try:
+            b = obs.load_flight_bundle(bdir)
+        except Exception:  # noqa: BLE001  half-written dir: skip
+            continue
+        eng_names = {e.get("type") for e in
+                     (b.get("engine") or {}).get("events") or []}
+        pool_names = {e.get("type") for e in
+                      (b.get("pool") or {}).get("events") or []}
+        if (b.get("reason") == "disagg-prefill-kill"
+                and "pull_fallback" in eng_names):
+            pull_fb_seen = True
+        if (b.get("reason") == "disagg-decode-kill"
+                and "handoff_fallback" in pool_names):
+            handoff_fb_seen = True
+    assert pull_fb_seen, (
+        "no disagg-prefill-kill bundle carries a pull_fallback "
+        "event: the prefill kill is not flight-explained")
+    assert handoff_fb_seen, (
+        "no disagg-decode-kill bundle carries a handoff_fallback "
+        "event: the decode kill is not flight-explained")
+
+    return {
+        "prefill_kill_mid_handoff": phase_a,
+        "decode_kill_post_handoff": phase_b,
+        "requests": dict(results,
+                         admitted=sum(results.values())),
+        "flight": {
+            "prefill_kill_explained": True,
+            "decode_kill_explained": True,
+        },
+        "quiesced": True,
+    }
+
+
 def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
               max_new_tokens=10, stall_deadline_s=1.0,
               watchdog_poll_s=0.05, drain_timeout_s=2.0,
@@ -746,6 +1032,17 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
                                       seed, kv_dtype,
                                       max_new_tokens=8)
 
+    # ------------------------------- disaggregation fault drill
+    # Fresh role-split pools (1 prefill + 1 decode over the handoff
+    # path): kill the prefill replica mid-handoff (decode side aborts
+    # the pull typed and prefills in place, token-identical), then
+    # kill the decode replica post-handoff (partial stream fails
+    # typed; the resubmit lands decode-in-place on the prefill
+    # replica through the handoff-fallback ladder, token-identical).
+    # Hard-asserts inside; the artifact records the proof.
+    disagg = _run_disagg_phases(model, params, flight_dir, seed,
+                                kv_dtype)
+
     try:
         sha = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
@@ -775,7 +1072,16 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
             "and completes token-identically) and a replica is "
             "killed after its prefix migrated to a peer (the session "
             "resumes on the peer hitting the migrated pages, token-"
-            "identically); both faults are flight-explained."),
+            "identically); both faults are flight-explained. A "
+            "disaggregation fault drill follows: against role-split "
+            "1-prefill + 1-decode pools, the prefill replica is "
+            "killed mid-handoff (the decode side aborts the pull "
+            "typed and prefills in place, token-identically) and the "
+            "decode replica is killed post-handoff (the partial "
+            "stream fails typed; the resubmit lands decode-in-place "
+            "on the prefill replica through the typed handoff-"
+            "fallback ladder, token-identically); both "
+            "flight-explained."),
         "seed": seed,
         "mesh": {"tp": 1, "replicas": replicas},
         "knobs": {
@@ -823,6 +1129,7 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
             "summaries": bundles,
         },
         "kv_migration": migration,
+        "disagg": disagg,
         "quiesced": True,
         "wall_s": round(wall, 2),
         "git_sha": sha,

@@ -63,6 +63,20 @@ family:
   arm's cross-replica hit rate is not strictly above the local
   arm's (or it pulled nothing), when the TTFT p50 ratio is missing
   or >= 1.0, or when the kv/mesh/seed stamp is missing.
+- SERVE_BENCH disagg A/B (serve_bench.py --disagg-ab): {disagg_ab:
+  {unified, disagg, token_identical, ttft_p50_ratio,
+  throughput_ratio, kv_pull, autoscale, chaos}, mesh, kv, seed} —
+  the identical 2-replica pool + arrival trace served unified vs
+  role-split (prefill replica hands finished pages to the decode
+  replica over the KV-migration seam). REFUSED when the arms were
+  not token-identical across the handoff, when the disagg arm made
+  zero handoffs, when the steady-state TTFT p50 ratio is missing or
+  >= 1.0, when the throughput ratio is missing or < 1.0, when the
+  per-role autoscale phase did not scale the role pools apart (or
+  made no scale decision), when the chaos arm is missing /
+  faultless / lossy / not token-identical through the
+  decode-in-place fallback, or when the role/kv-pull/mesh/kv/seed
+  stamp is missing.
 - SERVE_BENCH autoscale (serve_bench.py --autoscale): {trace, seed,
   slo, autoscale, static_max, chip_seconds_ratio} — REFUSED when
   autoscale SLO attainment is below the floor the run itself
@@ -275,6 +289,17 @@ PREFIX_SHARE_ARM_REQUIRED = {
     "cross_replica_hit_rate": NUM,
     "pull_hints": NUM,
     "tokens": int,
+}
+
+# disaggregation A/B artifacts carry one of these per arm
+# (serve_bench.py run_disagg_ab): the same 2-replica pool + arrival
+# trace served unified vs role-split over the KV-migration handoff
+DISAGG_ARM_REQUIRED = {
+    "ttft_p50_s": NUM,
+    "tokens": int,
+    "tok_per_s": NUM,
+    "handoffs": int,
+    "handoff_fallbacks": int,
 }
 
 # batch-tier profile A/B artifacts carry one of these per arm
@@ -951,6 +976,161 @@ def check_prefix_share_ab(obj, name, problems):
             "saved nothing on the wire")
 
 
+def check_disagg_ab(obj, name, problems):
+    """serve_bench.py --disagg-ab artifact: the identical 2-replica
+    pool + decode-saturating arrival trace served unified (both
+    replicas mixed prefill+decode) vs disaggregated (1 prefill-role +
+    1 decode-role replica joined by the KV-migration handoff path —
+    serve/engine_pool.py roles). The checker REFUSES artifacts whose
+    arms were not token-identical across the handoff (a handoff that
+    changes greedy tokens is broken, whatever its TTFT), whose disagg
+    arm made zero handoffs (nothing was disaggregated), whose
+    steady-state TTFT p50 ratio is missing or >= 1.0 (the
+    interference-free prefill replica must beat unified, or the
+    artifact documents a regression), whose throughput ratio is
+    missing or < 1.0 (disaggregation must not cost tokens/chip-s at
+    equal chip count), whose per-role autoscale phase is missing or
+    did not scale the role pools APART on the same burst (or made no
+    scale-up decision at all), whose chaos arm is missing, faultless,
+    lossy, or not token-identical through the decode-in-place
+    fallback, or without its role/kv-pull/mesh/kv/seed stamps (a
+    handoff latency from unstamped pull knobs is not comparable to
+    anything)."""
+    _check_mesh(obj, name, problems, required=True)
+    if not isinstance(obj.get("seed"), int) \
+            or isinstance(obj.get("seed"), bool):
+        problems.append(f"{name}: disagg A/B artifact missing int "
+                        "'seed'")
+    kv = obj.get("kv")
+    if not isinstance(kv, dict) or not isinstance(
+            kv.get("kv_dtype"), str):
+        problems.append(
+            f"{name}: missing the kv stamp ({{kv_dtype, "
+            "paged_kernel}}) — handoff wire bytes from an unstamped "
+            "page dtype are not comparable")
+    ab = obj.get("disagg_ab")
+    if not isinstance(ab, dict):
+        problems.append(f"{name}: disagg_ab must be an object")
+        return
+    for arm in ("unified", "disagg"):
+        sec = ab.get(arm)
+        if not isinstance(sec, dict):
+            problems.append(f"{name}:disagg_ab: missing {arm} arm "
+                            "object")
+            continue
+        _check_fields(sec, DISAGG_ARM_REQUIRED,
+                      f"{name}:disagg_ab:{arm}", problems)
+        km = sec.get("kv_migration")
+        if not isinstance(km, dict):
+            problems.append(f"{name}:disagg_ab:{arm}: missing the "
+                            "kv_migration counter block")
+        else:
+            _check_fields(km, KV_MIGRATION_REQUIRED,
+                          f"{name}:disagg_ab:{arm}:kv_migration",
+                          problems)
+    if ab.get("token_identical") is not True:
+        problems.append(
+            f"{name}: disagg streams were not token-identical to "
+            "unified — a handoff that changes greedy tokens is "
+            "broken, whatever its TTFT")
+    dis = ab.get("disagg")
+    if isinstance(dis, dict):
+        h = dis.get("handoffs")
+        if isinstance(h, int) and not isinstance(h, bool) and h < 1:
+            problems.append(
+                f"{name}:disagg_ab: disagg arm made zero handoffs — "
+                "nothing was disaggregated; the arm measured a "
+                "mislabeled unified pool")
+        roles = dis.get("roles")
+        if not isinstance(roles, dict) \
+                or not roles.get("prefill") or not roles.get("decode"):
+            problems.append(
+                f"{name}:disagg_ab: disagg arm missing the role "
+                "stamp ({{prefill: n, decode: n}}) — an unstamped "
+                "topology is not a disaggregation measurement")
+    ratio = ab.get("ttft_p50_ratio")
+    if not isinstance(ratio, NUM) or isinstance(ratio, bool):
+        problems.append(f"{name}: disagg A/B artifact missing "
+                        "numeric ttft_p50_ratio")
+    elif ratio >= 1.0:
+        problems.append(
+            f"{name}:disagg_ab: ttft_p50_ratio {ratio} >= 1.0 — the "
+            "interference-free prefill replica did not beat unified "
+            "TTFT")
+    tr = ab.get("throughput_ratio")
+    if not isinstance(tr, NUM) or isinstance(tr, bool):
+        problems.append(f"{name}: disagg A/B artifact missing "
+                        "numeric throughput_ratio")
+    elif tr < 1.0:
+        problems.append(
+            f"{name}:disagg_ab: throughput_ratio {tr} < 1.0 — "
+            "disaggregation paid tokens/chip-s for its TTFT; the "
+            "regime is mis-tuned")
+    kp = ab.get("kv_pull")
+    if not isinstance(kp, dict) \
+            or not isinstance(kp.get("deadline_s"), NUM) \
+            or isinstance(kp.get("deadline_s"), bool) \
+            or not isinstance(kp.get("backoff_s"), NUM) \
+            or isinstance(kp.get("backoff_s"), bool):
+        problems.append(
+            f"{name}:disagg_ab: missing the kv_pull stamp "
+            "({{deadline_s, backoff_s}}) — a handoff latency from "
+            "unstamped pull knobs is not reproducible")
+    asc = ab.get("autoscale")
+    if not isinstance(asc, dict):
+        problems.append(f"{name}:disagg_ab: missing the per-role "
+                        "'autoscale' phase block")
+    else:
+        if asc.get("diverged") is not True:
+            problems.append(
+                f"{name}:disagg_ab: role pools did not diverge "
+                "under the prefill burst — per-role autoscaling was "
+                "not demonstrated")
+        ups = 0
+        for role in ("prefill", "decode"):
+            sec = asc.get(role)
+            if not isinstance(sec, dict):
+                problems.append(f"{name}:disagg_ab:autoscale: "
+                                f"missing the {role} scaler block")
+                continue
+            su = sec.get("scale_ups")
+            if isinstance(su, int) and not isinstance(su, bool):
+                ups += su
+        if ups < 1:
+            problems.append(
+                f"{name}:disagg_ab:autoscale: no scaler made a "
+                "scale-up decision — the phase measured an idle "
+                "pool")
+    chaos = ab.get("chaos")
+    if not isinstance(chaos, dict):
+        problems.append(f"{name}:disagg_ab: missing the 'chaos' "
+                        "decode-kill arm")
+    else:
+        fi = chaos.get("faults_injected")
+        if not isinstance(fi, int) or isinstance(fi, bool) or fi < 1:
+            problems.append(
+                f"{name}:disagg_ab:chaos: campaign injected no "
+                "faults — the fallback ladder was never exercised")
+        fb = chaos.get("handoff_fallbacks")
+        if not isinstance(fb, int) or isinstance(fb, bool) or fb < 1:
+            problems.append(
+                f"{name}:disagg_ab:chaos: decode kill produced no "
+                "typed handoff fallback — the abort path was never "
+                "taken")
+        for key in ("lost", "mismatched"):
+            v = chaos.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) \
+                    or v != 0:
+                problems.append(
+                    f"{name}:disagg_ab:chaos: {key} must be 0 — "
+                    "disaggregation may cost time, never "
+                    "correctness")
+        if chaos.get("token_identical") is not True:
+            problems.append(
+                f"{name}:disagg_ab:chaos: decode-in-place fallback "
+                "was not token-identical to the greedy reference")
+
+
 def check_batch_ab(obj, name, problems):
     """serve_bench.py --batch-ab artifact: one offline corpus through
     BatchInferenceJob on an engine built from the 'latency' vs
@@ -1107,6 +1287,14 @@ def check_serve_bench(obj, name, problems):
     if "mixed_ab" in obj:
         # mixed online+batch A/B family (serve_bench.py --mixed-ab)
         check_mixed_ab(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
+    if "disagg_ab" in obj:
+        # prefill/decode disaggregation A/B family (serve_bench.py
+        # --disagg-ab)
+        check_disagg_ab(obj, name, problems)
         sha = obj.get("git_sha")
         if sha is not None and not isinstance(sha, str):
             problems.append(f"{name}: git_sha must be a string")
@@ -1487,6 +1675,80 @@ def check_serve_chaos(obj, name, problems):
                 problems.append(
                     f"{name}:kv_migration: migration-drill pools "
                     "did not quiesce leak-free")
+    # Disaggregation fault drill (validated-if-present; campaigns
+    # predating role-split pools carry no block and still pass): the
+    # checker REFUSES a drill where the prefill kill mid-handoff
+    # produced no typed decode-in-place fallback, the decode kill
+    # post-handoff produced no resubmit, either phase completed
+    # non-token-identically, any drill request was lost or
+    # mismatched, either kill is not flight-explained, or the pools
+    # leaked pages.
+    dz = obj.get("disagg")
+    if dz is not None:
+        if not isinstance(dz, dict):
+            problems.append(f"{name}: disagg must be an object")
+        else:
+            pk = dz.get("prefill_kill_mid_handoff")
+            if not isinstance(pk, dict):
+                problems.append(f"{name}:disagg: missing the "
+                                "'prefill_kill_mid_handoff' phase "
+                                "block")
+            else:
+                fb = pk.get("fallbacks")
+                if not isinstance(fb, int) or isinstance(fb, bool) \
+                        or fb < 1:
+                    problems.append(
+                        f"{name}:disagg: prefill kill mid-handoff "
+                        "produced no typed decode-in-place fallback "
+                        "— the abort path was never exercised")
+                if pk.get("completed_token_identical") is not True:
+                    problems.append(
+                        f"{name}:disagg: the handed-off request did "
+                        "not complete token-identically after the "
+                        "prefill replica died")
+            dk = dz.get("decode_kill_post_handoff")
+            if not isinstance(dk, dict):
+                problems.append(f"{name}:disagg: missing the "
+                                "'decode_kill_post_handoff' phase "
+                                "block")
+            else:
+                rs = dk.get("resubmits")
+                if not isinstance(rs, int) or isinstance(rs, bool) \
+                        or rs < 1:
+                    problems.append(
+                        f"{name}:disagg: decode kill post-handoff "
+                        "produced no resubmit — the partial-stream "
+                        "recovery was never exercised")
+                if dk.get("completed_token_identical") is not True:
+                    problems.append(
+                        f"{name}:disagg: the stream did not "
+                        "re-prefill token-identically after the "
+                        "decode replica died")
+            dreq = dz.get("requests")
+            if isinstance(dreq, dict):
+                for key in ("lost", "mismatched"):
+                    v = dreq.get(key)
+                    if isinstance(v, int) and not isinstance(v, bool) \
+                            and v != 0:
+                        problems.append(
+                            f"{name}:disagg: {v} {key} request(s) "
+                            "in the disaggregation drill")
+            dfl = dz.get("flight")
+            if not isinstance(dfl, dict):
+                problems.append(f"{name}:disagg: missing the "
+                                "'flight' explanation block")
+            else:
+                for key, what in (
+                        ("prefill_kill_explained", "prefill kill"),
+                        ("decode_kill_explained", "decode kill")):
+                    if dfl.get(key) is not True:
+                        problems.append(
+                            f"{name}:disagg: no flight bundle "
+                            f"explains the {what}")
+            if dz.get("quiesced") is not True:
+                problems.append(
+                    f"{name}:disagg: disaggregation-drill pools did "
+                    "not quiesce leak-free")
     sha = obj.get("git_sha")
     if sha is not None and not isinstance(sha, str):
         problems.append(f"{name}: git_sha must be a string")

@@ -10,6 +10,10 @@ each request's TTFT is recomputed from its raw submit/first_token
 event timestamps and compared against the engine-stamped ``ttft_s``
 riding in the first_token event — they must agree to within 1ms or
 the phase spans don't mean what they claim (ISSUE 10 acceptance).
+When the event log carries pool ``handoff`` events (disaggregated
+role-split pools, serve/engine_pool.py), the report also derives the
+handoff latency — prefill-done to first decode token on the target
+replica, paired by trace id.
 
 Given a DIRECTORY instead of a file, it reads a CLUSTER flight
 bundle (serve/fleet/telemetry.py dump_cluster_bundle): the trigger,
@@ -97,6 +101,7 @@ def report(artifact: Dict[str, Any]) -> Dict[str, Any]:
         "requests": rows,
         "phase_percentiles": percentiles,
         "rounds": _round_stats(events),
+        "handoffs": _handoff_stats(events),
         "ttft_check": {
             "n": len(errs),
             "max_abs_err_s": round(max(errs), 6) if errs else None,
@@ -144,6 +149,56 @@ def _round_stats(events: List[Dict[str, Any]]
         "host_gap_p50_s": round(_pct(gaps, 0.50), 6),
         "host_gap_p99_s": round(_pct(gaps, 0.99), 6),
         "round_wall_p50_s": round(_pct(walls, 0.50), 6),
+    }
+
+
+def _handoff_stats(events: List[Dict[str, Any]]
+                   ) -> Optional[Dict[str, Any]]:
+    """Disaggregation handoff latency, derived from the pool's typed
+    events (serve/engine_pool.py): each ``handoff`` event (prefill
+    leg done, decode leg admitted with the finished-prefill pull
+    hint) is paired BY TRACE ID with the ``handoff_first_token``
+    event of the same request (first decode token on the target
+    replica). The interval is what the role split costs one stream —
+    the KV-migration pull plus residual admission on the decode side
+    — and is the number to watch when tuning the pull deadline /
+    backoff knobs (LlamaDeployment kv_pull_deadline_s /
+    kv_pull_backoff_s). ``handoff_fallback`` events are counted
+    alongside: a fallback is one typed abort that decoded in place
+    instead. None when the artifact carries no handoff events
+    (unified pools, single engines)."""
+    starts: Dict[str, float] = {}
+    lats: List[float] = []
+    fallbacks = 0
+    for ev in events:
+        et = ev.get("type")
+        if et == "handoff_fallback":
+            fallbacks += 1
+            continue
+        if et not in ("handoff", "handoff_first_token"):
+            continue
+        d = ev.get("data") or {}
+        tid = d.get("trace_id")
+        t = ev.get("t")
+        if tid is None or not isinstance(t, (int, float)):
+            continue
+        if et == "handoff":
+            starts.setdefault(str(tid), t)
+        else:
+            t0 = starts.get(str(tid))
+            if t0 is not None:
+                lats.append(t - t0)
+    if not starts and not fallbacks:
+        return None
+    return {
+        "handoffs": len(starts),
+        "paired": len(lats),
+        "fallbacks": fallbacks,
+        "latency_p50_s": (round(_pct(lats, 0.50), 6)
+                          if lats else None),
+        "latency_p95_s": (round(_pct(lats, 0.95), 6)
+                          if lats else None),
+        "latency_max_s": round(max(lats), 6) if lats else None,
     }
 
 
@@ -286,6 +341,16 @@ def main(argv: List[str]) -> int:
               f"round_wall p50={rd['round_wall_p50_s'] * 1e3:8.2f}ms")
         print(f"  host_gap_fraction={rd['host_gap_fraction']}  "
               f"overlap_efficiency={rd['overlap_efficiency']}")
+    ho = rep.get("handoffs")
+    if ho:
+        print(f"\ndisagg handoffs (n={ho['handoffs']}, "
+              f"paired={ho['paired']}, "
+              f"fallbacks={ho['fallbacks']}):")
+        if ho["paired"]:
+            print(f"  prefill-done -> first-decode-token latency  "
+                  f"p50={ho['latency_p50_s'] * 1e3:8.2f}ms  "
+                  f"p95={ho['latency_p95_s'] * 1e3:8.2f}ms  "
+                  f"max={ho['latency_max_s'] * 1e3:8.2f}ms")
     chk = rep["ttft_check"]
     print(f"\nttft cross-check: n={chk['n']} "
           f"max_abs_err={chk['max_abs_err_s']}s "
