@@ -151,28 +151,31 @@ def load_manifest(path: str) -> Dict[str, Any]:
 
 
 def verify_checkpoint_dir(path: str, deep: bool = False
-                          ) -> Tuple[bool, Optional[str]]:
+                          ) -> Tuple[bool, Optional[str],
+                                     Optional[Dict[str, Any]]]:
     """Is ``path`` a complete committed checkpoint? Shallow mode checks
     the manifest parses and every listed file exists with the recorded
     byte count; ``deep`` re-hashes contents (catches silent corruption,
-    not just truncation). Returns (ok, reason_if_not)."""
+    not just truncation). Returns (ok, reason_if_not, parsed_manifest)
+    — the manifest rides along so callers that need ``step`` or the
+    file table never re-read ``manifest.json`` after verifying."""
     try:
         manifest = load_manifest(path)
     except InvalidCheckpointError as e:
-        return False, e.reason
+        return False, e.reason, None
     for rel, rec in manifest["files"].items():
         full = os.path.join(path, rel)
         if not os.path.isfile(full):
-            return False, f"manifest lists missing file {rel!r}"
+            return False, f"manifest lists missing file {rel!r}", manifest
         if os.path.getsize(full) != rec.get("bytes"):
             return False, (f"file {rel!r} is {os.path.getsize(full)}B, "
-                           f"manifest says {rec.get('bytes')}B")
+                           f"manifest says {rec.get('bytes')}B"), manifest
         if deep and _sha256(full) != rec.get("sha256"):
-            return False, f"file {rel!r} fails its manifest hash"
+            return False, f"file {rel!r} fails its manifest hash", manifest
     # Extra payload files not in the manifest mean the directory was
     # tampered with after commit; tolerate (orbax may leave lockfiles)
     # but a missing/short file above is always fatal.
-    return True, None
+    return True, None, manifest
 
 
 class Checkpoint:
@@ -195,7 +198,7 @@ class Checkpoint:
     def from_directory(cls, path: str) -> "Checkpoint":
         if not os.path.isdir(path):
             raise FileNotFoundError(path)
-        ok, reason = verify_checkpoint_dir(path)
+        ok, reason, _manifest = verify_checkpoint_dir(path)
         if not ok:
             raise InvalidCheckpointError(path, reason)
         return cls(path=path)

@@ -34,7 +34,7 @@ import jax
 import numpy as np
 
 from ray_tpu.air.checkpoint import (Checkpoint, InvalidCheckpointError,
-                                    load_manifest, verify_checkpoint_dir)
+                                    verify_checkpoint_dir)
 
 logger = logging.getLogger(__name__)
 
@@ -208,7 +208,7 @@ class CheckpointManager:
         corrupted directories are skipped with a warning — resume must
         never load them — and the next-older complete one wins."""
         for step, path in reversed(self._scan()):
-            ok, reason = verify_checkpoint_dir(path, deep=True)
+            ok, reason, _manifest = verify_checkpoint_dir(path, deep=True)
             if ok:
                 return Checkpoint.from_directory(path)
             logger.warning("skipping torn checkpoint %s: %s", path,
@@ -219,11 +219,9 @@ class CheckpointManager:
         """Step of :meth:`latest_complete`'s winner (manifest-recorded),
         None when no complete checkpoint exists."""
         for step, path in reversed(self._scan()):
-            if verify_checkpoint_dir(path, deep=True)[0]:
-                try:
-                    mstep = load_manifest(path).get("step")
-                except InvalidCheckpointError:
-                    mstep = None
+            ok, _reason, manifest = verify_checkpoint_dir(path, deep=True)
+            if ok:
+                mstep = manifest.get("step")
                 return mstep if isinstance(mstep, int) else step
         return None
 

@@ -91,6 +91,8 @@ CONTAINED_TOTAL = "serve_engine_contained_faults_total"
 RETRIES_TOTAL = "serve_engine_retries_total"
 BATCH_TOKENS_TOTAL = "serve_batch_tokens_total"
 BATCH_PREEMPTED_TOTAL = "serve_batch_preempted_total"
+WEIGHT_SWAP_TOTAL = "serve_weight_swap_total"
+WEIGHT_ROLLBACK_TOTAL = "serve_weight_rollback_total"
 
 _METRICS: Optional[dict] = None
 
@@ -127,8 +129,39 @@ def _metrics() -> dict:
                 BATCH_PREEMPTED_TOTAL, "BATCH-lane slots preempted "
                 "— yielded to online traffic or page pressure; the "
                 "request requeues and recomputes/prefix-resumes"),
+            "weight_swaps": metrics.Counter(
+                WEIGHT_SWAP_TOTAL, "In-place hot weight swaps "
+                "applied (monotonic generation-fence flips between "
+                "scheduler rounds)"),
+            "weight_rollbacks": metrics.Counter(
+                WEIGHT_ROLLBACK_TOTAL, "Fleet rollout rollbacks: a "
+                "canaried generation failed its health/parity gates "
+                "and the controller re-installed the old payload "
+                "under a fresh generation"),
         }
     return _METRICS
+
+
+WEIGHT_GENERATION_GAUGE = "serve_weight_generation"
+
+_WEIGHT_GEN_GAUGE = None
+
+
+def _weight_generation_gauge():
+    """Lazy singleton for the per-replica weight-generation gauge
+    (clear_registry()-proof, same pattern as _metrics())."""
+    global _WEIGHT_GEN_GAUGE
+    from ray_tpu.util import metrics
+    if (_WEIGHT_GEN_GAUGE is None
+            or metrics.registry().get(WEIGHT_GENERATION_GAUGE)
+            is not _WEIGHT_GEN_GAUGE):
+        _WEIGHT_GEN_GAUGE = metrics.Gauge(
+            WEIGHT_GENERATION_GAUGE,
+            "Weight generation currently serving on each replica "
+            "(the monotonic swap fence; rollback still advances it "
+            "— weights_id names the payload)",
+            tag_keys=("replica",))
+    return _WEIGHT_GEN_GAUGE
 
 
 KV_BYTES_TOTAL = "serve_kv_bytes_total"
@@ -237,6 +270,19 @@ class RequestHandle:
     @property
     def error(self) -> Optional[BaseException]:
         return self._req.error
+
+    @property
+    def weights_tag(self) -> Optional[str]:
+        """``generation:weights_id`` of the serving engine at read
+        time (the X-Model-Generation header value) — which weight
+        payload a mid-rollout client was actually served by."""
+        eng = self._engine
+        if eng is None:
+            return None
+        gen = getattr(eng, "weight_generation", None)
+        if gen is None:
+            return None
+        return f"{gen}:{getattr(eng, 'weights_id', None)}"
 
     def stream(self):
         """Yield generated token ids as they are produced."""
@@ -444,6 +490,18 @@ class LLMEngine:
         if sharding is not None:
             params = sharding.shard_params(params)
         self.params = params
+        # Weight-generation fence (live rollout, serve/weight_rollout):
+        # strictly monotonic — every ``swap_weights`` must advance it,
+        # including rollbacks (which install the OLD payload under a
+        # NEW generation). ``weights_id`` names the payload itself so
+        # convergence proofs can tell "rolled forward" from "rolled
+        # back" when the generation alone cannot. ``replica_tag`` is
+        # stamped by the pool (like ``role``) so the per-replica
+        # generation gauge is attributable.
+        self.weight_generation = 0
+        self.weights_id = "g0"
+        self.replica_tag = "0"
+        self._pending_swap: Optional[Dict[str, Any]] = None
         self.S = max_slots
         self.Pg = page_size
         self.K = chunk
@@ -830,6 +888,149 @@ class LLMEngine:
             time.sleep(0.005)
         return True
 
+    # ------------------------------------------- live weight rollout
+
+    def swap_weights(self, params, *, generation: Optional[int] = None,
+                     weights_id: Optional[str] = None,
+                     mode: str = "preempt", wait: bool = True,
+                     timeout_s: float = 120.0) -> int:
+        """In-place hot weight swap under traffic.
+
+        The new payload is staged onto the device OFF the engine lock
+        (the double buffer: the old generation keeps serving while the
+        transfer runs), then the flip happens between scheduler rounds
+        — ``step()`` holds the engine lock for its entire round, so
+        taking the lock here IS the inter-round boundary.
+
+        ``mode="preempt"`` (default) flips immediately: trailing
+        readbacks are drained so every victim's generated-so-far is
+        complete, every active slot is preempted through the ordinary
+        token-identical recompute path (the same arm replica death
+        uses — prompt + generated re-prefill at the queue front), the
+        prefix cache is cleared (no KV computed under the old weights
+        may ever be matched against new-weight decode; per-slot spec
+        proposers die with their slots), and the fence advances.
+
+        ``mode="drain"`` pauses admission and applies the same flip
+        once every slot, trailing readback, and pending prefill has
+        settled — in-flight requests finish wholly on the old weights.
+
+        The fence is strictly monotonic: a ``generation`` at or below
+        the current one is refused with ``ValueError``. Roll BACK by
+        installing the old payload under a NEW generation (a distinct
+        ``weights_id`` names the payload). Returns the generation
+        serving after the swap (with ``wait=False`` in drain mode:
+        the generation that WILL serve once the drain settles)."""
+        if mode not in ("preempt", "drain"):
+            raise ValueError(f"unknown swap mode {mode!r}; expected "
+                             f"'preempt' or 'drain'")
+        if self._sharding is not None:
+            staged = self._sharding.shard_params(params)
+        else:
+            staged = jax.tree_util.tree_map(jnp.asarray, params)
+        jax.block_until_ready(jax.tree_util.tree_leaves(staged))
+        with self._work:
+            if self._stopped:
+                raise EngineShutdown(
+                    "cannot swap weights: engine stopped")
+            gen = (self.weight_generation + 1 if generation is None
+                   else int(generation))
+            if gen <= self.weight_generation:
+                raise ValueError(
+                    f"weight-generation fence is monotonic: requested "
+                    f"generation {gen} <= current "
+                    f"{self.weight_generation} (install the old "
+                    f"payload under a NEW generation to roll back)")
+            wid = weights_id if weights_id is not None else f"g{gen}"
+            if mode == "preempt":
+                self._apply_swap_locked(staged, gen, wid, mode)
+                self._work.notify_all()
+                return gen
+            if self._pending_swap is not None:
+                raise RuntimeError(
+                    "a drain-mode weight swap is already pending "
+                    f"(generation "
+                    f"{self._pending_swap['generation']})")
+            pend = {"params": staged, "generation": gen,
+                    "weights_id": wid, "applied": False,
+                    "event": threading.Event()}
+            self._pending_swap = pend
+            self.events.append("weight_swap_pending",
+                               data={"generation": gen,
+                                     "weights_id": wid})
+            self._work.notify_all()
+        if not wait:
+            return gen
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while not pend["event"].wait(timeout=0.05):
+            if self._stopped:
+                raise EngineShutdown(
+                    "engine stopped with a weight swap pending")
+            if time.monotonic() >= deadline:
+                with self._work:
+                    if self._pending_swap is pend:
+                        self._pending_swap = None
+                raise TimeoutError(
+                    f"drain-mode weight swap to generation {gen} did "
+                    f"not apply within {timeout_s}s")
+        if not pend["applied"]:
+            raise EngineShutdown(
+                "engine stopped with a weight swap pending")
+        return gen
+
+    def _maybe_apply_pending_swap_locked(self) -> None:
+        """Apply a pending drain-mode swap iff the engine has fully
+        settled (no slots, no trailing readbacks, no in-flight
+        prefills). Called between rounds by ``step()``."""
+        pend = self._pending_swap
+        if pend is None:
+            return
+        if (any(s is not None for s in self.slots) or self._fetchq
+                or self._pending_prefill):
+            return
+        self._pending_swap = None
+        self._apply_swap_locked(pend["params"], pend["generation"],
+                                pend["weights_id"], "drain")
+        pend["applied"] = True
+        pend["event"].set()
+
+    def _apply_swap_locked(self, staged, gen: int, wid: str,
+                           mode: str) -> None:
+        """The inter-round flip. Caller holds the engine lock and has
+        validated the fence."""
+        # settle trailing readbacks first so every preemption victim's
+        # generated-so-far is complete before its recompute prompt
+        # freezes (token-identity across the swap)
+        self._drain_fetches_locked()
+        preempted = 0
+        for i in range(len(self.slots)):
+            victim = self.slots[i]
+            if victim is None:
+                continue
+            self._preempt_locked(i)
+            if victim.preempted:
+                preempted += 1
+        # the fence's cache half: every slot was preempted (all shared
+        # references released), so clear() evicts the whole radix tree
+        # — no old-generation KV page survives to be matched against
+        # new-generation decode
+        evicted = 0
+        if self.prefix_cache is not None:
+            evicted = self.prefix_cache.clear()
+        self.params = staged
+        self.weight_generation = gen
+        self.weights_id = wid
+        self.stats["weight_swaps"] += 1
+        _metrics()["weight_swaps"].inc()
+        _weight_generation_gauge().set(
+            float(gen),
+            tags={"replica": str(getattr(self, "replica_tag", "0"))})
+        self.events.append("weight_swap", data={
+            "generation": gen, "weights_id": wid, "mode": mode,
+            "preempted": preempted,
+            "prefix_pages_evicted": evicted})
+        self._hb = time.monotonic()
+
     def load_report(self) -> Dict[str, Any]:
         """Compact load snapshot for pool routing: free capacity,
         queue pressure, outstanding token work, and the prefix-cache
@@ -886,6 +1087,8 @@ class LLMEngine:
                 "ttft_ewma_s": self._ttft_ewma,
                 "itl_ewma_s": self._itl_ewma,
                 "role": self.role,
+                "weight_generation": self.weight_generation,
+                "weights_id": self.weights_id,
                 "draining": self._draining,
                 "stopped": self._stopped,
                 "heartbeat_age_s": time.monotonic() - self._hb,
@@ -933,6 +1136,8 @@ class LLMEngine:
                 "ttft_ewma_s": self._ttft_ewma,
                 "itl_ewma_s": self._itl_ewma,
                 "role": self.role,
+                "weight_generation": self.weight_generation,
+                "weights_id": self.weights_id,
                 "draining": self._draining,
                 "stopped": self._stopped,
                 "heartbeat_age_s": time.monotonic() - self._hb,
@@ -987,6 +1192,9 @@ class LLMEngine:
                 fail(slot.req)
         for req in list(self._wait):
             fail(req)
+        pend, self._pending_swap = self._pending_swap, None
+        if pend is not None:
+            pend["event"].set()   # waiter sees applied=False + raises
         self.stats["force_killed"] += 1
 
     def shutdown(self):
@@ -1036,6 +1244,9 @@ class LLMEngine:
             self._pending_prefill.clear()
             while self._wait:
                 self._fail_req_locked(self._wait.popleft(), err)
+            pend, self._pending_swap = self._pending_swap, None
+            if pend is not None:
+                pend["event"].set()   # waiter raises EngineShutdown
 
     def _cancel(self, req: _Request,
                 error: Optional[BaseException] = None) -> bool:
@@ -1239,6 +1450,10 @@ class LLMEngine:
                 # dispatch earlier. Never blocks.
                 self._drain_fetches_locked(ready_only=True)
             _gap = time.monotonic() - _tg
+            if self._pending_swap is not None:
+                # drain-mode weight swap: admission is paused; flip
+                # here — between rounds — once everything settled
+                self._maybe_apply_pending_swap_locked()
             self._admit_locked()
             if not any(self.slots):
                 if self._fetchq or self._pending_prefill:
@@ -1487,7 +1702,11 @@ class LLMEngine:
                 while (not self._stopped and not self._wait
                        and not any(self.slots)
                        and not self._fetchq
-                       and not self._pending_prefill):
+                       and not self._pending_prefill
+                       and self._pending_swap is None):
+                    # a pending drain-mode weight swap is work: the
+                    # settled engine must run one more round so the
+                    # flip lands between rounds, not never
                     self._work.wait()
                 if self._stopped:
                     # deliver every token already computed before
@@ -1648,6 +1867,10 @@ class LLMEngine:
         head waits (for a slot or for pages), the lane order also
         guarantees no batch request can slip past it into capacity it
         frees."""
+        if self._pending_swap is not None:
+            # drain-mode weight swap pending: admission pauses so the
+            # active set settles and the flip can land between rounds
+            return
         while self._wait:
             req = self._next_admit_locked()
             if req is None:

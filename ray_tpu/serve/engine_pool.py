@@ -457,6 +457,19 @@ class PoolRequestHandle(ResubmitPolicy):
         return (f"{rep.idx}:{rep.generation}"
                 if rep is not None else None)
 
+    @property
+    def weights_tag(self) -> Optional[str]:
+        """``generation:weights_id`` of the serving replica's engine
+        (the X-Model-Generation header value). A resubmit that lands
+        mid-rollout on a replica serving a different payload shows a
+        different tag."""
+        rep = self._rep
+        eng = getattr(rep, "engine", None) if rep is not None else None
+        gen = getattr(eng, "weight_generation", None)
+        if gen is None:
+            return None
+        return f"{gen}:{getattr(eng, 'weights_id', None)}"
+
     # -------------------------------------------------------- internal
 
     def _resubmit(self, cause: BaseException) -> None:
@@ -583,10 +596,17 @@ class EnginePool:
         # role -> RolePoolView, registered by the views themselves:
         # per-role autoscaler attachment points + pool_stats blocks
         self._role_views: Dict[str, Any] = {}
+        # Current-weights source (live rollout, serve/weight_rollout):
+        # the factory closes over the ORIGINAL params, so without this
+        # a replica rebuilt after a mid-rollout death would rejoin the
+        # fleet on stale weights. ``set_weight_source`` records the
+        # payload every rebuild/add must be re-stamped to.
+        self._weight_source: Optional[Dict[str, Any]] = None
         self._replicas: List[_Replica] = []
         for i in range(num_replicas):
             eng = engine_factory(i)
             self._stamp_role(eng, roles[i])
+            self._stamp_replica_tag(eng, i)
             eng.start()
             rep = _Replica(i, eng, role=roles[i])
             self._replicas.append(rep)
@@ -604,6 +624,78 @@ class EnginePool:
             engine.role = role
         except Exception:
             pass
+
+    @staticmethod
+    def _stamp_replica_tag(engine, idx: int) -> None:
+        """Stamp the pool index onto the engine so its per-replica
+        metrics (the ``serve_weight_generation`` gauge) are
+        attributable. Same best-effort contract as ``_stamp_role``."""
+        try:
+            engine.replica_tag = str(idx)
+        except Exception:
+            pass
+
+    def _restamp_weights(self, rep: _Replica) -> None:
+        """Bring a freshly built replica onto the pool's CURRENT
+        weights. The engine factory closes over the original params;
+        when a rollout has moved the fleet past them, a rebuilt or
+        added replica must not rejoin on generation 0 — that is the
+        kill-mid-swap hole. Best-effort: a failure leaves the replica
+        serving factory weights and is evented (the rollout
+        controller's convergence check will see the lagging
+        weights_id)."""
+        src = self._weight_source
+        eng = rep.engine
+        if src is None or not hasattr(eng, "swap_weights"):
+            return
+        try:
+            eng.swap_weights(src["params"],
+                             generation=src["generation"],
+                             weights_id=src["weights_id"])
+            self.events.append("weight_restamp", sid=rep.idx,
+                               data={"generation": src["generation"],
+                                     "weights_id": src["weights_id"]})
+        except Exception as e:  # noqa: BLE001
+            self.events.append("weight_restamp_failed", sid=rep.idx,
+                               data={"error": repr(e)})
+
+    def set_weight_source(self, params, *, weights_id: str,
+                          generation: int) -> None:
+        """Record the payload every future rebuild/add re-stamps to
+        (``None``-free contract: call after each completed rollout or
+        rollback so replica churn converges on the fleet's current
+        weights, not the factory's)."""
+        with self._lock:
+            self._weight_source = {"params": params,
+                                   "weights_id": weights_id,
+                                   "generation": int(generation)}
+        self.events.append("weight_source", data={
+            "generation": int(generation), "weights_id": weights_id})
+
+    def swap_replica_weights(self, idx: int, params, *,
+                             weights_id: Optional[str] = None,
+                             generation: Optional[int] = None,
+                             mode: str = "preempt") -> int:
+        """Hot-swap ONE replica's weights through the engine's
+        generation fence (``LLMEngine.swap_weights``). The staged
+        rollout controller drives canary waves through this. Returns
+        the generation now serving on that replica."""
+        with self._lock:
+            rep = self._replicas[idx]
+            if rep.state not in (HEALTHY, SUSPECT):
+                raise RuntimeError(
+                    f"replica {idx} is {rep.state}; only live "
+                    f"replicas can swap weights")
+        gen = rep.engine.swap_weights(params, generation=generation,
+                                      weights_id=weights_id,
+                                      mode=mode)
+        with self._lock:
+            self.route_stats["weight_swaps"] += 1
+        self.events.append("weight_swap", sid=idx,
+                           data={"generation": gen,
+                                 "weights_id": rep.engine.weights_id,
+                                 "mode": mode})
+        return gen
 
     # --------------------------------------------------------- public
 
@@ -842,11 +934,13 @@ class EnginePool:
         else:
             eng = self._factory(idx)
             self._stamp_role(eng, role)
+            self._stamp_replica_tag(eng, idx)
             eng.start()
             rep = _Replica(idx, eng, role=role)
             with self._lock:
                 self._replicas.append(rep)
             self._wire_kv(rep)
+            self._restamp_weights(rep)
         with self._lock:
             self.route_stats["replicas_added"] += 1
         return idx
@@ -939,6 +1033,7 @@ class EnginePool:
         old = self._replicas[idx]
         eng = self._factory(idx)
         self._stamp_role(eng, old.role)
+        self._stamp_replica_tag(eng, idx)
         eng.start()
         with self._lock:
             self._replicas[idx] = _Replica(
@@ -946,6 +1041,9 @@ class EnginePool:
                 generation=old.generation + 1, role=old.role)
             self.route_stats["restarts"] += 1
         self._wire_kv(self._replicas[idx])
+        # kill-mid-swap closure: the factory built the engine on the
+        # ORIGINAL params; converge it onto the pool's current weights
+        self._restamp_weights(self._replicas[idx])
         self.events.append("restart", sid=idx,
                            data={"generation": old.generation + 1})
         _metrics()["restarts"].inc()
@@ -1160,10 +1258,18 @@ class EnginePool:
         if Pg <= 0 or len(prompt) < Pg:
             return None
         chain = path_hashes(prompt, Pg)
+        # weight-generation fence, cross-replica half: a donor serving
+        # a DIFFERENT weight payload holds KV computed under weights
+        # the target does not run — matching it would decode new
+        # tokens against foreign-generation pages. Mid-rollout, pulls
+        # simply stay within each side of the fleet.
+        my_wid = reports.get(rep.idx, {}).get("weights_id")
 
         def cover(idx: int) -> int:
-            have = reports.get(idx, {}).get("prefix_digest") \
-                or frozenset()
+            rpt = reports.get(idx, {})
+            if rpt.get("weights_id") != my_wid:
+                return 0
+            have = rpt.get("prefix_digest") or frozenset()
             n = 0
             for h in chain:
                 if h not in have:
@@ -1558,6 +1664,15 @@ class EnginePool:
             if itl is not None:
                 agg["itl_ewma_s"] = itl if agg["itl_ewma_s"] \
                     is None else max(agg["itl_ewma_s"], itl)
+        # rollout visibility: the newest generation serving anywhere
+        # in the pool, and whether the fleet is mid-rollout (mixed
+        # payloads across live replicas)
+        agg["weight_generation"] = max(
+            (rpt.get("weight_generation", 0) for rpt in reports),
+            default=0)
+        wids = {rpt.get("weights_id") for rpt in reports
+                if rpt.get("weights_id") is not None}
+        agg["weights_mixed"] = len(wids) > 1
         return agg
 
     def pool_stats(self) -> Dict[str, Any]:
@@ -1568,7 +1683,14 @@ class EnginePool:
             reps = [{"idx": r.idx, "state": r.state,
                      "deaths": r.deaths,
                      "generation": r.generation,
-                     "role": r.role}
+                     "role": r.role,
+                     # weight fence state (pool incarnation
+                     # "generation" above is a DIFFERENT counter:
+                     # restarts, not rollouts)
+                     "weight_generation": getattr(
+                         r.engine, "weight_generation", 0),
+                     "weights_id": getattr(
+                         r.engine, "weights_id", None)}
                     for r in self._replicas]
             role_views = dict(self._role_views)
         routed = counters.get("routed", 0)

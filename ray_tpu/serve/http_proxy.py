@@ -159,6 +159,14 @@ class HTTPProxy:
                     and "X-Replica" in request.headers)
         if echo_rep:
             payload.setdefault("echo_replica", True)
+        # X-Model-Generation: same opt-in contract, but the tag names
+        # the WEIGHTS that served the call ("<generation>:<weights_id>")
+        # — the half of replica identity that a live rollout changes
+        # without restarting the process.
+        echo_gen = (isinstance(payload, dict)
+                    and "X-Model-Generation" in request.headers)
+        if echo_gen:
+            payload.setdefault("echo_generation", True)
         try:
             if stream:
                 return await self._dispatch_stream(request, handle,
@@ -172,9 +180,14 @@ class HTTPProxy:
             headers = {}
             if tid:
                 headers["X-Trace-Id"] = tid
-            if echo_rep and isinstance(result, dict) \
-                    and "replica" in result:
-                headers["X-Replica"] = str(result.pop("replica"))
+            if isinstance(result, dict) and \
+                    ((echo_rep and "replica" in result) or
+                     (echo_gen and "generation" in result)):
+                if echo_rep and "replica" in result:
+                    headers["X-Replica"] = str(result.pop("replica"))
+                if echo_gen and "generation" in result:
+                    headers["X-Model-Generation"] = \
+                        str(result.pop("generation"))
                 result = result.get("ids", result)
             return web.json_response({"result": result},
                                      headers=headers or None)
@@ -219,11 +232,17 @@ class HTTPProxy:
         headers = {"Content-Type": "application/x-ndjson"}
         if trace_id:
             headers["X-Trace-Id"] = trace_id
-        # Opted-in streams lead with a {"replica": ...} marker item
-        # (llm.py stream()): lift it into the header while we still
-        # CAN set headers, then pull the real first token.
-        if more and isinstance(first, dict) and "replica" in first:
-            headers["X-Replica"] = str(first["replica"])
+        # Opted-in streams lead with a marker item carrying "replica"
+        # and/or "generation" keys (llm.py stream()): lift them into
+        # headers while we still CAN set headers, then pull the real
+        # first token.
+        if more and isinstance(first, dict) and \
+                ("replica" in first or "generation" in first):
+            if "replica" in first:
+                headers["X-Replica"] = str(first["replica"])
+            if "generation" in first:
+                headers["X-Model-Generation"] = \
+                    str(first["generation"])
             try:
                 more, first = await loop.run_in_executor(self._pool,
                                                          _next)

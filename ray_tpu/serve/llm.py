@@ -589,6 +589,21 @@ class LlamaDeployment:
             kw["trace_id"] = tid
         return self.engine().submit(ids, **kw)
 
+    def _weights_tag(self, h) -> str:
+        """``generation:weights_id`` of whatever served ``h`` (the
+        X-Model-Generation header value). Handle-first: the pool/
+        engine handles know their serving replica; fall back to the
+        deployment's own engine surface (single engine), then to the
+        never-swapped default."""
+        tag = getattr(h, "weights_tag", None)
+        if tag:
+            return tag
+        eng = self.engine()
+        gen = getattr(eng, "weight_generation", None)
+        if gen is not None:
+            return f"{gen}:{getattr(eng, 'weights_id', None)}"
+        return "0:g0"
+
     def __call__(self, prompt_ids: List[int]) -> List[int]:
         """One request: token ids in, prompt+generated ids out.
 
@@ -605,11 +620,18 @@ class LlamaDeployment:
             h = self._submit(ids, mnt, dl, sid, tid)
             gen = h.result()
             out = list(ids) + gen
-            if isinstance(prompt_ids, dict) \
-                    and prompt_ids.get("echo_replica"):
-                return {"ids": out,
-                        "replica": getattr(h, "replica_tag", None)
-                        or "0:0"}
+            echo_rep = isinstance(prompt_ids, dict) \
+                and prompt_ids.get("echo_replica")
+            echo_gen = isinstance(prompt_ids, dict) \
+                and prompt_ids.get("echo_generation")
+            if echo_rep or echo_gen:
+                resp: Dict[str, Any] = {"ids": out}
+                if echo_rep:
+                    resp["replica"] = getattr(
+                        h, "replica_tag", None) or "0:0"
+                if echo_gen:
+                    resp["generation"] = self._weights_tag(h)
+                return resp
             return out
         import jax.numpy as jnp
         from ray_tpu.models.llama import generate
@@ -634,10 +656,18 @@ class LlamaDeployment:
         if self.use_engine:
             ids, mnt, dl, sid, tid = self._request_args(prompt_ids)
             h = self._submit(ids, mnt, dl, sid, tid)
-            if isinstance(prompt_ids, dict) \
-                    and prompt_ids.get("echo_replica"):
-                yield {"replica": getattr(h, "replica_tag", None)
-                       or "0:0"}
+            echo_rep = isinstance(prompt_ids, dict) \
+                and prompt_ids.get("echo_replica")
+            echo_gen = isinstance(prompt_ids, dict) \
+                and prompt_ids.get("echo_generation")
+            if echo_rep or echo_gen:
+                marker: Dict[str, Any] = {}
+                if echo_rep:
+                    marker["replica"] = getattr(
+                        h, "replica_tag", None) or "0:0"
+                if echo_gen:
+                    marker["generation"] = self._weights_tag(h)
+                yield marker
             try:
                 yield from h.stream()
             except GeneratorExit:

@@ -68,8 +68,10 @@ Usage: python serve_bench.py [--model 7b|1b|tiny] [--ab] [--out FILE]
 import argparse
 import itertools
 import json
+import os
 import statistics
 import subprocess
+import tempfile
 import threading
 import time
 
@@ -2675,6 +2677,233 @@ def run_disagg_ab(args):
     }
 
 
+def run_rollout_ab(args):
+    """Live weight rollout A/B (serve_bench.py --rollout-ab): one
+    paced arrival trace against a 3-replica pool with no weight swap
+    (baseline arm) vs the SAME trace while a staged rollout walks the
+    pool mid-flight (rollout arm) — canary, parity probes, advance
+    waves, all in preempt mode so in-flight requests are preempted at
+    each flip and resubmit through the replica-death path. The new
+    payload is the SAME tensors republished under a new checkpoint
+    identity (air/checkpoint.py manifest -> weights_id), so every
+    completion in BOTH arms must equal the greedy reference: 0 lost /
+    0 mismatched is the gate, not a hope. TTFT p95 impact vs the
+    no-rollout arm is stamped against an explicit bound; the fence
+    proof records every per-replica generation transition (strictly
+    monotonic). A third leg publishes a genuinely PERTURBED payload
+    and proves the canary's parity probe fails it, the controller
+    auto-rolls-back, the fleet converges onto the baseline
+    weights_id, and the decision is flight-explained. The artifact
+    REFUSES to exist (tools/check_bench_schema.py ``rollout_ab``
+    family) with any lost/mismatched request, zero swaps, unbounded
+    TTFT impact, a broken fence, or a missing rollback proof."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.llama import Llama, generate, llama_tiny
+    from ray_tpu.serve.engine import LLMEngine
+    from ray_tpu.serve.engine_pool import EnginePool
+    from ray_tpu.serve.weight_rollout import (WeightRolloutController,
+                                              load_weights,
+                                              publish_weights)
+
+    cfg = llama_tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))
+
+    n_replicas = 3
+    prompt_len = 32
+    gen_tokens = 16
+    n_requests = 24
+    gap_s = 0.02
+    ttft_impact_limit = 5.0    # bound on p95 TTFT under the swap
+    # churn: preempt-mode flips recompute straddling requests, so
+    # some headroom over the no-rollout arm is expected — unbounded
+    # impact is not
+
+    rng = np.random.RandomState(args.seed + 47)
+    prompts = [rng.randint(1, cfg.vocab_size - 1,
+                           size=prompt_len).tolist()
+               for _ in range(n_requests)]
+    refs = [np.asarray(generate(
+        model, params, jnp.asarray([p], jnp.int32),
+        max_new_tokens=gen_tokens,
+        temperature=0.0))[0, prompt_len:].tolist() for p in prompts]
+
+    workdir = tempfile.mkdtemp(prefix="rollout_ab_")
+    _v2_path, wid2 = publish_weights(
+        params, os.path.join(workdir, "v2"), step=2,
+        extra={"release": "v2"})
+    v2_params, _ = load_weights(_v2_path)
+    flight_dir = os.path.join(workdir, "flight")
+
+    def factory(idx):
+        return LLMEngine(model, params, max_slots=4, page_size=8,
+                         n_pages=96, chunk=4, temperature=0.0,
+                         eos_id=-1, seed=args.seed,
+                         prefix_cache=True)
+
+    def run_arm(rollout):
+        pool = EnginePool(factory, n_replicas, seed=args.seed)
+        swaps = 0
+        transitions = []
+        try:
+            for i in range(n_replicas):   # compile every replica
+                pool.replica(i).engine.submit(
+                    list(prompts[0]), max_new_tokens=2).result()
+            ctl_result = {}
+
+            def run_rollout():
+                ctl = WeightRolloutController(
+                    pool, canary_fraction=0.34,
+                    probes=[(prompts[0], refs[0][:4])],
+                    swap_mode="preempt", flight_dir=flight_dir)
+                ctl_result["report"] = ctl.rollout(
+                    v2_params, weights_id=wid2,
+                    baseline_params=params,
+                    baseline_weights_id="g0")
+
+            handles = []
+            roller = None
+            for i, p in enumerate(prompts):
+                handles.append(pool.submit(
+                    list(p), max_new_tokens=gen_tokens))
+                if rollout and i == n_requests // 3:
+                    # the rollout lands mid-trace, under load
+                    roller = threading.Thread(target=run_rollout,
+                                              daemon=True)
+                    roller.start()
+                time.sleep(gap_s)
+            lost = mismatched = 0
+            for i, h in enumerate(handles):
+                try:
+                    if list(h.result()) != refs[i]:
+                        mismatched += 1
+                except Exception:  # noqa: BLE001
+                    lost += 1
+            if roller is not None:
+                roller.join(120)
+                report = ctl_result.get("report") or {}
+                if report.get("status") != "completed":
+                    print("WARNING: mid-trace rollout did not "
+                          "complete — the artifact will fail schema "
+                          "validation", flush=True)
+                transitions.extend(report.get("transitions", []))
+                swaps = pool.route_stats["weight_swaps"]
+            ttfts = sorted(h.ttft_s for h in handles
+                           if h.ttft_s is not None)
+        finally:
+            pool.shutdown()
+        return {
+            "requests": n_requests,
+            "lost": lost,
+            "mismatched": mismatched,
+            "ttft_p50_s": round(ttfts[len(ttfts) // 2], 4),
+            "ttft_p95_s": round(
+                ttfts[min(len(ttfts) - 1,
+                          int(0.95 * len(ttfts)))], 4),
+            "tokens": n_requests * gen_tokens - lost * gen_tokens,
+            **({"swaps": swaps} if rollout else {}),
+        }, transitions
+
+    print("rollout A/B: baseline arm (no rollout)", flush=True)
+    baseline, _ = run_arm(rollout=False)
+    print("rollout A/B: live-rollout arm", flush=True)
+    rolled, transitions = run_arm(rollout=True)
+
+    # fence proof: every transition advances, per replica
+    last = {}
+    monotonic = bool(transitions)
+    for tr in transitions:
+        if tr["to"] <= tr["from"] or tr["to"] <= last.get(tr["idx"],
+                                                          -1):
+            monotonic = False
+        last[tr["idx"]] = tr["to"]
+    ratio = _ratio(rolled["ttft_p95_s"],
+                   max(baseline["ttft_p95_s"], 0.01))
+    identical = (baseline["mismatched"] == 0
+                 and rolled["mismatched"] == 0)
+    for arm, sec in (("baseline", baseline), ("rollout", rolled)):
+        if sec["lost"] or sec["mismatched"]:
+            print(f"WARNING: {arm} arm lost/mismatched requests — "
+                  "the artifact will fail schema validation",
+                  flush=True)
+    if ratio is None or ratio > ttft_impact_limit:
+        print("WARNING: rollout TTFT impact exceeded the stamped "
+              "bound — the artifact will fail schema validation",
+              flush=True)
+
+    # ---- injected-regression leg: the canary must roll it back ----
+    print("rollout A/B: injected-regression canary rollback",
+          flush=True)
+    bad_params = jax.tree_util.tree_map(lambda x: x + 0.25, params)
+    bad_path, bad_wid = publish_weights(
+        bad_params, os.path.join(workdir, "bad"), step=3)
+    pool = EnginePool(factory, 2, seed=args.seed)
+    try:
+        pool.replica(0).engine.submit(
+            list(prompts[0]), max_new_tokens=2).result()
+        ctl = WeightRolloutController(
+            pool, canary_fraction=0.5,
+            probes=[(prompts[0], refs[0][:6])],
+            swap_mode="preempt", flight_dir=flight_dir)
+        report = ctl.rollout(load_weights(bad_path)[0],
+                             weights_id=bad_wid,
+                             baseline_params=params,
+                             baseline_weights_id="g0")
+        rb = report.get("rollback") or {}
+        bundle = rb.get("bundle") or ""
+        rollback = {
+            "injected_regression": True,
+            "rolled_back": report.get("status") == "rolled_back",
+            "reason": report.get("rollback_reason", ""),
+            "converged": bool(rb.get("converged")),
+            "probe_failures": len(report.get("probe_failures", [])),
+            "baseline_weights_id": "g0",
+            "flight_bundle": os.path.basename(bundle)
+            if bundle else "",
+        }
+    finally:
+        pool.shutdown()
+    if not (rollback["rolled_back"] and rollback["converged"]
+            and rollback["flight_bundle"]):
+        print("WARNING: injected regression was not rolled back "
+              "convergently — the artifact will fail schema "
+              "validation", flush=True)
+
+    return {
+        "rollout_ab": {
+            "replicas": n_replicas,
+            "prompt_len": prompt_len,
+            "gen_tokens": gen_tokens,
+            "arrival_gap_s": gap_s,
+            "baseline": baseline,
+            "rollout": rolled,
+            "token_identical": identical,
+            "ttft_p95_ratio": ratio,
+            "ttft_impact_limit": ttft_impact_limit,
+            "fence": {"monotonic": monotonic,
+                      "transitions": transitions},
+            "generations": {"from": "g0", "to": wid2},
+            "rollback": rollback,
+        },
+        "mesh": {"tp": 1, "replicas": n_replicas},
+        "model": "llama-tiny",
+        "notes": "Live weight rollout A/B (serve_bench.py "
+                 "--rollout-ab): one paced arrival trace vs the SAME "
+                 "trace with a staged canary rollout walking the "
+                 "3-replica pool mid-flight in preempt mode (the new "
+                 "payload is the same tensors republished under a "
+                 "new checkpoint identity, so every completion must "
+                 "match the greedy reference — 0 lost / 0 mismatched "
+                 "gated). TTFT p95 impact is bounded against the "
+                 "stamped limit; the fence proof records per-replica "
+                 "generation transitions; the injected-regression "
+                 "leg proves the canary parity probe triggers a "
+                 "convergent, flight-explained auto-rollback.",
+    }
+
+
 def _batch_bench_model(args):
     import jax
     import jax.numpy as jnp
@@ -3157,6 +3386,17 @@ def main():
                          "unified; adds a per-role autoscale phase "
                          "and a decode-kill chaos arm; self-gated by "
                          "tools/check_bench_schema.py")
+    ap.add_argument("--rollout-ab", action="store_true",
+                    help="live weight rollout A/B: one paced arrival "
+                         "trace with no swap vs the SAME trace while "
+                         "a staged canary rollout (preempt-mode hot "
+                         "swap, parity probes, auto-advance) walks "
+                         "the 3-replica pool mid-flight — gates 0 "
+                         "lost / 0 mismatched, bounded TTFT p95 "
+                         "impact, a monotonic generation fence, and "
+                         "an injected-regression canary rollback "
+                         "proven flight-explained; self-gated by "
+                         "tools/check_bench_schema.py")
     ap.add_argument("--batch-ab", action="store_true",
                     help="batch-tier profile A/B: one offline corpus "
                          "through BatchInferenceJob on an engine "
@@ -3391,6 +3631,25 @@ def main():
         # self-gate: token divergence across the handoff, zero
         # handoffs, a TTFT ratio that didn't improve, or a missing
         # role/kv/mesh stamp fails its OWN run
+        from tools import check_bench_schema as cbs
+        problems = []
+        cbs.check_file(out, problems)
+        for p in problems:
+            print(f"SCHEMA FAIL {p}")
+        print(json.dumps(result))
+        ray_tpu.shutdown()
+        if problems:
+            raise SystemExit(1)
+        return
+
+    if args.rollout_ab:
+        result = _stamp(run_rollout_ab(args), args, replicas=3)
+        out = args.out or "SERVE_BENCH_rollout_ab_cpu_smoke.json"
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        # self-gate: a lost or token-diverging request under the
+        # swap, zero swaps, unbounded TTFT impact, a broken fence,
+        # or a missing rollback proof fails its OWN run
         from tools import check_bench_schema as cbs
         problems = []
         cbs.check_file(out, problems)

@@ -927,6 +927,119 @@ def test_serve_chaos_kv_migration_rejects_losses_and_leaks(tmp_path):
     assert any("did not quiesce leak-free" in p for p in probs)
 
 
+def _rollout_drill():
+    # the weight_rollout fault-drill block as tools/chaos_serve.py
+    # _run_rollout_phases emits it
+    return {
+        "kill_mid_swap": {
+            "completed": True, "converged": True,
+            "swap_attempts": 2, "weights_id": "13f3a0203ac3"},
+        "torn_checkpoint": {
+            "refused_typed": True, "fleet_untouched": True,
+            "flipped_file": "arrays/x", "reason": "hash mismatch"},
+        "controller_resume": {
+            "completed": True, "converged": True,
+            "resumed_replicas": 1, "weights_id": "e7b2d4403dc6"},
+        "requests": {"admitted": 27, "completed": 27,
+                     "failed_typed": 0, "lost": 0, "mismatched": 0},
+        "flight": {"kill_mid_swap_explained": True,
+                   "rollout_done_explained": True},
+        "quiesced": True,
+    }
+
+
+def test_serve_chaos_weight_rollout_validated_if_present(tmp_path):
+    # campaigns predating the rollout drill carry no block and pass
+    ok = _serve_chaos_ok()
+    ok["weight_rollout"] = _rollout_drill()
+    assert _problems_for("SERVE_CHAOS_x.json", ok, tmp_path) == []
+    not_obj = _serve_chaos_ok()
+    not_obj["weight_rollout"] = 7
+    probs = _problems_for("SERVE_CHAOS_x.json", not_obj, tmp_path)
+    assert any("must be an object" in p for p in probs)
+    for phase in ("kill_mid_swap", "torn_checkpoint",
+                  "controller_resume", "flight"):
+        bad = _serve_chaos_ok()
+        bad["weight_rollout"] = _rollout_drill()
+        del bad["weight_rollout"][phase]
+        probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+        assert any(f"'{phase}'" in p for p in probs), phase
+
+
+def test_serve_chaos_weight_rollout_rejects_unconverged_kill(tmp_path):
+    bad = _serve_chaos_ok()
+    bad["weight_rollout"] = _rollout_drill()
+    bad["weight_rollout"]["kill_mid_swap"]["completed"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("did not complete after the mid-swap kill" in p
+               for p in probs)
+    bad = _serve_chaos_ok()
+    bad["weight_rollout"] = _rollout_drill()
+    bad["weight_rollout"]["kill_mid_swap"]["converged"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("did not converge" in p for p in probs)
+    # one attempt means the swap never actually raced the kill — the
+    # drill proved nothing about mid-swap recovery
+    bad = _serve_chaos_ok()
+    bad["weight_rollout"] = _rollout_drill()
+    bad["weight_rollout"]["kill_mid_swap"]["swap_attempts"] = 1
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("kill never landed mid-swap" in p for p in probs)
+
+
+def test_serve_chaos_weight_rollout_rejects_torn_acceptance(tmp_path):
+    bad = _serve_chaos_ok()
+    bad["weight_rollout"] = _rollout_drill()
+    bad["weight_rollout"]["torn_checkpoint"]["refused_typed"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("not refused with the typed error" in p for p in probs)
+    bad = _serve_chaos_ok()
+    bad["weight_rollout"] = _rollout_drill()
+    bad["weight_rollout"]["torn_checkpoint"]["fleet_untouched"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("mutated fleet weights" in p for p in probs)
+
+
+def test_serve_chaos_weight_rollout_rejects_broken_resume(tmp_path):
+    bad = _serve_chaos_ok()
+    bad["weight_rollout"] = _rollout_drill()
+    bad["weight_rollout"]["controller_resume"]["completed"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("resumed" in p and "did not complete" in p
+               for p in probs)
+    # zero resumed replicas: the fresh controller started from
+    # scratch — controller-death resumability was never exercised
+    bad = _serve_chaos_ok()
+    bad["weight_rollout"] = _rollout_drill()
+    bad["weight_rollout"]["controller_resume"]["resumed_replicas"] = 0
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("resume path was never exercised" in p for p in probs)
+
+
+def test_serve_chaos_weight_rollout_rejects_losses_and_leaks(tmp_path):
+    for key in ("lost", "mismatched"):
+        bad = _serve_chaos_ok()
+        bad["weight_rollout"] = _rollout_drill()
+        bad["weight_rollout"]["requests"][key] = 1
+        probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+        assert any(key in p and "rollout drill" in p
+                   for p in probs), key
+    for key, what in (("kill_mid_swap_explained", "mid-swap kill"),
+                      ("rollout_done_explained",
+                       "completed rollout")):
+        bad = _serve_chaos_ok()
+        bad["weight_rollout"] = _rollout_drill()
+        bad["weight_rollout"]["flight"][key] = False
+        probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+        assert any(f"no flight bundle explains the {what}" in p
+                   for p in probs), key
+    bad = _serve_chaos_ok()
+    bad["weight_rollout"] = _rollout_drill()
+    bad["weight_rollout"]["quiesced"] = False
+    probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
+    assert any("rollout-drill pools" in p for p in probs)
+
+
 # ---------------------------------------------------------------------------
 # SERVE_TRACE family (serve_bench.py --trace artifacts)
 # ---------------------------------------------------------------------------
@@ -1854,6 +1967,143 @@ def _disagg_ab():
         "kv": {"kv_dtype": "fp", "paged_kernel": "gather"},
         "seed": 0, "git_sha": "abc1234",
     }
+
+
+def _rollout_arm(ttft_p50, ttft_p95, swaps=None):
+    arm = {"requests": 24, "lost": 0, "mismatched": 0,
+           "ttft_p50_s": ttft_p50, "ttft_p95_s": ttft_p95,
+           "tokens": 384}
+    if swaps is not None:
+        arm["swaps"] = swaps
+    return arm
+
+
+def _rollout_ab():
+    return {
+        "rollout_ab": {
+            "replicas": 3, "prompt_len": 32, "gen_tokens": 16,
+            "baseline": _rollout_arm(0.08, 0.15),
+            "rollout": _rollout_arm(0.09, 0.21, swaps=3),
+            "token_identical": True,
+            "ttft_p95_ratio": 1.4,
+            "ttft_impact_limit": 3.0,
+            "fence": {"monotonic": True,
+                      "transitions": [
+                          {"idx": 0, "from": 0, "to": 1},
+                          {"idx": 1, "from": 0, "to": 1},
+                          {"idx": 2, "from": 0, "to": 1}]},
+            "generations": {"from": "aaaa00000000",
+                            "to": "bbbb11111111"},
+            "rollback": {"injected_regression": True,
+                         "rolled_back": True, "converged": True,
+                         "reason": "canary parity probe failed",
+                         "probe_failures": 1,
+                         "baseline_weights_id": "bbbb11111111",
+                         "flight_bundle": "weight-rollback-000000"},
+        },
+        "mesh": {"tp": 1, "replicas": 3},
+        "seed": 0, "git_sha": "abc1234",
+    }
+
+
+def test_rollout_ab_artifact_validates(tmp_path):
+    assert _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                         _rollout_ab(), tmp_path) == []
+
+
+def test_rollout_ab_refuses_missing_stamps(tmp_path):
+    for key, needle in (("mesh", "mesh stamp"), ("seed", "seed")):
+        bad = _rollout_ab()
+        del bad[key]
+        probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any(needle in p for p in probs), key
+    no_gen = _rollout_ab()
+    del no_gen["rollout_ab"]["generations"]
+    probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                          no_gen, tmp_path)
+    assert any("payload-identity stamp" in p for p in probs)
+
+
+def test_rollout_ab_refuses_lost_or_mismatched(tmp_path):
+    for arm in ("baseline", "rollout"):
+        for key in ("lost", "mismatched"):
+            bad = _rollout_ab()
+            bad["rollout_ab"][arm][key] = 1
+            probs = _problems_for(
+                "SERVE_BENCH_rollout_ab_cpu_smoke.json", bad,
+                tmp_path)
+            assert any("never correctness" in p for p in probs), \
+                (arm, key)
+    diverged = _rollout_ab()
+    diverged["rollout_ab"]["token_identical"] = False
+    probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                          diverged, tmp_path)
+    assert any("changed greedy tokens" in p for p in probs)
+
+
+def test_rollout_ab_refuses_unbounded_ttft(tmp_path):
+    over = _rollout_ab()
+    over["rollout_ab"]["ttft_p95_ratio"] = 5.0
+    probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                          over, tmp_path)
+    assert any("unbounded" in p for p in probs)
+    no_limit = _rollout_ab()
+    del no_limit["rollout_ab"]["ttft_impact_limit"]
+    probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                          no_limit, tmp_path)
+    assert any("ttft_impact_limit" in p for p in probs)
+    no_ratio = _rollout_ab()
+    del no_ratio["rollout_ab"]["ttft_p95_ratio"]
+    probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                          no_ratio, tmp_path)
+    assert any("ttft_p95_ratio" in p for p in probs)
+
+
+def test_rollout_ab_refuses_missing_rollback_proof(tmp_path):
+    gone = _rollout_ab()
+    del gone["rollout_ab"]["rollback"]
+    probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                          gone, tmp_path)
+    assert any("rollback" in p and "proof" in p for p in probs)
+    for key, needle in (
+            ("injected_regression", "no regression was injected"),
+            ("rolled_back", "did not roll back"),
+            ("converged", "did not converge")):
+        bad = _rollout_ab()
+        bad["rollout_ab"]["rollback"][key] = False
+        probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any(needle in p for p in probs), key
+    unexplained = _rollout_ab()
+    del unexplained["rollout_ab"]["rollback"]["flight_bundle"]
+    probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                          unexplained, tmp_path)
+    assert any("flight-explained" in p for p in probs)
+    no_probe = _rollout_ab()
+    no_probe["rollout_ab"]["rollback"]["probe_failures"] = 0
+    probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                          no_probe, tmp_path)
+    assert any("zero failed parity probes" in p for p in probs)
+
+
+def test_rollout_ab_refuses_swapless_rollout_and_broken_fence(
+        tmp_path):
+    swapless = _rollout_ab()
+    swapless["rollout_ab"]["rollout"]["swaps"] = 0
+    probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                          swapless, tmp_path)
+    assert any("zero weight swaps" in p for p in probs)
+    unfenced = _rollout_ab()
+    unfenced["rollout_ab"]["fence"]["monotonic"] = False
+    probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                          unfenced, tmp_path)
+    assert any("fence proof" in p for p in probs)
+    empty = _rollout_ab()
+    empty["rollout_ab"]["fence"]["transitions"] = []
+    probs = _problems_for("SERVE_BENCH_rollout_ab_cpu_smoke.json",
+                          empty, tmp_path)
+    assert any("never exercised" in p for p in probs)
 
 
 def test_disagg_ab_artifact_validates(tmp_path):

@@ -134,9 +134,10 @@ def test_deep_verify_catches_same_size_corruption(tmp_path):
         first = f.read(1)
         f.seek(0)
         f.write(bytes([first[0] ^ 0xFF]))
-    ok_shallow, _ = verify_checkpoint_dir(path)
-    ok_deep, reason = verify_checkpoint_dir(path, deep=True)
+    ok_shallow, _, manifest = verify_checkpoint_dir(path)
+    ok_deep, reason, _ = verify_checkpoint_dir(path, deep=True)
     assert ok_shallow
+    assert manifest["files"], "verify must return the parsed manifest"
     assert not ok_deep
     assert "hash" in reason
 

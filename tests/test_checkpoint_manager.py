@@ -56,6 +56,65 @@ def test_save_async_is_nonblocking_and_snapshots(tmp_path):
         mgr.close()
 
 
+def test_save_async_racing_reader_never_sees_torn(tmp_path):
+    """A reader calling ``Checkpoint.from_directory`` on a slot that an
+    async save is re-committing must see the OLD complete checkpoint or
+    the NEW complete one — never a torn mix. Two probes:
+
+    1. deterministic: while the writer is held in ``pre_commit_hook``
+       (staged, rename not yet observable) the reader gets the old
+       payload;
+    2. stochastic: a hammer thread reads in a loop across the actual
+       atomic-rename window and every read must parse as exactly one
+       of the two committed payloads.
+    """
+    from ray_tpu.air.checkpoint import Checkpoint
+    in_hook, release = threading.Event(), threading.Event()
+
+    def hook(step):
+        in_hook.set()
+        assert release.wait(10)
+
+    mgr = CheckpointManager(str(tmp_path), pre_commit_hook=hook)
+    try:
+        release.set()                       # first save runs unheld
+        mgr.save({"v": 1, "step": 7}, 7)
+        path = os.path.join(str(tmp_path), step_dir_name(7))
+        release.clear()
+        in_hook.clear()
+
+        seen, errors = set(), []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    seen.add(Checkpoint.from_directory(path)
+                             .to_dict()["v"])
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        handle = mgr.save_async({"v": 2, "step": 7}, 7)
+        assert in_hook.wait(10)
+        # Staged but not committed: reads resolve to the OLD payload.
+        assert Checkpoint.from_directory(path).to_dict()["v"] == 1
+        release.set()
+        assert handle.wait(10) and handle.committed
+        # Committed: reads resolve to the NEW payload.
+        assert Checkpoint.from_directory(path).to_dict()["v"] == 2
+        time.sleep(0.05)                    # let the hammer observe v=2
+        stop.set()
+        t.join(10)
+        assert not errors, f"reader saw a torn checkpoint: {errors[:3]}"
+        assert seen <= {1, 2} and seen, \
+            f"reads must be old- or new-complete, got {seen}"
+    finally:
+        release.set()
+        mgr.close()
+
+
 def test_latest_complete_skips_torn_directory(tmp_path):
     mgr = CheckpointManager(str(tmp_path))
     try:

@@ -241,6 +241,61 @@ def test_http_proxy_x_replica_header(serve_rt):
         stop_http()
 
 
+def test_http_proxy_model_generation_header(serve_rt):
+    """Opt-in X-Model-Generation: mirrors X-Replica, but the tag
+    names the WEIGHTS serving the call ("<generation>:<weights_id>")
+    — the half of replica identity a live rollout changes. Both
+    opt-ins compose on one request."""
+    import urllib.request
+    import json as _json
+    from ray_tpu.serve.http_proxy import start_http, stop_http
+
+    @serve.deployment
+    def gen(payload):
+        if isinstance(payload, dict) and (payload.get("echo_replica")
+                                          or payload.get(
+                                              "echo_generation")):
+            out = {"ids": [4, 5]}
+            if payload.get("echo_replica"):
+                out["replica"] = "0:1"
+            if payload.get("echo_generation"):
+                out["generation"] = "3:bc7332e425e8"
+            return out
+        return [4, 5]
+
+    serve.run(gen.bind())
+    proxy = start_http(port=0)
+    try:
+        def post(body, headers_in):
+            headers = {"Content-Type": "application/json"}
+            headers.update(headers_in)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{proxy.port}/gen",
+                method="POST", data=_json.dumps(body).encode(),
+                headers=headers)
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return (resp.headers.get("X-Replica"),
+                        resp.headers.get("X-Model-Generation"),
+                        _json.loads(resp.read()))
+
+        # generation alone: header echoed, body bare
+        rep, g, body = post({"prompt_ids": [0]},
+                            {"X-Model-Generation": "1"})
+        assert rep is None and g == "3:bc7332e425e8"
+        assert body == {"result": [4, 5]}
+        # both opt-ins on one request
+        rep, g, body = post({"prompt_ids": [0]},
+                            {"X-Replica": "1",
+                             "X-Model-Generation": "1"})
+        assert rep == "0:1" and g == "3:bc7332e425e8"
+        assert body == {"result": [4, 5]}
+        # no opt-in: no headers, payload untouched
+        rep, g, body = post({"prompt_ids": [0]}, {})
+        assert rep is None and g is None and body == {"result": [4, 5]}
+    finally:
+        stop_http()
+
+
 def test_llama_llm_deployment(serve_rt):
     """North-star path: Llama JAX replicas behind serve (tiny config)."""
     from ray_tpu.serve.llm import LlamaDeployment

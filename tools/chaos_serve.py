@@ -35,7 +35,14 @@ After the campaign it PROVES the pool's availability contract:
   post-handoff fails the partial stream typed and the resubmit
   lands decode-in-place on the prefill replica through the typed
   handoff-fallback ladder — token-identical throughout, both
-  flight-explained.
+  flight-explained;
+- live weight rollout (serve/weight_rollout.py) survives its chaos:
+  a replica killed with a drain-mode hot swap PENDING is rebuilt and
+  re-swapped (the fleet converges on the new weights_id), a torn
+  checkpoint is refused typed before any replica is touched, and a
+  controller killed mid-rollout is resumable — a fresh controller
+  skips already-converged replicas and completes, with traffic
+  token-identical across every swap.
 
 Writes a SERVE_CHAOS json artifact gated by
 tools/check_bench_schema.py (serve_chaos family).
@@ -691,6 +698,357 @@ def _run_disagg_phases(model, params, flight_dir, seed, kv_dtype):
     }
 
 
+def _run_rollout_phases(model, params, flight_dir, seed, kv_dtype):
+    """Live weight-rollout fault drill: three seeded phases against a
+    2-replica auto-restart pool under pooled traffic
+    (serve/weight_rollout.py).
+
+    A. replica killed MID-SWAP — the canary replica is paced and kept
+       busy so the drain-mode flip PENDS, then killed with the swap
+       pending. The controller's swap attempt fails typed, pooled
+       traffic makes the corpse visible (death -> backoff rebuild),
+       and the retry lands on the fresh incarnation: the rollout
+       completes, the fleet converges on the new weights_id, and the
+       successful transition records attempt >= 1 (the kill provably
+       landed mid-swap).
+    B. torn checkpoint — a published checkpoint gets one payload byte
+       flipped; ``load_weights`` deep-verifies and refuses TYPED
+       (InvalidCheckpointError) before any replica is touched.
+    C. controller killed mid-rollout — one replica is pre-swapped to
+       the next payload (the work a dead controller finished), then a
+       FRESH controller rolls out the same payload: it resumes
+       (skips the already-converged replica, never re-swaps it) and
+       completes.
+
+    The new payload is the SAME tensors republished under a release
+    tag, so every traffic completion has ONE greedy answer across the
+    swap — mixed-fleet serving is adjudicated token-identically
+    throughout. Hard-asserts inside; returns the ``weight_rollout``
+    artifact block."""
+    import glob
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from ray_tpu.air.checkpoint import InvalidCheckpointError
+    from ray_tpu.serve import obs
+    from ray_tpu.serve.engine import LLMEngine
+    from ray_tpu.serve.engine_pool import EnginePool
+    from ray_tpu.serve.errors import (DeadlineExceeded,
+                                      EngineDraining,
+                                      EngineOverloaded,
+                                      EngineShutdown,
+                                      RequestCancelled)
+    from ray_tpu.serve.faults import FaultInjector, check_quiesced
+    from ray_tpu.serve.weight_rollout import (WeightRolloutController,
+                                              load_weights,
+                                              publish_weights)
+
+    typed = (RequestCancelled, DeadlineExceeded, EngineOverloaded,
+             EngineDraining, EngineShutdown)
+    rng = np.random.RandomState(seed * 13 + 409)
+
+    def toks(n):
+        return rng.randint(1, 250, size=n).tolist()
+
+    traffic = [toks(24) for _ in range(3)]   # pooled client prompts
+    busy_p = toks(32)                        # pins the canary's slot
+    probe_p = toks(16)                       # controller parity probe
+    pin = toks(12)                           # factory warmup prompt
+    mnt = 8
+
+    def mk_engine(inj=None):
+        return LLMEngine(model, params, max_slots=2, page_size=8,
+                         n_pages=48, chunk=2, temperature=0.0,
+                         eos_id=-1, seed=0, prefix_cache=True,
+                         kv_dtype=kv_dtype, fault_injector=inj,
+                         flight_dir=flight_dir)
+
+    # same-knobs reference engine: ONE right answer per prompt (the
+    # republished payload is tensor-identical, so the references hold
+    # across every generation the drill serves)
+    ref = mk_engine()
+    want = {}
+    for p in traffic + [probe_p]:
+        h = ref.submit(list(p), max_new_tokens=mnt)
+        while ref.step():
+            pass
+        want[tuple(p)] = h.result()
+    ref.shutdown()
+
+    engines = []
+
+    def factory(idx):
+        eng = mk_engine(FaultInjector())
+        engines.append(eng)
+        eng.start()
+        eng.submit(list(pin), max_new_tokens=4).result()
+        eng.reset_latency_stats()
+        return eng
+
+    pool = EnginePool(factory, 2, auto_restart=True,
+                      restart_backoff_s=0.05, seed=seed)
+    results = {"completed": 0, "failed_typed": 0, "lost": 0,
+               "mismatched": 0}
+
+    def tick(n=1):
+        """Pooled traffic: every admitted request must complete
+        token-identically or fail typed — including the ticks that
+        make the mid-swap corpse visible to the routing plane."""
+        for i in range(n):
+            p = traffic[rng.randint(0, len(traffic))]
+            try:
+                out = pool.submit(list(p),
+                                  max_new_tokens=mnt).result()
+            except typed:
+                results["failed_typed"] += 1
+                continue
+            except BaseException:  # noqa: BLE001
+                results["lost"] += 1
+                continue
+            if out == want[tuple(p)]:
+                results["completed"] += 1
+            else:
+                results["mismatched"] += 1
+
+    workdir = tempfile.mkdtemp(prefix="chaos_rollout_")
+    try:
+        # the new payload: SAME tensors, distinct release tag ->
+        # distinct weights_id, token-identical outputs (round-tripped
+        # through the sha256-verified checkpoint on purpose)
+        v2_dir, wid2 = publish_weights(
+            params, os.path.join(workdir, "v2"), step=2,
+            extra={"release": "chaos-v2"})
+        v2_params, wid2_rt = load_weights(v2_dir)
+        assert wid2_rt == wid2
+
+        # ------------------------- phase A: replica killed mid-swap
+        tick(4)
+        eng0 = pool.replica(0).engine
+        # pace the canary's rounds and pin a slot so the drain-mode
+        # flip PENDS instead of applying at the next idle boundary
+        eng0._injector.slow("step", 0.03, times=2000)
+        busy_box = {}
+
+        def consume_busy():
+            try:
+                busy_box["tokens"] = eng0.submit(
+                    list(busy_p), max_new_tokens=48).result()
+            except BaseException as e:  # noqa: BLE001
+                busy_box["error"] = e
+
+        bt = threading.Thread(target=consume_busy, daemon=True)
+        bt.start()
+        deadline = time.monotonic() + 10.0
+        while (not any(eng0.slots)
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert any(eng0.slots), "busy request never took a slot"
+
+        ctl = WeightRolloutController(
+            pool, canary_fraction=0.5, probes=[(probe_p,
+                                                want[tuple(probe_p)])],
+            ttft_ratio_limit=None, swap_mode="drain",
+            max_swap_attempts=4, rebuild_wait_s=20.0,
+            flight_dir=flight_dir)
+        roll_box = {}
+
+        def run_rollout():
+            try:
+                roll_box["report"] = ctl.rollout(
+                    v2_params, weights_id=wid2,
+                    baseline_params=params,
+                    baseline_weights_id="g0")
+            except BaseException as e:  # noqa: BLE001
+                roll_box["error"] = e
+
+        rt = threading.Thread(target=run_rollout, daemon=True)
+        rt.start()
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if any(e[2] == "weight_swap_pending"
+                   for e in eng0.events.snapshot()):
+                break
+            time.sleep(0.005)
+        assert any(e[2] == "weight_swap_pending"
+                   for e in eng0.events.snapshot()), \
+            "drain-mode swap never pended on the busy canary"
+        eng0._injector.kill_replica()     # fires at the next round
+        deadline = time.monotonic() + 10.0
+        while not eng0._stopped and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng0._stopped, "armed kill never fired mid-swap"
+        bt.join(timeout=30.0)
+        assert "error" in busy_box, \
+            "the busy request survived its replica's death"
+        # routed traffic is how an idle corpse becomes visible: tick
+        # until the pool has noted the death and rebuilt the replica
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            tick(1)
+            rep0 = pool.replica(0)
+            if rep0.engine is not eng0 and rep0.state in ("healthy",
+                                                          "suspect"):
+                break
+            time.sleep(0.05)
+        rt.join(timeout=90.0)
+        assert not rt.is_alive(), "rollout wedged after the kill"
+        assert "error" not in roll_box, \
+            f"rollout raised: {roll_box.get('error')!r}"
+        report = roll_box["report"]
+        assert report["status"] == "completed", (
+            f"rollout did not complete past the mid-swap kill: "
+            f"{report.get('rollback_reason', report['status'])}")
+        tr0 = [t for t in report["transitions"] if t["idx"] == 0]
+        assert tr0 and tr0[-1]["attempt"] >= 1, (
+            f"canary swapped on the first attempt — the kill never "
+            f"landed mid-swap (transitions {report['transitions']})")
+        swap_attempts = tr0[-1]["attempt"] + 1
+        fleet = ctl.fleet_weights()
+        assert all(w == wid2 for _g, w in fleet.values()), \
+            f"fleet did not converge on {wid2}: {fleet}"
+        tick(4)
+        kinds = [e[2] for e in pool.events.snapshot()]
+        assert "weight_swap_failed" in kinds, \
+            "the failed mid-swap attempt was never evented"
+        assert "replica_death" in kinds and "rollout_done" in kinds
+        obs.dump_flight_bundle(
+            flight_dir, "rollout-kill-mid-swap", engine=eng0,
+            pool=pool, extra={"phase": "kill_mid_swap",
+                              "killed_idx": 0,
+                              "swap_attempts": swap_attempts,
+                              "weights_id": wid2})
+        phase_a = {
+            "completed": True,
+            "converged": True,
+            "swap_attempts": swap_attempts,
+            "weights_id": wid2,
+        }
+
+        # ------------------------------ phase B: torn checkpoint
+        fleet_before = ctl.fleet_weights()
+        v3_dir, _wid3 = publish_weights(
+            params, os.path.join(workdir, "v3"), step=3,
+            extra={"release": "chaos-v3"})
+        from ray_tpu.air.checkpoint import verify_checkpoint_dir
+        ok, _reason, manifest = verify_checkpoint_dir(v3_dir)
+        assert ok and manifest.get("files")
+        victim = sorted(manifest["files"])[0]
+        with open(os.path.join(v3_dir, victim), "r+b") as f:
+            b = f.read(1)
+            f.seek(0)
+            f.write(bytes([b[0] ^ 0xFF]))
+        torn_err = None
+        try:
+            load_weights(v3_dir)
+        except InvalidCheckpointError as e:
+            torn_err = e
+        assert torn_err is not None, (
+            "bit-flipped checkpoint was NOT refused — corrupt "
+            "weights could reach a serving fleet")
+        fleet_untouched = ctl.fleet_weights() == fleet_before
+        assert fleet_untouched, "a refused checkpoint mutated weights"
+        tick(2)
+        phase_b = {
+            "refused_typed": True,
+            "fleet_untouched": True,
+            "flipped_file": victim,
+            "reason": str(torn_err),
+        }
+
+        # --------------------- phase C: controller death -> resume
+        v4_dir, wid4 = publish_weights(
+            params, os.path.join(workdir, "v4"), step=4,
+            extra={"release": "chaos-v4"})
+        v4_params, _ = load_weights(v4_dir)
+        # the work a dead controller finished before dying: replica 0
+        # already serves the new payload
+        pool.swap_replica_weights(0, v4_params, weights_id=wid4,
+                                  mode="preempt")
+        ctl2 = WeightRolloutController(
+            pool, canary_fraction=0.5,
+            probes=[(probe_p, want[tuple(probe_p)])],
+            ttft_ratio_limit=None, swap_mode="preempt",
+            flight_dir=flight_dir)
+        rpt2 = ctl2.rollout(v4_params, weights_id=wid4,
+                            baseline_params=v2_params,
+                            baseline_weights_id=wid2)
+        assert rpt2["status"] == "completed", (
+            f"resumed rollout did not complete: "
+            f"{rpt2.get('rollback_reason', rpt2['status'])}")
+        assert rpt2["resumed"] == [0], (
+            f"resumed controller did not skip the already-swapped "
+            f"replica: {rpt2['resumed']}")
+        assert all(t["idx"] != 0 for t in rpt2["transitions"]), \
+            "the resumed controller RE-swapped the converged replica"
+        fleet = ctl2.fleet_weights()
+        assert all(w == wid4 for _g, w in fleet.values()), \
+            f"resumed rollout did not converge on {wid4}: {fleet}"
+        tick(2)
+        phase_c = {
+            "completed": True,
+            "converged": True,
+            "resumed_replicas": len(rpt2["resumed"]),
+            "weights_id": wid4,
+        }
+
+        assert results["lost"] == 0, (
+            f"rollout drill lost {results['lost']} admitted "
+            f"requests")
+        assert results["mismatched"] == 0, (
+            f"{results['mismatched']} rollout-drill completions "
+            f"diverged from greedy across the swap")
+
+        pool.shutdown()
+        for eng in engines:
+            eng.shutdown()
+        for eng in engines:
+            check_quiesced(eng)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    # ------------------------ the bundles on disk explain the drill
+    kill_seen, done_seen = False, False
+    for bdir in sorted(glob.glob(os.path.join(flight_dir, "*"))):
+        if not os.path.isdir(bdir):
+            continue
+        try:
+            b = obs.load_flight_bundle(bdir)
+        except Exception:  # noqa: BLE001  half-written dir: skip
+            continue
+        eng_names = {e.get("type") for e in
+                     (b.get("engine") or {}).get("events") or []}
+        pool_names = {e.get("type") for e in
+                      (b.get("pool") or {}).get("events") or []}
+        if (b.get("reason") == "rollout-kill-mid-swap"
+                and "weight_swap_pending" in eng_names
+                and "weight_swap_failed" in pool_names):
+            kill_seen = True
+        if (b.get("reason") == "weight-rollout-done"
+                and "rollout_done" in pool_names):
+            done_seen = True
+    assert kill_seen, (
+        "no rollout-kill-mid-swap bundle carries the pending-swap/"
+        "failed-attempt events: the kill is not flight-explained")
+    assert done_seen, (
+        "no weight-rollout-done bundle carries a rollout_done event: "
+        "the completed rollout is not flight-explained")
+
+    return {
+        "kill_mid_swap": phase_a,
+        "torn_checkpoint": phase_b,
+        "controller_resume": phase_c,
+        "requests": dict(results,
+                         admitted=sum(results.values())),
+        "flight": {
+            "kill_mid_swap_explained": True,
+            "rollout_done_explained": True,
+        },
+        "quiesced": True,
+    }
+
+
 def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
               max_new_tokens=10, stall_deadline_s=1.0,
               watchdog_poll_s=0.05, drain_timeout_s=2.0,
@@ -1043,6 +1401,17 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
     disagg = _run_disagg_phases(model, params, flight_dir, seed,
                                 kv_dtype)
 
+    # ------------------------------- live weight-rollout fault drill
+    # Fresh 2-replica auto-restart pool under pooled traffic: the
+    # canary replica is killed with a drain-mode swap PENDING (the
+    # controller retries onto the rebuilt incarnation and the fleet
+    # converges), a bit-flipped checkpoint is refused typed before
+    # any replica is touched, and a fresh controller resumes a
+    # half-done rollout without re-swapping the converged replica.
+    # Hard-asserts inside; the artifact records the proof.
+    rollout_drill = _run_rollout_phases(model, params, flight_dir,
+                                        seed, kv_dtype)
+
     try:
         sha = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
@@ -1081,6 +1450,16 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
             "stream fails typed; the resubmit lands decode-in-place "
             "on the prefill replica through the typed handoff-"
             "fallback ladder, token-identically); both "
+            "flight-explained. A live weight-rollout fault drill "
+            "closes the campaign: against a 2-replica auto-restart "
+            "pool under pooled traffic, the canary replica is killed "
+            "with a drain-mode hot weight swap PENDING (the rollout "
+            "controller retries onto the rebuilt replica and the "
+            "fleet converges on the new weights_id), a bit-flipped "
+            "checkpoint is refused typed before any replica is "
+            "touched, and a fresh controller resumes a half-done "
+            "rollout without re-swapping the converged replica — "
+            "token-identical traffic throughout, kill and completion "
             "flight-explained."),
         "seed": seed,
         "mesh": {"tp": 1, "replicas": replicas},
@@ -1130,6 +1509,7 @@ def run_chaos(seed=47, replicas=3, duration_s=3.0, clients=3,
         },
         "kv_migration": migration,
         "disagg": disagg,
+        "weight_rollout": rollout_drill,
         "quiesced": True,
         "wall_s": round(wall, 2),
         "git_sha": sha,

@@ -302,6 +302,19 @@ DISAGG_ARM_REQUIRED = {
     "handoff_fallbacks": int,
 }
 
+# live weight rollout A/B artifacts carry one of these per arm
+# (serve_bench.py run_rollout_ab): the same chaos-load trace served
+# with no rollout (baseline) vs with a hot checkpoint swap rolling
+# through the pool mid-trace.
+ROLLOUT_ARM_REQUIRED = {
+    "requests": int,
+    "lost": int,
+    "mismatched": int,
+    "ttft_p50_s": NUM,
+    "ttft_p95_s": NUM,
+    "tokens": int,
+}
+
 # batch-tier profile A/B artifacts carry one of these per arm
 # (serve_bench.py run_batch_ab): the same offline corpus through
 # BatchInferenceJob on an engine built from each scheduler profile.
@@ -1131,6 +1144,128 @@ def check_disagg_ab(obj, name, problems):
                 "was not token-identical to the greedy reference")
 
 
+def check_rollout_ab(obj, name, problems):
+    """serve_bench.py --rollout-ab artifact: one chaos-load trace
+    served with no weight swap (baseline arm) vs the SAME trace with
+    a staged live rollout walking the pool mid-trace (rollout arm),
+    plus an injected-regression leg whose canary must auto-rollback.
+    The checker REFUSES artifacts that lost or corrupted even one
+    request under the swap (lost/mismatched must be 0 in BOTH arms —
+    a rollout may cost time, never correctness), whose rollout arm
+    made zero swaps (nothing rolled out), whose TTFT impact is
+    missing or unbounded (ttft_p95_ratio must sit under the stamped
+    ttft_impact_limit), whose weight-generation fence is unproven
+    (fence.monotonic must be true with at least one recorded
+    transition), without the payload-identity stamp (generations
+    {{from, to}} weights_ids), without the injected-regression
+    rollback proof (rolled_back, converged, flight-explained, with
+    at least one failed parity probe), or without seed/mesh stamps
+    (an unseeded rollout under chaos load is an anecdote)."""
+    _check_mesh(obj, name, problems, required=True)
+    if not isinstance(obj.get("seed"), int) \
+            or isinstance(obj.get("seed"), bool):
+        problems.append(f"{name}: rollout A/B artifact missing int "
+                        "'seed'")
+    ab = obj.get("rollout_ab")
+    if not isinstance(ab, dict):
+        problems.append(f"{name}: rollout_ab must be an object")
+        return
+    for arm in ("baseline", "rollout"):
+        sec = ab.get(arm)
+        if not isinstance(sec, dict):
+            problems.append(f"{name}:rollout_ab: missing {arm} arm "
+                            "object")
+            continue
+        _check_fields(sec, ROLLOUT_ARM_REQUIRED,
+                      f"{name}:rollout_ab:{arm}", problems)
+        for key in ("lost", "mismatched"):
+            v = sec.get(key)
+            if isinstance(v, int) and not isinstance(v, bool) \
+                    and v != 0:
+                problems.append(
+                    f"{name}:rollout_ab:{arm}: {key} must be 0 — a "
+                    "rollout may cost time, never correctness")
+    ro = ab.get("rollout")
+    if isinstance(ro, dict):
+        sw = ro.get("swaps")
+        if not isinstance(sw, int) or isinstance(sw, bool) or sw < 1:
+            problems.append(
+                f"{name}:rollout_ab: rollout arm made zero weight "
+                "swaps — nothing rolled out; the arm measured a "
+                "mislabeled baseline")
+    if ab.get("token_identical") is not True:
+        problems.append(
+            f"{name}: completions under the rollout were not "
+            "token-identical to the reference — the swap changed "
+            "greedy tokens")
+    ratio = ab.get("ttft_p95_ratio")
+    limit = ab.get("ttft_impact_limit")
+    if not isinstance(ratio, NUM) or isinstance(ratio, bool):
+        problems.append(f"{name}: rollout A/B artifact missing "
+                        "numeric ttft_p95_ratio")
+    elif not isinstance(limit, NUM) or isinstance(limit, bool):
+        problems.append(
+            f"{name}:rollout_ab: missing the numeric "
+            "ttft_impact_limit stamp — an unbounded TTFT impact is "
+            "not a gated measurement")
+    elif ratio > limit:
+        problems.append(
+            f"{name}:rollout_ab: ttft_p95_ratio {ratio} > stamped "
+            f"limit {limit} — the swap's latency impact is "
+            "unbounded")
+    fence = ab.get("fence")
+    if not isinstance(fence, dict) \
+            or fence.get("monotonic") is not True:
+        problems.append(
+            f"{name}:rollout_ab: missing the fence proof "
+            "({{monotonic: true, transitions: [...]}}) — an "
+            "unfenced swap cannot claim old/new isolation")
+    else:
+        tr = fence.get("transitions")
+        if not isinstance(tr, list) or len(tr) < 1:
+            problems.append(
+                f"{name}:rollout_ab:fence: no recorded generation "
+                "transitions — the fence was never exercised")
+    gens = ab.get("generations")
+    if not isinstance(gens, dict) \
+            or not isinstance(gens.get("from"), str) \
+            or not isinstance(gens.get("to"), str):
+        problems.append(
+            f"{name}:rollout_ab: missing the payload-identity stamp "
+            "(generations {{from, to}} weights_ids) — an unstamped "
+            "swap is not attributable to a checkpoint")
+    rb = ab.get("rollback")
+    if not isinstance(rb, dict):
+        problems.append(
+            f"{name}:rollout_ab: missing the injected-regression "
+            "'rollback' proof — an auto-rollback that was never "
+            "demonstrated is a hope, not a safety property")
+        return
+    if rb.get("injected_regression") is not True:
+        problems.append(
+            f"{name}:rollout_ab:rollback: no regression was "
+            "injected — the leg rolled back nothing")
+    if rb.get("rolled_back") is not True:
+        problems.append(
+            f"{name}:rollout_ab:rollback: the canaried regression "
+            "did not roll back")
+    if rb.get("converged") is not True:
+        problems.append(
+            f"{name}:rollout_ab:rollback: the fleet did not "
+            "converge back onto the baseline payload")
+    pf = rb.get("probe_failures")
+    if not isinstance(pf, int) or isinstance(pf, bool) or pf < 1:
+        problems.append(
+            f"{name}:rollout_ab:rollback: zero failed parity probes "
+            "— the rollback was not triggered by the injected "
+            "regression")
+    if not isinstance(rb.get("flight_bundle"), str):
+        problems.append(
+            f"{name}:rollout_ab:rollback: missing the flight_bundle "
+            "stamp — the rollback decision must be "
+            "flight-explained")
+
+
 def check_batch_ab(obj, name, problems):
     """serve_bench.py --batch-ab artifact: one offline corpus through
     BatchInferenceJob on an engine built from the 'latency' vs
@@ -1277,6 +1412,13 @@ def check_mixed_ab(obj, name, problems):
 
 
 def check_serve_bench(obj, name, problems):
+    if "rollout_ab" in obj:
+        # live weight rollout A/B family (serve_bench.py --rollout-ab)
+        check_rollout_ab(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
     if "batch_ab" in obj:
         # batch-tier profile A/B family (serve_bench.py --batch-ab)
         check_batch_ab(obj, name, problems)
@@ -1501,7 +1643,11 @@ def check_serve_chaos(obj, name, problems):
     refuses a donor kill that produced no plain-prefill fallback, a
     non-token-identical pull or resume, a resume that recomputed
     instead of hitting migrated pages, and migration faults without
-    flight-bundle explanations."""
+    flight-bundle explanations. When it carries a ``weight_rollout``
+    fault-drill block it additionally refuses a mid-swap kill the
+    fleet did not converge past, a torn checkpoint that was not
+    refused typed, and a controller-death resume that re-swapped or
+    failed to converge."""
     _check_fields(obj, SERVE_CHAOS_REQUIRED, name, problems)
     _check_mesh(obj, name, problems, required=True)
     inj = obj.get("injected")
@@ -1749,6 +1895,106 @@ def check_serve_chaos(obj, name, problems):
                 problems.append(
                     f"{name}:disagg: disaggregation-drill pools did "
                     "not quiesce leak-free")
+    # Live weight-rollout fault drill (validated-if-present;
+    # campaigns predating hot checkpoint swap carry no block and
+    # still pass): the checker REFUSES a drill where the replica
+    # killed mid-swap did not converge on the new payload after
+    # rebuild (or needed no retry — then the kill never landed), a
+    # torn checkpoint was not refused with the typed error, the
+    # resumed rollout after controller death re-swapped or failed to
+    # converge, any drill request was lost or mismatched, the faults
+    # are not flight-explained, or the pools leaked pages.
+    wr = obj.get("weight_rollout")
+    if wr is not None:
+        if not isinstance(wr, dict):
+            problems.append(f"{name}: weight_rollout must be an "
+                            "object")
+        else:
+            km = wr.get("kill_mid_swap")
+            if not isinstance(km, dict):
+                problems.append(f"{name}:weight_rollout: missing "
+                                "the 'kill_mid_swap' phase block")
+            else:
+                if km.get("completed") is not True:
+                    problems.append(
+                        f"{name}:weight_rollout: the rollout did "
+                        "not complete after the mid-swap kill")
+                if km.get("converged") is not True:
+                    problems.append(
+                        f"{name}:weight_rollout: the fleet did not "
+                        "converge on the new payload after the "
+                        "mid-swap kill")
+                at = km.get("swap_attempts")
+                if not isinstance(at, int) or isinstance(at, bool) \
+                        or at < 2:
+                    problems.append(
+                        f"{name}:weight_rollout: the killed replica "
+                        "swapped on the first attempt — the kill "
+                        "never landed mid-swap")
+            tc = wr.get("torn_checkpoint")
+            if not isinstance(tc, dict):
+                problems.append(f"{name}:weight_rollout: missing "
+                                "the 'torn_checkpoint' phase block")
+            else:
+                if tc.get("refused_typed") is not True:
+                    problems.append(
+                        f"{name}:weight_rollout: the torn "
+                        "checkpoint was not refused with the typed "
+                        "error — corrupt weights could reach a "
+                        "serving fleet")
+                if tc.get("fleet_untouched") is not True:
+                    problems.append(
+                        f"{name}:weight_rollout: a torn checkpoint "
+                        "mutated fleet weights")
+            cr = wr.get("controller_resume")
+            if not isinstance(cr, dict):
+                problems.append(f"{name}:weight_rollout: missing "
+                                "the 'controller_resume' phase "
+                                "block")
+            else:
+                if cr.get("completed") is not True:
+                    problems.append(
+                        f"{name}:weight_rollout: the resumed "
+                        "rollout did not complete")
+                if cr.get("converged") is not True:
+                    problems.append(
+                        f"{name}:weight_rollout: the resumed "
+                        "rollout did not converge")
+                rs = cr.get("resumed_replicas")
+                if not isinstance(rs, int) or isinstance(rs, bool) \
+                        or rs < 1:
+                    problems.append(
+                        f"{name}:weight_rollout: the resumed "
+                        "controller found no already-swapped "
+                        "replica — the resume path was never "
+                        "exercised")
+            wreq = wr.get("requests")
+            if isinstance(wreq, dict):
+                for key in ("lost", "mismatched"):
+                    v = wreq.get(key)
+                    if isinstance(v, int) and not isinstance(v, bool) \
+                            and v != 0:
+                        problems.append(
+                            f"{name}:weight_rollout: {v} {key} "
+                            "request(s) in the rollout drill")
+            wfl = wr.get("flight")
+            if not isinstance(wfl, dict):
+                problems.append(f"{name}:weight_rollout: missing "
+                                "the 'flight' explanation block")
+            else:
+                for key, what in (
+                        ("kill_mid_swap_explained",
+                         "mid-swap kill"),
+                        ("rollout_done_explained",
+                         "completed rollout")):
+                    if wfl.get(key) is not True:
+                        problems.append(
+                            f"{name}:weight_rollout: no flight "
+                            f"bundle explains the {what}")
+            if wr.get("quiesced") is not True:
+                problems.append(
+                    f"{name}:weight_rollout: rollout-drill pools "
+                    "did not quiesce leak-free")
     sha = obj.get("git_sha")
     if sha is not None and not isinstance(sha, str):
         problems.append(f"{name}: git_sha must be a string")
