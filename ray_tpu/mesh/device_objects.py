@@ -36,7 +36,12 @@ Lifecycle, designed around XLA's ownership model rather than plasma's:
   is the only thing that ever crosses a process boundary.
 - **free**: when the owner's last local ref drops, the eager-GC drain
   (object_plane._drain_releases) also drops the table entry (freeing
-  HBM) and any spilled payload.
+  HBM). A never-escaped spill is deleted on the spot; an escaped one
+  rides the head's borrower protocol under `payload_oid` — consumers
+  register a payload borrow at resolve, the owner's release hands the
+  spill to the head, and the head frees every copy on the last
+  borrow drop (grace-windowed) instead of waiting for shm LRU
+  pressure.
 - **reshard**: `reshard(value, axes)` moves an Array between
   shardings with `jax.device_put`, which XLA lowers to device-to-device
   copies (ICI collective permute across chips) — the host is never in
@@ -154,6 +159,11 @@ class DeviceObjectTable:
         self._entries: Dict[ObjectID, Any] = {}
         self._planes: Dict[ObjectID, Any] = {}      # oid -> weakref(plane)
         self._spilled: set = set()
+        # Main oids whose PAYLOAD this process holds a registered
+        # borrow on (consumer side of the payload borrower protocol):
+        # added at resolve, consumed when the main ref's release
+        # drains (object_plane._device_borrow_released).
+        self._payload_borrows: set = set()
         # borrow cache: oid -> (array, nbytes)
         self._borrows: "collections.OrderedDict[ObjectID, Tuple[Any, int]]" \
             = collections.OrderedDict()
@@ -229,6 +239,19 @@ class DeviceObjectTable:
             return oid in self._spilled
 
     # ---- borrow side ------------------------------------------------------
+
+    def note_payload_borrow(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._payload_borrows.add(oid)
+
+    def take_payload_borrow(self, oid: ObjectID) -> bool:
+        """Consume the payload-borrow marker for ``oid`` (returns
+        whether one existed) — called once per main-ref release."""
+        with self._lock:
+            if oid in self._payload_borrows:
+                self._payload_borrows.discard(oid)
+                return True
+            return False
 
     def cache_borrow(self, oid: ObjectID, arr, nbytes: int) -> None:
         with self._lock:
@@ -329,6 +352,15 @@ def resolve_handle(handle: DeviceArrayHandle, plane,
         raise host
     arr = _device_put_like(host, handle)
     _TABLE.cache_borrow(oid, arr, int(getattr(host, "nbytes", 0)))
+    # Payload borrower protocol: register a borrow on the PAYLOAD id
+    # so the owner can free the host spill on last-borrow-drop instead
+    # of leaving it to shm LRU pressure. Dropped when this process's
+    # last ref to the main object releases (on_borrow_released).
+    try:
+        plane.note_borrow(payload_oid(oid))
+        _TABLE.note_payload_borrow(oid)
+    except Exception:
+        pass
     return arr
 
 
@@ -372,25 +404,42 @@ def spill_on_escape(oid: ObjectID) -> None:
 
 def on_ref_released(oid: ObjectID, plane, escaped: bool = False) -> None:
     """Hook from the eager-GC drain: the owner's last local ref
-    dropped. Always frees the HBM pin. The spilled host payload is
-    deleted only when the ref never escaped (external holders may
-    still resolve an escaped ref from the payload; until the borrower
-    protocol reclaims it, the shm LRU bounds it — same policy as
-    escaped byte objects)."""
+    dropped. Always frees the HBM pin. A never-escaped spill is
+    deleted directly (no external holder can exist). An ESCAPED
+    spill's lifetime is handed to the head's borrower protocol under
+    ``payload_oid`` — consumers registered payload borrows at resolve
+    (resolve_handle), so the head frees the host copy on the last
+    borrow drop (grace-windowed for in-flight handoffs) instead of
+    waiting for shm LRU pressure."""
     if not _TABLE.is_registered(oid):
         _TABLE.drop(oid)     # clears any borrow-cache entry
         return
     spilled = _TABLE.was_spilled(oid)
     _TABLE.drop(oid)
-    if spilled and not escaped:
-        poid = payload_oid(oid)
-        try:
-            plane.store.delete(poid)
-        except Exception:
-            pass
-        if getattr(plane, "multinode", False):
-            with plane._reg_lock:
-                plane._pending_free.append(poid.hex())
+    if not spilled:
+        return
+    poid = payload_oid(oid)
+    if escaped:
+        with plane._reg_lock:
+            plane._pending_owner_released.append((poid.hex(), 0.0))
+        return
+    try:
+        plane.store.delete(poid)
+    except Exception:
+        pass
+    if getattr(plane, "multinode", False):
+        with plane._reg_lock:
+            plane._pending_free.append(poid.hex())
+
+
+def on_borrow_released(oid: ObjectID, plane) -> None:
+    """Hook from the eager-GC drain's BORROWED branch: this process's
+    last ref to a borrowed object dropped. If ``resolve_handle``
+    registered a payload borrow for it, drop that borrow too — the
+    owner-side protocol frees the host spill once every payload
+    borrow is gone."""
+    if _TABLE.take_payload_borrow(oid):
+        plane.drop_borrow(payload_oid(oid))
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,21 @@
+"""RLHF rollout subsystem: the serving engine as an RL generation
+actor (ROADMAP: "engine as an RL rollout generator").
+
+The split follows Podracer's sebulba architecture (arXiv 2104.06272):
+a generation side (``RolloutGenerator`` over ``LLMEngine`` /
+``EnginePool``, submitting on ``LANE_BATCH`` so co-located online
+traffic keeps its SLO) and a learner side (``RolloutLearner``, reusing
+the rllib loss pieces), glued by ``RLHFLoop`` which overlaps round
+N+1's decode with round N's learner step under PR 19's monotonic
+weight-generation fence and a bounded-staleness knob.
+"""
+from ray_tpu.rl.rollout import (GeneratorKilled, RolloutBatch,
+                                RolloutGenerator)
+from ray_tpu.rl.learner import RolloutLearner
+from ray_tpu.rl.loop import (DuplicateRollout, RLHFLoop,
+                             StalenessViolation)
+
+__all__ = [
+    "RolloutBatch", "RolloutGenerator", "RolloutLearner", "RLHFLoop",
+    "GeneratorKilled", "DuplicateRollout", "StalenessViolation",
+]

@@ -1,0 +1,214 @@
+"""Token-level policy-gradient learner over engine rollout batches.
+
+Reuses the rllib loss pieces on sequence data: ``algo="ppo"`` applies
+``rllib.ppo.clipped_surrogate_loss`` with a per-sequence advantage
+(reward minus a running scalar baseline, normalized across the batch)
+broadcast to every completion token; ``algo="vtrace"`` applies
+``rllib.impala.vtrace_returns`` per sequence (reward at the terminal
+token, baseline as the constant value estimate) — the off-policy
+correction that matters once rollouts lag the learner by a bounded
+number of updates (rl/loop.py's staleness knob).
+
+The forward is the model's dense (no-kv-cache) teacher-forced pass:
+logits at position ``plen-1+i`` score completion token ``i``. Behavior
+logprobs come from the engine's capture (rollout.RolloutBatch), so the
+importance ratio is exact even when the batch was sampled a few
+generations ago. Shapes are frozen from the first batch (one jit
+compile); padding rides adv=0 / mask=0 so it contributes exactly zero
+loss.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rl.rollout import RolloutBatch
+from ray_tpu.rllib.impala import vtrace_returns
+from ray_tpu.rllib.ppo import clipped_surrogate_loss
+
+
+class RolloutLearner:
+    def __init__(self, model, params, *, algo: str = "ppo",
+                 lr: float = 1e-2, clip_eps: float = 0.2,
+                 gamma: float = 1.0, entropy_coef: float = 0.0,
+                 baseline_beta: float = 0.2, sgd_epochs: int = 1):
+        import optax
+
+        if algo not in ("ppo", "vtrace"):
+            raise ValueError(f"unknown algo {algo!r}; expected 'ppo' "
+                             f"or 'vtrace'")
+        self.model = model
+        self.params = params
+        self.algo = algo
+        self.clip_eps = float(clip_eps)
+        self.gamma = float(gamma)
+        self.entropy_coef = float(entropy_coef)
+        self.baseline_beta = float(baseline_beta)
+        self.sgd_epochs = max(1, int(sgd_epochs))
+        self.baseline = 0.0
+        self.update_count = 0
+        self._opt = optax.adam(lr)
+        self.opt_state = self._opt.init(params)
+        self._shape = None          # (B, L, T) frozen on first update
+        self._update_fn = self._build_update()
+
+    # ------------------------------------------------------------- jit
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        model = self.model
+        algo = self.algo
+        clip_eps = self.clip_eps
+        gamma = self.gamma
+        entropy_coef = self.entropy_coef
+
+        def loss_fn(params, b):
+            logits, _ = model.apply(params, b["tokens"])
+            sel = jnp.take_along_axis(
+                logits.astype(jnp.float32),
+                b["gpos"][:, :, None], axis=1)          # [B, T, V]
+            logp_all = jax.nn.log_softmax(sel)
+            logp = jnp.take_along_axis(
+                logp_all, b["targets"][:, :, None], axis=-1)[..., 0]
+            mask = b["mask"]
+            denom = jnp.maximum(mask.sum(), 1.0)
+            if algo == "ppo":
+                adv_tok = b["adv"][:, None] * mask       # pad -> 0 loss
+                pg = clipped_surrogate_loss(
+                    logp.ravel(), b["behavior"].ravel(),
+                    adv_tok.ravel(), clip_eps)
+            else:
+                rho = jnp.exp(logp - b["behavior"]) * mask
+                values = jnp.full_like(logp, b["baseline"])
+
+                def one(v, r, d, rh):
+                    return vtrace_returns(v, jnp.float32(0.0), r, d, rh,
+                                          gamma=gamma)
+
+                _vs, pg_adv = jax.vmap(one)(
+                    values, b["rew_tok"], b["dones"], rho)
+                pg = -(mask * logp * pg_adv).sum() / denom
+            ent = -(mask[:, :, None] * jnp.exp(logp_all) *
+                    logp_all).sum() / denom
+            return pg - entropy_coef * ent, (pg, ent)
+
+        opt = self._opt
+
+        @jax.jit
+        def update(params, opt_state, b):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, b)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        return update
+
+    # ------------------------------------------------------------ host
+
+    def _pack(self, batch: RolloutBatch) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        rewards = batch.rewards
+        if rewards is None:
+            raise ValueError(
+                f"batch {batch.batch_id} is unscored (rewards=None)")
+        B = batch.num_samples()
+        if self._shape is None:
+            L = max(len(p) + len(c) for p, c in
+                    zip(batch.prompts, batch.completions))
+            T = max(max(len(c) for c in batch.completions), 1)
+            self._shape = (B, L, T)
+        eB, L, T = self._shape
+        if B != eB:
+            raise ValueError(f"batch size changed: {B} != {eB}")
+        tokens = np.zeros((B, L), np.int32)
+        gpos = np.zeros((B, T), np.int32)
+        targets = np.zeros((B, T), np.int32)
+        behavior = np.zeros((B, T), np.float32)
+        mask = np.zeros((B, T), np.float32)
+        rew_tok = np.zeros((B, T), np.float32)
+        dones = np.zeros((B, T), np.float32)
+        for i, (p, c, lp) in enumerate(zip(batch.prompts,
+                                           batch.completions,
+                                           batch.logprobs)):
+            if len(lp) != len(c):
+                raise ValueError(
+                    f"batch {batch.batch_id} sample {i}: {len(lp)} "
+                    f"logprobs for {len(c)} tokens — was the engine "
+                    f"built with capture_logprobs=True?")
+            seq = list(p) + list(c)
+            if len(seq) > L or len(c) > T:
+                raise ValueError(
+                    f"sample {i} exceeds frozen shape (L={L}, T={T})")
+            tokens[i, :len(seq)] = seq
+            n = len(c)
+            gpos[i, :n] = np.arange(len(p) - 1, len(p) - 1 + n)
+            targets[i, :n] = c
+            behavior[i, :n] = lp
+            mask[i, :n] = 1.0
+            if n:
+                rew_tok[i, n - 1] = rewards[i]
+                dones[i, n - 1] = 1.0
+        rew = np.asarray(rewards, np.float32)
+        base = self.baseline if self.update_count else float(rew.mean())
+        adv = rew - base
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        return {
+            "tokens": jnp.asarray(tokens),
+            "gpos": jnp.asarray(gpos),
+            "targets": jnp.asarray(targets),
+            "behavior": jnp.asarray(behavior),
+            "mask": jnp.asarray(mask),
+            "adv": jnp.asarray(adv),
+            "rew_tok": jnp.asarray(rew_tok),
+            "dones": jnp.asarray(dones),
+            "baseline": jnp.float32(base),
+        }
+
+    def update(self, batch: RolloutBatch) -> Dict[str, Any]:
+        """One policy-gradient step on a scored rollout batch."""
+        packed = self._pack(batch)
+        # Multiple epochs over the same batch is standard PPO — the
+        # clipped ratio (against the FIXED behavior logprobs) is what
+        # keeps later epochs from running away from the sampler.
+        for _ in range(self.sgd_epochs):
+            (self.params, self.opt_state, loss,
+             (pg, ent)) = self._update_fn(
+                self.params, self.opt_state, packed)
+        rew_mean = float(np.mean(batch.rewards))
+        beta = self.baseline_beta
+        self.baseline = (rew_mean if self.update_count == 0
+                         else (1 - beta) * self.baseline +
+                         beta * rew_mean)
+        self.update_count += 1
+        return {
+            "update": self.update_count,
+            "loss": float(loss),
+            "pg_loss": float(pg),
+            "entropy": float(ent),
+            "reward_mean": rew_mean,
+            "baseline": self.baseline,
+            "num_tokens": batch.num_tokens(),
+        }
+
+    # ----------------------------------------------------------- state
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+            "baseline": self.baseline,
+            "update_count": self.update_count,
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.baseline = float(state["baseline"])
+        self.update_count = int(state["update_count"])

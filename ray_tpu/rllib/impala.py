@@ -23,6 +23,42 @@ from ray_tpu.rllib.ppo import RolloutWorker, _policy_defs
 from ray_tpu.rllib.env import ENV_REGISTRY
 
 
+def vtrace_returns(values, last_value, rewards, dones, rhos, *,
+                   gamma, rho_bar=1.0, c_bar=1.0):
+    """V-trace targets via a reverse scan (Espeholt et al. '18, eq. 1):
+    vs = V(s) + sum_k (gamma^k * prod(c) * delta_k).
+
+    Module level so external learners (e.g. ray_tpu.rl) can apply the
+    same off-policy correction to token-level batches. Returns
+    ``(vs, pg_adv)``, both stop-gradiented.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    discounts = gamma * (1.0 - dones.astype(jnp.float32))
+    next_values = jnp.concatenate(
+        [values[1:], jnp.array([last_value])])
+    clipped_rho = jnp.minimum(rho_bar, rhos)
+    clipped_c = jnp.minimum(c_bar, rhos)
+    deltas = clipped_rho * (
+        rewards + discounts * next_values - values)
+
+    def body(acc, xs):
+        delta, disc, c = xs
+        acc = delta + disc * c * acc
+        return acc, acc
+
+    _, advs = jax.lax.scan(
+        body, jnp.float32(0.0),
+        (deltas, discounts, clipped_c), reverse=True)
+    vs = values + advs
+    next_vs = jnp.concatenate(
+        [vs[1:], jnp.array([last_value])])
+    pg_adv = clipped_rho * (
+        rewards + discounts * next_vs - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
 class ImpalaConfig(AlgorithmConfig):
     def _defaults(self) -> Dict[str, Any]:
         return {
@@ -78,42 +114,16 @@ class Impala(Algorithm):
         rho_bar = cfg.vtrace_clip_rho
         c_bar = cfg.vtrace_clip_c
 
-        def vtrace(values, last_value, rewards, dones, rhos):
-            """V-trace targets via a reverse scan (Espeholt et al. '18,
-            eq. 1): vs = V(s) + sum_k (gamma^k * prod(c) * delta_k)."""
-            discounts = gamma * (1.0 - dones.astype(jnp.float32))
-            next_values = jnp.concatenate(
-                [values[1:], jnp.array([last_value])])
-            clipped_rho = jnp.minimum(rho_bar, rhos)
-            clipped_c = jnp.minimum(c_bar, rhos)
-            deltas = clipped_rho * (
-                rewards + discounts * next_values - values)
-
-            def body(acc, xs):
-                delta, disc, c = xs
-                acc = delta + disc * c * acc
-                return acc, acc
-
-            _, advs = jax.lax.scan(
-                body, jnp.float32(0.0),
-                (deltas, discounts, clipped_c), reverse=True)
-            vs = values + advs
-            next_vs = jnp.concatenate(
-                [vs[1:], jnp.array([last_value])])
-            pg_adv = clipped_rho * (
-                rewards + discounts * next_vs - values)
-            return jax.lax.stop_gradient(vs), \
-                jax.lax.stop_gradient(pg_adv)
-
         def loss_fn(params, batch):
             logits, values = model.apply(params, batch["obs"])
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 logp_all, batch["actions"][:, None], axis=1)[:, 0]
             rhos = jnp.exp(logp - batch["logp"])
-            vs, pg_adv = vtrace(
+            vs, pg_adv = vtrace_returns(
                 jax.lax.stop_gradient(values), batch["last_value"],
-                batch["rewards"], batch["dones"], rhos)
+                batch["rewards"], batch["dones"], rhos,
+                gamma=gamma, rho_bar=rho_bar, c_bar=c_bar)
             pg_loss = -jnp.mean(logp * pg_adv)
             vf_loss = jnp.mean((values - vs) ** 2)
             entropy = -jnp.mean(

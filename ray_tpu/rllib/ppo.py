@@ -23,6 +23,26 @@ from ray_tpu.rllib.env import ENV_REGISTRY
 
 
 # --------------------------------------------------------------------------
+# Loss pieces (module level so external learners — e.g. ray_tpu.rl — can
+# reuse the exact clipped-surrogate objective on token-level batches)
+# --------------------------------------------------------------------------
+
+def clipped_surrogate_loss(logp, behavior_logp, adv, clip_eps):
+    """Clipped-PPO policy-gradient loss.
+
+    ``logp`` is the current policy's log-prob of the taken action,
+    ``behavior_logp`` the log-prob under the policy that generated the
+    data. All three arrays share a leading axis; returns a scalar.
+    """
+    import jax.numpy as jnp
+
+    ratio = jnp.exp(logp - behavior_logp)
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv
+    return -jnp.mean(jnp.minimum(unclipped, clipped))
+
+
+# --------------------------------------------------------------------------
 # Policy network (jax/flax actor-critic MLP)
 # --------------------------------------------------------------------------
 
@@ -170,12 +190,8 @@ class PPO:
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 logp_all, mb["actions"][:, None], axis=-1)[:, 0]
-            ratio = jnp.exp(logp - mb["logp"])
-            adv = mb["adv"]
-            unclipped = ratio * adv
-            clipped = jnp.clip(ratio, 1 - cfg.clip_eps,
-                               1 + cfg.clip_eps) * adv
-            pg_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+            pg_loss = clipped_surrogate_loss(
+                logp, mb["logp"], mb["adv"], cfg.clip_eps)
             vf_loss = jnp.mean((values - mb["returns"]) ** 2)
             entropy = -jnp.mean(
                 jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
@@ -219,11 +235,45 @@ class PPO:
         returns = adv + values
         return adv, returns
 
+    def train_on_batch(self, data: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Minibatch clipped-PPO SGD over an externally supplied batch.
+
+        ``data`` needs ``obs``, ``actions``, ``logp`` (behavior log-probs),
+        ``adv`` and ``returns``, all index-aligned on the leading axis.
+        Advantages are normalized here. This is the consume-external-
+        rollouts surface used by ray_tpu.rl; ``train()`` delegates to it
+        after sampling from its own workers.
+        """
+        import jax.numpy as jnp
+
+        cfg = self.config
+        adv = np.asarray(data["adv"], np.float32)
+        data = dict(data)
+        data["adv"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        n = len(data["obs"])
+        mb_size = min(cfg.minibatch_size, n)
+        rng = np.random.RandomState(cfg.seed + self._iteration)
+        mbs = []
+        for _ in range(cfg.num_sgd_epochs):
+            perm = rng.permutation(n)
+            for i in range(0, n - mb_size + 1, mb_size):
+                idx = perm[i:i + mb_size]
+                mbs.append({k: v[idx] for k, v in data.items()})
+        stacked = {k: jnp.asarray(np.stack([m[k] for m in mbs]))
+                   for k in mbs[0]}
+        self.params, self.opt_state, mean_loss = self._update(
+            self.params, self.opt_state, stacked)
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter": n,
+            "loss": float(mean_loss),
+        }
+
     def train(self) -> Dict[str, Any]:
         """One iteration: parallel sample -> GAE -> minibatch SGD ->
         broadcast weights."""
-        import jax.numpy as jnp
-
         cfg = self.config
         t0 = time.time()
         weights_ref = ray_tpu.put(self.params)
@@ -245,36 +295,18 @@ class PPO:
             "adv": np.concatenate(advs),
             "returns": np.concatenate(rets),
         }
-        adv = data["adv"]
-        data["adv"] = (adv - adv.mean()) / (adv.std() + 1e-8)
-
-        n = len(data["obs"])
-        mb_size = min(cfg.minibatch_size, n)
-        rng = np.random.RandomState(cfg.seed + self._iteration)
-        mbs = []
-        for _ in range(cfg.num_sgd_epochs):
-            perm = rng.permutation(n)
-            for i in range(0, n - mb_size + 1, mb_size):
-                idx = perm[i:i + mb_size]
-                mbs.append({k: v[idx] for k, v in data.items()})
-        stacked = {k: jnp.asarray(np.stack([m[k] for m in mbs]))
-                   for k in mbs[0]}
-        self.params, self.opt_state, mean_loss = self._update(
-            self.params, self.opt_state, stacked)
+        stats = self.train_on_batch(data)
 
         reward_lists = ray_tpu.get(
             [w.episode_rewards.remote() for w in self.workers])
         all_rewards = [r for lst in reward_lists for r in lst]
-        self._iteration += 1
-        return {
-            "training_iteration": self._iteration,
+        stats.update({
             "episode_reward_mean": (float(np.mean(all_rewards))
                                     if all_rewards else float("nan")),
             "episodes_total": len(all_rewards),
-            "timesteps_this_iter": n,
-            "loss": float(mean_loss),
             "time_this_iter_s": time.time() - t0,
-        }
+        })
+        return stats
 
     def get_policy_params(self):
         return self.params

@@ -448,6 +448,18 @@ class ObjectPlane:
         self._ensure_reg_thread()
         self._reg_wake.set()
 
+    def drop_borrow(self, oid: ObjectID) -> None:
+        """Explicitly drop a borrow registered via ``note_borrow`` for
+        an id whose lifetime rides a COMPANION object's release —
+        device-object payload borrows (mesh/device_objects) drop when
+        the main ref's release drains, not from their own finalizer."""
+        with self._own_lock:
+            self._borrowed.discard(oid)
+        with self._reg_lock:
+            self._pending_borrow_drop.append(oid.hex())
+        self._ensure_reg_thread()
+        self._reg_wake.set()
+
     def release_owned(self, oid: ObjectID) -> None:
         """Zero-ref notification (called from ObjectRef.__del__, which
         can run inside a GC pause): deque.append ONLY — it is atomic
@@ -479,15 +491,24 @@ class ObjectPlane:
                 oid = self._release_q.popleft()
             except IndexError:
                 return
+            borrow_dropped = False
             with self._own_lock:
-                if oid not in self._owned:
+                not_owned = oid not in self._owned
+                if not_owned:
                     if oid in self._borrowed:
                         # Last local ref of a BORROWED object: tell
                         # the owner-side protocol (batched).
                         self._borrowed.discard(oid)
                         with self._reg_lock:
                             self._pending_borrow_drop.append(oid.hex())
-                    continue
+                        borrow_dropped = True
+            if not_owned:
+                if borrow_dropped:
+                    # Outside _own_lock: the device-object layer may
+                    # re-enter the plane to drop a payload borrow.
+                    self._device_borrow_released(oid)
+                continue
+            with self._own_lock:
                 self._owned.discard(oid)
                 escaped = oid in self._escaped
                 esc_age = None
@@ -527,6 +548,22 @@ class ObjectPlane:
         try:
             from ray_tpu.mesh.device_objects import on_ref_released
             on_ref_released(oid, self, escaped=escaped)
+        except Exception:
+            pass
+
+    def _device_borrow_released(self, oid: ObjectID) -> None:
+        """Borrower-side companion of ``_device_released``: this
+        process's last ref to a BORROWED object dropped. If it was a
+        device object resolved here, the payload borrow registered at
+        resolve time drops with it (head frees the owner's host spill
+        on last-borrow-drop). Same sys.modules guard: jax-free
+        processes never borrowed a device object."""
+        import sys
+        if "ray_tpu.mesh.device_objects" not in sys.modules:
+            return
+        try:
+            from ray_tpu.mesh.device_objects import on_borrow_released
+            on_borrow_released(oid, self)
         except Exception:
             pass
 

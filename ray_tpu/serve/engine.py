@@ -193,6 +193,13 @@ def _dev_ready(buf) -> bool:
         return False
 
 
+def _first_leaf(buf):
+    """Representative device array of a readback entry. Logprob
+    capture packs (tokens, logprobs) pairs out of one jitted call, so
+    either leaf's readiness stands for the pair's."""
+    return buf[0] if isinstance(buf, tuple) else buf
+
+
 @dataclasses.dataclass
 class _Request:
     rid: int
@@ -230,6 +237,17 @@ class _Request:
                                  # counts in its own queue-depth lane
                                  # so the autoscaler never scales for
                                  # preemptible backlog.
+    logprobs: Optional[List[float]] = None
+                                 # per-token sampling logprobs, index-
+                                 # aligned with ``generated`` (RL
+                                 # rollout capture, ray_tpu/rl). None
+                                 # unless the engine was built with
+                                 # ``capture_logprobs=True``; appended
+                                 # by _emit_to in the same truncation
+                                 # loop as the tokens, so eos/budget
+                                 # cuts and preemption recompute keep
+                                 # the two lists aligned by
+                                 # construction.
 
     @property
     def remaining(self) -> int:
@@ -317,6 +335,17 @@ class RequestHandle:
         if self._req.t_first is None:
             return None
         return self._req.t_first - self._req.t_submit
+
+    @property
+    def logprobs(self) -> Optional[List[float]]:
+        """Per-token sampling logprobs, index-aligned with
+        ``result()``: entry i is log p(token_i | prefix) under the
+        weights that sampled it. None unless the engine was built
+        with ``capture_logprobs=True``. Read after ``done`` (or
+        ``result()``) for the complete, truncation-consistent list —
+        mid-stream reads see a prefix."""
+        lp = self._req.logprobs
+        return None if lp is None else list(lp)
 
 
 @dataclasses.dataclass
@@ -443,6 +472,18 @@ class LLMEngine:
         drain before planning in eos/spec mode — the PR-10 latency
         profile). Env ``RAY_TPU_OVERLAP=0``/``1`` force-overrides
         the knob for A/B runs without touching call sites.
+    capture_logprobs: record the sampling logprob of every emitted
+        token (RL rollout capture, ray_tpu/rl). The jitted decode and
+        prefill steps compute ``log_softmax`` of the sampling logits
+        and gather the chosen token's logprob into a float32 buffer
+        that rides the existing trailing-readback path — no extra
+        host syncs, no extra dispatches. Tokens and logprobs stay
+        index-aligned through eos/budget truncation and preemption
+        recompute because emission appends both in one loop. Read via
+        ``RequestHandle.logprobs``. Speculative decoding is silently
+        disabled under capture (the verify path emits tokens without
+        per-token distributions — same auto-disable contract as
+        temperature > 0). Off by default: serving pays nothing.
     kv_dtype: KV pool storage dtype. ``"fp"``/None stores cfg.dtype
         pages (exact). ``"int8"`` stores quantized pages with one
         fp32 absmax scale per (kv_head, physical page) — half the
@@ -477,7 +518,8 @@ class LLMEngine:
                  overlap: Optional[bool] = None,
                  kv_dtype: Optional[str] = None,
                  prefix_digest_max: int = 512,
-                 role: str = ROLE_UNIFIED):
+                 role: str = ROLE_UNIFIED,
+                 capture_logprobs: bool = False):
         self.model = model
         self.cfg = model.config
         # Tensor-parallel placement (serve/sharding.py
@@ -577,15 +619,21 @@ class LLMEngine:
         self.kv_fetcher: Optional[Any] = None
         self.kv_migration_stats = kv_migration.new_stats()
         self._write_page_fn = None   # built on first pulled landing
+        # RL rollout logprob capture: must be fixed before the jitted
+        # decode/prefill builders run (they close over it).
+        self.capture_logprobs = bool(capture_logprobs)
         # Speculative decoding (serve/spec_decode.py): greedy-only —
         # verification accepts drafts against the argmax, so with
         # sampling it would skew the output distribution. Silently
-        # off at temperature > 0 (docs/serving.md).
+        # off at temperature > 0 (docs/serving.md), and under logprob
+        # capture (the verify emits accepted tokens without per-token
+        # sampling distributions).
         if spec_len < 0:
             raise ValueError("spec_len must be >= 0")
         if spec_len and spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
-        self.spec_len = spec_len if temperature <= 0.0 else 0
+        self.spec_len = (spec_len if temperature <= 0.0
+                         and not self.capture_logprobs else 0)
         self.spec_ngram = spec_ngram
         self._proposer_factory = (
             spec_proposer if spec_proposer is not None
@@ -769,6 +817,8 @@ class LLMEngine:
         req = _Request(next(self._rid), prompt_ids, max_new_tokens,
                        t_submit=time.monotonic(), trace_id=trace_id,
                        pull=pull, batch=(priority == LANE_BATCH))
+        if self.capture_logprobs:
+            req.logprobs = []
         if deadline_s is not None:
             req.deadline = req.t_submit + deadline_s
         self.events.append("submit", rid=req.rid, t=req.t_submit,
@@ -834,6 +884,28 @@ class LLMEngine:
         finally:
             self._work.release()
         return RequestHandle(req, self)
+
+    def submit_rollout_batch(self, prompts: List[List[int]],
+                             max_new_tokens: int = 64,
+                             deadline_s: Optional[float] = None,
+                             trace_id: Optional[str] = None
+                             ) -> List[RequestHandle]:
+        """Rollout-batch submit surface (ray_tpu/rl): queue one
+        BATCH-lane request per prompt, in order, and return the
+        handles. Batch-lane semantics are exactly the RL generator's
+        needs — admits only behind online traffic, first preemption
+        victim, excluded from the TTFT SLO signals — so a co-located
+        online workload keeps its latency while rollouts soak the
+        leftover capacity. ``trace_id`` (if given) stamps each
+        request as ``{trace_id}:{i}``; per-token logprobs ride the
+        handles when the engine was built with
+        ``capture_logprobs=True``."""
+        return [self.submit(list(p), max_new_tokens=max_new_tokens,
+                            deadline_s=deadline_s,
+                            trace_id=(f"{trace_id}:{i}"
+                                      if trace_id else None),
+                            priority=LANE_BATCH)
+                for i, p in enumerate(prompts)]
 
     def start(self) -> "LLMEngine":
         """Run the scheduler loop in a daemon thread."""
@@ -2558,7 +2630,7 @@ class LLMEngine:
         blocking_rounds = 0
         while self._fetchq or self._pending_prefill:
             front_ready = bool(self._fetchq) and \
-                _dev_ready(self._fetchq[0][0])
+                _dev_ready(_first_leaf(self._fetchq[0][0]))
             # A finished buffer is always read (free — no block): on a
             # local device the previous dispatch is usually done by
             # now, so emission stays prompt. The `keep` fence only
@@ -2576,7 +2648,8 @@ class LLMEngine:
             # can be withheld.
             pre_ready = bool(self._pending_prefill) and (
                 not ready_only or all(
-                    _dev_ready(f) for f, _ in self._pending_prefill))
+                    _dev_ready(_first_leaf(f))
+                    for f, _ in self._pending_prefill))
             if not take_buf and not pre_ready:
                 return
             if take_buf and not front_ready:
@@ -2613,6 +2686,9 @@ class LLMEngine:
             # precedes its first decode ride, and both can land in
             # the same drain round
             for (_f, placements), firsts in zip(pend_pre, vals[k:]):
+                f_lps = None
+                if isinstance(firsts, tuple):   # logprob capture
+                    firsts, f_lps = firsts
                 for ix, slot, row in placements:
                     if slot.preempted:
                         continue
@@ -2622,8 +2698,13 @@ class LLMEngine:
                     except EngineFault as e:
                         self._fail_rider_locked(ix, slot, e.original)
                         continue
-                    self._emit_to(slot.req, [int(firsts[row])], ix)
+                    self._emit_to(slot.req, [int(firsts[row])], ix,
+                                  lps=(None if f_lps is None
+                                       else [float(f_lps[row])]))
             for (_buf, riders, _steps), toks in zip(batch, vals):
+                lp_buf = None
+                if isinstance(toks, tuple):     # logprob capture
+                    toks, lp_buf = toks
                 for i, slot, take in riders:
                     if slot.preempted:
                         continue    # recomputed from scratch
@@ -2633,7 +2714,9 @@ class LLMEngine:
                     except EngineFault as e:
                         self._fail_rider_locked(i, slot, e.original)
                         continue
-                    self._emit_to(slot.req, toks[:take, i].tolist(), i)
+                    self._emit_to(slot.req, toks[:take, i].tolist(), i,
+                                  lps=(None if lp_buf is None
+                                       else lp_buf[:take, i].tolist()))
 
     def _fail_rider_locked(self, ix: int, slot: _Slot,
                            err: BaseException) -> None:
@@ -2648,11 +2731,15 @@ class LLMEngine:
         else:
             self._fail_req_locked(slot.req, err, "fault_failed")
 
-    def _emit_to(self, req: _Request, tokens: List[int], ix: int):
+    def _emit_to(self, req: _Request, tokens: List[int], ix: int,
+                 lps: Optional[List[float]] = None):
         """Deliver tokens to the request; close it when it hits eos
         or its budget. In no-eos mode the slot/pages were already
         retired at dispatch time; with an eos, closing here frees
-        them (the readback is what reveals the eos)."""
+        them (the readback is what reveals the eos). ``lps`` (logprob
+        capture) is index-aligned with ``tokens``; exactly the
+        emitted prefix is appended, so eos/budget truncation keeps
+        ``req.logprobs`` aligned with ``req.generated``."""
         if req.closed:
             return
         done = False
@@ -2690,6 +2777,8 @@ class LLMEngine:
                     or req.remaining <= 0):
                 done = True
                 break
+        if n_put and req.logprobs is not None and lps is not None:
+            req.logprobs.extend(float(x) for x in lps[:n_put])
         if n_put:
             _now = time.monotonic()
             self.events.append("emit", rid=req.rid, sid=ix, t=_now,
@@ -2767,10 +2856,13 @@ class LLMEngine:
             start[r] = slot.prefilled
             last_idx[r] = take - 1
             pt[r, :len(slot.pages)] = slot.pages
-        firsts, self.pages, self._rng = fn(
+        out, self.pages, self._rng = fn(
             self.params, self.pages, self._h2d(ids),
             self._h2d(start), self._h2d(last_idx),
             self._h2d(pt), self._rng)
+        # logprob capture packs (firsts, first_logprobs); the seed
+        # scatter takes the raw firsts, emission gets the pair
+        firsts = out[0] if self.capture_logprobs else out
         placements = []
         for r, (ix, slot, take) in enumerate(rows):
             slot.prefilled += take
@@ -2796,7 +2888,7 @@ class LLMEngine:
         # decode stream on a host RTT. Queued even with no finished
         # rows so drains (and preemption barriers) can sync on every
         # in-flight prefill dispatch.
-        self._pending_prefill.append((firsts, placements))
+        self._pending_prefill.append((out, placements))
         self.events.append(
             "prefill",
             rid=tuple(slot.req.rid for _ix, slot, _t in rows),
@@ -2819,6 +2911,7 @@ class LLMEngine:
         model, temp = self.model, self.temperature
         B = self._max_prefill_batch
         constrain = self._constrain_kv
+        capture = self.capture_logprobs
         from ray_tpu.models.llama import _pick_token
 
         def prefill(params, pages, ids, start, last_idx, page_table,
@@ -2833,6 +2926,17 @@ class LLMEngine:
             new_pages = constrain([kv_layer_store(c) for c in new_kv])
             last = logits[jnp.arange(B), last_idx]        # [B, V]
             firsts = _pick_token(last, sub, temp)
+            if capture:
+                # Score under the SAMPLING distribution (temperature-
+                # scaled at temp > 0) — the behavior policy an RL
+                # learner's importance ratio needs, not the raw model
+                # distribution.
+                slog = (last.astype(jnp.float32) / temp if temp > 0.0
+                        else last.astype(jnp.float32))
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(slog),
+                    firsts[:, None], axis=-1)[:, 0]
+                return (firsts, lp), new_pages, rng
             return firsts, new_pages, rng
 
         return jax.jit(prefill, donate_argnums=(1,))
@@ -2864,6 +2968,7 @@ class LLMEngine:
         model, temp = self.model, self.temperature
         KMAX, S = self.KMAX, self.S
         constrain = self._constrain_kv
+        capture = self.capture_logprobs
         from ray_tpu.models.llama import _pick_token
 
         def decode(params, pages, page_table, pos, cur, rng, steps):
@@ -2874,28 +2979,43 @@ class LLMEngine:
             # pos/cur are the DEVICE-authoritative per-slot state:
             # they chain dispatch-to-dispatch (admission seeds rows
             # via _build_seed's scatter), so no host readback ever
-            # sits between two dispatches.
+            # sits between two dispatches. With logprob capture a
+            # float32 [KMAX, S] buffer of the chosen tokens' logprobs
+            # rides the same carry and the same trailing readback.
             buf0 = jnp.zeros((KMAX, S), jnp.int32)
+            lp0 = jnp.zeros((KMAX, S), jnp.float32)
 
             def body(i, carry):
-                pages, pos, cur, key, buf = carry
+                pages, pos, cur, key, buf, lps = carry
                 key, sub = jax.random.split(key)
                 kv = [kv_layer_view(layer, page_table)
                       for layer in pages]
                 logits, new_kv = model.apply(
                     params, cur[:, None], kv_caches=kv, cache_len=pos)
                 nxt = _pick_token(logits[:, -1], sub, temp)
+                if capture:
+                    # Behavior-policy logprob: temperature-scaled to
+                    # match what _pick_token actually sampled from.
+                    slog = (logits[:, -1].astype(jnp.float32) / temp
+                            if temp > 0.0
+                            else logits[:, -1].astype(jnp.float32))
+                    lp = jnp.take_along_axis(
+                        jax.nn.log_softmax(slog),
+                        nxt[:, None], axis=-1)[:, 0]
+                    lps = lps.at[i].set(lp)
                 # pin the loop-carried pool to the head-sharded layout
                 # so the carry's sharding is loop-invariant (GSPMD
                 # would otherwise be free to reshard mid-carry)
                 new_pages = constrain(
                     [kv_layer_store(c) for c in new_kv])
-                return (new_pages, pos + 1, nxt, key, buf.at[i].set(nxt))
-            pages, pos, cur, key, buf = jax.lax.fori_loop(
-                0, steps, body, (pages, pos, cur, rng, buf0))
+                return (new_pages, pos + 1, nxt, key,
+                        buf.at[i].set(nxt), lps)
+            pages, pos, cur, key, buf, lps = jax.lax.fori_loop(
+                0, steps, body, (pages, pos, cur, rng, buf0, lp0))
             # key/pos/cur return as device state: the host never syncs
             # on them between dispatches
-            return buf, pages, key, pos, cur   # buf: [KMAX, S]
+            out = (buf, lps) if capture else buf
+            return out, pages, key, pos, cur   # buf: [KMAX, S]
 
         return jax.jit(decode, donate_argnums=(1, 3, 4))
 

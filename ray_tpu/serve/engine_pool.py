@@ -470,6 +470,18 @@ class PoolRequestHandle(ResubmitPolicy):
             return None
         return f"{gen}:{getattr(eng, 'weights_id', None)}"
 
+    @property
+    def logprobs(self) -> Optional[List[float]]:
+        """Per-token sampling logprobs from the serving replica's
+        handle (engines built with ``capture_logprobs=True``; None
+        otherwise). A death-triggered resubmit regenerates from
+        scratch on the new replica, so the list always reflects one
+        engine's aligned token stream — never a stitched mix."""
+        inner = self._inner
+        if inner is None:
+            return None
+        return getattr(inner, "logprobs", None)
+
     # -------------------------------------------------------- internal
 
     def _resubmit(self, cause: BaseException) -> None:
@@ -799,6 +811,25 @@ class EnginePool:
                                        priority=priority)
         handle._attach(rep, inner)
         return handle
+
+    def submit_rollout_batch(self, prompts: Sequence[Sequence[int]],
+                             max_new_tokens: int = 64,
+                             deadline_s: Optional[float] = None,
+                             trace_id: Optional[str] = None
+                             ) -> List[PoolRequestHandle]:
+        """Rollout-batch submit surface (ray_tpu/rl): one BATCH-lane
+        request per prompt, routed through the batch spill path
+        (least-backlog replica, no stickiness/affinity claims), in
+        order. Mirrors ``LLMEngine.submit_rollout_batch`` so the RL
+        generator drives a single engine and a pool through one
+        interface; per-token logprobs ride the handles when the
+        replica engines were built with ``capture_logprobs=True``."""
+        return [self.submit(list(p), max_new_tokens=max_new_tokens,
+                            deadline_s=deadline_s,
+                            trace_id=(f"{trace_id}:{i}"
+                                      if trace_id else None),
+                            priority=LANE_BATCH)
+                for i, p in enumerate(prompts)]
 
     def _submit_leg(self, prompt: List[int], max_new_tokens: int,
                     deadline_s: Optional[float],

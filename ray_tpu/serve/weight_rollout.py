@@ -12,9 +12,10 @@ auto-rollback on regression.
 Identity model: the **generation** is a per-engine strictly monotonic
 fence (every swap advances it, rollbacks included), so "which payload
 is serving" is named by the **weights_id** — derived here from the
-checkpoint manifest's file hashes, so the same bytes always get the
-same id and a rollback provably converges the fleet back onto the old
-payload. Every transition is evented into the pool ring and the
+checkpoint payload's canonical content (tree paths + dtypes + raw
+leaf bytes), so the same bytes always get the same id — across
+independent publishes, not just within one directory — and a rollback
+provably converges the fleet back onto the old payload. Every transition is evented into the pool ring and the
 terminal transitions (rollback, completion) are flight-bundle-
 explained.
 
@@ -49,15 +50,54 @@ HEALTHY_STATES = ("healthy", "suspect")
 
 
 def weights_id_from_manifest(manifest: Dict[str, Any]) -> str:
-    """Stable payload identity: a digest over the manifest's per-file
-    sha256 table. Same bytes -> same id, regardless of directory name
-    or publish time — the property rollback convergence proofs rely
-    on."""
+    """Legacy payload identity: a digest over the manifest's per-file
+    sha256 table. Stable for one committed directory, but NOT across
+    republishes of the same tensors — the array store embeds
+    per-write metadata, so byte-identical payloads serialize to
+    different files. Kept for auditing a specific directory;
+    ``publish_weights``/``load_weights`` stamp ids with
+    ``weights_id_from_payload`` instead."""
     h = hashlib.sha256()
     for rel in sorted(manifest.get("files") or {}):
         rec = manifest["files"][rel]
         h.update(rel.encode())
         h.update(str(rec.get("sha256")).encode())
+    return h.hexdigest()[:12]
+
+
+def weights_id_from_payload(data: Dict[str, Any]) -> str:
+    """Canonical payload identity: a digest over the checkpoint
+    dict's tree paths, dtypes, shapes and raw leaf bytes (metadata
+    entries included, so release tags still distinguish byte-identical
+    tensors). Same content -> same id across independent publishes —
+    the property the RLHF resume proof (republish the recovered
+    params, land on the recovered id) and rollback convergence rely
+    on."""
+    import numpy as np
+    h = hashlib.sha256()
+
+    def walk(prefix: str, v: Any) -> None:
+        if isinstance(v, dict):
+            for k in sorted(v):
+                walk(f"{prefix}/{k}", v[k])
+            return
+        if isinstance(v, (list, tuple)):
+            for i, x in enumerate(v):
+                walk(f"{prefix}/{i}", x)
+            return
+        h.update(prefix.encode())
+        try:
+            a = np.asarray(v)
+        except Exception:
+            a = None
+        if a is None or a.dtype == object:
+            h.update(repr(v).encode())
+        else:
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(np.ascontiguousarray(a).tobytes())
+
+    walk("", data)
     return h.hexdigest()[:12]
 
 
@@ -72,10 +112,10 @@ def publish_weights(params, path: str, step: Optional[int] = None,
     data = dict(extra or {})
     data["params"] = params
     out = Checkpoint.from_dict(data).to_directory(path, step=step)
-    ok, reason, manifest = verify_checkpoint_dir(out)
+    ok, reason, _manifest = verify_checkpoint_dir(out)
     if not ok:                                    # pragma: no cover
         raise InvalidCheckpointError(out, reason)
-    return out, weights_id_from_manifest(manifest)
+    return out, weights_id_from_payload(data)
 
 
 def load_weights(path: str) -> Tuple[Any, str]:
@@ -83,14 +123,31 @@ def load_weights(path: str) -> Tuple[Any, str]:
     truncated, or bit-rotted directory is refused TYPED
     (``InvalidCheckpointError``) before any replica is touched.
     Returns ``(params, weights_id)``."""
-    ok, reason, manifest = verify_checkpoint_dir(path, deep=True)
+    ok, reason, _manifest = verify_checkpoint_dir(path, deep=True)
     if not ok:
         raise InvalidCheckpointError(path, reason)
     data = Checkpoint.from_directory(path).to_dict()
     if "params" not in data:
         raise InvalidCheckpointError(
             path, "checkpoint carries no 'params' entry")
-    return data["params"], weights_id_from_manifest(manifest)
+    return data["params"], weights_id_from_payload(data)
+
+
+def publish_and_swap(engine, params, path: str, *,
+                     step: Optional[int] = None, mode: str = "preempt",
+                     extra: Optional[Dict[str, Any]] = None
+                     ) -> Tuple[int, str]:
+    """In-process publish -> swap shortcut for a co-located learner
+    (the RLHF loop's per-update path): commit ``params`` as a durable
+    manifest checkpoint, then install them on ``engine`` under the next
+    generation. The durable copy is what a restarted generator re-syncs
+    from; the swap is what live decode picks up. Returns
+    ``(generation, weights_id)``."""
+    _, wid = publish_weights(params, path, step=step, extra=extra)
+    gen = engine.swap_weights(
+        params, generation=engine.weight_generation + 1,
+        weights_id=wid, mode=mode)
+    return gen, wid
 
 
 class WeightRolloutController:

@@ -186,6 +186,30 @@ def check_generation(control_dir: str, attempt: int) -> None:
             f"{generation(control_dir)}")
 
 
+class AttemptFence:
+    """Context-manager packaging of the GENERATION-file fence for loops
+    outside the trainer (e.g. the RLHF driver in ray_tpu.rl): entering
+    records this attempt as started (``fence``); ``check()`` is the
+    per-round / pre-commit gate and raises ``StaleGeneration`` once a
+    newer attempt has fenced — a superseded loop stops itself and can
+    never commit, the same zombie discipline as the chaos train loop."""
+
+    def __init__(self, control_dir: str, attempt: int):
+        self.control_dir = control_dir
+        self.attempt = attempt
+
+    def __enter__(self) -> "AttemptFence":
+        os.makedirs(self.control_dir, exist_ok=True)
+        fence(self.control_dir, self.attempt)
+        return self
+
+    def check(self) -> None:
+        check_generation(self.control_dir, self.attempt)
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
 def _hang_path(control_dir: str, rank: int) -> str:
     return os.path.join(control_dir, f"hang-{rank}")
 

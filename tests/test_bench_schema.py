@@ -2315,3 +2315,151 @@ def test_serve_chaos_disagg_requires_flight_and_quiesce(tmp_path):
     bad["disagg"]["quiesced"] = False
     probs = _problems_for("SERVE_CHAOS_x.json", bad, tmp_path)
     assert any("disagg" in p and "quiesce" in p for p in probs)
+
+
+# ------------------------------------------------- rlhf A/B family
+
+
+def _rlhf_arm(mode, util):
+    rounds = 6
+    return {
+        "mode": mode,
+        "rounds": rounds,
+        "wall_s": 8.0,
+        "gen_busy_s": util * 8.0,
+        "generator_utilization": util,
+        "staleness_bound": 1,
+        "max_staleness": 1 if mode == "overlap" else 0,
+        "overlap_observed": mode == "overlap",
+        "reward_curve": [0.5 + 0.05 * i for i in range(rounds)],
+        "ledger": [f"round-{i}" for i in range(rounds)],
+        "batch_log": [
+            {"batch_id": f"round-{i}", "round": i,
+             "weights_id": f"wid{i:08d}aaaa", "generation": i + 1,
+             "staleness": 1 if (mode == "overlap" and i) else 0,
+             "reward_mean": 0.5 + 0.05 * i, "num_tokens": 128}
+            for i in range(rounds)],
+        "final_weights_id": "widfinal0000",
+    }
+
+
+def _rlhf_ab():
+    return {
+        "rlhf_ab": {
+            "overlap": _rlhf_arm("overlap", 0.42),
+            "serialized": _rlhf_arm("serialized", 0.35),
+            "utilization_ratio": 1.2,
+            "chaos": {
+                "generator_kill": {"kill_round": 3, "restarts": 1,
+                                   "rounds": 6, "ledger_len": 6,
+                                   "duplicates": 0, "lost": 0},
+                "learner_kill": {"kill_step": 3, "resumed": True,
+                                 "recovered_weights_id": "widrec000000",
+                                 "resync_weights_id": "widrec000000",
+                                 "rounds": 6, "ledger_len": 6,
+                                 "duplicates": 0, "lost": 0},
+            },
+        },
+        "mesh": {"tp": 1, "replicas": 1},
+        "seed": 0, "git_sha": "abc1234",
+    }
+
+
+def test_rlhf_ab_artifact_validates(tmp_path):
+    assert _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                         _rlhf_ab(), tmp_path) == []
+
+
+def test_rlhf_ab_refuses_missing_stamps(tmp_path):
+    for key, needle in (("mesh", "mesh stamp"), ("seed", "seed")):
+        bad = _rlhf_ab()
+        del bad[key]
+        probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any(needle in p for p in probs), key
+    unstamped = _rlhf_ab()
+    del unstamped["rlhf_ab"]["overlap"]["batch_log"][2]["weights_id"]
+    probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                          unstamped, tmp_path)
+    assert any("weights_id" in p and "batch_log" in p for p in probs)
+
+
+def test_rlhf_ab_refuses_flat_or_declining_curve(tmp_path):
+    rounds = 6
+    for curve in ([0.5] * rounds,
+                  [0.5 - 0.02 * i for i in range(rounds)]):
+        bad = _rlhf_ab()
+        bad["rlhf_ab"]["overlap"]["reward_curve"] = curve
+        probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any("did not" in p and "improve" in p for p in probs)
+    missing = _rlhf_ab()
+    del missing["rlhf_ab"]["overlap"]["reward_curve"]
+    probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                          missing, tmp_path)
+    assert any("reward_curve" in p for p in probs)
+
+
+def test_rlhf_ab_refuses_unprofitable_overlap(tmp_path):
+    for ratio in (1.0, 0.8):
+        bad = _rlhf_ab()
+        bad["rlhf_ab"]["utilization_ratio"] = ratio
+        probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                              bad, tmp_path)
+        assert any("utilization_ratio" in p for p in probs), ratio
+    never = _rlhf_ab()
+    never["rlhf_ab"]["overlap"]["overlap_observed"] = False
+    probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                          never, tmp_path)
+    assert any("overlap_observed" in p for p in probs)
+
+
+def test_rlhf_ab_refuses_staleness_over_bound(tmp_path):
+    bad = _rlhf_ab()
+    bad["rlhf_ab"]["overlap"]["max_staleness"] = 2
+    probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("max_staleness" in p and "bound" in p for p in probs)
+
+
+def test_rlhf_ab_refuses_duplicate_ledger(tmp_path):
+    bad = _rlhf_ab()
+    bad["rlhf_ab"]["overlap"]["ledger"][2] = "round-1"
+    probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                          bad, tmp_path)
+    assert any("duplicate" in p and "ledger" in p for p in probs)
+
+
+def test_rlhf_ab_refuses_lossy_or_unexercised_chaos(tmp_path):
+    no_chaos = _rlhf_ab()
+    del no_chaos["rlhf_ab"]["chaos"]
+    probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                          no_chaos, tmp_path)
+    assert any("chaos" in p for p in probs)
+    unkilled = _rlhf_ab()
+    unkilled["rlhf_ab"]["chaos"]["generator_kill"]["restarts"] = 0
+    probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                          unkilled, tmp_path)
+    assert any("nothing was killed" in p for p in probs)
+    for drill in ("generator_kill", "learner_kill"):
+        for key in ("duplicates", "lost"):
+            bad = _rlhf_ab()
+            bad["rlhf_ab"]["chaos"][drill][key] = 1
+            probs = _problems_for(
+                "SERVE_BENCH_rlhf_ab_cpu_smoke.json", bad, tmp_path)
+            assert any(key in p and "0" in p for p in probs), \
+                (drill, key)
+
+
+def test_rlhf_ab_refuses_resync_mismatch(tmp_path):
+    unresumed = _rlhf_ab()
+    unresumed["rlhf_ab"]["chaos"]["learner_kill"]["resumed"] = False
+    probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                          unresumed, tmp_path)
+    assert any("did not" in p and "resume" in p for p in probs)
+    wrong = _rlhf_ab()
+    wrong["rlhf_ab"]["chaos"]["learner_kill"]["resync_weights_id"] = \
+        "widother0000"
+    probs = _problems_for("SERVE_BENCH_rlhf_ab_cpu_smoke.json",
+                          wrong, tmp_path)
+    assert any("wrong policy" in p for p in probs)

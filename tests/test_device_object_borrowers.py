@@ -1,0 +1,159 @@
+"""Payload borrower protocol for device objects (PR 20 satellite).
+
+An escaped device object's host spill (`payload_oid`) used to revert
+to shm-LRU lifetime once the owner dropped its ref. Now consumers
+register a borrow on the payload id at resolve time and the owner's
+release hands the spill to the head's borrower protocol, so the host
+copy frees on the LAST borrow drop — the drop-order matrix:
+
+- owner drops first: the borrower's live ref keeps the payload
+  resolvable well past the grace window; it frees after the borrower
+  lets go.
+- borrower drops first: the payload survives (owner still holds);
+  it frees within the grace window of the owner's own drop.
+- escaped but never resolved: no payload borrow exists, so the
+  owner's drop frees the spill eagerly after the grace window — not
+  under LRU pressure.
+"""
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime import Cluster
+
+GRACE = 0.5
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import os
+    import ray_tpu._private.worker as worker_mod
+    if worker_mod.is_initialized():
+        worker_mod.shutdown()
+    os.environ["RAY_TPU_borrow_grace_s"] = str(GRACE)
+    from ray_tpu._private.config import GlobalConfig
+    GlobalConfig.reset()
+    c = Cluster(num_workers=1,
+                resources_per_worker={"CPU": 2, "node0": 10},
+                store_capacity=256 * 1024 * 1024)
+    c.add_node(num_workers=1,
+               resources_per_worker={"CPU": 2, "node1": 10},
+               store_capacity=256 * 1024 * 1024)
+    yield c
+    c.shutdown()
+    os.environ.pop("RAY_TPU_borrow_grace_s", None)
+    GlobalConfig.reset()
+
+
+def _store():
+    from ray_tpu._private.worker import global_worker
+    return global_worker().runtime.plane.store
+
+
+def _wait_gone(oid, timeout=15.0):
+    deadline = time.time() + timeout
+    store = _store()
+    while time.time() < deadline:
+        if not store.contains(oid):
+            return True
+        time.sleep(0.25)
+    return False
+
+
+def _put_device_array(value=3.0, n=1024):
+    import jax.numpy as jnp
+    return ray_tpu.put(jnp.full((n,), value, jnp.float32))
+
+
+@ray_tpu.remote(resources={"node1": 1})
+class Holder:
+    """Borrower on the other node; resolve/hold/drop are separated so
+    each drop-order arm controls exactly when the payload borrow is
+    registered and when it drops."""
+
+    def __init__(self):
+        self.ref = None
+
+    def hold(self, boxed):
+        self.ref = boxed[0]        # nested ref stays a ref
+        return True
+
+    def resolve(self):
+        import numpy as _np
+        return float(_np.asarray(ray_tpu.get(self.ref))[0])
+
+    def drop(self):
+        self.ref = None
+        import gc as _gc
+        _gc.collect()
+        return True
+
+
+def test_owner_drops_first_borrower_pins_payload(cluster):
+    from ray_tpu.mesh.device_objects import payload_oid
+
+    h = Holder.remote()
+    ref = _put_device_array(7.0)
+    oid = ref.id
+    poid = payload_oid(oid)
+    assert ray_tpu.get(h.hold.remote([ref]))      # escape -> spill
+    assert ray_tpu.get(h.resolve.remote()) == 7.0  # payload borrow
+    assert _store().contains(poid)
+    time.sleep(1.0)            # let the borrow registration land
+    del ref
+    gc.collect()
+    # Well past the grace window the payload borrow still pins the
+    # host spill, and the borrower can still resolve the array.
+    time.sleep(GRACE * 4 + 1.0)
+    assert _store().contains(poid), \
+        "payload freed while a borrow was registered"
+    assert ray_tpu.get(h.resolve.remote()) == 7.0
+    # Last borrow drops -> payload freed within grace + flusher lag.
+    assert ray_tpu.get(h.drop.remote())
+    assert _wait_gone(poid), "payload not freed after last borrow drop"
+    assert _wait_gone(oid), "descriptor not freed after borrow drop"
+    ray_tpu.kill(h)
+
+
+def test_borrower_drops_first_owner_keeps_payload(cluster):
+    from ray_tpu.mesh.device_objects import payload_oid
+
+    h = Holder.remote()
+    ref = _put_device_array(5.0)
+    poid = payload_oid(ref.id)
+    assert ray_tpu.get(h.hold.remote([ref]))
+    assert ray_tpu.get(h.resolve.remote()) == 5.0
+    time.sleep(1.0)
+    assert ray_tpu.get(h.drop.remote())           # borrower lets go
+    # The owner still holds its ref: the payload must survive the
+    # borrow drop (the head forgets the borrow entry, nothing frees).
+    time.sleep(GRACE * 4 + 1.0)
+    assert _store().contains(poid), \
+        "payload freed while the owner still held its ref"
+    del ref
+    gc.collect()
+    assert _wait_gone(poid), "payload not freed after owner drop"
+    ray_tpu.kill(h)
+
+
+def test_escaped_never_resolved_frees_eagerly(cluster):
+    from ray_tpu.mesh.device_objects import payload_oid
+
+    @ray_tpu.remote(resources={"node1": 1})
+    def touch(boxed):
+        # Deserializes the ref (escape happened at pickling) but never
+        # resolves it: no payload borrow is ever registered.
+        return boxed[0] is not None
+
+    ref = _put_device_array(1.0)
+    poid = payload_oid(ref.id)
+    assert ray_tpu.get(touch.remote([ref]))
+    assert _store().contains(poid)                # spill happened
+    del ref
+    gc.collect()
+    # No borrows: the owner's release frees the spill after the grace
+    # window — eagerly, not under LRU pressure.
+    assert _wait_gone(poid), "unborrowed payload not freed eagerly"

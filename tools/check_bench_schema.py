@@ -315,6 +315,19 @@ ROLLOUT_ARM_REQUIRED = {
     "tokens": int,
 }
 
+# RLHF A/B artifacts carry one of these per arm (tools/rl_bench.py):
+# the same toy rollout->score->update loop with decode overlapping
+# the learner step vs fully serialized.
+RLHF_ARM_REQUIRED = {
+    "rounds": int,
+    "wall_s": NUM,
+    "gen_busy_s": NUM,
+    "generator_utilization": NUM,
+    "staleness_bound": int,
+    "max_staleness": int,
+    "final_weights_id": str,
+}
+
 # batch-tier profile A/B artifacts carry one of these per arm
 # (serve_bench.py run_batch_ab): the same offline corpus through
 # BatchInferenceJob on an engine built from each scheduler profile.
@@ -1266,6 +1279,169 @@ def check_rollout_ab(obj, name, problems):
             "flight-explained")
 
 
+def _check_rlhf_arm(sec, where, problems):
+    """Shared per-arm validation for the rlhf_ab family: staleness
+    stays within the stamped bound, the rollout ledger has no
+    duplicates, and every consumed batch is stamped with the
+    weights_id/generation that produced it."""
+    _check_fields(sec, RLHF_ARM_REQUIRED, where, problems)
+    bound = sec.get("staleness_bound")
+    mx = sec.get("max_staleness")
+    if isinstance(bound, int) and not isinstance(bound, bool) \
+            and isinstance(mx, int) and not isinstance(mx, bool) \
+            and mx > bound:
+        problems.append(
+            f"{where}: max_staleness {mx} exceeds the stamped "
+            f"staleness_bound {bound} — the loop consumed a rollout "
+            "batch staler than the knob allows")
+    ledger = sec.get("ledger")
+    if not isinstance(ledger, list) or not ledger \
+            or not all(isinstance(b, str) for b in ledger):
+        problems.append(
+            f"{where}: missing the rollout ledger (non-empty list "
+            "of batch ids) — exactly-once consumption cannot be "
+            "audited without it")
+    elif len(set(ledger)) != len(ledger):
+        problems.append(
+            f"{where}: duplicate batch ids in the rollout ledger — "
+            "the learner consumed the same rollout batch twice")
+    log = sec.get("batch_log")
+    if not isinstance(log, list) or not log:
+        problems.append(
+            f"{where}: missing batch_log — consumed batches must "
+            "each carry the weights_id that generated them")
+        return
+    for i, ent in enumerate(log):
+        if not isinstance(ent, dict) \
+                or not isinstance(ent.get("weights_id"), str) \
+                or not ent.get("weights_id"):
+            problems.append(
+                f"{where}:batch_log[{i}]: missing the weights_id "
+                "stamp — an unattributed rollout batch breaks the "
+                "policy-version audit trail")
+            break
+
+
+def check_rlhf_ab(obj, name, problems):
+    """tools/rl_bench.py artifact: a toy RLHF loop (serving engine as
+    rollout generator, PPO learner) run twice over the same prompts
+    and seed — decode for round N+1 overlapped with the learner step
+    for round N, vs fully serialized — plus two chaos drills (kill
+    the generator mid-round, kill the learner pre-commit). The
+    checker REFUSES artifacts whose learning curve is flat or
+    non-improving (a rollout loop that doesn't learn measured
+    plumbing, not RL), whose overlapped generator utilization is not
+    strictly above serialized (the A/B exists to prove the overlap
+    pays), whose overlap arm never actually overlapped, whose
+    staleness exceeded the stamped bound, whose rollout ledgers
+    contain duplicates (exactly-once violated), whose chaos drills
+    lost or duplicated rollouts or failed to re-sync the generator to
+    the recovered weights_id, or without seed/mesh stamps."""
+    _check_mesh(obj, name, problems, required=True)
+    if not isinstance(obj.get("seed"), int) \
+            or isinstance(obj.get("seed"), bool):
+        problems.append(f"{name}: rlhf A/B artifact missing int "
+                        "'seed'")
+    ab = obj.get("rlhf_ab")
+    if not isinstance(ab, dict):
+        problems.append(f"{name}: rlhf_ab must be an object")
+        return
+    for arm in ("overlap", "serialized"):
+        sec = ab.get(arm)
+        if not isinstance(sec, dict):
+            problems.append(f"{name}:rlhf_ab: missing {arm} arm "
+                            "object")
+            continue
+        _check_rlhf_arm(sec, f"{name}:rlhf_ab:{arm}", problems)
+    ov = ab.get("overlap")
+    if isinstance(ov, dict):
+        curve = ov.get("reward_curve")
+        if not isinstance(curve, list) or len(curve) < 4 \
+                or not all(isinstance(r, NUM)
+                           and not isinstance(r, bool)
+                           for r in curve):
+            problems.append(
+                f"{name}:rlhf_ab:overlap: missing the reward_curve "
+                "(list of >= 4 per-round mean rewards) — a learning "
+                "claim without a curve is an anecdote")
+        elif curve[-1] <= curve[0]:
+            problems.append(
+                f"{name}:rlhf_ab:overlap: reward curve did not "
+                f"improve ({curve[0]} -> {curve[-1]}) — the loop "
+                "moved tokens but learned nothing")
+        if ov.get("overlap_observed") is not True:
+            problems.append(
+                f"{name}:rlhf_ab:overlap: overlap_observed is not "
+                "true — round N+1 generation never ran during the "
+                "round N learner step; the arm measured a "
+                "mislabeled serialized loop")
+    ratio = ab.get("utilization_ratio")
+    if not isinstance(ratio, NUM) or isinstance(ratio, bool):
+        problems.append(
+            f"{name}:rlhf_ab: missing numeric utilization_ratio "
+            "(overlap generator_utilization / serialized)")
+    elif ratio <= 1.0:
+        problems.append(
+            f"{name}:rlhf_ab: utilization_ratio {ratio} <= 1 — "
+            "the overlapped loop did not beat the serialized one; "
+            "the sebulba split bought nothing")
+    chaos = ab.get("chaos")
+    if not isinstance(chaos, dict):
+        problems.append(
+            f"{name}:rlhf_ab: missing the 'chaos' section — "
+            "exactly-once recovery that was never demonstrated is a "
+            "hope, not a property")
+        return
+    gk = chaos.get("generator_kill")
+    if not isinstance(gk, dict):
+        problems.append(
+            f"{name}:rlhf_ab:chaos: missing the generator_kill "
+            "drill")
+    else:
+        rs = gk.get("restarts")
+        if not isinstance(rs, int) or isinstance(rs, bool) or rs < 1:
+            problems.append(
+                f"{name}:rlhf_ab:chaos:generator_kill: zero "
+                "generator restarts — nothing was killed")
+        for key in ("duplicates", "lost"):
+            v = gk.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) \
+                    or v != 0:
+                problems.append(
+                    f"{name}:rlhf_ab:chaos:generator_kill: {key} "
+                    "must be 0 — a restart may cost time, never "
+                    "rollouts")
+    lk = chaos.get("learner_kill")
+    if not isinstance(lk, dict):
+        problems.append(
+            f"{name}:rlhf_ab:chaos: missing the learner_kill drill")
+        return
+    if lk.get("resumed") is not True:
+        problems.append(
+            f"{name}:rlhf_ab:chaos:learner_kill: the loop did not "
+            "resume from the last complete checkpoint")
+    for key in ("duplicates", "lost"):
+        v = lk.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v != 0:
+            problems.append(
+                f"{name}:rlhf_ab:chaos:learner_kill: {key} must be "
+                "0 — resume must replay only the uncommitted round")
+    rec = lk.get("recovered_weights_id")
+    syn = lk.get("resync_weights_id")
+    if not isinstance(rec, str) or not isinstance(syn, str) \
+            or not rec or not syn:
+        problems.append(
+            f"{name}:rlhf_ab:chaos:learner_kill: missing "
+            "recovered_weights_id/resync_weights_id stamps — the "
+            "generator's re-sync to the recovered checkpoint is "
+            "unproven")
+    elif rec != syn:
+        problems.append(
+            f"{name}:rlhf_ab:chaos:learner_kill: generator "
+            f"re-synced to {syn} but the recovered checkpoint is "
+            f"{rec} — the fleet is sampling from the wrong policy")
+
+
 def check_batch_ab(obj, name, problems):
     """serve_bench.py --batch-ab artifact: one offline corpus through
     BatchInferenceJob on an engine built from the 'latency' vs
@@ -1415,6 +1591,13 @@ def check_serve_bench(obj, name, problems):
     if "rollout_ab" in obj:
         # live weight rollout A/B family (serve_bench.py --rollout-ab)
         check_rollout_ab(obj, name, problems)
+        sha = obj.get("git_sha")
+        if sha is not None and not isinstance(sha, str):
+            problems.append(f"{name}: git_sha must be a string")
+        return
+    if "rlhf_ab" in obj:
+        # RLHF rollout A/B family (tools/rl_bench.py)
+        check_rlhf_ab(obj, name, problems)
         sha = obj.get("git_sha")
         if sha is not None and not isinstance(sha, str):
             problems.append(f"{name}: git_sha must be a string")

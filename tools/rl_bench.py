@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""RLHF rollout A/B bench: the serving engine as an RL generation
+actor (ray_tpu.rl), overlapped vs serialized, plus chaos drills.
+
+Four legs, all on the tiny-llama CPU smoke config with a dense toy
+reward (fraction of sampled tokens in the upper half of the vocab —
+chance level 0.5, so the curve has somewhere to go):
+
+  1. overlap arm    — RLHFLoop with round N+1's decode running during
+                      round N's learner step (staleness bound 1); the
+                      reward curve must strictly improve.
+  2. serialized arm — the identical loop with overlap off; same
+                      rounds, same seed. The generator-utilization
+                      ratio overlap/serialized must be > 1 (the
+                      sebulba split has to pay for itself).
+  3. generator kill — a mid-round hook raises GeneratorKilled once;
+                      the loop restarts the generator at exactly the
+                      unconsumed round and the final ledger holds
+                      every round exactly once (0 dup / 0 lost).
+  4. learner kill   — a pre-commit hook raises before round K's
+                      checkpoint commits; run() dies, a fresh loop
+                      (attempt+1, same dirs) resumes from the last
+                      COMPLETE checkpoint, re-publishes the recovered
+                      params (same bytes => same weights_id) and the
+                      generator provably re-syncs to it.
+
+Writes SERVE_BENCH_rlhf_ab_cpu_smoke.json (rlhf_ab family), gated by
+tools/check_bench_schema.py::check_rlhf_ab.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/rl_bench.py [--rounds N] [--out F]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SEED = 0
+N_PROMPTS = 16
+PROMPT_LEN = 8
+MAX_NEW = 8
+LEARNER_DELAY_S = 0.15
+
+
+def _build(seed: int):
+    """Fresh tiny-llama engine (logprob capture on) + matching
+    learner. Each leg gets its own so weight generations never leak
+    across legs."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import Llama, llama_tiny
+    from ray_tpu.rl import RolloutGenerator, RolloutLearner
+    from ray_tpu.serve.engine import LLMEngine
+
+    cfg = llama_tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, PROMPT_LEN), jnp.int32))
+    engine = LLMEngine(model, params, max_slots=4, page_size=16,
+                       n_pages=128, chunk=4, prefill_chunk=16,
+                       temperature=1.0, eos_id=-1, seed=seed,
+                       capture_logprobs=True).start()
+    gen = RolloutGenerator(engine, max_new_tokens=MAX_NEW)
+    learner = RolloutLearner(model, params, algo="ppo", lr=1e-2,
+                             sgd_epochs=8)
+    return engine, gen, learner
+
+
+def _prompts_fn(round_idx: int):
+    import numpy as np
+    rng = np.random.RandomState(SEED * 100003 + round_idx)
+    return [rng.randint(1, 128, size=PROMPT_LEN).tolist()
+            for _ in range(N_PROMPTS)]
+
+
+def _reward_fn(prompt, completion):
+    # Dense toy objective: fraction of sampled tokens in the upper
+    # half of the 256-token vocab. Prompts come from the lower half,
+    # so the starting policy sits near chance (0.5).
+    if not completion:
+        return 0.0
+    return sum(1 for t in completion if t >= 128) / len(completion)
+
+
+def _ledger_audit(ledger, rounds):
+    expected = {f"round-{i}" for i in range(rounds)}
+    got = list(ledger)
+    return {
+        "duplicates": len(got) - len(set(got)),
+        "lost": len(expected - set(got)),
+    }
+
+
+def _arm_record(stats):
+    return {
+        "mode": stats["mode"],
+        "rounds": stats["rounds"],
+        "wall_s": round(stats["wall_s"], 4),
+        "gen_busy_s": round(stats["gen_busy_s"], 4),
+        "generator_utilization":
+            round(stats["generator_utilization"], 4),
+        "staleness_bound": stats["staleness_bound"],
+        "max_staleness": stats["max_staleness"],
+        "overlap_observed": stats["overlap_observed"],
+        "reward_curve": [round(r, 4) for r in stats["reward_curve"]],
+        "ledger": stats["ledger"],
+        "batch_log": stats["batch_log"],
+        "final_weights_id": stats["final_weights_id"],
+    }
+
+
+def _run_arm(overlap: bool, rounds: int, work: str):
+    from ray_tpu.rl import RLHFLoop
+    engine, gen, learner = _build(SEED)
+    tag = "overlap" if overlap else "serialized"
+    try:
+        loop = RLHFLoop(
+            gen, learner, _reward_fn, _prompts_fn, rounds=rounds,
+            staleness_bound=1, overlap=overlap,
+            ckpt_dir=os.path.join(work, tag, "ckpt"),
+            publish_dir=os.path.join(work, tag, "pub"),
+            learner_delay_s=LEARNER_DELAY_S)
+        return loop.run()
+    finally:
+        engine.shutdown()
+
+
+def _run_generator_kill(rounds: int, work: str):
+    from ray_tpu.rl import RLHFLoop
+    from ray_tpu.rl.rollout import GeneratorKilled
+
+    engine, gen, learner = _build(SEED)
+    kill_round = rounds // 2
+    killed = []
+
+    def mid_round(r):
+        if r == kill_round and not killed:
+            killed.append(r)
+            raise GeneratorKilled(
+                f"chaos: generator killed mid-round {r}")
+
+    try:
+        loop = RLHFLoop(
+            gen, learner, _reward_fn, _prompts_fn, rounds=rounds,
+            staleness_bound=1, overlap=True,
+            ckpt_dir=os.path.join(work, "genkill", "ckpt"),
+            publish_dir=os.path.join(work, "genkill", "pub"),
+            generator_mid_round_hook=mid_round)
+        stats = loop.run()
+    finally:
+        engine.shutdown()
+    audit = _ledger_audit(stats["ledger"], rounds)
+    return {
+        "kill_round": kill_round,
+        "restarts": stats["generator_restarts"],
+        "rounds": rounds,
+        "ledger_len": len(stats["ledger"]),
+        "duplicates": audit["duplicates"],
+        "lost": audit["lost"],
+        "max_staleness": stats["max_staleness"],
+    }
+
+
+def _run_learner_kill(rounds: int, work: str):
+    from ray_tpu.rl import RLHFLoop
+
+    engine, gen, learner = _build(SEED)
+    kill_step = rounds // 2
+    ckpt = os.path.join(work, "lkill", "ckpt")
+    pub = os.path.join(work, "lkill", "pub")
+    ctl = os.path.join(work, "lkill", "ctl")
+
+    def kill(step):
+        if step == kill_step:
+            raise RuntimeError(
+                f"chaos: learner killed pre-commit at round {step}")
+
+    died = False
+    try:
+        loop = RLHFLoop(
+            gen, learner, _reward_fn, _prompts_fn, rounds=rounds,
+            staleness_bound=1, overlap=True, ckpt_dir=ckpt,
+            publish_dir=pub, control_dir=ctl, attempt=1,
+            learner_kill_hook=kill)
+        loop.run()
+    except RuntimeError as e:
+        died = "chaos: learner killed" in str(e)
+    finally:
+        engine.shutdown()
+
+    # Attempt 2: fresh engine + FRESH learner (all learned state must
+    # come back from the checkpoint), same dirs. The fence supersedes
+    # attempt 1 so a zombie commit can't land.
+    engine2, gen2, learner2 = _build(SEED)
+    try:
+        loop2 = RLHFLoop(
+            gen2, learner2, _reward_fn, _prompts_fn, rounds=rounds,
+            staleness_bound=1, overlap=True, ckpt_dir=ckpt,
+            publish_dir=pub, control_dir=ctl, attempt=2)
+        stats = loop2.run()
+    finally:
+        engine2.shutdown()
+    audit = _ledger_audit(stats["ledger"], rounds)
+    return {
+        "kill_step": kill_step,
+        "first_run_died": died,
+        "resumed": stats["resumed"],
+        "start_round": stats["start_round"],
+        "recovered_weights_id": stats["recovered_weights_id"],
+        "resync_weights_id": stats["resync_weights_id"],
+        "rounds": rounds,
+        "ledger_len": len(stats["ledger"]),
+        "duplicates": audit["duplicates"],
+        "lost": audit["lost"],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..",
+        "SERVE_BENCH_rlhf_ab_cpu_smoke.json"))
+    args = ap.parse_args()
+
+    work = tempfile.mkdtemp(prefix="rl_bench_")
+    try:
+        print(f"[rl_bench] overlap arm ({args.rounds} rounds)...")
+        ov = _run_arm(True, args.rounds, work)
+        print(f"[rl_bench]   reward {ov['reward_curve'][0]:.3f} -> "
+              f"{ov['reward_curve'][-1]:.3f}  util "
+              f"{ov['generator_utilization']:.3f}  overlap_observed "
+              f"{ov['overlap_observed']}")
+        print("[rl_bench] serialized arm...")
+        se = _run_arm(False, args.rounds, work)
+        print(f"[rl_bench]   util {se['generator_utilization']:.3f}")
+        ratio = (ov["generator_utilization"] /
+                 max(se["generator_utilization"], 1e-9))
+        print(f"[rl_bench] utilization ratio {ratio:.3f}")
+        print("[rl_bench] chaos: generator kill mid-round...")
+        gk = _run_generator_kill(max(6, args.rounds // 2), work)
+        print(f"[rl_bench]   restarts={gk['restarts']} "
+              f"dup={gk['duplicates']} lost={gk['lost']}")
+        print("[rl_bench] chaos: learner kill pre-commit...")
+        lk = _run_learner_kill(max(6, args.rounds // 2), work)
+        print(f"[rl_bench]   resumed={lk['resumed']} "
+              f"resync=={lk['resync_weights_id'] == lk['recovered_weights_id']} "
+              f"dup={lk['duplicates']} lost={lk['lost']}")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+    except Exception:
+        sha = None
+
+    artifact = {
+        "rlhf_ab": {
+            "overlap": _arm_record(ov),
+            "serialized": _arm_record(se),
+            "utilization_ratio": round(ratio, 4),
+            "chaos": {
+                "generator_kill": gk,
+                "learner_kill": lk,
+            },
+        },
+        "model": "llama_tiny",
+        "mesh": {"tp": 1, "replicas": 1},
+        "seed": SEED,
+        "git_sha": sha,
+        "notes": (
+            "CPU smoke: tiny llama as rollout generator on "
+            "LANE_BATCH with per-token logprob capture; PPO learner "
+            f"(lr 1e-2, 8 sgd epochs) on a dense toy reward; "
+            f"{N_PROMPTS} prompts x {PROMPT_LEN} tokens, "
+            f"{MAX_NEW} new tokens; learner step padded "
+            f"{LEARNER_DELAY_S}s to make the overlap measurable."),
+    }
+    out = os.path.abspath(args.out)
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"[rl_bench] wrote {out}")
+
+    # Self-gate: refuse to leave a malformed artifact behind.
+    from tools.check_bench_schema import check_serve_bench
+    problems: list = []
+    check_serve_bench(artifact, os.path.basename(out), problems)
+    if problems:
+        for p in problems:
+            print(f"[rl_bench] SCHEMA: {p}", file=sys.stderr)
+        return 1
+    print("[rl_bench] schema gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
